@@ -1,0 +1,25 @@
+"""predictionio_tpu — a TPU-native machine learning server.
+
+A from-scratch re-design of the capabilities of PredictionIO
+(reference: methodmill/PredictionIO): REST event collection with pluggable
+storage, engines composed from pluggable DASE components (DataSource,
+Preparator, Algorithm(s), Serving, Evaluation), a ``pio``-style CLI, a
+deployed REST query server, and metric-driven evaluation/tuning — with the
+Spark/MLlib compute substrate replaced by a JAX/XLA runtime: training runs
+as pjit-sharded XLA programs over a `jax.sharding.Mesh` with ICI collectives
+in place of Spark shuffles, and trained parameters live in HBM behind a
+batched XLA predict path.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+
+  L0  parallel/   device mesh + collectives        (ref: Apache Spark)
+  L1  data/storage/  event + metadata storage      (ref: data/.../storage)
+  L2  data/api/   REST event server                (ref: data/.../api)
+  L3  core/       DASE controller API              (ref: core/.../controller)
+  L4  workflow/   train/eval/deploy runtime        (ref: core/.../workflow)
+  L5  tools/      CLI + ops                        (ref: tools/)
+  L6  templates/  engine templates                 (ref: examples/)
+  L7  models/     algorithm library                (ref: e2/)
+"""
+
+__version__ = "0.1.0"

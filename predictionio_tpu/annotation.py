@@ -1,0 +1,22 @@
+"""API-stability annotations (ref: common/src/main/java/io/prediction/annotation/*.java).
+
+The reference ships ``@DeveloperApi`` and ``@Experimental`` Java annotations;
+here they are no-op decorators that tag the wrapped object so docs and the
+CLI can surface stability levels.
+"""
+
+from __future__ import annotations
+
+
+def developer_api(obj):
+    """Lower-level API for engine/tooling developers; may change across minor
+    versions (ref: common/.../annotation/DeveloperApi.java)."""
+    obj.__pio_developer_api__ = True
+    return obj
+
+
+def experimental(obj):
+    """Experimental API; may change or be removed at any time
+    (ref: common/.../annotation/Experimental.java)."""
+    obj.__pio_experimental__ = True
+    return obj

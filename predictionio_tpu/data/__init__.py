@@ -1,0 +1,4 @@
+"""Event data plane: event model, property maps, storage, ingestion API.
+
+Mirrors the reference's ``data`` module (data/src/main/scala/io/prediction/data/).
+"""

@@ -1,0 +1,80 @@
+"""Property aggregation: fold ``$set/$unset/$delete`` events into the current
+entity properties.
+
+Re-design of the reference's ``LEventAggregator``
+(ref: data/.../storage/LEventAggregator.scala:37-145) and the RDD version
+``PEventAggregator`` (ref: data/.../storage/PEventAggregator.scala:195-209).
+The parallel version here is a plain grouped fold — the downstream TPU input
+pipeline consumes the aggregated maps as columnar batches, so there is no
+per-row distributed shuffle to mirror.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Iterable
+
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event
+
+#: Event names that control aggregation (ref: LEventAggregator.eventNames)
+AGGREGATION_EVENT_NAMES = ("$set", "$unset", "$delete")
+
+
+@dataclass
+class _Prop:
+    dm: DataMap | None = None
+    first_updated: dt.datetime | None = None
+    last_updated: dt.datetime | None = None
+
+
+def _fold_datamap(p: DataMap | None, e: Event) -> DataMap | None:
+    # ref: LEventAggregator.dataMapAggregator:90-110
+    if e.event == "$set":
+        return e.properties if p is None else p.merge(e.properties)
+    if e.event == "$unset":
+        return None if p is None else p.remove(e.properties.key_set())
+    if e.event == "$delete":
+        return None
+    return p
+
+
+def _fold_prop(p: _Prop, e: Event) -> _Prop:
+    # ref: LEventAggregator.propAggregator:113-131
+    if e.event not in AGGREGATION_EVENT_NAMES:
+        return p
+    t = e.event_time
+    return _Prop(
+        dm=_fold_datamap(p.dm, e),
+        first_updated=t if p.first_updated is None else min(p.first_updated, t),
+        last_updated=t if p.last_updated is None else max(p.last_updated, t),
+    )
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> PropertyMap | None:
+    """Fold one entity's events (any order; sorted by event time here) into
+    its current PropertyMap, or None if the entity ended up deleted
+    (ref: LEventAggregator.aggregatePropertiesSingle:66-88)."""
+    prop = _Prop()
+    for e in sorted(events, key=lambda ev: ev.event_time):
+        prop = _fold_prop(prop, e)
+    if prop.dm is None:
+        return None
+    assert prop.first_updated is not None and prop.last_updated is not None
+    return PropertyMap(prop.dm.to_dict(), prop.first_updated, prop.last_updated)
+
+
+def aggregate_properties(events: Iterable[Event]) -> dict[str, PropertyMap]:
+    """Group events by entityId, fold each group, and drop deleted entities
+    (ref: LEventAggregator.aggregateProperties:39-58)."""
+    by_entity: dict[str, list[Event]] = defaultdict(list)
+    for e in events:
+        by_entity[e.entity_id].append(e)
+    out: dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        pm = aggregate_properties_single(evs)
+        if pm is not None:
+            out[entity_id] = pm
+    return out

@@ -1,0 +1,181 @@
+"""Typed access over JSON property maps.
+
+Re-designs the reference's ``DataMap``/``PropertyMap``
+(ref: data/.../storage/DataMap.scala:48-241, data/.../storage/PropertyMap.scala:32).
+Values are plain JSON-compatible Python values (str, int, float, bool, list,
+dict, None); typed getters convert and validate on access the way the
+reference's json4s extraction does.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from collections.abc import Iterator, Mapping
+from typing import Any, TypeVar
+
+from predictionio_tpu.utils.time import parse_datetime
+
+T = TypeVar("T")
+
+_MISSING = object()
+
+
+class DataMapError(Exception):
+    """Raised on missing keys or type mismatches (ref: DataMapException)."""
+
+
+def _convert(name: str, value: Any, as_: type | None):
+    if as_ is None:
+        return value
+    if as_ is _dt.datetime:
+        if isinstance(value, _dt.datetime):
+            return value
+        if isinstance(value, str):
+            return parse_datetime(value)
+        raise DataMapError(f"field {name}: cannot convert {type(value).__name__} to datetime")
+    if as_ is float and isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    if as_ is int and isinstance(value, (int, float)) and not isinstance(value, bool):
+        if isinstance(value, float) and not value.is_integer():
+            raise DataMapError(f"field {name}: {value!r} is not an integer")
+        return int(value)
+    if as_ in (int, float) and isinstance(value, bool):
+        raise DataMapError(f"field {name}: expected {as_.__name__}, got bool")
+    if isinstance(value, as_):
+        return value
+    raise DataMapError(
+        f"field {name}: expected {as_.__name__}, got {type(value).__name__} ({value!r})"
+    )
+
+
+class DataMap(Mapping):
+    """Immutable JSON property map with typed accessors.
+
+    Ref behavior parity: ``get`` raises on a missing key, ``get_opt`` returns
+    None, ``get_or_else`` falls back; ``merge`` is the reference's ``++`` and
+    ``remove`` its ``--`` (ref: DataMap.scala:48-241).
+    """
+
+    __slots__ = ("_fields",)
+
+    def __init__(self, fields: Mapping[str, Any] | None = None):
+        self._fields: dict[str, Any] = dict(fields or {})
+
+    # -- Mapping protocol ---------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._fields[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._fields)
+
+    def __len__(self) -> int:
+        return len(self._fields)
+
+    def __repr__(self) -> str:
+        return f"DataMap({self._fields!r})"
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, DataMap):
+            return self._fields == other._fields
+        if isinstance(other, Mapping):
+            return self._fields == dict(other)
+        return NotImplemented
+
+    def __hash__(self):
+        # key-only hash keeps the hash/eq invariant (values may compare equal
+        # across types, e.g. 1 == 1.0, and may be unhashable containers)
+        return hash(frozenset(self._fields))
+
+    # -- typed accessors ----------------------------------------------------
+    def require(self, name: str) -> None:
+        if name not in self._fields:
+            raise DataMapError(f"The field {name} is required.")
+
+    def get(self, name: str, as_: type | None = None) -> Any:  # type: ignore[override]
+        self.require(name)
+        value = self._fields[name]
+        if value is None:
+            raise DataMapError(f"The required field {name} cannot be null.")
+        return _convert(name, value, as_)
+
+    def get_opt(self, name: str, as_: type | None = None) -> Any | None:
+        if name not in self._fields or self._fields[name] is None:
+            return None
+        return _convert(name, self._fields[name], as_)
+
+    def get_or_else(self, name: str, default: T, as_: type | None = None) -> T | Any:
+        got = self.get_opt(name, as_)
+        return default if got is None else got
+
+    def get_datetime(self, name: str) -> _dt.datetime:
+        return self.get(name, _dt.datetime)
+
+    def get_datetime_opt(self, name: str) -> _dt.datetime | None:
+        return self.get_opt(name, _dt.datetime)
+
+    def get_string_list(self, name: str) -> list[str]:
+        v = self.get(name, list)
+        return [str(x) for x in v]
+
+    def get_double_list(self, name: str) -> list[float]:
+        v = self.get(name, list)
+        return [float(x) for x in v]
+
+    # -- set ops ------------------------------------------------------------
+    def merge(self, other: DataMap | Mapping[str, Any]) -> DataMap:
+        merged = dict(self._fields)
+        merged.update(dict(other))
+        return DataMap(merged)
+
+    def remove(self, keys) -> DataMap:
+        keys = set(keys)
+        return DataMap({k: v for k, v in self._fields.items() if k not in keys})
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._fields
+
+    def key_set(self) -> set[str]:
+        return set(self._fields)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._fields)
+
+    def extract(self, cls: type[T]) -> T:
+        """Bind fields to a dataclass-style constructor by keyword
+        (the reference's ``extract[T]`` case-class binding)."""
+        return cls(**self._fields)  # type: ignore[call-arg]
+
+
+class PropertyMap(DataMap):
+    """A DataMap carrying aggregation bookkeeping: when the entity's
+    properties were first and last written (ref: PropertyMap.scala:32)."""
+
+    __slots__ = ("first_updated", "last_updated")
+
+    def __init__(
+        self,
+        fields: Mapping[str, Any] | None,
+        first_updated: _dt.datetime,
+        last_updated: _dt.datetime,
+    ):
+        super().__init__(fields)
+        self.first_updated = first_updated
+        self.last_updated = last_updated
+
+    def __repr__(self) -> str:
+        return (
+            f"PropertyMap({self._fields!r}, first={self.first_updated.isoformat()}, "
+            f"last={self.last_updated.isoformat()})"
+        )
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, PropertyMap):
+            return (
+                self._fields == other._fields
+                and self.first_updated == other.first_updated
+                and self.last_updated == other.last_updated
+            )
+        return super().__eq__(other)
+
+    __hash__ = DataMap.__hash__

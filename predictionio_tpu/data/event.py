@@ -1,0 +1,177 @@
+"""Canonical event model + validation.
+
+Re-design of the reference's ``Event`` and ``EventValidation``
+(ref: data/.../storage/Event.scala:39-164): an event names something that
+happened to an entity, optionally involving a target entity, with JSON
+properties and two timestamps (event time, system creation time). Special
+``$set/$unset/$delete`` events mutate entity properties and are folded by
+the aggregators in :mod:`predictionio_tpu.data.aggregation`.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import uuid
+from dataclasses import dataclass, field, replace
+from typing import Any, Mapping
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.utils.time import (
+    ensure_aware,
+    format_datetime,
+    now,
+    parse_datetime,
+)
+
+# Reserved names (ref: Event.scala:77-164)
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+BUILTIN_PROPERTIES: frozenset[str] = frozenset()
+
+
+def is_reserved_prefix(name: str) -> bool:
+    return name.startswith("$") or name.startswith("pio_")
+
+
+def is_special_event(name: str) -> bool:
+    return name in SPECIAL_EVENTS
+
+
+class EventValidationError(ValueError):
+    """Event failed validation (ref raises require() IllegalArgumentException)."""
+
+
+@dataclass(frozen=True)
+class Event:
+    """One event (ref: Event.scala:39-57). ``properties`` is a DataMap."""
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: str | None = None
+    target_entity_id: str | None = None
+    properties: DataMap = field(default_factory=DataMap)
+    event_time: dt.datetime = field(default_factory=now)
+    tags: tuple[str, ...] = ()
+    pr_id: str | None = None
+    event_id: str | None = None
+    creation_time: dt.datetime = field(default_factory=now)
+
+    def __post_init__(self):
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(self.properties))
+        object.__setattr__(self, "event_time", ensure_aware(self.event_time))
+        object.__setattr__(self, "creation_time", ensure_aware(self.creation_time))
+        object.__setattr__(self, "tags", tuple(self.tags))
+
+    def with_id(self, event_id: str) -> "Event":
+        return replace(self, event_id=event_id)
+
+    # -- JSON wire format (ref: storage/EventJson4sSupport.scala) -----------
+    def to_json(self, with_id: bool = True) -> dict[str, Any]:
+        d: dict[str, Any] = {}
+        if with_id and self.event_id is not None:
+            d["eventId"] = self.event_id
+        d.update(
+            {
+                "event": self.event,
+                "entityType": self.entity_type,
+                "entityId": self.entity_id,
+            }
+        )
+        if self.target_entity_type is not None:
+            d["targetEntityType"] = self.target_entity_type
+        if self.target_entity_id is not None:
+            d["targetEntityId"] = self.target_entity_id
+        d["properties"] = self.properties.to_dict()
+        d["eventTime"] = format_datetime(self.event_time)
+        if self.tags:
+            d["tags"] = list(self.tags)
+        if self.pr_id is not None:
+            d["prId"] = self.pr_id
+        d["creationTime"] = format_datetime(self.creation_time)
+        return d
+
+    @staticmethod
+    def from_json(d: Mapping[str, Any]) -> "Event":
+        def _time(key: str) -> dt.datetime:
+            v = d.get(key)
+            if v is None:
+                return now()
+            if isinstance(v, dt.datetime):
+                return ensure_aware(v)
+            return parse_datetime(str(v))
+
+        if "event" not in d:
+            raise EventValidationError("field event is required")
+        if "entityType" not in d:
+            raise EventValidationError("field entityType is required")
+        if "entityId" not in d:
+            raise EventValidationError("field entityId is required")
+        props = d.get("properties") or {}
+        if not isinstance(props, Mapping):
+            raise EventValidationError("properties must be a JSON object")
+        return Event(
+            event=str(d["event"]),
+            entity_type=str(d["entityType"]),
+            entity_id=str(d["entityId"]),
+            target_entity_type=(
+                None if d.get("targetEntityType") is None else str(d["targetEntityType"])
+            ),
+            target_entity_id=(
+                None if d.get("targetEntityId") is None else str(d["targetEntityId"])
+            ),
+            properties=DataMap(props),
+            event_time=_time("eventTime"),
+            tags=tuple(d.get("tags") or ()),
+            pr_id=None if d.get("prId") is None else str(d["prId"]),
+            event_id=None if d.get("eventId") is None else str(d["eventId"]),
+            creation_time=_time("creationTime"),
+        )
+
+
+def new_event_id() -> str:
+    """Generate a storage-independent event id (the reference derives ids
+    from the HBase rowkey; we use a UUID hex, ref: HBEventsUtil.RowKey)."""
+    return uuid.uuid4().hex
+
+
+def validate_event(e: Event) -> None:
+    """Validation rules with reference parity (ref: Event.scala:109-141).
+
+    Raises :class:`EventValidationError` when the event violates any rule.
+    """
+
+    def need(cond: bool, msg: str) -> None:
+        if not cond:
+            raise EventValidationError(msg)
+
+    need(bool(e.event), "event must not be empty.")
+    need(bool(e.entity_type), "entityType must not be empty string.")
+    need(bool(e.entity_id), "entityId must not be empty string.")
+    need(e.target_entity_type is None or bool(e.target_entity_type),
+         "targetEntityType must not be empty string")
+    need(e.target_entity_id is None or bool(e.target_entity_id),
+         "targetEntityId must not be empty string.")
+    need(not (e.target_entity_type is not None and e.target_entity_id is None),
+         "targetEntityType and targetEntityId must be specified together.")
+    need(not (e.target_entity_type is None and e.target_entity_id is not None),
+         "targetEntityType and targetEntityId must be specified together.")
+    need(not (e.event == "$unset" and e.properties.is_empty),
+         "properties cannot be empty for $unset event")
+    need(not is_reserved_prefix(e.event) or is_special_event(e.event),
+         f"{e.event} is not a supported reserved event name.")
+    need(not is_special_event(e.event)
+         or (e.target_entity_type is None and e.target_entity_id is None),
+         f"Reserved event {e.event} cannot have targetEntity")
+    need(not is_reserved_prefix(e.entity_type) or e.entity_type in BUILTIN_ENTITY_TYPES,
+         f"The entityType {e.entity_type} is not allowed. "
+         "'pio_' is a reserved name prefix.")
+    need(e.target_entity_type is None
+         or not is_reserved_prefix(e.target_entity_type)
+         or e.target_entity_type in BUILTIN_ENTITY_TYPES,
+         f"The targetEntityType {e.target_entity_type} is not allowed. "
+         "'pio_' is a reserved name prefix.")
+    for k in e.properties.key_set():
+        need(not is_reserved_prefix(k) or k in BUILTIN_PROPERTIES,
+             f"The property {k} is not allowed. 'pio_' is a reserved name prefix.")

@@ -1,0 +1,20 @@
+"""Pluggable storage: events, metadata, and model blobs.
+
+Mirrors the reference's storage registry + DAO-trait design
+(ref: data/.../storage/Storage.scala:112-393): backends are discovered from
+``PIO_STORAGE_SOURCES_<NAME>_TYPE`` / ``PIO_STORAGE_REPOSITORIES_*`` env
+vars and instantiated via a registry, so new backends plug in without
+touching callers.
+"""
+
+from predictionio_tpu.data.storage.base import (  # noqa: F401
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    StorageError,
+)
+from predictionio_tpu.data.storage.registry import Storage  # noqa: F401

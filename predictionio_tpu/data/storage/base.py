@@ -1,0 +1,376 @@
+"""DAO interfaces + metadata records.
+
+Re-design of the reference's storage traits: ``LEvents``
+(ref: data/.../storage/LEvents.scala:36-488), metadata DAOs (``Apps``,
+``AccessKeys``, ``Channels``, ``EngineInstances``, ``EngineManifests``,
+``EvaluationInstances``, ``Models``) and their record case classes.
+
+The reference exposes future-based async CRUD plus blocking wrappers; the
+Python build is synchronous (the event server wraps calls in a thread pool
+— that is where the reference's Futures actually ran too, on the storage
+client's I/O pool). There is no separate ``PEvents``: the parallel-read
+path is :mod:`predictionio_tpu.data.store.p_event_store`, which decodes
+scans into columnar batches for the TPU input pipeline.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import re
+import secrets
+import string
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.event import Event
+
+
+class StorageError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Metadata records (ref: data/.../storage/{Apps,AccessKeys,...}.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class App:
+    """ref: Apps.scala:26-30"""
+
+    id: int
+    name: str
+    description: str | None = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    """ref: AccessKeys.scala:27-31. ``events`` restricts which event names the
+    key may write; empty means unrestricted."""
+
+    key: str
+    appid: int
+    events: tuple[str, ...] = ()
+
+
+_CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+CHANNEL_NAME_CONSTRAINT = (
+    "Only alphanumeric and - characters are allowed and max length is 16."
+)
+
+
+def is_valid_channel_name(name: str) -> bool:
+    """ref: Channels.scala:46-56"""
+    return bool(_CHANNEL_NAME_RE.match(name))
+
+
+@dataclass(frozen=True)
+class Channel:
+    """ref: Channels.scala:27-34; name must be unique within the app."""
+
+    id: int
+    name: str
+    appid: int
+
+    def __post_init__(self):
+        if not is_valid_channel_name(self.name):
+            raise ValueError(
+                f"Invalid channel name: {self.name}. {CHANNEL_NAME_CONSTRAINT}"
+            )
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """One train run's full record (ref: EngineInstances.scala:30-47)."""
+
+    id: str
+    status: str
+    start_time: dt.datetime
+    end_time: dt.datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    spark_conf: dict[str, str] = field(default_factory=dict)
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+
+
+@dataclass(frozen=True)
+class EngineManifest:
+    """Registered engine build (ref: EngineManifests.scala:27-35)."""
+
+    id: str
+    version: str
+    name: str
+    description: str | None
+    files: tuple[str, ...]
+    engine_factory: str
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    """One evaluation run's record (ref: EvaluationInstances.scala:28-45)."""
+
+    id: str = ""
+    status: str = ""
+    start_time: dt.datetime = field(default_factory=lambda: dt.datetime.now(dt.timezone.utc))
+    end_time: dt.datetime = field(default_factory=lambda: dt.datetime.now(dt.timezone.utc))
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    spark_conf: dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass(frozen=True)
+class Model:
+    """Serialized model blob keyed by engine-instance id (ref: Models.scala:27-31)."""
+
+    id: str
+    models: bytes
+
+
+def generate_access_key() -> str:
+    """Random 64-char url-safe key (ref: AccessKeys.scala:62-64)."""
+    alphabet = string.ascii_letters + string.digits + "-_"
+    return "".join(secrets.choice(alphabet) for _ in range(64))
+
+
+# ---------------------------------------------------------------------------
+# Events DAO (ref: LEvents.scala)
+# ---------------------------------------------------------------------------
+
+
+class Events(ABC):
+    """Event CRUD + range find + aggregation, per app/channel
+    (ref: LEvents.scala:36-488; the blocking-wrapper surface)."""
+
+    @abstractmethod
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Initialize backing storage for an app/channel (ref: LEvents.scala:46)."""
+
+    @abstractmethod
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Drop all events of an app/channel (ref: LEvents.scala:56)."""
+
+    @abstractmethod
+    def close(self) -> None:
+        """Release client connections (ref: LEvents.scala:66)."""
+
+    @abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        """Insert, returning the event id (ref: LEvents.scala:87)."""
+
+    @abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        """ref: LEvents.scala futureGet"""
+
+    @abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        """ref: LEvents.scala futureDelete; True if the event existed."""
+
+    @abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None | type(...) = ...,
+        target_entity_id: str | None | type(...) = ...,
+        limit: int | None = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        """Range scan (ref: LEvents.scala:164-221). ``target_entity_type=None``
+        means "must have no target entity" — matching the reference's
+        ``Option[Option[String]]`` — while leaving it at the default ``...``
+        means "don't filter". ``limit=None`` or ``-1`` means no cap; events
+        come back in event-time order, reversed when ``reversed_``."""
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        channel_id: int | None,
+        entity_type: str,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ):
+        """Aggregate ``$set/$unset/$delete`` into current entity properties
+        (ref: LEvents.scala:191-261, delegating to LEventAggregator)."""
+        from predictionio_tpu.data.aggregation import (
+            AGGREGATION_EVENT_NAMES,
+            aggregate_properties,
+        )
+
+        events = self.find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=list(AGGREGATION_EVENT_NAMES),
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {
+                k: v for k, v in result.items() if req.issubset(v.key_set())
+            }
+        return result
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAO interfaces (ref: data/.../storage/*.scala traits)
+# ---------------------------------------------------------------------------
+
+
+class Apps(ABC):
+    @abstractmethod
+    def insert(self, app: App) -> int | None:
+        """Insert; returns generated id when ``app.id == 0`` (ref: Apps.scala:40)."""
+
+    @abstractmethod
+    def get(self, app_id: int) -> App | None: ...
+
+    @abstractmethod
+    def get_by_name(self, name: str) -> App | None: ...
+
+    @abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(ABC):
+    @abstractmethod
+    def insert(self, access_key: AccessKey) -> str | None:
+        """Insert; generates the key when empty (ref: AccessKeys.scala:43-64)."""
+
+    @abstractmethod
+    def get(self, key: str) -> AccessKey | None: ...
+
+    @abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]: ...
+
+    @abstractmethod
+    def update(self, access_key: AccessKey) -> bool: ...
+
+    @abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(ABC):
+    @abstractmethod
+    def insert(self, channel: Channel) -> int | None:
+        """Insert; returns generated id when ``channel.id == 0``."""
+
+    @abstractmethod
+    def get(self, channel_id: int) -> Channel | None: ...
+
+    @abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[Channel]: ...
+
+    @abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(ABC):
+    @abstractmethod
+    def insert(self, instance: EngineInstance) -> str:
+        """Insert; returns generated id."""
+
+    @abstractmethod
+    def get(self, instance_id: str) -> EngineInstance | None: ...
+
+    @abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None:
+        """Latest COMPLETED instance for deploy (ref: EngineInstances.scala:66)."""
+
+    @abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]: ...
+
+    @abstractmethod
+    def update(self, instance: EngineInstance) -> bool: ...
+
+    @abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EngineManifests(ABC):
+    @abstractmethod
+    def insert(self, manifest: EngineManifest) -> None: ...
+
+    @abstractmethod
+    def get(self, manifest_id: str, version: str) -> EngineManifest | None: ...
+
+    @abstractmethod
+    def get_all(self) -> list[EngineManifest]: ...
+
+    @abstractmethod
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None: ...
+
+    @abstractmethod
+    def delete(self, manifest_id: str, version: str) -> None: ...
+
+
+class EvaluationInstances(ABC):
+    @abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abstractmethod
+    def get(self, instance_id: str) -> EvaluationInstance | None: ...
+
+    @abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]:
+        """Completed evaluations, most recent first (for the dashboard)."""
+
+    @abstractmethod
+    def update(self, instance: EvaluationInstance) -> bool: ...
+
+    @abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class Models(ABC):
+    @abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abstractmethod
+    def get(self, model_id: str) -> Model | None: ...
+
+    @abstractmethod
+    def delete(self, model_id: str) -> bool: ...

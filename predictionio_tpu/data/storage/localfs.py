@@ -1,0 +1,47 @@
+"""Local-filesystem model blob store.
+
+Mirrors the reference's localfs/HDFS backends, which cover only the Models
+DAO (ref: data/.../storage/localfs/LocalFSModels.scala:28-60,
+data/.../storage/hdfs/HDFSModels.scala:28-60).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import Model
+
+
+class LocalFSClient:
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        self.base_path = Path(
+            config.get("PATH") or (Path.home() / ".pio_store" / "models")
+        )
+        self.base_path.mkdir(parents=True, exist_ok=True)
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, client: LocalFSClient, prefix: str = ""):
+        self._dir = client.base_path
+        self._prefix = prefix
+
+    def _path(self, model_id: str) -> Path:
+        return self._dir / f"{self._prefix}{model_id}.bin"
+
+    def insert(self, model: Model) -> None:
+        self._path(model.id).write_bytes(model.models)
+
+    def get(self, model_id: str):
+        p = self._path(model_id)
+        if not p.exists():
+            return None
+        return Model(model_id, p.read_bytes())
+
+    def delete(self, model_id: str) -> bool:
+        p = self._path(model_id)
+        if not p.exists():
+            return False
+        p.unlink()
+        return True

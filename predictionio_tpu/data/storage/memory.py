@@ -1,0 +1,358 @@
+"""In-memory storage backend — the test/dev backend.
+
+Plays the role the reference's in-JVM test fixtures play; implements every
+DAO so the whole stack can run without a database (the reference's nearest
+analog is the localfs/HDFS model store plus test storage config,
+ref: data/src/test/resources/application.conf).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import itertools
+import threading
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    StorageError,
+    generate_access_key,
+)
+
+
+class MemClient:
+    """Shared state for one named storage source."""
+
+    def __init__(self, config: dict | None = None):
+        self.lock = threading.RLock()
+        self.tables: dict[str, dict] = {}
+
+    def table(self, name: str) -> dict:
+        with self.lock:
+            return self.tables.setdefault(name, {})
+
+    def drop(self, name: str) -> bool:
+        with self.lock:
+            return self.tables.pop(name, None) is not None
+
+
+def _event_key(app_id: int, channel_id: int | None) -> str:
+    return f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
+
+
+class MemEvents(base.Events):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._prefix = prefix
+
+    def _tname(self, app_id: int, channel_id: int | None) -> str:
+        return self._prefix + _event_key(app_id, channel_id)
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        self._c.table(self._tname(app_id, channel_id))
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        return self._c.drop(self._tname(app_id, channel_id))
+
+    def close(self) -> None:
+        pass
+
+    def _store(self, app_id: int, channel_id: int | None) -> dict:
+        name = self._tname(app_id, channel_id)
+        with self._c.lock:
+            if name not in self._c.tables:
+                raise StorageError(
+                    f"Event store for app {app_id} channel {channel_id} is not "
+                    "initialized; run `pio app new` first."
+                )
+            return self._c.tables[name]
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        eid = event.event_id or new_event_id()
+        with self._c.lock:
+            self._store(app_id, channel_id)[eid] = event.with_id(eid)
+        return eid
+
+    def get(self, event_id: str, app_id: int, channel_id: int | None = None):
+        with self._c.lock:
+            return self._store(app_id, channel_id).get(event_id)
+
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        with self._c.lock:
+            return self._store(app_id, channel_id).pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        with self._c.lock:
+            events = list(self._store(app_id, channel_id).values())
+
+        def ok(e: Event) -> bool:
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if entity_id is not None and e.entity_id != entity_id:
+                return False
+            if event_names is not None and e.event not in event_names:
+                return False
+            if target_entity_type is not ... and e.target_entity_type != target_entity_type:
+                return False
+            if target_entity_id is not ... and e.target_entity_id != target_entity_id:
+                return False
+            return True
+
+        out = sorted(
+            (e for e in events if ok(e)),
+            key=lambda e: e.event_time,
+            reverse=reversed_,
+        )
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return iter(out)
+
+
+class MemApps(base.Apps):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "apps")
+        self._seq = itertools.count(1)
+
+    def insert(self, app: App) -> int | None:
+        with self._c.lock:
+            if any(a.name == app.name for a in self._t.values()):
+                return None
+            app_id = app.id if app.id != 0 else next(
+                i for i in self._seq if i not in self._t
+            )
+            if app_id in self._t:
+                return None
+            self._t[app_id] = App(app_id, app.name, app.description)
+            return app_id
+
+    def get(self, app_id: int):
+        return self._t.get(app_id)
+
+    def get_by_name(self, name: str):
+        return next((a for a in self._t.values() if a.name == name), None)
+
+    def get_all(self):
+        return list(self._t.values())
+
+    def update(self, app: App) -> bool:
+        with self._c.lock:
+            if app.id not in self._t:
+                return False
+            self._t[app.id] = app
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self._c.lock:
+            return self._t.pop(app_id, None) is not None
+
+
+class MemAccessKeys(base.AccessKeys):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "access_keys")
+
+    def insert(self, access_key: AccessKey) -> str | None:
+        key = access_key.key or generate_access_key()
+        with self._c.lock:
+            if key in self._t:
+                return None
+            self._t[key] = AccessKey(key, access_key.appid, tuple(access_key.events))
+            return key
+
+    def get(self, key: str):
+        return self._t.get(key)
+
+    def get_all(self):
+        return list(self._t.values())
+
+    def get_by_app_id(self, app_id: int):
+        return [k for k in self._t.values() if k.appid == app_id]
+
+    def update(self, access_key: AccessKey) -> bool:
+        with self._c.lock:
+            if access_key.key not in self._t:
+                return False
+            self._t[access_key.key] = access_key
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._c.lock:
+            return self._t.pop(key, None) is not None
+
+
+class MemChannels(base.Channels):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "channels")
+        self._seq = itertools.count(1)
+
+    def insert(self, channel: Channel) -> int | None:
+        with self._c.lock:
+            cid = channel.id if channel.id != 0 else next(
+                i for i in self._seq if i not in self._t
+            )
+            if cid in self._t:
+                return None
+            if any(
+                c.appid == channel.appid and c.name == channel.name
+                for c in self._t.values()
+            ):
+                return None
+            self._t[cid] = Channel(cid, channel.name, channel.appid)
+            return cid
+
+    def get(self, channel_id: int):
+        return self._t.get(channel_id)
+
+    def get_by_app_id(self, app_id: int):
+        return [c for c in self._t.values() if c.appid == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._c.lock:
+            return self._t.pop(channel_id, None) is not None
+
+
+class MemEngineInstances(base.EngineInstances):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "engine_instances")
+        self._seq = itertools.count(1)
+
+    def insert(self, instance: EngineInstance) -> str:
+        with self._c.lock:
+            iid = instance.id or str(next(self._seq))
+            self._t[iid] = base.EngineInstance(**{**instance.__dict__, "id": iid})
+            return iid
+
+    def get(self, instance_id: str):
+        return self._t.get(instance_id)
+
+    def get_all(self):
+        return list(self._t.values())
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        out = [
+            i
+            for i in self._t.values()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        return sorted(out, key=lambda i: i.start_time, reverse=True)
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, instance: EngineInstance) -> bool:
+        with self._c.lock:
+            if instance.id not in self._t:
+                return False
+            self._t[instance.id] = instance
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._c.lock:
+            return self._t.pop(instance_id, None) is not None
+
+
+class MemEngineManifests(base.EngineManifests):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "engine_manifests")
+
+    def insert(self, manifest: EngineManifest) -> None:
+        with self._c.lock:
+            self._t[(manifest.id, manifest.version)] = manifest
+
+    def get(self, manifest_id: str, version: str):
+        return self._t.get((manifest_id, version))
+
+    def get_all(self):
+        return list(self._t.values())
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        self.insert(manifest)
+
+    def delete(self, manifest_id: str, version: str) -> None:
+        with self._c.lock:
+            self._t.pop((manifest_id, version), None)
+
+
+class MemEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "evaluation_instances")
+        self._seq = itertools.count(1)
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        with self._c.lock:
+            iid = instance.id or str(next(self._seq))
+            self._t[iid] = base.EvaluationInstance(**{**instance.__dict__, "id": iid})
+            return iid
+
+    def get(self, instance_id: str):
+        return self._t.get(instance_id)
+
+    def get_all(self):
+        return list(self._t.values())
+
+    def get_completed(self):
+        out = [i for i in self._t.values() if i.status == "EVALCOMPLETED"]
+        return sorted(out, key=lambda i: i.start_time, reverse=True)
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        with self._c.lock:
+            if instance.id not in self._t:
+                return False
+            self._t[instance.id] = instance
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._c.lock:
+            return self._t.pop(instance_id, None) is not None
+
+
+class MemModels(base.Models):
+    def __init__(self, client: MemClient, prefix: str = ""):
+        self._c = client
+        self._t = client.table(prefix + "models")
+
+    def insert(self, model: Model) -> None:
+        with self._c.lock:
+            self._t[model.id] = model
+
+    def get(self, model_id: str):
+        return self._t.get(model_id)
+
+    def delete(self, model_id: str) -> bool:
+        with self._c.lock:
+            return self._t.pop(model_id, None) is not None

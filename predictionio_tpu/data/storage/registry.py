@@ -1,0 +1,266 @@
+"""Env-var–driven storage registry.
+
+Re-design of the reference's ``Storage`` object
+(ref: data/.../storage/Storage.scala:112-393): storage *sources* are
+declared via ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ per-source config keys),
+and the three *repositories* — METADATA, EVENTDATA, MODELDATA — are bound to
+sources via ``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``. DAOs are
+resolved by naming convention, mirroring the reference's reflective
+``io.prediction.data.storage.<type>.<prefix><TraitName>`` instantiation
+(ref: Storage.scala:263-312): module ``predictionio_tpu.data.storage.<type>``
+must expose ``<ClassPrefix><DAOName>`` classes and a ``<ClassPrefix>Client``.
+
+With no env configuration, a SQLite source at ``$PIO_FS_BASEDIR/pio.db``
+(default ``~/.pio_store/pio.db``) backs all three repositories — the
+same "single full-coverage SQL backend" default posture as the reference's
+PostgreSQL quickstart config (ref: conf/pio-env.sh.template).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import re
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+
+from predictionio_tpu.data.storage.base import StorageError
+
+logger = logging.getLogger(__name__)
+
+#: backend type → (module name, class prefix). Mirrors the reference's
+#: convention where HBase classes are ``HB*``, JDBC are ``JDBC*`` etc.
+BACKEND_TYPES = {
+    "sqlite": ("predictionio_tpu.data.storage.sql", "SQL"),
+    "memory": ("predictionio_tpu.data.storage.memory", "Mem"),
+    "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
+}
+
+_REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+
+@dataclass
+class SourceConfig:
+    name: str
+    type: str
+    config: dict[str, str]
+
+
+@dataclass
+class RepositoryConfig:
+    repo: str
+    source: str
+    prefix: str
+
+
+def _default_base_dir() -> str:
+    return os.environ.get(
+        "PIO_FS_BASEDIR", str(Path.home() / ".pio_store")
+    )
+
+
+class Storage:
+    """Process-wide storage registry (singleton, like the reference's
+    ``Storage`` object). Call :meth:`reset` to re-read env config (tests)."""
+
+    _lock = threading.RLock()
+    _instance: "Storage | None" = None
+
+    def __init__(self):
+        self.sources: dict[str, SourceConfig] = {}
+        self.repositories: dict[str, RepositoryConfig] = {}
+        self._clients: dict[str, object] = {}
+        self._daos: dict[tuple[str, str], object] = {}
+        self._parse_env()
+
+    # -- singleton ----------------------------------------------------------
+    @classmethod
+    def instance(cls) -> "Storage":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Storage()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                for client in cls._instance._clients.values():
+                    close = getattr(client, "close", None)
+                    if close:
+                        try:
+                            close()
+                        except Exception:  # pragma: no cover - best effort
+                            pass
+            cls._instance = None
+
+    # -- env parsing (ref: Storage.scala:122-165) ---------------------------
+    def _parse_env(self) -> None:
+        env = os.environ
+        raw_sources: dict[str, dict[str, str]] = {}
+        for key, value in env.items():
+            m = _SOURCE_RE.match(key)
+            if m:
+                raw_sources.setdefault(m.group(1), {})[m.group(2)] = value
+        for name, cfg in raw_sources.items():
+            stype = cfg.pop("TYPE", None)
+            if not stype:
+                logger.warning("Storage source %s has no TYPE; skipped", name)
+                continue
+            if stype.lower() in BACKEND_TYPES:
+                stype = stype.lower()
+            self.sources[name] = SourceConfig(name, stype, cfg)
+
+        raw_repos: dict[str, dict[str, str]] = {}
+        for key, value in env.items():
+            m = _REPO_RE.match(key)
+            if m:
+                raw_repos.setdefault(m.group(1), {})[m.group(2)] = value
+        for repo, cfg in raw_repos.items():
+            if "SOURCE" not in cfg:
+                continue
+            self.repositories[repo] = RepositoryConfig(
+                repo=repo,
+                source=cfg["SOURCE"],
+                prefix=cfg.get("NAME", f"pio_{repo.lower()}") + "_",
+            )
+
+        # default wiring when nothing is configured
+        if not self.sources:
+            base = _default_base_dir()
+            self.sources["PIO_TPU_DEFAULT"] = SourceConfig(
+                "PIO_TPU_DEFAULT",
+                "sqlite",
+                {"PATH": str(Path(base) / "pio.db")},
+            )
+        default_source = next(iter(self.sources))
+        for repo in _REPOSITORIES:
+            if repo not in self.repositories:
+                self.repositories[repo] = RepositoryConfig(
+                    repo=repo,
+                    source=default_source,
+                    prefix=f"pio_{repo.lower()}_",
+                )
+
+    # -- client / DAO resolution (ref: Storage.scala:210-312) ---------------
+    def _backend(self, stype: str) -> tuple[str, str]:
+        if stype in BACKEND_TYPES:
+            return BACKEND_TYPES[stype]
+        # third-party backends: TYPE is a module path exposing <Prefix>* with
+        # prefix declared as CLASS_PREFIX at module level
+        try:
+            mod = importlib.import_module(stype)
+            return stype, getattr(mod, "CLASS_PREFIX")
+        except Exception as e:
+            raise StorageError(f"Unknown storage backend type: {stype}") from e
+
+    def _client(self, source_name: str):
+        with self._lock:
+            if source_name in self._clients:
+                return self._clients[source_name]
+            if source_name not in self.sources:
+                raise StorageError(f"Undefined storage source: {source_name}")
+            src = self.sources[source_name]
+            mod_name, prefix = self._backend(src.type)
+            mod = importlib.import_module(mod_name)
+            client_cls = getattr(mod, f"{prefix}Client")
+            client = client_cls(src.config)
+            self._clients[source_name] = client
+            return client
+
+    def _dao(self, repo: str, dao_name: str):
+        with self._lock:
+            cache_key = (repo, dao_name)
+            if cache_key in self._daos:
+                return self._daos[cache_key]
+            if repo not in self.repositories:
+                raise StorageError(f"Undefined storage repository: {repo}")
+            rcfg = self.repositories[repo]
+            src = self.sources.get(rcfg.source)
+            if src is None:
+                raise StorageError(
+                    f"Repository {repo} references undefined source {rcfg.source}"
+                )
+            mod_name, prefix = self._backend(src.type)
+            mod = importlib.import_module(mod_name)
+            cls = getattr(mod, f"{prefix}{dao_name}", None)
+            if cls is None:
+                raise StorageError(
+                    f"Storage backend {src.type} does not implement {dao_name}"
+                )
+            dao = cls(self._client(rcfg.source), rcfg.prefix)
+            self._daos[cache_key] = dao
+            return dao
+
+    # -- typed accessors (ref: Storage.scala:350-381) -----------------------
+    @classmethod
+    def get_events(cls):
+        """The LEvents analog (ref: Storage.getLEvents)."""
+        return cls.instance()._dao("EVENTDATA", "Events")
+
+    @classmethod
+    def get_meta_data_apps(cls):
+        return cls.instance()._dao("METADATA", "Apps")
+
+    @classmethod
+    def get_meta_data_access_keys(cls):
+        return cls.instance()._dao("METADATA", "AccessKeys")
+
+    @classmethod
+    def get_meta_data_channels(cls):
+        return cls.instance()._dao("METADATA", "Channels")
+
+    @classmethod
+    def get_meta_data_engine_instances(cls):
+        return cls.instance()._dao("METADATA", "EngineInstances")
+
+    @classmethod
+    def get_meta_data_engine_manifests(cls):
+        return cls.instance()._dao("METADATA", "EngineManifests")
+
+    @classmethod
+    def get_meta_data_evaluation_instances(cls):
+        return cls.instance()._dao("METADATA", "EvaluationInstances")
+
+    @classmethod
+    def get_model_data_models(cls):
+        return cls.instance()._dao("MODELDATA", "Models")
+
+    # -- smoke test (ref: Storage.verifyAllDataObjects:325-348) -------------
+    @classmethod
+    def verify_all_data_objects(cls) -> list[str]:
+        """Instantiate every DAO and round-trip a write/delete against the
+        event store for app id 0. Returns a list of failures (empty = OK)."""
+        from predictionio_tpu.data.event import Event
+
+        failures: list[str] = []
+        for getter in (
+            cls.get_meta_data_apps,
+            cls.get_meta_data_access_keys,
+            cls.get_meta_data_channels,
+            cls.get_meta_data_engine_instances,
+            cls.get_meta_data_engine_manifests,
+            cls.get_meta_data_evaluation_instances,
+            cls.get_model_data_models,
+        ):
+            try:
+                getter()
+            except Exception as e:
+                failures.append(f"{getter.__name__}: {e}")
+        try:
+            events = cls.get_events()
+            events.init(0)
+            eid = events.insert(
+                Event(event="$set", entity_type="pio_test", entity_id="pio_test"),
+                0,
+            )
+            events.delete(eid, 0)
+            events.remove(0)
+        except Exception as e:
+            failures.append(f"event store round-trip: {e}")
+        return failures

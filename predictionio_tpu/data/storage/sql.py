@@ -1,0 +1,691 @@
+"""SQL storage backend (SQLite) — the full-coverage backend.
+
+Plays the role of the reference's JDBC backend, its only backend covering
+events + all metadata + models in one database
+(ref: data/.../storage/jdbc/*.scala, JDBCLEvents/JDBCModels/JDBCApps/...).
+Events live in one table per app/channel named ``events_<appId>[_<ch>]``,
+matching the reference's table-per-app layout (ref: JDBCUtils.eventTableName),
+with an ``(entityType, entityId, eventTime)`` index serving the same
+entity-time range scans the HBase rowkey serves
+(ref: data/.../storage/hbase/HBEventsUtil.scala:81-128).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import sqlite3
+import threading
+import uuid
+from pathlib import Path
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    StorageError,
+    generate_access_key,
+)
+from predictionio_tpu.utils.time import format_datetime, parse_datetime, to_millis
+
+
+class SQLClient:
+    """One sqlite database shared by all DAOs of a storage source."""
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        path = config.get("PATH") or config.get("URL") or ":memory:"
+        if path != ":memory:":
+            Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self.lock = threading.RLock()
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.execute("PRAGMA synchronous=NORMAL")
+
+    def execute(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
+        with self.lock:
+            cur = self.conn.execute(sql, params)
+            self.conn.commit()
+            return cur
+
+    def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
+        with self.lock:
+            return self.conn.execute(sql, params).fetchall()
+
+    def close(self):
+        with self.lock:
+            self.conn.close()
+
+
+def _event_table(prefix: str, app_id: int, channel_id: int | None) -> str:
+    name = f"{prefix}events_{app_id}"
+    if channel_id:
+        name += f"_{channel_id}"
+    return name
+
+
+_EVENT_COLS = (
+    "id, event, entityType, entityId, targetEntityType, targetEntityId, "
+    "properties, eventTime, eventTimeMs, tags, prId, creationTime"
+)
+
+
+class SQLEvents(base.Events):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._prefix = prefix
+
+    def _t(self, app_id: int, channel_id: int | None) -> str:
+        return _event_table(self._prefix, app_id, channel_id)
+
+    def _exists(self, table: str) -> bool:
+        return bool(
+            self._c.query(
+                "SELECT 1 FROM sqlite_master WHERE type='table' AND name=?", (table,)
+            )
+        )
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        t = self._t(app_id, channel_id)
+        with self._c.lock:
+            self._c.execute(
+                f"""CREATE TABLE IF NOT EXISTS "{t}" (
+                    id TEXT PRIMARY KEY,
+                    event TEXT NOT NULL,
+                    entityType TEXT NOT NULL,
+                    entityId TEXT NOT NULL,
+                    targetEntityType TEXT,
+                    targetEntityId TEXT,
+                    properties TEXT NOT NULL,
+                    eventTime TEXT NOT NULL,
+                    eventTimeMs INTEGER NOT NULL,
+                    tags TEXT NOT NULL,
+                    prId TEXT,
+                    creationTime TEXT NOT NULL
+                )"""
+            )
+            self._c.execute(
+                f'CREATE INDEX IF NOT EXISTS "{t}_entity_time" '
+                f'ON "{t}" (entityType, entityId, eventTimeMs)'
+            )
+            self._c.execute(
+                f'CREATE INDEX IF NOT EXISTS "{t}_time" ON "{t}" (eventTimeMs)'
+            )
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        t = self._t(app_id, channel_id)
+        if not self._exists(t):
+            return False
+        self._c.execute(f'DROP TABLE "{t}"')
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def _require(self, app_id: int, channel_id: int | None) -> str:
+        t = self._t(app_id, channel_id)
+        if not self._exists(t):
+            raise StorageError(
+                f"Event store for app {app_id} channel {channel_id} is not "
+                "initialized; run `pio app new` first."
+            )
+        return t
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        t = self._require(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        self._c.execute(
+            f'INSERT OR REPLACE INTO "{t}" ({_EVENT_COLS}) '
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                eid,
+                event.event,
+                event.entity_type,
+                event.entity_id,
+                event.target_entity_type,
+                event.target_entity_id,
+                json.dumps(event.properties.to_dict()),
+                format_datetime(event.event_time),
+                to_millis(event.event_time),
+                json.dumps(list(event.tags)),
+                event.pr_id,
+                format_datetime(event.creation_time),
+            ),
+        )
+        return eid
+
+    @staticmethod
+    def _row_to_event(row: tuple) -> Event:
+        (
+            eid, name, etype, eid2, tetype, teid, props, etime, _ms, tags, prid, ctime,
+        ) = row
+        return Event(
+            event=name,
+            entity_type=etype,
+            entity_id=eid2,
+            target_entity_type=tetype,
+            target_entity_id=teid,
+            properties=DataMap(json.loads(props)),
+            event_time=parse_datetime(etime),
+            tags=tuple(json.loads(tags)),
+            pr_id=prid,
+            event_id=eid,
+            creation_time=parse_datetime(ctime),
+        )
+
+    def get(self, event_id: str, app_id: int, channel_id: int | None = None):
+        t = self._require(app_id, channel_id)
+        rows = self._c.query(
+            f'SELECT {_EVENT_COLS} FROM "{t}" WHERE id=?', (event_id,)
+        )
+        return self._row_to_event(rows[0]) if rows else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        t = self._require(app_id, channel_id)
+        cur = self._c.execute(f'DELETE FROM "{t}" WHERE id=?', (event_id,))
+        return cur.rowcount > 0
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        t = self._require(app_id, channel_id)
+        where, params = [], []
+        if start_time is not None:
+            where.append("eventTimeMs >= ?")
+            params.append(to_millis(start_time))
+        if until_time is not None:
+            where.append("eventTimeMs < ?")
+            params.append(to_millis(until_time))
+        if entity_type is not None:
+            where.append("entityType = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            where.append("entityId = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            where.append(
+                "event IN (" + ",".join("?" * len(event_names)) + ")"
+            )
+            params.extend(event_names)
+        if target_entity_type is not ...:
+            if target_entity_type is None:
+                where.append("targetEntityType IS NULL")
+            else:
+                where.append("targetEntityType = ?")
+                params.append(target_entity_type)
+        if target_entity_id is not ...:
+            if target_entity_id is None:
+                where.append("targetEntityId IS NULL")
+            else:
+                where.append("targetEntityId = ?")
+                params.append(target_entity_id)
+        sql = f'SELECT {_EVENT_COLS} FROM "{t}"'
+        if where:
+            sql += " WHERE " + " AND ".join(where)
+        sql += " ORDER BY eventTimeMs " + ("DESC" if reversed_ else "ASC")
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {int(limit)}"
+        return (self._row_to_event(row) for row in self._c.query(sql, params))
+
+
+def _new_instance_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class SQLApps(base.Apps):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "apps"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT UNIQUE NOT NULL, "
+            "description TEXT)"
+        )
+
+    def insert(self, app: App) -> int | None:
+        try:
+            with self._c.lock:
+                if app.id != 0:
+                    self._c.execute(
+                        f'INSERT INTO "{self._t}" (id, name, description) VALUES (?,?,?)',
+                        (app.id, app.name, app.description),
+                    )
+                    return app.id
+                cur = self._c.execute(
+                    f'INSERT INTO "{self._t}" (name, description) VALUES (?,?)',
+                    (app.name, app.description),
+                )
+                return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def _get(self, where: str, params) -> App | None:
+        rows = self._c.query(
+            f'SELECT id, name, description FROM "{self._t}" WHERE {where}', params
+        )
+        return App(*rows[0]) if rows else None
+
+    def get(self, app_id: int):
+        return self._get("id=?", (app_id,))
+
+    def get_by_name(self, name: str):
+        return self._get("name=?", (name,))
+
+    def get_all(self):
+        return [
+            App(*r)
+            for r in self._c.query(f'SELECT id, name, description FROM "{self._t}"')
+        ]
+
+    def update(self, app: App) -> bool:
+        cur = self._c.execute(
+            f'UPDATE "{self._t}" SET name=?, description=? WHERE id=?',
+            (app.name, app.description, app.id),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, app_id: int) -> bool:
+        cur = self._c.execute(f'DELETE FROM "{self._t}" WHERE id=?', (app_id,))
+        return cur.rowcount > 0
+
+
+class SQLAccessKeys(base.AccessKeys):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "access_keys"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "accesskey TEXT PRIMARY KEY, appid INTEGER NOT NULL, events TEXT NOT NULL)"
+        )
+
+    def insert(self, access_key: AccessKey) -> str | None:
+        key = access_key.key or generate_access_key()
+        try:
+            self._c.execute(
+                f'INSERT INTO "{self._t}" (accesskey, appid, events) VALUES (?,?,?)',
+                (key, access_key.appid, json.dumps(list(access_key.events))),
+            )
+            return key
+        except sqlite3.IntegrityError:
+            return None
+
+    @staticmethod
+    def _row(r) -> AccessKey:
+        return AccessKey(r[0], r[1], tuple(json.loads(r[2])))
+
+    def get(self, key: str):
+        rows = self._c.query(
+            f'SELECT accesskey, appid, events FROM "{self._t}" WHERE accesskey=?',
+            (key,),
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self):
+        return [
+            self._row(r)
+            for r in self._c.query(f'SELECT accesskey, appid, events FROM "{self._t}"')
+        ]
+
+    def get_by_app_id(self, app_id: int):
+        return [
+            self._row(r)
+            for r in self._c.query(
+                f'SELECT accesskey, appid, events FROM "{self._t}" WHERE appid=?',
+                (app_id,),
+            )
+        ]
+
+    def update(self, access_key: AccessKey) -> bool:
+        cur = self._c.execute(
+            f'UPDATE "{self._t}" SET appid=?, events=? WHERE accesskey=?',
+            (access_key.appid, json.dumps(list(access_key.events)), access_key.key),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, key: str) -> bool:
+        cur = self._c.execute(f'DELETE FROM "{self._t}" WHERE accesskey=?', (key,))
+        return cur.rowcount > 0
+
+
+class SQLChannels(base.Channels):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "channels"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT NOT NULL, "
+            "appid INTEGER NOT NULL, UNIQUE(appid, name))"
+        )
+
+    def insert(self, channel: Channel) -> int | None:
+        try:
+            with self._c.lock:
+                if channel.id != 0:
+                    self._c.execute(
+                        f'INSERT INTO "{self._t}" (id, name, appid) VALUES (?,?,?)',
+                        (channel.id, channel.name, channel.appid),
+                    )
+                    return channel.id
+                cur = self._c.execute(
+                    f'INSERT INTO "{self._t}" (name, appid) VALUES (?,?)',
+                    (channel.name, channel.appid),
+                )
+                return cur.lastrowid
+        except sqlite3.IntegrityError:
+            return None
+
+    def get(self, channel_id: int):
+        rows = self._c.query(
+            f'SELECT id, name, appid FROM "{self._t}" WHERE id=?', (channel_id,)
+        )
+        return Channel(*rows[0]) if rows else None
+
+    def get_by_app_id(self, app_id: int):
+        return [
+            Channel(*r)
+            for r in self._c.query(
+                f'SELECT id, name, appid FROM "{self._t}" WHERE appid=?', (app_id,)
+            )
+        ]
+
+    def delete(self, channel_id: int) -> bool:
+        cur = self._c.execute(f'DELETE FROM "{self._t}" WHERE id=?', (channel_id,))
+        return cur.rowcount > 0
+
+
+def _dt_out(t: dt.datetime) -> str:
+    return format_datetime(t)
+
+
+_EI_COLS = (
+    "id, status, startTime, endTime, engineId, engineVersion, engineVariant, "
+    "engineFactory, batch, env, sparkConf, dataSourceParams, preparatorParams, "
+    "algorithmsParams, servingParams, startTimeMs"
+)
+
+
+class SQLEngineInstances(base.EngineInstances):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "engine_instances"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "id TEXT PRIMARY KEY, status TEXT, startTime TEXT, endTime TEXT, "
+            "engineId TEXT, engineVersion TEXT, engineVariant TEXT, "
+            "engineFactory TEXT, batch TEXT, env TEXT, sparkConf TEXT, "
+            "dataSourceParams TEXT, preparatorParams TEXT, algorithmsParams TEXT, "
+            "servingParams TEXT, startTimeMs INTEGER)"
+        )
+
+    @staticmethod
+    def _row(r) -> EngineInstance:
+        return EngineInstance(
+            id=r[0],
+            status=r[1],
+            start_time=parse_datetime(r[2]),
+            end_time=parse_datetime(r[3]),
+            engine_id=r[4],
+            engine_version=r[5],
+            engine_variant=r[6],
+            engine_factory=r[7],
+            batch=r[8],
+            env=json.loads(r[9]),
+            spark_conf=json.loads(r[10]),
+            data_source_params=r[11],
+            preparator_params=r[12],
+            algorithms_params=r[13],
+            serving_params=r[14],
+        )
+
+    def _values(self, i: EngineInstance, iid: str):
+        return (
+            iid,
+            i.status,
+            _dt_out(i.start_time),
+            _dt_out(i.end_time),
+            i.engine_id,
+            i.engine_version,
+            i.engine_variant,
+            i.engine_factory,
+            i.batch,
+            json.dumps(i.env),
+            json.dumps(i.spark_conf),
+            i.data_source_params,
+            i.preparator_params,
+            i.algorithms_params,
+            i.serving_params,
+            to_millis(i.start_time),
+        )
+
+    def insert(self, instance: EngineInstance) -> str:
+        iid = instance.id or _new_instance_id()
+        self._c.execute(
+            f'INSERT OR REPLACE INTO "{self._t}" ({_EI_COLS}) '
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._values(instance, iid),
+        )
+        return iid
+
+    def get(self, instance_id: str):
+        rows = self._c.query(
+            f'SELECT {_EI_COLS} FROM "{self._t}" WHERE id=?', (instance_id,)
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self):
+        return [self._row(r) for r in self._c.query(f'SELECT {_EI_COLS} FROM "{self._t}"')]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        rows = self._c.query(
+            f'SELECT {_EI_COLS} FROM "{self._t}" WHERE status=? AND engineId=? '
+            "AND engineVersion=? AND engineVariant=? ORDER BY startTimeMs DESC",
+            ("COMPLETED", engine_id, engine_version, engine_variant),
+        )
+        return [self._row(r) for r in rows]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, instance: EngineInstance) -> bool:
+        cols = _EI_COLS.split(", ")[1:]
+        cur = self._c.execute(
+            f'UPDATE "{self._t}" SET '
+            + ", ".join(f"{c}=?" for c in cols)
+            + " WHERE id=?",
+            self._values(instance, instance.id)[1:] + (instance.id,),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, instance_id: str) -> bool:
+        cur = self._c.execute(f'DELETE FROM "{self._t}" WHERE id=?', (instance_id,))
+        return cur.rowcount > 0
+
+
+class SQLEngineManifests(base.EngineManifests):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "engine_manifests"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "id TEXT, version TEXT, name TEXT, description TEXT, files TEXT, "
+            "engineFactory TEXT, PRIMARY KEY (id, version))"
+        )
+
+    def insert(self, manifest: EngineManifest) -> None:
+        self._c.execute(
+            f'INSERT OR REPLACE INTO "{self._t}" VALUES (?,?,?,?,?,?)',
+            (
+                manifest.id,
+                manifest.version,
+                manifest.name,
+                manifest.description,
+                json.dumps(list(manifest.files)),
+                manifest.engine_factory,
+            ),
+        )
+
+    @staticmethod
+    def _row(r) -> EngineManifest:
+        return EngineManifest(r[0], r[1], r[2], r[3], tuple(json.loads(r[4])), r[5])
+
+    def get(self, manifest_id: str, version: str):
+        rows = self._c.query(
+            f'SELECT * FROM "{self._t}" WHERE id=? AND version=?',
+            (manifest_id, version),
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self):
+        return [self._row(r) for r in self._c.query(f'SELECT * FROM "{self._t}"')]
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        self.insert(manifest)
+
+    def delete(self, manifest_id: str, version: str) -> None:
+        self._c.execute(
+            f'DELETE FROM "{self._t}" WHERE id=? AND version=?', (manifest_id, version)
+        )
+
+
+_EVI_COLS = (
+    "id, status, startTime, endTime, evaluationClass, engineParamsGeneratorClass, "
+    "batch, env, sparkConf, evaluatorResults, evaluatorResultsHTML, "
+    "evaluatorResultsJSON, startTimeMs"
+)
+
+
+class SQLEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "evaluation_instances"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "id TEXT PRIMARY KEY, status TEXT, startTime TEXT, endTime TEXT, "
+            "evaluationClass TEXT, engineParamsGeneratorClass TEXT, batch TEXT, "
+            "env TEXT, sparkConf TEXT, evaluatorResults TEXT, "
+            "evaluatorResultsHTML TEXT, evaluatorResultsJSON TEXT, "
+            "startTimeMs INTEGER)"
+        )
+
+    @staticmethod
+    def _row(r) -> EvaluationInstance:
+        return EvaluationInstance(
+            id=r[0],
+            status=r[1],
+            start_time=parse_datetime(r[2]),
+            end_time=parse_datetime(r[3]),
+            evaluation_class=r[4],
+            engine_params_generator_class=r[5],
+            batch=r[6],
+            env=json.loads(r[7]),
+            spark_conf=json.loads(r[8]),
+            evaluator_results=r[9],
+            evaluator_results_html=r[10],
+            evaluator_results_json=r[11],
+        )
+
+    def _values(self, i: EvaluationInstance, iid: str):
+        return (
+            iid,
+            i.status,
+            _dt_out(i.start_time),
+            _dt_out(i.end_time),
+            i.evaluation_class,
+            i.engine_params_generator_class,
+            i.batch,
+            json.dumps(i.env),
+            json.dumps(i.spark_conf),
+            i.evaluator_results,
+            i.evaluator_results_html,
+            i.evaluator_results_json,
+            to_millis(i.start_time),
+        )
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        iid = instance.id or _new_instance_id()
+        self._c.execute(
+            f'INSERT OR REPLACE INTO "{self._t}" ({_EVI_COLS}) '
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._values(instance, iid),
+        )
+        return iid
+
+    def get(self, instance_id: str):
+        rows = self._c.query(
+            f'SELECT {_EVI_COLS} FROM "{self._t}" WHERE id=?', (instance_id,)
+        )
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self):
+        return [
+            self._row(r) for r in self._c.query(f'SELECT {_EVI_COLS} FROM "{self._t}"')
+        ]
+
+    def get_completed(self):
+        rows = self._c.query(
+            f'SELECT {_EVI_COLS} FROM "{self._t}" WHERE status=? '
+            "ORDER BY startTimeMs DESC",
+            ("EVALCOMPLETED",),
+        )
+        return [self._row(r) for r in rows]
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        cols = _EVI_COLS.split(", ")[1:]
+        cur = self._c.execute(
+            f'UPDATE "{self._t}" SET '
+            + ", ".join(f"{c}=?" for c in cols)
+            + " WHERE id=?",
+            self._values(instance, instance.id)[1:] + (instance.id,),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, instance_id: str) -> bool:
+        cur = self._c.execute(f'DELETE FROM "{self._t}" WHERE id=?', (instance_id,))
+        return cur.rowcount > 0
+
+
+class SQLModels(base.Models):
+    def __init__(self, client: SQLClient, prefix: str = ""):
+        self._c = client
+        self._t = prefix + "models"
+        client.execute(
+            f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
+            "id TEXT PRIMARY KEY, models BLOB NOT NULL)"
+        )
+
+    def insert(self, model: Model) -> None:
+        self._c.execute(
+            f'INSERT OR REPLACE INTO "{self._t}" (id, models) VALUES (?,?)',
+            (model.id, model.models),
+        )
+
+    def get(self, model_id: str):
+        rows = self._c.query(
+            f'SELECT id, models FROM "{self._t}" WHERE id=?', (model_id,)
+        )
+        return Model(rows[0][0], bytes(rows[0][1])) if rows else None
+
+    def delete(self, model_id: str) -> bool:
+        cur = self._c.execute(f'DELETE FROM "{self._t}" WHERE id=?', (model_id,))
+        return cur.rowcount > 0

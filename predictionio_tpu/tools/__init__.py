@@ -1,0 +1,1 @@
+"""CLI & ops tools (ref: tools/ module + bin/pio)."""

@@ -1,0 +1,61 @@
+"""``pio`` console (ref: tools/.../console/Console.scala:186-651).
+
+Subcommands land incrementally as each subsystem lands; this module is the
+single dispatch point, like the reference's scopt-based ``Console``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from predictionio_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio",
+        description="predictionio_tpu console — TPU-native ML server",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command")
+
+    p_status = sub.add_parser("status", help="verify installation and storage")
+    p_status.set_defaults(func=cmd_status)
+
+    return parser
+
+
+def cmd_status(args) -> int:
+    """ref: Console.status:1033-1120 — storage smoke test."""
+    from predictionio_tpu.data.storage import Storage
+
+    print("[INFO] Inspecting predictionio_tpu installation...")
+    print(f"[INFO] predictionio_tpu {__version__}")
+    s = Storage.instance()
+    for name, src in s.sources.items():
+        print(f"[INFO] Storage source {name}: type={src.type}")
+    for repo, cfg in s.repositories.items():
+        print(f"[INFO] Repository {repo} -> source {cfg.source} (prefix {cfg.prefix})")
+    failures = Storage.verify_all_data_objects()
+    if failures:
+        for f in failures:
+            print(f"[ERROR] {f}", file=sys.stderr)
+        print("[ERROR] Unable to connect to all storage backends.", file=sys.stderr)
+        return 1
+    print("[INFO] All storage backends are properly configured.")
+    print("[INFO] Your system is all ready to go.")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "command", None):
+        parser.print_help()
+        return 1
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Time utilities: ISO-8601 parse/format with timezone preservation.
+
+The reference uses Joda-Time `DateTime` with millisecond precision and keeps
+the supplied zone (ref: data/.../storage/Event.scala, data/.../Utils.scala
+``stringToDateTime``). We mirror that: all event times are timezone-aware
+datetimes; naive inputs are taken as UTC; storage keys use epoch millis.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+UTC = dt.timezone.utc
+
+
+def now() -> dt.datetime:
+    return dt.datetime.now(tz=UTC)
+
+
+def ensure_aware(t: dt.datetime) -> dt.datetime:
+    if t.tzinfo is None:
+        return t.replace(tzinfo=UTC)
+    return t
+
+
+def parse_datetime(s: str) -> dt.datetime:
+    """Parse ISO-8601, accepting 'Z' suffix and missing zone (→ UTC)."""
+    s = s.strip()
+    if s.endswith(("Z", "z")):
+        s = s[:-1] + "+00:00"
+    try:
+        t = dt.datetime.fromisoformat(s)
+    except ValueError as e:
+        raise ValueError(f"Invalid ISO-8601 datetime: {s!r}") from e
+    return ensure_aware(t)
+
+
+def format_datetime(t: dt.datetime) -> str:
+    """ISO-8601 with millisecond precision, matching the reference's wire
+    format (e.g. ``2004-12-13T21:39:45.618-07:00``)."""
+    t = ensure_aware(t)
+    base = t.strftime("%Y-%m-%dT%H:%M:%S")
+    millis = t.microsecond // 1000
+    off = t.utcoffset() or dt.timedelta(0)
+    total = int(off.total_seconds())
+    sign = "+" if total >= 0 else "-"
+    total = abs(total)
+    return f"{base}.{millis:03d}{sign}{total // 3600:02d}:{(total % 3600) // 60:02d}"
+
+
+def to_millis(t: dt.datetime) -> int:
+    return int(ensure_aware(t).timestamp() * 1000)
+
+
+def from_millis(ms: int, tz: dt.tzinfo = UTC) -> dt.datetime:
+    return dt.datetime.fromtimestamp(ms / 1000.0, tz=tz)

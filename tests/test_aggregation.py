@@ -1,0 +1,113 @@
+"""$set/$unset/$delete fold tests (ref: LEventAggregatorSpec.scala)."""
+
+import datetime as dt
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.aggregation import (
+    aggregate_properties,
+    aggregate_properties_single,
+)
+
+UTC = dt.timezone.utc
+
+
+def ev(name, entity_id, props, minute):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=DataMap(props),
+        event_time=dt.datetime(2020, 1, 1, 0, minute, tzinfo=UTC),
+    )
+
+
+def test_set_merges_latest_wins():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1, "b": "x"}, 0),
+            ev("$set", "u1", {"b": "y", "c": True}, 1),
+        ]
+    )
+    assert pm.to_dict() == {"a": 1, "b": "y", "c": True}
+    assert pm.first_updated.minute == 0
+    assert pm.last_updated.minute == 1
+
+
+def test_out_of_order_events_sorted_by_event_time():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"b": "late"}, 5),
+            ev("$set", "u1", {"a": 1, "b": "early"}, 0),
+        ]
+    )
+    assert pm.to_dict() == {"a": 1, "b": "late"}
+
+
+def test_unset_removes_keys():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1, "b": 2}, 0),
+            ev("$unset", "u1", {"a": None}, 1),
+        ]
+    )
+    assert pm.to_dict() == {"b": 2}
+
+
+def test_unset_before_any_set_is_noop_then_set():
+    pm = aggregate_properties_single(
+        [
+            ev("$unset", "u1", {"a": None}, 0),
+            ev("$set", "u1", {"a": 1}, 1),
+        ]
+    )
+    assert pm.to_dict() == {"a": 1}
+    # firstUpdated counts the $unset too (ref: propAggregator)
+    assert pm.first_updated.minute == 0
+
+
+def test_delete_clears_entity():
+    assert (
+        aggregate_properties_single(
+            [
+                ev("$set", "u1", {"a": 1}, 0),
+                ev("$delete", "u1", {}, 1),
+            ]
+        )
+        is None
+    )
+
+
+def test_delete_then_set_recreates():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1}, 0),
+            ev("$delete", "u1", {}, 1),
+            ev("$set", "u1", {"b": 2}, 2),
+        ]
+    )
+    assert pm.to_dict() == {"b": 2}
+    assert pm.first_updated.minute == 0  # update times span all special events
+
+
+def test_non_special_events_ignored():
+    pm = aggregate_properties_single(
+        [
+            ev("$set", "u1", {"a": 1}, 0),
+            ev("view", "u1", {"x": 9}, 1),
+        ]
+    )
+    assert pm.to_dict() == {"a": 1}
+    assert pm.last_updated.minute == 0
+
+
+def test_group_by_entity_and_drop_deleted():
+    result = aggregate_properties(
+        [
+            ev("$set", "u1", {"a": 1}, 0),
+            ev("$set", "u2", {"a": 2}, 0),
+            ev("$delete", "u2", {}, 1),
+        ]
+    )
+    assert set(result) == {"u1"}
+    assert result["u1"].to_dict() == {"a": 1}

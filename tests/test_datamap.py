@@ -1,0 +1,102 @@
+"""DataMap typed-access tests (ref: data/.../storage/DataMapSpec.scala)."""
+
+import datetime as dt
+from dataclasses import dataclass
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
+
+
+@pytest.fixture
+def dm():
+    return DataMap(
+        {
+            "string": "a",
+            "int": 10,
+            "double": 2.5,
+            "bool": True,
+            "list": ["a", "b"],
+            "doubles": [1, 2.5],
+            "nullval": None,
+            "time": "2020-01-02T03:04:05.000+00:00",
+        }
+    )
+
+
+def test_get_required(dm):
+    assert dm.get("string", str) == "a"
+    assert dm.get("int", int) == 10
+    assert dm.get("double", float) == 2.5
+    assert dm.get("int", float) == 10.0  # numeric widening
+    assert dm.get("bool", bool) is True
+
+
+def test_get_missing_raises(dm):
+    with pytest.raises(DataMapError):
+        dm.get("nope")
+    with pytest.raises(DataMapError):
+        dm.get("nullval")  # required field cannot be null
+
+
+def test_get_type_mismatch(dm):
+    with pytest.raises(DataMapError):
+        dm.get("string", int)
+    with pytest.raises(DataMapError):
+        dm.get("double", int)  # 2.5 is not an integer
+
+
+def test_get_opt_and_default(dm):
+    assert dm.get_opt("nope") is None
+    assert dm.get_opt("nullval") is None
+    assert dm.get_opt("int", int) == 10
+    assert dm.get_or_else("nope", 42) == 42
+    assert dm.get_or_else("int", 42) == 10
+
+
+def test_lists_and_datetime(dm):
+    assert dm.get_string_list("list") == ["a", "b"]
+    assert dm.get_double_list("doubles") == [1.0, 2.5]
+    t = dm.get_datetime("time")
+    assert t == dt.datetime(2020, 1, 2, 3, 4, 5, tzinfo=dt.timezone.utc)
+
+
+def test_merge_remove_keyset(dm):
+    merged = dm.merge(DataMap({"int": 11, "new": "x"}))
+    assert merged.get("int", int) == 11
+    assert merged.get("new") == "x"
+    assert dm.get("int", int) == 10  # immutable
+    removed = dm.remove(["string", "int"])
+    assert "string" not in removed.key_set()
+    assert "int" not in removed.key_set()
+    assert "double" in removed.key_set()
+
+
+def test_extract_dataclass():
+    @dataclass
+    class P:
+        a: int
+        b: str
+
+    assert DataMap({"a": 1, "b": "x"}).extract(P) == P(1, "x")
+
+
+def test_property_map_carries_update_times():
+    t1 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    t2 = dt.datetime(2020, 6, 1, tzinfo=dt.timezone.utc)
+    pm = PropertyMap({"a": 1}, t1, t2)
+    assert pm.first_updated == t1
+    assert pm.last_updated == t2
+    assert pm.get("a", int) == 1
+
+
+def test_bool_is_not_a_number():
+    with pytest.raises(DataMapError):
+        DataMap({"x": True}).get("x", int)
+    with pytest.raises(DataMapError):
+        DataMap({"x": False}).get("x", float)
+
+
+def test_hash_eq_invariant():
+    a, b = DataMap({"a": 1}), DataMap({"a": 1.0})
+    assert a == b and hash(a) == hash(b)

@@ -1,0 +1,87 @@
+"""Event model + validation parity tests (ref rules: Event.scala:109-164)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+from predictionio_tpu.utils.time import format_datetime, parse_datetime
+
+
+def ok(**kw):
+    defaults = dict(event="my_event", entity_type="user", entity_id="u1")
+    defaults.update(kw)
+    return Event(**defaults)
+
+
+def test_valid_plain_event():
+    validate_event(ok())
+
+
+def test_valid_special_events():
+    validate_event(ok(event="$set", properties=DataMap({"a": 1})))
+    validate_event(ok(event="$unset", properties=DataMap({"a": 1})))
+    validate_event(ok(event="$delete"))
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type="item"),  # type without id
+        dict(target_entity_id="i1"),  # id without type
+        dict(target_entity_type="", target_entity_id="i1"),
+        dict(target_entity_type="item", target_entity_id=""),
+        dict(event="$unset"),  # empty properties
+        dict(event="$custom"),  # reserved prefix, not special
+        dict(event="pio_thing"),
+        dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+        dict(entity_type="pio_user"),
+        dict(target_entity_type="pio_item", target_entity_id="i1"),
+        dict(properties=DataMap({"pio_x": 1})),
+        dict(properties=DataMap({"$x": 1})),
+    ],
+)
+def test_invalid_events(kw):
+    with pytest.raises(EventValidationError):
+        validate_event(ok(**kw))
+
+
+def test_builtin_entity_type_allowed():
+    validate_event(ok(entity_type="pio_pr"))
+    validate_event(ok(target_entity_type="pio_pr", target_entity_id="x"))
+
+
+def test_json_round_trip_preserves_timezone():
+    t = parse_datetime("2004-12-13T21:39:45.618-07:00")
+    e = ok(event="$set", properties=DataMap({"a": 1, "b": "x"}), event_time=t,
+           tags=("t1", "t2"), pr_id="pr1")
+    d = e.to_json()
+    assert d["eventTime"] == "2004-12-13T21:39:45.618-07:00"
+    e2 = Event.from_json(d)
+    assert e2.event == "$set"
+    assert e2.properties == DataMap({"a": 1, "b": "x"})
+    assert e2.event_time == t
+    assert e2.event_time.utcoffset() == dt.timedelta(hours=-7)
+    assert e2.tags == ("t1", "t2")
+    assert e2.pr_id == "pr1"
+
+
+def test_from_json_requires_core_fields():
+    with pytest.raises(EventValidationError):
+        Event.from_json({"entityType": "user", "entityId": "u1"})
+    with pytest.raises(EventValidationError):
+        Event.from_json({"event": "e", "entityId": "u1"})
+
+
+def test_format_datetime_millis_and_utc():
+    t = dt.datetime(2020, 1, 2, 3, 4, 5, 678000, tzinfo=dt.timezone.utc)
+    assert format_datetime(t) == "2020-01-02T03:04:05.678+00:00"
+    assert parse_datetime("2020-01-02T03:04:05.678Z") == t

@@ -1,0 +1,323 @@
+"""Backend-parametrized storage behavioral spec.
+
+The reference runs ONE behavioral spec against each live events backend
+(ref: data/.../storage/LEventsSpec.scala:21-67 — "Events can be implemented
+by: HBLEvents / JDBCLEvents"); here the same steps run against the memory
+and sqlite backends via the ``storage`` fixture parametrization.
+"""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    StorageError,
+)
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def storage(request):
+    return request.getfixturevalue(f"{request.param}_storage")
+
+
+def ev(name="view", entity_id="u1", minute=0, **kw):
+    return Event(
+        event=name,
+        entity_type=kw.pop("entity_type", "user"),
+        entity_id=entity_id,
+        event_time=dt.datetime(2020, 1, 1, 0, minute, tzinfo=UTC),
+        **kw,
+    )
+
+
+class TestEvents:
+    def test_insert_get_delete_round_trip(self, storage):
+        events = storage.get_events()
+        assert events.init(1)
+        e = ev(properties=DataMap({"a": 1}), tags=("x",), pr_id="p")
+        eid = events.insert(e, 1)
+        got = events.get(eid, 1)
+        assert got.event == "view"
+        assert got.event_id == eid
+        assert got.properties == DataMap({"a": 1})
+        assert got.tags == ("x",)
+        assert events.delete(eid, 1)
+        assert events.get(eid, 1) is None
+        assert not events.delete(eid, 1)
+
+    def test_uninitialized_app_raises(self, storage):
+        events = storage.get_events()
+        with pytest.raises(StorageError):
+            events.insert(ev(), 99)
+
+    def test_channels_are_isolated(self, storage):
+        events = storage.get_events()
+        events.init(1)
+        events.init(1, 7)
+        eid = events.insert(ev(), 1, 7)
+        assert events.get(eid, 1) is None
+        assert events.get(eid, 1, 7) is not None
+        assert list(events.find(app_id=1)) == []
+        assert len(list(events.find(app_id=1, channel_id=7))) == 1
+
+    def test_find_filters(self, storage):
+        events = storage.get_events()
+        events.init(2)
+        events.insert(ev("view", "u1", 0), 2)
+        events.insert(ev("buy", "u1", 1), 2)
+        events.insert(ev("view", "u2", 2), 2)
+        events.insert(
+            ev("rate", "u1", 3, target_entity_type="item", target_entity_id="i1"),
+            2,
+        )
+
+        assert len(list(events.find(app_id=2))) == 4
+        assert len(list(events.find(app_id=2, entity_id="u1"))) == 3
+        assert len(list(events.find(app_id=2, event_names=["view"]))) == 2
+        assert len(list(events.find(app_id=2, event_names=["view", "buy"]))) == 3
+        # time range: [start, until)
+        t1 = dt.datetime(2020, 1, 1, 0, 1, tzinfo=UTC)
+        t3 = dt.datetime(2020, 1, 1, 0, 3, tzinfo=UTC)
+        mid = list(events.find(app_id=2, start_time=t1, until_time=t3))
+        assert [e.event for e in mid] == ["buy", "view"]
+        # target entity filters (tri-state: unset / None / value)
+        assert len(list(events.find(app_id=2, target_entity_type="item"))) == 1
+        assert len(list(events.find(app_id=2, target_entity_type=None))) == 3
+        assert len(list(events.find(app_id=2, target_entity_id="i1"))) == 1
+
+    def test_find_order_limit_reversed(self, storage):
+        events = storage.get_events()
+        events.init(3)
+        for m in (2, 0, 1):
+            events.insert(ev("view", "u1", m), 3)
+        got = [e.event_time.minute for e in events.find(app_id=3)]
+        assert got == [0, 1, 2]
+        got = [e.event_time.minute for e in events.find(app_id=3, reversed_=True)]
+        assert got == [2, 1, 0]
+        assert len(list(events.find(app_id=3, limit=2))) == 2
+        assert len(list(events.find(app_id=3, limit=-1))) == 3
+
+    def test_aggregate_properties(self, storage):
+        events = storage.get_events()
+        events.init(4)
+        events.insert(
+            ev("$set", "u1", 0, properties=DataMap({"a": 1, "b": "x"})), 4
+        )
+        events.insert(ev("$set", "u1", 1, properties=DataMap({"b": "y"})), 4)
+        events.insert(ev("$set", "u2", 0, properties=DataMap({"a": 2})), 4)
+        events.insert(ev("$delete", "u2", 1), 4)
+        result = events.aggregate_properties(4, None, "user")
+        assert set(result) == {"u1"}
+        assert result["u1"].to_dict() == {"a": 1, "b": "y"}
+        # required-keys filter
+        events.insert(ev("$set", "u3", 0, properties=DataMap({"c": 3})), 4)
+        result = events.aggregate_properties(4, None, "user", required=["a"])
+        assert set(result) == {"u1"}
+
+    def test_remove_drops_all(self, storage):
+        events = storage.get_events()
+        events.init(5)
+        events.insert(ev(), 5)
+        assert events.remove(5)
+        with pytest.raises(StorageError):
+            list(events.find(app_id=5))
+
+
+class TestMetadata:
+    def test_apps(self, storage):
+        apps = storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id is not None
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        assert apps.update(App(app_id, "renamed", None))
+        assert apps.get(app_id).name == "renamed"
+        assert len(apps.get_all()) == 1
+        assert apps.delete(app_id)
+        assert apps.get(app_id) is None
+
+    def test_access_keys(self, storage):
+        keys = storage.get_meta_data_access_keys()
+        key = keys.insert(AccessKey("", 1, ("view", "buy")))
+        assert key and len(key) == 64
+        assert keys.get(key).events == ("view", "buy")
+        key2 = keys.insert(AccessKey("explicit-key", 2))
+        assert key2 == "explicit-key"
+        assert {k.key for k in keys.get_by_app_id(1)} == {key}
+        assert keys.update(AccessKey(key, 1, ()))
+        assert keys.get(key).events == ()
+        assert keys.delete(key)
+        assert keys.get(key) is None
+
+    def test_channels(self, storage):
+        channels = storage.get_meta_data_channels()
+        cid = channels.insert(Channel(0, "ch1", 1))
+        assert cid is not None
+        assert channels.get(cid).name == "ch1"
+        assert channels.insert(Channel(0, "ch1", 1)) is None  # dup in app
+        assert channels.insert(Channel(0, "ch1", 2)) is not None  # other app ok
+        assert {c.name for c in channels.get_by_app_id(1)} == {"ch1"}
+        with pytest.raises(ValueError):
+            Channel(0, "bad name!", 1)
+        with pytest.raises(ValueError):
+            Channel(0, "x" * 17, 1)
+        assert channels.delete(cid)
+
+    def test_engine_instances_latest_completed(self, storage):
+        insts = storage.get_meta_data_engine_instances()
+        t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+
+        def make(status, hour):
+            return EngineInstance(
+                id="",
+                status=status,
+                start_time=t0 + dt.timedelta(hours=hour),
+                end_time=t0 + dt.timedelta(hours=hour + 1),
+                engine_id="e1",
+                engine_version="1",
+                engine_variant="default",
+                engine_factory="f",
+            )
+
+        insts.insert(make("INIT", 0))
+        id1 = insts.insert(make("COMPLETED", 1))
+        id2 = insts.insert(make("COMPLETED", 2))
+        assert insts.get(id1).status == "COMPLETED"
+        latest = insts.get_latest_completed("e1", "1", "default")
+        assert latest.id == id2
+        assert insts.get_latest_completed("e1", "1", "other") is None
+        assert len(insts.get_all()) == 3
+        updated = EngineInstance(**{**latest.__dict__, "status": "ABORTED"})
+        assert insts.update(updated)
+        assert insts.get_latest_completed("e1", "1", "default").id == id1
+
+    def test_engine_manifests(self, storage):
+        manifests = storage.get_meta_data_engine_manifests()
+        m = EngineManifest("eng", "1.0", "My Engine", None, ("a.py",), "factory")
+        manifests.insert(m)
+        assert manifests.get("eng", "1.0").name == "My Engine"
+        assert manifests.get("eng", "2.0") is None
+        manifests.update(
+            EngineManifest("eng", "1.0", "Renamed", None, (), "factory"), upsert=True
+        )
+        assert manifests.get("eng", "1.0").name == "Renamed"
+        manifests.delete("eng", "1.0")
+        assert manifests.get("eng", "1.0") is None
+
+    def test_evaluation_instances(self, storage):
+        evals = storage.get_meta_data_evaluation_instances()
+        eid = evals.insert(EvaluationInstance(status="INIT"))
+        assert evals.get(eid).status == "INIT"
+        done = EvaluationInstance(
+            **{**evals.get(eid).__dict__, "status": "EVALCOMPLETED",
+               "evaluator_results": "metric=0.9"}
+        )
+        assert evals.update(done)
+        assert [i.id for i in evals.get_completed()] == [eid]
+        assert evals.delete(eid)
+
+    def test_models(self, storage):
+        models = storage.get_model_data_models()
+        models.insert(Model("m1", b"\x00\x01binary"))
+        assert models.get("m1").models == b"\x00\x01binary"
+        assert models.get("m2") is None
+        assert models.delete("m1")
+        assert not models.delete("m1")
+
+
+def test_verify_all_data_objects(storage):
+    assert storage.verify_all_data_objects() == []
+
+
+def test_default_config_uses_sqlite(monkeypatch, tmp_path):
+    from predictionio_tpu.data.storage import Storage
+
+    for key in list(__import__("os").environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    Storage.reset()
+    try:
+        s = Storage.instance()
+        assert s.sources["PIO_TPU_DEFAULT"].type == "sqlite"
+        assert (
+            s.repositories["METADATA"].source == "PIO_TPU_DEFAULT"
+        )
+        assert Storage.verify_all_data_objects() == []
+        assert (tmp_path / "pio.db").exists()
+    finally:
+        Storage.reset()
+
+
+def test_localfs_models_backend(monkeypatch, tmp_path):
+    from predictionio_tpu.data.storage import Storage
+
+    for key in list(__import__("os").environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_FS_TYPE", "localfs")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_FS_PATH", str(tmp_path / "models"))
+    for repo in ("METADATA", "EVENTDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "MEM")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE", "FS")
+    Storage.reset()
+    try:
+        models = Storage.get_model_data_models()
+        models.insert(Model("m1", b"blob"))
+        assert models.get("m1").models == b"blob"
+        assert (tmp_path / "models").exists()
+    finally:
+        Storage.reset()
+
+
+class TestReviewRegressions:
+    """Regressions from code review: backend contract parity edge cases."""
+
+    def test_update_nonexistent_instance_returns_false(self, storage):
+        insts = storage.get_meta_data_engine_instances()
+        ghost = EngineInstance(
+            id="nope", status="COMPLETED",
+            start_time=dt.datetime(2020, 1, 1, tzinfo=UTC),
+            end_time=dt.datetime(2020, 1, 1, tzinfo=UTC),
+            engine_id="e", engine_version="1", engine_variant="v",
+            engine_factory="f",
+        )
+        assert not insts.update(ghost)
+        assert insts.get("nope") is None
+        evals = storage.get_meta_data_evaluation_instances()
+        assert not evals.update(EvaluationInstance(id="nope", status="X"))
+        assert evals.get("nope") is None
+
+    def test_latest_completed_orders_by_instant_not_string(self, storage):
+        insts = storage.get_meta_data_engine_instances()
+        # 10:00+09:00 == 01:00 UTC (older); 05:00+00:00 == 05:00 UTC (newer)
+        older = dt.datetime(2020, 1, 1, 10, 0, tzinfo=dt.timezone(dt.timedelta(hours=9)))
+        newer = dt.datetime(2020, 1, 1, 5, 0, tzinfo=UTC)
+
+        def make(t):
+            return EngineInstance(
+                id="", status="COMPLETED", start_time=t, end_time=t,
+                engine_id="e", engine_version="1", engine_variant="v",
+                engine_factory="f",
+            )
+
+        insts.insert(make(older))
+        newest_id = insts.insert(make(newer))
+        assert insts.get_latest_completed("e", "1", "v").id == newest_id
+
+    def test_find_raises_eagerly_on_uninitialized(self, storage):
+        with pytest.raises(StorageError):
+            storage.get_events().find(app_id=12345)
