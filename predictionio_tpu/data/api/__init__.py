@@ -1,0 +1,1 @@
+"""REST event ingestion API (ref: data/.../api/)."""

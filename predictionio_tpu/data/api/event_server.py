@@ -1,0 +1,255 @@
+"""REST Event Server (default port 7070).
+
+Re-design of the reference's spray/akka event server
+(ref: data/.../api/EventServer.scala:50-529). Route surface parity:
+
+  GET  /                        → {"status": "alive"}
+  GET  /plugins.json            → plugin inventory
+  GET  /plugins/<type>/<name>/… → plugin REST handler (auth)
+  POST /events.json             → 201 {"eventId": id} (auth, validation)
+  GET  /events.json             → query events (auth; default limit 20)
+  GET  /events/<id>.json        → single event (auth)
+  DELETE /events/<id>.json      → {"message": "Found"/"Not Found"} (auth)
+  GET  /stats.json              → per-app counters (auth; requires --stats)
+  POST/GET /webhooks/<name>.json→ JSON webhook connector (auth)
+  POST/GET /webhooks/<name>     → form webhook connector (auth)
+
+Auth = ``accessKey`` query param, optional ``channel`` name resolved against
+the key's app (ref: withAccessKey, EventServer.scala:81-107).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from predictionio_tpu.data.api.plugins import (
+    EventInfo,
+    EventServerPluginContext,
+    INPUT_BLOCKER,
+    INPUT_SNIFFER,
+)
+from predictionio_tpu.data.api.stats import Stats
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.webhooks import (
+    ConnectorError,
+    form_connectors,
+    json_connectors,
+    to_event,
+)
+from predictionio_tpu.utils.http import AppServer, HTTPError, Request, Router
+from predictionio_tpu.utils.time import parse_datetime
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 7070  # ref: EventServer.scala:504
+DEFAULT_GET_LIMIT = 20  # ref: EventServer.scala:313
+
+
+@dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = DEFAULT_PORT
+    stats: bool = False
+
+
+@dataclass
+class AuthData:
+    app_id: int
+    channel_id: int | None
+
+
+class EventService:
+    """Route handlers bound to storage DAOs; one instance per server."""
+
+    def __init__(self, config: EventServerConfig):
+        self.config = config
+        self.event_client = Storage.get_events()
+        self.access_keys_client = Storage.get_meta_data_access_keys()
+        self.channels_client = Storage.get_meta_data_channels()
+        self.stats = Stats()
+        self.plugin_context = EventServerPluginContext()
+        self.json_connectors = json_connectors()
+        self.form_connectors = form_connectors()
+        self.router = self._build_router()
+
+    # -- auth (ref: withAccessKey) ------------------------------------------
+    def _auth(self, request: Request) -> AuthData:
+        key_param = request.query.get("accessKey")
+        if not key_param:
+            raise HTTPError(401, "Missing accessKey.")
+        key = self.access_keys_client.get(key_param)
+        if key is None:
+            raise HTTPError(401, "Invalid accessKey.")
+        channel = request.query.get("channel")
+        if channel is not None:
+            channel_map = {
+                c.name: c.id for c in self.channels_client.get_by_app_id(key.appid)
+            }
+            if channel not in channel_map:
+                raise HTTPError(401, f"Invalid channel '{channel}'.")
+            return AuthData(key.appid, channel_map[channel])
+        return AuthData(key.appid, None)
+
+    # -- routes -------------------------------------------------------------
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("GET", "/", lambda req: (200, {"status": "alive"}))
+        r.add("GET", "/plugins.json", lambda req: (200, self.plugin_context.to_json()))
+        # trailing segments become plugin args (ref: EventServer.scala:145-160)
+        r.add("GET", "/plugins/{ptype}/{pname}", self.handle_plugin_rest)
+        r.add("GET", "/plugins/{ptype}/{pname}/{args:path}", self.handle_plugin_rest)
+        r.add("POST", "/events.json", self.post_event)
+        r.add("GET", "/events.json", self.get_events)
+        r.add("GET", "/events/{event_id}.json", self.get_event)
+        r.add("DELETE", "/events/{event_id}.json", self.delete_event)
+        r.add("GET", "/stats.json", self.get_stats)
+        r.add("POST", "/webhooks/{web}.json", self.post_webhook_json)
+        r.add("GET", "/webhooks/{web}.json", self.get_webhook_json)
+        r.add("POST", "/webhooks/{web}", self.post_webhook_form)
+        r.add("GET", "/webhooks/{web}", self.get_webhook_form)
+        return r
+
+    def handle_plugin_rest(self, request: Request):
+        auth = self._auth(request)
+        ptype = request.path_params["ptype"]
+        pname = request.path_params["pname"]
+        plugins = {
+            INPUT_BLOCKER: self.plugin_context.input_blockers,
+            INPUT_SNIFFER: self.plugin_context.input_sniffers,
+        }.get(ptype)
+        if plugins is None or pname not in plugins:
+            return 404, {"message": "Not Found"}
+        args = [s for s in request.path_params.get("args", "").split("/") if s]
+        return 200, plugins[pname].handle_rest(auth.app_id, auth.channel_id, args)
+
+    def _ingest(self, auth: AuthData, make_event) -> tuple[int, dict]:
+        """Shared validate → blockers → insert → sniffers → stats → 201 tail
+        used by the event and webhook POST routes."""
+        try:
+            event = make_event()
+            validate_event(event)
+        except (EventValidationError, ConnectorError, ValueError) as e:
+            return 400, {"message": str(e)}
+        info = EventInfo(auth.app_id, auth.channel_id, event)
+        for blocker in self.plugin_context.input_blockers.values():
+            blocker.process(info, self.plugin_context)  # may raise HTTPError
+        event_id = self.event_client.insert(event, auth.app_id, auth.channel_id)
+        for sniffer in self.plugin_context.input_sniffers.values():
+            try:
+                sniffer.process(info, self.plugin_context)
+            except Exception:
+                logger.exception("input sniffer failed")
+        if self.config.stats:
+            self.stats.update(auth.app_id, 201, event)
+        return 201, {"eventId": event_id}
+
+    def post_event(self, request: Request):
+        auth = self._auth(request)
+        return self._ingest(auth, lambda: Event.from_json(request.json() or {}))
+
+    def get_events(self, request: Request):
+        auth = self._auth(request)
+        q = request.query
+        try:
+            reversed_ = q.get("reversed") == "true"
+            if reversed_ and not (q.get("entityType") and q.get("entityId")):
+                raise ValueError(
+                    "the parameter reversed can only be used with both entityType "
+                    "and entityId specified."
+                )
+            kwargs = dict(
+                app_id=auth.app_id,
+                channel_id=auth.channel_id,
+                start_time=(
+                    parse_datetime(q["startTime"]) if "startTime" in q else None
+                ),
+                until_time=(
+                    parse_datetime(q["untilTime"]) if "untilTime" in q else None
+                ),
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=[q["event"]] if "event" in q else None,
+                limit=int(q.get("limit", DEFAULT_GET_LIMIT)),
+                reversed_=reversed_,
+            )
+            if "targetEntityType" in q:
+                kwargs["target_entity_type"] = q["targetEntityType"]
+            if "targetEntityId" in q:
+                kwargs["target_entity_id"] = q["targetEntityId"]
+            events = list(self.event_client.find(**kwargs))
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        if not events:
+            return 404, {"message": "Not Found"}
+        return 200, [e.to_json() for e in events]
+
+    def get_event(self, request: Request):
+        auth = self._auth(request)
+        event = self.event_client.get(
+            request.path_params["event_id"], auth.app_id, auth.channel_id
+        )
+        if event is None:
+            return 404, {"message": "Not Found"}
+        return 200, event.to_json()
+
+    def delete_event(self, request: Request):
+        auth = self._auth(request)
+        found = self.event_client.delete(
+            request.path_params["event_id"], auth.app_id, auth.channel_id
+        )
+        if found:
+            return 200, {"message": "Found"}
+        return 404, {"message": "Not Found"}
+
+    def get_stats(self, request: Request):
+        auth = self._auth(request)
+        if not self.config.stats:
+            return 404, {
+                "message": "To see stats, launch Event Server with --stats argument."
+            }
+        return 200, self.stats.get(auth.app_id)
+
+    # -- webhooks (ref: api/Webhooks.scala) ---------------------------------
+    def post_webhook_json(self, request: Request):
+        auth = self._auth(request)
+        web = request.path_params["web"]
+        connector = self.json_connectors.get(web)
+        if connector is None:
+            return 404, {"message": f"webhooks connection for {web} is not supported."}
+        data = request.json()
+        if not isinstance(data, dict):
+            return 400, {"message": "JSON object expected."}
+        return self._ingest(auth, lambda: to_event(connector, data))
+
+    def get_webhook_json(self, request: Request):
+        self._auth(request)
+        web = request.path_params["web"]
+        if web not in self.json_connectors:
+            return 404, {"message": f"webhooks connection for {web} is not supported."}
+        return 200, {"message": "Ok"}
+
+    def post_webhook_form(self, request: Request):
+        auth = self._auth(request)
+        web = request.path_params["web"]
+        connector = self.form_connectors.get(web)
+        if connector is None:
+            return 404, {"message": f"webhooks connection for {web} is not supported."}
+        return self._ingest(auth, lambda: to_event(connector, request.form()))
+
+    def get_webhook_form(self, request: Request):
+        self._auth(request)
+        web = request.path_params["web"]
+        if web not in self.form_connectors:
+            return 404, {"message": f"webhooks connection for {web} is not supported."}
+        return 200, {"message": "Ok"}
+
+
+def create_event_server(config: EventServerConfig | None = None) -> AppServer:
+    """Build and bind the event server (ref: EventServer.createEventServer:508-529).
+    Caller starts it with ``.start()`` / blocks with ``.wait()``."""
+    config = config or EventServerConfig()
+    service = EventService(config)
+    server = AppServer(service.router, config.ip, config.port)
+    return server

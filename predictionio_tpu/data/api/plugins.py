@@ -1,0 +1,102 @@
+"""Event-server plugin SPI: input blockers & sniffers.
+
+Mirrors the reference's ``EventServerPlugin``/``EventServerPluginContext``
+(ref: data/.../api/EventServerPlugin.scala, loaded via ``ServiceLoader`` in
+``EventServerPluginContext.scala``). Python plugins register through the
+``predictionio_tpu.event_server_plugins`` entry-point group or
+programmatically via :func:`register_plugin`.
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+
+from predictionio_tpu.data.event import Event
+
+logger = logging.getLogger(__name__)
+
+INPUT_BLOCKER = "inputblocker"
+INPUT_SNIFFER = "inputsniffer"
+
+
+@dataclass
+class EventInfo:
+    app_id: int
+    channel_id: int | None
+    event: Event
+
+
+class EventServerPlugin(ABC):
+    """ref: api/EventServerPlugin.scala:25-40"""
+
+    plugin_name: str = ""
+    plugin_description: str = ""
+    plugin_type: str = INPUT_SNIFFER
+
+    @abstractmethod
+    def process(self, event_info: EventInfo, context: "EventServerPluginContext") -> None:
+        """Called on every accepted event. Blockers may raise to reject."""
+
+    def handle_rest(self, app_id: int, channel_id: int | None, args: list[str]):
+        """Serve ``GET /plugins/<type>/<name>/...`` (ref: handleREST)."""
+        return {"message": "handleREST not implemented"}
+
+
+_registered: list[EventServerPlugin] = []
+
+
+def register_plugin(plugin: EventServerPlugin) -> None:
+    _registered.append(plugin)
+
+
+def clear_plugins() -> None:
+    _registered.clear()
+
+
+class EventServerPluginContext:
+    """ref: api/EventServerPluginContext.scala — discovers plugins and splits
+    them by type."""
+
+    def __init__(self, plugins: list[EventServerPlugin] | None = None):
+        found = list(plugins) if plugins is not None else self._discover()
+        self.input_blockers = {
+            p.plugin_name: p for p in found if p.plugin_type == INPUT_BLOCKER
+        }
+        self.input_sniffers = {
+            p.plugin_name: p for p in found if p.plugin_type == INPUT_SNIFFER
+        }
+
+    @staticmethod
+    def _discover() -> list[EventServerPlugin]:
+        plugins = list(_registered)
+        try:
+            from importlib.metadata import entry_points
+
+            for ep in entry_points(group="predictionio_tpu.event_server_plugins"):
+                try:
+                    plugins.append(ep.load()())
+                except Exception:
+                    logger.exception("failed to load event server plugin %s", ep.name)
+        except Exception:
+            pass
+        return plugins
+
+    def to_json(self) -> dict:
+        def desc(plugins: dict[str, EventServerPlugin]) -> dict:
+            return {
+                n: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__,
+                }
+                for n, p in plugins.items()
+            }
+
+        return {
+            "plugins": {
+                "inputblockers": desc(self.input_blockers),
+                "inputsniffers": desc(self.input_sniffers),
+            }
+        }

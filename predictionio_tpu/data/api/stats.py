@@ -1,0 +1,54 @@
+"""Event-server bookkeeping behind ``--stats``.
+
+Mirrors the reference's ``Stats``/``StatsActor``
+(ref: data/.../api/Stats.scala:40-79, data/.../api/StatsActor.scala): counts
+by (entityType, event) and by HTTP status code, per app, since server start.
+The actor mailbox is replaced by a lock (same serialization guarantee).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.utils.time import format_datetime, now
+
+
+class Stats:
+    def __init__(self):
+        self.start_time = now()
+        self._lock = threading.Lock()
+        self._status_count: Counter = Counter()
+        self._ete_count: Counter = Counter()
+
+    def update(self, app_id: int, status_code: int, event: Event) -> None:
+        with self._lock:
+            self._status_count[(app_id, status_code)] += 1
+            self._ete_count[
+                (app_id, event.entity_type, event.event, event.target_entity_type)
+            ] += 1
+
+    def get(self, app_id: int) -> dict:
+        """Snapshot for one app (ref: Stats.get → StatsSnapshot)."""
+        with self._lock:
+            basic = [
+                {
+                    "entityType": et,
+                    "event": ev,
+                    "targetEntityType": tet,
+                    "count": c,
+                }
+                for (aid, et, ev, tet), c in self._ete_count.items()
+                if aid == app_id
+            ]
+            status = [
+                {"status": code, "count": c}
+                for (aid, code), c in self._status_count.items()
+                if aid == app_id
+            ]
+        return {
+            "startTime": format_datetime(self.start_time),
+            "basic": basic,
+            "statusCode": status,
+        }

@@ -1,0 +1,52 @@
+"""Webhook connector SPI: third-party payloads → events.
+
+Mirrors the reference's pluggable connector design
+(ref: data/.../webhooks/JsonConnector.scala:21-31,
+data/.../webhooks/FormConnector.scala:22-31,
+data/.../webhooks/ConnectorUtil.scala:27-45,
+data/.../api/WebhooksConnectors.scala:25-33). Connectors never build Event
+objects directly — they emit event JSON which goes through the one canonical
+``Event.from_json`` path, keeping event formation consistent.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Mapping
+
+from predictionio_tpu.data.event import Event
+
+
+class ConnectorError(Exception):
+    """ref: webhooks/ConnectorException.scala"""
+
+
+class JsonConnector(ABC):
+    @abstractmethod
+    def to_event_json(self, data: Mapping[str, Any]) -> dict:
+        """Convert a JSON webhook payload to event JSON."""
+
+
+class FormConnector(ABC):
+    @abstractmethod
+    def to_event_json(self, data: Mapping[str, str]) -> dict:
+        """Convert a form-encoded webhook payload to event JSON."""
+
+
+def to_event(connector: JsonConnector | FormConnector, data: Mapping) -> Event:
+    """ref: ConnectorUtil.toEvent — route through the canonical JSON parser."""
+    return Event.from_json(connector.to_event_json(data))
+
+
+def json_connectors() -> dict[str, JsonConnector]:
+    """Registered JSON-payload connectors (ref: WebhooksConnectors.json)."""
+    from predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+
+    return {"segmentio": SegmentIOConnector()}
+
+
+def form_connectors() -> dict[str, FormConnector]:
+    """Registered form-payload connectors (ref: WebhooksConnectors.form)."""
+    from predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+
+    return {"mailchimp": MailChimpConnector()}

@@ -1,0 +1,152 @@
+"""MailChimp webhook connector (form-encoded payloads).
+
+Behavior parity with the reference connector
+(ref: data/.../webhooks/mailchimp/MailChimpConnector.scala): the six
+MailChimp webhook types map to events on ``user`` entities targeting the
+``list`` (or ``campaign``) entity; ``fired_at`` ("yyyy-MM-dd HH:mm:ss", UTC)
+becomes the ISO-8601 eventTime.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Mapping
+
+from predictionio_tpu.data.webhooks import ConnectorError, FormConnector
+from predictionio_tpu.utils.time import UTC, format_datetime
+
+
+def _parse_mailchimp_time(s: str) -> str:
+    try:
+        t = dt.datetime.strptime(s, "%Y-%m-%d %H:%M:%S").replace(tzinfo=UTC)
+    except ValueError as e:
+        raise ConnectorError(f"Cannot parse fired_at: {s!r}") from e
+    return format_datetime(t)
+
+
+class MailChimpConnector(FormConnector):
+    def to_event_json(self, data: Mapping[str, str]) -> dict:
+        typ = data.get("type")
+        if typ is None:
+            raise ConnectorError("The field 'type' is required.")
+        builder = {
+            "subscribe": self._subscribe,
+            "unsubscribe": self._unsubscribe,
+            "profile": self._profile,
+            "upemail": self._upemail,
+            "cleaned": self._cleaned,
+            "campaign": self._campaign,
+        }.get(typ)
+        if builder is None:
+            raise ConnectorError(f"Cannot convert unknown type {typ} to event JSON.")
+        try:
+            return builder(data)
+        except KeyError as e:
+            raise ConnectorError(f"Missing field {e} in {typ} payload.") from e
+
+    def _merges(self, d: Mapping[str, str]) -> dict:
+        merges = {
+            "EMAIL": d["data[merges][EMAIL]"],
+            "FNAME": d["data[merges][FNAME]"],
+            "LNAME": d["data[merges][LNAME]"],
+        }
+        if "data[merges][INTERESTS]" in d:
+            merges["INTERESTS"] = d["data[merges][INTERESTS]"]
+        return merges
+
+    def _subscribe(self, d: Mapping[str, str]) -> dict:
+        return {
+            "event": "subscribe",
+            "entityType": "user",
+            "entityId": d["data[id]"],
+            "targetEntityType": "list",
+            "targetEntityId": d["data[list_id]"],
+            "eventTime": _parse_mailchimp_time(d["fired_at"]),
+            "properties": {
+                "email": d["data[email]"],
+                "email_type": d["data[email_type]"],
+                "merges": self._merges(d),
+                "ip_opt": d["data[ip_opt]"],
+                "ip_signup": d["data[ip_signup]"],
+            },
+        }
+
+    def _unsubscribe(self, d: Mapping[str, str]) -> dict:
+        return {
+            "event": "unsubscribe",
+            "entityType": "user",
+            "entityId": d["data[id]"],
+            "targetEntityType": "list",
+            "targetEntityId": d["data[list_id]"],
+            "eventTime": _parse_mailchimp_time(d["fired_at"]),
+            "properties": {
+                "action": d["data[action]"],
+                "reason": d["data[reason]"],
+                "email": d["data[email]"],
+                "email_type": d["data[email_type]"],
+                "merges": self._merges(d),
+                "ip_opt": d["data[ip_opt]"],
+                "campaign_id": d["data[campaign_id]"],
+            },
+        }
+
+    def _profile(self, d: Mapping[str, str]) -> dict:
+        return {
+            "event": "profile",
+            "entityType": "user",
+            "entityId": d["data[id]"],
+            "targetEntityType": "list",
+            "targetEntityId": d["data[list_id]"],
+            "eventTime": _parse_mailchimp_time(d["fired_at"]),
+            "properties": {
+                "email": d["data[email]"],
+                "email_type": d["data[email_type]"],
+                "merges": self._merges(d),
+                "ip_opt": d["data[ip_opt]"],
+            },
+        }
+
+    def _upemail(self, d: Mapping[str, str]) -> dict:
+        # ref: MailChimpConnector.scala:207-230
+        return {
+            "event": "upemail",
+            "entityType": "user",
+            "entityId": d["data[new_id]"],
+            "targetEntityType": "list",
+            "targetEntityId": d["data[list_id]"],
+            "eventTime": _parse_mailchimp_time(d["fired_at"]),
+            "properties": {
+                "new_email": d["data[new_email]"],
+                "old_email": d["data[old_email]"],
+            },
+        }
+
+    def _cleaned(self, d: Mapping[str, str]) -> dict:
+        # ref: MailChimpConnector.scala:239-266
+        return {
+            "event": "cleaned",
+            "entityType": "list",
+            "entityId": d["data[list_id]"],
+            "eventTime": _parse_mailchimp_time(d["fired_at"]),
+            "properties": {
+                "campaignId": d["data[campaign_id]"],
+                "reason": d["data[reason]"],
+                "email": d["data[email]"],
+            },
+        }
+
+    def _campaign(self, d: Mapping[str, str]) -> dict:
+        # ref: MailChimpConnector.scala:269-295
+        return {
+            "event": "campaign",
+            "entityType": "campaign",
+            "entityId": d["data[id]"],
+            "targetEntityType": "list",
+            "targetEntityId": d["data[list_id]"],
+            "eventTime": _parse_mailchimp_time(d["fired_at"]),
+            "properties": {
+                "subject": d["data[subject]"],
+                "status": d["data[status]"],
+                "reason": d["data[reason]"],
+            },
+        }

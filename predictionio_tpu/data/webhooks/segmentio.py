@@ -1,0 +1,87 @@
+"""segment.io webhook connector.
+
+Behavior parity with the reference connector
+(ref: data/.../webhooks/segmentio/SegmentIOConnector.scala): accepts the six
+Segment spec message types, maps userId (falling back to anonymousId) to a
+``user`` entity, the message type to the event name, and merges type-specific
+fields (+ optional context) into properties.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from predictionio_tpu.data.webhooks import ConnectorError, JsonConnector
+
+
+class SegmentIOConnector(JsonConnector):
+    def to_event_json(self, data: Mapping[str, Any]) -> dict:
+        typ = data.get("type")
+        if not typ:
+            raise ConnectorError(f"Cannot extract type field from {dict(data)}.")
+        builder = {
+            "track": self._track,
+            "identify": self._identify,
+            "alias": self._alias,
+            "page": self._page,
+            "screen": self._screen,
+            "group": self._group,
+        }.get(typ)
+        if builder is None:
+            raise ConnectorError(
+                f"Cannot convert unknown type {typ} to event JSON."
+            )
+        try:
+            props = builder(data)
+        except KeyError as e:
+            raise ConnectorError(
+                f"Cannot convert {dict(data)} to event JSON. Missing field {e}."
+            ) from e
+        return self._common(data, typ, props)
+
+    # -- per-type property builders (ref: Events.* case classes) ------------
+    def _track(self, d) -> dict:
+        props = {"event": d["event"]}
+        if d.get("properties") is not None:
+            props["properties"] = d["properties"]
+        return props
+
+    def _identify(self, d) -> dict:
+        return {"userId": d["userId"], "traits": d.get("traits")}
+
+    def _alias(self, d) -> dict:
+        return {"previousId": d["previousId"], "userId": d["userId"]}
+
+    def _page(self, d) -> dict:
+        props = {"name": d["name"]}
+        if d.get("properties") is not None:
+            props["properties"] = d["properties"]
+        return props
+
+    def _screen(self, d) -> dict:
+        props = {"name": d["name"]}
+        if d.get("properties") is not None:
+            props["properties"] = d["properties"]
+        return props
+
+    def _group(self, d) -> dict:
+        return {"groupId": d["groupId"], "traits": d.get("traits")}
+
+    # -- common fields (ref: commonToJson) ----------------------------------
+    def _common(self, d: Mapping[str, Any], typ: str, props: dict) -> dict:
+        user_id = d.get("userId") or d.get("anonymousId")
+        if not user_id:
+            raise ConnectorError(
+                "there was no `userId` or `anonymousId` in the common fields."
+            )
+        if d.get("context") is not None:
+            props = {"context": d["context"], **props}
+        out = {
+            "event": typ,
+            "entityType": "user",
+            "entityId": user_id,
+            "properties": props,
+        }
+        if d.get("timestamp"):
+            out["eventTime"] = d["timestamp"]
+        return out

@@ -1,0 +1,204 @@
+"""App / access-key / channel management (ref: tools/.../console/App.scala).
+
+`app new` creates the app record, a default access key, and initializes the
+app's event store (ref: App.create); `app delete` cascades: data, channels,
+access keys, then the app record (ref: App.delete); `channel-new` initializes
+the channel's event table (ref: App.channelNew:~390).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    is_valid_channel_name,
+    CHANNEL_NAME_CONSTRAINT,
+)
+
+
+def _err(msg: str) -> int:
+    print(f"[ERROR] {msg}", file=sys.stderr)
+    return 1
+
+
+def app_new(name: str, app_id: int = 0, description: str | None = None,
+            access_key: str = "") -> int:
+    apps = Storage.get_meta_data_apps()
+    if apps.get_by_name(name) is not None:
+        return _err(f"App {name} already exists. Aborting.")
+    if app_id != 0 and apps.get(app_id) is not None:
+        return _err(f"App ID {app_id} already exists. Aborting.")
+    new_id = apps.insert(App(app_id, name, description))
+    if new_id is None:
+        return _err(f"Unable to create new app: {name}")
+    events = Storage.get_events()
+    if not events.init(new_id):
+        return _err(f"Unable to initialize Event Store for app {name}.")
+    key = Storage.get_meta_data_access_keys().insert(AccessKey(access_key, new_id, ()))
+    if key is None:
+        return _err("Unable to create new access key.")
+    print(f"[INFO] Initialized Event Store for this app ID: {new_id}.")
+    print("[INFO] Created new app:")
+    print(f"[INFO]       Name: {name}")
+    print(f"[INFO]         ID: {new_id}")
+    print(f"[INFO] Access Key: {key}")
+    return 0
+
+
+def app_list() -> int:
+    apps = sorted(Storage.get_meta_data_apps().get_all(), key=lambda a: a.name)
+    keys = Storage.get_meta_data_access_keys()
+    print(f"[INFO] {'Name':<20} |   ID | {'Access Key':<64} | Allowed Event(s)")
+    for app in apps:
+        for k in keys.get_by_app_id(app.id):
+            events = ",".join(k.events) if k.events else "(all)"
+            print(f"[INFO] {app.name:<20} | {app.id:>4} | {k.key:<64} | {events}")
+    print(f"[INFO] Finished listing {len(apps)} app(s).")
+    return 0
+
+
+def app_show(name: str) -> int:
+    app = Storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        return _err(f"App {name} does not exist. Aborting.")
+    print(f"[INFO]     App Name: {app.name}")
+    print(f"[INFO]       App ID: {app.id}")
+    print(f"[INFO]  Description: {app.description or ''}")
+    for k in Storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        events = ",".join(k.events) if k.events else "(all)"
+        print(f"[INFO]   Access Key: {k.key} | {events}")
+    for ch in Storage.get_meta_data_channels().get_by_app_id(app.id):
+        print(f"[INFO]      Channel: {ch.name} (ID {ch.id})")
+    return 0
+
+
+def app_delete(name: str, force: bool = False) -> int:
+    apps = Storage.get_meta_data_apps()
+    app = apps.get_by_name(name)
+    if app is None:
+        return _err(f"App {name} does not exist. Aborting.")
+    if not force:
+        confirm = input(f"Delete app {name} and ALL its data? (YES to confirm): ")
+        if confirm != "YES":
+            print("[INFO] Aborted.")
+            return 0
+    events = Storage.get_events()
+    channels = Storage.get_meta_data_channels()
+    for ch in channels.get_by_app_id(app.id):
+        events.remove(app.id, ch.id)
+        channels.delete(ch.id)
+    events.remove(app.id)
+    keys = Storage.get_meta_data_access_keys()
+    for k in keys.get_by_app_id(app.id):
+        keys.delete(k.key)
+    if not apps.delete(app.id):
+        return _err(f"Unable to delete app {name}.")
+    print(f"[INFO] App successfully deleted: {name}")
+    return 0
+
+
+def app_data_delete(name: str, channel: str | None = None, force: bool = False) -> int:
+    app = Storage.get_meta_data_apps().get_by_name(name)
+    if app is None:
+        return _err(f"App {name} does not exist. Aborting.")
+    channel_id = None
+    if channel is not None:
+        chans = {
+            c.name: c.id
+            for c in Storage.get_meta_data_channels().get_by_app_id(app.id)
+        }
+        if channel not in chans:
+            return _err(f"Channel {channel} does not exist. Aborting.")
+        channel_id = chans[channel]
+    if not force:
+        confirm = input(f"Delete all data of app {name}? (YES to confirm): ")
+        if confirm != "YES":
+            print("[INFO] Aborted.")
+            return 0
+    events = Storage.get_events()
+    events.remove(app.id, channel_id)
+    events.init(app.id, channel_id)
+    print(f"[INFO] Removed Event Store of the app ID: {app.id}")
+    return 0
+
+
+def channel_new(app_name: str, channel_name: str) -> int:
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        return _err(f"App {app_name} does not exist. Aborting.")
+    if not is_valid_channel_name(channel_name):
+        return _err(f"Invalid channel name: {channel_name}. {CHANNEL_NAME_CONSTRAINT}")
+    channels = Storage.get_meta_data_channels()
+    if any(c.name == channel_name for c in channels.get_by_app_id(app.id)):
+        return _err(f"Channel {channel_name} already exists. Aborting.")
+    channel_id = channels.insert(Channel(0, channel_name, app.id))
+    if channel_id is None:
+        return _err("Unable to create channel.")
+    if not Storage.get_events().init(app.id, channel_id):
+        channels.delete(channel_id)
+        return _err("Unable to initialize Event Store for the channel.")
+    print(f"[INFO] Channel {channel_name} (ID {channel_id}) created for app {app_name}.")
+    return 0
+
+
+def channel_delete(app_name: str, channel_name: str, force: bool = False) -> int:
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        return _err(f"App {app_name} does not exist. Aborting.")
+    channels = Storage.get_meta_data_channels()
+    chan = next(
+        (c for c in channels.get_by_app_id(app.id) if c.name == channel_name), None
+    )
+    if chan is None:
+        return _err(f"Channel {channel_name} does not exist. Aborting.")
+    if not force:
+        confirm = input(
+            f"Delete channel {channel_name} and ALL its data? (YES to confirm): "
+        )
+        if confirm != "YES":
+            print("[INFO] Aborted.")
+            return 0
+    Storage.get_events().remove(app.id, chan.id)
+    channels.delete(chan.id)
+    print(f"[INFO] Channel successfully deleted: {channel_name}")
+    return 0
+
+
+def accesskey_new(app_name: str, key: str = "", events: list[str] | None = None) -> int:
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        return _err(f"App {app_name} does not exist. Aborting.")
+    created = Storage.get_meta_data_access_keys().insert(
+        AccessKey(key, app.id, tuple(events or ()))
+    )
+    if created is None:
+        return _err("Unable to create access key.")
+    print(f"[INFO] Created new access key: {created}")
+    return 0
+
+
+def accesskey_list(app_name: str | None = None) -> int:
+    keys = Storage.get_meta_data_access_keys()
+    if app_name is not None:
+        app = Storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            return _err(f"App {app_name} does not exist. Aborting.")
+        all_keys = keys.get_by_app_id(app.id)
+    else:
+        all_keys = keys.get_all()
+    print(f"[INFO] {'Access Key':<64} | App ID | Allowed Event(s)")
+    for k in sorted(all_keys, key=lambda k: k.appid):
+        events = ",".join(k.events) if k.events else "(all)"
+        print(f"[INFO] {k.key:<64} | {k.appid:>6} | {events}")
+    return 0
+
+
+def accesskey_delete(key: str) -> int:
+    if Storage.get_meta_data_access_keys().delete(key):
+        print(f"[INFO] Deleted access key: {key}")
+        return 0
+    return _err(f"Unable to delete access key: {key}")

@@ -23,7 +23,98 @@ def build_parser() -> argparse.ArgumentParser:
     p_status = sub.add_parser("status", help="verify installation and storage")
     p_status.set_defaults(func=cmd_status)
 
+    # -- app management (ref: Console.scala:467-559) ------------------------
+    p_app = sub.add_parser("app", help="manage apps")
+    app_sub = p_app.add_subparsers(dest="app_command", required=True)
+
+    p = app_sub.add_parser("new", help="create a new app")
+    p.add_argument("name")
+    p.add_argument("--id", type=int, default=0)
+    p.add_argument("--description")
+    p.add_argument("--access-key", default="")
+    p.set_defaults(func=lambda a: _app().app_new(a.name, a.id, a.description,
+                                                 a.access_key))
+
+    p = app_sub.add_parser("list", help="list all apps")
+    p.set_defaults(func=lambda a: _app().app_list())
+
+    p = app_sub.add_parser("show", help="show app details")
+    p.add_argument("name")
+    p.set_defaults(func=lambda a: _app().app_show(a.name))
+
+    p = app_sub.add_parser("delete", help="delete an app and all data")
+    p.add_argument("name")
+    p.add_argument("--force", "-f", action="store_true")
+    p.set_defaults(func=lambda a: _app().app_delete(a.name, a.force))
+
+    p = app_sub.add_parser("data-delete", help="delete all data of an app")
+    p.add_argument("name")
+    p.add_argument("--channel")
+    p.add_argument("--force", "-f", action="store_true")
+    p.set_defaults(func=lambda a: _app().app_data_delete(a.name, a.channel, a.force))
+
+    p = app_sub.add_parser("channel-new", help="add a channel to an app")
+    p.add_argument("name")
+    p.add_argument("channel")
+    p.set_defaults(func=lambda a: _app().channel_new(a.name, a.channel))
+
+    p = app_sub.add_parser("channel-delete", help="delete a channel and its data")
+    p.add_argument("name")
+    p.add_argument("channel")
+    p.add_argument("--force", "-f", action="store_true")
+    p.set_defaults(func=lambda a: _app().channel_delete(a.name, a.channel, a.force))
+
+    # -- access keys (ref: Console.scala:561-607) ---------------------------
+    p_key = sub.add_parser("accesskey", help="manage access keys")
+    key_sub = p_key.add_subparsers(dest="accesskey_command", required=True)
+
+    p = key_sub.add_parser("new", help="create a new access key for an app")
+    p.add_argument("app_name")
+    p.add_argument("--key", default="")
+    p.add_argument("--events", nargs="*", default=None,
+                   help="restrict the key to these event names")
+    p.set_defaults(func=lambda a: _app().accesskey_new(a.app_name, a.key, a.events))
+
+    p = key_sub.add_parser("list", help="list access keys")
+    p.add_argument("app_name", nargs="?")
+    p.set_defaults(func=lambda a: _app().accesskey_list(a.app_name))
+
+    p = key_sub.add_parser("delete", help="delete an access key")
+    p.add_argument("key")
+    p.set_defaults(func=lambda a: _app().accesskey_delete(a.key))
+
+    # -- event server (ref: Console.scala:878-890) --------------------------
+    p_es = sub.add_parser("eventserver", help="launch the REST event server")
+    p_es.add_argument("--ip", default="0.0.0.0")
+    p_es.add_argument("--port", type=int, default=7070)
+    p_es.add_argument("--stats", action="store_true")
+    p_es.set_defaults(func=cmd_eventserver)
+
     return parser
+
+
+def _app():
+    from predictionio_tpu.tools import app as app_module
+
+    return app_module
+
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+
+    server = create_event_server(
+        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats)
+    )
+    server.start()
+    print(f"[INFO] Event Server is listening on {args.ip}:{server.port}")
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
 
 
 def cmd_status(args) -> int:

@@ -1,0 +1,182 @@
+"""Minimal threaded HTTP server + router.
+
+Plays the role spray-can/akka-http plays in the reference (event server,
+engine server, dashboard, admin API all bind REST routes). Threaded to match
+the synchronous storage DAOs; handlers return ``(status, json-serializable)``
+and everything is emitted as JSON, like the reference's
+``respondWithMediaType(application/json)`` routes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import socket
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, str]
+    headers: dict[str, str]
+    body: bytes
+    path_params: dict[str, str] = field(default_factory=dict)
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+    def form(self) -> dict[str, str]:
+        parsed = urllib.parse.parse_qs(
+            self.body.decode("utf-8"), keep_blank_values=True
+        )
+        return {k: v[0] for k, v in parsed.items()}
+
+
+class HTTPError(Exception):
+    """Raise inside a handler to produce a JSON error response."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+Handler = Callable[[Request], "tuple[int, Any]"]
+
+
+class Router:
+    """Method+path-pattern routing. Patterns use ``{name}`` segments, e.g.
+    ``/events/{eventId}.json``."""
+
+    def __init__(self):
+        self._routes: list[tuple[str, re.Pattern, Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        """``{name}`` matches one path segment; ``{name:path}`` matches the
+        rest of the path (for trailing-args routes)."""
+        escaped = re.escape(pattern).replace(r"\{", "{").replace(r"\}", "}")
+        regex = re.sub(r"\{(\w+):path\}", r"(?P<\1>.+)", escaped)
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+?)", regex)
+        self._routes.append((method.upper(), re.compile("^" + regex + "$"), handler))
+
+    def route(self, method: str, pattern: str):
+        def deco(fn: Handler) -> Handler:
+            self.add(method, pattern, fn)
+            return fn
+
+        return deco
+
+    def dispatch(self, request: Request) -> tuple[int, Any]:
+        matched_path = False
+        for method, regex, handler in self._routes:
+            m = regex.match(request.path)
+            if not m:
+                continue
+            matched_path = True
+            if method != request.method:
+                continue
+            request.path_params = m.groupdict()
+            return handler(request)
+        if matched_path:
+            return 405, {"message": "Method Not Allowed"}
+        return 404, {"message": "Not Found"}
+
+
+class AppServer:
+    """Bind a Router on host:port; start/stop/serve_forever."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000):
+        self.router = router
+        self.host = host
+        self.port = port
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def _make_handler(self):
+        router = self.router
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                logger.debug("%s %s", self.address_string(), fmt % args)
+
+            def _handle(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                qs = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                request = Request(
+                    method=self.command,
+                    path=parsed.path,
+                    query={k: v[0] for k, v in qs.items()},
+                    headers={k: v for k, v in self.headers.items()},
+                    body=body,
+                )
+                try:
+                    status, payload = router.dispatch(request)
+                except HTTPError as e:
+                    status, payload = e.status, {"message": e.message}
+                except json.JSONDecodeError as e:
+                    status, payload = 400, {"message": f"Invalid JSON: {e}"}
+                except Exception as e:  # last-resort 500, mirror exceptionHandler
+                    logger.exception("handler error")
+                    status, payload = 500, {"message": str(e)}
+                data = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json; charset=UTF-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_GET = do_POST = do_DELETE = do_PUT = _handle
+
+        return _Handler
+
+    def start(self) -> None:
+        """Bind and serve on a daemon thread. Retries the bind 3 times, like
+        the reference's MasterActor (ref: CreateServer.scala:363-373)."""
+        import time
+
+        last_err: OSError | None = None
+        for _ in range(3):
+            try:
+                self._server = ThreadingHTTPServer(
+                    (self.host, self.port), self._make_handler()
+                )
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(1)
+        if self._server is None:
+            raise last_err  # type: ignore[misc]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

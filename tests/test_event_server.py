@@ -1,0 +1,321 @@
+"""Event Server REST API tests over live HTTP.
+
+The reference tests routes with spray testkit
+(ref: data/.../api/EventServiceSpec.scala); here each test talks to a real
+server on an ephemeral port — same contract, real sockets.
+"""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.api.event_server import (
+    EventServerConfig,
+    create_event_server,
+)
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+
+def call(port, method, path, params=None, body=None, form=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    data = None
+    headers = {}
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    elif form is not None:
+        data = urllib.parse.urlencode(form).encode()
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(url, data=data, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def server(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "testapp"))
+    key = memory_storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    channel_id = memory_storage.get_meta_data_channels().insert(
+        Channel(0, "ch1", app_id)
+    )
+    events = memory_storage.get_events()
+    events.init(app_id)
+    events.init(app_id, channel_id)
+    srv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0, stats=True))
+    srv.start()
+    yield {"port": srv.port, "key": key, "app_id": app_id}
+    srv.stop()
+
+
+EVENT = {
+    "event": "my_event",
+    "entityType": "user",
+    "entityId": "uid",
+    "properties": {"prop1": 1, "prop2": "value2"},
+    "eventTime": "2013-08-09T18:03:09.000-07:00",
+}
+
+
+def test_root_alive(server):
+    assert call(server["port"], "GET", "/") == (200, {"status": "alive"})
+
+
+def test_post_event_created_201(server):
+    status, body = call(
+        server["port"], "POST", "/events.json", {"accessKey": server["key"]}, EVENT
+    )
+    assert status == 201
+    assert "eventId" in body
+
+
+def test_post_event_missing_key_401(server):
+    status, _ = call(server["port"], "POST", "/events.json", None, EVENT)
+    assert status == 401
+
+
+def test_post_event_bad_key_401(server):
+    status, _ = call(
+        server["port"], "POST", "/events.json", {"accessKey": "wrong"}, EVENT
+    )
+    assert status == 401
+
+
+def test_post_event_invalid_event_400(server):
+    bad = dict(EVENT, event="$custom")
+    status, body = call(
+        server["port"], "POST", "/events.json", {"accessKey": server["key"]}, bad
+    )
+    assert status == 400
+    assert "reserved" in body["message"]
+
+
+def test_post_malformed_json_400(server):
+    url = f"http://127.0.0.1:{server['port']}/events.json?accessKey={server['key']}"
+    req = urllib.request.Request(
+        url, data=b"{not json", headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_get_single_event_and_delete(server):
+    port, key = server["port"], server["key"]
+    _, body = call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
+    eid = body["eventId"]
+    status, got = call(port, "GET", f"/events/{eid}.json", {"accessKey": key})
+    assert status == 200
+    assert got["event"] == "my_event"
+    assert got["eventTime"] == "2013-08-09T18:03:09.000-07:00"
+    status, msg = call(port, "DELETE", f"/events/{eid}.json", {"accessKey": key})
+    assert (status, msg) == (200, {"message": "Found"})
+    status, msg = call(port, "DELETE", f"/events/{eid}.json", {"accessKey": key})
+    assert (status, msg) == (404, {"message": "Not Found"})
+
+
+def test_get_events_query(server):
+    port, key = server["port"], server["key"]
+    for i in range(25):
+        e = dict(EVENT, entityId=f"u{i % 2}",
+                 eventTime=f"2013-08-09T18:03:{i:02d}.000Z")
+        call(port, "POST", "/events.json", {"accessKey": key}, e)
+    # default limit 20
+    status, body = call(port, "GET", "/events.json", {"accessKey": key})
+    assert status == 200
+    assert len(body) == 20
+    # explicit limit
+    _, body = call(port, "GET", "/events.json", {"accessKey": key, "limit": "3"})
+    assert len(body) == 3
+    # entity filter
+    _, body = call(
+        port, "GET", "/events.json",
+        {"accessKey": key, "entityType": "user", "entityId": "u1", "limit": "-1"},
+    )
+    assert len(body) == 12
+    # reversed requires entity
+    status, body = call(port, "GET", "/events.json",
+                        {"accessKey": key, "reversed": "true"})
+    assert status == 400
+    # reversed with entity
+    status, body = call(
+        port, "GET", "/events.json",
+        {"accessKey": key, "entityType": "user", "entityId": "u1",
+         "reversed": "true", "limit": "2"},
+    )
+    assert status == 200
+    assert body[0]["eventTime"] > body[1]["eventTime"]
+    # empty result is 404
+    status, body = call(
+        port, "GET", "/events.json", {"accessKey": key, "entityId": "nobody",
+                                      "entityType": "user"},
+    )
+    assert status == 404
+
+
+def test_channel_auth(server):
+    port, key = server["port"], server["key"]
+    status, body = call(
+        port, "POST", "/events.json", {"accessKey": key, "channel": "ch1"}, EVENT
+    )
+    assert status == 201
+    # event went to the channel, not the default store
+    status, _ = call(port, "GET", "/events.json", {"accessKey": key})
+    assert status == 404
+    status, body = call(
+        port, "GET", "/events.json", {"accessKey": key, "channel": "ch1"}
+    )
+    assert status == 200 and len(body) == 1
+    status, body = call(
+        port, "POST", "/events.json", {"accessKey": key, "channel": "nope"}, EVENT
+    )
+    assert status == 401
+    assert "Invalid channel" in body["message"]
+
+
+def test_stats(server):
+    port, key = server["port"], server["key"]
+    call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
+    status, body = call(port, "GET", "/stats.json", {"accessKey": key})
+    assert status == 200
+    assert body["basic"][0]["event"] == "my_event"
+    assert body["basic"][0]["count"] == 1
+
+
+def test_stats_disabled_404(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "nostats"))
+    key = memory_storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    memory_storage.get_events().init(app_id)
+    srv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0, stats=False))
+    srv.start()
+    try:
+        status, body = call(srv.port, "GET", "/stats.json", {"accessKey": key})
+        assert status == 404
+        assert "--stats" in body["message"]
+    finally:
+        srv.stop()
+
+
+def test_webhook_json_segmentio(server):
+    port, key = server["port"], server["key"]
+    payload = {
+        "type": "track",
+        "userId": "u9",
+        "event": "Signed Up",
+        "timestamp": "2015-01-01T00:00:00Z",
+    }
+    status, body = call(
+        port, "POST", "/webhooks/segmentio.json", {"accessKey": key}, payload
+    )
+    assert status == 201
+    status, events = call(
+        port, "GET", "/events.json", {"accessKey": key, "event": "track"}
+    )
+    assert status == 200
+    assert events[0]["entityId"] == "u9"
+    # GET reports connector presence
+    assert call(port, "GET", "/webhooks/segmentio.json", {"accessKey": key})[0] == 200
+    assert call(port, "GET", "/webhooks/nope.json", {"accessKey": key})[0] == 404
+
+
+def test_webhook_form_mailchimp(server):
+    port, key = server["port"], server["key"]
+    form = {
+        "type": "subscribe",
+        "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98",
+        "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com",
+        "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp",
+        "data[merges][LNAME]": "API",
+        "data[ip_opt]": "10.20.10.30",
+        "data[ip_signup]": "10.20.10.30",
+    }
+    status, body = call(
+        port, "POST", "/webhooks/mailchimp", {"accessKey": key}, form=form
+    )
+    assert status == 201
+    status, events = call(
+        port, "GET", "/events.json", {"accessKey": key, "event": "subscribe"}
+    )
+    assert events[0]["targetEntityId"] == "a6b5da1054"
+
+
+def test_plugins_json(server):
+    status, body = call(server["port"], "GET", "/plugins.json")
+    assert status == 200
+    assert body == {"plugins": {"inputblockers": {}, "inputsniffers": {}}}
+
+
+def test_bad_event_time_returns_400_not_500(server):
+    bad = dict(EVENT, eventTime="garbage")
+    status, body = call(
+        server["port"], "POST", "/events.json", {"accessKey": server["key"]}, bad
+    )
+    assert status == 400
+    # segmentio path with bad timestamp also 400s
+    status, _ = call(
+        server["port"], "POST", "/webhooks/segmentio.json",
+        {"accessKey": server["key"]},
+        {"type": "track", "userId": "u", "event": "x", "timestamp": "garbage"},
+    )
+    assert status == 400
+    # attacker-controlled type resolving to internal helper is still 400
+    status, _ = call(
+        server["port"], "POST", "/webhooks/segmentio.json",
+        {"accessKey": server["key"]}, {"type": "common", "userId": "u"},
+    )
+    assert status == 400
+
+
+def test_plugin_rest_with_args(server, monkeypatch):
+    from predictionio_tpu.data.api import plugins as plugmod
+
+    class EchoPlugin(plugmod.EventServerPlugin):
+        plugin_name = "echo"
+        plugin_type = plugmod.INPUT_BLOCKER
+
+        def process(self, event_info, context):
+            pass
+
+        def handle_rest(self, app_id, channel_id, args):
+            return {"appId": app_id, "args": args}
+
+    service_ctx = plugmod.EventServerPluginContext([EchoPlugin()])
+    # rebuild a server with the plugin present
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        EventService,
+    )
+    from predictionio_tpu.utils.http import AppServer
+
+    svc = EventService(EventServerConfig(ip="127.0.0.1", port=0))
+    svc.plugin_context = service_ctx
+    srv = AppServer(svc.router, "127.0.0.1", 0)
+    srv.start()
+    try:
+        status, body = call(
+            srv.port, "GET", "/plugins/inputblocker/echo/a/b",
+            {"accessKey": server["key"]},
+        )
+        assert status == 200
+        assert body["args"] == ["a", "b"]
+        status, body = call(
+            srv.port, "GET", "/plugins/inputblocker/echo",
+            {"accessKey": server["key"]},
+        )
+        assert body["args"] == []
+    finally:
+        srv.stop()

@@ -1,0 +1,156 @@
+"""Webhook connector tests (ref: data/.../webhooks/{segmentio,mailchimp}/…Spec.scala)."""
+
+import pytest
+
+from predictionio_tpu.data.event import validate_event
+from predictionio_tpu.data.webhooks import (
+    ConnectorError,
+    form_connectors,
+    json_connectors,
+    to_event,
+)
+
+
+class TestSegmentIO:
+    def setup_method(self):
+        self.c = json_connectors()["segmentio"]
+
+    def test_track(self):
+        payload = {
+            "type": "track",
+            "userId": "019mr8mf4r",
+            "event": "Purchased an Item",
+            "properties": {"revenue": 39.95, "shipping": "2-day"},
+            "timestamp": "2012-12-02T00:30:08.276+00:00",
+        }
+        e = to_event(self.c, payload)
+        validate_event(e)
+        assert e.event == "track"
+        assert e.entity_type == "user"
+        assert e.entity_id == "019mr8mf4r"
+        assert e.properties.get("event") == "Purchased an Item"
+        assert e.properties.get("properties")["revenue"] == 39.95
+        assert e.event_time.isoformat().startswith("2012-12-02T00:30:08.276")
+
+    def test_identify_with_anonymous_id_fallback(self):
+        e = to_event(
+            self.c,
+            {
+                "type": "identify",
+                "anonymousId": "anon1",
+                "userId": "anon1",
+                "traits": {"email": "x@y.z"},
+                "timestamp": "2015-01-01T00:00:00Z",
+            },
+        )
+        assert e.entity_id == "anon1"
+        assert e.properties.get("traits") == {"email": "x@y.z"}
+
+    def test_context_merged_into_properties(self):
+        e = to_event(
+            self.c,
+            {
+                "type": "page",
+                "userId": "u1",
+                "name": "Home",
+                "context": {"ip": "1.2.3.4"},
+                "timestamp": "2015-01-01T00:00:00Z",
+            },
+        )
+        assert e.properties.get("context") == {"ip": "1.2.3.4"}
+        assert e.properties.get("name") == "Home"
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ConnectorError):
+            self.c.to_event_json({"type": "bogus", "userId": "u"})
+
+    def test_missing_user_rejected(self):
+        with pytest.raises(ConnectorError):
+            self.c.to_event_json(
+                {"type": "track", "event": "x", "timestamp": "2015-01-01T00:00:00Z"}
+            )
+
+
+class TestMailChimp:
+    def setup_method(self):
+        self.c = form_connectors()["mailchimp"]
+        self.subscribe = {
+            "type": "subscribe",
+            "fired_at": "2009-03-26 21:35:57",
+            "data[id]": "8a25ff1d98",
+            "data[list_id]": "a6b5da1054",
+            "data[email]": "api@mailchimp.com",
+            "data[email_type]": "html",
+            "data[merges][EMAIL]": "api@mailchimp.com",
+            "data[merges][FNAME]": "MailChimp",
+            "data[merges][LNAME]": "API",
+            "data[merges][INTERESTS]": "Group1,Group2",
+            "data[ip_opt]": "10.20.10.30",
+            "data[ip_signup]": "10.20.10.30",
+        }
+
+    def test_subscribe(self):
+        e = to_event(self.c, self.subscribe)
+        validate_event(e)
+        assert e.event == "subscribe"
+        assert e.entity_type == "user"
+        assert e.entity_id == "8a25ff1d98"
+        assert e.target_entity_type == "list"
+        assert e.target_entity_id == "a6b5da1054"
+        assert e.event_time.isoformat().startswith("2009-03-26T21:35:57")
+        assert e.properties.get("merges")["FNAME"] == "MailChimp"
+
+    def test_unsubscribe(self):
+        payload = dict(self.subscribe)
+        payload.update(
+            {
+                "type": "unsubscribe",
+                "data[action]": "unsub",
+                "data[reason]": "manual",
+                "data[campaign_id]": "cb398d21d2",
+            }
+        )
+        del payload["data[ip_signup]"]
+        e = to_event(self.c, payload)
+        assert e.event == "unsubscribe"
+        assert e.properties.get("action") == "unsub"
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ConnectorError):
+            self.c.to_event_json({"type": "woo", "fired_at": "2009-03-26 21:35:57"})
+
+    def test_bad_date_rejected(self):
+        payload = dict(self.subscribe, fired_at="not-a-date")
+        with pytest.raises(ConnectorError):
+            self.c.to_event_json(payload)
+
+
+def test_mailchimp_upemail_reference_parity():
+    c = form_connectors()["mailchimp"]
+    e = to_event(c, {
+        "type": "upemail",
+        "fired_at": "2009-03-26 22:15:09",
+        "data[list_id]": "a6b5da1054",
+        "data[new_id]": "51da8c3259",
+        "data[new_email]": "api+new@mailchimp.com",
+        "data[old_email]": "api+old@mailchimp.com",
+    })
+    assert e.entity_id == "51da8c3259"
+    assert e.target_entity_type == "list"
+    assert e.target_entity_id == "a6b5da1054"
+
+
+def test_mailchimp_campaign_targets_list():
+    c = form_connectors()["mailchimp"]
+    e = to_event(c, {
+        "type": "campaign",
+        "fired_at": "2009-03-26 21:31:21",
+        "data[id]": "5aa2102003",
+        "data[subject]": "S",
+        "data[status]": "sent",
+        "data[reason]": "",
+        "data[list_id]": "a6b5da1054",
+    })
+    assert e.entity_type == "campaign"
+    assert e.target_entity_type == "list"
+    assert e.target_entity_id == "a6b5da1054"
