@@ -1,0 +1,72 @@
+"""Headline benchmark: ALS training throughput (MovieLens-100K scale).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.md: "published": {});
+its equivalent workload is MLlib ALS inside `pio train`
+(ref: examples/scala-parallel-recommendation/.../ALSAlgorithm.scala:27-67,
+rank 10 / 20 iterations on MovieLens). We measure full ALS iterations/sec
+(both half-solves, all degree buckets) at ML-100K scale — 943 users, 1682
+items, 100k ratings, rank 10 — on the available accelerator. vs_baseline is
+relative to a conservative Spark-MLlib-local reference of 0.5 iter/s for
+this workload class (MLlib ALS local-mode iterations are O(seconds) each);
+the real comparison is re-measured by the driver across rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def synthesize_ml100k(seed: int = 0):
+    """ML-100K-shaped synthetic ratings (same size/sparsity/degree skew)."""
+    rng = np.random.default_rng(seed)
+    n_users, n_items, nnz = 943, 1682, 100_000
+    # zipf-ish item popularity, matching MovieLens' skew
+    item_p = 1.0 / np.arange(1, n_items + 1) ** 0.8
+    item_p /= item_p.sum()
+    user_p = 1.0 / np.arange(1, n_users + 1) ** 0.6
+    user_p /= user_p.sum()
+    ui = rng.choice(n_users, nnz, p=user_p).astype(np.int32)
+    ii = rng.choice(n_items, nnz, p=item_p).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    return ui, ii, r, n_users, n_items
+
+
+def main() -> None:
+    from predictionio_tpu.models.als import ALS, ALSParams
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    ctx = compute_context()
+    ui, ii, r, n_users, n_items = synthesize_ml100k()
+
+    als = ALS(ctx, ALSParams(rank=10, num_iterations=1, seed=0))
+    # warmup: compile all bucket shapes
+    als.train(ui, ii, r, n_users, n_items)
+
+    iters = 10
+    als_timed = ALS(ctx, ALSParams(rank=10, num_iterations=iters, seed=0))
+    t0 = time.perf_counter()
+    factors = als_timed.train(ui, ii, r, n_users, n_items)
+    np.asarray(factors.user_features)  # block
+    dt = time.perf_counter() - t0
+
+    iter_per_sec = iters / dt
+    baseline_iter_per_sec = 0.5  # Spark MLlib local-mode class, see docstring
+    print(
+        json.dumps(
+            {
+                "metric": "ml100k_als_rank10_iterations_per_sec",
+                "value": round(iter_per_sec, 3),
+                "unit": "iter/s",
+                "vs_baseline": round(iter_per_sec / baseline_iter_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

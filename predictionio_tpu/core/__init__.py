@@ -1,0 +1,37 @@
+"""Engine developer API: the DASE controller layer (L3).
+
+Mirrors the reference's ``controller`` package
+(ref: core/src/main/scala/io/prediction/controller/): engines are composed
+from pluggable DataSource, Preparator, Algorithm(s), Serving components and
+evaluated with Metrics over parameter sweeps.
+"""
+
+from predictionio_tpu.core.params import Params, params_from_json, params_to_json  # noqa: F401
+from predictionio_tpu.core.base import (  # noqa: F401
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    SanityCheck,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    TrainingInterruption,
+)
+from predictionio_tpu.core.dase import (  # noqa: F401
+    IdentityPreparator,
+    LAlgorithm,
+    LAverageServing,
+    LDataSource,
+    LFirstServing,
+    LPreparator,
+    LServing,
+    P2LAlgorithm,
+    PAlgorithm,
+    PDataSource,
+    PPreparator,
+)
+from predictionio_tpu.core.engine import (  # noqa: F401
+    Engine,
+    EngineParams,
+    SimpleEngine,
+)

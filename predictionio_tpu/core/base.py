@@ -1,0 +1,128 @@
+"""Type-erased DASE contracts the workflow runtime drives.
+
+Re-design of the reference's abstract bases
+(ref: core/src/main/scala/io/prediction/core/BaseDataSource.scala:31-51,
+BasePreparator.scala:40, BaseAlgorithm.scala:60-137, BaseServing.scala:36-50,
+BaseEvaluator.scala:37-72). The reference splits "Base*" (type-erased,
+RDD-typed) from "controller" classes (typed, user-facing); in Python the
+erasure layer is just the uniform method surface Engine.train/eval calls.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Generic, Sequence, TypeVar
+
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+M = TypeVar("M")  # model
+Q = TypeVar("Q")  # query
+P = TypeVar("P")  # predicted result
+A = TypeVar("A")  # actual result
+
+
+class TrainingInterruption(Exception):
+    """Raised to stop the pipeline early (ref: CreateWorkflow's
+    --stop-after-read / --stop-after-prepare debug workflow)."""
+
+
+class StopAfterReadInterruption(TrainingInterruption):
+    pass
+
+
+class StopAfterPrepareInterruption(TrainingInterruption):
+    pass
+
+
+class SanityCheck:
+    """Data classes may implement ``sanity_check`` which train calls on
+    TD/PD/models unless skipped (ref: controller/SanityCheck.scala:24,
+    enforcement controller/Engine.scala:648-704)."""
+
+    def sanity_check(self) -> None:
+        raise NotImplementedError
+
+
+class BaseDataSource(ABC, Generic[TD, EI, Q, A]):
+    @abstractmethod
+    def read_training(self, ctx: ComputeContext) -> TD:
+        """ref: BaseDataSource.readTrainingBase"""
+
+    def read_eval(
+        self, ctx: ComputeContext
+    ) -> Sequence[tuple[TD, EI, Sequence[tuple[Q, A]]]]:
+        """Folds of (training data, eval info, (query, actual) pairs)
+        (ref: BaseDataSource.readEvalBase). Default: no eval support."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_eval; "
+            "evaluation is not supported for this data source"
+        )
+
+
+class BasePreparator(ABC, Generic[TD, PD]):
+    @abstractmethod
+    def prepare(self, ctx: ComputeContext, training_data: TD) -> PD:
+        """ref: BasePreparator.prepareBase"""
+
+
+class BaseAlgorithm(ABC, Generic[PD, M, Q, P]):
+    query_class: type | None = None  # for JSON query binding at serve time
+
+    @abstractmethod
+    def train(self, ctx: ComputeContext, prepared_data: PD) -> M:
+        """ref: BaseAlgorithm.trainBase"""
+
+    @abstractmethod
+    def predict(self, model: M, query: Q) -> P:
+        """ref: BaseAlgorithm.predictBase — the serve-time path."""
+
+    def batch_predict(
+        self, model: M, queries: Sequence[tuple[int, Q]]
+    ) -> list[tuple[int, P]]:
+        """Indexed batch predict used by evaluation
+        (ref: BaseAlgorithm.batchPredictBase)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement batch_predict"
+        )
+
+    def make_persistent_model(self, ctx: ComputeContext, model_id: str, model: M):
+        """Hook deciding what gets serialized after train
+        (ref: BaseAlgorithm.makePersistentModel): return the model itself for
+        automatic persistence, a :class:`PersistentModelManifest` if the
+        algorithm saved it manually, or ``None`` (Unit) to re-train on
+        deploy."""
+        return model
+
+
+class BaseServing(ABC, Generic[Q, P]):
+    def supplement(self, query: Q) -> Q:
+        """ref: BaseServing.supplementBase"""
+        return query
+
+    @abstractmethod
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        """ref: BaseServing.serveBase"""
+
+
+class BaseEvaluator(ABC):
+    @abstractmethod
+    def evaluate(self, ctx: ComputeContext, evaluation, eval_data_set, params):
+        """ref: BaseEvaluator.evaluateBase"""
+
+
+class BaseEvaluatorResult:
+    """ref: BaseEvaluator.scala BaseEvaluatorResult:37-72"""
+
+    no_save: bool = False
+
+    def to_one_liner(self) -> str:
+        return ""
+
+    def to_html(self) -> str:
+        return ""
+
+    def to_json(self) -> Any:
+        return ""

@@ -1,0 +1,154 @@
+"""User-facing DASE component flavors.
+
+The reference ships Local (L), Parallel (P), and Parallel-to-Local (P2L)
+variants of each component (ref: controller/PDataSource.scala:34,
+LDataSource.scala:35, PPreparator.scala:30, LPreparator.scala:33,
+PAlgorithm.scala:44, P2LAlgorithm.scala:43, LAlgorithm.scala:42,
+LServing.scala:27-52). The split encodes *where data lives*: P-variants
+operate on cluster-distributed data, L-variants on driver-local objects,
+P2L trains on distributed data but yields a local model.
+
+TPU translation: "distributed data" means mesh-sharded device arrays /
+columnar batches feeding XLA programs; "local" means host Python objects.
+The semantics preserved from the reference:
+
+- ``LAlgorithm.train`` takes no ComputeContext (single-host training; the
+  reference wraps it in a 1-element RDD, controller/LAlgorithm.scala:45).
+- ``P2LAlgorithm.batch_predict`` defaults to mapping ``predict`` over
+  queries (controller/P2LAlgorithm.scala:66); ``LAlgorithm`` likewise
+  (its RDD cartesian collapses to a map in-process,
+  controller/LAlgorithm.scala:68-74); ``PAlgorithm`` has NO default — a
+  distributed model must implement its own batched path
+  (controller/PAlgorithm.scala:69 throws).
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Generic, Sequence
+
+from predictionio_tpu.core.base import (
+    A,
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    EI,
+    M,
+    P,
+    PD,
+    Q,
+    TD,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+# -- data sources -----------------------------------------------------------
+
+
+class PDataSource(BaseDataSource[TD, EI, Q, A]):
+    """Training data as mesh-ready columnar/array batches."""
+
+
+class LDataSource(BaseDataSource[TD, EI, Q, A]):
+    """Driver-local training data (ref auto-wraps in RDD; here no wrapping
+    is needed — the contract surface stays the same)."""
+
+    @abstractmethod
+    def read_training_local(self) -> TD: ...
+
+    def read_training(self, ctx: ComputeContext) -> TD:
+        return self.read_training_local()
+
+    def read_eval_local(self) -> Sequence[tuple[TD, EI, Sequence[tuple[Q, A]]]]:
+        raise NotImplementedError
+
+    def read_eval(self, ctx: ComputeContext):
+        return self.read_eval_local()
+
+
+# -- preparators ------------------------------------------------------------
+
+
+class PPreparator(BasePreparator[TD, PD]):
+    pass
+
+
+class LPreparator(BasePreparator[TD, PD]):
+    @abstractmethod
+    def prepare_local(self, training_data: TD) -> PD: ...
+
+    def prepare(self, ctx: ComputeContext, training_data: TD) -> PD:
+        return self.prepare_local(training_data)
+
+
+class IdentityPreparator(BasePreparator[TD, TD]):
+    """ref: controller/IdentityPreparator.scala:31"""
+
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx: ComputeContext, training_data: TD) -> TD:
+        return training_data
+
+
+# -- algorithms -------------------------------------------------------------
+
+
+class PAlgorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Model stays device-resident/sharded. No default batch_predict
+    (ref: PAlgorithm.batchPredict throws, controller/PAlgorithm.scala:69)."""
+
+
+class P2LAlgorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Trains on mesh data, yields a host-local model."""
+
+    def batch_predict(self, model, queries):
+        # ref: P2LAlgorithm.scala:66 — qs.mapValues(predict)
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+class LAlgorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Single-host algorithm: train sees only local prepared data."""
+
+    @abstractmethod
+    def train_local(self, prepared_data: PD) -> M: ...
+
+    def train(self, ctx: ComputeContext, prepared_data: PD) -> M:
+        return self.train_local(prepared_data)
+
+    def batch_predict(self, model, queries):
+        # ref: LAlgorithm.scala:68-74 — model × queries cartesian, in-process
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+# -- serving ----------------------------------------------------------------
+
+
+class LServing(BaseServing[Q, P]):
+    """ref: controller/LServing.scala:27-52"""
+
+
+class FirstServing(LServing[Q, P]):
+    """Serve the first algorithm's prediction (ref: LFirstServing.scala:25)."""
+
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(LServing[Q, float]):
+    """Average numeric predictions (ref: LAverageServing.scala:25)."""
+
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)
+
+
+# reference-parity aliases (the reference names these LFirstServing etc.)
+LFirstServing = FirstServing
+LAverageServing = AverageServing

@@ -1,0 +1,276 @@
+"""Engine: chains DASE components; concrete train/eval.
+
+Re-design of the reference's ``Engine``
+(ref: controller/Engine.scala:80-816): an Engine binds a DataSource class, a
+Preparator class, a named map of Algorithm classes, and a Serving class;
+``EngineParams`` carries per-component parameters. ``Engine.train`` drives
+read → prepare → per-algorithm train with sanity checks and early-stop
+interrupts (ref: Engine.train:621-708); ``Engine.eval`` fans out folds ×
+algorithms and joins predictions per query index before serving
+(ref: Engine.eval:726-816).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from predictionio_tpu.core.base import (
+    BaseAlgorithm,
+    BaseDataSource,
+    BasePreparator,
+    BaseServing,
+    SanityCheck,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+)
+from predictionio_tpu.core.params import params_from_json, params_to_json
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class EngineParams:
+    """Per-component parameters (ref: controller/EngineParams.scala:28-100).
+    ``algorithms_params`` is a sequence of (algorithm-name, params); names
+    select classes from the engine's algorithm map."""
+
+    data_source_params: Any = None
+    preparator_params: Any = None
+    algorithms_params: Sequence[tuple[str, Any]] = field(default_factory=tuple)
+    serving_params: Any = None
+
+
+@dataclass
+class WorkflowParams:
+    """Train/eval workflow knobs (ref: workflow/WorkflowParams.scala:28-41)."""
+
+    batch: str = ""
+    verbose: int = 0
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+def _bind_params(cls: type | None, params: Any):
+    """Bind a raw JSON dict to the component's declared ``params_class``
+    (one place, used by both engine.json parsing and construction)."""
+    params_class = getattr(cls, "params_class", None) if cls else None
+    if isinstance(params, dict) and params_class is not None:
+        return params_from_json(params_class, params)
+    return params
+
+
+def _instantiate(cls: type, params: Any):
+    """The Doer analog (ref: core/AbstractDoer.scala:36-63): construct a
+    component with its params. Components take params as the single
+    constructor argument; a ``params_class`` attribute binds JSON dicts."""
+    params = _bind_params(cls, params)
+    if params is None:
+        try:
+            return cls()
+        except TypeError:
+            return cls(None)
+    return cls(params)
+
+
+def _sanity_check(obj: Any, what: str, wp: WorkflowParams) -> None:
+    # ref: Engine.scala:648-704 — call sanityCheck() on data/models that
+    # implement it, unless --skip-sanity-check
+    if wp.skip_sanity_check:
+        return
+    if isinstance(obj, SanityCheck):
+        logger.info("%s: running sanity check", what)
+        obj.sanity_check()
+
+
+class Engine:
+    """ref: controller/Engine.scala:80"""
+
+    def __init__(
+        self,
+        data_source_class: type[BaseDataSource],
+        preparator_class: type[BasePreparator],
+        algorithm_class_map: dict[str, type[BaseAlgorithm]],
+        serving_class: type[BaseServing],
+    ):
+        self.data_source_class = data_source_class
+        self.preparator_class = preparator_class
+        self.algorithm_class_map = dict(algorithm_class_map)
+        self.serving_class = serving_class
+
+    # -- component construction --------------------------------------------
+    def _algorithms(self, engine_params: EngineParams) -> list[BaseAlgorithm]:
+        algos = []
+        for name, aparams in engine_params.algorithms_params:
+            if name not in self.algorithm_class_map:
+                raise KeyError(
+                    f"Algorithm {name} is not registered in this engine; "
+                    f"available: {sorted(self.algorithm_class_map)}"
+                )
+            algos.append(_instantiate(self.algorithm_class_map[name], aparams))
+        if not algos:
+            raise ValueError("EngineParams names no algorithms")
+        return algos
+
+    # -- train (ref: Engine.train:621-708) ----------------------------------
+    def train(
+        self,
+        ctx: ComputeContext,
+        engine_params: EngineParams,
+        params: WorkflowParams | None = None,
+    ) -> list[Any]:
+        wp = params or WorkflowParams()
+        data_source = _instantiate(
+            self.data_source_class, engine_params.data_source_params
+        )
+        preparator = _instantiate(
+            self.preparator_class, engine_params.preparator_params
+        )
+        algorithms = self._algorithms(engine_params)
+
+        td = data_source.read_training(ctx)
+        _sanity_check(td, "TrainingData", wp)
+        if wp.stop_after_read:
+            raise StopAfterReadInterruption()
+
+        pd = preparator.prepare(ctx, td)
+        _sanity_check(pd, "PreparedData", wp)
+        if wp.stop_after_prepare:
+            raise StopAfterPrepareInterruption()
+
+        models = [algo.train(ctx, pd) for algo in algorithms]
+        for model in models:
+            _sanity_check(model, "Model", wp)
+        return models
+
+    # -- eval (ref: Engine.eval:726-816) ------------------------------------
+    def eval(
+        self,
+        ctx: ComputeContext,
+        engine_params: EngineParams,
+        params: WorkflowParams | None = None,
+    ) -> list[tuple[Any, list[tuple[Any, Any, Any]]]]:
+        """Returns per-fold ``(eval_info, [(query, prediction, actual)])``."""
+        wp = params or WorkflowParams()
+        data_source = _instantiate(
+            self.data_source_class, engine_params.data_source_params
+        )
+        preparator = _instantiate(
+            self.preparator_class, engine_params.preparator_params
+        )
+        serving = _instantiate(self.serving_class, engine_params.serving_params)
+
+        results = []
+        for fold_idx, (td, ei, qa_pairs) in enumerate(data_source.read_eval(ctx)):
+            logger.info("eval fold %d: %d queries", fold_idx, len(qa_pairs))
+            pd = preparator.prepare(ctx, td)
+            algorithms = self._algorithms(engine_params)
+            models = [algo.train(ctx, pd) for algo in algorithms]
+            # supplement BEFORE predicting; serve receives the ORIGINAL query
+            # (ref: Engine.eval:766 and the comment at :801-803)
+            indexed_queries = [
+                (i, serving.supplement(q)) for i, (q, _a) in enumerate(qa_pairs)
+            ]
+            # per-algo batch predict, then join on query index — the in-process
+            # equivalent of the reference's RDD union+groupByKey join
+            # (ref: Engine.eval:786-792)
+            per_query: list[list[Any]] = [
+                [None] * len(algorithms) for _ in qa_pairs
+            ]
+            for ai, (algo, model) in enumerate(zip(algorithms, models)):
+                for qi, prediction in algo.batch_predict(model, indexed_queries):
+                    per_query[qi][ai] = prediction
+            fold_result = []
+            for i, (q, a) in enumerate(qa_pairs):
+                prediction = serving.serve(q, per_query[i])
+                fold_result.append((q, prediction, a))
+            results.append((ei, fold_result))
+        return results
+
+    # -- deploy-time model preparation (ref: Engine.prepareDeploy:196-265) ---
+    def prepare_deploy(
+        self,
+        ctx: ComputeContext,
+        engine_params: EngineParams,
+        instance_id: str,
+        persisted_models: list[Any],
+        params: WorkflowParams | None = None,
+    ) -> list[Any]:
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModelManifest,
+            load_persistent_model,
+        )
+
+        algorithms = self._algorithms(engine_params)
+        if any(m is None for m in persisted_models):
+            # a None (Unit) model means re-train on deploy
+            # (ref: Engine.scala:208-230 train-anew path)
+            logger.info("deploy: re-training (model persisted as Unit)")
+            trained = self.train(ctx, engine_params, params)
+        else:
+            trained = persisted_models
+        out = []
+        for algo, model in zip(algorithms, trained):
+            if isinstance(model, PersistentModelManifest):
+                out.append(load_persistent_model(model, instance_id, ctx))
+            else:
+                out.append(model)
+        return out
+
+    # -- engine.json parsing (ref: Engine.jValueToEngineParams:353-416) ------
+    def engine_params_from_json(self, variant: dict[str, Any]) -> EngineParams:
+        def component_params(key: str, cls: type | None):
+            obj = variant.get(key)
+            if obj is None:
+                return None
+            p = obj.get("params", {}) if isinstance(obj, dict) else {}
+            return _bind_params(cls, p)
+
+        algorithms_params = []
+        for algo in variant.get("algorithms", []):
+            name = algo["name"]
+            cls = self.algorithm_class_map.get(name)
+            if cls is None:
+                raise KeyError(
+                    f"engine.json names unknown algorithm {name!r}; "
+                    f"available: {sorted(self.algorithm_class_map)}"
+                )
+            algorithms_params.append((name, _bind_params(cls, algo.get("params", {}))))
+
+        return EngineParams(
+            data_source_params=component_params("datasource", self.data_source_class),
+            preparator_params=component_params("preparator", self.preparator_class),
+            algorithms_params=tuple(algorithms_params),
+            serving_params=component_params("serving", self.serving_class),
+        )
+
+    @staticmethod
+    def engine_params_to_json(engine_params: EngineParams) -> dict[str, Any]:
+        return {
+            "datasource": {"params": params_to_json(engine_params.data_source_params)},
+            "preparator": {"params": params_to_json(engine_params.preparator_params)},
+            "algorithms": [
+                {"name": name, "params": params_to_json(p)}
+                for name, p in engine_params.algorithms_params
+            ],
+            "serving": {"params": params_to_json(engine_params.serving_params)},
+        }
+
+
+class SimpleEngine(Engine):
+    """Single-algorithm engine with identity preparator and first-serving
+    (ref: controller/EngineParams.scala:121-135)."""
+
+    def __init__(self, data_source_class, algorithm_class):
+        from predictionio_tpu.core.dase import FirstServing, IdentityPreparator
+
+        super().__init__(
+            data_source_class,
+            IdentityPreparator,
+            {"": algorithm_class},
+            FirstServing,
+        )

@@ -1,0 +1,98 @@
+"""Component parameter classes.
+
+The reference's ``Params`` are plain case classes deserialized from
+engine.json by reflection (ref: controller/Params.scala:23,
+controller/Engine.scala:353-416 ``jValueToEngineParams``). Here parameter
+classes are dataclasses; :func:`params_from_json` binds a JSON object to a
+dataclass by field name, applying nested dataclass conversion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import types
+import typing
+from typing import Any, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+
+class Params:
+    """Marker base class for component params (ref: controller/Params.scala).
+    Subclasses should be ``@dataclass``es."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """ref: controller/EmptyParams"""
+
+
+def _convert(value: Any, annotation: Any) -> Any:
+    if value is None:
+        return None
+    origin = get_origin(annotation)
+    if origin in (types.UnionType, typing.Union):
+        # Optional[...] / unions: convert against the sole non-None member
+        members = [a for a in get_args(annotation) if a is not type(None)]
+        if len(members) == 1:
+            return _convert(value, members[0])
+        return value
+    if dataclasses.is_dataclass(annotation) and isinstance(value, dict):
+        return params_from_json(annotation, value)
+    if origin in (list, tuple) and isinstance(value, (list, tuple)):
+        args = get_args(annotation)
+        inner = args[0] if args else None
+        out = [_convert(v, inner) for v in value]
+        return tuple(out) if origin is tuple else out
+    if annotation is float and isinstance(value, int):
+        return float(value)
+    return value
+
+
+def params_from_json(cls: Type[T], json_obj: dict[str, Any] | None) -> T:
+    """Bind a JSON object to a dataclass (ref: WorkflowUtils.extractParams).
+    Unknown keys are rejected — the reference fails on malformed params JSON
+    rather than silently dropping them."""
+    json_obj = json_obj or {}
+    if not dataclasses.is_dataclass(cls):
+        # plain classes accept the dict verbatim
+        return cls(**json_obj)  # type: ignore[call-arg]
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(json_obj) - set(fields)
+    if unknown:
+        raise ValueError(
+            f"Unknown parameter(s) {sorted(unknown)} for {cls.__name__}; "
+            f"expected a subset of {sorted(fields)}"
+        )
+    kwargs = {}
+    for name, value in json_obj.items():
+        kwargs[name] = _convert(value, _resolve_type(cls, fields[name]))
+    return cls(**kwargs)
+
+
+def _resolve_type(cls, f: dataclasses.Field):
+    # cache on the class itself — __dict__, not getattr, so subclasses don't
+    # inherit a parent's stale hint cache
+    hints = cls.__dict__.get("__pio_hints__")
+    if hints is None:
+        import typing
+
+        try:
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            hints = {}
+        try:
+            cls.__pio_hints__ = hints
+        except Exception:
+            pass
+    return hints.get(f.name, f.type)
+
+
+def params_to_json(params: Any) -> dict[str, Any]:
+    if params is None:
+        return {}
+    if dataclasses.is_dataclass(params):
+        return dataclasses.asdict(params)
+    if isinstance(params, dict):
+        return dict(params)
+    return dict(params.__dict__)

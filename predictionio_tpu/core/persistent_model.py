@@ -1,0 +1,116 @@
+"""Model persistence triad.
+
+Re-design of the reference's three persistence modes
+(ref: controller/PersistentModel.scala:64, workflow/PersistentModelManifest,
+SparkWorkflowUtils.getPersistentModel reflection WorkflowUtils.scala:350-383):
+
+1. **automatic** — the model object is serialized wholesale (reference: Kryo
+   blob into the Models store; here: pickle, with numpy/jax arrays converted
+   to host arrays first).
+2. **manual** — the model implements :class:`PersistentModel`; ``save``
+   writes wherever it wants and train persists only a
+   :class:`PersistentModelManifest` naming the loader class, resolved at
+   deploy.
+3. **re-train on deploy** — ``make_persistent_model`` returns ``None``
+   (the reference's Unit model), and deploy runs training again.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pickle
+from dataclasses import dataclass
+from typing import Any
+
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+class PersistentModel:
+    """ref: controller/PersistentModel.scala — models that save themselves."""
+
+    def save(self, instance_id: str, params: Any) -> bool:
+        """Return True if saved; False falls back to automatic persistence
+        (matching the reference's boolean contract)."""
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, instance_id: str, params: Any, ctx: ComputeContext):
+        """ref: PersistentModelLoader.apply"""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class PersistentModelManifest:
+    """Stored in place of the model blob (ref: workflow/PersistentModelManifest)."""
+
+    class_name: str  # "module.path:ClassName"
+    params_json: dict | None = None
+
+
+def resolve_class(class_name: str) -> type:
+    """Resolve ``module.path:ClassName`` or dotted ``module.ClassName``
+    (the WorkflowUtils.getEngine / getPersistentModel reflection analog)."""
+    if ":" in class_name:
+        module_name, cls_name = class_name.split(":", 1)
+    else:
+        module_name, _, cls_name = class_name.rpartition(".")
+    module = importlib.import_module(module_name)
+    obj = module
+    for part in cls_name.split("."):
+        obj = getattr(obj, part)
+    return obj  # type: ignore[return-value]
+
+
+def class_path(cls: type) -> str:
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def load_persistent_model(
+    manifest: PersistentModelManifest, instance_id: str, ctx: ComputeContext
+):
+    """ref: WorkflowUtils.getPersistentModel:350-383"""
+    cls = resolve_class(manifest.class_name)
+    return cls.load(instance_id, manifest.params_json, ctx)
+
+
+def serialize_models(models: list[Any]) -> bytes:
+    """Automatic persistence (the reference's Kryo stage,
+    ref: CoreWorkflow.scala:74-79)."""
+    import numpy as np
+
+    def to_host(obj):
+        # jax arrays → numpy before pickling
+        if type(obj).__module__.startswith("jax"):
+            return np.asarray(obj)
+        return obj
+
+    return pickle.dumps([_map_arrays(m, to_host) for m in models],
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_models(blob: bytes) -> list[Any]:
+    return pickle.loads(blob)
+
+
+def _map_arrays(obj: Any, fn):
+    """Shallow conversion of jax arrays in common containers/dataclasses."""
+    import dataclasses
+
+    converted = fn(obj)
+    if converted is not obj:
+        return converted
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        changes = {
+            f.name: _map_arrays(getattr(obj, f.name), fn)
+            for f in dataclasses.fields(obj)
+        }
+        try:
+            return dataclasses.replace(obj, **changes)
+        except Exception:
+            return obj
+    if isinstance(obj, dict):
+        return {k: _map_arrays(v, fn) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        mapped = [_map_arrays(v, fn) for v in obj]
+        return type(obj)(mapped) if isinstance(obj, tuple) else mapped
+    return obj

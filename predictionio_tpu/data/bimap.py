@@ -1,0 +1,62 @@
+"""Immutable bidirectional id↔index maps.
+
+Re-design of the reference's ``BiMap``/``EntityMap``
+(ref: data/.../storage/BiMap.scala:24-96, storage/EntityMap.scala): every
+factorization template maps external string ids to dense int indices. Here
+the construction target is device arrays, so the map also vectorizes
+encode/decode over numpy arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Hashable, Iterable, Sequence, TypeVar
+
+import numpy as np
+
+K = TypeVar("K", bound=Hashable)
+
+
+class BiMap(Generic[K]):
+    def __init__(self, forward: dict[K, int]):
+        self._fwd = dict(forward)
+        self._rev = {v: k for k, v in self._fwd.items()}
+        if len(self._rev) != len(self._fwd):
+            raise ValueError("BiMap values must be unique")
+
+    @staticmethod
+    def string_int(keys: Iterable[K]) -> "BiMap[K]":
+        """Assign 0..n-1 indices in first-seen order (ref: BiMap.stringInt)."""
+        fwd: dict[K, int] = {}
+        for k in keys:
+            if k not in fwd:
+                fwd[k] = len(fwd)
+        return BiMap(fwd)
+
+    def __call__(self, key: K) -> int:
+        return self._fwd[key]
+
+    def get(self, key: K, default: int | None = None) -> int | None:
+        return self._fwd.get(key, default)
+
+    def inverse(self, index: int) -> K:
+        return self._rev[index]
+
+    def contains(self, key: K) -> bool:
+        return key in self._fwd
+
+    __contains__ = contains
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def keys(self):
+        return self._fwd.keys()
+
+    def to_dict(self) -> dict[K, int]:
+        return dict(self._fwd)
+
+    def encode(self, keys: Sequence[K]) -> np.ndarray:
+        return np.fromiter((self._fwd[k] for k in keys), dtype=np.int32, count=len(keys))
+
+    def decode(self, indices: Iterable[int]) -> list[K]:
+        return [self._rev[int(i)] for i in indices]

@@ -1,0 +1,3 @@
+"""Engine-facing event store facades (ref: data/.../store/)."""
+
+from predictionio_tpu.data.store.event_stores import LEventStore, PEventStore  # noqa: F401

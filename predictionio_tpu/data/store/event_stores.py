@@ -1,0 +1,151 @@
+"""Name-based event access for engines — the blessed read path.
+
+Re-design of the reference's ``PEventStore``/``LEventStore``
+(ref: data/.../store/PEventStore.scala:54-116, LEventStore.scala:31-120,
+store/Common.scala ``appNameToId``): engines address apps by *name* (not id)
+and channels by name. ``PEventStore`` feeds training (bulk scans, optionally
+decoded to columnar numpy batches for the TPU input pipeline);
+``LEventStore`` serves low-latency entity lookups on the predict path
+(the ecommerce template's serve-time filters)."""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage
+
+
+def app_name_to_id(app_name: str, channel_name: str | None = None) -> tuple[int, int | None]:
+    """ref: store/Common.scala appNameToId"""
+    app = Storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise ValueError(
+            f"App {app_name} does not exist. Please use valid app name."
+        )
+    channel_id = None
+    if channel_name is not None:
+        channels = Storage.get_meta_data_channels().get_by_app_id(app.id)
+        chan = next((c for c in channels if c.name == channel_name), None)
+        if chan is None:
+            raise ValueError(
+                f"Channel {channel_name} does not exist. Please use valid "
+                "channel name."
+            )
+        channel_id = chan.id
+    return app.id, channel_id
+
+
+class PEventStore:
+    """Bulk reads for training (ref: PEventStore.scala:54-116)."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: str | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+    ) -> Iterator[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return Storage.get_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+        )
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: str | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        required: Sequence[str] | None = None,
+    ):
+        """ref: PEventStore.aggregateProperties"""
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return Storage.get_events().aggregate_properties(
+            app_id, channel_id, entity_type,
+            start_time=start_time, until_time=until_time, required=required,
+        )
+
+    @staticmethod
+    def interaction_arrays(
+        app_name: str,
+        event_names: Sequence[str],
+        channel_name: str | None = None,
+        rating_property: str | None = "rating",
+        default_rating: float = 1.0,
+    ) -> tuple[list[str], list[str], np.ndarray, list[str], list[str]]:
+        """Columnar decode of (entity → target) interaction events for the
+        TPU input pipeline: returns (user_ids, item_ids, ratings,
+        event_names_per_row, pr_ids). This is the framework-native fast path
+        the reference implements per-template by mapping over RDD[Event]."""
+        users: list[str] = []
+        items: list[str] = []
+        ratings: list[float] = []
+        names: list[str] = []
+        for e in PEventStore.find(
+            app_name, channel_name=channel_name, event_names=event_names
+        ):
+            if e.target_entity_id is None:
+                continue
+            users.append(e.entity_id)
+            items.append(e.target_entity_id)
+            names.append(e.event)
+            if rating_property is not None:
+                ratings.append(
+                    float(e.properties.get_or_else(rating_property, default_rating))
+                )
+            else:
+                ratings.append(default_rating)
+        return users, items, np.asarray(ratings, dtype=np.float32), names, []
+
+
+class LEventStore:
+    """Low-latency entity reads on the predict path
+    (ref: LEventStore.scala:58 findByEntity, used by the ecommerce template
+    at serve time)."""
+
+    @staticmethod
+    def find_by_entity(
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        limit: int | None = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        return Storage.get_events().find(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=limit,
+            reversed_=latest,
+        )

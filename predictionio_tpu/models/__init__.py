@@ -1,0 +1,7 @@
+"""Algorithm library (L7): XLA-native model kernels.
+
+Plays the role of MLlib + the reference's e2 module: ALS matrix
+factorization (explicit + implicit), categorical naive Bayes, Markov chain,
+binary vectorizer, two-tower retrieval. All hot paths are jit-compiled XLA
+programs over the ComputeContext mesh.
+"""

@@ -1,0 +1,14 @@
+"""Device mesh + sharding substrate (L0).
+
+This layer replaces the reference's Apache Spark compute backend
+(ref: core/.../workflow/WorkflowContext.scala:26-42 creates the
+SparkContext; RDD partitions ↔ mesh-sharded array axes; Spark
+shuffle/treeAggregate ↔ XLA collectives over ICI).
+"""
+
+from predictionio_tpu.parallel.mesh import (  # noqa: F401
+    ComputeContext,
+    batch_sharding,
+    compute_context,
+    replicated,
+)

@@ -1,0 +1,114 @@
+"""ComputeContext: the mesh-backed analog of the reference's SparkContext.
+
+The reference builds one SparkContext per workflow run
+(ref: workflow/WorkflowContext.scala:26-42) and every DASE stage executes on
+it. Here the equivalent handle is a :class:`ComputeContext` wrapping a
+`jax.sharding.Mesh` over all visible devices with named axes:
+
+  ``data``  — batch/data-parallel axis (RDD-partition analog). Factor-matrix
+              row shards, per-example batches.
+  ``model`` — model-parallel axis for tensor-sharded layers (two-tower MLPs,
+              embedding tables, sampled-softmax all-to-all).
+
+Multi-host: `jax.distributed.initialize()` is invoked by the workflow entry
+point when ``PIO_TPU_COORDINATOR`` is set, collapsing the reference's
+driver⇄executor spark-submit process model into one SPMD program per host
+(SURVEY.md §5 "Distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from functools import cached_property
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class ComputeContext:
+    """Mesh + sharding helpers handed to every DASE component at train time
+    (the ``sc: SparkContext`` parameter of the reference's ``trainBase``)."""
+
+    mesh: Mesh
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def data_axis_size(self) -> int:
+        return self.mesh.shape[DATA_AXIS]
+
+    @property
+    def model_axis_size(self) -> int:
+        return self.mesh.shape.get(MODEL_AXIS, 1)
+
+    @cached_property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, *axes: str | None) -> NamedSharding:
+        """Sharding with the leading array axis split over the data axis by
+        default: ``ctx.batch_sharding()`` ≡ rows over ``data``."""
+        if not axes:
+            axes = (DATA_AXIS,)
+        return NamedSharding(self.mesh, P(*axes))
+
+    def pad_to_multiple(self, n: int, axis: str = DATA_AXIS) -> int:
+        """Rows must divide the mesh axis; round up."""
+        size = self.mesh.shape[axis]
+        return ((n + size - 1) // size) * size
+
+    def device_put_sharded_rows(self, array: np.ndarray, pad_value=0):
+        """Host ndarray → device array row-sharded over ``data``, padding rows
+        so the shard count divides evenly. Returns (device_array, n_valid)."""
+        n = array.shape[0]
+        padded = self.pad_to_multiple(n)
+        if padded != n:
+            pad_width = [(0, padded - n)] + [(0, 0)] * (array.ndim - 1)
+            array = np.pad(array, pad_width, constant_values=pad_value)
+        return jax.device_put(array, self.batch_sharding()), n
+
+
+def _make_mesh(n_model: int = 1) -> Mesh:
+    devices = np.array(jax.devices())
+    n = devices.size
+    if n % n_model != 0:
+        raise ValueError(f"model axis {n_model} does not divide {n} devices")
+    return Mesh(devices.reshape(n // n_model, n_model), (DATA_AXIS, MODEL_AXIS))
+
+
+def compute_context(n_model: int = 1) -> ComputeContext:
+    """Build the process-wide compute context (ref: WorkflowContext.apply).
+
+    ``PIO_TPU_MODEL_AXIS`` overrides the model-parallel axis size the way the
+    reference's ``sparkConf`` passthrough tuned Spark
+    (ref: workflow/WorkflowUtils.scala:314-333).
+    """
+    env_model = os.environ.get("PIO_TPU_MODEL_AXIS")
+    if env_model:
+        n_model = int(env_model)
+    ctx = ComputeContext(_make_mesh(n_model))
+    logger.info(
+        "compute context: %d device(s), mesh %s", ctx.n_devices, dict(ctx.mesh.shape)
+    )
+    return ctx
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    if not axes:
+        axes = (DATA_AXIS,)
+    return NamedSharding(mesh, P(*axes))

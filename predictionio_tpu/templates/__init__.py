@@ -1,0 +1,13 @@
+"""Built-in engine templates (L6).
+
+Python re-designs of the reference's stock templates
+(ref: examples/scala-parallel-{recommendation,classification,similarproduct,
+ecommercerecommendation}) plus the new two-tower retrieval engine. Each
+template module exposes ``engine_factory()`` and a default ``ENGINE_JSON``;
+``pio template scaffold <name> <dir>`` copies a user-editable engine.py +
+engine.json into place.
+"""
+
+# names listed here must have a module in this package; `pio template
+# list/scaffold` trusts this tuple
+TEMPLATE_NAMES = ("recommendation",)

@@ -1,0 +1,280 @@
+"""Recommendation engine template (MovieLens-class).
+
+Re-design of the reference's scala-parallel-recommendation template
+(ref: examples/scala-parallel-recommendation/custom-serving/src/main/scala/
+{Engine,DataSource,Preparator,ALSAlgorithm,Serving}.scala): explicit-rating
+ALS on ``rate``/``buy`` events (a ``buy`` counts as rating 4.0, ref:
+DataSource.scala:40-47), queries ask for the top-N items for a user.
+
+The MLlib ``ALS.train`` call (ALSAlgorithm.scala:27-67) is replaced by the
+TPU-native ALS of :mod:`predictionio_tpu.models.als`; predict-time
+``model.recommendProducts`` becomes one jitted matmul + top_k in HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.core import Engine, LServing, PAlgorithm, PDataSource, PPreparator
+from predictionio_tpu.core.base import SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.als import ALS, ALSFactors, ALSParams, top_k_scores
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+# -- queries / results (ref: Engine.scala Query/PredictedResult) ------------
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple[ItemScore, ...] = ()
+
+
+# -- data source ------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "recommendation"
+    eval_k: int | None = None  # k-fold eval split count (None = no eval)
+    buy_rating: float = 4.0  # implicit "buy" → rating (ref: DataSource.scala:44)
+    seed: int = 3
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: list[str]
+    items: list[str]
+    ratings: np.ndarray  # [n] float32
+
+    def sanity_check(self) -> None:
+        # ref: DataSource readTraining sanity — empty data fails fast
+        if len(self.users) == 0:
+            raise ValueError("TrainingData is empty; ingest rate/buy events first")
+        if not np.isfinite(self.ratings).all():
+            raise ValueError("TrainingData has non-finite ratings")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def _read(self) -> TrainingData:
+        users, items, ratings, names, _ = PEventStore.interaction_arrays(
+            self.params.app_name,
+            event_names=["rate", "buy"],
+            rating_property="rating",
+            default_rating=self.params.buy_rating,
+        )
+        # "buy" events carry no rating property → buy_rating default applies
+        return TrainingData(users, items, ratings)
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        return self._read()
+
+    def read_eval(self, ctx: ComputeContext):
+        """k-fold split for `pio eval` (ref: evaluation variants of the
+        template; fold logic mirrors e2 CrossValidation.splitData)."""
+        k = self.params.eval_k
+        if not k:
+            raise NotImplementedError("set eval_k in datasource params to evaluate")
+        td = self._read()
+        n = len(td.users)
+        rng = np.random.default_rng(self.params.seed)
+        fold_of = rng.integers(0, k, n)
+        folds = []
+        for fold in range(k):
+            test = fold_of == fold
+            train = ~test
+            fold_td = TrainingData(
+                [u for u, t in zip(td.users, train) if t],
+                [i for i, t in zip(td.items, train) if t],
+                td.ratings[train],
+            )
+            qa = [
+                (Query(user=u, num=10), ActualRating(item=i, rating=float(r)))
+                for u, i, r, t in zip(td.users, td.items, td.ratings, test)
+                if t
+            ]
+            folds.append((fold_td, {"fold": fold}, qa))
+        return folds
+
+
+@dataclass(frozen=True)
+class ActualRating:
+    item: str
+    rating: float
+
+
+# -- preparator -------------------------------------------------------------
+
+
+@dataclass
+class PreparedData:
+    user_ids: BiMap
+    item_ids: BiMap
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    ratings: np.ndarray
+
+
+class Preparator(PPreparator):
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        # BiMap.stringInt indexing (ref: ALSAlgorithm.scala:33-38)
+        user_ids = BiMap.string_int(td.users)
+        item_ids = BiMap.string_int(td.items)
+        return PreparedData(
+            user_ids=user_ids,
+            item_ids=item_ids,
+            user_idx=user_ids.encode(td.users),
+            item_idx=item_ids.encode(td.items),
+            ratings=td.ratings,
+        )
+
+
+# -- ALS algorithm ----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    rank: int = 10
+    numIterations: int = 20
+    lambda_: float = 0.01
+    seed: int | None = None
+    implicitPrefs: bool = False
+    alpha: float = 1.0
+
+
+@dataclass
+class ALSModel:
+    factors: ALSFactors
+    user_ids: BiMap
+    item_ids: BiMap
+
+
+class ALSAlgorithm(PAlgorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
+        als = ALS(
+            ctx,
+            ALSParams(
+                rank=self.params.rank,
+                num_iterations=self.params.numIterations,
+                lambda_=self.params.lambda_,
+                implicit_prefs=self.params.implicitPrefs,
+                alpha=self.params.alpha,
+                seed=self.params.seed,
+            ),
+        )
+        factors = als.train(
+            pd.user_idx,
+            pd.item_idx,
+            pd.ratings,
+            n_users=len(pd.user_ids),
+            n_items=len(pd.item_ids),
+        )
+        return ALSModel(factors, pd.user_ids, pd.item_ids)
+
+    def predict(self, model: ALSModel, query: Query) -> PredictedResult:
+        uidx = model.user_ids.get(query.user)
+        if uidx is None:
+            return PredictedResult(())  # unknown user (ref returns empty)
+        q = model.factors.user_features[uidx][None, :]
+        k = min(query.num, len(model.item_ids))
+        scores, idx = top_k_scores(q, model.factors.item_features, k)
+        items = model.item_ids.decode(np.asarray(idx[0]))
+        return PredictedResult(
+            tuple(
+                ItemScore(item, float(s))
+                for item, s in zip(items, np.asarray(scores[0]))
+            )
+        )
+
+    def batch_predict(self, model: ALSModel, queries):
+        """Batched eval path: one matmul for all known users."""
+        known = [(i, q) for i, q in queries if q.user in model.user_ids]
+        out = [(i, PredictedResult(())) for i, q in queries
+               if q.user not in model.user_ids]
+        if known:
+            uidx = np.array([model.user_ids(q.user) for _, q in known], np.int32)
+            k = min(max(q.num for _, q in known), len(model.item_ids))
+            scores, idx = top_k_scores(
+                model.factors.user_features[uidx], model.factors.item_features, k
+            )
+            for row, (i, q) in enumerate(known):
+                items = model.item_ids.decode(np.asarray(idx[row])[: q.num])
+                out.append(
+                    (i, PredictedResult(tuple(
+                        ItemScore(item, float(s))
+                        for item, s in zip(items, np.asarray(scores[row]))
+                    )))
+                )
+        return out
+
+
+# -- serving ----------------------------------------------------------------
+
+
+class Serving(LServing):
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        return predictions[0]
+
+
+# -- factory (ref: Engine.scala:20-27 EngineFactory) ------------------------
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"als": ALSAlgorithm},
+        serving_class=Serving,
+    )
+
+
+ENGINE_JSON = {
+    "id": "default",
+    "description": "Default settings",
+    "engineFactory": "predictionio_tpu.templates.recommendation:engine_factory",
+    "datasource": {"params": {"app_name": "MyApp1"}},
+    "algorithms": [
+        {
+            "name": "als",
+            "params": {
+                "rank": 10,
+                "numIterations": 20,
+                "lambda_": 0.01,
+                "seed": 3,
+            },
+        }
+    ],
+}

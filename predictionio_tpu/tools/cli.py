@@ -83,6 +83,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("key")
     p.set_defaults(func=lambda a: _app().accesskey_delete(a.key))
 
+    # -- build / train (ref: Console.scala:803-833) -------------------------
+    p_build = sub.add_parser("build", help="verify and register the engine in cwd")
+    p_build.add_argument("--engine-json", default="engine.json")
+    p_build.set_defaults(func=cmd_build)
+
+    p_train = sub.add_parser("train", help="train the engine in cwd")
+    p_train.add_argument("--engine-json", default="engine.json")
+    p_train.add_argument("--batch", default="")
+    p_train.add_argument("--skip-sanity-check", action="store_true")
+    p_train.add_argument("--stop-after-read", action="store_true")
+    p_train.add_argument("--stop-after-prepare", action="store_true")
+    p_train.set_defaults(func=cmd_train)
+
+    # -- template scaffolding (ref: Console.scala template get) -------------
+    p_tpl = sub.add_parser("template", help="manage engine templates")
+    tpl_sub = p_tpl.add_subparsers(dest="template_command", required=True)
+    p = tpl_sub.add_parser("list", help="list built-in templates")
+    p.set_defaults(func=cmd_template_list)
+    p = tpl_sub.add_parser("scaffold", help="copy a template into a directory")
+    p.add_argument("template_name")
+    p.add_argument("directory")
+    p.add_argument("--app-name", default="MyApp1")
+    p.set_defaults(func=cmd_template_scaffold)
+
     # -- event server (ref: Console.scala:878-890) --------------------------
     p_es = sub.add_parser("eventserver", help="launch the REST event server")
     p_es.add_argument("--ip", default="0.0.0.0")
@@ -97,6 +121,121 @@ def _app():
     from predictionio_tpu.tools import app as app_module
 
     return app_module
+
+
+def _load_variant(engine_json_path: str):
+    import json
+    from pathlib import Path
+
+    path = Path(engine_json_path)
+    if not path.exists():
+        print(f"[ERROR] {path} not found. Are you in an engine directory?",
+              file=sys.stderr)
+        return None
+    return json.loads(path.read_text())
+
+
+def cmd_build(args) -> int:
+    """Verify the engine factory resolves and register a manifest
+    (ref: Console.build:803-823 — compile+RegisterEngine; Python needs no
+    compile, so build = import-check + register)."""
+    import os
+
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import EngineManifest
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    variant = _load_variant(args.engine_json)
+    if variant is None:
+        return 1
+    factory = variant.get("engineFactory")
+    if not factory:
+        print("[ERROR] engine.json has no engineFactory.", file=sys.stderr)
+        return 1
+    engine = get_engine(factory, os.getcwd())
+    manifest = EngineManifest(
+        id=variant.get("id", "default"),
+        version=variant.get("version", "1"),
+        name=os.path.basename(os.getcwd()),
+        description=variant.get("description"),
+        files=(),
+        engine_factory=factory,
+    )
+    Storage.get_meta_data_engine_manifests().update(manifest, upsert=True)
+    print(f"[INFO] Engine {manifest.id} {manifest.version} "
+          f"({len(engine.algorithm_class_map)} algorithm(s)) is ready.")
+    print("[INFO] Your engine is ready for training.")
+    return 0
+
+
+def cmd_train(args) -> int:
+    """ref: Console.train:825-833 → RunWorkflow → CreateWorkflow; collapses
+    to an in-process run (no spark-submit)."""
+    import os
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    variant = _load_variant(args.engine_json)
+    if variant is None:
+        return 1
+    factory = variant["engineFactory"]
+    engine = get_engine(factory, os.getcwd())
+    engine_params = engine.engine_params_from_json(variant)
+    wp = WorkflowParams(
+        batch=args.batch,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance = new_engine_instance(
+        engine_id=variant.get("id", "default"),
+        engine_version=variant.get("version", "1"),
+        engine_variant=variant.get("id", "default"),
+        engine_factory=factory,
+        engine_params=engine_params,
+        batch=args.batch,
+    )
+    instance_id = run_train(engine, engine_params, instance, wp)
+    print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_template_list(args) -> int:
+    from predictionio_tpu.templates import TEMPLATE_NAMES
+
+    for name in TEMPLATE_NAMES:
+        print(f"[INFO] {name}")
+    return 0
+
+
+def cmd_template_scaffold(args) -> int:
+    import importlib
+    import json
+    from pathlib import Path
+
+    from predictionio_tpu.templates import TEMPLATE_NAMES
+
+    if args.template_name not in TEMPLATE_NAMES:
+        print(f"[ERROR] Unknown template {args.template_name}. "
+              f"Available: {', '.join(TEMPLATE_NAMES)}", file=sys.stderr)
+        return 1
+    mod = importlib.import_module(
+        f"predictionio_tpu.templates.{args.template_name}"
+    )
+    target = Path(args.directory)
+    target.mkdir(parents=True, exist_ok=True)
+    variant = json.loads(json.dumps(mod.ENGINE_JSON))
+    if "datasource" in variant:
+        variant["datasource"].setdefault("params", {})["app_name"] = args.app_name
+    (target / "engine.json").write_text(json.dumps(variant, indent=2) + "\n")
+    print(f"[INFO] Scaffolded template {args.template_name} in {target}")
+    print(f"[INFO] Edit {target}/engine.json and run `pio train` there.")
+    return 0
 
 
 def cmd_eventserver(args) -> int:

@@ -1,0 +1,2 @@
+"""Workflow runtime (L4): train/eval/deploy executables
+(ref: core/src/main/scala/io/prediction/workflow/)."""

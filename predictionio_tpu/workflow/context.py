@@ -1,0 +1,33 @@
+"""Workflow compute-context factory (ref: workflow/WorkflowContext.scala:26-42).
+
+The reference creates one SparkContext per workflow run with an app name of
+``PredictionIO <mode>: <batch>``; here we build the mesh ComputeContext and,
+when ``PIO_TPU_COORDINATOR`` is set, initialize `jax.distributed` first so
+multi-host meshes span all processes (the spark-submit cluster analog)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from predictionio_tpu.parallel.mesh import ComputeContext, compute_context
+
+logger = logging.getLogger(__name__)
+
+_initialized_distributed = False
+
+
+def workflow_context(batch: str = "", mode: str = "") -> ComputeContext:
+    global _initialized_distributed
+    coordinator = os.environ.get("PIO_TPU_COORDINATOR")
+    if coordinator and not _initialized_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ.get("PIO_TPU_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PIO_TPU_PROCESS_ID", "0")),
+        )
+        _initialized_distributed = True
+    logger.info("PredictionIO %s: %s", mode, batch)
+    return compute_context()

@@ -1,0 +1,109 @@
+"""Core train/eval workflow.
+
+Re-design of the reference's ``CoreWorkflow``
+(ref: workflow/CoreWorkflow.scala:42-160): run the engine, persist models,
+and manage the engine/evaluation instance lifecycle
+(INIT → COMPLETED/ABORTED) in the metadata store."""
+
+from __future__ import annotations
+
+import json
+import logging
+import traceback
+
+from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
+from predictionio_tpu.core.persistent_model import (
+    PersistentModel,
+    PersistentModelManifest,
+    class_path,
+    serialize_models,
+)
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import EngineInstance, Model
+from predictionio_tpu.utils.time import now
+from predictionio_tpu.workflow.context import workflow_context
+
+logger = logging.getLogger(__name__)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_instance: EngineInstance,
+    params: WorkflowParams | None = None,
+) -> str:
+    """Train → persist models → mark instance COMPLETED
+    (ref: CoreWorkflow.runTrain:42-99). Returns the instance id."""
+    wp = params or WorkflowParams()
+    instances = Storage.get_meta_data_engine_instances()
+    instance_id = instances.insert(engine_instance)
+    logger.info("engine instance %s: INIT", instance_id)
+    try:
+        ctx = workflow_context(batch=wp.batch, mode="Training")
+        models = engine.train(ctx, engine_params, wp)
+        # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
+        algorithms = engine._algorithms(engine_params)
+        persisted = []
+        for algo, model in zip(algorithms, models):
+            p = algo.make_persistent_model(ctx, instance_id, model)
+            if isinstance(p, PersistentModel):
+                saved = p.save(instance_id, None)
+                p = (
+                    PersistentModelManifest(class_path(type(p)))
+                    if saved
+                    else model
+                )
+            persisted.append(p)
+        blob = serialize_models(persisted)
+        Storage.get_model_data_models().insert(Model(instance_id, blob))
+        logger.info("model data saved: %d bytes", len(blob))
+        done = EngineInstance(
+            **{
+                **instances.get(instance_id).__dict__,
+                "status": "COMPLETED",
+                "end_time": now(),
+            }
+        )
+        instances.update(done)
+        logger.info("engine instance %s: COMPLETED", instance_id)
+        return instance_id
+    except Exception:
+        logger.error("training failed:\n%s", traceback.format_exc())
+        aborted = EngineInstance(
+            **{
+                **instances.get(instance_id).__dict__,
+                "status": "ABORTED",
+                "end_time": now(),
+            }
+        )
+        instances.update(aborted)
+        raise
+
+
+def new_engine_instance(
+    engine_id: str,
+    engine_version: str,
+    engine_variant: str,
+    engine_factory: str,
+    engine_params: EngineParams,
+    batch: str = "",
+) -> EngineInstance:
+    """Build the INIT instance record (ref: CreateWorkflow.scala:233-250)."""
+    ep_json = Engine.engine_params_to_json(engine_params)
+    return EngineInstance(
+        id="",
+        status="INIT",
+        start_time=now(),
+        end_time=now(),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=batch,
+        env={},
+        spark_conf={},
+        data_source_params=json.dumps(ep_json["datasource"]),
+        preparator_params=json.dumps(ep_json["preparator"]),
+        algorithms_params=json.dumps(ep_json["algorithms"]),
+        serving_params=json.dumps(ep_json["serving"]),
+    )

@@ -1,0 +1,37 @@
+"""Engine factory resolution.
+
+The reference loads user engine classes reflectively by name from registered
+jars (ref: workflow/WorkflowUtils.scala:62 ``getEngine``,
+core/AbstractDoer.scala Doer). Here an engine factory is any callable named
+``module:callable`` (or dotted path) returning an :class:`Engine`; engine
+directories are put on ``sys.path`` so user engine.py modules resolve the
+way template jars did."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.persistent_model import resolve_class
+
+
+def load_engine_factory(name: str, engine_dir: str | Path | None = None):
+    """Resolve an engine factory by name, optionally rooting imports at the
+    engine directory (the reference's jar-on-classpath analog)."""
+    if engine_dir is not None:
+        engine_dir = str(Path(engine_dir).resolve())
+        if engine_dir not in sys.path:
+            sys.path.insert(0, engine_dir)
+    factory = resolve_class(name)
+    return factory
+
+
+def get_engine(name: str, engine_dir: str | Path | None = None) -> Engine:
+    factory = load_engine_factory(name, engine_dir)
+    engine = factory() if callable(factory) else factory
+    if not isinstance(engine, Engine):
+        raise TypeError(
+            f"Engine factory {name} returned {type(engine).__name__}, not Engine"
+        )
+    return engine

@@ -1,0 +1,21 @@
+"""Module-level params dataclasses for JSON-binding tests (type hints on
+local classes cannot be resolved by typing.get_type_hints)."""
+
+from dataclasses import dataclass
+
+from predictionio_tpu.core.params import Params
+
+
+@dataclass(frozen=True)
+class Inner(Params):
+    x: float = 0.0
+
+
+@dataclass(frozen=True)
+class Base(Params):
+    a: int = 0
+
+
+@dataclass(frozen=True)
+class Sub(Base):
+    inner: Inner | None = None

@@ -1,0 +1,162 @@
+"""Fake-component engine zoo for wiring tests.
+
+Mirror of the reference's test fixture ``SampleEngine.scala``
+(ref: core/src/test/scala/io/prediction/controller/SampleEngine.scala):
+numbered fake DASE components whose data are tiny id-tagged objects, so
+tests can assert exactly which params reached which component and that
+eval joins line up — no real ML involved.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from predictionio_tpu.core import (
+    LServing,
+    PAlgorithm,
+    P2LAlgorithm,
+    PDataSource,
+    PPreparator,
+)
+from predictionio_tpu.core.base import SanityCheck
+
+
+@dataclass(frozen=True)
+class TD(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError("TD sanity check failed (error=True)")
+
+
+@dataclass(frozen=True)
+class EI:
+    id: int
+
+
+@dataclass(frozen=True)
+class Q:
+    id: int
+    q: int
+
+
+@dataclass(frozen=True)
+class A:
+    id: int
+    q: int
+
+
+@dataclass(frozen=True)
+class PD:
+    id: int
+    td: TD
+
+
+@dataclass(frozen=True)
+class M:
+    id: int
+    pd: PD
+    params_v: int = 0
+
+
+@dataclass(frozen=True)
+class Pred:
+    id: int
+    q: Q
+    models: tuple = ()
+
+
+@dataclass(frozen=True)
+class DSParams:
+    id: int = 0
+    error: bool = False
+    n_folds: int = 2
+    n_queries: int = 3
+
+
+class DataSource0(PDataSource):
+    params_class = DSParams
+
+    def __init__(self, params: DSParams | None = None):
+        self.params = params or DSParams()
+
+    def read_training(self, ctx):
+        return TD(self.params.id, self.params.error)
+
+    def read_eval(self, ctx):
+        folds = []
+        for f in range(self.params.n_folds):
+            qa = [(Q(f, i), A(f, i)) for i in range(self.params.n_queries)]
+            folds.append((TD(f), EI(f), qa))
+        return folds
+
+
+@dataclass(frozen=True)
+class PrepParams:
+    id: int = 0
+
+
+class Preparator0(PPreparator):
+    params_class = PrepParams
+
+    def __init__(self, params: PrepParams | None = None):
+        self.params = params or PrepParams()
+
+    def prepare(self, ctx, td: TD) -> PD:
+        return PD(self.params.id, td)
+
+
+@dataclass(frozen=True)
+class AlgoParams:
+    id: int = 0
+    v: int = 0
+
+
+class Algo0(P2LAlgorithm):
+    params_class = AlgoParams
+
+    def __init__(self, params: AlgoParams | None = None):
+        self.params = params or AlgoParams()
+
+    def train(self, ctx, pd: PD) -> M:
+        return M(self.params.id, pd, self.params.v)
+
+    def predict(self, model: M, query: Q) -> Pred:
+        return Pred(self.params.id, query, (model,))
+
+
+class Algo1(Algo0):
+    pass
+
+
+class PAlgo0(PAlgorithm):
+    """No batch_predict — exercises the P-algorithm contract."""
+
+    params_class = AlgoParams
+
+    def __init__(self, params: AlgoParams | None = None):
+        self.params = params or AlgoParams()
+
+    def train(self, ctx, pd: PD) -> M:
+        return M(self.params.id, pd, self.params.v)
+
+    def predict(self, model: M, query: Q) -> Pred:
+        return Pred(self.params.id, query, (model,))
+
+
+@dataclass(frozen=True)
+class ServingParams:
+    id: int = 0
+
+
+class Serving0(LServing):
+    params_class = ServingParams
+
+    def __init__(self, params: ServingParams | None = None):
+        self.params = params or ServingParams()
+
+    def serve(self, query: Q, predictions) -> Pred:
+        # tag which serving saw the query + collapse algo predictions
+        return Pred(self.params.id, query, tuple(predictions))

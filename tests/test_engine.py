@@ -1,0 +1,198 @@
+"""Engine wiring tests (ref: core/src/test/scala/.../EngineTest.scala,
+EngineWorkflowTest.scala) using the fake-component zoo."""
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.engine import WorkflowParams
+from predictionio_tpu.core.base import (
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+)
+from predictionio_tpu.parallel.mesh import compute_context
+
+from sample_engine import (
+    A,
+    Algo0,
+    Algo1,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    EI,
+    M,
+    PD,
+    PrepParams,
+    Preparator0,
+    Pred,
+    Q,
+    Serving0,
+    ServingParams,
+    TD,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+@pytest.fixture
+def engine():
+    return Engine(
+        data_source_class=DataSource0,
+        preparator_class=Preparator0,
+        algorithm_class_map={"algo0": Algo0, "algo1": Algo1},
+        serving_class=Serving0,
+    )
+
+
+def params(ds=0, prep=0, algos=(("algo0", 0),), serving=0, **kw):
+    return EngineParams(
+        data_source_params=DSParams(id=ds, **kw),
+        preparator_params=PrepParams(id=prep),
+        algorithms_params=tuple((n, AlgoParams(id=i, v=i * 10)) for n, i in algos),
+        serving_params=ServingParams(id=serving),
+    )
+
+
+class TestTrain:
+    def test_params_reach_components(self, ctx, engine):
+        models = engine.train(ctx, params(ds=1, prep=2, algos=(("algo0", 3),)))
+        assert models == [M(3, PD(2, TD(1)), 30)]
+
+    def test_multiple_algorithms_in_order(self, ctx, engine):
+        models = engine.train(
+            ctx, params(algos=(("algo0", 5), ("algo1", 6), ("algo0", 7)))
+        )
+        assert [m.id for m in models] == [5, 6, 7]
+
+    def test_unknown_algorithm_name(self, ctx, engine):
+        with pytest.raises(KeyError):
+            engine.train(
+                ctx,
+                EngineParams(algorithms_params=(("nope", AlgoParams()),)),
+            )
+
+    def test_no_algorithms(self, ctx, engine):
+        with pytest.raises(ValueError):
+            engine.train(ctx, EngineParams())
+
+    def test_sanity_check_fails_fast(self, ctx, engine):
+        with pytest.raises(ValueError, match="sanity check failed"):
+            engine.train(ctx, params(error=True))
+
+    def test_sanity_check_skippable(self, ctx, engine):
+        models = engine.train(
+            ctx, params(error=True), WorkflowParams(skip_sanity_check=True)
+        )
+        assert models[0].pd.td.error
+
+    def test_stop_after_read(self, ctx, engine):
+        with pytest.raises(StopAfterReadInterruption):
+            engine.train(ctx, params(), WorkflowParams(stop_after_read=True))
+
+    def test_stop_after_prepare(self, ctx, engine):
+        with pytest.raises(StopAfterPrepareInterruption):
+            engine.train(ctx, params(), WorkflowParams(stop_after_prepare=True))
+
+
+class TestEval:
+    def test_eval_join_semantics(self, ctx, engine):
+        """Every query sees all algorithms' predictions in declared order
+        (ref: Engine.eval:786-816 union+groupByKey join)."""
+        results = engine.eval(
+            ctx, params(algos=(("algo0", 1), ("algo1", 2)), serving=9)
+        )
+        assert len(results) == 2  # folds
+        for fold, (ei, qpa) in enumerate(results):
+            assert ei == EI(fold)
+            assert len(qpa) == 3
+            for q, p, a in qpa:
+                assert isinstance(q, Q) and isinstance(a, A)
+                assert q.id == fold and a.id == fold and q.q == a.q
+                assert p.id == 9  # serving tag
+                inner = p.q  # serving received the query
+                assert inner == q
+                # joined predictions: algo ids in order
+                assert [pred.id for pred in p.models] == [1, 2]
+
+    def test_eval_not_supported_without_read_eval(self, ctx):
+        from predictionio_tpu.core import PDataSource
+
+        class NoEvalDS(PDataSource):
+            def __init__(self, params=None):
+                pass
+
+            def read_training(self, ctx):
+                return TD(0)
+
+        eng = Engine(NoEvalDS, Preparator0, {"algo0": Algo0}, Serving0)
+        with pytest.raises(NotImplementedError):
+            eng.eval(eng, EngineParams(algorithms_params=(("algo0", None),)))
+
+
+class TestEngineParamsJson:
+    def test_variant_parsing_binds_params_classes(self, engine):
+        variant = {
+            "id": "default",
+            "engineFactory": "x",
+            "datasource": {"params": {"id": 4, "n_folds": 3}},
+            "preparator": {"params": {"id": 5}},
+            "algorithms": [
+                {"name": "algo0", "params": {"id": 6, "v": 60}},
+                {"name": "algo1", "params": {"id": 7}},
+            ],
+            "serving": {"params": {"id": 8}},
+        }
+        ep = engine.engine_params_from_json(variant)
+        assert ep.data_source_params == DSParams(id=4, n_folds=3)
+        assert ep.preparator_params == PrepParams(id=5)
+        assert ep.algorithms_params[0] == ("algo0", AlgoParams(id=6, v=60))
+        assert ep.algorithms_params[1] == ("algo1", AlgoParams(id=7))
+        assert ep.serving_params == ServingParams(id=8)
+
+    def test_unknown_algorithm_rejected(self, engine):
+        with pytest.raises(KeyError):
+            engine.engine_params_from_json(
+                {"algorithms": [{"name": "bogus", "params": {}}]}
+            )
+
+    def test_unknown_param_key_rejected(self, engine):
+        with pytest.raises(ValueError, match="Unknown parameter"):
+            engine.engine_params_from_json(
+                {"algorithms": [{"name": "algo0", "params": {"typo": 1}}]}
+            )
+
+    def test_round_trip(self, engine):
+        ep = params(ds=1, algos=(("algo0", 2),))
+        j = Engine.engine_params_to_json(ep)
+        assert j["algorithms"][0]["name"] == "algo0"
+        assert j["datasource"]["params"]["id"] == 1
+
+
+class TestSupplementOrdering:
+    def test_supplement_runs_before_predict_serve_gets_original(self, ctx):
+        from dataclasses import replace
+
+        class SupplementServing(Serving0):
+            def supplement(self, query):
+                return replace(query, q=query.q + 100)
+
+        eng = Engine(DataSource0, Preparator0, {"algo0": Algo0},
+                     SupplementServing)
+        results = eng.eval(ctx, params())
+        for _ei, qpa in results:
+            for q, p, _a in qpa:
+                assert q.q < 100  # serve saw the original query
+                # algorithms saw the supplemented one
+                assert all(pred.q.q >= 100 for pred in p.models)
+
+
+def test_subclass_params_hints_not_inherited():
+    from params_fixtures import Inner, Sub, Base
+    from predictionio_tpu.core.params import params_from_json
+
+    params_from_json(Base, {"a": 1})  # populate Base's hint cache
+    bound = params_from_json(Sub, {"a": 2, "inner": {"x": 1}})
+    assert isinstance(bound.inner, Inner)
+    assert bound.inner.x == 1.0

@@ -1,0 +1,131 @@
+"""End-to-end train workflow: events → recommendation engine → model store.
+
+The milestone flow of SURVEY.md §7 step 4: ingest rating events, run the
+engine through run_train, and load the persisted model back — zero Spark.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.engine import WorkflowParams
+from predictionio_tpu.core.persistent_model import deserialize_models
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.parallel.mesh import compute_context
+from predictionio_tpu.templates.recommendation import (
+    ALSModel,
+    DataSourceParams,
+    Query,
+    engine_factory,
+)
+from predictionio_tpu.workflow.core_workflow import new_engine_instance, run_train
+
+
+@pytest.fixture
+def seeded_app(memory_storage):
+    """App 'mlapp' with synthetic low-rank rating events."""
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "mlapp"))
+    events = memory_storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    n_users, n_items, rank = 30, 20, 3
+    u = rng.normal(size=(n_users, rank))
+    v = rng.normal(size=(n_items, rank))
+    scores = u @ v.T
+    # ratings 1..5 by score quantile
+    qs = np.quantile(scores, [0.2, 0.4, 0.6, 0.8])
+    for ui in range(n_users):
+        for ii in range(n_items):
+            if rng.random() < 0.5:
+                rating = float(1 + np.searchsorted(qs, scores[ui, ii]))
+                events.insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{ui}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{ii}",
+                        properties=DataMap({"rating": rating}),
+                    ),
+                    app_id,
+                )
+    # a few buys (no rating property → default 4.0)
+    for ui in range(5):
+        events.insert(
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id=f"u{ui}",
+                target_entity_type="item",
+                target_entity_id="i0",
+            ),
+            app_id,
+        )
+    return memory_storage
+
+
+def test_train_persists_model_and_completes_instance(seeded_app):
+    engine = engine_factory()
+    variant = {
+        "id": "default",
+        "engineFactory": "predictionio_tpu.templates.recommendation:engine_factory",
+        "datasource": {"params": {"app_name": "mlapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "numIterations": 5, "lambda_": 0.05, "seed": 1}}
+        ],
+    }
+    ep = engine.engine_params_from_json(variant)
+    assert ep.data_source_params == DataSourceParams(app_name="mlapp")
+    instance = new_engine_instance(
+        "default", "1", "default",
+        variant["engineFactory"], ep, batch="test-batch",
+    )
+    instance_id = run_train(engine, ep, instance, WorkflowParams(batch="test-batch"))
+
+    # instance lifecycle: COMPLETED with params recorded
+    instances = seeded_app.get_meta_data_engine_instances()
+    done = instances.get(instance_id)
+    assert done.status == "COMPLETED"
+    assert json.loads(done.algorithms_params)[0]["name"] == "als"
+    assert instances.get_latest_completed("default", "1", "default").id == instance_id
+
+    # model round-trips from the model store
+    blob = seeded_app.get_model_data_models().get(instance_id)
+    assert blob is not None
+    models = deserialize_models(blob.models)
+    model = models[0]
+    assert isinstance(model, ALSModel)
+    assert model.factors.user_features.shape[1] == 8
+
+    # the model actually recommends: rated-highly items rank above unrated
+    algo = engine.algorithm_class_map["als"](
+        engine.engine_params_from_json(variant).algorithms_params[0][1]
+    )
+    result = algo.predict(model, Query(user="u0", num=5))
+    assert len(result.itemScores) == 5
+    assert result.itemScores[0].score >= result.itemScores[-1].score
+    # unknown user → empty result (reference behavior)
+    assert algo.predict(model, Query(user="nobody", num=5)).itemScores == ()
+
+
+def test_train_failure_marks_aborted(memory_storage):
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "emptyapp"))
+    memory_storage.get_events().init(app_id)
+    engine = engine_factory()
+    variant = {
+        "engineFactory": "x",
+        "datasource": {"params": {"app_name": "emptyapp"}},
+        "algorithms": [{"name": "als", "params": {}}],
+    }
+    ep = engine.engine_params_from_json(variant)
+    instance = new_engine_instance("default", "1", "default", "x", ep)
+    with pytest.raises(ValueError, match="empty"):
+        run_train(engine, ep, instance)
+    insts = memory_storage.get_meta_data_engine_instances().get_all()
+    assert [i.status for i in insts] == ["ABORTED"]
