@@ -18,6 +18,8 @@ from predictionio_tpu.core.base import (  # noqa: F401
     TrainingInterruption,
 )
 from predictionio_tpu.core.dase import (  # noqa: F401
+    AverageServing,
+    FirstServing,
     IdentityPreparator,
     LAlgorithm,
     LAverageServing,

@@ -191,6 +191,17 @@ class Engine:
             results.append((ei, fold_result))
         return results
 
+    def batch_eval(
+        self,
+        ctx: ComputeContext,
+        engine_params_list: Sequence[EngineParams],
+        params: WorkflowParams | None = None,
+    ) -> list[tuple[EngineParams, Any]]:
+        """Default: evaluate candidates independently
+        (ref: BaseEngine.batchEval:72-82). FastEvalEngine overrides this
+        with prefix memoization."""
+        return [(ep, self.eval(ctx, ep, params)) for ep in engine_params_list]
+
     # -- deploy-time model preparation (ref: Engine.prepareDeploy:196-265) ---
     def prepare_deploy(
         self,

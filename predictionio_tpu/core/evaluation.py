@@ -1,0 +1,190 @@
+"""Evaluation + parameter tuning.
+
+Re-design of the reference's ``Evaluation``/``EngineParamsGenerator``/
+``MetricEvaluator`` (ref: controller/Evaluation.scala:88-96,
+controller/EngineParamsGenerator.scala:27,
+controller/MetricEvaluator.scala:48-262): an Evaluation binds an engine, a
+list of candidate EngineParams, and a Metric; the MetricEvaluator runs the
+engine's eval for every candidate, scores them, picks the best by metric
+ordering, and renders one-liner/HTML/JSON results for the dashboard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from predictionio_tpu.core.base import BaseEvaluator, BaseEvaluatorResult
+from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
+from predictionio_tpu.core.metrics import Metric, ZeroMetric
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+logger = logging.getLogger(__name__)
+
+
+class EngineParamsGenerator:
+    """ref: controller/EngineParamsGenerator.scala:27 — subclasses set
+    ``engine_params_list``."""
+
+    engine_params_list: Sequence[EngineParams] = ()
+
+
+@dataclass
+class MetricScores:
+    """ref: MetricEvaluator.scala MetricScores"""
+
+    score: float
+    other_scores: list[float]
+
+
+@dataclass
+class MetricEvaluatorResult(BaseEvaluatorResult):
+    """ref: MetricEvaluator.scala:48-107"""
+
+    best_score: MetricScores = None  # type: ignore[assignment]
+    best_engine_params: EngineParams = None  # type: ignore[assignment]
+    best_idx: int = 0
+    metric_header: str = ""
+    other_metric_headers: list[str] = field(default_factory=list)
+    engine_params_scores: list[tuple[EngineParams, MetricScores]] = field(
+        default_factory=list
+    )
+
+    def to_one_liner(self) -> str:
+        return f"[{self.best_score.score}] {self.metric_header}"
+
+    def to_json(self):
+        return {
+            "metricHeader": self.metric_header,
+            "otherMetricHeaders": self.other_metric_headers,
+            "bestScore": self.best_score.score,
+            "bestIndex": self.best_idx,
+            "bestEngineParams": Engine.engine_params_to_json(
+                self.best_engine_params
+            ),
+            "scores": [
+                {
+                    "engineParams": Engine.engine_params_to_json(ep),
+                    "score": ms.score,
+                    "otherScores": ms.other_scores,
+                }
+                for ep, ms in self.engine_params_scores
+            ],
+        }
+
+    def to_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{ms.score}</td><td>{ms.other_scores}</td>"
+            f"<td><pre>{json.dumps(Engine.engine_params_to_json(ep), indent=2)}"
+            "</pre></td></tr>"
+            for ep, ms in self.engine_params_scores
+        )
+        return (
+            f"<h2>Metric: {self.metric_header}</h2>"
+            f"<p>Best score: {self.best_score.score} "
+            f"(candidate #{self.best_idx})</p>"
+            f"<table border=1><tr><th>{self.metric_header}</th>"
+            f"<th>{self.other_metric_headers}</th><th>Engine Params</th></tr>"
+            f"{rows}</table>"
+        )
+
+
+class MetricEvaluator(BaseEvaluator):
+    """ref: MetricEvaluator.scala:217-262"""
+
+    def __init__(
+        self,
+        metric: Metric,
+        other_metrics: Sequence[Metric] = (),
+        output_path: str | None = None,
+    ):
+        self.metric = metric
+        self.other_metrics = list(other_metrics)
+        self.output_path = output_path  # best.json (ref writes best.json)
+
+    def evaluate(
+        self,
+        ctx: ComputeContext,
+        evaluation: "Evaluation",
+        engine_eval_data_set: Sequence[tuple[EngineParams, Any]],
+        params: WorkflowParams | None = None,
+    ) -> MetricEvaluatorResult:
+        scores: list[tuple[EngineParams, MetricScores]] = []
+        for i, (engine_params, eval_data_set) in enumerate(engine_eval_data_set):
+            ms = MetricScores(
+                score=self.metric.calculate(eval_data_set),
+                other_scores=[
+                    m.calculate(eval_data_set) for m in self.other_metrics
+                ],
+            )
+            logger.info("candidate %d: %s = %s", i, self.metric.header, ms.score)
+            scores.append((engine_params, ms))
+        best_idx, (best_params, best_score) = max(
+            enumerate(scores),
+            key=lambda t: self.metric.compare_key(t[1][1].score),
+        )
+        result = MetricEvaluatorResult(
+            best_score=best_score,
+            best_engine_params=best_params,
+            best_idx=best_idx,
+            metric_header=self.metric.header,
+            other_metric_headers=[m.header for m in self.other_metrics],
+            engine_params_scores=scores,
+        )
+        if self.output_path:
+            with open(self.output_path, "w") as f:
+                json.dump(
+                    Engine.engine_params_to_json(best_params), f, indent=2
+                )
+            logger.info("best params written to %s", self.output_path)
+        return result
+
+
+class Evaluation:
+    """ref: controller/Evaluation.scala — binds engine + params candidates +
+    metric(s). Subclass and set the class attributes, or construct directly."""
+
+    engine: Engine = None  # type: ignore[assignment]
+    engine_params_list: Sequence[EngineParams] = ()
+    metric: Metric = ZeroMetric()
+    other_metrics: Sequence[Metric] = ()
+    output_path: str | None = "best.json"
+
+    def __init__(
+        self,
+        engine: Engine | None = None,
+        engine_params_list: Sequence[EngineParams] | None = None,
+        metric: Metric | None = None,
+        other_metrics: Sequence[Metric] | None = None,
+        params_generator: EngineParamsGenerator | None = None,
+    ):
+        if engine is not None:
+            self.engine = engine
+        if engine_params_list is not None:
+            self.engine_params_list = engine_params_list
+        if params_generator is not None:
+            self.engine_params_list = params_generator.engine_params_list
+        if metric is not None:
+            self.metric = metric
+        if other_metrics is not None:
+            self.other_metrics = other_metrics
+
+    @property
+    def evaluator(self) -> MetricEvaluator:
+        return MetricEvaluator(self.metric, self.other_metrics, self.output_path)
+
+    def run(
+        self, ctx: ComputeContext, params: WorkflowParams | None = None
+    ) -> MetricEvaluatorResult:
+        """batchEval + evaluateBase (ref: EvaluationWorkflow.scala:31-41)."""
+        if self.engine is None:
+            raise ValueError("Evaluation has no engine")
+        if not self.engine_params_list:
+            raise ValueError("Evaluation has no engine params candidates")
+        engine_eval_data_set = self.engine.batch_eval(
+            ctx, self.engine_params_list, params
+        )
+        return self.evaluator.evaluate(ctx, self, engine_eval_data_set, params)

@@ -1,0 +1,118 @@
+"""FastEvalEngine: prefix-memoized evaluation for hyperparameter sweeps.
+
+Re-design of the reference's ``FastEvalEngine``
+(ref: controller/FastEvalEngine.scala:43-343): when sweeping EngineParams,
+candidates sharing a params *prefix* (datasource → preparator → algorithms)
+share pipeline stage results instead of recomputing them. The caches key on
+the JSON form of the prefix params, mirroring the reference's
+DataSourcePrefix/PreparatorPrefix/AlgorithmsPrefix case-class keys.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Sequence
+
+from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams, _instantiate
+from predictionio_tpu.core.params import params_to_json
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+logger = logging.getLogger(__name__)
+
+
+def _key(*parts: Any) -> str:
+    return json.dumps([params_to_json(p) if not isinstance(p, (list, tuple))
+                       else [[n, params_to_json(pp)] for n, pp in p]
+                       for p in parts], sort_keys=True, default=str)
+
+
+class FastEvalEngineWorkflow:
+    """Stage caches for one sweep (ref: FastEvalEngineWorkflow:43-282)."""
+
+    def __init__(self, engine: Engine, ctx: ComputeContext,
+                 params: WorkflowParams | None = None):
+        self.engine = engine
+        self.ctx = ctx
+        self.params = params or WorkflowParams()
+        self.data_source_cache: dict[str, Any] = {}
+        self.preparator_cache: dict[str, Any] = {}
+        self.algorithms_cache: dict[str, Any] = {}
+
+    # ref: getDataSourceResult:85
+    def get_data_source_result(self, dsp) -> Any:
+        key = _key(dsp)
+        if key not in self.data_source_cache:
+            logger.info("fast-eval: computing datasource stage %s", key[:80])
+            ds = _instantiate(self.engine.data_source_class, dsp)
+            self.data_source_cache[key] = ds.read_eval(self.ctx)
+        return self.data_source_cache[key]
+
+    # ref: getPreparatorResult:108
+    def get_preparator_result(self, dsp, pp) -> list[Any]:
+        key = _key(dsp, pp)
+        if key not in self.preparator_cache:
+            folds = self.get_data_source_result(dsp)
+            preparator = _instantiate(self.engine.preparator_class, pp)
+            self.preparator_cache[key] = [
+                (preparator.prepare(self.ctx, td), ei, qa)
+                for td, ei, qa in folds
+            ]
+        return self.preparator_cache[key]
+
+    # ref: computeAlgorithmsResult:128
+    def get_algorithms_result(self, dsp, pp, algo_params_list):
+        key = _key(dsp, pp, list(algo_params_list))
+        if key not in self.algorithms_cache:
+            prepared_folds = self.get_preparator_result(dsp, pp)
+            per_fold = []
+            for pd, ei, qa in prepared_folds:
+                algorithms = [
+                    _instantiate(self.engine.algorithm_class_map[name], ap)
+                    for name, ap in algo_params_list
+                ]
+                models = [a.train(self.ctx, pd) for a in algorithms]
+                per_fold.append((algorithms, models, ei, qa))
+            self.algorithms_cache[key] = per_fold
+        return self.algorithms_cache[key]
+
+    def get_result(self, engine_params: EngineParams):
+        """Full per-candidate eval result reusing cached stages
+        (ref: ServingPrefix / getResult)."""
+        serving = _instantiate(
+            self.engine.serving_class, engine_params.serving_params
+        )
+        results = []
+        for algorithms, models, ei, qa_pairs in self.get_algorithms_result(
+            engine_params.data_source_params,
+            engine_params.preparator_params,
+            engine_params.algorithms_params,
+        ):
+            indexed = [(i, serving.supplement(q))
+                       for i, (q, _a) in enumerate(qa_pairs)]
+            per_query = [[None] * len(algorithms) for _ in qa_pairs]
+            for ai, (algo, model) in enumerate(zip(algorithms, models)):
+                for qi, prediction in algo.batch_predict(model, indexed):
+                    per_query[qi][ai] = prediction
+            fold = [
+                (q, serving.serve(q, per_query[i]), a)
+                for i, (q, a) in enumerate(qa_pairs)
+            ]
+            results.append((ei, fold))
+        return results
+
+
+class FastEvalEngine(Engine):
+    """Engine whose batch_eval memoizes shared stage prefixes
+    (ref: FastEvalEngine:310-343)."""
+
+    def batch_eval(
+        self,
+        ctx: ComputeContext,
+        engine_params_list: Sequence[EngineParams],
+        params: WorkflowParams | None = None,
+    ):
+        workflow = FastEvalEngineWorkflow(self, ctx, params)
+        return [
+            (ep, workflow.get_result(ep)) for ep in engine_params_list
+        ]

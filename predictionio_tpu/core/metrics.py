@@ -1,0 +1,95 @@
+"""Metric base classes (ref: controller/Metric.scala:36-266).
+
+A Metric folds the evaluation result set — per-fold ``(eval_info,
+[(query, prediction, actual)])`` — into one comparable number. The
+reference computes averages/stdevs with Spark ``StatCounter`` unions; here
+the fold results are host lists and numpy does the reduction.
+"""
+
+from __future__ import annotations
+
+import math
+from abc import ABC, abstractmethod
+from typing import Any, Generic, Sequence, TypeVar
+
+EI = TypeVar("EI")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+
+EvalDataSet = Sequence[tuple[Any, Sequence[tuple[Any, Any, Any]]]]
+
+
+class Metric(ABC, Generic[EI, Q, P, A]):
+    """ref: Metric.scala:36. Larger is better unless ``comparator`` flips."""
+
+    #: set to -1 to prefer smaller scores (the reference overrides Ordering)
+    sign: int = 1
+
+    @abstractmethod
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        """Fold the whole evaluation result set into a score."""
+
+    def compare_key(self, score: float) -> float:
+        if score is None or (isinstance(score, float) and math.isnan(score)):
+            return float("-inf")
+        return self.sign * score
+
+    @property
+    def header(self) -> str:
+        return type(self).__name__
+
+
+class QPAMetric(Metric[EI, Q, P, A]):
+    """Per-(q,p,a) scoring with a reduction over all folds."""
+
+    @abstractmethod
+    def calculate_qpa(self, q: Q, p: P, a: A) -> float | None: ...
+
+    def _scores(self, eval_data_set: EvalDataSet) -> list[float]:
+        out = []
+        for _ei, qpas in eval_data_set:
+            for q, p, a in qpas:
+                s = self.calculate_qpa(q, p, a)
+                if s is not None:
+                    out.append(float(s))
+        return out
+
+
+class AverageMetric(QPAMetric[EI, Q, P, A]):
+    """ref: Metric.scala AverageMetric:95 — mean of per-query scores.
+    Subclasses implement ``calculate_qpa`` returning a float (never None)."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        scores = self._scores(eval_data_set)
+        return sum(scores) / len(scores) if scores else float("nan")
+
+
+class OptionAverageMetric(AverageMetric[EI, Q, P, A]):
+    """ref: Metric.scala OptionAverageMetric:132 — None scores are excluded
+    from both numerator and denominator."""
+
+
+class StdevMetric(QPAMetric[EI, Q, P, A]):
+    """ref: Metric.scala StdevMetric:170 — population stdev of scores."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        scores = self._scores(eval_data_set)
+        if not scores:
+            return float("nan")
+        mean = sum(scores) / len(scores)
+        return math.sqrt(sum((s - mean) ** 2 for s in scores) / len(scores))
+
+
+class SumMetric(QPAMetric[EI, Q, P, A]):
+    """ref: Metric.scala SumMetric:217"""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        return float(sum(self._scores(eval_data_set)))
+
+
+class ZeroMetric(Metric[EI, Q, P, A]):
+    """ref: Metric.scala ZeroMetric:253 — always 0; placeholder metric."""
+
+    def calculate(self, eval_data_set: EvalDataSet) -> float:
+        return 0.0

@@ -1,0 +1,120 @@
+"""Categorical Naive Bayes over string features.
+
+Re-design of the reference's e2 algorithm library version
+(ref: e2/src/main/scala/io/prediction/e2/engine/CategoricalNaiveBayes.scala:
+29-176): features are categorical strings per position; the model keeps log
+priors and per-(feature-position, value) log likelihoods, with a pluggable
+default log-likelihood for unseen values (``logScore`` with default
+function, ref :82-176). Training is a vocabulary-encode + the same one-hot
+count reduction as multinomial NB; data volumes here are metadata-small so
+counting runs host-side in numpy.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+
+@dataclass(frozen=True)
+class LabeledPoint:
+    """ref: e2/.../engine/LabeledPoint (label + categorical feature vector)"""
+
+    label: str
+    features: tuple[str, ...]
+
+
+@dataclass
+class CategoricalNaiveBayesModel:
+    """ref: CategoricalNaiveBayes.scala Model:82"""
+
+    priors: dict[str, float]  # label → log prior
+    likelihoods: dict[str, list[dict[str, float]]]  # label → per-pos {value: log p}
+
+    def _log_score_internal(
+        self,
+        label: str,
+        features: Sequence[str],
+        default_likelihood: Callable[[list[float]], float],
+    ) -> float:
+        # ref: logScoreInternal — unseen values get defaultLikelihood
+        pos_likelihoods = self.likelihoods[label]
+        if len(features) != len(pos_likelihoods):
+            raise ValueError(
+                f"feature vector length {len(features)} != model "
+                f"{len(pos_likelihoods)}"
+            )
+        total = self.priors[label]
+        for pos, value in enumerate(features):
+            ll = pos_likelihoods[pos].get(value)
+            if ll is None:
+                ll = default_likelihood(list(pos_likelihoods[pos].values()))
+            total += ll
+        return total
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Callable[[list[float]], float] = (
+            lambda lls: float("-inf")
+        ),
+    ) -> float | None:
+        """Log score of (features, label); None when the label is unknown
+        (ref: CategoricalNaiveBayes.scala logScore:103-115)."""
+        if point.label not in self.priors:
+            return None
+        return self._log_score_internal(
+            point.label, point.features, default_likelihood
+        )
+
+    def score_all(
+        self,
+        features: Sequence[str],
+        default_likelihood: Callable[[list[float]], float] = (
+            lambda lls: float("-inf")
+        ),
+    ) -> dict[str, float]:
+        return {
+            label: self._log_score_internal(label, features, default_likelihood)
+            for label in self.priors
+        }
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Label with the highest score (ref: predict:137-151); unseen values
+        score -inf per the reference default."""
+        scores = self.score_all(features)
+        return max(scores, key=scores.get)
+
+
+def train_categorical_nb(points: Sequence[LabeledPoint]) -> CategoricalNaiveBayesModel:
+    """ref: CategoricalNaiveBayes.train:29-80"""
+    if not points:
+        raise ValueError("no labeled points")
+    n_features = len(points[0].features)
+    label_counts: Counter = Counter()
+    value_counts: dict[str, list[Counter]] = defaultdict(
+        lambda: [Counter() for _ in range(n_features)]
+    )
+    for p in points:
+        if len(p.features) != n_features:
+            raise ValueError("inconsistent feature vector length")
+        label_counts[p.label] += 1
+        for pos, v in enumerate(p.features):
+            value_counts[p.label][pos][v] += 1
+    total = sum(label_counts.values())
+    priors = {
+        label: math.log(c) - math.log(total) for label, c in label_counts.items()
+    }
+    likelihoods = {
+        label: [
+            {
+                v: math.log(c) - math.log(label_counts[label])
+                for v, c in pos_counter.items()
+            }
+            for pos_counter in value_counts[label]
+        ]
+        for label in label_counts
+    }
+    return CategoricalNaiveBayesModel(priors, likelihoods)

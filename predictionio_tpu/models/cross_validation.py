@@ -1,0 +1,45 @@
+"""Generic k-fold cross-validation splitter.
+
+Re-design of the reference's e2 helper
+(ref: e2/src/main/scala/io/prediction/e2/evaluation/CrossValidation.scala:
+33-64 ``CommonHelperFunctions.splitData``): splits indexed data into k
+folds shaped exactly as ``read_eval`` needs —
+``[(training_points, eval_info, [(query, actual)])]``."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, TypeVar
+
+import numpy as np
+
+D = TypeVar("D")
+TD = TypeVar("TD")
+EI = TypeVar("EI")
+Q = TypeVar("Q")
+A = TypeVar("A")
+
+
+def split_data(
+    k: int,
+    data: Sequence[D],
+    make_training_data: Callable[[list[D]], TD],
+    make_eval_info: Callable[[list[D]], EI],
+    make_query_actual: Callable[[D], tuple[Q, A]],
+    seed: int = 0,
+) -> list[tuple[TD, EI, list[tuple[Q, A]]]]:
+    if k < 2:
+        raise ValueError("k must be >= 2")
+    rng = np.random.default_rng(seed)
+    fold_of = rng.integers(0, k, len(data))
+    folds = []
+    for fold in range(k):
+        training = [d for d, f in zip(data, fold_of) if f != fold]
+        testing = [d for d, f in zip(data, fold_of) if f == fold]
+        folds.append(
+            (
+                make_training_data(training),
+                make_eval_info(training),
+                [make_query_actual(d) for d in testing],
+            )
+        )
+    return folds

@@ -1,0 +1,86 @@
+"""First-order Markov chain with top-N sparsified transitions.
+
+Re-design of the reference's e2 MarkovChain
+(ref: e2/src/main/scala/io/prediction/e2/engine/MarkovChain.scala:32-89):
+train builds a row-normalized transition matrix keeping only the top-N
+probabilities per row; predict is distribution × matrix. The matrix is kept
+as dense [S, topN] (indices + probs) so predictNext is a gather + segment
+sum — static shapes, XLA-friendly."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class MarkovChainModel:
+    """ref: MarkovChainModel (transitionVectors, n)"""
+
+    top_indices: np.ndarray  # [S, topN] int32 next-state ids (pad -1)
+    top_probs: np.ndarray  # [S, topN] float32 row-normalized probs (pad 0)
+    n_states: int
+
+    def transition_row(self, state: int) -> dict[int, float]:
+        out = {}
+        for j, p in zip(self.top_indices[state], self.top_probs[state]):
+            if j >= 0 and p > 0:
+                out[int(j)] = float(p)
+        return out
+
+    def predict_next(self, current: np.ndarray) -> np.ndarray:
+        """distribution [S] → next distribution [S]
+        (ref: MarkovChainModel.predict = vector × matrix)."""
+        current = np.asarray(current, dtype=np.float32)
+        nxt = np.zeros(self.n_states, np.float32)
+        valid = self.top_indices >= 0
+        src = np.repeat(np.arange(self.n_states), self.top_indices.shape[1])
+        flat_idx = self.top_indices.ravel()
+        contrib = (current[src] * self.top_probs.ravel())
+        mask = valid.ravel()
+        np.add.at(nxt, flat_idx[mask], contrib[mask])
+        return nxt
+
+
+def train_markov_chain(
+    from_idx: np.ndarray,
+    to_idx: np.ndarray,
+    counts: np.ndarray,
+    n_states: int,
+    top_n: int = 10,
+) -> MarkovChainModel:
+    """ref: MarkovChain.train:32-60 — CoordinateMatrix → row-normalize →
+    keep top-N per row. Works on the sparse triplets directly (O(nnz)
+    memory), never densifying the [S, S] matrix."""
+    from_idx = np.asarray(from_idx, np.int64)
+    to_idx = np.asarray(to_idx, np.int64)
+    counts = np.asarray(counts, np.float64)
+    top_n = min(top_n, n_states)
+    # coalesce duplicate (from, to) pairs
+    flat = from_idx * n_states + to_idx
+    uniq, inv = np.unique(flat, return_inverse=True)
+    summed = np.zeros(len(uniq), np.float64)
+    np.add.at(summed, inv, counts)
+    rows = (uniq // n_states).astype(np.int64)
+    cols = (uniq % n_states).astype(np.int32)
+    row_sums = np.zeros(n_states, np.float64)
+    np.add.at(row_sums, rows, summed)
+    probs = summed / row_sums[rows]
+
+    top_idx = np.full((n_states, top_n), -1, np.int32)
+    top_probs = np.zeros((n_states, top_n), np.float32)
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, probs_s = rows[order], cols[order], probs[order]
+    boundaries = np.searchsorted(rows_s, np.arange(n_states + 1))
+    for state in np.unique(rows_s):
+        lo, hi = boundaries[state], boundaries[state + 1]
+        seg_p, seg_c = probs_s[lo:hi], cols_s[lo:hi]
+        if len(seg_p) > top_n:
+            keep = np.argpartition(-seg_p, top_n - 1)[:top_n]
+            seg_p, seg_c = seg_p[keep], seg_c[keep]
+        sort = np.argsort(-seg_p)
+        seg_p, seg_c = seg_p[sort], seg_c[sort]
+        top_idx[state, : len(seg_c)] = seg_c
+        top_probs[state, : len(seg_p)] = seg_p
+    return MarkovChainModel(top_idx, top_probs, n_states)

@@ -1,0 +1,65 @@
+"""Shared serve-time filter helpers for recommendation-style templates.
+
+The similarproduct and ecommerce templates apply the same white/black-list +
+category filters before their top-k kernels (ref:
+examples/scala-parallel-ecommercerecommendation/.../ALSAlgorithm.scala:
+148-267 and examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala);
+all filters fold into ONE boolean exclusion mask handed to the XLA kernel,
+keeping the device path a single masked matmul + top_k.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+def build_exclusion_mask(
+    item_ids: BiMap,
+    banned: Iterable[str] = (),
+    black_list: Sequence[str] | None = None,
+    white_list: Sequence[str] | None = None,
+    categories: Sequence[str] | None = None,
+    item_categories: Mapping[str, tuple[str, ...]] | None = None,
+) -> np.ndarray:
+    """[1, n_items] bool mask; True → excluded from recommendation."""
+    n_items = len(item_ids)
+    exclude = np.zeros((1, n_items), bool)
+
+    def ban(item: str) -> None:
+        idx = item_ids.get(item)
+        if idx is not None:
+            exclude[0, idx] = True
+
+    for item in banned:
+        ban(item)
+    if black_list:
+        for item in black_list:
+            ban(item)
+    if white_list is not None:
+        allowed = {item_ids(i) for i in white_list if i in item_ids}
+        mask = np.ones(n_items, bool)
+        if allowed:
+            mask[list(allowed)] = False
+        exclude[0] |= mask
+    if categories is not None:
+        want = set(categories)
+        cats_by_item = item_categories or {}
+        for item, idx in item_ids.to_dict().items():
+            if not (set(cats_by_item.get(item, ())) & want):
+                exclude[0, idx] = True
+    return exclude
+
+
+def topk_to_item_scores(scores_row, idx_row, item_ids: BiMap, num: int,
+                        make_item_score):
+    """Decode a top-k kernel row into template ItemScore objects, dropping
+    -inf (fully-excluded) entries."""
+    out = []
+    for s, i in zip(np.asarray(scores_row), np.asarray(idx_row)):
+        if np.isfinite(s):
+            out.append(make_item_score(item_ids.inverse(int(i)), float(s)))
+    return tuple(out[:num])

@@ -1,0 +1,50 @@
+"""Binary one-hot vectorizer for (feature, value) string pairs.
+
+Re-design of the reference's e2 BinaryVectorizer
+(ref: e2/src/main/scala/io/prediction/e2/engine/BinaryVectorizer.scala:24-60):
+builds an index over distinct (property, value) pairs and encodes maps of
+properties into dense one-hot vectors for the TPU classifiers."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+
+@dataclass
+class BinaryVectorizer:
+    index: dict[tuple[str, str], int]
+
+    @staticmethod
+    def fit(
+        maps: Iterable[Mapping[str, str]], properties: Sequence[str]
+    ) -> "BinaryVectorizer":
+        """ref: BinaryVectorizer.apply — distinct (property, value) pairs."""
+        seen: dict[tuple[str, str], int] = {}
+        for m in maps:
+            for prop in properties:
+                if prop in m:
+                    key = (prop, str(m[prop]))
+                    if key not in seen:
+                        seen[key] = len(seen)
+        return BinaryVectorizer(seen)
+
+    @property
+    def n_features(self) -> int:
+        return len(self.index)
+
+    def transform(self, m: Mapping[str, str]) -> np.ndarray:
+        """ref: BinaryVectorizer.toBinary — O(len(m)) lookups."""
+        out = np.zeros(len(self.index), np.float32)
+        for prop, value in m.items():
+            i = self.index.get((prop, str(value)))
+            if i is not None:
+                out[i] = 1.0
+        return out
+
+    def transform_batch(self, maps: Sequence[Mapping[str, str]]) -> np.ndarray:
+        return np.stack([self.transform(m) for m in maps]) if maps else (
+            np.zeros((0, len(self.index)), np.float32)
+        )

@@ -10,4 +10,9 @@ engine.json into place.
 
 # names listed here must have a module in this package; `pio template
 # list/scaffold` trusts this tuple
-TEMPLATE_NAMES = ("recommendation",)
+TEMPLATE_NAMES = (
+    "recommendation",
+    "classification",
+    "similarproduct",
+    "ecommercerecommendation",
+)

@@ -1,0 +1,282 @@
+"""E-commerce recommendation engine template.
+
+Re-design of the reference's scala-parallel-ecommercerecommendation
+template (ref: examples/scala-parallel-ecommercerecommendation/
+train-with-rate-event/src/main/scala/ALSAlgorithm.scala:148-299): implicit
+ALS on view/buy events with SERVE-TIME business filters — at predict time
+the algorithm reads the event store for the latest ``$set`` of the
+``constraint`` entity's ``unavailableItems`` (ref :194-221), merges query
+white/black lists plus the user's recently seen items into an exclusion
+set, and for unknown users falls back to recommending near their recent
+views (``predictNewUser``, ref :285).
+
+This is the template that exercises LEventStore on the query path. The
+XLA-side design keeps predict a single batched matmul+top_k: all filters
+are folded host-side into one boolean exclusion mask passed to the kernel —
+no host callbacks inside jit.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.core import Engine, FirstServing, P2LAlgorithm, PDataSource, PPreparator
+from predictionio_tpu.core.base import SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.models.als import ALS, ALSParams, top_k_cosine, top_k_scores
+from predictionio_tpu.models.serving_filters import (
+    build_exclusion_mask,
+    topk_to_item_scores,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+    categories: tuple[str, ...] | None = None
+    whiteList: tuple[str, ...] | None = None
+    blackList: tuple[str, ...] | None = None
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple[ItemScore, ...] = ()
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "ecommerce"
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: list[str]
+    items: list[str]
+    events: list[str]  # per-row event name (view / buy)
+    item_categories: dict[str, tuple[str, ...]]
+
+    def sanity_check(self) -> None:
+        if not self.users:
+            raise ValueError("TrainingData is empty; ingest view/buy events first")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        app = self.params.app_name
+        users, items, names = [], [], []
+        for e in PEventStore.find(app, event_names=["view", "buy"]):
+            if e.target_entity_id is not None:
+                users.append(e.entity_id)
+                items.append(e.target_entity_id)
+                names.append(e.event)
+        categories = {}
+        for item_id, pm in PEventStore.aggregate_properties(app, "item").items():
+            cats = pm.get_opt("categories", list)
+            if cats:
+                categories[item_id] = tuple(str(c) for c in cats)
+        return TrainingData(users, items, names, categories)
+
+
+@dataclass
+class PreparedData:
+    td: TrainingData
+
+
+class Preparator(PPreparator):
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        return PreparedData(td)
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    app_name: str = "ecommerce"
+    rank: int = 10
+    numIterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = None
+    buy_weight: float = 5.0  # buys count more than views
+    unseen_only: bool = True  # exclude items the user has seen
+    seen_events: tuple[str, ...] = ("view", "buy")
+    similar_events: tuple[str, ...] = ("view",)  # cold-start basis
+
+
+@dataclass
+class ECommModel:
+    user_features: np.ndarray
+    item_features: np.ndarray
+    user_ids: BiMap
+    item_ids: BiMap
+    item_categories: dict[str, tuple[str, ...]]
+
+
+class ECommAlgorithm(P2LAlgorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> ECommModel:
+        td = pd.td
+        weights: dict[tuple[str, str], float] = defaultdict(float)
+        for u, i, name in zip(td.users, td.items, td.events):
+            weights[(u, i)] += (
+                self.params.buy_weight if name == "buy" else 1.0
+            )
+        users = [u for u, _ in weights]
+        items = [i for _, i in weights]
+        ratings = np.fromiter(weights.values(), np.float32, count=len(weights))
+        user_ids = BiMap.string_int(users)
+        item_ids = BiMap.string_int(items)
+        als = ALS(
+            ctx,
+            ALSParams(
+                rank=self.params.rank,
+                num_iterations=self.params.numIterations,
+                lambda_=self.params.lambda_,
+                implicit_prefs=True,
+                alpha=self.params.alpha,
+                seed=self.params.seed,
+            ),
+        )
+        factors = als.train(
+            user_ids.encode(users), item_ids.encode(items), ratings,
+            n_users=len(user_ids), n_items=len(item_ids),
+        )
+        return ECommModel(
+            factors.user_features, factors.item_features, user_ids, item_ids,
+            td.item_categories,
+        )
+
+    # -- serve-time filters (ref: ALSAlgorithm.scala:148-267) ---------------
+    def _unavailable_items(self) -> set[str]:
+        """Latest $set on the 'constraint/unavailableItems' entity
+        (ref :194-221)."""
+        try:
+            events = list(
+                LEventStore.find_by_entity(
+                    self.params.app_name,
+                    entity_type="constraint",
+                    entity_id="unavailableItems",
+                    event_names=["$set"],
+                    limit=1,
+                    latest=True,
+                )
+            )
+        except ValueError:
+            return set()
+        if not events:
+            return set()
+        items = events[0].properties.get_opt("items", list) or []
+        return {str(i) for i in items}
+
+    def _seen_items(self, user: str) -> set[str]:
+        """Items the user has interacted with (ref :154-190 seenItems)."""
+        if not self.params.unseen_only:
+            return set()
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.seen_events),
+            )
+        except ValueError:
+            return set()
+        return {e.target_entity_id for e in events if e.target_entity_id}
+
+    def _recent_items(self, user: str, n: int = 10) -> list[str]:
+        """Recently viewed items for cold-start (ref predictNewUser :285)."""
+        try:
+            events = LEventStore.find_by_entity(
+                self.params.app_name,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(self.params.similar_events),
+                limit=n,
+                latest=True,
+            )
+        except ValueError:
+            return []
+        return [e.target_entity_id for e in events if e.target_entity_id]
+
+    def _exclusion_mask(self, model: ECommModel, query: Query,
+                        user: str) -> np.ndarray:
+        return build_exclusion_mask(
+            model.item_ids,
+            banned=(*self._unavailable_items(), *self._seen_items(user)),
+            black_list=query.blackList,
+            white_list=query.whiteList,
+            categories=query.categories,
+            item_categories=model.item_categories,
+        )
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        exclude = self._exclusion_mask(model, query, query.user)
+        k = min(query.num, len(model.item_ids))
+        uidx = model.user_ids.get(query.user)
+        if uidx is not None:
+            q = model.user_features[uidx][None, :]
+            scores, idx = top_k_scores(q, model.item_features, k, exclude)
+        else:
+            # cold-start: recommend near the user's recent views (ref :285)
+            recent = [model.item_ids(i) for i in self._recent_items(query.user)
+                      if i in model.item_ids]
+            if not recent:
+                return PredictedResult(())
+            q = model.item_features[np.asarray(recent, np.int32)].mean(axis=0)[None, :]
+            scores, idx = top_k_cosine(q, model.item_features, k, exclude)
+        return PredictedResult(
+            topk_to_item_scores(scores[0], idx[0], model.item_ids, query.num,
+                                ItemScore)
+        )
+
+
+class Serving(FirstServing):
+    pass
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"ecomm": ECommAlgorithm},
+        serving_class=Serving,
+    )
+
+
+ENGINE_JSON = {
+    "id": "default",
+    "description": "Default settings",
+    "engineFactory": (
+        "predictionio_tpu.templates.ecommercerecommendation:engine_factory"
+    ),
+    "datasource": {"params": {"app_name": "MyApp1"}},
+    "algorithms": [
+        {"name": "ecomm",
+         "params": {"app_name": "MyApp1", "rank": 10, "numIterations": 20,
+                    "lambda_": 0.01, "alpha": 1.0, "seed": 3}}
+    ],
+}

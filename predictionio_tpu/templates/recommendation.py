@@ -17,8 +17,17 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from predictionio_tpu.core import Engine, LServing, PAlgorithm, PDataSource, PPreparator
+from predictionio_tpu.core import (
+    Engine,
+    EngineParams,
+    LServing,
+    PAlgorithm,
+    PDataSource,
+    PPreparator,
+)
 from predictionio_tpu.core.base import SanityCheck
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.metrics import OptionAverageMetric
 from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import PEventStore
@@ -91,31 +100,30 @@ class DataSource(PDataSource):
         return self._read()
 
     def read_eval(self, ctx: ComputeContext):
-        """k-fold split for `pio eval` (ref: evaluation variants of the
-        template; fold logic mirrors e2 CrossValidation.splitData)."""
+        """k-fold split for `pio eval` via the shared splitter
+        (ref: evaluation variants of the template; e2 CrossValidation)."""
+        from predictionio_tpu.models.cross_validation import split_data
+
         k = self.params.eval_k
         if not k:
             raise NotImplementedError("set eval_k in datasource params to evaluate")
         td = self._read()
-        n = len(td.users)
-        rng = np.random.default_rng(self.params.seed)
-        fold_of = rng.integers(0, k, n)
-        folds = []
-        for fold in range(k):
-            test = fold_of == fold
-            train = ~test
-            fold_td = TrainingData(
-                [u for u, t in zip(td.users, train) if t],
-                [i for i, t in zip(td.items, train) if t],
-                td.ratings[train],
-            )
-            qa = [
-                (Query(user=u, num=10), ActualRating(item=i, rating=float(r)))
-                for u, i, r, t in zip(td.users, td.items, td.ratings, test)
-                if t
-            ]
-            folds.append((fold_td, {"fold": fold}, qa))
-        return folds
+        rows = list(zip(td.users, td.items, td.ratings.tolist()))
+        return split_data(
+            k,
+            rows,
+            make_training_data=lambda rs: TrainingData(
+                [u for u, _, _ in rs],
+                [i for _, i, _ in rs],
+                np.asarray([r for _, _, r in rs], np.float32),
+            ),
+            make_eval_info=lambda rs: {"n_train": len(rs)},
+            make_query_actual=lambda row: (
+                Query(user=row[0], num=10),
+                ActualRating(item=row[1], rating=float(row[2])),
+            ),
+            seed=self.params.seed,
+        )
 
 
 @dataclass(frozen=True)
@@ -258,6 +266,53 @@ def engine_factory() -> Engine:
         preparator_class=Preparator,
         algorithm_class_map={"als": ALSAlgorithm},
         serving_class=Serving,
+    )
+
+
+# -- evaluation (ref: the template's evaluation variant — Evaluation.scala
+# with PrecisionAtK over k-fold readEval) ----------------------------------
+
+
+class PrecisionAtK(OptionAverageMetric):
+    """Fraction of queries whose held-out item appears in the top-k,
+    counting only positively-rated actuals (rating >= threshold)."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 4.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"PrecisionAtK(k={self.k}, threshold={self.rating_threshold})"
+
+    def calculate_qpa(self, q: Query, p: PredictedResult, a: ActualRating):
+        if a.rating < self.rating_threshold:
+            return None  # excluded from the average (OptionAverageMetric)
+        top = [s.item for s in p.itemScores[: self.k]]
+        return 1.0 if a.item in top else 0.0
+
+
+def evaluation(
+    app_name: str = "MyApp1", eval_k: int = 3,
+    ranks=(8, 16), lambdas=(0.01, 0.1),
+) -> Evaluation:
+    """Parameter-sweep evaluation over rank × lambda (ref: the template's
+    EngineParamsList generator)."""
+    candidates = [
+        EngineParams(
+            data_source_params=DataSourceParams(app_name=app_name, eval_k=eval_k),
+            algorithms_params=(
+                ("als", AlgorithmParams(rank=r, numIterations=10, lambda_=l,
+                                        seed=3)),
+            ),
+        )
+        for r in ranks
+        for l in lambdas
+    ]
+    return Evaluation(
+        engine=engine_factory(),
+        engine_params_list=candidates,
+        metric=PrecisionAtK(k=10, rating_threshold=4.0),
     )
 
 

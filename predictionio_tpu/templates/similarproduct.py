@@ -1,0 +1,272 @@
+"""Similar-product engine template.
+
+Re-design of the reference's scala-parallel-similarproduct template
+(ref: examples/scala-parallel-similarproduct/multi/src/main/scala/
+{Engine,DataSource,Preparator,ALSAlgorithm,LikeAlgorithm,Serving}.scala):
+implicit-feedback ALS on ``view`` events; queries name a set of liked items
+and get cosine-similar items back, excluding the query items and honoring
+white/black lists. The ``multi`` variant's second algorithm trains on
+like/dislike events as ±1 implicit ratings (LikeAlgorithm.scala:16-60);
+Serving sums scores across algorithms (Serving.scala).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    Engine,
+    LServing,
+    P2LAlgorithm,
+    PDataSource,
+    PPreparator,
+)
+from predictionio_tpu.core.base import SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.als import ALS, ALSParams, top_k_cosine
+from predictionio_tpu.models.serving_filters import (
+    build_exclusion_mask,
+    topk_to_item_scores,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@dataclass(frozen=True)
+class Query:
+    items: tuple[str, ...]
+    num: int = 10
+    categories: tuple[str, ...] | None = None
+    whiteList: tuple[str, ...] | None = None
+    blackList: tuple[str, ...] | None = None
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple[ItemScore, ...] = ()
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "similarproduct"
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    view_users: list[str]
+    view_items: list[str]
+    like_users: list[str] = field(default_factory=list)
+    like_items: list[str] = field(default_factory=list)
+    like_signs: list[float] = field(default_factory=list)  # +1 like / -1 dislike
+    item_categories: dict[str, tuple[str, ...]] = field(default_factory=dict)
+
+    def sanity_check(self) -> None:
+        if not self.view_users:
+            raise ValueError("TrainingData is empty; ingest view events first")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        app = self.params.app_name
+        view_users, view_items = [], []
+        for e in PEventStore.find(app, event_names=["view"]):
+            if e.target_entity_id is not None:
+                view_users.append(e.entity_id)
+                view_items.append(e.target_entity_id)
+        like_users, like_items, like_signs = [], [], []
+        for e in PEventStore.find(app, event_names=["like", "dislike"]):
+            if e.target_entity_id is not None:
+                like_users.append(e.entity_id)
+                like_items.append(e.target_entity_id)
+                like_signs.append(1.0 if e.event == "like" else -1.0)
+        categories = {}
+        for item_id, pm in PEventStore.aggregate_properties(app, "item").items():
+            cats = pm.get_opt("categories", list)
+            if cats:
+                categories[item_id] = tuple(str(c) for c in cats)
+        return TrainingData(
+            view_users, view_items, like_users, like_items, like_signs, categories
+        )
+
+
+@dataclass
+class PreparedData:
+    td: TrainingData
+
+
+class Preparator(PPreparator):
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        return PreparedData(td)
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    rank: int = 10
+    numIterations: int = 20
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int | None = None
+
+
+@dataclass
+class SimilarModel:
+    item_features: np.ndarray  # [n_items, rank]
+    item_ids: BiMap
+    item_categories: dict[str, tuple[str, ...]]
+
+
+def _train_implicit_item_factors(
+    ctx: ComputeContext,
+    users: list[str],
+    items: list[str],
+    ratings: np.ndarray,
+    params: AlgorithmParams,
+    item_categories: dict[str, tuple[str, ...]],
+) -> SimilarModel:
+    if not users:
+        raise ValueError("no interaction events to train on")
+    user_ids = BiMap.string_int(users)
+    item_ids = BiMap.string_int(items)
+    als = ALS(
+        ctx,
+        ALSParams(
+            rank=params.rank,
+            num_iterations=params.numIterations,
+            lambda_=params.lambda_,
+            implicit_prefs=True,
+            alpha=params.alpha,
+            seed=params.seed,
+        ),
+    )
+    factors = als.train(
+        user_ids.encode(users),
+        item_ids.encode(items),
+        ratings,
+        n_users=len(user_ids),
+        n_items=len(item_ids),
+    )
+    return SimilarModel(factors.item_features, item_ids, item_categories)
+
+
+def _similar_items(model: SimilarModel, query: Query) -> PredictedResult:
+    """Cosine top-k over the query items' mean factor, with the reference's
+    filters: drop query items, apply white/black lists and categories
+    (ref: ALSAlgorithm.predict in the similarproduct template)."""
+    known = [model.item_ids(i) for i in query.items if i in model.item_ids]
+    if not known:
+        return PredictedResult(())
+    q = model.item_features[np.asarray(known, np.int32)].mean(axis=0)[None, :]
+    exclude = build_exclusion_mask(
+        model.item_ids,
+        banned=(i for i in query.items if i in model.item_ids),
+        black_list=query.blackList,
+        white_list=query.whiteList,
+        categories=query.categories,
+        item_categories=model.item_categories,
+    )
+    k = min(query.num, len(model.item_ids))
+    scores, idx = top_k_cosine(q, model.item_features, k, exclude)
+    return PredictedResult(
+        topk_to_item_scores(scores[0], idx[0], model.item_ids, query.num,
+                            ItemScore)
+    )
+
+
+class ALSAlgorithm(P2LAlgorithm):
+    """Implicit ALS on view counts (ref: multi/.../ALSAlgorithm.scala)."""
+
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> SimilarModel:
+        td = pd.td
+        # collapse duplicate views to counts (implicit strength)
+        counts: dict[tuple[str, str], float] = defaultdict(float)
+        for u, i in zip(td.view_users, td.view_items):
+            counts[(u, i)] += 1.0
+        users = [u for u, _ in counts]
+        items = [i for _, i in counts]
+        ratings = np.fromiter(counts.values(), np.float32, count=len(counts))
+        return _train_implicit_item_factors(
+            ctx, users, items, ratings, self.params, td.item_categories
+        )
+
+    def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+        return _similar_items(model, query)
+
+
+class LikeAlgorithm(ALSAlgorithm):
+    """like/dislike → ±1 implicit ratings (ref: LikeAlgorithm.scala:16-60);
+    latest event per (user, item) wins."""
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> SimilarModel:
+        td = pd.td
+        last: dict[tuple[str, str], float] = {}
+        for u, i, s in zip(td.like_users, td.like_items, td.like_signs):
+            last[(u, i)] = s  # events are time-ordered from the store
+        users = [u for u, _ in last]
+        items = [i for _, i in last]
+        ratings = np.fromiter(last.values(), np.float32, count=len(last))
+        return _train_implicit_item_factors(
+            ctx, users, items, ratings, self.params, td.item_categories
+        )
+
+
+class Serving(LServing):
+    """Sum scores across algorithms per item (ref: multi Serving.scala)."""
+
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        combined: dict[str, float] = defaultdict(float)
+        for p in predictions:
+            for s in p.itemScores:
+                combined[s.item] += s.score
+        top = sorted(combined.items(), key=lambda kv: -kv[1])[: query.num]
+        return PredictedResult(
+            tuple(ItemScore(i, s) for i, s in top)
+        )
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        serving_class=Serving,
+    )
+
+
+ENGINE_JSON = {
+    "id": "default",
+    "description": "Default settings",
+    "engineFactory": "predictionio_tpu.templates.similarproduct:engine_factory",
+    "datasource": {"params": {"app_name": "MyApp1"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 10, "numIterations": 20, "lambda_": 0.01,
+                    "alpha": 1.0, "seed": 3}}
+    ],
+}

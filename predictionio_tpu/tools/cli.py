@@ -96,6 +96,31 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("--stop-after-prepare", action="store_true")
     p_train.set_defaults(func=cmd_train)
 
+    # -- deploy / undeploy (ref: Console.scala:835-922) ---------------------
+    p_deploy = sub.add_parser("deploy", help="deploy the latest trained engine")
+    p_deploy.add_argument("--engine-json", default="engine.json")
+    p_deploy.add_argument("--ip", default="0.0.0.0")
+    p_deploy.add_argument("--port", type=int, default=8000)
+    p_deploy.add_argument("--feedback", action="store_true")
+    p_deploy.add_argument("--event-server-ip", default="0.0.0.0")
+    p_deploy.add_argument("--event-server-port", type=int, default=7070)
+    p_deploy.add_argument("--accesskey", default="")
+    p_deploy.set_defaults(func=cmd_deploy)
+
+    p_undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
+    p_undeploy.add_argument("--ip", default="127.0.0.1")
+    p_undeploy.add_argument("--port", type=int, default=8000)
+    p_undeploy.set_defaults(func=cmd_undeploy)
+
+    # -- eval (ref: Console.scala:279-306) ----------------------------------
+    p_eval = sub.add_parser("eval", help="run an evaluation (parameter sweep)")
+    p_eval.add_argument("evaluation_class",
+                        help="module:attr of an Evaluation (class or instance)")
+    p_eval.add_argument("params_generator_class", nargs="?",
+                        help="module:attr of an EngineParamsGenerator")
+    p_eval.add_argument("--batch", default="")
+    p_eval.set_defaults(func=cmd_eval)
+
     # -- template scaffolding (ref: Console.scala template get) -------------
     p_tpl = sub.add_parser("template", help="manage engine templates")
     tpl_sub = p_tpl.add_subparsers(dest="template_command", required=True)
@@ -202,6 +227,92 @@ def cmd_train(args) -> int:
     )
     instance_id = run_train(engine, engine_params, instance, wp)
     print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    """ref: Console.deploy:835-894 — latest completed instance → server."""
+    import os
+
+    from predictionio_tpu.workflow.create_server import ServerConfig, create_server
+
+    variant = _load_variant(args.engine_json)
+    if variant is None:
+        return 1
+    config = ServerConfig(
+        engine_id=variant.get("id", "default"),
+        engine_version=variant.get("version", "1"),
+        engine_variant=variant.get("id", "default"),
+        engine_dir=os.getcwd(),
+        ip=args.ip,
+        port=args.port,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        accesskey=args.accesskey,
+    )
+    try:
+        server, service = create_server(config)
+    except RuntimeError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    server.start()
+    print(f"[INFO] Engine is deployed and running. Engine API is live at "
+          f"http://{args.ip}:{server.port}.")
+    try:
+        service.wait_for_stop()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    print("[INFO] Engine server shut down.")
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    """ref: Console.undeploy:896-922 — HTTP GET /stop."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            print(f"[INFO] {resp.read().decode()}")
+        return 0
+    except (urllib.error.URLError, OSError) as e:
+        print(f"[ERROR] Undeploy failed: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_eval(args) -> int:
+    """ref: Console.eval:279-306 → CreateWorkflow evaluation branch."""
+    import os
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.core.evaluation import Evaluation
+    from predictionio_tpu.workflow.engine_loader import load_engine_factory
+    from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+    obj = load_engine_factory(args.evaluation_class, os.getcwd())
+    evaluation = obj if isinstance(obj, Evaluation) else (
+        obj() if callable(obj) else obj
+    )
+    if not isinstance(evaluation, Evaluation):
+        print(f"[ERROR] {args.evaluation_class} is not an Evaluation.",
+              file=sys.stderr)
+        return 1
+    if args.params_generator_class:
+        gen = load_engine_factory(args.params_generator_class, os.getcwd())
+        if isinstance(gen, type) or not hasattr(gen, "engine_params_list"):
+            gen = gen()  # class or factory function → instantiate
+        evaluation.engine_params_list = gen.engine_params_list
+    instance_id, result = run_evaluation(
+        evaluation,
+        evaluation_class=args.evaluation_class,
+        params_generator_class=args.params_generator_class or "",
+        params=WorkflowParams(batch=args.batch),
+    )
+    print(f"[INFO] {result.to_one_liner()}")
+    print(f"[INFO] Evaluation completed. Instance ID: {instance_id}")
     return 0
 
 

@@ -1,0 +1,256 @@
+"""Engine (query) server — `pio deploy` (default port 8000).
+
+Re-design of the reference's ``CreateServer``
+(ref: core/.../workflow/CreateServer.scala:112-708): loads the latest
+COMPLETED engine instance's models into memory (HBM for device models),
+answers ``POST /queries.json`` by running supplement → per-algorithm
+predict → serve, posts optional feedback events back to the Event Server,
+and supports hot reload (``/reload``) and shutdown (``/stop``).
+
+Route surface parity:
+  GET  /                → server status (JSON: engine info + bookkeeping)
+  POST /queries.json    → predict (the hot path)
+  GET  /reload          → swap in the latest completed instance
+  GET  /stop            → graceful shutdown (used by `pio undeploy`)
+  GET  /plugins.json    → engine-server plugin inventory
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
+from predictionio_tpu.core.persistent_model import deserialize_models
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.utils.http import AppServer, HTTPError, Request, Router
+from predictionio_tpu.utils.time import format_datetime, now
+from predictionio_tpu.workflow.context import workflow_context
+from predictionio_tpu.workflow.engine_loader import get_engine
+from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PORT = 8000  # ref: CreateServer.scala:88
+
+
+@dataclass
+class ServerConfig:
+    engine_id: str = "default"
+    engine_version: str = "1"
+    engine_variant: str = "default"
+    engine_dir: str | None = None
+    ip: str = "0.0.0.0"
+    port: int = DEFAULT_PORT
+    feedback: bool = False
+    event_server_ip: str = "0.0.0.0"
+    event_server_port: int = 7070
+    accesskey: str = ""
+
+
+def _query_to_obj(query_class: type | None, data: dict):
+    if query_class is None:
+        return data
+    if dataclasses.is_dataclass(query_class):
+        names = {f.name for f in dataclasses.fields(query_class)}
+        unknown = set(data) - names
+        if unknown:
+            raise HTTPError(
+                400, f"Unexpected query field(s) {sorted(unknown)}; "
+                     f"expected a subset of {sorted(names)}"
+            )
+        return query_class(**data)
+    return query_class(**data)
+
+
+def _result_to_json(result):
+    if dataclasses.is_dataclass(result) and not isinstance(result, type):
+        return dataclasses.asdict(result)
+    if isinstance(result, (dict, list, str, int, float, bool)) or result is None:
+        return result
+    return result.__dict__
+
+
+class QueryService:
+    """Holds the deployed engine state; swapped wholesale on /reload
+    (the MasterActor ReloadServer analog, ref: CreateServer.scala:337-358)."""
+
+    def __init__(self, config: ServerConfig):
+        self.config = config
+        self.lock = threading.RLock()
+        self.start_time = now()
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.plugin_context = EngineServerPluginContext()
+        self._stop_event = threading.Event()
+        self._load()
+        self.router = self._build_router()
+
+    # -- model loading (ref: createServerActorWithEngine:206-265) -----------
+    def _load(self) -> None:
+        cfg = self.config
+        instances = Storage.get_meta_data_engine_instances()
+        instance = instances.get_latest_completed(
+            cfg.engine_id, cfg.engine_version, cfg.engine_variant
+        )
+        if instance is None:
+            raise RuntimeError(
+                f"No valid engine instance found for {cfg.engine_id} "
+                f"{cfg.engine_version} {cfg.engine_variant}. Try running "
+                "`pio train` first."
+            )
+        engine = get_engine(instance.engine_factory, cfg.engine_dir)
+        variant = {
+            "datasource": json.loads(instance.data_source_params or "{}"),
+            "preparator": json.loads(instance.preparator_params or "{}"),
+            "algorithms": json.loads(instance.algorithms_params or "[]"),
+            "serving": json.loads(instance.serving_params or "{}"),
+        }
+        engine_params = engine.engine_params_from_json(variant)
+        blob = Storage.get_model_data_models().get(instance.id)
+        if blob is None:
+            raise RuntimeError(f"No model data for instance {instance.id}")
+        persisted = deserialize_models(blob.models)
+        ctx = workflow_context(batch=instance.batch, mode="Serving")
+        models = engine.prepare_deploy(
+            ctx, engine_params, instance.id, persisted, WorkflowParams()
+        )
+        from predictionio_tpu.core.engine import _instantiate
+
+        algo_instances = engine._algorithms(engine_params)
+        serving = _instantiate(engine.serving_class, engine_params.serving_params)
+        with self.lock:
+            self.instance = instance
+            self.engine = engine
+            self.engine_params = engine_params
+            self.models = models
+            self.algorithms = algo_instances
+            self.serving = serving
+        logger.info(
+            "deployed engine instance %s (trained %s)",
+            instance.id, format_datetime(instance.start_time),
+        )
+
+    # -- routes -------------------------------------------------------------
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("GET", "/", self.get_status)
+        r.add("POST", "/queries.json", self.post_query)
+        r.add("GET", "/reload", self.get_reload)
+        r.add("GET", "/stop", self.get_stop)
+        r.add(
+            "GET", "/plugins.json",
+            lambda req: (200, self.plugin_context.to_json()),
+        )
+        return r
+
+    def get_status(self, request: Request):
+        with self.lock:
+            return 200, {
+                "status": "alive",
+                "engineInstanceId": self.instance.id,
+                "engineFactory": self.instance.engine_factory,
+                "startTime": format_datetime(self.start_time),
+                "requestCount": self.request_count,
+                "avgServingSec": round(self.avg_serving_sec, 6),
+                "lastServingSec": round(self.last_serving_sec, 6),
+            }
+
+    def post_query(self, request: Request):
+        """The per-query hot path (ref: ServerActor route:490-641)."""
+        t0 = time.perf_counter()
+        data = request.json()
+        if not isinstance(data, dict):
+            return 400, {"message": "JSON object expected."}
+        with self.lock:
+            algorithms = self.algorithms
+            models = self.models
+            serving = self.serving
+        query_class = algorithms[0].query_class
+        try:
+            query = _query_to_obj(query_class, data)
+        except TypeError as e:
+            return 400, {"message": str(e)}
+        supplemented = serving.supplement(query)
+        predictions = [
+            algo.predict(model, supplemented)
+            for algo, model in zip(algorithms, models)
+        ]
+        prediction = serving.serve(query, predictions)
+        result = _result_to_json(prediction)
+        # output plugins (ref: CreateServer.scala:598-601)
+        for blocker in self.plugin_context.output_blockers.values():
+            result = blocker.process(query, result, self.plugin_context)
+        for sniffer in self.plugin_context.output_sniffers.values():
+            try:
+                sniffer.process(query, result, self.plugin_context)
+            except Exception:
+                logger.exception("output sniffer failed")
+        pr_id = None
+        if self.config.feedback:
+            pr_id = self._send_feedback(data, result)
+            if pr_id is not None and isinstance(result, dict):
+                result = {**result, "prId": pr_id}
+        dt = time.perf_counter() - t0
+        with self.lock:
+            self.request_count += 1
+            self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+            self.last_serving_sec = dt
+        return 200, result
+
+    def _send_feedback(self, query_json: dict, result) -> str | None:
+        """POST the predict event back to the Event Server with prId
+        (ref: ServerActor:534-596)."""
+        cfg = self.config
+        import uuid
+
+        pr_id = uuid.uuid4().hex[:12]
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {"query": query_json, "prediction": result},
+            "eventTime": format_datetime(now()),
+        }
+        url = (
+            f"http://{cfg.event_server_ip}:{cfg.event_server_port}/events.json"
+            f"?accessKey={cfg.accesskey}"
+        )
+        try:
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(event).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            return pr_id
+        except Exception:
+            logger.exception("feedback POST failed")
+            return None
+
+    def get_reload(self, request: Request):
+        """Hot-swap to the latest completed instance (ref: ReloadServer)."""
+        old = self.instance.id
+        self._load()
+        return 200, {"reloaded": True, "previous": old, "current": self.instance.id}
+
+    def get_stop(self, request: Request):
+        self._stop_event.set()
+        return 200, {"message": "Shutting down."}
+
+    def wait_for_stop(self) -> None:
+        self._stop_event.wait()
+
+
+def create_server(config: ServerConfig) -> tuple[AppServer, QueryService]:
+    service = QueryService(config)
+    server = AppServer(service.router, config.ip, config.port)
+    return server, service

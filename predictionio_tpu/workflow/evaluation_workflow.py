@@ -1,0 +1,72 @@
+"""Evaluation workflow: run an Evaluation, persist the EvaluationInstance.
+
+Re-design of the reference's evaluation path
+(ref: workflow/EvaluationWorkflow.scala:31-41,
+workflow/CoreWorkflow.runEvaluation:101-160): insert instance (INIT), run
+batchEval + evaluator, store one-liner/HTML/JSON results, mark
+EVALCOMPLETED."""
+
+from __future__ import annotations
+
+import json
+import logging
+import traceback
+
+from predictionio_tpu.core.engine import WorkflowParams
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import EvaluationInstance
+from predictionio_tpu.utils.time import now
+from predictionio_tpu.workflow.context import workflow_context
+
+logger = logging.getLogger(__name__)
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    evaluation_class: str = "",
+    params_generator_class: str = "",
+    params: WorkflowParams | None = None,
+) -> tuple[str, object]:
+    """Returns (instance_id, MetricEvaluatorResult)."""
+    wp = params or WorkflowParams()
+    instances = Storage.get_meta_data_evaluation_instances()
+    instance_id = instances.insert(
+        EvaluationInstance(
+            status="INIT",
+            start_time=now(),
+            end_time=now(),
+            evaluation_class=evaluation_class,
+            engine_params_generator_class=params_generator_class,
+            batch=wp.batch,
+        )
+    )
+    logger.info("evaluation instance %s: INIT", instance_id)
+    try:
+        ctx = workflow_context(batch=wp.batch, mode="Evaluation")
+        result = evaluation.run(ctx, wp)
+        if not result.no_save:
+            done = EvaluationInstance(
+                **{
+                    **instances.get(instance_id).__dict__,
+                    "status": "EVALCOMPLETED",
+                    "end_time": now(),
+                    "evaluator_results": result.to_one_liner(),
+                    "evaluator_results_html": result.to_html(),
+                    "evaluator_results_json": json.dumps(result.to_json()),
+                }
+            )
+            instances.update(done)
+        logger.info("evaluation instance %s: EVALCOMPLETED", instance_id)
+        return instance_id, result
+    except Exception:
+        logger.error("evaluation failed:\n%s", traceback.format_exc())
+        aborted = EvaluationInstance(
+            **{
+                **instances.get(instance_id).__dict__,
+                "status": "ABORTED",
+                "end_time": now(),
+            }
+        )
+        instances.update(aborted)
+        raise

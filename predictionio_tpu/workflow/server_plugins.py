@@ -1,0 +1,88 @@
+"""Engine-server plugin SPI: output blockers & sniffers.
+
+Mirrors the reference's ``EngineServerPlugin``
+(ref: core/.../workflow/EngineServerPlugin.scala:25-40,
+EngineServerPluginContext.scala ServiceLoader discovery): output blockers
+may transform/veto every response; sniffers observe it. Registration via the
+``predictionio_tpu.engine_server_plugins`` entry-point group or
+:func:`register_plugin`.
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+
+logger = logging.getLogger(__name__)
+
+OUTPUT_BLOCKER = "outputblocker"
+OUTPUT_SNIFFER = "outputsniffer"
+
+
+class EngineServerPlugin(ABC):
+    plugin_name: str = ""
+    plugin_description: str = ""
+    plugin_type: str = OUTPUT_SNIFFER
+
+    @abstractmethod
+    def process(self, query, prediction, context: "EngineServerPluginContext"):
+        """Blockers return the (possibly transformed) prediction; sniffers'
+        return value is ignored."""
+
+    def handle_rest(self, args: list[str]):
+        return {"message": "handleREST not implemented"}
+
+
+_registered: list[EngineServerPlugin] = []
+
+
+def register_plugin(plugin: EngineServerPlugin) -> None:
+    _registered.append(plugin)
+
+
+def clear_plugins() -> None:
+    _registered.clear()
+
+
+class EngineServerPluginContext:
+    def __init__(self, plugins: list[EngineServerPlugin] | None = None):
+        found = list(plugins) if plugins is not None else self._discover()
+        self.output_blockers = {
+            p.plugin_name: p for p in found if p.plugin_type == OUTPUT_BLOCKER
+        }
+        self.output_sniffers = {
+            p.plugin_name: p for p in found if p.plugin_type == OUTPUT_SNIFFER
+        }
+
+    @staticmethod
+    def _discover() -> list[EngineServerPlugin]:
+        plugins = list(_registered)
+        try:
+            from importlib.metadata import entry_points
+
+            for ep in entry_points(group="predictionio_tpu.engine_server_plugins"):
+                try:
+                    plugins.append(ep.load()())
+                except Exception:
+                    logger.exception("failed to load engine server plugin %s", ep.name)
+        except Exception:
+            pass
+        return plugins
+
+    def to_json(self) -> dict:
+        def desc(plugins):
+            return {
+                n: {
+                    "name": p.plugin_name,
+                    "description": p.plugin_description,
+                    "class": type(p).__module__ + "." + type(p).__qualname__,
+                }
+                for n, p in plugins.items()
+            }
+
+        return {
+            "plugins": {
+                "outputblockers": desc(self.output_blockers),
+                "outputsniffers": desc(self.output_sniffers),
+            }
+        }

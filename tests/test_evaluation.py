@@ -1,0 +1,222 @@
+"""Metric + MetricEvaluator + FastEvalEngine tests
+(ref: core/src/test/scala/.../{MetricTest,MetricEvaluatorTest,
+FastEvalEngineTest}.scala)."""
+
+import math
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.core.evaluation import (
+    Evaluation,
+    EngineParamsGenerator,
+    MetricEvaluator,
+)
+from predictionio_tpu.core.fast_eval import FastEvalEngine
+from predictionio_tpu.core.metrics import (
+    AverageMetric,
+    OptionAverageMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.parallel.mesh import compute_context
+
+from sample_engine import (
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    PrepParams,
+    Preparator0,
+    Serving0,
+    ServingParams,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+def fake_eval_data(*fold_scores):
+    """Build eval data where calculate_qpa can recover a number per qpa."""
+    return [
+        (None, [((None), (s), (None)) for s in scores])
+        for scores in fold_scores
+    ]
+
+
+class PMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p)
+
+
+class POptMetric(OptionAverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return None if p < 0 else float(p)
+
+
+class PSum(SumMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p)
+
+
+class PStdev(StdevMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p)
+
+
+class TestMetrics:
+    def test_average_across_folds(self):
+        data = fake_eval_data([1, 2, 3], [5])
+        assert PMetric().calculate(data) == pytest.approx(11 / 4)
+
+    def test_option_average_excludes_none(self):
+        data = fake_eval_data([1, -1, 3], [-1, 5])
+        assert POptMetric().calculate(data) == pytest.approx(3.0)
+
+    def test_sum(self):
+        assert PSum().calculate(fake_eval_data([1, 2], [3])) == 6.0
+
+    def test_stdev(self):
+        data = fake_eval_data([2, 4, 4, 4], [5, 5, 7, 9])
+        assert PStdev().calculate(data) == pytest.approx(2.0)
+
+    def test_zero(self):
+        assert ZeroMetric().calculate(fake_eval_data([9])) == 0.0
+
+    def test_empty_average_is_nan(self):
+        assert math.isnan(PMetric().calculate(fake_eval_data()))
+
+
+class QCountMetric(AverageMetric):
+    """Scores by the algo-params v tag inside predictions: selects the
+    candidate whose algorithm id is largest."""
+
+    def calculate_qpa(self, q, p, a):
+        return float(sum(m.params_v for m in p.models[0].models))
+
+
+def candidates(ids):
+    return [
+        EngineParams(
+            data_source_params=DSParams(id=0),
+            preparator_params=PrepParams(id=0),
+            algorithms_params=(("algo0", AlgoParams(id=i, v=i * 10)),),
+            serving_params=ServingParams(id=0),
+        )
+        for i in ids
+    ]
+
+
+@pytest.fixture
+def engine():
+    return Engine(DataSource0, Preparator0, {"algo0": Algo0}, Serving0)
+
+
+class TestMetricEvaluator:
+    def test_picks_best_candidate(self, ctx, engine, tmp_path):
+        ev = Evaluation(
+            engine=engine,
+            engine_params_list=candidates([1, 3, 2]),
+            metric=QCountMetric(),
+        )
+        ev.output_path = str(tmp_path / "best.json")
+        result = ev.run(ctx)
+        assert result.best_idx == 1
+        assert result.best_engine_params.algorithms_params[0][1].id == 3
+        assert result.best_score.score == 30.0
+        assert len(result.engine_params_scores) == 3
+        # best.json written
+        import json
+
+        best = json.loads((tmp_path / "best.json").read_text())
+        assert best["algorithms"][0]["params"]["id"] == 3
+        # renders
+        assert "QCountMetric" in result.to_one_liner()
+        assert "table" in result.to_html()
+        assert result.to_json()["bestIndex"] == 1
+
+    def test_sign_flips_ordering(self, ctx, engine):
+        class SmallerBetter(QCountMetric):
+            sign = -1
+
+        ev = Evaluation(
+            engine=engine,
+            engine_params_list=candidates([1, 3, 2]),
+            metric=SmallerBetter(),
+        )
+        ev.output_path = None
+        result = ev.run(ctx)
+        assert result.best_engine_params.algorithms_params[0][1].id == 1
+
+    def test_params_generator(self, ctx, engine):
+        class Gen(EngineParamsGenerator):
+            engine_params_list = candidates([4, 2])
+
+        ev = Evaluation(engine=engine, params_generator=Gen(), metric=QCountMetric())
+        ev.output_path = None
+        result = ev.run(ctx)
+        assert result.best_engine_params.algorithms_params[0][1].id == 4
+
+
+class CountingDataSource(DataSource0):
+    reads = 0
+
+    def read_eval(self, ctx):
+        type(self).reads += 1
+        return super().read_eval(ctx)
+
+
+class CountingAlgo(Algo0):
+    trains = 0
+
+    def train(self, ctx, pd):
+        type(self).trains += 1
+        return super().train(ctx, pd)
+
+
+class TestFastEvalEngine:
+    def test_prefix_memoization(self, ctx):
+        CountingDataSource.reads = 0
+        CountingAlgo.trains = 0
+        engine = FastEvalEngine(
+            CountingDataSource, Preparator0, {"algo0": CountingAlgo}, Serving0
+        )
+        # 3 candidates: same datasource params; two share algo params and
+        # differ only in serving params
+        shared_algo = (("algo0", AlgoParams(id=1, v=10)),)
+        eps = [
+            EngineParams(DSParams(0), PrepParams(0), shared_algo,
+                         ServingParams(1)),
+            EngineParams(DSParams(0), PrepParams(0), shared_algo,
+                         ServingParams(2)),
+            EngineParams(DSParams(0), PrepParams(0),
+                         (("algo0", AlgoParams(id=2, v=20)),), ServingParams(1)),
+        ]
+        results = engine.batch_eval(ctx, eps)
+        assert len(results) == 3
+        # datasource read once (shared prefix), trains once per distinct
+        # algo-params set per fold (2 folds × 2 distinct sets = 4)
+        assert CountingDataSource.reads == 1
+        assert CountingAlgo.trains == 4
+        # all candidates still produce full results
+        for ep, folds in results:
+            assert len(folds) == 2
+            for _ei, qpa in folds:
+                assert len(qpa) == 3
+
+    def test_evaluation_uses_fast_engine_batch_eval(self, ctx):
+        CountingDataSource.reads = 0
+        engine = FastEvalEngine(
+            CountingDataSource, Preparator0, {"algo0": CountingAlgo}, Serving0
+        )
+        ev = Evaluation(
+            engine=engine,
+            engine_params_list=candidates([1, 2]),
+            metric=QCountMetric(),
+        )
+        ev.output_path = None
+        ev.run(ctx)
+        assert CountingDataSource.reads == 1

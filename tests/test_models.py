@@ -1,0 +1,166 @@
+"""Algorithm library tests (ref: e2/src/test/scala/.../engine/*Test.scala)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.categorical_nb import (
+    LabeledPoint,
+    train_categorical_nb,
+)
+from predictionio_tpu.models.cross_validation import split_data
+from predictionio_tpu.models.markov_chain import train_markov_chain
+from predictionio_tpu.models.naive_bayes import (
+    predict_naive_bayes,
+    train_naive_bayes,
+)
+from predictionio_tpu.models.vectorizer import BinaryVectorizer
+from predictionio_tpu.parallel.mesh import compute_context
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+class TestNaiveBayes:
+    def test_separable_classes(self, ctx):
+        rng = np.random.default_rng(0)
+        # class 0 heavy on features 0-1, class 1 heavy on 2-3
+        n = 200
+        x0 = rng.poisson([5, 5, 0.5, 0.5], (n, 4))
+        x1 = rng.poisson([0.5, 0.5, 5, 5], (n, 4))
+        x = np.vstack([x0, x1]).astype(np.float32)
+        y = np.array([0.0] * n + [1.0] * n, np.float32)
+        model = train_naive_bayes(ctx, x, y, lambda_=1.0)
+        labels, scores = predict_naive_bayes(
+            model, np.array([[6, 4, 0, 1], [0, 1, 7, 4]], np.float32)
+        )
+        assert labels == [0.0, 1.0]
+        assert scores.shape == (2, 2)
+
+    def test_priors_respected(self, ctx):
+        # same likelihoods, skewed priors → majority class wins on ties
+        x = np.ones((100, 2), np.float32)
+        y = np.array([1.0] * 90 + [2.0] * 10, np.float32)
+        model = train_naive_bayes(ctx, x, y)
+        labels, _ = predict_naive_bayes(model, [1.0, 1.0])
+        assert labels == [1.0]
+
+    def test_negative_features_rejected(self, ctx):
+        with pytest.raises(ValueError):
+            train_naive_bayes(
+                ctx, np.array([[-1.0, 0.0]], np.float32),
+                np.array([0.0], np.float32),
+            )
+
+
+class TestCategoricalNB:
+    """Fixture data mirrors e2 NaiveBayesFixture (sunny/hot/... play tennis)."""
+
+    POINTS = [
+        LabeledPoint("yes", ("overcast", "hot", "normal")),
+        LabeledPoint("yes", ("overcast", "mild", "high")),
+        LabeledPoint("yes", ("rain", "mild", "normal")),
+        LabeledPoint("yes", ("sunny", "cool", "normal")),
+        LabeledPoint("no", ("sunny", "hot", "high")),
+        LabeledPoint("no", ("rain", "cool", "high")),
+        LabeledPoint("no", ("sunny", "mild", "high")),
+    ]
+
+    def test_train_and_score(self):
+        model = train_categorical_nb(self.POINTS)
+        assert set(model.priors) == {"yes", "no"}
+        scores = model.score_all(("sunny", "cool", "normal"))
+        assert scores["yes"] > scores["no"]
+
+    def test_unknown_label_scores_none(self):
+        model = train_categorical_nb(self.POINTS)
+        assert model.log_score(LabeledPoint("maybe", ("sunny", "hot", "high"))) is None
+        known = model.log_score(LabeledPoint("yes", ("sunny", "hot", "normal")))
+        assert known is not None
+
+    def test_unseen_value_defaults_neg_inf(self):
+        model = train_categorical_nb(self.POINTS)
+        s = model.log_score(LabeledPoint("yes", ("typhoon", "hot", "high")))
+        assert s == float("-inf")
+        s2 = model.log_score(
+            LabeledPoint("yes", ("typhoon", "hot", "normal")),
+            default_likelihood=lambda lls: -10.0,
+        )
+        assert s2 is not None and s2 > float("-inf")
+
+    def test_predict(self):
+        model = train_categorical_nb(self.POINTS)
+        assert model.predict(("sunny", "hot", "high")) == "no"
+
+    def test_length_mismatch(self):
+        model = train_categorical_nb(self.POINTS)
+        with pytest.raises(ValueError):
+            model.score_all(("sunny",))
+
+
+class TestMarkovChain:
+    def test_row_normalization_and_topn(self):
+        # state 0 → 1 (3x), → 2 (1x); state 1 → 0 (2x)
+        model = train_markov_chain(
+            np.array([0, 0, 1]), np.array([1, 2, 0]),
+            np.array([3.0, 1.0, 2.0]), n_states=3, top_n=2,
+        )
+        row0 = model.transition_row(0)
+        assert row0[1] == pytest.approx(0.75)
+        assert row0[2] == pytest.approx(0.25)
+        assert model.transition_row(1) == {0: pytest.approx(1.0)}
+        assert model.transition_row(2) == {}
+
+    def test_topn_sparsification(self):
+        # state 0 transitions to 4 states; top_n=2 keeps the best two
+        model = train_markov_chain(
+            np.zeros(4, int), np.arange(1, 5),
+            np.array([4.0, 3.0, 2.0, 1.0]), n_states=5, top_n=2,
+        )
+        row = model.transition_row(0)
+        assert set(row) == {1, 2}
+
+    def test_predict_next(self):
+        model = train_markov_chain(
+            np.array([0, 1]), np.array([1, 2]), np.array([1.0, 1.0]),
+            n_states=3, top_n=2,
+        )
+        nxt = model.predict_next(np.array([1.0, 0.0, 0.0]))
+        assert nxt[1] == pytest.approx(1.0)
+        nxt2 = model.predict_next(nxt)
+        assert nxt2[2] == pytest.approx(1.0)
+
+
+class TestBinaryVectorizer:
+    def test_fit_transform(self):
+        maps = [{"color": "red", "size": "L"}, {"color": "blue", "size": "L"}]
+        vec = BinaryVectorizer.fit(maps, ["color", "size"])
+        assert vec.n_features == 3  # red, blue, L
+        v = vec.transform({"color": "red", "size": "L"})
+        assert v.sum() == 2.0
+        v2 = vec.transform({"color": "green", "size": "M"})
+        assert v2.sum() == 0.0
+        batch = vec.transform_batch(maps)
+        assert batch.shape == (2, 3)
+
+
+class TestCrossValidation:
+    def test_split_shapes(self):
+        data = list(range(100))
+        folds = split_data(
+            4, data,
+            make_training_data=lambda d: ("td", len(d)),
+            make_eval_info=lambda d: ("ei", len(d)),
+            make_query_actual=lambda d: (f"q{d}", f"a{d}"),
+            seed=1,
+        )
+        assert len(folds) == 4
+        total_test = sum(len(qa) for _td, _ei, qa in folds)
+        assert total_test == 100  # every point tested exactly once
+        for td, ei, qa in folds:
+            assert td[1] + len(qa) == 100
+
+    def test_k_too_small(self):
+        with pytest.raises(ValueError):
+            split_data(1, [1], lambda d: d, lambda d: d, lambda d: (d, d))

@@ -1,0 +1,177 @@
+"""Engine (query) server tests over live HTTP: train → deploy → query
+(ref: CreateServer.scala behaviors: predict loop, reload, stop, status)."""
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.engine import WorkflowParams
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import AccessKey, App
+from predictionio_tpu.templates.recommendation import engine_factory
+from predictionio_tpu.workflow.core_workflow import new_engine_instance, run_train
+from predictionio_tpu.workflow.create_server import ServerConfig, create_server
+
+FACTORY = "predictionio_tpu.templates.recommendation:engine_factory"
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def seed_and_train(storage, seed=1, rank=4):
+    apps = storage.get_meta_data_apps()
+    app = apps.get_by_name("qsapp")
+    if app is None:
+        app_id = apps.insert(App(0, "qsapp"))
+        storage.get_events().init(app_id)
+    else:
+        app_id = app.id
+    events = storage.get_events()
+    rng = np.random.default_rng(seed)
+    for ui in range(20):
+        for ii in range(15):
+            if rng.random() < 0.5:
+                events.insert(
+                    Event(
+                        event="rate", entity_type="user", entity_id=f"u{ui}",
+                        target_entity_type="item", target_entity_id=f"i{ii}",
+                        properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                    ),
+                    app_id,
+                )
+    engine = engine_factory()
+    variant = {
+        "engineFactory": FACTORY,
+        "datasource": {"params": {"app_name": "qsapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": rank, "numIterations": 3, "seed": 0}}],
+    }
+    ep = engine.engine_params_from_json(variant)
+    instance = new_engine_instance("default", "1", "default", FACTORY, ep)
+    return run_train(engine, ep, instance, WorkflowParams())
+
+
+@pytest.fixture
+def server(memory_storage):
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    yield {"port": srv.port, "service": service, "storage": memory_storage}
+    srv.stop()
+
+
+def test_deploy_without_train_fails(memory_storage):
+    with pytest.raises(RuntimeError, match="No valid engine instance"):
+        create_server(ServerConfig(ip="127.0.0.1", port=0))
+
+
+def test_status_page(server):
+    status, body = call(server["port"], "GET", "/")
+    assert status == 200
+    assert body["status"] == "alive"
+    assert body["requestCount"] == 0
+    assert body["engineFactory"] == FACTORY
+
+
+def test_query_returns_ranked_items(server):
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"user": "u1", "num": 5})
+    assert status == 200
+    assert len(body["itemScores"]) == 5
+    scores = [s["score"] for s in body["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+    # unknown user → empty itemScores (reference behavior)
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"user": "stranger", "num": 5})
+    assert status == 200
+    assert body["itemScores"] == []
+
+
+def test_query_bookkeeping(server):
+    for _ in range(3):
+        call(server["port"], "POST", "/queries.json", {"user": "u1", "num": 2})
+    status, body = call(server["port"], "GET", "/")
+    assert body["requestCount"] == 3
+    assert body["avgServingSec"] > 0
+
+
+def test_bad_query_field_400(server):
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"usr": "u1"})
+    assert status == 400
+    assert "usr" in body["message"]
+
+
+def test_reload_picks_up_new_instance(server):
+    old_id = server["service"].instance.id
+    new_id = seed_and_train(server["storage"], seed=2)
+    status, body = call(server["port"], "GET", "/reload")
+    assert status == 200
+    assert body["previous"] == old_id
+    assert body["current"] == new_id
+    assert server["service"].instance.id == new_id
+
+
+def test_stop_endpoint_releases_wait(server):
+    service = server["service"]
+    waiter = threading.Thread(target=service.wait_for_stop)
+    waiter.start()
+    status, body = call(server["port"], "GET", "/stop")
+    assert status == 200
+    waiter.join(timeout=5)
+    assert not waiter.is_alive()
+
+
+def test_feedback_loop(memory_storage):
+    """Deploy with feedback → query → predict event lands in event store."""
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+
+    seed_and_train(memory_storage)
+    app_id = memory_storage.get_meta_data_apps().get_by_name("qsapp").id
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ())
+    )
+    es = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    es.start()
+    srv, service = create_server(
+        ServerConfig(
+            ip="127.0.0.1", port=0, feedback=True,
+            event_server_ip="127.0.0.1", event_server_port=es.port,
+            accesskey=key,
+        )
+    )
+    srv.start()
+    try:
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 2})
+        assert status == 200
+        assert "prId" in body
+        fed = list(memory_storage.get_events().find(
+            app_id=app_id, event_names=["predict"]))
+        assert len(fed) == 1
+        assert fed[0].entity_type == "pio_pr"
+        assert fed[0].entity_id == body["prId"]
+        assert fed[0].properties.get("query")["user"] == "u1"
+    finally:
+        srv.stop()
+        es.stop()

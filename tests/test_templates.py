@@ -1,0 +1,288 @@
+"""Template engine tests: classification, similarproduct, ecommerce
+(ref: the reference's quickstart flows for each stock template)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.parallel.mesh import compute_context
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+def make_app(storage, name):
+    app_id = storage.get_meta_data_apps().insert(App(0, name))
+    storage.get_events().init(app_id)
+    return app_id
+
+
+class TestClassification:
+    @pytest.fixture
+    def app(self, memory_storage):
+        app_id = make_app(memory_storage, "clsapp")
+        events = memory_storage.get_events()
+        rng = np.random.default_rng(0)
+        # plan = 1 if attr0 > attr1 else 0 (clearly separable, count features)
+        for i in range(120):
+            a0, a1, a2 = rng.integers(0, 10, 3)
+            plan = 1.0 if a0 > a1 else 0.0
+            events.insert(
+                Event(
+                    event="$set", entity_type="user", entity_id=f"u{i}",
+                    properties=DataMap(
+                        {"attr0": int(a0), "attr1": int(a1), "attr2": int(a2),
+                         "plan": plan}
+                    ),
+                ),
+                app_id,
+            )
+        return memory_storage
+
+    def test_train_and_predict_both_algorithms(self, ctx, app):
+        from predictionio_tpu.templates.classification import (
+            Query,
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        variant = {
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "clsapp"}},
+            "algorithms": [
+                {"name": "naive", "params": {"lambda_": 1.0}},
+                {"name": "logistic", "params": {"epochs": 120}},
+            ],
+        }
+        ep = engine.engine_params_from_json(variant)
+        models = engine.train(ctx, ep)
+        assert len(models) == 2
+        algos = engine._algorithms(ep)
+        for algo, model in zip(algos, models):
+            hi = algo.predict(model, Query(attr0=9, attr1=1, attr2=5))
+            lo = algo.predict(model, Query(attr0=1, attr1=9, attr2=5))
+            assert hi.label == 1.0, f"{type(algo).__name__} failed hi"
+            assert lo.label == 0.0, f"{type(algo).__name__} failed lo"
+
+    def test_evaluation_accuracy(self, ctx, app):
+        from predictionio_tpu.templates.classification import evaluation
+
+        ev = evaluation(app_name="clsapp", eval_k=3, lambdas=(1.0,))
+        ev.output_path = None
+        result = ev.run(ctx)
+        assert result.best_score.score > 0.8
+
+
+def seed_views(storage, app_id, seed=0):
+    """Two item clusters; users view within their cluster."""
+    events = storage.get_events()
+    rng = np.random.default_rng(seed)
+    for u in range(30):
+        cluster = u % 2
+        for _ in range(8):
+            item = rng.integers(0, 10) + cluster * 10
+            events.insert(
+                Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{item}",
+                ),
+                app_id,
+            )
+    for i in range(20):
+        events.insert(
+            Event(
+                event="$set", entity_type="item", entity_id=f"i{i}",
+                properties=DataMap(
+                    {"categories": ["even" if i % 2 == 0 else "odd"]}
+                ),
+            ),
+            app_id,
+        )
+
+
+class TestSimilarProduct:
+    @pytest.fixture
+    def app(self, memory_storage):
+        app_id = make_app(memory_storage, "simapp")
+        seed_views(memory_storage, app_id)
+        events = memory_storage.get_events()
+        # like/dislike events for the multi variant
+        rng = np.random.default_rng(1)
+        for u in range(30):
+            cluster = u % 2
+            item = rng.integers(0, 10) + cluster * 10
+            events.insert(
+                Event(event="like", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{item}"),
+                app_id,
+            )
+        return memory_storage
+
+    def test_similar_items_same_cluster(self, ctx, app):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        variant = {
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "simapp"}},
+            "algorithms": [
+                {"name": "als",
+                 "params": {"rank": 8, "numIterations": 8, "alpha": 5.0,
+                            "seed": 0}},
+            ],
+        }
+        ep = engine.engine_params_from_json(variant)
+        models = engine.train(ctx, ep)
+        algo = engine._algorithms(ep)[0]
+        result = algo.predict(models[0], Query(items=("i1",), num=5))
+        assert len(result.itemScores) == 5
+        assert "i1" not in [s.item for s in result.itemScores]
+        # majority of similar items from the same cluster (items 0-9)
+        same = sum(1 for s in result.itemScores
+                   if int(s.item[1:]) < 10)
+        assert same >= 3
+
+    def test_filters(self, ctx, app):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        variant = {
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "simapp"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 8, "numIterations": 5, "seed": 0}}],
+        }
+        ep = engine.engine_params_from_json(variant)
+        models = engine.train(ctx, ep)
+        algo = engine._algorithms(ep)[0]
+        m = models[0]
+        # whiteList restricts
+        r = algo.predict(m, Query(items=("i1",), num=5,
+                                  whiteList=("i2", "i3")))
+        assert {s.item for s in r.itemScores} <= {"i2", "i3"}
+        # blackList drops
+        r = algo.predict(m, Query(items=("i1",), num=20, blackList=("i2",)))
+        assert "i2" not in {s.item for s in r.itemScores}
+        # categories filter
+        r = algo.predict(m, Query(items=("i1",), num=20, categories=("even",)))
+        assert all(int(s.item[1:]) % 2 == 0 for s in r.itemScores)
+        # unknown query items → empty
+        assert algo.predict(m, Query(items=("zzz",), num=5)).itemScores == ()
+
+    def test_multi_algorithm_serving_combines(self, ctx, app):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        variant = {
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "simapp"}},
+            "algorithms": [
+                {"name": "als",
+                 "params": {"rank": 8, "numIterations": 5, "seed": 0}},
+                {"name": "likealgo",
+                 "params": {"rank": 8, "numIterations": 5, "seed": 0}},
+            ],
+        }
+        ep = engine.engine_params_from_json(variant)
+        results = None
+        models = engine.train(ctx, ep)
+        assert len(models) == 2
+
+
+class TestECommerce:
+    @pytest.fixture
+    def app(self, memory_storage):
+        app_id = make_app(memory_storage, "ecomapp")
+        seed_views(memory_storage, app_id, seed=2)
+        events = memory_storage.get_events()
+        # u0 buys i0
+        events.insert(
+            Event(event="buy", entity_type="user", entity_id="u0",
+                  target_entity_type="item", target_entity_id="i0"),
+            app_id,
+        )
+        return memory_storage
+
+    def engine_and_model(self, ctx, unseen_only=True):
+        from predictionio_tpu.templates.ecommercerecommendation import (
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        variant = {
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "ecomapp"}},
+            "algorithms": [
+                {"name": "ecomm",
+                 "params": {"app_name": "ecomapp", "rank": 8,
+                            "numIterations": 8, "alpha": 5.0, "seed": 0,
+                            "unseen_only": unseen_only}},
+            ],
+        }
+        ep = engine.engine_params_from_json(variant)
+        models = engine.train(ctx, ep)
+        return engine._algorithms(ep)[0], models[0]
+
+    def test_recommends_and_excludes_seen(self, ctx, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        algo, model = self.engine_and_model(ctx)
+        result = algo.predict(model, Query(user="u0", num=5))
+        assert len(result.itemScores) > 0
+        # u0's seen items excluded
+        app_id = app.get_meta_data_apps().get_by_name("ecomapp").id
+        seen = {
+            e.target_entity_id
+            for e in app.get_events().find(
+                app_id=app_id, entity_type="user", entity_id="u0",
+                event_names=["view", "buy"],
+            )
+        }
+        assert not ({s.item for s in result.itemScores} & seen)
+
+    def test_unavailable_items_constraint(self, ctx, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        algo, model = self.engine_and_model(ctx, unseen_only=False)
+        base = algo.predict(model, Query(user="u1", num=3))
+        top_item = base.itemScores[0].item
+        # operator marks the top item unavailable via a $set constraint event
+        app_id = app.get_meta_data_apps().get_by_name("ecomapp").id
+        app.get_events().insert(
+            Event(event="$set", entity_type="constraint",
+                  entity_id="unavailableItems",
+                  properties=DataMap({"items": [top_item]})),
+            app_id,
+        )
+        filtered = algo.predict(model, Query(user="u1", num=3))
+        assert top_item not in {s.item for s in filtered.itemScores}
+
+    def test_cold_start_user_via_recent_views(self, ctx, app):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        algo, model = self.engine_and_model(ctx)
+        # brand-new user with two views ingested AFTER training
+        app_id = app.get_meta_data_apps().get_by_name("ecomapp").id
+        for item in ("i1", "i2"):
+            app.get_events().insert(
+                Event(event="view", entity_type="user", entity_id="newbie",
+                      target_entity_type="item", target_entity_id=item),
+                app_id,
+            )
+        result = algo.predict(model, Query(user="newbie", num=4))
+        assert len(result.itemScores) > 0
+        # a user with no history at all → empty
+        assert algo.predict(model, Query(user="ghost", num=4)).itemScores == ()
