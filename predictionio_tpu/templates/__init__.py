@@ -15,4 +15,5 @@ TEMPLATE_NAMES = (
     "classification",
     "similarproduct",
     "ecommercerecommendation",
+    "twotower",
 )

@@ -1,0 +1,186 @@
+"""Two-tower deep retrieval engine template (BASELINE.json configs[4]).
+
+New engine family with no reference counterpart: trains the two-tower model
+of :mod:`predictionio_tpu.models.two_tower` on view/buy interaction events
+and serves top-N retrieval queries like the recommendation template. The
+DASE surface is identical to the stock templates, so the whole workflow
+(train/deploy/eval CLI, REST serving) applies unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.core import Engine, FirstServing, P2LAlgorithm, PDataSource, PPreparator
+from predictionio_tpu.core.base import SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.als import top_k_scores
+from predictionio_tpu.models.two_tower import (
+    TwoTowerModel,
+    TwoTowerParams,
+    embed_users,
+    train_two_tower,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple[ItemScore, ...] = ()
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "twotower"
+    event_names: tuple[str, ...] = ("view", "buy")
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    users: list[str]
+    items: list[str]
+
+    def sanity_check(self) -> None:
+        if not self.users:
+            raise ValueError("TrainingData is empty; ingest interaction events")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        users, items, _ratings, _names, _ = PEventStore.interaction_arrays(
+            self.params.app_name,
+            event_names=list(self.params.event_names),
+            rating_property=None,
+        )
+        return TrainingData(users, items)
+
+
+@dataclass
+class PreparedData:
+    user_ids: BiMap
+    item_ids: BiMap
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+
+
+class Preparator(PPreparator):
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        user_ids = BiMap.string_int(td.users)
+        item_ids = BiMap.string_int(td.items)
+        return PreparedData(
+            user_ids, item_ids,
+            user_ids.encode(td.users), item_ids.encode(td.items),
+        )
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    embed_dim: int = 64
+    hidden_dims: tuple[int, ...] = (128,)
+    out_dim: int = 32
+    batch_size: int = 1024
+    steps: int = 1000
+    learning_rate: float = 1e-3
+    temperature: float = 0.05
+    seed: int = 0
+
+
+@dataclass
+class RetrievalModel:
+    tt: TwoTowerModel
+    user_ids: BiMap
+    item_ids: BiMap
+
+
+class TwoTowerAlgorithm(P2LAlgorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams):
+        self.params = params
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> RetrievalModel:
+        p = self.params
+        tt = train_two_tower(
+            ctx,
+            pd.user_idx,
+            pd.item_idx,
+            n_users=len(pd.user_ids),
+            n_items=len(pd.item_ids),
+            p=TwoTowerParams(
+                embed_dim=p.embed_dim,
+                hidden_dims=tuple(p.hidden_dims),
+                out_dim=p.out_dim,
+                batch_size=p.batch_size,
+                steps=p.steps,
+                learning_rate=p.learning_rate,
+                temperature=p.temperature,
+                seed=p.seed,
+            ),
+        )
+        return RetrievalModel(tt, pd.user_ids, pd.item_ids)
+
+    def predict(self, model: RetrievalModel, query: Query) -> PredictedResult:
+        uidx = model.user_ids.get(query.user)
+        if uidx is None:
+            return PredictedResult(())
+        q = embed_users(model.tt, np.array([uidx], np.int32))
+        k = min(query.num, len(model.item_ids))
+        scores, idx = top_k_scores(q, model.tt.item_embeddings, k)
+        items = model.item_ids.decode(np.asarray(idx[0]))
+        return PredictedResult(
+            tuple(
+                ItemScore(item, float(s))
+                for item, s in zip(items, np.asarray(scores[0]))
+            )
+        )
+
+
+class Serving(FirstServing):
+    pass
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"twotower": TwoTowerAlgorithm},
+        serving_class=Serving,
+    )
+
+
+ENGINE_JSON = {
+    "id": "default",
+    "description": "Two-tower deep retrieval",
+    "engineFactory": "predictionio_tpu.templates.twotower:engine_factory",
+    "datasource": {"params": {"app_name": "MyApp1"}},
+    "algorithms": [
+        {"name": "twotower",
+         "params": {"embed_dim": 64, "out_dim": 32, "steps": 1000,
+                    "batch_size": 1024, "seed": 0}}
+    ],
+}
