@@ -185,8 +185,6 @@ def train_two_tower(
     p: TwoTowerParams,
     callback=None,
 ) -> TwoTowerModel:
-    import optax
-
     if user_idx.size == 0:
         raise ValueError("train_two_tower called with zero interactions")
     params = init_params(n_users, n_items, p)

@@ -1,0 +1,38 @@
+"""TPU-native operator library (the framework's "kernel zoo").
+
+The reference has no custom compute kernels — all its math lives in external
+Spark MLlib (SURVEY.md §2.1). On TPU the equivalent substrate is this package:
+XLA-program building blocks plus hand-written pallas kernels for the hot ops,
+shared by the model families in :mod:`predictionio_tpu.models`.
+
+Modules:
+  attention       — multi-head attention: XLA reference impl + pallas flash
+                    kernel (blockwise online-softmax, MXU-tiled).
+  ring_attention  — sequence-parallel ring attention over a mesh axis
+                    (ppermute K/V rotation, blockwise combine).
+  collectives     — thin named-axis collective helpers used inside shard_map.
+  topk            — chunked maximum-inner-product search (serving hot path).
+"""
+
+from predictionio_tpu.ops.attention import flash_attention, mha_attention
+from predictionio_tpu.ops.collectives import (
+    all_gather_rows,
+    psum_mean,
+    ring_permute,
+)
+from predictionio_tpu.ops.ring_attention import (
+    ring_attention,
+    ring_self_attention,
+)
+from predictionio_tpu.ops.topk import chunked_topk_scores
+
+__all__ = [
+    "mha_attention",
+    "flash_attention",
+    "ring_attention",
+    "ring_self_attention",
+    "all_gather_rows",
+    "psum_mean",
+    "ring_permute",
+    "chunked_topk_scores",
+]

@@ -1,0 +1,202 @@
+"""Multi-head attention: XLA reference implementation + pallas flash kernel.
+
+The reference framework has no attention anywhere (it predates LLMs,
+SURVEY.md §5 "Long-context"); this module exists because the TPU build makes
+long-context sequence models a first-class model family (the sequential
+recommendation template). Two implementations share one semantics:
+
+  * :func:`mha_attention` — straight XLA einsum + softmax. Differentiable,
+    used for training and as the numerical reference.
+  * :func:`flash_attention` — pallas blockwise kernel (online softmax, never
+    materializes the [Lq, Lk] score matrix in HBM). MXU-tiled; serving path.
+
+The XLA path (:func:`mha_attention`, :func:`_online_block_update`) takes
+``q_offset``/``k_offset`` giving the *global* sequence position of the first
+row of the local block — that is what lets ring attention reuse the same
+masking logic per rotated block. The pallas kernel operates on a full
+(unsharded) sequence and derives positions from its grid indices.
+
+Shapes: q [B, Lq, H, D]; k, v [B, Lk, H, D]; output [B, Lq, H, D].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-negative finite mask value: -inf breaks the online-softmax update when
+# an entire row is masked (exp(-inf - -inf) = nan), see _online_block_update.
+NEG_INF = -1e30
+
+
+def _causal_mask(lq: int, lk: int, q_offset, k_offset):
+    """Boolean [lq, lk] mask, True where attention is allowed: global query
+    position >= global key position."""
+    q_pos = q_offset + jnp.arange(lq)[:, None]
+    k_pos = k_offset + jnp.arange(lk)[None, :]
+    return q_pos >= k_pos
+
+
+def mha_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    q_offset=0,
+    k_offset=0,
+    kv_valid: int | None = None,
+):
+    """Reference attention. ``kv_valid`` masks out key positions >= kv_valid
+    (right-padding of the key/value block)."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    lq, lk = q.shape[1], k.shape[1]
+    mask = jnp.ones((lq, lk), dtype=bool)
+    if causal:
+        mask = _causal_mask(lq, lk, q_offset, k_offset)
+    if kv_valid is not None:
+        mask = mask & (jnp.arange(lk)[None, :] < kv_valid)
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # Rows with no visible key softmax over all-NEG_INF logits → uniform junk;
+    # zero them so fully-masked queries return 0 (matches flash/ring paths).
+    any_visible = mask.any(axis=-1)[None, None, :, None]
+    p = jnp.where(any_visible, p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _online_block_update(q, k, v, num, den, m, *, causal, q_offset, k_offset,
+                         kv_valid=None):
+    """One blockwise online-softmax accumulation step (the flash-attention
+    recurrence), shared by ring attention.
+
+    Carries: num [B, Lq, H, D], den [B, H, Lq], m [B, H, Lq].
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    lq, lk = q.shape[1], k.shape[1]
+    mask = jnp.ones((lq, lk), dtype=bool)
+    if causal:
+        mask = _causal_mask(lq, lk, q_offset, k_offset)
+    if kv_valid is not None:
+        mask = mask & (jnp.arange(lk)[None, :] < kv_valid)
+    mask = mask[None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(mask, p, 0.0)  # kill exp(NEG_INF - NEG_INF) = 1 artifacts
+    corr = jnp.exp(m - m_new)
+    den = den * corr + p.sum(axis=-1)
+    num = num * jnp.moveaxis(corr, 1, 2)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return num, den, m_new
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  blk_q: int, blk_k: int, n_kb: int, causal: bool,
+                  scale: float):
+    """Pallas kernel body. Grid = (B*H, n_qb, n_kb); kv blocks iterate in the
+    last (minor) grid dimension so the VMEM scratch accumulators carry the
+    online-softmax state across kv blocks for a fixed q block."""
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [blk_q, D]
+    k = k_ref[0]  # [blk_k, D]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        qb = pl.program_id(1)
+        q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = q_pos >= k_pos
+        s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_ref[:]          # [blk_q, 1]
+    m_new = jnp.maximum(m_prev[:, 0], s.max(axis=-1))[:, None]
+    p = jnp.exp(s - m_new)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m_prev - m_new)  # [blk_q, 1]
+    l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+        p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+    )
+    m_ref[:] = m_new
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "blk_q", "blk_k", "interpret"),
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    blk_q: int = 128,
+    blk_k: int = 128,
+    interpret: bool = False,
+):
+    """Blockwise flash attention as a pallas TPU kernel.
+
+    Heads fold into the grid's batch dimension; each grid step works on a
+    [blk_q, D] query tile against a [blk_k, D] key tile entirely in VMEM.
+    ``interpret=True`` runs the kernel in interpreter mode (CPU CI).
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    blk_q = min(blk_q, lq)
+    blk_k = min(blk_k, lk)
+    if lq % blk_q or lk % blk_k:
+        raise ValueError(
+            f"sequence lengths ({lq},{lk}) must divide blocks ({blk_q},{blk_k})"
+        )
+    n_qb, n_kb = lq // blk_q, lk // blk_k
+    scale = 1.0 / (d**0.5)
+
+    # [B, L, H, D] → [B*H, L, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, blk_q=blk_q, blk_k=blk_k, n_kb=n_kb, causal=causal,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)

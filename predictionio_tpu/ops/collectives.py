@@ -1,0 +1,34 @@
+"""Named-axis collective helpers used inside ``shard_map`` bodies.
+
+The reference's communication backend is Spark shuffle/treeAggregate/broadcast
+(SURVEY.md §5 "Distributed communication backend"); the TPU build's data plane
+is XLA collectives over ICI. These wrappers exist so model code reads at the
+level of intent (gather negatives, average grads, rotate blocks) rather than
+raw lax calls, and so the axis-name conventions stay in one place.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+
+def all_gather_rows(x, axis_name: str):
+    """Concatenate each device's rows along axis 0 (ICI all-gather).
+    Spark-broadcast / shuffle-read analog for in-batch negative pools."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def psum_mean(x, axis_name: str):
+    """Mean over the named axis (ICI all-reduce) — the treeAggregate analog,
+    used for data-parallel gradient averaging."""
+    return lax.pmean(x, axis_name)
+
+
+def ring_permute(x, axis_name: str, *, reverse: bool = False):
+    """Rotate blocks one hop around the ring (ICI neighbor exchange)."""
+    n = lax.axis_size(axis_name)
+    if reverse:
+        perm = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

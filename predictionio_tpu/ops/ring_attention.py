@@ -1,0 +1,89 @@
+"""Sequence-parallel ring attention over a mesh axis.
+
+Long sequences are sharded across devices on a ``seq`` mesh axis; each device
+holds one contiguous block of Q, K, V. K/V blocks rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchange) while every device accumulates its
+queries' attention over each visiting block with the blockwise online-softmax
+update from :mod:`predictionio_tpu.ops.attention`. After ``n`` steps every
+query has seen every key without any device ever materializing the full
+sequence — HBM per device stays O(L/n).
+
+The reference framework has nothing like this (its only parallelism is RDD
+data-parallelism, SURVEY.md §2.1); this is the TPU build's long-context
+strategy required by the framework's sequence model family.
+
+Differentiable end-to-end: the rotation is a ``lax.scan`` of ``ppermute``
+(both have transpose rules), so one ``jax.grad`` gives the backward ring.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.attention import NEG_INF, _online_block_update
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
+    """Attention over a sequence sharded on ``axis_name``. Must be called
+    inside ``shard_map``; q, k, v are the *local* blocks [B, Lloc, H, D].
+    Returns the local output block [B, Lloc, H, D]."""
+    n = lax.axis_size(axis_name)
+    my_block = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    q_offset = my_block * lq
+
+    # scan carries must enter with the same varying-manual-axes type they
+    # exit with; fresh zeros are unvarying until pvary'd over the mesh axes
+    axes = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else (axis_name,)
+    _vary = lambda x: lax.pcast(x, axes, to="varying")
+    num0 = _vary(jnp.zeros((b, lq, h, d), jnp.float32))
+    den0 = _vary(jnp.zeros((b, h, lq), jnp.float32))
+    m0 = _vary(jnp.full((b, h, lq), NEG_INF, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_cur, v_cur, kb, num, den, m = carry
+        num, den, m = _online_block_update(
+            q, k_cur, v_cur, num, den, m,
+            causal=causal, q_offset=q_offset, k_offset=kb * lk,
+        )
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        # after receiving from the left neighbor, we hold its block
+        kb_next = (kb - 1) % n
+        return (k_next, v_next, kb_next, num, den, m), None
+
+    (_, _, _, num, den, m), _ = lax.scan(
+        step, (k, v, my_block, num0, den0, m0), None, length=n
+    )
+    den = jnp.moveaxis(den, 1, 2)[..., None]  # [B, Lq, H, 1]
+    out = jnp.where(den > 0, num / jnp.maximum(den, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(
+    mesh: Mesh,
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    seq_axis: str = "seq",
+    data_axis: str | None = "data",
+):
+    """Jittable wrapper: shard [B, L, H, D] arrays with batch over
+    ``data_axis`` and sequence over ``seq_axis``, run the ring."""
+    spec = P(data_axis, seq_axis, None, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    shard = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return shard(q, k, v)

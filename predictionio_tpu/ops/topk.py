@@ -1,0 +1,67 @@
+"""Chunked maximum-inner-product search — the serving hot op.
+
+Every recommendation template's predict is "score the whole item catalog
+against a query vector, return top-k" (ref: MLlib's
+``model.recommendProducts``, examples/.../ALSAlgorithm.scala:71). On TPU that
+is one MXU matmul + ``lax.top_k``; for catalogs too large to score in one
+tile, :func:`chunked_topk_scores` scans the catalog in fixed-size chunks and
+merges running top-k — peak memory O(chunk + k) instead of O(n_items), with
+static shapes throughout so XLA keeps everything on-device.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192):
+    """Top-k inner-product item search.
+
+    queries: [B, D]; items: [N, D]. Returns (scores [B, k], indices [B, k]).
+    Items are scanned in ``chunk``-row tiles; each step's top-k merges into
+    the running top-k by concatenation + re-top-k (2k candidates).
+    """
+    n, d = items.shape
+    b = queries.shape[0]
+    k = min(k, n)
+    if n <= chunk:
+        scores = queries @ items.T
+        return lax.top_k(scores, k)
+    k_chunk = min(k, chunk)  # a chunk can contribute at most `chunk` rows
+
+    n_chunks = -(-n // chunk)
+    padded = n_chunks * chunk
+    if padded != n:
+        pad = jnp.full((padded - n, d), 0.0, items.dtype)
+        items = jnp.concatenate([items, pad], axis=0)
+    items_c = items.reshape(n_chunks, chunk, d)
+
+    init_s = jnp.full((b, k), -jnp.inf, queries.dtype)
+    init_i = jnp.full((b, k), -1, jnp.int32)
+
+    def step(carry, inp):
+        best_s, best_i = carry
+        ci, block = inp
+        s = queries @ block.T  # [B, chunk]
+        idx = ci * chunk + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+        valid = idx < n
+        s = jnp.where(valid, s, -jnp.inf)
+        cs, ci_local = lax.top_k(s, k_chunk)
+        cand_s = jnp.concatenate([best_s, cs], axis=1)
+        cand_i = jnp.concatenate(
+            [best_i, jnp.take_along_axis(idx, ci_local, axis=1)], axis=1
+        )
+        ms, mi = lax.top_k(cand_s, k)
+        return (ms, jnp.take_along_axis(cand_i, mi, axis=1)), None
+
+    (best_s, best_i), _ = lax.scan(
+        step,
+        (init_s, init_i),
+        (jnp.arange(n_chunks, dtype=jnp.int32), items_c),
+    )
+    return best_s, best_i

@@ -1,0 +1,146 @@
+"""ops package: attention correctness (XLA vs pallas vs ring), top-k search."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from predictionio_tpu.ops import (
+    chunked_topk_scores,
+    flash_attention,
+    mha_attention,
+    ring_self_attention,
+)
+
+
+def _numpy_attention(q, k, v, causal=False):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((lq, lk), bool), k=lk - lq)
+        s = np.where(mask[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _qkv(b=2, l=32, h=2, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.normal(size=(b, l, h, d)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+class TestMHAAttention:
+    def test_matches_numpy(self):
+        q, k, v = _qkv()
+        out = mha_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(out, _numpy_attention(q, k, v), atol=1e-5)
+
+    def test_causal_matches_numpy(self):
+        q, k, v = _qkv()
+        out = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True
+        )
+        np.testing.assert_allclose(
+            out, _numpy_attention(q, k, v, causal=True), atol=1e-5
+        )
+
+    def test_kv_valid_masks_padding(self):
+        q, k, v = _qkv()
+        out_masked = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), kv_valid=20
+        )
+        ref = _numpy_attention(q[:, :, :, :], k[:, :20], v[:, :20])
+        np.testing.assert_allclose(out_masked, ref, atol=1e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv(b=2, l=64, h=2, d=16)
+        ref = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal
+        )
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=causal, blk_q=16, blk_k=16, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_single_block(self):
+        q, k, v = _qkv(b=1, l=16, h=1, d=8)
+        ref = mha_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+class TestRingAttention:
+    def _mesh(self):
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        return Mesh(devs, ("data", "seq"))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        q, k, v = _qkv(b=2, l=64, h=2, d=8)
+        ref = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal
+        )
+        with self._mesh() as mesh:
+            out = ring_self_attention(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=causal,
+            )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_gradients_match(self):
+        q, k, v = _qkv(b=2, l=32, h=1, d=8)
+        qj, kj, vj = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+        mesh = self._mesh()
+
+        def loss_full(q, k, v):
+            return (mha_attention(q, k, v, causal=True) ** 2).sum()
+
+        def loss_ring(q, k, v):
+            return (
+                ring_self_attention(mesh, q, k, v, causal=True) ** 2
+            ).sum()
+
+        g_full = jax.grad(loss_full, argnums=(0, 1, 2))(qj, kj, vj)
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(qj, kj, vj)
+        for gf, gr in zip(g_full, g_ring):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gf), atol=1e-3, rtol=1e-3
+            )
+
+
+class TestChunkedTopK:
+    def test_matches_full_topk(self):
+        rng = np.random.default_rng(0)
+        queries = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+        items = jnp.asarray(rng.normal(size=(1000, 16)).astype(np.float32))
+        full_s, full_i = jax.lax.top_k(queries @ items.T, 10)
+        s, i = chunked_topk_scores(queries, items, k=10, chunk=128)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(full_s), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(full_i))
+
+    def test_single_chunk_path(self):
+        rng = np.random.default_rng(1)
+        queries = jnp.asarray(rng.normal(size=(2, 8)).astype(np.float32))
+        items = jnp.asarray(rng.normal(size=(50, 8)).astype(np.float32))
+        s, i = chunked_topk_scores(queries, items, k=5, chunk=1024)
+        full_s, full_i = jax.lax.top_k(queries @ items.T, 5)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(full_s), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(full_i))
+
+    def test_k_larger_than_chunk_tail(self):
+        rng = np.random.default_rng(2)
+        queries = jnp.asarray(rng.normal(size=(1, 4)).astype(np.float32))
+        items = jnp.asarray(rng.normal(size=(130, 4)).astype(np.float32))
+        s, i = chunked_topk_scores(queries, items, k=7, chunk=64)
+        full_s, full_i = jax.lax.top_k(queries @ items.T, 7)
+        np.testing.assert_allclose(np.asarray(s), np.asarray(full_s), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(full_i))
