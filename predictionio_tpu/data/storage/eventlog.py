@@ -1,0 +1,513 @@
+"""Append-only binary event-log backend with a native (C++) scan path.
+
+The TPU-native analog of the reference's HBase events backend — its
+highest-throughput event store (ref: data/.../storage/hbase/HBLEvents.scala,
+HBPEvents.scala:82-112, HBEventsUtil.scala:51-303). Design translation:
+
+* HBase table per app/channel (``HBEventsUtil.tableName``, :51)
+  → one log file ``<prefix>events_<app>[_<ch>].piolog`` per app/channel.
+* rowkey = md5(entity)[16B] ++ time ++ uuid enabling server-side entity/time
+  range scans (``RowKey``, :81-128) → per-record FNV-1a entity hash + event
+  time in the fixed header, filtered inside the C++ scanner.
+* region-parallel ``newAPIHadoopRDD`` scan feeding Spark (HBPEvents.scala:82)
+  → :meth:`ELogEvents.interactions`: a single C++ pass that filters, interns
+  entity-id strings to int32 indices and returns columnar numpy arrays ready
+  for the TPU input pipeline (no per-event Python objects at all).
+
+Writes go through Python (ingestion is HTTP-bound, one record per request);
+reads use :mod:`predictionio_tpu.native` when the C++ library is available
+and an identical pure-Python codec otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import datetime as dt
+import json
+import struct
+import threading
+from pathlib import Path
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import StorageError
+
+MAGIC = b"PIOLOG01"
+_NULL16 = 0xFFFF
+_FIXED = struct.Struct("<B3xqqQ8HI")  # flags, times, hash, lens[8], props_len
+_TAG_SEP = "\x1f"
+_EPOCH = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+_I64_MIN = -(2**63)
+_I64_MAX = 2**63 - 1
+
+
+def _to_us(t: dt.datetime) -> int:
+    return round((t - _EPOCH).total_seconds() * 1e6)
+
+
+def _from_us(us: int) -> dt.datetime:
+    return _EPOCH + dt.timedelta(microseconds=us)
+
+
+def entity_hash(entity_type: str, entity_id: str) -> int:
+    """FNV-1a 64 over ``entity_type \\0 entity_id`` — must match the C++
+    scanner's ``fnv1a`` exactly."""
+    h = 14695981039346656037
+    for b in entity_type.encode():
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF  # \0 separator (xor with 0)
+    for b in entity_id.encode():
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def encode_record(event: Event, event_id: str, tombstone: bool = False) -> bytes:
+    """Serialize one event, including the u32 length prefix."""
+    parts: list[bytes] = []
+    lens: list[int] = []
+
+    def put(s: str | None) -> None:
+        if s is None:
+            lens.append(_NULL16)
+        else:
+            b = s.encode()
+            if len(b) >= _NULL16:
+                raise StorageError(f"string field too long ({len(b)} bytes)")
+            lens.append(len(b))
+            parts.append(b)
+
+    put(event_id)
+    put(event.event)
+    put(event.entity_type)
+    put(event.entity_id)
+    put(event.target_entity_type)
+    put(event.target_entity_id)
+    put(event.pr_id)
+    put(_TAG_SEP.join(event.tags) if event.tags else None)
+    props = json.dumps(event.properties.to_dict(), separators=(",", ":")).encode()
+    fixed = _FIXED.pack(
+        1 if tombstone else 0,
+        _to_us(event.event_time),
+        _to_us(event.creation_time),
+        entity_hash(event.entity_type, event.entity_id),
+        *lens,
+        len(props),
+    )
+    payload = fixed + b"".join(parts) + props
+    return struct.pack("<I", len(payload)) + payload
+
+
+def decode_record(buf: bytes, pos: int = 0) -> tuple[Event | None, int, int]:
+    """Parse one record at ``pos``; returns (event, next_pos, flags). Event is
+    None (with next_pos == pos) on truncation — treat as EOF."""
+    if pos + 4 > len(buf):
+        return None, pos, 0
+    (total,) = struct.unpack_from("<I", buf, pos)
+    if total < _FIXED.size or pos + 4 + total > len(buf):
+        return None, pos, 0
+    p = pos + 4
+    vals = _FIXED.unpack_from(buf, p)
+    flags, ev_us, cr_us = vals[0], vals[1], vals[2]
+    lens = vals[4:12]
+    props_len = vals[12]
+    cursor = p + _FIXED.size
+    fields: list[str | None] = []
+    for ln in lens:
+        if ln == _NULL16:
+            fields.append(None)
+        else:
+            fields.append(buf[cursor : cursor + ln].decode())
+            cursor += ln
+    props = json.loads(buf[cursor : cursor + props_len].decode())
+    event_id, name, etype, eid, tetype, teid, pr_id, tags = fields
+    event = Event(
+        event=name,
+        entity_type=etype,
+        entity_id=eid,
+        target_entity_type=tetype,
+        target_entity_id=teid,
+        properties=DataMap(props),
+        event_time=_from_us(ev_us),
+        tags=tuple(tags.split(_TAG_SEP)) if tags else (),
+        pr_id=pr_id,
+        event_id=event_id,
+        creation_time=_from_us(cr_us),
+    )
+    return event, pos + 4 + total, flags
+
+
+def intern_interactions(
+    events: "Iterator[Event]",
+    event_names: Sequence[str],
+    rating_key: str | None,
+    default_rating: float,
+) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Shared Python interning pass over an event iterator — the fallback
+    mirror of the C++ columnar scan (must keep identical semantics)."""
+    users: dict[str, int] = {}
+    items: dict[str, int] = {}
+    ui: list[int] = []
+    ii: list[int] = []
+    rr: list[float] = []
+    ni: list[int] = []
+    name_to_idx = {n: k for k, n in enumerate(event_names)}
+    for ev in events:
+        if ev.event not in name_to_idx or ev.target_entity_id is None:
+            continue
+        ui.append(users.setdefault(ev.entity_id, len(users)))
+        ii.append(items.setdefault(ev.target_entity_id, len(items)))
+        ni.append(name_to_idx[ev.event])
+        v = default_rating
+        if rating_key is not None:
+            raw = ev.properties.get_opt(rating_key)
+            if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+                v = float(raw)
+        rr.append(v)
+    return (
+        list(users), list(items),
+        np.asarray(ui, dtype=np.int32), np.asarray(ii, dtype=np.int32),
+        np.asarray(rr, dtype=np.float32), np.asarray(ni, dtype=np.int32),
+    )
+
+
+def _names_blob(names: Sequence[str]) -> bytes:
+    out = bytearray()
+    for n in names:
+        b = n.encode()
+        out += struct.pack("<H", len(b)) + b
+    return bytes(out)
+
+
+class ELogClient:
+    """One directory of per-app/channel log files."""
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        from predictionio_tpu.data.storage.registry import _default_base_dir
+
+        path = config.get("PATH") or str(Path(_default_base_dir()) / "eventlog")
+        self.base_dir = Path(path)
+        self.base_dir.mkdir(parents=True, exist_ok=True)
+        self.lock = threading.RLock()
+
+    def close(self) -> None:
+        pass
+
+
+class ELogEvents(base.Events):
+    """Events DAO over the binary log (ref contract: LEvents.scala:36-488)."""
+
+    def __init__(self, client: ELogClient, prefix: str = ""):
+        self._c = client
+        self._prefix = prefix
+
+    def _path(self, app_id: int, channel_id: int | None) -> Path:
+        suffix = f"_{channel_id}" if channel_id is not None else ""
+        return self._c.base_dir / f"{self._prefix}events_{app_id}{suffix}.piolog"
+
+    @staticmethod
+    def _lib():
+        from predictionio_tpu.native import eventlog_lib
+
+        return eventlog_lib()
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        path = self._path(app_id, channel_id)
+        with self._c.lock:
+            if not path.exists():
+                path.write_bytes(MAGIC)
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        path = self._path(app_id, channel_id)
+        with self._c.lock:
+            if not path.exists():
+                return False
+            path.unlink()
+        return True
+
+    def close(self) -> None:
+        pass
+
+    def _require(self, app_id: int, channel_id: int | None) -> Path:
+        path = self._path(app_id, channel_id)
+        if not path.exists():
+            raise StorageError(
+                f"Event store for app {app_id} channel {channel_id} is not "
+                "initialized; run `pio app new` first."
+            )
+        return path
+
+    # -- writes (Python; appends are atomic under the client lock) ----------
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        path = self._require(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        with self._c.lock:
+            if event.event_id is not None:
+                self._tombstone(path, event.event_id)  # upsert semantics
+            with path.open("ab") as f:
+                f.write(encode_record(event, eid))
+                f.flush()
+        return eid
+
+    def _find_offset(self, path: Path, event_id: str) -> int:
+        lib = self._lib()
+        if lib is not None:
+            return lib.pio_eventlog_find_offset(
+                str(path).encode(), event_id.encode()
+            )
+        buf = path.read_bytes()
+        pos = len(MAGIC)
+        while True:
+            ev, next_pos, flags = decode_record(buf, pos)
+            if ev is None:
+                return -1
+            if not (flags & 1) and ev.event_id == event_id:
+                return pos
+            pos = next_pos
+
+    def _tombstone(self, path: Path, event_id: str) -> bool:
+        off = self._find_offset(path, event_id)
+        if off < 0:
+            return False
+        with path.open("r+b") as f:
+            f.seek(off + 4)
+            flags = f.read(1)[0]
+            f.seek(off + 4)
+            f.write(bytes([flags | 1]))
+        return True
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        path = self._require(app_id, channel_id)
+        off = self._find_offset(path, event_id)
+        if off < 0:
+            return None
+        with path.open("rb") as f:
+            f.seek(off)
+            head = f.read(4)
+            (total,) = struct.unpack("<I", head)
+            buf = head + f.read(total)
+        ev, _, _ = decode_record(buf, 0)
+        return ev
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        path = self._require(app_id, channel_id)
+        with self._c.lock:
+            return self._tombstone(path, event_id)
+
+    # -- reads --------------------------------------------------------------
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        path = self._require(app_id, channel_id)  # eager, before iteration
+        start_us = _to_us(start_time) if start_time is not None else _I64_MIN
+        until_us = _to_us(until_time) if until_time is not None else _I64_MAX
+        cap = -1 if limit is None or limit < 0 else limit
+        lib = self._lib()
+        if lib is not None:
+            return self._find_native(
+                lib, path, start_us, until_us, entity_type, entity_id,
+                event_names, target_entity_type, target_entity_id, cap,
+                reversed_,
+            )
+        return self._find_python(
+            path, start_us, until_us, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, cap, reversed_,
+        )
+
+    def _find_native(
+        self, lib, path, start_us, until_us, entity_type, entity_id,
+        event_names, target_entity_type, target_entity_id, cap, reversed_,
+    ) -> Iterator[Event]:
+        tt_mode, tt_val = self._target_mode(target_entity_type)
+        ti_mode, ti_val = self._target_mode(target_entity_id)
+        names = _names_blob(event_names) if event_names else None
+        out_buf = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        out_count = ctypes.c_int64()
+        rc = lib.pio_eventlog_scan(
+            str(path).encode(), start_us, until_us,
+            entity_type.encode() if entity_type else None,
+            entity_id.encode() if entity_id else None,
+            names, len(event_names or ()),
+            tt_mode, tt_val, ti_mode, ti_val,
+            cap, 1 if reversed_ else 0,
+            ctypes.byref(out_buf), ctypes.byref(out_len),
+            ctypes.byref(out_count),
+        )
+        if rc != 0:
+            raise StorageError(f"native scan failed for {path}")
+        try:
+            buf = ctypes.string_at(out_buf, out_len.value)
+        finally:
+            lib.pio_free(out_buf)
+        pos = 0
+        for _ in range(out_count.value):
+            ev, pos, _flags = decode_record(buf, pos)
+            if ev is None:
+                break
+            yield ev
+
+    def _find_python(
+        self, path, start_us, until_us, entity_type, entity_id, event_names,
+        target_entity_type, target_entity_id, cap, reversed_,
+    ) -> Iterator[Event]:
+        buf = path.read_bytes()
+        names = set(event_names) if event_names else None
+        matches: list[tuple[int, int, Event]] = []
+        pos = len(MAGIC)
+        order = 0
+        while True:
+            ev, next_pos, flags = decode_record(buf, pos)
+            if ev is None:
+                break
+            pos = next_pos
+            if flags & 1:
+                continue
+            us = _to_us(ev.event_time)
+            if not (start_us <= us < until_us):
+                continue
+            if entity_type is not None and ev.entity_type != entity_type:
+                continue
+            if entity_id is not None and ev.entity_id != entity_id:
+                continue
+            if names is not None and ev.event not in names:
+                continue
+            if target_entity_type is not ... and ev.target_entity_type != target_entity_type:
+                continue
+            if target_entity_id is not ... and ev.target_entity_id != target_entity_id:
+                continue
+            matches.append((us, order, ev))
+            order += 1
+        matches.sort(key=lambda m: (m[0], m[1]), reverse=reversed_)
+        if cap >= 0:
+            matches = matches[:cap]
+        for _, _, ev in matches:
+            yield ev
+
+    @staticmethod
+    def _target_mode(value) -> tuple[int, bytes | None]:
+        if value is ...:
+            return 0, None
+        if value is None:
+            return 1, None
+        return 2, str(value).encode()
+
+    # -- columnar fast path (feeds the TPU input pipeline) ------------------
+    def interactions(
+        self,
+        app_id: int,
+        channel_id: int | None,
+        event_names: Sequence[str],
+        rating_key: str | None = "rating",
+        default_rating: float = 1.0,
+    ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Decode (entity → target) events into columnar arrays in one native
+        pass: returns (user_ids, item_ids, user_idx[i32], item_idx[i32],
+        ratings[f32], name_idx[i32]) where ``user_ids[user_idx[k]]`` is row
+        k's entity id and ``event_names[name_idx[k]]`` its event name.
+        Falls back to a Python pass without the C++ library."""
+        if not event_names:
+            raise ValueError("interactions requires at least one event name")
+        path = self._require(app_id, channel_id)
+        lib = self._lib()
+        if lib is None:
+            return self._interactions_python(
+                path, event_names, rating_key, default_rating
+            )
+        c = ctypes
+        n = c.c_int64()
+        user_idx = c.c_void_p(); item_idx = c.c_void_p()
+        rating = c.c_void_p(); name_idx = c.c_void_p(); time_us = c.c_void_p()
+        n_users = c.c_int64(); users_blob = c.c_void_p(); users_len = c.c_int64()
+        n_items = c.c_int64(); items_blob = c.c_void_p(); items_len = c.c_int64()
+        # The stored properties JSON comes from json.dumps (ensure_ascii),
+        # so the key bytes the C++ scanner sees are JSON-escaped; escape the
+        # lookup key the same way for byte-exact comparison.
+        rating_key_bytes = (
+            json.dumps(rating_key)[1:-1].encode() if rating_key else None
+        )
+        rc = lib.pio_eventlog_interactions(
+            str(path).encode(), _names_blob(event_names), len(event_names),
+            rating_key_bytes,
+            c.c_float(default_rating),
+            c.byref(n), c.byref(user_idx), c.byref(item_idx), c.byref(rating),
+            c.byref(name_idx), c.byref(time_us),
+            c.byref(n_users), c.byref(users_blob), c.byref(users_len),
+            c.byref(n_items), c.byref(items_blob), c.byref(items_len),
+        )
+        if rc != 0:
+            raise StorageError(f"native interactions scan failed for {path}")
+        try:
+            rows = n.value
+            ui = np.frombuffer(
+                ctypes.string_at(user_idx, rows * 4), dtype=np.int32
+            ).copy()
+            ii = np.frombuffer(
+                ctypes.string_at(item_idx, rows * 4), dtype=np.int32
+            ).copy()
+            rr = np.frombuffer(
+                ctypes.string_at(rating, rows * 4), dtype=np.float32
+            ).copy()
+            ni = np.frombuffer(
+                ctypes.string_at(name_idx, rows * 4), dtype=np.int32
+            ).copy()
+            users = self._decode_blob(
+                ctypes.string_at(users_blob, users_len.value), n_users.value
+            )
+            items = self._decode_blob(
+                ctypes.string_at(items_blob, items_len.value), n_items.value
+            )
+        finally:
+            for p in (user_idx, item_idx, rating, name_idx, time_us,
+                      users_blob, items_blob):
+                lib.pio_free(p)
+        return users, items, ui, ii, rr, ni
+
+    @staticmethod
+    def _decode_blob(blob: bytes, count: int) -> list[str]:
+        out: list[str] = []
+        pos = 0
+        for _ in range(count):
+            (ln,) = struct.unpack_from("<H", blob, pos)
+            out.append(blob[pos + 2 : pos + 2 + ln].decode())
+            pos += 2 + ln
+        return out
+
+    def _interactions_python(
+        self, path, event_names, rating_key, default_rating
+    ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        def live_events():
+            buf = path.read_bytes()
+            pos = len(MAGIC)
+            while True:
+                ev, next_pos, flags = decode_record(buf, pos)
+                if ev is None:
+                    return
+                pos = next_pos
+                if not (flags & 1):
+                    yield ev
+
+        return intern_interactions(
+            live_events(), event_names, rating_key, default_rating
+        )

@@ -36,6 +36,8 @@ BACKEND_TYPES = {
     "sqlite": ("predictionio_tpu.data.storage.sql", "SQL"),
     "memory": ("predictionio_tpu.data.storage.memory", "Mem"),
     "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
+    # binary event log with native C++ scan path (the HBase-analog backend)
+    "eventlog": ("predictionio_tpu.data.storage.eventlog", "ELog"),
 }
 
 _REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

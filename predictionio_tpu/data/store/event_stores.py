@@ -84,6 +84,41 @@ class PEventStore:
         )
 
     @staticmethod
+    def interaction_indices(
+        app_name: str,
+        event_names: Sequence[str],
+        channel_name: str | None = None,
+        rating_property: str | None = "rating",
+        default_rating: float = 1.0,
+    ) -> tuple[list[str], list[str], np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Interned columnar decode of (entity → target) interaction events —
+        the TPU input-pipeline fast path: returns (user_ids, item_ids,
+        user_idx[i32], item_idx[i32], ratings[f32], name_idx[i32]) with
+        ``user_ids[user_idx[k]]`` row k's entity id. On the eventlog backend
+        this is a single native C++ pass (scan + filter + string-interning,
+        no per-event Python objects); other backends fall back to an
+        event-iterator pass with the same result."""
+        if not event_names:
+            raise ValueError(
+                "interaction_indices requires at least one event name"
+            )
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        backend = Storage.get_events()
+        if hasattr(backend, "interactions"):
+            return backend.interactions(
+                app_id, channel_id, list(event_names),
+                rating_key=rating_property, default_rating=default_rating,
+            )
+        from predictionio_tpu.data.storage.eventlog import intern_interactions
+
+        return intern_interactions(
+            backend.find(
+                app_id=app_id, channel_id=channel_id, event_names=event_names
+            ),
+            event_names, rating_property, default_rating,
+        )
+
+    @staticmethod
     def interaction_arrays(
         app_name: str,
         event_names: Sequence[str],
@@ -91,29 +126,17 @@ class PEventStore:
         rating_property: str | None = "rating",
         default_rating: float = 1.0,
     ) -> tuple[list[str], list[str], np.ndarray, list[str], list[str]]:
-        """Columnar decode of (entity → target) interaction events for the
-        TPU input pipeline: returns (user_ids, item_ids, ratings,
-        event_names_per_row, pr_ids). This is the framework-native fast path
-        the reference implements per-template by mapping over RDD[Event]."""
-        users: list[str] = []
-        items: list[str] = []
-        ratings: list[float] = []
-        names: list[str] = []
-        for e in PEventStore.find(
-            app_name, channel_name=channel_name, event_names=event_names
-        ):
-            if e.target_entity_id is None:
-                continue
-            users.append(e.entity_id)
-            items.append(e.target_entity_id)
-            names.append(e.event)
-            if rating_property is not None:
-                ratings.append(
-                    float(e.properties.get_or_else(rating_property, default_rating))
-                )
-            else:
-                ratings.append(default_rating)
-        return users, items, np.asarray(ratings, dtype=np.float32), names, []
+        """Row-aligned string view over :meth:`interaction_indices`:
+        (user_ids, item_ids, ratings, event_names_per_row, pr_ids). The
+        reference implements this per-template by mapping over RDD[Event]."""
+        table_u, table_i, ui, ii, rr, ni = PEventStore.interaction_indices(
+            app_name, event_names, channel_name=channel_name,
+            rating_property=rating_property, default_rating=default_rating,
+        )
+        users = [table_u[k] for k in ui]
+        items = [table_i[k] for k in ii]
+        names = [event_names[k] for k in ni]
+        return users, items, rr, names, []
 
 
 class LEventStore:

@@ -1,0 +1,108 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's performance-critical host-side layer is JVM-native (HBase
+scan path, Spark shuffle machinery); here the analog is a small C++ library
+compiled on first use with the system toolchain. Everything degrades
+gracefully: callers check :func:`eventlog_lib` for ``None`` and fall back to
+pure-Python implementations, so the framework works without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "eventlog.cc"
+_SO = _HERE / "_eventlog.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> bool:
+    """(Re)build the shared library when the source is newer. Returns True
+    when a loadable .so exists afterwards."""
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return True
+    cxx = os.environ.get("CXX", "g++")
+    tmp = _SO.with_suffix(f".so.tmp{os.getpid()}")
+    cmd = [
+        cxx, "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", str(tmp), str(_SRC),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+        os.replace(tmp, _SO)  # atomic vs concurrent builders
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        logger.warning("native eventlog build failed, using Python path: %s",
+                       detail.strip()[:500])
+        tmp.unlink(missing_ok=True)
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.pio_free.argtypes = [c.c_void_p]
+    lib.pio_free.restype = None
+    lib.pio_eventlog_scan.argtypes = [
+        c.c_char_p, c.c_int64, c.c_int64,           # path, start_us, until_us
+        c.c_char_p, c.c_char_p,                     # entity_type, entity_id
+        c.c_char_p, c.c_int32,                      # names blob, n_names
+        c.c_int32, c.c_char_p,                      # target_type mode, value
+        c.c_int32, c.c_char_p,                      # target_id mode, value
+        c.c_int64, c.c_int32,                       # limit, reversed
+        c.POINTER(c.c_void_p), c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+    ]
+    lib.pio_eventlog_scan.restype = c.c_int32
+    lib.pio_eventlog_find_offset.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pio_eventlog_find_offset.restype = c.c_int64
+    lib.pio_eventlog_interactions.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_int32,          # path, names blob, n
+        c.c_char_p, c.c_float,                      # rating key, default
+        c.POINTER(c.c_int64),                       # out n
+        c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),  # user_idx, item_idx
+        c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),  # rating, name_idx
+        c.POINTER(c.c_void_p),                      # time_us
+        c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+        c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+    ]
+    lib.pio_eventlog_interactions.restype = c.c_int32
+    return lib
+
+
+def eventlog_lib() -> ctypes.CDLL | None:
+    """The compiled event-log library, building it on first call; ``None``
+    when no C++ toolchain is available (pure-Python fallback engages)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PIO_DISABLE_NATIVE"):
+            return None
+        if _compile():
+            try:
+                _lib = _bind(ctypes.CDLL(str(_SO)))
+            except OSError as e:  # pragma: no cover - load failure
+                logger.warning("native eventlog load failed: %s", e)
+        return _lib
+
+
+def reset_for_tests() -> None:
+    global _lib, _tried
+    with _lock:
+        _lib = None
+        _tried = False

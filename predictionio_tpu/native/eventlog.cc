@@ -1,0 +1,490 @@
+// Native event-log scan/decode library.
+//
+// TPU-native replacement for the role the reference's HBase scan path plays
+// (ref: data/.../storage/hbase/HBEventsUtil.scala:51-303, HBPEvents.scala:82-112):
+// the performance-critical bulk-read side of the event store. One append-only
+// binary log file per (app, channel) — the analog of the reference's
+// HBase table per app/channel (HBEventsUtil.scala:51) — scanned and filtered
+// here in C++, with two read paths:
+//
+//   pio_eventlog_scan          filtered scan -> time-ordered raw records
+//                              (the LEvents.find contract)
+//   pio_eventlog_interactions  filtered scan -> columnar int32/float32
+//                              arrays with interned entity-id string tables,
+//                              the zero-Python fast path that feeds ratings
+//                              matrices straight into the TPU input pipeline
+//                              (replaces the reference's per-template
+//                              RDD[Event] -> MLlib Rating map)
+//
+// Record layout (little-endian), after a u32 total-length prefix:
+//   off  0: u8  flags          bit0 = tombstone
+//   off  1: u8  pad[3]
+//   off  4: i64 event_time_us  microseconds since epoch (UTC)
+//   off 12: i64 creation_time_us
+//   off 20: u64 entity_hash    FNV-1a 64 of entity_type \0 entity_id
+//   off 28: u16 lens[8]        event_id, event, entity_type, entity_id,
+//                              target_entity_type, target_entity_id,
+//                              pr_id, tags      (0xFFFF = null)
+//   off 44: u32 props_len
+//   off 48: payload bytes, strings back-to-back in lens[] order, then props
+//
+// The file begins with the 8-byte magic "PIOLOG01". Appends are done by the
+// Python writer (insert is HTTP-bound); a truncated trailing record (reader
+// racing an append) is treated as end-of-file.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kFixedSize = 48;
+constexpr uint16_t kNull16 = 0xFFFF;
+constexpr char kMagic[8] = {'P', 'I', 'O', 'L', 'O', 'G', '0', '1'};
+
+struct Record {
+  const uint8_t* base;  // points at the u32 length prefix
+  uint32_t total_len;   // payload length (bytes after the u32)
+  int64_t event_time_us;
+  const char* event;
+  uint32_t event_len;
+  const char* entity_type;
+  uint32_t entity_type_len;
+  const char* entity_id;
+  uint32_t entity_id_len;
+  const char* target_entity_type;  // nullptr when null
+  uint32_t target_entity_type_len;
+  const char* target_entity_id;
+  uint32_t target_entity_id_len;
+  const char* props;
+  uint32_t props_len;
+  const char* event_id;
+  uint32_t event_id_len;
+  uint64_t entity_hash;
+  uint8_t flags;
+};
+
+inline uint16_t rd16(const uint8_t* p) {
+  uint16_t v;
+  std::memcpy(&v, p, 2);
+  return v;
+}
+inline uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+inline int64_t rd64i(const uint8_t* p) {
+  int64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+inline uint64_t rd64u(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+bool read_file(const char* path, std::vector<uint8_t>& out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    std::fclose(f);
+    return false;
+  }
+  out.resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(out.data(), 1, out.size(), f) : 0;
+  std::fclose(f);
+  out.resize(got);
+  if (out.size() < sizeof(kMagic)) return false;
+  return std::memcmp(out.data(), kMagic, sizeof(kMagic)) == 0;
+}
+
+// Parse one record at `pos`; returns false on truncation/corruption (EOF).
+bool parse_record(const std::vector<uint8_t>& buf, size_t pos, Record* r,
+                  size_t* next) {
+  if (pos + 4 > buf.size()) return false;
+  uint32_t total = rd32(&buf[pos]);
+  if (total < kFixedSize || pos + 4 + total > buf.size()) return false;
+  const uint8_t* p = &buf[pos + 4];
+  r->base = &buf[pos];
+  r->total_len = total;
+  r->flags = p[0];
+  r->event_time_us = rd64i(p + 4);
+  r->entity_hash = rd64u(p + 20);
+  uint16_t lens[8];
+  for (int i = 0; i < 8; i++) lens[i] = rd16(p + 28 + 2 * i);
+  uint32_t props_len = rd32(p + 44);
+  const char* cursor = reinterpret_cast<const char*>(p + kFixedSize);
+  const char* end = reinterpret_cast<const char*>(p + total);
+  auto take = [&](uint16_t len, const char** s, uint32_t* out_len) -> bool {
+    if (len == kNull16) {
+      *s = nullptr;
+      *out_len = 0;
+      return true;
+    }
+    if (cursor + len > end) return false;
+    *s = cursor;
+    *out_len = len;
+    cursor += len;
+    return true;
+  };
+  const char* tags;
+  uint32_t tags_len;
+  const char* pr_id;
+  uint32_t pr_id_len;
+  if (!take(lens[0], &r->event_id, &r->event_id_len)) return false;
+  if (!take(lens[1], &r->event, &r->event_len)) return false;
+  if (!take(lens[2], &r->entity_type, &r->entity_type_len)) return false;
+  if (!take(lens[3], &r->entity_id, &r->entity_id_len)) return false;
+  if (!take(lens[4], &r->target_entity_type, &r->target_entity_type_len))
+    return false;
+  if (!take(lens[5], &r->target_entity_id, &r->target_entity_id_len))
+    return false;
+  if (!take(lens[6], &pr_id, &pr_id_len)) return false;
+  if (!take(lens[7], &tags, &tags_len)) return false;
+  if (cursor + props_len > end) return false;
+  r->props = cursor;
+  r->props_len = props_len;
+  *next = pos + 4 + total;
+  return true;
+}
+
+struct NameFilter {
+  // Event-name allowlist, decoded from a [u16 len][bytes]... blob.
+  std::vector<std::pair<const char*, uint32_t>> names;
+
+  void init(const uint8_t* blob, int32_t n) {
+    const uint8_t* p = blob;
+    for (int32_t i = 0; i < n; i++) {
+      uint16_t len = rd16(p);
+      names.emplace_back(reinterpret_cast<const char*>(p + 2), len);
+      p += 2 + len;
+    }
+  }
+  // Returns the index of the matching name, or -1.
+  int32_t match(const char* s, uint32_t len) const {
+    if (names.empty()) return 0;
+    for (size_t i = 0; i < names.size(); i++) {
+      if (names[i].second == len && std::memcmp(names[i].first, s, len) == 0)
+        return static_cast<int32_t>(i);
+    }
+    return -1;
+  }
+  bool active() const { return !names.empty(); }
+};
+
+inline bool str_eq(const char* s, uint32_t len, const char* c_str) {
+  size_t cl = std::strlen(c_str);
+  return cl == len && std::memcmp(s, c_str, len) == 0;
+}
+
+uint64_t fnv1a(const char* type, uint32_t type_len, const char* id,
+               uint32_t id_len) {
+  uint64_t h = 14695981039346656037ULL;
+  auto mix = [&](const char* s, uint32_t n) {
+    for (uint32_t i = 0; i < n; i++) {
+      h ^= static_cast<uint8_t>(s[i]);
+      h *= 1099511628211ULL;
+    }
+  };
+  mix(type, type_len);
+  h ^= 0;
+  h *= 1099511628211ULL;
+  mix(id, id_len);
+  return h;
+}
+
+// Skip one JSON value starting at *p (within [p, end)); returns false on
+// malformed input. Used by the top-level numeric-key extractor below.
+bool skip_ws(const char** p, const char* end) {
+  while (*p < end && (**p == ' ' || **p == '\t' || **p == '\n' || **p == '\r'))
+    (*p)++;
+  return *p < end;
+}
+
+bool skip_string(const char** p, const char* end) {
+  if (*p >= end || **p != '"') return false;
+  (*p)++;
+  while (*p < end) {
+    char c = **p;
+    if (c == '\\') {
+      (*p) += 2;
+      continue;
+    }
+    (*p)++;
+    if (c == '"') return true;
+  }
+  return false;
+}
+
+bool skip_value(const char** p, const char* end) {
+  if (!skip_ws(p, end)) return false;
+  char c = **p;
+  if (c == '"') return skip_string(p, end);
+  if (c == '{' || c == '[') {
+    char open = c;
+    char close = (c == '{') ? '}' : ']';
+    int depth = 0;
+    while (*p < end) {
+      char d = **p;
+      if (d == '"') {
+        if (!skip_string(p, end)) return false;
+        continue;
+      }
+      if (d == open) depth++;
+      if (d == close) depth--;
+      (*p)++;
+      if (depth == 0) return true;
+    }
+    return false;
+  }
+  // number / true / false / null
+  while (*p < end && **p != ',' && **p != '}' && **p != ']') (*p)++;
+  return true;
+}
+
+// Extract a top-level numeric key from a JSON object; true when found.
+bool json_top_level_number(const char* s, uint32_t len, const char* key,
+                           double* out) {
+  const char* p = s;
+  const char* end = s + len;
+  size_t key_len = std::strlen(key);
+  if (!skip_ws(&p, end) || *p != '{') return false;
+  p++;
+  while (true) {
+    if (!skip_ws(&p, end)) return false;
+    if (*p == '}') return false;
+    if (*p != '"') return false;
+    const char* kstart = p + 1;
+    if (!skip_string(&p, end)) return false;
+    const char* kend = p - 1;  // closing quote
+    bool is_key = (static_cast<size_t>(kend - kstart) == key_len &&
+                   std::memcmp(kstart, key, key_len) == 0);
+    if (!skip_ws(&p, end) || *p != ':') return false;
+    p++;
+    if (is_key) {
+      if (!skip_ws(&p, end)) return false;
+      char* parse_end = nullptr;
+      double v = std::strtod(p, &parse_end);
+      if (parse_end == p) return false;  // not numeric (string/obj/bool)
+      *out = v;
+      return true;
+    }
+    if (!skip_value(&p, end)) return false;
+    if (!skip_ws(&p, end)) return false;
+    if (*p == ',') {
+      p++;
+      continue;
+    }
+    return false;  // '}' or malformed
+  }
+}
+
+struct Match {
+  size_t offset;
+  int64_t time_us;
+  uint32_t size;  // including the u32 prefix
+};
+
+// Shared filtered-scan core. target modes: 0 = no filter, 1 = must be null,
+// 2 = exact match (the reference's Option[Option[String]],
+// ref: LEvents.scala:164-221).
+template <typename Fn>
+void scan_impl(const std::vector<uint8_t>& buf, int64_t start_us,
+               int64_t until_us, const char* entity_type,
+               const char* entity_id, const uint8_t* names_blob,
+               int32_t n_names, int32_t target_type_mode,
+               const char* target_type, int32_t target_id_mode,
+               const char* target_id, Fn&& fn) {
+  NameFilter names;
+  if (names_blob && n_names > 0) names.init(names_blob, n_names);
+  uint64_t want_hash = 0;
+  bool use_hash = entity_type && entity_id;
+  if (use_hash)
+    want_hash = fnv1a(entity_type, std::strlen(entity_type), entity_id,
+                      std::strlen(entity_id));
+  size_t pos = sizeof(kMagic);
+  Record r;
+  size_t next;
+  while (parse_record(buf, pos, &r, &next)) {
+    size_t here = pos;
+    pos = next;
+    if (r.flags & 1) continue;  // tombstone
+    if (r.event_time_us < start_us || r.event_time_us >= until_us) continue;
+    if (use_hash && r.entity_hash != want_hash) continue;
+    if (entity_type && !str_eq(r.entity_type, r.entity_type_len, entity_type))
+      continue;
+    if (entity_id && !str_eq(r.entity_id, r.entity_id_len, entity_id))
+      continue;
+    int32_t name_idx = 0;
+    if (names.active()) {
+      name_idx = names.match(r.event, r.event_len);
+      if (name_idx < 0) continue;
+    }
+    if (target_type_mode == 1 && r.target_entity_type != nullptr) continue;
+    if (target_type_mode == 2 &&
+        (r.target_entity_type == nullptr ||
+         !str_eq(r.target_entity_type, r.target_entity_type_len, target_type)))
+      continue;
+    if (target_id_mode == 1 && r.target_entity_id != nullptr) continue;
+    if (target_id_mode == 2 &&
+        (r.target_entity_id == nullptr ||
+         !str_eq(r.target_entity_id, r.target_entity_id_len, target_id)))
+      continue;
+    fn(r, here, name_idx);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pio_free(void* p) { std::free(p); }
+
+// Filtered scan -> concatenated raw records ordered by event time
+// (insertion order breaks ties), reversed when `reversed_`. Caller frees
+// *out_buf with pio_free. Returns 0 on success, -1 on unreadable file.
+int32_t pio_eventlog_scan(const char* path, int64_t start_us, int64_t until_us,
+                          const char* entity_type, const char* entity_id,
+                          const uint8_t* names_blob, int32_t n_names,
+                          int32_t target_type_mode, const char* target_type,
+                          int32_t target_id_mode, const char* target_id,
+                          int64_t limit, int32_t reversed_, uint8_t** out_buf,
+                          int64_t* out_len, int64_t* out_count) {
+  std::vector<uint8_t> buf;
+  if (!read_file(path, buf)) return -1;
+  std::vector<Match> matches;
+  scan_impl(buf, start_us, until_us, entity_type, entity_id, names_blob,
+            n_names, target_type_mode, target_type, target_id_mode, target_id,
+            [&](const Record& r, size_t offset, int32_t) {
+              matches.push_back({offset, r.event_time_us, r.total_len + 4});
+            });
+  std::stable_sort(matches.begin(), matches.end(),
+                   [](const Match& a, const Match& b) {
+                     return a.time_us < b.time_us;
+                   });
+  if (reversed_) std::reverse(matches.begin(), matches.end());
+  if (limit >= 0 && static_cast<size_t>(limit) < matches.size())
+    matches.resize(static_cast<size_t>(limit));
+  size_t total = 0;
+  for (const auto& m : matches) total += m.size;
+  uint8_t* out = static_cast<uint8_t*>(std::malloc(total ? total : 1));
+  if (!out) return -1;
+  size_t w = 0;
+  for (const auto& m : matches) {
+    std::memcpy(out + w, &buf[m.offset], m.size);
+    w += m.size;
+  }
+  *out_buf = out;
+  *out_len = static_cast<int64_t>(total);
+  *out_count = static_cast<int64_t>(matches.size());
+  return 0;
+}
+
+// Find the file offset of a live record by event id; -1 if absent.
+// (Python writes the tombstone byte — offset + 4 — in place.)
+int64_t pio_eventlog_find_offset(const char* path, const char* event_id) {
+  std::vector<uint8_t> buf;
+  if (!read_file(path, buf)) return -1;
+  size_t id_len = std::strlen(event_id);
+  size_t pos = sizeof(kMagic);
+  Record r;
+  size_t next;
+  while (parse_record(buf, pos, &r, &next)) {
+    size_t here = pos;
+    pos = next;
+    if (r.flags & 1) continue;
+    if (r.event_id_len == id_len &&
+        std::memcmp(r.event_id, event_id, id_len) == 0)
+      return static_cast<int64_t>(here);
+  }
+  return -1;
+}
+
+// Columnar interaction decode: (entity -> target) events with interned
+// string tables. Arrays are row-aligned; string tables are [u16 len][bytes]
+// blobs in first-seen order. rating_key == nullptr -> default_rating
+// everywhere. Caller frees the five arrays and two blobs with pio_free.
+int32_t pio_eventlog_interactions(
+    const char* path, const uint8_t* names_blob, int32_t n_names,
+    const char* rating_key, float default_rating, int64_t* out_n,
+    int32_t** out_user_idx, int32_t** out_item_idx, float** out_rating,
+    int32_t** out_name_idx, int64_t** out_time_us, int64_t* out_n_users,
+    uint8_t** out_users_blob, int64_t* out_users_blob_len, int64_t* out_n_items,
+    uint8_t** out_items_blob, int64_t* out_items_blob_len) {
+  std::vector<int32_t> user_idx, item_idx, name_idx;
+  std::vector<float> rating;
+  std::vector<int64_t> time_us;
+  std::unordered_map<std::string, int32_t> users, items;
+  std::string users_blob, items_blob;
+  auto intern = [](std::unordered_map<std::string, int32_t>& table,
+                   std::string& blob, const char* s, uint32_t len) -> int32_t {
+    std::string key(s, len);
+    auto it = table.find(key);
+    if (it != table.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(table.size());
+    table.emplace(std::move(key), idx);
+    uint16_t l16 = static_cast<uint16_t>(len);
+    blob.append(reinterpret_cast<const char*>(&l16), 2);
+    blob.append(s, len);
+    return idx;
+  };
+  std::vector<uint8_t> buf;
+  if (!read_file(path, buf)) return -1;
+  scan_impl(
+      buf, INT64_MIN, INT64_MAX, nullptr, nullptr, names_blob, n_names, 0,
+      nullptr, 0, nullptr,
+      [&](const Record& r, size_t, int32_t nidx) {
+        if (r.target_entity_id == nullptr) return;
+        user_idx.push_back(
+            intern(users, users_blob, r.entity_id, r.entity_id_len));
+        item_idx.push_back(intern(items, items_blob, r.target_entity_id,
+                                  r.target_entity_id_len));
+        name_idx.push_back(nidx);
+        time_us.push_back(r.event_time_us);
+        float v = default_rating;
+        if (rating_key) {
+          double d;
+          if (json_top_level_number(r.props, r.props_len, rating_key, &d))
+            v = static_cast<float>(d);
+        }
+        rating.push_back(v);
+      });
+  auto copy_out = [](const void* src, size_t bytes) -> void* {
+    void* p = std::malloc(bytes ? bytes : 1);
+    if (p && bytes) std::memcpy(p, src, bytes);
+    return p;
+  };
+  size_t n = user_idx.size();
+  *out_n = static_cast<int64_t>(n);
+  *out_user_idx =
+      static_cast<int32_t*>(copy_out(user_idx.data(), n * sizeof(int32_t)));
+  *out_item_idx =
+      static_cast<int32_t*>(copy_out(item_idx.data(), n * sizeof(int32_t)));
+  *out_rating =
+      static_cast<float*>(copy_out(rating.data(), n * sizeof(float)));
+  *out_name_idx =
+      static_cast<int32_t*>(copy_out(name_idx.data(), n * sizeof(int32_t)));
+  *out_time_us =
+      static_cast<int64_t*>(copy_out(time_us.data(), n * sizeof(int64_t)));
+  *out_n_users = static_cast<int64_t>(users.size());
+  *out_users_blob =
+      static_cast<uint8_t*>(copy_out(users_blob.data(), users_blob.size()));
+  *out_users_blob_len = static_cast<int64_t>(users_blob.size());
+  *out_n_items = static_cast<int64_t>(items.size());
+  *out_items_blob =
+      static_cast<uint8_t*>(copy_out(items_blob.data(), items_blob.size()));
+  *out_items_blob_len = static_cast<int64_t>(items_blob.size());
+  return 0;
+}
+
+}  // extern "C"

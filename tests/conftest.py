@@ -42,6 +42,29 @@ def memory_storage(monkeypatch):
 
 
 @pytest.fixture()
+def eventlog_storage(monkeypatch, tmp_path):
+    """EVENTDATA on the binary event-log backend (native C++ scan path when
+    the toolchain is available), metadata/models in memory — mirroring the
+    reference's HBase-events + ES-metadata deployment shape."""
+    from predictionio_tpu.data.storage import Storage
+
+    for key in list(os.environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_ELOG_TYPE", "eventlog")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_ELOG_PATH", str(tmp_path / "elog"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "ELOG")
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME", "test_events")
+    for repo in ("METADATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "MEM")
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"test_{repo.lower()}")
+    Storage.reset()
+    yield Storage
+    Storage.reset()
+
+
+@pytest.fixture()
 def sqlite_storage(monkeypatch, tmp_path):
     """Wire all three repositories to a throwaway SQLite database."""
     from predictionio_tpu.data.storage import Storage
