@@ -1,0 +1,83 @@
+"""Entity-id ↔ dense-index maps carrying per-entity data.
+
+Re-design of the reference's ``EntityIdIxMap`` / ``EntityMap``
+(ref: data/.../storage/EntityMap.scala:27-99): entity ids interned to dense
+indices (the layout factor matrices and embedding tables index by), with an
+optional data payload per entity (e.g. aggregated properties feeding feature
+vectors).
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Iterable, Mapping, TypeVar
+
+from predictionio_tpu.data.bimap import BiMap
+
+A = TypeVar("A")
+
+
+class EntityIdIxMap:
+    """ref: EntityMap.scala:27-56."""
+
+    def __init__(self, id_to_ix: BiMap[str]):
+        self.id_to_ix = id_to_ix
+
+    @staticmethod
+    def from_keys(keys: Iterable[str]) -> "EntityIdIxMap":
+        return EntityIdIxMap(BiMap.string_int(keys))
+
+    def __call__(self, id_: str) -> int:
+        return self.id_to_ix(id_)
+
+    def id_of(self, ix: int) -> str:
+        return self.id_to_ix.inverse(ix)
+
+    def contains(self, id_: str) -> bool:
+        return self.id_to_ix.contains(id_)
+
+    def get(self, id_: str, default: int | None = None) -> int | None:
+        return self.id_to_ix.get(id_, default)
+
+    def __len__(self) -> int:
+        return len(self.id_to_ix)
+
+    def to_dict(self) -> dict[str, int]:
+        return self.id_to_ix.to_dict()
+
+    def take(self, n: int) -> "EntityIdIxMap":
+        """First n ids by index (ref: EntityMap.scala:54-56)."""
+        items = sorted(self.id_to_ix.to_dict().items(), key=lambda kv: kv[1])
+        return EntityIdIxMap(BiMap(dict(items[:n])))
+
+
+class EntityMap(EntityIdIxMap, Generic[A]):
+    """Id↔index map with a data payload per entity
+    (ref: EntityMap.scala:68-99)."""
+
+    def __init__(
+        self,
+        id_to_data: Mapping[str, A],
+        id_to_ix: BiMap[str] | None = None,
+    ):
+        super().__init__(
+            id_to_ix if id_to_ix is not None else BiMap.string_int(id_to_data)
+        )
+        self.id_to_data = dict(id_to_data)
+
+    def data(self, id_or_ix: str | int) -> A:
+        if isinstance(id_or_ix, int):
+            id_or_ix = self.id_of(id_or_ix)
+        return self.id_to_data[id_or_ix]
+
+    def get_data(self, id_or_ix: str | int, default: A | None = None) -> A | None:
+        try:
+            return self.data(id_or_ix)
+        except (KeyError, IndexError):
+            return default
+
+    def take(self, n: int) -> "EntityMap[A]":
+        base = super().take(n)
+        kept = {
+            k: v for k, v in self.id_to_data.items() if base.contains(k)
+        }
+        return EntityMap(kept, base.id_to_ix)

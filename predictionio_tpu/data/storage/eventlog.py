@@ -164,8 +164,15 @@ def intern_interactions(
         v = default_rating
         if rating_key is not None:
             raw = ev.properties.get_opt(rating_key)
-            if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+            if isinstance(raw, bool):
+                pass  # booleans are not ratings
+            elif isinstance(raw, (int, float)):
                 v = float(raw)
+            elif isinstance(raw, str):
+                try:
+                    v = float(raw)  # numeric strings accepted, like the C++
+                except ValueError:
+                    pass
         rr.append(v)
     return (
         list(users), list(items),

@@ -1,0 +1,6 @@
+"""Materialized batch views over events (the reference's view package)."""
+
+from predictionio_tpu.data.view.data_view import DataView
+from predictionio_tpu.data.view.batch_view import LBatchView
+
+__all__ = ["DataView", "LBatchView"]

@@ -1,0 +1,121 @@
+"""In-memory batch view over an app's events.
+
+Re-design of the reference's legacy ``LBatchView`` / ``EventSeq``
+(ref: data/.../view/LBatchView.scala:105-205): load a time window of events
+once, then filter / aggregate-by-entity over the materialized sequence.
+The RDD twin ``PBatchView`` collapses into the same class here — bulk
+columnar access is :class:`~predictionio_tpu.data.view.data_view.DataView`
+and ``PEventStore.interaction_indices``.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Callable, Iterable, TypeVar
+
+from predictionio_tpu.data.aggregation import aggregate_properties
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import Storage
+
+T = TypeVar("T")
+
+
+class EventSeq:
+    """Filter/aggregate combinators over a list of events
+    (ref: LBatchView.scala:105-131)."""
+
+    def __init__(self, events: Iterable[Event]):
+        self.events = list(events)
+
+    def filter(
+        self,
+        predicate: Callable[[Event], bool] | None = None,
+        event: str | None = None,
+        entity_type: str | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+    ) -> "EventSeq":
+        def keep(e: Event) -> bool:
+            if predicate is not None and not predicate(e):
+                return False
+            if event is not None and e.event != event:
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            return True
+
+        return EventSeq([e for e in self.events if keep(e)])
+
+    def aggregate_by_entity_ordered(
+        self, init: T, op: Callable[[T, Event], T]
+    ) -> dict[str, T]:
+        """Fold events per entity id in event-time order
+        (ref: LBatchView.scala:121-131)."""
+        grouped: dict[str, list[Event]] = {}
+        for e in sorted(self.events, key=lambda e: e.event_time):
+            grouped.setdefault(e.entity_id, []).append(e)
+        out: dict[str, T] = {}
+        for entity_id, events in grouped.items():
+            acc = init
+            for e in events:
+                acc = op(acc, e)
+            out[entity_id] = acc
+        return out
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def __len__(self):
+        return len(self.events)
+
+
+class LBatchView:
+    """One loaded window of an app's events (ref: LBatchView.scala:134-205)."""
+
+    def __init__(
+        self,
+        app_id: int,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        channel_id: int | None = None,
+    ):
+        self.app_id = app_id
+        self.start_time = start_time
+        self.until_time = until_time
+        self.channel_id = channel_id
+        self._events: EventSeq | None = None
+
+    @property
+    def events(self) -> EventSeq:
+        if self._events is None:
+            self._events = EventSeq(
+                Storage.get_events().find(
+                    app_id=self.app_id,
+                    channel_id=self.channel_id,
+                    start_time=self.start_time,
+                    until_time=self.until_time,
+                )
+            )
+        return self._events
+
+    def aggregate_properties(self, entity_type: str) -> dict[str, PropertyMap]:
+        """Current properties per entity of a type, from $set/$unset/$delete
+        folds (ref: LBatchView.scala:156-172)."""
+        return aggregate_properties(
+            self.events.filter(entity_type=entity_type)
+        )
+
+    def group_by_entity_ordered(
+        self, predicate: Callable[[Event], bool] | None = None
+    ) -> dict[str, list[Event]]:
+        """Events per entity in time order (ref: LBatchView.scala:189-205)."""
+        seq = self.events.filter(predicate) if predicate else self.events
+        out: dict[str, list[Event]] = {}
+        for e in sorted(seq, key=lambda e: e.event_time):
+            out.setdefault(e.entity_id, []).append(e)
+        return out

@@ -273,9 +273,15 @@ bool json_top_level_number(const char* s, uint32_t len, const char* key,
     p++;
     if (is_key) {
       if (!skip_ws(&p, end)) return false;
+      // Accept numbers and fully-numeric strings ("4.5"); reject everything
+      // else (bool/object/array/non-numeric string) — must mirror the
+      // Python fallback in eventlog.py intern_interactions.
+      bool quoted = (*p == '"');
+      const char* num_start = quoted ? p + 1 : p;
       char* parse_end = nullptr;
-      double v = std::strtod(p, &parse_end);
-      if (parse_end == p) return false;  // not numeric (string/obj/bool)
+      double v = std::strtod(num_start, &parse_end);
+      if (parse_end == num_start) return false;
+      if (quoted && *parse_end != '"') return false;  // e.g. "4.5x"
       *out = v;
       return true;
     }

@@ -1,0 +1,123 @@
+"""Admin REST API (experimental in the reference, kept for parity).
+
+Re-design of ``AdminServiceActor``'s routes
+(ref: tools/.../admin/AdminAPI.scala:34-120) and ``CommandClient``
+(ref: tools/.../admin/CommandClient.scala): app CRUD over HTTP on port 7071.
+
+Routes (same shapes as the reference):
+  GET    /                      → service status
+  GET    /cmd/app               → list apps (with access keys)
+  POST   /cmd/app               → create app {"name": ..., "description": ...}
+  DELETE /cmd/app/{name}        → delete app and all data
+  DELETE /cmd/app/{name}/data   → delete app data only
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    StorageError,
+)
+from predictionio_tpu.utils.http import AppServer, HTTPError, Request, Router
+
+
+def _app_json(app: App) -> dict:
+    keys = Storage.get_meta_data_access_keys().get_by_app_id(app.id)
+    return {
+        "name": app.name,
+        "id": app.id,
+        "description": app.description,
+        "accessKeys": [
+            {"key": k.key, "events": list(k.events)} for k in keys
+        ],
+    }
+
+
+def build_router() -> Router:
+    r = Router()
+    apps = lambda: Storage.get_meta_data_apps()  # noqa: E731
+
+    def index(request: Request):
+        return 200, {"status": "alive"}
+
+    def list_apps(request: Request):
+        return 200, {
+            "status": 1,
+            "message": "Successful retrieved app list.",
+            "apps": [_app_json(a) for a in apps().get_all()],
+        }
+
+    def new_app(request: Request):
+        body = request.json() or {}
+        name = body.get("name")
+        if not name:
+            raise HTTPError(400, "Name of app not provided.")
+        if apps().get_by_name(name) is not None:
+            raise HTTPError(409, f"App {name} already exists.")
+        app_id = apps().insert(
+            App(id=int(body.get("id") or 0), name=name,
+                description=body.get("description"))
+        )
+        if app_id is None:
+            raise HTTPError(500, "Unable to create app.")
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey(key="", appid=app_id, events=())
+        )
+        Storage.get_events().init(app_id)
+        return 200, {
+            "status": 1,
+            "message": f"App {name} created.",
+            "id": app_id,
+            "name": name,
+            "accessKey": key,
+        }
+
+    def _find_app(request: Request) -> App:
+        name = request.path_params["name"]
+        app = apps().get_by_name(name)
+        if app is None:
+            raise HTTPError(404, f"App {name} does not exist.")
+        return app
+
+    def _channels(app_id: int) -> list[Channel]:
+        return Storage.get_meta_data_channels().get_by_app_id(app_id)
+
+    def delete_app_data(request: Request):
+        app = _find_app(request)
+        events = Storage.get_events()
+        try:
+            for ch in _channels(app.id):
+                events.remove(app.id, ch.id)
+                events.init(app.id, ch.id)
+            events.remove(app.id)
+            events.init(app.id)
+        except StorageError as e:
+            raise HTTPError(500, str(e))
+        return 200, {"status": 1, "message": f"Removed data of app {app.name}."}
+
+    def delete_app(request: Request):
+        app = _find_app(request)
+        events = Storage.get_events()
+        for ch in _channels(app.id):
+            events.remove(app.id, ch.id)
+            Storage.get_meta_data_channels().delete(ch.id)
+        events.remove(app.id)
+        for k in Storage.get_meta_data_access_keys().get_by_app_id(app.id):
+            Storage.get_meta_data_access_keys().delete(k.key)
+        apps().delete(app.id)
+        return 200, {"status": 1, "message": f"App {app.name} deleted."}
+
+    r.add("GET", "/", index)
+    r.add("GET", "/cmd/app", list_apps)
+    r.add("POST", "/cmd/app", new_app)
+    r.add("DELETE", "/cmd/app/{name}/data", delete_app_data)
+    r.add("DELETE", "/cmd/app/{name}", delete_app)
+    return r
+
+
+def create_admin_server(ip: str = "127.0.0.1", port: int = 7071) -> AppServer:
+    """ref: AdminAPI.scala (admin server port 7071)."""
+    return AppServer(build_router(), host=ip, port=port)

@@ -139,6 +139,49 @@ def build_parser() -> argparse.ArgumentParser:
     p_es.add_argument("--stats", action="store_true")
     p_es.set_defaults(func=cmd_eventserver)
 
+    # -- dashboard / admin server (ref: Console.scala:866-890) --------------
+    p_db = sub.add_parser("dashboard", help="launch the evaluation dashboard")
+    p_db.add_argument("--ip", default="0.0.0.0")
+    p_db.add_argument("--port", type=int, default=9000)
+    p_db.set_defaults(func=cmd_dashboard)
+
+    p_admin = sub.add_parser("adminserver", help="launch the admin REST API")
+    p_admin.add_argument("--ip", default="127.0.0.1")
+    p_admin.add_argument("--port", type=int, default=7071)
+    p_admin.set_defaults(func=cmd_adminserver)
+
+    # -- export / import (ref: Console.scala export/import) -----------------
+    p_exp = sub.add_parser("export", help="export events to a JSON-lines file")
+    p_exp.add_argument("--app-name", required=True)
+    p_exp.add_argument("--channel")
+    p_exp.add_argument("--output", required=True)
+    p_exp.set_defaults(func=cmd_export)
+
+    p_imp = sub.add_parser("import", help="import events from a JSON-lines file")
+    p_imp.add_argument("--app-name", required=True)
+    p_imp.add_argument("--channel")
+    p_imp.add_argument("--input", required=True)
+    p_imp.set_defaults(func=cmd_import)
+
+    # -- misc verbs (ref: Console.scala:186-651) ----------------------------
+    p_ver = sub.add_parser("version", help="print the framework version")
+    p_ver.set_defaults(func=lambda a: (print(__version__), 0)[1])
+
+    p_unreg = sub.add_parser("unregister",
+                             help="unregister the engine in cwd")
+    p_unreg.add_argument("--engine-json", default="engine.json")
+    p_unreg.set_defaults(func=cmd_unregister)
+
+    p_run = sub.add_parser(
+        "run", help="run an arbitrary entry point with storage env configured"
+    )
+    p_run.add_argument("main_class", help="module:attr callable")
+    p_run.add_argument("args", nargs="*")
+    p_run.set_defaults(func=cmd_run)
+
+    p_up = sub.add_parser("upgrade", help="check for framework upgrades")
+    p_up.set_defaults(func=cmd_upgrade)
+
     return parser
 
 
@@ -364,6 +407,101 @@ def cmd_eventserver(args) -> int:
         server.wait()
     except KeyboardInterrupt:
         server.stop()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    """ref: Console.dashboard:866-874 → Dashboard.scala."""
+    from predictionio_tpu.tools.dashboard import create_dashboard
+
+    server = create_dashboard(ip=args.ip, port=args.port)
+    server.start()
+    print(f"[INFO] Dashboard is listening on {args.ip}:{server.port}")
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    """ref: Console.adminserver → AdminAPI.scala."""
+    from predictionio_tpu.tools.admin_api import create_admin_server
+
+    server = create_admin_server(ip=args.ip, port=args.port)
+    server.start()
+    print(f"[INFO] Admin server is listening on {args.ip}:{server.port}")
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def cmd_export(args) -> int:
+    """ref: Console export → EventsToFile.scala."""
+    from predictionio_tpu.tools.export_import import events_to_file
+
+    try:
+        n = events_to_file(args.app_name, args.output, args.channel)
+    except (ValueError, OSError) as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    print(f"[INFO] Events are exported to {args.output} ({n} events).")
+    return 0
+
+
+def cmd_import(args) -> int:
+    """ref: Console import → FileToEvents.scala."""
+    from predictionio_tpu.tools.export_import import file_to_events
+
+    try:
+        n = file_to_events(args.app_name, args.input, args.channel)
+    except (ValueError, OSError) as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    print(f"[INFO] Events are imported ({n} events).")
+    return 0
+
+
+def cmd_unregister(args) -> int:
+    """ref: Console.unregister → RegisterEngine.unregisterEngine
+    (tools/RegisterEngine.scala:62-84)."""
+    from predictionio_tpu.data.storage import Storage
+
+    variant = _load_variant(args.engine_json)
+    if variant is None:
+        return 1
+    manifests = Storage.get_meta_data_engine_manifests()
+    mid = variant.get("id", "default")
+    version = variant.get("version", "1")
+    if manifests.get(mid, version) is None:
+        print(f"[ERROR] Engine {mid} {version} is not registered.",
+              file=sys.stderr)
+        return 1
+    manifests.delete(mid, version)
+    print(f"[INFO] Engine {mid} {version} unregistered.")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """ref: Console.run → Runner.runOnSpark (tools/Runner.scala:92-210);
+    collapses to an in-process call of a module:attr entry point."""
+    import os
+
+    from predictionio_tpu.workflow.engine_loader import load_engine_factory
+
+    fn = load_engine_factory(args.main_class, os.getcwd())
+    result = fn(args.args) if callable(fn) else None
+    return int(result) if isinstance(result, int) else 0
+
+
+def cmd_upgrade(args) -> int:
+    """The reference phones home for new versions
+    (ref: workflow/WorkflowUtils.scala:385-406); this build is offline-first,
+    so upgrade checking is a no-op by design."""
+    print(f"[INFO] predictionio_tpu {__version__}; upgrade checking is "
+          "disabled in this offline-first build.")
     return 0
 
 

@@ -1,0 +1,98 @@
+"""Evaluation dashboard web UI.
+
+Re-design of the reference's spray/twirl dashboard
+(ref: tools/.../dashboard/Dashboard.scala:36-141 + twirl
+``dashboard/index.scala.html``): lists completed evaluation instances most
+recent first with links to each instance's HTML results page, default port
+9000 (``Dashboard.scala:35``). CORS headers mirror ``CorsSupport.scala``.
+"""
+
+from __future__ import annotations
+
+import html
+
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.storage.base import EvaluationInstance
+from predictionio_tpu.utils.http import (
+    AppServer,
+    HTTPError,
+    RawResponse,
+    Request,
+    Router,
+)
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>predictionio_tpu Dashboard</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ border: 1px solid #ccc; padding: 6px 10px; text-align: left; }}
+ th {{ background: #f0f0f0; }}
+</style></head>
+<body>
+<h1>Evaluation Dashboard</h1>
+<p>{count} completed evaluation(s), most recent first.</p>
+<table>
+<tr><th>ID</th><th>Start</th><th>End</th><th>Evaluation</th>
+<th>Params generator</th><th>Batch</th><th>Result</th><th></th></tr>
+{rows}
+</table>
+</body></html>"""
+
+_ROW = ("<tr><td>{id}</td><td>{start}</td><td>{end}</td><td>{cls}</td>"
+        "<td>{gen}</td><td>{batch}</td><td>{result}</td>"
+        '<td><a href="/engine_instances/{id}/evaluator_results.html">HTML</a> '
+        '<a href="/engine_instances/{id}/evaluator_results.json">JSON</a>'
+        "</td></tr>")
+
+
+def _instances() -> list[EvaluationInstance]:
+    return Storage.get_meta_data_evaluation_instances().get_completed()
+
+
+def build_router() -> Router:
+    r = Router()
+
+    def index(request: Request):
+        instances = _instances()
+        rows = "\n".join(
+            _ROW.format(
+                id=html.escape(i.id),
+                start=html.escape(str(i.start_time)),
+                end=html.escape(str(i.end_time)),
+                cls=html.escape(i.evaluation_class),
+                gen=html.escape(i.engine_params_generator_class),
+                batch=html.escape(i.batch),
+                result=html.escape(i.evaluator_results),
+            )
+            for i in instances
+        )
+        return 200, RawResponse(_PAGE.format(count=len(instances), rows=rows))
+
+    def _get(request: Request) -> EvaluationInstance:
+        iid = request.path_params["instance_id"]
+        inst = Storage.get_meta_data_evaluation_instances().get(iid)
+        if inst is None or inst.status != "EVALCOMPLETED":
+            raise HTTPError(404, f"Invalid instance ID: {iid}")
+        return inst
+
+    def results_html(request: Request):
+        return 200, RawResponse(_get(request).evaluator_results_html)
+
+    def results_json(request: Request):
+        return 200, RawResponse(
+            _get(request).evaluator_results_json,
+            content_type="application/json; charset=UTF-8",
+        )
+
+    r.add("GET", "/", index)
+    r.add("GET", "/engine_instances/{instance_id}/evaluator_results.html",
+          results_html)
+    r.add("GET", "/engine_instances/{instance_id}/evaluator_results.json",
+          results_json)
+    return r
+
+
+def create_dashboard(ip: str = "0.0.0.0", port: int = 9000) -> AppServer:
+    """ref: Dashboard.scala:36-141 (port 9000 default at :35)."""
+    return AppServer(build_router(), host=ip, port=port)

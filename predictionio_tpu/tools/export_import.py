@@ -1,0 +1,65 @@
+"""Event export/import: events ↔ JSON-lines files.
+
+Re-design of the reference's Spark jobs ``EventsToFile``
+(ref: tools/.../export/EventsToFile.scala:28-104, json or parquet output via
+Spark SQL) and ``FileToEvents`` (ref: tools/.../imprt/FileToEvents.scala:28-95).
+There is no cluster job to launch here: the event store scans in-process, so
+both directions are plain streaming loops. JSON-lines keeps the reference's
+json format (one event object per line, the ``/events.json`` wire shape).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+from predictionio_tpu.data.event import Event, validate_event
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.store.event_stores import app_name_to_id
+
+
+def events_to_file(
+    app_name: str,
+    output: str,
+    channel_name: str | None = None,
+) -> int:
+    """Export all events of an app/channel to a JSON-lines file; returns the
+    number of events written (ref: EventsToFile.scala:78-96)."""
+    app_id, channel_id = app_name_to_id(app_name, channel_name)
+    events = Storage.get_events()
+    path = Path(output)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    n = 0
+    with path.open("w", encoding="utf-8") as f:
+        for event in events.find(app_id=app_id, channel_id=channel_id):
+            f.write(json.dumps(event.to_json()) + "\n")
+            n += 1
+    return n
+
+
+def file_to_events(
+    app_name: str,
+    input_path: str,
+    channel_name: str | None = None,
+) -> int:
+    """Import events from a JSON-lines file; returns the number inserted
+    (ref: FileToEvents.scala:70-89 — parse, validate, write batch)."""
+    app_id, channel_id = app_name_to_id(app_name, channel_name)
+    events = Storage.get_events()
+    n = 0
+    with Path(input_path).open("r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = Event.from_json(json.loads(line))
+                validate_event(event)
+            except (ValueError, KeyError) as e:
+                print(f"[WARN] line {lineno}: skipped invalid event: {e}",
+                      file=sys.stderr)
+                continue
+            events.insert(event, app_id, channel_id)
+            n += 1
+    return n

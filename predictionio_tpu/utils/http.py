@@ -43,6 +43,16 @@ class Request:
         return {k: v[0] for k, v in parsed.items()}
 
 
+@dataclass
+class RawResponse:
+    """Return from a handler (as the payload) to emit non-JSON content —
+    the dashboard and engine-server status pages serve HTML, like the
+    reference's twirl templates."""
+
+    body: str | bytes
+    content_type: str = "text/html; charset=UTF-8"
+
+
 class HTTPError(Exception):
     """Raise inside a handler to produce a JSON error response."""
 
@@ -133,9 +143,18 @@ class AppServer:
                 except Exception as e:  # last-resort 500, mirror exceptionHandler
                     logger.exception("handler error")
                     status, payload = 500, {"message": str(e)}
-                data = json.dumps(payload).encode("utf-8")
+                if isinstance(payload, RawResponse):
+                    data = (
+                        payload.body.encode("utf-8")
+                        if isinstance(payload.body, str)
+                        else payload.body
+                    )
+                    content_type = payload.content_type
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                    content_type = "application/json; charset=UTF-8"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json; charset=UTF-8")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

@@ -1,0 +1,53 @@
+"""Evaluator-only runs: execute an arbitrary function through the full
+evaluation plumbing.
+
+Re-design of the reference's ``FakeWorkflow``
+(ref: core/.../workflow/FakeWorkflow.scala: ``FakeEngine``/``FakeRunner``/
+``FakeRun``): useful for developing new features under the exact environment
+of a real workflow run — `pio eval my_module:hello` with
+``hello = FakeRun(lambda ctx: ...)``. Results are not persisted
+(``FakeEvalResult.noSave``, ref :69-71).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from predictionio_tpu.core.base import BaseEvaluatorResult
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+class FakeEvalResult(BaseEvaluatorResult):
+    """ref: FakeWorkflow.scala:69-71 (noSave = true)."""
+
+    no_save = True
+
+    def to_one_liner(self) -> str:
+        return "FakeRun completed"
+
+    def to_json(self):
+        return {"fake": True}
+
+    def to_html(self) -> str:
+        return "<p>FakeRun completed</p>"
+
+
+class FakeRun(Evaluation):
+    """Run ``func(ctx)`` through `pio eval` (ref: FakeWorkflow.scala:73-103).
+
+    Example::
+
+        # my_module.py
+        hello = FakeRun(lambda ctx: print(ctx.mesh))
+        # shell
+        pio eval my_module:hello
+    """
+
+    def __init__(self, func: Callable[[ComputeContext], None]):
+        super().__init__()
+        self.func = func
+
+    def run(self, ctx: ComputeContext, params=None) -> FakeEvalResult:
+        self.func(ctx)
+        return FakeEvalResult()

@@ -1,0 +1,37 @@
+"""EntityMap (ref: storage/EntityMap.scala) and FakeRun evaluator-only runs
+(ref: workflow/FakeWorkflow.scala)."""
+
+from predictionio_tpu.data.entity_map import EntityIdIxMap, EntityMap
+from predictionio_tpu.workflow.fake_workflow import FakeEvalResult, FakeRun
+
+
+class TestEntityMap:
+    def test_id_ix_round_trip(self):
+        m = EntityIdIxMap.from_keys(["b", "a", "b", "c"])
+        assert len(m) == 3
+        assert m.id_of(m("a")) == "a"
+        assert m.contains("b") and not m.contains("z")
+        assert m.get("z") is None
+        t = m.take(2)
+        assert len(t) == 2
+
+    def test_entity_map_data(self):
+        m = EntityMap({"u1": {"age": 3}, "u2": {"age": 5}})
+        assert m.data("u1") == {"age": 3}
+        assert m.data(m("u2")) == {"age": 5}
+        assert m.get_data("zz", default={"age": 0}) == {"age": 0}
+        t = m.take(1)
+        assert len(t) == 1 and t.data(0) is not None
+
+
+class TestFakeRun:
+    def test_runs_through_eval_workflow(self, memory_storage):
+        from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+        calls = []
+        run = FakeRun(lambda ctx: calls.append(ctx.mesh.devices.size))
+        instance_id, result = run_evaluation(run, evaluation_class="fake")
+        assert calls == [8]  # the virtual 8-device CPU mesh
+        assert isinstance(result, FakeEvalResult)
+        # noSave: instance must NOT be recorded as completed
+        assert memory_storage.get_meta_data_evaluation_instances().get_completed() == []

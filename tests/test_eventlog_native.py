@@ -199,6 +199,35 @@ def test_interactions_escaped_rating_key(tmp_path):
     assert rr.tolist() == [4.0]
 
 
+def test_interactions_numeric_string_ratings(tmp_path):
+    """Numeric-string ratings ({"rating": "4.5"}) count; non-numeric strings
+    and booleans fall back to the default — in BOTH scan paths."""
+    store = ELogEvents(ELogClient({"PATH": str(tmp_path)}))
+    store.init(1)
+    for k, props in enumerate(
+        [{"rating": "4.5"}, {"rating": "x"}, {"rating": True}, {"rating": 2}]
+    ):
+        store.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{k}",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap(props),
+                  event_time=dt.datetime(2020, 1, 1, k, tzinfo=UTC)),
+            1,
+        )
+
+    class PyStore(ELogEvents):
+        @staticmethod
+        def _lib():
+            return None
+
+    expected = [4.5, 1.0, 1.0, 2.0]
+    *_, rr, _ni = store.interactions(1, None, ["rate"], rating_key="rating")
+    assert rr.tolist() == expected
+    py = PyStore(ELogClient({"PATH": str(tmp_path)}))
+    *_, rr_py, _ni = py.interactions(1, None, ["rate"], rating_key="rating")
+    assert rr_py.tolist() == expected
+
+
 def test_interactions_empty_names_rejected(tmp_path):
     store = ELogEvents(ELogClient({"PATH": str(tmp_path)}))
     store.init(1)

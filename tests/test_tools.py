@@ -1,0 +1,212 @@
+"""Tools layer: export/import round trip, dashboard, admin REST API, CLI verbs.
+
+Reference surfaces: EventsToFile/FileToEvents (tools/.../export, imprt),
+Dashboard.scala, AdminAPI.scala (covered there by AdminAPISpec), Console verbs.
+"""
+
+import datetime as dt
+import json
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App, EvaluationInstance
+
+UTC = dt.timezone.utc
+
+
+def _seed_app(storage, name="exapp"):
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name=name))
+    storage.get_events().init(app_id)
+    return app_id
+
+
+def _url(server, path):
+    return f"http://127.0.0.1:{server.port}{path}"
+
+
+def _get(server, path):
+    with urllib.request.urlopen(_url(server, path), timeout=5) as r:
+        return r.status, r.read().decode(), r.headers.get("Content-Type", "")
+
+
+def _req(server, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(_url(server, path), data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestExportImport:
+    def test_round_trip(self, memory_storage, tmp_path):
+        from predictionio_tpu.tools.export_import import (
+            events_to_file,
+            file_to_events,
+        )
+
+        app_id = _seed_app(memory_storage, "exapp")
+        _seed_app(memory_storage, "imapp")
+        events = memory_storage.get_events()
+        originals = [
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({"rating": i}),
+                  event_time=dt.datetime(2020, 1, 1, i, tzinfo=UTC))
+            for i in range(1, 6)
+        ]
+        for e in originals:
+            events.insert(e, app_id)
+        out = tmp_path / "events.jsonl"
+        assert events_to_file("exapp", str(out)) == 5
+        assert len(out.read_text().strip().splitlines()) == 5
+
+        assert file_to_events("imapp", str(out)) == 5
+        imported = sorted(
+            (e for e in events.find(app_id=2)), key=lambda e: e.event_time
+        )
+        for orig, imp in zip(originals, imported):
+            assert imp.entity_id == orig.entity_id
+            assert imp.properties == orig.properties
+            assert imp.event_time == orig.event_time
+
+    def test_import_skips_invalid_lines(self, memory_storage, tmp_path):
+        from predictionio_tpu.tools.export_import import file_to_events
+
+        _seed_app(memory_storage)
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(
+            json.dumps({"event": "view", "entityType": "user",
+                        "entityId": "u1"}) + "\n"
+            + "not json\n"
+            + json.dumps({"entityType": "user"}) + "\n"  # missing fields
+        )
+        assert file_to_events("exapp", str(bad)) == 1
+
+
+class TestDashboard:
+    @pytest.fixture()
+    def server(self, memory_storage):
+        from predictionio_tpu.tools.dashboard import create_dashboard
+
+        s = create_dashboard(ip="127.0.0.1", port=0)
+        s.start()
+        yield s
+        s.stop()
+
+    def test_lists_completed_instances(self, memory_storage, server):
+        dao = memory_storage.get_meta_data_evaluation_instances()
+        iid = dao.insert(EvaluationInstance(
+            status="EVALCOMPLETED",
+            evaluation_class="my.Eval",
+            evaluator_results="metric=0.5",
+            evaluator_results_html="<html><b>best</b></html>",
+            evaluator_results_json='{"best": 0.5}',
+        ))
+        dao.insert(EvaluationInstance(status="INIT"))
+        status, body, ctype = _get(server, "/")
+        assert status == 200 and "text/html" in ctype
+        assert "my.Eval" in body and "metric=0.5" in body
+        assert body.count("<tr>") == 2  # header + 1 completed only
+
+        status, body, ctype = _get(
+            server, f"/engine_instances/{iid}/evaluator_results.html"
+        )
+        assert status == 200 and "<b>best</b>" in body
+        status, body, ctype = _get(
+            server, f"/engine_instances/{iid}/evaluator_results.json"
+        )
+        assert status == 200 and json.loads(body) == {"best": 0.5}
+
+    def test_unknown_instance_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server, "/engine_instances/nope/evaluator_results.html")
+        assert ei.value.code == 404
+
+
+class TestAdminAPI:
+    @pytest.fixture()
+    def server(self, memory_storage):
+        from predictionio_tpu.tools.admin_api import create_admin_server
+
+        s = create_admin_server(ip="127.0.0.1", port=0)
+        s.start()
+        yield s
+        s.stop()
+
+    def test_app_lifecycle(self, memory_storage, server):
+        status, body = _req(server, "GET", "/")
+        assert status == 200 and body["status"] == "alive"
+
+        status, body = _req(server, "POST", "/cmd/app", {"name": "a1"})
+        assert status == 200 and body["id"] == 1 and body["accessKey"]
+
+        status, body = _req(server, "POST", "/cmd/app", {"name": "a1"})
+        assert status == 409
+
+        status, body = _req(server, "GET", "/cmd/app")
+        assert [a["name"] for a in body["apps"]] == ["a1"]
+        assert body["apps"][0]["accessKeys"]
+
+        # ingest an event, wipe data, app survives
+        events = memory_storage.get_events()
+        events.insert(Event(event="view", entity_type="user", entity_id="u"), 1)
+        status, body = _req(server, "DELETE", "/cmd/app/a1/data")
+        assert status == 200
+        assert list(events.find(app_id=1)) == []
+
+        status, body = _req(server, "DELETE", "/cmd/app/a1")
+        assert status == 200
+        status, body = _req(server, "GET", "/cmd/app")
+        assert body["apps"] == []
+
+        status, body = _req(server, "DELETE", "/cmd/app/a1")
+        assert status == 404
+
+
+class TestCLIVerbs:
+    def test_version_and_upgrade(self, capsys):
+        from predictionio_tpu import __version__
+        from predictionio_tpu.tools.cli import main
+
+        assert main(["version"]) == 0
+        assert __version__ in capsys.readouterr().out
+        assert main(["upgrade"]) == 0
+
+    def test_export_import_cli(self, memory_storage, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        app_id = _seed_app(memory_storage, "cliapp")
+        memory_storage.get_events().insert(
+            Event(event="view", entity_type="user", entity_id="u1"), app_id
+        )
+        out = tmp_path / "ev.jsonl"
+        assert main(["export", "--app-name", "cliapp",
+                     "--output", str(out)]) == 0
+        assert main(["export", "--app-name", "nope",
+                     "--output", str(out)]) == 1
+        _seed_app(memory_storage, "cliapp2")
+        assert main(["import", "--app-name", "cliapp2",
+                     "--input", str(out)]) == 0
+        assert len(list(memory_storage.get_events().find(app_id=2))) == 1
+
+    def test_unregister(self, memory_storage, tmp_path, monkeypatch, capsys):
+        from predictionio_tpu.data.storage.base import EngineManifest
+        from predictionio_tpu.tools.cli import main
+
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "engine.json").write_text(
+            json.dumps({"id": "e1", "version": "1", "engineFactory": "x:y"})
+        )
+        assert main(["unregister"]) == 1  # not registered yet
+        memory_storage.get_meta_data_engine_manifests().update(
+            EngineManifest(id="e1", version="1", name="e1", description=None,
+                           files=(), engine_factory="x:y"),
+            upsert=True,
+        )
+        assert main(["unregister"]) == 0
+        assert memory_storage.get_meta_data_engine_manifests().get("e1", "1") is None

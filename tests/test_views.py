@@ -1,0 +1,114 @@
+"""Batch views: DataView caching + LBatchView filters/aggregation
+(ref: data/.../view/DataView.scala, LBatchView.scala)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.data.view import DataView, LBatchView
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def seeded(memory_storage):
+    app_id = memory_storage.get_meta_data_apps().insert(App(id=0, name="vapp"))
+    events = memory_storage.get_events()
+    events.init(app_id)
+    for i in range(1, 6):
+        events.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{i % 2}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({"rating": i}),
+                  event_time=dt.datetime(2020, 1, i, tzinfo=UTC)),
+            app_id,
+        )
+    events.insert(
+        Event(event="$set", entity_type="user", entity_id="u0",
+              properties=DataMap({"plan": "pro"}),
+              event_time=dt.datetime(2020, 1, 1, tzinfo=UTC)),
+        app_id,
+    )
+    return memory_storage, app_id
+
+
+class TestDataView:
+    def convert(self, e: Event):
+        if e.event != "rate":
+            return None
+        return {
+            "user": e.entity_id,
+            "item": e.target_entity_id,
+            "rating": float(e.properties.get("rating")),
+        }
+
+    def test_materialize_and_cache(self, seeded, tmp_path):
+        view = DataView.create(
+            "vapp", self.convert, name="ratings", version="1",
+            until_time=dt.datetime(2021, 1, 1, tzinfo=UTC),
+            base_dir=str(tmp_path),
+        )
+        assert sorted(view) == ["item", "rating", "user"]
+        assert view["rating"].tolist() == [1.0, 2.0, 3.0, 4.0, 5.0]
+        files = list((tmp_path / "view").glob("*.npz"))
+        assert len(files) == 1
+
+        # cache hit: returns same data even after events change underneath
+        storage, app_id = seeded
+        storage.get_events().insert(
+            Event(event="rate", entity_type="user", entity_id="u9",
+                  target_entity_type="item", target_entity_id="i9",
+                  properties=DataMap({"rating": 9}),
+                  event_time=dt.datetime(2020, 2, 1, tzinfo=UTC)),
+            app_id,
+        )
+        again = DataView.create(
+            "vapp", self.convert, name="ratings", version="1",
+            until_time=dt.datetime(2021, 1, 1, tzinfo=UTC),
+            base_dir=str(tmp_path),
+        )
+        assert again["rating"].tolist() == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+        # version bump invalidates (the reference's cache-busting contract)
+        v2 = DataView.create(
+            "vapp", self.convert, name="ratings", version="2",
+            until_time=dt.datetime(2021, 1, 1, tzinfo=UTC),
+            base_dir=str(tmp_path),
+        )
+        assert v2["rating"].tolist() == [1.0, 2.0, 3.0, 4.0, 5.0, 9.0]
+
+    def test_inconsistent_columns_rejected(self, seeded, tmp_path):
+        def bad(e: Event):
+            if e.event == "$set":
+                return {"other": 1}
+            return {"user": e.entity_id}
+
+        with pytest.raises(ValueError, match="inconsistent columns"):
+            DataView.create("vapp", bad, name="bad", base_dir=str(tmp_path))
+
+
+class TestLBatchView:
+    def test_filters_and_aggregates(self, seeded):
+        _, app_id = seeded
+        view = LBatchView(app_id)
+        assert len(view.events) == 6
+        rates = view.events.filter(event="rate")
+        assert len(rates) == 5
+        windowed = view.events.filter(
+            start_time=dt.datetime(2020, 1, 2, tzinfo=UTC),
+            until_time=dt.datetime(2020, 1, 4, tzinfo=UTC),
+        )
+        assert len(windowed) == 2
+
+        props = view.aggregate_properties("user")
+        assert props["u0"].get("plan") == "pro"
+
+        counts = rates.aggregate_by_entity_ordered(0, lambda acc, e: acc + 1)
+        assert counts == {"u1": 3, "u0": 2}
+
+        grouped = view.group_by_entity_ordered(lambda e: e.event == "rate")
+        assert [e.properties.get("rating") for e in grouped["u1"]] == [1, 3, 5]
