@@ -47,7 +47,9 @@ def main() -> None:
     # warmup: compile all bucket shapes
     als.train(ui, ii, r, n_users, n_items)
 
-    iters = 10
+    # rank 10 / 20 iterations = the stock template's engine.json defaults
+    # (ref: examples/scala-parallel-recommendation engine.json)
+    iters = 20
     als_timed = ALS(ctx, ALSParams(rank=10, num_iterations=iters, seed=0))
     t0 = time.perf_counter()
     factors = als_timed.train(ui, ii, r, n_users, n_items)

@@ -39,6 +39,10 @@ from predictionio_tpu.parallel.mesh import ComputeContext
 
 logger = logging.getLogger(__name__)
 
+#: Replicating the packed rating blobs costs n_devices × blob bytes of HBM;
+#: above this size, ALS.train switches to per-bucket sharded transfers.
+_PACK_REPLICATE_MAX_BYTES = 128 * 1024 * 1024
+
 
 @dataclass(frozen=True)
 class ALSParams:
@@ -125,7 +129,6 @@ def _bucketize(
     return buckets
 
 
-@partial(jax.jit, static_argnames=("implicit", "rank"), donate_argnums=(0,))
 def _solve_bucket(
     target,  # [n_entities, rank] factor matrix being updated (replicated)
     fixed,  # [n_other, rank] fixed-side factors (replicated)
@@ -142,7 +145,8 @@ def _solve_bucket(
 ):
     """One bucket's batched normal-equation solve. ``rows/cols/...`` are
     sharded over the mesh ``data`` axis; ``target``/``fixed`` replicated, so
-    the row scatter at the end compiles to an ICI all-gather."""
+    the row scatter at the end compiles to an ICI all-gather. Traced inside
+    :func:`_als_iteration` — not jitted on its own."""
     y = fixed[cols]  # [n, k, r] gather, local (fixed is replicated)
     n_ratings = weights.sum(axis=1)  # [n]
     if implicit:
@@ -167,9 +171,144 @@ def _solve_bucket(
     return cleared.at[rows].add(sol)
 
 
-@partial(jax.jit, static_argnames=())
 def _gram(fixed):
     return fixed.T @ fixed
+
+
+@partial(jax.jit, static_argnames=("n", "rank"))
+def _init_factors(key, n: int, rank: int):
+    """MLlib-style init: small random factors scaled by 1/sqrt(rank).
+    Jitted so the factors are BORN on device — a host round trip per factor
+    matrix costs ~250ms through a tunneled TPU."""
+    return jax.random.normal(key, (n, rank), jnp.float32) / jnp.sqrt(
+        jnp.asarray(rank, jnp.float32)
+    )
+
+
+def _pack_buckets(buckets: list[_Bucket]) -> tuple[np.ndarray, np.ndarray, tuple]:
+    """Flatten a side's buckets into ONE int32 and ONE float32 host array.
+
+    Host→device transfer latency (not bandwidth) dominates small training
+    jobs — 5 arrays × buckets × 2 sides is dozens of round trips; packing
+    makes it two. Shapes are returned as a static tuple so the on-device
+    unpack in :func:`_als_iteration` is plain static slicing."""
+    ints = np.concatenate(
+        [np.concatenate([b.rows, b.cols.ravel()]) for b in buckets]
+    ).astype(np.int32)
+    floats = np.concatenate(
+        [
+            np.concatenate([b.ratings.ravel(), b.weights.ravel(), b.row_valid])
+            for b in buckets
+        ]
+    ).astype(np.float32)
+    shapes = tuple((len(b.rows), b.cols.shape[1]) for b in buckets)
+    return ints, floats, shapes
+
+
+def _unpack_buckets(ints, floats, shapes, shard):
+    """Static-offset slicing of the packed arrays back into bucket tensors,
+    resharding each onto the mesh ``data`` axis (ICI, cheap) so the solves
+    run with the same layout as individually-transferred buckets."""
+    out = []
+    oi = of = 0
+    for n, k in shapes:
+        rows = ints[oi : oi + n]
+        cols = ints[oi + n : oi + n + n * k].reshape(n, k)
+        oi += n + n * k
+        ratings = floats[of : of + n * k].reshape(n, k)
+        weights = floats[of + n * k : of + 2 * n * k].reshape(n, k)
+        row_valid = floats[of + 2 * n * k : of + 2 * n * k + n]
+        of += 2 * n * k + n
+        b = (rows, cols, ratings, weights, row_valid)
+        if shard is not None:
+            b = tuple(jax.lax.with_sharding_constraint(x, shard) for x in b)
+        out.append(b)
+    return out
+
+
+def _packed_len(shapes: tuple) -> tuple[int, int]:
+    """(int32 length, float32 length) of one side's packed blob."""
+    ints = sum(n + n * k for n, k in shapes)
+    floats = sum(2 * n * k + n for n, k in shapes)
+    return ints, floats
+
+
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "user_shapes", "item_shapes", "shard"),
+    donate_argnums=(0, 1),
+)
+def _als_iteration(
+    user_f,
+    item_f,
+    ints,  # both sides' packed int32 blob (user first)
+    floats,  # both sides' packed float32 blob (user first)
+    lambda_: float,
+    alpha: float,
+    *,
+    implicit: bool,
+    rank: int,
+    user_shapes: tuple,
+    item_shapes: tuple,
+    shard=None,
+):
+    """One full ALS iteration — both half-solves over every degree bucket —
+    as a single XLA program. Fusing the whole iteration removes per-bucket
+    dispatch overhead (the dominant cost at small problem sizes) and lets
+    XLA overlap the bucket solves' gathers/scatters."""
+    ui_len, uf_len = _packed_len(user_shapes)
+    user_buckets = _unpack_buckets(
+        ints[:ui_len], floats[:uf_len], user_shapes, shard
+    )
+    item_buckets = _unpack_buckets(
+        ints[ui_len:], floats[uf_len:], item_shapes, shard
+    )
+    return _iteration_body(
+        user_f, item_f, user_buckets, item_buckets, lambda_, alpha,
+        implicit, rank,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank"),
+    donate_argnums=(0, 1),
+)
+def _als_iteration_sharded(
+    user_f,
+    item_f,
+    user_buckets,  # pytree of per-bucket tuples, already sharded on device
+    item_buckets,
+    lambda_: float,
+    alpha: float,
+    *,
+    implicit: bool,
+    rank: int,
+):
+    """Large-job variant: buckets were transferred individually with the
+    batch sharding, so each device holds 1/n of the rating data for the whole
+    run (no replication of the blobs — see ALS.train's size cutover)."""
+    return _iteration_body(
+        user_f, item_f, user_buckets, item_buckets, lambda_, alpha,
+        implicit, rank,
+    )
+
+
+def _iteration_body(
+    user_f, item_f, user_buckets, item_buckets, lambda_, alpha, implicit, rank
+):
+    zeros_gram = jnp.zeros((rank, rank), user_f.dtype)
+    yty = _gram(item_f) if implicit else zeros_gram
+    for b in user_buckets:
+        user_f = _solve_bucket(
+            user_f, item_f, *b, yty, lambda_, alpha, implicit, rank
+        )
+    xtx = _gram(user_f) if implicit else zeros_gram
+    for b in item_buckets:
+        item_f = _solve_bucket(
+            item_f, user_f, *b, xtx, lambda_, alpha, implicit, rank
+        )
+    return user_f, item_f
 
 
 @jax.jit
@@ -215,51 +354,70 @@ class ALS:
             p.rank,
         )
 
+        multi = ctx.mesh.devices.size > 1
         key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
         ku, ki = jax.random.split(key)
-        # MLlib-style init: small random factors, scaled by 1/sqrt(rank)
-        user_f = jax.device_put(
-            jax.random.normal(ku, (n_users, p.rank), jnp.float32)
-            / jnp.sqrt(p.rank),
-            ctx.replicated,
-        )
-        item_f = jax.device_put(
-            jax.random.normal(ki, (n_items, p.rank), jnp.float32)
-            / jnp.sqrt(p.rank),
-            ctx.replicated,
-        )
+        user_f = _init_factors(ku, n_users, p.rank)
+        item_f = _init_factors(ki, n_items, p.rank)
+        if multi:  # single-chip: factors already live where they must
+            user_f = jax.device_put(user_f, ctx.replicated)
+            item_f = jax.device_put(item_f, ctx.replicated)
 
-        shard = ctx.batch_sharding()
-        dev_user_buckets = [self._put_bucket(b, shard) for b in user_buckets]
-        dev_item_buckets = [self._put_bucket(b, shard) for b in item_buckets]
-        zeros_gram = jnp.zeros((p.rank, p.rank), jnp.float32)
+        u_ints, u_floats, u_shapes = _pack_buckets(user_buckets)
+        i_ints, i_floats, i_shapes = _pack_buckets(item_buckets)
+        packed_bytes = (
+            u_ints.nbytes + u_floats.nbytes + i_ints.nbytes + i_floats.nbytes
+        )
+        # Two transfer strategies (latency vs HBM): small jobs pack ALL
+        # rating data into ONE int32 + ONE float32 replicated transfer
+        # (host→device round trips dominate at this scale); large multi-chip
+        # jobs transfer per-bucket with the batch sharding so each device
+        # holds 1/n of the data instead of a full replica.
+        pack = not multi or packed_bytes <= _PACK_REPLICATE_MAX_BYTES
+        if pack:
+            ints = np.concatenate([u_ints, i_ints])
+            floats = np.concatenate([u_floats, i_floats])
+            if multi:
+                ints, floats = jax.device_put((ints, floats), ctx.replicated)
+            else:
+                ints, floats = jnp.asarray(ints), jnp.asarray(floats)
+            shard = ctx.batch_sharding() if multi else None
+        else:
+            bshard = ctx.batch_sharding()
+            dev_user_buckets = tuple(
+                tuple(
+                    jax.device_put(x, bshard)
+                    for x in (b.rows, b.cols, b.ratings, b.weights, b.row_valid)
+                )
+                for b in user_buckets
+            )
+            dev_item_buckets = tuple(
+                tuple(
+                    jax.device_put(x, bshard)
+                    for x in (b.rows, b.cols, b.ratings, b.weights, b.row_valid)
+                )
+                for b in item_buckets
+            )
 
         for it in range(p.num_iterations):
-            yty = _gram(item_f) if p.implicit_prefs else zeros_gram
-            for b in dev_user_buckets:
-                user_f = _solve_bucket(
-                    user_f, item_f, *b, yty, p.lambda_, p.alpha,
-                    p.implicit_prefs, p.rank,
+            if pack:
+                user_f, item_f = _als_iteration(
+                    user_f, item_f, ints, floats, p.lambda_, p.alpha,
+                    implicit=p.implicit_prefs, rank=p.rank,
+                    user_shapes=u_shapes, item_shapes=i_shapes, shard=shard,
                 )
-            xtx = _gram(user_f) if p.implicit_prefs else zeros_gram
-            for b in dev_item_buckets:
-                item_f = _solve_bucket(
-                    item_f, user_f, *b, xtx, p.lambda_, p.alpha,
-                    p.implicit_prefs, p.rank,
+            else:
+                user_f, item_f = _als_iteration_sharded(
+                    user_f, item_f, dev_user_buckets, dev_item_buckets,
+                    p.lambda_, p.alpha,
+                    implicit=p.implicit_prefs, rank=p.rank,
                 )
             if callback is not None:
                 callback(it, user_f, item_f)
 
-        return ALSFactors(np.asarray(user_f), np.asarray(item_f))
-
-    def _put_bucket(self, b: _Bucket, shard):
-        return (
-            jax.device_put(b.rows, shard),
-            jax.device_put(b.cols, shard),
-            jax.device_put(b.ratings, shard),
-            jax.device_put(b.weights, shard),
-            jax.device_put(b.row_valid, shard),
-        )
+        # one readback for both factor matrices
+        packed = np.asarray(jnp.concatenate([user_f, item_f], axis=0))
+        return ALSFactors(packed[:n_users], packed[n_users:])
 
     def rmse(
         self,

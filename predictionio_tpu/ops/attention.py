@@ -107,6 +107,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     last (minor) grid dimension so the VMEM scratch accumulators carry the
     online-softmax state across kv blocks for a fixed q block."""
     kb = pl.program_id(2)
+    qb = pl.program_id(1)
 
     @pl.when(kb == 0)
     def _init():
@@ -114,28 +115,39 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]  # [blk_q, D]
-    k = k_ref[0]  # [blk_k, D]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    def _compute():
+        q = q_ref[0]  # [blk_q, D]
+        k = k_ref[0]  # [blk_k, D]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        mask = None
+        if causal:
+            q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = q_pos >= k_pos
+            s_masked = jnp.where(mask, s, NEG_INF)
+        else:
+            s_masked = s
+
+        m_prev = m_ref[:]          # [blk_q, 1]
+        m_new = jnp.maximum(m_prev[:, 0], s_masked.max(axis=-1))[:, None]
+        p = jnp.exp(s_masked - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)  # [blk_q, 1]
+        l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
 
     if causal:
-        qb = pl.program_id(1)
-        q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = q_pos >= k_pos
-        s = jnp.where(mask, s, NEG_INF)
-
-    m_prev = m_ref[:]          # [blk_q, 1]
-    m_new = jnp.maximum(m_prev[:, 0], s.max(axis=-1))[:, None]
-    p = jnp.exp(s - m_new)
-    if causal:
-        p = jnp.where(mask, p, 0.0)
-    corr = jnp.exp(m_prev - m_new)  # [blk_q, 1]
-    l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * corr + jnp.dot(
-        p.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
-    )
-    m_ref[:] = m_new
+        # Blocks fully past the diagonal (first key position > last query
+        # position) are entirely masked: skip their matmuls — roughly halves
+        # causal kernel time vs computing provably-zero contributions.
+        pl.when(kb * blk_k <= qb * blk_q + (blk_q - 1))(_compute)
+    else:
+        _compute()
 
     @pl.when(kb == n_kb - 1)
     def _finalize():

@@ -127,6 +127,24 @@ def test_top_k_kernels(ctx):
     assert idx[0][0] == 0
 
 
+def test_sharded_transfer_path_matches_packed(ctx, monkeypatch):
+    """Above the replication cutover ALS transfers buckets individually with
+    the batch sharding; results must match the packed path exactly."""
+    import predictionio_tpu.models.als as als_mod
+
+    ui, ii, r, full = synthetic()
+    p = ALSParams(rank=4, num_iterations=3, lambda_=0.01, seed=1)
+    packed = ALS(ctx, p).train(ui, ii, r, 60, 40)
+    monkeypatch.setattr(als_mod, "_PACK_REPLICATE_MAX_BYTES", 0)
+    sharded = ALS(ctx, p).train(ui, ii, r, 60, 40)
+    np.testing.assert_allclose(
+        packed.user_features, sharded.user_features, rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        packed.item_features, sharded.item_features, rtol=2e-4, atol=2e-4
+    )
+
+
 def test_zero_ratings_raises(ctx):
     als = ALS(ctx, ALSParams())
     with pytest.raises(ValueError):
