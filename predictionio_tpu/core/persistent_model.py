@@ -73,6 +73,47 @@ def load_persistent_model(
     return cls.load(instance_id, manifest.params_json, ctx)
 
 
+class LocalFileSystemPersistentModel(PersistentModel):
+    """Filesystem-backed persistent model using array-tree checkpoints.
+
+    Re-design of the reference's convenience pair
+    ``LocalFileSystemPersistentModel(-Loader)``
+    (ref: controller/LocalFileSystemPersistentModel.scala:40-64, which
+    Spark-saves to ``/tmp/<id>``): subclasses implement ``to_state()`` →
+    pytree and ``from_state(state, ctx)`` → model, and the checkpoint lands
+    under ``$PIO_FS_BASEDIR/persistent_models/<instance_id>/``.
+    """
+
+    @staticmethod
+    def _dir(instance_id: str):
+        from pathlib import Path
+
+        from predictionio_tpu.data.storage.registry import _default_base_dir
+
+        return Path(_default_base_dir()) / "persistent_models" / instance_id
+
+    def to_state(self) -> Any:
+        """Pytree of arrays/scalars capturing the model."""
+        raise NotImplementedError
+
+    @classmethod
+    def from_state(cls, state: Any, ctx: ComputeContext):
+        """Rebuild the model from :meth:`to_state` output."""
+        raise NotImplementedError
+
+    def save(self, instance_id: str, params: Any) -> bool:
+        from predictionio_tpu.utils.checkpoint import save_pytree
+
+        save_pytree(self._dir(instance_id), self.to_state())
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, params: Any, ctx: ComputeContext):
+        from predictionio_tpu.utils.checkpoint import load_pytree
+
+        return cls.from_state(load_pytree(cls._dir(instance_id)), ctx)
+
+
 def serialize_models(models: list[Any]) -> bytes:
     """Automatic persistence (the reference's Kryo stage,
     ref: CoreWorkflow.scala:74-79)."""

@@ -1,0 +1,223 @@
+"""SASRec-style sequential recommendation transformer.
+
+The reference has no sequence model (it predates LLMs; SURVEY.md §5
+"Long-context: absent") — this is the TPU build's long-context model family:
+a causal self-attention transformer over each user's interaction history
+(SASRec, arxiv 1808.09781 pattern), built on the shared attention ops
+(:mod:`predictionio_tpu.ops.attention`), which scale to long histories via
+the flash kernel and ring attention.
+
+Design notes (TPU-first):
+- item id 0 is the padding id; embeddings row 0 stays zero-masked out of
+  attention and loss.
+- training step is one jitted program: forward over [B, L], sampled-negative
+  binary CE at every position (the SASRec objective), adam update. Batch
+  rows shard over the mesh ``data`` axis; parameters are replicated
+  (dp — GSPMD inserts the gradient all-reduce).
+- serving scores are one matmul of the last hidden state against the item
+  embedding table + ``lax.top_k`` (same shape as the ALS serving path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from predictionio_tpu.ops.attention import mha_attention
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@dataclass(frozen=True)
+class SASRecParams:
+    max_len: int = 50
+    embed_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 2
+    ffn_dim: int = 128
+    dropout: float = 0.2
+    learning_rate: float = 1e-3
+    batch_size: int = 128
+    num_epochs: int = 20
+    l2_emb: float = 0.0
+    seed: int = 0
+
+
+def init_params(n_items: int, p: SASRecParams, key=None) -> dict:
+    """Parameter pytree. ``n_items`` excludes the padding id; the embedding
+    table has ``n_items + 1`` rows with row 0 = padding."""
+    if key is None:
+        key = jax.random.PRNGKey(p.seed)
+    d, h = p.embed_dim, p.ffn_dim
+    keys = jax.random.split(key, 2 + 6 * p.num_blocks)
+    scale = 0.02
+    params = {
+        "item_emb": scale * jax.random.normal(keys[0], (n_items + 1, d)),
+        "pos_emb": scale * jax.random.normal(keys[1], (p.max_len, d)),
+        "blocks": [],
+        "ln_f": {"g": jnp.ones(d), "b": jnp.zeros(d)},
+    }
+    for i in range(p.num_blocks):
+        k = keys[2 + 6 * i : 8 + 6 * i]
+        params["blocks"].append(
+            {
+                "wq": scale * jax.random.normal(k[0], (d, d)),
+                "wk": scale * jax.random.normal(k[1], (d, d)),
+                "wv": scale * jax.random.normal(k[2], (d, d)),
+                "wo": scale * jax.random.normal(k[3], (d, d)),
+                "ln1": {"g": jnp.ones(d), "b": jnp.zeros(d)},
+                "ln2": {"g": jnp.ones(d), "b": jnp.zeros(d)},
+                "w1": scale * jax.random.normal(k[4], (d, h)),
+                "b1": jnp.zeros(h),
+                "w2": scale * jax.random.normal(k[5], (h, d)),
+                "b2": jnp.zeros(d),
+            }
+        )
+    return params
+
+
+def _layer_norm(x, g, b, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
+def forward(params: dict, seqs, p: SASRecParams, *, dropout_key=None):
+    """Hidden states [B, L, D] for padded item-id sequences [B, L] (0=pad).
+    ``dropout_key`` enables dropout (training); None disables (serving)."""
+    b, l = seqs.shape
+    d = p.embed_dim
+    valid = (seqs > 0)[..., None]  # [B, L, 1]
+    x = params["item_emb"][seqs] * jnp.sqrt(jnp.asarray(d, jnp.float32))
+    x = x + params["pos_emb"][None, :l]
+    x = jnp.where(valid, x, 0.0)
+
+    def dropout(key, t):
+        if dropout_key is None or p.dropout <= 0.0:
+            return t
+        keep = jax.random.bernoulli(key, 1.0 - p.dropout, t.shape)
+        return jnp.where(keep, t / (1.0 - p.dropout), 0.0)
+
+    keys = (
+        jax.random.split(dropout_key, 2 * p.num_blocks + 1)
+        if dropout_key is not None
+        else [None] * (2 * p.num_blocks + 1)
+    )
+    x = dropout(keys[0], x) if dropout_key is not None else x
+    n_heads = p.num_heads
+    head_dim = d // n_heads
+    for i, blk in enumerate(params["blocks"]):
+        h = _layer_norm(x, blk["ln1"]["g"], blk["ln1"]["b"])
+        q = (h @ blk["wq"]).reshape(b, l, n_heads, head_dim)
+        k = (h @ blk["wk"]).reshape(b, l, n_heads, head_dim)
+        v = (h @ blk["wv"]).reshape(b, l, n_heads, head_dim)
+        attn = mha_attention(
+            q, k, v, causal=True, kv_mask=seqs > 0
+        ).reshape(b, l, d)
+        attn = attn @ blk["wo"]
+        if dropout_key is not None:
+            attn = dropout(keys[1 + 2 * i], attn)
+        x = jnp.where(valid, x + attn, 0.0)
+        h = _layer_norm(x, blk["ln2"]["g"], blk["ln2"]["b"])
+        f = jax.nn.relu(h @ blk["w1"] + blk["b1"]) @ blk["w2"] + blk["b2"]
+        if dropout_key is not None:
+            f = dropout(keys[2 + 2 * i], f)
+        x = jnp.where(valid, x + f, 0.0)
+    return _layer_norm(x, params["ln_f"]["g"], params["ln_f"]["b"])
+
+
+def _loss_fn(params, seqs, pos, neg, key, p: SASRecParams):
+    """SASRec objective: binary CE of (positive next item vs one sampled
+    negative) at every non-pad position. pos/neg are [B, L] target ids."""
+    h = forward(params, seqs, p, dropout_key=key)  # [B, L, D]
+    pos_logit = jnp.einsum("bld,bld->bl", h, params["item_emb"][pos])
+    neg_logit = jnp.einsum("bld,bld->bl", h, params["item_emb"][neg])
+    mask = (pos > 0).astype(jnp.float32)
+    loss = -(
+        jax.nn.log_sigmoid(pos_logit) + jax.nn.log_sigmoid(-neg_logit)
+    ) * mask
+    loss = loss.sum() / jnp.maximum(mask.sum(), 1.0)
+    if p.l2_emb > 0.0:
+        loss = loss + p.l2_emb * (params["item_emb"] ** 2).sum()
+    return loss
+
+
+@partial(jax.jit, static_argnames=("p",), donate_argnums=(0, 1))
+def _train_step(params, opt_state, seqs, pos, neg, key, tx_lr, p: SASRecParams):
+    loss, grads = jax.value_and_grad(_loss_fn)(params, seqs, pos, neg, key, p)
+    updates, opt_state = optax.adam(tx_lr).update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+
+@partial(jax.jit, static_argnames=("k", "p"))
+def predict_top_k(params, seqs, k: int, p: SASRecParams, exclude_mask=None):
+    """Top-k next items for padded sequences [B, L]: last hidden state @
+    item embedding table. ``exclude_mask`` [B, n_items+1] True → drop
+    (padding id and seen items)."""
+    h = forward(params, seqs, p)  # [B, L, D]
+    # sequences are LEFT-padded, so the last real item is always at L-1
+    last = h[:, -1]
+    scores = last @ params["item_emb"].T  # [B, n_items+1]
+    scores = scores.at[:, 0].set(-jnp.inf)  # never recommend padding
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, k)
+
+
+class SASRec:
+    """Training driver mirroring the ALS driver's shape."""
+
+    def __init__(self, ctx: ComputeContext, params: SASRecParams):
+        self.ctx = ctx
+        self.p = params
+
+    def train(self, sequences: list[list[int]], n_items: int,
+              callback=None) -> dict:
+        """``sequences``: per-user item-id lists (ids 1..n_items, time
+        order). Returns the trained parameter pytree."""
+        p = self.p
+        rng = np.random.default_rng(p.seed)
+        seqs, pos = _make_training_arrays(sequences, p.max_len)
+        n = len(seqs)
+        if n == 0:
+            raise ValueError("SASRec.train called with no sequences")
+        params = init_params(n_items, p)
+        opt_state = optax.adam(p.learning_rate).init(params)
+        key = jax.random.PRNGKey(p.seed)
+        bs = min(p.batch_size, n)
+        steps_per_epoch = max(n // bs, 1)
+        for epoch in range(p.num_epochs):
+            order = rng.permutation(n)
+            for s in range(steps_per_epoch):
+                idx = order[s * bs : (s + 1) * bs]
+                if len(idx) < bs:  # static shapes: drop ragged tail batch
+                    continue
+                neg = rng.integers(1, n_items + 1, size=(bs, p.max_len))
+                neg = np.where(pos[idx] > 0, neg, 0).astype(np.int32)
+                key, sub = jax.random.split(key)
+                params, opt_state, loss = _train_step(
+                    params, opt_state, seqs[idx], pos[idx], neg, sub,
+                    p.learning_rate, p,
+                )
+            if callback is not None:
+                callback(epoch, float(loss))
+        return jax.tree_util.tree_map(np.asarray, params)
+
+
+def _make_training_arrays(sequences: list[list[int]], max_len: int):
+    """Left-pad each user's last ``max_len+1`` items into input [n, L] and
+    next-item target [n, L] arrays."""
+    seqs = np.zeros((len(sequences), max_len), dtype=np.int32)
+    pos = np.zeros((len(sequences), max_len), dtype=np.int32)
+    for i, s in enumerate(sequences):
+        s = s[-(max_len + 1):]
+        inp, tgt = s[:-1], s[1:]
+        if not inp:
+            continue
+        seqs[i, -len(inp):] = inp
+        pos[i, -len(tgt):] = tgt
+    return seqs, pos

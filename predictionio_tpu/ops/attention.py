@@ -16,6 +16,11 @@ row of the local block — that is what lets ring attention reuse the same
 masking logic per rotated block. The pallas kernel operates on a full
 (unsharded) sequence and derives positions from its grid indices.
 
+Masking support differs by path: per-row key masks (``kv_mask``, used by
+left-padded sequence batches) exist only on :func:`mha_attention`; the
+flash kernel and ring path support causal + ``kv_valid`` (right-padding)
+masking only.
+
 Shapes: q [B, Lq, H, D]; k, v [B, Lk, H, D]; output [B, Lq, H, D].
 """
 
@@ -50,9 +55,12 @@ def mha_attention(
     q_offset=0,
     k_offset=0,
     kv_valid: int | None = None,
+    kv_mask=None,
 ):
     """Reference attention. ``kv_valid`` masks out key positions >= kv_valid
-    (right-padding of the key/value block)."""
+    (right-padding of the key/value block); ``kv_mask`` [B, Lk] bool masks
+    arbitrary key positions per row (False → hidden; left-padded sequence
+    batches like SASRec's)."""
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -62,11 +70,14 @@ def mha_attention(
         mask = _causal_mask(lq, lk, q_offset, k_offset)
     if kv_valid is not None:
         mask = mask & (jnp.arange(lk)[None, :] < kv_valid)
-    s = jnp.where(mask[None, None], s, NEG_INF)
+    mask = mask[None, None]  # [1|B, 1, lq, lk]
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, :]
+    s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     # Rows with no visible key softmax over all-NEG_INF logits → uniform junk;
     # zero them so fully-masked queries return 0 (matches flash/ring paths).
-    any_visible = mask.any(axis=-1)[None, None, :, None]
+    any_visible = mask.any(axis=-1)[..., None]
     p = jnp.where(any_visible, p, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 

@@ -16,4 +16,5 @@ TEMPLATE_NAMES = (
     "similarproduct",
     "ecommercerecommendation",
     "twotower",
+    "sequentialrecommendation",
 )

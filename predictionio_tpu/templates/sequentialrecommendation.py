@@ -1,0 +1,230 @@
+"""Sequential recommendation engine template (SASRec transformer).
+
+No counterpart exists in the reference (its four stock templates are all
+matrix-factorization/classification era — SURVEY.md §2.6); this template is
+the TPU build's long-context model family made product: next-item
+recommendation from each user's interaction *sequence*, served through the
+same DASE / engine.json / train / deploy surfaces as the stock templates.
+
+Query/result shapes mirror the recommendation template:
+``{"user": ..., "num": N}`` → ``{"itemScores": [{"item", "score"}]}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.core import Engine, FirstServing, P2LAlgorithm, PDataSource, PPreparator
+from predictionio_tpu.core.base import SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.store import PEventStore
+from predictionio_tpu.models.sasrec import (
+    SASRec,
+    SASRecParams,
+    predict_top_k,
+)
+from predictionio_tpu.parallel.mesh import ComputeContext
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple[ItemScore, ...] = ()
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp1"
+    event_names: tuple[str, ...] = ("view", "buy")
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    user_sequences: dict[str, list[str]]  # user → item ids in time order
+
+    def sanity_check(self) -> None:
+        if not self.user_sequences:
+            raise ValueError(
+                "TrainingData has no user sequences; ingest interaction events"
+            )
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        sequences: dict[str, list[str]] = {}
+        for e in PEventStore.find(
+            self.params.app_name, event_names=list(self.params.event_names)
+        ):
+            if e.target_entity_id is None:
+                continue
+            sequences.setdefault(e.entity_id, []).append(e.target_entity_id)
+        # PEventStore.find returns event-time order, so per-user lists are
+        # already chronological
+        return TrainingData(sequences)
+
+
+@dataclass
+class PreparedData:
+    item_ids: BiMap  # item → 1-based index (0 = padding)
+    sequences: list[list[int]]  # per-user encoded sequences
+    users: list[str]
+    popular: list[str]  # cold-start fallback ranking
+
+
+class Preparator(PPreparator):
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx: ComputeContext, td: TrainingData) -> PreparedData:
+        all_items: list[str] = []
+        for seq in td.user_sequences.values():
+            all_items.extend(seq)
+        # 1-based ids: reserve 0 for padding
+        distinct = list(dict.fromkeys(all_items))
+        item_ids = BiMap({it: i + 1 for i, it in enumerate(distinct)})
+        users = list(td.user_sequences)
+        sequences = [
+            [item_ids(it) for it in td.user_sequences[u]] for u in users
+        ]
+        counts: dict[str, int] = {}
+        for it in all_items:
+            counts[it] = counts.get(it, 0) + 1
+        popular = sorted(counts, key=counts.get, reverse=True)
+        return PreparedData(item_ids, sequences, users, popular)
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    max_len: int = 50
+    embed_dim: int = 64
+    num_blocks: int = 2
+    num_heads: int = 2
+    ffn_dim: int = 128
+    dropout: float = 0.2
+    learning_rate: float = 1e-3
+    batch_size: int = 128
+    num_epochs: int = 20
+    seed: int = 0
+    exclude_seen: bool = True  # drop items already in the user's history
+
+
+@dataclass
+class SASRecModel:
+    params: dict  # trained parameter pytree (host arrays)
+    item_ids: BiMap
+    user_sequences: dict[str, list[int]]  # encoded, for serve-time context
+    popular: list[str]
+    hp: SASRecParams
+    exclude_seen: bool = True
+
+
+class SASRecAlgorithm(P2LAlgorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams):
+        self.params = params
+
+    def _hp(self) -> SASRecParams:
+        a = self.params
+        return SASRecParams(
+            max_len=a.max_len, embed_dim=a.embed_dim,
+            num_blocks=a.num_blocks, num_heads=a.num_heads,
+            ffn_dim=a.ffn_dim, dropout=a.dropout,
+            learning_rate=a.learning_rate, batch_size=a.batch_size,
+            num_epochs=a.num_epochs, seed=a.seed,
+        )
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> SASRecModel:
+        hp = self._hp()
+        trained = SASRec(ctx, hp).train(pd.sequences, n_items=len(pd.item_ids))
+        return SASRecModel(
+            params=trained,
+            item_ids=pd.item_ids,
+            user_sequences=dict(zip(pd.users, pd.sequences)),
+            popular=pd.popular,
+            hp=hp,
+            exclude_seen=self.params.exclude_seen,
+        )
+
+    def predict(self, model: SASRecModel, query: Query) -> PredictedResult:
+        seq = model.user_sequences.get(query.user)
+        if not seq:
+            # cold start: most popular items (the ecommerce template's
+            # predictNewUser spirit)
+            return PredictedResult(
+                tuple(
+                    ItemScore(item=it, score=0.0)
+                    for it in model.popular[: query.num]
+                )
+            )
+        hp = model.hp
+        padded = np.zeros((1, hp.max_len), dtype=np.int32)
+        tail = seq[-hp.max_len:]
+        padded[0, -len(tail):] = tail
+        exclude = None
+        if model.exclude_seen:  # full history, not just the model window
+            n_rows = model.params["item_emb"].shape[0]
+            exclude = np.zeros((1, n_rows), dtype=bool)
+            exclude[0, np.asarray(seq, dtype=np.int64)] = True
+        scores, idx = predict_top_k(
+            model.params, padded, query.num, hp, exclude_mask=exclude
+        )
+        scores = np.asarray(scores[0])
+        idx = np.asarray(idx[0])
+        out = []
+        for s, i in zip(scores, idx):
+            if not np.isfinite(s) or i == 0:
+                continue
+            out.append(
+                ItemScore(item=model.item_ids.inverse(int(i)), score=float(s))
+            )
+        return PredictedResult(tuple(out))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"sasrec": SASRecAlgorithm},
+        serving_class=FirstServing,
+    )
+
+
+ENGINE_JSON = {
+    "id": "default",
+    "description": "Sequential recommendation (SASRec transformer)",
+    "engineFactory": (
+        "predictionio_tpu.templates.sequentialrecommendation:engine_factory"
+    ),
+    "datasource": {"params": {"app_name": "MyApp1"}},
+    "algorithms": [
+        {
+            "name": "sasrec",
+            "params": {
+                "max_len": 50, "embed_dim": 64, "num_blocks": 2,
+                "num_heads": 2, "dropout": 0.2, "num_epochs": 20,
+                "seed": 3,
+            },
+        }
+    ],
+}

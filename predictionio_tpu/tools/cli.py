@@ -94,6 +94,8 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("--skip-sanity-check", action="store_true")
     p_train.add_argument("--stop-after-read", action="store_true")
     p_train.add_argument("--stop-after-prepare", action="store_true")
+    p_train.add_argument("--profile", metavar="DIR", default=None,
+                         help="write a JAX device trace (xprof) to DIR")
     p_train.set_defaults(func=cmd_train)
 
     # -- deploy / undeploy (ref: Console.scala:835-922) ---------------------
@@ -268,7 +270,9 @@ def cmd_train(args) -> int:
         engine_params=engine_params,
         batch=args.batch,
     )
-    instance_id = run_train(engine, engine_params, instance, wp)
+    instance_id = run_train(
+        engine, engine_params, instance, wp, trace_dir=args.profile
+    )
     print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
     return 0
 

@@ -1,0 +1,59 @@
+"""Array-tree checkpoints for model persistence.
+
+The reference's unit of persistence is a Kryo blob (CoreWorkflow.scala:74-79)
+or user-managed files (LocalFileSystemPersistentModel.scala:40-64). The
+TPU-native analog (SURVEY.md §5 checkpoint/resume) stores model state as a
+*pytree of arrays* in a dependency-free on-disk format:
+
+    <dir>/
+      structure.json     the tree with integer slot ids at leaf positions
+      tree.json          per-slot metadata (array vs inline JSON value)
+      arrays.npz         leaf arrays keyed by slot id
+
+Containers must be JSON-representable (dicts with string keys, lists;
+tuples load back as lists). Leaves are numpy/jax arrays or JSON scalars.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def save_pytree(directory: str | Path, tree: Any) -> None:
+    """Checkpoint a pytree of arrays (+ JSON-serializable scalar leaves)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    host = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(host)
+    arrays = {}
+    slots = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, np.ndarray):
+            arrays[str(i)] = leaf
+            slots.append({"kind": "array"})
+        else:
+            slots.append({"kind": "json", "value": leaf})
+    (directory / "tree.json").write_text(json.dumps({"slots": slots}))
+    np.savez(directory / "arrays.npz", **arrays)
+    structure = jax.tree_util.tree_unflatten(treedef, list(range(len(leaves))))
+    (directory / "structure.json").write_text(json.dumps(structure))
+
+
+def load_pytree(directory: str | Path) -> Any:
+    """Load a checkpoint written by :func:`save_pytree`."""
+    directory = Path(directory)
+    slots = json.loads((directory / "tree.json").read_text())["slots"]
+    structure = json.loads((directory / "structure.json").read_text())
+    with np.load(directory / "arrays.npz", allow_pickle=False) as z:
+        leaves = [
+            z[str(i)] if slot["kind"] == "array" else slot["value"]
+            for i, slot in enumerate(slots)
+        ]
+    return jax.tree_util.tree_map(lambda i: leaves[i], structure)

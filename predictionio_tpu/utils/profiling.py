@@ -1,0 +1,54 @@
+"""Profiling hooks: JAX device traces + lightweight phase timers.
+
+The reference has no profiler of its own — per-query bookkeeping on the
+engine server and Spark UI job timings (SURVEY.md §5 "Tracing/profiling";
+ref: CreateServer.scala:418-420,603-610). The TPU build exposes the real
+thing: :func:`device_trace` wraps a region in ``jax.profiler.trace`` so
+xprof/TensorBoard shows the XLA op timeline, and :class:`PhaseTimer`
+records wall-clock per workflow phase (read/prepare/train per algorithm),
+surfaced in train logs and the engine-instance record.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str | None):
+    """Wrap a region in a JAX profiler trace when ``trace_dir`` is set
+    (no-op otherwise). View with TensorBoard's profile plugin / xprof."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+    logger.info("device trace written to %s", trace_dir)
+
+
+@dataclass
+class PhaseTimer:
+    """Wall-clock per named phase; one line per phase on report()."""
+
+    phases: list[tuple[str, float]] = field(default_factory=list)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases.append((name, time.perf_counter() - t0))
+
+    def report(self) -> dict[str, float]:
+        out = {name: round(dt, 4) for name, dt in self.phases}
+        for name, dt in self.phases:
+            logger.info("phase %-20s %8.3fs", name, dt)
+        return out

@@ -31,16 +31,23 @@ def run_train(
     engine_params: EngineParams,
     engine_instance: EngineInstance,
     params: WorkflowParams | None = None,
+    trace_dir: str | None = None,
 ) -> str:
     """Train → persist models → mark instance COMPLETED
-    (ref: CoreWorkflow.runTrain:42-99). Returns the instance id."""
+    (ref: CoreWorkflow.runTrain:42-99). Returns the instance id.
+    ``trace_dir`` wraps training in a JAX device trace (xprof)."""
+    from predictionio_tpu.utils.profiling import PhaseTimer, device_trace
+
     wp = params or WorkflowParams()
     instances = Storage.get_meta_data_engine_instances()
     instance_id = instances.insert(engine_instance)
     logger.info("engine instance %s: INIT", instance_id)
     try:
         ctx = workflow_context(batch=wp.batch, mode="Training")
-        models = engine.train(ctx, engine_params, wp)
+        timer = PhaseTimer()
+        with device_trace(trace_dir), timer.phase("train"):
+            models = engine.train(ctx, engine_params, wp)
+        timer.report()
         # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
         algorithms = engine._algorithms(engine_params)
         persisted = []

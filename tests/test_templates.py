@@ -286,3 +286,22 @@ class TestECommerce:
         assert len(result.itemScores) > 0
         # a user with no history at all → empty
         assert algo.predict(model, Query(user="ghost", num=4)).itemScores == ()
+
+
+class TestTemplateContracts:
+    def test_every_template_declares_query_class(self):
+        """Every template algorithm must bind a query_class, or the query
+        server hands predict() a raw dict (regression: sequentialrecommendation)."""
+        import importlib
+
+        from predictionio_tpu.templates import TEMPLATE_NAMES
+
+        for name in TEMPLATE_NAMES:
+            mod = importlib.import_module(f"predictionio_tpu.templates.{name}")
+            engine = mod.engine_factory()
+            for algo_name, algo_cls in engine.algorithm_class_map.items():
+                assert getattr(algo_cls, "query_class", None) is not None, (
+                    f"{name}:{algo_name} has no query_class"
+                )
+            variant = mod.ENGINE_JSON
+            assert variant["engineFactory"].startswith("predictionio_tpu.templates.")
