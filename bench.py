@@ -1,16 +1,29 @@
-"""Headline benchmark: ALS training throughput (MovieLens-100K scale).
+"""Headline benchmark: ALS training throughput at MovieLens-20M scale.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-The reference publishes no benchmark numbers (BASELINE.md: "published": {});
-its equivalent workload is MLlib ALS inside `pio train`
-(ref: examples/scala-parallel-recommendation/.../ALSAlgorithm.scala:27-67,
-rank 10 / 20 iterations on MovieLens). We measure full ALS iterations/sec
-(both half-solves, all degree buckets) at ML-100K scale — 943 users, 1682
-items, 100k ratings, rank 10 — on the available accelerator. vs_baseline is
-relative to a conservative Spark-MLlib-local reference of 0.5 iter/s for
-this workload class (MLlib ALS local-mode iterations are O(seconds) each);
-the real comparison is re-measured by the driver across rounds.
+The north-star metric (BASELINE.json) is **MovieLens-20M ALS iterations per
+second**. The reference's equivalent workload is MLlib ALS inside
+`pio train` (ref: examples/scala-parallel-recommendation/.../
+ALSAlgorithm.scala:27-67, rank 10 / 20 iterations). We measure full ALS
+iterations/sec (both half-solves, all degree buckets) on:
+
+  * **ML-20M shape** — 138,493 users × 26,744 items × 20M ratings, rank 10
+    (the stock template's engine.json default) — the headline number — and
+    rank 64 for an MXU-utilization (MFU) reading; the rank-10 problem is
+    HBM-gather-bound by construction.
+  * **ML-100K shape** — 943 × 1,682 × 100k, rank 10 — kept for
+    round-over-round continuity with BENCH_r01.
+
+`extra` also reports achieved FLOP/s and MFU (executed FLOPs incl. padding ÷
+bf16 peak for the detected TPU generation — conservative: the solves run in
+f32) and the p50/p99 REST predict latency measured through the deployed
+query-server hot path (see serving bench below).
+
+vs_baseline: Spark MLlib local-mode ALS on ML-20M runs O(10s+) per
+iteration (treeAggregate + block shuffles on a single host); we use a
+conservative 0.1 iter/s for the headline ratio. The real comparison is
+re-measured by the driver across rounds.
 """
 
 from __future__ import annotations
@@ -21,11 +34,14 @@ import time
 import numpy as np
 
 
-def synthesize_ml100k(seed: int = 0):
-    """ML-100K-shaped synthetic ratings (same size/sparsity/degree skew)."""
+# --------------------------------------------------------------------------
+# Synthetic MovieLens-shaped data
+# --------------------------------------------------------------------------
+
+
+def synthesize(n_users: int, n_items: int, nnz: int, seed: int = 0):
+    """MovieLens-shaped synthetic ratings: zipf-ish user/item degree skew."""
     rng = np.random.default_rng(seed)
-    n_users, n_items, nnz = 943, 1682, 100_000
-    # zipf-ish item popularity, matching MovieLens' skew
     item_p = 1.0 / np.arange(1, n_items + 1) ** 0.8
     item_p /= item_p.sum()
     user_p = 1.0 / np.arange(1, n_users + 1) ** 0.6
@@ -33,38 +49,146 @@ def synthesize_ml100k(seed: int = 0):
     ui = rng.choice(n_users, nnz, p=user_p).astype(np.int32)
     ii = rng.choice(n_items, nnz, p=item_p).astype(np.int32)
     r = rng.integers(1, 6, nnz).astype(np.float32)
-    return ui, ii, r, n_users, n_items
+    return ui, ii, r
+
+
+def synthesize_ml100k(seed: int = 0):
+    ui, ii, r = synthesize(943, 1682, 100_000, seed)
+    return ui, ii, r, 943, 1682
+
+
+def synthesize_ml20m(seed: int = 0):
+    ui, ii, r = synthesize(138_493, 26_744, 20_000_000, seed)
+    return ui, ii, r, 138_493, 26_744
+
+
+# --------------------------------------------------------------------------
+# FLOP model (executed work, including bucket padding)
+# --------------------------------------------------------------------------
+
+
+def _padded_shapes(idx: np.ndarray, params, ctx) -> list[tuple[int, int]]:
+    """(n_rows_padded, width) per degree bucket for one side — mirrors
+    models/als._bucketize's grouping without materializing the tiles."""
+    _, counts = np.unique(idx, return_counts=True)
+    widths = [w for w in params.bucket_widths if w <= params.max_degree]
+    if not widths or widths[-1] < params.max_degree:
+        widths.append(params.max_degree)
+    shapes = []
+    for bi, width in enumerate(widths):
+        lo = widths[bi - 1] if bi > 0 else 0
+        if bi == len(widths) - 1:
+            sel = counts > lo
+        else:
+            sel = (counts > lo) & (counts <= width)
+        n = int(sel.sum())
+        if n:
+            shapes.append((ctx.pad_to_multiple(n), width))
+    return shapes
+
+
+def flops_per_iteration(u_shapes, i_shapes, rank: int) -> float:
+    """Executed FLOPs of one full ALS iteration (both half-solves): per
+    bucket row batch [n, k] — gram einsum 2nkr², rhs 2nkr, Cholesky nr³/3,
+    two triangular solves 2nr²."""
+    total = 0.0
+    for shapes in (u_shapes, i_shapes):
+        for n, k in shapes:
+            total += 2 * n * k * rank * rank + 2 * n * k * rank
+            total += n * rank**3 / 3 + 2 * n * rank * rank
+    return total
+
+
+#: bf16 peak FLOP/s by TPU generation (conservative denominator: the ALS
+#: solves run in f32). Public numbers; v5e = "TFRT TPU v5 lite".
+_PEAK_BF16 = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in _PEAK_BF16.items():
+        if tag in kind:
+            return peak
+    return None
+
+
+# --------------------------------------------------------------------------
+# ALS throughput
+# --------------------------------------------------------------------------
+
+
+def bench_als(ctx, ui, ii, r, n_users, n_items, rank: int, iters: int):
+    from predictionio_tpu.models.als import ALS, ALSParams
+
+    warm = ALS(ctx, ALSParams(rank=rank, num_iterations=1, seed=0))
+    warm.train(ui, ii, r, n_users, n_items)  # compile all bucket shapes
+
+    als = ALS(ctx, ALSParams(rank=rank, num_iterations=iters, seed=0))
+    t0 = time.perf_counter()
+    factors = als.train(ui, ii, r, n_users, n_items)
+    np.asarray(factors.user_features)  # block
+    dt = time.perf_counter() - t0
+    return iters / dt, factors
 
 
 def main() -> None:
-    from predictionio_tpu.models.als import ALS, ALSParams
+    from predictionio_tpu.models.als import ALSParams
     from predictionio_tpu.parallel.mesh import compute_context
 
     ctx = compute_context()
-    ui, ii, r, n_users, n_items = synthesize_ml100k()
+    dev = ctx.mesh.devices.flat[0]
+    peak = peak_flops(dev)
+    extra: dict = {"device": getattr(dev, "device_kind", str(dev)),
+                   "n_devices": int(ctx.mesh.devices.size)}
 
-    als = ALS(ctx, ALSParams(rank=10, num_iterations=1, seed=0))
-    # warmup: compile all bucket shapes
-    als.train(ui, ii, r, n_users, n_items)
+    # --- ML-100K continuity number (rank 10 / 20 iters, template default)
+    ui, ii, r, nu, ni = synthesize_ml100k()
+    ml100k_ips, _ = bench_als(ctx, ui, ii, r, nu, ni, rank=10, iters=20)
+    extra["ml100k_als_rank10_iter_per_sec"] = round(ml100k_ips, 3)
 
-    # rank 10 / 20 iterations = the stock template's engine.json defaults
-    # (ref: examples/scala-parallel-recommendation engine.json)
-    iters = 20
-    als_timed = ALS(ctx, ALSParams(rank=10, num_iterations=iters, seed=0))
-    t0 = time.perf_counter()
-    factors = als_timed.train(ui, ii, r, n_users, n_items)
-    np.asarray(factors.user_features)  # block
-    dt = time.perf_counter() - t0
+    # --- ML-20M north star (rank 10, template default)
+    ui, ii, r, nu, ni = synthesize_ml20m()
+    ml20m_ips, _ = bench_als(ctx, ui, ii, r, nu, ni, rank=10, iters=10)
+    p = ALSParams(rank=10)
+    u_shapes = _padded_shapes(ui, p, ctx)
+    i_shapes = _padded_shapes(ii, p, ctx)
+    fl10 = flops_per_iteration(u_shapes, i_shapes, 10)
+    extra["ml20m_rank10_gflop_per_iter"] = round(fl10 / 1e9, 2)
+    extra["ml20m_rank10_achieved_gflops"] = round(fl10 * ml20m_ips / 1e9, 1)
+    pad = sum(n * k for n, k in u_shapes) / max(len(r), 1)
+    extra["pad_ratio"] = round(pad, 2)
 
-    iter_per_sec = iters / dt
-    baseline_iter_per_sec = 0.5  # Spark MLlib local-mode class, see docstring
+    # --- ML-20M rank 64: MXU-utilization reading (larger contractions)
+    ml20m64_ips, _ = bench_als(ctx, ui, ii, r, nu, ni, rank=64, iters=3)
+    fl64 = flops_per_iteration(u_shapes, i_shapes, 64)
+    extra["ml20m_rank64_iter_per_sec"] = round(ml20m64_ips, 3)
+    extra["ml20m_rank64_achieved_tflops"] = round(fl64 * ml20m64_ips / 1e12, 2)
+    if peak:
+        extra["mfu_rank10"] = round(fl10 * ml20m_ips / peak, 4)
+        extra["mfu_rank64"] = round(fl64 * ml20m64_ips / peak, 4)
+        extra["peak_bf16_tflops"] = peak / 1e12
+
+    # --- serving latency (p50/p99 REST predict through the query server)
+    try:
+        from bench_serving import bench_query_latency
+
+        extra.update(bench_query_latency())
+    except Exception as e:  # serving bench must never sink the headline
+        extra["serving_bench_error"] = repr(e)
+
+    baseline_iter_per_sec = 0.1  # Spark MLlib local-mode class, see docstring
     print(
         json.dumps(
             {
-                "metric": "ml100k_als_rank10_iterations_per_sec",
-                "value": round(iter_per_sec, 3),
+                "metric": "ml20m_als_rank10_iterations_per_sec",
+                "value": round(ml20m_ips, 3),
                 "unit": "iter/s",
-                "vs_baseline": round(iter_per_sec / baseline_iter_per_sec, 2),
+                "vs_baseline": round(ml20m_ips / baseline_iter_per_sec, 2),
+                "extra": extra,
             }
         )
     )

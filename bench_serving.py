@@ -1,0 +1,170 @@
+"""REST predict-latency bench: p50/p99 of POST /queries.json.
+
+BASELINE.json's second metric is "p50 REST predict latency". This measures
+the deployed query-server hot path end to end (HTTP parse → JSON query
+binding → batched device predict → serve → JSON response) on the
+recommendation template at ML-100K catalog scale, sequentially (true
+per-request latency) and under concurrency (where the MicroBatcher
+coalesces requests into one device call — the path the reference leaves
+sequential, ref: CreateServer.scala:513-520).
+
+Importable (bench.py calls bench_query_latency) or runnable standalone.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+
+def _setup_storage():
+    from predictionio_tpu.data.storage import Storage
+
+    for key in list(os.environ):
+        if key.startswith("PIO_STORAGE_"):
+            del os.environ[key]
+    os.environ["PIO_STORAGE_SOURCES_MEM_TYPE"] = "memory"
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        os.environ[f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"] = "MEM"
+        os.environ[f"PIO_STORAGE_REPOSITORIES_{repo}_NAME"] = f"bench_{repo.lower()}"
+    Storage.reset()
+    return Storage
+
+
+def _seed_and_train(storage, n_users=943, n_items=1682, nnz=30_000):
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.templates.recommendation import engine_factory
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "benchapp"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    uu = rng.integers(0, n_users, nnz)
+    ii = rng.integers(0, n_items, nnz)
+    rr = rng.integers(1, 6, nnz)
+    for u, i, r in zip(uu, ii, rr):
+        events.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({"rating": float(r)})),
+            app_id,
+        )
+    engine = engine_factory()
+    variant = {
+        "engineFactory": factory,
+        "datasource": {"params": {"app_name": "benchapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 10, "numIterations": 5, "seed": 0}}
+        ],
+    }
+    ep = engine.engine_params_from_json(variant)
+    instance = new_engine_instance("default", "1", "default", factory, ep)
+    run_train(engine, ep, instance, WorkflowParams())
+
+
+class _Client:
+    """Keep-alive HTTP client (one connection per thread)."""
+
+    def __init__(self, port: int):
+        self.conn = http.client.HTTPConnection("127.0.0.1", port)
+
+    def query(self, user: str, num: int = 10) -> float:
+        body = json.dumps({"user": user, "num": num})
+        t0 = time.perf_counter()
+        self.conn.request(
+            "POST", "/queries.json", body,
+            {"Content-Type": "application/json"},
+        )
+        resp = self.conn.getresponse()
+        data = resp.read()
+        dt = time.perf_counter() - t0
+        if resp.status != 200:
+            raise RuntimeError(f"query failed: {resp.status} {data[:200]!r}")
+        return dt
+
+    def close(self):
+        self.conn.close()
+
+
+def bench_query_latency(
+    seq_requests: int = 300, threads: int = 8, per_thread: int = 100
+) -> dict:
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    storage = _setup_storage()
+    try:
+        _seed_and_train(storage)
+        srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+        srv.start()
+        try:
+            c = _Client(srv.port)
+            for k in range(30):  # warmup: compile all top_k shapes in play
+                c.query(f"u{k % 900}", 10)
+
+            # -- sequential: true per-request latency
+            lat = [c.query(f"u{k % 900}", 10) for k in range(seq_requests)]
+            c.close()
+            seq = np.asarray(lat) * 1e3
+
+            # -- concurrent: batcher coalesces, measure tail + throughput
+            all_lat: list[list[float]] = [[] for _ in range(threads)]
+            errors: list[Exception] = []
+
+            def worker(tid: int):
+                try:
+                    cc = _Client(srv.port)
+                    for k in range(per_thread):
+                        all_lat[tid].append(cc.query(f"u{(tid * 131 + k) % 900}"))
+                    cc.close()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            conc = np.asarray([x for xs in all_lat for x in xs]) * 1e3
+            out = {
+                "serve_p50_ms": round(float(np.percentile(seq, 50)), 2),
+                "serve_p99_ms": round(float(np.percentile(seq, 99)), 2),
+                "serve_conc_p50_ms": round(float(np.percentile(conc, 50)), 2),
+                "serve_conc_p99_ms": round(float(np.percentile(conc, 99)), 2),
+                "serve_qps": round(len(conc) / wall, 1),
+                "serve_concurrency": threads,
+            }
+            if service.batcher is not None:
+                out["serve_max_batch_seen"] = service.batcher.max_batch_seen
+            return out
+        finally:
+            srv.stop()
+    finally:
+        from predictionio_tpu.data.storage import Storage
+
+        Storage.reset()
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_query_latency()))

@@ -154,6 +154,7 @@ def intern_interactions(
     ii: list[int] = []
     rr: list[float] = []
     ni: list[int] = []
+    tt: list[int] = []
     name_to_idx = {n: k for k, n in enumerate(event_names)}
     for ev in events:
         if ev.event not in name_to_idx or ev.target_entity_id is None:
@@ -161,6 +162,7 @@ def intern_interactions(
         ui.append(users.setdefault(ev.entity_id, len(users)))
         ii.append(items.setdefault(ev.target_entity_id, len(items)))
         ni.append(name_to_idx[ev.event])
+        tt.append(_to_us(ev.event_time))
         v = default_rating
         if rating_key is not None:
             raw = ev.properties.get_opt(rating_key)
@@ -174,10 +176,17 @@ def intern_interactions(
                 except ValueError:
                     pass
         rr.append(v)
+    # Rows come out event-time sorted (stable, so file order breaks ties) to
+    # honor the store-wide convention that event reads are time-ordered —
+    # every other PEventStore.interaction_indices path goes through find(),
+    # which sorts by event time.
+    order = np.argsort(np.asarray(tt, dtype=np.int64), kind="stable")
     return (
         list(users), list(items),
-        np.asarray(ui, dtype=np.int32), np.asarray(ii, dtype=np.int32),
-        np.asarray(rr, dtype=np.float32), np.asarray(ni, dtype=np.int32),
+        np.asarray(ui, dtype=np.int32)[order],
+        np.asarray(ii, dtype=np.int32)[order],
+        np.asarray(rr, dtype=np.float32)[order],
+        np.asarray(ni, dtype=np.int32)[order],
     )
 
 
@@ -200,6 +209,9 @@ class ELogClient:
         self.base_dir = Path(path)
         self.base_dir.mkdir(parents=True, exist_ok=True)
         self.lock = threading.RLock()
+        # Per-file {event_id: live-record offset} caches keyed by the file
+        # size they were built at; kept fresh incrementally under the lock.
+        self.id_index: dict[Path, tuple[int, dict[str, int]]] = {}
 
     def close(self) -> None:
         pass
@@ -233,6 +245,7 @@ class ELogEvents(base.Events):
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
         path = self._path(app_id, channel_id)
         with self._c.lock:
+            self._c.id_index.pop(path, None)
             if not path.exists():
                 return False
             path.unlink()
@@ -254,13 +267,42 @@ class ELogEvents(base.Events):
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         path = self._require(app_id, channel_id)
         eid = event.event_id or new_event_id()
+        rec = encode_record(event, eid)
         with self._c.lock:
             if event.event_id is not None:
                 self._tombstone(path, event.event_id)  # upsert semantics
             with path.open("ab") as f:
-                f.write(encode_record(event, eid))
+                off = f.tell()
+                f.write(rec)
                 f.flush()
+            cached = self._c.id_index.get(path)
+            if cached is not None and cached[0] == off:
+                cached[1][eid] = off
+                self._c.id_index[path] = (off + len(rec), cached[1])
         return eid
+
+    def _id_index(self, path: Path) -> dict[str, int]:
+        """event_id → live-record offset, cached per file and maintained
+        incrementally under the client lock; rebuilt in one pass when the
+        file grew outside this process. Makes bulk imports of preset-id
+        events (``pio import`` of an export file) O(N) instead of one full
+        file scan per record."""
+        size = path.stat().st_size
+        cached = self._c.id_index.get(path)
+        if cached is not None and cached[0] == size:
+            return cached[1]
+        idx: dict[str, int] = {}
+        buf = path.read_bytes()
+        pos = len(MAGIC)
+        while True:
+            ev, next_pos, flags = decode_record(buf, pos)
+            if ev is None:
+                break
+            if not (flags & 1):
+                idx[ev.event_id] = pos
+            pos = next_pos
+        self._c.id_index[path] = (size, idx)
+        return idx
 
     def _find_offset(self, path: Path, event_id: str) -> int:
         lib = self._lib()
@@ -279,7 +321,7 @@ class ELogEvents(base.Events):
             pos = next_pos
 
     def _tombstone(self, path: Path, event_id: str) -> bool:
-        off = self._find_offset(path, event_id)
+        off = self._id_index(path).pop(event_id, -1)
         if off < 0:
             return False
         with path.open("r+b") as f:
@@ -432,7 +474,9 @@ class ELogEvents(base.Events):
         """Decode (entity → target) events into columnar arrays in one native
         pass: returns (user_ids, item_ids, user_idx[i32], item_idx[i32],
         ratings[f32], name_idx[i32]) where ``user_ids[user_idx[k]]`` is row
-        k's entity id and ``event_names[name_idx[k]]`` its event name.
+        k's entity id and ``event_names[name_idx[k]]`` its event name. Rows
+        are event-time sorted (stable; insertion order breaks ties) to match
+        the time-ordered contract of every find()-based read path.
         Falls back to a Python pass without the C++ library."""
         if not event_names:
             raise ValueError("interactions requires at least one event name")
@@ -479,6 +523,9 @@ class ELogEvents(base.Events):
             ni = np.frombuffer(
                 ctypes.string_at(name_idx, rows * 4), dtype=np.int32
             ).copy()
+            ts = np.frombuffer(
+                ctypes.string_at(time_us, rows * 8), dtype=np.int64
+            ).copy()
             users = self._decode_blob(
                 ctypes.string_at(users_blob, users_len.value), n_users.value
             )
@@ -489,7 +536,8 @@ class ELogEvents(base.Events):
             for p in (user_idx, item_idx, rating, name_idx, time_us,
                       users_blob, items_blob):
                 lib.pio_free(p)
-        return users, items, ui, ii, rr, ni
+        order = np.argsort(ts, kind="stable")  # time-ordered, like find()
+        return users, items, ui[order], ii[order], rr[order], ni[order]
 
     @staticmethod
     def _decode_blob(blob: bytes, count: int) -> list[str]:

@@ -1,4 +1,4 @@
-"""SQL storage backend (SQLite) — the full-coverage backend.
+"""SQL storage backend — SQLite embedded + dialect layer for server DBs.
 
 Plays the role of the reference's JDBC backend, its only backend covering
 events + all metadata + models in one database
@@ -8,6 +8,12 @@ matching the reference's table-per-app layout (ref: JDBCUtils.eventTableName),
 with an ``(entityType, entityId, eventTime)`` index serving the same
 entity-time range scans the HBase rowkey serves
 (ref: data/.../storage/hbase/HBEventsUtil.scala:81-128).
+
+Like the reference's scalikejdbc layer spanning PostgreSQL and MySQL with
+one DAO implementation (ref: JDBCUtils.scala driverType branches), the DAO
+classes here are written against a small :class:`Dialect` — SQLite is the
+embedded default; :mod:`predictionio_tpu.data.storage.postgres` provides
+the server-database flavor over the pure-Python wire client.
 """
 
 from __future__ import annotations
@@ -37,8 +43,49 @@ from predictionio_tpu.data.storage.base import (
 from predictionio_tpu.utils.time import format_datetime, parse_datetime, to_millis
 
 
+class Dialect:
+    """SQL flavor differences consulted by the DAO classes. The base class
+    is the SQLite dialect; subclasses override the handful of divergences
+    (the reference handles the same split via JDBCUtils driverType)."""
+
+    name = "sqlite"
+    integrity_errors: tuple = (sqlite3.IntegrityError,)
+    autoinc_pk = "INTEGER PRIMARY KEY AUTOINCREMENT"
+    bigint = "INTEGER"
+    blob = "BLOB"
+
+    def upsert_sql(
+        self, table: str, cols: Sequence[str], keys: Sequence[str]
+    ) -> str:
+        ph = ",".join("?" * len(cols))
+        return (
+            f'INSERT OR REPLACE INTO "{table}" ({", ".join(cols)}) '
+            f"VALUES ({ph})"
+        )
+
+    def table_exists(self, client: "SQLClient", table: str) -> bool:
+        return bool(
+            client.query(
+                "SELECT 1 FROM sqlite_master WHERE type='table' AND name=?",
+                (table,),
+            )
+        )
+
+    def insert_autoid(
+        self, client: "SQLClient", table: str, cols: Sequence[str], values
+    ) -> int:
+        """INSERT a row into a table with an auto-increment id; return it."""
+        ph = ",".join("?" * len(cols))
+        cur = client.execute(
+            f'INSERT INTO "{table}" ({", ".join(cols)}) VALUES ({ph})', values
+        )
+        return cur.lastrowid
+
+
 class SQLClient:
     """One sqlite database shared by all DAOs of a storage source."""
+
+    dialect: Dialect = Dialect()
 
     def __init__(self, config: dict | None = None):
         config = config or {}

@@ -57,6 +57,11 @@ class ALSParams:
     seed: int | None = None
     max_degree: int = 4096  # per-entity neighbor cap (oversized rows truncate)
     bucket_widths: tuple[int, ...] = (16, 64, 256, 1024, 4096)
+    #: Multi-chip transfer strategy cutover (see ALS.train): packed blobs up
+    #: to this size are replicated (one transfer, n_devices × HBM copies);
+    #: larger jobs transfer per-bucket with the batch sharding so each
+    #: device holds 1/n of the rating data.
+    pack_replicate_max_bytes: int = _PACK_REPLICATE_MAX_BYTES
 
 
 @dataclass
@@ -192,6 +197,10 @@ def _pack_buckets(buckets: list[_Bucket]) -> tuple[np.ndarray, np.ndarray, tuple
     jobs — 5 arrays × buckets × 2 sides is dozens of round trips; packing
     makes it two. Shapes are returned as a static tuple so the on-device
     unpack in :func:`_als_iteration` is plain static slicing."""
+    if not buckets:  # a side with no ratings solves nothing
+        return (
+            np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float32), ()
+        )
     ints = np.concatenate(
         [np.concatenate([b.rows, b.cols.ravel()]) for b in buckets]
     ).astype(np.int32)
@@ -373,7 +382,7 @@ class ALS:
         # (host→device round trips dominate at this scale); large multi-chip
         # jobs transfer per-bucket with the batch sharding so each device
         # holds 1/n of the data instead of a full replica.
-        pack = not multi or packed_bytes <= _PACK_REPLICATE_MAX_BYTES
+        pack = not multi or packed_bytes <= p.pack_replicate_max_bytes
         if pack:
             ints = np.concatenate([u_ints, i_ints])
             floats = np.concatenate([u_floats, i_floats])

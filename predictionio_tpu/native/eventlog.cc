@@ -33,6 +33,7 @@
 // racing an append) is treated as end-of-file.
 
 #include <algorithm>
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -278,10 +279,30 @@ bool json_top_level_number(const char* s, uint32_t len, const char* key,
       // Python fallback in eventlog.py intern_interactions.
       bool quoted = (*p == '"');
       const char* num_start = quoted ? p + 1 : p;
-      char* parse_end = nullptr;
-      double v = std::strtod(num_start, &parse_end);
-      if (parse_end == num_start) return false;
-      if (quoted && *parse_end != '"') return false;  // e.g. "4.5x"
+      const char* num_end = end;
+      if (quoted) {  // bound the parse at the closing quote
+        const char* q = num_start;
+        while (q < end && *q != '"') q++;
+        if (q == end) return false;  // unterminated string
+        num_end = q;
+        // Mirror the Python fallback's float(str): tolerate surrounding
+        // whitespace and a leading '+', which from_chars rejects.
+        while (num_start < num_end &&
+               (*num_start == ' ' || (*num_start >= '\t' && *num_start <= '\r')))
+          num_start++;
+        while (num_end > num_start &&
+               (num_end[-1] == ' ' || (num_end[-1] >= '\t' && num_end[-1] <= '\r')))
+          num_end--;
+        if (num_start < num_end && *num_start == '+') num_start++;
+      }
+      // std::from_chars: locale-independent (strtod honors LC_NUMERIC and
+      // would mis-parse "4.5" under comma-decimal locales) and bounded (the
+      // mmap'd buffer is not null-terminated, so strtod could read past it
+      // on a truncated final record).
+      double v = 0.0;
+      auto res = std::from_chars(num_start, num_end, v);
+      if (res.ec != std::errc() || res.ptr == num_start) return false;
+      if (quoted && res.ptr != num_end) return false;  // e.g. "4.5x"
       *out = v;
       return true;
     }

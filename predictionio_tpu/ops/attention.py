@@ -16,10 +16,11 @@ row of the local block — that is what lets ring attention reuse the same
 masking logic per rotated block. The pallas kernel operates on a full
 (unsharded) sequence and derives positions from its grid indices.
 
-Masking support differs by path: per-row key masks (``kv_mask``, used by
-left-padded sequence batches) exist only on :func:`mha_attention`; the
-flash kernel and ring path support causal + ``kv_valid`` (right-padding)
-masking only.
+Masking support differs by path: arbitrary per-row key masks (``kv_mask``,
+used by left-padded sequence batches) exist only on :func:`mha_attention`;
+the flash kernel and ring path support causal + ``kv_valid`` (right-padding)
+masking — on the flash kernel ``kv_valid`` may be a scalar or a per-batch
+[B] array of valid key counts.
 
 Shapes: q [B, Lq, H, D]; k, v [B, Lk, H, D]; output [B, Lq, H, D].
 """
@@ -111,12 +112,13 @@ def _online_block_update(q, k, v, num, den, m, *, causal, q_offset, k_offset,
     return num, den, m_new
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                   blk_q: int, blk_k: int, n_kb: int, causal: bool,
-                  scale: float):
+                  scale: float, has_kv: bool):
     """Pallas kernel body. Grid = (B*H, n_qb, n_kb); kv blocks iterate in the
     last (minor) grid dimension so the VMEM scratch accumulators carry the
-    online-softmax state across kv blocks for a fixed q block."""
+    online-softmax state across kv blocks for a fixed q block. ``kv_ref`` is
+    a per-(batch·head) valid-key count in SMEM, used only when ``has_kv``."""
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -136,14 +138,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             mask = q_pos >= k_pos
-            s_masked = jnp.where(mask, s, NEG_INF)
-        else:
-            s_masked = s
+        if has_kv:
+            k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            kvm = k_pos < kv_ref[0, 0]
+            mask = kvm if mask is None else mask & kvm
+        s_masked = s if mask is None else jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]          # [blk_q, 1]
         m_new = jnp.maximum(m_prev[:, 0], s_masked.max(axis=-1))[:, None]
         p = jnp.exp(s_masked - m_new)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)  # [blk_q, 1]
         l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
@@ -152,17 +156,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         )
         m_ref[:] = m_new
 
+    # Skip provably-all-masked blocks entirely: causal blocks fully past the
+    # diagonal (static structure, roughly halves causal kernel time) and
+    # blocks entirely beyond this sequence's valid-key count (dynamic).
+    preds = []
     if causal:
-        # Blocks fully past the diagonal (first key position > last query
-        # position) are entirely masked: skip their matmuls — roughly halves
-        # causal kernel time vs computing provably-zero contributions.
-        pl.when(kb * blk_k <= qb * blk_q + (blk_q - 1))(_compute)
+        preds.append(kb * blk_k <= qb * blk_q + (blk_q - 1))
+    if has_kv:
+        preds.append(kb * blk_k < kv_ref[0, 0])
+    if preds:
+        pred = preds[0] if len(preds) == 1 else preds[0] & preds[1]
+        pl.when(pred)(_compute)
     else:
         _compute()
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        if has_kv:
+            # Fully-masked query rows (kv_valid == 0) have l == 0; return 0
+            # for them, matching mha_attention's any_visible zeroing.
+            l = l_ref[:]
+            o_ref[0] = jnp.where(
+                l > 0.0, acc_ref[:] / jnp.maximum(l, 1e-30), 0.0
+            ).astype(o_ref.dtype)
+        else:
+            o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -175,6 +193,7 @@ def flash_attention(
     v,
     *,
     causal: bool = False,
+    kv_valid=None,
     blk_q: int = 128,
     blk_k: int = 128,
     interpret: bool = False,
@@ -183,6 +202,9 @@ def flash_attention(
 
     Heads fold into the grid's batch dimension; each grid step works on a
     [blk_q, D] query tile against a [blk_k, D] key tile entirely in VMEM.
+    ``kv_valid`` (scalar or [B] int) masks out key positions >= kv_valid
+    per batch element (right-padded sequences); blocks entirely beyond the
+    valid count are skipped, not just masked.
     ``interpret=True`` runs the kernel in interpreter mode (CPU CI).
     """
     b, lq, h, d = q.shape
@@ -201,14 +223,23 @@ def flash_attention(
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
 
+    has_kv = kv_valid is not None
+    if has_kv:
+        kv = jnp.broadcast_to(jnp.asarray(kv_valid, jnp.int32), (b,))
+        kv = jnp.repeat(kv, h)[:, None]  # [B*H, 1]
+    else:
+        kv = jnp.zeros((b * h, 1), jnp.int32)
+
     kernel = functools.partial(
         _flash_kernel, blk_q=blk_q, blk_k=blk_k, n_kb=n_kb, causal=causal,
-        scale=scale,
+        scale=scale, has_kv=has_kv,
     )
     out = pl.pallas_call(
         kernel,
         grid=(b * h, n_qb, n_kb),
         in_specs=[
+            pl.BlockSpec((1, 1), lambda bh, qi, ki: (bh, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
@@ -221,5 +252,5 @@ def flash_attention(
             pltpu.VMEM((blk_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(kv, qf, kf, vf)
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)

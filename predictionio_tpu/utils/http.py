@@ -65,6 +65,14 @@ class HTTPError(Exception):
 Handler = Callable[[Request], "tuple[int, Any]"]
 
 
+class _ThreadingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # socketserver's default listen backlog of 5 drops concurrent connection
+    # bursts (ECONNRESET) — the micro-batched serving path exists precisely
+    # to absorb such bursts, so queue them instead.
+    request_queue_size = 128
+
+
 class Router:
     """Method+path-pattern routing. Patterns use ``{name}`` segments, e.g.
     ``/events/{eventId}.json``."""
@@ -171,7 +179,7 @@ class AppServer:
         last_err: OSError | None = None
         for _ in range(3):
             try:
-                self._server = ThreadingHTTPServer(
+                self._server = _ThreadingHTTPServer(
                     (self.host, self.port), self._make_handler()
                 )
                 break

@@ -1,0 +1,87 @@
+"""Micro-batched serving: coalesce concurrent queries into one device call.
+
+The reference's ServerActor answers queries strictly one at a time on an
+actor thread (ref: core/.../workflow/CreateServer.scala:513-520 — the
+predict loop carries a "TODO: Parallelize"). On TPU the predict hot path
+is an XLA program whose cost is nearly flat in batch size (one
+[b, rank] × [rank, n_items] matmul + top_k fills the MXU better as b
+grows), so the TPU-first design queues concurrent requests and runs ONE
+device call over the drained batch: tail latency under load drops from
+O(n_concurrent × t_predict) to ≈ t_predict + queueing.
+
+Greedy drain, no timed window: an idle server answers a lone query
+immediately (zero added latency); batches form exactly when concurrency
+exists — while one batch is on the device, arrivals accumulate and become
+the next batch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, Sequence
+
+__all__ = ["MicroBatcher"]
+
+
+class MicroBatcher:
+    """Single consumer thread draining a submit queue into batched calls.
+
+    ``process_batch(items) -> list[result]`` runs on the consumer thread;
+    a returned item that is an Exception instance fails only its own
+    request, a raised exception fails the whole drained batch.
+    """
+
+    def __init__(
+        self,
+        process_batch: Callable[[Sequence], list],
+        max_batch: int = 64,
+        name: str = "pio-microbatcher",
+    ):
+        self._process = process_batch
+        self.max_batch = max_batch
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        # serving stats (surfaced on the engine-server status page)
+        self.batch_count = 0
+        self.request_count = 0
+        self.max_batch_seen = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
+        self._thread.start()
+
+    def submit(self, item):
+        """Block until the consumer thread has processed ``item``; returns
+        its result or re-raises its exception in the caller thread."""
+        f: Future = Future()
+        self._q.put((item, f))
+        return f.result()
+
+    def _loop(self) -> None:
+        while True:
+            pairs = [self._q.get()]
+            while len(pairs) < self.max_batch:
+                try:
+                    pairs.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            items = [p[0] for p in pairs]
+            futures = [p[1] for p in pairs]
+            self.batch_count += 1
+            self.request_count += len(items)
+            self.max_batch_seen = max(self.max_batch_seen, len(items))
+            try:
+                results = self._process(items)
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"process_batch returned {len(results)} results "
+                        f"for {len(items)} items"
+                    )
+            except Exception as e:
+                for f in futures:
+                    f.set_exception(e)
+                continue
+            for f, r in zip(futures, results):
+                if isinstance(r, Exception):
+                    f.set_exception(r)
+                else:
+                    f.set_result(r)

@@ -127,16 +127,17 @@ def test_top_k_kernels(ctx):
     assert idx[0][0] == 0
 
 
-def test_sharded_transfer_path_matches_packed(ctx, monkeypatch):
+def test_sharded_transfer_path_matches_packed(ctx):
     """Above the replication cutover ALS transfers buckets individually with
-    the batch sharding; results must match the packed path exactly."""
-    import predictionio_tpu.models.als as als_mod
-
+    the batch sharding; results must match the packed path exactly. The
+    cutover is a real ALSParams knob (pack_replicate_max_bytes), so this
+    exercises the production sharded path un-mocked."""
     ui, ii, r, full = synthetic()
     p = ALSParams(rank=4, num_iterations=3, lambda_=0.01, seed=1)
     packed = ALS(ctx, p).train(ui, ii, r, 60, 40)
-    monkeypatch.setattr(als_mod, "_PACK_REPLICATE_MAX_BYTES", 0)
-    sharded = ALS(ctx, p).train(ui, ii, r, 60, 40)
+    p_sharded = ALSParams(rank=4, num_iterations=3, lambda_=0.01, seed=1,
+                          pack_replicate_max_bytes=0)
+    sharded = ALS(ctx, p_sharded).train(ui, ii, r, 60, 40)
     np.testing.assert_allclose(
         packed.user_features, sharded.user_features, rtol=2e-4, atol=2e-4
     )

@@ -172,8 +172,11 @@ def test_interactions_columnar(tmp_path):
         e for e in events
         if e.event in {"view", "buy", "rate"} and e.target_entity_id is not None
     ]
+    # Rows are event-time sorted, stable (insertion order breaks ties) —
+    # the same contract as the find()-based read paths.
+    expected.sort(key=lambda e: e.event_time)
     assert len(ui) == len(ii) == len(rr) == len(ni) == len(expected)
-    for k, e in enumerate(expected):  # file order is insertion order
+    for k, e in enumerate(expected):
         assert users[ui[k]] == e.entity_id
         assert items[ii[k]] == e.target_entity_id
         assert names[ni[k]] == e.event
@@ -205,7 +208,8 @@ def test_interactions_numeric_string_ratings(tmp_path):
     store = ELogEvents(ELogClient({"PATH": str(tmp_path)}))
     store.init(1)
     for k, props in enumerate(
-        [{"rating": "4.5"}, {"rating": "x"}, {"rating": True}, {"rating": 2}]
+        [{"rating": "4.5"}, {"rating": "x"}, {"rating": True}, {"rating": 2},
+         {"rating": "+3.5"}, {"rating": " 2.5 "}, {"rating": "4.5x"}]
     ):
         store.insert(
             Event(event="rate", entity_type="user", entity_id=f"u{k}",
@@ -220,7 +224,7 @@ def test_interactions_numeric_string_ratings(tmp_path):
         def _lib():
             return None
 
-    expected = [4.5, 1.0, 1.0, 2.0]
+    expected = [4.5, 1.0, 1.0, 2.0, 3.5, 2.5, 1.0]
     *_, rr, _ni = store.interactions(1, None, ["rate"], rating_key="rating")
     assert rr.tolist() == expected
     py = PyStore(ELogClient({"PATH": str(tmp_path)}))

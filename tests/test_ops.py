@@ -77,6 +77,48 @@ class TestFlashAttention:
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kv_valid_scalar_matches_mha(self, causal):
+        q, k, v = _qkv(b=2, l=64, h=2, d=16)
+        ref = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=causal, kv_valid=37,
+        )
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=causal, kv_valid=37, blk_q=16, blk_k=16, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_kv_valid_per_batch(self):
+        # Per-example valid lengths (right-padded batch, SASRec serving
+        # shape): each element must match an mha call on its own slice.
+        q, k, v = _qkv(b=3, l=32, h=2, d=8)
+        valid = np.array([32, 17, 5], np.int32)
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, kv_valid=jnp.asarray(valid),
+            blk_q=8, blk_k=8, interpret=True,
+        )
+        for i, n in enumerate(valid):
+            ref = mha_attention(
+                jnp.asarray(q[i:i + 1]), jnp.asarray(k[i:i + 1]),
+                jnp.asarray(v[i:i + 1]), causal=True, kv_valid=int(n),
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[i:i + 1]), np.asarray(ref), atol=1e-4
+            )
+
+    def test_kv_valid_zero_rows_are_zero(self):
+        q, k, v = _qkv(b=2, l=16, h=1, d=8)
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            kv_valid=jnp.asarray([0, 16], np.int32),
+            blk_q=8, blk_k=8, interpret=True,
+        )
+        assert np.all(np.asarray(out[0]) == 0.0)
+        assert np.all(np.isfinite(np.asarray(out[1])))
+
 
 class TestRingAttention:
     def _mesh(self):

@@ -139,6 +139,62 @@ def test_stop_endpoint_releases_wait(server):
     assert not waiter.is_alive()
 
 
+def test_microbatched_concurrent_queries(server):
+    """Concurrent queries coalesce into batched device calls and all return
+    correct per-query results (the batched path must match single-query)."""
+    service = server["service"]
+    assert service.batcher is not None  # ALSAlgorithm has a batched path
+    _, single = call(server["port"], "POST", "/queries.json",
+                     {"user": "u1", "num": 3})
+    results = {}
+    errors = []
+
+    def fire(k, uid, num):
+        try:
+            status, body = call(server["port"], "POST", "/queries.json",
+                                {"user": uid, "num": num})
+            results[k] = (uid, num, status, body)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=fire, args=(k, f"u{k % 20}", 2 + k % 4))
+        for k in range(32)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(results) == 32
+    for uid, num, status, body in results.values():
+        assert status == 200
+        assert len(body["itemScores"]) == num
+        scores = [s["score"] for s in body["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+    # u1's answer through the batch path matches the lone-query answer
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"user": "u1", "num": 3})
+    assert body == single
+    status, body = call(server["port"], "GET", "/")
+    assert body["batching"]["requests"] >= 33
+
+
+def test_batcher_disabled_config(memory_storage):
+    seed_and_train(memory_storage)
+    srv, service = create_server(
+        ServerConfig(ip="127.0.0.1", port=0, batching=False)
+    )
+    srv.start()
+    try:
+        assert service.batcher is None
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 2})
+        assert status == 200 and len(body["itemScores"]) == 2
+    finally:
+        srv.stop()
+
+
 def test_feedback_loop(memory_storage):
     """Deploy with feedback → query → predict event lands in event store."""
     from predictionio_tpu.data.api.event_server import (
