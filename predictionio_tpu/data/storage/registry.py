@@ -38,6 +38,10 @@ BACKEND_TYPES = {
     "localfs": ("predictionio_tpu.data.storage.localfs", "LocalFS"),
     # binary event log with native C++ scan path (the HBase-analog backend)
     "eventlog": ("predictionio_tpu.data.storage.eventlog", "ELog"),
+    # server database over the pure-Python v3 wire client (the JDBC analog)
+    "postgres": ("predictionio_tpu.data.storage.postgres", "PG"),
+    "pgsql": ("predictionio_tpu.data.storage.postgres", "PG"),
+    "jdbc": ("predictionio_tpu.data.storage.postgres", "PG"),
 }
 
 _REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

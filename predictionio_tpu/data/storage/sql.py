@@ -57,10 +57,16 @@ class Dialect:
     def upsert_sql(
         self, table: str, cols: Sequence[str], keys: Sequence[str]
     ) -> str:
+        """INSERT-or-replace keyed on ``keys``. The ``ON CONFLICT … DO
+        UPDATE SET c=excluded.c`` form is shared verbatim by SQLite (3.24+)
+        and PostgreSQL (9.5+) — one statement covers both dialects, the way
+        the reference's scalikejdbc SQL spans Postgres and MySQL."""
         ph = ",".join("?" * len(cols))
+        updates = ", ".join(f"{c}=excluded.{c}" for c in cols if c not in keys)
+        action = f"DO UPDATE SET {updates}" if updates else "DO NOTHING"
         return (
-            f'INSERT OR REPLACE INTO "{table}" ({", ".join(cols)}) '
-            f"VALUES ({ph})"
+            f'INSERT INTO "{table}" ({", ".join(cols)}) VALUES ({ph}) '
+            f"ON CONFLICT ({', '.join(keys)}) {action}"
         )
 
     def table_exists(self, client: "SQLClient", table: str) -> bool:
@@ -134,14 +140,11 @@ class SQLEvents(base.Events):
         return _event_table(self._prefix, app_id, channel_id)
 
     def _exists(self, table: str) -> bool:
-        return bool(
-            self._c.query(
-                "SELECT 1 FROM sqlite_master WHERE type='table' AND name=?", (table,)
-            )
-        )
+        return self._c.dialect.table_exists(self._c, table)
 
     def init(self, app_id: int, channel_id: int | None = None) -> bool:
         t = self._t(app_id, channel_id)
+        d = self._c.dialect
         with self._c.lock:
             self._c.execute(
                 f"""CREATE TABLE IF NOT EXISTS "{t}" (
@@ -153,7 +156,7 @@ class SQLEvents(base.Events):
                     targetEntityId TEXT,
                     properties TEXT NOT NULL,
                     eventTime TEXT NOT NULL,
-                    eventTimeMs INTEGER NOT NULL,
+                    eventTimeMs {d.bigint} NOT NULL,
                     tags TEXT NOT NULL,
                     prId TEXT,
                     creationTime TEXT NOT NULL
@@ -191,8 +194,7 @@ class SQLEvents(base.Events):
         t = self._require(app_id, channel_id)
         eid = event.event_id or new_event_id()
         self._c.execute(
-            f'INSERT OR REPLACE INTO "{t}" ({_EVENT_COLS}) '
-            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._c.dialect.upsert_sql(t, _EVENT_COLS.split(", "), ("id",)),
             (
                 eid,
                 event.event,
@@ -305,7 +307,7 @@ class SQLApps(base.Apps):
         self._t = prefix + "apps"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT UNIQUE NOT NULL, "
+            f"id {client.dialect.autoinc_pk}, name TEXT UNIQUE NOT NULL, "
             "description TEXT)"
         )
 
@@ -318,12 +320,13 @@ class SQLApps(base.Apps):
                         (app.id, app.name, app.description),
                     )
                     return app.id
-                cur = self._c.execute(
-                    f'INSERT INTO "{self._t}" (name, description) VALUES (?,?)',
+                return self._c.dialect.insert_autoid(
+                    self._c,
+                    self._t,
+                    ("name", "description"),
                     (app.name, app.description),
                 )
-                return cur.lastrowid
-        except sqlite3.IntegrityError:
+        except self._c.dialect.integrity_errors:
             return None
 
     def _get(self, where: str, params) -> App | None:
@@ -373,7 +376,7 @@ class SQLAccessKeys(base.AccessKeys):
                 (key, access_key.appid, json.dumps(list(access_key.events))),
             )
             return key
-        except sqlite3.IntegrityError:
+        except self._c.dialect.integrity_errors:
             return None
 
     @staticmethod
@@ -420,7 +423,7 @@ class SQLChannels(base.Channels):
         self._t = prefix + "channels"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT NOT NULL, "
+            f"id {client.dialect.autoinc_pk}, name TEXT NOT NULL, "
             "appid INTEGER NOT NULL, UNIQUE(appid, name))"
         )
 
@@ -433,12 +436,13 @@ class SQLChannels(base.Channels):
                         (channel.id, channel.name, channel.appid),
                     )
                     return channel.id
-                cur = self._c.execute(
-                    f'INSERT INTO "{self._t}" (name, appid) VALUES (?,?)',
+                return self._c.dialect.insert_autoid(
+                    self._c,
+                    self._t,
+                    ("name", "appid"),
                     (channel.name, channel.appid),
                 )
-                return cur.lastrowid
-        except sqlite3.IntegrityError:
+        except self._c.dialect.integrity_errors:
             return None
 
     def get(self, channel_id: int):
@@ -481,7 +485,7 @@ class SQLEngineInstances(base.EngineInstances):
             "engineId TEXT, engineVersion TEXT, engineVariant TEXT, "
             "engineFactory TEXT, batch TEXT, env TEXT, sparkConf TEXT, "
             "dataSourceParams TEXT, preparatorParams TEXT, algorithmsParams TEXT, "
-            "servingParams TEXT, startTimeMs INTEGER)"
+            f"servingParams TEXT, startTimeMs {client.dialect.bigint})"
         )
 
     @staticmethod
@@ -527,8 +531,7 @@ class SQLEngineInstances(base.EngineInstances):
     def insert(self, instance: EngineInstance) -> str:
         iid = instance.id or _new_instance_id()
         self._c.execute(
-            f'INSERT OR REPLACE INTO "{self._t}" ({_EI_COLS}) '
-            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._c.dialect.upsert_sql(self._t, _EI_COLS.split(", "), ("id",)),
             self._values(instance, iid),
         )
         return iid
@@ -579,9 +582,11 @@ class SQLEngineManifests(base.EngineManifests):
             "engineFactory TEXT, PRIMARY KEY (id, version))"
         )
 
+    _COLS = ("id", "version", "name", "description", "files", "engineFactory")
+
     def insert(self, manifest: EngineManifest) -> None:
         self._c.execute(
-            f'INSERT OR REPLACE INTO "{self._t}" VALUES (?,?,?,?,?,?)',
+            self._c.dialect.upsert_sql(self._t, self._COLS, ("id", "version")),
             (
                 manifest.id,
                 manifest.version,
@@ -632,7 +637,7 @@ class SQLEvaluationInstances(base.EvaluationInstances):
             "evaluationClass TEXT, engineParamsGeneratorClass TEXT, batch TEXT, "
             "env TEXT, sparkConf TEXT, evaluatorResults TEXT, "
             "evaluatorResultsHTML TEXT, evaluatorResultsJSON TEXT, "
-            "startTimeMs INTEGER)"
+            f"startTimeMs {client.dialect.bigint})"
         )
 
     @staticmethod
@@ -672,8 +677,7 @@ class SQLEvaluationInstances(base.EvaluationInstances):
     def insert(self, instance: EvaluationInstance) -> str:
         iid = instance.id or _new_instance_id()
         self._c.execute(
-            f'INSERT OR REPLACE INTO "{self._t}" ({_EVI_COLS}) '
-            "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            self._c.dialect.upsert_sql(self._t, _EVI_COLS.split(", "), ("id",)),
             self._values(instance, iid),
         )
         return iid
@@ -718,12 +722,12 @@ class SQLModels(base.Models):
         self._t = prefix + "models"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "id TEXT PRIMARY KEY, models BLOB NOT NULL)"
+            f"id TEXT PRIMARY KEY, models {client.dialect.blob} NOT NULL)"
         )
 
     def insert(self, model: Model) -> None:
         self._c.execute(
-            f'INSERT OR REPLACE INTO "{self._t}" (id, models) VALUES (?,?)',
+            self._c.dialect.upsert_sql(self._t, ("id", "models"), ("id",)),
             (model.id, model.models),
         )
 

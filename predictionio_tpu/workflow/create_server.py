@@ -248,10 +248,25 @@ class QueryService:
         return 200, result
 
     def _predict_batch(self, queries: list) -> list:
-        """MicroBatcher consumer: supplement each query, run each algorithm
-        ONCE over the whole batch (batched algorithms get one device call;
-        others loop), then serve per query. Per-query serve errors fail only
-        their own request."""
+        """MicroBatcher consumer with per-request error isolation: when the
+        batch-wide path (supplement / batched predict) raises — e.g. one
+        malformed query poisoning a shared device call — re-run each query
+        alone so only the offender fails, instead of 500ing every request
+        that happened to share the micro-batch."""
+        try:
+            return self._predict_batch_shared(queries)
+        except Exception as e:  # noqa: BLE001
+            if len(queries) == 1:
+                return [e]
+            out = []
+            for q in queries:
+                out.extend(self._predict_batch([q]))
+            return out
+
+    def _predict_batch_shared(self, queries: list) -> list:
+        """One supplement + one (batched) predict per algorithm over the
+        whole drained batch; serve per query. Per-query serve errors fail
+        only their own request."""
         with self.lock:
             algorithms = self.algorithms
             models = self.models

@@ -65,6 +65,54 @@ def eventlog_storage(monkeypatch, tmp_path):
 
 
 @pytest.fixture()
+def postgres_storage(monkeypatch, tmp_path):
+    """Wire all three repositories to the postgres backend.
+
+    Runs against a live server when ``PIO_TEST_POSTGRES_URL`` is set (CI
+    service-container style, like the reference's Travis Postgres); falls
+    back to the hermetic in-process fake server (tests/fake_pg_server.py)
+    speaking the real v3 wire protocol over a real socket.
+    """
+    from predictionio_tpu.data.storage import Storage
+
+    live_url = os.environ.get("PIO_TEST_POSTGRES_URL")
+    for key in list(os.environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    server = None
+    if live_url:
+        url = live_url
+        # a real server persists tables across runs; drop leftovers so the
+        # spec is rerunnable (the fake server gets a fresh :memory: db)
+        from predictionio_tpu.data.storage.postgres import PGClient
+
+        cleaner = PGClient({"URL": url})
+        leftovers = cleaner.query(
+            "SELECT table_name FROM information_schema.tables "
+            "WHERE table_schema=current_schema() AND table_name LIKE ?",
+            ("test\\_%",),
+        )
+        for (name,) in leftovers:
+            cleaner.execute(f'DROP TABLE IF EXISTS "{name}"')
+        cleaner.close()
+    else:
+        from fake_pg_server import FakePostgresServer
+
+        server = FakePostgresServer(auth="scram").start()
+        url = server.url()
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_PGSQL_TYPE", "postgres")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_PGSQL_URL", url)
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "PGSQL")
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"test_{repo.lower()}")
+    Storage.reset()
+    yield Storage
+    Storage.reset()
+    if server is not None:
+        server.stop()
+
+
+@pytest.fixture()
 def sqlite_storage(monkeypatch, tmp_path):
     """Wire all three repositories to a throwaway SQLite database."""
     from predictionio_tpu.data.storage import Storage

@@ -1,0 +1,357 @@
+"""In-process fake PostgreSQL server for backend tests.
+
+Speaks enough of the v3 wire protocol to serve the ``postgres`` storage
+backend end to end — startup, cleartext/MD5/SCRAM-SHA-256 auth, simple
+query — executing the received SQL against an embedded sqlite database
+after a small PG→sqlite dialect translation. This lets the
+backend-parametrized storage spec (the reference's LEventsSpec pattern,
+ref: data/src/test/scala/io/prediction/data/storage/LEventsSpec.scala:21-67,
+which requires a live Postgres from the Travis env) run hermetically:
+DAO → literal rendering → socket → wire protocol → SQL → wire → decode.
+
+Set ``PIO_TEST_POSTGRES_URL`` to run the same spec against a real server
+instead (CI service-container style).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import re
+import socket
+import sqlite3
+import struct
+import threading
+from base64 import b64decode, b64encode
+
+# --------------------------------------------------------------------------
+# PG → sqlite SQL translation
+# --------------------------------------------------------------------------
+
+_ESTRING_RE = re.compile(r"E'((?:[^']|'')*)'")
+_BYTEA_RE = re.compile(r"'\\x([0-9a-fA-F]*)'::bytea")
+_INFOSCHEMA_RE = re.compile(
+    r"FROM\s+information_schema\.tables\s+WHERE\s+"
+    r"(?:table_schema=current_schema\(\)\s+AND\s+)?table_name=",
+    re.IGNORECASE,
+)
+
+
+def translate_sql(sql: str) -> str:
+    sql = _BYTEA_RE.sub(lambda m: "X'" + m.group(1) + "'", sql)
+    # E'..' escape strings: our client doubles backslashes; undo that and
+    # keep the '' quote doubling, which sqlite shares.
+    sql = _ESTRING_RE.sub(
+        lambda m: "'" + m.group(1).replace("\\\\", "\\") + "'", sql
+    )
+    sql = sql.replace("BIGSERIAL PRIMARY KEY", "INTEGER PRIMARY KEY AUTOINCREMENT")
+    sql = sql.replace("BIGINT", "INTEGER")
+    sql = sql.replace("BYTEA", "BLOB")
+    sql = _INFOSCHEMA_RE.sub("FROM sqlite_master WHERE type='table' AND name=", sql)
+    return sql
+
+
+def _oid_for(values) -> int:
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return 16
+        if isinstance(v, int):
+            return 20
+        if isinstance(v, float):
+            return 701
+        if isinstance(v, (bytes, memoryview)):
+            return 17
+        return 25
+    return 25
+
+
+def _encode_value(v) -> bytes | None:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, (bytes, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+def _msg(tag: bytes, body: bytes) -> bytes:
+    return tag + struct.pack("!i", len(body) + 4) + body
+
+
+def _command_tag(sql: str, rowcount: int, nrows: int) -> bytes:
+    verb = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else "OK"
+    if verb == "SELECT":
+        return f"SELECT {nrows}".encode()
+    if verb == "INSERT":
+        return f"INSERT 0 {max(rowcount, nrows, 0)}".encode()
+    if verb in ("UPDATE", "DELETE"):
+        return f"{verb} {max(rowcount, 0)}".encode()
+    return verb.encode()
+
+
+class FakePostgresServer:
+    """Threaded fake server. ``auth`` is one of trust|cleartext|md5|scram."""
+
+    def __init__(
+        self,
+        user: str = "pio",
+        password: str = "pio",
+        database: str = "pio",
+        auth: str = "scram",
+        db_path: str = ":memory:",
+    ):
+        self.user, self.password, self.database, self.auth = (
+            user, password, database, auth,
+        )
+        self._db = sqlite3.connect(
+            db_path, check_same_thread=False, isolation_level=None
+        )
+        self._db_lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(8)
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._rbuf: dict[socket.socket, bytearray] = {}
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FakePostgresServer":
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in list(self._rbuf):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+        self._db.close()
+
+    def url(self) -> str:
+        return (
+            f"postgresql://{self.user}:{self.password}"
+            f"@127.0.0.1:{self.port}/{self.database}"
+        )
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    # -- per-connection protocol -------------------------------------------
+    def _recv_exact(self, conn: socket.socket, n: int) -> bytes:
+        buf = self._rbuf[conn]
+        while len(buf) < n:
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise ConnectionError("client went away")
+            buf += chunk
+        out = bytes(buf[:n])
+        del buf[:n]
+        return out
+
+    def _read_tagged(self, conn) -> tuple[bytes, bytes]:
+        head = self._recv_exact(conn, 5)
+        (length,) = struct.unpack("!i", head[1:5])
+        return head[:1], self._recv_exact(conn, length - 4)
+
+    def _serve(self, conn: socket.socket) -> None:
+        self._rbuf[conn] = bytearray()
+        try:
+            # untagged startup message
+            (length,) = struct.unpack("!i", self._recv_exact(conn, 4))
+            body = self._recv_exact(conn, length - 4)
+            (version,) = struct.unpack_from("!i", body, 0)
+            if version != 196608:
+                conn.close()  # no SSLRequest / cancel support needed
+                return
+            params = dict(
+                zip(*[iter(body[4:].rstrip(b"\x00").split(b"\x00"))] * 2)
+            )
+            user = params.get(b"user", b"").decode()
+            if not self._authenticate(conn, user):
+                return
+            conn.sendall(_msg(b"R", struct.pack("!i", 0)))  # AuthenticationOk
+            for k, v in (("server_version", "14.0 (fake)"),
+                         ("client_encoding", "UTF8"),
+                         ("standard_conforming_strings", "on")):
+                conn.sendall(_msg(b"S", f"{k}\x00{v}\x00".encode()))
+            conn.sendall(_msg(b"K", struct.pack("!ii", os.getpid(), 12345)))
+            conn.sendall(_msg(b"Z", b"I"))
+            while True:
+                tag, body = self._read_tagged(conn)
+                if tag == b"X":
+                    break
+                if tag != b"Q":
+                    conn.sendall(self._error("08P01", f"unsupported {tag!r}"))
+                    conn.sendall(_msg(b"Z", b"I"))
+                    continue
+                self._run_query(conn, body.rstrip(b"\x00").decode())
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._rbuf.pop(conn, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- auth ---------------------------------------------------------------
+    def _expect_password(self, conn) -> bytes:
+        tag, body = self._read_tagged(conn)
+        if tag != b"p":
+            raise ConnectionError(f"expected password message, got {tag!r}")
+        return body
+
+    def _auth_fail(self, conn) -> None:
+        conn.sendall(self._error("28P01", "password authentication failed"))
+        conn.close()
+
+    def _authenticate(self, conn, user: str) -> bool:
+        if user != self.user:
+            self._auth_fail(conn)
+            return False
+        if self.auth == "trust":
+            return True
+        if self.auth == "cleartext":
+            conn.sendall(_msg(b"R", struct.pack("!i", 3)))
+            if self._expect_password(conn).rstrip(b"\x00").decode() != self.password:
+                self._auth_fail(conn)
+                return False
+            return True
+        if self.auth == "md5":
+            salt = os.urandom(4)
+            conn.sendall(_msg(b"R", struct.pack("!i", 5) + salt))
+            inner = hashlib.md5(
+                self.password.encode() + self.user.encode()
+            ).hexdigest()
+            expect = b"md5" + hashlib.md5(inner.encode() + salt).hexdigest().encode()
+            if self._expect_password(conn).rstrip(b"\x00") != expect:
+                self._auth_fail(conn)
+                return False
+            return True
+        if self.auth == "scram":
+            return self._auth_scram(conn)
+        raise ValueError(f"unknown auth mode {self.auth}")
+
+    def _auth_scram(self, conn) -> bool:
+        conn.sendall(_msg(b"R", struct.pack("!i", 10) + b"SCRAM-SHA-256\x00\x00"))
+        body = self._expect_password(conn)
+        mech, rest = body.split(b"\x00", 1)
+        if mech != b"SCRAM-SHA-256":
+            self._auth_fail(conn)
+            return False
+        (ln,) = struct.unpack_from("!i", rest, 0)
+        client_first = rest[4:4 + ln].decode()
+        bare = client_first.split(",", 2)[2]
+        client_nonce = dict(
+            f.split("=", 1) for f in bare.split(",")
+        )["r"]
+        salt, iters = os.urandom(16), 4096
+        server_nonce = client_nonce + b64encode(os.urandom(12)).decode()
+        server_first = (
+            f"r={server_nonce},s={b64encode(salt).decode()},i={iters}"
+        )
+        conn.sendall(
+            _msg(b"R", struct.pack("!i", 11) + server_first.encode())
+        )
+        client_final = self._expect_password(conn).decode()
+        fields = dict(f.split("=", 1) for f in client_final.split(","))
+        without_proof = client_final[: client_final.rindex(",p=")]
+        auth_message = ",".join([bare, server_first, without_proof])
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.password.encode(), salt, iters
+        )
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        signature = hmac.new(
+            stored_key, auth_message.encode(), hashlib.sha256
+        ).digest()
+        expect_proof = bytes(a ^ b for a, b in zip(client_key, signature))
+        if b64decode(fields["p"]) != expect_proof or fields["r"] != server_nonce:
+            self._auth_fail(conn)
+            return False
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        server_sig = hmac.new(
+            server_key, auth_message.encode(), hashlib.sha256
+        ).digest()
+        conn.sendall(
+            _msg(
+                b"R",
+                struct.pack("!i", 12)
+                + b"v=" + b64encode(server_sig),
+            )
+        )
+        return True
+
+    # -- query execution ----------------------------------------------------
+    @staticmethod
+    def _error(sqlstate: str, message: str) -> bytes:
+        body = (
+            b"SERROR\x00" + b"C" + sqlstate.encode() + b"\x00"
+            + b"M" + message.encode() + b"\x00\x00"
+        )
+        return _msg(b"E", body)
+
+    def _run_query(self, conn, sql: str) -> None:
+        translated = translate_sql(sql)
+        try:
+            with self._db_lock:
+                cur = self._db.execute(translated)
+                rows = cur.fetchall()
+                desc = cur.description
+                rowcount = cur.rowcount
+        except sqlite3.IntegrityError as e:
+            conn.sendall(self._error("23505", str(e)))
+            conn.sendall(_msg(b"Z", b"I"))
+            return
+        except sqlite3.Error as e:
+            conn.sendall(self._error("42601", f"{e} in: {translated[:200]}"))
+            conn.sendall(_msg(b"Z", b"I"))
+            return
+        if desc is not None:
+            cols = [d[0] for d in desc]
+            oids = [
+                _oid_for([row[i] for row in rows]) for i in range(len(cols))
+            ]
+            rd = struct.pack("!h", len(cols))
+            for name, oid in zip(cols, oids):
+                rd += name.encode() + b"\x00"
+                rd += struct.pack("!ihihih", 0, 0, oid, -1, -1, 0)
+            conn.sendall(_msg(b"T", rd))
+            for row in rows:
+                dr = struct.pack("!h", len(row))
+                for v in row:
+                    enc = _encode_value(v)
+                    if enc is None:
+                        dr += struct.pack("!i", -1)
+                    else:
+                        dr += struct.pack("!i", len(enc)) + enc
+                conn.sendall(_msg(b"D", dr))
+        conn.sendall(_msg(b"C", _command_tag(sql, rowcount, len(rows)) + b"\x00"))
+        conn.sendall(_msg(b"Z", b"I"))

@@ -1,0 +1,246 @@
+"""Unit tests for the pure-Python Postgres v3 wire client.
+
+Golden-byte checks of the message codecs plus the RFC 7677 SCRAM-SHA-256
+example exchange, and live auth-mode round trips against the in-process
+fake server (tests/fake_pg_server.py) — cleartext, MD5, and SCRAM, which
+covers the reference's JDBC quickstart auth posture
+(ref: conf/pio-env.sh.template PIO_STORAGE_SOURCES_PGSQL_*).
+"""
+
+import struct
+
+import pytest
+
+from fake_pg_server import FakePostgresServer, translate_sql
+from predictionio_tpu.data.storage import pgwire
+from predictionio_tpu.data.storage.pgwire import (
+    Connection,
+    PGError,
+    PGIntegrityError,
+    ScramClient,
+    build_startup,
+    decode_value,
+    error_for,
+    format_literal,
+    parse_command_tag,
+    parse_data_row,
+    parse_pg_url,
+    parse_row_description,
+    render_query,
+)
+
+
+class TestLiterals:
+    def test_basic_types(self):
+        assert format_literal(None) == "NULL"
+        assert format_literal(True) == "TRUE"
+        assert format_literal(False) == "FALSE"
+        assert format_literal(42) == "42"
+        assert format_literal(1.5) == "1.5"
+        assert format_literal("abc") == "'abc'"
+
+    def test_quote_doubling(self):
+        assert format_literal("it's") == "'it''s'"
+
+    def test_backslash_uses_e_string(self):
+        assert format_literal("a\\b") == "E'a\\\\b'"
+        assert format_literal("a\\'b") == "E'a\\\\''b'"
+
+    def test_bytes_hex(self):
+        assert format_literal(b"\x00\xff") == "'\\x00ff'::bytea"
+
+    def test_nul_rejected(self):
+        with pytest.raises(PGError):
+            format_literal("a\x00b")
+
+    def test_nan_inf(self):
+        assert format_literal(float("inf")) == "'inf'::float8"
+
+    def test_render_query(self):
+        assert (
+            render_query("SELECT * FROM t WHERE a=? AND b=?", (1, "x"))
+            == "SELECT * FROM t WHERE a=1 AND b='x'"
+        )
+
+    def test_render_query_count_mismatch(self):
+        with pytest.raises(PGError):
+            render_query("SELECT ?", (1, 2))
+
+
+class TestCodecs:
+    def test_startup_golden_bytes(self):
+        msg = build_startup("u", "d")
+        assert msg == (
+            struct.pack("!i", len(msg))
+            + struct.pack("!i", 196608)
+            + b"user\x00u\x00database\x00d\x00client_encoding\x00UTF8\x00\x00"
+        )
+
+    def test_decode_values(self):
+        assert decode_value(b"7", 20) == 7
+        assert decode_value(b"1.25", 701) == 1.25
+        assert decode_value(b"t", 16) is True
+        assert decode_value(b"f", 16) is False
+        assert decode_value(b"\\x00ff", 17) == b"\x00\xff"
+        assert decode_value(b"abc", 25) == "abc"
+        assert decode_value(None, 25) is None
+
+    def test_command_tags(self):
+        assert parse_command_tag(b"SELECT 5") == 5
+        assert parse_command_tag(b"INSERT 0 3") == 3
+        assert parse_command_tag(b"UPDATE 2") == 2
+        assert parse_command_tag(b"CREATE TABLE") == -1
+
+    def test_row_description_and_data_row(self):
+        body = struct.pack("!h", 1) + b"id\x00" + struct.pack(
+            "!ihihih", 0, 0, 20, 8, -1, 0
+        )
+        assert parse_row_description(body) == [("id", 20)]
+        row = struct.pack("!h", 2) + struct.pack("!i", 1) + b"7" + struct.pack("!i", -1)
+        assert parse_data_row(row) == [b"7", None]
+
+    def test_error_class_mapping(self):
+        assert isinstance(error_for("dup", "23505"), PGIntegrityError)
+        assert not isinstance(error_for("syntax", "42601"), PGIntegrityError)
+
+
+class TestScramRFC7677:
+    """The exact example exchange from RFC 7677 §3."""
+
+    def test_example_exchange(self):
+        c = ScramClient("user", "pencil", nonce="rOprNGfwEbeRWgbNEkqO")
+        assert c.client_first() == "n,,n=user,r=rOprNGfwEbeRWgbNEkqO"
+        server_first = (
+            "r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+            "s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+        )
+        assert c.client_final(server_first) == (
+            "c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+            "p=dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+        )
+        c.verify_server_final("v=6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4=")
+
+    def test_bad_server_signature_rejected(self):
+        c = ScramClient("user", "pencil", nonce="rOprNGfwEbeRWgbNEkqO")
+        c.client_final(
+            "r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+            "s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096"
+        )
+        with pytest.raises(PGError):
+            c.verify_server_final("v=AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA=")
+
+    def test_nonce_must_extend(self):
+        c = ScramClient("user", "pencil", nonce="abc")
+        with pytest.raises(PGError):
+            c.client_final("r=XYZdef,s=QSXCR+Q6sek8bf92,i=4096")
+
+
+class TestParseURL:
+    def test_full(self):
+        assert parse_pg_url("postgresql://u:p@h:5433/db") == {
+            "host": "h", "port": 5433, "user": "u", "password": "p",
+            "database": "db",
+        }
+
+    def test_jdbc_prefix(self):
+        d = parse_pg_url("jdbc:postgresql://example:5432/pio")
+        assert d == {"host": "example", "port": 5432, "database": "pio"}
+
+    def test_minimal(self):
+        assert parse_pg_url("postgres://localhost") == {"host": "localhost"}
+
+
+@pytest.mark.parametrize("auth", ["trust", "cleartext", "md5", "scram"])
+class TestLiveAuthModes:
+    def test_round_trip(self, auth):
+        srv = FakePostgresServer(auth=auth).start()
+        try:
+            conn = Connection(
+                host="127.0.0.1", port=srv.port, user="pio",
+                password="pio", database="pio",
+            )
+            res = conn.execute("SELECT 1 + 1")
+            assert res.rows == [(2,)]
+            conn.close()
+        finally:
+            srv.stop()
+
+    def test_wrong_password_rejected(self, auth):
+        if auth == "trust":
+            pytest.skip("trust mode has no password check")
+        srv = FakePostgresServer(auth=auth).start()
+        try:
+            with pytest.raises((PGError, OSError)):
+                Connection(
+                    host="127.0.0.1", port=srv.port, user="pio",
+                    password="wrong", database="pio",
+                )
+        finally:
+            srv.stop()
+
+
+class TestLiveQueries:
+    def test_dml_rowcount_and_errors(self):
+        srv = FakePostgresServer(auth="trust").start()
+        try:
+            conn = Connection(host="127.0.0.1", port=srv.port, user="pio",
+                              password="pio", database="pio")
+            conn.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v TEXT)")
+            assert conn.execute("INSERT INTO t VALUES (?,?)", (1, "a")).rowcount == 1
+            with pytest.raises(PGIntegrityError):
+                conn.execute("INSERT INTO t VALUES (?,?)", (1, "b"))
+            # connection stays usable after a server error
+            assert conn.execute("UPDATE t SET v=? WHERE id=?", ("c", 1)).rowcount == 1
+            assert conn.execute("SELECT v FROM t").rows == [("c",)]
+            assert conn.execute("DELETE FROM t WHERE id=?", (1,)).rowcount == 1
+            conn.close()
+        finally:
+            srv.stop()
+
+    def test_bytea_and_backslash_round_trip(self):
+        srv = FakePostgresServer(auth="trust").start()
+        try:
+            conn = Connection(host="127.0.0.1", port=srv.port, user="pio",
+                              password="pio", database="pio")
+            conn.execute("CREATE TABLE b (id BIGINT PRIMARY KEY, blob BYTEA, s TEXT)")
+            payload = bytes(range(256))
+            tricky = 'back\\slash "and quote\'s'
+            conn.execute("INSERT INTO b VALUES (?,?,?)", (1, payload, tricky))
+            rows = conn.execute("SELECT blob, s FROM b").rows
+            assert rows == [(payload, tricky)]
+            conn.close()
+        finally:
+            srv.stop()
+
+
+class TestClientReconnect:
+    def test_reconnects_after_server_restart(self, monkeypatch):
+        from predictionio_tpu.data.storage.postgres import PGClient
+
+        srv = FakePostgresServer(auth="scram").start()
+        client = PGClient({"URL": srv.url()})
+        assert client.query("SELECT 40 + 2") == [(42,)]
+        port = srv.port
+        srv.stop()
+        srv2 = FakePostgresServer(auth="scram").start()
+        # land the replacement on the same port so the stored conn kwargs hold
+        monkeypatch.setattr(client, "_kw", {**client._kw, "port": srv2.port})
+        try:
+            assert client.query("SELECT 40 + 2") == [(42,)]
+        finally:
+            client.close()
+            srv2.stop()
+        assert port  # silence unused warnings
+
+
+class TestTranslateSQL:
+    def test_estring_unescape(self):
+        assert translate_sql("SELECT E'a\\\\b'") == "SELECT 'a\\b'"
+
+    def test_bytea_to_sqlite_hex(self):
+        assert translate_sql("VALUES ('\\xdead'::bytea)") == "VALUES (X'dead')"
+
+    def test_type_tokens(self):
+        out = translate_sql("CREATE TABLE x (id BIGSERIAL PRIMARY KEY, n BIGINT, b BYTEA)")
+        assert "AUTOINCREMENT" in out and "BLOB" in out
+        assert "BIGINT" not in out and "BYTEA" not in out

@@ -180,6 +180,33 @@ def test_microbatched_concurrent_queries(server):
     assert body["batching"]["requests"] >= 33
 
 
+def test_poison_query_fails_alone_in_batch(server):
+    """One malformed query sharing a micro-batch must 500 alone: the
+    batch-wide device path fails, the server re-runs each query solo, and
+    the 31 well-formed neighbors still answer 200."""
+    service = server["service"]
+    assert service.batcher is not None
+    results = {}
+
+    def fire(k, body):
+        status, resp = call(server["port"], "POST", "/queries.json", body)
+        results[k] = (status, resp)
+
+    bodies = [
+        {"user": f"u{k % 20}", "num": 3} for k in range(31)
+    ] + [{"user": "u1", "num": "three"}]  # poison: non-int num
+    threads = [
+        threading.Thread(target=fire, args=(k, b)) for k, b in enumerate(bodies)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    statuses = [results[k][0] for k in range(31)]
+    assert statuses == [200] * 31
+    assert results[31][0] == 500
+
+
 def test_batcher_disabled_config(memory_storage):
     seed_and_train(memory_storage)
     srv, service = create_server(

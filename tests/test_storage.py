@@ -26,7 +26,7 @@ from predictionio_tpu.data.storage.base import (
 UTC = dt.timezone.utc
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "postgres"])
 def storage(request):
     return request.getfixturevalue(f"{request.param}_storage")
 
