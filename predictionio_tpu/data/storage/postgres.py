@@ -76,8 +76,12 @@ class PGClient:
     ``lock``, ``execute`` (returns an object with ``rowcount``), ``query``.
 
     A connection lost mid-flight (server restart, idle timeout) is
-    re-established and the statement retried once — every DAO statement is
-    an upsert, keyed delete, or read, so a single retry is safe.
+    re-established and the statement retried once when the statement is
+    idempotent (reads, ON CONFLICT upserts, keyed deletes/updates). Plain
+    INSERTs are NOT retried: the loss may have happened after the server
+    committed but before the client read the reply, and re-executing would
+    either duplicate the row or misreport a success as a unique-constraint
+    failure — those surface the connection error to the caller instead.
     """
 
     dialect: Dialect = PGDialect()
@@ -93,7 +97,7 @@ class PGClient:
             kw["port"] = int(config["PORT"])
         if config.get("USERNAME"):
             kw["user"] = config["USERNAME"]
-        if config.get("PASSWORD") is not None and "PASSWORD" in config:
+        if config.get("PASSWORD") is not None:
             kw["password"] = config["PASSWORD"]
         if config.get("DATABASE"):
             kw["database"] = config["DATABASE"]
@@ -110,6 +114,13 @@ class PGClient:
             pass
         self._conn = pgwire.Connection(**self._kw)
 
+    @staticmethod
+    def _retry_safe(sql: str) -> bool:
+        head = sql.lstrip()[:6].upper()
+        if head != "INSERT":
+            return True  # reads, keyed deletes/updates, DDL
+        return "ON CONFLICT" in sql.upper()  # upserts are idempotent
+
     def execute(self, sql: str, params: Sequence = ()) -> pgwire.Result:
         with self.lock:
             try:
@@ -120,6 +131,8 @@ class PGClient:
                 if isinstance(e, pgwire.PGError) and e.sqlstate:
                     raise
                 self._reconnect()
+                if not self._retry_safe(sql):
+                    raise
                 return self._conn.execute(sql, params)
 
     def query(self, sql: str, params: Sequence = ()) -> list[tuple]:

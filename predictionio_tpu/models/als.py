@@ -452,27 +452,50 @@ class ALS:
 # Serving-side kernels
 # ---------------------------------------------------------------------------
 
+#: Catalogs larger than this route through the chunked MIPS scan
+#: (ops/topk.chunked_topk_scores) instead of one dense [b, n_items] score
+#: matrix — peak serving memory stays O(chunk), not O(n_items). Every
+#: template's predict inherits the dispatch through these two functions.
+CHUNKED_TOPK_THRESHOLD = 32768
+CHUNKED_TOPK_CHUNK = 8192
+
 
 @partial(jax.jit, static_argnames=("k",))
-def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
-    """Batched recommend: scores = q @ Yᵀ (one MXU matmul) + lax.top_k.
-    ``exclude_mask`` [b, n_items] True → drop (seen items, blacklists — the
-    serve-time filters of the ecommerce template)."""
+def _top_k_dense(query_vecs, item_features, k: int, exclude_mask=None):
     scores = query_vecs @ item_features.T  # [b, n_items]
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask, -jnp.inf, scores)
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k",))
+def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
+    """Batched recommend: scores = q @ Yᵀ (one MXU matmul) + lax.top_k.
+    ``exclude_mask`` [b, n_items] True → drop (seen items, blacklists — the
+    serve-time filters of the ecommerce template). Catalogs above
+    ``CHUNKED_TOPK_THRESHOLD`` rows stream through the chunked MIPS kernel."""
+    if item_features.shape[0] > CHUNKED_TOPK_THRESHOLD:
+        from predictionio_tpu.ops.topk import chunked_topk_scores
+
+        return chunked_topk_scores(
+            jnp.asarray(query_vecs), jnp.asarray(item_features), k=k,
+            chunk=CHUNKED_TOPK_CHUNK, exclude_mask=exclude_mask,
+        )
+    return _top_k_dense(query_vecs, item_features, k, exclude_mask)
+
+
+@partial(jax.jit)
+def _l2_normalize(x):
+    return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-9)
+
+
 def top_k_cosine(query_vecs, item_features, k: int, exclude_mask=None):
     """Item-to-item cosine similarity (similarproduct template's scoring,
-    ref: examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala)."""
-    qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=-1, keepdims=True) + 1e-9)
-    yn = item_features / (
-        jnp.linalg.norm(item_features, axis=-1, keepdims=True) + 1e-9
+    ref: examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala).
+    Normalizing both sides reduces cosine to inner product, so large
+    catalogs share the chunked MIPS dispatch of :func:`top_k_scores`."""
+    return top_k_scores(
+        _l2_normalize(jnp.asarray(query_vecs)),
+        _l2_normalize(jnp.asarray(item_features)),
+        k,
+        exclude_mask,
     )
-    scores = qn @ yn.T
-    if exclude_mask is not None:
-        scores = jnp.where(exclude_mask, -jnp.inf, scores)
-    return jax.lax.top_k(scores, k)

@@ -16,6 +16,15 @@ Design notes (TPU-first):
   (dp — GSPMD inserts the gradient all-reduce).
 - serving scores are one matmul of the last hidden state against the item
   embedding table + ``lax.top_k`` (same shape as the ALS serving path).
+- the serving forward routes attention by ``attn_impl``: ``"mha"`` (XLA
+  reference), ``"flash"`` (pallas blockwise kernel — long histories on one
+  chip), ``"ring"`` (sequence-parallel ring over a ``seq`` mesh axis —
+  histories beyond one device's HBM), or ``"auto"`` (flash on TPU once the
+  history window is at least one MXU tile, else mha). Sequences are
+  left-padded, so padding enters all three paths as a ``kv_start`` valid-key
+  window bound. Training always uses the mha path (the pallas kernel
+  defines no VJP); the choice is numerically transparent — all paths share
+  one masking semantics (tests/test_sasrec.py parity tests).
 """
 
 from __future__ import annotations
@@ -28,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from predictionio_tpu.ops.attention import mha_attention
+from predictionio_tpu.ops.attention import flash_attention, mha_attention
 from predictionio_tpu.parallel.mesh import ComputeContext
 
 
@@ -45,6 +54,7 @@ class SASRecParams:
     num_epochs: int = 20
     l2_emb: float = 0.0
     seed: int = 0
+    attn_impl: str = "auto"  # auto | mha | flash | ring (serving forward)
 
 
 def init_params(n_items: int, p: SASRecParams, key=None) -> dict:
@@ -86,9 +96,80 @@ def _layer_norm(x, g, b, eps=1e-6):
     return (x - mu) / jnp.sqrt(var + eps) * g + b
 
 
-def forward(params: dict, seqs, p: SASRecParams, *, dropout_key=None):
+def _flash_block(l: int) -> int:
+    """Largest divisor of ``l`` that fits a 128-row MXU tile."""
+    for bs in range(min(l, 128), 0, -1):
+        if l % bs == 0:
+            return bs
+    return 1
+
+
+def _resolve_attn(p: SASRecParams, *, serving: bool, l: int) -> str:
+    """Pick the attention path for this call. Training always gets the
+    differentiable mha reference (the pallas kernel defines no VJP and the
+    ring path needs a sharded batch); serving honors ``attn_impl``, with
+    ``auto`` = flash on TPU once the window is at least one MXU tile."""
+    impl = p.attn_impl
+    if impl not in ("auto", "mha", "flash", "ring"):
+        raise ValueError(f"unknown attn_impl {impl!r}")
+    if not serving:
+        return "mha"
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu and l >= 128 and _flash_block(l) >= 32:
+            return "flash"
+        return "mha"
+    return impl
+
+
+def _ring_mesh():
+    """All visible devices on a ``seq`` axis (batch axis 1): the serving
+    layout for histories sharded beyond one device."""
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    return Mesh(devices.reshape(1, -1), ("data", "seq"))
+
+
+def _attend(q, k, v, seqs, impl: str, mesh=None):
+    """One attention call [B, L, H, Dh] with SASRec's left-padded masking:
+    causal + valid-key window starting at the first real item. All three
+    impls share the same ``kv_start`` window semantics by construction."""
+    l = seqs.shape[1]
+    kv_start = (l - (seqs > 0).sum(axis=1)).astype(jnp.int32)  # [B]
+    if impl == "mha":
+        return mha_attention(q, k, v, causal=True, kv_start=kv_start)
+    if impl == "flash":
+        bs = _flash_block(l)
+        if bs < 8:
+            raise ValueError(
+                f"attn_impl='flash' needs max_len ({l}) with a tile-sized "
+                f"divisor (>= 8; ideally a multiple of 128); best found {bs}"
+            )
+        return flash_attention(
+            q, k, v, causal=True, kv_start=kv_start, blk_q=bs, blk_k=bs,
+            interpret=jax.default_backend() != "tpu",
+        )
+    if impl == "ring":
+        from predictionio_tpu.ops.ring_attention import ring_self_attention
+
+        n_seq = mesh.shape["seq"]
+        if l % n_seq:
+            raise ValueError(
+                f"ring attention needs max_len ({l}) divisible by the seq "
+                f"axis ({n_seq} devices)"
+            )
+        return ring_self_attention(
+            mesh, q, k, v, causal=True, kv_start=kv_start
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def forward(params: dict, seqs, p: SASRecParams, *, dropout_key=None,
+            mesh=None):
     """Hidden states [B, L, D] for padded item-id sequences [B, L] (0=pad).
-    ``dropout_key`` enables dropout (training); None disables (serving)."""
+    ``dropout_key`` enables dropout (training); None disables (serving).
+    ``mesh`` overrides the device mesh for the ring-attention path."""
     b, l = seqs.shape
     d = p.embed_dim
     valid = (seqs > 0)[..., None]  # [B, L, 1]
@@ -110,14 +191,15 @@ def forward(params: dict, seqs, p: SASRecParams, *, dropout_key=None):
     x = dropout(keys[0], x) if dropout_key is not None else x
     n_heads = p.num_heads
     head_dim = d // n_heads
+    impl = _resolve_attn(p, serving=dropout_key is None, l=l)
+    if impl == "ring" and mesh is None:
+        mesh = _ring_mesh()  # resolve once, not per transformer block
     for i, blk in enumerate(params["blocks"]):
         h = _layer_norm(x, blk["ln1"]["g"], blk["ln1"]["b"])
         q = (h @ blk["wq"]).reshape(b, l, n_heads, head_dim)
         k = (h @ blk["wk"]).reshape(b, l, n_heads, head_dim)
         v = (h @ blk["wv"]).reshape(b, l, n_heads, head_dim)
-        attn = mha_attention(
-            q, k, v, causal=True, kv_mask=seqs > 0
-        ).reshape(b, l, d)
+        attn = _attend(q, k, v, seqs, impl, mesh=mesh).reshape(b, l, d)
         attn = attn @ blk["wo"]
         if dropout_key is not None:
             attn = dropout(keys[1 + 2 * i], attn)
@@ -153,19 +235,35 @@ def _train_step(params, opt_state, seqs, pos, neg, key, tx_lr, p: SASRecParams):
     return optax.apply_updates(params, updates), opt_state, loss
 
 
-@partial(jax.jit, static_argnames=("k", "p"))
-def predict_top_k(params, seqs, k: int, p: SASRecParams, exclude_mask=None):
-    """Top-k next items for padded sequences [B, L]: last hidden state @
-    item embedding table. ``exclude_mask`` [B, n_items+1] True → drop
-    (padding id and seen items)."""
-    h = forward(params, seqs, p)  # [B, L, D]
-    # sequences are LEFT-padded, so the last real item is always at L-1
-    last = h[:, -1]
-    scores = last @ params["item_emb"].T  # [B, n_items+1]
+@partial(jax.jit, static_argnames=("k",))
+def _score_last(item_emb, last, k: int, exclude_mask=None):
+    """Top-k of last-hidden-state scores against the item table."""
+    scores = last @ item_emb.T  # [B, n_items+1]
     scores = scores.at[:, 0].set(-jnp.inf)  # never recommend padding
     if exclude_mask is not None:
         scores = jnp.where(exclude_mask, -jnp.inf, scores)
     return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("k", "p"))
+def _predict_top_k_jit(params, seqs, k: int, p: SASRecParams,
+                       exclude_mask=None):
+    h = forward(params, seqs, p)  # [B, L, D]
+    # sequences are LEFT-padded, so the last real item is always at L-1
+    return _score_last(params["item_emb"], h[:, -1], k, exclude_mask)
+
+
+def predict_top_k(params, seqs, k: int, p: SASRecParams, exclude_mask=None,
+                  mesh=None):
+    """Top-k next items for padded sequences [B, L]: last hidden state @
+    item embedding table. ``exclude_mask`` [B, n_items+1] True → drop
+    (padding id and seen items). The ring-attention path runs the forward
+    eagerly (it places sequence shards itself); mha/flash go through one
+    jitted program."""
+    if _resolve_attn(p, serving=True, l=seqs.shape[1]) == "ring":
+        h = forward(params, seqs, p, mesh=mesh)
+        return _score_last(params["item_emb"], h[:, -1], k, exclude_mask)
+    return _predict_top_k_jit(params, seqs, k, p, exclude_mask)
 
 
 class SASRec:

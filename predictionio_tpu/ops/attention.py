@@ -16,11 +16,12 @@ row of the local block — that is what lets ring attention reuse the same
 masking logic per rotated block. The pallas kernel operates on a full
 (unsharded) sequence and derives positions from its grid indices.
 
-Masking support differs by path: arbitrary per-row key masks (``kv_mask``,
-used by left-padded sequence batches) exist only on :func:`mha_attention`;
-the flash kernel and ring path support causal + ``kv_valid`` (right-padding)
-masking — on the flash kernel ``kv_valid`` may be a scalar or a per-batch
-[B] array of valid key counts.
+Masking support: arbitrary per-row key masks (``kv_mask``) exist only on
+:func:`mha_attention`; every path (mha, flash, ring) supports causal plus a
+contiguous valid-key *window* ``[kv_start, kv_valid)`` — ``kv_valid`` masks
+right-padding, ``kv_start`` masks left-padding (SASRec's left-padded
+sequence batches route through it). Both may be scalars or per-batch [B]
+arrays of positions.
 
 Shapes: q [B, Lq, H, D]; k, v [B, Lk, H, D]; output [B, Lq, H, D].
 """
@@ -47,6 +48,24 @@ def _causal_mask(lq: int, lk: int, q_offset, k_offset):
     return q_pos >= k_pos
 
 
+def _kv_window_mask(lk: int, k_offset, kv_valid, kv_start):
+    """[1|B, lk] bool mask of the contiguous valid-key window
+    ``kv_start <= global_key_pos < kv_valid`` (either bound may be None;
+    each may be a scalar or a per-batch [B] array)."""
+    if kv_valid is None and kv_start is None:
+        return None
+    k_pos = k_offset + jnp.arange(lk)[None, :]  # [1, lk] global positions
+    m = None
+    if kv_valid is not None:
+        kv = jnp.atleast_1d(jnp.asarray(kv_valid, jnp.int32))
+        m = k_pos < kv[:, None]
+    if kv_start is not None:
+        ks = jnp.atleast_1d(jnp.asarray(kv_start, jnp.int32))
+        ms = k_pos >= ks[:, None]
+        m = ms if m is None else m & ms
+    return m
+
+
 def mha_attention(
     q,
     k,
@@ -55,13 +74,14 @@ def mha_attention(
     causal: bool = False,
     q_offset=0,
     k_offset=0,
-    kv_valid: int | None = None,
+    kv_valid=None,
+    kv_start=None,
     kv_mask=None,
 ):
     """Reference attention. ``kv_valid`` masks out key positions >= kv_valid
-    (right-padding of the key/value block); ``kv_mask`` [B, Lk] bool masks
-    arbitrary key positions per row (False → hidden; left-padded sequence
-    batches like SASRec's)."""
+    (right-padding of the key/value block); ``kv_start`` masks positions
+    < kv_start (left-padding); both scalar or per-batch [B]. ``kv_mask``
+    [B, Lk] bool masks arbitrary key positions per row (False → hidden)."""
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -69,9 +89,10 @@ def mha_attention(
     mask = jnp.ones((lq, lk), dtype=bool)
     if causal:
         mask = _causal_mask(lq, lk, q_offset, k_offset)
-    if kv_valid is not None:
-        mask = mask & (jnp.arange(lk)[None, :] < kv_valid)
     mask = mask[None, None]  # [1|B, 1, lq, lk]
+    win = _kv_window_mask(lk, k_offset, kv_valid, kv_start)
+    if win is not None:
+        mask = mask & win[:, None, None, :]
     if kv_mask is not None:
         mask = mask & kv_mask[:, None, None, :]
     s = jnp.where(mask, s, NEG_INF)
@@ -84,9 +105,12 @@ def mha_attention(
 
 
 def _online_block_update(q, k, v, num, den, m, *, causal, q_offset, k_offset,
-                         kv_valid=None):
+                         kv_valid=None, kv_start=None):
     """One blockwise online-softmax accumulation step (the flash-attention
-    recurrence), shared by ring attention.
+    recurrence), shared by ring attention. ``kv_valid``/``kv_start`` bound
+    the valid-key window in *global* key positions (``k_offset`` maps this
+    block's local columns to global positions — that is what lets the ring
+    path mask left/right padding of the full sequence per rotated block).
 
     Carries: num [B, Lq, H, D], den [B, H, Lq], m [B, H, Lq].
     """
@@ -97,9 +121,10 @@ def _online_block_update(q, k, v, num, den, m, *, causal, q_offset, k_offset,
     mask = jnp.ones((lq, lk), dtype=bool)
     if causal:
         mask = _causal_mask(lq, lk, q_offset, k_offset)
-    if kv_valid is not None:
-        mask = mask & (jnp.arange(lk)[None, :] < kv_valid)
     mask = mask[None, None]
+    win = _kv_window_mask(lk, k_offset, kv_valid, kv_start)
+    if win is not None:
+        mask = mask & win[:, None, None, :]
     s = jnp.where(mask, s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
     p = jnp.exp(s - m_new[..., None])
@@ -114,11 +139,14 @@ def _online_block_update(q, k, v, num, den, m, *, causal, q_offset, k_offset,
 
 def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                   blk_q: int, blk_k: int, n_kb: int, causal: bool,
-                  scale: float, has_kv: bool):
+                  scale: float, has_valid: bool, has_start: bool):
     """Pallas kernel body. Grid = (B*H, n_qb, n_kb); kv blocks iterate in the
     last (minor) grid dimension so the VMEM scratch accumulators carry the
     online-softmax state across kv blocks for a fixed q block. ``kv_ref`` is
-    a per-(batch·head) valid-key count in SMEM, used only when ``has_kv``."""
+    the full [B*H, 2] array of per-(batch·head) [start, end) valid-key
+    windows in SMEM (unblocked — TPU SMEM lowering rejects sub-tile block
+    shapes), used only when ``has_valid``/``has_start``."""
+    bh = pl.program_id(0)
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -138,10 +166,14 @@ def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             mask = q_pos >= k_pos
-        if has_kv:
+        if has_valid or has_start:
             k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            kvm = k_pos < kv_ref[0, 0]
-            mask = kvm if mask is None else mask & kvm
+            if has_valid:
+                kvm = k_pos < kv_ref[bh, 1]
+                mask = kvm if mask is None else mask & kvm
+            if has_start:
+                ksm = k_pos >= kv_ref[bh, 0]
+                mask = ksm if mask is None else mask & ksm
         s_masked = s if mask is None else jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]          # [blk_q, 1]
@@ -158,23 +190,28 @@ def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     # Skip provably-all-masked blocks entirely: causal blocks fully past the
     # diagonal (static structure, roughly halves causal kernel time) and
-    # blocks entirely beyond this sequence's valid-key count (dynamic).
+    # blocks entirely outside this sequence's valid-key window (dynamic).
     preds = []
     if causal:
         preds.append(kb * blk_k <= qb * blk_q + (blk_q - 1))
-    if has_kv:
-        preds.append(kb * blk_k < kv_ref[0, 0])
+    if has_valid:
+        preds.append(kb * blk_k < kv_ref[bh, 1])
+    if has_start:
+        preds.append((kb + 1) * blk_k > kv_ref[bh, 0])
     if preds:
-        pred = preds[0] if len(preds) == 1 else preds[0] & preds[1]
+        pred = preds[0]
+        for extra in preds[1:]:
+            pred = pred & extra
         pl.when(pred)(_compute)
     else:
         _compute()
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
-        if has_kv:
-            # Fully-masked query rows (kv_valid == 0) have l == 0; return 0
-            # for them, matching mha_attention's any_visible zeroing.
+        if has_valid or has_start:
+            # Fully-masked query rows (empty valid window, or causal queries
+            # entirely before kv_start) have l == 0; return 0 for them,
+            # matching mha_attention's any_visible zeroing.
             l = l_ref[:]
             o_ref[0] = jnp.where(
                 l > 0.0, acc_ref[:] / jnp.maximum(l, 1e-30), 0.0
@@ -194,6 +231,7 @@ def flash_attention(
     *,
     causal: bool = False,
     kv_valid=None,
+    kv_start=None,
     blk_q: int = 128,
     blk_k: int = 128,
     interpret: bool = False,
@@ -203,8 +241,9 @@ def flash_attention(
     Heads fold into the grid's batch dimension; each grid step works on a
     [blk_q, D] query tile against a [blk_k, D] key tile entirely in VMEM.
     ``kv_valid`` (scalar or [B] int) masks out key positions >= kv_valid
-    per batch element (right-padded sequences); blocks entirely beyond the
-    valid count are skipped, not just masked.
+    (right-padded sequences); ``kv_start`` masks positions < kv_start
+    (left-padded sequences, SASRec's serving batches); blocks entirely
+    outside the valid window are skipped, not just masked.
     ``interpret=True`` runs the kernel in interpreter mode (CPU CI).
     """
     b, lq, h, d = q.shape
@@ -223,23 +262,26 @@ def flash_attention(
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
 
-    has_kv = kv_valid is not None
-    if has_kv:
-        kv = jnp.broadcast_to(jnp.asarray(kv_valid, jnp.int32), (b,))
-        kv = jnp.repeat(kv, h)[:, None]  # [B*H, 1]
-    else:
-        kv = jnp.zeros((b * h, 1), jnp.int32)
+    has_valid = kv_valid is not None
+    has_start = kv_start is not None
+    # [B*H, 2] (start, end) window in SMEM; unused bounds get (0, lk)
+    start = jnp.broadcast_to(
+        jnp.asarray(kv_start if has_start else 0, jnp.int32), (b,)
+    )
+    end = jnp.broadcast_to(
+        jnp.asarray(kv_valid if has_valid else lk, jnp.int32), (b,)
+    )
+    kv = jnp.repeat(jnp.stack([start, end], axis=1), h, axis=0)  # [B*H, 2]
 
     kernel = functools.partial(
         _flash_kernel, blk_q=blk_q, blk_k=blk_k, n_kb=n_kb, causal=causal,
-        scale=scale, has_kv=has_kv,
+        scale=scale, has_valid=has_valid, has_start=has_start,
     )
     out = pl.pallas_call(
         kernel,
         grid=(b * h, n_qb, n_kb),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bh, qi, ki: (bh, 0),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole [B*H, 2] window
             pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),

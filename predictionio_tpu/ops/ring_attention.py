@@ -28,10 +28,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from predictionio_tpu.ops.attention import NEG_INF, _online_block_update
 
 
-def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   kv_valid=None, kv_start=None):
     """Attention over a sequence sharded on ``axis_name``. Must be called
     inside ``shard_map``; q, k, v are the *local* blocks [B, Lloc, H, D].
-    Returns the local output block [B, Lloc, H, D]."""
+    ``kv_valid``/``kv_start`` bound the valid-key window in *global*
+    sequence positions (scalar or per-batch [B], replicated across the ring)
+    — right/left padding of the full sequence. Returns the local output
+    block [B, Lloc, H, D]."""
     n = lax.axis_size(axis_name)
     my_block = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -52,6 +56,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
         num, den, m = _online_block_update(
             q, k_cur, v_cur, num, den, m,
             causal=causal, q_offset=q_offset, k_offset=kb * lk,
+            kv_valid=kv_valid, kv_start=kv_start,
         )
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
@@ -67,6 +72,32 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
     return out.astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=64)
+def _ring_callable(mesh: Mesh, causal: bool, has_valid: bool,
+                   has_start: bool, seq_axis: str, data_axis: str | None):
+    """shard_map'd + jitted ring program, cached per (mesh, config) so
+    serving calls (one per transformer block per request) reuse one trace."""
+    spec = P(data_axis, seq_axis, None, None)
+    kv_spec = P(data_axis)
+    in_specs = [spec, spec, spec] + [kv_spec] * (has_valid + has_start)
+
+    def fn(qq, kk, vv, *bounds):
+        bound_kw = {}
+        i = 0
+        if has_valid:
+            bound_kw["kv_valid"] = bounds[i]
+            i += 1
+        if has_start:
+            bound_kw["kv_start"] = bounds[i]
+        return ring_attention(
+            qq, kk, vv, axis_name=seq_axis, causal=causal, **bound_kw
+        )
+
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=spec)
+    )
+
+
 def ring_self_attention(
     mesh: Mesh,
     q,
@@ -74,16 +105,29 @@ def ring_self_attention(
     v,
     *,
     causal: bool = False,
+    kv_valid=None,
+    kv_start=None,
     seq_axis: str = "seq",
     data_axis: str | None = "data",
 ):
     """Jittable wrapper: shard [B, L, H, D] arrays with batch over
-    ``data_axis`` and sequence over ``seq_axis``, run the ring."""
+    ``data_axis`` and sequence over ``seq_axis``, run the ring.
+    ``kv_valid``/``kv_start`` are global-position window bounds (scalar or
+    [B]), sharded with the batch."""
     spec = P(data_axis, seq_axis, None, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    shard = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-    )
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return shard(q, k, v)
+    b = q.shape[0]
+    kv_sharding = NamedSharding(mesh, P(data_axis))
+
+    args = [q, k, v]
+    for bound in (kv_valid, kv_start):
+        if bound is not None:
+            arr = jnp.broadcast_to(jnp.asarray(bound, jnp.int32), (b,))
+            args.append(jax.device_put(arr, kv_sharding))
+
+    shard = _ring_callable(
+        mesh, causal, kv_valid is not None, kv_start is not None,
+        seq_axis, data_axis,
+    )
+    return shard(*args)

@@ -19,18 +19,24 @@ from jax import lax
 
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
-def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192):
+def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192,
+                        exclude_mask=None):
     """Top-k inner-product item search.
 
     queries: [B, D]; items: [N, D]. Returns (scores [B, k], indices [B, k]).
     Items are scanned in ``chunk``-row tiles; each step's top-k merges into
     the running top-k by concatenation + re-top-k (2k candidates).
+    ``exclude_mask`` [B, N] True → drop (the serve-time filter shape of the
+    ecommerce template); it is scanned chunkwise alongside the items so the
+    full [B, N] score matrix is never materialized.
     """
     n, d = items.shape
     b = queries.shape[0]
     k = min(k, n)
     if n <= chunk:
         scores = queries @ items.T
+        if exclude_mask is not None:
+            scores = jnp.where(exclude_mask, -jnp.inf, scores)
         return lax.top_k(scores, k)
     k_chunk = min(k, chunk)  # a chunk can contribute at most `chunk` rows
 
@@ -40,16 +46,27 @@ def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192):
         pad = jnp.full((padded - n, d), 0.0, items.dtype)
         items = jnp.concatenate([items, pad], axis=0)
     items_c = items.reshape(n_chunks, chunk, d)
+    xs = (jnp.arange(n_chunks, dtype=jnp.int32), items_c)
+    if exclude_mask is not None:
+        em = exclude_mask
+        if padded != n:
+            em = jnp.concatenate(
+                [em, jnp.zeros((b, padded - n), bool)], axis=1
+            )
+        # [B, padded] → [n_chunks, B, chunk] so scan slices one tile per step
+        xs = xs + (em.reshape(b, n_chunks, chunk).transpose(1, 0, 2),)
 
     init_s = jnp.full((b, k), -jnp.inf, queries.dtype)
     init_i = jnp.full((b, k), -1, jnp.int32)
 
     def step(carry, inp):
         best_s, best_i = carry
-        ci, block = inp
+        ci, block = inp[0], inp[1]
         s = queries @ block.T  # [B, chunk]
         idx = ci * chunk + jnp.arange(chunk, dtype=jnp.int32)[None, :]
         valid = idx < n
+        if exclude_mask is not None:
+            valid = valid & ~inp[2]
         s = jnp.where(valid, s, -jnp.inf)
         cs, ci_local = lax.top_k(s, k_chunk)
         cand_s = jnp.concatenate([best_s, cs], axis=1)
@@ -59,9 +76,5 @@ def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192):
         ms, mi = lax.top_k(cand_s, k)
         return (ms, jnp.take_along_axis(cand_i, mi, axis=1)), None
 
-    (best_s, best_i), _ = lax.scan(
-        step,
-        (init_s, init_i),
-        (jnp.arange(n_chunks, dtype=jnp.int32), items_c),
-    )
+    (best_s, best_i), _ = lax.scan(step, (init_s, init_i), xs)
     return best_s, best_i

@@ -125,6 +125,9 @@ class AlgorithmParams(Params):
     num_epochs: int = 20
     seed: int = 0
     exclude_seen: bool = True  # drop items already in the user's history
+    # serving attention path: auto | mha | flash (pallas kernel) | ring
+    # (sequence-parallel over the mesh; histories beyond one device)
+    attn_impl: str = "auto"
 
 
 @dataclass
@@ -151,7 +154,7 @@ class SASRecAlgorithm(P2LAlgorithm):
             num_blocks=a.num_blocks, num_heads=a.num_heads,
             ffn_dim=a.ffn_dim, dropout=a.dropout,
             learning_rate=a.learning_rate, batch_size=a.batch_size,
-            num_epochs=a.num_epochs, seed=a.seed,
+            num_epochs=a.num_epochs, seed=a.seed, attn_impl=a.attn_impl,
         )
 
     def train(self, ctx: ComputeContext, pd: PreparedData) -> SASRecModel:

@@ -119,6 +119,49 @@ class TestFlashAttention:
         assert np.all(np.asarray(out[0]) == 0.0)
         assert np.all(np.isfinite(np.asarray(out[1])))
 
+    def test_kv_start_per_batch_matches_kv_mask(self):
+        # Left-padded batch (SASRec serving shape): kv_start = L - n_valid
+        # must equal an arbitrary kv_mask over the same window on mha.
+        q, k, v = _qkv(b=3, l=32, h=2, d=8)
+        start = np.array([0, 12, 27], np.int32)
+        kv_mask = np.arange(32)[None, :] >= start[:, None]
+        ref = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, kv_mask=jnp.asarray(kv_mask),
+        )
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, kv_start=jnp.asarray(start),
+            blk_q=8, blk_k=8, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+        # mha's own kv_start path agrees too
+        out_mha = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, kv_start=jnp.asarray(start),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_mha), np.asarray(ref), atol=1e-5
+        )
+
+    def test_kv_window_start_and_valid_together(self):
+        q, k, v = _qkv(b=2, l=32, h=1, d=8)
+        start = np.array([4, 9], np.int32)
+        valid = np.array([30, 17], np.int32)
+        kv_mask = (np.arange(32)[None, :] >= start[:, None]) & (
+            np.arange(32)[None, :] < valid[:, None]
+        )
+        ref = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            kv_mask=jnp.asarray(kv_mask),
+        )
+        out = flash_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            kv_start=jnp.asarray(start), kv_valid=jnp.asarray(valid),
+            blk_q=8, blk_k=8, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
 
 class TestRingAttention:
     def _mesh(self):
@@ -135,6 +178,22 @@ class TestRingAttention:
             out = ring_self_attention(
                 mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                 causal=causal,
+            )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+    def test_kv_start_matches_full_attention(self):
+        # Left-padding masked across the ring: global-position window.
+        q, k, v = _qkv(b=2, l=64, h=2, d=8)
+        start = np.array([10, 40], np.int32)
+        kv_mask = np.arange(64)[None, :] >= start[:, None]
+        ref = mha_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            causal=True, kv_mask=jnp.asarray(kv_mask),
+        )
+        with self._mesh() as mesh:
+            out = ring_self_attention(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=True, kv_start=jnp.asarray(start),
             )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
@@ -186,3 +245,42 @@ class TestChunkedTopK:
         full_s, full_i = jax.lax.top_k(queries @ items.T, 7)
         np.testing.assert_allclose(np.asarray(s), np.asarray(full_s), atol=1e-5)
         np.testing.assert_array_equal(np.asarray(i), np.asarray(full_i))
+
+    def test_exclude_mask_matches_dense(self):
+        rng = np.random.default_rng(3)
+        queries = jnp.asarray(rng.normal(size=(3, 8)).astype(np.float32))
+        items = jnp.asarray(rng.normal(size=(500, 8)).astype(np.float32))
+        mask = rng.random((3, 500)) < 0.3
+        dense = jnp.where(jnp.asarray(mask), -jnp.inf, queries @ items.T)
+        full_s, full_i = jax.lax.top_k(dense, 10)
+        s, i = chunked_topk_scores(
+            queries, items, k=10, chunk=128, exclude_mask=jnp.asarray(mask)
+        )
+        np.testing.assert_allclose(np.asarray(s), np.asarray(full_s), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(full_i))
+
+    def test_serving_dispatch_uses_chunked_above_threshold(self, monkeypatch):
+        """als.top_k_scores / top_k_cosine carry every template's predict;
+        above the catalog threshold they must stream through the chunked
+        kernel and still agree with the dense path."""
+        from predictionio_tpu.models import als
+
+        rng = np.random.default_rng(4)
+        queries = rng.normal(size=(2, 8)).astype(np.float32)
+        items = rng.normal(size=(300, 8)).astype(np.float32)
+        mask = rng.random((2, 300)) < 0.2
+        dense_s, dense_i = als._top_k_dense(
+            jnp.asarray(queries), jnp.asarray(items), 7, jnp.asarray(mask)
+        )
+        monkeypatch.setattr(als, "CHUNKED_TOPK_THRESHOLD", 100)
+        monkeypatch.setattr(als, "CHUNKED_TOPK_CHUNK", 64)
+        s, i = als.top_k_scores(queries, items, 7, jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(s), np.asarray(dense_s), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(dense_i))
+        # cosine shares the dispatch (normalize → inner product)
+        c_s, c_i = als.top_k_cosine(queries, items, 7)
+        qn = queries / np.linalg.norm(queries, axis=-1, keepdims=True)
+        yn = items / np.linalg.norm(items, axis=-1, keepdims=True)
+        ref_s, ref_i = jax.lax.top_k(jnp.asarray(qn @ yn.T), 7)
+        np.testing.assert_allclose(np.asarray(c_s), np.asarray(ref_s), atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(c_i), np.asarray(ref_i))

@@ -92,6 +92,83 @@ class TestSASRecModel:
             SASRec(ctx, SASRecParams()).train([], n_items=5)
 
 
+class TestServingAttentionImpls:
+    """The flagship kernels carry the product path: the serving forward must
+    give identical results through mha (XLA reference), flash (pallas
+    kernel), and ring (sequence-parallel) attention."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        from predictionio_tpu.models.sasrec import init_params
+
+        p = SASRecParams(
+            max_len=16, embed_dim=32, num_blocks=2, num_heads=2,
+            ffn_dim=64, dropout=0.0, seed=7,
+        )
+        params = init_params(n_items=40, p=p)
+        rng = np.random.default_rng(3)
+        seqs = np.zeros((5, p.max_len), np.int32)
+        for i, n in enumerate([16, 11, 7, 3, 1]):  # varied left-padding
+            seqs[i, -n:] = rng.integers(1, 41, n)
+        return p, params, seqs
+
+    def _topk(self, setup, impl):
+        from dataclasses import replace
+
+        p, params, seqs = setup
+        return predict_top_k(params, seqs, 5, replace(p, attn_impl=impl))
+
+    def test_flash_matches_mha(self, setup):
+        s_m, i_m = self._topk(setup, "mha")
+        s_f, i_f = self._topk(setup, "flash")
+        np.testing.assert_array_equal(np.asarray(i_m), np.asarray(i_f))
+        np.testing.assert_allclose(
+            np.asarray(s_m), np.asarray(s_f), rtol=1e-4, atol=1e-5
+        )
+
+    def test_ring_matches_mha(self, setup):
+        s_m, i_m = self._topk(setup, "mha")
+        s_r, i_r = self._topk(setup, "ring")
+        np.testing.assert_array_equal(np.asarray(i_m), np.asarray(i_r))
+        np.testing.assert_allclose(
+            np.asarray(s_m), np.asarray(s_r), rtol=1e-4, atol=1e-5
+        )
+
+    def test_ring_rejects_indivisible_seq_axis(self, setup):
+        from dataclasses import replace
+
+        p, params, _ = setup
+        bad = np.zeros((2, 12), np.int32)  # 12 % 8 devices != 0
+        bad[:, -3:] = 1
+        with pytest.raises(ValueError, match="divisible"):
+            predict_top_k(params, bad, 3, replace(p, attn_impl="ring"))
+
+    def test_template_attn_impl_flash_end_to_end(self, memory_storage, ctx):
+        """attn_impl flows from engine.json params through to serving."""
+        from predictionio_tpu.templates.sequentialrecommendation import (
+            AlgorithmParams,
+            SASRecAlgorithm,
+        )
+
+        algo = SASRecAlgorithm(AlgorithmParams(attn_impl="flash"))
+        assert algo._hp().attn_impl == "flash"
+        algo = SASRecAlgorithm(AlgorithmParams())
+        assert algo._hp().attn_impl == "auto"
+
+    def test_training_path_stays_differentiable(self, setup):
+        """attn_impl=flash must not break training (which needs the mha
+        VJP) — resolve_attn routes non-serving calls to mha."""
+        from predictionio_tpu.models.sasrec import _resolve_attn
+
+        p, _, _ = setup
+        from dataclasses import replace
+
+        assert _resolve_attn(replace(p, attn_impl="flash"),
+                             serving=False, l=16) == "mha"
+        assert _resolve_attn(replace(p, attn_impl="ring"),
+                             serving=False, l=16) == "mha"
+
+
 class TestSequentialTemplate:
     def test_end_to_end(self, memory_storage, ctx):
         from predictionio_tpu.data.datamap import DataMap
