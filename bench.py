@@ -83,7 +83,12 @@ def _padded_shapes(idx: np.ndarray, params, ctx) -> list[tuple[int, int]]:
             sel = (counts > lo) & (counts <= width)
         n = int(sel.sum())
         if n:
-            shapes.append((ctx.pad_to_multiple(n), width))
+            from predictionio_tpu.models.als import _chunk_plan
+
+            padded, _nc = _chunk_plan(
+                n, width, params.rank, params.max_solve_elems, ctx.n_devices
+            )
+            shapes.append((padded, width))
     return shapes
 
 

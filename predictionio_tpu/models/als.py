@@ -34,6 +34,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.parallel.mesh import ComputeContext
 
@@ -62,6 +63,11 @@ class ALSParams:
     #: larger jobs transfer per-bucket with the batch sharding so each
     #: device holds 1/n of the rating data.
     pack_replicate_max_bytes: int = _PACK_REPLICATE_MAX_BYTES
+    #: HBM bound on a bucket solve's gathered-factor tensor ([rows, k, rank]
+    #: elements). Buckets above it solve in sequential row chunks via
+    #: ``lax.map`` so the gather temp is O(chunk), not O(bucket) — at
+    #: ML-20M rank 64 the unchunked gather alone is >12 GB, past a v5e chip.
+    max_solve_elems: int = 1 << 28
 
 
 @dataclass
@@ -80,6 +86,22 @@ class _Bucket:
     ratings: np.ndarray  # [n, k] float32
     weights: np.ndarray  # [n, k] float32, 1.0 valid / 0.0 padding
     row_valid: np.ndarray  # [n] float32, 1.0 for real rows
+    nc: int = 1  # solve in this many sequential row chunks (see max_solve_elems)
+
+
+def _chunk_plan(
+    n_real: int, width: int, rank: int, max_elems: int, unit: int
+) -> tuple[int, int]:
+    """(n_padded, nc): pad ``n_real`` rows to ``nc`` equal chunks of ``c``
+    rows, ``c`` a multiple of the data-axis size ``unit``, such that one
+    chunk's gathered-factor tensor ``c*width*rank`` fits ``max_elems``
+    (bottoming out at one row-block per device)."""
+    nc = 1
+    while True:
+        c = ((n_real + nc * unit - 1) // (nc * unit)) * unit
+        if c * width * max(rank, 1) <= max_elems or c == unit:
+            return nc * c, nc
+        nc *= 2
 
 
 def _bucketize(
@@ -114,7 +136,10 @@ def _bucketize(
         b_entities = uniq[sel]
         b_starts = starts[sel]
         b_counts = np.minimum(counts[sel], width)
-        n = ctx.pad_to_multiple(len(b_entities))
+        n, nc = _chunk_plan(
+            len(b_entities), width, params.rank, params.max_solve_elems,
+            ctx.n_devices,
+        )
         cols = np.zeros((n, width), dtype=np.int32)
         rates = np.zeros((n, width), dtype=np.float32)
         weights = np.zeros((n, width), dtype=np.float32)
@@ -130,8 +155,39 @@ def _bucketize(
             cols[j, :c] = neighbor_sorted[s : s + c]
             rates[j, :c] = ratings_sorted[s : s + c]
             weights[j, :c] = 1.0
-        buckets.append(_Bucket(rows, cols, rates, weights, row_valid))
+        buckets.append(_Bucket(rows, cols, rates, weights, row_valid, nc))
     return buckets
+
+
+def _chunk_solutions(
+    fixed,  # [n_other, rank] fixed-side factors (replicated)
+    cols,  # [c, k] int32
+    ratings,  # [c, k] f32
+    weights,  # [c, k] f32
+    yty,  # [rank, rank] — YᵀY for implicit, zeros for explicit
+    lambda_: float,
+    alpha: float,
+    implicit: bool,
+    rank: int,
+):
+    """Normal-equation solutions for one row chunk of a bucket."""
+    y = fixed[cols]  # [c, k, r] gather, local (fixed is replicated)
+    n_ratings = weights.sum(axis=1)  # [c]
+    if implicit:
+        conf_minus1 = alpha * ratings * weights  # (c-1), only observed
+        gram = yty[None, :, :] + jnp.einsum(
+            "nk,nkr,nks->nrs", conf_minus1, y, y, optimize=True
+        )
+        rhs = jnp.einsum("nk,nkr->nr", (1.0 + conf_minus1) * weights, y)
+    else:
+        gram = jnp.einsum("nk,nkr,nks->nrs", weights, y, y, optimize=True)
+        rhs = jnp.einsum("nk,nkr->nr", ratings * weights, y)
+    # ALS-WR: λ scaled by per-entity rating count; +ε keeps padded rows SPD
+    reg = lambda_ * jnp.maximum(n_ratings, 1.0) + 1e-8
+    gram = gram + reg[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
+    return jax.scipy.linalg.cho_solve(
+        (jnp.linalg.cholesky(gram), True), rhs[..., None]
+    )[..., 0]
 
 
 def _solve_bucket(
@@ -147,28 +203,34 @@ def _solve_bucket(
     alpha: float,
     implicit: bool,
     rank: int,
+    nc: int = 1,
+    shard=None,
 ):
     """One bucket's batched normal-equation solve. ``rows/cols/...`` are
     sharded over the mesh ``data`` axis; ``target``/``fixed`` replicated, so
-    the row scatter at the end compiles to an ICI all-gather. Traced inside
-    :func:`_als_iteration` — not jitted on its own."""
-    y = fixed[cols]  # [n, k, r] gather, local (fixed is replicated)
-    n_ratings = weights.sum(axis=1)  # [n]
-    if implicit:
-        conf_minus1 = alpha * ratings * weights  # (c-1), only observed
-        gram = yty[None, :, :] + jnp.einsum(
-            "nk,nkr,nks->nrs", conf_minus1, y, y, optimize=True
+    the row scatter at the end compiles to an ICI all-gather. Buckets whose
+    gather temp would exceed ALSParams.max_solve_elems arrive with ``nc>1``
+    and solve in sequential ``lax.map`` row chunks so HBM stays bounded.
+    Traced inside :func:`_als_iteration` — not jitted on its own."""
+    if nc > 1:
+        n = rows.shape[0]
+        c = n // nc
+        xs = tuple(
+            x.reshape((nc, c) + x.shape[1:]) for x in (cols, ratings, weights)
         )
-        rhs = jnp.einsum("nk,nkr->nr", (1.0 + conf_minus1) * weights, y)
+        if shard is not None:
+            cs = NamedSharding(shard.mesh, P(None, *shard.spec))
+            xs = tuple(jax.lax.with_sharding_constraint(x, cs) for x in xs)
+        sol = jax.lax.map(
+            lambda t: _chunk_solutions(
+                fixed, *t, yty, lambda_, alpha, implicit, rank
+            ),
+            xs,
+        ).reshape(n, rank)
     else:
-        gram = jnp.einsum("nk,nkr,nks->nrs", weights, y, y, optimize=True)
-        rhs = jnp.einsum("nk,nkr->nr", ratings * weights, y)
-    # ALS-WR: λ scaled by per-entity rating count; +ε keeps padded rows SPD
-    reg = lambda_ * jnp.maximum(n_ratings, 1.0) + 1e-8
-    gram = gram + reg[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
-    sol = jax.scipy.linalg.cho_solve(
-        (jnp.linalg.cholesky(gram), True), rhs[..., None]
-    )[..., 0]
+        sol = _chunk_solutions(
+            fixed, cols, ratings, weights, yty, lambda_, alpha, implicit, rank
+        )
     sol = sol * row_valid[:, None]  # padded rows contribute nothing
     # scatter solved rows; padding rows alias an in-bucket entity and are
     # masked to zero, so add-after-clear keeps every row correct
@@ -210,7 +272,7 @@ def _pack_buckets(buckets: list[_Bucket]) -> tuple[np.ndarray, np.ndarray, tuple
             for b in buckets
         ]
     ).astype(np.float32)
-    shapes = tuple((len(b.rows), b.cols.shape[1]) for b in buckets)
+    shapes = tuple((len(b.rows), b.cols.shape[1], b.nc) for b in buckets)
     return ints, floats, shapes
 
 
@@ -220,7 +282,7 @@ def _unpack_buckets(ints, floats, shapes, shard):
     run with the same layout as individually-transferred buckets."""
     out = []
     oi = of = 0
-    for n, k in shapes:
+    for n, k, _nc in shapes:
         rows = ints[oi : oi + n]
         cols = ints[oi + n : oi + n + n * k].reshape(n, k)
         oi += n + n * k
@@ -237,8 +299,8 @@ def _unpack_buckets(ints, floats, shapes, shard):
 
 def _packed_len(shapes: tuple) -> tuple[int, int]:
     """(int32 length, float32 length) of one side's packed blob."""
-    ints = sum(n + n * k for n, k in shapes)
-    floats = sum(2 * n * k + n for n, k in shapes)
+    ints = sum(n + n * k for n, k, _nc in shapes)
+    floats = sum(2 * n * k + n for n, k, _nc in shapes)
     return ints, floats
 
 
@@ -273,14 +335,15 @@ def _als_iteration(
         ints[ui_len:], floats[uf_len:], item_shapes, shard
     )
     return _iteration_body(
-        user_f, item_f, user_buckets, item_buckets, lambda_, alpha,
-        implicit, rank,
+        user_f, item_f, user_buckets, item_buckets,
+        tuple(s[2] for s in user_shapes), tuple(s[2] for s in item_shapes),
+        lambda_, alpha, implicit, rank, shard,
     )
 
 
 @partial(
     jax.jit,
-    static_argnames=("implicit", "rank"),
+    static_argnames=("implicit", "rank", "user_nc", "item_nc", "shard"),
     donate_argnums=(0, 1),
 )
 def _als_iteration_sharded(
@@ -293,29 +356,35 @@ def _als_iteration_sharded(
     *,
     implicit: bool,
     rank: int,
+    user_nc: tuple = (),
+    item_nc: tuple = (),
+    shard=None,
 ):
     """Large-job variant: buckets were transferred individually with the
     batch sharding, so each device holds 1/n of the rating data for the whole
     run (no replication of the blobs — see ALS.train's size cutover)."""
+    user_nc = user_nc or (1,) * len(user_buckets)
+    item_nc = item_nc or (1,) * len(item_buckets)
     return _iteration_body(
-        user_f, item_f, user_buckets, item_buckets, lambda_, alpha,
-        implicit, rank,
+        user_f, item_f, user_buckets, item_buckets, user_nc, item_nc,
+        lambda_, alpha, implicit, rank, shard,
     )
 
 
 def _iteration_body(
-    user_f, item_f, user_buckets, item_buckets, lambda_, alpha, implicit, rank
+    user_f, item_f, user_buckets, item_buckets, user_nc, item_nc,
+    lambda_, alpha, implicit, rank, shard=None,
 ):
     zeros_gram = jnp.zeros((rank, rank), user_f.dtype)
     yty = _gram(item_f) if implicit else zeros_gram
-    for b in user_buckets:
+    for b, nc in zip(user_buckets, user_nc):
         user_f = _solve_bucket(
-            user_f, item_f, *b, yty, lambda_, alpha, implicit, rank
+            user_f, item_f, *b, yty, lambda_, alpha, implicit, rank, nc, shard
         )
     xtx = _gram(user_f) if implicit else zeros_gram
-    for b in item_buckets:
+    for b, nc in zip(item_buckets, item_nc):
         item_f = _solve_bucket(
-            item_f, user_f, *b, xtx, lambda_, alpha, implicit, rank
+            item_f, user_f, *b, xtx, lambda_, alpha, implicit, rank, nc, shard
         )
     return user_f, item_f
 
@@ -420,6 +489,9 @@ class ALS:
                     user_f, item_f, dev_user_buckets, dev_item_buckets,
                     p.lambda_, p.alpha,
                     implicit=p.implicit_prefs, rank=p.rank,
+                    user_nc=tuple(b.nc for b in user_buckets),
+                    item_nc=tuple(b.nc for b in item_buckets),
+                    shard=bshard,
                 )
             if callback is not None:
                 callback(it, user_f, item_f)

@@ -1,0 +1,211 @@
+"""RMSE / numerical parity pins for the TPU ALS (BASELINE.md row 3).
+
+Two guards, per the round-1 review:
+
+1. **Exact parity against an independent implementation.** A dense, pure
+   numpy normal-equation ALS (written from the MLlib update rule, ref:
+   examples/scala-parallel-recommendation/.../ALSAlgorithm.scala:55-61 and
+   MLlib 1.3 ALS-WR weighting) is run from the *same* initial factors, and
+   the bucketed XLA implementation must match it per final factor matrix to
+   float32 tolerance — both explicit and implicit (Hu-Koren) modes.
+
+2. **Holdout-RMSE regression pin at ML-100K scale.** The real MovieLens
+   ML-100K file cannot be fetched in this zero-egress environment, so we pin
+   a fixed-seed ML-100K-*statistics* problem (943x1682, 100k ratings drawn
+   as clipped integer ratings = global mean + user bias + item bias +
+   low-rank interaction + noise, calibrated to published ML-100K moments:
+   mean ~3.53, std ~1.12) and assert the rank-10/20-iter/lambda=0.01 holdout
+   RMSE lands in the MLlib-class band (~0.91-0.95 on the real dataset) and
+   within a tight tolerance of the recorded value, so any numerical
+   regression in the solver moves the pin.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.als import ALS, ALSParams
+from predictionio_tpu.parallel.mesh import compute_context
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+# ---------------------------------------------------------------------------
+# Independent reference implementation (dense numpy, float64)
+# ---------------------------------------------------------------------------
+
+
+def _half_solve(prev, fixed, by_entity, rank, lam, alpha, implicit):
+    """Solve one side's normal equations entity-by-entity (no bucketing, no
+    padding — a deliberately different evaluation strategy from the XLA
+    degree-bucketed batched solver). Entities with no observed ratings keep
+    their previous factors, as in the bucketed solver — in implicit mode
+    those rows still feed the dense YtY Gram term."""
+    out = prev.copy()
+    yty = fixed.T @ fixed if implicit else None
+    eye = np.eye(rank)
+    for e, (cols, rates) in by_entity.items():
+        y = fixed[cols]  # [k, rank]
+        n = len(cols)
+        if implicit:
+            cm1 = alpha * rates  # (c - 1) for observed entries
+            gram = yty + (y * cm1[:, None]).T @ y
+            rhs = ((1.0 + cm1)[:, None] * y).sum(axis=0)
+        else:
+            gram = y.T @ y
+            rhs = y.T @ rates
+        reg = lam * max(n, 1.0) + 1e-8
+        out[e] = np.linalg.solve(gram + reg * eye, rhs)
+    return out
+
+
+def numpy_als(user_f0, item_f0, ui, ii, r, iters, lam, alpha=1.0,
+              implicit=False):
+    """MLlib-shaped ALS: users solved against current items, then items
+    against the *updated* users, ALS-WR count-scaled regularization."""
+    n_users, rank = user_f0.shape
+    n_items = item_f0.shape[0]
+    by_user: dict = {}
+    by_item: dict = {}
+    for u, i, x in zip(ui, ii, r):
+        by_user.setdefault(int(u), ([], []))
+        by_user[int(u)][0].append(int(i))
+        by_user[int(u)][1].append(float(x))
+    for u in by_user:
+        cols, rates = by_user[u]
+        by_user[u] = (np.asarray(cols), np.asarray(rates, dtype=np.float64))
+    for u, i, x in zip(ui, ii, r):
+        by_item.setdefault(int(i), ([], []))
+        by_item[int(i)][0].append(int(u))
+        by_item[int(i)][1].append(float(x))
+    for i in by_item:
+        cols, rates = by_item[i]
+        by_item[i] = (np.asarray(cols), np.asarray(rates, dtype=np.float64))
+
+    user_f = user_f0.astype(np.float64)
+    item_f = item_f0.astype(np.float64)
+    for _ in range(iters):
+        user_f = _half_solve(
+            user_f, item_f, by_user, rank, lam, alpha, implicit)
+        item_f = _half_solve(
+            item_f, user_f, by_item, rank, lam, alpha, implicit)
+    return user_f, item_f
+
+
+def _init_factors_of(ctx, params, ui, ii, r, n_users, n_items):
+    """The XLA solver's initial factors: run zero iterations."""
+    p0 = ALSParams(rank=params.rank, num_iterations=0, lambda_=params.lambda_,
+                   implicit_prefs=params.implicit_prefs, alpha=params.alpha,
+                   seed=params.seed)
+    f = ALS(ctx, p0).train(ui, ii, r, n_users, n_items)
+    return f.user_features.copy(), f.item_features.copy()
+
+
+def _ratings(n_users=50, n_items=35, density=0.3, seed=3):
+    rng = np.random.default_rng(seed)
+    mask = rng.random((n_users, n_items)) < density
+    ui, ii = np.nonzero(mask)
+    r = rng.integers(1, 6, len(ui)).astype(np.float32)
+    return ui.astype(np.int32), ii.astype(np.int32), r
+
+
+@pytest.mark.parametrize("implicit", [False, True], ids=["explicit", "implicit"])
+def test_als_matches_independent_dense_solver(ctx, implicit):
+    ui, ii, r = _ratings()
+    n_users, n_items = 50, 35
+    if implicit:
+        r = (r >= 4).astype(np.float32) * 2.0  # implicit strength signal
+        keep = r > 0
+        ui, ii, r = ui[keep], ii[keep], r[keep]
+    params = ALSParams(rank=6, num_iterations=5, lambda_=0.05,
+                       implicit_prefs=implicit, alpha=1.5, seed=7)
+    u0, v0 = _init_factors_of(ctx, params, ui, ii, r, n_users, n_items)
+
+    got = ALS(ctx, params).train(ui, ii, r, n_users, n_items)
+    want_u, want_v = numpy_als(
+        u0, v0, ui, ii, r, iters=5, lam=0.05, alpha=1.5, implicit=implicit)
+
+    # float32 batched-Cholesky vs float64 dense solve, 5 alternations deep
+    np.testing.assert_allclose(
+        got.user_features, want_u, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        got.item_features, want_v, rtol=2e-3, atol=2e-3)
+
+
+def test_als_parity_entities_without_ratings_stay_at_init(ctx):
+    """Entities absent from the training set keep their initial factors —
+    the bucketed scatter must not clobber them (padding-row aliasing)."""
+    ui = np.array([0, 0, 1, 2], dtype=np.int32)
+    ii = np.array([0, 1, 1, 0], dtype=np.int32)
+    r = np.array([5.0, 3.0, 4.0, 1.0], dtype=np.float32)
+    params = ALSParams(rank=4, num_iterations=3, lambda_=0.1, seed=11)
+    u0, v0 = _init_factors_of(ctx, params, ui, ii, r, 6, 5)
+    got = ALS(ctx, params).train(ui, ii, r, 6, 5)
+    np.testing.assert_allclose(got.user_features[3:], u0[3:], atol=1e-6)
+    np.testing.assert_allclose(got.item_features[2:], v0[2:], atol=1e-6)
+
+
+def test_chunked_bucket_solve_matches_unchunked(ctx):
+    """Buckets above max_solve_elems solve in sequential lax.map row chunks
+    (HBM-bounded path used at ML-20M scale); results must be identical."""
+    ui, ii, r = _ratings(n_users=64, n_items=48, density=0.5, seed=9)
+    base = ALSParams(rank=5, num_iterations=4, lambda_=0.02, seed=3)
+    tiny = ALSParams(rank=5, num_iterations=4, lambda_=0.02, seed=3,
+                     max_solve_elems=5 * 16)  # force nc > 1 everywhere
+    want = ALS(ctx, base).train(ui, ii, r, 64, 48)
+    got = ALS(ctx, tiny).train(ui, ii, r, 64, 48)
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        got.item_features, want.item_features, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ML-100K-scale holdout RMSE pin
+# ---------------------------------------------------------------------------
+
+#: Recorded holdout RMSE for the fixed-seed problem below (rank 10,
+#: 20 iterations, lambda 0.01 — the stock template's engine.json defaults).
+#: Guards solver regressions; re-record ONLY for intentional algorithm
+#: changes, with justification.
+ML100K_PIN = 0.9356
+ML100K_TOL = 0.02
+
+
+def synthesize_ml100k_ratings(seed=0):
+    """ML-100K-moment synthetic ratings: 943 users x 1682 items, 100k
+    entries, integer 1..5, mean ~3.53 / std ~1.12, zipf-ish popularity."""
+    rng = np.random.default_rng(seed)
+    n_users, n_items, nnz = 943, 1682, 100_000
+    item_p = 1.0 / np.arange(1, n_items + 1) ** 0.8
+    item_p /= item_p.sum()
+    user_p = 1.0 / np.arange(1, n_users + 1) ** 0.6
+    user_p /= user_p.sum()
+    ui = rng.choice(n_users, nnz, p=user_p).astype(np.int32)
+    ii = rng.choice(n_items, nnz, p=item_p).astype(np.int32)
+    bu = rng.normal(0, 0.45, n_users)
+    bi = rng.normal(0, 0.5, n_items)
+    latent_u = rng.normal(0, 1, (n_users, 8)) / np.sqrt(8)
+    latent_i = rng.normal(0, 1, (n_items, 8))
+    inter = np.einsum("nr,nr->n", latent_u[ui], latent_i[ii])
+    raw = 3.53 + bu[ui] + bi[ii] + 0.55 * inter + rng.normal(0, 0.65, nnz)
+    r = np.clip(np.rint(raw), 1, 5).astype(np.float32)
+    return ui, ii, r
+
+
+@pytest.mark.slow
+def test_ml100k_scale_holdout_rmse_pin(ctx):
+    ui, ii, r = synthesize_ml100k_ratings()
+    rng = np.random.default_rng(42)
+    test = rng.random(len(r)) < 0.2
+    train = ~test
+    als = ALS(ctx, ALSParams(rank=10, num_iterations=20, lambda_=0.01, seed=0))
+    factors = als.train(ui[train], ii[train], r[train], 943, 1682)
+    rmse = als.rmse(factors, ui[test], ii[test], r[test])
+    # the MLlib-class band BASELINE.md row 3 cites for real ML-100K
+    assert 0.85 < rmse < 1.0, f"holdout RMSE {rmse:.4f} outside sanity band"
+    assert abs(rmse - ML100K_PIN) < ML100K_TOL, (
+        f"holdout RMSE {rmse:.4f} drifted from pin {ML100K_PIN}"
+    )
