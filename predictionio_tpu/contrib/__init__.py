@@ -1,0 +1,9 @@
+"""Third-party-style storage backends.
+
+Modules here are NOT in the registry's built-in ``BACKEND_TYPES`` table —
+they resolve through the third-party hook: set a source's TYPE to the module
+path (``PIO_STORAGE_SOURCES_X_TYPE=predictionio_tpu.contrib.jsonfs``) and
+the registry imports it and discovers the DAO classes via ``CLASS_PREFIX``
+(ref: Storage.scala:263-312, which classloads
+``io.prediction.data.storage.<type>.StorageClient`` the same way for the
+elasticsearch/hbase/jdbc jars)."""

@@ -1,0 +1,562 @@
+"""JSON file-tree storage backend (third-party registration proof).
+
+The reference's Elasticsearch backend stores metadata as JSON documents in
+an external document store and is loaded by classloader convention, not a
+built-in table (ref: data/.../storage/elasticsearch/StorageClient.scala:33-45
+via Storage.scala:263-312). This backend plays both roles for the TPU stack:
+
+* every record is one human-readable JSON document in a directory tree
+  (``<root>/<table>/<key>.json``; model blobs as sibling ``.bin`` files),
+  so an operator can inspect/repair state with ls + cat, and a shared
+  filesystem (NFS, GCS fuse) gives multi-process deployments a common
+  metadata store;
+* it is deliberately NOT in the registry's ``BACKEND_TYPES`` — it resolves
+  through the third-party module-path hook
+  (``PIO_STORAGE_SOURCES_DOC_TYPE=predictionio_tpu.contrib.jsonfs``),
+  proving the same spec-suite compliance path an external plugin package
+  would take.
+
+Writes are atomic (tmp + rename) and compound operations (uniqueness
+checks, id sequences) serialize on an fcntl file lock, so concurrent
+processes — event server + trainer + query server — can share one tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as dt
+import fcntl
+import json
+import os
+import shutil
+import urllib.parse
+from pathlib import Path
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    StorageError,
+    generate_access_key,
+)
+from predictionio_tpu.utils.time import format_datetime, parse_datetime
+
+#: Registry third-party discovery contract: DAO classes are
+#: ``<CLASS_PREFIX><DaoName>`` in this module.
+CLASS_PREFIX = "JsonFs"
+
+
+def _enc(key: object) -> str:
+    return urllib.parse.quote(str(key), safe="")
+
+
+class JsonFsClient:
+    """One storage source = one directory tree."""
+
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        path = config.get("PATH")
+        if not path:
+            raise StorageError(
+                "jsonfs storage source requires PIO_STORAGE_SOURCES_<NAME>_PATH"
+            )
+        self.root = Path(path)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    # -- locking ------------------------------------------------------------
+    def lock(self):
+        return _FileLock(self.root / ".lock")
+
+    # -- table --------------------------------------------------------------
+    def tdir(self, table: str, create: bool = False) -> Path:
+        d = self.root / table
+        if create:
+            d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def drop(self, table: str) -> bool:
+        d = self.tdir(table)
+        if not d.exists():
+            return False
+        shutil.rmtree(d)
+        return True
+
+    # -- records ------------------------------------------------------------
+    def write(self, table: str, key: object, doc: dict) -> None:
+        d = self.tdir(table, create=True)
+        path = d / (_enc(key) + ".json")
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+
+    def read(self, table: str, key: object) -> dict | None:
+        path = self.tdir(table) / (_enc(key) + ".json")
+        try:
+            return json.loads(path.read_text())
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+
+    def delete(self, table: str, key: object) -> bool:
+        path = self.tdir(table) / (_enc(key) + ".json")
+        try:
+            path.unlink()
+            return True
+        except FileNotFoundError:
+            return False
+
+    def scan(self, table: str) -> Iterator[dict]:
+        d = self.tdir(table)
+        if not d.exists():
+            return
+        for path in sorted(d.glob("*.json")):
+            yield json.loads(path.read_text())
+
+    def next_seq(self, table: str) -> int:
+        """Monotonic per-table id sequence (callers hold the source lock)."""
+        seq = self.tdir(table, create=True) / ".seq"
+        current = int(seq.read_text()) if seq.exists() else 0
+        seq.write_text(str(current + 1))
+        return current + 1
+
+
+class _FileLock:
+    def __init__(self, path: Path):
+        self._path = path
+        self._fd: int | None = None
+
+    def __enter__(self):
+        self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR)
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        os.close(self._fd)
+        self._fd = None
+
+
+# ---------------------------------------------------------------------------
+# Events
+# ---------------------------------------------------------------------------
+
+
+def _event_table(prefix: str, app_id: int, channel_id: int | None) -> str:
+    return prefix + f"events_{app_id}" + (f"_{channel_id}" if channel_id else "")
+
+
+class JsonFsEvents(base.Events):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._prefix = prefix
+
+    def _t(self, app_id: int, channel_id: int | None) -> str:
+        return _event_table(self._prefix, app_id, channel_id)
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        self._c.tdir(self._t(app_id, channel_id), create=True)
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        return self._c.drop(self._t(app_id, channel_id))
+
+    def close(self) -> None:
+        pass
+
+    def _require_init(self, app_id: int, channel_id: int | None) -> str:
+        table = self._t(app_id, channel_id)
+        if not self._c.tdir(table).exists():
+            raise StorageError(
+                f"Event store for app {app_id} channel {channel_id} is not "
+                "initialized; run `pio app new` first."
+            )
+        return table
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        table = self._require_init(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        self._c.write(table, eid, event.with_id(eid).to_json())
+        return eid
+
+    def get(self, event_id: str, app_id: int, channel_id: int | None = None):
+        doc = self._c.read(self._require_init(app_id, channel_id), event_id)
+        return Event.from_json(doc) if doc is not None else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        return self._c.delete(self._require_init(app_id, channel_id), event_id)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        table = self._require_init(app_id, channel_id)
+        events = [Event.from_json(doc) for doc in self._c.scan(table)]
+
+        def ok(e: Event) -> bool:
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if entity_id is not None and e.entity_id != entity_id:
+                return False
+            if event_names is not None and e.event not in event_names:
+                return False
+            if target_entity_type is not ... and e.target_entity_type != target_entity_type:
+                return False
+            if target_entity_id is not ... and e.target_entity_id != target_entity_id:
+                return False
+            return True
+
+        out = sorted(
+            (e for e in events if ok(e)),
+            key=lambda e: e.event_time,
+            reverse=reversed_,
+        )
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return iter(out)
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAOs
+# ---------------------------------------------------------------------------
+
+
+class JsonFsApps(base.Apps):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "apps"
+
+    def insert(self, app: App) -> int | None:
+        with self._c.lock():
+            if any(d["name"] == app.name for d in self._c.scan(self._table)):
+                return None
+            if app.id:
+                app_id = app.id
+                if self._c.read(self._table, app_id) is not None:
+                    return None
+            else:
+                # explicit-id inserts don't advance .seq; skip over them
+                app_id = self._c.next_seq(self._table)
+                while self._c.read(self._table, app_id) is not None:
+                    app_id = self._c.next_seq(self._table)
+            self._c.write(
+                self._table, app_id,
+                {"id": app_id, "name": app.name, "description": app.description},
+            )
+            return app_id
+
+    def _from(self, d: dict) -> App:
+        return App(d["id"], d["name"], d.get("description"))
+
+    def get(self, app_id: int):
+        doc = self._c.read(self._table, app_id)
+        return self._from(doc) if doc else None
+
+    def get_by_name(self, name: str):
+        return next(
+            (self._from(d) for d in self._c.scan(self._table) if d["name"] == name),
+            None,
+        )
+
+    def get_all(self):
+        return [self._from(d) for d in self._c.scan(self._table)]
+
+    def update(self, app: App) -> bool:
+        with self._c.lock():
+            if self._c.read(self._table, app.id) is None:
+                return False
+            self._c.write(
+                self._table, app.id,
+                {"id": app.id, "name": app.name, "description": app.description},
+            )
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self._c.lock():
+            return self._c.delete(self._table, app_id)
+
+
+class JsonFsAccessKeys(base.AccessKeys):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "access_keys"
+
+    def _doc(self, k: AccessKey) -> dict:
+        return {"key": k.key, "appid": k.appid, "events": list(k.events)}
+
+    def _from(self, d: dict) -> AccessKey:
+        return AccessKey(d["key"], d["appid"], tuple(d.get("events", ())))
+
+    def insert(self, access_key: AccessKey) -> str | None:
+        key = access_key.key or generate_access_key()
+        with self._c.lock():
+            if self._c.read(self._table, key) is not None:
+                return None
+            self._c.write(
+                self._table, key,
+                self._doc(AccessKey(key, access_key.appid, tuple(access_key.events))),
+            )
+            return key
+
+    def get(self, key: str):
+        doc = self._c.read(self._table, key)
+        return self._from(doc) if doc else None
+
+    def get_all(self):
+        return [self._from(d) for d in self._c.scan(self._table)]
+
+    def get_by_app_id(self, app_id: int):
+        return [k for k in self.get_all() if k.appid == app_id]
+
+    def update(self, access_key: AccessKey) -> bool:
+        with self._c.lock():
+            if self._c.read(self._table, access_key.key) is None:
+                return False
+            self._c.write(self._table, access_key.key, self._doc(access_key))
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._c.lock():
+            return self._c.delete(self._table, key)
+
+
+class JsonFsChannels(base.Channels):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "channels"
+
+    def _from(self, d: dict) -> Channel:
+        return Channel(d["id"], d["name"], d["appid"])
+
+    def insert(self, channel: Channel) -> int | None:
+        with self._c.lock():
+            if channel.id:
+                cid = channel.id
+                if self._c.read(self._table, cid) is not None:
+                    return None
+            else:
+                cid = self._c.next_seq(self._table)
+                while self._c.read(self._table, cid) is not None:
+                    cid = self._c.next_seq(self._table)
+            if any(
+                d["appid"] == channel.appid and d["name"] == channel.name
+                for d in self._c.scan(self._table)
+            ):
+                return None
+            self._c.write(
+                self._table, cid,
+                {"id": cid, "name": channel.name, "appid": channel.appid},
+            )
+            return cid
+
+    def get(self, channel_id: int):
+        doc = self._c.read(self._table, channel_id)
+        return self._from(doc) if doc else None
+
+    def get_by_app_id(self, app_id: int):
+        return [
+            self._from(d) for d in self._c.scan(self._table)
+            if d["appid"] == app_id
+        ]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._c.lock():
+            return self._c.delete(self._table, channel_id)
+
+
+def _instance_doc(instance) -> dict:
+    doc = dataclasses.asdict(instance)
+    for k, v in doc.items():
+        if isinstance(v, dt.datetime):
+            doc[k] = {"$dt": format_datetime(v)}
+    return doc
+
+
+def _instance_from(cls, d: dict):
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, dict) and set(v) == {"$dt"}:
+            out[k] = parse_datetime(v["$dt"])
+        else:
+            out[k] = v
+    return cls(**out)
+
+
+class JsonFsEngineInstances(base.EngineInstances):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "engine_instances"
+
+    def insert(self, instance: EngineInstance) -> str:
+        with self._c.lock():
+            iid = instance.id or str(self._c.next_seq(self._table))
+            inst = EngineInstance(**{**instance.__dict__, "id": iid})
+            self._c.write(self._table, iid, _instance_doc(inst))
+            return iid
+
+    def get(self, instance_id: str):
+        doc = self._c.read(self._table, instance_id)
+        return _instance_from(EngineInstance, doc) if doc else None
+
+    def get_all(self):
+        return [
+            _instance_from(EngineInstance, d) for d in self._c.scan(self._table)
+        ]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        out = [
+            i for i in self.get_all()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        return sorted(out, key=lambda i: i.start_time, reverse=True)
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, instance: EngineInstance) -> bool:
+        with self._c.lock():
+            if self._c.read(self._table, instance.id) is None:
+                return False
+            self._c.write(self._table, instance.id, _instance_doc(instance))
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._c.lock():
+            return self._c.delete(self._table, instance_id)
+
+
+class JsonFsEngineManifests(base.EngineManifests):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "engine_manifests"
+
+    @staticmethod
+    def _key(manifest_id: str, version: str) -> str:
+        return f"{_enc(manifest_id)}__{_enc(version)}"
+
+    def insert(self, manifest: EngineManifest) -> None:
+        doc = dataclasses.asdict(manifest)
+        doc["files"] = list(manifest.files)
+        self._c.write(self._table, self._key(manifest.id, manifest.version), doc)
+
+    def get(self, manifest_id: str, version: str):
+        doc = self._c.read(self._table, self._key(manifest_id, version))
+        if not doc:
+            return None
+        doc["files"] = tuple(doc.get("files", ()))
+        return EngineManifest(**doc)
+
+    def get_all(self):
+        out = []
+        for d in self._c.scan(self._table):
+            d["files"] = tuple(d.get("files", ()))
+            out.append(EngineManifest(**d))
+        return out
+
+    def update(self, manifest: EngineManifest, upsert: bool = False) -> None:
+        self.insert(manifest)
+
+    def delete(self, manifest_id: str, version: str) -> None:
+        self._c.delete(self._table, self._key(manifest_id, version))
+
+
+class JsonFsEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "evaluation_instances"
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        with self._c.lock():
+            iid = instance.id or str(self._c.next_seq(self._table))
+            inst = EvaluationInstance(**{**instance.__dict__, "id": iid})
+            self._c.write(self._table, iid, _instance_doc(inst))
+            return iid
+
+    def get(self, instance_id: str):
+        doc = self._c.read(self._table, instance_id)
+        return _instance_from(EvaluationInstance, doc) if doc else None
+
+    def get_all(self):
+        return [
+            _instance_from(EvaluationInstance, d)
+            for d in self._c.scan(self._table)
+        ]
+
+    def get_completed(self):
+        out = [i for i in self.get_all() if i.status == "EVALCOMPLETED"]
+        return sorted(out, key=lambda i: i.start_time, reverse=True)
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        with self._c.lock():
+            if self._c.read(self._table, instance.id) is None:
+                return False
+            self._c.write(self._table, instance.id, _instance_doc(instance))
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._c.lock():
+            return self._c.delete(self._table, instance_id)
+
+
+class JsonFsModels(base.Models):
+    """Model blobs live beside the JSON index as raw ``.bin`` files."""
+
+    def __init__(self, client: JsonFsClient, prefix: str = ""):
+        self._c = client
+        self._table = prefix + "models"
+
+    def _bin(self, model_id: str) -> Path:
+        return self._c.tdir(self._table, create=True) / (_enc(model_id) + ".bin")
+
+    def insert(self, model: Model) -> None:
+        with self._c.lock():
+            path = self._bin(model.id)
+            tmp = path.with_suffix(".bin.tmp")
+            tmp.write_bytes(model.models)
+            os.replace(tmp, path)
+            self._c.write(
+                self._table, model.id,
+                {"id": model.id, "size": len(model.models)},
+            )
+
+    def get(self, model_id: str):
+        doc = self._c.read(self._table, model_id)
+        if doc is None:
+            return None
+        try:
+            blob = self._bin(model_id).read_bytes()
+        except FileNotFoundError:
+            return None
+        return Model(model_id, blob)
+
+    def delete(self, model_id: str) -> bool:
+        with self._c.lock():
+            existed = self._c.delete(self._table, model_id)
+            try:
+                self._bin(model_id).unlink()
+            except FileNotFoundError:
+                pass
+            return existed

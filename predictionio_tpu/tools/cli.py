@@ -128,6 +128,17 @@ def build_parser() -> argparse.ArgumentParser:
     tpl_sub = p_tpl.add_subparsers(dest="template_command", required=True)
     p = tpl_sub.add_parser("list", help="list built-in templates")
     p.set_defaults(func=cmd_template_list)
+    p = tpl_sub.add_parser(
+        "get", help="fetch a template from the gallery / a git source"
+    )
+    p.add_argument("repository", help="gallery ID, Org/Repo, git URL, or path")
+    p.add_argument("directory")
+    p.add_argument("--version", default=None, help="tag to use (default: newest)")
+    p.add_argument("--name", default=None, help="author name")
+    p.add_argument("--email", default=None, help="author e-mail")
+    p.add_argument("--package", dest="organization", default=None,
+                   help="organization / package name")
+    p.set_defaults(func=cmd_template_get)
     p = tpl_sub.add_parser("scaffold", help="copy a template into a directory")
     p.add_argument("template_name")
     p.add_argument("directory")
@@ -151,6 +162,19 @@ def build_parser() -> argparse.ArgumentParser:
     p_admin.add_argument("--ip", default="127.0.0.1")
     p_admin.add_argument("--port", type=int, default=7071)
     p_admin.set_defaults(func=cmd_adminserver)
+
+    # -- start-all / stop-all (ref: bin/pio-start-all, bin/pio-stop-all) ----
+    from predictionio_tpu.tools.start_stop import cmd_start_all, cmd_stop_all
+
+    p_sa = sub.add_parser(
+        "start-all", help="start event server + admin API + dashboard"
+    )
+    p_sa.add_argument("--event-port", type=int, default=None)
+    p_sa.add_argument("--admin-port", type=int, default=None)
+    p_sa.add_argument("--dashboard-port", type=int, default=None)
+    p_sa.set_defaults(func=cmd_start_all)
+    p_st = sub.add_parser("stop-all", help="stop services started by start-all")
+    p_st.set_defaults(func=cmd_stop_all)
 
     # -- export / import (ref: Console.scala export/import) -----------------
     p_exp = sub.add_parser("export", help="export events to a JSON-lines file")
@@ -281,11 +305,17 @@ def cmd_deploy(args) -> int:
     """ref: Console.deploy:835-894 — latest completed instance → server."""
     import os
 
-    from predictionio_tpu.workflow.create_server import ServerConfig, create_server
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+        undeploy,
+    )
 
     variant = _load_variant(args.engine_json)
     if variant is None:
         return 1
+    if args.port:  # ref: CreateServer.scala:288-310 undeploy-before-bind
+        undeploy(args.ip, args.port)
     config = ServerConfig(
         engine_id=variant.get("id", "default"),
         engine_version=variant.get("version", "1"),
@@ -365,10 +395,29 @@ def cmd_eval(args) -> int:
 
 def cmd_template_list(args) -> int:
     from predictionio_tpu.templates import TEMPLATE_NAMES
+    from predictionio_tpu.tools.template import load_gallery
 
     for name in TEMPLATE_NAMES:
         print(f"[INFO] {name}")
+    gallery = load_gallery()
+    if gallery:
+        print("[INFO] Gallery templates:")
+        for entry in sorted(gallery, key=lambda e: str(e.get("repo", "")).lower()):
+            print(f"[INFO] {entry.get('repo')}")
     return 0
+
+
+def cmd_template_get(args) -> int:
+    from predictionio_tpu.tools.template import get_template
+
+    return get_template(
+        args.repository,
+        args.directory,
+        version=args.version,
+        name=args.name,
+        email=args.email,
+        organization=args.organization,
+    )
 
 
 def cmd_template_scaffold(args) -> int:

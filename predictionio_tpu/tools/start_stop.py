@@ -1,0 +1,139 @@
+"""``pio start-all`` / ``pio stop-all`` — one-command operator bring-up.
+
+The reference ships ``bin/pio-start-all`` / ``bin/pio-stop-all`` shell
+scripts that start/stop the dependent services of a single-node deployment
+(Elasticsearch, HBase, the Event Server — ref: bin/pio-start-all,
+bin/pio-stop-all). The TPU stack's storage backends are in-process, so the
+services to manage are our own: the Event Server (7070), the Admin API
+(7071), and the Dashboard (9000). Each is spawned as a detached child
+running the ``pio`` console verb, with a pidfile + logfile under
+``$PIO_TPU_HOME/pids`` (default ``~/.predictionio_tpu``)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+SERVICES = (
+    # (name, verb, port flag default)
+    ("eventserver", ["eventserver"], 7070),
+    ("adminserver", ["adminserver"], 7071),
+    ("dashboard", ["dashboard"], 9000),
+)
+
+
+def _pid_dir() -> Path:
+    home = os.environ.get("PIO_TPU_HOME")
+    base = Path(home) if home else Path.home() / ".predictionio_tpu"
+    d = base / "pids"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _alive(pid: int) -> bool:
+    if pid <= 0:  # empty/corrupt pidfile must read as "not running"
+        return False
+    try:  # reap first, in case it's an exited child of this very process
+        os.waitpid(pid, os.WNOHANG)
+    except (ChildProcessError, OSError):
+        pass
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    try:  # a zombie still answers kill(0); check its state
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(") ", 1)[1].startswith("Z"):
+                return False
+    except (OSError, IndexError):
+        pass
+    return True
+
+
+def cmd_start_all(args) -> int:
+    """Start event server + admin server + dashboard, detached."""
+    pid_dir = _pid_dir()
+    rc = 0
+    for name, verb, default_port in SERVICES:
+        pidfile = pid_dir / f"{name}.pid"
+        if pidfile.exists() and _alive(int(pidfile.read_text().strip() or 0)):
+            # ref bin/pio-start-all aborts when a service is already up
+            print(f"[ERROR] {name} is already running. Please use "
+                  "`pio stop-all` to stop it first.", file=sys.stderr)
+            rc = 1
+            continue
+        port = getattr(args, f"{name.replace('server', '')}_port", None) or \
+            default_port
+        log_path = pid_dir / f"{name}.log"
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "predictionio_tpu.tools.cli",
+                 *verb, "--port", str(port)],
+                stdout=log, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        pidfile.write_text(str(proc.pid) + "\n")
+        print(f"[INFO] Starting {name} on port {port} (pid {proc.pid}, "
+              f"log {log_path})")
+    # brief liveness check so obvious failures surface immediately
+    time.sleep(1.0)
+    for name, _verb, _port in SERVICES:
+        pidfile = pid_dir / f"{name}.pid"
+        if pidfile.exists() and not _alive(int(pidfile.read_text().strip())):
+            print(f"[ERROR] {name} exited right after start — see "
+                  f"{pid_dir / (name + '.log')}", file=sys.stderr)
+            pidfile.unlink()
+            rc = 1
+    if rc == 0:
+        print("[INFO] All services started.")
+    return rc
+
+
+def cmd_stop_all(args) -> int:
+    """Stop every service started by ``pio start-all``."""
+    pid_dir = _pid_dir()
+    stopped = 0
+    for name, _verb, _port in SERVICES:
+        pidfile = pid_dir / f"{name}.pid"
+        if not pidfile.exists():
+            continue
+        try:
+            pid = int(pidfile.read_text().strip())
+        except ValueError:
+            pidfile.unlink()
+            continue
+        if _alive(pid):
+            print(f"[INFO] Stopping {name} (pid {pid})")
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            for _ in range(20):
+                if not _alive(pid):
+                    break
+                time.sleep(0.1)
+            if _alive(pid):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            try:  # reap our own child so no zombie outlives stop-all
+                os.waitpid(pid, 0)
+            except (ChildProcessError, OSError):
+                pass
+            stopped += 1
+        pidfile.unlink()
+    print(f"[INFO] Stopped {stopped} service(s).")
+    return 0
+
+
+def main_start_all() -> None:  # pio-start-all console script
+    sys.exit(cmd_start_all(type("Args", (), {})()))
+
+
+def main_stop_all() -> None:  # pio-stop-all console script
+    sys.exit(cmd_stop_all(type("Args", (), {})()))

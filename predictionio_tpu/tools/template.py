@@ -1,0 +1,179 @@
+"""``pio template`` — the template-gallery workflow.
+
+Re-design of the reference's GitHub template gallery (ref:
+tools/src/main/scala/io/prediction/tools/console/Template.scala:143-330):
+
+* ``pio template list`` — built-in templates plus, when a gallery index is
+  configured, its registered template IDs (the reference fetches
+  ``templates.prediction.io/index.json``; ours reads the
+  ``PIO_TEMPLATE_GALLERY`` env var — a path or URL to an index.json of
+  ``[{"repo": ..., "source": <git url or local path>}, ...]``).
+* ``pio template get <repo> <dir>`` — fetch a template engine by git clone
+  (GitHub ``Org/Repo`` shorthand, any git URL, or a local directory — the
+  reference downloads a tag zipball), pick a version (``--version`` tag,
+  else the newest tag, else the default branch — ref Template.scala:293-306
+  ``tags.head``), then personalize: ``{{name}}``/``{{email}}``/
+  ``{{organization}}`` placeholders are substituted across text files the
+  way the reference rewrites Scala package names, with defaults taken from
+  ``git config`` (ref: Template.scala:244-265). Non-interactive by design —
+  the reference's readLine prompts and subscribe POST don't fit a scripted
+  TPU workflow; author metadata is recorded in ``.template-meta.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+TEXT_SUFFIXES = {".py", ".json", ".md", ".txt", ".toml", ".cfg", ".ini",
+                 ".yaml", ".yml", ".html", ".sh"}
+PLACEHOLDERS = ("name", "email", "organization")
+
+
+def _git(args: list[str], cwd: str | None = None) -> str:
+    res = subprocess.run(
+        ["git", *args], cwd=cwd, capture_output=True, text=True, check=True
+    )
+    return res.stdout.strip()
+
+
+def _git_config(key: str) -> str | None:
+    try:
+        return _git(["config", "--get", key]) or None
+    except subprocess.CalledProcessError:
+        return None
+
+
+def load_gallery() -> list[dict]:
+    """Gallery index entries, or [] when no gallery is configured."""
+    source = os.environ.get("PIO_TEMPLATE_GALLERY")
+    if not source:
+        return []
+    try:
+        if source.startswith(("http://", "https://")):
+            with urllib.request.urlopen(source, timeout=10) as resp:
+                raw = resp.read().decode("utf-8")
+        else:
+            raw = Path(source).read_text()
+        entries = json.loads(raw)
+        return entries if isinstance(entries, list) else []
+    except Exception as e:  # noqa: BLE001 — gallery outage must not kill list
+        print(f"[WARN] Unable to read template gallery {source}: {e}",
+              file=sys.stderr)
+        return []
+
+
+def resolve_source(repo: str) -> str:
+    """Template ID → clonable source: gallery mapping first, then local
+    paths and git URLs verbatim, then GitHub ``Org/Repo`` shorthand."""
+    for entry in load_gallery():
+        if entry.get("repo") == repo:
+            return entry.get("source") or entry.get("url") or repo
+    if Path(repo).exists():
+        return repo
+    if "://" in repo or repo.endswith(".git") or repo.startswith("git@"):
+        return repo
+    return f"https://github.com/{repo}.git"
+
+
+def _checkout_version(dest: Path, version: str | None) -> str | None:
+    """Pick the requested tag, else the newest tag (ref: ``tags.head``),
+    else stay on the default branch. Returns the tag used, if any."""
+    # version-aware ordering: same-second tags make creatordate ambiguous
+    tags = _git(
+        ["tag", "--list", "--sort=-v:refname"], cwd=str(dest)
+    ).splitlines()
+    tag = None
+    if version:
+        if version not in tags:
+            raise SystemExit(
+                f"[ERROR] {dest.name} does not have tag {version}. Aborting."
+            )
+        tag = version
+    elif tags:
+        tag = tags[0]
+    if tag:
+        _git(["checkout", "--quiet", f"tags/{tag}"], cwd=str(dest))
+    return tag
+
+
+def personalize(target: Path, subs: dict[str, str]) -> int:
+    """Substitute ``{{name}}``-style placeholders across the template's text
+    files — the analog of the reference's package rename sweep
+    (ref: Template.scala:366-419). Returns the number of files rewritten."""
+    changed = 0
+    for path in target.rglob("*"):
+        if not path.is_file() or path.suffix not in TEXT_SUFFIXES:
+            continue
+        try:
+            text = path.read_text()
+        except UnicodeDecodeError:
+            continue
+        out = text
+        for key, value in subs.items():
+            out = out.replace("{{" + key + "}}", value)
+        if out != text:
+            path.write_text(out)
+            changed += 1
+    return changed
+
+
+def get_template(
+    repo: str,
+    directory: str,
+    version: str | None = None,
+    name: str | None = None,
+    email: str | None = None,
+    organization: str | None = None,
+) -> int:
+    source = resolve_source(repo)
+    target = Path(directory)
+    if target.exists() and any(target.iterdir()):
+        print(f"[ERROR] Destination {target} exists and is not empty. "
+              "Aborting.", file=sys.stderr)
+        return 1
+    # the gallery index is untrusted input: a crafted "source" could abuse
+    # git transport helpers (ext::sh -c ...) or be parsed as an option
+    if source.startswith("-") or (
+        "://" in source
+        and not source.startswith(("http://", "https://", "ssh://", "git://"))
+    ) or source.startswith("ext::"):
+        print(f"[ERROR] Refusing suspicious template source: {source}",
+              file=sys.stderr)
+        return 1
+    print(f"[INFO] Retrieving {repo}")
+    try:
+        _git(["clone", "--quiet", "--", source, str(target)])
+    except subprocess.CalledProcessError as e:
+        print(f"[ERROR] Unable to fetch {source}: {e.stderr.strip()}",
+              file=sys.stderr)
+        return 1
+    try:
+        tag = _checkout_version(target, version)
+    except SystemExit as e:
+        print(str(e), file=sys.stderr)
+        shutil.rmtree(target)
+        return 1
+    if tag:
+        print(f"[INFO] Using tag {tag}")
+    shutil.rmtree(target / ".git", ignore_errors=True)
+
+    subs = {
+        "name": name or _git_config("user.name") or "",
+        "email": email or _git_config("user.email") or "",
+        "organization": organization or "org.example",
+    }
+    changed = personalize(target, subs)
+    if changed:
+        print(f"[INFO] Personalized {changed} file(s)")
+    meta = {"repo": repo, "source": source, "tag": tag, **subs}
+    (target / ".template-meta.json").write_text(
+        json.dumps(meta, indent=2) + "\n"
+    )
+    print(f"[INFO] Engine template {repo} is now ready at {target}")
+    return 0

@@ -22,13 +22,22 @@ import json
 import logging
 import threading
 import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
+
+import html
 
 from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
 from predictionio_tpu.core.persistent_model import deserialize_models
 from predictionio_tpu.data.storage import Storage
-from predictionio_tpu.utils.http import AppServer, HTTPError, Request, Router
+from predictionio_tpu.utils.http import (
+    AppServer,
+    HTTPError,
+    RawResponse,
+    Request,
+    Router,
+)
 from predictionio_tpu.utils.time import format_datetime, now
 from predictionio_tpu.workflow.context import workflow_context
 from predictionio_tpu.workflow.engine_loader import get_engine
@@ -179,6 +188,12 @@ class QueryService:
         return r
 
     def get_status(self, request: Request):
+        """Server status: HTML when the client asks for it (a browser's
+        ``Accept: text/html``), JSON otherwise — the reference serves the
+        twirl index page here (ref: CreateServer.scala:418-420,
+        core/src/main/twirl/io/prediction/workflow/index.scala.html)."""
+        if "text/html" in request.headers.get("Accept", ""):
+            return 200, RawResponse(self._status_html())
         with self.lock:
             body = {
                 "status": "alive",
@@ -196,6 +211,81 @@ class QueryService:
                 "maxBatchSize": self.batcher.max_batch_seen,
             }
         return 200, body
+
+    def _status_html(self) -> str:
+        """Engine-server index page, mirroring the reference's field set
+        (ref: core/src/main/twirl/io/prediction/workflow/index.scala.html):
+        training times, variant/instance ids, server start time, request
+        count, avg/last serving seconds, per-stage parameters, feedback."""
+        cfg = self.config
+        with self.lock:
+            inst = self.instance
+            algorithms = self.algorithms
+            models = self.models
+            request_count = self.request_count
+            avg_s = self.avg_serving_sec
+            last_s = self.last_serving_sec
+
+        def esc(v) -> str:
+            return html.escape(str(v))
+
+        def table(rows: list[tuple[str, object]]) -> str:
+            tr = "".join(
+                f"<tr><th>{esc(k)}</th><td>{esc(v)}</td></tr>" for k, v in rows
+            )
+            return f"<table>{tr}</table>"
+
+        algo_rows = "".join(
+            f"<tr><th rowspan=3>{i + 1}</th>"
+            f"<th>Class</th><td>{esc(type(a).__name__)}</td></tr>"
+            f"<tr><th>Parameters</th><td>{esc(getattr(a, 'params', ''))}</td></tr>"
+            f"<tr><th>Model</th><td>{esc(type(m).__name__)}</td></tr>"
+            for i, (a, m) in enumerate(zip(algorithms, models))
+        )
+        title = (
+            f"{esc(inst.engine_factory)} ({esc(inst.engine_variant)}) - "
+            f"PredictionIO Engine Server at {esc(cfg.ip)}:{esc(cfg.port)}"
+        )
+        return f"""<!DOCTYPE html>
+<html lang="en"><head><title>{title}</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+ th, td {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left; }}
+ td {{ font-family: Menlo, Monaco, Consolas, "Courier New", monospace; }}
+</style></head><body>
+<h1>PredictionIO Engine Server at {esc(cfg.ip)}:{esc(cfg.port)}</h1>
+<p>{esc(inst.engine_factory)} ({esc(inst.engine_variant)})</p>
+<h2>Engine Information</h2>
+{table([
+    ("Training Start Time", format_datetime(inst.start_time)),
+    ("Training End Time", format_datetime(inst.end_time)),
+    ("Variant ID", inst.engine_variant),
+    ("Instance ID", inst.id),
+])}
+<h2>Server Information</h2>
+{table([
+    ("Start Time", format_datetime(self.start_time)),
+    ("Request Count", request_count),
+    ("Average Serving Time", f"{avg_s:.4f} seconds"),
+    ("Last Serving Time", f"{last_s:.4f} seconds"),
+    ("Engine Factory Class", inst.engine_factory),
+])}
+<h2>Data Source</h2>
+{table([("Parameters", inst.data_source_params)])}
+<h2>Data Preparator</h2>
+{table([("Parameters", inst.preparator_params)])}
+<h2>Algorithms and Models</h2>
+<table><tr><th>#</th><th colspan=2>Information</th></tr>{algo_rows}</table>
+<h2>Serving</h2>
+{table([("Parameters", inst.serving_params)])}
+<h2>Feedback Loop Information</h2>
+{table([
+    ("Feedback Loop Enabled?", cfg.feedback),
+    ("Event Server IP", cfg.event_server_ip),
+    ("Event Server Port", cfg.event_server_port),
+])}
+</body></html>"""
 
     def post_query(self, request: Request):
         """The per-query hot path (ref: ServerActor route:490-641).
@@ -334,6 +424,32 @@ class QueryService:
 
     def wait_for_stop(self) -> None:
         self._stop_event.wait()
+
+
+def undeploy(ip: str, port: int) -> None:
+    """Stop any engine server already on ip:port before binding ours — the
+    reference MasterActor's undeploy-before-bind (ref:
+    CreateServer.scala:288-310). Nothing listening is the normal case."""
+    host = "127.0.0.1" if ip in ("0.0.0.0", "::") else ip
+    url = f"http://{host}:{port}/stop"
+    logger.info("Undeploying any existing engine instance at %s:%s", ip, port)
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            if resp.status == 200:
+                time.sleep(0.5)  # let the old server release the port
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            logger.error(
+                "Another process is using %s:%s. Unable to undeploy.", ip, port
+            )
+        else:
+            logger.error(
+                "Another process is using %s:%s, or an existing engine "
+                "server is not responding properly (HTTP %s). Unable to "
+                "undeploy.", ip, port, e.code,
+            )
+    except (ConnectionError, OSError):
+        logger.debug("Nothing at %s:%s", ip, port)
 
 
 def create_server(config: ServerConfig) -> tuple[AppServer, QueryService]:

@@ -128,3 +128,26 @@ def sqlite_storage(monkeypatch, tmp_path):
     Storage.reset()
     yield Storage
     Storage.reset()
+
+
+@pytest.fixture()
+def jsonfs_storage(monkeypatch, tmp_path):
+    """All three repositories on the contrib jsonfs document tree, resolved
+    through the registry's THIRD-PARTY module-path hook (TYPE = a module
+    path, not a built-in name) — the ES-plugin loading path of the
+    reference (ref: Storage.scala:263-312)."""
+    from predictionio_tpu.data.storage import Storage
+
+    for key in list(os.environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv(
+        "PIO_STORAGE_SOURCES_DOC_TYPE", "predictionio_tpu.contrib.jsonfs"
+    )
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_DOC_PATH", str(tmp_path / "doctree"))
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "DOC")
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"test_{repo.lower()}")
+    Storage.reset()
+    yield Storage
+    Storage.reset()

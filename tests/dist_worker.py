@@ -1,0 +1,51 @@
+"""Worker process for the two-process jax.distributed smoke test.
+
+Spawned by tests/test_distributed.py with PIO_TPU_COORDINATOR /
+PIO_TPU_NUM_PROCESSES / PIO_TPU_PROCESS_ID set — the same env contract the
+reference's spark-submit cluster deploy uses for driver/executor wiring
+(ref: workflow/WorkflowContext.scala:26-42; SURVEY.md §2.1
+driver⇄executor process model). Each process contributes 4 virtual CPU
+devices; the mesh must span all 8 and a global-sum pjit program must agree
+on every process.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.workflow.context import workflow_context
+
+    ctx = workflow_context("distributed smoke", "train")
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    assert ctx.n_devices == 8, ctx.n_devices
+
+    # one globally-sharded array: row i carries value i, rows over `data`
+    arr = jax.make_array_from_callback(
+        (8, 4),
+        NamedSharding(ctx.mesh, P("data")),
+        lambda idx: np.full((1, 4), idx[0].start, np.float32),
+    )
+    total = jax.jit(
+        lambda x: x.sum(), out_shardings=NamedSharding(ctx.mesh, P())
+    )(arr)
+    # sum over rows 0..7 of 4 columns = 4 * 28
+    print(f"RESULT {os.environ['PIO_TPU_PROCESS_ID']} {float(total)}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Two-process jax.distributed smoke test (SURVEY.md §2.1).
+
+The reference scales out by launching executors via spark-submit; our analog
+is N SPMD processes joined through ``jax.distributed.initialize``, driven by
+the ``PIO_TPU_COORDINATOR`` env contract in workflow/context.py. This test
+actually exercises that path: two real OS processes, 4 virtual CPU devices
+each, one global mesh, gloo cross-process collectives.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).with_name("dist_worker.py")
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_spans_and_reduces():
+    port = _free_port()
+    env_base = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("PIO_TPU_", "XLA_", "JAX_"))
+    }
+    env_base["PYTHONPATH"] = str(REPO_ROOT)
+    env_base["PIO_TPU_COORDINATOR"] = f"localhost:{port}"
+    env_base["PIO_TPU_NUM_PROCESSES"] = "2"
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, PIO_TPU_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(WORKER)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"RESULT {pid} 112.0" in out, f"worker {pid} output:\n{out}"

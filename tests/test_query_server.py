@@ -90,6 +90,38 @@ def test_status_page(server):
     assert body["engineFactory"] == FACTORY
 
 
+def test_status_page_html_for_browsers(server):
+    """GET / with Accept: text/html renders the engine-server index page
+    (ref: core/src/main/twirl/io/prediction/workflow/index.scala.html)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server['port']}/",
+        headers={"Accept": "text/html,application/xhtml+xml"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/html")
+        page = resp.read().decode()
+    assert "PredictionIO Engine Server" in page
+    assert FACTORY in page
+    assert "Request Count" in page
+    assert "Average Serving Time" in page
+    assert "Last Serving Time" in page
+    assert "Instance ID" in page
+
+
+def test_undeploy_before_bind_stops_existing_server(server):
+    """undeploy() hits /stop on an occupied ip:port so a redeploy can bind
+    (ref: CreateServer.scala:288-310); on an empty port it is a no-op."""
+    from predictionio_tpu.workflow.create_server import undeploy
+
+    service = server["service"]
+    assert not service._stop_event.is_set()
+    undeploy("127.0.0.1", server["port"])
+    assert service._stop_event.is_set()
+    # nothing listening: must not raise
+    undeploy("127.0.0.1", 1)  # port 1 is never bound in tests
+
+
 def test_query_returns_ranked_items(server):
     status, body = call(server["port"], "POST", "/queries.json",
                         {"user": "u1", "num": 5})

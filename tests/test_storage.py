@@ -26,7 +26,7 @@ from predictionio_tpu.data.storage.base import (
 UTC = dt.timezone.utc
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog", "postgres"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "postgres", "jsonfs"])
 def storage(request):
     return request.getfixturevalue(f"{request.param}_storage")
 
@@ -239,6 +239,19 @@ class TestMetadata:
 
 def test_verify_all_data_objects(storage):
     assert storage.verify_all_data_objects() == []
+
+
+def test_third_party_backend_resolves_by_module_path(jsonfs_storage):
+    """The jsonfs spec backend is NOT a built-in type: its TYPE is a module
+    path discovered via CLASS_PREFIX — the plugin-classloading contract an
+    external backend package relies on (ref: Storage.scala:263-312)."""
+    from predictionio_tpu.data.storage.registry import BACKEND_TYPES
+
+    assert "predictionio_tpu.contrib.jsonfs" not in BACKEND_TYPES
+    assert "jsonfs" not in BACKEND_TYPES
+    from predictionio_tpu.contrib.jsonfs import JsonFsApps
+
+    assert isinstance(jsonfs_storage.get_meta_data_apps(), JsonFsApps)
 
 
 def test_default_config_uses_sqlite(monkeypatch, tmp_path):

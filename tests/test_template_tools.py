@@ -1,0 +1,164 @@
+"""Template gallery (`pio template get/list`) and start-all/stop-all tests
+(ref: tools/.../console/Template.scala:143-330, bin/pio-start-all)."""
+
+import json
+import os
+import socket
+import subprocess
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.tools.cli import main as cli_main
+
+
+def _git(args, cwd):
+    subprocess.run(
+        ["git", *args], cwd=cwd, check=True, capture_output=True, text=True,
+        env={**os.environ,
+             "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+             "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+    )
+
+
+@pytest.fixture
+def template_repo(tmp_path):
+    """A local git 'GitHub' repo with two tags and personalization
+    placeholders — the hermetic stand-in for a gallery template."""
+    repo = tmp_path / "upstream"
+    repo.mkdir()
+    (repo / "engine.json").write_text(json.dumps({
+        "engineFactory": "{{organization}}.myengine:engine_factory",
+        "datasource": {"params": {"app_name": "MyApp1"}},
+    }))
+    (repo / "README.md").write_text("by {{name}} <{{email}}>\n")
+    (repo / "blob.bin").write_bytes(b"\x00\xff{{name}}")  # binary: untouched
+    _git(["init", "-q"], repo)
+    _git(["add", "-A"], repo)
+    _git(["commit", "-q", "-m", "v1"], repo)
+    _git(["tag", "v0.1.0"], repo)
+    (repo / "VERSION").write_text("2\n")
+    _git(["add", "-A"], repo)
+    _git(["commit", "-q", "-m", "v2"], repo)
+    _git(["tag", "v0.2.0"], repo)
+    return repo
+
+
+class TestTemplateGet:
+    def test_get_latest_tag_and_personalize(self, template_repo, tmp_path):
+        dest = tmp_path / "mytpl"
+        rc = cli_main([
+            "template", "get", str(template_repo), str(dest),
+            "--name", "Jane Doe", "--email", "jane@example.com",
+            "--package", "com.acme",
+        ])
+        assert rc == 0
+        assert (dest / "VERSION").exists()  # newest tag v0.2.0
+        engine = json.loads((dest / "engine.json").read_text())
+        assert engine["engineFactory"].startswith("com.acme.")
+        assert "Jane Doe <jane@example.com>" in (dest / "README.md").read_text()
+        assert (dest / "blob.bin").read_bytes() == b"\x00\xff{{name}}"
+        assert not (dest / ".git").exists()
+        meta = json.loads((dest / ".template-meta.json").read_text())
+        assert meta["tag"] == "v0.2.0"
+
+    def test_get_pinned_version(self, template_repo, tmp_path):
+        dest = tmp_path / "pinned"
+        rc = cli_main([
+            "template", "get", str(template_repo), str(dest),
+            "--version", "v0.1.0", "--package", "org.x",
+        ])
+        assert rc == 0
+        assert not (dest / "VERSION").exists()  # pre-v0.2.0 tree
+
+    def test_get_unknown_tag_fails(self, template_repo, tmp_path):
+        dest = tmp_path / "bad"
+        rc = cli_main([
+            "template", "get", str(template_repo), str(dest),
+            "--version", "v9.9.9",
+        ])
+        assert rc == 1
+        assert not dest.exists()
+
+    def test_get_via_gallery_index(self, template_repo, tmp_path, monkeypatch,
+                                   capsys):
+        index = tmp_path / "index.json"
+        index.write_text(json.dumps(
+            [{"repo": "acme/recommender", "source": str(template_repo)}]
+        ))
+        monkeypatch.setenv("PIO_TEMPLATE_GALLERY", str(index))
+        assert cli_main(["template", "list"]) == 0
+        assert "acme/recommender" in capsys.readouterr().out
+        dest = tmp_path / "fromgallery"
+        rc = cli_main(
+            ["template", "get", "acme/recommender", str(dest),
+             "--package", "org.g"]
+        )
+        assert rc == 0
+        assert (dest / "engine.json").exists()
+
+    def test_get_refuses_nonempty_destination(self, template_repo, tmp_path):
+        dest = tmp_path / "occupied"
+        dest.mkdir()
+        (dest / "keep.txt").write_text("x")
+        rc = cli_main(["template", "get", str(template_repo), str(dest)])
+        assert rc == 1
+        assert (dest / "keep.txt").exists()
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestStartStopAll:
+    def test_start_all_then_stop_all(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+        # children inherit storage env: keep them on the memory backend
+        for key in list(os.environ):
+            if key.startswith("PIO_STORAGE_"):
+                monkeypatch.delenv(key)
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+        for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+            monkeypatch.setenv(
+                f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "MEM")
+            monkeypatch.setenv(
+                f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", repo.lower())
+        ports = {name: _free_port()
+                 for name in ("event", "admin", "dashboard")}
+        rc = cli_main([
+            "start-all",
+            "--event-port", str(ports["event"]),
+            "--admin-port", str(ports["admin"]),
+            "--dashboard-port", str(ports["dashboard"]),
+        ])
+        pid_dir = tmp_path / "pids"
+        try:
+            assert rc == 0
+            pids = {p.stem: int(p.read_text()) for p in pid_dir.glob("*.pid")}
+            assert set(pids) == {"eventserver", "adminserver", "dashboard"}
+            # the event server answers HTTP once it finishes booting
+            deadline = time.time() + 60
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{ports['event']}/", timeout=2
+                    ) as resp:
+                        assert resp.status == 200
+                    break
+                except Exception:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+            # starting again while running is refused (ref pio-start-all)
+            assert cli_main(["start-all"]) == 1
+        finally:
+            assert cli_main(["stop-all"]) == 0
+        from predictionio_tpu.tools.start_stop import _alive
+
+        for pid in pids.values():
+            assert not _alive(pid)
+        assert not list(pid_dir.glob("*.pid"))
