@@ -116,7 +116,10 @@ class JsonFsClient:
         if not d.exists():
             return
         for path in sorted(d.glob("*.json")):
-            yield json.loads(path.read_text())
+            try:
+                yield json.loads(path.read_text())
+            except FileNotFoundError:
+                continue  # deleted by a concurrent process mid-scan
 
     def next_seq(self, table: str) -> int:
         """Monotonic per-table id sequence (callers hold the source lock)."""

@@ -6,11 +6,12 @@ examples/scala-parallel-recommendation/custom-serving/src/main/scala/
 ALSAlgorithm.scala:27-67) with an XLA-native design in the style of ALX
 (arxiv 2112.02194, PAPERS.md):
 
-- Ratings are preprocessed host-side into **degree-bucketed dense tiles**:
-  entities are grouped by neighbor count and each bucket is padded to a
-  fixed width, so every device step is a large static-shape batched einsum +
-  Cholesky solve on the MXU — no sparse scatter/gather loops, no dynamic
-  shapes.
+- Ratings are grouped host-side into **degree buckets** (entities by
+  neighbor count); the host ships only narrow sorted COO arrays + per-
+  bucket CSR pointers, and the padded dense tiles are built ON DEVICE per
+  solve chunk, so every device step is a large static-shape batched
+  contraction + unrolled Cholesky — no sparse scatter/gather loops, no
+  dynamic shapes, no tile-sized host transfers.
 - Each half-iteration solves all entities of one side: gather the *fixed*
   side's factors (replicated in HBM), form per-entity normal equations
   ``(Yᵀ C Y + λ n I) x = Yᵀ C r``, batched ``cho_solve``, and scatter rows
@@ -40,11 +41,6 @@ from predictionio_tpu.parallel.mesh import ComputeContext
 
 logger = logging.getLogger(__name__)
 
-#: Replicating the packed rating blobs costs n_devices × blob bytes of HBM;
-#: above this size, ALS.train switches to per-bucket sharded transfers.
-_PACK_REPLICATE_MAX_BYTES = 128 * 1024 * 1024
-
-
 @dataclass(frozen=True)
 class ALSParams:
     """Hyperparameters (ref template engine.json defaults: rank 10,
@@ -57,17 +53,27 @@ class ALSParams:
     alpha: float = 1.0  # implicit confidence weight (MLlib default 1.0)
     seed: int | None = None
     max_degree: int = 4096  # per-entity neighbor cap (oversized rows truncate)
-    bucket_widths: tuple[int, ...] = (16, 64, 256, 1024, 4096)
-    #: Multi-chip transfer strategy cutover (see ALS.train): packed blobs up
-    #: to this size are replicated (one transfer, n_devices × HBM copies);
-    #: larger jobs transfer per-bucket with the batch sharding so each
-    #: device holds 1/n of the rating data.
-    pack_replicate_max_bytes: int = _PACK_REPLICATE_MAX_BYTES
+    #: Finer widths cut tile padding (HBM traffic scales with sum(n*k)):
+    #: at ML-20M the geometric ladder below pads ~1.4x vs ~2.2x for the
+    #: coarse (16,64,256,1024,4096) ladder.
+    bucket_widths: tuple[int, ...] = (
+        16, 32, 64, 128, 256, 512, 1024, 2048, 4096
+    )
+    #: dtype of the gathered fixed-side factors in the normal-equation
+    #: assembly (Gram/rhs einsums accumulate in f32 either way, and the
+    #:  solve itself is f32). bf16 halves the dominant HBM gather traffic;
+    #: set "float32" for bit-level parity studies.
+    gather_dtype: str = "bfloat16"
     #: HBM bound on a bucket solve's gathered-factor tensor ([rows, k, rank]
     #: elements). Buckets above it solve in sequential row chunks via
     #: ``lax.map`` so the gather temp is O(chunk), not O(bucket) — at
     #: ML-20M rank 64 the unchunked gather alone is >12 GB, past a v5e chip.
     max_solve_elems: int = 1 << 28
+    #: Solver choice. ``bucket`` (the ``auto`` pick) is the ALX-style
+    #: degree-bucketed dense batched solve; ``segment`` builds the normal
+    #: equations by sorted segment-sum over ratings — correct and
+    #: memory-lean, but its scatter-based reduction measured slower on v5e.
+    solver: str = "auto"
 
 
 @dataclass
@@ -77,16 +83,26 @@ class ALSFactors:
 
 
 @dataclass
-class _Bucket:
-    """One degree bucket of the bipartite graph, padded to static shape.
-    ``rows`` indexes the entity side being solved; ``cols`` the fixed side."""
+class _TileSpec:
+    """One degree bucket, described by per-entity CSR pointers instead of
+    materialized [n, k] tiles: the dense tiles are built ON DEVICE from the
+    sorted rating arrays (a [n, k] iota + two gathers), so the host ships
+    ~12 bytes/rating instead of ~24 and no tile buffers at all."""
 
-    rows: np.ndarray  # [n] int32 entity indices (padded with 0, weight 0)
-    cols: np.ndarray  # [n, k] int32 neighbor indices (padded 0)
-    ratings: np.ndarray  # [n, k] float32
-    weights: np.ndarray  # [n, k] float32, 1.0 valid / 0.0 padding
-    row_valid: np.ndarray  # [n] float32, 1.0 for real rows
+    rows: np.ndarray  # [n] int32 entity indices (padding aliases rows[0])
+    starts: np.ndarray  # [n] int32 offset into the sorted rating arrays
+    counts: np.ndarray  # [n] int32 ratings per entity (0 for padding rows)
+    width: int  # tile width k
     nc: int = 1  # solve in this many sequential row chunks (see max_solve_elems)
+
+
+@dataclass
+class _SidePlan:
+    """One side's per-bucket CSR tile specs. The entity-sorted rating
+    arrays the specs point into are produced ON DEVICE (`_device_etl`) —
+    the host computes only a `bincount` degree histogram."""
+
+    specs: list
 
 
 def _chunk_plan(
@@ -104,27 +120,42 @@ def _chunk_plan(
         nc *= 2
 
 
+def _narrow_nbr(neighbor_sorted: np.ndarray, n_other: int) -> np.ndarray:
+    if n_other <= np.iinfo(np.uint16).max:
+        return neighbor_sorted.astype(np.uint16)
+    return neighbor_sorted.astype(np.int32)
+
+
+def _narrow_val(ratings_sorted: np.ndarray) -> np.ndarray:
+    if (
+        np.all(ratings_sorted == np.rint(ratings_sorted))
+        and np.all(np.abs(ratings_sorted) <= 127)
+    ):
+        return ratings_sorted.astype(np.int8)
+    return ratings_sorted.astype(np.float32)
+
+
 def _bucketize(
     ctx: ComputeContext,
     entity_idx: np.ndarray,
-    neighbor_idx: np.ndarray,
-    ratings: np.ndarray,
     n_entities: int,
     params: ALSParams,
-) -> list[_Bucket]:
-    """Group entities by degree into padded dense tiles (ALX §3.2-style
-    density bucketing). Host-side, one-time per training run."""
-    order = np.argsort(entity_idx, kind="stable")
-    entity_sorted = entity_idx[order]
-    neighbor_sorted = neighbor_idx[order]
-    ratings_sorted = ratings[order]
-    uniq, starts, counts = np.unique(
-        entity_sorted, return_index=True, return_counts=True
-    )
+) -> _SidePlan:
+    """Group one side's entities by degree into tile *specs* (ALX §3.2-style
+    density bucketing). Host work is ONE `bincount` pass — no sorting: the
+    CSR starts follow from the cumulative histogram because the device-side
+    stable sort groups entities in ascending order, and the padded dense
+    tiles are built on device per solve chunk."""
+    counts_all = np.bincount(entity_idx, minlength=n_entities)
+    starts_all = np.zeros(len(counts_all), dtype=np.int64)
+    np.cumsum(counts_all[:-1], out=starts_all[1:])
+    uniq = np.flatnonzero(counts_all).astype(np.int32)
+    starts = starts_all[uniq].astype(np.int32)
+    counts = counts_all[uniq].astype(np.int32)
     widths = [w for w in params.bucket_widths if w <= params.max_degree]
     if not widths or widths[-1] < params.max_degree:
         widths.append(params.max_degree)
-    buckets: list[_Bucket] = []
+    specs: list[_TileSpec] = []
     for bi, width in enumerate(widths):
         lo = widths[bi - 1] if bi > 0 else 0
         if bi == len(widths) - 1:
@@ -134,103 +165,191 @@ def _bucketize(
         if not sel.any():
             continue
         b_entities = uniq[sel]
-        b_starts = starts[sel]
-        b_counts = np.minimum(counts[sel], width)
         n, nc = _chunk_plan(
             len(b_entities), width, params.rank, params.max_solve_elems,
             ctx.n_devices,
         )
-        cols = np.zeros((n, width), dtype=np.int32)
-        rates = np.zeros((n, width), dtype=np.float32)
-        weights = np.zeros((n, width), dtype=np.float32)
         rows = np.zeros(n, dtype=np.int32)
-        row_valid = np.zeros(n, dtype=np.float32)
+        b_starts = np.zeros(n, dtype=np.int32)
+        b_counts = np.zeros(n, dtype=np.int32)
         rows[: len(b_entities)] = b_entities
         # padding rows must alias an entity already being solved in this
-        # bucket: the scatter clears target[rows], so pointing padding at an
-        # out-of-bucket entity (e.g. index 0) would wipe its factors
+        # bucket (their count stays 0): the scatter clears target[rows], so
+        # pointing padding at an out-of-bucket entity would wipe its factors
         rows[len(b_entities):] = b_entities[0]
-        row_valid[: len(b_entities)] = 1.0
-        for j, (s, c) in enumerate(zip(b_starts, b_counts)):
-            cols[j, :c] = neighbor_sorted[s : s + c]
-            rates[j, :c] = ratings_sorted[s : s + c]
-            weights[j, :c] = 1.0
-        buckets.append(_Bucket(rows, cols, rates, weights, row_valid, nc))
-    return buckets
+        b_starts[: len(b_entities)] = starts[sel]
+        b_counts[: len(b_entities)] = np.minimum(counts[sel], width)
+        specs.append(_TileSpec(rows, b_starts, b_counts, width, nc))
+    return _SidePlan(specs)
 
 
-def _chunk_solutions(
-    fixed,  # [n_other, rank] fixed-side factors (replicated)
-    cols,  # [c, k] int32
-    ratings,  # [c, k] f32
-    weights,  # [c, k] f32
-    yty,  # [rank, rank] — YᵀY for implicit, zeros for explicit
-    lambda_: float,
-    alpha: float,
-    implicit: bool,
-    rank: int,
-):
-    """Normal-equation solutions for one row chunk of a bucket."""
-    y = fixed[cols]  # [c, k, r] gather, local (fixed is replicated)
-    n_ratings = weights.sum(axis=1)  # [c]
-    if implicit:
-        conf_minus1 = alpha * ratings * weights  # (c-1), only observed
-        gram = yty[None, :, :] + jnp.einsum(
-            "nk,nkr,nks->nrs", conf_minus1, y, y, optimize=True
-        )
-        rhs = jnp.einsum("nk,nkr->nr", (1.0 + conf_minus1) * weights, y)
-    else:
-        gram = jnp.einsum("nk,nkr,nks->nrs", weights, y, y, optimize=True)
-        rhs = jnp.einsum("nk,nkr->nr", ratings * weights, y)
-    # ALS-WR: λ scaled by per-entity rating count; +ε keeps padded rows SPD
-    reg = lambda_ * jnp.maximum(n_ratings, 1.0) + 1e-8
+@jax.jit
+def _device_etl(u_idx, i_idx, ratings):
+    """Sort the raw COO by each side ON DEVICE (the host ships the unsorted
+    triple once, in the narrowest dtypes). A 20M-row stable device sort is
+    tens of ms; the same sorts in numpy cost ~9s of host time per train.
+    The stable ascending sort makes the bincount-derived CSR starts from
+    :func:`_bucketize` line up exactly."""
+    u32 = u_idx.astype(jnp.int32)
+    i32 = i_idx.astype(jnp.int32)
+    rf = ratings.astype(jnp.float32)
+    pu = jnp.argsort(u32, stable=True)
+    pi = jnp.argsort(i32, stable=True)
+    return i32[pu], rf[pu], u32[pi], rf[pi]
+
+
+#: Ranks up to this solve via the unrolled structure-of-arrays Cholesky —
+#: measured ~6x faster than batched `lax.linalg.cholesky` at rank 10 on
+#: v5e (tiny batched linalg serializes poorly and its [n, r, r] operands
+#: tile-pad ~20x). Above it, unrolling r(r+1)/2 lane ops bloats the program.
+_SOA_SOLVE_MAX_RANK = 16
+
+
+def _soa_cho_solve(gram, rhs, reg, rank: int):
+    """Batched SPD solve in structure-of-arrays form: every L[i][j] is an
+    [n]-vector, the r(r+1)/2-step Cholesky-Banachiewicz recurrence is
+    unrolled at trace time, and all arithmetic is full-lane VPU ops."""
+    gram_t = jnp.transpose(gram, (1, 2, 0))  # [r, r, n] — n on lanes
+    rhs_t = rhs.T  # [r, n]
+    a = [[gram_t[i, j] for j in range(rank)] for i in range(rank)]
+    l = [[None] * rank for _ in range(rank)]
+    for j in range(rank):
+        s = a[j][j] + reg
+        for k in range(j):
+            s = s - l[j][k] * l[j][k]
+        d = jnp.sqrt(s)
+        l[j][j] = d
+        inv_d = 1.0 / d
+        for i in range(j + 1, rank):
+            s = a[i][j]
+            for k in range(j):
+                s = s - l[i][k] * l[j][k]
+            l[i][j] = s * inv_d
+    y = [None] * rank
+    for i in range(rank):
+        s = rhs_t[i]
+        for k in range(i):
+            s = s - l[i][k] * y[k]
+        y[i] = s / l[i][i]
+    x = [None] * rank
+    for i in reversed(range(rank)):
+        s = y[i]
+        for k in range(i + 1, rank):
+            s = s - l[k][i] * x[k]
+        x[i] = s / l[i][i]
+    return jnp.stack(x, axis=1)  # [n, r]
+
+
+def _reg_solve(gram, rhs, reg, rank: int):
+    """(gram + reg I) x = rhs, batched over the leading axis."""
+    if rank <= _SOA_SOLVE_MAX_RANK:
+        return _soa_cho_solve(gram, rhs, reg, rank)
     gram = gram + reg[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
     return jax.scipy.linalg.cho_solve(
         (jnp.linalg.cholesky(gram), True), rhs[..., None]
     )[..., 0]
 
 
-def _solve_bucket(
-    target,  # [n_entities, rank] factor matrix being updated (replicated)
+def _chunk_solutions(
     fixed,  # [n_other, rank] fixed-side factors (replicated)
-    rows,  # [n] int32
-    cols,  # [n, k] int32
-    ratings,  # [n, k] f32
-    weights,  # [n, k] f32
-    row_valid,  # [n] f32
+    nbr,  # [nnz] int32 sorted neighbor indices (replicated)
+    val,  # [nnz] f32 sorted ratings (replicated)
+    starts,  # [c] int32 CSR offsets
+    counts,  # [c] int32 per-entity degrees (0 → padding row)
+    width: int,
     yty,  # [rank, rank] — YᵀY for implicit, zeros for explicit
     lambda_: float,
     alpha: float,
     implicit: bool,
     rank: int,
+    gather_dtype: str = "bfloat16",
+):
+    """Normal-equation solutions for one row chunk of a bucket.
+
+    The [c, k] tile is built here on device (iota + CSR gather) instead of
+    being shipped from the host. The gathered factor tile [c, k, r] is the
+    dominant HBM traffic (its r-minor layout tile-pads r → 128 lanes, a
+    12.8x byte amplification at rank 10), so the gather and the Gram/rhs
+    contractions run in ``gather_dtype`` (bf16 halves the bytes and doubles
+    MXU rate) while accumulating and solving in f32."""
+    dt = jnp.dtype(gather_dtype)
+    iota = jnp.arange(width, dtype=jnp.int32)[None, :]
+    in_row = iota < counts[:, None]  # [c, k] bool validity mask
+    idx = jnp.where(in_row, starts[:, None] + iota, 0)
+    cols = nbr[idx]  # [c, k] — padded lanes alias nbr[0], masked below
+    weights = in_row.astype(jnp.float32)
+    ratings = val[idx] * weights
+    y = fixed.astype(dt)[cols]  # [c, k, r] gather, local (fixed replicated)
+    n_ratings = counts.astype(jnp.float32)  # [c]
+    if implicit:
+        conf_minus1 = alpha * ratings * weights  # (c-1), only observed
+        yw = y * conf_minus1[..., None].astype(dt)
+        gram = yty[None, :, :] + jnp.einsum(
+            "nkr,nks->nrs", yw, y, preferred_element_type=jnp.float32
+        )
+        rhs = jnp.einsum(
+            "nkr,nk->nr", y, ((1.0 + conf_minus1) * weights).astype(dt),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        yw = y * weights[..., None].astype(dt)
+        gram = jnp.einsum(
+            "nkr,nks->nrs", yw, y, preferred_element_type=jnp.float32
+        )
+        rhs = jnp.einsum(
+            "nkr,nk->nr", y, (ratings * weights).astype(dt),
+            preferred_element_type=jnp.float32,
+        )
+    # ALS-WR: λ scaled by per-entity rating count; +ε keeps padded rows SPD
+    reg = lambda_ * jnp.maximum(n_ratings, 1.0) + 1e-8
+    return _reg_solve(gram, rhs, reg, rank)
+
+
+def _solve_bucket(
+    target,  # [n_entities, rank] factor matrix being updated (replicated)
+    fixed,  # [n_other, rank] fixed-side factors (replicated)
+    nbr,  # [nnz] int32 sorted neighbors (replicated)
+    val,  # [nnz] f32 sorted ratings (replicated)
+    rows,  # [n] int32
+    starts,  # [n] int32
+    counts,  # [n] int32
+    yty,  # [rank, rank] — YᵀY for implicit, zeros for explicit
+    lambda_: float,
+    alpha: float,
+    implicit: bool,
+    rank: int,
+    width: int,
     nc: int = 1,
     shard=None,
+    gather_dtype: str = "bfloat16",
 ):
-    """One bucket's batched normal-equation solve. ``rows/cols/...`` are
-    sharded over the mesh ``data`` axis; ``target``/``fixed`` replicated, so
-    the row scatter at the end compiles to an ICI all-gather. Buckets whose
-    gather temp would exceed ALSParams.max_solve_elems arrive with ``nc>1``
-    and solve in sequential ``lax.map`` row chunks so HBM stays bounded.
-    Traced inside :func:`_als_iteration` — not jitted on its own."""
+    """One bucket's batched normal-equation solve. ``rows/starts/counts``
+    are sharded over the mesh ``data`` axis; ``target``/``fixed``/``nbr``/
+    ``val`` replicated, so the row scatter at the end compiles to an ICI
+    all-gather. Buckets whose gather temp would exceed
+    ALSParams.max_solve_elems arrive with ``nc>1`` and solve in sequential
+    ``lax.map`` row chunks so HBM stays bounded. Traced inside the train
+    loop — not jitted on its own."""
     if nc > 1:
         n = rows.shape[0]
         c = n // nc
-        xs = tuple(
-            x.reshape((nc, c) + x.shape[1:]) for x in (cols, ratings, weights)
-        )
+        xs = tuple(x.reshape(nc, c) for x in (starts, counts))
         if shard is not None:
             cs = NamedSharding(shard.mesh, P(None, *shard.spec))
             xs = tuple(jax.lax.with_sharding_constraint(x, cs) for x in xs)
         sol = jax.lax.map(
             lambda t: _chunk_solutions(
-                fixed, *t, yty, lambda_, alpha, implicit, rank
+                fixed, nbr, val, *t, width, yty, lambda_, alpha, implicit,
+                rank, gather_dtype,
             ),
             xs,
         ).reshape(n, rank)
     else:
         sol = _chunk_solutions(
-            fixed, cols, ratings, weights, yty, lambda_, alpha, implicit, rank
+            fixed, nbr, val, starts, counts, width, yty, lambda_, alpha,
+            implicit, rank, gather_dtype,
         )
+    row_valid = (counts > 0).astype(sol.dtype)
     sol = sol * row_valid[:, None]  # padded rows contribute nothing
     # scatter solved rows; padding rows alias an in-bucket entity and are
     # masked to zero, so add-after-clear keeps every row correct
@@ -252,140 +371,277 @@ def _init_factors(key, n: int, rank: int):
     )
 
 
-def _pack_buckets(buckets: list[_Bucket]) -> tuple[np.ndarray, np.ndarray, tuple]:
-    """Flatten a side's buckets into ONE int32 and ONE float32 host array.
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "meta", "shard", "gather_dtype"),
+    donate_argnums=(0, 1),
+)
+def _als_train(
+    user_f,
+    item_f,
+    u_nbr,  # [nnz] uint16/int32 user-sorted item indices (replicated)
+    u_val,  # [nnz] int8/f32 user-sorted ratings (replicated)
+    i_nbr,  # [nnz] item-sorted user indices (replicated)
+    i_val,  # [nnz] item-sorted ratings (replicated)
+    u_tiles,  # per-bucket (rows, starts, counts) tuples, sharded over `data`
+    i_tiles,
+    lambda_: float,
+    alpha: float,
+    iters,  # TRACED loop bound — iteration count changes reuse the compile
+    *,
+    implicit: bool,
+    rank: int,
+    meta: tuple,  # ((user (width, nc)...), (item (width, nc)...)) — static
+    shard=None,
+    gather_dtype: str = "bfloat16",
+):
+    """The WHOLE training run as one XLA dispatch.
 
-    Host→device transfer latency (not bandwidth) dominates small training
-    jobs — 5 arrays × buckets × 2 sides is dozens of round trips; packing
-    makes it two. Shapes are returned as a static tuple so the on-device
-    unpack in :func:`_als_iteration` is plain static slicing."""
-    if not buckets:  # a side with no ratings solves nothing
-        return (
-            np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float32), ()
+    The host ships only the narrow sorted COO arrays (uint16/int8 where
+    lossless) plus tiny per-bucket CSR pointers; dense tiles are built on
+    device inside each solve chunk. A single dispatch with a ``fori_loop``
+    keeps the host (and a tunneled TPU's per-call RPC and re-transfer)
+    entirely out of the training loop — at ML-20M scale that overhead
+    rivalled the compute itself."""
+    u_nbr = u_nbr.astype(jnp.int32)
+    i_nbr = i_nbr.astype(jnp.int32)
+    u_val = u_val.astype(jnp.float32)
+    i_val = i_val.astype(jnp.float32)
+    u_meta, i_meta = meta
+
+    def body(_i, carry):
+        uf, itf = carry
+        return _iteration_body(
+            uf, itf, u_nbr, u_val, i_nbr, i_val, u_tiles, i_tiles,
+            u_meta, i_meta, lambda_, alpha, implicit, rank, shard,
+            gather_dtype,
         )
-    ints = np.concatenate(
-        [np.concatenate([b.rows, b.cols.ravel()]) for b in buckets]
-    ).astype(np.int32)
-    floats = np.concatenate(
-        [
-            np.concatenate([b.ratings.ravel(), b.weights.ravel(), b.row_valid])
-            for b in buckets
-        ]
-    ).astype(np.float32)
-    shapes = tuple((len(b.rows), b.cols.shape[1], b.nc) for b in buckets)
-    return ints, floats, shapes
 
-
-def _unpack_buckets(ints, floats, shapes, shard):
-    """Static-offset slicing of the packed arrays back into bucket tensors,
-    resharding each onto the mesh ``data`` axis (ICI, cheap) so the solves
-    run with the same layout as individually-transferred buckets."""
-    out = []
-    oi = of = 0
-    for n, k, _nc in shapes:
-        rows = ints[oi : oi + n]
-        cols = ints[oi + n : oi + n + n * k].reshape(n, k)
-        oi += n + n * k
-        ratings = floats[of : of + n * k].reshape(n, k)
-        weights = floats[of + n * k : of + 2 * n * k].reshape(n, k)
-        row_valid = floats[of + 2 * n * k : of + 2 * n * k + n]
-        of += 2 * n * k + n
-        b = (rows, cols, ratings, weights, row_valid)
-        if shard is not None:
-            b = tuple(jax.lax.with_sharding_constraint(x, shard) for x in b)
-        out.append(b)
-    return out
-
-
-def _packed_len(shapes: tuple) -> tuple[int, int]:
-    """(int32 length, float32 length) of one side's packed blob."""
-    ints = sum(n + n * k for n, k, _nc in shapes)
-    floats = sum(2 * n * k + n for n, k, _nc in shapes)
-    return ints, floats
+    return jax.lax.fori_loop(0, iters, body, (user_f, item_f))
 
 
 @partial(
     jax.jit,
-    static_argnames=("implicit", "rank", "user_shapes", "item_shapes", "shard"),
+    static_argnames=("implicit", "rank", "meta", "shard", "gather_dtype"),
     donate_argnums=(0, 1),
 )
 def _als_iteration(
     user_f,
     item_f,
-    ints,  # both sides' packed int32 blob (user first)
-    floats,  # both sides' packed float32 blob (user first)
+    u_nbr,
+    u_val,
+    i_nbr,
+    i_val,
+    u_tiles,
+    i_tiles,
     lambda_: float,
     alpha: float,
     *,
     implicit: bool,
     rank: int,
-    user_shapes: tuple,
-    item_shapes: tuple,
+    meta: tuple,
     shard=None,
+    gather_dtype: str = "bfloat16",
 ):
-    """One full ALS iteration — both half-solves over every degree bucket —
-    as a single XLA program. Fusing the whole iteration removes per-bucket
-    dispatch overhead (the dominant cost at small problem sizes) and lets
-    XLA overlap the bucket solves' gathers/scatters."""
-    ui_len, uf_len = _packed_len(user_shapes)
-    user_buckets = _unpack_buckets(
-        ints[:ui_len], floats[:uf_len], user_shapes, shard
-    )
-    item_buckets = _unpack_buckets(
-        ints[ui_len:], floats[uf_len:], item_shapes, shard
-    )
+    """One ALS iteration as its own dispatch — the callback path (per-
+    iteration convergence probes); training without a callback goes through
+    :func:`_als_train`."""
+    u_meta, i_meta = meta
     return _iteration_body(
-        user_f, item_f, user_buckets, item_buckets,
-        tuple(s[2] for s in user_shapes), tuple(s[2] for s in item_shapes),
-        lambda_, alpha, implicit, rank, shard,
-    )
-
-
-@partial(
-    jax.jit,
-    static_argnames=("implicit", "rank", "user_nc", "item_nc", "shard"),
-    donate_argnums=(0, 1),
-)
-def _als_iteration_sharded(
-    user_f,
-    item_f,
-    user_buckets,  # pytree of per-bucket tuples, already sharded on device
-    item_buckets,
-    lambda_: float,
-    alpha: float,
-    *,
-    implicit: bool,
-    rank: int,
-    user_nc: tuple = (),
-    item_nc: tuple = (),
-    shard=None,
-):
-    """Large-job variant: buckets were transferred individually with the
-    batch sharding, so each device holds 1/n of the rating data for the whole
-    run (no replication of the blobs — see ALS.train's size cutover)."""
-    user_nc = user_nc or (1,) * len(user_buckets)
-    item_nc = item_nc or (1,) * len(item_buckets)
-    return _iteration_body(
-        user_f, item_f, user_buckets, item_buckets, user_nc, item_nc,
-        lambda_, alpha, implicit, rank, shard,
+        user_f, item_f, u_nbr.astype(jnp.int32), u_val.astype(jnp.float32),
+        i_nbr.astype(jnp.int32), i_val.astype(jnp.float32),
+        u_tiles, i_tiles, u_meta, i_meta, lambda_, alpha, implicit, rank,
+        shard, gather_dtype,
     )
 
 
 def _iteration_body(
-    user_f, item_f, user_buckets, item_buckets, user_nc, item_nc,
-    lambda_, alpha, implicit, rank, shard=None,
+    user_f, item_f, u_nbr, u_val, i_nbr, i_val, u_tiles, i_tiles,
+    u_meta, i_meta, lambda_, alpha, implicit, rank, shard=None,
+    gather_dtype="bfloat16",
 ):
     zeros_gram = jnp.zeros((rank, rank), user_f.dtype)
     yty = _gram(item_f) if implicit else zeros_gram
-    for b, nc in zip(user_buckets, user_nc):
+    for (rows, starts, counts), (width, nc) in zip(u_tiles, u_meta):
         user_f = _solve_bucket(
-            user_f, item_f, *b, yty, lambda_, alpha, implicit, rank, nc, shard
+            user_f, item_f, u_nbr, u_val, rows, starts, counts, yty,
+            lambda_, alpha, implicit, rank, width, nc, shard, gather_dtype,
         )
     xtx = _gram(user_f) if implicit else zeros_gram
-    for b, nc in zip(item_buckets, item_nc):
+    for (rows, starts, counts), (width, nc) in zip(i_tiles, i_meta):
         item_f = _solve_bucket(
-            item_f, user_f, *b, xtx, lambda_, alpha, implicit, rank, nc, shard
+            item_f, user_f, i_nbr, i_val, rows, starts, counts, xtx,
+            lambda_, alpha, implicit, rank, width, nc, shard, gather_dtype,
         )
+    return user_f, item_f
+
+
+# ---------------------------------------------------------------------------
+# Segment-sum solver (small ranks)
+# ---------------------------------------------------------------------------
+#
+# The bucketed solver's per-entity Gram matmuls execute as batched r x r
+# contractions: on the MXU those pad to 128x128 output tiles, a ~160x FLOP
+# amplification at the stock rank 10 (measured: 0.15 iter/s on ML-20M, MFU
+# ~0). For small ranks the normal equations are instead accumulated as a
+# *sorted segment reduction over ratings*:
+#
+#   gram[e]  = sum_{(e,j) in R}  w * y_j (x) y_j     -> r(r+1)/2 lanes
+#   rhs[e]   = sum_{(e,j) in R}  w * r * y_j         -> r lanes
+#
+# which is pure VPU elementwise work + `segment_sum` with
+# ``indices_are_sorted`` (ratings are host-sorted by entity once per run),
+# followed by one batched Cholesky solve over all entities. No degree
+# buckets, no padded tiles, no scatter at the end — the solve covers every
+# entity and zero-degree rows keep their previous factors by a `where`.
+
+
+@dataclass
+class _SegSide:
+    """One side's host-prepared, entity-sorted rating arrays."""
+
+    seg: np.ndarray  # [nnz_pad] int32 entity index per rating (sorted)
+    nbr: np.ndarray  # [nnz_pad] int32 fixed-side index per rating
+    val: np.ndarray  # [nnz_pad] f32 rating
+    wgt: np.ndarray  # [nnz_pad] f32 1.0 valid / 0.0 padding
+    n_entities: int
+    nc: int  # scan chunk count
+
+
+def _segment_prepare(
+    ctx: ComputeContext,
+    entity_idx: np.ndarray,
+    neighbor_idx: np.ndarray,
+    ratings: np.ndarray,
+    n_entities: int,
+    params: ALSParams,
+) -> _SegSide:
+    order = np.argsort(entity_idx, kind="stable")
+    seg = entity_idx[order]
+    nbr = neighbor_idx[order]
+    val = ratings[order]
+    lanes = params.rank * (params.rank + 1) // 2 + params.rank + 1
+    n, nc = _chunk_plan(
+        len(seg), 1, lanes, params.max_solve_elems, ctx.n_devices
+    )
+    pad = n - len(seg)
+    if pad:
+        # padding carries weight 0 (contributes nothing) and reuses the
+        # LAST segment id so the ids stay ascending — segment_sum is called
+        # with indices_are_sorted=True, which is UB on unsorted ids
+        last = seg[-1] if len(seg) else np.int32(0)
+        seg = np.concatenate([seg, np.full(pad, last, np.int32)])
+        nbr = np.concatenate([nbr, np.zeros(pad, np.int32)])
+        val = np.concatenate([val, np.zeros(pad, np.float32)])
+    wgt = np.ones(n, np.float32)
+    if pad:
+        wgt[len(order):] = 0.0
+    return _SegSide(seg, nbr, val, wgt, n_entities, nc)
+
+
+def _segment_half_solve(
+    prev,  # [n_entities, rank] factors being updated (replicated)
+    fixed,  # [n_other, rank] fixed-side factors (replicated)
+    seg, nbr, val, wgt,  # [nnz_pad] rating arrays, sharded over `data`
+    yty,  # [rank, rank] — YtY for implicit, zeros for explicit
+    lambda_: float,
+    alpha: float,
+    implicit: bool,
+    rank: int,
+    n_entities: int,
+    nc: int,
+    shard=None,
+):
+    iu, ju = np.triu_indices(rank)
+    n_pairs = len(iu)
+
+    def chunk_stats(carry, xs):
+        c_seg, c_nbr, c_val, c_wgt = xs
+        y = fixed[c_nbr]  # [c, r]
+        if implicit:
+            cm1 = alpha * c_val * c_wgt  # (confidence - 1), observed only
+            pair_w = cm1
+            rhs_w = (1.0 + cm1) * c_wgt
+        else:
+            pair_w = c_wgt
+            rhs_w = c_val * c_wgt
+        data = jnp.concatenate(
+            [
+                y[:, iu] * y[:, ju] * pair_w[:, None],  # [c, r(r+1)/2]
+                y * rhs_w[:, None],  # [c, r]
+                c_wgt[:, None],  # [c, 1] rating counts
+            ],
+            axis=1,
+        )
+        carry = carry + jax.ops.segment_sum(
+            data, c_seg, num_segments=n_entities, indices_are_sorted=True
+        )
+        return carry, None
+
+    stats0 = jnp.zeros((n_entities, n_pairs + rank + 1), fixed.dtype)
+    if nc > 1:
+        c = seg.shape[0] // nc
+        xs = tuple(x.reshape(nc, c) for x in (seg, nbr, val, wgt))
+        if shard is not None:
+            cs = NamedSharding(shard.mesh, P(None, *shard.spec))
+            xs = tuple(jax.lax.with_sharding_constraint(x, cs) for x in xs)
+        stats, _ = jax.lax.scan(chunk_stats, stats0, xs)
+    else:
+        stats, _ = chunk_stats(stats0, (seg, nbr, val, wgt))
+
+    pairs = stats[:, :n_pairs]
+    rhs = stats[:, n_pairs : n_pairs + rank]
+    counts = stats[:, -1]
+    gram = jnp.zeros((n_entities, rank, rank), fixed.dtype)
+    gram = gram.at[:, iu, ju].set(pairs)
+    gram = gram.at[:, ju, iu].set(pairs)  # symmetrize (diag overwritten same)
+    if implicit:
+        gram = gram + yty[None, :, :]
+    reg = lambda_ * jnp.maximum(counts, 1.0) + 1e-8
+    gram = gram + reg[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
+    sol = jax.scipy.linalg.cho_solve(
+        (jnp.linalg.cholesky(gram), True), rhs[..., None]
+    )[..., 0]
+    # zero-degree entities keep their previous factors (init preservation)
+    return jnp.where(counts[:, None] > 0, sol, prev)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "implicit", "rank", "n_users", "n_items", "user_nc", "item_nc",
+        "shard",
+    ),
+    donate_argnums=(0, 1),
+)
+def _als_iteration_segment(
+    user_f,
+    item_f,
+    u_seg, u_nbr, u_val, u_wgt,
+    i_seg, i_nbr, i_val, i_wgt,
+    lambda_: float,
+    alpha: float,
+    *,
+    implicit: bool,
+    rank: int,
+    n_users: int,
+    n_items: int,
+    user_nc: int,
+    item_nc: int,
+    shard=None,
+):
+    """One full ALS iteration via segment-sum normal equations."""
+    zeros_gram = jnp.zeros((rank, rank), user_f.dtype)
+    yty = _gram(item_f) if implicit else zeros_gram
+    user_f = _segment_half_solve(
+        user_f, item_f, u_seg, u_nbr, u_val, u_wgt, yty,
+        lambda_, alpha, implicit, rank, n_users, user_nc, shard,
+    )
+    xtx = _gram(user_f) if implicit else zeros_gram
+    item_f = _segment_half_solve(
+        item_f, user_f, i_seg, i_nbr, i_val, i_wgt, xtx,
+        lambda_, alpha, implicit, rank, n_items, item_nc, shard,
+    )
     return user_f, item_f
 
 
@@ -424,12 +680,24 @@ class ALS:
         if user_idx.size == 0:
             raise ValueError("ALS.train called with zero ratings")
 
-        user_buckets = _bucketize(ctx, user_idx, item_idx, ratings, n_users, p)
-        item_buckets = _bucketize(ctx, item_idx, user_idx, ratings, n_items, p)
+        if p.solver not in ("auto", "bucket", "segment"):
+            raise ValueError(
+                f"ALSParams.solver must be auto/bucket/segment, got {p.solver!r}"
+            )
+        # auto → bucket: the segment-sum path's scatter-heavy reduction
+        # measured slower than the dense bucketed reduce on v5e (it remains
+        # available as an explicit option and for very skewed graphs)
+        if p.solver == "segment":
+            return self._train_segment(
+                user_idx, item_idx, ratings, n_users, n_items, callback
+            )
+
+        uplan = _bucketize(ctx, user_idx, n_users, p)
+        iplan = _bucketize(ctx, item_idx, n_items, p)
         logger.info(
             "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
-            ratings.size, n_users, len(user_buckets), n_items, len(item_buckets),
-            p.rank,
+            ratings.size, n_users, len(uplan.specs), n_items,
+            len(iplan.specs), p.rank,
         )
 
         multi = ctx.mesh.devices.size > 1
@@ -441,62 +709,100 @@ class ALS:
             user_f = jax.device_put(user_f, ctx.replicated)
             item_f = jax.device_put(item_f, ctx.replicated)
 
-        u_ints, u_floats, u_shapes = _pack_buckets(user_buckets)
-        i_ints, i_floats, i_shapes = _pack_buckets(item_buckets)
-        packed_bytes = (
-            u_ints.nbytes + u_floats.nbytes + i_ints.nbytes + i_floats.nbytes
-        )
-        # Two transfer strategies (latency vs HBM): small jobs pack ALL
-        # rating data into ONE int32 + ONE float32 replicated transfer
-        # (host→device round trips dominate at this scale); large multi-chip
-        # jobs transfer per-bucket with the batch sharding so each device
-        # holds 1/n of the data instead of a full replica.
-        pack = not multi or packed_bytes <= p.pack_replicate_max_bytes
-        if pack:
-            ints = np.concatenate([u_ints, i_ints])
-            floats = np.concatenate([u_floats, i_floats])
-            if multi:
-                ints, floats = jax.device_put((ints, floats), ctx.replicated)
-            else:
-                ints, floats = jnp.asarray(ints), jnp.asarray(floats)
-            shard = ctx.batch_sharding() if multi else None
-        else:
-            bshard = ctx.batch_sharding()
-            dev_user_buckets = tuple(
-                tuple(
-                    jax.device_put(x, bshard)
-                    for x in (b.rows, b.cols, b.ratings, b.weights, b.row_valid)
-                )
-                for b in user_buckets
-            )
-            dev_item_buckets = tuple(
-                tuple(
-                    jax.device_put(x, bshard)
-                    for x in (b.rows, b.cols, b.ratings, b.weights, b.row_valid)
-                )
-                for b in item_buckets
-            )
+        # transfer: the UNSORTED raw COO once, in the narrowest lossless
+        # dtypes (uint16 ids when they fit, int8 integer ratings) + tiny
+        # per-bucket CSR pointers (sharded over `data`). Per-side sorting
+        # and dense-tile construction both happen on device, so nothing
+        # [n, k]-sized or pre-sorted ever crosses the host link.
+        shard = ctx.batch_sharding() if multi else None
 
-        for it in range(p.num_iterations):
-            if pack:
+        def put(x, sharding):
+            if multi:
+                return jax.device_put(x, sharding)
+            return jnp.asarray(x)
+
+        repl = ctx.replicated if multi else None
+        raw_u = put(_narrow_nbr(user_idx, n_users), repl)
+        raw_i = put(_narrow_nbr(item_idx, n_items), repl)
+        raw_r = put(_narrow_val(ratings), repl)
+        u_nbr, u_val, i_nbr, i_val = _device_etl(raw_u, raw_i, raw_r)
+        u_tiles = tuple(
+            tuple(put(x, shard) for x in (s.rows, s.starts, s.counts))
+            for s in uplan.specs
+        )
+        i_tiles = tuple(
+            tuple(put(x, shard) for x in (s.rows, s.starts, s.counts))
+            for s in iplan.specs
+        )
+        meta = (
+            tuple((s.width, s.nc) for s in uplan.specs),
+            tuple((s.width, s.nc) for s in iplan.specs),
+        )
+        static = dict(
+            implicit=p.implicit_prefs, rank=p.rank, meta=meta, shard=shard,
+            gather_dtype=p.gather_dtype,
+        )
+
+        if callback is None:
+            # the whole training run in ONE device dispatch (fori_loop):
+            # per-call host/RPC overhead would otherwise rival the compute
+            user_f, item_f = _als_train(
+                user_f, item_f, u_nbr, u_val, i_nbr, i_val,
+                u_tiles, i_tiles, p.lambda_, p.alpha, p.num_iterations,
+                **static,
+            )
+        else:
+            for it in range(p.num_iterations):
                 user_f, item_f = _als_iteration(
-                    user_f, item_f, ints, floats, p.lambda_, p.alpha,
-                    implicit=p.implicit_prefs, rank=p.rank,
-                    user_shapes=u_shapes, item_shapes=i_shapes, shard=shard,
+                    user_f, item_f, u_nbr, u_val, i_nbr, i_val,
+                    u_tiles, i_tiles, p.lambda_, p.alpha, **static,
                 )
-            else:
-                user_f, item_f = _als_iteration_sharded(
-                    user_f, item_f, dev_user_buckets, dev_item_buckets,
-                    p.lambda_, p.alpha,
-                    implicit=p.implicit_prefs, rank=p.rank,
-                    user_nc=tuple(b.nc for b in user_buckets),
-                    item_nc=tuple(b.nc for b in item_buckets),
-                    shard=bshard,
-                )
-            if callback is not None:
                 callback(it, user_f, item_f)
 
         # one readback for both factor matrices
+        packed = np.asarray(jnp.concatenate([user_f, item_f], axis=0))
+        return ALSFactors(packed[:n_users], packed[n_users:])
+
+    def _train_segment(
+        self, user_idx, item_idx, ratings, n_users, n_items, callback=None
+    ) -> ALSFactors:
+        """Segment-sum solver driver (see module section above)."""
+        p = self.params
+        ctx = self.ctx
+        us = _segment_prepare(ctx, user_idx, item_idx, ratings, n_users, p)
+        it = _segment_prepare(ctx, item_idx, user_idx, ratings, n_items, p)
+        logger.info(
+            "ALS(segment): %d ratings, %d users (%d chunks), %d items "
+            "(%d chunks), rank %d",
+            ratings.size, n_users, us.nc, n_items, it.nc, p.rank,
+        )
+        multi = ctx.mesh.devices.size > 1
+        key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+        ku, ki = jax.random.split(key)
+        user_f = _init_factors(ku, n_users, p.rank)
+        item_f = _init_factors(ki, n_items, p.rank)
+        shard = None
+        if multi:
+            user_f = jax.device_put(user_f, ctx.replicated)
+            item_f = jax.device_put(item_f, ctx.replicated)
+            shard = ctx.batch_sharding()
+
+        def put(x):
+            return jax.device_put(x, shard) if multi else jnp.asarray(x)
+
+        u_arrs = tuple(put(x) for x in (us.seg, us.nbr, us.val, us.wgt))
+        i_arrs = tuple(put(x) for x in (it.seg, it.nbr, it.val, it.wgt))
+
+        for step in range(p.num_iterations):
+            user_f, item_f = _als_iteration_segment(
+                user_f, item_f, *u_arrs, *i_arrs, p.lambda_, p.alpha,
+                implicit=p.implicit_prefs, rank=p.rank,
+                n_users=n_users, n_items=n_items,
+                user_nc=us.nc, item_nc=it.nc, shard=shard,
+            )
+            if callback is not None:
+                callback(step, user_f, item_f)
+
         packed = np.asarray(jnp.concatenate([user_f, item_f], axis=0))
         return ALSFactors(packed[:n_users], packed[n_users:])
 

@@ -60,7 +60,11 @@ def cmd_start_all(args) -> int:
     rc = 0
     for name, verb, default_port in SERVICES:
         pidfile = pid_dir / f"{name}.pid"
-        if pidfile.exists() and _alive(int(pidfile.read_text().strip() or 0)):
+        try:
+            old_pid = int(pidfile.read_text().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            old_pid = 0  # absent or corrupt pidfile → not running
+        if old_pid and _alive(old_pid):
             # ref bin/pio-start-all aborts when a service is already up
             print(f"[ERROR] {name} is already running. Please use "
                   "`pio stop-all` to stop it first.", file=sys.stderr)

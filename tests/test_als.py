@@ -127,23 +127,24 @@ def test_top_k_kernels(ctx):
     assert idx[0][0] == 0
 
 
-def test_sharded_transfer_path_matches_packed(ctx):
-    """Above the replication cutover ALS transfers buckets individually with
-    the batch sharding; results must match the packed path exactly. The
-    cutover is a real ALSParams knob (pack_replicate_max_bytes), so this
-    exercises the production sharded path un-mocked."""
+def test_narrow_transfer_dtypes_match_wide(ctx, monkeypatch):
+    """ALS ships uint16 neighbors / int8 ratings when lossless; forcing the
+    wide dtypes must produce identical factors — the narrowing is a pure
+    transfer-format optimization, not a numerics change."""
+    from predictionio_tpu.models import als as als_mod
+
     ui, ii, r, full = synthetic()
     p = ALSParams(rank=4, num_iterations=3, lambda_=0.01, seed=1)
-    packed = ALS(ctx, p).train(ui, ii, r, 60, 40)
-    p_sharded = ALSParams(rank=4, num_iterations=3, lambda_=0.01, seed=1,
-                          pack_replicate_max_bytes=0)
-    sharded = ALS(ctx, p_sharded).train(ui, ii, r, 60, 40)
+    narrow = ALS(ctx, p).train(ui, ii, r, 60, 40)  # small sides → uint16/int8
+    monkeypatch.setattr(
+        als_mod, "_narrow_nbr", lambda nbr, n: nbr.astype(np.int32))
+    monkeypatch.setattr(
+        als_mod, "_narrow_val", lambda v: v.astype(np.float32))
+    wide = ALS(ctx, p).train(ui, ii, r, 60, 40)
     np.testing.assert_allclose(
-        packed.user_features, sharded.user_features, rtol=2e-4, atol=2e-4
-    )
+        narrow.user_features, wide.user_features, rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(
-        packed.item_features, sharded.item_features, rtol=2e-4, atol=2e-4
-    )
+        narrow.item_features, wide.item_features, rtol=1e-6, atol=1e-6)
 
 
 def test_zero_ratings_raises(ctx):

@@ -120,7 +120,8 @@ def test_als_matches_independent_dense_solver(ctx, implicit):
         keep = r > 0
         ui, ii, r = ui[keep], ii[keep], r[keep]
     params = ALSParams(rank=6, num_iterations=5, lambda_=0.05,
-                       implicit_prefs=implicit, alpha=1.5, seed=7)
+                       implicit_prefs=implicit, alpha=1.5, seed=7,
+                       gather_dtype="float32")  # bitwise-comparable to f64 ref
     u0, v0 = _init_factors_of(ctx, params, ui, ii, r, n_users, n_items)
 
     got = ALS(ctx, params).train(ui, ii, r, n_users, n_items)
@@ -151,8 +152,10 @@ def test_chunked_bucket_solve_matches_unchunked(ctx):
     """Buckets above max_solve_elems solve in sequential lax.map row chunks
     (HBM-bounded path used at ML-20M scale); results must be identical."""
     ui, ii, r = _ratings(n_users=64, n_items=48, density=0.5, seed=9)
-    base = ALSParams(rank=5, num_iterations=4, lambda_=0.02, seed=3)
+    base = ALSParams(rank=5, num_iterations=4, lambda_=0.02, seed=3,
+                     solver="bucket", gather_dtype="float32")
     tiny = ALSParams(rank=5, num_iterations=4, lambda_=0.02, seed=3,
+                     solver="bucket", gather_dtype="float32",
                      max_solve_elems=5 * 16)  # force nc > 1 everywhere
     want = ALS(ctx, base).train(ui, ii, r, 64, 48)
     got = ALS(ctx, tiny).train(ui, ii, r, 64, 48)
@@ -160,6 +163,32 @@ def test_chunked_bucket_solve_matches_unchunked(ctx):
         got.user_features, want.user_features, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(
         got.item_features, want.item_features, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("implicit", [False, True], ids=["explicit", "implicit"])
+def test_segment_solver_matches_bucket_solver(ctx, implicit):
+    """The two solver designs (VPU segment-sum vs MXU degree-bucketed) are
+    numerically interchangeable — both explicit and implicit, chunked and
+    unchunked segment scans."""
+    ui, ii, r = _ratings(n_users=70, n_items=50, density=0.4, seed=5)
+    common = dict(rank=7, num_iterations=4, lambda_=0.03, seed=2,
+                  implicit_prefs=implicit, alpha=1.2,
+                  gather_dtype="float32")
+    want = ALS(ctx, ALSParams(solver="bucket", **common)).train(
+        ui, ii, r, 70, 50)
+    got = ALS(ctx, ALSParams(solver="segment", **common)).train(
+        ui, ii, r, 70, 50)
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=3e-3, atol=3e-3)
+    np.testing.assert_allclose(
+        got.item_features, want.item_features, rtol=3e-3, atol=3e-3)
+    # chunked segment scan (nc > 1) agrees with the unchunked pass
+    lanes = 7 * 8 // 2 + 7 + 1
+    chunked = ALS(ctx, ALSParams(
+        solver="segment", max_solve_elems=lanes * 64, **common,
+    )).train(ui, ii, r, 70, 50)
+    np.testing.assert_allclose(
+        chunked.user_features, got.user_features, rtol=1e-4, atol=1e-5)
 
 
 # ---------------------------------------------------------------------------
