@@ -29,6 +29,7 @@ entity's rating count.
 from __future__ import annotations
 
 import logging
+import weakref
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -846,19 +847,81 @@ def _top_k_dense(query_vecs, item_features, k: int, exclude_mask=None):
     return jax.lax.top_k(scores, k)
 
 
+#: (id(host array), tag) → (weakref to host array, device copy). Serving
+#: passes the SAME factor matrices on every request; without this cache
+#: each query re-ships the whole catalog over the host link (~RTT-sized
+#: latency per call through a tunneled TPU). Entries die with their host
+#: array. Cached arrays are treated as immutable-after-training, which
+#: holds for every product path (factors are replaced wholesale on reload).
+_DEVICE_CACHE: dict = {}
+
+
+def _as_device(arr, tag: str = "", transform=None):
+    """Device-resident (optionally transformed) copy of ``arr``, cached by
+    host-array identity. jax arrays pass through (transformed, uncached)."""
+    if not isinstance(arr, np.ndarray):
+        dev = jnp.asarray(arr)
+        return transform(dev) if transform is not None else dev
+    key = (id(arr), tag)
+    hit = _DEVICE_CACHE.get(key)
+    if hit is not None and hit[0]() is arr:
+        return hit[1]
+    dev = jnp.asarray(arr)
+    if transform is not None:
+        dev = transform(dev)
+    ref = weakref.ref(arr, lambda _r, key=key: _DEVICE_CACHE.pop(key, None))
+    _DEVICE_CACHE[key] = (ref, dev)
+    return dev
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
 def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     """Batched recommend: scores = q @ Yᵀ (one MXU matmul) + lax.top_k.
     ``exclude_mask`` [b, n_items] True → drop (seen items, blacklists — the
     serve-time filters of the ecommerce template). Catalogs above
-    ``CHUNKED_TOPK_THRESHOLD`` rows stream through the chunked MIPS kernel."""
-    if item_features.shape[0] > CHUNKED_TOPK_THRESHOLD:
+    ``CHUNKED_TOPK_THRESHOLD`` rows stream through the chunked MIPS kernel.
+
+    The catalog matrix is device-cached across calls, batch/k are padded
+    to powers of two so the micro-batcher's varying batch sizes hit a
+    handful of compiled programs instead of one per size, and the results
+    come back as host numpy in one readback."""
+    items = _as_device(item_features)
+    q = jnp.asarray(query_vecs)
+    b = q.shape[0]
+    k = min(k, items.shape[0])
+    if k <= 0:  # e.g. query num=0 — an empty result, not one item
+        return (
+            np.zeros((b, 0), np.float32), np.zeros((b, 0), np.int32)
+        )
+    bp = _pow2(b)
+    kp = min(_pow2(k), items.shape[0])
+    if bp != b:
+        q = jnp.concatenate(
+            [q, jnp.zeros((bp - b,) + q.shape[1:], q.dtype)]
+        )
+        if exclude_mask is not None and np.shape(exclude_mask)[0] == b:
+            # [1, n_items] broadcast masks need no padding; per-row masks
+            # pad on device (no host round trip of the full mask)
+            em = jnp.asarray(exclude_mask)
+            exclude_mask = jnp.concatenate(
+                [em, jnp.zeros((bp - b,) + em.shape[1:], em.dtype)]
+            )
+    if items.shape[0] > CHUNKED_TOPK_THRESHOLD:
         from predictionio_tpu.ops.topk import chunked_topk_scores
 
-        return chunked_topk_scores(
-            jnp.asarray(query_vecs), jnp.asarray(item_features), k=k,
-            chunk=CHUNKED_TOPK_CHUNK, exclude_mask=exclude_mask,
+        scores, idx = chunked_topk_scores(
+            q, items, k=kp, chunk=CHUNKED_TOPK_CHUNK,
+            exclude_mask=exclude_mask,
         )
-    return _top_k_dense(query_vecs, item_features, k, exclude_mask)
+    else:
+        scores, idx = _top_k_dense(q, items, kp, exclude_mask)
+    # ONE readback for the whole batch: per-row np.asarray() in callers
+    # would pay a host-link round trip per query
+    scores, idx = jax.device_get((scores[:b, :k], idx[:b, :k]))
+    return scores, idx
 
 
 @partial(jax.jit)
@@ -870,10 +933,11 @@ def top_k_cosine(query_vecs, item_features, k: int, exclude_mask=None):
     """Item-to-item cosine similarity (similarproduct template's scoring,
     ref: examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala).
     Normalizing both sides reduces cosine to inner product, so large
-    catalogs share the chunked MIPS dispatch of :func:`top_k_scores`."""
+    catalogs share the chunked MIPS dispatch of :func:`top_k_scores`; the
+    normalized catalog is device-cached alongside the raw one."""
     return top_k_scores(
         _l2_normalize(jnp.asarray(query_vecs)),
-        _l2_normalize(jnp.asarray(item_features)),
+        _as_device(item_features, tag="l2", transform=_l2_normalize),
         k,
         exclude_mask,
     )

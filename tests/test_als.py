@@ -127,6 +127,26 @@ def test_top_k_kernels(ctx):
     assert idx[0][0] == 0
 
 
+def test_top_k_zero_and_broadcast_mask():
+    rng = np.random.default_rng(0)
+    item_f = rng.normal(size=(7, 5)).astype(np.float32)
+    q = rng.normal(size=(3, 5)).astype(np.float32)  # non-pow2 batch
+    scores, idx = top_k_scores(q, item_f, 0)
+    assert scores.shape == (3, 0) and idx.shape == (3, 0)
+    # [1, n_items] broadcast mask across a padded batch (serving_filters
+    # convention) — same exclusion applied to every row
+    mask = np.zeros((1, 7), bool)
+    mask[0, 4] = True
+    scores, idx = top_k_scores(q, item_f, 6, mask)
+    assert idx.shape == (3, 6)
+    assert not (idx == 4).any()
+    # per-row mask on the same non-pow2 batch
+    mask3 = np.zeros((3, 7), bool)
+    mask3[1, 2] = True
+    _, idx = top_k_scores(q, item_f, 6, mask3)
+    assert 2 not in idx[1] and (2 in idx[0] or 2 in idx[2])
+
+
 def test_narrow_transfer_dtypes_match_wide(ctx, monkeypatch):
     """ALS ships uint16 neighbors / int8 ratings when lossless; forcing the
     wide dtypes must produce identical factors — the narrowing is a pure
