@@ -19,7 +19,9 @@ counterpart (the reference predates deep retrieval); designed TPU-first:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+from functools import partial
 from dataclasses import dataclass
 
 import jax
@@ -88,15 +90,16 @@ def init_params(n_users: int, n_items: int, p: TwoTowerParams) -> dict:
 
 
 def _make_step(loss_fn, tx):
-    """Shared optimizer-step wrapper around a loss function."""
+    """Shared optimizer-step wrapper around a loss function. Returns the
+    jitted per-step function (callback path) AND the raw traceable step so
+    the no-callback path can fuse the whole run into one ``fori_loop``."""
 
-    @jax.jit
-    def train_step(params, opt_state, u_idx, i_idx):
+    def step(params, opt_state, u_idx, i_idx):
         loss, grads = jax.value_and_grad(loss_fn)(params, u_idx, i_idx)
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    return train_step
+    return jax.jit(step), step
 
 
 def make_train_step(ctx: ComputeContext, p: TwoTowerParams, tx):
@@ -176,6 +179,56 @@ def make_train_step_gspmd(ctx: ComputeContext, p: TwoTowerParams, tx):
     return _make_step(loss_fn, tx)
 
 
+#: (mesh devices, model-axis size, params, batch) → (fused runner, stepper,
+#: sampler). jax.jit caches per function object, so rebuilding the closures
+#: every train_two_tower call would recompile — benchmarks and repeated
+#: trains (FastEval sweeps) reuse the compiled programs through this cache.
+_TRAINER_CACHE: dict = {}
+
+
+def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
+    # steps and seed are runtime inputs to the compiled programs, not part
+    # of their shape — exclude them so e.g. a 2-step warmup compiles the
+    # same programs a 10k-step run reuses
+    key = (
+        tuple(id(d) for d in ctx.mesh.devices.flat),
+        ctx.model_axis_size, dataclasses.replace(p, steps=0, seed=0), batch,
+    )
+    hit = _TRAINER_CACHE.get(key)
+    if hit is not None:
+        return hit
+    tx = optax.adam(p.learning_rate)
+    if ctx.model_axis_size > 1:
+        # dp×tp: params tensor-sharded over the model axis, GSPMD collectives
+        train_step, raw_step = make_train_step_gspmd(ctx, p, tx)
+    else:
+        # pure dp: explicit shard_map loss with ICI all_gather negatives
+        train_step, raw_step = make_train_step(ctx, p, tx)
+
+    def sample(key, s, n: int):
+        ks = jax.random.fold_in(key, s)
+        return jax.random.randint(ks, (batch,), 0, n, dtype=jnp.int32)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(params, opt_state, u_all, i_all, key, steps):
+        def body(s, carry):
+            params, opt_state, _ = carry
+            sel = sample(key, s, u_all.shape[0])
+            return raw_step(params, opt_state, u_all[sel], i_all[sel])
+
+        zero = jnp.zeros((), jnp.float32)
+        return jax.lax.fori_loop(0, steps, body, (params, opt_state, zero))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def one_step(params, opt_state, u_all, i_all, key, s):
+        sel = sample(key, s, u_all.shape[0])
+        return raw_step(params, opt_state, u_all[sel], i_all[sel])
+
+    entry = (tx, run, one_step)
+    _TRAINER_CACHE[key] = entry
+    return entry
+
+
 def train_two_tower(
     ctx: ComputeContext,
     user_idx: np.ndarray,
@@ -187,36 +240,45 @@ def train_two_tower(
 ) -> TwoTowerModel:
     if user_idx.size == 0:
         raise ValueError("train_two_tower called with zero interactions")
-    params = init_params(n_users, n_items, p)
-    tx = optax.adam(p.learning_rate)
-    if ctx.model_axis_size > 1:
-        # dp×tp: params tensor-sharded over the model axis, GSPMD collectives
-        params = shard_params(ctx, params)
-        train_step = make_train_step_gspmd(ctx, p, tx)
-    else:
-        # pure dp: explicit shard_map loss with ICI all_gather negatives
-        params = jax.device_put(params, ctx.replicated)
-        train_step = make_train_step(ctx, p, tx)
-    opt_state = tx.init(params)
-
     # global batch must split evenly over the data axis
     batch = ctx.pad_to_multiple(min(p.batch_size, max(len(user_idx), 1)))
-    rng = np.random.default_rng(p.seed)
-    shard = ctx.batch_sharding()
+    tx, run, one_step = _get_trainer(ctx, p, batch)
+    params = init_params(n_users, n_items, p)
+    if ctx.model_axis_size > 1:
+        params = shard_params(ctx, params)
+    else:
+        params = jax.device_put(params, ctx.replicated)
+    opt_state = tx.init(params)
+
+    # batches are sampled ON DEVICE (fold_in per step) from the resident
+    # interaction arrays — the host batch sampler and per-step transfers
+    # (an RTT each through a tunneled TPU) stay out of the loop, and the
+    # trajectory is identical with or without a progress callback
+    u_all = jax.device_put(
+        np.ascontiguousarray(user_idx.astype(np.int32)), ctx.replicated
+    )
+    i_all = jax.device_put(
+        np.ascontiguousarray(item_idx.astype(np.int32)), ctx.replicated
+    )
+    key = jax.random.PRNGKey(p.seed)
     loss = None
-    # at most one step in flight: on oversubscribed hosts (CPU test meshes,
-    # 1 core serving 8 virtual devices) letting async dispatch pile up
-    # executions starves the collective rendezvous of pool threads and XLA
-    # aborts after its 40s stuck-timeout; the sync also gives the host-side
-    # batch sampler back-pressure on TPU
-    for step in range(p.steps):
-        sel = rng.integers(0, len(user_idx), batch)
-        u = jax.device_put(user_idx[sel].astype(np.int32), shard)
-        i = jax.device_put(item_idx[sel].astype(np.int32), shard)
-        params, opt_state, loss = train_step(params, opt_state, u, i)
-        loss.block_until_ready()
-        if callback is not None and (step + 1) % 100 == 0:
-            callback(step, float(loss))
+    if callback is None:
+        if p.steps > 0:  # whole run = ONE device dispatch
+            params, opt_state, loss = run(
+                params, opt_state, u_all, i_all, key, p.steps
+            )
+    else:
+        # per-step dispatch so the callback sees progress; at most one step
+        # in flight (on oversubscribed CPU test meshes async pile-up
+        # starves the collective rendezvous and XLA aborts on its
+        # stuck-timeout)
+        for step in range(p.steps):
+            params, opt_state, loss = one_step(
+                params, opt_state, u_all, i_all, key, step
+            )
+            loss.block_until_ready()
+            if (step + 1) % 100 == 0:
+                callback(step, float(loss))
     if loss is not None:
         logger.info("two-tower final loss: %.4f", float(loss))
 
