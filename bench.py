@@ -166,13 +166,21 @@ def bench_two_tower(ctx) -> dict:
 
     nu, ni = 138_493, 26_744  # ML-20M entity counts (synthesize_ml20m)
     ui, ii, _r = synthesize(nu, ni, 2_000_000)
-    p_warm = TwoTowerParams(batch_size=4096, steps=2, seed=0)
-    train_two_tower(ctx, ui, ii, nu, ni, p_warm)
+
+    def timed(steps: int) -> float:
+        t0 = time.perf_counter()
+        train_two_tower(
+            ctx, ui, ii, nu, ni,
+            TwoTowerParams(batch_size=4096, steps=steps, seed=0),
+        )
+        return time.perf_counter() - t0
+
+    timed(2)  # compile (the trainer cache keys ignore the step count)
+    # delta timing isolates the training loop from init/transfer and the
+    # serving-corpus export that train_two_tower also performs
+    t_short, t_long = timed(2), timed(202)
+    dt = max(t_long - t_short, 1e-9)
     steps = 200
-    p_run = TwoTowerParams(batch_size=4096, steps=steps, seed=0)
-    t0 = time.perf_counter()
-    train_two_tower(ctx, ui, ii, nu, ni, p_run)
-    dt = time.perf_counter() - t0
     return {
         "two_tower_steps_per_sec": round(steps / dt, 2),
         "two_tower_batch": 4096,

@@ -228,11 +228,48 @@ def _loss_fn(params, seqs, pos, neg, key, p: SASRecParams):
     return loss
 
 
-@partial(jax.jit, static_argnames=("p",), donate_argnums=(0, 1))
-def _train_step(params, opt_state, seqs, pos, neg, key, tx_lr, p: SASRecParams):
+def _raw_train_step(params, opt_state, seqs, pos, neg, key, tx_lr,
+                    p: SASRecParams):
     loss, grads = jax.value_and_grad(_loss_fn)(params, seqs, pos, neg, key, p)
     updates, opt_state = optax.adam(tx_lr).update(grads, opt_state, params)
     return optax.apply_updates(params, updates), opt_state, loss
+
+
+@partial(
+    jax.jit,
+    static_argnames=("p", "steps_per_epoch", "bs", "n_items"),
+    donate_argnums=(0, 1),
+)
+def _train_epoch(
+    params, opt_state, seqs, pos, key, epoch, tx_lr,
+    *, p: SASRecParams, steps_per_epoch: int, bs: int, n_items: int,
+):
+    """One epoch as a single dispatch: on-device shuffle, on-device negative
+    sampling, ``fori_loop`` over the full batches — the host (and, through
+    a tunneled TPU, a per-step RPC + batch transfer) stays out of the
+    training loop."""
+    n = seqs.shape[0]
+    ekey = jax.random.fold_in(key, epoch)
+    order = jax.random.permutation(ekey, n).astype(jnp.int32)
+
+    def body(s, carry):
+        params, opt_state, _ = carry
+        idx = jax.lax.dynamic_slice_in_dim(order, s * bs, bs)
+        sb, pb = seqs[idx], pos[idx]
+        kneg = jax.random.fold_in(ekey, 1 + 2 * s)
+        neg = jax.random.randint(
+            kneg, (bs, p.max_len), 1, n_items + 1, dtype=jnp.int32
+        )
+        neg = jnp.where(pb > 0, neg, 0)
+        kstep = jax.random.fold_in(ekey, 2 + 2 * s)
+        return _raw_train_step(
+            params, opt_state, sb, pb, neg, kstep, tx_lr, p
+        )
+
+    zero = jnp.zeros((), jnp.float32)
+    return jax.lax.fori_loop(
+        0, steps_per_epoch, body, (params, opt_state, zero)
+    )
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -278,7 +315,6 @@ class SASRec:
         """``sequences``: per-user item-id lists (ids 1..n_items, time
         order). Returns the trained parameter pytree."""
         p = self.p
-        rng = np.random.default_rng(p.seed)
         seqs, pos = _make_training_arrays(sequences, p.max_len)
         n = len(seqs)
         if n == 0:
@@ -288,19 +324,15 @@ class SASRec:
         key = jax.random.PRNGKey(p.seed)
         bs = min(p.batch_size, n)
         steps_per_epoch = max(n // bs, 1)
+        seqs_d = jnp.asarray(seqs)  # dataset resident on device for the run
+        pos_d = jnp.asarray(pos)
+        loss = None
         for epoch in range(p.num_epochs):
-            order = rng.permutation(n)
-            for s in range(steps_per_epoch):
-                idx = order[s * bs : (s + 1) * bs]
-                if len(idx) < bs:  # static shapes: drop ragged tail batch
-                    continue
-                neg = rng.integers(1, n_items + 1, size=(bs, p.max_len))
-                neg = np.where(pos[idx] > 0, neg, 0).astype(np.int32)
-                key, sub = jax.random.split(key)
-                params, opt_state, loss = _train_step(
-                    params, opt_state, seqs[idx], pos[idx], neg, sub,
-                    p.learning_rate, p,
-                )
+            params, opt_state, loss = _train_epoch(
+                params, opt_state, seqs_d, pos_d, key, epoch,
+                p.learning_rate,
+                p=p, steps_per_epoch=steps_per_epoch, bs=bs, n_items=n_items,
+            )
             if callback is not None:
                 callback(epoch, float(loss))
         return jax.tree_util.tree_map(np.asarray, params)

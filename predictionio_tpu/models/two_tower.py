@@ -179,11 +179,14 @@ def make_train_step_gspmd(ctx: ComputeContext, p: TwoTowerParams, tx):
     return _make_step(loss_fn, tx)
 
 
-#: (mesh devices, model-axis size, params, batch) → (fused runner, stepper,
-#: sampler). jax.jit caches per function object, so rebuilding the closures
-#: every train_two_tower call would recompile — benchmarks and repeated
-#: trains (FastEval sweeps) reuse the compiled programs through this cache.
+#: (mesh devices, model-axis size, compile-relevant params, batch) →
+#: (optax transform, fused whole-run jit, per-step jit). jax.jit caches per
+#: function object, so rebuilding the closures every train_two_tower call
+#: would recompile — benchmarks and repeated trains (FastEval sweeps)
+#: reuse the compiled programs through this cache. Bounded FIFO so long
+#: hyperparameter sweeps don't pin one executable set per combination.
 _TRAINER_CACHE: dict = {}
+_TRAINER_CACHE_MAX = 8
 
 
 def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
@@ -200,31 +203,44 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
     tx = optax.adam(p.learning_rate)
     if ctx.model_axis_size > 1:
         # dp×tp: params tensor-sharded over the model axis, GSPMD collectives
-        train_step, raw_step = make_train_step_gspmd(ctx, p, tx)
+        _, raw_step = make_train_step_gspmd(ctx, p, tx)
     else:
         # pure dp: explicit shard_map loss with ICI all_gather negatives
-        train_step, raw_step = make_train_step(ctx, p, tx)
+        _, raw_step = make_train_step(ctx, p, tx)
+    bshard = ctx.batch_sharding()
 
-    def sample(key, s, n: int):
+    def sample_batch(u_all, i_all, key, s):
+        """On-device batch: ONE index draw selects paired (user, item)
+        interaction rows; the gathered batches are constrained onto the
+        data axis so GSPMD keeps the batch split under dp×tp (params are
+        only model-sharded, so nothing else seeds that propagation)."""
         ks = jax.random.fold_in(key, s)
-        return jax.random.randint(ks, (batch,), 0, n, dtype=jnp.int32)
+        sel = jax.random.randint(
+            ks, (batch,), 0, u_all.shape[0], dtype=jnp.int32
+        )
+        return (
+            jax.lax.with_sharding_constraint(u_all[sel], bshard),
+            jax.lax.with_sharding_constraint(i_all[sel], bshard),
+        )
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def run(params, opt_state, u_all, i_all, key, steps):
         def body(s, carry):
             params, opt_state, _ = carry
-            sel = sample(key, s, u_all.shape[0])
-            return raw_step(params, opt_state, u_all[sel], i_all[sel])
+            u, i = sample_batch(u_all, i_all, key, s)
+            return raw_step(params, opt_state, u, i)
 
         zero = jnp.zeros((), jnp.float32)
         return jax.lax.fori_loop(0, steps, body, (params, opt_state, zero))
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def one_step(params, opt_state, u_all, i_all, key, s):
-        sel = sample(key, s, u_all.shape[0])
-        return raw_step(params, opt_state, u_all[sel], i_all[sel])
+        u, i = sample_batch(u_all, i_all, key, s)
+        return raw_step(params, opt_state, u, i)
 
     entry = (tx, run, one_step)
+    if len(_TRAINER_CACHE) >= _TRAINER_CACHE_MAX:
+        _TRAINER_CACHE.pop(next(iter(_TRAINER_CACHE)))
     _TRAINER_CACHE[key] = entry
     return entry
 
