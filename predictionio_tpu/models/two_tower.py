@@ -197,8 +197,9 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
         tuple(id(d) for d in ctx.mesh.devices.flat),
         ctx.model_axis_size, dataclasses.replace(p, steps=0, seed=0), batch,
     )
-    hit = _TRAINER_CACHE.get(key)
+    hit = _TRAINER_CACHE.pop(key, None)
     if hit is not None:
+        _TRAINER_CACHE[key] = hit  # LRU refresh: hot entries stay resident
         return hit
     tx = optax.adam(p.learning_rate)
     if ctx.model_axis_size > 1:

@@ -7,6 +7,7 @@ single dispatch point, like the reference's scopt-based ``Console``.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from predictionio_tpu import __version__
@@ -550,11 +551,14 @@ def cmd_run(args) -> int:
 
 
 def cmd_upgrade(args) -> int:
-    """The reference phones home for new versions
-    (ref: workflow/WorkflowUtils.scala:385-406); this build is offline-first,
-    so upgrade checking is a no-op by design."""
-    print(f"[INFO] predictionio_tpu {__version__}; upgrade checking is "
-          "disabled in this offline-first build.")
+    from predictionio_tpu.utils.version_check import check_upgrade
+
+    latest = check_upgrade("console")
+    note = ("" if os.environ.get("PIO_UPGRADE_URL")
+            else "; remote upgrade checking is disabled in this "
+                 "offline-first build (set PIO_UPGRADE_URL to enable)")
+    print(f"[INFO] predictionio_tpu {__version__} (latest known: {latest})"
+          f"{note}")
     return 0
 
 

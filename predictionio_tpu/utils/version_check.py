@@ -1,0 +1,47 @@
+"""Offline-safe version probe, shared by `pio upgrade` and the engine
+server's daily upgrade checker (ref: CreateServer.scala:268-275
+UpgradeActor, workflow/WorkflowUtils.scala:385-406). The reference phones
+home unconditionally; this build only probes when ``PIO_UPGRADE_URL`` is
+set, and failures degrade to the local version."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import urllib.parse
+import urllib.request
+
+from predictionio_tpu import __version__
+
+logger = logging.getLogger(__name__)
+
+
+def upgrade_probe_url() -> str | None:
+    return os.environ.get("PIO_UPGRADE_URL") or None
+
+
+def check_upgrade(component: str = "console") -> str:
+    """Latest known version: the remote's answer when a probe URL is
+    configured and reachable, the local version otherwise."""
+    url = upgrade_probe_url()
+    if not url:
+        return __version__
+    parts = urllib.parse.urlsplit(url)
+    query = urllib.parse.parse_qsl(parts.query, keep_blank_values=True)
+    query.append(("component", component))
+    probe = urllib.parse.urlunsplit(
+        parts._replace(query=urllib.parse.urlencode(query))
+    )
+    try:
+        with urllib.request.urlopen(probe, timeout=5) as r:
+            latest = json.loads(r.read()).get("version", __version__)
+        if latest != __version__:
+            logger.info(
+                "A newer version (%s) is available (running %s).",
+                latest, __version__,
+            )
+        return latest
+    except Exception:
+        logger.debug("upgrade probe failed", exc_info=True)
+        return __version__

@@ -65,6 +65,11 @@ class ServerConfig:
     #: implements batch_predict; single queries never wait.
     batching: bool = True
     max_batch: int = 64
+    #: Daily upgrade check (ref: CreateServer.scala:268-275 UpgradeActor —
+    #: one check per day on a background timer). The check itself is the
+    #: same offline-safe version probe as `pio upgrade`.
+    upgrade_check: bool = True
+    upgrade_check_interval_sec: float = 86400.0
 
 
 def _query_to_obj(query_class: type | None, data: dict):
@@ -103,6 +108,10 @@ class QueryService:
         self.last_serving_sec = 0.0
         self.plugin_context = EngineServerPluginContext()
         self._stop_event = threading.Event()
+        from predictionio_tpu.utils.version_check import upgrade_probe_url
+
+        if config.upgrade_check and upgrade_probe_url():
+            self._start_upgrade_checker()  # offline deploys pay nothing
         self._load()
         self.batcher = None
         if config.batching and any(
@@ -411,6 +420,26 @@ class QueryService:
         except Exception:
             logger.exception("feedback POST failed")
             return None
+
+    def _start_upgrade_checker(self) -> None:
+        """Daily upgrade-check timer (ref: CreateServer.scala:268-275
+        UpgradeActor + Upgrade.checkUpgrade). Runs on a daemon thread tied
+        to the server's stop event; failures never disturb serving."""
+
+        def loop():
+            from predictionio_tpu.utils.version_check import check_upgrade
+
+            while not self._stop_event.wait(
+                self.config.upgrade_check_interval_sec
+            ):
+                try:
+                    check_upgrade("deployment")
+                except Exception:
+                    logger.debug("upgrade check failed", exc_info=True)
+
+        threading.Thread(
+            target=loop, name="upgrade-check", daemon=True
+        ).start()
 
     def get_reload(self, request: Request):
         """Hot-swap to the latest completed instance (ref: ReloadServer)."""

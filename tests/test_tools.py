@@ -177,6 +177,43 @@ class TestCLIVerbs:
         assert __version__ in capsys.readouterr().out
         assert main(["upgrade"]) == 0
 
+    def test_check_upgrade_probe(self, monkeypatch):
+        """Offline → local version; with PIO_UPGRADE_URL → remote version
+        (the engine server's daily UpgradeActor analog shares this probe,
+        ref: CreateServer.scala:268-275)."""
+        import http.server
+        import threading
+
+        from predictionio_tpu import __version__
+        from predictionio_tpu.utils.version_check import check_upgrade
+
+        monkeypatch.delenv("PIO_UPGRADE_URL", raising=False)
+        assert check_upgrade() == __version__
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b'{"version": "99.0.0"}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            monkeypatch.setenv(
+                "PIO_UPGRADE_URL",
+                f"http://127.0.0.1:{srv.server_address[1]}/upgrade?channel=s",
+            )
+            assert check_upgrade("deployment") == "99.0.0"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
     def test_export_import_cli(self, memory_storage, tmp_path, capsys):
         from predictionio_tpu.tools.cli import main
 
