@@ -177,6 +177,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_st = sub.add_parser("stop-all", help="stop services started by start-all")
     p_st.set_defaults(func=cmd_stop_all)
 
+    # -- shell (ref: bin/pio-shell sbt console) -----------------------------
+    p_sh = sub.add_parser(
+        "shell", help="interactive Python shell with the stack preloaded"
+    )
+    p_sh.set_defaults(func=cmd_shell)
+
     # -- export / import (ref: Console.scala export/import) -----------------
     p_exp = sub.add_parser("export", help="export events to a JSON-lines file")
     p_exp.add_argument("--app-name", required=True)
@@ -548,6 +554,28 @@ def cmd_run(args) -> int:
     fn = load_engine_factory(args.main_class, os.getcwd())
     result = fn(args.args) if callable(fn) else None
     return int(result) if isinstance(result, int) else 0
+
+
+def cmd_shell(args) -> int:
+    """Interactive shell with Storage + ComputeContext preloaded — the
+    analog of the reference's `bin/pio-shell` sbt console
+    (ref: bin/pio-shell:30-33, which drops into a Scala REPL with the pio
+    classpath)."""
+    import code
+
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    banner = (
+        f"predictionio_tpu {__version__} shell\n"
+        "preloaded: Storage, compute_context()  "
+        "(e.g. `events = Storage.get_events()`)"
+    )
+    code.interact(
+        banner=banner,
+        local={"Storage": Storage, "compute_context": compute_context},
+    )
+    return 0
 
 
 def cmd_upgrade(args) -> int:

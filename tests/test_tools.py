@@ -177,6 +177,25 @@ class TestCLIVerbs:
         assert __version__ in capsys.readouterr().out
         assert main(["upgrade"]) == 0
 
+    def test_shell_preloads_stack(self, memory_storage, monkeypatch, capsys):
+        """`pio shell` drops into a REPL with Storage and compute_context
+        bound (ref: bin/pio-shell:30-33)."""
+        import code
+
+        captured = {}
+
+        def fake_interact(banner="", local=None):
+            captured["banner"] = banner
+            captured["local"] = local
+
+        monkeypatch.setattr(code, "interact", fake_interact)
+        from predictionio_tpu.tools.cli import main
+
+        assert main(["shell"]) == 0
+        assert "Storage" in captured["local"]
+        assert callable(captured["local"]["compute_context"])
+        assert captured["local"]["Storage"].get_events() is not None
+
     def test_check_upgrade_probe(self, monkeypatch):
         """Offline → local version; with PIO_UPGRADE_URL → remote version
         (the engine server's daily UpgradeActor analog shares this probe,
