@@ -100,8 +100,9 @@ class _TileSpec:
 @dataclass
 class _SidePlan:
     """One side's per-bucket CSR tile specs. The entity-sorted rating
-    arrays the specs point into are produced ON DEVICE (`_device_etl`) —
-    the host computes only a `bincount` degree histogram."""
+    arrays the specs point into are produced by the counting-sort ETL
+    (`_sort_perm`); the spec construction itself needs only a `bincount`
+    degree histogram."""
 
     specs: list
 
@@ -136,20 +137,25 @@ def _narrow_val(ratings_sorted: np.ndarray) -> np.ndarray:
     return ratings_sorted.astype(np.float32)
 
 
-def _bucketize(
-    ctx: ComputeContext,
-    entity_idx: np.ndarray,
-    n_entities: int,
-    params: ALSParams,
-) -> _SidePlan:
-    """Group one side's entities by degree into tile *specs* (ALX §3.2-style
-    density bucketing). Host work is ONE `bincount` pass — no sorting: the
-    CSR starts follow from the cumulative histogram because the device-side
-    stable sort groups entities in ascending order, and the padded dense
-    tiles are built on device per solve chunk."""
+def _histogram(entity_idx: np.ndarray, n_entities: int):
+    """(counts_all, starts_all): degree histogram + exclusive cumsum — the
+    CSR layout shared by the tile specs and the counting-sort ETL."""
     counts_all = np.bincount(entity_idx, minlength=n_entities)
     starts_all = np.zeros(len(counts_all), dtype=np.int64)
     np.cumsum(counts_all[:-1], out=starts_all[1:])
+    return counts_all, starts_all
+
+
+def _bucketize(
+    ctx: ComputeContext,
+    counts_all: np.ndarray,
+    starts_all: np.ndarray,
+    params: ALSParams,
+) -> _SidePlan:
+    """Group one side's entities by degree into tile *specs* (ALX §3.2-style
+    density bucketing) from the CSR histogram. The starts are valid because
+    the counting-sort ETL (:func:`_sort_perm`) groups entities in ascending
+    order with stable ties — the load-bearing invariant between the two."""
     uniq = np.flatnonzero(counts_all).astype(np.int32)
     starts = starts_all[uniq].astype(np.int32)
     counts = counts_all[uniq].astype(np.int32)
@@ -184,19 +190,31 @@ def _bucketize(
     return _SidePlan(specs)
 
 
-@jax.jit
-def _device_etl(u_idx, i_idx, ratings):
-    """Sort the raw COO by each side ON DEVICE (the host ships the unsorted
-    triple once, in the narrowest dtypes). A 20M-row stable device sort is
-    tens of ms; the same sorts in numpy cost ~9s of host time per train.
-    The stable ascending sort makes the bincount-derived CSR starts from
-    :func:`_bucketize` line up exactly."""
-    u32 = u_idx.astype(jnp.int32)
-    i32 = i_idx.astype(jnp.int32)
-    rf = ratings.astype(jnp.float32)
-    pu = jnp.argsort(u32, stable=True)
-    pi = jnp.argsort(i32, stable=True)
-    return i32[pu], rf[pu], u32[pi], rf[pi]
+def _sort_perm(entity_idx: np.ndarray, starts_all: np.ndarray) -> np.ndarray:
+    """Stable ascending sort permutation over entity ids — the ETL step
+    that groups ratings per entity. Fast path: a one-pass C counting sort
+    (native/eventlog.cc pio_counting_sort_perm, ~0.1s for 20M rows; keys
+    are bounded by the entity count so counting sort is O(n)). Fallback:
+    numpy's stable argsort (~3s) when no toolchain is available. A device
+    `jnp.argsort` was measured SLOWER than either (~7s — TPU sorts are
+    comparison networks)."""
+    import ctypes
+
+    from predictionio_tpu.native import eventlog_lib
+
+    lib = eventlog_lib()
+    if lib is not None and hasattr(lib, "pio_counting_sort_perm"):
+        keys = np.ascontiguousarray(entity_idx, dtype=np.int32)
+        next_pos = starts_all.copy()  # the C pass mutates its cursors
+        perm = np.empty(len(keys), dtype=np.int32)
+        rc = lib.pio_counting_sort_perm(
+            keys.ctypes.data_as(ctypes.c_void_p), len(keys), len(next_pos),
+            next_pos.ctypes.data_as(ctypes.c_void_p),
+            perm.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc == 0:
+            return perm
+    return np.argsort(entity_idx, kind="stable").astype(np.int32)
 
 
 #: Ranks up to this solve via the unrolled structure-of-arrays Cholesky —
@@ -693,8 +711,10 @@ class ALS:
                 user_idx, item_idx, ratings, n_users, n_items, callback
             )
 
-        uplan = _bucketize(ctx, user_idx, n_users, p)
-        iplan = _bucketize(ctx, item_idx, n_items, p)
+        u_counts, u_starts = _histogram(user_idx, n_users)
+        i_counts, i_starts = _histogram(item_idx, n_items)
+        uplan = _bucketize(ctx, u_counts, u_starts, p)
+        iplan = _bucketize(ctx, i_counts, i_starts, p)
         logger.info(
             "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
             ratings.size, n_users, len(uplan.specs), n_items,
@@ -710,11 +730,12 @@ class ALS:
             user_f = jax.device_put(user_f, ctx.replicated)
             item_f = jax.device_put(item_f, ctx.replicated)
 
-        # transfer: the UNSORTED raw COO once, in the narrowest lossless
-        # dtypes (uint16 ids when they fit, int8 integer ratings) + tiny
-        # per-bucket CSR pointers (sharded over `data`). Per-side sorting
-        # and dense-tile construction both happen on device, so nothing
-        # [n, k]-sized or pre-sorted ever crosses the host link.
+        # ETL: each side's ratings grouped per entity by a one-pass C
+        # counting sort (see _sort_perm), then shipped ONCE in the
+        # narrowest lossless dtypes (uint16 ids when they fit, int8
+        # integer ratings) + tiny per-bucket CSR pointers (sharded over
+        # `data`). Dense tiles are built on device, so nothing [n, k]-sized
+        # ever crosses the host link.
         shard = ctx.batch_sharding() if multi else None
 
         def put(x, sharding):
@@ -723,10 +744,13 @@ class ALS:
             return jnp.asarray(x)
 
         repl = ctx.replicated if multi else None
-        raw_u = put(_narrow_nbr(user_idx, n_users), repl)
-        raw_i = put(_narrow_nbr(item_idx, n_items), repl)
-        raw_r = put(_narrow_val(ratings), repl)
-        u_nbr, u_val, i_nbr, i_val = _device_etl(raw_u, raw_i, raw_r)
+        pu = _sort_perm(user_idx, u_starts)
+        pi = _sort_perm(item_idx, i_starts)
+        val_wide = _narrow_val(ratings)  # dtype decided once, cast per perm
+        u_nbr = put(_narrow_nbr(item_idx[pu], n_items), repl)
+        u_val = put(val_wide[pu], repl)
+        i_nbr = put(_narrow_nbr(user_idx[pi], n_users), repl)
+        i_val = put(val_wide[pi], repl)
         u_tiles = tuple(
             tuple(put(x, shard) for x in (s.rows, s.starts, s.counts))
             for s in uplan.specs

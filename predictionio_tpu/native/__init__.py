@@ -80,6 +80,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
     lib.pio_eventlog_interactions.restype = c.c_int32
+    try:  # added after the first release of the .so: bind defensively so a
+        # stale library (mtime newer than the source) degrades to the
+        # numpy fallback instead of crashing ALS.train
+        lib.pio_counting_sort_perm.argtypes = [
+            c.c_void_p, c.c_int64, c.c_int64, c.c_void_p, c.c_void_p,
+        ]
+        lib.pio_counting_sort_perm.restype = c.c_int32
+    except AttributeError:
+        logger.warning(
+            "native library lacks pio_counting_sort_perm (stale build?); "
+            "sort fast path disabled"
+        )
     return lib
 
 

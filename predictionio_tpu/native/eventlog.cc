@@ -514,4 +514,22 @@ int32_t pio_eventlog_interactions(
   return 0;
 }
 
+
+// Stable counting-sort permutation: perm_out[dest] = source index, dests
+// assigned by fetch-and-add on per-key cursors pre-filled with the CSR
+// starts (ascending-key exclusive cumsum of the key histogram). One pass
+// at memory speed — numpy's stable argsort takes ~3s for 20M int32 keys
+// and a TPU comparison sort ~7s; this runs in ~0.1s. Used by the ALS
+// training ETL (models/als.py) to group ratings by entity.
+int32_t pio_counting_sort_perm(const int32_t* keys, int64_t n,
+                               int64_t n_keys, int64_t* next_pos,
+                               int32_t* perm_out) {
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t k = keys[j];
+    if (k < 0 || k >= n_keys) return -1;  // corrupt input; caller falls back
+    perm_out[next_pos[k]++] = static_cast<int32_t>(j);
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -148,6 +148,23 @@ def test_als_parity_entities_without_ratings_stay_at_init(ctx):
     np.testing.assert_allclose(got.item_features[2:], v0[2:], atol=1e-6)
 
 
+def test_native_counting_sort_matches_numpy_stable_argsort():
+    """The C counting-sort ETL must equal numpy's stable argsort exactly
+    (same tie order) — the CSR starts assume it."""
+    from predictionio_tpu.models.als import _histogram, _sort_perm
+    from predictionio_tpu.native import eventlog_lib
+
+    lib = eventlog_lib()
+    if lib is None or not hasattr(lib, "pio_counting_sort_perm"):
+        pytest.skip("native toolchain unavailable — numpy fallback only")
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 97, 100_000).astype(np.int32)
+    _counts, starts_all = _histogram(keys, 97)
+    got = _sort_perm(keys, starts_all)
+    want = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(got, want)
+
+
 def test_chunked_bucket_solve_matches_unchunked(ctx):
     """Buckets above max_solve_elems solve in sequential lax.map row chunks
     (HBM-bounded path used at ML-20M scale); results must be identical."""
