@@ -238,9 +238,10 @@ def main() -> None:
 
     # --- serving latency (p50/p99 REST predict through the query server)
     try:
-        from bench_serving import bench_query_latency
+        from bench_serving import bench_event_ingest, bench_query_latency
 
         extra.update(bench_query_latency())
+        extra.update(bench_event_ingest())
     except Exception as e:  # serving bench must never sink the headline
         extra["serving_bench_error"] = repr(e)
 

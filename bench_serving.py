@@ -166,5 +166,76 @@ def bench_query_latency(
         Storage.reset()
 
 
+def bench_event_ingest(total: int = 2000, conns: int = 8) -> dict:
+    """POST /events.json throughput over keep-alive connections (the event
+    collection surface, ref: data/.../api/EventServer.scala:226-261)."""
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    storage = _setup_storage()
+    try:
+        apps = storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "ingestbench"))
+        storage.get_events().init(app_id)
+        key = storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ())
+        )
+        server = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            body = json.dumps({
+                "event": "view", "entityType": "user", "entityId": "u1",
+                "targetEntityType": "item", "targetEntityId": "i1",
+            }).encode()
+
+            errors: list[Exception] = []
+
+            def worker(n):
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", server.port
+                    )
+                    for _ in range(n):
+                        conn.request(
+                            "POST", f"/events.json?accessKey={key}", body,
+                            {"Content-Type": "application/json"},
+                        )
+                        r = conn.getresponse()
+                        r.read()
+                        assert r.status == 201, r.status
+                    conn.close()
+                except Exception as e:  # noqa: BLE001 — re-raised after join
+                    errors.append(e)
+
+            worker(50)  # warm
+            if errors:
+                raise errors[0]
+            per_conn = total // conns
+            sent = per_conn * conns
+            ts = [
+                threading.Thread(target=worker, args=(per_conn,))
+                for _ in range(conns)
+            ]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            return {"ingest_events_per_sec": round(sent / dt, 0)}
+        finally:
+            server.stop()
+    finally:
+        Storage.reset()
+
+
 if __name__ == "__main__":
-    print(json.dumps(bench_query_latency()))
+    results = bench_query_latency()
+    results.update(bench_event_ingest())
+    print(json.dumps(results))

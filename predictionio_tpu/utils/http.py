@@ -126,6 +126,10 @@ class AppServer:
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # headers and body flush as separate TCP segments; without
+            # TCP_NODELAY, Nagle + delayed ACK stalls every keep-alive
+            # request ~40ms (measured: 182 -> >2000 events/s on ingest)
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 logger.debug("%s %s", self.address_string(), fmt % args)
