@@ -118,6 +118,13 @@ def bench_query_latency(
             c = _Client(srv.port)
             for k in range(30):  # warmup: compile all top_k shapes in play
                 c.query(f"u{k % 900}", 10)
+            # the first query kicked off the background batch-shape warmup;
+            # let it finish so its compiles don't pollute the timed runs
+            deadline = time.time() + 300
+            while time.time() < deadline and any(
+                t.name == "batch-warmup" for t in threading.enumerate()
+            ):
+                time.sleep(0.2)
 
             # -- sequential: true per-request latency
             lat = [c.query(f"u{k % 900}", 10) for k in range(seq_requests)]
@@ -197,7 +204,7 @@ def bench_event_ingest(total: int = 2000, conns: int = 8) -> dict:
             def worker(n):
                 try:
                     conn = http.client.HTTPConnection(
-                        "127.0.0.1", server.port
+                        "127.0.0.1", server.port, timeout=30
                     )
                     for _ in range(n):
                         conn.request(

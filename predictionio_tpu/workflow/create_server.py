@@ -108,6 +108,10 @@ class QueryService:
         self.last_serving_sec = 0.0
         self.plugin_context = EngineServerPluginContext()
         self._stop_event = threading.Event()
+        self._batch_shapes_warmed = False
+        #: one batch on the device at a time: serializes the micro-batcher
+        #: consumer with the background batch-shape warmup
+        self._device_lock = threading.Lock()
         from predictionio_tpu.utils.version_check import upgrade_probe_url
 
         if config.upgrade_check and upgrade_probe_url():
@@ -178,6 +182,9 @@ class QueryService:
             self.models = models
             self.algorithms = algo_instances
             self.serving = serving
+            # fresh models mean fresh device programs: let the next query
+            # re-trigger the batch-shape warmup
+            self._batch_shapes_warmed = False
         logger.info(
             "deployed engine instance %s (trained %s)",
             instance.id, format_datetime(instance.start_time),
@@ -318,6 +325,7 @@ class QueryService:
             return 400, {"message": str(e)}
         if self.batcher is not None:
             prediction = self.batcher.submit(query)
+            self._maybe_warm_batch_shapes(query)
         else:
             supplemented = serving.supplement(query)
             predictions = [
@@ -346,6 +354,39 @@ class QueryService:
             self.last_serving_sec = dt
         return 200, result
 
+    def _maybe_warm_batch_shapes(self, query) -> None:
+        """After the first successful query, replay it at every batch
+        shape the server can produce — batches pad to powers of two in
+        :meth:`_predict_batch_shared`, so the pow2 ladder up to max_batch
+        is exhaustive — on a background thread serialized with live
+        traffic by the device lock. Without this, the first concurrent
+        burst after a (re)deploy pays one XLA compile per new batch shape
+        (observed as multi-second p99 outliers)."""
+        if self._batch_shapes_warmed:  # unlocked fast path (hot per-query)
+            return
+        with self.lock:
+            if self._batch_shapes_warmed:
+                return
+            self._batch_shapes_warmed = True
+
+        def warm():
+            top = max(self.config.max_batch, 1)
+            sizes = []
+            size = 2
+            while size < top:
+                sizes.append(size)
+                size *= 2
+            sizes.append(top)  # the exact max drain, pow2 or not
+            for s in sizes:
+                try:
+                    self._predict_batch_shared([query] * s)
+                except Exception:  # warmup must never surface
+                    logger.debug("batch warmup failed", exc_info=True)
+                    return
+            logger.info("batched predict warmed up to batch %d", top)
+
+        threading.Thread(target=warm, name="batch-warmup", daemon=True).start()
+
     def _predict_batch(self, queries: list) -> list:
         """MicroBatcher consumer with per-request error isolation: when the
         batch-wide path (supplement / batched predict) raises — e.g. one
@@ -365,22 +406,38 @@ class QueryService:
     def _predict_batch_shared(self, queries: list) -> list:
         """One supplement + one (batched) predict per algorithm over the
         whole drained batch; serve per query. Per-query serve errors fail
-        only their own request."""
+        only their own request.
+
+        Batches are PADDED to a power of two (repeating the last query) so
+        the micro-batcher's arbitrary drain sizes map onto a handful of
+        device program shapes — these are exactly the shapes the
+        post-deploy warmup compiles. The device lock serializes this path
+        with the background warmup (one batch on the device at a time, the
+        micro-batcher's own invariant)."""
         with self.lock:
             algorithms = self.algorithms
             models = self.models
             serving = self.serving
-        supplemented = [serving.supplement(q) for q in queries]
+        n = len(queries)
+        padded = queries
+        if n > 1:
+            bp = 1 << (n - 1).bit_length()
+            if bp != n:
+                padded = queries + [queries[-1]] * (bp - n)
+        supplemented = [serving.supplement(q) for q in padded]
         per_algo: list[list] = []
-        for algo, model in zip(algorithms, models):
-            if len(queries) > 1 and self._overrides_batch_predict(algo):
-                indexed = algo.batch_predict(model, list(enumerate(supplemented)))
-                got = dict(indexed)
-                per_algo.append([got[i] for i in range(len(queries))])
-            else:
-                per_algo.append(
-                    [algo.predict(model, q) for q in supplemented]
-                )
+        with self._device_lock:
+            for algo, model in zip(algorithms, models):
+                if n > 1 and self._overrides_batch_predict(algo):
+                    indexed = algo.batch_predict(
+                        model, list(enumerate(supplemented))
+                    )
+                    got = dict(indexed)
+                    per_algo.append([got[i] for i in range(n)])
+                else:
+                    per_algo.append(
+                        [algo.predict(model, q) for q in supplemented[:n]]
+                    )
         out: list = []
         for i, query in enumerate(queries):
             try:

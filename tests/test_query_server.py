@@ -171,6 +171,33 @@ def test_stop_endpoint_releases_wait(server):
     assert not waiter.is_alive()
 
 
+def test_first_query_warms_batch_shapes(server):
+    """The first successful query triggers a background replay at pow2
+    batch sizes so a post-deploy concurrent burst doesn't pay per-shape
+    compiles."""
+    import time as _time
+
+    service = server["service"]
+    assert service.batcher is not None
+    assert not service._batch_shapes_warmed
+    status, _ = call(server["port"], "POST", "/queries.json",
+                     {"user": "u1", "num": 3})
+    assert status == 200
+    assert service._batch_shapes_warmed
+    # the background warmer replays through the batched path; wait for the
+    # thread to finish (it logs via request_count-neutral direct calls)
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        threads = [t.name for t in threading.enumerate()]
+        if "batch-warmup" not in threads:
+            break
+        _time.sleep(0.1)
+    assert "batch-warmup" not in [t.name for t in threading.enumerate()]
+    # warmup must not count as served requests
+    status, body = call(server["port"], "GET", "/")
+    assert body["requestCount"] == 1
+
+
 def test_microbatched_concurrent_queries(server):
     """Concurrent queries coalesce into batched device calls and all return
     correct per-query results (the batched path must match single-query)."""
