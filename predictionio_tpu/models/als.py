@@ -65,10 +65,14 @@ class ALSParams:
     #:  solve itself is f32). bf16 halves the dominant HBM gather traffic;
     #: set "float32" for bit-level parity studies.
     gather_dtype: str = "bfloat16"
-    #: HBM bound on a bucket solve's gathered-factor tensor ([rows, k, rank]
-    #: elements). Buckets above it solve in sequential row chunks via
-    #: ``lax.map`` so the gather temp is O(chunk), not O(bucket) — at
-    #: ML-20M rank 64 the unchunked gather alone is >12 GB, past a v5e chip.
+    #: HBM budget for a bucket solve's gathered-factor tensor, expressed as
+    #: f32-equivalent elements (i.e. a BYTE budget of 4x this value): the
+    #: effective element bound is scaled by 4/itemsize(gather_dtype), so
+    #: the default bf16 path fits 2x the elements in the same HBM — see
+    #: :func:`_effective_max_elems`. Buckets above the budget solve in
+    #: sequential ``lax.map`` row chunks so the gather temp is O(chunk),
+    #: not O(bucket) — at ML-20M rank 64 the unchunked gather alone is
+    #: >12 GB, past a v5e chip.
     max_solve_elems: int = 1 << 28
     #: Solver choice. ``bucket`` (the ``auto`` pick) is the ALX-style
     #: degree-bucketed dense batched solve; ``segment`` builds the normal
@@ -122,6 +126,16 @@ def _chunk_plan(
         nc *= 2
 
 
+def _effective_max_elems(params: ALSParams) -> int:
+    """The chunk planner's element budget: ``max_solve_elems`` is an
+    f32-equivalent (byte) budget, so narrower gather dtypes fit
+    proportionally more elements (fewer/larger chunks measured ~1.5x
+    faster at ML-20M rank 64). Shared with bench.py's FLOP/pad model."""
+    return params.max_solve_elems * (
+        4 // jnp.dtype(params.gather_dtype).itemsize
+    )
+
+
 def _narrow_nbr(neighbor_sorted: np.ndarray, n_other: int) -> np.ndarray:
     if n_other <= np.iinfo(np.uint16).max:
         return neighbor_sorted.astype(np.uint16)
@@ -162,6 +176,7 @@ def _bucketize(
     widths = [w for w in params.bucket_widths if w <= params.max_degree]
     if not widths or widths[-1] < params.max_degree:
         widths.append(params.max_degree)
+    max_elems = _effective_max_elems(params)
     specs: list[_TileSpec] = []
     for bi, width in enumerate(widths):
         lo = widths[bi - 1] if bi > 0 else 0
@@ -173,7 +188,7 @@ def _bucketize(
             continue
         b_entities = uniq[sel]
         n, nc = _chunk_plan(
-            len(b_entities), width, params.rank, params.max_solve_elems,
+            len(b_entities), width, params.rank, max_elems,
             ctx.n_devices,
         )
         rows = np.zeros(n, dtype=np.int32)
