@@ -215,7 +215,8 @@ def main() -> None:
     ui, ii, r, nu, ni = synthesize_ml20m()
     ml20m_ips, _, steady = bench_als(
         ctx, ui, ii, r, nu, ni, rank=10, iters=20, steady=True)
-    extra["ml20m_rank10_steady_iter_per_sec"] = round(steady, 3)
+    if steady > 0:
+        extra["ml20m_rank10_steady_iter_per_sec"] = round(steady, 3)
     p10 = ALSParams(rank=10)
     u10 = _padded_shapes(ui, p10, ctx)
     i10 = _padded_shapes(ii, p10, ctx)

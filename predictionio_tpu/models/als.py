@@ -131,8 +131,9 @@ def _effective_max_elems(params: ALSParams) -> int:
     f32-equivalent (byte) budget, so narrower gather dtypes fit
     proportionally more elements (fewer/larger chunks measured ~1.5x
     faster at ML-20M rank 64). Shared with bench.py's FLOP/pad model."""
-    return params.max_solve_elems * (
-        4 // jnp.dtype(params.gather_dtype).itemsize
+    return max(
+        params.max_solve_elems * 4 // jnp.dtype(params.gather_dtype).itemsize,
+        1,
     )
 
 

@@ -224,14 +224,15 @@ def _app():
     return app_module
 
 
-def _load_variant(engine_json_path: str):
+def _load_variant(engine_json_path: str, quiet: bool = False):
     import json
     from pathlib import Path
 
     path = Path(engine_json_path)
     if not path.exists():
-        print(f"[ERROR] {path} not found. Are you in an engine directory?",
-              file=sys.stderr)
+        if not quiet:
+            print(f"[ERROR] {path} not found. Are you in an engine "
+                  "directory?", file=sys.stderr)
         return None
     return json.loads(path.read_text())
 
@@ -377,9 +378,32 @@ def cmd_eval(args) -> int:
     from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
 
     obj = load_engine_factory(args.evaluation_class, os.getcwd())
-    evaluation = obj if isinstance(obj, Evaluation) else (
-        obj() if callable(obj) else obj
-    )
+    if isinstance(obj, Evaluation):
+        evaluation = obj
+    elif callable(obj):
+        # evaluation factories commonly parameterize on app_name (the
+        # reference's evaluation variants hardcode appName in code); pass
+        # the scaffolded engine.json's app so `pio eval` works in a fresh
+        # template directory without editing the factory
+        kwargs = {}
+        try:
+            variant = _load_variant("engine.json", quiet=True)
+            app_name = (
+                ((variant or {}).get("datasource") or {}).get("params") or {}
+            ).get("app_name")
+        except Exception:  # a broken engine.json must not block eval
+            app_name = None
+        if app_name:
+            import inspect
+
+            try:
+                if "app_name" in inspect.signature(obj).parameters:
+                    kwargs["app_name"] = app_name
+            except (TypeError, ValueError):
+                pass
+        evaluation = obj(**kwargs)
+    else:
+        evaluation = obj
     if not isinstance(evaluation, Evaluation):
         print(f"[ERROR] {args.evaluation_class} is not an Evaluation.",
               file=sys.stderr)

@@ -177,6 +177,50 @@ class TestCLIVerbs:
         assert __version__ in capsys.readouterr().out
         assert main(["upgrade"]) == 0
 
+    def test_eval_cli_uses_engine_json_app_name(
+        self, memory_storage, tmp_path, monkeypatch, capsys
+    ):
+        """`pio eval` in a scaffolded engine dir injects engine.json's
+        app_name into an evaluation factory that accepts one (the factory's
+        default points at a different app)."""
+        import json
+
+        import numpy as np
+
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.tools.cli import main
+
+        app_id = _seed_app(memory_storage, "evalapp")
+        events = memory_storage.get_events()
+        rng = np.random.default_rng(0)
+        for u in range(25):
+            for i in range(15):
+                if rng.random() < 0.6:
+                    events.insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id=f"u{u}", target_entity_type="item",
+                              target_entity_id=f"i{i}",
+                              properties=DataMap(
+                                  {"rating": float(rng.integers(1, 6))})),
+                        app_id,
+                    )
+        engine_dir = tmp_path / "engine"
+        engine_dir.mkdir()
+        (engine_dir / "engine.json").write_text(json.dumps({
+            "engineFactory":
+                "predictionio_tpu.templates.recommendation:engine_factory",
+            "datasource": {"params": {"app_name": "evalapp"}},
+        }))
+        monkeypatch.chdir(engine_dir)
+        rc = main([
+            "eval", "predictionio_tpu.templates.recommendation:evaluation",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "Evaluation completed" in out
+        assert "PrecisionAtK" in out
+
     def test_shell_preloads_stack(self, memory_storage, monkeypatch, capsys):
         """`pio shell` drops into a REPL with Storage and compute_context
         bound (ref: bin/pio-shell:30-33)."""
