@@ -44,7 +44,34 @@ def main() -> int:
     # sum over rows 0..7 of 4 columns = 4 * 28
     print(f"RESULT {os.environ['PIO_TPU_PROCESS_ID']} {float(total)}",
           flush=True)
+
+    # a REAL training program over the spanning mesh: tiny ALS, identical
+    # inputs on every process, collectives over the 8 global devices
+    fingerprint = als_fingerprint(ctx)
+    print(f"ALS {os.environ['PIO_TPU_PROCESS_ID']} {fingerprint:.4f}",
+          flush=True)
     return 0
+
+
+def als_fingerprint(ctx) -> float:
+    """Train a fixed tiny ALS problem on ``ctx`` and reduce the factors to
+    one number — shared by the distributed workers and the single-process
+    comparison in test_distributed.py so the two runs can't drift."""
+    import numpy as np
+
+    from predictionio_tpu.models.als import ALS, ALSParams
+
+    rng = np.random.default_rng(0)
+    n_users, n_items = 24, 16
+    mask = rng.random((n_users, n_items)) < 0.5
+    ui, ii = np.nonzero(mask)
+    r = rng.integers(1, 6, len(ui)).astype(np.float32)
+    als = ALS(ctx, ALSParams(rank=4, num_iterations=3, lambda_=0.05, seed=1,
+                             gather_dtype="float32"))
+    factors = als.train(ui.astype(np.int32), ii.astype(np.int32), r,
+                        n_users, n_items)
+    return float(np.abs(factors.user_features).sum()
+                 + np.abs(factors.item_features).sum())
 
 
 if __name__ == "__main__":

@@ -56,3 +56,23 @@ def test_two_process_mesh_spans_and_reduces():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"RESULT {pid} 112.0" in out, f"worker {pid} output:\n{out}"
+    # the distributed ALS training converged identically on both processes,
+    # and matches the same training run on a single-process 8-device mesh
+    import re
+
+    fps = [
+        float(re.search(rf"ALS {pid} ([0-9.]+)", out).group(1))
+        for pid, out in enumerate(outs)
+    ]
+    assert fps[0] == fps[1], f"process factor mismatch: {fps}"
+    single = _single_process_fingerprint()
+    assert abs(fps[0] - single) < 1e-2, (fps[0], single)
+
+
+def _single_process_fingerprint() -> float:
+    """Same tiny ALS on the in-process 8-device mesh (conftest wiring)."""
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    from dist_worker import als_fingerprint
+
+    return als_fingerprint(compute_context())
