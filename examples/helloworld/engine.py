@@ -1,0 +1,86 @@
+"""Hello-world engine: average temperature per day of week.
+
+The analog of the reference's minimal custom-engine tutorial
+(ref: examples/experimental/scala-local-helloworld/HelloWorld.scala):
+every DASE component written by hand in one file, no template, no event
+store — training data comes from ``data/data.csv``. Run from this
+directory:
+
+    pio train
+    pio deploy --port 8000 &
+    curl -s -X POST localhost:8000/queries.json -d '{"day": "Mon"}'
+
+Even a toy engine inherits the full lifecycle: the trained model is
+persisted to the Models store, `pio deploy` serves it with micro-batching,
+and /reload hot-swaps after retraining.
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass
+from pathlib import Path
+
+from predictionio_tpu.core import Engine, IdentityPreparator, LServing
+from predictionio_tpu.core.dase import LAlgorithm, LDataSource
+
+
+@dataclass(frozen=True)
+class MyTrainingData:
+    temperatures: tuple  # ((day, temperature), ...)
+
+
+@dataclass(frozen=True)
+class MyQuery:
+    day: str
+
+
+@dataclass(frozen=True)
+class MyPredictedResult:
+    temperature: float
+
+
+class MyDataSource(LDataSource):
+    def __init__(self, params=None):
+        pass
+
+    def read_training_local(self) -> MyTrainingData:
+        path = Path(__file__).parent / "data" / "data.csv"
+        with open(path) as f:
+            rows = tuple(
+                (day, float(temp)) for day, temp in csv.reader(f)
+            )
+        return MyTrainingData(rows)
+
+
+class MyAlgorithm(LAlgorithm):
+    query_class = MyQuery
+
+    def __init__(self, params=None):
+        pass
+
+    def train_local(self, pd: MyTrainingData) -> dict:
+        sums: dict[str, list[float]] = {}
+        for day, temp in pd.temperatures:
+            sums.setdefault(day, []).append(temp)
+        return {day: sum(v) / len(v) for day, v in sums.items()}
+
+    def predict(self, model: dict, query: MyQuery) -> MyPredictedResult:
+        return MyPredictedResult(temperature=model.get(query.day, 0.0))
+
+
+class MyServing(LServing):
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=MyDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"algo": MyAlgorithm},
+        serving_class=MyServing,
+    )

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Periodic retrain + hot-swap, the cron pattern of the reference's
+# examples/redeploy-script: run `pio train` in the engine directory, then
+# tell the live query server to load the new instance without downtime.
+#
+#   crontab: 0 3 * * *  /path/to/redeploy.sh /path/to/engine 8000
+set -euo pipefail
+ENGINE_DIR=${1:?usage: redeploy.sh <engine-dir> [port]}
+PORT=${2:-8000}
+
+cd "$ENGINE_DIR"
+pio train
+if curl -fsS "http://127.0.0.1:${PORT}/reload" >/dev/null; then
+  echo "redeployed $(date -Is)"
+else
+  echo "train succeeded but no server answered on :${PORT} (deploy it with: pio deploy --port ${PORT})" >&2
+  exit 1
+fi

@@ -1,0 +1,38 @@
+"""The hand-written example engines stay working (ref:
+examples/experimental/scala-local-helloworld)."""
+
+from pathlib import Path
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def test_helloworld_engine_trains_and_predicts(memory_storage):
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    factory = "engine:engine_factory"
+    engine = get_engine(factory, EXAMPLES / "helloworld")
+    ep = engine.engine_params_from_json(
+        {"algorithms": [{"name": "algo", "params": {}}]}
+    )
+    instance = new_engine_instance("helloworld", "1", "default", factory, ep)
+    instance_id = run_train(engine, ep, instance, WorkflowParams())
+    assert instance_id
+
+    # deploy-shape round trip: model comes back from the Models store
+    from predictionio_tpu.core.persistent_model import deserialize_models
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    blob = memory_storage.get_model_data_models().get(instance_id)
+    models = engine.prepare_deploy(
+        compute_context(), ep, instance_id,
+        deserialize_models(blob.models), WorkflowParams(),
+    )
+    algo = engine._algorithms(ep)[0]
+    result = algo.predict(models[0], algo.query_class(day="Mon"))
+    assert abs(result.temperature - 76.0) < 1e-9  # (75.5 + 76.5) / 2
+    assert algo.predict(models[0], algo.query_class(day="Nope")).temperature == 0.0
