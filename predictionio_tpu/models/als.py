@@ -137,10 +137,28 @@ def _effective_max_elems(params: ALSParams) -> int:
     )
 
 
-def _narrow_nbr(neighbor_sorted: np.ndarray, n_other: int) -> np.ndarray:
-    if n_other <= np.iinfo(np.uint16).max:
+def _narrow_nbr(neighbor_sorted: np.ndarray, n_other: int):
+    """Neighbor ids in the narrowest lossless wire format: uint16 when they
+    fit, a (lo: uint16, hi: uint8) pair for ids < 2^24 (3 bytes/row instead
+    of 4 — the item-side solve's user ids are the largest single transfer),
+    int32 otherwise. :func:`_widen_nbr` reassembles on device."""
+    # ids are in [0, n_other), so n_other == 2^16 still fits uint16
+    if n_other <= (1 << 16):
         return neighbor_sorted.astype(np.uint16)
+    if n_other <= (1 << 24):
+        arr = neighbor_sorted.astype(np.uint32)
+        return (
+            (arr & 0xFFFF).astype(np.uint16), (arr >> 16).astype(np.uint8)
+        )
     return neighbor_sorted.astype(np.int32)
+
+
+def _widen_nbr(nbr) -> "jnp.ndarray":
+    """Device-side inverse of :func:`_narrow_nbr` → int32 indices."""
+    if isinstance(nbr, tuple):
+        lo, hi = nbr
+        return lo.astype(jnp.int32) | (hi.astype(jnp.int32) << 16)
+    return nbr.astype(jnp.int32)
 
 
 def _narrow_val(ratings_sorted: np.ndarray) -> np.ndarray:
@@ -409,7 +427,7 @@ def _init_factors(key, n: int, rank: int):
 @partial(
     jax.jit,
     static_argnames=("implicit", "rank", "meta", "shard", "gather_dtype"),
-    donate_argnums=(0, 1),
+    donate_argnums=(0, 1, 2, 3, 4, 5),
 )
 def _als_train(
     user_f,
@@ -438,8 +456,8 @@ def _als_train(
     keeps the host (and a tunneled TPU's per-call RPC and re-transfer)
     entirely out of the training loop — at ML-20M scale that overhead
     rivalled the compute itself."""
-    u_nbr = u_nbr.astype(jnp.int32)
-    i_nbr = i_nbr.astype(jnp.int32)
+    u_nbr = _widen_nbr(u_nbr)
+    i_nbr = _widen_nbr(i_nbr)
     u_val = u_val.astype(jnp.float32)
     i_val = i_val.astype(jnp.float32)
     u_meta, i_meta = meta
@@ -483,8 +501,8 @@ def _als_iteration(
     :func:`_als_train`."""
     u_meta, i_meta = meta
     return _iteration_body(
-        user_f, item_f, u_nbr.astype(jnp.int32), u_val.astype(jnp.float32),
-        i_nbr.astype(jnp.int32), i_val.astype(jnp.float32),
+        user_f, item_f, _widen_nbr(u_nbr), u_val.astype(jnp.float32),
+        _widen_nbr(i_nbr), i_val.astype(jnp.float32),
         u_tiles, i_tiles, u_meta, i_meta, lambda_, alpha, implicit, rank,
         shard, gather_dtype,
     )
@@ -755,9 +773,11 @@ class ALS:
         shard = ctx.batch_sharding() if multi else None
 
         def put(x, sharding):
+            # x may be a (lo, hi) tuple from _narrow_nbr; device_put maps
+            # over pytrees, jnp.asarray does not
             if multi:
                 return jax.device_put(x, sharding)
-            return jnp.asarray(x)
+            return jax.device_put(x)
 
         repl = ctx.replicated if multi else None
         pu = _sort_perm(user_idx, u_starts)

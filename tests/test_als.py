@@ -172,3 +172,24 @@ def test_zero_ratings_raises(ctx):
     with pytest.raises(ValueError):
         als.train(np.array([], np.int32), np.array([], np.int32),
                   np.array([], np.float32), 5, 5)
+
+
+def test_three_byte_neighbor_encoding_roundtrip():
+    """Ids in (2^16, 2^24) ship as a (uint16, uint8) pair — 3 bytes/row —
+    and reassemble exactly on device."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.als import _narrow_nbr, _widen_nbr
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1 << 24, 10_000).astype(np.int32)
+    narrow = _narrow_nbr(ids, (1 << 24) - 1)
+    assert isinstance(narrow, tuple)
+    lo, hi = narrow
+    assert lo.dtype == np.uint16 and hi.dtype == np.uint8
+    wide = np.asarray(_widen_nbr((jnp.asarray(lo), jnp.asarray(hi))))
+    np.testing.assert_array_equal(wide, ids)
+    small = _narrow_nbr(ids % 1000, 1000)
+    assert small.dtype == np.uint16
+    big = _narrow_nbr(ids, 1 << 25)
+    assert big.dtype == np.int32
