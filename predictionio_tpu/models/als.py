@@ -161,13 +161,10 @@ def _widen_nbr(nbr) -> "jnp.ndarray":
     return nbr.astype(jnp.int32)
 
 
-def _narrow_val(ratings_sorted: np.ndarray) -> np.ndarray:
-    if (
-        np.all(ratings_sorted == np.rint(ratings_sorted))
-        and np.all(np.abs(ratings_sorted) <= 127)
-    ):
-        return ratings_sorted.astype(np.int8)
-    return ratings_sorted.astype(np.float32)
+def _val_fits_int8(ratings: np.ndarray) -> bool:
+    return bool(
+        np.all(ratings == np.rint(ratings)) and np.all(np.abs(ratings) <= 127)
+    )
 
 
 def _histogram(entity_idx: np.ndarray, n_entities: int):
@@ -249,6 +246,42 @@ def _sort_perm(entity_idx: np.ndarray, starts_all: np.ndarray) -> np.ndarray:
         if rc == 0:
             return perm
     return np.argsort(entity_idx, kind="stable").astype(np.int32)
+
+
+def _sorted_side(
+    entity_idx: np.ndarray,
+    starts_all: np.ndarray,
+    neighbor_idx: np.ndarray,
+    ratings: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(neighbors, ratings) grouped by entity in one fused C pass — the
+    counting sort applies the payloads while sorting, replacing a
+    permutation plus two 20M-row fancy-index gathers. Falls back to the
+    :func:`_sort_perm` + gather route without a toolchain."""
+    import ctypes
+
+    from predictionio_tpu.native import eventlog_lib
+
+    lib = eventlog_lib()
+    if lib is not None and hasattr(lib, "pio_counting_sort_apply"):
+        keys = np.ascontiguousarray(entity_idx, dtype=np.int32)
+        ids = np.ascontiguousarray(neighbor_idx, dtype=np.int32)
+        vals = np.ascontiguousarray(ratings, dtype=np.float32)
+        next_pos = starts_all.copy()
+        out_ids = np.empty(len(keys), dtype=np.int32)
+        out_vals = np.empty(len(keys), dtype=np.float32)
+        rc = lib.pio_counting_sort_apply(
+            keys.ctypes.data_as(ctypes.c_void_p), len(keys), len(next_pos),
+            next_pos.ctypes.data_as(ctypes.c_void_p),
+            ids.ctypes.data_as(ctypes.c_void_p),
+            vals.ctypes.data_as(ctypes.c_void_p),
+            out_ids.ctypes.data_as(ctypes.c_void_p),
+            out_vals.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc == 0:
+            return out_ids, out_vals
+    perm = _sort_perm(entity_idx, starts_all)
+    return neighbor_idx[perm], ratings[perm]
 
 
 #: Ranks up to this solve via the unrolled structure-of-arrays Cholesky —
@@ -427,7 +460,7 @@ def _init_factors(key, n: int, rank: int):
 @partial(
     jax.jit,
     static_argnames=("implicit", "rank", "meta", "shard", "gather_dtype"),
-    donate_argnums=(0, 1, 2, 3, 4, 5),
+    donate_argnums=(0, 1),
 )
 def _als_train(
     user_f,
@@ -780,13 +813,16 @@ class ALS:
             return jax.device_put(x)
 
         repl = ctx.replicated if multi else None
-        pu = _sort_perm(user_idx, u_starts)
-        pi = _sort_perm(item_idx, i_starts)
-        val_wide = _narrow_val(ratings)  # dtype decided once, cast per perm
-        u_nbr = put(_narrow_nbr(item_idx[pu], n_items), repl)
-        u_val = put(val_wide[pu], repl)
-        i_nbr = put(_narrow_nbr(user_idx[pi], n_users), repl)
-        i_val = put(val_wide[pi], repl)
+        u_ids, u_vals = _sorted_side(user_idx, u_starts, item_idx, ratings)
+        i_ids, i_vals = _sorted_side(item_idx, i_starts, user_idx, ratings)
+        # integrality is permutation-invariant: decide the wire dtype once
+        if _val_fits_int8(ratings):
+            u_vals = u_vals.astype(np.int8)
+            i_vals = i_vals.astype(np.int8)
+        u_nbr = put(_narrow_nbr(u_ids, n_items), repl)
+        u_val = put(u_vals, repl)
+        i_nbr = put(_narrow_nbr(i_ids, n_users), repl)
+        i_val = put(i_vals, repl)
         u_tiles = tuple(
             tuple(put(x, shard) for x in (s.rows, s.starts, s.counts))
             for s in uplan.specs

@@ -80,18 +80,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
     lib.pio_eventlog_interactions.restype = c.c_int32
-    try:  # added after the first release of the .so: bind defensively so a
-        # stale library (mtime newer than the source) degrades to the
-        # numpy fallback instead of crashing ALS.train
-        lib.pio_counting_sort_perm.argtypes = [
-            c.c_void_p, c.c_int64, c.c_int64, c.c_void_p, c.c_void_p,
-        ]
-        lib.pio_counting_sort_perm.restype = c.c_int32
-    except AttributeError:
-        logger.warning(
-            "native library lacks pio_counting_sort_perm (stale build?); "
-            "sort fast path disabled"
-        )
+    # these symbols postdate the first release of the .so: bind each
+    # defensively so a stale library (mtime newer than the source) degrades
+    # to the numpy fallback for just the missing piece
+    for name, argtypes in (
+        ("pio_counting_sort_perm",
+         [c.c_void_p, c.c_int64, c.c_int64, c.c_void_p, c.c_void_p]),
+        ("pio_counting_sort_apply",
+         [c.c_void_p, c.c_int64, c.c_int64, c.c_void_p,
+          c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]),
+    ):
+        try:
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = c.c_int32
+        except AttributeError:
+            logger.warning(
+                "native library lacks %s (stale build?); that sort fast "
+                "path is disabled", name,
+            )
     return lib
 
 

@@ -532,4 +532,24 @@ int32_t pio_counting_sort_perm(const int32_t* keys, int64_t n,
   return 0;
 }
 
+
+// Counting sort with fused payload application: one pass reads (key, id,
+// value) rows sequentially and writes them to their sorted positions —
+// replaces a separate permutation plus two 20M-row numpy fancy-index
+// gathers (~1.7s host) with a single memory-speed sweep.
+int32_t pio_counting_sort_apply(const int32_t* keys, int64_t n,
+                                int64_t n_keys, int64_t* next_pos,
+                                const int32_t* payload_ids,
+                                const float* payload_vals, int32_t* out_ids,
+                                float* out_vals) {
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t k = keys[j];
+    if (k < 0 || k >= n_keys) return -1;  // corrupt input; caller falls back
+    int64_t d = next_pos[k]++;
+    out_ids[d] = payload_ids[j];
+    out_vals[d] = payload_vals[j];
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -158,8 +158,7 @@ def test_narrow_transfer_dtypes_match_wide(ctx, monkeypatch):
     narrow = ALS(ctx, p).train(ui, ii, r, 60, 40)  # small sides → uint16/int8
     monkeypatch.setattr(
         als_mod, "_narrow_nbr", lambda nbr, n: nbr.astype(np.int32))
-    monkeypatch.setattr(
-        als_mod, "_narrow_val", lambda v: v.astype(np.float32))
+    monkeypatch.setattr(als_mod, "_val_fits_int8", lambda r: False)
     wide = ALS(ctx, p).train(ui, ii, r, 60, 40)
     np.testing.assert_allclose(
         narrow.user_features, wide.user_features, rtol=1e-6, atol=1e-6)
