@@ -70,6 +70,8 @@ def synthesize_ml20m(seed: int = 0):
 def _padded_shapes(idx: np.ndarray, params, ctx) -> list[tuple[int, int]]:
     """(n_rows_padded, width) per degree bucket for one side — mirrors
     models/als._bucketize's grouping without materializing the tiles."""
+    from predictionio_tpu.models.als import _chunk_plan, _effective_max_elems
+
     _, counts = np.unique(idx, return_counts=True)
     widths = [w for w in params.bucket_widths if w <= params.max_degree]
     if not widths or widths[-1] < params.max_degree:
@@ -83,11 +85,6 @@ def _padded_shapes(idx: np.ndarray, params, ctx) -> list[tuple[int, int]]:
             sel = (counts > lo) & (counts <= width)
         n = int(sel.sum())
         if n:
-            from predictionio_tpu.models.als import (
-                _chunk_plan,
-                _effective_max_elems,
-            )
-
             padded, _nc = _chunk_plan(
                 n, width, params.rank, _effective_max_elems(params),
                 ctx.n_devices,

@@ -101,16 +101,6 @@ class _TileSpec:
     nc: int = 1  # solve in this many sequential row chunks (see max_solve_elems)
 
 
-@dataclass
-class _SidePlan:
-    """One side's per-bucket CSR tile specs. The entity-sorted rating
-    arrays the specs point into are produced by the counting-sort ETL
-    (`_sort_perm`); the spec construction itself needs only a `bincount`
-    degree histogram."""
-
-    specs: list
-
-
 def _chunk_plan(
     n_real: int, width: int, rank: int, max_elems: int, unit: int
 ) -> tuple[int, int]:
@@ -181,7 +171,7 @@ def _bucketize(
     counts_all: np.ndarray,
     starts_all: np.ndarray,
     params: ALSParams,
-) -> _SidePlan:
+) -> list[_TileSpec]:
     """Group one side's entities by degree into tile *specs* (ALX §3.2-style
     density bucketing) from the CSR histogram. The starts are valid because
     the counting-sort ETL (:func:`_sort_perm`) groups entities in ascending
@@ -218,7 +208,18 @@ def _bucketize(
         b_starts[: len(b_entities)] = starts[sel]
         b_counts[: len(b_entities)] = np.minimum(counts[sel], width)
         specs.append(_TileSpec(rows, b_starts, b_counts, width, nc))
-    return _SidePlan(specs)
+    return specs
+
+
+def _native_sort_lib(symbol: str):
+    """The compiled sort library when available and carrying ``symbol``,
+    else None (callers fall back to numpy)."""
+    from predictionio_tpu.native import eventlog_lib
+
+    lib = eventlog_lib()
+    if lib is not None and hasattr(lib, symbol):
+        return lib
+    return None
 
 
 def _sort_perm(entity_idx: np.ndarray, starts_all: np.ndarray) -> np.ndarray:
@@ -231,10 +232,8 @@ def _sort_perm(entity_idx: np.ndarray, starts_all: np.ndarray) -> np.ndarray:
     comparison networks)."""
     import ctypes
 
-    from predictionio_tpu.native import eventlog_lib
-
-    lib = eventlog_lib()
-    if lib is not None and hasattr(lib, "pio_counting_sort_perm"):
+    lib = _native_sort_lib("pio_counting_sort_perm")
+    if lib is not None:
         keys = np.ascontiguousarray(entity_idx, dtype=np.int32)
         next_pos = starts_all.copy()  # the C pass mutates its cursors
         perm = np.empty(len(keys), dtype=np.int32)
@@ -260,10 +259,8 @@ def _sorted_side(
     :func:`_sort_perm` + gather route without a toolchain."""
     import ctypes
 
-    from predictionio_tpu.native import eventlog_lib
-
-    lib = eventlog_lib()
-    if lib is not None and hasattr(lib, "pio_counting_sort_apply"):
+    lib = _native_sort_lib("pio_counting_sort_apply")
+    if lib is not None:
         keys = np.ascontiguousarray(entity_idx, dtype=np.int32)
         ids = np.ascontiguousarray(neighbor_idx, dtype=np.int32)
         vals = np.ascontiguousarray(ratings, dtype=np.float32)
@@ -441,6 +438,15 @@ def _solve_bucket(
     # masked to zero, so add-after-clear keeps every row correct
     cleared = target.at[rows].multiply(0.0)
     return cleared.at[rows].add(sol)
+
+
+def _put(x, sharding):
+    """Host → device placement: explicit sharding on a multi-chip mesh
+    (``sharding is None`` on a single chip → default device). Maps over
+    pytrees (the (lo, hi) neighbor pairs from _narrow_nbr)."""
+    if sharding is not None:
+        return jax.device_put(x, sharding)
+    return jax.device_put(x)
 
 
 def _gram(fixed):
@@ -780,12 +786,12 @@ class ALS:
 
         u_counts, u_starts = _histogram(user_idx, n_users)
         i_counts, i_starts = _histogram(item_idx, n_items)
-        uplan = _bucketize(ctx, u_counts, u_starts, p)
-        iplan = _bucketize(ctx, i_counts, i_starts, p)
+        u_specs = _bucketize(ctx, u_counts, u_starts, p)
+        i_specs = _bucketize(ctx, i_counts, i_starts, p)
         logger.info(
             "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
-            ratings.size, n_users, len(uplan.specs), n_items,
-            len(iplan.specs), p.rank,
+            ratings.size, n_users, len(u_specs), n_items,
+            len(i_specs), p.rank,
         )
 
         multi = ctx.mesh.devices.size > 1
@@ -805,13 +811,6 @@ class ALS:
         # ever crosses the host link.
         shard = ctx.batch_sharding() if multi else None
 
-        def put(x, sharding):
-            # x may be a (lo, hi) tuple from _narrow_nbr; device_put maps
-            # over pytrees, jnp.asarray does not
-            if multi:
-                return jax.device_put(x, sharding)
-            return jax.device_put(x)
-
         repl = ctx.replicated if multi else None
         u_ids, u_vals = _sorted_side(user_idx, u_starts, item_idx, ratings)
         i_ids, i_vals = _sorted_side(item_idx, i_starts, user_idx, ratings)
@@ -819,21 +818,21 @@ class ALS:
         if _val_fits_int8(ratings):
             u_vals = u_vals.astype(np.int8)
             i_vals = i_vals.astype(np.int8)
-        u_nbr = put(_narrow_nbr(u_ids, n_items), repl)
-        u_val = put(u_vals, repl)
-        i_nbr = put(_narrow_nbr(i_ids, n_users), repl)
-        i_val = put(i_vals, repl)
+        u_nbr = _put(_narrow_nbr(u_ids, n_items), repl)
+        u_val = _put(u_vals, repl)
+        i_nbr = _put(_narrow_nbr(i_ids, n_users), repl)
+        i_val = _put(i_vals, repl)
         u_tiles = tuple(
-            tuple(put(x, shard) for x in (s.rows, s.starts, s.counts))
-            for s in uplan.specs
+            tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
+            for s in u_specs
         )
         i_tiles = tuple(
-            tuple(put(x, shard) for x in (s.rows, s.starts, s.counts))
-            for s in iplan.specs
+            tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
+            for s in i_specs
         )
         meta = (
-            tuple((s.width, s.nc) for s in uplan.specs),
-            tuple((s.width, s.nc) for s in iplan.specs),
+            tuple((s.width, s.nc) for s in u_specs),
+            tuple((s.width, s.nc) for s in i_specs),
         )
         static = dict(
             implicit=p.implicit_prefs, rank=p.rank, meta=meta, shard=shard,
@@ -884,11 +883,10 @@ class ALS:
             item_f = jax.device_put(item_f, ctx.replicated)
             shard = ctx.batch_sharding()
 
-        def put(x):
-            return jax.device_put(x, shard) if multi else jnp.asarray(x)
-
-        u_arrs = tuple(put(x) for x in (us.seg, us.nbr, us.val, us.wgt))
-        i_arrs = tuple(put(x) for x in (it.seg, it.nbr, it.val, it.wgt))
+        u_arrs = tuple(
+            _put(x, shard) for x in (us.seg, us.nbr, us.val, us.wgt))
+        i_arrs = tuple(
+            _put(x, shard) for x in (it.seg, it.nbr, it.val, it.wgt))
 
         for step in range(p.num_iterations):
             user_f, item_f = _als_iteration_segment(

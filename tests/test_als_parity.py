@@ -165,6 +165,26 @@ def test_native_counting_sort_matches_numpy_stable_argsort():
     np.testing.assert_array_equal(got, want)
 
 
+def test_native_fused_sort_apply_matches_numpy():
+    """The fused sort+apply kernel (the training fast path) must group
+    payloads exactly like numpy's stable argsort gather."""
+    from predictionio_tpu.models.als import _histogram, _sorted_side
+    from predictionio_tpu.native import eventlog_lib
+
+    lib = eventlog_lib()
+    if lib is None or not hasattr(lib, "pio_counting_sort_apply"):
+        pytest.skip("native toolchain unavailable — numpy fallback only")
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 83, 60_000).astype(np.int32)
+    nbr = rng.integers(0, 1_000_000, len(keys)).astype(np.int32)
+    vals = rng.normal(size=len(keys)).astype(np.float32)
+    _counts, starts_all = _histogram(keys, 83)
+    got_ids, got_vals = _sorted_side(keys, starts_all, nbr, vals)
+    perm = np.argsort(keys, kind="stable")
+    np.testing.assert_array_equal(got_ids, nbr[perm])
+    np.testing.assert_array_equal(got_vals, vals[perm])
+
+
 def test_chunked_bucket_solve_matches_unchunked(ctx):
     """Buckets above max_solve_elems solve in sequential lax.map row chunks
     (HBM-bounded path used at ML-20M scale); results must be identical."""
