@@ -1,0 +1,109 @@
+"""Cross-process durability of the jsonfs document-tree backend: a real
+`pio eventserver` child process ingests over HTTP into the shared tree, and
+this process then reads the same events through its own Storage — the
+event-server + trainer deployment shape the backend exists for (the ES-
+analog role, ref: Storage.scala:263-312)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_event_server_child_process_shares_jsonfs_tree(tmp_path, monkeypatch):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    tree = tmp_path / "doctree"
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("PIO_STORAGE_")
+    }
+    env.update({
+        "PYTHONPATH": os.pathsep.join(
+            p for p in (str(REPO_ROOT), os.environ.get("PYTHONPATH")) if p
+        ),
+        "JAX_PLATFORMS": "cpu",
+        "PIO_STORAGE_SOURCES_DOC_TYPE": "predictionio_tpu.contrib.jsonfs",
+        "PIO_STORAGE_SOURCES_DOC_PATH": str(tree),
+    })
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        env[f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"] = "DOC"
+        env[f"PIO_STORAGE_REPOSITORIES_{repo}_NAME"] = f"mp_{repo.lower()}"
+
+    # this process creates the app + key in the shared tree FIRST
+    # (conftest convention: clear all storage env, then set the new wiring)
+    for k in list(os.environ):
+        if k.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(k)
+    for k, v in env.items():
+        if k.startswith("PIO_STORAGE_"):
+            monkeypatch.setenv(k, v)
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    Storage.reset()
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(0, "mpapp"))
+        Storage.get_events().init(app_id)
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ())
+        )
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.tools.cli",
+             "eventserver", "--port", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            deadline = time.time() + 60
+            up = False
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=2
+                    ):
+                        up = True
+                        break
+                except Exception:
+                    assert proc.poll() is None, proc.stdout.read()
+                    time.sleep(0.3)
+            if not up:
+                proc.terminate()
+                out, _ = proc.communicate(timeout=20)
+                raise AssertionError(
+                    f"event server not listening within 60s:\n{out}"
+                )
+            for i in range(5):
+                body = json.dumps({
+                    "event": "buy", "entityType": "user",
+                    "entityId": f"u{i}", "targetEntityType": "item",
+                    "targetEntityId": f"i{i}",
+                }).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/events.json?accessKey={key}",
+                    data=body, headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert resp.status == 201
+        finally:
+            proc.terminate()
+            proc.wait(timeout=20)
+
+        # the child's writes are durable JSON documents this process reads
+        events = list(Storage.get_events().find(app_id))
+        assert len(events) == 5
+        assert {e.entity_id for e in events} == {f"u{i}" for i in range(5)}
+        table_dirs = list(tree.glob("*events*"))
+        assert table_dirs, f"no event table under {tree}"
+    finally:
+        Storage.reset()
