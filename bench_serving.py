@@ -36,7 +36,8 @@ def _setup_storage():
     return Storage
 
 
-def _seed_and_train(storage, n_users=943, n_items=1682, nnz=30_000):
+def _seed_and_train(storage, n_users=943, n_items=1682, nnz=30_000,
+                    rank=10):
     from predictionio_tpu.core.engine import WorkflowParams
     from predictionio_tpu.data.datamap import DataMap
     from predictionio_tpu.data.event import Event
@@ -69,12 +70,13 @@ def _seed_and_train(storage, n_users=943, n_items=1682, nnz=30_000):
         "datasource": {"params": {"app_name": "benchapp"}},
         "algorithms": [
             {"name": "als",
-             "params": {"rank": 10, "numIterations": 5, "seed": 0}}
+             "params": {"rank": rank, "numIterations": 5, "seed": 0}}
         ],
     }
     ep = engine.engine_params_from_json(variant)
     instance = new_engine_instance("default", "1", "default", factory, ep)
     run_train(engine, ep, instance, WorkflowParams())
+    return n_items, rank
 
 
 class _Client:
@@ -111,7 +113,7 @@ def bench_query_latency(
 
     storage = _setup_storage()
     try:
-        _seed_and_train(storage)
+        n_items, rank = _seed_and_train(storage)
         srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
         srv.start()
         try:
@@ -164,6 +166,45 @@ def bench_query_latency(
             }
             if service.batcher is not None:
                 out["serve_max_batch_seen"] = service.batcher.max_batch_seen
+
+            # placement telemetry: what the latency-aware policy decided
+            # for this catalog (parallel/placement.py), the measured link
+            # RTT it decided on, and — when it picked the host — the
+            # accelerator-pinned latency for comparison.
+            from predictionio_tpu.parallel.placement import (
+                link_rtt,
+                serving_device,
+            )
+
+            out["serve_link_rtt_ms"] = round(link_rtt() * 1e3, 3)
+            # the decision is per padded batch size: report it for the
+            # sequential phase (b=1) and the concurrent phase's largest
+            # drained batch, which may differ near the RTT crossover
+            picked_host = serving_device(2.0 * 1 * n_items * rank) is not None
+            out["serve_placement"] = "host" if picked_host else "default"
+            bmax = out.get("serve_max_batch_seen", threads)
+            conc_host = (
+                serving_device(2.0 * bmax * n_items * rank) is not None
+            )
+            out["serve_conc_placement"] = "host" if conc_host else "default"
+            if picked_host:
+                prev = os.environ.get("PIO_SERVING_DEVICE")
+                os.environ["PIO_SERVING_DEVICE"] = "default"
+                try:
+                    c2 = _Client(srv.port)
+                    for k in range(5):  # compile/warm the device program
+                        c2.query(f"u{k}", 10)
+                    lat = [c2.query(f"u{k % 900}", 10) for k in range(50)]
+                    c2.close()
+                    accel = np.asarray(lat) * 1e3
+                    out["serve_accel_pinned_p50_ms"] = round(
+                        float(np.percentile(accel, 50)), 2
+                    )
+                finally:
+                    if prev is None:
+                        del os.environ["PIO_SERVING_DEVICE"]
+                    else:
+                        os.environ["PIO_SERVING_DEVICE"] = prev
             return out
         finally:
             srv.stop()

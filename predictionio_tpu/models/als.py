@@ -29,7 +29,6 @@ entity's rating count.
 from __future__ import annotations
 
 import logging
-import weakref
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -39,6 +38,13 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.parallel.mesh import ComputeContext
+# host-array-identity device cache: without it each query would re-ship
+# the whole catalog over the host link (~RTT-sized latency per call
+# through a tunneled TPU); lives beside the latency-aware placement policy
+from predictionio_tpu.parallel.placement import (
+    device_cache_put as _as_device,
+    host_cache_transform,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -941,32 +947,6 @@ def _top_k_dense(query_vecs, item_features, k: int, exclude_mask=None):
     return jax.lax.top_k(scores, k)
 
 
-#: (id(host array), tag) → (weakref to host array, device copy). Serving
-#: passes the SAME factor matrices on every request; without this cache
-#: each query re-ships the whole catalog over the host link (~RTT-sized
-#: latency per call through a tunneled TPU). Entries die with their host
-#: array. Cached arrays are treated as immutable-after-training, which
-#: holds for every product path (factors are replaced wholesale on reload).
-_DEVICE_CACHE: dict = {}
-
-
-def _as_device(arr, tag: str = "", transform=None):
-    """Device-resident (optionally transformed) copy of ``arr``, cached by
-    host-array identity. jax arrays pass through (transformed, uncached)."""
-    if not isinstance(arr, np.ndarray):
-        dev = jnp.asarray(arr)
-        return transform(dev) if transform is not None else dev
-    key = (id(arr), tag)
-    hit = _DEVICE_CACHE.get(key)
-    if hit is not None and hit[0]() is arr:
-        return hit[1]
-    dev = jnp.asarray(arr)
-    if transform is not None:
-        dev = transform(dev)
-    ref = weakref.ref(arr, lambda _r, key=key: _DEVICE_CACHE.pop(key, None))
-    _DEVICE_CACHE[key] = (ref, dev)
-    return dev
-
 
 def _pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
@@ -981,10 +961,24 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     The catalog matrix is device-cached across calls, batch/k are padded
     to powers of two so the micro-batcher's varying batch sizes hit a
     handful of compiled programs instead of one per size, and the results
-    come back as host numpy in one readback."""
-    items = _as_device(item_features)
-    q = jnp.asarray(query_vecs)
-    b = q.shape[0]
+    come back as host numpy in one readback.
+
+    Placement: host-numpy queries go through latency-aware serving
+    placement (parallel/placement.py) — the call runs on the CPU backend
+    when the score matmul is too small to out-pay the accelerator's
+    measured link RTT. Device-resident queries (e.g. a tower forward that
+    already ran on the accelerator) keep their device."""
+    n_items = int(np.shape(item_features)[0])
+    rank = int(np.shape(item_features)[1])
+    b = int(np.shape(query_vecs)[0])
+    host_q = isinstance(query_vecs, np.ndarray)
+    if host_q:
+        from predictionio_tpu.parallel.placement import serving_device
+
+        place = serving_device(2.0 * _pow2(b) * n_items * rank)
+    else:
+        place = None
+    items = _as_device(item_features, device=place)
     k = min(k, items.shape[0])
     if k <= 0:  # e.g. query num=0 — an empty result, not one item
         return (
@@ -992,17 +986,42 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
         )
     bp = _pow2(b)
     kp = min(_pow2(k), items.shape[0])
-    if bp != b:
-        q = jnp.concatenate(
-            [q, jnp.zeros((bp - b,) + q.shape[1:], q.dtype)]
+    if bp != b and host_q:
+        # pad host-side so q ships to the serving device in one put
+        query_vecs = np.concatenate(
+            [query_vecs,
+             np.zeros((bp - b,) + query_vecs.shape[1:], query_vecs.dtype)]
         )
-        if exclude_mask is not None and np.shape(exclude_mask)[0] == b:
-            # [1, n_items] broadcast masks need no padding; per-row masks
-            # pad on device (no host round trip of the full mask)
-            em = jnp.asarray(exclude_mask)
-            exclude_mask = jnp.concatenate(
-                [em, jnp.zeros((bp - b,) + em.shape[1:], em.dtype)]
+    if place is not None:
+        q = jax.device_put(query_vecs, place)
+        if exclude_mask is not None and not isinstance(exclude_mask, np.ndarray):
+            # a device-resident mask must follow the serving device so one
+            # call never mixes committed devices
+            exclude_mask = jax.device_put(exclude_mask, place)
+    else:
+        q = jnp.asarray(query_vecs)
+    if bp != b:
+        if not host_q:
+            q = jnp.concatenate(
+                [q, jnp.zeros((bp - b,) + q.shape[1:], q.dtype)]
             )
+        if exclude_mask is not None and np.shape(exclude_mask)[0] == b:
+            # [1, n_items] broadcast masks need no padding. Per-row host
+            # masks pad host-side (keeps them placement-neutral: the jit
+            # call ships them to whichever device the query committed to);
+            # device-resident masks (already moved to the serving device
+            # above) pad on device — no host round trip.
+            if isinstance(exclude_mask, np.ndarray):
+                exclude_mask = np.concatenate(
+                    [exclude_mask,
+                     np.zeros((bp - b,) + exclude_mask.shape[1:],
+                              exclude_mask.dtype)]
+                )
+            else:
+                em = jnp.asarray(exclude_mask)
+                exclude_mask = jnp.concatenate(
+                    [em, jnp.zeros((bp - b,) + em.shape[1:], em.dtype)]
+                )
     if items.shape[0] > CHUNKED_TOPK_THRESHOLD:
         from predictionio_tpu.ops.topk import chunked_topk_scores
 
@@ -1027,11 +1046,19 @@ def top_k_cosine(query_vecs, item_features, k: int, exclude_mask=None):
     """Item-to-item cosine similarity (similarproduct template's scoring,
     ref: examples/scala-parallel-similarproduct/.../ALSAlgorithm.scala).
     Normalizing both sides reduces cosine to inner product, so large
-    catalogs share the chunked MIPS dispatch of :func:`top_k_scores`; the
-    normalized catalog is device-cached alongside the raw one."""
-    return top_k_scores(
-        _l2_normalize(jnp.asarray(query_vecs)),
-        _as_device(item_features, tag="l2", transform=_l2_normalize),
-        k,
-        exclude_mask,
-    )
+    catalogs share the chunked MIPS dispatch of :func:`top_k_scores`
+    (including its latency-aware placement: host queries normalize
+    host-side so they stay numpy through the placement decision)."""
+    def _host_l2(a):
+        a = np.asarray(a, np.float32)
+        return a / (np.linalg.norm(a, axis=-1, keepdims=True) + 1e-9)
+
+    if isinstance(query_vecs, np.ndarray):
+        q = _host_l2(query_vecs)
+    else:
+        q = _l2_normalize(query_vecs)
+    if isinstance(item_features, np.ndarray):
+        items = host_cache_transform(item_features, "l2", _host_l2)
+    else:
+        items = _as_device(item_features, tag="l2", transform=_l2_normalize)
+    return top_k_scores(q, items, k, exclude_mask)

@@ -78,8 +78,22 @@ def _nb_scores(pi, theta, x):
 
 
 def predict_naive_bayes(model: NaiveBayesModel, features: np.ndarray):
-    """Batched predict: returns (labels, log joint scores [B, C])."""
+    """Batched predict: returns (labels, log joint scores [B, C]).
+
+    The score program is a few-KFLOP matmul, so latency-aware placement
+    (parallel/placement.py) runs it on the host CPU backend whenever the
+    accelerator's link RTT dominates; model arrays are device-cached."""
+    from predictionio_tpu.parallel.placement import (
+        device_cache_put,
+        serving_device,
+    )
+
     x = np.atleast_2d(np.asarray(features, dtype=np.float32))
-    scores = np.asarray(_nb_scores(model.pi, model.theta, x))
+    place = serving_device(2.0 * x.shape[0] * model.theta.size)
+    pi = device_cache_put(model.pi, device=place)
+    theta = device_cache_put(model.theta, device=place)
+    if place is not None:
+        x = jax.device_put(x, place)
+    scores = np.asarray(_nb_scores(pi, theta, x))
     idx = scores.argmax(axis=1)
     return [model.labels[i] for i in idx], scores

@@ -296,10 +296,40 @@ def predict_top_k(params, seqs, k: int, p: SASRecParams, exclude_mask=None,
     item embedding table. ``exclude_mask`` [B, n_items+1] True → drop
     (padding id and seen items). The ring-attention path runs the forward
     eagerly (it places sequence shards itself); mha/flash go through one
-    jitted program."""
+    jitted program.
+
+    Host-numpy parameter pytrees (the post-checkpoint serving state) are
+    device-cached per leaf and placed by the latency-aware serving policy
+    (parallel/placement.py): the forward+score FLOPs of one query batch
+    are small enough that a high-RTT accelerator link loses to the host
+    CPU backend, while a co-located chip keeps the work."""
     if _resolve_attn(p, serving=True, l=seqs.shape[1]) == "ring":
         h = forward(params, seqs, p, mesh=mesh)
         return _score_last(params["item_emb"], h[:, -1], k, exclude_mask)
+    leaves = jax.tree.leaves(params)
+    if leaves and isinstance(leaves[0], np.ndarray):
+        from predictionio_tpu.parallel.placement import (
+            device_cache_put,
+            serving_device,
+        )
+
+        b, l = np.shape(seqs)
+        d = p.embed_dim
+        n_rows = int(np.shape(params["item_emb"])[0])
+        # attention/FFN stack + final catalog score, per padded batch
+        fwd = 2.0 * b * l * d * (4 * d + 2 * p.ffn_dim) * p.num_blocks
+        fwd += 2.0 * b * l * l * d * p.num_blocks  # attention scores
+        place = serving_device(fwd + 2.0 * b * n_rows * d)
+        params = jax.tree.map(
+            lambda a: device_cache_put(a, device=place), params
+        )
+        if place is not None:
+            seqs = jax.device_put(np.asarray(seqs), place)
+            if exclude_mask is not None and not isinstance(
+                exclude_mask, np.ndarray
+            ):
+                # a device-resident mask must follow the serving device
+                exclude_mask = jax.device_put(exclude_mask, place)
     return _predict_top_k_jit(params, seqs, k, p, exclude_mask)
 
 

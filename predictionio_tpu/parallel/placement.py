@@ -1,0 +1,203 @@
+"""Latency-aware serving placement: host XLA vs. accelerator per call size.
+
+Serving differs from training in one structural way: every query *must*
+read its (tiny) result back to the host before the HTTP response can be
+written, so per-query latency is bounded below by one blocking
+device→host round trip. On a co-located chip that link RTT is tens of
+microseconds; on a remote/tunneled accelerator it is tens of
+milliseconds — paid even for a 10-element top-k result. The reference
+never faces the trade-off because its serving is local JVM math
+(ref: core/.../workflow/CreateServer.scala:513-520).
+
+The TPU-first answer is to keep serving a single XLA program but place it
+where the *measured* numbers say it runs fastest end to end:
+
+    host_time(flops)  = flops / measured_host_matmul_rate
+    accel_time(flops) ≈ link_rtt + flops / accel_peak   (compute ≈ free)
+
+so the accelerator is chosen exactly when its FLOP advantage out-pays the
+link round trip. Both inputs are measured once per process, not assumed:
+``link_rtt()`` times blocking readbacks of fresh scalar results, and
+``host_flops_rate()`` times a small f32 matmul on the CPU backend. With a
+co-located TPU (sub-millisecond RTT) any real catalog scores on the TPU;
+behind a high-latency tunnel, small-catalog models serve from the host
+CPU backend — the identical jitted program, compiled by XLA:CPU. (The
+query server kicks a deploy-time background thread that runs both
+measurements, so the first user query doesn't pay them inline.)
+
+``PIO_SERVING_DEVICE`` overrides: ``auto`` (default), ``default`` (always
+the default JAX backend), ``cpu`` (always host).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "link_rtt",
+    "host_flops_rate",
+    "serving_device",
+    "device_cache_put",
+    "host_cache_transform",
+    "reset_measurements",
+]
+
+
+# ---------------------------------------------------------------------------
+# Identity-keyed caches for immutable-after-training host arrays
+# ---------------------------------------------------------------------------
+
+#: (id(host array), tag, device) → (weakref to host array, cached value).
+#: Serving passes the SAME model arrays on every request; without this
+#: cache each query would re-ship them over the host link (~RTT-sized
+#: latency per call through a tunneled TPU) or redo host transforms.
+#: Entries die with their host array; cached values are treated as
+#: immutable-after-training (model state is replaced wholesale on reload).
+_IDENTITY_CACHE: dict = {}
+
+
+def _identity_cached(arr: np.ndarray, key: tuple, build):
+    hit = _IDENTITY_CACHE.get(key)
+    if hit is not None and hit[0]() is arr:
+        return hit[1]
+    val = build()
+    ref = weakref.ref(arr, lambda _r, key=key: _IDENTITY_CACHE.pop(key, None))
+    _IDENTITY_CACHE[key] = (ref, val)
+    return val
+
+
+def device_cache_put(arr, tag: str = "", transform=None, device=None):
+    """Device-resident (optionally transformed) copy of ``arr``, cached by
+    array identity. ``device`` pins the copy (serving placement); None =
+    default backend. jax arrays already on ``device`` pass through; ones
+    committed elsewhere are moved — and cached, so a catalog living on the
+    accelerator is shipped to the serving device once, not per query —
+    keeping every serving call on a single device."""
+    if not isinstance(arr, np.ndarray):
+        if device is None:
+            dev = jnp.asarray(arr)
+            return transform(dev) if transform is not None else dev
+        if getattr(arr, "devices", None) and arr.devices() == {device}:
+            return transform(arr) if transform is not None else arr
+
+        def build_jax():
+            dev = jax.device_put(arr, device)
+            return transform(dev) if transform is not None else dev
+
+        return _identity_cached(arr, (id(arr), tag, device), build_jax)
+
+    def build():
+        dev = (
+            jax.device_put(arr, device) if device is not None else jnp.asarray(arr)
+        )
+        return transform(dev) if transform is not None else dev
+
+    return _identity_cached(arr, (id(arr), tag, device), build)
+
+
+def host_cache_transform(arr: np.ndarray, tag: str, transform):
+    """Cached host-side transform of a host array (e.g. L2-normalizing a
+    catalog once), keyed by array identity like :func:`device_cache_put`."""
+    return _identity_cached(arr, (id(arr), tag, "host"), lambda: transform(arr))
+
+
+# ---------------------------------------------------------------------------
+# Measured placement inputs
+# ---------------------------------------------------------------------------
+
+_measurements: dict = {}
+_measure_lock = threading.Lock()
+
+
+def _measured(key: str, fn):
+    """Measure-once with double-checked locking: concurrent first callers
+    must not run the timing benchmarks simultaneously (contended runs
+    would cache permanently skewed numbers)."""
+    val = _measurements.get(key)
+    if val is None:
+        with _measure_lock:
+            val = _measurements.get(key)
+            if val is None:
+                val = fn()
+                _measurements[key] = val
+    return val
+
+
+def reset_measurements() -> None:
+    """Drop cached RTT/throughput measurements (tests, backend changes)."""
+    _measurements.clear()
+
+
+def _measure_link_rtt() -> float:
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return 0.0
+    # each sample reads a *fresh* device scalar (jax caches the host copy
+    # after the first read, so reusing one array would measure a no-op)
+    xs = [jax.device_put(np.float32(i), dev) for i in range(5)]
+    jax.block_until_ready(xs)
+    samples = []
+    for x in xs:
+        t0 = time.perf_counter()
+        float(x)
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def link_rtt() -> float:
+    """Median blocking readback RTT (seconds) of the default backend."""
+    return _measured("link_rtt", _measure_link_rtt)
+
+
+def _measure_host_flops_rate() -> float:
+    cpu = _cpu_device()
+    if cpu is None:
+        return 1e9  # no CPU backend registered; value never used
+    a = jax.device_put(np.ones((256, 64), np.float32), cpu)
+    b = jax.device_put(np.ones((64, 8192), np.float32), cpu)
+    mm = jax.jit(jnp.matmul)
+    jax.block_until_ready(mm(a, b))  # compile
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = mm(a, b)
+    jax.block_until_ready(r)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return reps * 2.0 * 256 * 64 * 8192 / dt
+
+
+def host_flops_rate() -> float:
+    """Measured f32 matmul throughput (FLOP/s) of the CPU backend."""
+    return _measured("host_flops", _measure_host_flops_rate)
+
+
+def _cpu_device():
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
+
+
+def serving_device(flops: float):
+    """Device to run a serving call of ``flops`` on, or None for the
+    default backend. Decision per the module docstring's cost model."""
+    mode = os.environ.get("PIO_SERVING_DEVICE", "auto")
+    if mode == "default":
+        return None
+    cpu = _cpu_device()
+    if cpu is None:
+        return None
+    if mode == "cpu":
+        return cpu
+    if jax.default_backend() == "cpu":
+        return None
+    if flops / host_flops_rate() > link_rtt():
+        return None  # accelerator FLOPs out-pay the link round trip
+    return cpu

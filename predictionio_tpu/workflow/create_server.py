@@ -127,6 +127,27 @@ class QueryService:
                 self._predict_batch, max_batch=config.max_batch
             )
         self.router = self._build_router()
+        self._start_placement_measurement()
+
+    @staticmethod
+    def _start_placement_measurement() -> None:
+        """Measure the serving-placement inputs (accelerator link RTT,
+        host matmul rate — parallel/placement.py) on a deploy-time
+        background thread so the first user query doesn't pay the ~6
+        blocking device round trips + CPU benchmark inline."""
+
+        def measure():
+            try:
+                from predictionio_tpu.parallel import placement
+
+                placement.link_rtt()
+                placement.host_flops_rate()
+            except Exception:  # measurement must never sink a deploy
+                logger.debug("placement measurement failed", exc_info=True)
+
+        threading.Thread(
+            target=measure, name="placement-measure", daemon=True
+        ).start()
 
     @staticmethod
     def _overrides_batch_predict(algo) -> bool:

@@ -1,0 +1,181 @@
+"""Latency-aware serving placement (parallel/placement.py).
+
+Tests run on the CPU backend (conftest), where the default-backend path
+and the placed path are both XLA:CPU — so parity checks exercise the
+placement plumbing (committed devices, caching, padding) rather than a
+real accelerator link. The decision function itself is tested against
+both env overrides and the measured-cost model.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from predictionio_tpu.models.als import top_k_cosine, top_k_scores
+from predictionio_tpu.parallel import placement
+
+
+@pytest.fixture(autouse=True)
+def _reset_decision_caches():
+    placement.reset_measurements()
+    yield
+    placement.reset_measurements()
+
+
+def test_serving_device_default_backend_cpu_is_noop(monkeypatch):
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    assert placement.serving_device(1.0) is None
+    assert placement.serving_device(1e15) is None
+
+
+def test_serving_device_env_overrides(monkeypatch):
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "default")
+    assert placement.serving_device(1.0) is None
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    dev = placement.serving_device(1e15)
+    assert dev is not None and dev.platform == "cpu"
+
+
+def test_cost_model_crossover(monkeypatch):
+    """With a (mocked) high-RTT link, small calls go to the host and big
+    calls stay on the accelerator."""
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    monkeypatch.setattr(placement.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(placement, "link_rtt", lambda: 0.1)
+    monkeypatch.setattr(placement, "host_flops_rate", lambda: 1e10)
+    # 1e8 FLOP / 1e10 FLOP/s = 10 ms host < 100 ms RTT → host
+    assert placement.serving_device(1e8) is not None
+    # 1e10 FLOP = 1 s host > 100 ms RTT → accelerator (None = default)
+    assert placement.serving_device(1e10) is None
+
+
+def test_link_rtt_zero_on_cpu_backend():
+    assert placement.link_rtt() == 0.0
+
+
+def test_host_flops_rate_positive():
+    assert placement.host_flops_rate() > 1e8  # any real host beats 0.1 GF/s
+
+
+def test_device_cache_put_caches_per_device():
+    arr = np.ones((4, 3), np.float32)
+    a = placement.device_cache_put(arr)
+    b = placement.device_cache_put(arr)
+    assert a is b
+    cpu = jax.devices("cpu")[0]
+    c = placement.device_cache_put(arr, device=cpu)
+    d = placement.device_cache_put(arr, device=cpu)
+    assert c is d
+    np.testing.assert_array_equal(np.asarray(c), arr)
+
+
+def test_device_cache_put_caches_moved_jax_arrays():
+    """A device-resident array moved to the serving device ships once,
+    not per call; one already there passes through untouched."""
+    cpu0, cpu1 = jax.devices()[:2]
+    x = jax.device_put(np.ones((4, 3), np.float32), cpu1)
+    a = placement.device_cache_put(x, device=cpu0)
+    b = placement.device_cache_put(x, device=cpu0)
+    assert a is b
+    assert a.devices() == {cpu0}
+    c = placement.device_cache_put(a, device=cpu0)
+    assert c is a
+
+
+def test_top_k_scores_parity_forced_cpu(monkeypatch):
+    """Forced-host serving returns bitwise-identical results to the
+    default path (same XLA program on the same backend here)."""
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    items = rng.normal(size=(50, 8)).astype(np.float32)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "default")
+    s0, i0 = top_k_scores(q, items, 7)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    s1, i1 = top_k_scores(q, items, 7)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_allclose(s0, s1, rtol=1e-6)
+
+
+def test_top_k_scores_forced_cpu_with_padding_and_mask(monkeypatch):
+    """Odd batch size (pow2 padding path) + per-row mask on the host path."""
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    rng = np.random.default_rng(1)
+    q = rng.normal(size=(3, 4)).astype(np.float32)
+    items = rng.normal(size=(20, 4)).astype(np.float32)
+    mask = np.zeros((3, 20), bool)
+    mask[:, :10] = True  # only items 10.. are allowed
+    scores, idx = top_k_scores(q, items, 5, exclude_mask=mask)
+    assert idx.shape == (3, 5)
+    assert (idx >= 10).all()
+    assert np.isfinite(scores).all()
+
+
+def test_top_k_scores_device_resident_operands_follow_placement(monkeypatch):
+    """A catalog or mask committed to another device must be moved to the
+    serving device, not crash the jit call with mixed committed devices.
+    (Simulated with two virtual CPU devices: placement picks cpu:0, the
+    operands start committed to cpu:1.)"""
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    other = jax.devices()[1]
+    rng = np.random.default_rng(3)
+    q = rng.normal(size=(2, 4)).astype(np.float32)
+    items_host = rng.normal(size=(16, 4)).astype(np.float32)
+    items_dev = jax.device_put(items_host, other)
+    mask = jax.device_put(np.zeros((2, 16), bool), other)
+    scores, idx = top_k_scores(q, items_dev, 3, exclude_mask=mask)
+    assert idx.shape == (2, 3)
+    s2, i2 = top_k_cosine(q, items_dev, 3)
+    assert i2.shape == (2, 3)
+
+
+def test_top_k_cosine_parity_forced_cpu(monkeypatch):
+    rng = np.random.default_rng(2)
+    q = rng.normal(size=(2, 6)).astype(np.float32)
+    items = rng.normal(size=(30, 6)).astype(np.float32)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "default")
+    s0, i0 = top_k_cosine(q, items, 4)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    s1, i1 = top_k_cosine(q, items, 4)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_allclose(s0, s1, rtol=1e-5)
+
+
+def test_sasrec_predict_forced_cpu(monkeypatch):
+    """SASRec's placed predict matches the default path."""
+    from predictionio_tpu.models.sasrec import (
+        SASRecParams,
+        init_params,
+        predict_top_k,
+    )
+
+    p = SASRecParams(max_len=8, embed_dim=8, num_blocks=1, num_heads=1,
+                     ffn_dim=16, attn_impl="mha")
+    params = jax.tree.map(np.asarray, init_params(20, p))
+    seqs = np.array([[0, 0, 0, 0, 1, 5, 9, 3]], np.int32)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "default")
+    s0, i0 = predict_top_k(params, seqs, 5, p)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    s1, i1 = predict_top_k(params, seqs, 5, p)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), rtol=1e-5)
+
+
+def test_naive_bayes_predict_forced_cpu(monkeypatch):
+    from predictionio_tpu.models.naive_bayes import (
+        NaiveBayesModel,
+        predict_naive_bayes,
+    )
+
+    model = NaiveBayesModel(
+        pi=np.log(np.array([0.5, 0.5], np.float32)),
+        theta=np.log(np.array([[0.2, 0.8], [0.7, 0.3]], np.float32)),
+        labels=[0.0, 1.0],
+    )
+    x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "default")
+    l0, s0 = predict_naive_bayes(model, x)
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    l1, s1 = predict_naive_bayes(model, x)
+    assert l0 == l1
+    np.testing.assert_allclose(s0, s1, rtol=1e-6)
