@@ -183,8 +183,9 @@ def bench_query_latency(
             picked_host = serving_device(2.0 * 1 * n_items * rank) is not None
             out["serve_placement"] = "host" if picked_host else "default"
             bmax = out.get("serve_max_batch_seen", threads)
+            bp = 1 << max(bmax - 1, 0).bit_length()  # pow2 pad, as served
             conc_host = (
-                serving_device(2.0 * bmax * n_items * rank) is not None
+                serving_device(2.0 * bp * n_items * rank) is not None
             )
             out["serve_conc_placement"] = "host" if conc_host else "default"
             if picked_host:

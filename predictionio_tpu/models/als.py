@@ -44,6 +44,7 @@ from predictionio_tpu.parallel.mesh import ComputeContext
 from predictionio_tpu.parallel.placement import (
     device_cache_put as _as_device,
     host_cache_transform,
+    serving_device,
 )
 
 logger = logging.getLogger(__name__)
@@ -790,16 +791,6 @@ class ALS:
                 user_idx, item_idx, ratings, n_users, n_items, callback
             )
 
-        u_counts, u_starts = _histogram(user_idx, n_users)
-        i_counts, i_starts = _histogram(item_idx, n_items)
-        u_specs = _bucketize(ctx, u_counts, u_starts, p)
-        i_specs = _bucketize(ctx, i_counts, i_starts, p)
-        logger.info(
-            "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
-            ratings.size, n_users, len(u_specs), n_items,
-            len(i_specs), p.rank,
-        )
-
         multi = ctx.mesh.devices.size > 1
         key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
         ku, ki = jax.random.split(key)
@@ -814,27 +805,39 @@ class ALS:
         # narrowest lossless dtypes (uint16 ids when they fit, int8
         # integer ratings) + tiny per-bucket CSR pointers (sharded over
         # `data`). Dense tiles are built on device, so nothing [n, k]-sized
-        # ever crosses the host link.
+        # ever crosses the host link. The two sides run on parallel
+        # threads — the C sort drops the GIL — and each side starts its
+        # (async) host→device transfer as soon as its arrays exist, so one
+        # side's upload overlaps the other side's sort.
         shard = ctx.batch_sharding() if multi else None
-
         repl = ctx.replicated if multi else None
-        u_ids, u_vals = _sorted_side(user_idx, u_starts, item_idx, ratings)
-        i_ids, i_vals = _sorted_side(item_idx, i_starts, user_idx, ratings)
-        # integrality is permutation-invariant: decide the wire dtype once
-        if _val_fits_int8(ratings):
-            u_vals = u_vals.astype(np.int8)
-            i_vals = i_vals.astype(np.int8)
-        u_nbr = _put(_narrow_nbr(u_ids, n_items), repl)
-        u_val = _put(u_vals, repl)
-        i_nbr = _put(_narrow_nbr(i_ids, n_users), repl)
-        i_val = _put(i_vals, repl)
-        u_tiles = tuple(
-            tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
-            for s in u_specs
-        )
-        i_tiles = tuple(
-            tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
-            for s in i_specs
+        int8_vals = _val_fits_int8(ratings)
+
+        def prep_side(entity_idx, n_entities, neighbor_idx, n_other):
+            counts, starts = _histogram(entity_idx, n_entities)
+            specs = _bucketize(ctx, counts, starts, p)
+            ids, vals = _sorted_side(entity_idx, starts, neighbor_idx, ratings)
+            if int8_vals:  # integrality is permutation-invariant
+                vals = vals.astype(np.int8)
+            nbr = _put(_narrow_nbr(ids, n_other), repl)
+            val = _put(vals, repl)
+            tiles = tuple(
+                tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
+                for s in specs
+            )
+            return specs, nbr, val, tiles
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            fut_u = ex.submit(prep_side, user_idx, n_users, item_idx, n_items)
+            fut_i = ex.submit(prep_side, item_idx, n_items, user_idx, n_users)
+            u_specs, u_nbr, u_val, u_tiles = fut_u.result()
+            i_specs, i_nbr, i_val, i_tiles = fut_i.result()
+        logger.info(
+            "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
+            ratings.size, n_users, len(u_specs), n_items,
+            len(i_specs), p.rank,
         )
         meta = (
             tuple((s.width, s.nc) for s in u_specs),
@@ -973,8 +976,6 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     b = int(np.shape(query_vecs)[0])
     host_q = isinstance(query_vecs, np.ndarray)
     if host_q:
-        from predictionio_tpu.parallel.placement import serving_device
-
         place = serving_device(2.0 * _pow2(b) * n_items * rank)
     else:
         place = None
