@@ -1,0 +1,340 @@
+"""Stock backtesting: momentum predictions scored by a portfolio simulator.
+
+The analog of the reference's experimental stock workload
+(ref: examples/experimental/scala-stock/src/main/scala/
+{BackTestingMetrics,RegressionStrategy}.scala). Two pieces:
+
+* ``MomentumAlgorithm`` — predicts each ticker's next-day return as the
+  mean of its last ``window`` daily returns. All days × all tickers are
+  scored in ONE jitted pass over the price matrix at train time
+  (a [days, tickers] rolling-mean via cumulative sums — no Python loop),
+  so predict is a table lookup.
+* ``BacktestingEvaluator`` — a custom ``BaseEvaluator`` (the reference's
+  ``BacktestingEvaluator`` extends Evaluator the same way): replays the
+  per-day predictions as a trading strategy — enter positions whose
+  predicted return ≥ ``enter_threshold``, exit at ≤ ``exit_threshold``,
+  at most ``max_positions`` concurrent — and reports NAV, total return,
+  daily vol, and annualized Sharpe. The daily portfolio loop is a
+  ``lax.scan`` over the [days, tickers] decision matrix: positions are a
+  mask vector, cash/NAV a carry — the scan replaces the reference's
+  mutable ArrayBuffer walk (BackTestingMetrics.scala:100-170).
+
+Training data is ``data/prices.csv`` (``date_idx,ticker,price``). Run
+from this directory:
+
+    pio train
+    pio eval --evaluation engine:evaluation
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import Engine, IdentityPreparator, LServing
+from predictionio_tpu.core.base import BaseEvaluator, BaseEvaluatorResult
+from predictionio_tpu.core.dase import LAlgorithm, LDataSource
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.params import Params
+
+
+@dataclass(frozen=True)
+class StockData:
+    tickers: tuple  # (ticker, ...)
+    prices: tuple  # row-major [days][tickers] price tuples
+
+
+@dataclass(frozen=True)
+class Query:
+    day: int  # date index into the price frame
+
+
+@dataclass(frozen=True)
+class Prediction:
+    scores: tuple  # ((ticker, predicted next-day return), ...)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    path: str = ""  # defaults to data/prices.csv beside this file
+    eval_start: int = 20  # first day queried during evaluation
+
+
+def _load_prices(path_param: str) -> StockData:
+    path = (
+        Path(path_param)
+        if path_param
+        else Path(__file__).parent / "data" / "prices.csv"
+    )
+    by_day: dict[int, dict[str, float]] = {}
+    with open(path) as f:
+        for day, ticker, price in csv.reader(f):
+            by_day.setdefault(int(day), {})[ticker] = float(price)
+    tickers = tuple(sorted(by_day[0]))
+    prices = tuple(
+        tuple(by_day[d][t] for t in tickers) for d in sorted(by_day)
+    )
+    return StockData(tickers, prices)
+
+
+class DataSource(LDataSource):
+    def __init__(self, params: DataSourceParams | None = None):
+        self.params = params or DataSourceParams()
+
+    def read_training_local(self) -> StockData:
+        return _load_prices(self.params.path)
+
+    def read_eval_local(self):
+        """One fold: train on the whole frame, query every day from
+        ``eval_start`` on. actual=None — the evaluator recomputes realized
+        returns from the price frame itself (ref: BackTestingMetrics
+        reads rawData price frames, not per-query actuals); the frame is
+        the fold's eval_info."""
+        td = self.read_training_local()
+        n_days = len(td.prices)
+        qa = [
+            (Query(day=d), None)
+            for d in range(self.params.eval_start, n_days - 1)
+        ]
+        return [(td, td, qa)]
+
+
+@dataclass(frozen=True)
+class MomentumParams(Params):
+    window: int = 10
+
+
+@dataclass
+class MomentumModel:
+    tickers: tuple
+    scores: np.ndarray  # [days, tickers] predicted next-day returns
+
+
+@partial(jax.jit, static_argnames=("window",))
+def _momentum_scores(prices, window: int):
+    """[days, tickers] trailing-mean daily returns: day d's score is the
+    mean return over (d-window, d]. Rolling mean via cumsum difference —
+    one fused pass, no per-day loop."""
+    rets = prices[1:] / prices[:-1] - 1.0  # [days-1, t]
+    window = min(window, rets.shape[0])  # short frames: whole-history mean
+    csum = jnp.cumsum(rets, axis=0)
+    shifted = jnp.concatenate(
+        [jnp.zeros((window, rets.shape[1]), rets.dtype), csum[:-window]]
+    )
+    rolling = (csum - shifted) / window
+    # day 0 has no history; early days use the partial mean
+    partial_n = jnp.minimum(
+        jnp.arange(1, rets.shape[0] + 1), window
+    ).astype(rets.dtype)[:, None]
+    rolling = jnp.where(
+        jnp.arange(rets.shape[0])[:, None] < window,
+        csum / partial_n,
+        rolling,
+    )
+    # score for querying day d = trailing stats of returns up to day d
+    return jnp.concatenate([jnp.zeros((1, rets.shape[1])), rolling])
+
+
+class MomentumAlgorithm(LAlgorithm):
+    params_class = MomentumParams
+    query_class = Query
+
+    def __init__(self, params: MomentumParams | None = None):
+        self.params = params or MomentumParams()
+
+    def train_local(self, pd: StockData) -> MomentumModel:
+        prices = jnp.asarray(pd.prices, jnp.float32)
+        scores = np.asarray(_momentum_scores(prices, self.params.window))
+        return MomentumModel(pd.tickers, scores)
+
+    def predict(self, model: MomentumModel, query: Query) -> Prediction:
+        d = min(max(query.day, 0), len(model.scores) - 1)
+        return Prediction(
+            tuple(zip(model.tickers, model.scores[d].tolist()))
+        )
+
+
+class Serving(LServing):
+    def __init__(self, params=None):
+        pass
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+# ---------------------------------------------------------------------------
+# Backtesting evaluator (ref: BackTestingMetrics.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BacktestingParams(Params):
+    enter_threshold: float = 0.001
+    exit_threshold: float = -0.001
+    max_positions: int = 3
+
+
+@dataclass
+class BacktestingResult(BaseEvaluatorResult):
+    ret: float = 0.0  # total return over the test span
+    vol: float = 0.0  # daily return stdev
+    sharpe: float = 0.0  # annualized
+    days: int = 0
+    nav: tuple = ()  # daily NAV curve
+
+    def to_one_liner(self) -> str:
+        return (
+            f"ret={self.ret:.4f} vol={self.vol:.4f} "
+            f"sharpe={self.sharpe:.2f} days={self.days}"
+        )
+
+    def to_json(self):
+        return {
+            "ret": self.ret,
+            "vol": self.vol,
+            "sharpe": self.sharpe,
+            "days": self.days,
+        }
+
+    def to_html(self) -> str:
+        rows = "".join(
+            f"<tr><td>{d}</td><td>{v:.4f}</td></tr>"
+            for d, v in enumerate(self.nav)
+        )
+        return (
+            "<html><body><h1>Backtest</h1>"
+            f"<p>{self.to_one_liner()}</p>"
+            f"<table><tr><th>day</th><th>NAV</th></tr>{rows}</table>"
+            "</body></html>"
+        )
+
+
+@partial(jax.jit, static_argnames=("max_positions",))
+def _simulate(enter, exit_, scores, rets, max_positions: int):
+    """Daily portfolio walk as a lax.scan.
+
+    enter/exit_: [days, t] decision matrices for each queried day;
+    scores: [days, t] predicted returns (entry priority); rets: [days, t]
+    NEXT-day realized returns. Carry = current position mask [t].
+    Free slots fill best-predicted-score first (the reference sorts its
+    candidate list by pValue descending, BackTestingMetrics.scala:88-92).
+    Equal-weight NAV: each day's portfolio return is the mean next-day
+    return of held positions (ref holds equal dollar positions,
+    BackTestingMetrics.scala:120-150)."""
+    t = rets.shape[1]
+
+    def step(positions, inp):
+        en, ex, sc, ret = inp
+        positions = jnp.where(ex > 0, 0.0, positions)
+        free = max_positions - positions.sum()
+        eligible = (en > 0) & (positions == 0.0)
+        # rank eligible candidates by predicted score desc (ties by index):
+        # rank_i = 1 + #{eligible j : score_j > score_i, or equal & j < i}
+        s = jnp.where(eligible, sc, -jnp.inf)
+        idx = jnp.arange(t)
+        better = (s[None, :] > s[:, None]) | (
+            (s[None, :] == s[:, None]) & (idx[None, :] < idx[:, None])
+        )
+        rank = 1 + (better & eligible[None, :]).sum(axis=1)
+        add = jnp.where(eligible & (rank <= free), 1.0, 0.0)
+        positions = jnp.clip(positions + add, 0.0, 1.0)
+        held = positions.sum()
+        day_ret = jnp.where(
+            held > 0, (positions * ret).sum() / jnp.maximum(held, 1.0), 0.0
+        )
+        return positions, day_ret
+
+    _, daily = jax.lax.scan(
+        step, jnp.zeros(t), (enter, exit_, scores, rets)
+    )
+    return daily
+
+
+class BacktestingEvaluator(BaseEvaluator):
+    def __init__(self, params: BacktestingParams | None = None):
+        self.params = params or BacktestingParams()
+
+    def evaluate(self, ctx, evaluation, engine_eval_data_set, params=None):
+        p = self.params
+        best: BacktestingResult | None = None
+        for _engine_params, eval_data_set in engine_eval_data_set:
+            for ei, qpas in eval_data_set:  # ei is the StockData fold info
+                prices = np.asarray(ei.prices, np.float32)
+                rets_all = prices[1:] / prices[:-1] - 1.0
+                days = [q.day for q, _pr, _a in qpas]
+                scores = np.stack(
+                    [
+                        np.array([s for _t, s in pr.scores], np.float32)
+                        for _q, pr, _a in qpas
+                    ]
+                )
+                enter = scores >= p.enter_threshold
+                exit_ = scores <= p.exit_threshold
+                rets = rets_all[days]  # day d row = return d -> d+1
+                daily = np.asarray(
+                    _simulate(
+                        jnp.asarray(enter, jnp.float32),
+                        jnp.asarray(exit_, jnp.float32),
+                        jnp.asarray(scores),
+                        jnp.asarray(rets),
+                        p.max_positions,
+                    )
+                )
+                nav = np.cumprod(1.0 + daily)
+                vol = float(daily.std())
+                sharpe = float(
+                    daily.mean() / vol * np.sqrt(252) if vol > 0 else 0.0
+                )
+                result = BacktestingResult(
+                    ret=float(nav[-1] - 1.0),
+                    vol=vol,
+                    sharpe=sharpe,
+                    days=len(daily),
+                    nav=tuple(float(x) for x in nav),
+                )
+                if best is None or result.ret > best.ret:
+                    best = result
+        return best or BacktestingResult()
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"momentum": MomentumAlgorithm},
+        serving_class=Serving,
+    )
+
+
+class BacktestingEvaluation(Evaluation):
+    """Evaluation binding the custom evaluator (the reference wires its
+    BacktestingEvaluator into Workflow.run the same way)."""
+
+    def __init__(self, engine, engine_params_list,
+                 backtesting_params: BacktestingParams | None = None):
+        super().__init__(engine=engine, engine_params_list=engine_params_list)
+        self.backtesting_params = backtesting_params or BacktestingParams()
+        self.output_path = None  # no best.json: not a metric sweep
+
+    @property
+    def evaluator(self):
+        return BacktestingEvaluator(self.backtesting_params)
+
+
+def evaluation() -> Evaluation:
+    """`pio eval engine:evaluation` entry point: a small momentum-window
+    sweep scored by the backtest (best total return wins)."""
+    eng = engine_factory()
+    candidates = [
+        eng.engine_params_from_json(
+            {"algorithms": [{"name": "momentum", "params": {"window": w}}]}
+        )
+        for w in (5, 10, 20)
+    ]
+    return BacktestingEvaluation(eng, candidates)

@@ -805,10 +805,11 @@ class ALS:
         # narrowest lossless dtypes (uint16 ids when they fit, int8
         # integer ratings) + tiny per-bucket CSR pointers (sharded over
         # `data`). Dense tiles are built on device, so nothing [n, k]-sized
-        # ever crosses the host link. The two sides run on parallel
-        # threads — the C sort drops the GIL — and each side starts its
-        # (async) host→device transfer as soon as its arrays exist, so one
-        # side's upload overlaps the other side's sort.
+        # ever crosses the host link. The two sides' host prep runs on
+        # parallel threads; the transfers are issued afterwards on THIS
+        # thread in a fixed order — in a multi-process SPMD run every
+        # process must issue sharded puts in the same order, so they must
+        # never race (async dispatch still overlaps them with each other).
         shard = ctx.batch_sharding() if multi else None
         repl = ctx.replicated if multi else None
         int8_vals = _val_fits_int8(ratings)
@@ -819,21 +820,27 @@ class ALS:
             ids, vals = _sorted_side(entity_idx, starts, neighbor_idx, ratings)
             if int8_vals:  # integrality is permutation-invariant
                 vals = vals.astype(np.int8)
-            nbr = _put(_narrow_nbr(ids, n_other), repl)
-            val = _put(vals, repl)
-            tiles = tuple(
-                tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
-                for s in specs
-            )
-            return specs, nbr, val, tiles
+            return specs, _narrow_nbr(ids, n_other), vals
 
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=2) as ex:
             fut_u = ex.submit(prep_side, user_idx, n_users, item_idx, n_items)
             fut_i = ex.submit(prep_side, item_idx, n_items, user_idx, n_users)
-            u_specs, u_nbr, u_val, u_tiles = fut_u.result()
-            i_specs, i_nbr, i_val, i_tiles = fut_i.result()
+            u_specs, u_ids, u_vals = fut_u.result()
+            i_specs, i_ids, i_vals = fut_i.result()
+        u_nbr = _put(u_ids, repl)
+        u_val = _put(u_vals, repl)
+        i_nbr = _put(i_ids, repl)
+        i_val = _put(i_vals, repl)
+        u_tiles = tuple(
+            tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
+            for s in u_specs
+        )
+        i_tiles = tuple(
+            tuple(_put(x, shard) for x in (s.rows, s.starts, s.counts))
+            for s in i_specs
+        )
         logger.info(
             "ALS: %d ratings, %d users (%d buckets), %d items (%d buckets), rank %d",
             ratings.size, n_users, len(u_specs), n_items,

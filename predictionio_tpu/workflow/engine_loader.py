@@ -18,11 +18,28 @@ from predictionio_tpu.core.persistent_model import resolve_class
 
 def load_engine_factory(name: str, engine_dir: str | Path | None = None):
     """Resolve an engine factory by name, optionally rooting imports at the
-    engine directory (the reference's jar-on-classpath analog)."""
+    engine directory (the reference's jar-on-classpath analog).
+
+    Every scaffolded engine ships a module named ``engine``, so a module
+    of that name cached from a *different* engine directory must not
+    shadow this one: if the cached module's file is not the one inside
+    ``engine_dir``, it is evicted and re-imported from here (the moral
+    equivalent of swapping the engine jar on the classpath)."""
     if engine_dir is not None:
         engine_dir = str(Path(engine_dir).resolve())
-        if engine_dir not in sys.path:
-            sys.path.insert(0, engine_dir)
+        # move (not just add) to the FRONT: a previously-loaded engine dir
+        # sitting earlier in sys.path would otherwise win the re-import
+        # after the eviction below
+        if engine_dir in sys.path:
+            sys.path.remove(engine_dir)
+        sys.path.insert(0, engine_dir)
+        mod_name = name.split(":", 1)[0] if ":" in name else name.rsplit(".", 1)[0]
+        target = Path(engine_dir) / (mod_name.replace(".", "/") + ".py")
+        existing = sys.modules.get(mod_name)
+        if existing is not None and target.exists():
+            current = getattr(existing, "__file__", "") or ""
+            if current and Path(current).resolve() != target.resolve():
+                del sys.modules[mod_name]
     factory = resolve_class(name)
     return factory
 

@@ -1,7 +1,10 @@
 """The hand-written example engines stay working (ref:
-examples/experimental/scala-local-helloworld)."""
+examples/experimental/ — scala-local-helloworld,
+scala-parallel-friend-recommendation, scala-stock)."""
 
 from pathlib import Path
+
+import numpy as np
 
 EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
 
@@ -36,3 +39,140 @@ def test_helloworld_engine_trains_and_predicts(memory_storage):
     result = algo.predict(models[0], algo.query_class(day="Mon"))
     assert abs(result.temperature - 76.0) < 1e-9  # (75.5 + 76.5) / 2
     assert algo.predict(models[0], algo.query_class(day="Nope")).temperature == 0.0
+
+
+def test_friend_recommendation_simrank(memory_storage):
+    """SimRank engine: fixpoint properties + community structure + full
+    train workflow (ref: examples/experimental/
+    scala-parallel-friend-recommendation/DeltaSimRankRDD.scala)."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    factory = "engine:engine_factory"
+    engine = get_engine(factory, EXAMPLES / "friendrecommendation")
+    ep = engine.engine_params_from_json(
+        {"algorithms": [{"name": "simrank",
+                         "params": {"decay": 0.8, "iterations": 7}}]}
+    )
+    instance = new_engine_instance("friends", "1", "default", factory, ep)
+    assert run_train(engine, ep, instance, WorkflowParams())
+
+    algo = engine._algorithms(ep)[0]
+    ds = engine.data_source_class()
+    model = algo.train_local(ds.read_training_local())
+    s = model.scores
+    # SimRank invariants: diag 1, symmetric-ish bounds, scores in [0, 1]
+    np.testing.assert_allclose(np.diag(s), 1.0)
+    assert (s >= 0).all() and (s <= 1.0 + 1e-6).all()
+    # community structure: node 1's most similar users are in its own
+    # community (1-7); node 14's in 8-14
+    r = algo.predict(model, algo.query_class(user="1", num=3))
+    assert r.friend_scores, "node 1 should have similar users"
+    assert all(int(fs.user) <= 7 for fs in r.friend_scores)
+    r2 = algo.predict(model, algo.query_class(user="14", num=3))
+    assert all(int(fs.user) >= 8 for fs in r2.friend_scores)
+    # unknown user → empty result, not an error
+    assert algo.predict(model, algo.query_class(user="zz")).friend_scores == ()
+
+
+def test_stock_backtesting_evaluation(memory_storage):
+    """Momentum + backtesting evaluator end to end through the evaluation
+    workflow (ref: examples/experimental/scala-stock/BackTestingMetrics)."""
+    from predictionio_tpu.workflow.engine_loader import load_engine_factory
+    from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+    obj = load_engine_factory("engine:evaluation", EXAMPLES / "stock")
+    evaluation = obj()
+    instance_id, result = run_evaluation(evaluation, "engine:evaluation")
+    assert instance_id
+    assert result.days > 0
+    assert len(result.nav) == result.days
+    assert "sharpe=" in result.to_one_liner()
+    assert "<table>" in result.to_html()
+    # the evaluation instance records the one-liner
+    inst = memory_storage.get_meta_data_evaluation_instances().get(instance_id)
+    assert inst.status == "EVALCOMPLETED"
+    assert "ret=" in inst.evaluator_results
+
+
+def test_stock_momentum_scores_shape_and_signal():
+    import sys
+    sys.path.insert(0, str(EXAMPLES / "stock"))
+    try:
+        import importlib
+        eng = importlib.import_module("engine")
+        importlib.reload(eng)
+        td = eng.DataSource().read_training_local()
+        model = eng.MomentumAlgorithm(eng.MomentumParams(window=10)).train_local(td)
+        assert model.scores.shape == (len(td.prices), len(td.tickers))
+        # AMZN (drift +0.3%/day) should out-score NVDA (-0.2%/day) on average
+        ti = {t: i for i, t in enumerate(model.tickers)}
+        assert model.scores[30:, ti["AMZN"]].mean() > model.scores[30:, ti["NVDA"]].mean()
+    finally:
+        sys.path.remove(str(EXAMPLES / "stock"))
+
+
+def test_engine_loader_round_trip_between_engine_dirs():
+    """Loading engine:engine_factory from dir A, then B, then A again must
+    return A's engine — not B's cached module (sys.path priority)."""
+    from predictionio_tpu.workflow.engine_loader import load_engine_factory
+
+    fr = EXAMPLES / "friendrecommendation"
+    st = EXAMPLES / "stock"
+    f1 = load_engine_factory("engine:engine_factory", fr)
+    f2 = load_engine_factory("engine:engine_factory", st)
+    f3 = load_engine_factory("engine:engine_factory", fr)
+    assert "friendrecommendation" in f1.__module__ or "friendrecommendation" in (
+        __import__("sys").modules[f1.__module__].__file__
+    )
+    assert f1.__code__.co_filename != f2.__code__.co_filename
+    assert f3.__code__.co_filename == f1.__code__.co_filename
+
+
+def test_stock_simulate_fills_best_score_first():
+    import importlib
+    import sys
+
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(EXAMPLES / "stock"))
+    try:
+        eng = importlib.import_module("engine")
+        importlib.reload(eng)
+        # 3 tickers, 1 day, 1 free slot: ticker 2 has the best score and
+        # must be the one entered, despite ticker 0 coming first
+        enter = jnp.ones((1, 3), jnp.float32)
+        exit_ = jnp.zeros((1, 3), jnp.float32)
+        scores = jnp.asarray([[0.01, 0.02, 0.05]], jnp.float32)
+        rets = jnp.asarray([[1.0, 2.0, 4.0]], jnp.float32)
+        daily = eng._simulate(enter, exit_, scores, rets, 1)
+        assert float(daily[0]) == 4.0  # held only the best-scored ticker
+        # two slots: best two (tickers 2 and 1), equal weight
+        daily2 = eng._simulate(enter, exit_, scores, rets, 2)
+        assert abs(float(daily2[0]) - 3.0) < 1e-6
+    finally:
+        sys.path.remove(str(EXAMPLES / "stock"))
+
+
+def test_stock_momentum_short_frame_window_clamp():
+    import importlib
+    import sys
+
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(EXAMPLES / "stock"))
+    try:
+        eng = importlib.import_module("engine")
+        importlib.reload(eng)
+        prices = jnp.asarray(
+            np.linspace(100, 110, 8)[:, None].repeat(2, axis=1), jnp.float32
+        )
+        scores = eng._momentum_scores(prices, 20)  # window > days-1
+        assert scores.shape == (8, 2)
+        assert bool(jnp.isfinite(scores).all())
+    finally:
+        sys.path.remove(str(EXAMPLES / "stock"))
