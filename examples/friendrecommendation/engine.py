@@ -69,6 +69,8 @@ class DataSourceParams(Params):
 
 
 class DataSource(LDataSource):
+    params_class = DataSourceParams
+
     def __init__(self, params: DataSourceParams | None = None):
         self.params = params or DataSourceParams()
 

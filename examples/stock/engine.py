@@ -23,7 +23,7 @@ Training data is ``data/prices.csv`` (``date_idx,ticker,price``). Run
 from this directory:
 
     pio train
-    pio eval --evaluation engine:evaluation
+    pio eval engine:evaluation
 """
 
 from __future__ import annotations
@@ -84,6 +84,8 @@ def _load_prices(path_param: str) -> StockData:
 
 
 class DataSource(LDataSource):
+    params_class = DataSourceParams
+
     def __init__(self, params: DataSourceParams | None = None):
         self.params = params or DataSourceParams()
 

@@ -176,3 +176,39 @@ def test_stock_momentum_short_frame_window_clamp():
         assert bool(jnp.isfinite(scores).all())
     finally:
         sys.path.remove(str(EXAMPLES / "stock"))
+
+
+def test_regression_ols_recovers_coefficients(memory_storage):
+    """OLS engine recovers the generating coefficients and the eval sweep
+    picks a fold (ref: examples/experimental/scala-local-regression)."""
+    import importlib
+    import sys
+
+    from predictionio_tpu.workflow.engine_loader import load_engine_factory
+    from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+    sys.path.insert(0, str(EXAMPLES / "regression"))
+    try:
+        eng_mod = importlib.import_module("engine")
+        importlib.reload(eng_mod)
+        td = eng_mod.DataSource()._load()
+        model = eng_mod.OLSAlgorithm().train_local(td)
+        # data generated with beta=(2,-1.5,.5,3,0,1), intercept 0.7, noise .05
+        np.testing.assert_allclose(
+            model, [2.0, -1.5, 0.5, 3.0, 0.0, 1.0, 0.7], atol=0.05
+        )
+        pred = eng_mod.OLSAlgorithm().predict(
+            model, eng_mod.Query(features=(1, 1, 1, 1, 1, 1))
+        )
+        assert abs(pred.prediction - (2 - 1.5 + 0.5 + 3 + 0 + 1 + 0.7)) < 0.2
+    finally:
+        sys.path.remove(str(EXAMPLES / "regression"))
+
+    obj = load_engine_factory("engine:evaluation", EXAMPLES / "regression")
+    evaluation = obj()
+    evaluation.output_path = None  # don't write best.json into the repo
+    instance_id, result = run_evaluation(evaluation, "engine:evaluation")
+    assert instance_id
+    # MSE is negated (higher is better); with tiny noise all folds ~ -0.0025
+    assert -0.01 < result.best_score.score < 0
+    assert "Mean Square Error" in result.metric_header
