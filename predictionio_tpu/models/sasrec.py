@@ -29,6 +29,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 
@@ -39,6 +40,8 @@ import optax
 
 from predictionio_tpu.ops.attention import flash_attention, mha_attention
 from predictionio_tpu.parallel.mesh import ComputeContext
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -333,6 +336,14 @@ def predict_top_k(params, seqs, k: int, p: SASRecParams, exclude_mask=None,
     return _predict_top_k_jit(params, seqs, k, p, exclude_mask)
 
 
+def dataclass_replace_epochs(p: SASRecParams) -> SASRecParams:
+    """The fingerprint ignores num_epochs: extending an interrupted run
+    to more epochs is a legitimate resume."""
+    import dataclasses
+
+    return dataclasses.replace(p, num_epochs=0)
+
+
 class SASRec:
     """Training driver mirroring the ALS driver's shape."""
 
@@ -341,9 +352,15 @@ class SASRec:
         self.p = params
 
     def train(self, sequences: list[list[int]], n_items: int,
-              callback=None) -> dict:
+              callback=None, checkpointer=None) -> dict:
         """``sequences``: per-user item-id lists (ids 1..n_items, time
-        order). Returns the trained parameter pytree."""
+        order). Returns the trained parameter pytree.
+
+        ``checkpointer`` (utils.checkpoint.TrainCheckpointer) saves
+        (params, opt_state) per epoch and resumes from the newest
+        checkpoint — the per-epoch RNG derives from (seed, epoch), so a
+        resumed run follows the exact trajectory of an uninterrupted one
+        (asserted by tests/test_checkpoint_resume.py)."""
         p = self.p
         seqs, pos = _make_training_arrays(sequences, p.max_len)
         n = len(seqs)
@@ -352,12 +369,28 @@ class SASRec:
         params = init_params(n_items, p)
         opt_state = optax.adam(p.learning_rate).init(params)
         key = jax.random.PRNGKey(p.seed)
+        start_epoch = 0
+        fingerprint = ""
+        if checkpointer is not None:
+            from predictionio_tpu.utils.checkpoint import fingerprint_arrays
+
+            # bind checkpoints to this exact run: different data or
+            # shape-affecting hyperparameters must not resume (num_epochs
+            # excluded so an interrupted run can be extended)
+            fingerprint = fingerprint_arrays(
+                dataclass_replace_epochs(p), n_items, seqs, pos
+            )
+            hit = checkpointer.load_latest((params, opt_state), fingerprint)
+            if hit is not None:
+                last_epoch, (params, opt_state) = hit
+                start_epoch = last_epoch + 1
+                logger.info("SASRec: resuming after epoch %d", last_epoch)
         bs = min(p.batch_size, n)
         steps_per_epoch = max(n // bs, 1)
         seqs_d = jnp.asarray(seqs)  # dataset resident on device for the run
         pos_d = jnp.asarray(pos)
         loss = None
-        for epoch in range(p.num_epochs):
+        for epoch in range(start_epoch, p.num_epochs):
             params, opt_state, loss = _train_epoch(
                 params, opt_state, seqs_d, pos_d, key, epoch,
                 p.learning_rate,
@@ -365,6 +398,8 @@ class SASRec:
             )
             if callback is not None:
                 callback(epoch, float(loss))
+            if checkpointer is not None and checkpointer.should_save(epoch):
+                checkpointer.save(epoch, (params, opt_state), fingerprint)
         return jax.tree_util.tree_map(np.asarray, params)
 
 

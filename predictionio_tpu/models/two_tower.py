@@ -225,14 +225,20 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
         )
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def run(params, opt_state, u_all, i_all, key, steps):
+    def run(params, opt_state, u_all, i_all, key, steps, start=0):
+        """``start`` offsets the on-device RNG step index so segmented
+        runs (mid-training checkpointing) sample the same batch sequence
+        an uninterrupted run would."""
+
         def body(s, carry):
             params, opt_state, _ = carry
             u, i = sample_batch(u_all, i_all, key, s)
             return raw_step(params, opt_state, u, i)
 
         zero = jnp.zeros((), jnp.float32)
-        return jax.lax.fori_loop(0, steps, body, (params, opt_state, zero))
+        return jax.lax.fori_loop(
+            start, start + steps, body, (params, opt_state, zero)
+        )
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def one_step(params, opt_state, u_all, i_all, key, s):
@@ -254,7 +260,13 @@ def train_two_tower(
     n_items: int,
     p: TwoTowerParams,
     callback=None,
+    checkpointer=None,
 ) -> TwoTowerModel:
+    """``checkpointer`` (utils.checkpoint.TrainCheckpointer) splits the
+    fused run into ``checkpointer.every``-step segments, saving
+    (params, opt_state) after each; a restart resumes from the newest
+    segment boundary with the identical batch trajectory (the on-device
+    sampler keys off the absolute step index)."""
     if user_idx.size == 0:
         raise ValueError("train_two_tower called with zero interactions")
     # global batch must split evenly over the data axis
@@ -266,6 +278,33 @@ def train_two_tower(
     else:
         params = jax.device_put(params, ctx.replicated)
     opt_state = tx.init(params)
+    start_step = 0
+    fingerprint = ""
+    if checkpointer is not None:
+        import dataclasses
+
+        from predictionio_tpu.utils.checkpoint import fingerprint_arrays
+
+        # bind checkpoints to this run's data + shape-affecting config
+        # (steps excluded: extending an interrupted run is a legal resume)
+        fingerprint = fingerprint_arrays(
+            dataclasses.replace(p, steps=0), n_users, n_items,
+            user_idx.astype(np.int32), item_idx.astype(np.int32),
+        )
+        hit = checkpointer.load_latest((params, opt_state), fingerprint)
+        if hit is not None:
+            last, (h_params, h_opt) = hit
+            start_step = last + 1
+            params = (
+                shard_params(ctx, h_params)
+                if ctx.model_axis_size > 1
+                else jax.device_put(h_params, ctx.replicated)
+            )
+            # restored host leaves stay UNcommitted (like tx.init's fresh
+            # arrays): jit places them via sharding propagation, so they
+            # never conflict with the replicated/sharded params
+            opt_state = h_opt
+            logger.info("two-tower: resuming at step %d", start_step)
 
     # batches are sampled ON DEVICE (fold_in per step) from the resident
     # interaction arrays — the host batch sampler and per-step transfers
@@ -280,22 +319,35 @@ def train_two_tower(
     key = jax.random.PRNGKey(p.seed)
     loss = None
     if callback is None:
-        if p.steps > 0:  # whole run = ONE device dispatch
-            params, opt_state, loss = run(
-                params, opt_state, u_all, i_all, key, p.steps
+        step = start_step
+        while step < p.steps:  # whole run = ONE dispatch per segment
+            seg = (
+                min(checkpointer.every, p.steps - step)
+                if checkpointer is not None
+                else p.steps - step
             )
+            params, opt_state, loss = run(
+                params, opt_state, u_all, i_all, key, seg, step
+            )
+            step += seg
+            if checkpointer is not None:
+                # also save the final segment so fused and callback modes
+                # leave identical checkpoint state behind
+                checkpointer.save(step - 1, (params, opt_state), fingerprint)
     else:
         # per-step dispatch so the callback sees progress; at most one step
         # in flight (on oversubscribed CPU test meshes async pile-up
         # starves the collective rendezvous and XLA aborts on its
         # stuck-timeout)
-        for step in range(p.steps):
+        for step in range(start_step, p.steps):
             params, opt_state, loss = one_step(
                 params, opt_state, u_all, i_all, key, step
             )
             loss.block_until_ready()
             if (step + 1) % 100 == 0:
                 callback(step, float(loss))
+            if checkpointer is not None and checkpointer.should_save(step):
+                checkpointer.save(step, (params, opt_state), fingerprint)
     if loss is not None:
         logger.info("two-tower final loss: %.4f", float(loss))
 

@@ -128,6 +128,11 @@ class AlgorithmParams(Params):
     # serving attention path: auto | mha | flash (pallas kernel) | ring
     # (sequence-parallel over the mesh; histories beyond one device)
     attn_impl: str = "auto"
+    # mid-training checkpointing (utils.checkpoint.TrainCheckpointer):
+    # empty = off; a crashed/killed train resumes from the newest epoch
+    # checkpoint in this directory instead of restarting from zero
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 1  # epochs between checkpoints
 
 
 @dataclass
@@ -159,7 +164,17 @@ class SASRecAlgorithm(P2LAlgorithm):
 
     def train(self, ctx: ComputeContext, pd: PreparedData) -> SASRecModel:
         hp = self._hp()
-        trained = SASRec(ctx, hp).train(pd.sequences, n_items=len(pd.item_ids))
+        checkpointer = None
+        if self.params.checkpoint_dir:
+            from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+            checkpointer = TrainCheckpointer(
+                self.params.checkpoint_dir,
+                every=self.params.checkpoint_every,
+            )
+        trained = SASRec(ctx, hp).train(
+            pd.sequences, n_items=len(pd.item_ids), checkpointer=checkpointer
+        )
         return SASRecModel(
             params=trained,
             item_ids=pd.item_ids,

@@ -16,12 +16,17 @@ tuples load back as lists). Leaves are numpy/jax arrays or JSON scalars.
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
+import shutil
 from pathlib import Path
 from typing import Any
 
 import jax
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 def save_pytree(directory: str | Path, tree: Any) -> None:
@@ -46,14 +51,157 @@ def save_pytree(directory: str | Path, tree: Any) -> None:
     (directory / "structure.json").write_text(json.dumps(structure))
 
 
-def load_pytree(directory: str | Path) -> Any:
-    """Load a checkpoint written by :func:`save_pytree`."""
-    directory = Path(directory)
+def _read_leaves(directory: Path) -> list:
     slots = json.loads((directory / "tree.json").read_text())["slots"]
-    structure = json.loads((directory / "structure.json").read_text())
     with np.load(directory / "arrays.npz", allow_pickle=False) as z:
-        leaves = [
+        return [
             z[str(i)] if slot["kind"] == "array" else slot["value"]
             for i, slot in enumerate(slots)
         ]
+
+
+def load_pytree(directory: str | Path) -> Any:
+    """Load a checkpoint written by :func:`save_pytree`."""
+    directory = Path(directory)
+    leaves = _read_leaves(directory)
+    structure = json.loads((directory / "structure.json").read_text())
     return jax.tree_util.tree_map(lambda i: leaves[i], structure)
+
+
+def load_pytree_like(directory: str | Path, like: Any) -> Any:
+    """Load a checkpoint into the exact tree structure of ``like``.
+
+    ``save_pytree``'s JSON structure cannot represent custom node types
+    (optax optimizer states are NamedTuples, which JSON flattens to
+    lists), so resuming training loads the leaves back through the
+    treedef of a freshly-initialized state of the same shape — the
+    standard restore-with-target pattern (cf. orbax restore_args).
+    Array leaves are validated against ``like``'s shapes/dtypes: a
+    count-compatible but shape-changed checkpoint (e.g. the item catalog
+    grew between runs) must raise, not silently corrupt training."""
+    directory = Path(directory)
+    leaves = _read_leaves(directory)
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(like_leaves) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves; target structure expects "
+            f"{len(like_leaves)}"
+        )
+    for i, (got, ref) in enumerate(zip(leaves, like_leaves)):
+        if isinstance(got, np.ndarray) and hasattr(ref, "shape"):
+            if tuple(got.shape) != tuple(ref.shape) or (
+                np.dtype(got.dtype) != np.dtype(ref.dtype)
+            ):
+                raise ValueError(
+                    f"checkpoint leaf {i} is {got.dtype}{tuple(got.shape)}; "
+                    f"target expects "
+                    f"{np.dtype(ref.dtype)}{tuple(ref.shape)}"
+                )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def fingerprint_arrays(*parts) -> str:
+    """Stable fingerprint of training inputs: hashes each part's bytes
+    (arrays) or repr (config objects). Trainers bind checkpoints to it so
+    a resume against different data/hyperparameters starts fresh instead
+    of silently returning a stale model."""
+    h = hashlib.sha1()
+    for part in parts:
+        if isinstance(part, np.ndarray):
+            h.update(str(part.shape).encode())
+            h.update(str(part.dtype).encode())
+            h.update(np.ascontiguousarray(part).tobytes())
+        else:
+            h.update(repr(part).encode())
+    return h.hexdigest()
+
+
+class TrainCheckpointer:
+    """Periodic mid-training checkpoint + resume.
+
+    The reference has NO mid-training checkpointing — its unit of
+    persistence is the finished model (SURVEY.md §5); a crashed
+    20-epoch run restarts from zero. Iterative TPU trainers (SASRec
+    epochs, two-tower step segments) save ``(step, state)`` here every
+    ``every`` units and resume from ``latest()``.
+
+    Writes are atomic (tmp dir + rename) so a crash mid-save leaves the
+    previous checkpoint intact; stale tmp dirs are swept at construction.
+    The newest ``keep`` checkpoints are retained. Checkpoints carry the
+    trainer's data/config ``fingerprint``; a mismatched fingerprint at
+    load time means the directory belongs to a different run — it is
+    cleared and the training starts fresh.
+    """
+
+    def __init__(self, directory: str | Path, every: int = 1, keep: int = 2):
+        self.directory = Path(directory)
+        self.every = max(every, 1)
+        self.keep = max(keep, 1)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        for d in self.directory.iterdir():  # crash-mid-save leftovers
+            if d.is_dir() and d.name.startswith("tmp-"):
+                shutil.rmtree(d, ignore_errors=True)
+
+    def _step_dirs(self) -> list[tuple[int, Path]]:
+        out = []
+        for d in self.directory.iterdir():
+            if d.is_dir() and d.name.startswith("step-"):
+                try:
+                    out.append((int(d.name[5:]), d))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def should_save(self, step: int) -> bool:
+        """True on every ``every``-th unit (1-indexed steps/epochs)."""
+        return (step + 1) % self.every == 0
+
+    def save(self, step: int, state: Any, fingerprint: str = "") -> None:
+        tmp = self.directory / f"tmp-{step}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        save_pytree(tmp, state)
+        (tmp / "fingerprint.txt").write_text(fingerprint)
+        final = self.directory / f"step-{step}"
+        if final.exists():
+            shutil.rmtree(final)
+        tmp.rename(final)
+        for _s, d in self._step_dirs()[: -self.keep]:
+            shutil.rmtree(d, ignore_errors=True)
+
+    def clear(self) -> None:
+        """Drop every checkpoint (a finished or abandoned run)."""
+        for d in self.directory.iterdir():
+            if d.is_dir() and (
+                d.name.startswith("step-") or d.name.startswith("tmp-")
+            ):
+                shutil.rmtree(d, ignore_errors=True)
+
+    def latest_step(self) -> int | None:
+        dirs = self._step_dirs()
+        return dirs[-1][0] if dirs else None
+
+    def load_latest(
+        self, like: Any, fingerprint: str = ""
+    ) -> tuple[int, Any] | None:
+        """(step, state) of the newest checkpoint restored into the
+        structure of ``like``, or None if no (matching) checkpoint
+        exists. A fingerprint mismatch — different data or
+        hyperparameters than the run that wrote the checkpoints —
+        clears the directory and returns None."""
+        dirs = self._step_dirs()
+        if not dirs:
+            return None
+        step, d = dirs[-1]
+        fp_file = d / "fingerprint.txt"
+        saved_fp = fp_file.read_text() if fp_file.exists() else ""
+        if saved_fp != fingerprint:
+            logger.warning(
+                "checkpoints in %s were written by a different run "
+                "(data/config fingerprint mismatch) — clearing and "
+                "training from scratch",
+                self.directory,
+            )
+            self.clear()
+            return None
+        return step, load_pytree_like(d, like)

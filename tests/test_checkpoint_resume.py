@@ -1,0 +1,169 @@
+"""Mid-training checkpoint/resume (utils.checkpoint.TrainCheckpointer).
+
+The reference persists only finished models (SURVEY.md §5 — a crashed run
+restarts from zero); these tests pin the stronger guarantee: an
+interrupted-and-resumed run reproduces the uninterrupted trajectory
+exactly, for both epoch-granular (SASRec) and fused-segment (two-tower)
+trainers.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.parallel.mesh import compute_context
+from predictionio_tpu.utils.checkpoint import (
+    TrainCheckpointer,
+    load_pytree_like,
+    save_pytree,
+)
+
+
+def test_checkpointer_atomic_save_load_and_prune(tmp_path):
+    ckpt = TrainCheckpointer(tmp_path, every=1, keep=2)
+    like = {"a": np.zeros(3), "b": (np.zeros(2), 7)}
+    for step in range(5):
+        ckpt.save(
+            step,
+            {"a": np.full(3, float(step)), "b": (np.full(2, float(step)), 7)},
+            "fp1",
+        )
+    assert ckpt.latest_step() == 4
+    step, state = ckpt.load_latest(like, "fp1")
+    assert step == 4
+    np.testing.assert_array_equal(state["a"], np.full(3, 4.0))
+    # pruned to `keep` newest
+    names = sorted(d.name for d in tmp_path.iterdir())
+    assert names == ["step-3", "step-4"]
+
+
+def test_checkpointer_fingerprint_mismatch_clears(tmp_path):
+    ckpt = TrainCheckpointer(tmp_path, every=1)
+    ckpt.save(0, {"a": np.zeros(2)}, "old-run")
+    assert ckpt.load_latest({"a": np.zeros(2)}, "new-run") is None
+    assert ckpt.latest_step() is None  # stale checkpoints cleared
+
+
+def test_load_pytree_like_shape_mismatch_raises(tmp_path):
+    save_pytree(tmp_path / "c", {"emb": np.zeros((5, 4), np.float32)})
+    with pytest.raises(ValueError, match="leaf 0"):
+        load_pytree_like(tmp_path / "c", {"emb": np.zeros((9, 4), np.float32)})
+
+
+def test_checkpointer_sweeps_stale_tmp_dirs(tmp_path):
+    (tmp_path / "tmp-7").mkdir(parents=True)
+    (tmp_path / "tmp-7" / "junk").write_text("crashed mid-save")
+    TrainCheckpointer(tmp_path)
+    assert not (tmp_path / "tmp-7").exists()
+
+
+def test_load_pytree_like_restores_namedtuple_structure(tmp_path):
+    import optax
+
+    params = {"w": np.ones((2, 2), np.float32)}
+    opt_state = optax.adam(1e-3).init(params)
+    save_pytree(tmp_path / "c", (params, opt_state))
+    fresh = optax.adam(1e-3).init(params)
+    p2, o2 = load_pytree_like(tmp_path / "c", (params, fresh))
+    assert type(o2) is type(opt_state)  # tuple-of-NamedTuples preserved
+    np.testing.assert_array_equal(p2["w"], params["w"])
+
+
+def test_load_pytree_like_leaf_count_mismatch(tmp_path):
+    save_pytree(tmp_path / "c", {"a": np.zeros(2)})
+    with pytest.raises(ValueError, match="leaves"):
+        load_pytree_like(tmp_path / "c", {"a": np.zeros(2), "b": np.zeros(1)})
+
+
+def _sasrec_sequences(n=24, n_items=30, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        list(rng.integers(1, n_items + 1, rng.integers(4, 12)))
+        for _ in range(n)
+    ]
+
+
+def test_sasrec_resume_matches_uninterrupted(tmp_path):
+    from predictionio_tpu.models.sasrec import SASRec, SASRecParams
+
+    ctx = compute_context()
+    p = SASRecParams(
+        max_len=8, embed_dim=8, num_blocks=1, num_heads=1, ffn_dim=16,
+        batch_size=8, num_epochs=4, dropout=0.0, attn_impl="mha", seed=3,
+    )
+    seqs = _sasrec_sequences()
+    straight = SASRec(ctx, p).train(seqs, 30)
+
+    # interrupted: 2 epochs with a checkpointer, then resume to 4
+    ckpt = TrainCheckpointer(tmp_path / "sas", every=1)
+    p2 = SASRecParams(**{**p.__dict__, "num_epochs": 2})
+    SASRec(ctx, p2).train(seqs, 30, checkpointer=ckpt)
+    assert ckpt.latest_step() == 1
+    resumed = SASRec(ctx, p).train(seqs, 30, checkpointer=ckpt)
+
+    import jax
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        straight, resumed,
+    )
+
+
+def test_two_tower_resume_matches_uninterrupted(tmp_path):
+    from predictionio_tpu.models.two_tower import (
+        TwoTowerParams,
+        train_two_tower,
+    )
+
+    ctx = compute_context()
+    rng = np.random.default_rng(0)
+    ui = rng.integers(0, 40, 400).astype(np.int32)
+    ii = rng.integers(0, 50, 400).astype(np.int32)
+    p = TwoTowerParams(
+        embed_dim=8, hidden_dims=(16,), out_dim=8, batch_size=32,
+        steps=6, seed=1,
+    )
+    straight = train_two_tower(ctx, ui, ii, 40, 50, p)
+
+    ckpt = TrainCheckpointer(tmp_path / "tt", every=2)
+    p_half = TwoTowerParams(**{**p.__dict__, "steps": 4})
+    train_two_tower(ctx, ui, ii, 40, 50, p_half, checkpointer=ckpt)
+    assert ckpt.latest_step() is not None
+    resumed = train_two_tower(ctx, ui, ii, 40, 50, p, checkpointer=ckpt)
+
+    np.testing.assert_allclose(
+        straight.item_embeddings, resumed.item_embeddings,
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        straight.user_embeddings, resumed.user_embeddings,
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_sasrec_template_checkpoint_dir_param(tmp_path, memory_storage):
+    """checkpoint_dir in engine.json reaches the trainer: a second train
+    resumes (no-ops) from the completed checkpoint."""
+    from predictionio_tpu.templates.sequentialrecommendation import (
+        AlgorithmParams,
+        Preparator,
+        SASRecAlgorithm,
+        TrainingData,
+    )
+
+    rng = np.random.default_rng(1)
+    td = TrainingData(
+        user_sequences={
+            f"u{u}": [f"i{x}" for x in rng.integers(0, 20, 8)]
+            for u in range(12)
+        }
+    )
+    ctx = compute_context()
+    pd = Preparator().prepare(ctx, td)
+    params = AlgorithmParams(
+        max_len=6, embed_dim=8, num_blocks=1, num_heads=1, ffn_dim=16,
+        num_epochs=2, batch_size=8, dropout=0.0, attn_impl="mha",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    algo = SASRecAlgorithm(params)
+    algo.train(ctx, pd)
+    assert (tmp_path / "ckpt" / "step-1").is_dir()
