@@ -130,31 +130,40 @@ def peak_flops(device) -> float | None:
 
 
 def bench_als(ctx, ui, ii, r, n_users, n_items, rank: int, iters: int,
-              steady: bool = False):
+              steady: bool = False, repeats: int = 1):
     """(full-train iter/s, factors[, steady-state iter/s]).
 
     The headline divides a complete warm `train()` by its iteration count —
     it includes host prep, the COO transfer, and the final factor readback,
-    like the MLlib job it replaces. `steady` additionally isolates the
-    per-iteration device rate via a 1-iteration train's delta (what longer
-    trainings and multi-epoch workloads see)."""
+    like the MLlib job it replaces. `repeats` takes the best of N timed
+    trains (a tunneled chip's host link adds seconds of run-to-run jitter;
+    best-of-N reports the achievable rate). `steady` additionally isolates
+    the per-iteration device rate via a 1-iteration train's delta (what
+    longer trainings and multi-epoch workloads see)."""
     from predictionio_tpu.models.als import ALS, ALSParams
 
     warm = ALS(ctx, ALSParams(rank=rank, num_iterations=1, seed=0))
     warm.train(ui, ii, r, n_users, n_items)  # compile all bucket shapes
 
     als = ALS(ctx, ALSParams(rank=rank, num_iterations=iters, seed=0))
-    t0 = time.perf_counter()
-    factors = als.train(ui, ii, r, n_users, n_items)
-    np.asarray(factors.user_features)  # block
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        factors = als.train(ui, ii, r, n_users, n_items)
+        np.asarray(factors.user_features)  # block
+        dt = min(dt, time.perf_counter() - t0)
     if not steady:
         return iters / dt, factors
+    # the 1-iter reference gets the same best-of-N treatment: jitter is
+    # positive-additive, so each min() converges to its true time from
+    # above and the delta stays meaningful
     one = ALS(ctx, ALSParams(rank=rank, num_iterations=1, seed=0))
-    t0 = time.perf_counter()
-    f1 = one.train(ui, ii, r, n_users, n_items)
-    np.asarray(f1.user_features)
-    dt1 = time.perf_counter() - t0
+    dt1 = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        f1 = one.train(ui, ii, r, n_users, n_items)
+        np.asarray(f1.user_features)
+        dt1 = min(dt1, time.perf_counter() - t0)
     steady_rate = (iters - 1) / max(dt - dt1, 1e-9) if dt > dt1 else 0.0
     return iters / dt, factors, steady_rate
 
@@ -180,11 +189,19 @@ def bench_two_tower(ctx) -> dict:
     # delta timing isolates the training loop from init/transfer and the
     # serving-corpus export that train_two_tower also performs; the step
     # spread must dwarf the multi-second fixed-cost noise of a tunneled
-    # chip, so measure thousands of steps
+    # chip, so measure thousands of steps — and take the best of two
+    # passes (run-to-run link jitter is seconds-sized)
     steps = 2000
-    t_short, t_long = timed(2), timed(steps + 2)
-    dt = t_long - t_short
-    if dt <= 0:  # fixed-cost noise swamped the loop — don't report garbage
+    # jitter is positive-additive on BOTH terms, so min() each side
+    # independently: min(t_long) - min(t_short) converges to the true
+    # loop time from above (min over per-pass deltas would understate it
+    # whenever a pass's short run caught a spike)
+    shorts, longs = [], []
+    for _ in range(2):
+        shorts.append(timed(2))
+        longs.append(timed(steps + 2))
+    dt = min(longs) - min(shorts)
+    if dt <= 0:  # noise swamped the loop — don't report garbage
         return {"two_tower_bench_error": "timing noise exceeded loop time"}
     return {
         "two_tower_steps_per_sec": round(steps / dt, 2),
@@ -211,7 +228,7 @@ def main() -> None:
     # --- ML-20M north star (rank 10 / 20 iterations, template defaults)
     ui, ii, r, nu, ni = synthesize_ml20m()
     ml20m_ips, _, steady = bench_als(
-        ctx, ui, ii, r, nu, ni, rank=10, iters=20, steady=True)
+        ctx, ui, ii, r, nu, ni, rank=10, iters=20, steady=True, repeats=2)
     if steady > 0:
         extra["ml20m_rank10_steady_iter_per_sec"] = round(steady, 3)
     p10 = ALSParams(rank=10)
@@ -225,7 +242,7 @@ def main() -> None:
 
     # --- ML-20M rank 64: MXU-utilization reading (bucketed solver)
     ml20m64_ips, _, steady64 = bench_als(
-        ctx, ui, ii, r, nu, ni, rank=64, iters=8, steady=True)
+        ctx, ui, ii, r, nu, ni, rank=64, iters=8, steady=True, repeats=2)
     p64 = ALSParams(rank=64)
     u_shapes = _padded_shapes(ui, p64, ctx)
     i_shapes = _padded_shapes(ii, p64, ctx)
