@@ -1,0 +1,165 @@
+"""The README quickstart, end to end, through real CLI subprocesses.
+
+Pins the functional baseline flows of BASELINE.md: `pio status` → `pio
+app new` → event ingestion over REST (201 + eventId) → `pio template
+scaffold` → `pio build` → `pio train` → `pio deploy` (REST predict) →
+`pio export`/`import` round trip — each step the real console script in
+a real subprocess, the way an operator runs it (ref: Console.scala
+quickstart verbs, README.md:44-60)."""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(workdir: Path) -> dict:
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("PIO_STORAGE_")
+    }
+    env.update(
+        PIO_STORAGE_SOURCES_S_TYPE="sqlite",
+        PIO_STORAGE_SOURCES_S_PATH=str(workdir / "pio.db"),
+        PIO_STORAGE_REPOSITORIES_METADATA_SOURCE="S",
+        PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE="S",
+        PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE="S",
+        # subprocesses must not monopolize the real accelerator in CI
+        JAX_PLATFORMS="cpu",
+    )
+    return env
+
+
+def _pio(args, cwd, env, timeout=300) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"pio {' '.join(args)} failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    return proc.stdout + proc.stderr
+
+
+def _wait_port(port: int, deadline: float = 60.0) -> None:
+    end = time.time() + deadline
+    while time.time() < end:
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            c.request("GET", "/")
+            c.getresponse().read()
+            c.close()
+            return
+        except OSError:
+            time.sleep(0.5)
+    raise TimeoutError(f"nothing listening on {port}")
+
+
+def test_quickstart_flow(tmp_path):
+    env = _env(tmp_path)
+    out = _pio(["status"], tmp_path, env)
+    assert "ready to go" in out
+
+    out = _pio(["app", "new", "QuickApp"], tmp_path, env)
+    key = next(
+        line.split(":", 1)[1].strip()
+        for line in out.splitlines()
+        if "Access Key" in line
+    )
+    assert len(key) == 64
+
+    # -- event server: ingest the quickstart's rate events over REST
+    es_port = _free_port()
+    es = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli",
+         "eventserver", "--port", str(es_port)],
+        cwd=tmp_path, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_port(es_port)
+        conn = http.client.HTTPConnection("127.0.0.1", es_port)
+        for u in range(12):
+            for i in range(10):
+                body = json.dumps({
+                    "event": "rate", "entityType": "user",
+                    "entityId": f"u{u}", "targetEntityType": "item",
+                    "targetEntityId": f"i{(u * 3 + i) % 25}",
+                    "properties": {"rating": float(1 + (u + i) % 5)},
+                })
+                conn.request(
+                    "POST", f"/events.json?accessKey={key}", body,
+                    {"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = json.loads(resp.read())
+                assert resp.status == 201 and data["eventId"]
+        conn.close()
+    finally:
+        es.send_signal(signal.SIGTERM)
+        es.wait(timeout=10)
+
+    # -- scaffold + build + train
+    _pio(["template", "scaffold", "recommendation", "QuickRec"],
+         tmp_path, env)
+    engine_dir = tmp_path / "QuickRec"
+    variant = json.loads((engine_dir / "engine.json").read_text())
+    variant["datasource"]["params"]["app_name"] = "QuickApp"
+    variant["algorithms"][0]["params"]["numIterations"] = 3
+    (engine_dir / "engine.json").write_text(json.dumps(variant))
+    _pio(["build"], engine_dir, env)
+    out = _pio(["train"], engine_dir, env)
+    assert "Training completed" in out
+
+    # -- deploy + query over REST
+    dep_port = _free_port()
+    dep = subprocess.Popen(
+        [sys.executable, "-m", "predictionio_tpu.tools.cli",
+         "deploy", "--port", str(dep_port)],
+        cwd=engine_dir, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        _wait_port(dep_port, deadline=120)
+        conn = http.client.HTTPConnection("127.0.0.1", dep_port)
+        conn.request(
+            "POST", "/queries.json",
+            json.dumps({"user": "u1", "num": 4}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        result = json.loads(resp.read())
+        assert resp.status == 200
+        assert len(result["itemScores"]) == 4
+        conn.close()
+    finally:
+        dep.send_signal(signal.SIGTERM)
+        dep.wait(timeout=10)
+
+    # -- export / import round trip
+    _pio(["export", "--app-name", "QuickApp", "--output", "events.jsonl"],
+         tmp_path, env)
+    lines = (tmp_path / "events.jsonl").read_text().splitlines()
+    assert len(lines) == 120
+    _pio(["app", "new", "ImportApp"], tmp_path, env)
+    _pio(["import", "--app-name", "ImportApp", "--input", "events.jsonl"],
+         tmp_path, env)
+    _pio(["export", "--app-name", "ImportApp", "--output", "events2.jsonl"],
+         tmp_path, env)
+    assert len((tmp_path / "events2.jsonl").read_text().splitlines()) == 120
