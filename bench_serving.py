@@ -215,7 +215,41 @@ def bench_query_latency(
         Storage.reset()
 
 
-def bench_event_ingest(total: int = 2000, conns: int = 8) -> dict:
+def _ingest_worker(port: int, key: str, n: int, barrier, out_q) -> None:
+    """One client process: connect, sync on the barrier, POST n events.
+    Separate PROCESSES, not threads — in-process clients share the
+    server's GIL and understate its real capacity."""
+    import http.client as hc
+    import json as _json
+    import time as _time
+
+    body = _json.dumps({
+        "event": "view", "entityType": "user", "entityId": "u1",
+        "targetEntityType": "item", "targetEntityId": "i1",
+    }).encode()
+    conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(
+        "POST", f"/events.json?accessKey={key}", body,
+        {"Content-Type": "application/json"},
+    )
+    r = conn.getresponse()
+    r.read()
+    assert r.status == 201, r.status
+    barrier.wait()
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        conn.request(
+            "POST", f"/events.json?accessKey={key}", body,
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 201, r.status
+    out_q.put(_time.perf_counter() - t0)
+    conn.close()
+
+
+def bench_event_ingest(total: int = 4000, conns: int = 8) -> dict:
     """POST /events.json throughput over keep-alive connections (the event
     collection surface, ref: data/.../api/EventServer.scala:226-261)."""
     from predictionio_tpu.data.api.event_server import (
@@ -236,48 +270,59 @@ def bench_event_ingest(total: int = 2000, conns: int = 8) -> dict:
         server = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
         server.start()
         try:
-            body = json.dumps({
-                "event": "view", "entityType": "user", "entityId": "u1",
-                "targetEntityType": "item", "targetEntityId": "i1",
-            }).encode()
+            import multiprocessing as mp
 
-            errors: list[Exception] = []
-
-            def worker(n):
-                try:
-                    conn = http.client.HTTPConnection(
-                        "127.0.0.1", server.port, timeout=30
-                    )
-                    for _ in range(n):
-                        conn.request(
-                            "POST", f"/events.json?accessKey={key}", body,
-                            {"Content-Type": "application/json"},
-                        )
-                        r = conn.getresponse()
-                        r.read()
-                        assert r.status == 201, r.status
-                    conn.close()
-                except Exception as e:  # noqa: BLE001 — re-raised after join
-                    errors.append(e)
-
-            worker(50)  # warm
-            if errors:
-                raise errors[0]
+            mp_ctx = mp.get_context("spawn")  # no forked jax/server state
+            barrier = mp_ctx.Barrier(conns + 1)
+            out_q = mp_ctx.Queue()
             per_conn = total // conns
             sent = per_conn * conns
-            ts = [
-                threading.Thread(target=worker, args=(per_conn,))
+            procs = [
+                mp_ctx.Process(
+                    target=_ingest_worker,
+                    args=(server.port, key, per_conn, barrier, out_q),
+                )
                 for _ in range(conns)
             ]
+            for p in procs:
+                p.start()
+            try:
+                barrier.wait(timeout=60)  # all workers connected + warmed
+            except Exception:
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(
+                    "ingest worker(s) died before the barrier; exit codes: "
+                    f"{[p.exitcode for p in procs]}"
+                )
             t0 = time.perf_counter()
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
-            dt = time.perf_counter() - t0
-            if errors:
-                raise errors[0]
-            return {"ingest_events_per_sec": round(sent / dt, 0)}
+            times = []
+            import queue as _queue
+
+            for _ in range(conns):
+                try:
+                    times.append(out_q.get(timeout=120))
+                except _queue.Empty:
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        "ingest worker died mid-run; exit codes: "
+                        f"{[p.exitcode for p in procs]}"
+                    )
+            wall = time.perf_counter() - t0
+            for p in procs:
+                p.join(timeout=30)
+            if any(p.exitcode != 0 for p in procs):
+                raise RuntimeError(
+                    f"ingest worker failed: {[p.exitcode for p in procs]}"
+                )
+            return {
+                "ingest_events_per_sec": round(sent / wall, 0),
+                "ingest_conns": conns,
+                "ingest_per_conn_events_per_sec": round(
+                    per_conn / (sum(times) / conns), 0
+                ),
+            }
         finally:
             server.stop()
     finally:

@@ -12,15 +12,12 @@ import socket
 import subprocess
 import sys
 from pathlib import Path
+from predictionio_tpu.utils.http import free_port as _free_port
 
 WORKER = Path(__file__).with_name("dist_worker.py")
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def test_two_process_mesh_spans_and_reduces():

@@ -12,6 +12,7 @@ from pathlib import Path
 import pytest
 
 from predictionio_tpu.tools.cli import main as cli_main
+from predictionio_tpu.utils.http import free_port as _free_port
 
 
 def _git(args, cwd):
@@ -108,10 +109,6 @@ class TestTemplateGet:
         assert (dest / "keep.txt").exists()
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 class TestStartStopAll:
