@@ -234,24 +234,75 @@ class ECommAlgorithm(P2LAlgorithm):
         )
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
-        exclude = self._exclusion_mask(model, query, query.user)
-        k = min(query.num, len(model.item_ids))
-        uidx = model.user_ids.get(query.user)
-        if uidx is not None:
-            q = model.user_features[uidx][None, :]
-            scores, idx = top_k_scores(q, model.item_features, k, exclude)
-        else:
-            # cold-start: recommend near the user's recent views (ref :285)
-            recent = [model.item_ids(i) for i in self._recent_items(query.user)
-                      if i in model.item_ids]
-            if not recent:
-                return PredictedResult(())
-            q = model.item_features[np.asarray(recent, np.int32)].mean(axis=0)[None, :]
-            scores, idx = top_k_cosine(q, model.item_features, k, exclude)
-        return PredictedResult(
-            topk_to_item_scores(scores[0], idx[0], model.item_ids, query.num,
-                                ItemScore)
-        )
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: ECommModel, queries):
+        """Micro-batched serving. The serve-time event-store reads
+        (unavailable items, seen items, recent views — host I/O) stay
+        per-query like the reference's predict (ref ALSAlgorithm.scala
+        :194-221); the device work batches into at most two calls per
+        drained batch: one top_k_scores for warm users, one top_k_cosine
+        for cold-start users."""
+        out = []
+        warm = []  # (index, query, uidx, mask)
+        cold = []  # (index, query, mean-vec, mask)
+        # the serving layer pads a drained batch by repeating its LAST
+        # query object — memoize the per-query host work (event-store
+        # reads, mask build) by object identity so duplicates are free
+        prepped: dict[int, tuple] = {}
+        for i, q in queries:
+            hit = prepped.get(id(q))
+            if hit is None:
+                exclude = self._exclusion_mask(model, q, q.user)
+                uidx = model.user_ids.get(q.user)
+                if uidx is not None:
+                    hit = ("warm", uidx, exclude)
+                else:
+                    # cold-start: recommend near recent views (ref :285)
+                    recent = [
+                        model.item_ids(it)
+                        for it in self._recent_items(q.user)
+                        if it in model.item_ids
+                    ]
+                    if not recent:
+                        hit = ("empty",)
+                    else:
+                        vec = model.item_features[
+                            np.asarray(recent, np.int32)
+                        ].mean(axis=0)
+                        hit = ("cold", vec, exclude)
+                prepped[id(q)] = hit
+            if hit[0] == "warm":
+                warm.append((i, q, hit[1], hit[2]))
+            elif hit[0] == "cold":
+                cold.append((i, q, hit[1], hit[2]))
+            else:
+                out.append((i, PredictedResult(())))
+
+        def emit(rows, scores, idx):
+            for row, (i, q, _x, _m) in enumerate(rows):
+                out.append(
+                    (i, PredictedResult(topk_to_item_scores(
+                        scores[row], idx[row], model.item_ids, q.num,
+                        ItemScore,
+                    )))
+                )
+
+        if warm:
+            uidx = np.array([u for _, _, u, _ in warm], np.int32)
+            masks = np.concatenate([m for _, _, _, m in warm], axis=0)
+            k = min(max(q.num for _, q, _, _ in warm), len(model.item_ids))
+            scores, idx = top_k_scores(
+                model.user_features[uidx], model.item_features, k, masks
+            )
+            emit(warm, scores, idx)
+        if cold:
+            qs = np.stack([v for _, _, v, _ in cold])
+            masks = np.concatenate([m for _, _, _, m in cold], axis=0)
+            k = min(max(q.num for _, q, _, _ in cold), len(model.item_ids))
+            scores, idx = top_k_cosine(qs, model.item_features, k, masks)
+            emit(cold, scores, idx)
+        return out
 
 
 class Serving(FirstServing):

@@ -185,38 +185,58 @@ class SASRecAlgorithm(P2LAlgorithm):
         )
 
     def predict(self, model: SASRecModel, query: Query) -> PredictedResult:
-        seq = model.user_sequences.get(query.user)
-        if not seq:
-            # cold start: most popular items (the ecommerce template's
-            # predictNewUser spirit)
-            return PredictedResult(
-                tuple(
-                    ItemScore(item=it, score=0.0)
-                    for it in model.popular[: query.num]
-                )
-            )
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: SASRecModel, queries):
+        """Micro-batched serving: padded histories and per-user seen
+        masks stack into ONE transformer forward + catalog score for the
+        drained batch."""
         hp = model.hp
-        padded = np.zeros((1, hp.max_len), dtype=np.int32)
-        tail = seq[-hp.max_len:]
-        padded[0, -len(tail):] = tail
-        exclude = None
-        if model.exclude_seen:  # full history, not just the model window
-            n_rows = model.params["item_emb"].shape[0]
-            exclude = np.zeros((1, n_rows), dtype=bool)
-            exclude[0, np.asarray(seq, dtype=np.int64)] = True
-        scores, idx = predict_top_k(
-            model.params, padded, query.num, hp, exclude_mask=exclude
-        )
-        scores = np.asarray(scores[0])
-        idx = np.asarray(idx[0])
+        n_rows = model.params["item_emb"].shape[0]
         out = []
-        for s, i in zip(scores, idx):
-            if not np.isfinite(s) or i == 0:
+        rows = []  # (index, query, history)
+        for i, q in queries:
+            seq = model.user_sequences.get(q.user)
+            if not seq:
+                # cold start: most popular items (the ecommerce template's
+                # predictNewUser spirit)
+                out.append(
+                    (i, PredictedResult(tuple(
+                        ItemScore(item=it, score=0.0)
+                        for it in model.popular[: q.num]
+                    )))
+                )
                 continue
-            out.append(
-                ItemScore(item=model.item_ids.inverse(int(i)), score=float(s))
+            rows.append((i, q, seq))
+        if rows:
+            padded = np.zeros((len(rows), hp.max_len), dtype=np.int32)
+            for r, (_i, _q, seq) in enumerate(rows):
+                tail = seq[-hp.max_len:]
+                padded[r, -len(tail):] = tail
+            exclude = None
+            if model.exclude_seen:  # full history, not the model window
+                exclude = np.zeros((len(rows), n_rows), dtype=bool)
+                for r, (_i, _q, seq) in enumerate(rows):
+                    exclude[r, np.asarray(seq, dtype=np.int64)] = True
+            k = max(q.num for _, q, _ in rows)
+            scores, idx = predict_top_k(
+                model.params, padded, k, hp, exclude_mask=exclude
             )
-        return PredictedResult(tuple(out))
+            scores = np.asarray(scores)
+            idx = np.asarray(idx)
+            for r, (i, q, _seq) in enumerate(rows):
+                items = []
+                for s, j in zip(scores[r][: q.num], idx[r][: q.num]):
+                    if not np.isfinite(s) or j == 0:
+                        continue
+                    items.append(
+                        ItemScore(
+                            item=model.item_ids.inverse(int(j)),
+                            score=float(s),
+                        )
+                    )
+                out.append((i, PredictedResult(tuple(items))))
+        return out
 
 
 def engine_factory() -> Engine:

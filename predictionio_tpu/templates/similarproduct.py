@@ -166,28 +166,41 @@ def _train_implicit_item_factors(
     return SimilarModel(factors.item_features, item_ids, item_categories)
 
 
-def _similar_items(model: SimilarModel, query: Query) -> PredictedResult:
-    """Cosine top-k over the query items' mean factor, with the reference's
-    filters: drop query items, apply white/black lists and categories
-    (ref: ALSAlgorithm.predict in the similarproduct template)."""
-    known = [model.item_ids(i) for i in query.items if i in model.item_ids]
-    if not known:
-        return PredictedResult(())
-    q = model.item_features[np.asarray(known, np.int32)].mean(axis=0)[None, :]
-    exclude = build_exclusion_mask(
-        model.item_ids,
-        banned=(i for i in query.items if i in model.item_ids),
-        black_list=query.blackList,
-        white_list=query.whiteList,
-        categories=query.categories,
-        item_categories=model.item_categories,
-    )
-    k = min(query.num, len(model.item_ids))
-    scores, idx = top_k_cosine(q, model.item_features, k, exclude)
-    return PredictedResult(
-        topk_to_item_scores(scores[0], idx[0], model.item_ids, query.num,
-                            ItemScore)
-    )
+def _similar_items_batch(model: SimilarModel, queries):
+    """Cosine top-k over each query's mean item factor, with the
+    reference's filters (drop query items, white/black lists, categories
+    — ref: ALSAlgorithm.predict in the similarproduct template), batched:
+    query vectors and per-query exclusion masks stack into ONE
+    top_k_cosine call for the whole drained micro-batch."""
+    out = []
+    rows = []  # (index, query, q_vec [d], mask [1, n_items])
+    for i, q in queries:
+        known = [model.item_ids(it) for it in q.items if it in model.item_ids]
+        if not known:
+            out.append((i, PredictedResult(())))
+            continue
+        vec = model.item_features[np.asarray(known, np.int32)].mean(axis=0)
+        mask = build_exclusion_mask(
+            model.item_ids,
+            banned=(it for it in q.items if it in model.item_ids),
+            black_list=q.blackList,
+            white_list=q.whiteList,
+            categories=q.categories,
+            item_categories=model.item_categories,
+        )
+        rows.append((i, q, vec, mask))
+    if rows:
+        qs = np.stack([v for _, _, v, _ in rows])
+        masks = np.concatenate([m for _, _, _, m in rows], axis=0)
+        k = min(max(q.num for _, q, _, _ in rows), len(model.item_ids))
+        scores, idx = top_k_cosine(qs, model.item_features, k, masks)
+        for row, (i, q, _v, _m) in enumerate(rows):
+            out.append(
+                (i, PredictedResult(topk_to_item_scores(
+                    scores[row], idx[row], model.item_ids, q.num, ItemScore
+                )))
+            )
+    return out
 
 
 class ALSAlgorithm(P2LAlgorithm):
@@ -213,7 +226,11 @@ class ALSAlgorithm(P2LAlgorithm):
         )
 
     def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
-        return _similar_items(model, query)
+        return _similar_items_batch(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: SimilarModel, queries):
+        """Micro-batched serving: one device call per drained batch."""
+        return _similar_items_batch(model, queries)
 
 
 class LikeAlgorithm(ALSAlgorithm):

@@ -19,6 +19,7 @@ from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.als import top_k_scores
+from predictionio_tpu.models.serving_filters import topk_to_item_scores
 from predictionio_tpu.models.two_tower import (
     TwoTowerModel,
     TwoTowerParams,
@@ -145,19 +146,34 @@ class TwoTowerAlgorithm(P2LAlgorithm):
         return RetrievalModel(tt, pd.user_ids, pd.item_ids)
 
     def predict(self, model: RetrievalModel, query: Query) -> PredictedResult:
-        uidx = model.user_ids.get(query.user)
-        if uidx is None:
-            return PredictedResult(())
-        q = embed_users(model.tt, np.array([uidx], np.int32))
-        k = min(query.num, len(model.item_ids))
-        scores, idx = top_k_scores(q, model.tt.item_embeddings, k)
-        items = model.item_ids.decode(np.asarray(idx[0]))
-        return PredictedResult(
-            tuple(
-                ItemScore(item, float(s))
-                for item, s in zip(items, np.asarray(scores[0]))
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(self, model: RetrievalModel, queries):
+        """Micro-batched serving: ONE top_k_scores call for every known
+        user in the drained batch (the query server coalesces concurrent
+        requests through this, workflow/batching.py)."""
+        out = []
+        known = []
+        for i, q in queries:
+            uidx = model.user_ids.get(q.user)
+            if uidx is None:
+                out.append((i, PredictedResult(())))
+            else:
+                known.append((i, q, uidx))
+        if known:
+            qv = embed_users(
+                model.tt, np.array([u for _, _, u in known], np.int32)
             )
-        )
+            k = min(max(q.num for _, q, _ in known), len(model.item_ids))
+            scores, idx = top_k_scores(qv, model.tt.item_embeddings, k)
+            for row, (i, q, _u) in enumerate(known):
+                out.append(
+                    (i, PredictedResult(topk_to_item_scores(
+                        scores[row], idx[row], model.item_ids, q.num,
+                        ItemScore,
+                    )))
+                )
+        return out
 
 
 class Serving(FirstServing):

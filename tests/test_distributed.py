@@ -8,7 +8,6 @@ each, one global mesh, gloo cross-process collectives.
 """
 
 import os
-import socket
 import subprocess
 import sys
 from pathlib import Path
@@ -16,8 +15,6 @@ from predictionio_tpu.utils.http import free_port as _free_port
 
 WORKER = Path(__file__).with_name("dist_worker.py")
 REPO_ROOT = Path(__file__).resolve().parent.parent
-
-
 
 
 def test_two_process_mesh_spans_and_reduces():

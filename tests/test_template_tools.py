@@ -3,7 +3,6 @@
 
 import json
 import os
-import socket
 import subprocess
 import time
 import urllib.request
@@ -107,8 +106,6 @@ class TestTemplateGet:
         rc = cli_main(["template", "get", str(template_repo), str(dest)])
         assert rc == 1
         assert (dest / "keep.txt").exists()
-
-
 
 
 class TestStartStopAll:

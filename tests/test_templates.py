@@ -305,3 +305,134 @@ class TestTemplateContracts:
                 )
             variant = mod.ENGINE_JSON
             assert variant["engineFactory"].startswith("predictionio_tpu.templates.")
+
+
+class TestBatchPredictParity:
+    """batch_predict must return exactly what per-query predict returns —
+    every template serves through the micro-batcher now, so the batched
+    path IS the product path (ref: the serving loop the reference leaves
+    sequential, CreateServer.scala:513-520)."""
+
+    def _assert_parity(self, algo, model, queries):
+        batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+        assert len(batched) == len(queries)
+        for i, q in enumerate(queries):
+            single = algo.predict(model, q)
+            b_scores = batched[i].itemScores
+            s_scores = single.itemScores
+            # identical item RANKING; scores match to float tolerance
+            # (batched matmuls tile/pad differently than singles)
+            assert [s.item for s in b_scores] == [s.item for s in s_scores], (
+                f"query {i} ranking diverged"
+            )
+            np.testing.assert_allclose(
+                [s.score for s in b_scores],
+                [s.score for s in s_scores],
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"query {i} scores diverged",
+            )
+
+    def test_similarproduct(self, ctx, memory_storage):
+        from predictionio_tpu.templates.similarproduct import (
+            Query,
+            engine_factory,
+        )
+
+        app_id = make_app(memory_storage, "simapp2")
+        seed_views(memory_storage, app_id)
+        engine = engine_factory()
+        ep = engine.engine_params_from_json({
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "simapp2"}},
+            "algorithms": [
+                {"name": "als",
+                 "params": {"rank": 8, "numIterations": 6, "alpha": 5.0,
+                            "seed": 0}},
+            ],
+        })
+        algo = engine._algorithms(ep)[0]
+        model = engine.train(ctx, ep)[0]
+        self._assert_parity(algo, model, [
+            Query(items=("i1",), num=4),
+            Query(items=("i12", "i13"), num=3),
+            Query(items=("nope",), num=2),  # unknown → empty
+            Query(items=("i2",), num=5, blackList=("i3",)),
+        ])
+
+    def test_ecommerce(self, ctx, memory_storage):
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        app_id = make_app(memory_storage, "ecomapp2")
+        seed_views(memory_storage, app_id, seed=2)
+        from predictionio_tpu.templates.ecommercerecommendation import (
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        ep = engine.engine_params_from_json({
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "ecomapp2"}},
+            "algorithms": [
+                {"name": "ecomm",
+                 "params": {"app_name": "ecomapp2", "rank": 8,
+                            "numIterations": 6, "alpha": 5.0, "seed": 0}},
+            ],
+        })
+        algo = engine._algorithms(ep)[0]
+        model = engine.train(ctx, ep)[0]
+        self._assert_parity(algo, model, [
+            Query(user="u1", num=4),
+            Query(user="u2", num=3, categories=None),
+            Query(user="no-such-user", num=3),  # cold start path
+        ])
+
+    def test_twotower(self, ctx, memory_storage):
+        from predictionio_tpu.templates.twotower import Query, engine_factory
+
+        app_id = make_app(memory_storage, "ttapp2")
+        seed_views(memory_storage, app_id, seed=3)
+        engine = engine_factory()
+        ep = engine.engine_params_from_json({
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "ttapp2"}},
+            "algorithms": [
+                {"name": "twotower",
+                 "params": {"embed_dim": 8, "out_dim": 8, "steps": 30,
+                            "batch_size": 32, "seed": 0}},
+            ],
+        })
+        algo = engine._algorithms(ep)[0]
+        model = engine.train(ctx, ep)[0]
+        self._assert_parity(algo, model, [
+            Query(user="u1", num=4),
+            Query(user="u5", num=2),
+            Query(user="missing", num=3),
+        ])
+
+    def test_sequentialrecommendation(self, ctx, memory_storage):
+        from predictionio_tpu.templates.sequentialrecommendation import (
+            Query,
+            engine_factory,
+        )
+
+        app_id = make_app(memory_storage, "seqapp2")
+        seed_views(memory_storage, app_id, seed=4)
+        engine = engine_factory()
+        ep = engine.engine_params_from_json({
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "seqapp2"}},
+            "algorithms": [
+                {"name": "sasrec",
+                 "params": {"max_len": 8, "embed_dim": 8, "num_blocks": 1,
+                            "num_heads": 1, "ffn_dim": 16, "num_epochs": 2,
+                            "batch_size": 8, "dropout": 0.0,
+                            "attn_impl": "mha", "seed": 0}},
+            ],
+        })
+        algo = engine._algorithms(ep)[0]
+        model = engine.train(ctx, ep)[0]
+        self._assert_parity(algo, model, [
+            Query(user="u1", num=4),
+            Query(user="u3", num=2),
+            Query(user="missing", num=3),  # popular fallback
+        ])
