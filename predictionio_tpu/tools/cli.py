@@ -615,11 +615,36 @@ def cmd_upgrade(args) -> int:
 
 
 def cmd_status(args) -> int:
-    """ref: Console.status:1033-1120 — storage smoke test."""
+    """ref: Console.status:1033-1120 — storage smoke test, plus the
+    compute substrate report (the reference prints its Spark version
+    check here; the TPU analog is the JAX backend + device inventory
+    and, off the CPU backend, the measured accelerator link RTT that
+    drives serving placement)."""
     from predictionio_tpu.data.storage import Storage
 
     print("[INFO] Inspecting predictionio_tpu installation...")
     print(f"[INFO] predictionio_tpu {__version__}")
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        devices = jax.devices()
+        kinds: dict[str, int] = {}
+        for d in devices:
+            kind = getattr(d, "device_kind", d.platform)
+            kinds[kind] = kinds.get(kind, 0) + 1
+        inventory = ", ".join(f"{n}x {k}" for k, n in kinds.items())
+        print(f"[INFO] JAX backend: {backend} ({inventory})")
+        if backend != "cpu":
+            from predictionio_tpu.parallel.placement import link_rtt
+
+            rtt_ms = link_rtt() * 1e3
+            print(
+                f"[INFO] Accelerator link RTT: {rtt_ms:.2f} ms "
+                f"(drives serving placement; see PIO_SERVING_DEVICE)"
+            )
+    except Exception as e:  # a broken accelerator must not fail status
+        print(f"[WARN] JAX backend probe failed: {e}", file=sys.stderr)
     s = Storage.instance()
     for name, src in s.sources.items():
         print(f"[INFO] Storage source {name}: type={src.type}")
