@@ -164,6 +164,20 @@ class NaiveBayesAlgorithm(P2LAlgorithm):
         )
         return PredictedResult(label=float(labels[0]))
 
+    def batch_predict(self, model: NaiveBayesModel, queries):
+        """Micro-batched serving: one score matmul for the drained batch
+        (predict_naive_bayes is row-batched already)."""
+        import numpy as np
+
+        x = np.array(
+            [[q.attr0, q.attr1, q.attr2] for _, q in queries], np.float32
+        )
+        labels, _ = predict_naive_bayes(model, x)
+        return [
+            (i, PredictedResult(label=float(lbl)))
+            for (i, _q), lbl in zip(queries, labels)
+        ]
+
 
 # -- softmax regression (the add-algorithm second slot) ---------------------
 
@@ -242,6 +256,17 @@ class LogisticAlgorithm(P2LAlgorithm):
         x = np.array([[query.attr0, query.attr1, query.attr2]], np.float32)
         scores = x @ model.w + model.b
         return PredictedResult(label=float(model.labels[int(scores.argmax())]))
+
+    def batch_predict(self, model: LogisticModel, queries):
+        """Micro-batched serving: one [b, F] @ [F, C] score for the batch."""
+        x = np.array(
+            [[q.attr0, q.attr1, q.attr2] for _, q in queries], np.float32
+        )
+        scores = x @ model.w + model.b
+        return [
+            (i, PredictedResult(label=float(model.labels[int(row.argmax())])))
+            for (i, _q), row in zip(queries, scores)
+        ]
 
 
 class Serving(FirstServing):

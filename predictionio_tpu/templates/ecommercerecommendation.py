@@ -120,6 +120,15 @@ class AlgorithmParams(Params):
     unseen_only: bool = True  # exclude items the user has seen
     seen_events: tuple[str, ...] = ("view", "buy")
     similar_events: tuple[str, ...] = ("view",)  # cold-start basis
+    #: TTL (seconds) for the serve-time read of the GLOBAL
+    #: constraint/unavailableItems entity. The default 0 matches the
+    #: reference exactly — every query re-reads the constraint, so an
+    #: operator's $set takes effect on the very next prediction
+    #: (ref :194-221). Setting a small TTL keeps the event store off the
+    #: per-query hot path under load (SURVEY §7 hard part (c):
+    #: "prefetch/cache constraint entities host-side") at the cost of
+    #: constraint changes landing within the TTL instead of instantly.
+    constraint_cache_seconds: float = 0.0
 
 
 @dataclass
@@ -173,7 +182,21 @@ class ECommAlgorithm(P2LAlgorithm):
     # -- serve-time filters (ref: ALSAlgorithm.scala:148-267) ---------------
     def _unavailable_items(self) -> set[str]:
         """Latest $set on the 'constraint/unavailableItems' entity
-        (ref :194-221)."""
+        (ref :194-221), cached for ``constraint_cache_seconds``."""
+        ttl = self.params.constraint_cache_seconds
+        if ttl > 0:
+            import time as _time
+
+            cached = getattr(self, "_unavail_cache", None)
+            now = _time.monotonic()
+            if cached is not None and now - cached[0] < ttl:
+                return cached[1]
+            val = self._read_unavailable_items()
+            self._unavail_cache = (now, val)
+            return val
+        return self._read_unavailable_items()
+
+    def _read_unavailable_items(self) -> set[str]:
         try:
             events = list(
                 LEventStore.find_by_entity(

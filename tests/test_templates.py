@@ -436,3 +436,82 @@ class TestBatchPredictParity:
             Query(user="u3", num=2),
             Query(user="missing", num=3),  # popular fallback
         ])
+
+
+class TestConstraintCache:
+    def test_unavailable_items_cached_within_ttl(self, ctx, memory_storage,
+                                                 monkeypatch):
+        """The global constraint read hits the event store once per TTL
+        window, not once per query (SURVEY §7 hard part (c))."""
+        from predictionio_tpu.templates import ecommercerecommendation as ec
+
+        app_id = make_app(memory_storage, "cacheapp")
+        seed_views(memory_storage, app_id, seed=5)
+        algo = ec.ECommAlgorithm(ec.AlgorithmParams(
+            app_name="cacheapp", rank=4, numIterations=2,
+            constraint_cache_seconds=60.0,
+        ))
+        calls = {"n": 0}
+        real = ec.LEventStore.find_by_entity
+
+        def counting(*a, **kw):
+            if kw.get("entity_type") == "constraint":
+                calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(ec.LEventStore, "find_by_entity", counting)
+        for _ in range(5):
+            algo._unavailable_items()
+        assert calls["n"] == 1
+
+        # ttl=0 restores the reference's per-query reads
+        algo0 = ec.ECommAlgorithm(ec.AlgorithmParams(
+            app_name="cacheapp", constraint_cache_seconds=0.0,
+        ))
+        for _ in range(3):
+            algo0._unavailable_items()
+        assert calls["n"] == 4
+
+
+class TestClassificationBatchParity:
+    def test_both_algorithms(self, ctx, memory_storage):
+        from predictionio_tpu.templates.classification import (
+            Query,
+            engine_factory,
+        )
+
+        app_id = make_app(memory_storage, "clsapp2")
+        events = memory_storage.get_events()
+        rng = np.random.default_rng(0)
+        for i in range(80):
+            a0, a1, a2 = rng.integers(0, 10, 3)
+            events.insert(
+                Event(
+                    event="$set", entity_type="user", entity_id=f"u{i}",
+                    properties=DataMap(
+                        {"attr0": int(a0), "attr1": int(a1),
+                         "attr2": int(a2),
+                         "plan": 1.0 if a0 > a1 else 0.0}
+                    ),
+                ),
+                app_id,
+            )
+        engine = engine_factory()
+        ep = engine.engine_params_from_json({
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "clsapp2"}},
+            "algorithms": [
+                {"name": "naive", "params": {"lambda_": 1.0}},
+                {"name": "logistic", "params": {"epochs": 80}},
+            ],
+        })
+        models = engine.train(ctx, ep)
+        queries = [Query(attr0=9, attr1=1, attr2=4),
+                   Query(attr0=1, attr1=9, attr2=4),
+                   Query(attr0=7, attr1=2, attr2=0)]
+        for algo, model in zip(engine._algorithms(ep), models):
+            batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+            for i, q in enumerate(queries):
+                assert batched[i] == algo.predict(model, q), (
+                    f"{type(algo).__name__} query {i}"
+                )
