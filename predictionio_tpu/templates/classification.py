@@ -167,8 +167,6 @@ class NaiveBayesAlgorithm(P2LAlgorithm):
     def batch_predict(self, model: NaiveBayesModel, queries):
         """Micro-batched serving: one score matmul for the drained batch
         (predict_naive_bayes is row-batched already)."""
-        import numpy as np
-
         x = np.array(
             [[q.attr0, q.attr1, q.attr2] for _, q in queries], np.float32
         )

@@ -40,8 +40,14 @@ from predictionio_tpu.parallel.mesh import ComputeContext
 
 @dataclass(frozen=True)
 class Query:
+    """The stock query plus the reference's variant extensions: category
+    filtering (ref: filter-by-category variant ALSAlgorithm.scala:67) and
+    a per-query blacklist (custom-query variant HOWTO)."""
+
     user: str
     num: int = 10
+    categories: tuple[str, ...] | None = None
+    blackList: tuple[str, ...] | None = None
 
 
 @dataclass(frozen=True)
@@ -71,6 +77,9 @@ class TrainingData(SanityCheck):
     users: list[str]
     items: list[str]
     ratings: np.ndarray  # [n] float32
+    #: item → categories from $set properties (the filter-by-category
+    #: variant's movie metadata)
+    item_categories: dict[str, tuple[str, ...]] = field(default_factory=dict)
 
     def sanity_check(self) -> None:
         # ref: DataSource readTraining sanity — empty data fails fast
@@ -94,7 +103,14 @@ class DataSource(PDataSource):
             default_rating=self.params.buy_rating,
         )
         # "buy" events carry no rating property → buy_rating default applies
-        return TrainingData(users, items, ratings)
+        categories = {}
+        for item_id, pm in PEventStore.aggregate_properties(
+            self.params.app_name, "item"
+        ).items():
+            cats = pm.get_opt("categories", list)
+            if cats:
+                categories[item_id] = tuple(str(c) for c in cats)
+        return TrainingData(users, items, ratings, categories)
 
     def read_training(self, ctx: ComputeContext) -> TrainingData:
         return self._read()
@@ -142,6 +158,7 @@ class PreparedData:
     user_idx: np.ndarray
     item_idx: np.ndarray
     ratings: np.ndarray
+    item_categories: dict[str, tuple[str, ...]]
 
 
 class Preparator(PPreparator):
@@ -158,6 +175,7 @@ class Preparator(PPreparator):
             user_idx=user_ids.encode(td.users),
             item_idx=item_ids.encode(td.items),
             ratings=td.ratings,
+            item_categories=td.item_categories,
         )
 
 
@@ -179,6 +197,7 @@ class ALSModel:
     factors: ALSFactors
     user_ids: BiMap
     item_ids: BiMap
+    item_categories: dict[str, tuple[str, ...]] = field(default_factory=dict)
 
 
 class ALSAlgorithm(PAlgorithm):
@@ -207,46 +226,108 @@ class ALSAlgorithm(PAlgorithm):
             n_users=len(pd.user_ids),
             n_items=len(pd.item_ids),
         )
-        return ALSModel(factors, pd.user_ids, pd.item_ids)
+        return ALSModel(factors, pd.user_ids, pd.item_ids, pd.item_categories)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
-        uidx = model.user_ids.get(query.user)
-        if uidx is None:
-            return PredictedResult(())  # unknown user (ref returns empty)
-        q = model.factors.user_features[uidx][None, :]
-        k = min(query.num, len(model.item_ids))
-        scores, idx = top_k_scores(q, model.factors.item_features, k)
-        items = model.item_ids.decode(np.asarray(idx[0]))
-        return PredictedResult(
-            tuple(
-                ItemScore(item, float(s))
-                for item, s in zip(items, np.asarray(scores[0]))
-            )
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    @staticmethod
+    def _query_mask(model: ALSModel, q: Query):
+        """[1, n_items] exclusion mask for the variant filters (category
+        filter — ref filter-by-category ALSAlgorithm.scala:67 — and
+        per-query blacklist), or None when the query uses neither."""
+        if q.categories is None and not q.blackList:
+            return None
+        from predictionio_tpu.models.serving_filters import (
+            build_exclusion_mask,
+        )
+
+        return build_exclusion_mask(
+            model.item_ids,
+            black_list=q.blackList,
+            categories=q.categories,
+            # getattr: models pickled before this field existed restore
+            # without it (pickle bypasses dataclass defaults)
+            item_categories=getattr(model, "item_categories", {}),
         )
 
     def batch_predict(self, model: ALSModel, queries):
-        """Batched eval path: one matmul for all known users."""
+        """Batched serving/eval path: one matmul for all known users,
+        with per-query variant filters stacked into one mask."""
         known = [(i, q) for i, q in queries if q.user in model.user_ids]
         out = [(i, PredictedResult(())) for i, q in queries
                if q.user not in model.user_ids]
         if known:
             uidx = np.array([model.user_ids(q.user) for _, q in known], np.int32)
             k = min(max(q.num for _, q in known), len(model.item_ids))
+            # memoize per query object: the serving layer pads drained
+            # batches by repeating the LAST query, and mask building is a
+            # catalog-sized host loop
+            mask_memo: dict[int, object] = {}
+            masks = []
+            for _, q in known:
+                if id(q) not in mask_memo:
+                    mask_memo[id(q)] = self._query_mask(model, q)
+                masks.append(mask_memo[id(q)])
+            exclude = None
+            if any(m is not None for m in masks):
+                n = len(model.item_ids)
+                exclude = np.concatenate(
+                    [m if m is not None else np.zeros((1, n), bool)
+                     for m in masks],
+                    axis=0,
+                )
             scores, idx = top_k_scores(
-                model.factors.user_features[uidx], model.factors.item_features, k
+                model.factors.user_features[uidx],
+                model.factors.item_features, k, exclude,
             )
+            from predictionio_tpu.models.serving_filters import (
+                topk_to_item_scores,
+            )
+
             for row, (i, q) in enumerate(known):
-                items = model.item_ids.decode(np.asarray(idx[row])[: q.num])
                 out.append(
-                    (i, PredictedResult(tuple(
-                        ItemScore(item, float(s))
-                        for item, s in zip(items, np.asarray(scores[row]))
+                    (i, PredictedResult(topk_to_item_scores(
+                        scores[row], idx[row], model.item_ids, q.num,
+                        ItemScore,
                     )))
                 )
         return out
 
 
 # -- serving ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ServingParams(Params):
+    """The custom-serving variant's blacklist file (ref:
+    custom-serving/src/main/scala/Serving.scala — re-read per request so
+    operators edit the file without redeploying)."""
+
+    filepath: str = ""
+
+
+class FileBlacklistServing(LServing):
+    """Drop disabled products listed one-per-line in ``filepath``
+    (the reference's custom-serving variant)."""
+
+    params_class = ServingParams
+
+    def __init__(self, params: ServingParams | None = None):
+        self.params = params or ServingParams()
+
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        result = predictions[0]
+        if not self.params.filepath:
+            return result
+        try:
+            with open(self.params.filepath) as f:
+                disabled = {line.strip() for line in f if line.strip()}
+        except OSError:
+            return result
+        return PredictedResult(tuple(
+            s for s in result.itemScores if s.item not in disabled
+        ))
 
 
 class Serving(LServing):

@@ -515,3 +515,94 @@ class TestClassificationBatchParity:
                 assert batched[i] == algo.predict(model, q), (
                     f"{type(algo).__name__} query {i}"
                 )
+
+
+class TestRecommendationVariants:
+    """The reference recommendation template's variants (ref:
+    examples/scala-parallel-recommendation/{custom-query,custom-serving,
+    filter-by-category}): category filter, per-query blacklist, and the
+    file-based blacklist Serving."""
+
+    def _model(self, ctx, storage):
+        from predictionio_tpu.templates.recommendation import engine_factory
+
+        app_id = make_app(storage, "recvar")
+        events = storage.get_events()
+        rng = np.random.default_rng(0)
+        for u in range(25):
+            for _ in range(6):
+                i = rng.integers(0, 15)
+                events.insert(
+                    Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": float(1 + (u + i) % 5)})),
+                    app_id,
+                )
+        for i in range(15):
+            events.insert(
+                Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                      properties=DataMap(
+                          {"categories": ["even" if i % 2 == 0 else "odd"]})),
+                app_id,
+            )
+        engine = engine_factory()
+        ep = engine.engine_params_from_json({
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "recvar"}},
+            "algorithms": [
+                {"name": "als",
+                 "params": {"rank": 6, "numIterations": 5, "seed": 0}},
+            ],
+        })
+        return engine, ep, engine.train(ctx, ep)[0]
+
+    def test_category_and_blacklist_filters(self, ctx, memory_storage):
+        from predictionio_tpu.templates.recommendation import Query
+
+        engine, ep, model = self._model(ctx, memory_storage)
+        algo = engine._algorithms(ep)[0]
+        r = algo.predict(model, Query(user="u1", num=10, categories=("even",)))
+        assert r.itemScores
+        assert all(int(s.item[1:]) % 2 == 0 for s in r.itemScores)
+        r = algo.predict(model, Query(user="u1", num=20, blackList=("i2", "i4")))
+        assert {"i2", "i4"}.isdisjoint({s.item for s in r.itemScores})
+        # plain queries are unaffected (no mask path)
+        assert algo.predict(model, Query(user="u1", num=5)).itemScores
+
+    def test_file_blacklist_serving(self, ctx, memory_storage, tmp_path):
+        from predictionio_tpu.templates.recommendation import (
+            FileBlacklistServing,
+            Query,
+            ServingParams,
+        )
+
+        engine, ep, model = self._model(ctx, memory_storage)
+        algo = engine._algorithms(ep)[0]
+        base = algo.predict(model, Query(user="u2", num=5))
+        top = base.itemScores[0].item
+        path = tmp_path / "disabled.txt"
+        path.write_text(f"{top}\n")
+        serving = FileBlacklistServing(ServingParams(filepath=str(path)))
+        served = serving.serve(Query(user="u2", num=5), [base])
+        assert top not in {s.item for s in served.itemScores}
+        # operators edit the file live: re-read on every request
+        path.write_text("")
+        served2 = serving.serve(Query(user="u2", num=5), [base])
+        assert top in {s.item for s in served2.itemScores}
+
+    def test_old_pickled_model_without_categories_still_serves(
+        self, ctx, memory_storage
+    ):
+        """Models persisted before item_categories existed restore via
+        pickle WITHOUT the attribute (pickle bypasses dataclass
+        defaults); filtered queries must not crash on them."""
+        from predictionio_tpu.templates.recommendation import Query
+
+        engine, ep, model = self._model(ctx, memory_storage)
+        algo = engine._algorithms(ep)[0]
+        del model.__dict__["item_categories"]  # simulate an old blob
+        r = algo.predict(model, Query(user="u1", num=5, blackList=("i1",)))
+        assert "i1" not in {s.item for s in r.itemScores}
+        # category filters degrade to empty results (no metadata), not 500s
+        r2 = algo.predict(model, Query(user="u1", num=5, categories=("even",)))
+        assert r2.itemScores == ()
