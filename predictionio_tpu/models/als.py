@@ -81,8 +81,13 @@ class ALSParams:
     #: not O(bucket) — at ML-20M rank 64 the unchunked gather alone is
     #: >12 GB, past a v5e chip.
     max_solve_elems: int = 1 << 28
-    #: Solver choice. ``bucket`` (the ``auto`` pick) is the ALX-style
-    #: degree-bucketed dense batched solve; ``segment`` builds the normal
+    #: Solver choice. ``auto`` picks ``dense`` (whole-catalog int8
+    #: matmul normal equations, models/als_dense.py) when the densified
+    #: rating matrix fits the HBM budget and the ratings are int8-encodable
+    #: — ~14x the bucket solver's rate at ML-20M, where the bucket path is
+    #: HBM-gather-tile-amplification-bound (docs/perf.md). ``bucket`` is
+    #: the ALX-style degree-bucketed gather solve (the general fallback:
+    #: any catalog size, sharded meshes); ``segment`` builds the normal
     #: equations by sorted segment-sum over ratings — correct and
     #: memory-lean, but its scatter-based reduction measured slower on v5e.
     solver: str = "auto"
@@ -744,11 +749,29 @@ def _als_iteration_segment(
     return user_f, item_f
 
 
-@jax.jit
-def _rmse_terms(user_f, item_f, u_idx, i_idx, rating, weight):
-    pred = jnp.einsum("nr,nr->n", user_f[u_idx], item_f[i_idx])
-    err = (pred - rating) ** 2 * weight
-    return err.sum(), weight.sum()
+@partial(jax.jit, static_argnames=("nc",))
+def _rmse_terms(user_f, item_f, u_idx, i_idx, rating, weight, nc: int = 1):
+    """Weighted squared-error sum. ``nc`` > 1 evaluates in sequential row
+    chunks: the factor row-gathers tile-pad rank -> 128 lanes (~12.8x), so
+    an unchunked 20M-row gather materializes ~10 GB of temps — past HBM."""
+
+    def terms(args):
+        u, i, r, w = args
+        pred = jnp.einsum("nr,nr->n", user_f[u], item_f[i])
+        err = (pred - r) ** 2 * w
+        return err.sum(), w.sum()
+
+    if nc == 1:
+        return terms((u_idx, i_idx, rating, weight))
+    c = u_idx.shape[0] // nc
+    xs = tuple(x.reshape(nc, c) for x in (u_idx, i_idx, rating, weight))
+    sq, wt = jax.lax.map(terms, xs)
+    return sq.sum(), wt.sum()
+
+
+#: Row-chunk target for _rmse_terms: the [c, rank] gathers' lane-padded
+#: temps stay ~1 GB at this chunk size.
+_RMSE_CHUNK = 2_000_000
 
 
 class ALS:
@@ -779,17 +802,32 @@ class ALS:
         if user_idx.size == 0:
             raise ValueError("ALS.train called with zero ratings")
 
-        if p.solver not in ("auto", "bucket", "segment"):
+        if p.solver not in ("auto", "bucket", "segment", "dense"):
             raise ValueError(
-                f"ALSParams.solver must be auto/bucket/segment, got {p.solver!r}"
+                "ALSParams.solver must be auto/dense/bucket/segment, "
+                f"got {p.solver!r}"
             )
-        # auto → bucket: the segment-sum path's scatter-heavy reduction
-        # measured slower than the dense bucketed reduce on v5e (it remains
-        # available as an explicit option and for very skewed graphs)
         if p.solver == "segment":
             return self._train_segment(
                 user_idx, item_idx, ratings, n_users, n_items, callback
             )
+        if p.solver in ("auto", "dense"):
+            from predictionio_tpu.models import als_dense
+
+            if p.solver == "dense" and not als_dense.dense_eligible(
+                    n_users, n_items, ratings):
+                raise ValueError(
+                    "solver='dense' requires int8-encodable ratings and "
+                    f"n_users*n_items <= {als_dense.DENSE_MAX_BYTES} cells"
+                )
+            if p.solver == "dense" or als_dense.auto_pick(
+                    ctx, n_users, n_items, ratings):
+                user_f, item_f = als_dense.train_dense(
+                    ctx, p, user_idx, item_idx, ratings, n_users, n_items,
+                    callback)
+                packed = np.asarray(
+                    jnp.concatenate([user_f, item_f], axis=0))
+                return ALSFactors(packed[:n_users], packed[n_users:])
 
         multi = ctx.mesh.devices.size > 1
         key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
@@ -925,15 +963,27 @@ class ALS:
         ratings: np.ndarray,
     ) -> float:
         ctx = self.ctx
-        u, n = ctx.device_put_sharded_rows(np.asarray(user_idx, np.int32))
-        i, _ = ctx.device_put_sharded_rows(np.asarray(item_idx, np.int32))
-        r, _ = ctx.device_put_sharded_rows(np.asarray(ratings, np.float32))
-        w = np.zeros(u.shape[0], np.float32)
+        n = len(user_idx)
+        nc = max(1, -(-n // _RMSE_CHUNK))
+        unit = ctx.n_devices
+        c = -(-n // (nc * unit)) * unit
+        total = nc * c
+
+        def put(x, dtype):
+            x = np.asarray(x, dtype)
+            if len(x) != total:
+                x = np.concatenate([x, np.zeros(total - len(x), dtype)])
+            return jax.device_put(x, ctx.batch_sharding())
+
+        u = put(user_idx, np.int32)
+        i = put(item_idx, np.int32)
+        r = put(ratings, np.float32)
+        w = np.zeros(total, np.float32)
         w[:n] = 1.0
         w = jax.device_put(w, ctx.batch_sharding())
         uf = jax.device_put(jnp.asarray(factors.user_features), ctx.replicated)
         vf = jax.device_put(jnp.asarray(factors.item_features), ctx.replicated)
-        sq, cnt = _rmse_terms(uf, vf, u, i, r, w)
+        sq, cnt = _rmse_terms(uf, vf, u, i, r, w, nc=nc)
         return float(np.sqrt(sq / cnt))
 
 

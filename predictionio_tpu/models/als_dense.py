@@ -1,0 +1,477 @@
+"""Dense-operand ALS solver: normal equations as whole-catalog MXU matmuls.
+
+Replaces the degree-bucketed *gather* formulation (models/als.py) for
+problems whose rating matrix fits HBM densified. Motivation (round-3 perf
+study, docs/perf.md): TPU gathers are HBM-tile-granular, so the bucket
+solver's per-rating factor-row gather reads a ~4 KB tile for every ~40 B
+logical row — it runs at ~60% of the HBM roofline yet delivers <1% useful
+bytes. The fix is a *reformulation*, not a faster gather: materialize the
+rating matrix ``A`` once as dense int8 (constant across iterations) and
+compute each half-step's normal equations as two large dense matmuls —
+
+    explicit:  gram pairs = ind(A) @ [pairs(Y) | 1]      (count column)
+               rhs        = A @ Y / scale
+    implicit:  corrections= A @ [pairs(Y) | Y]           (Hu-Koren c-1)
+               rhs/count  = ind(A) @ [Y | 1]
+
+which the MXU executes at O(TFLOP/s) instead of the gather's
+O(10 GFLOP/s). One rating cell is one int8 byte, so HBM traffic per
+iteration is ~2 x bytes(A) instead of ~4 KB x nnz: at MovieLens-20M
+(138k x 27k, 20M ratings, rank 10) this is ~25 ms/iteration vs ~360 ms
+for the gather path — both measured on one v5e chip.
+
+Exactness: the dense matrix holds each cell's single rating (times a
+lossless x2 scale when ratings are half-stars). Cells rated more than
+once (possible in synthetic/test data; real MovieLens rates each pair
+once) and zero-valued ratings cannot ride the dense cells, so they are
+collapsed host-side into a per-cell (count, value-sum) side-COO and
+applied as f32 segment-sum corrections to the normal equations — every
+input edge contributes exactly once, like MLlib's. One deliberate
+difference from the bucket solver: ``ALSParams.max_degree`` is that
+solver's tile-capacity cap (entities beyond it get their excess edges
+TRUNCATED); the dense formulation has no tiles and uses all edges, so
+for entities above max_degree the two solvers legitimately differ — the
+dense result is the faithful one.
+
+The solve itself reuses models/als.py's structure-of-arrays Cholesky and
+ALS-WR count-scaled regularization (ref MLlib semantics:
+examples/scala-parallel-recommendation/custom-serving/src/main/scala/
+ALSAlgorithm.scala:55-61).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: Auto-gate budget for the densified rating matrix, in bytes (int8: one
+#: byte per user x item cell). ML-20M is ~3.7 GB; a v5e chip has ~15 GB
+#: usable, and the solver needs ~2x(A block) of bf16 transients on top.
+DENSE_MAX_BYTES = 6_000_000_000
+
+#: Target bytes per row-block of A. Blocks bound the scatter transient
+#: (XLA promotes int8 scatter operands internally) and set the unit the
+#: iteration loop walks.
+_BLOCK_BYTES = 1_000_000_000
+
+
+def _int8_scale(vals: np.ndarray) -> int:
+    """Lossless int8 encoding scale for the rating values: 1 (integers),
+    2 (half-steps, e.g. MovieLens 0.5..5.0 stars), or 0 (not encodable —
+    the dense solver does not apply)."""
+    for s in (1, 2):
+        v = vals * s
+        if np.all(v == np.rint(v)) and np.all(np.abs(v) <= 127):
+            return s
+    return 0
+
+
+def dense_eligible(n_users: int, n_items: int, ratings: np.ndarray,
+                   max_bytes: int = DENSE_MAX_BYTES) -> bool:
+    """Whether the dense solver applies: the densified matrix fits the
+    byte budget and the values are losslessly int8-encodable."""
+    cells = int(n_users) * int(n_items)
+    return cells <= max_bytes and _int8_scale(ratings) != 0
+
+
+def auto_pick(ctx, n_users: int, n_items: int, ratings: np.ndarray) -> bool:
+    """The ``solver="auto"`` gate, shared by ALS.train and bench.py: dense
+    wants a single device (it runs replicated, not sharded), a density
+    above ~1/2000 (below that the gather's nnz-proportional traffic beats
+    reading every dense cell), the HBM byte budget, and int8-encodable
+    values — cheap checks first, the full ratings scan last."""
+    cells = int(n_users) * int(n_items)
+    return (
+        ctx.mesh.devices.size == 1
+        and ratings.size * 2000 >= cells
+        and cells <= DENSE_MAX_BYTES
+        and _int8_scale(ratings) != 0
+    )
+
+
+@dataclass
+class _DupSide:
+    """Collapsed correction cells for one solve direction, sorted by the
+    entity being solved: cells rated more than once contribute
+    (count-1 extra multiplicity, value-sum minus the densified rating),
+    zero-valued cells contribute (count, 0)."""
+
+    seg: np.ndarray  # [nd] int32 entity index (sorted ascending)
+    nbr: np.ndarray  # [nd] int32 fixed-side index
+    cnt: np.ndarray  # [nd] f32 extra multiplicity for the gram/count terms
+    val: np.ndarray  # [nd] f32 extra value mass for the rhs term
+
+
+@dataclass
+class _DensePlan:
+    """Host-prepared dense-solve inputs (see ``_dense_prepare``)."""
+
+    nb: int  # number of user-row blocks of A
+    ub: int  # rows per block (padded; nb*ub >= n_users)
+    flat: list  # nb x [m_b] int32 block-local flat cell (>=ub*n_items: pad)
+    vals: list  # nb x [m_b] int8 scaled rating (0 on padding)
+    scale: int  # rating -> int8 multiplier (1 or 2)
+    dup_u: _DupSide | None  # corrections for the user-side solve
+    dup_i: _DupSide | None  # corrections for the item-side solve
+    n_users: int
+    n_items: int
+
+
+def _sort_by_cell(ui, ii, vals, n_users: int, n_items: int):
+    """(u, i, v) sorted by (user, item): two stable counting-sort passes
+    (item first, then user) through models/als.py's C fast path — ~4x
+    faster than one 20M-row int64 argsort."""
+    from predictionio_tpu.models.als import _histogram, _sorted_side
+
+    counts_i, starts_i = _histogram(ii, n_items)
+    u_by_item, v_by_item = _sorted_side(ii, starts_i, ui, vals)
+    item_keys = np.repeat(
+        np.arange(n_items, dtype=np.int32), counts_i.astype(np.int64))
+    _c, starts_u = _histogram(u_by_item, n_users)
+    si, sv = _sorted_side(u_by_item, starts_u, item_keys, v_by_item)
+    counts_u = np.diff(np.append(starts_u, len(ui)))
+    su = np.repeat(
+        np.arange(n_users, dtype=np.int32), counts_u.astype(np.int64))
+    return su, si, sv
+
+
+def _collapse_corrections(su, si, sv, main_mask):
+    """Per-cell (entity-sorted) correction arrays from the cell-sorted
+    edges. ``main_mask`` marks the one edge per cell carried by the dense
+    matrix (False everywhere for zero-valued cells)."""
+    extra = ~main_mask
+    if not extra.any():
+        return None, None
+    # collapse the extra edges per cell: multiplicity + value mass
+    eu, ei = su[extra], si[extra]
+    cell_start = np.flatnonzero(np.concatenate(
+        [[True], (eu[1:] != eu[:-1]) | (ei[1:] != ei[:-1])]))
+    cnt = np.diff(np.append(cell_start, len(eu))).astype(np.float32)
+    valsum = np.add.reduceat(
+        sv[extra].astype(np.float64), cell_start).astype(np.float32)
+    du = eu[cell_start]
+    di = ei[cell_start]
+    # user-side view is already (u, i)-sorted; item side needs its own sort
+    u_side = _DupSide(du.astype(np.int32), di.astype(np.int32), cnt, valsum)
+    o = np.argsort(di, kind="stable")
+    i_side = _DupSide(
+        di[o].astype(np.int32), du[o].astype(np.int32), cnt[o], valsum[o])
+    return u_side, i_side
+
+
+def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
+                   scale: int | None = None) -> _DensePlan:
+    if scale is None:
+        scale = _int8_scale(vals)
+    assert scale, "dense solver requires int8-encodable ratings"
+    su, si, sv = _sort_by_cell(ui, ii, vals, n_users, n_items)
+    first = np.concatenate(
+        [[True], (su[1:] != su[:-1]) | (si[1:] != si[:-1])])
+    # the densified edge per cell: its first occurrence — unless the value
+    # is 0 (indistinguishable from an empty cell), which rides corrections
+    main = first & (sv != 0)
+    dup_u, dup_i = _collapse_corrections(su, si, sv, main)
+    if dup_u is None:  # common case: all cells rated once, nonzero
+        mu, mi = su, si
+        mv = (sv * scale).astype(np.int8) if scale != 1 else sv.astype(np.int8)
+    else:
+        mu, mi, mv = su[main], si[main], (sv[main] * scale).astype(np.int8)
+    ub = max(_BLOCK_BYTES // max(n_items, 1), 1)
+    nb = max((n_users + ub - 1) // ub, 1)
+    ub = (n_users + nb - 1) // nb
+    bounds = np.searchsorted(mu, np.arange(1, nb) * ub)
+    starts = np.concatenate([[0], bounds, [len(mu)]])
+    flat_all = (mu.astype(np.int64) % ub) * n_items + mi
+    oor = ub * n_items  # first out-of-range cell: scatter drops from here
+    flat, bvals = [], []
+    for b in range(nb):
+        lo, hi = starts[b], starts[b + 1]
+        k = hi - lo
+        # padded to a multiple of 1024: XLA's TPU scatter strategy choice
+        # is size-sensitive (awkward update counts fall off a ~40x perf
+        # cliff — measured round 3); the padding cells are ascending
+        # distinct out-of-range ids, dropped by the scatter while keeping
+        # indices_are_sorted/unique_indices true
+        m = max((k + 1023) // 1024 * 1024, 1024)
+        f = np.empty(m, np.int32)
+        v = np.zeros(m, np.int8)
+        f[:k] = flat_all[lo:hi].astype(np.int32)
+        f[k:] = oor + np.arange(m - k, dtype=np.int32)
+        v[:k] = mv[lo:hi]
+        flat.append(f)
+        bvals.append(v)
+    return _DensePlan(nb, ub, flat, bvals, scale, dup_u, dup_i,
+                      n_users, n_items)
+
+
+@partial(jax.jit, static_argnames=("ub", "n_items"))
+def _scatter_block(flat, vals, ub: int, n_items: int):
+    """One row-block of the densified rating matrix, scattered flat (1D):
+    TPU lowers 1D sorted-unique scatters markedly better than 2D ones.
+    Padding cells index >= ub*n_items and are dropped."""
+    a = jnp.zeros((ub * n_items,), jnp.int8)
+    return a.at[flat].set(
+        vals, unique_indices=True, indices_are_sorted=True, mode="drop"
+    ).reshape(ub, n_items)
+
+
+def _pairs_payload(f, rank: int):
+    """[n, pairs+rank+1] f32 payload: upper-triangle factor pair products,
+    the factors, and a ones count column — the matmul right-hand sides.
+
+    Numerical contract (learned the hard way, round 3): the payload stays
+    **f32** and the dots run at ``Precision.HIGHEST``. The gram is
+    assembled from independently-rounded pair-sum dot outputs, so it is
+    only PSD up to the dot's rounding error — and TPU default-precision
+    f32 dots round through bf16 (~1e-3 relative), orders of magnitude
+    above the ALS-WR regularization floor for low-degree entities, which
+    NaN'd the Cholesky. The *left* operands are exact in bf16 (0/1
+    indicators and small-integer ratings), so bf16 x f32 @ HIGHEST
+    measures f32-exact (rel ~4e-7) at the same speed as a default bf16
+    dot."""
+    iu, ju = np.triu_indices(rank)
+    return jnp.concatenate(
+        [f[:, iu] * f[:, ju], f, jnp.ones((f.shape[0], 1), jnp.float32)],
+        axis=1)
+
+
+def _dup_correction(dup, fixed, rank: int, n_entities: int, alpha,
+                    implicit: bool):
+    """f32 segment-sum of the correction cells' normal-equation terms →
+    [n_entities, pairs+rank+1] in the same column layout as the matmul
+    payload (pairs-weight, rhs, count)."""
+    seg, nbr, cnt, val = dup
+    y = fixed[nbr]  # [nd, r] gather — nd is the (small) correction count
+    iu, ju = np.triu_indices(rank)
+    z = y[:, iu] * y[:, ju]
+    if implicit:
+        pair_w = alpha * val  # sum of (c-1) = alpha * value mass
+        rhs_w = cnt + alpha * val  # sum of (1 + alpha r)
+    else:
+        pair_w = cnt
+        rhs_w = val
+    data = jnp.concatenate(
+        [z * pair_w[:, None], y * rhs_w[:, None], cnt[:, None]], axis=1)
+    return jax.ops.segment_sum(
+        data, seg, num_segments=n_entities, indices_are_sorted=True)
+
+
+def _dense_half_solve(
+    prev,  # [n, r] f32 factors being updated
+    fixed,  # [n_other, r] f32 fixed-side factors
+    blocks,  # tuple of [ub, n_other] int8 (user side) — or None (item side)
+    tblocks,  # tuple of [ub, n] int8 to contract over dim 0 — or None
+    dup,  # (seg, nbr, cnt, val) correction arrays or None
+    lambda_, alpha, implicit: bool, rank: int, scale: int,
+    exact: bool = False,
+):
+    """One half-iteration: payload matmuls over the dense blocks + f32
+    corrections + SoA Cholesky solve. Exactly one of ``blocks`` (row
+    blocks: entities on rows) / ``tblocks`` (transposed contraction:
+    entities on columns) is set."""
+    from predictionio_tpu.models.als import _reg_solve
+
+    n = prev.shape[0]
+    n_pairs = rank * (rank + 1) // 2
+    payload = _pairs_payload(fixed, rank)  # [n_other, P+r+1] f32
+    if implicit:
+        # ind @ [Y | 1] -> rhs base + counts; val @ [Z | Y] -> Hu-Koren
+        # gram corrections + alpha-weighted rhs part
+        ind_payload = payload[:, n_pairs:]
+        val_payload = payload[:, : n_pairs + rank]
+    else:
+        # ind @ [Z | 1] -> gram pairs + counts; val @ Y -> rhs
+        ind_payload = jnp.concatenate(
+            [payload[:, :n_pairs], payload[:, -1:]], axis=1)
+        val_payload = payload[:, n_pairs: n_pairs + rank]
+
+    # bf16 left operands are EXACT (0/1 and |scaled rating| <= 127 are all
+    # bf16-representable). The dot whose payload carries the gram PAIRS
+    # must run at HIGHEST (see _pairs_payload's numerical contract): in
+    # explicit mode that is the indicator dot, in implicit mode the value
+    # dot. The other dot only feeds rhs (and exactly-representable
+    # counts), where bf16-payload rounding is the same accepted error
+    # class as the bucket solver's bf16 gather — relaxed unless the
+    # caller asked for the f32 parity mode.
+    hi = jax.lax.Precision.HIGHEST
+    lo = hi if exact else None
+    ind_prec, val_prec = (lo, hi) if implicit else (hi, lo)
+
+    def dots(a, ip, vp, dims):
+        ai = (a != 0).astype(jnp.bfloat16)
+        av = a.astype(jnp.bfloat16)
+        gi = jax.lax.dot_general(ai, ip, (dims, ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=ind_prec)
+        gv = jax.lax.dot_general(av, vp, (dims, ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=val_prec)
+        return gi, gv
+
+    if blocks is not None:
+        gis, gvs = [], []
+        for a in blocks:
+            gi, gv = dots(a, ind_payload, val_payload, ((1,), (0,)))
+            gis.append(gi)
+            gvs.append(gv)
+        gi = jnp.concatenate(gis)[:n]
+        gv = jnp.concatenate(gvs)[:n]
+    else:
+        ub = tblocks[0].shape[0]
+        nb = len(tblocks)
+        # pad the payloads to the blocked row count: the blocks' padding
+        # rows are all-zero, but an unpadded dynamic_slice would CLAMP the
+        # last block's start and misalign every row in it
+        up = nb * ub
+        n_other = ind_payload.shape[0]
+        if up != n_other:
+            ind_payload = jnp.pad(
+                ind_payload, ((0, up - n_other), (0, 0)))
+            val_payload = jnp.pad(
+                val_payload, ((0, up - n_other), (0, 0)))
+        gi = gv = 0.0
+        for b, a in enumerate(tblocks):
+            ip = jax.lax.dynamic_slice(
+                ind_payload, (b * ub, 0), (ub, ind_payload.shape[1]))
+            vp = jax.lax.dynamic_slice(
+                val_payload, (b * ub, 0), (ub, val_payload.shape[1]))
+            d_gi, d_gv = dots(a, ip, vp, ((0,), (0,)))
+            gi, gv = gi + d_gi, gv + d_gv
+
+    if implicit:
+        pairs = gv[:, :n_pairs] * alpha / scale
+        rhs = gi[:, :rank] + alpha * gv[:, n_pairs:] / scale
+        counts = gi[:, -1]
+    else:
+        pairs = gi[:, :n_pairs]
+        rhs = gv / scale
+        counts = gi[:, -1]
+
+    if dup is not None:
+        corr = _dup_correction(dup, fixed, rank, n, alpha, implicit)
+        pairs = pairs + corr[:, :n_pairs]
+        rhs = rhs + corr[:, n_pairs: n_pairs + rank]
+        counts = counts + corr[:, -1]
+
+    iu, ju = np.triu_indices(rank)
+    gram = jnp.zeros((n, rank, rank), jnp.float32)
+    gram = gram.at[:, iu, ju].set(pairs)
+    gram = gram.at[:, ju, iu].set(pairs)
+    if implicit:
+        gram = gram + (fixed.T @ fixed)[None, :, :]
+    reg = lambda_ * jnp.maximum(counts, 1.0) + 1e-8
+    sol = _reg_solve(gram, rhs, reg, rank)
+    # zero-degree entities keep their previous factors
+    return jnp.where(counts[:, None] > 0, sol, prev)
+
+
+def _iteration_dense(user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha,
+                     implicit, rank, scale, exact):
+    user_f = _dense_half_solve(
+        user_f, item_f, blocks, None, dup_u, lambda_, alpha, implicit,
+        rank, scale, exact)
+    item_f = _dense_half_solve(
+        item_f, user_f, None, blocks, dup_i, lambda_, alpha, implicit,
+        rank, scale, exact)
+    return user_f, item_f
+
+
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "scale", "exact"),
+    donate_argnums=(0, 1),
+)
+def _dense_train(
+    user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha, iters,
+    *, implicit: bool, rank: int, scale: int, exact: bool = False,
+):
+    """The whole dense training run as one XLA dispatch (fori_loop) —
+    per-call dispatch through a tunneled TPU costs ~15 ms, which would
+    rival the ~25 ms iteration itself."""
+    def body(_i, carry):
+        uf, itf = carry
+        return _iteration_dense(uf, itf, blocks, dup_u, dup_i, lambda_,
+                                alpha, implicit, rank, scale, exact)
+
+    return jax.lax.fori_loop(0, iters, body, (user_f, item_f))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "scale", "exact"),
+    donate_argnums=(0, 1),
+)
+def _dense_iteration(
+    user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha,
+    *, implicit: bool, rank: int, scale: int, exact: bool = False,
+):
+    """One iteration as its own dispatch — the per-iteration callback path
+    (convergence probes)."""
+    return _iteration_dense(
+        user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha, implicit,
+        rank, scale, exact)
+
+
+def prepare_device_inputs(plan: _DensePlan):
+    """(blocks, dup_u, dup_i) device arrays from a host plan — the
+    scatter-densified int8 row blocks plus the correction-cell arrays.
+    Shared by train_dense and bench.py's steady-state timer so both time
+    the same program."""
+    blocks = tuple(
+        _scatter_block(
+            jax.device_put(plan.flat[b]), jax.device_put(plan.vals[b]),
+            ub=plan.ub, n_items=plan.n_items)
+        for b in range(plan.nb)
+    )
+    dup_u = dup_i = None
+    if plan.dup_u is not None:
+        dup_u = tuple(jax.device_put(x) for x in (
+            plan.dup_u.seg, plan.dup_u.nbr, plan.dup_u.cnt, plan.dup_u.val))
+        dup_i = tuple(jax.device_put(x) for x in (
+            plan.dup_i.seg, plan.dup_i.nbr, plan.dup_i.cnt, plan.dup_i.val))
+    return blocks, dup_u, dup_i
+
+
+def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
+                callback=None):
+    """Driver: prepare + densify + train. Returns (user_f, item_f) as
+    device arrays; models/als.ALS.train wraps this."""
+    from predictionio_tpu.models.als import _init_factors
+
+    p = params
+    plan = _dense_prepare(ui, ii, ratings, n_users, n_items)
+    nd = 0 if plan.dup_u is None else len(plan.dup_u.seg)
+    logger.info(
+        "ALS(dense): %d ratings -> %d x %d int8 cells in %d blocks, "
+        "%d correction cells, scale %d, rank %d",
+        len(ratings), n_users, n_items, plan.nb, nd, plan.scale, p.rank)
+
+    key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+    ku, ki = jax.random.split(key)
+    user_f = _init_factors(ku, n_users, p.rank)
+    item_f = _init_factors(ki, n_items, p.rank)
+    blocks, dup_u, dup_i = prepare_device_inputs(plan)
+
+    # gather_dtype="float32" is the parity-study mode: every dot at
+    # HIGHEST. The default runs the gram-pairs dot at HIGHEST (a PSD
+    # requirement, see _pairs_payload) and the rhs dot relaxed.
+    static = dict(implicit=p.implicit_prefs, rank=p.rank, scale=plan.scale,
+                  exact=p.gather_dtype == "float32")
+    if callback is None:
+        user_f, item_f = _dense_train(
+            user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
+            p.num_iterations, **static)
+    else:
+        for it in range(p.num_iterations):
+            user_f, item_f = _dense_iteration(
+                user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
+                **static)
+            callback(it, user_f, item_f)
+    return user_f, item_f

@@ -1,0 +1,158 @@
+"""Dense-operand ALS solver (models/als_dense.py) correctness.
+
+The dense solver is a pure reformulation of the bucket solver's normal
+equations (whole-catalog int8 matmuls instead of per-rating gathers), so
+its contract is edge-for-edge equivalence: same math as the independent
+numpy reference and the bucket solver, including duplicate cells and
+zero-valued ratings, which ride a side-correction path."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import als_dense
+from predictionio_tpu.models.als import ALS, ALSParams
+from predictionio_tpu.parallel.mesh import compute_context
+from tests.test_als_parity import _init_factors_of, _ratings, numpy_als
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+@pytest.mark.parametrize("implicit", [False, True], ids=["explicit", "implicit"])
+def test_dense_matches_independent_dense_solver(ctx, implicit):
+    ui, ii, r = _ratings()
+    n_users, n_items = 50, 35
+    if implicit:
+        r = (r >= 4).astype(np.float32) * 2.0
+        keep = r > 0
+        ui, ii, r = ui[keep], ii[keep], r[keep]
+    params = ALSParams(rank=6, num_iterations=5, lambda_=0.05,
+                       implicit_prefs=implicit, alpha=1.5, seed=7,
+                       solver="dense", gather_dtype="float32")
+    u0, v0 = _init_factors_of(ctx, params, ui, ii, r, n_users, n_items)
+
+    got = ALS(ctx, params).train(ui, ii, r, n_users, n_items)
+    want_u, want_v = numpy_als(
+        u0, v0, ui, ii, r, iters=5, lam=0.05, alpha=1.5, implicit=implicit)
+    np.testing.assert_allclose(got.user_features, want_u, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(got.item_features, want_v, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("implicit", [False, True], ids=["explicit", "implicit"])
+def test_dense_matches_bucket_on_duplicate_cells(ctx, implicit):
+    """Cells rated multiple times (sampling with replacement) must
+    contribute once per edge, exactly like the bucket solver."""
+    rng = np.random.default_rng(4)
+    n_users, n_items, nnz = 40, 30, 900  # heavy duplication
+    ui = rng.integers(0, n_users, nnz).astype(np.int32)
+    ii = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    common = dict(rank=5, num_iterations=4, lambda_=0.03, seed=2,
+                  implicit_prefs=implicit, alpha=1.2,
+                  gather_dtype="float32")
+    want = ALS(ctx, ALSParams(solver="bucket", **common)).train(
+        ui, ii, r, n_users, n_items)
+    got = ALS(ctx, ALSParams(solver="dense", **common)).train(
+        ui, ii, r, n_users, n_items)
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=3e-3, atol=3e-3)
+    np.testing.assert_allclose(
+        got.item_features, want.item_features, rtol=3e-3, atol=3e-3)
+
+
+def test_dense_zero_valued_ratings_keep_gram_weight(ctx):
+    """An explicit rating of exactly 0 cannot ride the int8 cells (0 means
+    'unobserved' there) — it must still add its gram/count contribution
+    via the correction path."""
+    ui = np.array([0, 0, 1, 1, 2], dtype=np.int32)
+    ii = np.array([0, 1, 0, 2, 1], dtype=np.int32)
+    r = np.array([5.0, 0.0, 3.0, 0.0, 4.0], dtype=np.float32)
+    common = dict(rank=3, num_iterations=3, lambda_=0.1, seed=5,
+                  gather_dtype="float32")
+    want = ALS(ctx, ALSParams(solver="bucket", **common)).train(ui, ii, r, 4, 4)
+    got = ALS(ctx, ALSParams(solver="dense", **common)).train(ui, ii, r, 4, 4)
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        got.item_features, want.item_features, rtol=1e-4, atol=1e-4)
+
+
+def test_dense_half_star_ratings_use_scale_two(ctx):
+    """MovieLens half-star ratings (0.5..5.0) encode losslessly at x2."""
+    rng = np.random.default_rng(8)
+    ui, ii, _ = _ratings(seed=8)
+    r = (rng.integers(1, 11, len(ui)) * 0.5).astype(np.float32)
+    assert als_dense._int8_scale(r) == 2
+    common = dict(rank=4, num_iterations=4, lambda_=0.05, seed=1,
+                  gather_dtype="float32")
+    want = ALS(ctx, ALSParams(solver="bucket", **common)).train(ui, ii, r, 50, 35)
+    got = ALS(ctx, ALSParams(solver="dense", **common)).train(ui, ii, r, 50, 35)
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=3e-3, atol=3e-3)
+
+
+def test_dense_entities_without_ratings_stay_at_init(ctx):
+    ui = np.array([0, 0, 1, 2], dtype=np.int32)
+    ii = np.array([0, 1, 1, 0], dtype=np.int32)
+    r = np.array([5.0, 3.0, 4.0, 1.0], dtype=np.float32)
+    params = ALSParams(rank=4, num_iterations=3, lambda_=0.1, seed=11,
+                       solver="dense")
+    u0, v0 = _init_factors_of(ctx, params, ui, ii, r, 6, 5)
+    got = ALS(ctx, params).train(ui, ii, r, 6, 5)
+    np.testing.assert_allclose(got.user_features[3:], u0[3:], atol=1e-6)
+    np.testing.assert_allclose(got.item_features[2:], v0[2:], atol=1e-6)
+
+
+def test_dense_multi_block_matches_single_block(ctx, monkeypatch):
+    """Row-blocked A (the ML-20M layout: several ~1 GB int8 blocks) must
+    be exactly equivalent to one block — covers the block split, the
+    padded scatter, and the transposed item-side contraction."""
+    ui, ii, r = _ratings(n_users=60, n_items=40, density=0.4, seed=12)
+    common = dict(rank=5, num_iterations=4, lambda_=0.02, seed=3,
+                  solver="dense", gather_dtype="float32")
+    want = ALS(ctx, ALSParams(**common)).train(ui, ii, r, 60, 40)
+    monkeypatch.setattr(als_dense, "_BLOCK_BYTES", 40 * 17)  # force 4 blocks
+    got = ALS(ctx, ALSParams(**common)).train(ui, ii, r, 60, 40)
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        got.item_features, want.item_features, rtol=1e-4, atol=1e-5)
+
+
+def test_dense_callback_path_matches_fused(ctx):
+    """Per-iteration callback dispatch equals the single fori_loop train."""
+    ui, ii, r = _ratings(seed=6)
+    common = dict(rank=4, num_iterations=3, lambda_=0.05, seed=9,
+                  solver="dense", gather_dtype="float32")
+    want = ALS(ctx, ALSParams(**common)).train(ui, ii, r, 50, 35)
+    seen = []
+    got = ALS(ctx, ALSParams(**common)).train(
+        ui, ii, r, 50, 35, callback=lambda it, uf, itf: seen.append(it))
+    assert seen == [0, 1, 2]
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=1e-5, atol=1e-6)
+
+
+def test_dense_eligibility_gate():
+    ints = np.array([1.0, 5.0, 3.0], np.float32)
+    halves = np.array([0.5, 4.5], np.float32)
+    odd = np.array([1.25, 3.0], np.float32)
+    assert als_dense._int8_scale(ints) == 1
+    assert als_dense._int8_scale(halves) == 2
+    assert als_dense._int8_scale(odd) == 0
+    assert als_dense.dense_eligible(1000, 1000, ints)
+    assert not als_dense.dense_eligible(1000, 1000, odd)
+    assert not als_dense.dense_eligible(10**6, 10**5, ints)  # over budget
+
+
+def test_dense_rejects_non_encodable_ratings(ctx):
+    ui, ii, r = _ratings(seed=2)
+    r = r + 0.25  # not int8-encodable at x1 or x2
+    with pytest.raises(ValueError, match="dense"):
+        ALS(ctx, ALSParams(solver="dense")).train(ui, ii, r, 50, 35)
+    # auto quietly falls back to the bucket solver
+    f = ALS(ctx, ALSParams(solver="auto", rank=4, num_iterations=2)).train(
+        ui, ii, r, 50, 35)
+    assert f.user_features.shape == (50, 4)
