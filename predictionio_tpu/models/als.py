@@ -1033,7 +1033,10 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     b = int(np.shape(query_vecs)[0])
     host_q = isinstance(query_vecs, np.ndarray)
     if host_q:
-        place = serving_device(2.0 * _pow2(b) * n_items * rank)
+        up = _pow2(b) * rank * query_vecs.dtype.itemsize
+        if isinstance(exclude_mask, np.ndarray):
+            up += exclude_mask.nbytes
+        place = serving_device(2.0 * _pow2(b) * n_items * rank, up)
     else:
         place = None
     items = _as_device(item_features, device=place)

@@ -339,6 +339,7 @@ def train_two_tower(
         # in flight (on oversubscribed CPU test meshes async pile-up
         # starves the collective rendezvous and XLA aborts on its
         # stuck-timeout)
+        last_saved = None
         for step in range(start_step, p.steps):
             params, opt_state, loss = one_step(
                 params, opt_state, u_all, i_all, key, step
@@ -348,6 +349,12 @@ def train_two_tower(
                 callback(step, float(loss))
             if checkpointer is not None and checkpointer.should_save(step):
                 checkpointer.save(step, (params, opt_state), fingerprint)
+                last_saved = step
+        # save the final (possibly partial) segment too, mirroring the
+        # fused path — both modes leave identical checkpoint state behind
+        if (checkpointer is not None and p.steps > start_step
+                and last_saved != p.steps - 1):
+            checkpointer.save(p.steps - 1, (params, opt_state), fingerprint)
     if loss is not None:
         logger.info("two-tower final loss: %.4f", float(loss))
 

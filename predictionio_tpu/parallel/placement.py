@@ -43,6 +43,7 @@ import numpy as np
 __all__ = [
     "link_rtt",
     "host_flops_rate",
+    "uplink_rate",
     "serving_device",
     "device_cache_put",
     "host_cache_transform",
@@ -178,6 +179,41 @@ def host_flops_rate() -> float:
     return _measured("host_flops", _measure_host_flops_rate)
 
 
+def _measure_uplink_rate() -> float:
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return float("inf")
+
+    def best_put(nbytes: int) -> float:
+        payload = np.ones(nbytes // 4, np.float32)
+        jax.block_until_ready(jax.device_put(payload, dev))  # warm the path
+        # min-of-N: the link jitter is positive-additive (see bench.py),
+        # so min() converges to the true time from above
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(payload, dev))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # differential sizing cancels the fixed per-put round-trip term (a
+    # blocking put of any size pays ~one RTT, which link_rtt() already
+    # charges to the call): rate = extra bytes / extra time
+    small, large = 1 << 20, 8 << 20
+    dt = best_put(large) - best_put(small)
+    if dt <= 1e-5:
+        # degenerate measurement (very fast local link): charging zero
+        # for uploads just degrades to the bare-RTT model
+        return float("inf")
+    return (large - small) / dt
+
+
+def uplink_rate() -> float:
+    """Measured host->device transfer rate (bytes/s) of the default
+    backend, fixed-cost-corrected (differential sizing)."""
+    return _measured("uplink_rate", _measure_uplink_rate)
+
+
 def _cpu_device():
     try:
         return jax.devices("cpu")[0]
@@ -185,9 +221,13 @@ def _cpu_device():
         return None
 
 
-def serving_device(flops: float):
+def serving_device(flops: float, upload_bytes: float = 0.0):
     """Device to run a serving call of ``flops`` on, or None for the
-    default backend. Decision per the module docstring's cost model."""
+    default backend. Decision per the module docstring's cost model;
+    ``upload_bytes`` (the query batch the call must ship host->device)
+    adds a measured-uplink term to the accelerator side, so large drained
+    micro-batches over a slow link don't get mis-placed by the bare
+    one-RTT approximation."""
     mode = os.environ.get("PIO_SERVING_DEVICE", "auto")
     if mode == "default":
         return None
@@ -198,6 +238,9 @@ def serving_device(flops: float):
         return cpu
     if jax.default_backend() == "cpu":
         return None
-    if flops / host_flops_rate() > link_rtt():
-        return None  # accelerator FLOPs out-pay the link round trip
+    accel_cost = link_rtt() + (
+        upload_bytes / uplink_rate() if upload_bytes else 0.0
+    )
+    if flops / host_flops_rate() > accel_cost:
+        return None  # accelerator FLOPs out-pay round trip + upload
     return cpu

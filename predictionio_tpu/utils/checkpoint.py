@@ -129,8 +129,9 @@ class TrainCheckpointer:
     previous checkpoint intact; stale tmp dirs are swept at construction.
     The newest ``keep`` checkpoints are retained. Checkpoints carry the
     trainer's data/config ``fingerprint``; a mismatched fingerprint at
-    load time means the directory belongs to a different run — it is
-    cleared and the training starts fresh.
+    load time means the directory belongs to a different run — those
+    checkpoints are moved aside (``foreign-*`` stash, removed by
+    ``clear()``) and the training starts fresh.
     """
 
     def __init__(self, directory: str | Path, every: int = 1, keep: int = 2):
@@ -170,10 +171,12 @@ class TrainCheckpointer:
             shutil.rmtree(d, ignore_errors=True)
 
     def clear(self) -> None:
-        """Drop every checkpoint (a finished or abandoned run)."""
+        """Drop every checkpoint (a finished or abandoned run), including
+        foreign-* stashes moved aside by fingerprint mismatches."""
         for d in self.directory.iterdir():
             if d.is_dir() and (
                 d.name.startswith("step-") or d.name.startswith("tmp-")
+                or d.name.startswith("foreign-")
             ):
                 shutil.rmtree(d, ignore_errors=True)
 
@@ -188,7 +191,7 @@ class TrainCheckpointer:
         structure of ``like``, or None if no (matching) checkpoint
         exists. A fingerprint mismatch — different data or
         hyperparameters than the run that wrote the checkpoints —
-        clears the directory and returns None."""
+        moves the foreign checkpoints aside and returns None."""
         dirs = self._step_dirs()
         if not dirs:
             return None
@@ -196,12 +199,23 @@ class TrainCheckpointer:
         fp_file = d / "fingerprint.txt"
         saved_fp = fp_file.read_text() if fp_file.exists() else ""
         if saved_fp != fingerprint:
+            # do NOT delete: a misconfigured checkpoint_dir pointing at
+            # another run's (or a shared) directory must not destroy that
+            # run's checkpoints. Move them aside (unique stash dir: two
+            # mismatching runs may alternate on a shared directory) so
+            # this run's saves can't interleave with them; explicit
+            # clear() deletes stashes too.
+            import tempfile
+
+            stash = Path(tempfile.mkdtemp(
+                prefix="foreign-", dir=self.directory))
+            for _s, sd in dirs:
+                sd.rename(stash / sd.name)
             logger.warning(
                 "checkpoints in %s were written by a different run "
-                "(data/config fingerprint mismatch) — clearing and "
+                "(data/config fingerprint mismatch) — moved aside to %s; "
                 "training from scratch",
-                self.directory,
+                self.directory, stash,
             )
-            self.clear()
             return None
         return step, load_pytree_like(d, like)

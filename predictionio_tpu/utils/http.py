@@ -84,7 +84,12 @@ class _FastHeaders:
 
     def __init__(self, pairs: list[tuple[str, str]]):
         self._pairs = pairs
-        self._lower = {k.lower(): v for k, v in pairs}
+        # first-wins on duplicates, matching the email-parser fallback
+        # path's Message.get (last-wins would let a second Content-Length
+        # silently reframe the body behind a proxy)
+        self._lower = {}
+        for k, v in pairs:
+            self._lower.setdefault(k.lower(), v)
 
     def get(self, name: str, default=None):
         return self._lower.get(name.lower(), default)
@@ -94,6 +99,13 @@ class _FastHeaders:
 
     def items(self):
         return list(self._pairs)
+
+
+def _first_wins_dict(pairs) -> dict:
+    out: dict = {}
+    for k, v in pairs:
+        out.setdefault(k, v)
+    return out
 
 
 #: Date header cache: one strftime per second, not per request.
@@ -215,8 +227,14 @@ class AppServer:
                     if len(line) > 65536:
                         self.send_error(431, "Header line too long")
                         return False
+                    if line == b"":
+                        # EOF mid-headers: the peer vanished — abort the
+                        # connection rather than dispatching a truncated
+                        # request as if the header block had ended
+                        self.close_connection = True
+                        return False
                     raw_lines.append(line)
-                    if line in (b"\r\n", b"\n", b""):
+                    if line in (b"\r\n", b"\n"):
                         break
                     if len(raw_lines) > 100:
                         self.send_error(431, "Too many headers")
@@ -228,8 +246,8 @@ class AppServer:
                         continue
                     name, sep, value = line.partition(b":")
                     if not sep:
-                        folded = True  # malformed: let email.parser decide
-                        continue
+                        self.send_error(400, "Malformed header line")
+                        return False
                     pairs.append(
                         (
                             name.decode("iso-8859-1"),
@@ -245,6 +263,16 @@ class AppServer:
                     self.headers = _FastHeaders(list(msg.items()))
                 else:
                     self.headers = _FastHeaders(pairs)
+                # conflicting duplicate Content-Length values are a
+                # request-smuggling vector behind proxies (RFC 7230 §3.3.2)
+                lengths = {
+                    v.strip()
+                    for k, v in self.headers.items()
+                    if k.lower() == "content-length"
+                }
+                if len(lengths) > 1:
+                    self.send_error(400, "Conflicting Content-Length")
+                    return False
                 conntype = (self.headers.get("Connection") or "").lower()
                 if conntype == "close":
                     self.close_connection = True
@@ -277,7 +305,11 @@ class AppServer:
                     method=self.command,
                     path=parsed.path,
                     query={k: v[0] for k, v in qs.items()},
-                    headers={k: v for k, v in self.headers.items()},
+                    # first-wins on duplicates, matching the framing
+                    # decisions made from _FastHeaders.get above — a
+                    # last-wins dict here would let handlers interpret a
+                    # duplicated header differently than the server framed
+                    headers=_first_wins_dict(self.headers.items()),
                     body=body,
                 )
                 try:

@@ -319,3 +319,46 @@ def test_plugin_rest_with_args(server, monkeypatch):
         assert body["args"] == []
     finally:
         srv.stop()
+
+
+def _raw_http(port: int, payload: bytes) -> bytes:
+    """Send raw bytes on a fresh socket; return whatever the server sends
+    back (empty = connection closed without a response)."""
+    import socket
+
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+        s.sendall(payload)
+        s.shutdown(socket.SHUT_WR)
+        chunks = []
+        while True:
+            b = s.recv(65536)
+            if not b:
+                return b"".join(chunks)
+            chunks.append(b)
+
+
+def test_conflicting_content_length_rejected_400(server):
+    """Two Content-Length headers with different values are a request-
+    smuggling vector — the fast header parser must refuse to pick one
+    (advisor finding, round 2)."""
+    raw = (
+        b"POST /events.json HTTP/1.1\r\nHost: x\r\n"
+        b"Content-Length: 5\r\nContent-Length: 0\r\n\r\nhello"
+    )
+    resp = _raw_http(server["port"], raw)
+    assert resp.startswith(b"HTTP/1.1 400")
+
+
+def test_eof_mid_headers_aborts_without_dispatch(server):
+    """A peer that vanishes mid-header-block must get the connection
+    dropped, not have its truncated request dispatched (advisor finding,
+    round 2)."""
+    raw = b"POST /events.json HTTP/1.1\r\nHost: x\r\n"  # EOF before blank line
+    resp = _raw_http(server["port"], raw)
+    assert resp == b""  # closed, no response written
+
+
+def test_colonless_header_line_rejected_400(server):
+    raw = b"GET / HTTP/1.1\r\nHost x no colon here\r\n\r\n"
+    resp = _raw_http(server["port"], raw)
+    assert resp.startswith(b"HTTP/1.1 400")
