@@ -1027,7 +1027,40 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     placement (parallel/placement.py) — the call runs on the CPU backend
     when the score matmul is too small to out-pay the accelerator's
     measured link RTT. Device-resident queries (e.g. a tower forward that
-    already ran on the accelerator) keep their device."""
+    already ran on the accelerator) keep their device.
+
+    Catalogs beyond one chip's HBM arrive as an ops.topk.ShardedCatalog
+    (mesh-row-sharded, see shard_catalog); those route through the
+    shard_map MIPS with a cross-device candidate merge — placement logic
+    does not apply (the catalog's mesh IS the placement)."""
+    from predictionio_tpu.ops.topk import ShardedCatalog
+
+    if isinstance(item_features, ShardedCatalog):
+        from predictionio_tpu.ops.topk import sharded_topk_scores
+
+        kk = min(k, item_features.n)
+        b = int(np.shape(query_vecs)[0])
+        if kk <= 0:
+            return np.zeros((b, 0), np.float32), np.zeros((b, 0), np.int32)
+        # pow2-pad batch and k like the dense path: the micro-batcher's
+        # varying drain sizes must reuse a handful of compiled shard_map
+        # programs, not one per size
+        bp = _pow2(b)
+        kp = min(_pow2(kk), item_features.n)
+        if bp != b:
+            query_vecs = np.concatenate(
+                [np.asarray(query_vecs),
+                 np.zeros((bp - b,) + np.shape(query_vecs)[1:],
+                          np.asarray(query_vecs).dtype)])
+            if exclude_mask is not None and np.shape(exclude_mask)[0] == b:
+                em = np.asarray(exclude_mask)
+                exclude_mask = np.concatenate(
+                    [em, np.zeros((bp - b,) + em.shape[1:], em.dtype)])
+        scores, idx = sharded_topk_scores(
+            query_vecs, item_features, k=kp,
+            chunk=CHUNKED_TOPK_CHUNK, exclude_mask=exclude_mask)
+        scores, idx = jax.device_get((scores[:b, :kk], idx[:b, :kk]))
+        return scores, idx
     n_items = int(np.shape(item_features)[0])
     rank = int(np.shape(item_features)[1])
     b = int(np.shape(query_vecs)[0])

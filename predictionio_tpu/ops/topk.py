@@ -1,4 +1,4 @@
-"""Chunked maximum-inner-product search — the serving hot op.
+"""Chunked + sharded maximum-inner-product search — the serving hot op.
 
 Every recommendation template's predict is "score the whole item catalog
 against a query vector, return top-k" (ref: MLlib's
@@ -7,15 +7,27 @@ is one MXU matmul + ``lax.top_k``; for catalogs too large to score in one
 tile, :func:`chunked_topk_scores` scans the catalog in fixed-size chunks and
 merges running top-k — peak memory O(chunk + k) instead of O(n_items), with
 static shapes throughout so XLA keeps everything on-device.
+
+Catalogs beyond one chip's HBM shard over a mesh axis instead:
+:func:`shard_catalog` places the item matrix row-sharded over the ``model``
+axis, and :func:`sharded_topk_scores` runs the MIPS as a ``shard_map`` —
+each device scores only its local rows and keeps a local top-k, then one
+``all_gather`` of the tiny [B, k] candidate lists (riding ICI, not HBM)
+feeds a replicated merge. This is the MIPS analog of MLlib's block-sharded
+factor serving (ref: CreateServer.scala:513-520) with the block shuffle
+replaced by an XLA collective.
 """
 
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
@@ -78,3 +90,123 @@ def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192,
 
     (best_s, best_i), _ = lax.scan(step, (init_s, init_i), xs)
     return best_s, best_i
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded catalog MIPS
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShardedCatalog:
+    """An item matrix row-sharded over a mesh axis (see
+    :func:`shard_catalog`). ``items`` is [padded_n, d] with rows beyond
+    ``n`` zero; models/als.top_k_scores recognizes this wrapper and routes
+    through :func:`sharded_topk_scores`."""
+
+    items: jax.Array
+    n: int
+    axis: str = "model"
+
+    @property
+    def mesh(self):
+        return self.items.sharding.mesh
+
+    @property
+    def shape(self):
+        return (self.n, self.items.shape[1])
+
+
+def shard_catalog(mesh, items, axis: str = "model") -> ShardedCatalog:
+    """Place a host catalog [N, D] row-sharded over ``mesh`` axis
+    ``axis``, padded so every device holds the same row count."""
+    items = np.asarray(items)
+    p = mesh.shape[axis]
+    n, d = items.shape
+    padded = -(-n // p) * p
+    if padded != n:
+        items = np.concatenate(
+            [items, np.zeros((padded - n, d), items.dtype)])
+    arr = jax.device_put(items, NamedSharding(mesh, P(axis, None)))
+    return ShardedCatalog(arr, n, axis)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_topk_fn(mesh, axis: str, k: int, n: int, local_n: int,
+                     chunk: int, has_mask: bool):
+    """Compiled shard_map MIPS for one (mesh, shape, k) configuration."""
+    kl = min(k, local_n)
+
+    def local_topk(q, it, em):
+        base = lax.axis_index(axis) * local_n
+        if local_n > chunk:
+            # catalog padding rows (global id >= n, zero vectors scoring
+            # 0) must be masked BEFORE the local top-k — re-masking after
+            # would let them displace valid negative-score candidates
+            pad = (base + jnp.arange(local_n, dtype=jnp.int32))[None, :] >= n
+            pad = jnp.broadcast_to(pad, (q.shape[0], local_n))
+            em = pad if em is None else (em | pad)
+            ls, li = chunked_topk_scores(q, it, k=kl, chunk=chunk,
+                                         exclude_mask=em)
+        else:
+            s = q @ it.T  # [B, local_n]
+            idx = base + jnp.arange(local_n, dtype=jnp.int32)[None, :]
+            valid = idx < n
+            if em is not None:
+                valid = valid & ~em
+            s = jnp.where(valid, s, -jnp.inf)
+            ls, li = lax.top_k(s, kl)
+        gi = base + li
+        # each device contributes its kl best; the merge inputs are tiny
+        # [B, kl] lists — the all-gather moves O(p*B*k), not catalog rows
+        alls = lax.all_gather(ls, axis)  # [p, B, kl]
+        alli = lax.all_gather(gi, axis)
+        b = q.shape[0]
+        cand_s = alls.transpose(1, 0, 2).reshape(b, -1)
+        cand_i = alli.transpose(1, 0, 2).reshape(b, -1)
+        ms, sel = lax.top_k(cand_s, k)
+        return ms, jnp.take_along_axis(cand_i, sel, axis=1)
+
+    if has_mask:
+        fn = local_topk
+        in_specs = (P(), P(axis, None), P(None, axis))
+    else:
+        def fn(q, it):
+            return local_topk(q, it, None)
+
+        in_specs = (P(), P(axis, None))
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
+        check_vma=False,
+    ))
+
+
+def sharded_topk_scores(queries, catalog: ShardedCatalog, *, k: int = 10,
+                        chunk: int = 8192, exclude_mask=None):
+    """Top-k inner-product search over a mesh-sharded catalog.
+
+    queries [B, D] (replicated); returns (scores [B, k], indices [B, k])
+    replicated on every device. ``exclude_mask`` [B, n] True → drop, as in
+    :func:`chunked_topk_scores`.
+    """
+    mesh = catalog.mesh
+    p = mesh.shape[catalog.axis]
+    padded_n = catalog.items.shape[0]
+    local_n = padded_n // p
+    k = min(k, catalog.n)
+    queries = jax.device_put(jnp.asarray(queries), NamedSharding(mesh, P()))
+    args = [queries, catalog.items]
+    if exclude_mask is not None:
+        em = jnp.asarray(exclude_mask)
+        if em.shape[0] == 1 and queries.shape[0] != 1:
+            em = jnp.broadcast_to(
+                em, (queries.shape[0],) + em.shape[1:])
+        if em.shape[1] != padded_n:
+            em = jnp.concatenate(
+                [em, jnp.zeros((em.shape[0], padded_n - em.shape[1]),
+                               bool)], axis=1)
+        args.append(jax.device_put(em, NamedSharding(
+            mesh, P(None, catalog.axis))))
+    fn = _sharded_topk_fn(mesh, catalog.axis, k, catalog.n, local_n,
+                          chunk, exclude_mask is not None)
+    return fn(*args)

@@ -102,9 +102,17 @@ class _FastHeaders:
 
 
 def _first_wins_dict(pairs) -> dict:
+    """First value per case-insensitively-deduped header name (keeping
+    the first-seen spelling as the key) — the same winner _FastHeaders'
+    framing lookups pick, so handlers and framing can't diverge on a
+    duplicated header that varies in case."""
     out: dict = {}
+    seen: set = set()
     for k, v in pairs:
-        out.setdefault(k, v)
+        low = k.lower()
+        if low not in seen:
+            seen.add(low)
+            out[k] = v
     return out
 
 

@@ -142,6 +142,7 @@ class QueryService:
 
                 placement.link_rtt()
                 placement.host_flops_rate()
+                placement.uplink_rate()
             except Exception:  # measurement must never sink a deploy
                 logger.debug("placement measurement failed", exc_info=True)
 

@@ -284,3 +284,97 @@ class TestChunkedTopK:
         ref_s, ref_i = jax.lax.top_k(jnp.asarray(qn @ yn.T), 7)
         np.testing.assert_allclose(np.asarray(c_s), np.asarray(ref_s), atol=1e-4)
         np.testing.assert_array_equal(np.asarray(c_i), np.asarray(ref_i))
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded catalog MIPS
+# ---------------------------------------------------------------------------
+
+
+def _dense_topk_ref(q, items, k, exclude=None):
+    import numpy as np
+
+    s = q @ items.T
+    if exclude is not None:
+        s = np.where(exclude, -np.inf, s)
+    idx = np.argsort(-s, axis=1)[:, :k]
+    return np.take_along_axis(s, idx, axis=1), idx
+
+
+def test_sharded_topk_matches_dense():
+    import numpy as np
+
+    from predictionio_tpu.ops.topk import shard_catalog, sharded_topk_scores
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    ctx = compute_context(n_model=4)  # real multi-shard catalog
+    rng = np.random.default_rng(0)
+    items = rng.normal(size=(1003, 16)).astype(np.float32)  # non-divisible
+    q = rng.normal(size=(5, 16)).astype(np.float32)
+    cat = shard_catalog(ctx.mesh, items, axis="model")
+    assert cat.items.shape[0] % ctx.mesh.shape["model"] == 0
+    s, i = sharded_topk_scores(q, cat, k=12)
+    ws, wi = _dense_topk_ref(q, items, 12)
+    np.testing.assert_array_equal(np.asarray(i), wi)
+    np.testing.assert_allclose(np.asarray(s), ws, rtol=1e-5)
+
+
+def test_sharded_topk_chunked_local_path_and_mask():
+    import numpy as np
+
+    from predictionio_tpu.ops.topk import shard_catalog, sharded_topk_scores
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    ctx = compute_context(n_model=4)
+    rng = np.random.default_rng(1)
+    items = rng.normal(size=(900, 8)).astype(np.float32)
+    q = rng.normal(size=(3, 8)).astype(np.float32)
+    mask = rng.random((3, 900)) < 0.3
+    cat = shard_catalog(ctx.mesh, items, axis="model")
+    # chunk smaller than the per-device shard forces the chunked local scan
+    s, i = sharded_topk_scores(q, cat, k=7, chunk=128, exclude_mask=mask)
+    ws, wi = _dense_topk_ref(q, items, 7, mask)
+    np.testing.assert_array_equal(np.asarray(i), wi)
+    np.testing.assert_allclose(np.asarray(s), ws, rtol=1e-5)
+
+
+def test_top_k_scores_routes_sharded_catalog():
+    import numpy as np
+
+    from predictionio_tpu.models.als import top_k_scores
+    from predictionio_tpu.ops.topk import shard_catalog
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    ctx = compute_context(n_model=8)  # whole mesh on the model axis
+    rng = np.random.default_rng(2)
+    items = rng.normal(size=(500, 12)).astype(np.float32)
+    q = rng.normal(size=(2, 12)).astype(np.float32)
+    cat = shard_catalog(ctx.mesh, items, axis="model")
+    s, i = top_k_scores(q, cat, 9)
+    ws, wi = _dense_topk_ref(q, items, 9)
+    np.testing.assert_array_equal(i, wi)
+    np.testing.assert_allclose(s, ws, rtol=1e-5)
+    # k larger than the catalog clamps; k=0 returns empty
+    s0, i0 = top_k_scores(q, cat, 0)
+    assert s0.shape == (2, 0) and i0.shape == (2, 0)
+
+
+def test_sharded_topk_chunked_with_padding_and_negative_scores():
+    """Catalog padding rows (zero vectors, score 0) must not displace
+    valid negative-score candidates in the chunked local path — the
+    round-3 review's found failure mode: non-divisible catalog + local
+    chunk scan + all-negative scores."""
+    import numpy as np
+
+    from predictionio_tpu.ops.topk import shard_catalog, sharded_topk_scores
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    ctx = compute_context(n_model=4)
+    rng = np.random.default_rng(3)
+    items = -np.abs(rng.normal(size=(1001, 8))).astype(np.float32)
+    q = np.abs(rng.normal(size=(2, 8))).astype(np.float32)  # scores all < 0
+    cat = shard_catalog(ctx.mesh, items, axis="model")
+    s, i = sharded_topk_scores(q, cat, k=6, chunk=64)
+    ws, wi = _dense_topk_ref(q, items, 6)
+    np.testing.assert_array_equal(np.asarray(i), wi)
+    assert np.isfinite(np.asarray(s)).all()
