@@ -215,51 +215,139 @@ def bench_query_latency(
         Storage.reset()
 
 
-def _ingest_worker(port: int, key: str, n: int, barrier, out_q) -> None:
-    """One client process: connect, sync on the barrier, POST n events.
+def _ingest_worker(port: int, key: str, n: int, barrier, out_q,
+                   batch: int = 1) -> None:
+    """One client process: connect, sync on the barrier, POST n events
+    (one per request, or in /batch/events.json arrays of ``batch``).
     Separate PROCESSES, not threads — in-process clients share the
     server's GIL and understate its real capacity."""
     import http.client as hc
     import json as _json
     import time as _time
 
-    body = _json.dumps({
+    ev = {
         "event": "view", "entityType": "user", "entityId": "u1",
         "targetEntityType": "item", "targetEntityId": "i1",
-    }).encode()
+    }
+    if batch > 1:
+        path = f"/batch/events.json?accessKey={key}"
+        body = _json.dumps([ev] * batch).encode()
+        ok = 200
+    else:
+        path = f"/events.json?accessKey={key}"
+        body = _json.dumps(ev).encode()
+        ok = 201
     conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
-    conn.request(
-        "POST", f"/events.json?accessKey={key}", body,
-        {"Content-Type": "application/json"},
-    )
+    conn.request("POST", path, body, {"Content-Type": "application/json"})
     r = conn.getresponse()
     r.read()
-    assert r.status == 201, r.status
+    assert r.status == ok, r.status
     barrier.wait()
     t0 = _time.perf_counter()
-    for _ in range(n):
+    for _ in range(-(-n // batch)):
         conn.request(
-            "POST", f"/events.json?accessKey={key}", body,
-            {"Content-Type": "application/json"},
+            "POST", path, body, {"Content-Type": "application/json"}
         )
         r = conn.getresponse()
         r.read()
-        assert r.status == 201, r.status
+        assert r.status == ok, r.status
     out_q.put(_time.perf_counter() - t0)
     conn.close()
 
 
-def bench_event_ingest(total: int = 4000, conns: int = 8) -> dict:
+def _run_ingest_clients(port: int, key: str, total: int, conns: int,
+                        batch: int = 1) -> dict:
+    """Fire ``total`` events at ``port`` from ``conns`` client processes;
+    returns throughput numbers (shared by the single- and multi-worker
+    ingest benches)."""
+    import multiprocessing as mp
+
+    mp_ctx = mp.get_context("spawn")  # no forked jax/server state
+    barrier = mp_ctx.Barrier(conns + 1)
+    out_q = mp_ctx.Queue()
+    per_conn = total // conns
+    # batch mode rounds each worker's send count UP to whole batches
+    sent = -(-per_conn // batch) * batch * conns
+    procs = [
+        mp_ctx.Process(
+            target=_ingest_worker,
+            args=(port, key, per_conn, barrier, out_q, batch),
+        )
+        for _ in range(conns)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        barrier.wait(timeout=60)  # all workers connected + warmed
+    except Exception:
+        for p in procs:
+            p.terminate()
+        raise RuntimeError(
+            "ingest worker(s) died before the barrier; exit codes: "
+            f"{[p.exitcode for p in procs]}"
+        )
+    t0 = time.perf_counter()
+    times = []
+    import queue as _queue
+
+    for _ in range(conns):
+        try:
+            times.append(out_q.get(timeout=120))
+        except _queue.Empty:
+            for p in procs:
+                p.terminate()
+            raise RuntimeError(
+                "ingest worker died mid-run; exit codes: "
+                f"{[p.exitcode for p in procs]}"
+            )
+    wall = time.perf_counter() - t0
+    for p in procs:
+        p.join(timeout=30)
+    if any(p.exitcode != 0 for p in procs):
+        raise RuntimeError(
+            f"ingest worker failed: {[p.exitcode for p in procs]}"
+        )
+    return {
+        "events_per_sec": round(sent / wall, 0),
+        "per_conn_events_per_sec": round(per_conn / (sum(times) / conns), 0),
+    }
+
+
+def bench_event_ingest(total: int = 4000, conns: int = 8,
+                       workers: int = 4) -> dict:
     """POST /events.json throughput over keep-alive connections (the event
-    collection surface, ref: data/.../api/EventServer.scala:226-261)."""
+    collection surface, ref: data/.../api/EventServer.scala:226-261).
+
+    Two configurations share one sqlite/WAL store (a multi-process-safe
+    backend, unlike the memory store used by the latency bench):
+
+      * one in-process server — the GIL-bound baseline;
+      * an N-worker SO_REUSEPORT cluster (EventServerCluster) — the
+        deployment story for ingestion at rate, headline number.
+    """
+    import tempfile
+
     from predictionio_tpu.data.api.event_server import (
+        EventServerCluster,
         EventServerConfig,
         create_event_server,
     )
     from predictionio_tpu.data.storage import Storage
     from predictionio_tpu.data.storage.base import AccessKey, App
 
-    storage = _setup_storage()
+    tmp = tempfile.TemporaryDirectory(prefix="pio-ingest-bench-")
+    for k in list(os.environ):
+        if k.startswith("PIO_STORAGE_"):
+            del os.environ[k]
+    os.environ["PIO_STORAGE_SOURCES_S_TYPE"] = "sqlite"
+    os.environ["PIO_STORAGE_SOURCES_S_PATH"] = os.path.join(
+        tmp.name, "pio.db")
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        os.environ[f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"] = "S"
+        os.environ[f"PIO_STORAGE_REPOSITORIES_{repo}_NAME"] = (
+            f"bench_{repo.lower()}")
+    Storage.reset()
+    storage = Storage
     try:
         apps = storage.get_meta_data_apps()
         app_id = apps.insert(App(0, "ingestbench"))
@@ -267,68 +355,45 @@ def bench_event_ingest(total: int = 4000, conns: int = 8) -> dict:
         key = storage.get_meta_data_access_keys().insert(
             AccessKey("", app_id, ())
         )
+        import multiprocessing as mp
+
+        host_cpus = mp.cpu_count()
+        out: dict = {"ingest_conns": conns, "ingest_host_cpus": host_cpus}
+
         server = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
         server.start()
         try:
-            import multiprocessing as mp
-
-            mp_ctx = mp.get_context("spawn")  # no forked jax/server state
-            barrier = mp_ctx.Barrier(conns + 1)
-            out_q = mp_ctx.Queue()
-            per_conn = total // conns
-            sent = per_conn * conns
-            procs = [
-                mp_ctx.Process(
-                    target=_ingest_worker,
-                    args=(server.port, key, per_conn, barrier, out_q),
-                )
-                for _ in range(conns)
-            ]
-            for p in procs:
-                p.start()
-            try:
-                barrier.wait(timeout=60)  # all workers connected + warmed
-            except Exception:
-                for p in procs:
-                    p.terminate()
-                raise RuntimeError(
-                    "ingest worker(s) died before the barrier; exit codes: "
-                    f"{[p.exitcode for p in procs]}"
-                )
-            t0 = time.perf_counter()
-            times = []
-            import queue as _queue
-
-            for _ in range(conns):
-                try:
-                    times.append(out_q.get(timeout=120))
-                except _queue.Empty:
-                    for p in procs:
-                        p.terminate()
-                    raise RuntimeError(
-                        "ingest worker died mid-run; exit codes: "
-                        f"{[p.exitcode for p in procs]}"
-                    )
-            wall = time.perf_counter() - t0
-            for p in procs:
-                p.join(timeout=30)
-            if any(p.exitcode != 0 for p in procs):
-                raise RuntimeError(
-                    f"ingest worker failed: {[p.exitcode for p in procs]}"
-                )
-            return {
-                "ingest_events_per_sec": round(sent / wall, 0),
-                "ingest_conns": conns,
-                "ingest_per_conn_events_per_sec": round(
-                    per_conn / (sum(times) / conns), 0
-                ),
-            }
+            r1 = _run_ingest_clients(server.port, key, total, conns)
+            rb = _run_ingest_clients(
+                server.port, key, total * 4, conns, batch=50)
         finally:
             server.stop()
+        out["ingest_events_per_sec"] = r1["events_per_sec"]
+        out["ingest_per_conn_events_per_sec"] = r1["per_conn_events_per_sec"]
+        out["ingest_batch50_events_per_sec"] = rb["events_per_sec"]
+
+        # the SO_REUSEPORT worker cluster only helps with >1 core to run
+        # the workers on; on a single-core host it just adds context
+        # switching, so bench it when the cores exist
+        if host_cpus > 1:
+            cluster = EventServerCluster(EventServerConfig(
+                ip="127.0.0.1", port=0, workers=workers))
+            cluster.start()
+            try:
+                r2 = _run_ingest_clients(cluster.port, key, total * 2, conns)
+                rb2 = _run_ingest_clients(
+                    cluster.port, key, total * 8, conns, batch=50)
+            finally:
+                cluster.stop()
+            out.update({
+                "ingest_workers": workers,
+                "ingest_cluster_events_per_sec": r2["events_per_sec"],
+                "ingest_cluster_batch50_events_per_sec": rb2["events_per_sec"],
+            })
+        return out
     finally:
         Storage.reset()
-
-
+        tmp.cleanup()
 if __name__ == "__main__":
     results = bench_query_latency()
     results.update(bench_event_ingest())

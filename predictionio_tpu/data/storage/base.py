@@ -170,6 +170,15 @@ class Events(ABC):
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         """Insert, returning the event id (ref: LEvents.scala:87)."""
 
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int,
+        channel_id: int | None = None,
+    ) -> list[str]:
+        """Insert many events, returning their ids in order. Default:
+        per-event insert; transactional backends override with one
+        commit for the whole batch (the /batch/events.json hot path)."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
     @abstractmethod
     def get(
         self, event_id: str, app_id: int, channel_id: int | None = None

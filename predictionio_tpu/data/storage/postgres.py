@@ -138,6 +138,32 @@ class PGClient:
     def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
         return self.execute(sql, params).rows
 
+    def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
+        """Batch execute. The wire client runs simple-protocol statements
+        one by one; wrapping them in a transaction gives one fsync/WAL
+        flush for the whole batch (the /batch/events.json hot path).
+        A dead connection is repaired at BEGIN (nothing is lost yet);
+        a drop mid-transaction fails the whole batch — the transaction
+        is gone with the connection."""
+        with self.lock:
+            try:
+                self._conn.execute("BEGIN", ())
+            except (OSError, pgwire.PGError) as e:
+                if isinstance(e, pgwire.PGError) and e.sqlstate:
+                    raise
+                self._reconnect()
+                self._conn.execute("BEGIN", ())
+            try:
+                for params in seq_params:
+                    self._conn.execute(sql, params)
+                self._conn.execute("COMMIT", ())
+            except Exception:
+                try:
+                    self._conn.execute("ROLLBACK", ())
+                except Exception:  # noqa: S110 — original error matters more
+                    pass
+                raise
+
     def close(self) -> None:
         with self.lock:
             self._conn.close()

@@ -151,6 +151,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_es.add_argument("--ip", default="0.0.0.0")
     p_es.add_argument("--port", type=int, default=7070)
     p_es.add_argument("--stats", action="store_true")
+    p_es.add_argument(
+        "--workers", type=int, default=1,
+        help="worker processes sharing the port via SO_REUSEPORT "
+             "(needs a multi-process-safe storage backend; default 1)",
+    )
     p_es.set_defaults(func=cmd_eventserver)
 
     # -- dashboard / admin server (ref: Console.scala:866-890) --------------
@@ -478,13 +483,30 @@ def cmd_template_scaffold(args) -> int:
 
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api.event_server import (
+        EventServerCluster,
         EventServerConfig,
         create_event_server,
     )
 
-    server = create_event_server(
-        EventServerConfig(ip=args.ip, port=args.port, stats=args.stats)
+    workers = getattr(args, "workers", 1)
+    config = EventServerConfig(
+        ip=args.ip, port=args.port, stats=args.stats, workers=workers
     )
+    if workers > 1:
+        cluster = EventServerCluster(config)
+        cluster.start()
+        print(
+            f"[INFO] Event Server is listening on {args.ip}:{cluster.port} "
+            f"({workers} SO_REUSEPORT workers)"
+        )
+        try:
+            cluster.wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            cluster.stop()
+        return 0
+    server = create_event_server(config)
     server.start()
     print(f"[INFO] Event Server is listening on {args.ip}:{server.port}")
     try:

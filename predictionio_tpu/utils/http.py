@@ -74,6 +74,20 @@ class _ThreadingHTTPServer(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+class _ReusePortHTTPServer(_ThreadingHTTPServer):
+    allow_reuse_port = True  # honored by socketserver on Python >= 3.11
+
+    def server_bind(self):
+        # explicit setsockopt too: on 3.10 socketserver ignores the class
+        # attribute and the second worker would die with EADDRINUSE
+        try:
+            self.socket.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        except (AttributeError, OSError):
+            pass  # platform without SO_REUSEPORT: single worker still works
+        super().server_bind()
+
+
 class _FastHeaders:
     """Case-insensitive header mapping with exactly the surface the base
     handler and our Request need (get/items/in). Built from raw header
@@ -169,12 +183,19 @@ class Router:
 
 
 class AppServer:
-    """Bind a Router on host:port; start/stop/serve_forever."""
+    """Bind a Router on host:port; start/stop/serve_forever.
 
-    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 8000):
+    ``reuse_port`` sets SO_REUSEPORT so several OS processes can bind the
+    same port and let the kernel balance accepted connections across them
+    — the multi-worker event-server deployment (one Python process per
+    worker; a single process is GIL-bound at ~3k events/s)."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0",
+                 port: int = 8000, reuse_port: bool = False):
         self.router = router
         self.host = host
         self.port = port
+        self.reuse_port = reuse_port
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -362,9 +383,12 @@ class AppServer:
         """Bind and serve on a daemon thread. Retries the bind 3 times, like
         the reference's MasterActor (ref: CreateServer.scala:363-373)."""
         last_err: OSError | None = None
+        server_cls = (
+            _ReusePortHTTPServer if self.reuse_port else _ThreadingHTTPServer
+        )
         for _ in range(3):
             try:
-                self._server = _ThreadingHTTPServer(
+                self._server = server_cls(
                     (self.host, self.port), self._make_handler()
                 )
                 break

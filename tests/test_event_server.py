@@ -362,3 +362,152 @@ def test_colonless_header_line_rejected_400(server):
     raw = b"GET / HTTP/1.1\r\nHost x no colon here\r\n\r\n"
     resp = _raw_http(server["port"], raw)
     assert resp.startswith(b"HTTP/1.1 400")
+
+
+def test_multi_worker_cluster_shared_port(tmp_path, monkeypatch):
+    """EventServerCluster: N SO_REUSEPORT worker processes share one port
+    and one sqlite store; every insert lands exactly once and reads see
+    all writes regardless of which worker serves them."""
+    import http.client
+    import threading
+
+    from predictionio_tpu.data.api.event_server import (
+        EventServerCluster,
+        EventServerConfig,
+    )
+    from predictionio_tpu.data.storage import Storage
+
+    for k in list(__import__("os").environ):
+        if k.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(k)
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_S_TYPE", "sqlite")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_S_PATH", str(tmp_path / "pio.db"))
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "S")
+        monkeypatch.setenv(
+            f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"t_{repo.lower()}")
+    Storage.reset()
+    try:
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "clusterapp"))
+        Storage.get_events().init(app_id)
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ()))
+
+        cluster = EventServerCluster(
+            EventServerConfig(ip="127.0.0.1", port=0, workers=2))
+        cluster.start()
+        try:
+            n_threads, per = 4, 25
+            errors: list = []
+
+            def client(tid: int):
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", cluster.port, timeout=30)
+                    for k in range(per):
+                        body = json.dumps({
+                            "event": "rate", "entityType": "user",
+                            "entityId": f"u{tid}",
+                            "targetEntityType": "item",
+                            "targetEntityId": f"i{k}",
+                        })
+                        conn.request(
+                            "POST", f"/events.json?accessKey={key}", body,
+                            {"Content-Type": "application/json"})
+                        r = conn.getresponse()
+                        assert r.status == 201, r.read()
+                        r.read()
+                    conn.close()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            ts = [threading.Thread(target=client, args=(t,))
+                  for t in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errors, errors
+            # reads via a (kernel-chosen) worker see every write
+            status, data = call(
+                cluster.port, "GET", "/events.json",
+                params={"accessKey": key, "limit": "200"})
+            assert status == 200
+            assert len(data) == n_threads * per
+        finally:
+            cluster.stop()
+    finally:
+        Storage.reset()
+
+
+def test_batch_events_mixed_results(server):
+    """POST /batch/events.json: array in, per-event status array out
+    (upstream-successor API semantics: the batch succeeds as a whole with
+    per-event verdicts; invalid events don't sink valid ones)."""
+    port, key = server["port"], server["key"]
+    batch = [
+        dict(EVENT, entityId="b0"),
+        dict(EVENT, event="$reserved"),   # invalid: reserved name
+        dict(EVENT, entityId="b2"),
+        {"entityType": "user"},           # invalid: missing fields
+    ]
+    status, body = call(
+        port, "POST", "/batch/events.json", {"accessKey": key}, batch)
+    assert status == 200
+    assert [r["status"] for r in body] == [201, 400, 201, 400]
+    assert body[0]["eventId"] and body[2]["eventId"]
+    # the two good events are queryable
+    status, got = call(
+        port, "GET", "/events.json",
+        {"accessKey": key, "entityType": "user", "entityId": "b0"})
+    assert status == 200 and len(got) == 1
+
+
+def test_batch_events_rejects_non_array_and_oversize(server):
+    port, key = server["port"], server["key"]
+    status, body = call(
+        port, "POST", "/batch/events.json", {"accessKey": key}, EVENT)
+    assert status == 400 and "array" in body["message"]
+    big = [dict(EVENT, entityId=f"x{i}") for i in range(51)]
+    status, body = call(
+        port, "POST", "/batch/events.json", {"accessKey": key}, big)
+    assert status == 400 and "exceeds" in body["message"]
+    status, _ = call(port, "POST", "/batch/events.json", None, [EVENT])
+    assert status == 401
+
+
+def test_sql_insert_batch_matches_looped_inserts(tmp_path, monkeypatch):
+    """The transactional sqlite insert_batch stores exactly what N single
+    inserts would."""
+    from predictionio_tpu.data.event import Event as Ev
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.storage import Storage
+
+    for k in list(__import__("os").environ):
+        if k.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(k)
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_S_TYPE", "sqlite")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_S_PATH", str(tmp_path / "b.db"))
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "S")
+        monkeypatch.setenv(
+            f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"bt_{repo.lower()}")
+    Storage.reset()
+    try:
+        events = Storage.get_events()
+        events.init(7)
+        evs = [
+            Ev(event="rate", entity_type="user", entity_id=f"u{i}",
+               target_entity_type="item", target_entity_id=f"i{i}",
+               properties=DataMap({"rating": float(i % 5 + 1)}))
+            for i in range(10)
+        ]
+        ids = events.insert_batch(evs, 7)
+        assert len(set(ids)) == 10
+        stored = list(events.find(app_id=7, limit=-1))
+        assert len(stored) == 10
+        got = events.get(ids[3], 7)
+        assert got.entity_id == "u3" and got.properties["rating"] == 4.0
+    finally:
+        Storage.reset()
