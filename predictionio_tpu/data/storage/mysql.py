@@ -1,0 +1,217 @@
+"""MySQL storage backend — the Dialect + DBAPI-adapter flavor of the SQL DAOs.
+
+The reference's JDBC layer spans PostgreSQL AND MySQL with one DAO
+implementation, switching on the driver class
+(ref: data/.../storage/jdbc/JDBCUtils.scala:26-46). The analog here: the
+shared dialect-driven DAOs (data/storage/sql.py) bound to a
+:class:`MySQLDialect` over any installed DBAPI-2.0 MySQL driver.
+
+Unlike the PostgreSQL backend — whose v3 wire client ships with the
+framework (data/storage/pgwire.py) — no MySQL wire client is bundled: a
+from-scratch MySQL protocol implementation is a large lift for modest
+value, so this backend plugs in a third-party driver instead. Configure:
+
+    PIO_STORAGE_SOURCES_MY_TYPE=mysql
+    PIO_STORAGE_SOURCES_MY_DRIVER=pymysql          # any DBAPI module
+    PIO_STORAGE_SOURCES_MY_HOST=...  _PORT=3306  _DATABASE=pio
+    PIO_STORAGE_SOURCES_MY_USERNAME=...  _PASSWORD=...
+
+The adapter normalizes the three DBAPI divergences the DAOs would
+otherwise see:
+
+- **paramstyle**: the DAOs render ``?`` placeholders (qmark);
+  format/pyformat drivers get them rewritten to ``%s`` outside string
+  literals.
+- **identifier quoting**: the DAOs double-quote identifiers; the session
+  is opened with ``sql_mode='ANSI_QUOTES'`` so MySQL accepts them.
+- **upsert**: MySQL has no ``ON CONFLICT``; the dialect renders
+  ``INSERT ... ON DUPLICATE KEY UPDATE c=VALUES(c)``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Sequence
+
+from predictionio_tpu.data.storage.sql import (
+    Dialect,
+    SQLAccessKeys,
+    SQLApps,
+    SQLChannels,
+    SQLEngineInstances,
+    SQLEngineManifests,
+    SQLEvaluationInstances,
+    SQLEvents,
+    SQLModels,
+)
+
+
+def qmark_to_format(sql: str) -> str:
+    """Rewrite ``?`` placeholders to ``%s`` and escape literal ``%``,
+    skipping quoted strings/identifiers — for format/pyformat drivers."""
+    out = []
+    quote: str | None = None
+    for ch in sql:
+        if quote:
+            out.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in ("'", '"', "`"):
+            quote = ch
+            out.append(ch)
+        elif ch == "?":
+            out.append("%s")
+        elif ch == "%":
+            out.append("%%")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+class MySQLDialect(Dialect):
+    name = "mysql"
+    autoinc_pk = "BIGINT PRIMARY KEY AUTO_INCREMENT"
+    bigint = "BIGINT"
+    blob = "LONGBLOB"
+    #: MySQL cannot index bare TEXT ("BLOB/TEXT column used in key
+    #: specification without a key length") — keyed/indexed text columns
+    #: get a length-bounded VARCHAR instead
+    text_key = "VARCHAR(255)"
+
+    def __init__(self, integrity_errors: tuple = ()):
+        # driver-specific IntegrityError classes, wired by the client.
+        # No classes -> () : unknown errors must PROPAGATE, not be
+        # mistaken for duplicate-key conflicts by the DAOs.
+        self.integrity_errors = integrity_errors
+
+    def ensure_index(self, client, name: str, table: str, cols: str) -> None:
+        # MySQL has no CREATE INDEX IF NOT EXISTS (MariaDB-only)
+        exists = client.query(
+            "SELECT 1 FROM information_schema.statistics "
+            "WHERE table_schema=DATABASE() AND table_name=? "
+            "AND index_name=?",
+            (table, name),
+        )
+        if not exists:
+            client.execute(f'CREATE INDEX "{name}" ON "{table}" ({cols})')
+
+    def upsert_sql(
+        self, table: str, cols: Sequence[str], keys: Sequence[str]
+    ) -> str:
+        """MySQL upsert: ``ON DUPLICATE KEY UPDATE`` keyed on the table's
+        PRIMARY/UNIQUE key (``keys`` is implicit — MySQL always resolves
+        conflicts against the unique indexes, which the DAO DDL declares
+        on exactly those columns)."""
+        ph = ",".join("?" * len(cols))
+        updates = ", ".join(
+            f"{c}=VALUES({c})" for c in cols if c not in keys
+        )
+        if not updates:  # key-only table: make the re-insert a no-op
+            updates = f"{keys[0]}={keys[0]}"
+        return (
+            f'INSERT INTO "{table}" ({", ".join(cols)}) VALUES ({ph}) '
+            f"ON DUPLICATE KEY UPDATE {updates}"
+        )
+
+    def table_exists(self, client: "MySQLClient", table: str) -> bool:
+        return bool(
+            client.query(
+                "SELECT 1 FROM information_schema.tables "
+                "WHERE table_schema=DATABASE() AND table_name=?",
+                (table,),
+            )
+        )
+
+    def insert_autoid(
+        self, client: "MySQLClient", table: str, cols: Sequence[str], values
+    ) -> int:
+        ph = ",".join("?" * len(cols))
+        cur = client.execute(
+            f'INSERT INTO "{table}" ({", ".join(cols)}) VALUES ({ph})',
+            values,
+        )
+        return int(cur.lastrowid)
+
+
+class MySQLClient:
+    """DBAPI adapter matching the SQLClient surface the DAOs consume
+    (``dialect``, ``lock``, ``execute``/``executemany``/``query``).
+
+    ``config["DRIVER"]`` names the DBAPI module (default ``pymysql``); it
+    is imported lazily so the backend can be *configured* — and this
+    module unit-tested — without a MySQL driver installed."""
+
+    def __init__(self, config: dict | None = None, driver_module=None):
+        config = config or {}
+        self.lock = threading.RLock()
+        if driver_module is None:
+            driver_module = importlib.import_module(
+                config.get("DRIVER", "pymysql"))
+        self._driver = driver_module
+        self.dialect = MySQLDialect(
+            integrity_errors=tuple(
+                e for e in (getattr(driver_module, "IntegrityError", None),)
+                if e is not None
+            )
+        )
+        paramstyle = getattr(driver_module, "paramstyle", "format")
+        self._translate = paramstyle in ("format", "pyformat")
+        kwargs = {
+            "host": config.get("HOST", "127.0.0.1"),
+            "port": int(config.get("PORT", 3306)),
+            "user": config.get("USERNAME", "root"),
+            "password": config.get("PASSWORD", ""),
+            "database": config.get("DATABASE", "pio"),
+        }
+        self.conn = driver_module.connect(**kwargs)
+        cur = self.conn.cursor()
+        # the shared DAOs double-quote identifiers (the PG/SQLite form);
+        # APPEND to the session sql_mode — replacing it would silently
+        # drop STRICT_TRANS_TABLES and let over-length values truncate
+        cur.execute(
+            "SET SESSION sql_mode="
+            "CONCAT(@@SESSION.sql_mode, ',ANSI_QUOTES')"
+        )
+        cur.close()
+
+    def _sql(self, sql: str) -> str:
+        return qmark_to_format(sql) if self._translate else sql
+
+    def execute(self, sql: str, params: Sequence = ()):
+        with self.lock:
+            cur = self.conn.cursor()
+            cur.execute(self._sql(sql), tuple(params))
+            self.conn.commit()
+            return cur
+
+    def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
+        with self.lock:
+            cur = self.conn.cursor()
+            cur.executemany(self._sql(sql), [tuple(p) for p in seq_params])
+            self.conn.commit()
+            cur.close()
+
+    def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
+        with self.lock:
+            cur = self.conn.cursor()
+            cur.execute(self._sql(sql), tuple(params))
+            rows = list(cur.fetchall())
+            cur.close()
+            return rows
+
+    def close(self) -> None:
+        with self.lock:
+            self.conn.close()
+
+
+# DAO suite: the dialect-driven SQL DAOs bound to the MySQL client/dialect
+# by the registry's <Prefix><DAOName> naming convention.
+MySQLEvents = SQLEvents
+MySQLApps = SQLApps
+MySQLAccessKeys = SQLAccessKeys
+MySQLChannels = SQLChannels
+MySQLEngineInstances = SQLEngineInstances
+MySQLEngineManifests = SQLEngineManifests
+MySQLEvaluationInstances = SQLEvaluationInstances
+MySQLModels = SQLModels

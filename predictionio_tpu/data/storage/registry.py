@@ -42,6 +42,9 @@ BACKEND_TYPES = {
     "postgres": ("predictionio_tpu.data.storage.postgres", "PG"),
     "pgsql": ("predictionio_tpu.data.storage.postgres", "PG"),
     "jdbc": ("predictionio_tpu.data.storage.postgres", "PG"),
+    # MySQL via an installed DBAPI driver (set _DRIVER; ref JDBC's MySQL
+    # branch, JDBCUtils.scala:26-46); no wire client is bundled
+    "mysql": ("predictionio_tpu.data.storage.mysql", "MySQL"),
 }
 
 _REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")

@@ -53,6 +53,15 @@ class Dialect:
     autoinc_pk = "INTEGER PRIMARY KEY AUTOINCREMENT"
     bigint = "INTEGER"
     blob = "BLOB"
+    #: type for PRIMARY-KEY/UNIQUE/indexed text columns. SQLite/Postgres
+    #: index TEXT directly; MySQL needs a length-bounded VARCHAR.
+    text_key = "TEXT"
+
+    def ensure_index(self, client, name: str, table: str, cols: str) -> None:
+        """Create the index if absent (MySQL lacks IF NOT EXISTS here)."""
+        client.execute(
+            f'CREATE INDEX IF NOT EXISTS "{name}" ON "{table}" ({cols})'
+        )
 
     def upsert_sql(
         self, table: str, cols: Sequence[str], keys: Sequence[str]
@@ -155,10 +164,10 @@ class SQLEvents(base.Events):
         with self._c.lock:
             self._c.execute(
                 f"""CREATE TABLE IF NOT EXISTS "{t}" (
-                    id TEXT PRIMARY KEY,
+                    id {d.text_key} PRIMARY KEY,
                     event TEXT NOT NULL,
-                    entityType TEXT NOT NULL,
-                    entityId TEXT NOT NULL,
+                    entityType {d.text_key} NOT NULL,
+                    entityId {d.text_key} NOT NULL,
                     targetEntityType TEXT,
                     targetEntityId TEXT,
                     properties TEXT NOT NULL,
@@ -169,13 +178,10 @@ class SQLEvents(base.Events):
                     creationTime TEXT NOT NULL
                 )"""
             )
-            self._c.execute(
-                f'CREATE INDEX IF NOT EXISTS "{t}_entity_time" '
-                f'ON "{t}" (entityType, entityId, eventTimeMs)'
-            )
-            self._c.execute(
-                f'CREATE INDEX IF NOT EXISTS "{t}_time" ON "{t}" (eventTimeMs)'
-            )
+            d.ensure_index(
+                self._c, f"{t}_entity_time", t,
+                "entityType, entityId, eventTimeMs")
+            d.ensure_index(self._c, f"{t}_time", t, "eventTimeMs")
         return True
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
@@ -341,7 +347,8 @@ class SQLApps(base.Apps):
         self._t = prefix + "apps"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            f"id {client.dialect.autoinc_pk}, name TEXT UNIQUE NOT NULL, "
+            f"id {client.dialect.autoinc_pk}, "
+            f"name {client.dialect.text_key} UNIQUE NOT NULL, "
             "description TEXT)"
         )
 
@@ -399,7 +406,8 @@ class SQLAccessKeys(base.AccessKeys):
         self._t = prefix + "access_keys"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "accesskey TEXT PRIMARY KEY, appid INTEGER NOT NULL, events TEXT NOT NULL)"
+            f"accesskey {client.dialect.text_key} PRIMARY KEY, "
+            "appid INTEGER NOT NULL, events TEXT NOT NULL)"
         )
 
     def insert(self, access_key: AccessKey) -> str | None:
@@ -515,7 +523,8 @@ class SQLEngineInstances(base.EngineInstances):
         self._t = prefix + "engine_instances"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "id TEXT PRIMARY KEY, status TEXT, startTime TEXT, endTime TEXT, "
+            f"id {client.dialect.text_key} PRIMARY KEY, "
+            "status TEXT, startTime TEXT, endTime TEXT, "
             "engineId TEXT, engineVersion TEXT, engineVariant TEXT, "
             "engineFactory TEXT, batch TEXT, env TEXT, sparkConf TEXT, "
             "dataSourceParams TEXT, preparatorParams TEXT, algorithmsParams TEXT, "
@@ -612,7 +621,9 @@ class SQLEngineManifests(base.EngineManifests):
         self._t = prefix + "engine_manifests"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "id TEXT, version TEXT, name TEXT, description TEXT, files TEXT, "
+            f"id {client.dialect.text_key}, "
+            f"version {client.dialect.text_key}, "
+            "name TEXT, description TEXT, files TEXT, "
             "engineFactory TEXT, PRIMARY KEY (id, version))"
         )
 
@@ -667,7 +678,8 @@ class SQLEvaluationInstances(base.EvaluationInstances):
         self._t = prefix + "evaluation_instances"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            "id TEXT PRIMARY KEY, status TEXT, startTime TEXT, endTime TEXT, "
+            f"id {client.dialect.text_key} PRIMARY KEY, "
+            "status TEXT, startTime TEXT, endTime TEXT, "
             "evaluationClass TEXT, engineParamsGeneratorClass TEXT, batch TEXT, "
             "env TEXT, sparkConf TEXT, evaluatorResults TEXT, "
             "evaluatorResultsHTML TEXT, evaluatorResultsJSON TEXT, "
@@ -756,7 +768,8 @@ class SQLModels(base.Models):
         self._t = prefix + "models"
         client.execute(
             f'CREATE TABLE IF NOT EXISTS "{self._t}" ('
-            f"id TEXT PRIMARY KEY, models {client.dialect.blob} NOT NULL)"
+            f"id {client.dialect.text_key} PRIMARY KEY, "
+            f"models {client.dialect.blob} NOT NULL)"
         )
 
     def insert(self, model: Model) -> None:

@@ -189,13 +189,22 @@ def build_parser() -> argparse.ArgumentParser:
     p_sh.set_defaults(func=cmd_shell)
 
     # -- export / import (ref: Console.scala export/import) -----------------
-    p_exp = sub.add_parser("export", help="export events to a JSON-lines file")
+    p_exp = sub.add_parser(
+        "export", help="export events to a JSON-lines or columnar file")
     p_exp.add_argument("--app-name", required=True)
     p_exp.add_argument("--channel")
     p_exp.add_argument("--output", required=True)
+    p_exp.add_argument(
+        "--format", choices=("json", "columnar"), default="json",
+        help="json lines (default) or columnar .npz (the reference's "
+             "parquet-option analog; feeds the TPU input pipeline "
+             "without JSON re-parsing)",
+    )
     p_exp.set_defaults(func=cmd_export)
 
-    p_imp = sub.add_parser("import", help="import events from a JSON-lines file")
+    p_imp = sub.add_parser(
+        "import",
+        help="import events from a JSON-lines or columnar (.npz) file")
     p_imp.add_argument("--app-name", required=True)
     p_imp.add_argument("--channel")
     p_imp.add_argument("--input", required=True)
@@ -549,7 +558,10 @@ def cmd_export(args) -> int:
     from predictionio_tpu.tools.export_import import events_to_file
 
     try:
-        n = events_to_file(args.app_name, args.output, args.channel)
+        n = events_to_file(
+            args.app_name, args.output, args.channel,
+            format=getattr(args, "format", "json"),
+        )
     except (ValueError, OSError) as e:
         print(f"[ERROR] {e}", file=sys.stderr)
         return 1

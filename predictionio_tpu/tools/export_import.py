@@ -1,11 +1,23 @@
-"""Event export/import: events ↔ JSON-lines files.
+"""Event export/import: events ↔ JSON-lines or columnar (npz) files.
 
 Re-design of the reference's Spark jobs ``EventsToFile``
-(ref: tools/.../export/EventsToFile.scala:28-104, json or parquet output via
-Spark SQL) and ``FileToEvents`` (ref: tools/.../imprt/FileToEvents.scala:28-95).
-There is no cluster job to launch here: the event store scans in-process, so
-both directions are plain streaming loops. JSON-lines keeps the reference's
-json format (one event object per line, the ``/events.json`` wire shape).
+(ref: tools/.../export/EventsToFile.scala:28-104, json **or parquet**
+output via Spark SQL) and ``FileToEvents``
+(ref: tools/.../imprt/FileToEvents.scala:28-95). There is no cluster job
+to launch here: the event store scans in-process, so both directions are
+plain streaming loops.
+
+Formats:
+
+- ``json`` — one event object per line (the ``/events.json`` wire
+  shape), the reference's default.
+- ``columnar`` — the parquet analog, idiomatic for this stack: one
+  ``.npz`` of per-column numpy arrays with low-cardinality columns
+  (event name, entity types, pr_id) dictionary-encoded. A columnar
+  export feeds the TPU input pipeline (``PEventStore``) without
+  re-parsing JSON per event, and is ~5x smaller on rating-shaped data.
+
+Both formats round-trip losslessly (tests/test_tools.py).
 """
 
 from __future__ import annotations
@@ -13,6 +25,8 @@ from __future__ import annotations
 import json
 import sys
 from pathlib import Path
+
+import numpy as np
 
 from predictionio_tpu.data.event import Event, validate_event
 from predictionio_tpu.data.storage import Storage
@@ -23,9 +37,14 @@ def events_to_file(
     app_name: str,
     output: str,
     channel_name: str | None = None,
+    format: str = "json",
 ) -> int:
-    """Export all events of an app/channel to a JSON-lines file; returns the
-    number of events written (ref: EventsToFile.scala:78-96)."""
+    """Export all events of an app/channel; returns the number written
+    (ref: EventsToFile.scala:78-96, format selection :85-96)."""
+    if format == "columnar":
+        return events_to_columnar(app_name, output, channel_name)
+    if format != "json":
+        raise ValueError(f"unknown export format {format!r} (json|columnar)")
     app_id, channel_id = app_name_to_id(app_name, channel_name)
     events = Storage.get_events()
     path = Path(output)
@@ -38,13 +57,142 @@ def events_to_file(
     return n
 
 
+def _dict_encode(values: list) -> tuple[np.ndarray, np.ndarray]:
+    """(codes int32, vocab) dictionary encoding; None encodes as -1."""
+    vocab: dict = {}
+    codes = np.empty(len(values), np.int32)
+    for i, v in enumerate(values):
+        if v is None:
+            codes[i] = -1
+        else:
+            codes[i] = vocab.setdefault(v, len(vocab))
+    return codes, np.array(list(vocab), dtype=object)
+
+
+def _dict_decode(codes: np.ndarray, vocab: np.ndarray, i: int):
+    c = int(codes[i])
+    return None if c < 0 else vocab[c]
+
+
+def events_to_columnar(
+    app_name: str,
+    output: str,
+    channel_name: str | None = None,
+) -> int:
+    """Columnar export: per-column arrays in one ``.npz``."""
+    app_id, channel_id = app_name_to_id(app_name, channel_name)
+    events = Storage.get_events()
+    cols: dict[str, list] = {k: [] for k in (
+        "event", "entity_type", "entity_id", "target_entity_type",
+        "target_entity_id", "properties", "event_time", "tags", "pr_id",
+        "event_id", "creation_time",
+    )}
+    from predictionio_tpu.utils.time import format_datetime
+
+    for e in events.find(app_id=app_id, channel_id=channel_id):
+        cols["event"].append(e.event)
+        cols["entity_type"].append(e.entity_type)
+        cols["entity_id"].append(e.entity_id)
+        cols["target_entity_type"].append(e.target_entity_type)
+        cols["target_entity_id"].append(e.target_entity_id)
+        cols["properties"].append(json.dumps(e.properties.to_dict()))
+        cols["event_time"].append(format_datetime(e.event_time))
+        cols["tags"].append(json.dumps(list(e.tags)))
+        cols["pr_id"].append(e.pr_id)
+        cols["event_id"].append(e.event_id)
+        cols["creation_time"].append(format_datetime(e.creation_time))
+    n = len(cols["event"])
+    arrays: dict[str, np.ndarray] = {"n": np.int64(n)}
+    # low-cardinality columns dictionary-encode; the rest store as object
+    for name in ("event", "entity_type", "target_entity_type", "pr_id"):
+        codes, vocab = _dict_encode(cols[name])
+        arrays[f"{name}_codes"] = codes
+        arrays[f"{name}_vocab"] = vocab
+    for name in ("entity_id", "target_entity_id", "properties",
+                 "event_time", "tags", "event_id", "creation_time"):
+        arrays[name] = np.array(
+            ["" if v is None else v for v in cols[name]], dtype=object)
+        arrays[f"{name}_null"] = np.array(
+            [v is None for v in cols[name]], dtype=bool)
+    path = Path(output)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with path.open("wb") as f:
+        np.savez_compressed(f, **arrays)
+    return n
+
+
+def columnar_to_events(
+    app_name: str,
+    input_path: str,
+    channel_name: str | None = None,
+) -> int:
+    """Import a columnar (.npz) export; returns the number inserted."""
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.utils.time import parse_datetime
+
+    app_id, channel_id = app_name_to_id(app_name, channel_name)
+    events = Storage.get_events()
+    import zipfile
+
+    try:
+        z = np.load(input_path, allow_pickle=True)
+        n = int(z["n"])
+        z["event_codes"], z["event_vocab"]  # schema probe
+    except (KeyError, OSError, ValueError, zipfile.BadZipFile) as e:
+        raise ValueError(
+            f"{input_path} is not a pio columnar export: {e}"
+        ) from e
+
+    def opt(name, i):
+        return None if bool(z[f"{name}_null"][i]) else z[name][i]
+
+    batch: list[Event] = []
+    inserted = 0
+    for i in range(n):
+        try:
+            event = Event(
+                event=str(_dict_decode(z["event_codes"], z["event_vocab"], i)),
+                entity_type=str(_dict_decode(
+                    z["entity_type_codes"], z["entity_type_vocab"], i)),
+                entity_id=str(z["entity_id"][i]),
+                target_entity_type=_dict_decode(
+                    z["target_entity_type_codes"],
+                    z["target_entity_type_vocab"], i),
+                target_entity_id=opt("target_entity_id", i),
+                properties=DataMap(json.loads(z["properties"][i])),
+                event_time=parse_datetime(str(z["event_time"][i])),
+                tags=tuple(json.loads(z["tags"][i])),
+                pr_id=_dict_decode(z["pr_id_codes"], z["pr_id_vocab"], i),
+                event_id=opt("event_id", i),
+                creation_time=parse_datetime(str(z["creation_time"][i])),
+            )
+            validate_event(event)
+        except (ValueError, KeyError) as e:
+            print(f"[WARN] row {i}: skipped invalid event: {e}",
+                  file=sys.stderr)
+            continue
+        batch.append(event)
+        if len(batch) >= 500:
+            inserted += len(events.insert_batch(batch, app_id, channel_id))
+            batch = []
+    if batch:
+        inserted += len(events.insert_batch(batch, app_id, channel_id))
+    return inserted
+
+
 def file_to_events(
     app_name: str,
     input_path: str,
     channel_name: str | None = None,
 ) -> int:
-    """Import events from a JSON-lines file; returns the number inserted
-    (ref: FileToEvents.scala:70-89 — parse, validate, write batch)."""
+    """Import events from a JSON-lines (or columnar ``.npz``) file;
+    returns the number inserted (ref: FileToEvents.scala:70-89 — parse,
+    validate, write batch). The format is sniffed from the content (zip
+    magic = columnar), not the file name."""
+    with Path(input_path).open("rb") as f:
+        magic = f.read(4)
+    if magic[:2] == b"PK":  # npz is a zip archive
+        return columnar_to_events(app_name, input_path, channel_name)
     app_id, channel_id = app_name_to_id(app_name, channel_name)
     events = Storage.get_events()
     n = 0

@@ -1,0 +1,186 @@
+"""MySQL dialect + DBAPI adapter (no server required).
+
+The reference's JDBC layer supports PostgreSQL and MySQL
+(ref: JDBCUtils.scala:26-46); data/storage/mysql.py is the MySQL branch.
+No MySQL server or driver exists in CI, so these tests pin the dialect's
+SQL rendering and drive the adapter against a recording fake DBAPI
+module — the seam a real driver (pymysql etc.) plugs into."""
+
+import pytest
+
+from predictionio_tpu.data.storage.mysql import (
+    MySQLClient,
+    MySQLDialect,
+    qmark_to_format,
+)
+
+
+class TestQmarkTranslation:
+    def test_basic(self):
+        assert qmark_to_format("SELECT ? , ?") == "SELECT %s , %s"
+
+    def test_skips_quoted_literals_and_identifiers(self):
+        sql = "INSERT INTO \"t?\" (a) VALUES (?) -- `b?` '?'"
+        # inside double quotes / backticks / single quotes: untouched
+        assert qmark_to_format('SELECT \'?\' , "a?b", `c?`, ?') == (
+            'SELECT \'?\' , "a?b", `c?`, %s'
+        )
+        assert "%s" in qmark_to_format(sql)
+        assert '"t?"' in qmark_to_format(sql)
+
+    def test_escapes_percent(self):
+        assert qmark_to_format("LIKE 'x%'") == "LIKE 'x%'"  # quoted: kept
+        assert qmark_to_format("SELECT 1 % 2") == "SELECT 1 %% 2"
+
+
+class TestDialect:
+    def test_upsert_renders_on_duplicate_key(self):
+        d = MySQLDialect()
+        sql = d.upsert_sql("t", ["id", "a", "b"], ("id",))
+        assert sql.startswith('INSERT INTO "t" (id, a, b) VALUES (?,?,?)')
+        assert "ON DUPLICATE KEY UPDATE a=VALUES(a), b=VALUES(b)" in sql
+
+    def test_upsert_key_only_is_noop(self):
+        d = MySQLDialect()
+        sql = d.upsert_sql("t", ["id"], ("id",))
+        assert "ON DUPLICATE KEY UPDATE id=id" in sql
+
+    def test_ddl_tokens(self):
+        d = MySQLDialect()
+        assert d.autoinc_pk == "BIGINT PRIMARY KEY AUTO_INCREMENT"
+        assert d.blob == "LONGBLOB"
+        assert d.bigint == "BIGINT"
+
+
+class _FakeCursor:
+    def __init__(self, driver):
+        self.driver = driver
+        self.lastrowid = 42
+
+    def execute(self, sql, params=()):
+        self.driver.executed.append((sql, tuple(params)))
+
+    def executemany(self, sql, seq):
+        self.driver.executed.append((sql, [tuple(p) for p in seq]))
+
+    def fetchall(self):
+        return self.driver.rows
+
+    def close(self):
+        pass
+
+
+class _FakeConn:
+    def __init__(self, driver):
+        self.driver = driver
+
+    def cursor(self):
+        return _FakeCursor(self.driver)
+
+    def commit(self):
+        self.driver.commits += 1
+
+    def close(self):
+        self.driver.closed = True
+
+
+class _FakeDriver:
+    """Recording stand-in for a DBAPI-2.0 MySQL module."""
+
+    paramstyle = "pyformat"
+
+    class IntegrityError(Exception):
+        pass
+
+    def __init__(self):
+        self.executed = []
+        self.rows = []
+        self.commits = 0
+        self.closed = False
+        self.connect_kwargs = None
+
+    def connect(self, **kwargs):
+        self.connect_kwargs = kwargs
+        return _FakeConn(self)
+
+
+@pytest.fixture()
+def driver():
+    return _FakeDriver()
+
+
+class TestAdapter:
+    def test_session_opens_with_ansi_quotes(self, driver):
+        MySQLClient({"DATABASE": "db1", "PORT": "3307"}, driver_module=driver)
+        assert driver.connect_kwargs["database"] == "db1"
+        assert driver.connect_kwargs["port"] == 3307
+        assert driver.executed[0][0] == (
+            "SET SESSION sql_mode="
+            "CONCAT(@@SESSION.sql_mode, ',ANSI_QUOTES')"
+        )
+
+    def test_qmark_params_translate_for_pyformat_driver(self, driver):
+        c = MySQLClient({}, driver_module=driver)
+        c.execute('INSERT INTO "t" (a) VALUES (?)', ("x",))
+        sql, params = driver.executed[-1]
+        assert sql == 'INSERT INTO "t" (a) VALUES (%s)'
+        assert params == ("x",)
+        assert driver.commits == 1
+
+    def test_qmark_driver_passes_through(self, driver):
+        driver.paramstyle = "qmark"
+        c = MySQLClient({}, driver_module=driver)
+        c.execute("SELECT ?", (1,))
+        assert driver.executed[-1][0] == "SELECT ?"
+
+    def test_executemany_one_commit(self, driver):
+        c = MySQLClient({}, driver_module=driver)
+        c.executemany("INSERT INTO \"t\" VALUES (?)", [(1,), (2,), (3,)])
+        sql, seq = driver.executed[-1]
+        assert sql == 'INSERT INTO "t" VALUES (%s)'
+        assert seq == [(1,), (2,), (3,)]
+        assert driver.commits == 1
+
+    def test_integrity_errors_wired_from_driver(self, driver):
+        c = MySQLClient({}, driver_module=driver)
+        assert c.dialect.integrity_errors == (driver.IntegrityError,)
+
+    def test_missing_integrity_error_means_propagate(self):
+        class _Bare(_FakeDriver):
+            pass
+
+        _Bare.IntegrityError = None  # driver without the DBAPI class
+        c = MySQLClient({}, driver_module=_Bare())
+        # () : DAOs' `except integrity_errors` never swallows unknown
+        # errors as duplicate-key conflicts
+        assert c.dialect.integrity_errors == ()
+
+    def test_text_key_is_length_bounded(self):
+        assert MySQLDialect().text_key == "VARCHAR(255)"
+
+    def test_ensure_index_checks_information_schema(self, driver):
+        c = MySQLClient({}, driver_module=driver)
+        driver.rows = []  # index absent -> created
+        c.dialect.ensure_index(c, "ix", "t", "a, b")
+        assert driver.executed[-1][0] == 'CREATE INDEX "ix" ON "t" (a, b)'
+        driver.rows = [(1,)]  # present -> no DDL
+        before = len(driver.executed)
+        c.dialect.ensure_index(c, "ix", "t", "a, b")
+        assert len(driver.executed) == before + 1  # just the probe query
+
+    def test_insert_autoid_uses_lastrowid(self, driver):
+        c = MySQLClient({}, driver_module=driver)
+        rid = c.dialect.insert_autoid(c, "t", ["a"], ("v",))
+        assert rid == 42
+
+    def test_registry_resolves_mysql_type(self):
+        from predictionio_tpu.data.storage.registry import BACKEND_TYPES
+
+        mod, prefix = BACKEND_TYPES["mysql"]
+        import importlib
+
+        m = importlib.import_module(mod)
+        for dao in ("Events", "Apps", "AccessKeys", "Channels",
+                    "EngineInstances", "EngineManifests",
+                    "EvaluationInstances", "Models", "Client"):
+            assert hasattr(m, f"{prefix}{dao}")

@@ -74,6 +74,61 @@ class TestExportImport:
             assert imp.properties == orig.properties
             assert imp.event_time == orig.event_time
 
+    def test_columnar_round_trip(self, memory_storage, tmp_path):
+        """json -> columnar(.npz) -> events is lossless, incl. optional
+        fields, tags, tz-offset event times, and None targets (the
+        reference's parquet-option analog, EventsToFile.scala:85-96)."""
+        from predictionio_tpu.tools.export_import import (
+            events_to_file,
+            file_to_events,
+        )
+
+        app_id = _seed_app(memory_storage, "exapp")
+        _seed_app(memory_storage, "imapp")
+        events = memory_storage.get_events()
+        tz = dt.timezone(dt.timedelta(hours=-7))
+        originals = [
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap({"rating": i, "s": "x"}),
+                  tags=("a", "b") if i % 2 else (),
+                  pr_id="p1" if i == 3 else None,
+                  event_time=dt.datetime(2020, 1, 1, i, tzinfo=tz))
+            for i in range(1, 6)
+        ] + [
+            Event(event="$set", entity_type="user", entity_id="u9",
+                  properties=DataMap({"plan": "pro"}),
+                  event_time=dt.datetime(2020, 1, 2, tzinfo=UTC)),
+        ]
+        for e in originals:
+            events.insert(e, app_id)
+        out = tmp_path / "events.npz"
+        assert events_to_file("exapp", str(out), format="columnar") == 6
+        assert file_to_events("imapp", str(out)) == 6
+        imported = sorted(
+            (e for e in events.find(app_id=2)), key=lambda e: e.event_time
+        )
+        for orig, imp in zip(
+            sorted(originals, key=lambda e: e.event_time), imported
+        ):
+            assert imp.event == orig.event
+            assert imp.entity_id == orig.entity_id
+            assert imp.target_entity_type == orig.target_entity_type
+            assert imp.target_entity_id == orig.target_entity_id
+            assert imp.properties == orig.properties
+            assert imp.tags == orig.tags
+            assert imp.pr_id == orig.pr_id
+            assert imp.event_time == orig.event_time
+
+    def test_export_rejects_unknown_format(self, memory_storage, tmp_path):
+        import pytest
+
+        from predictionio_tpu.tools.export_import import events_to_file
+
+        _seed_app(memory_storage, "exapp")
+        with pytest.raises(ValueError, match="format"):
+            events_to_file("exapp", str(tmp_path / "x"), format="parquet")
+
     def test_import_skips_invalid_lines(self, memory_storage, tmp_path):
         from predictionio_tpu.tools.export_import import file_to_events
 
