@@ -318,12 +318,15 @@ def bench_event_ingest(total: int = 4000, conns: int = 8,
     """POST /events.json throughput over keep-alive connections (the event
     collection surface, ref: data/.../api/EventServer.scala:226-261).
 
-    Two configurations share one sqlite/WAL store (a multi-process-safe
-    backend, unlike the memory store used by the latency bench):
+    Three configurations:
 
-      * one in-process server — the GIL-bound baseline;
-      * an N-worker SO_REUSEPORT cluster (EventServerCluster) — the
-        deployment story for ingestion at rate, headline number.
+      * memory store, one in-process server, single-event POSTs — the
+        round-1/2 continuity configuration
+        (``ingest_memory_events_per_sec``);
+      * sqlite/WAL store (durable, multi-process-safe), one in-process
+        server — single-event and batch-50 modes;
+      * an N-worker SO_REUSEPORT cluster (EventServerCluster) over the
+        same sqlite store — benched only on multi-core hosts.
     """
     import tempfile
 
@@ -334,6 +337,26 @@ def bench_event_ingest(total: int = 4000, conns: int = 8,
     )
     from predictionio_tpu.data.storage import Storage
     from predictionio_tpu.data.storage.base import AccessKey, App
+
+    # continuity number: the round-1/2 configuration (memory store,
+    # single process, single-event POSTs) so round-over-round deltas
+    # compare like for like before the durable-store numbers below
+    mem_storage = _setup_storage()
+    mem_rate = None
+    try:
+        app_id = mem_storage.get_meta_data_apps().insert(App(0, "ingestmem"))
+        mem_storage.get_events().init(app_id)
+        mkey = mem_storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ()))
+        msrv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+        msrv.start()
+        try:
+            mem_rate = _run_ingest_clients(
+                msrv.port, mkey, total, conns)["events_per_sec"]
+        finally:
+            msrv.stop()
+    finally:
+        Storage.reset()
 
     tmp = tempfile.TemporaryDirectory(prefix="pio-ingest-bench-")
     for k in list(os.environ):
@@ -359,6 +382,8 @@ def bench_event_ingest(total: int = 4000, conns: int = 8,
 
         host_cpus = mp.cpu_count()
         out: dict = {"ingest_conns": conns, "ingest_host_cpus": host_cpus}
+        if mem_rate is not None:
+            out["ingest_memory_events_per_sec"] = mem_rate
 
         server = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
         server.start()

@@ -1,0 +1,168 @@
+"""Item-to-item similarity engine — the DIMSUM example, redesigned.
+
+The reference's experimental DIMSUM engine
+(ref: examples/experimental/scala-parallel-similarproduct-dimsum/src/main/
+scala/DIMSUMAlgorithm.scala:69-150) computes thresholded column cosine
+similarities of the user x item interaction matrix with Spark's sampled
+``RowMatrix.columnSimilarities`` — DIMSUM exists to avoid the all-pairs
+shuffle on a cluster. On a TPU the all-pairs product IS the cheap part
+(one MXU matmul), so the redesign computes the similarities *exactly*:
+
+    C   = user x item interaction matrix (views, deduplicated)
+    Ĉ   = C with L2-normalized columns
+    S   = ĈᵀĈ            (exact cosine; chunked over item blocks)
+    keep S[i, j] >= threshold, top-k per item
+
+Train-time output is a per-item neighbor table, so serving is a pure
+lookup. Events: ``view`` (user → item), read from the event store like
+the similarproduct template.
+
+Run from this directory after ingesting view events:
+
+    pio train && pio deploy --port 8000 &
+    curl -s -X POST localhost:8000/queries.json -d '{"item": "i1", "num": 4}'
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.core import Engine, FirstServing, IdentityPreparator
+from predictionio_tpu.core.dase import LAlgorithm, LDataSource
+from predictionio_tpu.data.store.event_stores import PEventStore
+
+
+@dataclass(frozen=True)
+class DataSourceParams:
+    app_name: str = "MyApp"
+
+
+@dataclass(frozen=True)
+class ViewData:
+    user_ids: tuple
+    item_ids: tuple
+    user_idx: np.ndarray  # [n] int32
+    item_idx: np.ndarray  # [n] int32
+
+
+@dataclass(frozen=True)
+class AlgoParams:
+    #: minimum cosine to keep a pair (the DIMSUM threshold param)
+    threshold: float = 0.1
+    #: neighbors retained per item
+    top_k: int = 20
+
+
+@dataclass(frozen=True)
+class Query:
+    item: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple = field(default_factory=tuple)
+
+
+@dataclass(frozen=True)
+class SimilarityModel:
+    item_ids: tuple  # position -> item string id
+    neighbors: dict  # item idx -> tuple[(item idx, cosine), ...] desc
+
+
+class ViewDataSource(LDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training_local(self) -> ViewData:
+        user_ids, item_ids, user_idx, item_idx, _r, _n = (
+            PEventStore.interaction_indices(
+                self.params.app_name, ["view"], rating_property=None
+            )
+        )
+        return ViewData(tuple(user_ids), tuple(item_ids), user_idx, item_idx)
+
+
+class CosineSimilarityAlgorithm(LAlgorithm):
+    params_class = AlgoParams
+    query_class = Query
+
+    def __init__(self, params: AlgoParams):
+        self.params = params
+
+    def train_local(self, data: ViewData) -> SimilarityModel:
+        import jax.numpy as jnp
+
+        n_users = len(data.user_ids)
+        n_items = len(data.item_ids)
+        if n_items == 0:
+            return SimilarityModel((), {})
+        # interaction matrix, deduplicated (same user+item counted once —
+        # matching the reference's irDedup, DIMSUMAlgorithm.scala:106-118)
+        c = np.zeros((n_users, n_items), np.float32)
+        c[data.user_idx, data.item_idx] = 1.0
+        norms = np.linalg.norm(c, axis=0)
+        norms[norms == 0] = 1.0
+        c_hat = jnp.asarray(c / norms)
+        # exact all-pairs column cosine. The SCORE matrix is chunked over
+        # item blocks (O(chunk x n_items) at a time, with only top-k
+        # kept); the dense interaction matrix itself is this example's
+        # peak memory — fine into the tens of millions of cells. For
+        # production-size catalogs use the similarproduct template, whose
+        # factor-based scoring never materializes user x item.
+        import jax
+
+        chunk = 2048
+        p = self.params
+        neighbors: dict[int, tuple] = {}
+        for lo in range(0, n_items, chunk):
+            hi = min(lo + chunk, n_items)
+            # HIGHEST: TPU default-precision f32 dots round through bf16
+            # (~1e-3), visibly denting the "exact cosine" this example is
+            # about (identical columns must score 1.0)
+            block = np.asarray(jnp.matmul(
+                c_hat[:, lo:hi].T, c_hat,
+                precision=jax.lax.Precision.HIGHEST))  # [b, n_items]
+            for bi in range(hi - lo):
+                i = lo + bi
+                row = block[bi].copy()
+                row[i] = -1.0  # drop self-similarity
+                keep = np.flatnonzero(row >= p.threshold)
+                if len(keep) > p.top_k:
+                    keep = keep[np.argsort(-row[keep])[: p.top_k]]
+                else:
+                    keep = keep[np.argsort(-row[keep])]
+                if len(keep):
+                    neighbors[i] = tuple(
+                        (int(j), float(row[j])) for j in keep
+                    )
+        return SimilarityModel(data.item_ids, neighbors)
+
+    def predict(self, model: SimilarityModel, query: Query) -> PredictedResult:
+        try:
+            idx = model.item_ids.index(query.item)
+        except ValueError:
+            return PredictedResult()
+        scored = model.neighbors.get(idx, ())[: query.num]
+        return PredictedResult(tuple(
+            ItemScore(model.item_ids[j], s) for j, s in scored
+        ))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=ViewDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"cosine": CosineSimilarityAlgorithm},
+        serving_class=FirstServing,
+    )

@@ -1,0 +1,103 @@
+"""Trim-app: copy a time window of an app's events into a fresh app.
+
+The reference's experimental trim-app
+(ref: examples/experimental/scala-parallel-trim-app/src/main/scala/
+DataSource.scala:30-56) abuses the engine lifecycle on purpose: the
+*DataSource* does the real work — read the source app's events in
+[startTime, untilTime), refuse to run if the destination app already has
+events, write the window to the destination — and the algorithm/model
+are empty. It is the reference's recipe for trimming an app's history
+(run trim-app into a new app, then point the engine at it).
+
+Same shape here, over the in-process event store with batched writes.
+Run from this directory:
+
+    pio app new TrimmedApp
+    pio train    # copies the window src_app -> dst_app
+
+There is nothing to deploy; `pio train` IS the job.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.core import Engine, FirstServing, IdentityPreparator
+from predictionio_tpu.core.dase import LAlgorithm, LDataSource
+from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.data.store.event_stores import app_name_to_id
+from predictionio_tpu.utils.time import parse_datetime
+
+
+@dataclass(frozen=True)
+class TrimParams:
+    src_app: str = "MyApp"
+    dst_app: str = "TrimmedApp"
+    start_time: str | None = None  # ISO-8601, inclusive
+    until_time: str | None = None  # ISO-8601, exclusive
+
+
+@dataclass(frozen=True)
+class TrimResult:
+    copied: int
+
+
+@dataclass(frozen=True)
+class Query:
+    pass
+
+
+class TrimDataSource(LDataSource):
+    params_class = TrimParams
+
+    def __init__(self, params: TrimParams):
+        self.params = params
+
+    def read_training_local(self) -> TrimResult:
+        p = self.params
+        src_id, _ = app_name_to_id(p.src_app)
+        dst_id, _ = app_name_to_id(p.dst_app)
+        events = Storage.get_events()
+        # refuse a non-empty destination, like the reference
+        # (DataSource.scala:45-48: "DstApp ... is not empty. Quitting.")
+        if next(iter(events.find(app_id=dst_id, limit=1)), None) is not None:
+            raise RuntimeError(
+                f"destination app {p.dst_app!r} is not empty; quitting"
+            )
+        window = events.find(
+            app_id=src_id,
+            start_time=parse_datetime(p.start_time) if p.start_time else None,
+            until_time=parse_datetime(p.until_time) if p.until_time else None,
+        )
+        copied = 0
+        batch: list = []
+        for e in window:
+            batch.append(e)
+            if len(batch) >= 500:
+                copied += len(events.insert_batch(batch, dst_id))
+                batch = []
+        if batch:
+            copied += len(events.insert_batch(batch, dst_id))
+        return TrimResult(copied)
+
+
+class NoopAlgorithm(LAlgorithm):
+    query_class = Query
+
+    def __init__(self, params=None):
+        pass
+
+    def train_local(self, data: TrimResult) -> TrimResult:
+        return data  # the "model" is the copy report
+
+    def predict(self, model: TrimResult, query: Query) -> TrimResult:
+        return model
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=TrimDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"noop": NoopAlgorithm},
+        serving_class=FirstServing,
+    )

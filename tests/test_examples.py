@@ -5,6 +5,7 @@ scala-parallel-friend-recommendation, scala-stock)."""
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
 
@@ -212,3 +213,113 @@ def test_regression_ols_recovers_coefficients(memory_storage):
     # MSE is negated (higher is better); with tiny noise all folds ~ -0.0025
     assert -0.01 < result.best_score.score < 0
     assert "Mean Square Error" in result.metric_header
+
+
+def test_item_similarity_cosine_threshold(memory_storage):
+    """The DIMSUM example redesign: exact thresholded column cosine on
+    view events; similar items come back ranked by cosine."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "simapp"))
+    events = memory_storage.get_events()
+    events.init(app_id)
+    # i0 and i1 share two viewers (strong pair); i2 shares one with i0
+    for u, i in [("u1", "i0"), ("u1", "i1"), ("u2", "i0"), ("u2", "i1"),
+                 ("u3", "i2"), ("u1", "i2"), ("u4", "i3")]:
+        events.insert(
+            Event(event="view", entity_type="user", entity_id=u,
+                  target_entity_type="item", target_entity_id=i,
+                  properties=DataMap({})),
+            app_id,
+        )
+
+    factory = "engine:engine_factory"
+    engine = get_engine(factory, EXAMPLES / "itemsimilarity")
+    ep = engine.engine_params_from_json({
+        "datasource": {"params": {"app_name": "simapp"}},
+        "algorithms": [{"name": "cosine",
+                        "params": {"threshold": 0.1, "top_k": 5}}],
+    })
+    instance = new_engine_instance("sim", "1", "default", factory, ep)
+    instance_id = run_train(engine, ep, instance, WorkflowParams())
+    assert instance_id
+
+    from predictionio_tpu.core.persistent_model import deserialize_models
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    blob = memory_storage.get_model_data_models().get(instance_id)
+    models = engine.prepare_deploy(
+        compute_context(), ep, instance_id,
+        deserialize_models(blob.models), WorkflowParams())
+    algo = engine._algorithms(ep)[0]
+    res = algo.predict(models[0], algo.query_class(item="i0", num=3))
+    got = [(s.item, s.score) for s in res.itemScores]
+    assert got and got[0][0] == "i1"  # strongest co-view pair
+    # i0 and i1 have identical viewer sets {u1, u2} -> cosine 1.0;
+    # i2 shares only u1 -> 1/(sqrt(2)*sqrt(2)) = 0.5
+    assert abs(got[0][1] - 1.0) < 1e-5
+    assert ("i2", pytest.approx(0.5, abs=1e-5)) in [
+        (i, s) for i, s in got
+    ]
+    assert "i3" not in [g[0] for g in got]  # disjoint viewers: no pair
+    # unknown item -> empty, like the reference's None handling
+    assert algo.predict(
+        models[0], algo.query_class(item="nope", num=3)).itemScores == ()
+
+
+def test_trimapp_copies_window_and_refuses_nonempty_dst(memory_storage):
+    import datetime as dt
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    apps = memory_storage.get_meta_data_apps()
+    src_id = apps.insert(App(0, "SrcApp"))
+    dst_id = apps.insert(App(0, "DstApp"))
+    events = memory_storage.get_events()
+    events.init(src_id)
+    events.init(dst_id)
+    utc = dt.timezone.utc
+    for h in range(6):
+        events.insert(
+            Event(event="view", entity_type="user", entity_id=f"u{h}",
+                  properties=DataMap({}),
+                  event_time=dt.datetime(2020, 1, 1, h, tzinfo=utc)),
+            src_id,
+        )
+
+    factory = "engine:engine_factory"
+    engine = get_engine(factory, EXAMPLES / "trimapp")
+    ep = engine.engine_params_from_json({
+        "datasource": {"params": {
+            "src_app": "SrcApp", "dst_app": "DstApp",
+            "start_time": "2020-01-01T02:00:00Z",
+            "until_time": "2020-01-01T05:00:00Z",
+        }},
+        "algorithms": [{"name": "noop", "params": {}}],
+    })
+    instance = new_engine_instance("trim", "1", "default", factory, ep)
+    run_train(engine, ep, instance, WorkflowParams())
+    copied = sorted(e.entity_id for e in events.find(app_id=dst_id))
+    assert copied == ["u2", "u3", "u4"]  # [start, until)
+
+    # destination now non-empty: a second run must refuse
+    instance2 = new_engine_instance("trim", "1", "default", factory, ep)
+    with pytest.raises(RuntimeError, match="not empty"):
+        run_train(engine, ep, instance2, WorkflowParams())
