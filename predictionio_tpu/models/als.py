@@ -822,6 +822,14 @@ class ALS:
                 )
             if p.solver == "dense" or als_dense.auto_pick(
                     ctx, n_users, n_items, ratings):
+                if ctx.mesh.devices.size > 1 and callback is None:
+                    # SPMD: one A row-block per device, item normal
+                    # equations completed by a psum over `data`
+                    user_f, item_f = als_dense.train_dense_sharded(
+                        ctx, p, user_idx, item_idx, ratings, n_users,
+                        n_items)
+                    return ALSFactors(
+                        np.asarray(user_f)[:n_users], np.asarray(item_f))
                 user_f, item_f = als_dense.train_dense(
                     ctx, p, user_idx, item_idx, ratings, n_users, n_items,
                     callback)

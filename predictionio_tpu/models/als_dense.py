@@ -82,8 +82,10 @@ def dense_eligible(n_users: int, n_items: int, ratings: np.ndarray,
 
 
 def auto_pick(ctx, n_users: int, n_items: int, ratings: np.ndarray) -> bool:
-    """The ``solver="auto"`` gate, shared by ALS.train and bench.py: dense
-    wants a single device (it runs replicated, not sharded), a density
+    """The ``solver="auto"`` gate, shared by ALS.train and bench.py:
+    single device (the SPMD path exists — train_dense_sharded — but auto
+    stays conservative until it has been benched on real multi-chip
+    hardware; ``solver="dense"`` on a mesh opts in explicitly), density
     above ~1/2000 (below that the gather's nnz-proportional traffic beats
     reading every dense cell), the HBM byte budget, and int8-encodable
     values — cheap checks first, the full ratings scan last."""
@@ -167,7 +169,12 @@ def _collapse_corrections(su, si, sv, main_mask):
 
 
 def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
-                   scale: int | None = None) -> _DensePlan:
+                   scale: int | None = None,
+                   nb: int | None = None,
+                   uniform_m: bool = False) -> _DensePlan:
+    """``nb`` forces the row-block count (the SPMD path wants one block
+    per device); ``uniform_m`` pads every block's COO to one common size
+    (stackable into a [nb, m] sharded array)."""
     if scale is None:
         scale = _int8_scale(vals)
     assert scale, "dense solver requires int8-encodable ratings"
@@ -183,13 +190,16 @@ def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
         mv = (sv * scale).astype(np.int8) if scale != 1 else sv.astype(np.int8)
     else:
         mu, mi, mv = su[main], si[main], (sv[main] * scale).astype(np.int8)
-    ub = max(_BLOCK_BYTES // max(n_items, 1), 1)
-    nb = max((n_users + ub - 1) // ub, 1)
+    if nb is None:
+        ub = max(_BLOCK_BYTES // max(n_items, 1), 1)
+        nb = max((n_users + ub - 1) // ub, 1)
     ub = (n_users + nb - 1) // nb
     bounds = np.searchsorted(mu, np.arange(1, nb) * ub)
     starts = np.concatenate([[0], bounds, [len(mu)]])
     flat_all = (mu.astype(np.int64) % ub) * n_items + mi
     oor = ub * n_items  # first out-of-range cell: scatter drops from here
+    sizes = np.diff(starts)
+    common_m = max(int(sizes.max()) + 1023, 1024) // 1024 * 1024
     flat, bvals = [], []
     for b in range(nb):
         lo, hi = starts[b], starts[b + 1]
@@ -199,7 +209,8 @@ def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
         # cliff — measured round 3); the padding cells are ascending
         # distinct out-of-range ids, dropped by the scatter while keeping
         # indices_are_sorted/unique_indices true
-        m = max((k + 1023) // 1024 * 1024, 1024)
+        m = common_m if uniform_m else max(
+            (k + 1023) // 1024 * 1024, 1024)
         f = np.empty(m, np.int32)
         v = np.zeros(m, np.int8)
         f[:k] = flat_all[lo:hi].astype(np.int32)
@@ -242,6 +253,34 @@ def _pairs_payload(f, rank: int):
         axis=1)
 
 
+def _make_dots(implicit: bool, exact: bool):
+    """The pair of payload matmuls of one half-step, with the precision
+    placement both solver paths must share: bf16 left operands are EXACT
+    (0/1 and |scaled rating| <= 127 are all bf16-representable), and the
+    dot whose payload carries the gram PAIRS must run at HIGHEST (see
+    _pairs_payload's numerical contract) — the indicator dot in explicit
+    mode, the value dot in implicit mode. The other dot only feeds rhs
+    (and exactly-representable counts), where bf16-payload rounding is
+    the same accepted error class as the bucket solver's bf16 gather —
+    relaxed unless the caller asked for the f32 parity mode."""
+    hi = jax.lax.Precision.HIGHEST
+    lo = hi if exact else None
+    ind_prec, val_prec = (lo, hi) if implicit else (hi, lo)
+
+    def dots(a, ip, vp, dims):
+        ai = (a != 0).astype(jnp.bfloat16)
+        av = a.astype(jnp.bfloat16)
+        gi = jax.lax.dot_general(ai, ip, (dims, ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=ind_prec)
+        gv = jax.lax.dot_general(av, vp, (dims, ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=val_prec)
+        return gi, gv
+
+    return dots
+
+
 def _dup_correction(dup, fixed, rank: int, n_entities: int, alpha,
                     implicit: bool):
     """f32 segment-sum of the correction cells' normal-equation terms →
@@ -276,44 +315,9 @@ def _dense_half_solve(
     corrections + SoA Cholesky solve. Exactly one of ``blocks`` (row
     blocks: entities on rows) / ``tblocks`` (transposed contraction:
     entities on columns) is set."""
-    from predictionio_tpu.models.als import _reg_solve
-
     n = prev.shape[0]
-    n_pairs = rank * (rank + 1) // 2
-    payload = _pairs_payload(fixed, rank)  # [n_other, P+r+1] f32
-    if implicit:
-        # ind @ [Y | 1] -> rhs base + counts; val @ [Z | Y] -> Hu-Koren
-        # gram corrections + alpha-weighted rhs part
-        ind_payload = payload[:, n_pairs:]
-        val_payload = payload[:, : n_pairs + rank]
-    else:
-        # ind @ [Z | 1] -> gram pairs + counts; val @ Y -> rhs
-        ind_payload = jnp.concatenate(
-            [payload[:, :n_pairs], payload[:, -1:]], axis=1)
-        val_payload = payload[:, n_pairs: n_pairs + rank]
-
-    # bf16 left operands are EXACT (0/1 and |scaled rating| <= 127 are all
-    # bf16-representable). The dot whose payload carries the gram PAIRS
-    # must run at HIGHEST (see _pairs_payload's numerical contract): in
-    # explicit mode that is the indicator dot, in implicit mode the value
-    # dot. The other dot only feeds rhs (and exactly-representable
-    # counts), where bf16-payload rounding is the same accepted error
-    # class as the bucket solver's bf16 gather — relaxed unless the
-    # caller asked for the f32 parity mode.
-    hi = jax.lax.Precision.HIGHEST
-    lo = hi if exact else None
-    ind_prec, val_prec = (lo, hi) if implicit else (hi, lo)
-
-    def dots(a, ip, vp, dims):
-        ai = (a != 0).astype(jnp.bfloat16)
-        av = a.astype(jnp.bfloat16)
-        gi = jax.lax.dot_general(ai, ip, (dims, ((), ())),
-                                 preferred_element_type=jnp.float32,
-                                 precision=ind_prec)
-        gv = jax.lax.dot_general(av, vp, (dims, ((), ())),
-                                 preferred_element_type=jnp.float32,
-                                 precision=val_prec)
-        return gi, gv
+    ind_payload, val_payload = _local_half_inputs(fixed, rank, implicit)
+    dots = _make_dots(implicit, exact)
 
     if blocks is not None:
         gis, gvs = [], []
@@ -345,31 +349,11 @@ def _dense_half_solve(
             d_gi, d_gv = dots(a, ip, vp, ((0,), (0,)))
             gi, gv = gi + d_gi, gv + d_gv
 
-    if implicit:
-        pairs = gv[:, :n_pairs] * alpha / scale
-        rhs = gi[:, :rank] + alpha * gv[:, n_pairs:] / scale
-        counts = gi[:, -1]
-    else:
-        pairs = gi[:, :n_pairs]
-        rhs = gv / scale
-        counts = gi[:, -1]
-
+    corr = None
     if dup is not None:
         corr = _dup_correction(dup, fixed, rank, n, alpha, implicit)
-        pairs = pairs + corr[:, :n_pairs]
-        rhs = rhs + corr[:, n_pairs: n_pairs + rank]
-        counts = counts + corr[:, -1]
-
-    iu, ju = np.triu_indices(rank)
-    gram = jnp.zeros((n, rank, rank), jnp.float32)
-    gram = gram.at[:, iu, ju].set(pairs)
-    gram = gram.at[:, ju, iu].set(pairs)
-    if implicit:
-        gram = gram + (fixed.T @ fixed)[None, :, :]
-    reg = lambda_ * jnp.maximum(counts, 1.0) + 1e-8
-    sol = _reg_solve(gram, rhs, reg, rank)
-    # zero-degree entities keep their previous factors
-    return jnp.where(counts[:, None] > 0, sol, prev)
+    return _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha,
+                            implicit, rank, scale)
 
 
 def _iteration_dense(user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha,
@@ -475,3 +459,179 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                 **static)
             callback(it, user_f, item_f)
     return user_f, item_f
+
+
+# ---------------------------------------------------------------------------
+# SPMD dense training (mesh data axis)
+# ---------------------------------------------------------------------------
+#
+# Each device owns one row-block of A (its shard of the users): the user
+# half-step is entirely local (local rows x replicated item payload), the
+# item half-step contracts each device's block against its local user
+# rows and one psum over ``data`` produces the replicated item normal
+# equations — the same collective role MLlib's factor-block shuffle
+# plays, riding ICI. Item factors stay replicated; user factors live
+# row-sharded for the whole run and only materialize on the host once,
+# at the final readback.
+
+
+def _local_half_inputs(itf, rank, implicit):
+    payload = _pairs_payload(itf, rank)
+    n_pairs = rank * (rank + 1) // 2
+    if implicit:
+        return payload[:, n_pairs:], payload[:, : n_pairs + rank]
+    return (
+        jnp.concatenate([payload[:, :n_pairs], payload[:, -1:]], axis=1),
+        payload[:, n_pairs: n_pairs + rank],
+    )
+
+
+def _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha, implicit,
+                     rank, scale):
+    """pairs/rhs/counts -> regularized SoA Cholesky solve (the shared tail
+    of both half-steps; ``corr`` is an optional [n, P+r+1] f32 addend)."""
+    from predictionio_tpu.models.als import _reg_solve
+
+    n_pairs = rank * (rank + 1) // 2
+    if implicit:
+        pairs = gv[:, :n_pairs] * alpha / scale
+        rhs = gi[:, :rank] + alpha * gv[:, n_pairs:] / scale
+        counts = gi[:, -1]
+    else:
+        pairs = gi[:, :n_pairs]
+        rhs = gv / scale
+        counts = gi[:, -1]
+    if corr is not None:
+        pairs = pairs + corr[:, :n_pairs]
+        rhs = rhs + corr[:, n_pairs: n_pairs + rank]
+        counts = counts + corr[:, -1]
+    iu, ju = np.triu_indices(rank)
+    gram = jnp.zeros((prev.shape[0], rank, rank), jnp.float32)
+    gram = gram.at[:, iu, ju].set(pairs)
+    gram = gram.at[:, ju, iu].set(pairs)
+    if implicit:
+        gram = gram + (fixed.T @ fixed)[None, :, :]
+    reg = lambda_ * jnp.maximum(counts, 1.0) + 1e-8
+    sol = _reg_solve(gram, rhs, reg, rank)
+    return jnp.where(counts[:, None] > 0, sol, prev)
+
+
+def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
+                        scale: int | None = None):
+    """SPMD dense training over the mesh ``data`` axis. Returns
+    (user_f [padded, r] row-sharded, item_f [n_items, r] replicated) as
+    device arrays; rows beyond ``n_users`` are padding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.models.als import _init_factors
+
+    p = params
+    mesh = ctx.mesh
+    # one row-block per DATA-axis shard; model-axis devices replicate
+    ndev = mesh.shape["data"]
+    ub_est = -(-n_users // ndev)
+    if ub_est * n_items + len(ratings) >= 2**31:
+        # the flat-cell scatter ids are int32; unlike the single-device
+        # path (whose _BLOCK_BYTES split bounds ub*n_items), one-block-
+        # per-device has no second split — wrap-around would silently
+        # DROP ratings via the scatter's mode="drop"
+        raise ValueError(
+            "dense SPMD block too large for int32 cell ids "
+            f"({ub_est} rows x {n_items} items); use solver='bucket' or "
+            "more devices"
+        )
+    plan = _dense_prepare(ui, ii, ratings, n_users, n_items, scale=scale,
+                          nb=ndev, uniform_m=True)
+    ub = plan.ub
+    up = ndev * ub
+    logger.info(
+        "ALS(dense,SPMD): %d ratings -> %d x %d int8 cells, %d device "
+        "blocks of %d rows, scale %d, rank %d",
+        len(ratings), n_users, n_items, ndev, ub, plan.scale, p.rank)
+
+    data_ax = NamedSharding(mesh, P("data", None))
+    repl = NamedSharding(mesh, P())
+    flat = jax.device_put(np.stack(plan.flat), data_ax)  # [ndev, m]
+    vals = jax.device_put(np.stack(plan.vals), data_ax)
+    dup_u = dup_i = None
+    if plan.dup_u is not None:
+        dup_u = tuple(jax.device_put(x, repl) for x in (
+            plan.dup_u.seg, plan.dup_u.nbr, plan.dup_u.cnt, plan.dup_u.val))
+        dup_i = tuple(jax.device_put(x, repl) for x in (
+            plan.dup_i.seg, plan.dup_i.nbr, plan.dup_i.cnt, plan.dup_i.val))
+
+    key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+    ku, ki = jax.random.split(key)
+    # init must match the single-device dense path row for row (the PRNG
+    # stream depends on the shape), and the padding rows must be ZERO:
+    # they are never solved (count 0 keeps them), and implicit mode's
+    # all-gathered XtX Gram term must not see random vectors in them
+    uf_host = np.zeros((up, p.rank), np.float32)
+    uf_host[:n_users] = np.asarray(_init_factors(ku, n_users, p.rank))
+    uf0 = jax.device_put(uf_host, data_ax)
+    itf0 = jax.device_put(
+        np.asarray(_init_factors(ki, n_items, p.rank)), repl)
+
+    rank, implicit, sc = p.rank, p.implicit_prefs, plan.scale
+    exact = p.gather_dtype == "float32"
+    dots = _make_dots(implicit, exact)
+    n_pairs = rank * (rank + 1) // 2
+    ncols = n_pairs + rank + 1
+
+    def spmd_train(flat_l, vals_l, uf_l, itf, du, di):
+        # flat_l/vals_l/uf_l: this device's [1, ...] shard; squeeze it
+        a = _scatter_block(flat_l[0], vals_l[0], ub=ub, n_items=n_items)
+        row0 = jax.lax.axis_index("data") * ub
+
+        def corr_rows(dup, fixed, n_entities):
+            if dup is None:
+                return None
+            return _dup_correction(dup, fixed, rank, n_entities, p.alpha,
+                                   implicit)
+
+        def body(_i, carry):
+            uf_l, itf = carry
+            # ---- user half: local rows only
+            ip, vp = _local_half_inputs(itf, rank, implicit)
+            gi, gv = dots(a, ip, vp, ((1,), (0,)))
+            corr = corr_rows(du, itf, up)
+            if corr is not None:
+                corr = jax.lax.dynamic_slice(corr, (row0, 0), (ub, ncols))
+            uf_l = _normal_eq_solve(uf_l, gi, gv, corr, itf, p.lambda_,
+                                    p.alpha, implicit, rank, sc)
+            # ---- item half: local partial contraction + psum over data.
+            # The payload comes from the LOCAL user rows; summing the
+            # per-device partials over the axis completes the global
+            # normal equations.
+            ip2, vp2 = _local_half_inputs(uf_l, rank, implicit)
+            d_gi, d_gv = dots(a, ip2, vp2, ((0,), (0,)))
+            gi2 = jax.lax.psum(d_gi, "data")
+            gv2 = jax.lax.psum(d_gv, "data")
+            uf_full = None
+            if implicit or di is not None:
+                # the full (small) user matrix: implicit mode's XtX Gram
+                # term and the correction gathers need global rows —
+                # [up, r] f32 rides one all-gather
+                uf_full = jax.lax.all_gather(
+                    uf_l, "data").reshape(up, rank)
+            corr2 = corr_rows(di, uf_full, n_items) if di is not None \
+                else None
+            itf = _normal_eq_solve(
+                itf, gi2, gv2, corr2,
+                uf_full if implicit else itf,
+                p.lambda_, p.alpha, implicit, rank, sc)
+            return uf_l, itf
+
+        uf_l, itf = jax.lax.fori_loop(0, p.num_iterations, body,
+                                      (uf_l, itf))
+        return uf_l, itf
+
+    shard_fn = jax.jit(jax.shard_map(
+        spmd_train, mesh=mesh,
+        in_specs=(P("data", None), P("data", None), P("data", None), P(),
+                  P(), P()),
+        out_specs=(P("data", None), P()),
+        check_vma=False,
+    ))
+    uf, itf = shard_fn(flat, vals, uf0, itf0, dup_u, dup_i)
+    return uf, itf

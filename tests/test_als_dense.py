@@ -156,3 +156,49 @@ def test_dense_rejects_non_encodable_ratings(ctx):
     f = ALS(ctx, ALSParams(solver="auto", rank=4, num_iterations=2)).train(
         ui, ii, r, 50, 35)
     assert f.user_features.shape == (50, 4)
+
+
+@pytest.mark.parametrize("implicit", [False, True], ids=["explicit", "implicit"])
+def test_dense_sharded_matches_single_device(ctx, implicit):
+    """The SPMD dense path (one A row-block per device, psum'd item
+    normal equations) must reproduce the replicated dense result on the
+    same data — including duplicate-cell corrections."""
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(11)
+    n_users, n_items, nnz = 45, 30, 700  # dups guaranteed
+    ui = rng.integers(0, n_users, nnz).astype(np.int32)
+    ii = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    if implicit:
+        r = (r >= 3).astype(np.float32) * 2.0
+        keep = r > 0
+        ui, ii, r = ui[keep], ii[keep], r[keep]
+    common = dict(rank=5, num_iterations=4, lambda_=0.03, seed=2,
+                  implicit_prefs=implicit, alpha=1.2, solver="dense",
+                  gather_dtype="float32")
+    # single device: a 1-device mesh context
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    one = ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("data", "model")))
+    want = ALS(one, ALSParams(**common)).train(ui, ii, r, n_users, n_items)
+    got = ALS(ctx, ALSParams(**common)).train(ui, ii, r, n_users, n_items)
+    assert np.isfinite(got.user_features).all()
+    np.testing.assert_allclose(
+        got.user_features, want.user_features, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(
+        got.item_features, want.item_features, rtol=2e-3, atol=2e-3)
+
+
+def test_dense_sharded_entities_without_ratings_stay_at_init(ctx):
+    ui = np.array([0, 0, 1, 2], dtype=np.int32)
+    ii = np.array([0, 1, 1, 0], dtype=np.int32)
+    r = np.array([5.0, 3.0, 4.0, 1.0], dtype=np.float32)
+    params = ALSParams(rank=4, num_iterations=3, lambda_=0.1, seed=11,
+                       solver="dense")
+    u0, v0 = _init_factors_of(ctx, params, ui, ii, r, 11, 5)
+    got = ALS(ctx, params).train(ui, ii, r, 11, 5)
+    np.testing.assert_allclose(got.user_features[3:], u0[3:], atol=1e-6)
+    np.testing.assert_allclose(got.item_features[2:], v0[2:], atol=1e-6)
