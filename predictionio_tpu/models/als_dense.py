@@ -17,7 +17,7 @@ compute each half-step's normal equations as two large dense matmuls —
 which the MXU executes at O(TFLOP/s) instead of the gather's
 O(10 GFLOP/s). One rating cell is one int8 byte, so HBM traffic per
 iteration is ~2 x bytes(A) instead of ~4 KB x nnz: at MovieLens-20M
-(138k x 27k, 20M ratings, rank 10) this is ~25 ms/iteration vs ~360 ms
+(138k x 27k, 20M ratings, rank 10) this is ~37 ms/iteration vs ~360 ms
 for the gather path — both measured on one v5e chip.
 
 Exactness: the dense matrix holds each cell's single rating (times a
