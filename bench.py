@@ -222,7 +222,13 @@ def bench_als(ctx, ui, ii, r, n_users, n_items, rank: int, iters: int,
     try:
         steady_rate = _steady_rate_dense(ctx, ui, ii, r, n_users, n_items,
                                          rank, iters, repeats)
-    except Exception:  # fall back to the delta method below
+    except Exception as e:  # fall back to the delta method below — but
+        # say so: a silently-degraded measurement method is invisible in
+        # the JSON output otherwise
+        import sys as _sys
+
+        print(f"[bench] steady-rate dense timer failed, using delta "
+              f"method: {e!r}", file=_sys.stderr)
         steady_rate = None
     if steady_rate is None:
         # delta method: both terms best-of-N (jitter is positive-additive,
