@@ -31,6 +31,7 @@ the default JAX backend), ``cpu`` (always host).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -39,6 +40,8 @@ import weakref
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "link_rtt",
@@ -136,6 +139,100 @@ def reset_measurements() -> None:
     _measurements.clear()
 
 
+#: How long a raise-mode fallback stays cached before the probe is retried
+#: (transient tunnel blips self-heal); hang-mode fallbacks are permanent.
+_FALLBACK_TTL_S = 60.0
+#: A probe blocked longer than this (a wedged runtime usually *hangs*
+#: rather than raises) is abandoned to its daemon thread.
+_PROBE_TIMEOUT_S = 10.0
+
+
+class _Fallback:
+    """Cached host-favoring value standing in for a failed measurement.
+    ``expires`` is a monotonic deadline after which the probe is retried,
+    or None for permanent (hang-mode failures: retrying would leak one
+    blocked daemon thread per retry)."""
+
+    __slots__ = ("value", "expires")
+
+    def __init__(self, value: float, expires: float | None):
+        self.value = value
+        self.expires = expires
+
+
+def _run_probe_with_timeout(key: str, fn) -> float:
+    """Run ``fn`` on a worker thread with a deadline. A wedged accelerator
+    runtime typically *blocks* in device_put/readback rather than raising;
+    timing out here (and leaving the daemon thread to its fate) is the only
+    way serving can degrade instead of deadlocking behind the probe."""
+    result: dict = {}
+
+    def run():
+        try:
+            result["value"] = fn()
+        except Exception as exc:  # re-raised on the caller thread below
+            result["error"] = exc
+
+    t = threading.Thread(
+        target=run, name=f"placement-probe-{key}", daemon=True
+    )
+    t.start()
+    t.join(_PROBE_TIMEOUT_S)
+    if t.is_alive():
+        raise TimeoutError(
+            f"probe {key!r} still blocked after {_PROBE_TIMEOUT_S:.0f}s"
+        )
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def _measured_failsoft(key: str, fn, fallback: float) -> float:
+    """Measure-once, but a probe that fails (wedged TPU runtime, libtpu
+    version mismatch, dead tunnel) caches a host-favoring ``fallback``
+    instead of propagating: serving must degrade to the host CPU backend,
+    never crash or hang on an unhealthy accelerator (the reference's
+    serving is local JVM math and cannot depend on a second device being
+    healthy — ref: core/.../workflow/CreateServer.scala:513-520).
+    Raise-mode fallbacks expire after ``_FALLBACK_TTL_S`` so a transient
+    blip at deploy time doesn't pin serving to the host for the process
+    lifetime; hang-mode (timeout) fallbacks are permanent because each
+    retry would strand another blocked daemon thread."""
+
+    def fresh(val) -> bool:
+        return val is not None and not (
+            isinstance(val, _Fallback)
+            and val.expires is not None
+            and val.expires <= time.monotonic()
+        )
+
+    def unwrap(val) -> float:
+        return val.value if isinstance(val, _Fallback) else val
+
+    val = _measurements.get(key)
+    if fresh(val):
+        return unwrap(val)
+    with _measure_lock:
+        val = _measurements.get(key)
+        if fresh(val):
+            return unwrap(val)
+        try:
+            res = _run_probe_with_timeout(key, fn)
+            _measurements[key] = res
+            return res
+        except Exception as exc:
+            hang = isinstance(exc, TimeoutError)
+            logger.warning(
+                "placement probe %r failed (%s: %s); caching host-favoring "
+                "fallback %r %s — serving stays on the host CPU backend",
+                key, type(exc).__name__, exc, fallback,
+                "permanently" if hang else f"for {_FALLBACK_TTL_S:.0f}s",
+            )
+            expires = None if hang else time.monotonic() + _FALLBACK_TTL_S
+            _measurements[key] = _Fallback(fallback, expires)
+            return fallback
+
+
 def _measure_link_rtt() -> float:
     dev = jax.devices()[0]
     if dev.platform == "cpu":
@@ -153,8 +250,9 @@ def _measure_link_rtt() -> float:
 
 
 def link_rtt() -> float:
-    """Median blocking readback RTT (seconds) of the default backend."""
-    return _measured("link_rtt", _measure_link_rtt)
+    """Median blocking readback RTT (seconds) of the default backend.
+    Fail-soft: an unreachable accelerator measures as an infinite RTT."""
+    return _measured_failsoft("link_rtt", _measure_link_rtt, float("inf"))
 
 
 def _measure_host_flops_rate() -> float:
@@ -175,8 +273,13 @@ def _measure_host_flops_rate() -> float:
 
 
 def host_flops_rate() -> float:
-    """Measured f32 matmul throughput (FLOP/s) of the CPU backend."""
-    return _measured("host_flops", _measure_host_flops_rate)
+    """Measured f32 matmul throughput (FLOP/s) of the CPU backend.
+    Fail-soft: a failed *host* benchmark falls back to a conservative
+    finite 1 GFLOP/s (the same constant used when no CPU backend exists)
+    rather than inf — here the accelerator may be perfectly healthy, and
+    an inf host rate would silently pin arbitrarily large calls onto the
+    unbenchmarked host."""
+    return _measured_failsoft("host_flops", _measure_host_flops_rate, 1e9)
 
 
 def _measure_uplink_rate() -> float:
@@ -210,8 +313,9 @@ def _measure_uplink_rate() -> float:
 
 def uplink_rate() -> float:
     """Measured host->device transfer rate (bytes/s) of the default
-    backend, fixed-cost-corrected (differential sizing)."""
-    return _measured("uplink_rate", _measure_uplink_rate)
+    backend, fixed-cost-corrected (differential sizing). Fail-soft: an
+    unreachable accelerator measures as a ~dead link (1 B/s)."""
+    return _measured_failsoft("uplink_rate", _measure_uplink_rate, 1.0)
 
 
 def _cpu_device():
@@ -236,7 +340,15 @@ def serving_device(flops: float, upload_bytes: float = 0.0):
         return None
     if mode == "cpu":
         return cpu
-    if jax.default_backend() == "cpu":
+    try:
+        default_is_cpu = jax.default_backend() == "cpu"
+    except Exception as exc:  # runtime so broken even introspection fails
+        logger.warning(
+            "default-backend probe failed (%s: %s); serving from host CPU",
+            type(exc).__name__, exc,
+        )
+        return cpu
+    if default_is_cpu:
         return None
     accel_cost = link_rtt() + (
         upload_bytes / uplink_rate() if upload_bytes else 0.0

@@ -673,10 +673,16 @@ def cmd_status(args) -> int:
             from predictionio_tpu.parallel.placement import link_rtt
 
             rtt_ms = link_rtt() * 1e3
-            print(
-                f"[INFO] Accelerator link RTT: {rtt_ms:.2f} ms "
-                f"(drives serving placement; see PIO_SERVING_DEVICE)"
-            )
+            if rtt_ms == float("inf"):  # fail-soft probe: accel unreachable
+                print(
+                    "[WARN] Accelerator link probe failed — serving will "
+                    "stay on the host CPU backend", file=sys.stderr
+                )
+            else:
+                print(
+                    f"[INFO] Accelerator link RTT: {rtt_ms:.2f} ms "
+                    f"(drives serving placement; see PIO_SERVING_DEVICE)"
+                )
     except Exception as e:  # a broken accelerator must not fail status
         print(f"[WARN] JAX backend probe failed: {e}", file=sys.stderr)
     s = Storage.instance()

@@ -54,6 +54,103 @@ def test_link_rtt_zero_on_cpu_backend():
     assert placement.link_rtt() == 0.0
 
 
+def test_probes_failsoft_host_favoring(monkeypatch, caplog):
+    """A wedged accelerator runtime (any probe raising) caches a
+    host-favoring fallback with one warning instead of propagating, and
+    serving_device then picks the host for any call size (VERDICT r3
+    weak items 1/2/8)."""
+    import logging
+
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    monkeypatch.setattr(placement.jax, "default_backend", lambda: "tpu")
+
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise RuntimeError("libtpu version mismatch (simulated)")
+
+    monkeypatch.setattr(placement, "_measure_link_rtt", boom)
+    monkeypatch.setattr(placement, "_measure_uplink_rate", boom)
+    monkeypatch.setattr(placement, "_measure_host_flops_rate", boom)
+    with caplog.at_level(logging.WARNING, logger=placement.__name__):
+        assert placement.link_rtt() == float("inf")
+        assert placement.uplink_rate() == 1.0
+        assert placement.host_flops_rate() == 1e9  # finite: accel may be fine
+    assert sum("fail" in r.message for r in caplog.records) >= 3
+    # giant call + giant upload: still the host, never an exception
+    dev = placement.serving_device(1e18, upload_bytes=1e12)
+    assert dev is not None and dev.platform == "cpu"
+    # fallbacks are cached — the broken probe is not re-run per query
+    n = calls["n"]
+    placement.serving_device(1e18)
+    assert calls["n"] == n
+
+
+def test_probe_fallback_expires_and_reprobes(monkeypatch):
+    """A raise-mode fallback is a TTL'd cache entry, not a process-lifetime
+    pin: after the TTL a transient deploy-time blip self-heals and the real
+    measurement wins (code-review r4 finding)."""
+    monkeypatch.setattr(placement, "_FALLBACK_TTL_S", 0.05)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient tunnel blip")
+        return 0.0025
+
+    monkeypatch.setattr(placement, "_measure_link_rtt", flaky)
+    assert placement.link_rtt() == float("inf")
+    assert placement.link_rtt() == float("inf")  # within TTL: no re-probe
+    assert calls["n"] == 1
+    import time
+
+    time.sleep(0.06)
+    assert placement.link_rtt() == 0.0025  # TTL expired → recovered
+    assert placement.link_rtt() == 0.0025  # success is cached permanently
+    assert calls["n"] == 2
+
+
+def test_probe_hang_times_out_and_pins_permanently(monkeypatch):
+    """A probe that *blocks* (the common wedge mode: device_put/readback
+    hang rather than raise) must not deadlock serving behind the measure
+    lock — it times out to the fallback, permanently (each retry would
+    strand another blocked daemon thread)."""
+    import threading
+    import time
+
+    monkeypatch.setattr(placement, "_PROBE_TIMEOUT_S", 0.1)
+    monkeypatch.setattr(placement, "_FALLBACK_TTL_S", 0.0)
+    release = threading.Event()
+    calls = {"n": 0}
+
+    def hang():
+        calls["n"] += 1
+        release.wait(5)
+        return 0.001
+
+    monkeypatch.setattr(placement, "_measure_link_rtt", hang)
+    t0 = time.perf_counter()
+    assert placement.link_rtt() == float("inf")
+    assert time.perf_counter() - t0 < 2.0  # degraded, not deadlocked
+    time.sleep(0.01)  # TTL=0: a raise-mode fallback would now re-probe...
+    assert placement.link_rtt() == float("inf")
+    assert calls["n"] == 1  # ...but hang-mode is pinned: no second thread
+    release.set()
+
+
+def test_serving_device_failsoft_when_backend_introspection_raises(monkeypatch):
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+
+    def boom():
+        raise RuntimeError("runtime gone")
+
+    monkeypatch.setattr(placement.jax, "default_backend", boom)
+    dev = placement.serving_device(1e18)
+    assert dev is not None and dev.platform == "cpu"
+
+
 def test_host_flops_rate_positive():
     assert placement.host_flops_rate() > 1e8  # any real host beats 0.1 GF/s
 
