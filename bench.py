@@ -248,7 +248,11 @@ def _steady_rate_dense(ctx, ui, ii, r, n_users, n_items, rank, iters,
     from predictionio_tpu.models import als_dense
     from predictionio_tpu.models.als import ALSParams, _init_factors
 
-    if not als_dense.auto_pick(ctx, n_users, n_items, r):
+    # single-device only: this timer drives the unsharded _dense_train; on
+    # a mesh auto now routes to train_dense_sharded, which would make this
+    # measurement an implementation the product no longer runs there
+    if ctx.mesh.devices.size != 1 or not als_dense.auto_pick(
+            ctx, n_users, n_items, r):
         return None
     plan = als_dense._dense_prepare(ui, ii, r, n_users, n_items)
     blocks, dup_u, dup_i = als_dense.prepare_device_inputs(plan)

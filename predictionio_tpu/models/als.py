@@ -814,22 +814,38 @@ class ALS:
         if p.solver in ("auto", "dense"):
             from predictionio_tpu.models import als_dense
 
-            if p.solver == "dense" and not als_dense.dense_eligible(
-                    n_users, n_items, ratings):
+            if p.solver == "dense" and not als_dense.dense_eligible_on(
+                    ctx, n_users, n_items, ratings):
                 raise ValueError(
-                    "solver='dense' requires int8-encodable ratings and "
-                    f"n_users*n_items <= {als_dense.DENSE_MAX_BYTES} cells"
+                    "solver='dense' requires int8-encodable ratings and a "
+                    "rating matrix within the dense budget (single device: "
+                    f"n_users*n_items <= {als_dense.DENSE_MAX_BYTES} cells; "
+                    "mesh: one int32-addressable row-block per data shard)"
                 )
             if p.solver == "dense" or als_dense.auto_pick(
                     ctx, n_users, n_items, ratings):
-                if ctx.mesh.devices.size > 1 and callback is None:
-                    # SPMD: one A row-block per device, item normal
-                    # equations completed by a psum over `data`
-                    user_f, item_f = als_dense.train_dense_sharded(
-                        ctx, p, user_idx, item_idx, ratings, n_users,
-                        n_items)
-                    return ALSFactors(
-                        np.asarray(user_f)[:n_users], np.asarray(item_f))
+                if ctx.mesh.devices.size > 1:
+                    if als_dense.sharded_block_fits(
+                            ctx, n_users, n_items, ratings.size):
+                        # SPMD: one A row-block per device, item normal
+                        # equations completed by a psum over `data`
+                        user_f, item_f = als_dense.train_dense_sharded(
+                            ctx, p, user_idx, item_idx, ratings, n_users,
+                            n_items, callback=callback)
+                        return ALSFactors(
+                            np.asarray(user_f), np.asarray(item_f))
+                    # explicit solver="dense" on a mesh whose per-device
+                    # row-block exceeds the SPMD layout's int32/HBM
+                    # bounds: the single-device path below device_puts
+                    # every block UNSHARDED onto the default device —
+                    # possible OOM at sizes the mesh was meant to absorb
+                    logger.warning(
+                        "ALS(dense): %d-device mesh present but the "
+                        "per-device row-block of %d users x %d items "
+                        "exceeds the SPMD dense layout's bounds; falling "
+                        "back to the SINGLE-DEVICE dense path on the "
+                        "default device",
+                        ctx.mesh.devices.size, n_users, n_items)
                 user_f, item_f = als_dense.train_dense(
                     ctx, p, user_idx, item_idx, ratings, n_users, n_items,
                     callback)

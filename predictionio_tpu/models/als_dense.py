@@ -74,28 +74,66 @@ def _int8_scale(vals: np.ndarray) -> int:
 
 
 def dense_eligible(n_users: int, n_items: int, ratings: np.ndarray,
-                   max_bytes: int = DENSE_MAX_BYTES) -> bool:
+                   max_bytes: int | None = None) -> bool:
     """Whether the dense solver applies: the densified matrix fits the
-    byte budget and the values are losslessly int8-encodable."""
+    byte budget and the values are losslessly int8-encodable.
+    ``max_bytes`` defaults to DENSE_MAX_BYTES read at call time (a def-
+    time default would freeze out runtime tuning of the module budget)."""
     cells = int(n_users) * int(n_items)
-    return cells <= max_bytes and _int8_scale(ratings) != 0
+    budget = DENSE_MAX_BYTES if max_bytes is None else max_bytes
+    return cells <= budget and _int8_scale(ratings) != 0
+
+
+def sharded_block_fits(ctx, n_users: int, n_items: int, nnz: int) -> bool:
+    """Whether the SPMD dense path's one-row-block-per-device layout fits:
+    each data shard holds cells/data_shards int8 cells, so capacity scales
+    with the data axis. At the default DENSE_MAX_BYTES the binding
+    constraint is int32 flat-cell-id addressing (~2.1 GB of cells per
+    device, well under the 6 GB budget); the byte-budget clause only bites
+    when DENSE_MAX_BYTES is lowered below it. This is the single source of
+    truth for the bound — ALS.train's router and train_dense_sharded's
+    guard both call it."""
+    ub_est = -(-int(n_users) // int(ctx.mesh.shape["data"]))
+    block_cells = ub_est * int(n_items)
+    return (
+        block_cells + int(nnz) < 2**31
+        and block_cells <= DENSE_MAX_BYTES
+    )
+
+
+def dense_eligible_on(ctx, n_users: int, n_items: int,
+                      ratings: np.ndarray) -> bool:
+    """Mesh-aware eligibility for explicit ``solver="dense"``: int8-
+    encodable values, and EITHER the SPMD per-device row-block bound (on a
+    mesh) OR the single-device total-cells budget — explicit dense must
+    never be stricter than what ``auto`` would happily run on the same
+    topology."""
+    if _int8_scale(ratings) == 0:
+        return False
+    if ctx.mesh.devices.size > 1 and sharded_block_fits(
+            ctx, n_users, n_items, ratings.size):
+        return True
+    return int(n_users) * int(n_items) <= DENSE_MAX_BYTES
 
 
 def auto_pick(ctx, n_users: int, n_items: int, ratings: np.ndarray) -> bool:
     """The ``solver="auto"`` gate, shared by ALS.train and bench.py:
-    single device (the SPMD path exists — train_dense_sharded — but auto
-    stays conservative until it has been benched on real multi-chip
-    hardware; ``solver="dense"`` on a mesh opts in explicitly), density
-    above ~1/2000 (below that the gather's nnz-proportional traffic beats
-    reading every dense cell), the HBM byte budget, and int8-encodable
-    values — cheap checks first, the full ratings scan last."""
+    density above ~1/2000 (below that the gather's nnz-proportional
+    traffic beats reading every dense cell), the HBM byte budget (per
+    device: on a mesh each data shard holds one row-block, so the budget
+    scales with the data axis), SPMD int32 addressing on a mesh, and
+    int8-encodable values — cheap checks first, the full ratings scan
+    last. Meshes take the SPMD path (train_dense_sharded), validated by
+    the multichip dryrun and the 8-device parity suite."""
     cells = int(n_users) * int(n_items)
-    return (
-        ctx.mesh.devices.size == 1
-        and ratings.size * 2000 >= cells
-        and cells <= DENSE_MAX_BYTES
-        and _int8_scale(ratings) != 0
-    )
+    if ratings.size * 2000 < cells:
+        return False
+    if ctx.mesh.devices.size > 1:
+        if not sharded_block_fits(ctx, n_users, n_items, ratings.size):
+            return False
+    elif cells > DENSE_MAX_BYTES:
+        return False
+    return _int8_scale(ratings) != 0
 
 
 @dataclass
@@ -517,10 +555,16 @@ def _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha, implicit,
 
 
 def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
-                        scale: int | None = None):
+                        scale: int | None = None, callback=None):
     """SPMD dense training over the mesh ``data`` axis. Returns
-    (user_f [padded, r] row-sharded, item_f [n_items, r] replicated) as
-    device arrays; rows beyond ``n_users`` are padding."""
+    (user_f [n_users, r], item_f [n_items, r]), both REPLICATED device
+    arrays: user factors live row-sharded for the whole run and
+    materialize through one final all-gather — a process-spanning
+    row-sharded array would not be host-fetchable in a multi-process
+    deployment. ``callback`` (it, user_f, item_f) runs per iteration
+    (convergence probes) — each iteration is then its own collective
+    dispatch instead of one fused fori_loop, same trade as the
+    single-device path."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from predictionio_tpu.models.als import _init_factors
@@ -529,16 +573,15 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     mesh = ctx.mesh
     # one row-block per DATA-axis shard; model-axis devices replicate
     ndev = mesh.shape["data"]
-    ub_est = -(-n_users // ndev)
-    if ub_est * n_items + len(ratings) >= 2**31:
+    if not sharded_block_fits(ctx, n_users, n_items, len(ratings)):
         # the flat-cell scatter ids are int32; unlike the single-device
         # path (whose _BLOCK_BYTES split bounds ub*n_items), one-block-
         # per-device has no second split — wrap-around would silently
         # DROP ratings via the scatter's mode="drop"
         raise ValueError(
-            "dense SPMD block too large for int32 cell ids "
-            f"({ub_est} rows x {n_items} items); use solver='bucket' or "
-            "more devices"
+            "dense SPMD row-block out of bounds "
+            f"({-(-n_users // ndev)} rows x {n_items} items per device); "
+            "use solver='bucket' or more devices"
         )
     plan = _dense_prepare(ui, ii, ratings, n_users, n_items, scale=scale,
                           nb=ndev, uniform_m=True)
@@ -578,8 +621,11 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     n_pairs = rank * (rank + 1) // 2
     ncols = n_pairs + rank + 1
 
-    def spmd_train(flat_l, vals_l, uf_l, itf, du, di):
-        # flat_l/vals_l/uf_l: this device's [1, ...] shard; squeeze it
+    def spmd_train(iters, flat_l, vals_l, uf_l, itf, du, di):
+        # flat_l/vals_l/uf_l: this device's [1, ...] shard; squeeze it.
+        # ``iters`` is a traced replicated scalar so the SAME compiled
+        # program serves the fused run (num_iterations) and the
+        # per-iteration callback path (1 at a time).
         a = _scatter_block(flat_l[0], vals_l[0], ub=ub, n_items=n_items)
         row0 = jax.lax.axis_index("data") * ub
 
@@ -622,16 +668,27 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
                 p.lambda_, p.alpha, implicit, rank, sc)
             return uf_l, itf
 
-        uf_l, itf = jax.lax.fori_loop(0, p.num_iterations, body,
-                                      (uf_l, itf))
+        uf_l, itf = jax.lax.fori_loop(0, iters, body, (uf_l, itf))
         return uf_l, itf
 
     shard_fn = jax.jit(jax.shard_map(
         spmd_train, mesh=mesh,
-        in_specs=(P("data", None), P("data", None), P("data", None), P(),
-                  P(), P()),
+        in_specs=(P(), P("data", None), P("data", None), P("data", None),
+                  P(), P(), P()),
         out_specs=(P("data", None), P()),
         check_vma=False,
     ))
-    uf, itf = shard_fn(flat, vals, uf0, itf0, dup_u, dup_i)
-    return uf, itf
+    # the final (and callback-visible) user factors ride one all-gather:
+    # [n_users, r] f32 is small, and replication is what makes the result
+    # readable on every process of a multi-process mesh
+    replicate_users = jax.jit(lambda u: u[:n_users], out_shardings=repl)
+    if callback is None:
+        uf, itf = shard_fn(jnp.int32(p.num_iterations), flat, vals, uf0,
+                           itf0, dup_u, dup_i)
+    else:
+        one = jnp.int32(1)
+        uf, itf = uf0, itf0
+        for it in range(p.num_iterations):
+            uf, itf = shard_fn(one, flat, vals, uf, itf, dup_u, dup_i)
+            callback(it, replicate_users(uf), itf)
+    return replicate_users(uf), itf
