@@ -1,0 +1,177 @@
+"""Third-party document-store backend — the "bring your own database"
+half of the mongo-datasource example.
+
+The reference's experimental engine reads training data out of MongoDB
+instead of the built-in event store (ref: examples/experimental/
+scala-parallel-recommendation-mongo-datasource/src/main/scala/
+DataSource.scala:34-54). Its real lesson is the plugin contract: PIO's
+storage registry can load a backend the framework never shipped. This
+module is such a backend: a JSON-lines-per-app document store (the
+no-dependency stand-in for a document DB), discovered through the
+registry's module-path hook (data/storage/registry.py::_backend —
+``PIO_STORAGE_SOURCES_<NAME>_TYPE`` set to a module path, DAO classes
+found via ``CLASS_PREFIX``; ref: Storage.scala:263-312).
+
+Wire it like any built-in backend::
+
+    export PIO_STORAGE_SOURCES_DOCS_TYPE=examples.customstore.docstore
+    export PIO_STORAGE_SOURCES_DOCS_PATH=/var/pio/docstore
+    export PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=DOCS
+
+after which `pio app new`, the event server, and engine training all read
+and write rating documents through this module — see ``engine.py`` next
+to it for the engine side.
+
+Only the Events DAO is implemented (this store holds interaction
+documents; metadata/models stay on the default source), exactly like the
+reference example keeps metadata in PostgreSQL/Elasticsearch while
+ratings live in Mongo.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import threading
+from pathlib import Path
+from typing import Iterator, Sequence
+
+from predictionio_tpu.data.event import Event, new_event_id
+from predictionio_tpu.data.storage import base
+
+#: Registry discovery hook: DAO classes in this module are named
+#: ``<CLASS_PREFIX><DaoName>`` (ref: Storage.scala:289-301).
+CLASS_PREFIX = "Doc"
+
+
+class DocClient:
+    """One document-store "connection": a directory of JSON-lines
+    collections, one file per app/channel."""
+
+    def __init__(self, config: dict | None = None):
+        cfg = config or {}
+        self.root = Path(cfg.get("PATH", cfg.get("path", "docstore")))
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.lock = threading.RLock()
+
+    def collection(self, name: str) -> Path:
+        return self.root / f"{name}.jsonl"
+
+
+class DocEvents(base.Events):
+    """Events DAO over JSON-lines documents. Append-only writes; reads
+    scan the collection — the simplicity is the point (the contract under
+    test is the registry plumbing, not storage performance)."""
+
+    def __init__(self, client: DocClient, prefix: str = ""):
+        self._c = client
+        self._prefix = prefix
+
+    def _coll(self, app_id: int, channel_id: int | None) -> Path:
+        name = f"{self._prefix}events_{app_id}"
+        if channel_id:
+            name += f"_{channel_id}"
+        return self._c.collection(name)
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        with self._c.lock:
+            self._coll(app_id, channel_id).touch()
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        with self._c.lock:
+            path = self._coll(app_id, channel_id)
+            existed = path.exists()
+            if existed:
+                path.unlink()
+            return existed
+
+    def close(self) -> None:
+        pass
+
+    def _read_all(self, app_id: int, channel_id: int | None) -> list[Event]:
+        path = self._coll(app_id, channel_id)
+        if not path.exists():
+            raise base.StorageError(
+                f"Doc store for app {app_id} channel {channel_id} is not "
+                "initialized; run `pio app new` first."
+            )
+        with self._c.lock, open(path) as f:
+            return [Event.from_json(json.loads(line)) for line in f if
+                    line.strip()]
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: int | None = None) -> str:
+        eid = event.event_id or new_event_id()
+        doc = json.dumps(event.with_id(eid).to_json())
+        path = self._coll(app_id, channel_id)
+        with self._c.lock:
+            if not path.exists():
+                raise base.StorageError(
+                    f"Doc store for app {app_id} is not initialized; run "
+                    "`pio app new` first."
+                )
+            with open(path, "a") as f:
+                f.write(doc + "\n")
+        return eid
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: int | None = None) -> Event | None:
+        for e in self._read_all(app_id, channel_id):
+            if e.event_id == event_id:
+                return e
+        return None
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: int | None = None) -> bool:
+        with self._c.lock:
+            events = self._read_all(app_id, channel_id)
+            kept = [e for e in events if e.event_id != event_id]
+            if len(kept) == len(events):
+                return False
+            with open(self._coll(app_id, channel_id), "w") as f:
+                for e in kept:
+                    f.write(json.dumps(e.to_json()) + "\n")
+            return True
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        start_time: dt.datetime | None = None,
+        until_time: dt.datetime | None = None,
+        entity_type: str | None = None,
+        entity_id: str | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type=...,
+        target_entity_id=...,
+        limit: int | None = None,
+        reversed_: bool = False,
+    ) -> Iterator[Event]:
+        def ok(e: Event) -> bool:
+            if start_time is not None and e.event_time < start_time:
+                return False
+            if until_time is not None and e.event_time >= until_time:
+                return False
+            if entity_type is not None and e.entity_type != entity_type:
+                return False
+            if entity_id is not None and e.entity_id != entity_id:
+                return False
+            if event_names is not None and e.event not in event_names:
+                return False
+            if (target_entity_type is not ...
+                    and e.target_entity_type != target_entity_type):
+                return False
+            if (target_entity_id is not ...
+                    and e.target_entity_id != target_entity_id):
+                return False
+            return True
+
+        out = sorted(
+            (e for e in self._read_all(app_id, channel_id) if ok(e)),
+            key=lambda e: e.event_time,
+            reverse=reversed_,
+        )
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return iter(out)

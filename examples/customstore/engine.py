@@ -1,0 +1,77 @@
+"""Recommendation engine over a bring-your-own document store.
+
+The mongo-datasource example analog (ref: examples/experimental/
+scala-parallel-recommendation-mongo-datasource/src/main/scala/
+DataSource.scala): the reference keeps the recommendation template's
+Engine/ALSAlgorithm/Serving untouched and swaps ONLY the DataSource so
+training reads rating documents from MongoDB. This example does the
+same swap against ``docstore.py`` (the third-party JSON-lines backend
+next to this file, loaded through the storage registry's module-path
+hook): Preparator, ALSAlgorithm, and Serving are imported verbatim from
+``templates/recommendation``; the DataSource below reads raw rating
+documents from whatever backend the EVENTDATA repository is wired to.
+
+Run from this directory (after `pio app new docapp` + ingesting rate
+events — both of which also go through the custom store)::
+
+    export PIO_STORAGE_SOURCES_DOCS_TYPE=examples.customstore.docstore
+    export PIO_STORAGE_SOURCES_DOCS_PATH=$PWD/docstore
+    export PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE=DOCS
+    pio train && pio deploy --port 8000
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.core import Engine
+from predictionio_tpu.core.dase import PDataSource
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.store.event_stores import PEventStore
+from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.templates.recommendation import (
+    ALSAlgorithm,
+    Preparator,
+    Serving,
+    TrainingData,
+)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "docapp"
+
+
+class DocDataSource(PDataSource):
+    """Reads rating documents {uid, iid, rating} from the EVENTDATA
+    store — which the deployment wires to the third-party docstore
+    module (see module docstring). The mapping mirrors the reference's
+    mongoRDD.map over BSON fields (DataSource.scala:45-51)."""
+
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        self.params = params
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        users, items, ratings = [], [], []
+        for e in PEventStore.find(
+            self.params.app_name, event_names=["rate"],
+            entity_type="user", target_entity_type="item",
+        ):
+            users.append(e.entity_id)
+            items.append(e.target_entity_id)
+            ratings.append(float(e.properties.get("rating")))
+        return TrainingData(
+            users, items, np.asarray(ratings, np.float32))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DocDataSource,
+        preparator_class=Preparator,
+        algorithm_class_map={"als": ALSAlgorithm},
+        serving_class=Serving,
+    )

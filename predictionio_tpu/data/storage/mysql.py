@@ -49,13 +49,21 @@ from predictionio_tpu.data.storage.sql import (
 
 def qmark_to_format(sql: str) -> str:
     """Rewrite ``?`` placeholders to ``%s`` and escape literal ``%``,
-    skipping quoted strings/identifiers — for format/pyformat drivers."""
+    skipping quoted strings/identifiers — for format/pyformat drivers.
+    Inside string literals a backslash escapes the next character
+    (MySQL's default NO_BACKSLASH_ESCAPES=off), so ``'a\\'b'`` stays one
+    literal and a later ``'?'`` is not rewritten."""
     out = []
     quote: str | None = None
+    escaped = False
     for ch in sql:
         if quote:
             out.append(ch)
-            if ch == quote:
+            if escaped:
+                escaped = False
+            elif ch == "\\" and quote != "`":  # identifiers don't escape
+                escaped = True
+            elif ch == quote:
                 quote = None
         elif ch in ("'", '"', "`"):
             quote = ch

@@ -24,6 +24,7 @@ from predictionio_tpu.core import (
     PDataSource,
     PPreparator,
 )
+from predictionio_tpu.core.dase import LAlgorithm
 from predictionio_tpu.core.base import SanityCheck
 from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import BiMap
@@ -203,6 +204,17 @@ def _similar_items_batch(model: SimilarModel, queries):
     return out
 
 
+def _view_counts(td) -> tuple[list[str], list[str], np.ndarray]:
+    """Collapse duplicate views to counts (implicit strength)."""
+    counts: dict[tuple[str, str], float] = defaultdict(float)
+    for u, i in zip(td.view_users, td.view_items):
+        counts[(u, i)] += 1.0
+    users = [u for u, _ in counts]
+    items = [i for _, i in counts]
+    ratings = np.fromiter(counts.values(), np.float32, count=len(counts))
+    return users, items, ratings
+
+
 class ALSAlgorithm(P2LAlgorithm):
     """Implicit ALS on view counts (ref: multi/.../ALSAlgorithm.scala)."""
 
@@ -214,13 +226,7 @@ class ALSAlgorithm(P2LAlgorithm):
 
     def train(self, ctx: ComputeContext, pd: PreparedData) -> SimilarModel:
         td = pd.td
-        # collapse duplicate views to counts (implicit strength)
-        counts: dict[tuple[str, str], float] = defaultdict(float)
-        for u, i in zip(td.view_users, td.view_items):
-            counts[(u, i)] += 1.0
-        users = [u for u, _ in counts]
-        items = [i for _, i in counts]
-        ratings = np.fromiter(counts.values(), np.float32, count=len(counts))
+        users, items, ratings = _view_counts(td)
         return _train_implicit_item_factors(
             ctx, users, items, ratings, self.params, td.item_categories
         )
@@ -231,6 +237,39 @@ class ALSAlgorithm(P2LAlgorithm):
     def batch_predict(self, model: SimilarModel, queries):
         """Micro-batched serving: one device call per drained batch."""
         return _similar_items_batch(model, queries)
+
+
+class LocalALSAlgorithm(LAlgorithm):
+    """The similarproduct-localmodel variant (ref: examples/experimental/
+    scala-parallel-similarproduct-localmodel/src/main/scala/
+    ALSAlgorithm.scala:26-96): the same implicit-ALS item factors as
+    :class:`ALSAlgorithm`, but as an L-flavor algorithm — ``train_local``
+    sees only local prepared data and runs ALS on a single-device
+    context, and the model is plain host arrays (the shape the reference
+    collects its ``productFeatures`` Map into). Serving shares the
+    batched cosine path, so the two flavors are batch-predict
+    interchangeable."""
+
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams):
+        self.params = params
+
+    def train_local(self, pd: PreparedData) -> SimilarModel:
+        import jax
+        from jax.sharding import Mesh
+
+        td = pd.td
+        users, items, ratings = _view_counts(td)
+        local = ComputeContext(Mesh(
+            np.asarray(jax.devices()[:1]).reshape(1, 1), ("data", "model")))
+        return _train_implicit_item_factors(
+            local, users, items, ratings, self.params, td.item_categories
+        )
+
+    def predict(self, model: SimilarModel, query: Query) -> PredictedResult:
+        return _similar_items_batch(model, [(0, query)])[0][1]
 
 
 class LikeAlgorithm(ALSAlgorithm):
@@ -271,7 +310,8 @@ def engine_factory() -> Engine:
     return Engine(
         data_source_class=DataSource,
         preparator_class=Preparator,
-        algorithm_class_map={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        algorithm_class_map={"als": ALSAlgorithm, "likealgo": LikeAlgorithm,
+                             "localals": LocalALSAlgorithm},
         serving_class=Serving,
     )
 

@@ -1,0 +1,47 @@
+"""README performance claims stay containment-true (round-3 review:
+README bands had drifted outside the captured bench values).
+
+Two invariants, both anchored on bench.README_BANDS as the single source
+of truth:
+
+1. The README prose quotes exactly the band endpoints (``{lo:g}-{hi:g}``)
+   for every banded metric — the dict and the document cannot drift
+   apart silently.
+2. The latest capture (bench_captures/latest.json written by a healthy
+   full ``python bench.py`` run, else the highest-numbered driver
+   BENCH_r*.json — resolved by bench.latest_capture_path, the same
+   helper ``--check-readme`` uses) falls inside every band it measured.
+"""
+
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from bench import (  # noqa: E402
+    README_BANDS,
+    check_readme_bands,
+    latest_capture_path,
+    load_capture,
+)
+
+
+def test_readme_quotes_band_endpoints():
+    text = (ROOT / "README.md").read_text()
+    missing = []
+    for key, (lo, hi) in README_BANDS.items():
+        band = f"{lo:g}-{hi:g}"
+        if band not in text:
+            missing.append(f"{key}: '{band}' not found in README.md")
+    assert not missing, "\n".join(missing)
+
+
+def test_latest_capture_within_bands():
+    path = latest_capture_path()
+    if path is None:
+        import pytest
+
+        pytest.skip("no bench capture checked in yet")
+    violations = check_readme_bands(load_capture(path))
+    assert not violations, f"{path}:\n" + "\n".join(violations)

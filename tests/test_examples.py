@@ -323,3 +323,89 @@ def test_trimapp_copies_window_and_refuses_nonempty_dst(memory_storage):
     instance2 = new_engine_instance("trim", "1", "default", factory, ep)
     with pytest.raises(RuntimeError, match="not empty"):
         run_train(engine, ep, instance2, WorkflowParams())
+
+
+def test_customstore_third_party_datasource(monkeypatch, tmp_path):
+    """The mongo-datasource analog end-to-end: EVENTDATA wired to a
+    backend module the framework never shipped (examples/customstore/
+    docstore.py, loaded via the registry's module-path hook), rating
+    documents ingested through the standard event API, and the
+    recommendation engine trained through the example's custom
+    DataSource (ref: examples/experimental/
+    scala-parallel-recommendation-mongo-datasource/)."""
+    import os
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    for key in list(os.environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_MEM_TYPE", "memory")
+    monkeypatch.setenv(
+        "PIO_STORAGE_SOURCES_DOCS_TYPE", "examples.customstore.docstore"
+    )
+    monkeypatch.setenv(
+        "PIO_STORAGE_SOURCES_DOCS_PATH", str(tmp_path / "docstore")
+    )
+    monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "DOCS")
+    for repo in ("METADATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "MEM")
+    Storage.reset()
+    try:
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "docapp"))
+        events = Storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(5)
+        for u in range(15):
+            for i in range(12):
+                if rng.random() < 0.5:
+                    events.insert(
+                        Event(event="rate", entity_type="user",
+                              entity_id=f"u{u}", target_entity_type="item",
+                              target_entity_id=f"i{i}",
+                              properties=DataMap(
+                                  {"rating": float(rng.integers(1, 6))})),
+                        app_id,
+                    )
+        # the documents really live in the third-party store's files
+        docs = list((tmp_path / "docstore").glob("*.jsonl"))
+        assert docs and docs[0].stat().st_size > 0
+
+        factory = "engine:engine_factory"
+        engine = get_engine(factory, EXAMPLES / "customstore")
+        ep = engine.engine_params_from_json({
+            "datasource": {"params": {"app_name": "docapp"}},
+            "algorithms": [{"name": "als",
+                            "params": {"rank": 6, "numIterations": 3,
+                                       "seed": 0}}],
+        })
+        instance = new_engine_instance(
+            "customstore", "1", "default", factory, ep)
+        instance_id = run_train(engine, ep, instance, WorkflowParams())
+        assert instance_id
+
+        from predictionio_tpu.core.persistent_model import (
+            deserialize_models,
+        )
+        from predictionio_tpu.parallel.mesh import compute_context
+
+        blob = Storage.get_model_data_models().get(instance_id)
+        models = engine.prepare_deploy(
+            compute_context(), ep, instance_id,
+            deserialize_models(blob.models), WorkflowParams())
+        algo = engine._algorithms(ep)[0]
+        res = algo.predict(models[0], algo.query_class(user="u1", num=4))
+        assert len(res.itemScores) == 4
+        assert all(np.isfinite(s.score) for s in res.itemScores)
+    finally:
+        Storage.reset()

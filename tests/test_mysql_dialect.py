@@ -32,6 +32,18 @@ class TestQmarkTranslation:
         assert qmark_to_format("LIKE 'x%'") == "LIKE 'x%'"  # quoted: kept
         assert qmark_to_format("SELECT 1 % 2") == "SELECT 1 %% 2"
 
+    def test_backslash_escaped_quote_stays_in_literal(self):
+        # MySQL default escaping: 'a\'b' is ONE literal — the escaped
+        # quote must not end quote tracking, so the following '?' literal
+        # stays untouched and the bare ? is still rewritten
+        assert qmark_to_format(r"SELECT 'a\'b', '?', ?") == (
+            r"SELECT 'a\'b', '?', %s"
+        )
+        # double backslash before the closing quote really closes it
+        assert qmark_to_format(r"SELECT 'a\\', ?") == r"SELECT 'a\\', %s"
+        # backticked identifiers do not use backslash escaping
+        assert qmark_to_format(r"SELECT `a\`, ?") == r"SELECT `a\`, %s"
+
 
 class TestDialect:
     def test_upsert_renders_on_duplicate_key(self):

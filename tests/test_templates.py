@@ -179,6 +179,54 @@ class TestSimilarProduct:
         # unknown query items → empty
         assert algo.predict(m, Query(items=("zzz",), num=5)).itemScores == ()
 
+    def test_localmodel_variant_batch_predict_parity(self, ctx, app):
+        """The similarproduct-localmodel analog: the L-flavor algorithm
+        (train_local on a single-device context, plain host-array model)
+        is batch-predict interchangeable with the P2L variant on the
+        same data (ref: examples/experimental/
+        scala-parallel-similarproduct-localmodel/)."""
+        from predictionio_tpu.core.dase import LAlgorithm
+        from predictionio_tpu.templates.similarproduct import (
+            LocalALSAlgorithm,
+            Query,
+            SimilarModel,
+            engine_factory,
+        )
+
+        engine = engine_factory()
+        params = {"rank": 8, "numIterations": 8, "alpha": 5.0, "seed": 0}
+        variant = {
+            "engineFactory": "x",
+            "datasource": {"params": {"app_name": "simapp"}},
+            "algorithms": [{"name": "localals", "params": params}],
+        }
+        ep = engine.engine_params_from_json(variant)
+        models = engine.train(ctx, ep)
+        algo = engine._algorithms(ep)[0]
+        assert isinstance(algo, LocalALSAlgorithm)
+        assert isinstance(algo, LAlgorithm)
+        local_model = models[0]
+        assert isinstance(local_model, SimilarModel)
+        assert isinstance(local_model.item_features, np.ndarray)
+
+        # P2L variant on the same data/params for the parity check
+        variant_p2l = {**variant, "algorithms": [
+            {"name": "als", "params": params}]}
+        ep2 = engine.engine_params_from_json(variant_p2l)
+        p2l_model = engine.train(ctx, ep2)[0]
+        p2l_algo = engine._algorithms(ep2)[0]
+
+        queries = [(k, Query(items=(f"i{k}",), num=5)) for k in range(6)]
+        got = dict(algo.batch_predict(local_model, queries))
+        want = dict(p2l_algo.batch_predict(p2l_model, queries))
+        assert set(got) == set(want)
+        for k in got:
+            g = [(s.item, s.score) for s in got[k].itemScores]
+            w = [(s.item, s.score) for s in want[k].itemScores]
+            assert [i for i, _ in g] == [i for i, _ in w]
+            np.testing.assert_allclose(
+                [s for _, s in g], [s for _, s in w], rtol=5e-3, atol=5e-3)
+
     def test_multi_algorithm_serving_combines(self, ctx, app):
         from predictionio_tpu.templates.similarproduct import (
             Query,
