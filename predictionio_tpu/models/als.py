@@ -305,8 +305,16 @@ def _soa_cho_solve(gram, rhs, reg, rank: int):
     [n]-vector, the r(r+1)/2-step Cholesky-Banachiewicz recurrence is
     unrolled at trace time, and all arithmetic is full-lane VPU ops."""
     gram_t = jnp.transpose(gram, (1, 2, 0))  # [r, r, n] — n on lanes
-    rhs_t = rhs.T  # [r, n]
     a = [[gram_t[i, j] for j in range(rank)] for i in range(rank)]
+    return _soa_cho_solve_from(a, rhs.T, reg, rank)
+
+
+def _soa_cho_solve_from(a, rhs_t, reg, rank: int):
+    """The SoA Cholesky-solve core on prebuilt entries: ``a[i][j]`` is the
+    [n]-vector of gram entries, ``rhs_t`` [r, n]. Callers that already
+    hold the gram in packed upper-triangle columns (the dense solver's
+    matmul output) index those directly and skip the [n, r, r]
+    materialization + relayout entirely."""
     l = [[None] * rank for _ in range(rank)]
     for j in range(rank):
         s = a[j][j] + reg
@@ -335,14 +343,200 @@ def _soa_cho_solve(gram, rhs, reg, rank: int):
     return jnp.stack(x, axis=1)  # [n, r]
 
 
+#: Panel width of the blocked batched Cholesky below. 16 keeps each
+#: panel's unrolled SoA recurrences small (fast compile) while the
+#: trailing updates run as [n, 16p, 16]-shaped batched matmuls.
+_CHO_BLOCK = 16
+
+
+def _soa_cho_factor(blk, reg=None):
+    """Lower-Cholesky factor of SPD ``blk`` [B, B, n] (batch on LANES)
+    via the unrolled SoA recurrence — the factor-only half of
+    _soa_cho_solve; ``reg`` [n] adds to the diagonal."""
+    b = blk.shape[0]
+    l = [[None] * b for _ in range(b)]
+    for j in range(b):
+        s = blk[j, j] + (reg if reg is not None else 0.0)
+        for k in range(j):
+            s = s - l[j][k] * l[j][k]
+        d = jnp.sqrt(s)
+        l[j][j] = d
+        inv_d = 1.0 / d
+        for i in range(j + 1, b):
+            s = blk[i, j]
+            for k in range(j):
+                s = s - l[i][k] * l[j][k]
+            l[i][j] = s * inv_d
+    rows = [
+        jnp.stack([l[i][j] if j <= i else jnp.zeros_like(l[i][i])
+                   for j in range(b)])
+        for i in range(b)
+    ]
+    return jnp.stack(rows)  # [B, B, n] lower-triangular
+
+
+def _right_trisolve(a, l_kk):
+    """X with (per batch) X @ l_kkᵀ = a: a [B, B, n] (rows, cols, batch),
+    l_kk [B, B, n] lower. B unrolled column steps of [B, n] vector math."""
+    b = l_kk.shape[0]
+    cols = []
+    for j in range(b):
+        s = a[:, j]
+        for m in range(j):
+            s = s - cols[m] * l_kk[j, m][None, :]
+        cols.append(s / l_kk[j, j][None, :])
+    return jnp.stack(cols, axis=1)  # [B, B, n]
+
+
+def _forward_sub(l_kk, b_vec):
+    """y with l_kk @ y = b_vec per batch: b_vec [B, n]."""
+    b = l_kk.shape[0]
+    y = []
+    for j in range(b):
+        s = b_vec[j]
+        for m in range(j):
+            s = s - l_kk[j, m] * y[m]
+        y.append(s / l_kk[j, j])
+    return jnp.stack(y)
+
+
+def _backward_sub(l_kk, b_vec):
+    """x with l_kkᵀ @ x = b_vec per batch: b_vec [B, n]."""
+    b = l_kk.shape[0]
+    x = [None] * b
+    for j in reversed(range(b)):
+        s = b_vec[j]
+        for m in range(j + 1, b):
+            s = s - l_kk[m, j] * x[m]
+        x[j] = s / l_kk[j, j]
+    return jnp.stack(x)
+
+
+def _blocked_cho_solve(gram, rhs, reg, rank: int, block: int = _CHO_BLOCK):
+    """Batched SPD solve for ranks beyond the SoA unroll budget:
+    right-looking blocked Cholesky with ``block``-wide panels, entirely
+    in the SoA layout ([r, r, n]: the batch rides the LANE axis, every
+    scalar of the recurrence is an [n]-vector). Diagonal panels factor
+    through a small SoA unroll; panel solves are B-step substitution
+    unrolls; the O(r³) trailing updates are einsums contracting the tiny
+    panel dims with n broadcast — full-lane VPU work. Replaces XLA:TPU's
+    batched Cholesky custom call, which lane-pads [n, 64, 64] by 2x and
+    measured ~11 GFLOP/s at rank 64 (the rank-64 ALS iteration was ~70%
+    THIS solve, not the pairs dot — docs/perf.md §5). Blocking bounds
+    trace size at ~p²·B ops (rank 64: ~1k), where the flat SoA unroll's
+    ~r³/6 did not finish compiling.
+
+    Ranks that aren't a multiple of ``block`` are padded with an
+    identity diagonal (zero rhs rows solve to zero and are sliced off).
+    """
+    p = -(-rank // block)
+    rp = p * block
+    gram_t = jnp.transpose(gram, (1, 2, 0))  # [r, r, n]
+    rhs_t = rhs.T  # [r, n]
+    if rp != rank:
+        pad = rp - rank
+        gram_t = jnp.pad(gram_t, ((0, pad), (0, pad), (0, 0)))
+        eye_pad = jnp.concatenate(
+            [jnp.zeros((rank,), gram.dtype), jnp.ones((pad,), gram.dtype)])
+        gram_t = gram_t + jnp.eye(rp, dtype=gram.dtype)[
+            :, :, None] * eye_pad[:, None, None]
+        rhs_t = jnp.pad(rhs_t, ((0, pad), (0, 0)))
+
+    def blk(i, j):
+        return (slice(i * block, (i + 1) * block),
+                slice(j * block, (j + 1) * block))
+
+    t = {(i, j): gram_t[blk(i, j)] for i in range(p) for j in range(i + 1)}
+    return _blocked_cho_core(t, rhs_t, reg, rank, block)
+
+
+def _blocked_cho_core(t, rhs_t, reg, rank: int, block: int = _CHO_BLOCK):
+    """The blocked-Cholesky core on prebuilt lower-triangle panel blocks:
+    ``t[(i, j)]`` [B, B, n] for j <= i (i, j in panel units covering the
+    block-padded rank), ``rhs_t`` [pB, n]. See _blocked_cho_solve."""
+    p = -(-rank // block)
+    t = dict(t)  # trailing updates replace entries; don't mutate caller's
+    # HIGHEST keeps every contraction f32-exact: a default-precision
+    # einsum on TPU rounds operands through bf16, and ~1e-3 errors inside
+    # the Schur-complement updates can push a trailing diagonal negative
+    # → sqrt → NaN (the same hazard _pairs_payload documents for the gram)
+    hi = jax.lax.Precision.HIGHEST
+    l: dict = {}
+    for k in range(p):
+        l[(k, k)] = _soa_cho_factor(t[(k, k)], reg)
+        for i in range(k + 1, p):
+            l[(i, k)] = _right_trisolve(t[(i, k)], l[(k, k)])
+        for i in range(k + 1, p):
+            for j in range(k + 1, i + 1):
+                t[(i, j)] = t[(i, j)] - jnp.einsum(
+                    "abn,cbn->acn", l[(i, k)], l[(j, k)], precision=hi)
+    y = []
+    for i in range(p):
+        b_vec = rhs_t[i * block:(i + 1) * block]
+        for k in range(i):
+            b_vec = b_vec - jnp.einsum(
+                "abn,bn->an", l[(i, k)], y[k], precision=hi)
+        y.append(_forward_sub(l[(i, i)], b_vec))
+    x = [None] * p
+    for i in reversed(range(p)):
+        b_vec = y[i]
+        for k in range(i + 1, p):
+            b_vec = b_vec - jnp.einsum(
+                "abn,an->bn", l[(k, i)], x[k], precision=hi)
+        x[i] = _backward_sub(l[(i, i)], b_vec)
+    out = jnp.concatenate(x, axis=0)  # [rp, n]
+    return out[:rank].T
+
+
 def _reg_solve(gram, rhs, reg, rank: int):
     """(gram + reg I) x = rhs, batched over the leading axis."""
     if rank <= _SOA_SOLVE_MAX_RANK:
         return _soa_cho_solve(gram, rhs, reg, rank)
-    gram = gram + reg[:, None, None] * jnp.eye(rank, dtype=gram.dtype)
-    return jax.scipy.linalg.cho_solve(
-        (jnp.linalg.cholesky(gram), True), rhs[..., None]
-    )[..., 0]
+    return _blocked_cho_solve(gram, rhs, reg, rank)
+
+
+def _reg_solve_packed(pairs, rhs, reg, rank: int, block: int = _CHO_BLOCK):
+    """(gram + reg I) x = rhs where the gram arrives as packed upper-
+    triangle columns ``pairs`` [n, r(r+1)/2] — the dense solver's matmul
+    output layout. Feeds the SoA/blocked cores by INDEXING the packed
+    rows, skipping the [n, r, r] scatter-assembly and the [n, r, r] →
+    [r, r, n] relayout the gram-based path pays (round-4 profile: at
+    rank 64 those cost more than the factorization itself)."""
+    n = pairs.shape[0]
+    n_pairs = rank * (rank + 1) // 2
+    iu, ju = np.triu_indices(rank)
+    col = np.zeros((rank, rank), np.int64)
+    col[iu, ju] = np.arange(n_pairs)
+    col[ju, iu] = np.arange(n_pairs)
+    pairs_t = pairs.T  # [P, n]
+    if rank <= _SOA_SOLVE_MAX_RANK:
+        a = [[pairs_t[col[i, j]] for j in range(rank)]
+             for i in range(rank)]
+        return _soa_cho_solve_from(a, rhs.T, reg, rank)
+    p = -(-rank // block)
+    rp = p * block
+    # two sentinel rows: zeros (off-diagonal padding) and ones (identity
+    # diagonal for the padded tail — solves the zero rhs rows to zero)
+    idx = np.full((rp, rp), n_pairs, np.int64)
+    idx[:rank, :rank] = col
+    idx[np.arange(rank, rp), np.arange(rank, rp)] = n_pairs + 1
+    aug = jnp.concatenate([
+        pairs_t,
+        jnp.zeros((1, n), pairs.dtype),
+        jnp.ones((1, n), pairs.dtype),
+    ])
+    t = {}
+    for i in range(p):
+        for j in range(i + 1):
+            blk_idx = jnp.asarray(
+                idx[i * block:(i + 1) * block,
+                    j * block:(j + 1) * block].reshape(-1))
+            t[(i, j)] = jnp.take(aug, blk_idx, axis=0).reshape(
+                block, block, n)
+    rhs_t = rhs.T
+    if rp != rank:
+        rhs_t = jnp.pad(rhs_t, ((0, rp - rank), (0, 0)))
+    return _blocked_cho_core(t, rhs_t, reg, rank, block)
 
 
 def _chunk_solutions(

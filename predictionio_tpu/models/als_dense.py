@@ -291,7 +291,28 @@ def _pairs_payload(f, rank: int):
         axis=1)
 
 
-def _make_dots(implicit: bool, exact: bool):
+def use_kernel() -> bool:
+    """Whether the dense half-steps run the fused Pallas dual-dot kernel
+    (ops/dense_dots.py) instead of two XLA dots. ``PIO_DENSE_KERNEL``:
+    ``auto`` (default — currently XLA everywhere), ``pallas`` (force the
+    kernel; interpret-mode off-TPU, the CPU test path), ``xla`` (never).
+
+    Measured round 4 (docs/perf.md §5): XLA wins. Its mixed
+    ``bf16 x f32 @ HIGHEST`` dot costs ~1 MXU pass on v5e, while Mosaic
+    rejects mixed-precision matmuls ("Bad lhs type"), forcing the kernel
+    into a 3-term bf16 split — 3x the MXU passes for the same numerics.
+    The kernel's single-read fusion cannot buy that back (the iteration
+    is ~40% MXU / ~50% HBM); it measured ~79 ms/iter vs XLA's ~38 at
+    ML-20M rank 10. Kept env-selectable for future Mosaic versions."""
+    import os
+
+    mode = os.environ.get("PIO_DENSE_KERNEL", "auto")
+    if mode == "pallas":
+        return True
+    return False
+
+
+def _make_dots(implicit: bool, exact: bool, kernel: bool = False):
     """The pair of payload matmuls of one half-step, with the precision
     placement both solver paths must share: bf16 left operands are EXACT
     (0/1 and |scaled rating| <= 127 are all bf16-representable), and the
@@ -300,8 +321,29 @@ def _make_dots(implicit: bool, exact: bool):
     mode, the value dot in implicit mode. The other dot only feeds rhs
     (and exactly-representable counts), where bf16-payload rounding is
     the same accepted error class as the bucket solver's bf16 gather —
-    relaxed unless the caller asked for the f32 parity mode."""
+    relaxed unless the caller asked for the f32 parity mode.
+
+    ``kernel=True`` routes both dots through the fused Pallas kernel:
+    one pass over the int8 block feeds both operand views, and the
+    HIGHEST contract is reproduced by an in-kernel 3-term bf16 split
+    (ops/dense_dots.py) — blocks must be padded to the kernel tile grid
+    (prepare_device_inputs(pad_for_kernel=True))."""
     hi = jax.lax.Precision.HIGHEST
+    if kernel:
+        from predictionio_tpu.ops.dense_dots import fused_dual_dot
+
+        s_hi, s_lo = 3, 3 if exact else 1
+        si, sv = (s_lo, s_hi) if implicit else (s_hi, s_lo)
+        interp = jax.default_backend() != "tpu"
+
+        def dots(a, ip, vp, dims):
+            assert dims in (((1,), (0,)), ((0,), (0,)))
+            return fused_dual_dot(
+                a, ip, vp, contract_rows=dims == ((0,), (0,)),
+                splits_ind=si, splits_val=sv, interpret=interp)
+
+        return dots
+
     lo = hi if exact else None
     ind_prec, val_prec = (lo, hi) if implicit else (hi, lo)
 
@@ -346,33 +388,44 @@ def _dense_half_solve(
     blocks,  # tuple of [ub, n_other] int8 (user side) — or None (item side)
     tblocks,  # tuple of [ub, n] int8 to contract over dim 0 — or None
     dup,  # (seg, nbr, cnt, val) correction arrays or None
-    lambda_, alpha, implicit: bool, rank: int, scale: int,
-    exact: bool = False,
+    lambda_, alpha, implicit: bool, rank: int, scale: int, ub: int,
+    exact: bool = False, kernel: bool = False,
 ):
     """One half-iteration: payload matmuls over the dense blocks + f32
     corrections + SoA Cholesky solve. Exactly one of ``blocks`` (row
     blocks: entities on rows) / ``tblocks`` (transposed contraction:
-    entities on columns) is set."""
+    entities on columns) is set. ``ub`` is the plan's real-rows-per-block
+    (_DensePlan.ub — the block shapes may be kernel-padded beyond it).
+    With ``kernel`` the blocks are padded to the Pallas tile grid (zero
+    cells: they contribute to neither dot); payloads are padded to match
+    and outputs sliced back."""
     n = prev.shape[0]
     ind_payload, val_payload = _local_half_inputs(fixed, rank, implicit)
-    dots = _make_dots(implicit, exact)
+    dots = _make_dots(implicit, exact, kernel)
 
     if blocks is not None:
+        n_other = ind_payload.shape[0]
+        k_dim = blocks[0].shape[1]
+        if k_dim != n_other:  # kernel padding on the contracted dim
+            ind_payload = jnp.pad(
+                ind_payload, ((0, k_dim - n_other), (0, 0)))
+            val_payload = jnp.pad(
+                val_payload, ((0, k_dim - n_other), (0, 0)))
         gis, gvs = [], []
         for a in blocks:
             gi, gv = dots(a, ind_payload, val_payload, ((1,), (0,)))
-            gis.append(gi)
-            gvs.append(gv)
+            gis.append(gi[:ub])
+            gvs.append(gv[:ub])
         gi = jnp.concatenate(gis)[:n]
         gv = jnp.concatenate(gvs)[:n]
     else:
-        ub = tblocks[0].shape[0]
+        ub_p = tblocks[0].shape[0]  # padded block rows (== ub without kernel)
         nb = len(tblocks)
+        n_other = ind_payload.shape[0]
         # pad the payloads to the blocked row count: the blocks' padding
         # rows are all-zero, but an unpadded dynamic_slice would CLAMP the
         # last block's start and misalign every row in it
         up = nb * ub
-        n_other = ind_payload.shape[0]
         if up != n_other:
             ind_payload = jnp.pad(
                 ind_payload, ((0, up - n_other), (0, 0)))
@@ -384,8 +437,13 @@ def _dense_half_solve(
                 ind_payload, (b * ub, 0), (ub, ind_payload.shape[1]))
             vp = jax.lax.dynamic_slice(
                 val_payload, (b * ub, 0), (ub, val_payload.shape[1]))
+            if ub_p != ub:  # kernel padding: match the block's row count
+                ip = jnp.pad(ip, ((0, ub_p - ub), (0, 0)))
+                vp = jnp.pad(vp, ((0, ub_p - ub), (0, 0)))
             d_gi, d_gv = dots(a, ip, vp, ((0,), (0,)))
             gi, gv = gi + d_gi, gv + d_gv
+        gi = gi[:n]
+        gv = gv[:n]
 
     corr = None
     if dup is not None:
@@ -395,24 +453,25 @@ def _dense_half_solve(
 
 
 def _iteration_dense(user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha,
-                     implicit, rank, scale, exact):
+                     implicit, rank, scale, ub, exact, kernel=False):
     user_f = _dense_half_solve(
         user_f, item_f, blocks, None, dup_u, lambda_, alpha, implicit,
-        rank, scale, exact)
+        rank, scale, ub, exact, kernel)
     item_f = _dense_half_solve(
         item_f, user_f, None, blocks, dup_i, lambda_, alpha, implicit,
-        rank, scale, exact)
+        rank, scale, ub, exact, kernel)
     return user_f, item_f
 
 
 @partial(
     jax.jit,
-    static_argnames=("implicit", "rank", "scale", "exact"),
+    static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
     donate_argnums=(0, 1),
 )
 def _dense_train(
     user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha, iters,
-    *, implicit: bool, rank: int, scale: int, exact: bool = False,
+    *, implicit: bool, rank: int, scale: int, ub: int,
+    exact: bool = False, kernel: bool = False,
 ):
     """The whole dense training run as one XLA dispatch (fori_loop) —
     per-call dispatch through a tunneled TPU costs ~15 ms, which would
@@ -420,38 +479,56 @@ def _dense_train(
     def body(_i, carry):
         uf, itf = carry
         return _iteration_dense(uf, itf, blocks, dup_u, dup_i, lambda_,
-                                alpha, implicit, rank, scale, exact)
+                                alpha, implicit, rank, scale, ub, exact,
+                                kernel)
 
     return jax.lax.fori_loop(0, iters, body, (user_f, item_f))
 
 
 @partial(
     jax.jit,
-    static_argnames=("implicit", "rank", "scale", "exact"),
+    static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
     donate_argnums=(0, 1),
 )
 def _dense_iteration(
     user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha,
-    *, implicit: bool, rank: int, scale: int, exact: bool = False,
+    *, implicit: bool, rank: int, scale: int, ub: int,
+    exact: bool = False, kernel: bool = False,
 ):
     """One iteration as its own dispatch — the per-iteration callback path
     (convergence probes)."""
     return _iteration_dense(
         user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha, implicit,
-        rank, scale, exact)
+        rank, scale, ub, exact, kernel)
 
 
-def prepare_device_inputs(plan: _DensePlan):
+def prepare_device_inputs(plan: _DensePlan, pad_for_kernel: bool = False):
     """(blocks, dup_u, dup_i) device arrays from a host plan — the
     scatter-densified int8 row blocks plus the correction-cell arrays.
     Shared by train_dense and bench.py's steady-state timer so both time
-    the same program."""
+    the same program. ``pad_for_kernel`` zero-pads each block to the
+    Pallas tile grid (both dims to PAD_MULTIPLE, since either dim can be
+    the contraction) — done once per train, and zero cells contribute to
+    neither dot."""
     blocks = tuple(
         _scatter_block(
             jax.device_put(plan.flat[b]), jax.device_put(plan.vals[b]),
             ub=plan.ub, n_items=plan.n_items)
         for b in range(plan.nb)
     )
+    if pad_for_kernel:
+        from predictionio_tpu.ops.dense_dots import PAD_MULTIPLE
+
+        def up(x: int) -> int:
+            return -(-x // PAD_MULTIPLE) * PAD_MULTIPLE
+
+        ub_p, items_p = up(plan.ub), up(plan.n_items)
+        if (ub_p, items_p) != (plan.ub, plan.n_items):
+            blocks = tuple(
+                jnp.pad(a, ((0, ub_p - plan.ub),
+                            (0, items_p - plan.n_items)))
+                for a in blocks
+            )
     dup_u = dup_i = None
     if plan.dup_u is not None:
         dup_u = tuple(jax.device_put(x) for x in (
@@ -472,20 +549,24 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
     nd = 0 if plan.dup_u is None else len(plan.dup_u.seg)
     logger.info(
         "ALS(dense): %d ratings -> %d x %d int8 cells in %d blocks, "
-        "%d correction cells, scale %d, rank %d",
-        len(ratings), n_users, n_items, plan.nb, nd, plan.scale, p.rank)
+        "%d correction cells, scale %d, rank %d, dots=%s",
+        len(ratings), n_users, n_items, plan.nb, nd, plan.scale, p.rank,
+        "pallas" if use_kernel() else "xla")
 
     key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
     ku, ki = jax.random.split(key)
     user_f = _init_factors(ku, n_users, p.rank)
     item_f = _init_factors(ki, n_items, p.rank)
-    blocks, dup_u, dup_i = prepare_device_inputs(plan)
+    kernel = use_kernel()
+    blocks, dup_u, dup_i = prepare_device_inputs(
+        plan, pad_for_kernel=kernel)
 
     # gather_dtype="float32" is the parity-study mode: every dot at
     # HIGHEST. The default runs the gram-pairs dot at HIGHEST (a PSD
     # requirement, see _pairs_payload) and the rhs dot relaxed.
     static = dict(implicit=p.implicit_prefs, rank=p.rank, scale=plan.scale,
-                  exact=p.gather_dtype == "float32")
+                  ub=plan.ub, exact=p.gather_dtype == "float32",
+                  kernel=kernel)
     if callback is None:
         user_f, item_f = _dense_train(
             user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
@@ -526,9 +607,11 @@ def _local_half_inputs(itf, rank, implicit):
 
 def _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha, implicit,
                      rank, scale):
-    """pairs/rhs/counts -> regularized SoA Cholesky solve (the shared tail
-    of both half-steps; ``corr`` is an optional [n, P+r+1] f32 addend)."""
-    from predictionio_tpu.models.als import _reg_solve
+    """pairs/rhs/counts -> regularized Cholesky solve (the shared tail of
+    both half-steps; ``corr`` is an optional [n, P+r+1] f32 addend). The
+    gram stays in its packed upper-triangle column layout all the way
+    into the solver (_reg_solve_packed) — no [n, r, r] materialization."""
+    from predictionio_tpu.models.als import _reg_solve_packed
 
     n_pairs = rank * (rank + 1) // 2
     if implicit:
@@ -543,14 +626,17 @@ def _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha, implicit,
         pairs = pairs + corr[:, :n_pairs]
         rhs = rhs + corr[:, n_pairs: n_pairs + rank]
         counts = counts + corr[:, -1]
-    iu, ju = np.triu_indices(rank)
-    gram = jnp.zeros((prev.shape[0], rank, rank), jnp.float32)
-    gram = gram.at[:, iu, ju].set(pairs)
-    gram = gram.at[:, ju, iu].set(pairs)
     if implicit:
-        gram = gram + (fixed.T @ fixed)[None, :, :]
+        # Hu-Koren's shared XtX Gram term, packed: one [r, r] added to
+        # every entity's upper triangle
+        iu, ju = np.triu_indices(rank)
+        xtx = jax.lax.dot_general(
+            fixed, fixed, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        pairs = pairs + xtx[iu, ju][None, :]
     reg = lambda_ * jnp.maximum(counts, 1.0) + 1e-8
-    sol = _reg_solve(gram, rhs, reg, rank)
+    sol = _reg_solve_packed(pairs, rhs, reg, rank)
     return jnp.where(counts[:, None] > 0, sol, prev)
 
 

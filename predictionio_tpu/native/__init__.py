@@ -69,16 +69,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pio_eventlog_scan.restype = c.c_int32
     lib.pio_eventlog_find_offset.argtypes = [c.c_char_p, c.c_char_p]
     lib.pio_eventlog_find_offset.restype = c.c_int64
-    lib.pio_eventlog_interactions.argtypes = [
-        c.c_char_p, c.c_char_p, c.c_int32,          # path, names blob, n
-        c.c_char_p, c.c_float,                      # rating key, default
-        c.POINTER(c.c_int64),                       # out n
+    # the 12-entry out-pointer tail shared by both interaction decodes —
+    # one definition, or the two C ABIs drift apart silently
+    _interactions_tail = [
+        c.POINTER(c.c_int64),                          # out n
         c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),  # user_idx, item_idx
         c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),  # rating, name_idx
-        c.POINTER(c.c_void_p),                      # time_us
+        c.POINTER(c.c_void_p),                         # time_us
         c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
         c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
+    lib.pio_eventlog_interactions.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_int32,          # path, names blob, n
+        c.c_char_p, c.c_float,                      # rating key, default
+    ] + _interactions_tail
     lib.pio_eventlog_interactions.restype = c.c_int32
     # these symbols postdate the first release of the .so: bind each
     # defensively so a stale library (mtime newer than the source) degrades
@@ -89,6 +93,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ("pio_counting_sort_apply",
          [c.c_void_p, c.c_int64, c.c_int64, c.c_void_p,
           c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p]),
+        ("pio_eventlog_partition",
+         [c.c_char_p, c.c_int32, c.POINTER(c.c_int64)]),
+        ("pio_eventlog_interactions_range",
+         [c.c_char_p, c.c_int64, c.c_int64, c.c_char_p, c.c_int32,
+          c.c_char_p, c.c_float] + _interactions_tail),
     ):
         try:
             fn = getattr(lib, name)

@@ -32,6 +32,11 @@
 // Python writer (insert is HTTP-bound); a truncated trailing record (reader
 // racing an append) is treated as end-of-file.
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <charconv>
 #include <cstdint>
@@ -322,16 +327,36 @@ struct Match {
   uint32_t size;  // including the u32 prefix
 };
 
+// Read only bytes [from, to) of a file — the partitioned-scan path, where
+// ``from`` is a record boundary from pio_eventlog_partition (no magic
+// check: the magic lives at offset 0 of the FILE, not of this range).
+bool read_file_range(const char* path, int64_t from, int64_t to,
+                     std::vector<uint8_t>& out) {
+  if (from < 0 || to < from) return false;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  if (std::fseek(f, static_cast<long>(from), SEEK_SET) != 0) {
+    std::fclose(f);
+    return false;
+  }
+  out.resize(static_cast<size_t>(to - from));
+  size_t got = out.empty() ? 0 : std::fread(out.data(), 1, out.size(), f);
+  std::fclose(f);
+  out.resize(got);
+  return true;
+}
+
 // Shared filtered-scan core. target modes: 0 = no filter, 1 = must be null,
 // 2 = exact match (the reference's Option[Option[String]],
-// ref: LEvents.scala:164-221).
+// ref: LEvents.scala:164-221). ``begin_pos`` is sizeof(kMagic) for whole
+// files and 0 for range buffers from read_file_range.
 template <typename Fn>
-void scan_impl(const std::vector<uint8_t>& buf, int64_t start_us,
-               int64_t until_us, const char* entity_type,
-               const char* entity_id, const uint8_t* names_blob,
-               int32_t n_names, int32_t target_type_mode,
-               const char* target_type, int32_t target_id_mode,
-               const char* target_id, Fn&& fn) {
+void scan_impl_from(const std::vector<uint8_t>& buf, size_t begin_pos,
+                    int64_t start_us, int64_t until_us,
+                    const char* entity_type, const char* entity_id,
+                    const uint8_t* names_blob, int32_t n_names,
+                    int32_t target_type_mode, const char* target_type,
+                    int32_t target_id_mode, const char* target_id, Fn&& fn) {
   NameFilter names;
   if (names_blob && n_names > 0) names.init(names_blob, n_names);
   uint64_t want_hash = 0;
@@ -339,7 +364,7 @@ void scan_impl(const std::vector<uint8_t>& buf, int64_t start_us,
   if (use_hash)
     want_hash = fnv1a(entity_type, std::strlen(entity_type), entity_id,
                       std::strlen(entity_id));
-  size_t pos = sizeof(kMagic);
+  size_t pos = begin_pos;
   Record r;
   size_t next;
   while (parse_record(buf, pos, &r, &next)) {
@@ -369,6 +394,94 @@ void scan_impl(const std::vector<uint8_t>& buf, int64_t start_us,
       continue;
     fn(r, here, name_idx);
   }
+}
+
+template <typename Fn>
+void scan_impl(const std::vector<uint8_t>& buf, int64_t start_us,
+               int64_t until_us, const char* entity_type,
+               const char* entity_id, const uint8_t* names_blob,
+               int32_t n_names, int32_t target_type_mode,
+               const char* target_type, int32_t target_id_mode,
+               const char* target_id, Fn&& fn) {
+  scan_impl_from(buf, sizeof(kMagic), start_us, until_us, entity_type,
+                 entity_id, names_blob, n_names, target_type_mode,
+                 target_type, target_id_mode, target_id,
+                 std::forward<Fn>(fn));
+}
+
+// Body shared by the whole-file and range interaction decodes: scan
+// ``buf`` from ``begin_pos`` and return the columnar arrays + interned
+// string tables through the out-pointers.
+int32_t interactions_impl(
+    const std::vector<uint8_t>& buf, size_t begin_pos,
+    const uint8_t* names_blob, int32_t n_names, const char* rating_key,
+    float default_rating, int64_t* out_n, int32_t** out_user_idx,
+    int32_t** out_item_idx, float** out_rating, int32_t** out_name_idx,
+    int64_t** out_time_us, int64_t* out_n_users, uint8_t** out_users_blob,
+    int64_t* out_users_blob_len, int64_t* out_n_items,
+    uint8_t** out_items_blob, int64_t* out_items_blob_len) {
+  std::vector<int32_t> user_idx, item_idx, name_idx;
+  std::vector<float> rating;
+  std::vector<int64_t> time_us;
+  std::unordered_map<std::string, int32_t> users, items;
+  std::string users_blob, items_blob;
+  auto intern = [](std::unordered_map<std::string, int32_t>& table,
+                   std::string& blob, const char* s, uint32_t len) -> int32_t {
+    std::string key(s, len);
+    auto it = table.find(key);
+    if (it != table.end()) return it->second;
+    int32_t idx = static_cast<int32_t>(table.size());
+    table.emplace(std::move(key), idx);
+    uint16_t l16 = static_cast<uint16_t>(len);
+    blob.append(reinterpret_cast<const char*>(&l16), 2);
+    blob.append(s, len);
+    return idx;
+  };
+  scan_impl_from(
+      buf, begin_pos, INT64_MIN, INT64_MAX, nullptr, nullptr, names_blob,
+      n_names, 0, nullptr, 0, nullptr,
+      [&](const Record& r, size_t, int32_t nidx) {
+        if (r.target_entity_id == nullptr) return;
+        user_idx.push_back(
+            intern(users, users_blob, r.entity_id, r.entity_id_len));
+        item_idx.push_back(intern(items, items_blob, r.target_entity_id,
+                                  r.target_entity_id_len));
+        name_idx.push_back(nidx);
+        time_us.push_back(r.event_time_us);
+        float v = default_rating;
+        if (rating_key) {
+          double d;
+          if (json_top_level_number(r.props, r.props_len, rating_key, &d))
+            v = static_cast<float>(d);
+        }
+        rating.push_back(v);
+      });
+  auto copy_out = [](const void* src, size_t bytes) -> void* {
+    void* p = std::malloc(bytes ? bytes : 1);
+    if (p && bytes) std::memcpy(p, src, bytes);
+    return p;
+  };
+  size_t n = user_idx.size();
+  *out_n = static_cast<int64_t>(n);
+  *out_user_idx =
+      static_cast<int32_t*>(copy_out(user_idx.data(), n * sizeof(int32_t)));
+  *out_item_idx =
+      static_cast<int32_t*>(copy_out(item_idx.data(), n * sizeof(int32_t)));
+  *out_rating =
+      static_cast<float*>(copy_out(rating.data(), n * sizeof(float)));
+  *out_name_idx =
+      static_cast<int32_t*>(copy_out(name_idx.data(), n * sizeof(int32_t)));
+  *out_time_us =
+      static_cast<int64_t*>(copy_out(time_us.data(), n * sizeof(int64_t)));
+  *out_n_users = static_cast<int64_t>(users.size());
+  *out_users_blob =
+      static_cast<uint8_t*>(copy_out(users_blob.data(), users_blob.size()));
+  *out_users_blob_len = static_cast<int64_t>(users_blob.size());
+  *out_n_items = static_cast<int64_t>(items.size());
+  *out_items_blob =
+      static_cast<uint8_t*>(copy_out(items_blob.data(), items_blob.size()));
+  *out_items_blob_len = static_cast<int64_t>(items_blob.size());
+  return 0;
 }
 
 }  // namespace
@@ -448,70 +561,84 @@ int32_t pio_eventlog_interactions(
     int32_t** out_name_idx, int64_t** out_time_us, int64_t* out_n_users,
     uint8_t** out_users_blob, int64_t* out_users_blob_len, int64_t* out_n_items,
     uint8_t** out_items_blob, int64_t* out_items_blob_len) {
-  std::vector<int32_t> user_idx, item_idx, name_idx;
-  std::vector<float> rating;
-  std::vector<int64_t> time_us;
-  std::unordered_map<std::string, int32_t> users, items;
-  std::string users_blob, items_blob;
-  auto intern = [](std::unordered_map<std::string, int32_t>& table,
-                   std::string& blob, const char* s, uint32_t len) -> int32_t {
-    std::string key(s, len);
-    auto it = table.find(key);
-    if (it != table.end()) return it->second;
-    int32_t idx = static_cast<int32_t>(table.size());
-    table.emplace(std::move(key), idx);
-    uint16_t l16 = static_cast<uint16_t>(len);
-    blob.append(reinterpret_cast<const char*>(&l16), 2);
-    blob.append(s, len);
-    return idx;
-  };
   std::vector<uint8_t> buf;
   if (!read_file(path, buf)) return -1;
-  scan_impl(
-      buf, INT64_MIN, INT64_MAX, nullptr, nullptr, names_blob, n_names, 0,
-      nullptr, 0, nullptr,
-      [&](const Record& r, size_t, int32_t nidx) {
-        if (r.target_entity_id == nullptr) return;
-        user_idx.push_back(
-            intern(users, users_blob, r.entity_id, r.entity_id_len));
-        item_idx.push_back(intern(items, items_blob, r.target_entity_id,
-                                  r.target_entity_id_len));
-        name_idx.push_back(nidx);
-        time_us.push_back(r.event_time_us);
-        float v = default_rating;
-        if (rating_key) {
-          double d;
-          if (json_top_level_number(r.props, r.props_len, rating_key, &d))
-            v = static_cast<float>(d);
-        }
-        rating.push_back(v);
-      });
-  auto copy_out = [](const void* src, size_t bytes) -> void* {
-    void* p = std::malloc(bytes ? bytes : 1);
-    if (p && bytes) std::memcpy(p, src, bytes);
-    return p;
-  };
-  size_t n = user_idx.size();
-  *out_n = static_cast<int64_t>(n);
-  *out_user_idx =
-      static_cast<int32_t*>(copy_out(user_idx.data(), n * sizeof(int32_t)));
-  *out_item_idx =
-      static_cast<int32_t*>(copy_out(item_idx.data(), n * sizeof(int32_t)));
-  *out_rating =
-      static_cast<float*>(copy_out(rating.data(), n * sizeof(float)));
-  *out_name_idx =
-      static_cast<int32_t*>(copy_out(name_idx.data(), n * sizeof(int32_t)));
-  *out_time_us =
-      static_cast<int64_t*>(copy_out(time_us.data(), n * sizeof(int64_t)));
-  *out_n_users = static_cast<int64_t>(users.size());
-  *out_users_blob =
-      static_cast<uint8_t*>(copy_out(users_blob.data(), users_blob.size()));
-  *out_users_blob_len = static_cast<int64_t>(users_blob.size());
-  *out_n_items = static_cast<int64_t>(items.size());
-  *out_items_blob =
-      static_cast<uint8_t*>(copy_out(items_blob.data(), items_blob.size()));
-  *out_items_blob_len = static_cast<int64_t>(items_blob.size());
+  return interactions_impl(
+      buf, sizeof(kMagic), names_blob, n_names, rating_key, default_rating,
+      out_n, out_user_idx, out_item_idx, out_rating, out_name_idx,
+      out_time_us, out_n_users, out_users_blob, out_users_blob_len,
+      out_n_items, out_items_blob, out_items_blob_len);
+}
+
+
+// Record-aligned partition boundaries for a parallel scan — the analog of
+// the reference's region-parallel HBase read (HBPEvents.scala:82-90 via
+// newAPIHadoopRDD) and the JDBC backend's ranged partitions
+// (JDBCPEvents.scala:33-110). Walks only the u32 length prefixes (no
+// decode) over an mmap'd view — no heap copy of the (possibly multi-GB)
+// file, and the pages it faults in warm the cache for the workers'
+// ranged reads. Writes n_parts+1 offsets: out[0] = first record,
+// out[n_parts] = end of the last complete record, interior boundaries at
+// the first record crossing each even byte split.
+int32_t pio_eventlog_partition(const char* path, int32_t n_parts,
+                               int64_t* out_offsets) {
+  if (n_parts < 1) return -1;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < (long)sizeof(kMagic)) {
+    ::close(fd);
+    return -1;
+  }
+  size_t end = static_cast<size_t>(st.st_size);
+  const uint8_t* base = static_cast<const uint8_t*>(
+      ::mmap(nullptr, end, PROT_READ, MAP_PRIVATE, fd, 0));
+  ::close(fd);
+  if (base == MAP_FAILED) return -1;
+  if (std::memcmp(base, kMagic, sizeof(kMagic)) != 0) {
+    ::munmap(const_cast<uint8_t*>(base), end);
+    return -1;
+  }
+  size_t begin = sizeof(kMagic);
+  size_t span = end - begin;
+  out_offsets[0] = static_cast<int64_t>(begin);
+  int32_t k = 1;
+  size_t pos = begin;
+  while (pos + 4 <= end) {
+    uint32_t total = rd32(base + pos);
+    if (total < kFixedSize || pos + 4 + total > end) break;  // truncated tail
+    pos += 4 + total;
+    while (k < n_parts && pos - begin >= span * static_cast<uint64_t>(k) /
+                                             static_cast<uint64_t>(n_parts)) {
+      out_offsets[k++] = static_cast<int64_t>(pos);
+    }
+  }
+  while (k <= n_parts) out_offsets[k++] = static_cast<int64_t>(pos);
+  ::munmap(const_cast<uint8_t*>(base), end);
   return 0;
+}
+
+
+// Columnar interaction decode over one byte range [from, to) of the file
+// (record-aligned boundaries from pio_eventlog_partition). Each worker
+// thread reads only its own range and interns locally; the Python caller
+// merges the per-partition string tables (file order preserved, so the
+// merged interning order is identical to a sequential scan's).
+int32_t pio_eventlog_interactions_range(
+    const char* path, int64_t from, int64_t to, const uint8_t* names_blob,
+    int32_t n_names, const char* rating_key, float default_rating,
+    int64_t* out_n, int32_t** out_user_idx, int32_t** out_item_idx,
+    float** out_rating, int32_t** out_name_idx, int64_t** out_time_us,
+    int64_t* out_n_users, uint8_t** out_users_blob,
+    int64_t* out_users_blob_len, int64_t* out_n_items,
+    uint8_t** out_items_blob, int64_t* out_items_blob_len) {
+  std::vector<uint8_t> buf;
+  if (!read_file_range(path, from, to, buf)) return -1;
+  return interactions_impl(
+      buf, 0, names_blob, n_names, rating_key, default_rating, out_n,
+      out_user_idx, out_item_idx, out_rating, out_name_idx, out_time_us,
+      out_n_users, out_users_blob, out_users_blob_len, out_n_items,
+      out_items_blob, out_items_blob_len);
 }
 
 

@@ -1,0 +1,171 @@
+"""Fused dequant-dual-dot Pallas kernel for the dense ALS solver.
+
+The dense solver's half-step executes two payload matmuls against the
+same int8 rating block (models/als_dense.py):
+
+    gi = indicator(A) @ ind_payload      gv = A @ val_payload
+
+This kernel DMAs each int8 tile into VMEM once, forms both operand
+views (``!= 0`` indicator and value) on-chip, and emits both dots'
+partials from the same tile residency — one HBM pass over ``A`` per
+half-step instead of one per dot.
+
+**Status: parked, env-gated off by default** (``PIO_DENSE_KERNEL``,
+models/als_dense.use_kernel). Round-4 measurement on a v5e: XLA's
+mixed ``bf16 x f32 @ Precision.HIGHEST`` dot executes in ~1 MXU pass,
+but Mosaic rejects mixed-precision matmuls ("Bad lhs type"), so this
+kernel must emulate HIGHEST with the 3-term bf16 split below — 3x the
+MXU passes — and the iteration is not bandwidth-bound enough for the
+single-read fusion to pay that back (measured ~2x slower end to end;
+full study in docs/perf.md §5). The kernel stays correct, tested, and
+selectable in case a future Mosaic exposes the mixed dot.
+
+Numerics are the solver's exact contract (see _pairs_payload's notes):
+the dot whose payload carries the gram PAIRS must match XLA's
+``bf16 x f32 @ Precision.HIGHEST`` — which lowers to a 3-term bf16
+split of the f32 operand. The kernel performs the identical split
+in-kernel (``splits=3``): payload = hi + mid + lo with each term bf16,
+three MXU passes accumulated in f32, products exact because the int8-
+derived left operand is exactly bf16-representable. The relaxed dot
+(``splits=1``) rounds the payload to bf16 once — exactly XLA's default
+mixed-precision behavior.
+
+Both half-step orientations ride the same kernel:
+
+- ``contract_rows=False`` (user half): out[m] = sum_k A[m, k] p[k]
+- ``contract_rows=True`` (item half):  out[n] = sum_k A[k, n] p[k]
+
+Shapes must be pre-padded to the tile grid (``TILE_OUT`` x ``TILE_K``);
+models/als_dense.py pads the scattered blocks once per train (padding
+cells are zero, so they contribute nothing to either dot).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_dual_dot", "TILE_OUT", "TILE_K", "PAD_MULTIPLE"]
+
+#: Output-dimension tile (rows of the result). Payload blocks are indexed
+#: by the contraction step only, so they are re-streamed once per OUTPUT
+#: tile — a large out-tile bounds that redundant traffic (at ML-20M block
+#: shape: ~35 re-reads x 7 MB ≈ 0.25 GB vs the block's own 0.94 GB; at
+#: 256 it was ~1 GB and dominated). VMEM at (1024, 512): 512 KB int8
+#: A-tile + ~0.8 MB payload/accumulator tiles, double-buffered — well
+#: inside a v5e core's ~16 MB.
+TILE_OUT = 1024
+#: Contraction-dimension tile.
+TILE_K = 512
+#: Callers pad BOTH block dims to this (each dim is the out dim in one
+#: half-step and the contraction dim in the other).
+PAD_MULTIPLE = max(TILE_OUT, TILE_K)
+
+
+def _split_bf16(p, n: int):
+    """``n``-term bf16 decomposition of an f32 payload tile, smallest
+    term first (so the f32 accumulation adds small to large). n=1 is a
+    plain bf16 round (XLA default mixed precision); n=3 reproduces
+    ``Precision.HIGHEST`` for bf16-exact left operands."""
+    terms = []
+    rem = p
+    for _ in range(n):
+        t = rem.astype(jnp.bfloat16)
+        terms.append(t)
+        rem = rem - t.astype(jnp.float32)
+    return terms[::-1]
+
+
+def _kernel(a_ref, ip_ref, vp_ref, gi_ref, gv_ref, *, contract_rows: bool,
+            splits_ind: int, splits_val: int):
+    a = a_ref[:]
+    ai = (a != 0).astype(jnp.bfloat16)
+    av = a.astype(jnp.bfloat16)
+    if contract_rows:
+        dims = (((0,), (0,)), ((), ()))
+    else:
+        dims = (((1,), (0,)), ((), ()))
+
+    def dual(x, p_ref, n_splits):
+        acc = None
+        for t in _split_bf16(p_ref[:], n_splits):
+            d = jax.lax.dot_general(
+                x, t, dims, preferred_element_type=jnp.float32)
+            acc = d if acc is None else acc + d
+        return acc
+
+    pi = dual(ai, ip_ref, splits_ind)
+    pv = dual(av, vp_ref, splits_val)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        gi_ref[:] = pi
+        gv_ref[:] = pv
+
+    @pl.when(pl.program_id(1) > 0)
+    def _():
+        gi_ref[:] = gi_ref[:] + pi
+        gv_ref[:] = gv_ref[:] + pv
+
+
+@partial(jax.jit, static_argnames=("contract_rows", "splits_ind",
+                                   "splits_val", "interpret"))
+def fused_dual_dot(a, ind_payload, val_payload, *, contract_rows: bool,
+                   splits_ind: int = 3, splits_val: int = 1,
+                   interpret: bool = False):
+    """(indicator(a) . ind_payload, a . val_payload) in one pass over
+    ``a`` ([M, N] int8, dims pre-padded to the tile grid).
+
+    contract_rows=False: payloads [N, P*], outputs [M, P*].
+    contract_rows=True:  payloads [M, P*], outputs [N, P*].
+    """
+    m, n = a.shape
+    if contract_rows:
+        out_dim, k_dim = n, m
+    else:
+        out_dim, k_dim = m, n
+    assert out_dim % TILE_OUT == 0 and k_dim % TILE_K == 0, (
+        f"pad A to the {TILE_OUT}x{TILE_K} tile grid, got {a.shape}")
+    assert ind_payload.shape[0] == k_dim and val_payload.shape[0] == k_dim
+    pi_cols = ind_payload.shape[1]
+    pv_cols = val_payload.shape[1]
+    grid = (out_dim // TILE_OUT, k_dim // TILE_K)
+
+    if contract_rows:
+        a_spec = pl.BlockSpec((TILE_K, TILE_OUT), lambda j, k: (k, j),
+                              memory_space=pltpu.VMEM)
+    else:
+        a_spec = pl.BlockSpec((TILE_OUT, TILE_K), lambda i, k: (i, k),
+                              memory_space=pltpu.VMEM)
+    p_spec = lambda cols: pl.BlockSpec(  # noqa: E731
+        (TILE_K, cols), lambda i, k: (k, 0), memory_space=pltpu.VMEM)
+    out_spec = lambda cols: pl.BlockSpec(  # noqa: E731
+        (TILE_OUT, cols), lambda i, k: (i, 0), memory_space=pltpu.VMEM)
+
+    flops_per_col = 2 * out_dim * k_dim
+    cost = pl.CostEstimate(
+        flops=flops_per_col * (pi_cols * splits_ind + pv_cols * splits_val),
+        bytes_accessed=(
+            m * n
+            + k_dim * (pi_cols + pv_cols) * 4 * (out_dim // TILE_OUT)
+            + out_dim * (pi_cols + pv_cols) * 4
+        ),
+        transcendentals=0,
+    )
+    return pl.pallas_call(
+        partial(_kernel, contract_rows=contract_rows,
+                splits_ind=splits_ind, splits_val=splits_val),
+        grid=grid,
+        in_specs=[a_spec, p_spec(pi_cols), p_spec(pv_cols)],
+        out_specs=(out_spec(pi_cols), out_spec(pv_cols)),
+        out_shape=(
+            jax.ShapeDtypeStruct((out_dim, pi_cols), jnp.float32),
+            jax.ShapeDtypeStruct((out_dim, pv_cols), jnp.float32),
+        ),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(a, ind_payload, val_payload)

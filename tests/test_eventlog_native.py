@@ -258,3 +258,65 @@ def test_interactions_python_fallback_parity(tmp_path):
     assert a[0] == b[0] and a[1] == b[1]
     for k in range(2, 6):
         np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_partition_boundaries_cover_file(tmp_path):
+    """pio_eventlog_partition yields record-aligned, monotonic boundaries
+    whose union covers exactly the complete records."""
+    import ctypes
+
+    from predictionio_tpu.native import eventlog_lib
+
+    lib = eventlog_lib()
+    if lib is None or not hasattr(lib, "pio_eventlog_partition"):
+        pytest.skip("native library unavailable")
+    store = ELogEvents(ELogClient({"PATH": str(tmp_path)}))
+    store.init(1)
+    for e in make_events(200, seed=5):
+        store.insert(e, 1)
+    path = store._path(1, None)
+    for nparts in (1, 3, 7):
+        offs = (ctypes.c_int64 * (nparts + 1))()
+        assert lib.pio_eventlog_partition(
+            str(path).encode(), nparts, offs) == 0
+        vals = list(offs)
+        assert vals[0] == 8  # after magic
+        assert vals[-1] == path.stat().st_size  # all records complete
+        assert vals == sorted(vals)
+        # every boundary is a record start: decoding from it succeeds
+        buf = path.read_bytes()
+        for off in vals[:-1]:
+            ev, nxt, _ = decode_record(buf, off)
+            assert ev is not None and nxt > off
+
+
+@pytest.mark.parametrize("nparts", [2, 3, 8])
+def test_partitioned_interactions_match_sequential(tmp_path, nparts):
+    """The partitioned scan (threads over record-aligned byte ranges,
+    merged intern tables) returns results IDENTICAL to the sequential
+    scan — including the string-table order (VERDICT r3 item 3; ref:
+    JDBCPEvents.scala:33-110 partitioned training reads)."""
+    store = ELogEvents(ELogClient({"PATH": str(tmp_path)}))
+    store.init(1)
+    for e in make_events(300, seed=9):
+        store.insert(e, 1)
+    names = ["view", "buy", "rate"]
+    seq = store.interactions(1, None, names, partitions=1)
+    par = store.interactions(1, None, names, partitions=nparts)
+    assert par[0] == seq[0]  # user string table, same order
+    assert par[1] == seq[1]  # item string table, same order
+    for a, b in zip(par[2:], seq[2:]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_partitioned_interactions_default_from_env(tmp_path, monkeypatch):
+    store = ELogEvents(ELogClient({"PATH": str(tmp_path)}))
+    store.init(1)
+    for e in make_events(50, seed=2):
+        store.insert(e, 1)
+    monkeypatch.setenv("PIO_SCAN_PARTITIONS", "3")
+    par = store.interactions(1, None, ["view", "buy", "rate"])
+    seq = store.interactions(1, None, ["view", "buy", "rate"],
+                             partitions=1)
+    assert par[0] == seq[0]
+    np.testing.assert_array_equal(par[2], seq[2])
