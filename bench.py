@@ -346,7 +346,7 @@ def bench_two_tower(ctx) -> dict:
     # alongside so round-over-round deltas can be read against the jitter
     times = sorted(timed()[0] for _ in range(5))
     dt = times[0]
-    return {
+    out = {
         "two_tower_steady_steps_per_sec": round(steps / dt, 2),
         "two_tower_steps_per_sec": round(steps / dt, 2),  # r2/r3 continuity
         "two_tower_steps_per_sec_spread": [
@@ -355,6 +355,33 @@ def bench_two_tower(ctx) -> dict:
         "two_tower_fixed_steps": steps,
         "two_tower_examples_per_sec": round(steps * 4096 / dt, 0),
     }
+
+    # -- batch 16k via the chunked (online-logsumexp) in-batch softmax:
+    # the dense [16k, 16k] logits (~1 GB) capped usable batch sizes in
+    # round 3; the chunked loss makes the large-batch regime benchable
+    p16 = TwoTowerParams(batch_size=16384, steps=0, seed=0)
+    b16 = ctx.pad_to_multiple(p16.batch_size)
+    tx16, run16, _ = _get_trainer(ctx, p16, b16)
+    params16 = jax.device_put(init_params(nu, ni, p16), ctx.replicated)
+    opt16 = tx16.init(params16)
+    params16, opt16, loss16 = run16(
+        params16, opt16, u_all, i_all, key, 2)
+    float(loss16)
+    steps16 = 500
+
+    def timed16():
+        nonlocal params16, opt16
+        t0 = time.perf_counter()
+        params16, opt16, loss = run16(
+            params16, opt16, u_all, i_all, key, steps16)
+        float(loss)
+        return time.perf_counter() - t0
+
+    t16 = min(timed16() for _ in range(3))
+    out["two_tower_b16k_steps_per_sec"] = round(steps16 / t16, 2)
+    out["two_tower_b16k_examples_per_sec"] = round(
+        steps16 * 16384 / t16, 0)
+    return out
 
 
 #: The performance bands README.md claims, as ``extra`` key → (lo, hi).

@@ -213,6 +213,28 @@ class Storage:
         return cls.instance()._dao("EVENTDATA", "Events")
 
     @classmethod
+    def events_for_source(cls, source_name: str,
+                          prefix: str | None = None):
+        """Events DAO bound to an EXPLICIT configured source, bypassing
+        the repository mapping — the storage-migration hook (`pio
+        upgrade --migrate-events`), mirroring how the reference's
+        upgrade tool opens the old-format table next to the new one
+        (ref: data/.../hbase/upgrade/Upgrade.scala:46-60)."""
+        reg = cls.instance()
+        src = reg.sources.get(source_name)
+        if src is None:
+            raise StorageError(f"Undefined storage source: {source_name}")
+        mod_name, cls_prefix = reg._backend(src.type)
+        mod = importlib.import_module(mod_name)
+        dao_cls = getattr(mod, f"{cls_prefix}Events", None)
+        if dao_cls is None:
+            raise StorageError(
+                f"Storage backend {src.type} does not implement Events")
+        if prefix is None:
+            prefix = reg.repositories["EVENTDATA"].prefix
+        return dao_cls(reg._client(source_name), prefix)
+
+    @classmethod
     def get_meta_data_apps(cls):
         return cls.instance()._dao("METADATA", "Apps")
 

@@ -45,6 +45,67 @@ class TwoTowerParams:
     learning_rate: float = 1e-3
     temperature: float = 0.05
     seed: int = 0
+    #: in-batch-softmax column chunk: ``None`` = auto (dense logits up to
+    #: 4096 negatives, 2048-column online-softmax chunks above — a 16k
+    #: batch's [B, B] f32 logits are ~1 GB, which capped usable batch
+    #: sizes in round 3); 0 = always dense; >0 = explicit chunk size
+    loss_chunk: int | None = None
+
+
+#: auto mode: largest negatives count whose dense [B, B] logits are kept
+_DENSE_LOGITS_MAX = 4096
+_AUTO_CHUNK = 2048
+#: smallest worthwhile chunk: below this the scan degenerates toward
+#: per-column work and dense logits are the lesser evil
+_MIN_CHUNK = 64
+
+
+def _resolve_chunk(p: TwoTowerParams, n_negatives: int) -> int | None:
+    """Column-chunk size for the in-batch softmax, or None for dense.
+    The online softmax needs equal chunks, so the requested (or auto)
+    size is rounded DOWN to the largest divisor of the padded batch —
+    falling back to dense would silently rematerialize the [B, B]
+    logits whose memory blowup this feature exists to avoid."""
+    if p.loss_chunk is not None and p.loss_chunk < 0:
+        raise ValueError(f"loss_chunk must be >= 0, got {p.loss_chunk}")
+    if p.loss_chunk == 0:
+        return None
+    want = p.loss_chunk
+    if want is None:
+        if n_negatives <= _DENSE_LOGITS_MAX:
+            return None
+        want = _AUTO_CHUNK
+    want = max(1, min(want, n_negatives))
+    chunk = next(c for c in range(want, 0, -1) if n_negatives % c == 0)
+    if chunk < _MIN_CHUNK and chunk < n_negatives:
+        logger.warning(
+            "two-tower loss_chunk: no useful divisor of batch %d near %d "
+            "(best %d); using dense [B, B] logits", n_negatives, want, chunk)
+        return None
+    return chunk
+
+
+def _chunked_softmax_ce(u, v_pairs, v_all, temperature, chunk: int):
+    """Per-row in-batch sampled-softmax CE without materializing the
+    [rows, negatives] logits: an exact online logsumexp over column
+    chunks of ``v_all`` (the flash-attention trick applied to the loss).
+    ``v_pairs`` holds each row's positive item embedding."""
+    rows = u.shape[0]
+    pos = (u * v_pairs).sum(-1) / temperature
+    nc = v_all.shape[0] // chunk
+
+    def step(carry, vc):
+        m, s = carry
+        lg = (u @ vc.T) / temperature  # [rows, chunk]
+        m2 = jnp.maximum(m, lg.max(-1))
+        s = s * jnp.exp(m - m2) + jnp.exp(lg - m2[:, None]).sum(-1)
+        return (m2, s), None
+
+    m0 = jnp.full((rows,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((rows,), jnp.float32)
+    (m, s), _ = jax.lax.scan(
+        step, (m0, s0), v_all.reshape(nc, chunk, v_all.shape[1]))
+    return -(pos - (m + jnp.log(s)))
 
 
 @dataclass
@@ -115,13 +176,18 @@ def make_train_step(ctx: ComputeContext, p: TwoTowerParams, tx):
             v = _tower_forward(params["item"], i_idx)  # [b_local, d]
             # negatives from every device: ICI all_gather over the data axis
             v_all = jax.lax.all_gather(v, DATA_AXIS, tiled=True)  # [b_glob, d]
-            logits = (u @ v_all.T) / p.temperature  # [b_local, b_glob]
-            shard_idx = jax.lax.axis_index(DATA_AXIS)
-            b_local = u.shape[0]
-            labels = shard_idx * b_local + jnp.arange(b_local)
-            losses = -jax.nn.log_softmax(logits, axis=-1)[
-                jnp.arange(b_local), labels
-            ]
+            chunk = _resolve_chunk(p, v_all.shape[0])
+            if chunk is not None:
+                losses = _chunked_softmax_ce(u, v, v_all, p.temperature,
+                                             chunk)
+            else:
+                logits = (u @ v_all.T) / p.temperature  # [b_local, b_glob]
+                shard_idx = jax.lax.axis_index(DATA_AXIS)
+                b_local = u.shape[0]
+                labels = shard_idx * b_local + jnp.arange(b_local)
+                losses = -jax.nn.log_softmax(logits, axis=-1)[
+                    jnp.arange(b_local), labels
+                ]
             return jax.lax.pmean(losses.mean(), DATA_AXIS)
 
         return jax.shard_map(
@@ -171,6 +237,9 @@ def make_train_step_gspmd(ctx: ComputeContext, p: TwoTowerParams, tx):
     def loss_fn(params, u_idx, i_idx):
         u = _tower_forward(params["user"], u_idx)  # [B, d]
         v = _tower_forward(params["item"], i_idx)  # [B, d]
+        chunk = _resolve_chunk(p, v.shape[0])
+        if chunk is not None:
+            return _chunked_softmax_ce(u, v, v, p.temperature, chunk).mean()
         logits = (u @ v.T) / p.temperature  # [B, B]: global in-batch softmax
         b = u.shape[0]
         labels = jnp.arange(b)

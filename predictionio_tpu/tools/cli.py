@@ -226,7 +226,18 @@ def build_parser() -> argparse.ArgumentParser:
     p_run.add_argument("args", nargs="*")
     p_run.set_defaults(func=cmd_run)
 
-    p_up = sub.add_parser("upgrade", help="check for framework upgrades")
+    p_up = sub.add_parser(
+        "upgrade",
+        help="check for framework upgrades / migrate event storage",
+    )
+    p_up.add_argument(
+        "--migrate-events", action="store_true",
+        help="copy events between storage sources (format migration)")
+    p_up.add_argument("--from-source", help="source NAME to copy from")
+    p_up.add_argument("--to-source", help="source NAME to copy to")
+    p_up.add_argument("--app", help="migrate one app (default: all)")
+    p_up.add_argument("--batch", type=int, default=500,
+                      help="events per insert batch (default 500)")
     p_up.set_defaults(func=cmd_upgrade)
 
     return parser
@@ -637,6 +648,29 @@ def cmd_shell(args) -> int:
 
 
 def cmd_upgrade(args) -> int:
+    if getattr(args, "migrate_events", False):
+        # the data-migration mode of the reference's pio upgrade
+        # (ref: hbase/upgrade/Upgrade.scala via Console.scala)
+        if not args.from_source or not args.to_source:
+            print("[ERROR] --migrate-events requires --from-source and "
+                  "--to-source", file=sys.stderr)
+            return 1
+        from predictionio_tpu.tools.migrate import migrate_events
+
+        try:
+            copied = migrate_events(
+                args.from_source, args.to_source,
+                app_name=args.app, batch_size=args.batch)
+        except Exception as e:
+            print(f"[ERROR] migration failed: {e}", file=sys.stderr)
+            return 1
+        for app_name, n in copied.items():
+            print(f"[INFO] {app_name}: {n} events copied "
+                  f"{args.from_source} -> {args.to_source}")
+        print("[INFO] Migration complete. Point "
+              "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE at "
+              f"{args.to_source} to switch over.")
+        return 0
     from predictionio_tpu.utils.version_check import check_upgrade
 
     latest = check_upgrade("console")

@@ -357,3 +357,79 @@ def test_live_postgres_round_trip(postgres_storage):
     got = events.get(eid, 41)
     assert got is not None and got.properties["live"] is True
     assert events.delete(eid, 41)
+
+
+def test_migrate_events_between_sources(monkeypatch, tmp_path):
+    """pio upgrade --migrate-events: copy an app's events (all channels,
+    ids/times/properties preserved) from one configured source to
+    another — the storage-format migration path (ref: hbase/upgrade/
+    Upgrade.scala batch copy)."""
+    import os
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import App, Channel
+    from predictionio_tpu.tools.migrate import migrate_events
+
+    for key in list(os.environ):
+        if key.startswith("PIO_STORAGE_"):
+            monkeypatch.delenv(key)
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_SQL_TYPE", "sqlite")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_SQL_PATH",
+                       str(tmp_path / "old.db"))
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_ELOG_TYPE", "eventlog")
+    monkeypatch.setenv("PIO_STORAGE_SOURCES_ELOG_PATH",
+                       str(tmp_path / "elog"))
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "SQL")
+    Storage.reset()
+    try:
+        apps = Storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "migapp"))
+        ch_id = Storage.get_meta_data_channels().insert(
+            Channel(0, "mobile", app_id))
+        events = Storage.get_events()
+        events.init(app_id)
+        events.init(app_id, ch_id)
+        default_ids, channel_ids = [], []
+        for k in range(120):
+            e = Event(event="rate", entity_type="user", entity_id=f"u{k % 9}",
+                      target_entity_type="item", target_entity_id=f"i{k % 7}",
+                      properties=DataMap({"rating": float(1 + k % 5)}))
+            default_ids.append(events.insert(e, app_id))
+        for k in range(30):
+            e = Event(event="view", entity_type="user", entity_id=f"m{k}",
+                      target_entity_type="item", target_entity_id="i1")
+            channel_ids.append(events.insert(e, app_id, ch_id))
+
+        copied = migrate_events("SQL", "ELOG", app_name="migapp",
+                                batch_size=32)
+        assert copied == {"migapp": 150}
+
+        dst = Storage.events_for_source("ELOG")
+        got_default = list(dst.find(app_id=app_id))
+        got_channel = list(dst.find(app_id=app_id, channel_id=ch_id))
+        assert len(got_default) == 120 and len(got_channel) == 30
+        assert {e.event_id for e in got_default} == set(default_ids)
+        src_by_id = {e.event_id: e for e in events.find(app_id=app_id)}
+        for e in got_default:
+            s = src_by_id[e.event_id]
+            assert (e.event, e.entity_id, e.target_entity_id) == (
+                s.event, s.entity_id, s.target_entity_id)
+            assert e.properties.to_dict() == s.properties.to_dict()
+            assert e.event_time == s.event_time
+        # re-running upserts by id: no duplicates
+        copied2 = migrate_events("SQL", "ELOG", app_name="migapp")
+        assert copied2 == {"migapp": 150}
+        assert len(list(dst.find(app_id=app_id))) == 120
+        # degenerate batch size is rejected, not a silent no-op
+        with pytest.raises(ValueError, match="batch_size"):
+            migrate_events("SQL", "ELOG", batch_size=0)
+        # bulk migration skips apps with uninitialized stores instead of
+        # aborting the rest (explicitly named apps still raise)
+        apps.insert(App(0, "ghostapp"))  # never init'ed in SQL
+        copied3 = migrate_events("SQL", "ELOG")
+        assert copied3["migapp"] == 150 and copied3["ghostapp"] == 0
+    finally:
+        Storage.reset()
