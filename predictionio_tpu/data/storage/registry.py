@@ -182,6 +182,20 @@ class Storage:
             self._clients[source_name] = client
             return client
 
+    def _dao_class(self, stype: str, dao_name: str):
+        """Resolve a backend's DAO class by naming convention — the single
+        implementation of the ``<Prefix><DaoName>`` lookup shared by the
+        repository path (_dao) and the explicit-source path
+        (events_for_source)."""
+        mod_name, prefix = self._backend(stype)
+        mod = importlib.import_module(mod_name)
+        cls = getattr(mod, f"{prefix}{dao_name}", None)
+        if cls is None:
+            raise StorageError(
+                f"Storage backend {stype} does not implement {dao_name}"
+            )
+        return cls
+
     def _dao(self, repo: str, dao_name: str):
         with self._lock:
             cache_key = (repo, dao_name)
@@ -195,13 +209,7 @@ class Storage:
                 raise StorageError(
                     f"Repository {repo} references undefined source {rcfg.source}"
                 )
-            mod_name, prefix = self._backend(src.type)
-            mod = importlib.import_module(mod_name)
-            cls = getattr(mod, f"{prefix}{dao_name}", None)
-            if cls is None:
-                raise StorageError(
-                    f"Storage backend {src.type} does not implement {dao_name}"
-                )
+            cls = self._dao_class(src.type, dao_name)
             dao = cls(self._client(rcfg.source), rcfg.prefix)
             self._daos[cache_key] = dao
             return dao
@@ -224,12 +232,7 @@ class Storage:
         src = reg.sources.get(source_name)
         if src is None:
             raise StorageError(f"Undefined storage source: {source_name}")
-        mod_name, cls_prefix = reg._backend(src.type)
-        mod = importlib.import_module(mod_name)
-        dao_cls = getattr(mod, f"{cls_prefix}Events", None)
-        if dao_cls is None:
-            raise StorageError(
-                f"Storage backend {src.type} does not implement Events")
+        dao_cls = reg._dao_class(src.type, "Events")
         if prefix is None:
             prefix = reg.repositories["EVENTDATA"].prefix
         return dao_cls(reg._client(source_name), prefix)

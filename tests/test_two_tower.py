@@ -177,8 +177,9 @@ def test_resolve_chunk_auto_policy():
     )
 
     p = TwoTowerParams()
-    assert _resolve_chunk(p, 4096) is None          # dense up to 4096
-    assert _resolve_chunk(p, 8192) == 2048          # auto-chunk above
+    assert _resolve_chunk(p, 1024) is None          # chunking is a no-op
+    assert _resolve_chunk(p, 4096) == 2048          # chunked wins above
+    assert _resolve_chunk(p, 32768) == 2048
     assert _resolve_chunk(TwoTowerParams(loss_chunk=0), 16384) is None
     assert _resolve_chunk(TwoTowerParams(loss_chunk=4096), 16384) == 4096
     # non-dividing request rounds DOWN to the largest divisor (falling
