@@ -397,10 +397,10 @@ README_BANDS: dict[str, tuple[float, float]] = {
     "ml100k_als_rank10_iter_per_sec": (95, 230),
     "ml20m_rank64_steady_iter_per_sec": (0.4, 1),
     "mfu_rank10": (0.12, 0.17),
-    "two_tower_steady_steps_per_sec": (280, 560),
+    "two_tower_steady_steps_per_sec": (400, 800),
     "serve_p50_ms": (0.9, 1.5),
     "serve_qps": (1200, 2200),
-    "ingest_events_per_sec": (1500, 2400),
+    "ingest_events_per_sec": (1200, 2400),
     "ingest_batch50_events_per_sec": (10000, 17000),
 }
 
