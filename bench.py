@@ -503,7 +503,7 @@ def main() -> None:
     # --- ML-20M north star (rank 10 / 20 iterations, template defaults)
     ui, ii, r, nu, ni = synthesize_ml20m()
     ml20m_ips, _, steady = bench_als(
-        ctx, ui, ii, r, nu, ni, rank=10, iters=20, steady=True, repeats=2)
+        ctx, ui, ii, r, nu, ni, rank=10, iters=20, steady=True, repeats=4)
     if steady > 0:
         extra["ml20m_rank10_steady_iter_per_sec"] = round(steady, 3)
     from predictionio_tpu.models import als_dense

@@ -155,8 +155,16 @@ class _DensePlan:
 
     nb: int  # number of user-row blocks of A
     ub: int  # rows per block (padded; nb*ub >= n_users)
-    flat: list  # nb x [m_b] int32 block-local flat cell (>=ub*n_items: pad)
+    #: Compact COO per block — the host→device payload is the dominant
+    #: full-train cost through a slow link, so the flat cell ids are NOT
+    #: shipped: item indices ride uint16 when the catalog allows (2 B/edge
+    #: instead of a 4 B int32 flat id) plus one tiny [ub+1] CSR row-starts
+    #: vector, and the device reconstructs flat = row * n_items + item
+    #: (row via cumsum over boundary marks) before the scatter.
+    items: list  # nb x [m_b] u16/i32 item index (0 on padding)
     vals: list  # nb x [m_b] int8 scaled rating (0 on padding)
+    row_starts: list  # nb x [ub+1] int32 block-local CSR edge offsets
+    counts: list  # nb x int — real edges per block (m_b - padding)
     scale: int  # rating -> int8 multiplier (1 or 2)
     dup_u: _DupSide | None  # corrections for the user-side solve
     dup_i: _DupSide | None  # corrections for the item-side solve
@@ -234,37 +242,55 @@ def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
     ub = (n_users + nb - 1) // nb
     bounds = np.searchsorted(mu, np.arange(1, nb) * ub)
     starts = np.concatenate([[0], bounds, [len(mu)]])
-    flat_all = (mu.astype(np.int64) % ub) * n_items + mi
-    oor = ub * n_items  # first out-of-range cell: scatter drops from here
+    item_dtype = np.uint16 if n_items <= np.iinfo(np.uint16).max else np.int32
     sizes = np.diff(starts)
     common_m = max(int(sizes.max()) + 1023, 1024) // 1024 * 1024
-    flat, bvals = [], []
+    items, bvals, row_starts, counts = [], [], [], []
     for b in range(nb):
         lo, hi = starts[b], starts[b + 1]
-        k = hi - lo
+        k = int(hi - lo)
         # padded to a multiple of 1024: XLA's TPU scatter strategy choice
         # is size-sensitive (awkward update counts fall off a ~40x perf
-        # cliff — measured round 3); the padding cells are ascending
-        # distinct out-of-range ids, dropped by the scatter while keeping
-        # indices_are_sorted/unique_indices true
+        # cliff — measured round 3); padding entries become ascending
+        # distinct out-of-range flat ids on device, dropped by the
+        # scatter while keeping indices_are_sorted/unique_indices true
         m = common_m if uniform_m else max(
             (k + 1023) // 1024 * 1024, 1024)
-        f = np.empty(m, np.int32)
+        f = np.zeros(m, item_dtype)
         v = np.zeros(m, np.int8)
-        f[:k] = flat_all[lo:hi].astype(np.int32)
-        f[k:] = oor + np.arange(m - k, dtype=np.int32)
+        f[:k] = mi[lo:hi].astype(item_dtype)
         v[:k] = mv[lo:hi]
-        flat.append(f)
+        items.append(f)
         bvals.append(v)
-    return _DensePlan(nb, ub, flat, bvals, scale, dup_u, dup_i,
-                      n_users, n_items)
+        row_starts.append(np.searchsorted(
+            mu[lo:hi], b * ub + np.arange(ub + 1)).astype(np.int32))
+        counts.append(k)
+    return _DensePlan(nb, ub, items, bvals, row_starts, counts, scale,
+                      dup_u, dup_i, n_users, n_items)
 
 
 @partial(jax.jit, static_argnames=("ub", "n_items"))
-def _scatter_block(flat, vals, ub: int, n_items: int):
+def _scatter_block(items, vals, row_starts, k, ub: int, n_items: int):
     """One row-block of the densified rating matrix, scattered flat (1D):
     TPU lowers 1D sorted-unique scatters markedly better than 2D ones.
-    Padding cells index >= ub*n_items and are dropped."""
+    The flat cell ids are reconstructed ON DEVICE from the compact
+    (item, CSR row-starts) upload: a cumsum over row-boundary marks
+    yields each edge's local row. Positions past ``k`` (the padding) get
+    ascending out-of-range ids and are dropped by the scatter."""
+    m = items.shape[0]
+    marks = jnp.zeros((m,), jnp.int32)
+    # boundaries of trailing empty rows land at position k: harmlessly in
+    # the padding region when k < m, OUT of range (dropped) when k == m —
+    # mode="drop" is load-bearing for exactly-full blocks
+    marks = marks.at[row_starts[1:-1]].add(1, mode="drop")
+    row = jnp.cumsum(marks)
+    iota = jnp.arange(m, dtype=jnp.int32)
+    oor = ub * n_items
+    flat = jnp.where(
+        iota < k,
+        row * n_items + items.astype(jnp.int32),
+        oor + (iota - k),
+    )
     a = jnp.zeros((ub * n_items,), jnp.int8)
     return a.at[flat].set(
         vals, unique_indices=True, indices_are_sorted=True, mode="drop"
@@ -512,7 +538,9 @@ def prepare_device_inputs(plan: _DensePlan, pad_for_kernel: bool = False):
     neither dot."""
     blocks = tuple(
         _scatter_block(
-            jax.device_put(plan.flat[b]), jax.device_put(plan.vals[b]),
+            jax.device_put(plan.items[b]), jax.device_put(plan.vals[b]),
+            jax.device_put(plan.row_starts[b]),
+            jnp.int32(plan.counts[b]),
             ub=plan.ub, n_items=plan.n_items)
         for b in range(plan.nb)
     )
@@ -680,8 +708,11 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
 
     data_ax = NamedSharding(mesh, P("data", None))
     repl = NamedSharding(mesh, P())
-    flat = jax.device_put(np.stack(plan.flat), data_ax)  # [ndev, m]
+    items = jax.device_put(np.stack(plan.items), data_ax)  # [ndev, m]
     vals = jax.device_put(np.stack(plan.vals), data_ax)
+    row_starts = jax.device_put(np.stack(plan.row_starts), data_ax)
+    kcounts = jax.device_put(
+        np.asarray(plan.counts, np.int32), NamedSharding(mesh, P("data")))
     dup_u = dup_i = None
     if plan.dup_u is not None:
         dup_u = tuple(jax.device_put(x, repl) for x in (
@@ -707,12 +738,14 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     n_pairs = rank * (rank + 1) // 2
     ncols = n_pairs + rank + 1
 
-    def spmd_train(iters, flat_l, vals_l, uf_l, itf, du, di):
-        # flat_l/vals_l/uf_l: this device's [1, ...] shard; squeeze it.
-        # ``iters`` is a traced replicated scalar so the SAME compiled
-        # program serves the fused run (num_iterations) and the
+    def spmd_train(iters, items_l, vals_l, starts_l, k_l, uf_l, itf, du,
+                   di):
+        # items_l/vals_l/starts_l/uf_l: this device's [1, ...] shard;
+        # squeeze it. ``iters`` is a traced replicated scalar so the SAME
+        # compiled program serves the fused run (num_iterations) and the
         # per-iteration callback path (1 at a time).
-        a = _scatter_block(flat_l[0], vals_l[0], ub=ub, n_items=n_items)
+        a = _scatter_block(items_l[0], vals_l[0], starts_l[0], k_l[0],
+                           ub=ub, n_items=n_items)
         row0 = jax.lax.axis_index("data") * ub
 
         def corr_rows(dup, fixed, n_entities):
@@ -760,7 +793,7 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     shard_fn = jax.jit(jax.shard_map(
         spmd_train, mesh=mesh,
         in_specs=(P(), P("data", None), P("data", None), P("data", None),
-                  P(), P(), P()),
+                  P("data"), P("data", None), P(), P(), P()),
         out_specs=(P("data", None), P()),
         check_vma=False,
     ))
@@ -769,12 +802,13 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     # readable on every process of a multi-process mesh
     replicate_users = jax.jit(lambda u: u[:n_users], out_shardings=repl)
     if callback is None:
-        uf, itf = shard_fn(jnp.int32(p.num_iterations), flat, vals, uf0,
-                           itf0, dup_u, dup_i)
+        uf, itf = shard_fn(jnp.int32(p.num_iterations), items, vals,
+                           row_starts, kcounts, uf0, itf0, dup_u, dup_i)
     else:
         one = jnp.int32(1)
         uf, itf = uf0, itf0
         for it in range(p.num_iterations):
-            uf, itf = shard_fn(one, flat, vals, uf, itf, dup_u, dup_i)
+            uf, itf = shard_fn(one, items, vals, row_starts, kcounts, uf,
+                               itf, dup_u, dup_i)
             callback(it, replicate_users(uf), itf)
     return replicate_users(uf), itf
