@@ -45,3 +45,18 @@ def test_latest_capture_within_bands():
         pytest.skip("no bench capture checked in yet")
     violations = check_readme_bands(load_capture(path))
     assert not violations, f"{path}:\n" + "\n".join(violations)
+
+
+def test_legacy_key_fallback_checks_renamed_metrics():
+    """A renamed metric cannot escape its band against an old capture:
+    the checker falls back to the legacy key (r2/r3 continuity)."""
+    lo, hi = README_BANDS["two_tower_steady_steps_per_sec"]
+    violations = check_readme_bands(
+        {"two_tower_steps_per_sec": lo - 1})  # legacy name only, below band
+    assert any("two_tower_steady_steps_per_sec" in v for v in violations)
+    ok = check_readme_bands({"two_tower_steps_per_sec": (lo + hi) / 2})
+    assert not any("two_tower" in v for v in ok)
+
+
+def test_check_readme_skips_absent_metrics():
+    assert check_readme_bands({}) == []

@@ -365,3 +365,23 @@ class TestCLIVerbs:
         )
         assert main(["unregister"]) == 0
         assert memory_storage.get_meta_data_engine_manifests().get("e1", "1") is None
+
+
+def test_upgrade_migrate_requires_both_sources(capsys):
+    """pio upgrade --migrate-events without --from/--to-source exits 1
+    with a usable error instead of a traceback."""
+    from predictionio_tpu.tools.cli import main
+
+    rc = main(["upgrade", "--migrate-events", "--from-source", "A"])
+    assert rc == 1
+    assert "--to-source" in capsys.readouterr().err
+
+
+def test_upgrade_migrate_unknown_source_fails_cleanly(memory_storage,
+                                                     capsys):
+    from predictionio_tpu.tools.cli import main
+
+    rc = main(["upgrade", "--migrate-events", "--from-source", "NOPE",
+               "--to-source", "ALSO_NOPE"])
+    assert rc == 1
+    assert "migration failed" in capsys.readouterr().err
