@@ -356,9 +356,9 @@ def bench_two_tower(ctx) -> dict:
         "two_tower_examples_per_sec": round(steps * 4096 / dt, 0),
     }
 
-    # -- batch 16k (auto loss policy: dense logits, which fit v5e HBM
-    # and measured faster than the chunked CE at this size; the chunked
-    # path engages beyond 16k negatives — see two_tower._DENSE_LOGITS_MAX)
+    # -- batch 16k (auto loss policy selects the chunked CE here: it
+    # engages above 1024 negatives — two_tower._DENSE_LOGITS_MAX — and
+    # measured 84 vs 38 dense steps/s at this size, docs/perf.md §6)
     p16 = TwoTowerParams(batch_size=16384, steps=0, seed=0)
     b16 = ctx.pad_to_multiple(p16.batch_size)
     tx16, run16, _ = _get_trainer(ctx, p16, b16)
@@ -387,12 +387,17 @@ def bench_two_tower(ctx) -> dict:
 #: The performance bands README.md claims, as ``extra`` key → (lo, hi).
 #: SINGLE SOURCE OF TRUTH: tests/test_bench_readme.py asserts the README
 #: prose quotes exactly these endpoints (formatted ``{lo:g}-{hi:g}``) AND
-#: that the latest captured bench run falls inside every band it
-#: measured — round-3 review caught the README quietly drifting outside
-#: the captured values, which is exactly the kind of claim rot this
-#: check exists to fail loudly on.
+#: that every checked-in capture (the local latest.json AND the newest
+#: driver BENCH_r*.json) satisfies the band's CLAIM side — round-3/4
+#: review caught the README quietly drifting outside the captured
+#: values, which is exactly the kind of claim rot this check exists to
+#: fail loudly on. Containment is one-sided (round-4 review): throughput
+#: metrics enforce the FLOOR (``value >= lo`` — beating the top is good
+#: news, not a violation), latency metrics (_CEILING_BANDS) enforce the
+#: CEILING. The other endpoint is descriptive prose, kept in sync with
+#: observed runs by the quoting test + the band-refresh nudge in main().
 README_BANDS: dict[str, tuple[float, float]] = {
-    "ml20m_als_rank10_iterations_per_sec": (1.1, 3.2),
+    "ml20m_als_rank10_iterations_per_sec": (1.1, 3.4),
     "ml20m_rank10_steady_iter_per_sec": (24, 32),
     "ml100k_als_rank10_iter_per_sec": (95, 230),
     "ml20m_rank64_steady_iter_per_sec": (0.4, 1),
@@ -404,6 +409,9 @@ README_BANDS: dict[str, tuple[float, float]] = {
     "ingest_batch50_events_per_sec": (10000, 17000),
 }
 
+#: Bands whose claim is the UPPER endpoint (lower-is-better metrics).
+_CEILING_BANDS = {"serve_p50_ms"}
+
 #: Band key → the name older captures reported the same measurement
 #: under (r2/r3 continuity): the containment check falls back so a
 #: renamed metric cannot silently escape its band against an old capture.
@@ -412,42 +420,106 @@ _BAND_LEGACY_KEYS = {
 }
 
 
+def _band_value(extra: dict, key: str):
+    """The capture's value for a banded metric, falling back to the name
+    older captures used (_BAND_LEGACY_KEYS) — shared by the gate and the
+    refresh nudge so they judge the same value."""
+    val = extra.get(key)
+    if val is None:
+        val = extra.get(_BAND_LEGACY_KEYS.get(key, ""))
+    return val
+
+
 def check_readme_bands(extra: dict) -> list[str]:
     """Violation messages for every banded metric present in ``extra``
-    that falls outside its README band (absent keys are skipped: a
-    degraded section already reports itself via *_error)."""
+    that breaks its README claim (absent keys are skipped: a degraded
+    section already reports itself via *_error). One-sided: throughput
+    claims are floors, latency claims (_CEILING_BANDS) are ceilings —
+    a throughput run above the band top is an improvement, not a
+    violation (round-4 review: two-sided checks forced band-widening
+    every round, which is how regressions hid inside wide bands)."""
     out = []
     for key, (lo, hi) in README_BANDS.items():
-        val = extra.get(key)
-        if val is None:
-            val = extra.get(_BAND_LEGACY_KEYS.get(key, ""))
+        val = _band_value(extra, key)
         if val is None:
             continue
-        if not (lo <= float(val) <= hi):
+        if key in _CEILING_BANDS:
+            if float(val) > hi:
+                out.append(
+                    f"{key}={val} above README ceiling {hi:g}"
+                )
+        elif float(val) < lo:
             out.append(
-                f"{key}={val} outside README band {lo:g}-{hi:g}"
+                f"{key}={val} below README floor {lo:g}"
             )
     return out
 
 
-def latest_capture_path() -> str | None:
-    """Newest bench capture: bench_captures/latest.json (written by a
-    full non-degraded ``python bench.py`` run) if present, else the
-    highest-numbered driver BENCH_r*.json. Shared by --check-readme and
-    tests/test_bench_readme.py so the CLI and CI validate the SAME file."""
+def band_refresh_notes(extra: dict) -> list[str]:
+    """Non-fatal staleness nudges: throughput metrics beating their band
+    top by >15% (the README prose undersells the current build) and
+    latency metrics beating their floor by >15% (same). Printed by
+    main(); round-over-round moves >10% also deserve a sentence in
+    docs/perf.md (round-4 review: serve_qps -18% passed unremarked)."""
+    out = []
+    for key, (lo, hi) in README_BANDS.items():
+        val = _band_value(extra, key)
+        if val is None:
+            continue
+        if key in _CEILING_BANDS:
+            if float(val) < lo * 0.85:
+                out.append(
+                    f"{key}={val} well below README band {lo:g}-{hi:g}; "
+                    "consider refreshing the band")
+        elif float(val) > hi * 1.15:
+            out.append(
+                f"{key}={val} well above README band {lo:g}-{hi:g}; "
+                "consider refreshing the band")
+    return out
+
+
+def capture_paths() -> list[str]:
+    """Every capture the containment check validates: the local
+    bench_captures/latest.json (written by a full non-degraded TPU
+    ``python bench.py`` run, band violations included — round-4 review:
+    parking out-of-band runs elsewhere made the check green by
+    construction on the builder's machine) AND the highest-numbered
+    driver BENCH_r*.json (checked in, so a fresh clone validates the
+    same claims). Shared by --check-readme and
+    tests/test_bench_readme.py so the CLI and CI validate the SAME
+    files."""
     import glob
     import os
     import re
 
     here = os.path.dirname(os.path.abspath(__file__))
+    out = []
     latest = os.path.join(here, "bench_captures", "latest.json")
     if os.path.exists(latest):
-        return latest
+        out.append(latest)
     rounds = sorted(
         glob.glob(os.path.join(here, "BENCH_r*.json")),
         key=lambda p: int(re.search(r"_r(\d+)", os.path.basename(p)).group(1)),
     )
-    return rounds[-1] if rounds else None
+    if rounds:
+        out.append(rounds[-1])
+    return out
+
+
+def capture_file_name(extra: dict, degraded: bool) -> str:
+    """Where main() writes this run's capture. A healthy TPU run becomes
+    ``latest.json`` — the file the containment test validates — EVEN when
+    it violates bands: an out-of-band regression must be able to turn
+    the test red on the machine that produced it (round-4 review caught
+    the previous in-band-only write making the gate unfailable where it
+    runs). Degraded runs (errored sections) and non-TPU runs (README
+    bands are v5e claims; a CPU dev box would poison every later pytest)
+    park separately, uninspected by the gate."""
+    if degraded:
+        return "last-degraded.json"
+    if "tpu" not in str(extra.get("device", "")).lower():
+        return "last-offdevice.json"
+    return "latest.json"
 
 
 def load_capture(path: str) -> dict:
@@ -468,8 +540,7 @@ def _check_readme_cli(paths: list[str]) -> int:
     import sys
 
     if not paths:
-        latest = latest_capture_path()
-        paths = [latest] if latest else []
+        paths = capture_paths()
     if not paths:
         print("[bench] --check-readme: no captures found", file=sys.stderr)
         return 1
@@ -603,27 +674,31 @@ def main() -> None:
         "vs_baseline": round(ml20m_ips / baseline_iter_per_sec, 2),
         "extra": extra,
     }
-    violations = check_readme_bands(
-        {**extra, doc["metric"]: doc["value"]})
+    merged = {**extra, doc["metric"]: doc["value"]}
+    violations = check_readme_bands(merged)
+    cap_name = capture_file_name(extra, bool(extra.get("degraded_sections")))
     if violations:
         import sys as _sys
 
         extra["band_violations"] = violations
+        gated = (" (this run becomes latest.json, so the containment "
+                 "test will fail until it is resolved)"
+                 if cap_name == "latest.json" else
+                 f" (parked as {cap_name}: not gate-validated)")
         for v in violations:
-            print(f"[bench] WARNING: {v} — update README.md/README_BANDS "
-                  "or investigate the regression", file=_sys.stderr)
+            print(f"[bench] WARNING: {v} — investigate the regression"
+                  f"{gated}", file=_sys.stderr)
+    for note in band_refresh_notes(merged):
+        import sys as _sys
+
+        print(f"[bench] NOTE: {note}", file=_sys.stderr)
     try:
         import os as _os
 
         cap_dir = _os.path.join(
             _os.path.dirname(_os.path.abspath(__file__)), "bench_captures")
         _os.makedirs(cap_dir, exist_ok=True)
-        # a degraded or out-of-band run must not become the capture the
-        # containment test validates against (a CPU-only dev box would
-        # otherwise poison every later pytest run) — park it separately
-        healthy = not extra.get("degraded_sections") and not violations
-        name = "latest.json" if healthy else "last-degraded.json"
-        with open(_os.path.join(cap_dir, name), "w") as f:
+        with open(_os.path.join(cap_dir, cap_name), "w") as f:
             json.dump(doc, f, indent=1)
     except Exception:
         pass  # capture bookkeeping must never sink the bench output

@@ -139,19 +139,27 @@ def reset_measurements() -> None:
     _measurements.clear()
 
 
+import os as _os
+
 #: How long a raise-mode fallback stays cached before the probe is retried
-#: (transient tunnel blips self-heal); hang-mode fallbacks are permanent.
+#: (transient tunnel blips self-heal).
 _FALLBACK_TTL_S = 60.0
+#: How long a HANG-mode fallback stays cached. Long — each retry strands
+#: one blocked daemon thread — but not permanent: this environment's
+#: tunnel shows seconds-sized jitter, and one transient stall on an
+#: otherwise healthy accelerator must not forfeit accelerator serving
+#: for the process lifetime (round-4 advisory).
+_HANG_TTL_S = float(_os.environ.get("PIO_PROBE_HANG_TTL_S", "1800"))
 #: A probe blocked longer than this (a wedged runtime usually *hangs*
 #: rather than raises) is abandoned to its daemon thread.
-_PROBE_TIMEOUT_S = 10.0
+_PROBE_TIMEOUT_S = float(_os.environ.get("PIO_PROBE_TIMEOUT_S", "10"))
 
 
 class _Fallback:
     """Cached host-favoring value standing in for a failed measurement.
-    ``expires`` is a monotonic deadline after which the probe is retried,
-    or None for permanent (hang-mode failures: retrying would leak one
-    blocked daemon thread per retry)."""
+    ``expires`` is a monotonic deadline after which the probe is retried
+    (raise-mode: _FALLBACK_TTL_S; hang-mode: the much longer _HANG_TTL_S,
+    since each retry costs one stranded daemon thread)."""
 
     __slots__ = ("value", "expires")
 
@@ -196,8 +204,10 @@ def _measured_failsoft(key: str, fn, fallback: float) -> float:
     healthy — ref: core/.../workflow/CreateServer.scala:513-520).
     Raise-mode fallbacks expire after ``_FALLBACK_TTL_S`` so a transient
     blip at deploy time doesn't pin serving to the host for the process
-    lifetime; hang-mode (timeout) fallbacks are permanent because each
-    retry would strand another blocked daemon thread."""
+    lifetime; hang-mode (timeout) fallbacks get the longer ``_HANG_TTL_S``
+    because each retry strands another blocked daemon thread — but they
+    DO expire (a single tunnel stall must not cost accelerator serving
+    until restart). Both knobs take PIO_PROBE_* env overrides."""
 
     def fresh(val) -> bool:
         return val is not None and not (
@@ -222,14 +232,15 @@ def _measured_failsoft(key: str, fn, fallback: float) -> float:
             return res
         except Exception as exc:
             hang = isinstance(exc, TimeoutError)
+            ttl = _HANG_TTL_S if hang else _FALLBACK_TTL_S
             logger.warning(
                 "placement probe %r failed (%s: %s); caching host-favoring "
-                "fallback %r %s — serving stays on the host CPU backend",
-                key, type(exc).__name__, exc, fallback,
-                "permanently" if hang else f"for {_FALLBACK_TTL_S:.0f}s",
+                "fallback %r for %.0fs — serving stays on the host CPU "
+                "backend until the probe is retried",
+                key, type(exc).__name__, exc, fallback, ttl,
             )
-            expires = None if hang else time.monotonic() + _FALLBACK_TTL_S
-            _measurements[key] = _Fallback(fallback, expires)
+            _measurements[key] = _Fallback(
+                fallback, time.monotonic() + ttl)
             return fallback
 
 

@@ -238,6 +238,17 @@ def build_parser() -> argparse.ArgumentParser:
     p_up.add_argument("--app", help="migrate one app (default: all)")
     p_up.add_argument("--batch", type=int, default=500,
                       help="events per insert batch (default 500)")
+    p_up.add_argument(
+        "--from-prefix", default=None,
+        help="table prefix of the source store, INCLUDING the trailing "
+             "separator — a repository configured NAME=legacy uses "
+             "prefix 'legacy_' (default: the current EVENTDATA "
+             "repository's prefix)")
+    p_up.add_argument(
+        "--to-prefix", default=None,
+        help="table prefix of the target store, including the trailing "
+             "separator, e.g. 'legacy_' (default: the current EVENTDATA "
+             "repository's prefix)")
     p_up.set_defaults(func=cmd_upgrade)
 
     return parser
@@ -660,7 +671,8 @@ def cmd_upgrade(args) -> int:
         try:
             copied = migrate_events(
                 args.from_source, args.to_source,
-                app_name=args.app, batch_size=args.batch)
+                app_name=args.app, batch_size=args.batch,
+                from_prefix=args.from_prefix, to_prefix=args.to_prefix)
         except Exception as e:
             print(f"[ERROR] migration failed: {e}", file=sys.stderr)
             return 1

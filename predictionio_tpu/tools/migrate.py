@@ -42,11 +42,19 @@ def migrate_events(
     to_source: str,
     app_name: str | None = None,
     batch_size: int = 500,
+    from_prefix: str | None = None,
+    to_prefix: str | None = None,
 ) -> dict:
     """Copy events of one app (or every app) from ``from_source`` to
     ``to_source``. Returns per-app copied counts. The target tables are
     initialized first (``pio app new`` semantics); re-running upserts by
-    event id on id-preserving backends, so the migration is resumable."""
+    event id on id-preserving backends, so the migration is resumable.
+
+    ``from_prefix``/``to_prefix`` override the table-name prefix on
+    either endpoint (both default to the current EVENTDATA repository's
+    prefix — a from-source whose data was written under a *different*
+    repository prefix would otherwise silently migrate 0 events,
+    round-4 advisory)."""
     from predictionio_tpu.data.storage.base import StorageError
 
     if from_source == to_source:
@@ -62,8 +70,8 @@ def migrate_events(
         apps = [app]
     else:
         apps = apps_dao.get_all()
-    src = Storage.events_for_source(from_source)
-    dst = Storage.events_for_source(to_source)
+    src = Storage.events_for_source(from_source, prefix=from_prefix)
+    dst = Storage.events_for_source(to_source, prefix=to_prefix)
     copied: dict = {}
     for app in apps:
         channel_ids = [None] + [
@@ -93,4 +101,15 @@ def migrate_events(
         logger.info(
             "migrated %d events of app %r (%d channel(s)) %s -> %s",
             total, app.name, len(channel_ids), from_source, to_source)
+    if copied and not any(copied.values()):
+        # easy to misread as "the store was empty": the usual cause is a
+        # from-source written under a different table prefix than the
+        # current EVENTDATA repository's (round-4 advisory)
+        logger.warning(
+            "migration copied 0 events for every app — if %r should hold "
+            "data, its tables may use a different prefix; pass "
+            "--from-prefix (current: %r)",
+            from_source,
+            from_prefix if from_prefix is not None
+            else Storage.instance().repositories["EVENTDATA"].prefix)
     return copied

@@ -1,16 +1,23 @@
 """README performance claims stay containment-true (round-3 review:
-README bands had drifted outside the captured bench values).
+README bands had drifted outside the captured bench values; round-4
+review: the gate could not fail where it ran, because out-of-band runs
+were parked away from the validated path).
 
-Two invariants, both anchored on bench.README_BANDS as the single source
-of truth:
+Invariants, all anchored on bench.README_BANDS as the single source of
+truth:
 
 1. The README prose quotes exactly the band endpoints (``{lo:g}-{hi:g}``)
    for every banded metric — the dict and the document cannot drift
    apart silently.
-2. The latest capture (bench_captures/latest.json written by a healthy
-   full ``python bench.py`` run, else the highest-numbered driver
-   BENCH_r*.json — resolved by bench.latest_capture_path, the same
-   helper ``--check-readme`` uses) falls inside every band it measured.
+2. EVERY capture bench.capture_paths() resolves — the local
+   bench_captures/latest.json (which bench.py writes for every healthy
+   TPU run, band violations included) AND the newest checked-in driver
+   BENCH_r*.json — satisfies each band's claim side (floor for
+   throughput, ceiling for latency).
+3. The gate can actually fail: a deliberately stale floor produces a
+   violation against the same captures (so does an out-of-band capture
+   against the real bands), and bench.py routes healthy TPU runs to
+   latest.json regardless of violations.
 """
 
 import sys
@@ -21,8 +28,10 @@ sys.path.insert(0, str(ROOT))
 
 from bench import (  # noqa: E402
     README_BANDS,
+    _CEILING_BANDS,
+    capture_file_name,
+    capture_paths,
     check_readme_bands,
-    latest_capture_path,
     load_capture,
 )
 
@@ -37,14 +46,82 @@ def test_readme_quotes_band_endpoints():
     assert not missing, "\n".join(missing)
 
 
-def test_latest_capture_within_bands():
-    path = latest_capture_path()
-    if path is None:
+def test_all_captures_within_bands():
+    paths = capture_paths()
+    if not paths:
         import pytest
 
         pytest.skip("no bench capture checked in yet")
-    violations = check_readme_bands(load_capture(path))
-    assert not violations, f"{path}:\n" + "\n".join(violations)
+    failures = []
+    for path in paths:
+        for v in check_readme_bands(load_capture(path)):
+            failures.append(f"{path}: {v}")
+    assert not failures, "\n".join(failures)
+
+
+def test_stale_band_turns_the_gate_red():
+    """The containment gate must be able to fail: raising every floor
+    above any plausible measurement (and dropping every ceiling below
+    one) must produce violations against every checked-in capture —
+    i.e. the gate is exercised by real data, not green by construction."""
+    paths = capture_paths()
+    if not paths:
+        import pytest
+
+        pytest.skip("no bench capture checked in yet")
+    stale = {
+        key: ((1e12, 1e13) if key not in _CEILING_BANDS else (0.0, 1e-12))
+        for key in README_BANDS
+    }
+    import bench
+
+    orig = bench.README_BANDS
+    bench.README_BANDS = stale
+    try:
+        for path in paths:
+            extra = load_capture(path)
+            measured = [
+                k for k in stale
+                if extra.get(k) is not None
+                or extra.get(bench._BAND_LEGACY_KEYS.get(k, "")) is not None
+            ]
+            violations = bench.check_readme_bands(extra)
+            assert len(violations) == len(measured), (
+                f"{path}: stale bands produced {len(violations)} "
+                f"violations for {len(measured)} measured metrics"
+            )
+    finally:
+        bench.README_BANDS = orig
+
+
+def test_violating_run_still_becomes_latest_capture():
+    """bench.py must write an out-of-band (but healthy, on-device) run to
+    latest.json — the file this suite validates — so a regression turns
+    the gate red on the machine that produced it."""
+    extra_tpu = {"device": "TPU v5 lite"}
+    assert capture_file_name(extra_tpu, degraded=False) == "latest.json"
+    # degraded runs and off-device runs park away from the gate
+    assert capture_file_name(extra_tpu, degraded=True) == "last-degraded.json"
+    assert (
+        capture_file_name({"device": "cpu"}, degraded=False)
+        == "last-offdevice.json"
+    )
+
+
+def test_floor_and_ceiling_sense():
+    """Throughput bands are floors (above-top is NOT a violation);
+    latency bands are ceilings (below-floor is NOT a violation)."""
+    lo, hi = README_BANDS["serve_qps"]
+    assert check_readme_bands({"serve_qps": hi * 10}) == []
+    assert any(
+        "serve_qps" in v for v in check_readme_bands({"serve_qps": lo / 2})
+    )
+    lo, hi = README_BANDS["serve_p50_ms"]
+    assert check_readme_bands({"serve_p50_ms": lo / 10}) == []
+    assert any(
+        "serve_p50_ms" in v
+        for v in check_readme_bands({"serve_p50_ms": hi * 2})
+    )
 
 
 def test_legacy_key_fallback_checks_renamed_metrics():

@@ -112,21 +112,28 @@ def test_probe_fallback_expires_and_reprobes(monkeypatch):
     assert calls["n"] == 2
 
 
-def test_probe_hang_times_out_and_pins_permanently(monkeypatch):
+def test_probe_hang_times_out_with_long_ttl(monkeypatch):
     """A probe that *blocks* (the common wedge mode: device_put/readback
     hang rather than raise) must not deadlock serving behind the measure
-    lock — it times out to the fallback, permanently (each retry would
-    strand another blocked daemon thread)."""
+    lock — it times out to the fallback with the LONG hang TTL (each
+    retry strands a daemon thread, so it outlives the raise-mode TTL),
+    but it is not a process-lifetime pin: after _HANG_TTL_S the probe
+    retries and a recovered accelerator wins back serving (round-4
+    advisory: one transient tunnel stall must not forfeit the
+    accelerator until restart)."""
     import threading
     import time
 
     monkeypatch.setattr(placement, "_PROBE_TIMEOUT_S", 0.1)
     monkeypatch.setattr(placement, "_FALLBACK_TTL_S", 0.0)
+    monkeypatch.setattr(placement, "_HANG_TTL_S", 0.3)
     release = threading.Event()
     calls = {"n": 0}
 
     def hang():
         calls["n"] += 1
+        if calls["n"] > 1:
+            return 0.001  # the accelerator recovered
         release.wait(5)
         return 0.001
 
@@ -134,9 +141,12 @@ def test_probe_hang_times_out_and_pins_permanently(monkeypatch):
     t0 = time.perf_counter()
     assert placement.link_rtt() == float("inf")
     assert time.perf_counter() - t0 < 2.0  # degraded, not deadlocked
-    time.sleep(0.01)  # TTL=0: a raise-mode fallback would now re-probe...
+    time.sleep(0.01)  # raise-mode TTL(0) elapsed, hang TTL has not...
     assert placement.link_rtt() == float("inf")
-    assert calls["n"] == 1  # ...but hang-mode is pinned: no second thread
+    assert calls["n"] == 1  # ...no second thread inside the hang TTL
+    time.sleep(0.35)  # hang TTL elapsed
+    assert placement.link_rtt() == 0.001  # re-probe won back the device
+    assert calls["n"] == 2
     release.set()
 
 
