@@ -271,14 +271,15 @@ def _steady_rate_dense(ctx, ui, ii, r, n_users, n_items, rank, iters,
         return None
     kernel = als_dense.use_kernel()
     plan = als_dense._dense_prepare(ui, ii, r, n_users, n_items)
+    merged = als_dense.should_merge(plan, kernel)
     blocks, dup_u, dup_i = als_dense.prepare_device_inputs(
-        plan, pad_for_kernel=kernel)
+        plan, pad_for_kernel=kernel, merge=merged)
     p = ALSParams(rank=rank, num_iterations=iters, seed=0)
     ku, ki = jax.random.split(jax.random.PRNGKey(0))
     uf = _init_factors(ku, n_users, rank)
     itf = _init_factors(ki, n_items, rank)
     static = dict(implicit=False, rank=rank, scale=plan.scale,
-                  ub=plan.ub, kernel=kernel)
+                  ub=als_dense.merged_ub(plan, merged), kernel=kernel)
     args = (dup_u, dup_i, p.lambda_, p.alpha)
 
     def run(uf, itf, n):

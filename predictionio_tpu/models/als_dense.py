@@ -317,6 +317,44 @@ def _pairs_payload(f, rank: int):
         axis=1)
 
 
+#: Payload width (columns of the PSD-critical dot) above which the
+#: explicit 2-term bf16 split replaces XLA's HIGHEST mixed dot. Measured
+#: round 5 (v5e, ML-20M rank 64, 2081-column payload): XLA emits a
+#: 3-pass emulation for bf16 x f32 @ HIGHEST when several such dots
+#: share a program (~246 ms for the 4-block phase), while the explicit
+#: split is exactly 2 bf16-rate passes (~134-167 ms) AND more accurate
+#: (err/scale 8.7e-9 vs HIGHEST's 3.1e-8 against float64). At narrow
+#: payloads (rank 10: 56 columns) the dots are memory-bound and the
+#: difference is under tunnel-measurement noise, so HIGHEST keeps the
+#: round-3/4 behavior there.
+_PSD_SPLIT_MIN_COLS = 256
+
+
+def _psd_split(rank: int) -> bool:
+    """Whether the PSD-critical dot uses the explicit 2-term split.
+    ``PIO_DENSE_PSD_DOT``: auto (width policy), split, highest."""
+    import os
+
+    mode = os.environ.get("PIO_DENSE_PSD_DOT", "auto")
+    if mode == "split":
+        return True
+    if mode == "highest":
+        return False
+    return rank * (rank + 1) // 2 + 1 >= _PSD_SPLIT_MIN_COLS
+
+
+def _split2(x):
+    """f32 -> (hi, lo) bf16 terms with hi + lo ~ x to ~2^-17 relative:
+    the explicit two-pass emulation of a HIGHEST mixed dot. The
+    optimization_barrier is load-bearing: XLA:TPU otherwise folds
+    ``x - bf16(x)`` to literal zero (it treats the f32->bf16->f32 round
+    trip as value-preserving), silently degrading the split to one
+    default-precision pass — measured round 5."""
+    hi = jax.lax.optimization_barrier(x.astype(jnp.bfloat16))
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
 def use_kernel() -> bool:
     """Whether the dense half-steps run the fused Pallas dual-dot kernel
     (ops/dense_dots.py) instead of two XLA dots. ``PIO_DENSE_KERNEL``:
@@ -338,16 +376,24 @@ def use_kernel() -> bool:
     return False
 
 
-def _make_dots(implicit: bool, exact: bool, kernel: bool = False):
+def _make_dots(implicit: bool, exact: bool, kernel: bool = False,
+               rank: int | None = None):
     """The pair of payload matmuls of one half-step, with the precision
     placement both solver paths must share: bf16 left operands are EXACT
     (0/1 and |scaled rating| <= 127 are all bf16-representable), and the
-    dot whose payload carries the gram PAIRS must run at HIGHEST (see
+    dot whose payload carries the gram PAIRS must be f32-faithful (see
     _pairs_payload's numerical contract) — the indicator dot in explicit
     mode, the value dot in implicit mode. The other dot only feeds rhs
-    (and exactly-representable counts), where bf16-payload rounding is
-    the same accepted error class as the bucket solver's bf16 gather —
-    relaxed unless the caller asked for the f32 parity mode.
+    (and exactly-representable counts: f32 accumulation keeps integer
+    sums exact), where bf16-payload rounding is the same accepted error
+    class as the bucket solver's bf16 gather — relaxed unless the caller
+    asked for the f32 parity mode.
+
+    The f32-faithful dot has two implementations, chosen by payload
+    width (``rank``, see _PSD_SPLIT_MIN_COLS): XLA's HIGHEST mixed dot
+    at narrow payloads, the explicit 2-term bf16 split (_split2) at wide
+    ones, where HIGHEST's emulation spends 3 MXU passes and the split
+    spends exactly 2 with better accuracy (round-5 measurement).
 
     ``kernel=True`` routes both dots through the fused Pallas kernel:
     one pass over the int8 block feeds both operand views, and the
@@ -367,6 +413,30 @@ def _make_dots(implicit: bool, exact: bool, kernel: bool = False):
             return fused_dual_dot(
                 a, ip, vp, contract_rows=dims == ((0,), (0,)),
                 splits_ind=si, splits_val=sv, interpret=interp)
+
+        return dots
+
+    if not exact and rank is not None and _psd_split(rank):
+        def dots(a, ip, vp, dims):
+            ai = (a != 0).astype(jnp.bfloat16)
+            av = a.astype(jnp.bfloat16)
+
+            def faithful(lhs, payload):
+                out = 0.0
+                for t in _split2(payload):
+                    out = out + jax.lax.dot_general(
+                        lhs, t, (dims, ((), ())),
+                        preferred_element_type=jnp.float32)
+                return out
+
+            def relaxed(lhs, payload):
+                return jax.lax.dot_general(
+                    lhs, payload.astype(jnp.bfloat16), (dims, ((), ())),
+                    preferred_element_type=jnp.float32)
+
+            if implicit:
+                return relaxed(ai, ip), faithful(av, vp)
+            return faithful(ai, ip), relaxed(av, vp)
 
         return dots
 
@@ -427,7 +497,7 @@ def _dense_half_solve(
     and outputs sliced back."""
     n = prev.shape[0]
     ind_payload, val_payload = _local_half_inputs(fixed, rank, implicit)
-    dots = _make_dots(implicit, exact, kernel)
+    dots = _make_dots(implicit, exact, kernel, rank)
 
     if blocks is not None:
         n_other = ind_payload.shape[0]
@@ -528,22 +598,56 @@ def _dense_iteration(
         rank, scale, ub, exact, kernel)
 
 
-def prepare_device_inputs(plan: _DensePlan, pad_for_kernel: bool = False):
+#: Merged-A gate: concatenating the row blocks into ONE [nb*ub, n_items]
+#: array needs headroom for the in-place build (the full array plus one
+#: block's scatter transient); past this many cells the per-block layout
+#: is kept. ML-20M (3.7e9 cells) merges.
+_MERGE_MAX_CELLS = 4_500_000_000
+
+
+@partial(jax.jit, static_argnames=("ub", "n_items"),
+         donate_argnums=(4,))
+def _place_block(items, vals, row_starts, k, acc, b: int, ub: int,
+                 n_items: int):
+    """Scatter one block and write it into the merged A at row b*ub —
+    donation makes the update in place, so the peak transient stays one
+    block (the reason blocks exist at all: XLA promotes int8 scatter
+    operands internally, and a whole-A scatter would blow HBM)."""
+    a = _scatter_block(items, vals, row_starts, k, ub=ub, n_items=n_items)
+    return jax.lax.dynamic_update_slice(acc, a, (b * ub, 0))
+
+
+def prepare_device_inputs(plan: _DensePlan, pad_for_kernel: bool = False,
+                          merge: bool = False):
     """(blocks, dup_u, dup_i) device arrays from a host plan — the
     scatter-densified int8 row blocks plus the correction-cell arrays.
     Shared by train_dense and bench.py's steady-state timer so both time
     the same program. ``pad_for_kernel`` zero-pads each block to the
     Pallas tile grid (both dims to PAD_MULTIPLE, since either dim can be
     the contraction) — done once per train, and zero cells contribute to
-    neither dot."""
-    blocks = tuple(
-        _scatter_block(
-            jax.device_put(plan.items[b]), jax.device_put(plan.vals[b]),
-            jax.device_put(plan.row_starts[b]),
-            jnp.int32(plan.counts[b]),
-            ub=plan.ub, n_items=plan.n_items)
-        for b in range(plan.nb)
-    )
+    neither dot. ``merge`` returns ONE [nb*ub, n_items] array (a 1-tuple)
+    instead of nb row blocks: each half-step's payload matmuls then run
+    as a single dot pair, which measured ~20% faster than four per-block
+    dot pairs at rank 64 (round 5) and shrinks the program; callers must
+    treat the plan's row count as ``nb * ub`` (see merged_ub)."""
+    if merge and plan.nb > 1 and not pad_for_kernel:
+        acc = jnp.zeros((plan.nb * plan.ub, plan.n_items), jnp.int8)
+        for b in range(plan.nb):
+            acc = _place_block(
+                jax.device_put(plan.items[b]), jax.device_put(plan.vals[b]),
+                jax.device_put(plan.row_starts[b]),
+                jnp.int32(plan.counts[b]), acc, b,
+                ub=plan.ub, n_items=plan.n_items)
+        blocks = (acc,)
+    else:
+        blocks = tuple(
+            _scatter_block(
+                jax.device_put(plan.items[b]), jax.device_put(plan.vals[b]),
+                jax.device_put(plan.row_starts[b]),
+                jnp.int32(plan.counts[b]),
+                ub=plan.ub, n_items=plan.n_items)
+            for b in range(plan.nb)
+        )
     if pad_for_kernel:
         from predictionio_tpu.ops.dense_dots import PAD_MULTIPLE
 
@@ -566,6 +670,21 @@ def prepare_device_inputs(plan: _DensePlan, pad_for_kernel: bool = False):
     return blocks, dup_u, dup_i
 
 
+def should_merge(plan: _DensePlan, kernel: bool) -> bool:
+    """Single-device merge policy: one dot pair per half-step unless the
+    kernel path (per-block tile padding) or the in-place build headroom
+    (_MERGE_MAX_CELLS) says otherwise. Shared by train_dense and bench's
+    steady timer so both run the same program."""
+    return (not kernel and plan.nb > 1
+            and plan.nb * plan.ub * plan.n_items <= _MERGE_MAX_CELLS)
+
+
+def merged_ub(plan: _DensePlan, merged: bool) -> int:
+    """Rows-per-block the solver should assume: the whole padded row
+    count when the blocks were merged into one."""
+    return plan.nb * plan.ub if merged else plan.ub
+
+
 def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                 callback=None):
     """Driver: prepare + densify + train. Returns (user_f, item_f) as
@@ -586,14 +705,17 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
     user_f = _init_factors(ku, n_users, p.rank)
     item_f = _init_factors(ki, n_items, p.rank)
     kernel = use_kernel()
+    merged = should_merge(plan, kernel)
     blocks, dup_u, dup_i = prepare_device_inputs(
-        plan, pad_for_kernel=kernel)
+        plan, pad_for_kernel=kernel, merge=merged)
 
     # gather_dtype="float32" is the parity-study mode: every dot at
-    # HIGHEST. The default runs the gram-pairs dot at HIGHEST (a PSD
-    # requirement, see _pairs_payload) and the rhs dot relaxed.
+    # HIGHEST. The default runs the gram-pairs dot f32-faithfully
+    # (HIGHEST or explicit split — see _make_dots) and the rhs dot
+    # relaxed.
     static = dict(implicit=p.implicit_prefs, rank=p.rank, scale=plan.scale,
-                  ub=plan.ub, exact=p.gather_dtype == "float32",
+                  ub=merged_ub(plan, merged),
+                  exact=p.gather_dtype == "float32",
                   kernel=kernel)
     if callback is None:
         user_f, item_f = _dense_train(
@@ -734,7 +856,7 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
 
     rank, implicit, sc = p.rank, p.implicit_prefs, plan.scale
     exact = p.gather_dtype == "float32"
-    dots = _make_dots(implicit, exact)
+    dots = _make_dots(implicit, exact, rank=rank)
     n_pairs = rank * (rank + 1) // 2
     ncols = n_pairs + rank + 1
 

@@ -139,7 +139,21 @@ def reset_measurements() -> None:
     _measurements.clear()
 
 
-import os as _os
+def _env_seconds(name: str, default: float) -> float:
+    """Env override parsed fail-soft: this module's contract is to
+    degrade, never crash — a malformed value (e.g. '30m') falls back to
+    the default with a warning instead of a ValueError at import."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning(
+            "ignoring malformed %s=%r (want seconds as a number); "
+            "using default %.0fs", name, raw, default)
+        return default
+
 
 #: How long a raise-mode fallback stays cached before the probe is retried
 #: (transient tunnel blips self-heal).
@@ -149,10 +163,10 @@ _FALLBACK_TTL_S = 60.0
 #: tunnel shows seconds-sized jitter, and one transient stall on an
 #: otherwise healthy accelerator must not forfeit accelerator serving
 #: for the process lifetime (round-4 advisory).
-_HANG_TTL_S = float(_os.environ.get("PIO_PROBE_HANG_TTL_S", "1800"))
+_HANG_TTL_S = _env_seconds("PIO_PROBE_HANG_TTL_S", 1800.0)
 #: A probe blocked longer than this (a wedged runtime usually *hangs*
 #: rather than raises) is abandoned to its daemon thread.
-_PROBE_TIMEOUT_S = float(_os.environ.get("PIO_PROBE_TIMEOUT_S", "10"))
+_PROBE_TIMEOUT_S = _env_seconds("PIO_PROBE_TIMEOUT_S", 10.0)
 
 
 class _Fallback:

@@ -58,7 +58,21 @@ def migrate_events(
     from predictionio_tpu.data.storage.base import StorageError
 
     if from_source == to_source:
-        raise ValueError("--from-source and --to-source are the same")
+        # same source is legitimate when the endpoints use different
+        # table prefixes (migrating a legacy-prefixed store in place —
+        # the scenario --from-prefix/--to-prefix exist for); only the
+        # same source AND same effective prefix is a no-op copy onto
+        # itself
+        default_prefix = Storage.instance().repositories[
+            "EVENTDATA"].prefix
+        eff_from = from_prefix if from_prefix is not None else default_prefix
+        eff_to = to_prefix if to_prefix is not None else default_prefix
+        if eff_from == eff_to:
+            raise ValueError(
+                "--from-source and --to-source are the same store "
+                "(same source and same table prefix); pass "
+                "--from-prefix/--to-prefix to migrate between prefixes "
+                "within one source")
     if batch_size < 1:
         raise ValueError(f"batch_size must be >= 1, got {batch_size}")
     apps_dao = Storage.get_meta_data_apps()
