@@ -29,6 +29,7 @@ entity's rating count.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -1057,8 +1058,11 @@ class ALS:
                 user_f, item_f = als_dense.train_dense(
                     ctx, p, user_idx, item_idx, ratings, n_users, n_items,
                     callback)
+                t0 = time.perf_counter()
                 packed = np.asarray(
                     jnp.concatenate([user_f, item_f], axis=0))
+                als_dense.last_train_phases["readback_s"] = round(
+                    time.perf_counter() - t0, 3)
                 return ALSFactors(packed[:n_users], packed[n_users:])
 
         multi = ctx.mesh.devices.size > 1

@@ -685,38 +685,145 @@ def merged_ub(plan: _DensePlan, merged: bool) -> int:
     return plan.nb * plan.ub if merged else plan.ub
 
 
+#: Phase seconds of the most recent train_dense call, for bench/ops
+#: reporting: fingerprint_s, prepare_s, upload_densify_s, solve_s,
+#: cache_hit (ALS.train adds readback_s for the dense path). The device
+#: phases are sync-accurate only under PIO_DENSE_PHASE_TIMING=1 (each
+#: sync costs one ~100ms tunnel RTT, so the default records host-side
+#: enqueue times and lumps device time into the caller's readback).
+last_train_phases: dict = {}
+
+#: One-entry cache of the densified device inputs, keyed by a content
+#: fingerprint of the COO (ref: the reference's train path never
+#: re-reads what it already staged — CoreWorkflow.scala:42-99). A is
+#: constant across iterations AND across trains on the same ratings, so
+#: a retrain (deploy-time retrain, hyperparameter sweeps, repeated
+#:  bench trains) pays host sort + COO upload + densify exactly once.
+#: The entry pins ~bytes(A) of HBM between trains; clear_dense_cache()
+#: releases it, and any new fingerprint evicts the old entry.
+_A_CACHE: dict = {}
+
+
+def clear_dense_cache() -> None:
+    """Drop the cached densified inputs (frees the device A)."""
+    _A_CACHE.clear()
+
+
+def _cache_enabled() -> bool:
+    import os
+
+    return os.environ.get("PIO_DENSE_CACHE", "1") != "0"
+
+
+def _fingerprint(ui, ii, ratings, n_users: int, n_items: int,
+                 kernel: bool) -> str:
+    """Content hash of everything the device inputs derive from. blake2b
+    streams the 240 MB ML-20M COO at ~760 MB/s on this host — ~0.3 s to
+    skip ~7 s of sort + upload + densify on a hit."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    for a in (ui, ii, ratings):
+        h.update(np.ascontiguousarray(a))
+    h.update(repr((n_users, n_items, len(ratings), kernel,
+                   jax.default_backend())).encode())
+    return h.hexdigest()
+
+
+def _phase_sync(x) -> None:
+    """Tiny readback that orders a phase boundary for timing — only under
+    PIO_DENSE_PHASE_TIMING (block_until_ready does not block through
+    this environment's TPU tunnel; a 4-element fetch does)."""
+    np.asarray(jax.device_get(jnp.ravel(x)[:4]))
+
+
+def acquire_device_inputs(ui, ii, ratings, n_users: int, n_items: int,
+                          phases: dict | None = None) -> dict:
+    """Cache-aware densified device inputs: fingerprint + (prepare +
+    upload + densify | cache hit). Returns the entry dict
+    (blocks/dup_u/dup_i/scale/ub/nb/nd) — shared by train_dense and
+    bench.py's steady timer so the bench never rebuilds (or double-pins)
+    an A the cache already holds."""
+    import os
+    import time
+
+    if phases is None:
+        phases = {}
+    sync_timing = os.environ.get("PIO_DENSE_PHASE_TIMING") == "1"
+    kernel = use_kernel()
+    entry = None
+    key = None
+    if _cache_enabled():
+        t0 = time.perf_counter()
+        key = _fingerprint(ui, ii, ratings, n_users, n_items, kernel)
+        phases["fingerprint_s"] = round(time.perf_counter() - t0, 3)
+        entry = _A_CACHE.get(key)
+    phases["cache_hit"] = entry is not None
+
+    if entry is None:
+        t0 = time.perf_counter()
+        plan = _dense_prepare(ui, ii, ratings, n_users, n_items)
+        phases["prepare_s"] = round(time.perf_counter() - t0, 3)
+        merged = should_merge(plan, kernel)
+        t0 = time.perf_counter()
+        blocks, dup_u, dup_i = prepare_device_inputs(
+            plan, pad_for_kernel=kernel, merge=merged)
+        if sync_timing:
+            _phase_sync(blocks[0])
+        phases["upload_densify_s"] = round(time.perf_counter() - t0, 3)
+        nd = 0 if plan.dup_u is None else len(plan.dup_u.seg)
+        entry = dict(blocks=blocks, dup_u=dup_u, dup_i=dup_i,
+                     scale=plan.scale, ub=merged_ub(plan, merged),
+                     nb=plan.nb, nd=nd)
+        if key is not None:
+            _A_CACHE.clear()  # one entry: evict before pinning a new A
+            _A_CACHE[key] = entry
+        logger.info(
+            "ALS(dense): %d ratings -> %d x %d int8 cells in %d blocks"
+            "%s, %d correction cells, scale %d, dots=%s",
+            len(ratings), n_users, n_items, plan.nb,
+            " (merged)" if merged else "", nd, plan.scale,
+            "pallas" if kernel else "xla")
+    else:
+        logger.info(
+            "ALS(dense): cache hit — reusing densified %d x %d device "
+            "inputs (fingerprint %s)", n_users, n_items, key[:12])
+    return entry
+
+
 def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                 callback=None):
-    """Driver: prepare + densify + train. Returns (user_f, item_f) as
-    device arrays; models/als.ALS.train wraps this."""
+    """Driver: fingerprint + (prepare + densify | cache hit) + train.
+    Returns (user_f, item_f) as device arrays; models/als.ALS.train
+    wraps this."""
+    import time
+
     from predictionio_tpu.models.als import _init_factors
 
     p = params
-    plan = _dense_prepare(ui, ii, ratings, n_users, n_items)
-    nd = 0 if plan.dup_u is None else len(plan.dup_u.seg)
-    logger.info(
-        "ALS(dense): %d ratings -> %d x %d int8 cells in %d blocks, "
-        "%d correction cells, scale %d, rank %d, dots=%s",
-        len(ratings), n_users, n_items, plan.nb, nd, plan.scale, p.rank,
-        "pallas" if use_kernel() else "xla")
+    phases: dict = {}
+    import os
 
-    key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
-    ku, ki = jax.random.split(key)
+    sync_timing = os.environ.get("PIO_DENSE_PHASE_TIMING") == "1"
+    kernel = use_kernel()
+    entry = acquire_device_inputs(ui, ii, ratings, n_users, n_items,
+                                  phases=phases)
+
+    prng = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+    ku, ki = jax.random.split(prng)
     user_f = _init_factors(ku, n_users, p.rank)
     item_f = _init_factors(ki, n_items, p.rank)
-    kernel = use_kernel()
-    merged = should_merge(plan, kernel)
-    blocks, dup_u, dup_i = prepare_device_inputs(
-        plan, pad_for_kernel=kernel, merge=merged)
+    blocks, dup_u, dup_i = entry["blocks"], entry["dup_u"], entry["dup_i"]
 
     # gather_dtype="float32" is the parity-study mode: every dot at
     # HIGHEST. The default runs the gram-pairs dot f32-faithfully
     # (HIGHEST or explicit split — see _make_dots) and the rhs dot
     # relaxed.
-    static = dict(implicit=p.implicit_prefs, rank=p.rank, scale=plan.scale,
-                  ub=merged_ub(plan, merged),
+    static = dict(implicit=p.implicit_prefs, rank=p.rank,
+                  scale=entry["scale"], ub=entry["ub"],
                   exact=p.gather_dtype == "float32",
                   kernel=kernel)
+    t0 = time.perf_counter()
     if callback is None:
         user_f, item_f = _dense_train(
             user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
@@ -727,6 +834,11 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                 user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
                 **static)
             callback(it, user_f, item_f)
+    if sync_timing:
+        _phase_sync(user_f)
+    phases["solve_s"] = round(time.perf_counter() - t0, 3)
+    global last_train_phases
+    last_train_phases = phases
     return user_f, item_f
 
 

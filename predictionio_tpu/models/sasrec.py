@@ -16,15 +16,18 @@ Design notes (TPU-first):
   (dp — GSPMD inserts the gradient all-reduce).
 - serving scores are one matmul of the last hidden state against the item
   embedding table + ``lax.top_k`` (same shape as the ALS serving path).
-- the serving forward routes attention by ``attn_impl``: ``"mha"`` (XLA
+- the forward routes attention by ``attn_impl``: ``"mha"`` (XLA
   reference), ``"flash"`` (pallas blockwise kernel — long histories on one
   chip), ``"ring"`` (sequence-parallel ring over a ``seq`` mesh axis —
   histories beyond one device's HBM), or ``"auto"`` (flash on TPU once the
-  history window is at least one MXU tile, else mha). Sequences are
+  history window is at least one MXU tile for serving / once the O(L²)
+  score matrix dominates HBM for training, else mha). Sequences are
   left-padded, so padding enters all three paths as a ``kv_start`` valid-key
-  window bound. Training always uses the mha path (the pallas kernel
-  defines no VJP); the choice is numerically transparent — all paths share
-  one masking semantics (tests/test_sasrec.py parity tests).
+  window bound. Since round 5 every path is differentiable — the flash
+  kernel carries a recompute-from-lse custom VJP and the ring path's
+  ppermute scan transposes — so long-history TRAINING routes through
+  flash/ring too; the choice is numerically transparent — all paths share
+  one masking semantics (tests/test_sasrec.py parity + grad-parity tests).
 """
 
 from __future__ import annotations
@@ -108,18 +111,23 @@ def _flash_block(l: int) -> int:
 
 
 def _resolve_attn(p: SASRecParams, *, serving: bool, l: int) -> str:
-    """Pick the attention path for this call. Training always gets the
-    differentiable mha reference (the pallas kernel defines no VJP and the
-    ring path needs a sharded batch); serving honors ``attn_impl``, with
-    ``auto`` = flash on TPU once the window is at least one MXU tile."""
+    """Pick the attention path for this call. Every impl is usable for
+    BOTH training and serving since round 5 (the pallas flash kernel
+    grew a custom VJP; the ring path's ppermute scan was always
+    differentiable). ``auto`` = flash on TPU once the window is at
+    least one MXU tile for serving, and once the O(L²) score
+    activations stop fitting HBM comfortably for training — measured
+    crossover on the v5e (B=8-16, d=64, 2 blocks): mha wins to L=4096
+    (7.7 vs 17.1 ms/step at 2048, 25 vs 42 at 4096), flash wins 5.5x
+    at L=8192 (178 vs 981 ms/step), so the training threshold is
+    8192."""
     impl = p.attn_impl
     if impl not in ("auto", "mha", "flash", "ring"):
         raise ValueError(f"unknown attn_impl {impl!r}")
-    if not serving:
-        return "mha"
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
-        if on_tpu and l >= 128 and _flash_block(l) >= 32:
+        min_l = 128 if serving else 8192
+        if on_tpu and l >= min_l and _flash_block(l) >= 32:
             return "flash"
         return "mha"
     return impl

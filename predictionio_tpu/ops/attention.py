@@ -32,6 +32,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -137,15 +138,16 @@ def _online_block_update(q, k, v, num, den, m, *, causal, q_offset, k_offset,
     return num, den, m_new
 
 
-def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *,
                   blk_q: int, blk_k: int, n_kb: int, causal: bool,
-                  scale: float, has_valid: bool, has_start: bool):
+                  scale: float):
     """Pallas kernel body. Grid = (B*H, n_qb, n_kb); kv blocks iterate in the
     last (minor) grid dimension so the VMEM scratch accumulators carry the
     online-softmax state across kv blocks for a fixed q block. ``kv_ref`` is
     the full [B*H, 2] array of per-(batch·head) [start, end) valid-key
     windows in SMEM (unblocked — TPU SMEM lowering rejects sub-tile block
-    shapes), used only when ``has_valid``/``has_start``."""
+    shapes); a windowless call carries the trivial (0, lk) window."""
     bh = pl.program_id(0)
     kb = pl.program_id(2)
     qb = pl.program_id(1)
@@ -161,26 +163,17 @@ def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         k = k_ref[0]  # [blk_k, D]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
-        mask = None
+        k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (k_pos >= kv_ref[bh, 0]) & (k_pos < kv_ref[bh, 1])
         if causal:
             q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = q_pos >= k_pos
-        if has_valid or has_start:
-            k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            if has_valid:
-                kvm = k_pos < kv_ref[bh, 1]
-                mask = kvm if mask is None else mask & kvm
-            if has_start:
-                ksm = k_pos >= kv_ref[bh, 0]
-                mask = ksm if mask is None else mask & ksm
-        s_masked = s if mask is None else jnp.where(mask, s, NEG_INF)
+            mask = mask & (q_pos >= k_pos)
+        s_masked = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]          # [blk_q, 1]
         m_new = jnp.maximum(m_prev[:, 0], s_masked.max(axis=-1))[:, None]
         p = jnp.exp(s_masked - m_new)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
+        p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)  # [blk_q, 1]
         l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jnp.dot(
@@ -190,34 +183,255 @@ def _flash_kernel(kv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     # Skip provably-all-masked blocks entirely: causal blocks fully past the
     # diagonal (static structure, roughly halves causal kernel time) and
-    # blocks entirely outside this sequence's valid-key window (dynamic).
-    preds = []
+    # blocks entirely outside this sequence's valid-key window (dynamic;
+    # a windowless call carries the trivial (0, lk) window).
+    pred = (kb * blk_k < kv_ref[bh, 1]) & ((kb + 1) * blk_k > kv_ref[bh, 0])
     if causal:
-        preds.append(kb * blk_k <= qb * blk_q + (blk_q - 1))
-    if has_valid:
-        preds.append(kb * blk_k < kv_ref[bh, 1])
-    if has_start:
-        preds.append((kb + 1) * blk_k > kv_ref[bh, 0])
-    if preds:
-        pred = preds[0]
-        for extra in preds[1:]:
-            pred = pred & extra
-        pl.when(pred)(_compute)
-    else:
-        _compute()
+        pred = pred & (kb * blk_k <= qb * blk_q + (blk_q - 1))
+    pl.when(pred)(_compute)
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
-        if has_valid or has_start:
-            # Fully-masked query rows (empty valid window, or causal queries
-            # entirely before kv_start) have l == 0; return 0 for them,
-            # matching mha_attention's any_visible zeroing.
-            l = l_ref[:]
-            o_ref[0] = jnp.where(
-                l > 0.0, acc_ref[:] / jnp.maximum(l, 1e-30), 0.0
-            ).astype(o_ref.dtype)
-        else:
-            o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        l = l_ref[:]
+        # Fully-masked query rows (empty valid window, or causal queries
+        # entirely before kv_start) have l == 0; return 0 for them,
+        # matching mha_attention's any_visible zeroing.
+        o_ref[0] = jnp.where(
+            l > 0.0, acc_ref[:] / jnp.maximum(l, 1e-30), 0.0
+        ).astype(o_ref.dtype)
+        # log-sum-exp per query row, the backward's softmax residual.
+        # Fully-masked rows get 0 (finite): exp(NEG_INF - 0) underflows to
+        # p = 0 in the backward, giving the correct zero gradients.
+        lse_ref[0] = jnp.where(
+            l > 0.0, m_ref[:] + jnp.log(jnp.maximum(l, 1e-30)), 0.0
+        )
+
+
+def _flash_forward_impl(qf, kf, vf, kv, *, causal, blk_q, blk_k, interpret):
+    """(o, lse) on flattened [B*H, L, D] operands — shared by the primal
+    and the VJP-saving forward."""
+    bh, lq, d = qf.shape
+    lk = kf.shape[1]
+    n_qb, n_kb = lq // blk_q, lk // blk_k
+    scale = 1.0 / (d**0.5)
+    kernel = functools.partial(
+        _flash_kernel, blk_q=blk_q, blk_k=blk_k, n_kb=n_kb, causal=causal,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole [B*H, 2] window
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),
+            # trailing unit dim: Mosaic requires the last two block dims
+            # to be (8k, 128k) or equal to the array dims — (blk_q, 1)
+            # satisfies that where a flat (1, blk_q) block cannot
+            pl.BlockSpec((1, blk_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv, qf, kf, vf)
+
+
+def _flash_dq_kernel(kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                     dq_ref, acc_ref, *,
+                     blk_q: int, blk_k: int, n_kb: int, causal: bool,
+                     scale: float):
+    """dq backward pass: for a fixed q block, iterate kv blocks (minor grid
+    dim) recomputing p from the saved lse and accumulating
+    dq += (p ∘ (do·vᵀ − delta)) · k · scale."""
+    bh = pl.program_id(0)
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (k_pos >= kv_ref[bh, 0]) & (k_pos < kv_ref[bh, 1])
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        p = jnp.where(mask, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jnp.dot(do, v_ref[0].T.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0]) * scale
+        acc_ref[:] = acc_ref[:] + jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    pred = (kb * blk_k < kv_ref[bh, 1]) & ((kb + 1) * blk_k > kv_ref[bh, 0])
+    if causal:
+        pred = pred & (kb * blk_k <= qb * blk_q + (blk_q - 1))
+    pl.when(pred)(_compute)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(kv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                      dk_ref, dv_ref, acck_ref, accv_ref, *,
+                      blk_q: int, blk_k: int, n_qb: int, causal: bool,
+                      scale: float):
+    """dk/dv backward pass: for a fixed kv block, iterate q blocks (minor
+    grid dim): dv += pᵀ·do, dk += (p ∘ (do·vᵀ − delta))ᵀ·q · scale."""
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        acck_ref[:] = jnp.zeros_like(acck_ref)
+        accv_ref[:] = jnp.zeros_like(accv_ref)
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = qb * blk_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = kb * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (k_pos >= kv_ref[bh, 0]) & (k_pos < kv_ref[bh, 1])
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0])
+        p = jnp.where(mask, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)
+        accv_ref[:] = accv_ref[:] + jnp.dot(
+            p.T.astype(do_ref.dtype), do_ref[0],
+            preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_ref[0].T.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+        ds = (p * (dp - dl_ref[0]) * scale).astype(q.dtype)
+        acck_ref[:] = acck_ref[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+
+    pred = (kb * blk_k < kv_ref[bh, 1]) & ((kb + 1) * blk_k > kv_ref[bh, 0])
+    if causal:
+        pred = pred & (kb * blk_k <= qb * blk_q + (blk_q - 1))
+    pl.when(pred)(_compute)
+
+    @pl.when(qb == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = acck_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = accv_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_backward_impl(qf, kf, vf, kv, o, lse, do, *, causal, blk_q,
+                         blk_k, interpret):
+    """(dq, dk, dv) via the standard recompute-from-lse flash backward:
+    delta = rowsum(do ∘ o), then one kernel accumulating dq over kv blocks
+    and one accumulating dk/dv over q blocks."""
+    bh, lq, d = qf.shape
+    lk = kf.shape[1]
+    n_qb, n_kb = lq // blk_q, lk // blk_k
+    scale = 1.0 / (d**0.5)
+    delta = jnp.einsum(
+        "zld,zld->zl", do.astype(jnp.float32), o.astype(jnp.float32)
+    )[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, blk_q=blk_q, blk_k=blk_k, n_kb=n_kb,
+            causal=causal, scale=scale),
+        grid=(bh, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+    )(kv, qf, kf, vf, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, blk_q=blk_q, blk_k=blk_k, n_qb=n_qb,
+            causal=causal, scale=scale),
+        grid=(bh, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, blk_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), vf.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kv, qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_fn(causal: bool, blk_q: int, blk_k: int, interpret: bool):
+    """custom_vjp flash attention on flattened operands, cached per static
+    config. The valid-key window rides a traced [B*H, 2] int array (it
+    cannot be a nondiff_argnum), whose cotangent is float0."""
+
+    @jax.custom_vjp
+    def f(qf, kf, vf, kv):
+        o, _ = _flash_forward_impl(
+            qf, kf, vf, kv, causal=causal, blk_q=blk_q, blk_k=blk_k,
+            interpret=interpret)
+        return o
+
+    def fwd(qf, kf, vf, kv):
+        o, lse = _flash_forward_impl(
+            qf, kf, vf, kv, causal=causal, blk_q=blk_q, blk_k=blk_k,
+            interpret=interpret)
+        return o, (qf, kf, vf, kv, o, lse)
+
+    def bwd(res, do):
+        qf, kf, vf, kv, o, lse = res
+        dq, dk, dv = _flash_backward_impl(
+            qf, kf, vf, kv, o, lse, do, causal=causal, blk_q=blk_q,
+            blk_k=blk_k, interpret=interpret)
+        dkv = np.zeros(kv.shape, dtype=jax.dtypes.float0)
+        return dq, dk, dv, dkv
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 @functools.partial(
@@ -236,15 +450,18 @@ def flash_attention(
     blk_k: int = 128,
     interpret: bool = False,
 ):
-    """Blockwise flash attention as a pallas TPU kernel.
+    """Blockwise flash attention as a pallas TPU kernel — differentiable:
+    a custom VJP recomputes each block's probabilities from the saved
+    per-row log-sum-exp (the standard flash backward), so neither pass
+    ever materializes the [Lq, Lk] score matrix in HBM.
 
     Heads fold into the grid's batch dimension; each grid step works on a
     [blk_q, D] query tile against a [blk_k, D] key tile entirely in VMEM.
     ``kv_valid`` (scalar or [B] int) masks out key positions >= kv_valid
     (right-padded sequences); ``kv_start`` masks positions < kv_start
-    (left-padded sequences, SASRec's serving batches); blocks entirely
-    outside the valid window are skipped, not just masked.
-    ``interpret=True`` runs the kernel in interpreter mode (CPU CI).
+    (left-padded sequences, SASRec's batches); blocks entirely outside
+    the valid window are skipped, not just masked — in both passes.
+    ``interpret=True`` runs the kernels in interpreter mode (CPU CI).
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -254,45 +471,20 @@ def flash_attention(
         raise ValueError(
             f"sequence lengths ({lq},{lk}) must divide blocks ({blk_q},{blk_k})"
         )
-    n_qb, n_kb = lq // blk_q, lk // blk_k
-    scale = 1.0 / (d**0.5)
 
     # [B, L, H, D] → [B*H, L, D]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
 
-    has_valid = kv_valid is not None
-    has_start = kv_start is not None
     # [B*H, 2] (start, end) window in SMEM; unused bounds get (0, lk)
     start = jnp.broadcast_to(
-        jnp.asarray(kv_start if has_start else 0, jnp.int32), (b,)
+        jnp.asarray(kv_start if kv_start is not None else 0, jnp.int32), (b,)
     )
     end = jnp.broadcast_to(
-        jnp.asarray(kv_valid if has_valid else lk, jnp.int32), (b,)
+        jnp.asarray(kv_valid if kv_valid is not None else lk, jnp.int32), (b,)
     )
     kv = jnp.repeat(jnp.stack([start, end], axis=1), h, axis=0)  # [B*H, 2]
 
-    kernel = functools.partial(
-        _flash_kernel, blk_q=blk_q, blk_k=blk_k, n_kb=n_kb, causal=causal,
-        scale=scale, has_valid=has_valid, has_start=has_start,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, n_qb, n_kb),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # whole [B*H, 2] window
-            pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, blk_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((blk_q, 1), jnp.float32),
-            pltpu.VMEM((blk_q, 1), jnp.float32),
-            pltpu.VMEM((blk_q, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(kv, qf, kf, vf)
+    out = _flash_fn(causal, blk_q, blk_k, interpret)(qf, kf, vf, kv)
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)

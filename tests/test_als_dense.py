@@ -307,3 +307,68 @@ def test_dense_sharded_entities_without_ratings_stay_at_init(ctx):
     got = ALS(ctx, params).train(ui, ii, r, 11, 5)
     np.testing.assert_allclose(got.user_features[3:], u0[3:], atol=1e-6)
     np.testing.assert_allclose(got.item_features[2:], v0[2:], atol=1e-6)
+
+
+def _one_device_ctx():
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("data", "model")))
+
+
+def test_dense_cache_hit_reuses_device_inputs():
+    """A second train on byte-identical ratings hits the densified-A
+    cache (fingerprint match), skips prepare/upload, and reproduces the
+    cold result exactly."""
+    one = _one_device_ctx()
+    rng = np.random.default_rng(21)
+    n_users, n_items, nnz = 40, 25, 400
+    ui = rng.integers(0, n_users, nnz).astype(np.int32)
+    ii = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    params = ALSParams(rank=4, num_iterations=3, seed=3, solver="dense")
+    als_dense.clear_dense_cache()
+    cold = ALS(one, params).train(ui, ii, r, n_users, n_items)
+    assert als_dense.last_train_phases["cache_hit"] is False
+    assert "prepare_s" in als_dense.last_train_phases
+    warm = ALS(one, params).train(ui, ii, r, n_users, n_items)
+    assert als_dense.last_train_phases["cache_hit"] is True
+    assert "prepare_s" not in als_dense.last_train_phases
+    np.testing.assert_array_equal(cold.user_features, warm.user_features)
+    np.testing.assert_array_equal(cold.item_features, warm.item_features)
+
+
+def test_dense_cache_distinguishes_changed_ratings():
+    """Any content change (even one rating value) is a different
+    fingerprint: no stale densified A may be reused."""
+    one = _one_device_ctx()
+    rng = np.random.default_rng(22)
+    n_users, n_items, nnz = 30, 20, 250
+    ui = rng.integers(0, n_users, nnz).astype(np.int32)
+    ii = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    params = ALSParams(rank=4, num_iterations=3, seed=3, solver="dense")
+    als_dense.clear_dense_cache()
+    a = ALS(one, params).train(ui, ii, r, n_users, n_items)
+    r2 = r.copy()
+    r2[0] = 1.0 if r[0] != 1.0 else 2.0
+    b = ALS(one, params).train(ui, ii, r2, n_users, n_items)
+    assert als_dense.last_train_phases["cache_hit"] is False
+    assert not np.array_equal(a.user_features, b.user_features)
+
+
+def test_dense_cache_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("PIO_DENSE_CACHE", "0")
+    one = _one_device_ctx()
+    ui = np.array([0, 1, 2, 0], dtype=np.int32)
+    ii = np.array([0, 1, 0, 1], dtype=np.int32)
+    r = np.array([5.0, 3.0, 4.0, 2.0], dtype=np.float32)
+    params = ALSParams(rank=3, num_iterations=2, seed=0, solver="dense")
+    als_dense.clear_dense_cache()
+    ALS(one, params).train(ui, ii, r, 5, 4)
+    ALS(one, params).train(ui, ii, r, 5, 4)
+    assert als_dense.last_train_phases["cache_hit"] is False
+    assert not als_dense._A_CACHE

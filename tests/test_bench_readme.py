@@ -9,11 +9,11 @@ truth:
 1. The README prose quotes exactly the band endpoints (``{lo:g}-{hi:g}``)
    for every banded metric — the dict and the document cannot drift
    apart silently.
-2. EVERY capture bench.capture_paths() resolves — the local
-   bench_captures/latest.json (which bench.py writes for every healthy
-   TPU run, band violations included) AND the newest checked-in driver
-   BENCH_r*.json — satisfies each band's claim side (floor for
-   throughput, ceiling for latency).
+2. EVERY capture bench.capture_paths() resolves — the checked-in
+   bench_captures/latest.json (which bench.py overwrites on every
+   healthy TPU run, band violations included; the newest BENCH_r*.json
+   is the fallback when it is absent) — satisfies each band's claim
+   side (floor for throughput, ceiling for latency).
 3. The gate can actually fail: a deliberately stale floor produces a
    violation against the same captures (so does an out-of-band capture
    against the real bands), and bench.py routes healthy TPU runs to

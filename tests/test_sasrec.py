@@ -155,18 +155,47 @@ class TestServingAttentionImpls:
         algo = SASRecAlgorithm(AlgorithmParams())
         assert algo._hp().attn_impl == "auto"
 
-    def test_training_path_stays_differentiable(self, setup):
-        """attn_impl=flash must not break training (which needs the mha
-        VJP) — resolve_attn routes non-serving calls to mha."""
+    def test_training_honors_explicit_impl(self, setup):
+        """Since the round-5 flash VJP, explicit attn_impl is honored for
+        training too; auto-training stays mha below the long-context
+        threshold where mha's fused program is at parity."""
+        from dataclasses import replace
+
         from predictionio_tpu.models.sasrec import _resolve_attn
 
         p, _, _ = setup
+        assert _resolve_attn(replace(p, attn_impl="flash"),
+                             serving=False, l=16) == "flash"
+        assert _resolve_attn(replace(p, attn_impl="ring"),
+                             serving=False, l=16) == "ring"
+        assert _resolve_attn(replace(p, attn_impl="auto"),
+                             serving=False, l=512) == "mha"
+
+    def test_training_gradients_flash_match_mha(self, setup):
+        """Full SASRec loss gradients through the flash path equal the mha
+        path's — the pallas custom VJP under a real model, not just the
+        op-level parity in test_ops."""
         from dataclasses import replace
 
-        assert _resolve_attn(replace(p, attn_impl="flash"),
-                             serving=False, l=16) == "mha"
-        assert _resolve_attn(replace(p, attn_impl="ring"),
-                             serving=False, l=16) == "mha"
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models.sasrec import _loss_fn
+
+        p, params, seqs = setup
+        rng = np.random.default_rng(9)
+        pos = np.where(seqs > 0, rng.integers(1, 41, seqs.shape), 0)
+        neg = np.where(seqs > 0, rng.integers(1, 41, seqs.shape), 0)
+        args = (jnp.asarray(seqs), jnp.asarray(pos), jnp.asarray(neg), None)
+
+        g_mha = jax.grad(_loss_fn)(
+            params, *args, replace(p, attn_impl="mha"))
+        g_flash = jax.grad(_loss_fn)(
+            params, *args, replace(p, attn_impl="flash"))
+        flat_m, _ = jax.flatten_util.ravel_pytree(g_mha)
+        flat_f, _ = jax.flatten_util.ravel_pytree(g_flash)
+        np.testing.assert_allclose(
+            np.asarray(flat_f), np.asarray(flat_m), rtol=2e-3, atol=2e-5)
 
 
 class TestSequentialTemplate:
