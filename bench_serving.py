@@ -460,15 +460,20 @@ def bench_event_scan(n_events: int = 200_000) -> dict:
         out: dict = {"scan_events": n_events}
         parts = min(4, os.cpu_count() or 1)
 
-        def timed(partitions):
+        def best_of(fn, n=2):
             best = float("inf")
-            for _ in range(2):
+            for _ in range(n):
                 t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        def timed(partitions):
+            def run():
                 res = store.interactions(
                     1, None, ["rate"], partitions=partitions)
-                best = min(best, time.perf_counter() - t0)
-            assert len(res[2]) == n_events
-            return n_events / best
+                assert len(res[2]) == n_events
+            return n_events / best_of(run)
 
         out["scan_events_per_sec"] = round(timed(1), 0)
         if parts > 1:
@@ -476,6 +481,44 @@ def bench_event_scan(n_events: int = 200_000) -> dict:
             out["scan_partitions"] = parts
         else:
             out["scan_partitions"] = 1
+
+        # --- scan/ETL overlap (round-4 review: the parallel-scan claim
+        # needs a measured number). The C++ decode runs behind a ctypes
+        # call, which drops the GIL — so a scan thread can in principle
+        # run concurrently with the trainer's host-side counting-sort
+        # ETL. Ratio = concurrent wall / max(scan alone, ETL alone):
+        # 1.0 = perfect overlap (the slower side fully hides the other,
+        # regardless of how unbalanced they are — needs >= 2 cores);
+        # (t_scan + t_etl) / max(...) = none (on a single-core host
+        # both sides are CPU-bound and time-slice the core — the honest
+        # expectation here).
+        import threading
+
+        from predictionio_tpu.models.als import _histogram
+
+        etl_u = rng.integers(0, 5000, 3_000_000).astype(np.int32)
+
+        def etl_work():
+            for _ in range(4):
+                _histogram(etl_u, 5000)
+
+        def scan_work():
+            store.interactions(1, None, ["rate"], partitions=1)
+
+        t_scan = n_events / out["scan_events_per_sec"]  # measured above
+        t_etl = best_of(etl_work)
+
+        def concurrent():
+            t = threading.Thread(target=scan_work)
+            t.start()
+            etl_work()
+            t.join()
+
+        t_both = best_of(concurrent)
+        out["scan_etl_concurrent_vs_max"] = round(
+            t_both / max(t_scan, t_etl, 1e-9), 2)
+        out["scan_etl_no_overlap_bound"] = round(
+            (t_scan + t_etl) / max(t_scan, t_etl, 1e-9), 2)
         return out
     finally:
         tmp.cleanup()
