@@ -220,9 +220,12 @@ def _ingest_worker(port: int, key: str, n: int, barrier, out_q,
     """One client process: connect, sync on the barrier, POST n events
     (one per request, or in /batch/events.json arrays of ``batch``).
     Separate PROCESSES, not threads — in-process clients share the
-    server's GIL and understate its real capacity."""
-    import http.client as hc
+    server's GIL and understate its real capacity. Raw keep-alive socket
+    with a pre-serialized request: on a host where clients and server
+    share cores (ingest_host_cpus=1 on the bench machine), client-side
+    http.client CPU would be measured as server capacity lost."""
     import json as _json
+    import socket as _socket
     import time as _time
 
     ev = {
@@ -232,27 +235,56 @@ def _ingest_worker(port: int, key: str, n: int, barrier, out_q,
     if batch > 1:
         path = f"/batch/events.json?accessKey={key}"
         body = _json.dumps([ev] * batch).encode()
-        ok = 200
+        ok = b"200"
     else:
         path = f"/events.json?accessKey={key}"
         body = _json.dumps(ev).encode()
-        ok = 201
-    conn = hc.HTTPConnection("127.0.0.1", port, timeout=30)
-    conn.request("POST", path, body, {"Content-Type": "application/json"})
-    r = conn.getresponse()
-    r.read()
-    assert r.status == ok, r.status
+        ok = b"201"
+    req = (
+        f"POST {path} HTTP/1.1\r\nHost: 127.0.0.1\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n"
+    ).encode() + body
+    sock = _socket.create_connection(("127.0.0.1", port), timeout=30)
+    sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+
+    buf = bytearray()
+
+    def roundtrip() -> None:
+        nonlocal buf
+        sock.sendall(req)
+        # responses carry Content-Length and no chunking; frame by headers
+        while True:
+            end = buf.find(b"\r\n\r\n")
+            if end >= 0:
+                break
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError("server closed connection")
+            buf += chunk
+        head = bytes(buf[:end])
+        status = head.split(b" ", 2)[1]
+        assert status == ok, status
+        clen = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.lower() == b"content-length":
+                clen = int(v)
+        need = end + 4 + clen
+        while len(buf) < need:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise AssertionError("server closed connection")
+            buf += chunk
+        del buf[:need]
+
+    roundtrip()  # warm: connection + first parse
     barrier.wait()
     t0 = _time.perf_counter()
     for _ in range(-(-n // batch)):
-        conn.request(
-            "POST", path, body, {"Content-Type": "application/json"}
-        )
-        r = conn.getresponse()
-        r.read()
-        assert r.status == ok, r.status
+        roundtrip()
     out_q.put(_time.perf_counter() - t0)
-    conn.close()
+    sock.close()
 
 
 def _run_ingest_clients(port: int, key: str, total: int, conns: int,
@@ -278,7 +310,9 @@ def _run_ingest_clients(port: int, key: str, total: int, conns: int,
     for p in procs:
         p.start()
     try:
-        barrier.wait(timeout=60)  # all workers connected + warmed
+        # all workers connected + warmed; generous timeout — spawning
+        # 8 interpreters on a busy single-core host can take minutes
+        barrier.wait(timeout=300)
     except Exception:
         for p in procs:
             p.terminate()

@@ -193,6 +193,9 @@ class MySQLClient:
             self.conn.commit()
             return cur
 
+    # DBAPI commit-per-statement; the sqlite group commit doesn't apply
+    execute_group = execute
+
     def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
         with self.lock:
             cur = self.conn.cursor()

@@ -135,6 +135,10 @@ class PGClient:
                     raise
                 return self._conn.execute(sql, params)
 
+    # postgres autocommits per statement on the wire; the sqlite-specific
+    # group-commit optimization degrades to a plain execute here
+    execute_group = execute
+
     def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
         return self.execute(sql, params).rows
 

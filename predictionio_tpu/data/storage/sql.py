@@ -18,10 +18,13 @@ the server-database flavor over the pure-Python wire client.
 
 from __future__ import annotations
 
+import contextlib
 import datetime as dt
 import json
+import os
 import sqlite3
 import threading
+import time
 import uuid
 from pathlib import Path
 from typing import Iterator, Sequence
@@ -111,12 +114,126 @@ class SQLClient:
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.execute("PRAGMA synchronous=NORMAL")
+        # group-commit state (see execute_group)
+        self._gc_cv = threading.Condition()
+        self._gc_pending = 0
+        self._gc_committed = 0
+        #: (lo, hi] seq ranges rolled back by a failed commit. Ranges, not
+        #: a watermark: a failure must only fail the seqs it actually
+        #: rolled back — seqs a *previous* leader already committed stay
+        #: good even if their waiter has not woken yet. Contiguous
+        #: failures merge, so this stays O(distinct outages).
+        self._gc_failed: list[tuple[int, int]] = []
+        self._gc_error: BaseException | None = None
+        self._gc_leader = False
+        self._gc_last_thread: int | None = None
+        self._gc_last_time = 0.0
+
+    #: Commit-delay window (the postgres ``commit_delay`` idea): when a
+    #: *different* thread inserted within the last few ms — i.e. several
+    #: ingest connections are live — the commit leader sleeps this long so
+    #: stragglers join its commit. Staggered request/response cycles never
+    #: overlap inside the ~0.1 ms execute, so without the window every
+    #: event pays the full WAL commit even under 8-way load. A lone
+    #: connection never waits (its own thread was the last inserter).
+    GROUP_WINDOW_S = float(
+        os.environ.get("PIO_SQLITE_GROUP_COMMIT_WINDOW_MS", "1")) / 1e3
+    #: How recently another thread must have inserted to count as
+    #: concurrent load (seconds).
+    GROUP_CONCURRENT_S = 0.003
 
     def execute(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
         with self.lock:
             cur = self.conn.execute(sql, params)
             self.conn.commit()
             return cur
+
+    def execute_group(self, sql: str, params: Sequence = ()) -> sqlite3.Cursor:
+        """Execute + *group* commit: returns only after a commit covering
+        this statement, but concurrent callers share one fsync/commit — the
+        first waiter becomes the commit leader for everyone executed so far.
+        A WAL commit per row is the dominant cost of row-at-a-time event
+        ingestion (measured 0.13 ms of a 0.48 ms insert); with N concurrent
+        ingest connections this collapses N commits into one while keeping
+        the durability contract (201 ⇒ committed) intact."""
+        with self.lock:
+            cur = self.conn.execute(sql, params)
+            self._gc_pending += 1
+            my_seq = self._gc_pending
+            me = threading.get_ident()
+            tnow = time.monotonic()
+            concurrent = (
+                self._gc_last_thread is not None
+                and self._gc_last_thread != me
+                and tnow - self._gc_last_time < self.GROUP_CONCURRENT_S
+            )
+            self._gc_last_thread = me
+            self._gc_last_time = tnow
+        while True:
+            with self._gc_cv:
+                if self._gc_seq_failed(my_seq):
+                    # a leader's commit failed and rolled our row back with
+                    # its group; the row is NOT stored — surface that
+                    raise StorageError(
+                        "group commit failed; event not stored"
+                    ) from self._gc_error
+                if self._gc_committed >= my_seq:
+                    return cur
+                if not self._gc_leader:
+                    self._gc_leader = True
+                    break
+                self._gc_cv.wait()
+        try:
+            if concurrent and self.GROUP_WINDOW_S > 0:
+                time.sleep(self.GROUP_WINDOW_S)  # no locks held: stragglers
+                # execute behind us and ride this commit
+            with self.lock:
+                pending = self._gc_pending
+                self.conn.commit()
+            with self._gc_cv:
+                self._gc_committed = max(self._gc_committed, pending)
+        except BaseException as e:
+            # the open transaction holds every uncommitted statement; roll
+            # it back so a statement whose caller saw an error can never be
+            # silently committed by the NEXT leader, and fail exactly the
+            # seqs the rollback discarded — rows an earlier leader already
+            # committed stay good (their waiters may not have woken yet)
+            with self.lock:
+                pending = self._gc_pending
+                if self.conn.in_transaction:
+                    rolled_back = True
+                    try:
+                        self.conn.rollback()
+                    except sqlite3.Error:
+                        pass  # connection-level failure: nothing to keep
+                else:
+                    # a concurrent plain execute()'s commit made the whole
+                    # group durable before we could roll back: the rows
+                    # ARE stored, so this "failure" is a success
+                    rolled_back = False
+            with self._gc_cv:
+                if rolled_back:
+                    lo = self._gc_committed  # rolled back: (lo, pending]
+                    if pending > lo:
+                        if self._gc_failed and self._gc_failed[-1][1] >= lo:
+                            self._gc_failed[-1] = (
+                                self._gc_failed[-1][0], pending)
+                        else:
+                            self._gc_failed.append((lo, pending))
+                    self._gc_error = e
+                self._gc_committed = max(self._gc_committed, pending)
+            if rolled_back:
+                raise
+        finally:
+            with self._gc_cv:
+                self._gc_leader = False
+                self._gc_cv.notify_all()
+        return cur
+
+    def _gc_seq_failed(self, seq: int) -> bool:
+        """Whether ``seq`` was rolled back by a failed group commit (call
+        with the condition lock held)."""
+        return any(lo < seq <= hi for lo, hi in self._gc_failed)
 
     def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
         """Many statements, ONE commit — a WAL commit per row is the
@@ -151,6 +268,11 @@ class SQLEvents(base.Events):
     def __init__(self, client: SQLClient, prefix: str = ""):
         self._c = client
         self._prefix = prefix
+        # per-DAO hot-path caches: tables already probed as existing, and
+        # the upsert SQL text per table (rebuilding the statement string and
+        # re-querying sqlite_master per insert measured ~15% of insert cost)
+        self._verified: set[str] = set()
+        self._upsert_cache: dict[str, str] = {}
 
     def _t(self, app_id: int, channel_id: int | None) -> str:
         return _event_table(self._prefix, app_id, channel_id)
@@ -186,6 +308,7 @@ class SQLEvents(base.Events):
 
     def remove(self, app_id: int, channel_id: int | None = None) -> bool:
         t = self._t(app_id, channel_id)
+        self._verified.discard(t)
         if not self._exists(t):
             return False
         self._c.execute(f'DROP TABLE "{t}"')
@@ -196,42 +319,72 @@ class SQLEvents(base.Events):
 
     def _require(self, app_id: int, channel_id: int | None) -> str:
         t = self._t(app_id, channel_id)
+        if t in self._verified:
+            return t
         if not self._exists(t):
             raise StorageError(
                 f"Event store for app {app_id} channel {channel_id} is not "
                 "initialized; run `pio app new` first."
             )
+        self._verified.add(t)
         return t
 
-    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+    def _upsert_sql(self, t: str) -> str:
+        sql = self._upsert_cache.get(t)
+        if sql is None:
+            sql = self._c.dialect.upsert_sql(t, _EVENT_COLS.split(", "), ("id",))
+            self._upsert_cache[t] = sql
+        return sql
+
+    @contextlib.contextmanager
+    def _table(self, app_id: int, channel_id: int | None):
+        """The per-app table name, with dropped-table recovery around the
+        statements run against it: another process may drop the table
+        behind the _verified cache (`pio app delete`), so on any error
+        re-probe and surface the same clean StorageError an uncached call
+        raises. Broad on purpose — this DAO also backs postgres/mysql,
+        whose drivers raise their own error types for a missing table."""
         t = self._require(app_id, channel_id)
+        try:
+            yield t
+        except Exception:
+            self._verified.discard(t)
+            self._require(app_id, channel_id)
+            raise
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         eid = event.event_id or new_event_id()
-        self._c.execute(
-            self._c.dialect.upsert_sql(t, _EVENT_COLS.split(", "), ("id",)),
-            (
-                eid,
-                event.event,
-                event.entity_type,
-                event.entity_id,
-                event.target_entity_type,
-                event.target_entity_id,
-                json.dumps(event.properties.to_dict()),
-                format_datetime(event.event_time),
-                to_millis(event.event_time),
-                json.dumps(list(event.tags)),
-                event.pr_id,
-                format_datetime(event.creation_time),
-            ),
-        )
+        with self._table(app_id, channel_id) as t:
+            self._c.execute_group(
+                self._upsert_sql(t),
+                (
+                    eid,
+                    event.event,
+                    event.entity_type,
+                    event.entity_id,
+                    event.target_entity_type,
+                    event.target_entity_id,
+                    json.dumps(event.properties.to_dict()),
+                    format_datetime(event.event_time),
+                    to_millis(event.event_time),
+                    json.dumps(list(event.tags)),
+                    event.pr_id,
+                    format_datetime(event.creation_time),
+                ),
+            )
         return eid
 
     def insert_batch(
         self, events, app_id: int, channel_id: int | None = None
     ) -> list[str]:
-        t = self._require(app_id, channel_id)
         eids = [e.event_id or new_event_id() for e in events]
+        with self._table(app_id, channel_id) as t:
+            self._insert_rows(t, eids, events)
+        return eids
+
+    def _insert_rows(self, t: str, eids, events) -> None:
         self._c.executemany(
-            self._c.dialect.upsert_sql(t, _EVENT_COLS.split(", "), ("id",)),
+            self._upsert_sql(t),
             [
                 (
                     eid,
@@ -250,7 +403,6 @@ class SQLEvents(base.Events):
                 for eid, e in zip(eids, events)
             ],
         )
-        return eids
 
     @staticmethod
     def _row_to_event(row: tuple) -> Event:
@@ -272,15 +424,15 @@ class SQLEvents(base.Events):
         )
 
     def get(self, event_id: str, app_id: int, channel_id: int | None = None):
-        t = self._require(app_id, channel_id)
-        rows = self._c.query(
-            f'SELECT {_EVENT_COLS} FROM "{t}" WHERE id=?', (event_id,)
-        )
+        with self._table(app_id, channel_id) as t:
+            rows = self._c.query(
+                f'SELECT {_EVENT_COLS} FROM "{t}" WHERE id=?', (event_id,)
+            )
         return self._row_to_event(rows[0]) if rows else None
 
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
-        t = self._require(app_id, channel_id)
-        cur = self._c.execute(f'DELETE FROM "{t}" WHERE id=?', (event_id,))
+        with self._table(app_id, channel_id) as t:
+            cur = self._c.execute(f'DELETE FROM "{t}" WHERE id=?', (event_id,))
         return cur.rowcount > 0
 
     def find(
@@ -297,7 +449,6 @@ class SQLEvents(base.Events):
         limit: int | None = None,
         reversed_: bool = False,
     ) -> Iterator[Event]:
-        t = self._require(app_id, channel_id)
         where, params = [], []
         if start_time is not None:
             where.append("eventTimeMs >= ?")
@@ -328,13 +479,15 @@ class SQLEvents(base.Events):
             else:
                 where.append("targetEntityId = ?")
                 params.append(target_entity_id)
-        sql = f'SELECT {_EVENT_COLS} FROM "{t}"'
-        if where:
-            sql += " WHERE " + " AND ".join(where)
-        sql += " ORDER BY eventTimeMs " + ("DESC" if reversed_ else "ASC")
-        if limit is not None and limit >= 0:
-            sql += f" LIMIT {int(limit)}"
-        return (self._row_to_event(row) for row in self._c.query(sql, params))
+        with self._table(app_id, channel_id) as t:
+            sql = f'SELECT {_EVENT_COLS} FROM "{t}"'
+            if where:
+                sql += " WHERE " + " AND ".join(where)
+            sql += " ORDER BY eventTimeMs " + ("DESC" if reversed_ else "ASC")
+            if limit is not None and limit >= 0:
+                sql += f" LIMIT {int(limit)}"
+            rows = self._c.query(sql, params)
+        return (self._row_to_event(row) for row in rows)
 
 
 def _new_instance_id() -> str:

@@ -85,3 +85,25 @@ def test_format_datetime_millis_and_utc():
     t = dt.datetime(2020, 1, 2, 3, 4, 5, 678000, tzinfo=dt.timezone.utc)
     assert format_datetime(t) == "2020-01-02T03:04:05.678+00:00"
     assert parse_datetime("2020-01-02T03:04:05.678Z") == t
+
+
+def test_format_datetime_offsets_and_truncation():
+    # isoformat fast path vs the spec: millisecond truncation, negative and
+    # positive whole-minute offsets, and the odd-second-offset fallback
+    cases = [
+        (dt.datetime(2020, 1, 2, 3, 4, 5, 999999,
+                     tzinfo=dt.timezone(dt.timedelta(hours=-7))),
+         "2020-01-02T03:04:05.999-07:00"),
+        (dt.datetime(1999, 12, 31, 23, 59, 59, 1000,
+                     tzinfo=dt.timezone(dt.timedelta(minutes=330))),
+         "1999-12-31T23:59:59.001+05:30"),
+        (dt.datetime(2020, 6, 1, 0, 0, 0, 500,
+                     tzinfo=dt.timezone(dt.timedelta(minutes=-90))),
+         "2020-06-01T00:00:00.000-01:30"),
+        # offsets with a seconds component (pre-1900-style zones) take the
+        # manual path and drop the seconds, like the original formatter
+        (dt.datetime(2020, 1, 1, tzinfo=dt.timezone(dt.timedelta(seconds=3661))),
+         "2020-01-01T00:00:00.000+01:01"),
+    ]
+    for t, want in cases:
+        assert format_datetime(t) == want

@@ -511,3 +511,28 @@ def test_sql_insert_batch_matches_looped_inserts(tmp_path, monkeypatch):
         assert got.entity_id == "u3" and got.properties["rating"] == 4.0
     finally:
         Storage.reset()
+
+
+def test_auth_cache_ttl_semantics(server, memory_storage, monkeypatch):
+    """Positive access-key lookups are cached for the TTL (a deleted key
+    drains within it); unknown keys are never cached, so a key created
+    after a 401 works immediately."""
+    from predictionio_tpu.data.api import event_server as es_mod
+
+    port, key = server["port"], server["key"]
+    keys = memory_storage.get_meta_data_access_keys()
+
+    # unknown key: 401 now, works the moment it exists (no negative cache)
+    status, _ = call(port, "POST", "/events.json", {"accessKey": "nope"}, EVENT)
+    assert status == 401
+    from predictionio_tpu.data.storage.base import AccessKey
+    keys.insert(AccessKey("nope", server["app_id"], ()))
+    status, _ = call(port, "POST", "/events.json", {"accessKey": "nope"}, EVENT)
+    assert status == 201
+
+    # cached positive: deleting the key keeps it valid until the TTL
+    status, _ = call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
+    assert status == 201
+    keys.delete(key)
+    status, _ = call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
+    assert status == 201  # still inside the 5s TTL window

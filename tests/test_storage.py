@@ -433,3 +433,134 @@ def test_migrate_events_between_sources(monkeypatch, tmp_path):
         assert copied3["migapp"] == 150 and copied3["ghostapp"] == 0
     finally:
         Storage.reset()
+
+
+def test_sqlite_group_commit_concurrent_inserts_durable(sqlite_storage):
+    """Concurrent single-event inserts share commits (the ingest group-commit
+    path) but every acked insert must be durable: a second connection to the
+    same database file sees all rows the moment the threads return."""
+    import sqlite3
+    import threading
+
+    events = sqlite_storage.get_events()
+    events.init(7)
+    n_threads, per_thread = 8, 25
+    errors = []
+
+    def worker(t):
+        try:
+            for i in range(per_thread):
+                events.insert(ev(entity_id=f"t{t}-{i}"), 7)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    # fresh connection: only committed rows are visible
+    path = events._c.conn.execute("PRAGMA database_list").fetchall()[0][2]
+    with sqlite3.connect(path) as conn:
+        tables = [r[0] for r in conn.execute(
+            "SELECT name FROM sqlite_master WHERE name LIKE '%events_7'")]
+        (table,) = tables
+        count = conn.execute(f'SELECT COUNT(*) FROM "{table}"').fetchone()[0]
+    assert count == n_threads * per_thread
+
+
+def test_sqlite_verified_table_cache_invalidated_on_remove(sqlite_storage):
+    events = sqlite_storage.get_events()
+    events.init(8)
+    events.insert(ev(), 8)  # populates the verified-table cache
+    assert events.remove(8)
+    with pytest.raises(StorageError):
+        events.insert(ev(), 8)
+
+
+def test_sqlite_group_commit_failure_rolls_back(sqlite_storage):
+    """A failed group commit must NOT leave the executed statement in the
+    open transaction for the next leader to silently commit: the row is
+    rolled back and the caller sees the error (so an acked 201 always
+    means durably stored, and an error always means NOT stored)."""
+    events = sqlite_storage.get_events()
+    events.init(9)
+    client = events._c
+
+    class FailingCommitConn:
+        def __init__(self, conn):
+            self._conn = conn
+            self.fail_next = False
+
+        def commit(self):
+            if self.fail_next:
+                self.fail_next = False
+                raise sqlite3.OperationalError("disk I/O error (simulated)")
+            return self._conn.commit()
+
+        def __getattr__(self, name):
+            return getattr(self._conn, name)
+
+    import sqlite3
+    wrapper = FailingCommitConn(client.conn)
+    client.conn = wrapper
+    try:
+        wrapper.fail_next = True
+        with pytest.raises(sqlite3.OperationalError):
+            events.insert(ev(entity_id="doomed"), 9)
+        # the failed row must not surface later via another leader's commit
+        ok_id = events.insert(ev(entity_id="survivor"), 9)
+        stored = [e.entity_id for e in events.find(9)]
+        assert stored == ["survivor"]
+        assert events.get(ok_id, 9) is not None
+    finally:
+        client.conn = wrapper._conn
+
+
+def test_sqlite_group_commit_raise_after_durable_is_success(sqlite_storage):
+    """If the commit exception fires AFTER the transaction is already
+    durable (e.g. a concurrent plain execute()'s commit landed first),
+    the insert must report success — not fail a stored row, which would
+    push the client into a duplicating retry."""
+    import sqlite3 as _sqlite3
+
+    events = sqlite_storage.get_events()
+    events.init(11)
+    client = events._c
+
+    class CommitThenRaiseConn:
+        def __init__(self, conn):
+            self._conn = conn
+            self.arm = False
+
+        def commit(self):
+            self._conn.commit()  # durable first...
+            if self.arm:
+                self.arm = False
+                raise _sqlite3.OperationalError("post-commit glitch")
+
+        def __getattr__(self, name):
+            return getattr(self._conn, name)
+
+    wrapper = CommitThenRaiseConn(client.conn)
+    client.conn = wrapper
+    try:
+        wrapper.arm = True
+        eid = events.insert(ev(entity_id="kept"), 11)  # must NOT raise
+        assert events.get(eid, 11) is not None
+        assert [e.entity_id for e in events.find(11)] == ["kept"]
+    finally:
+        client.conn = wrapper._conn
+
+
+def test_sqlite_dropped_table_recovery_on_reads(sqlite_storage):
+    """get/find after an external drop surface the clean StorageError,
+    not a raw driver error (the _verified cache must re-probe)."""
+    events = sqlite_storage.get_events()
+    events.init(12)
+    events.insert(ev(), 12)  # populate the cache
+    # simulate another process dropping the table behind the cache
+    events._c.execute(f'DROP TABLE "{events._t(12, None)}"')
+    with pytest.raises(StorageError, match="not\\s+initialized"):
+        list(events.find(12))
