@@ -1,0 +1,1 @@
+"""DASE components deliberately spread across modules (see ../engine.py)."""

@@ -1,0 +1,31 @@
+"""Algorithm module of the refactor-test engine (ref:
+examples/experimental/scala-refactor-test/src/main/scala/Algorithm.scala:
+AlgorithmParams(a) — predict returns q + a)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.core import P2LAlgorithm
+from predictionio_tpu.core.params import Params
+
+from components.datasource import PredictedResult, Query, TrainingData
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    a: int = 2
+
+
+class Algorithm(P2LAlgorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: AlgorithmParams | None = None):
+        self.params = params or AlgorithmParams()
+
+    def train(self, ctx, pd: TrainingData):
+        return {"n": len(pd.events)}  # vanilla model
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        return PredictedResult(p=query.q + self.params.a)

@@ -1,0 +1,38 @@
+"""DataSource module of the refactor-test engine (ref:
+examples/experimental/scala-refactor-test/src/main/scala/DataSource.scala:
+readTraining emits the integers 0-99; readEval yields one fold whose
+queries are those integers and whose actuals are empty)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.core import PDataSource
+
+
+@dataclass(frozen=True)
+class Query:
+    q: int
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    p: int
+
+
+@dataclass(frozen=True)
+class TrainingData:
+    events: tuple
+
+
+class DataSource(PDataSource):
+    def __init__(self, params=None):
+        pass
+
+    def read_training(self, ctx) -> TrainingData:
+        return TrainingData(events=tuple(range(100)))
+
+    def read_eval(self, ctx):
+        td = self.read_training(ctx)
+        qa = [(Query(q=i), None) for i in range(3)]
+        return [(td, None, qa)]

@@ -1,0 +1,8 @@
+"""Serving module of the refactor-test engine (ref:
+examples/experimental/scala-refactor-test/src/main/scala/Serving.scala)."""
+
+from predictionio_tpu.core import FirstServing
+
+
+class Serving(FirstServing):
+    pass

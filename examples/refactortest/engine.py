@@ -1,0 +1,57 @@
+"""Vanilla engine whose DASE components live in separate modules.
+
+The analog of the reference's refactor-test experimental example
+(ref: examples/experimental/scala-refactor-test/src/main/scala/ — a
+vanilla engine split across Engine/DataSource/Algorithm/Serving files in
+a ``pio.refactor`` package, existing to prove the workflow machinery
+resolves components across namespace boundaries). Here the factory lives
+in ``engine.py`` (what the loader imports) while every component is
+imported from the ``components`` package beside it — exercising the
+engine-dir-on-sys.path loading the same way the reference exercises
+jar-on-classpath package resolution.
+
+Run from this directory::
+
+    pio build && pio train
+    pio eval engine:evaluation
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.core import Engine, IdentityPreparator
+from predictionio_tpu.core.engine import EngineParams
+from predictionio_tpu.core.evaluation import Evaluation
+from predictionio_tpu.core.metrics import AverageMetric
+
+from components.algorithm import Algorithm, AlgorithmParams
+from components.datasource import DataSource
+from components.serving import Serving
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_class=DataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"algo": Algorithm},
+        serving_class=Serving,
+    )
+
+
+class OffsetMetric(AverageMetric):
+    """ref: the VanillaEvaluator's per-query p - q check."""
+
+    header = "mean(prediction - query)"
+
+    def calculate_qpa(self, q, p, a) -> float:
+        return float(p.p - q.q)
+
+
+def evaluation() -> Evaluation:
+    return Evaluation(
+        engine=engine_factory(),
+        engine_params_list=[
+            EngineParams(algorithms_params=(("algo", AlgorithmParams(a=a)),))
+            for a in (1, 2)
+        ],
+        metric=OffsetMetric(),
+    )

@@ -409,3 +409,124 @@ def test_customstore_third_party_datasource(monkeypatch, tmp_path):
         assert all(np.isfinite(s.score) for s in res.itemScores)
     finally:
         Storage.reset()
+
+
+def test_customdatasource_file_engine(memory_storage):
+    """Recommendation engine with only the DataSource swapped to a
+    ``user::item::rating`` file (ref: examples/experimental/
+    scala-parallel-recommendation-custom-datasource/DataSource.scala)."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    factory = "engine:engine_factory"
+    engine = get_engine(factory, EXAMPLES / "customdatasource")
+    import json
+    ep = engine.engine_params_from_json(
+        json.loads((EXAMPLES / "customdatasource" / "engine.json").read_text())
+    )
+    instance = new_engine_instance("custds", "1", "default", factory, ep)
+    assert run_train(engine, ep, instance, WorkflowParams())
+
+    # block structure planted in the data file: users u0-u19 like i0-i14
+    from predictionio_tpu.parallel.mesh import compute_context
+    ds = engine.data_source_class(ep.data_source_params)
+    td = ds.read_training(compute_context())
+    assert len(td.users) == 440
+    algo = engine._algorithms(ep)[0]
+    pd = engine.preparator_class().prepare(compute_context(), td)
+    model = algo.train(compute_context(), pd)
+    r = algo.predict(model, algo.query_class(user="u3", num=5))
+    assert len(r.itemScores) == 5
+    block = {f"i{i}" for i in range(15)}
+    in_block = sum(1 for s in r.itemScores if s.item in block)
+    assert in_block >= 4, [s.item for s in r.itemScores]
+
+
+def test_movielens_sliding_window_evaluation(memory_storage):
+    """Temporal sliding-window evaluation (ref: examples/experimental/
+    scala-local-movielens-evaluation/Evaluation.scala's
+    EventsSlidingEvalParams): folds train strictly on the past."""
+    import datetime as dt
+
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.parallel.mesh import compute_context
+    from predictionio_tpu.workflow.engine_loader import load_engine_factory
+    from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "mlc"))
+    events = memory_storage.get_events()
+    events.init(app_id)
+    t0 = dt.datetime(1998, 1, 1, tzinfo=dt.timezone.utc)
+    # 6 weeks of ratings: 16 users x 1 rating/day, planted block taste
+    for day in range(42):
+        for u in range(16):
+            liked = u < 8
+            item = (day + u) % 12 if liked else 12 + (day + u) % 12
+            events.insert(
+                Event(
+                    event="rate", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{item}",
+                    properties=DataMap({"rating": 5.0 if liked else 4.5}),
+                    event_time=t0 + dt.timedelta(days=day, hours=u),
+                ),
+                app_id,
+            )
+
+    factory = load_engine_factory(
+        "engine:evaluation", EXAMPLES / "movielensevaluation")
+    evaluation = factory(app_name="mlc")
+    evaluation.output_path = None  # don't write best.json into the repo
+    # folds: train until 1998-02-01 + k*7d, test the following week
+    ds = evaluation.engine.data_source_class(
+        evaluation.engine_params_list[0].data_source_params)
+    folds = ds.read_eval(compute_context())
+    assert len(folds) == 2  # 42 days of data → 2 of 3 windows populated
+    for td, info, qa in folds:
+        assert td.users and qa
+        assert info.startswith("until=")
+    # the first fold trains only on events before the first cutoff
+    cutoff_events = 31 * 16  # days 0-30 inclusive x 16 users
+    assert len(folds[0][0].users) == cutoff_events
+
+    instance_id, result = run_evaluation(evaluation, "engine:evaluation")
+    assert instance_id
+    assert 0.0 <= result.best_score.score <= 1.0
+
+
+def test_refactortest_components_across_modules(memory_storage):
+    """Engine components spread across a package resolve through the
+    engine-dir loader (ref: examples/experimental/scala-refactor-test/)."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.engine_loader import (
+        get_engine,
+        load_engine_factory,
+    )
+    from predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+    factory = "engine:engine_factory"
+    engine = get_engine(factory, EXAMPLES / "refactortest")
+    ep = engine.engine_params_from_json(
+        {"algorithms": [{"name": "algo", "params": {"a": 5}}]}
+    )
+    instance = new_engine_instance("refactor", "1", "default", factory, ep)
+    assert run_train(engine, ep, instance, WorkflowParams())
+    algo = engine._algorithms(ep)[0]
+    assert algo.predict({"n": 100}, algo.query_class(q=7)).p == 12
+
+    evaluation = load_engine_factory(
+        "engine:evaluation", EXAMPLES / "refactortest")()
+    evaluation.output_path = None  # don't write best.json into the repo
+    instance_id, result = run_evaluation(evaluation, "engine:evaluation")
+    assert instance_id
+    assert result.best_score.score == 2.0  # a=2 beats a=1 on mean(p - q)
