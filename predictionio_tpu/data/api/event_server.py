@@ -42,7 +42,13 @@ from predictionio_tpu.data.webhooks import (
     json_connectors,
     to_event,
 )
-from predictionio_tpu.utils.http import AppServer, HTTPError, Request, Router
+from predictionio_tpu.utils.http import (
+    AppServer,
+    HTTPError,
+    RawResponse,
+    Request,
+    Router,
+)
 from predictionio_tpu.utils.time import parse_datetime
 
 logger = logging.getLogger(__name__)
@@ -157,7 +163,7 @@ class EventService:
         args = [s for s in request.path_params.get("args", "").split("/") if s]
         return 200, plugins[pname].handle_rest(auth.app_id, auth.channel_id, args)
 
-    def _ingest(self, auth: AuthData, make_event) -> tuple[int, dict]:
+    def _ingest(self, auth: AuthData, make_event) -> tuple[int, object]:
         """Shared validate → blockers → insert → sniffers → stats → 201 tail
         used by the event and webhook POST routes."""
         try:
@@ -176,6 +182,15 @@ class EventService:
                 logger.exception("input sniffer failed")
         if self.config.stats:
             self.stats.update(auth.app_id, 201, event)
+        # prebuilt JSON bytes for the common case — server-generated ids
+        # are uuid hex, no escaping needed; a CLIENT-supplied eventId can
+        # hold anything (quotes, non-ASCII) and must go through the real
+        # encoder, or the response is injectable/malformed
+        if event_id.isascii() and event_id.isalnum():
+            return 201, RawResponse(
+                b'{"eventId": "%s"}' % event_id.encode("ascii"),
+                "application/json; charset=UTF-8",
+            )
         return 201, {"eventId": event_id}
 
     def post_event(self, request: Request):

@@ -35,7 +35,7 @@ class Request:
     def json(self) -> Any:
         if not self.body:
             return None
-        return json.loads(self.body.decode("utf-8"))
+        return json.loads(self.body)  # accepts UTF-8 bytes directly
 
     def form(self) -> dict[str, str]:
         parsed = urllib.parse.parse_qs(
@@ -150,10 +150,15 @@ class Router:
 
     def __init__(self):
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
+        # parameterless patterns resolve with one dict hit instead of a
+        # regex scan — the ingest hot path (POST /events.json) is exact
+        self._exact: dict[tuple[str, str], Handler] = {}
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         """``{name}`` matches one path segment; ``{name:path}`` matches the
         rest of the path (for trailing-args routes)."""
+        if "{" not in pattern:
+            self._exact[(method.upper(), pattern)] = handler
         escaped = re.escape(pattern).replace(r"\{", "{").replace(r"\}", "}")
         regex = re.sub(r"\{(\w+):path\}", r"(?P<\1>.+)", escaped)
         regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+?)", regex)
@@ -167,6 +172,11 @@ class Router:
         return deco
 
     def dispatch(self, request: Request) -> tuple[int, Any]:
+        handler = self._exact.get((request.method, request.path))
+        if handler is not None:
+            return handler(request)
+        # miss: fall through to the regex walk — exact patterns are also
+        # registered there, so 405-vs-404 semantics are unchanged
         matched_path = False
         for method, regex, handler in self._routes:
             m = regex.match(request.path)

@@ -536,3 +536,27 @@ def test_auth_cache_ttl_semantics(server, memory_storage, monkeypatch):
     keys.delete(key)
     status, _ = call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
     assert status == 201  # still inside the 5s TTL window
+
+
+def test_exact_route_fast_path_keeps_405_404_semantics(server):
+    # exact hit
+    assert call(server["port"], "GET", "/") == (200, {"status": "alive"})
+    # wrong method on an exact path: 405, not 404
+    status, body = call(server["port"], "PUT", "/events.json")
+    assert status == 405
+    # unknown path: 404
+    status, _ = call(server["port"], "GET", "/nope.json")
+    assert status == 404
+
+
+def test_client_supplied_event_id_with_specials_round_trips(server):
+    """A client-supplied eventId containing JSON-special or non-ASCII
+    characters must come back correctly escaped (the prebuilt-bytes fast
+    path only covers server-generated hex ids)."""
+    tricky = 'a"b\\c é'
+    ev = dict(EVENT, eventId=tricky)
+    status, body = call(
+        server["port"], "POST", "/events.json", {"accessKey": server["key"]}, ev
+    )
+    assert status == 201
+    assert body["eventId"] == tricky
