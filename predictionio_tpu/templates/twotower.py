@@ -108,6 +108,9 @@ class AlgorithmParams(Params):
     learning_rate: float = 1e-3
     temperature: float = 0.05
     seed: int = 0
+    # "adam" | "rowwise_adam" (per-row second moment on the embedding
+    # tables: ~15% faster steps at near-Adam quality — models/two_tower)
+    optimizer: str = "adam"
 
 
 @dataclass
@@ -141,6 +144,7 @@ class TwoTowerAlgorithm(P2LAlgorithm):
                 learning_rate=p.learning_rate,
                 temperature=p.temperature,
                 seed=p.seed,
+                optimizer=p.optimizer,
             ),
         )
         return RetrievalModel(tt, pd.user_ids, pd.item_ids)
