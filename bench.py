@@ -516,7 +516,7 @@ README_BANDS: dict[str, tuple[float, float]] = {
     "two_tower_steady_steps_per_sec": (400, 800),
     "serve_p50_ms": (0.9, 1.5),
     "serve_qps": (1200, 2200),
-    "ingest_events_per_sec": (1200, 3000),
+    "ingest_events_per_sec": (1200, 3600),
     "ingest_batch50_events_per_sec": (10000, 17000),
 }
 
