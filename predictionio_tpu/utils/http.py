@@ -130,6 +130,28 @@ def _first_wins_dict(pairs) -> dict:
     return out
 
 
+#: Parsed-target cache: clients hammer the same request target
+#: (`/events.json?accessKey=...` on every ingest POST), so the
+#: urlsplit + parse_qs work is memoized on the raw target string. The
+#: hit path copies the query dict (handlers may mutate their Request's
+#: view). Bounded; wiped wholesale when full.
+_target_cache: dict[str, tuple[str, dict[str, str]]] = {}
+_TARGET_CACHE_MAX = 256
+
+
+def _parse_target(raw: str) -> tuple[str, dict[str, str]]:
+    hit = _target_cache.get(raw)
+    if hit is not None:
+        return hit[0], dict(hit[1])
+    parsed = urllib.parse.urlsplit(raw)
+    qs = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+    query = {k: v[0] for k, v in qs.items()}
+    if len(_target_cache) >= _TARGET_CACHE_MAX:
+        _target_cache.clear()
+    _target_cache[raw] = (parsed.path, query)
+    return parsed.path, dict(query)
+
+
 #: Date header cache: one strftime per second, not per request.
 _date_cache: tuple[int, str] = (0, "")
 
@@ -330,8 +352,7 @@ class AppServer:
                 return True
 
             def _handle(self):
-                parsed = urllib.parse.urlsplit(self.path)
-                qs = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+                path, query = _parse_target(self.path)
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                 except ValueError:
@@ -342,8 +363,8 @@ class AppServer:
                 body = self.rfile.read(length) if length else b""
                 request = Request(
                     method=self.command,
-                    path=parsed.path,
-                    query={k: v[0] for k, v in qs.items()},
+                    path=path,
+                    query=query,
                     # first-wins on duplicates, matching the framing
                     # decisions made from _FastHeaders.get above — a
                     # last-wins dict here would let handlers interpret a

@@ -560,3 +560,20 @@ def test_client_supplied_event_id_with_specials_round_trips(server):
     )
     assert status == 201
     assert body["eventId"] == tricky
+
+
+def test_repeated_query_strings_stay_independent(server):
+    """The parsed-target cache must hand each request its own query dict
+    (handlers may mutate it) and distinguish different targets."""
+    for _ in range(3):
+        status, _ = call(
+            server["port"], "POST", "/events.json",
+            {"accessKey": server["key"]}, EVENT)
+        assert status == 201
+    # different query on the same path parses independently
+    status, _ = call(server["port"], "POST", "/events.json",
+                     {"accessKey": "wrong"}, EVENT)
+    assert status == 401
+    status, body = call(server["port"], "GET", "/events.json",
+                        {"accessKey": server["key"], "limit": "2"})
+    assert status == 200 and len(body) <= 2
