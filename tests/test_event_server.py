@@ -6,6 +6,7 @@ server on an ephemeral port — same contract, real sockets.
 """
 
 import json
+import os
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -577,3 +578,60 @@ def test_repeated_query_strings_stay_independent(server):
     status, body = call(server["port"], "GET", "/events.json",
                         {"accessKey": server["key"], "limit": "2"})
     assert status == 200 and len(body) <= 2
+
+
+def test_concurrent_ingest_over_live_http_durable(sqlite_storage, tmp_path):
+    """Group commit through the FULL stack: concurrent keep-alive HTTP
+    clients against a sqlite-backed live server; every 201 must be
+    durable in the database file. The fresh-connection count runs while
+    the server is still up — a graceful stop would flush pending
+    commits and mask an ack-before-commit regression."""
+    import http.client
+    import sqlite3
+    import threading
+
+    apps = sqlite_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "conc"))
+    sqlite_storage.get_events().init(app_id)
+    key = sqlite_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    srv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        n_threads, per_thread = 4, 25
+        body = json.dumps({
+            "event": "buy", "entityType": "user", "entityId": "u",
+            "targetEntityType": "item", "targetEntityId": "i",
+        })
+        errors: list = []
+
+        def worker():
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=30)
+                for _ in range(per_thread):
+                    conn.request(
+                        "POST", f"/events.json?accessKey={key}", body,
+                        {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    r.read()
+                    assert r.status == 201, r.status
+                conn.close()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # durable NOW, server still running: a fresh sqlite connection
+        # must see every acked row
+        with sqlite3.connect(tmp_path / "pio.db") as db:
+            count = db.execute(
+                f'SELECT COUNT(*) FROM "test_eventdata_events_{app_id}"'
+            ).fetchone()[0]
+        assert count == n_threads * per_thread
+    finally:
+        srv.stop()
