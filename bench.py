@@ -589,6 +589,18 @@ def band_refresh_notes(extra: dict) -> list[str]:
     return out
 
 
+def _capture_dir() -> str:
+    """``bench_captures/`` next to this file, created on demand — ONE
+    definition shared by the capture write and ``--metrics-snapshot`` so
+    the two outputs can never drift apart."""
+    import os
+
+    d = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_captures")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def capture_paths() -> list[str]:
     """The capture(s) the containment check validates.
 
@@ -668,7 +680,7 @@ def _check_readme_cli(paths: list[str]) -> int:
     return rc
 
 
-def main() -> None:
+def main(metrics_snapshot: bool = False) -> None:
     from predictionio_tpu.models.als import ALSParams
     from predictionio_tpu.parallel.mesh import compute_context
 
@@ -781,6 +793,23 @@ def main() -> None:
         extra["host_baseline_error"] = repr(e)
         baseline_iter_per_sec = 0.1  # assumed Spark MLlib local-mode class
 
+    # --metrics-snapshot: dump the process obs registry into the capture
+    # (bench servers run in-process, so their stage histograms, ingest
+    # counters and group-commit sizes are all here) and park the raw
+    # Prometheus text next to the capture files
+    if metrics_snapshot:
+        try:
+            from predictionio_tpu.obs import REGISTRY
+
+            extra["metrics_snapshot"] = REGISTRY.snapshot()
+            import os as _os
+
+            with open(_os.path.join(_capture_dir(),
+                                    "metrics-snapshot.prom"), "w") as f:
+                f.write(REGISTRY.expose())
+        except Exception as e:
+            extra["metrics_snapshot_error"] = repr(e)
+
     # secondary sections swallow their exceptions into *_error fields so a
     # device/tunnel hiccup can't sink the headline — but a degraded run
     # must be LOUD, not a JSON field nobody reads (round-3 advisory)
@@ -827,10 +856,7 @@ def main() -> None:
     try:
         import os as _os
 
-        cap_dir = _os.path.join(
-            _os.path.dirname(_os.path.abspath(__file__)), "bench_captures")
-        _os.makedirs(cap_dir, exist_ok=True)
-        with open(_os.path.join(cap_dir, cap_name), "w") as f:
+        with open(_os.path.join(_capture_dir(), cap_name), "w") as f:
             json.dump(doc, f, indent=1)
     except Exception:
         pass  # capture bookkeeping must never sink the bench output
@@ -841,6 +867,7 @@ if __name__ == "__main__":
     import sys as _sys
 
     if "--check-readme" in _sys.argv:
-        args = [a for a in _sys.argv[1:] if a != "--check-readme"]
+        args = [a for a in _sys.argv[1:]
+                if a not in ("--check-readme", "--metrics-snapshot")]
         _sys.exit(_check_readme_cli(args))
-    main()
+    main(metrics_snapshot="--metrics-snapshot" in _sys.argv)

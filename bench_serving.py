@@ -128,6 +128,20 @@ def bench_query_latency(
             ):
                 time.sleep(0.2)
 
+            # stage-histogram baseline AFTER warmup: the 30 warmup queries
+            # above (whose first pays the XLA compile on the batcher
+            # thread) must not pollute the recorded stage quantiles —
+            # the breakdown below reports only the timed traffic
+            from predictionio_tpu.obs import REGISTRY
+
+            _STAGES = ("parse", "queue_wait", "predict", "serve",
+                       "feedback")
+            stage_hist = REGISTRY.get("pio_query_stage_seconds")
+            stage_base = (
+                {s: stage_hist.state(stage=s) for s in _STAGES}
+                if stage_hist is not None else {}
+            )
+
             # -- sequential: true per-request latency
             lat = [c.query(f"u{k % 900}", 10) for k in range(seq_requests)]
             c.close()
@@ -166,6 +180,30 @@ def bench_query_latency(
             }
             if service.batcher is not None:
                 out["serve_max_batch_seen"] = service.batcher.max_batch_seen
+
+            # server-side stage breakdown (the server is in-process, so
+            # the obs registry holds its histograms): alongside qps, the
+            # capture records WHERE the request time went — queue-wait vs
+            # device predict vs serve — which is what separates weather
+            # (queueing) from regression (device time) across rounds.
+            # Quantiles are deltas against the post-warmup baseline, so
+            # they cover exactly the timed traffic above.
+            if stage_hist is not None:
+                stages = {}
+                for stage, base in stage_base.items():
+                    cur = stage_hist.state(stage=stage)
+                    count = cur.count - base.count
+                    if count <= 0:
+                        continue
+                    p50 = stage_hist.quantile_since(0.5, base, stage=stage)
+                    p99 = stage_hist.quantile_since(0.99, base, stage=stage)
+                    stages[stage] = {
+                        "count": count,
+                        "p50_ms": round((p50 or 0.0) * 1e3, 3),
+                        "p99_ms": round((p99 or 0.0) * 1e3, 3),
+                    }
+                if stages:
+                    out["serve_stage_breakdown_ms"] = stages
 
             # placement telemetry: what the latency-aware policy decided
             # for this catalog (parallel/placement.py), the measured link

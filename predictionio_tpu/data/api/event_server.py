@@ -42,16 +42,42 @@ from predictionio_tpu.data.webhooks import (
     json_connectors,
     to_event,
 )
+from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 from predictionio_tpu.utils.http import (
     AppServer,
     HTTPError,
     RawResponse,
     Request,
     Router,
+    add_metrics_route,
 )
 from predictionio_tpu.utils.time import parse_datetime
 
 logger = logging.getLogger(__name__)
+
+# Ingest hot-path telemetry (process-wide; --stats keeps its own
+# per-server counters for the /stats.json contract).
+_INGESTED = REGISTRY.counter(
+    "pio_events_ingested_total",
+    "Event ingest outcomes by HTTP status (batch events count "
+    "individually)",
+    labels=("status",),
+)
+_INGEST_SECONDS = REGISTRY.histogram(
+    "pio_ingest_seconds",
+    "Single-event ingest latency: validate, blockers, commit, stats",
+)
+_BATCH_SIZE = REGISTRY.histogram(
+    "pio_ingest_batch_size",
+    "Valid events per /batch/events.json storage transaction",
+    buckets=DEFAULT_SIZE_BUCKETS,
+)
+_BATCH_SECONDS = REGISTRY.histogram(
+    "pio_batch_ingest_seconds",
+    "Whole /batch/events.json request latency (its own histogram: batch "
+    "wall time would corrupt the single-event quantiles)",
+)
 
 DEFAULT_PORT = 7070  # ref: EventServer.scala:504
 DEFAULT_GET_LIMIT = 20  # ref: EventServer.scala:313
@@ -148,6 +174,7 @@ class EventService:
         r.add("GET", "/webhooks/{web}.json", self.get_webhook_json)
         r.add("POST", "/webhooks/{web}", self.post_webhook_form)
         r.add("GET", "/webhooks/{web}", self.get_webhook_form)
+        add_metrics_route(r)
         return r
 
     def handle_plugin_rest(self, request: Request):
@@ -163,25 +190,51 @@ class EventService:
         args = [s for s in request.path_params.get("args", "").split("/") if s]
         return 200, plugins[pname].handle_rest(auth.app_id, auth.channel_id, args)
 
+    def _record_ingest(self, app_id: int, status: int,
+                       event: Event | None, t0: float | None) -> None:
+        """One ingest outcome into the process metrics and (when enabled)
+        the per-server --stats counters. 4xx/5xx record too — the
+        statusCode section of /stats.json must be truthful, and error
+        latencies belong in the histogram. ``t0 is None`` skips the
+        latency observation (per-event records inside a batch: the batch
+        observes its wall time once)."""
+        _INGESTED.inc(status=str(status))
+        if t0 is not None:
+            _INGEST_SECONDS.observe(time.perf_counter() - t0)
+        if self.config.stats:
+            self.stats.update(app_id, status, event)
+
     def _ingest(self, auth: AuthData, make_event) -> tuple[int, object]:
         """Shared validate → blockers → insert → sniffers → stats → 201 tail
         used by the event and webhook POST routes."""
+        t0 = time.perf_counter()
         try:
             event = make_event()
             validate_event(event)
         except (EventValidationError, ConnectorError, ValueError) as e:
+            self._record_ingest(auth.app_id, 400, None, t0)
             return 400, {"message": str(e)}
         info = EventInfo(auth.app_id, auth.channel_id, event)
-        for blocker in self.plugin_context.input_blockers.values():
-            blocker.process(info, self.plugin_context)  # may raise HTTPError
-        event_id = self.event_client.insert(event, auth.app_id, auth.channel_id)
+        try:
+            for blocker in self.plugin_context.input_blockers.values():
+                blocker.process(info, self.plugin_context)  # may raise HTTPError
+            event_id = self.event_client.insert(
+                event, auth.app_id, auth.channel_id)
+        except HTTPError as e:
+            self._record_ingest(auth.app_id, e.status, None, t0)
+            raise
+        except Exception:
+            self._record_ingest(auth.app_id, 500, None, t0)
+            raise
+        # record BEFORE the sniffers: the event is committed, and the
+        # metric's meaning is validate→commit — a slow sniffer must not
+        # read as storage latency
+        self._record_ingest(auth.app_id, 201, event, t0)
         for sniffer in self.plugin_context.input_sniffers.values():
             try:
                 sniffer.process(info, self.plugin_context)
             except Exception:
                 logger.exception("input sniffer failed")
-        if self.config.stats:
-            self.stats.update(auth.app_id, 201, event)
         # prebuilt JSON bytes for the common case — server-generated ids
         # are uuid hex, no escaping needed; a CLIENT-supplied eventId can
         # hold anything (quotes, non-ASCII) and must go through the real
@@ -211,14 +264,29 @@ class EventService:
         ingestion — batched, the same host moves ~an order of magnitude
         more events/s."""
         auth = self._auth(request)
-        payload = request.json()
+        t0 = time.perf_counter()
+
+        def reject(message: str):
+            """Whole-request 400 bookkeeping: the --stats per-response
+            section records it, pio_http_requests_total counts the
+            response at the http layer, and pio_events_ingested_total
+            stays strictly per-EVENT (a rejected 50-event body is not
+            "one failed event")."""
+            if self.config.stats:
+                self.stats.update(auth.app_id, 400, None)
+            _BATCH_SECONDS.observe(time.perf_counter() - t0)
+            return 400, {"message": message}
+
+        try:
+            payload = request.json()
+        except ValueError:
+            reject("")  # accounting only; the http layer answers
+            raise
         if not isinstance(payload, list):
-            return 400, {"message": "request body must be a JSON array"}
+            return reject("request body must be a JSON array")
         if len(payload) > self.BATCH_MAX:
-            return 400, {
-                "message": f"batch size {len(payload)} exceeds "
-                           f"{self.BATCH_MAX}"
-            }
+            return reject(
+                f"batch size {len(payload)} exceeds {self.BATCH_MAX}")
         results: list[dict] = []
         good: list[tuple[int, Event]] = []  # (position, event)
         for pos, item in enumerate(payload):
@@ -232,22 +300,35 @@ class EventService:
                 results.append({})  # placeholder, filled after the insert
             except HTTPError as e:
                 results.append({"status": e.status, "message": e.message})
+                self._record_ingest(auth.app_id, e.status, None, None)
             except (EventValidationError, ConnectorError, ValueError,
                     TypeError) as e:
                 results.append({"status": 400, "message": str(e)})
+                self._record_ingest(auth.app_id, 400, None, None)
         if good:
-            ids = self.event_client.insert_batch(
-                [e for _, e in good], auth.app_id, auth.channel_id)
+            try:
+                ids = self.event_client.insert_batch(
+                    [e for _, e in good], auth.app_id, auth.channel_id)
+            except Exception:
+                # storage failure: every valid event of the batch failed —
+                # record them (the monitoring must not under-report during
+                # exactly the incidents it exists for), then 500 via the
+                # http layer
+                for _ in good:
+                    self._record_ingest(auth.app_id, 500, None, None)
+                _BATCH_SECONDS.observe(time.perf_counter() - t0)
+                raise
+            _BATCH_SIZE.observe(float(len(good)))  # committed batches only
             for (pos, event), eid in zip(good, ids):
                 results[pos] = {"status": 201, "eventId": eid}
-                if self.config.stats:
-                    self.stats.update(auth.app_id, 201, event)
+                self._record_ingest(auth.app_id, 201, event, None)
                 info = EventInfo(auth.app_id, auth.channel_id, event)
                 for sniffer in self.plugin_context.input_sniffers.values():
                     try:
                         sniffer.process(info, self.plugin_context)
                     except Exception:
                         logger.exception("input sniffer failed")
+        _BATCH_SECONDS.observe(time.perf_counter() - t0)
         return 200, results
 
     def get_events(self, request: Request):
@@ -354,7 +435,7 @@ def create_event_server(config: EventServerConfig | None = None,
     config = config or EventServerConfig()
     service = EventService(config)
     server = AppServer(service.router, config.ip, config.port,
-                       reuse_port=reuse_port)
+                       reuse_port=reuse_port, server_name="event")
     return server
 
 

@@ -3,50 +3,85 @@
 Mirrors the reference's ``Stats``/``StatsActor``
 (ref: data/.../api/Stats.scala:40-79, data/.../api/StatsActor.scala): counts
 by (entityType, event) and by HTTP status code, per app, since server start.
-The actor mailbox is replaced by a lock (same serialization guarantee).
+
+Internals ride the obs metrics layer (the actor mailbox / hand-rolled
+Counter pair of earlier revisions is replaced by two labelled
+:class:`~predictionio_tpu.obs.metrics.Counter` metrics in a PRIVATE
+registry): ``/stats.json`` keeps its exact response contract and its
+"since server start" semantics — a private registry resets with each
+Stats instance, while the process-global ``/metrics`` counters
+(event_server.py) accumulate process-wide.
 """
 
 from __future__ import annotations
 
 import threading
-from collections import Counter
 
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.obs.metrics import MetricsRegistry
 from predictionio_tpu.utils.time import format_datetime, now
+
+#: Label value standing in for "no target entity type" (label values are
+#: strings; mapped back to absent in the JSON snapshot).
+_NONE = "\x00"
 
 
 class Stats:
     def __init__(self):
         self.start_time = now()
+        # outer lock spanning both counters: update() touches two metrics
+        # (each internally locked), and get() must snapshot them
+        # ATOMICALLY — the reference's actor mailbox guarantee, which two
+        # independent per-metric locks alone would not preserve
         self._lock = threading.Lock()
-        self._status_count: Counter = Counter()
-        self._ete_count: Counter = Counter()
+        self._registry = MetricsRegistry()
+        self._status = self._registry.counter(
+            "pio_app_responses_total",
+            "Responses by app and HTTP status since server start",
+            labels=("app_id", "status"),
+        )
+        self._ete = self._registry.counter(
+            "pio_app_events_total",
+            "Accepted events by app/entityType/event/targetEntityType",
+            labels=("app_id", "entity_type", "event", "target_entity_type"),
+        )
 
-    def update(self, app_id: int, status_code: int, event: Event) -> None:
+    def update(self, app_id: int, status_code: int,
+               event: Event | None = None) -> None:
+        """Record one outcome. ``event`` is None on requests that never
+        produced a valid event (4xx/5xx) — those now count in the
+        ``statusCode`` section instead of vanishing."""
         with self._lock:
-            self._status_count[(app_id, status_code)] += 1
-            self._ete_count[
-                (app_id, event.entity_type, event.event, event.target_entity_type)
-            ] += 1
+            self._status.inc(app_id=str(app_id), status=str(status_code))
+            if event is not None:
+                self._ete.inc(
+                    app_id=str(app_id),
+                    entity_type=event.entity_type,
+                    event=event.event,
+                    target_entity_type=event.target_entity_type or _NONE,
+                )
 
     def get(self, app_id: int) -> dict:
         """Snapshot for one app (ref: Stats.get → StatsSnapshot)."""
+        aid = str(app_id)
         with self._lock:
-            basic = [
-                {
-                    "entityType": et,
-                    "event": ev,
-                    "targetEntityType": tet,
-                    "count": c,
-                }
-                for (aid, et, ev, tet), c in self._ete_count.items()
-                if aid == app_id
-            ]
-            status = [
-                {"status": code, "count": c}
-                for (aid, code), c in self._status_count.items()
-                if aid == app_id
-            ]
+            ete_items = self._ete.items()
+            status_items = self._status.items()
+        basic = [
+            {
+                "entityType": et,
+                "event": ev,
+                "targetEntityType": None if tet == _NONE else tet,
+                "count": int(c),
+            }
+            for (a, et, ev, tet), c in ete_items
+            if a == aid
+        ]
+        status = [
+            {"status": int(code), "count": int(c)}
+            for (a, code), c in status_items
+            if a == aid
+        ]
         return {
             "startTime": format_datetime(self.start_time),
             "basic": basic,

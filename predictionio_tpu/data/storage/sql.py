@@ -43,7 +43,17 @@ from predictionio_tpu.data.storage.base import (
     StorageError,
     generate_access_key,
 )
+from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 from predictionio_tpu.utils.time import format_datetime, parse_datetime, to_millis
+
+#: How many statements one WAL commit made durable — the group-commit
+#: coalescing factor (1 = a lone connection paying the full commit).
+_GROUP_COMMIT_SIZE = REGISTRY.histogram(
+    "pio_group_commit_size",
+    "Statements made durable per shared sqlite WAL commit",
+    buckets=DEFAULT_SIZE_BUCKETS,
+)
 
 
 class Dialect:
@@ -191,6 +201,9 @@ class SQLClient:
                 pending = self._gc_pending
                 self.conn.commit()
             with self._gc_cv:
+                group = pending - self._gc_committed
+                if group > 0:
+                    _GROUP_COMMIT_SIZE.observe(float(group))
                 self._gc_committed = max(self._gc_committed, pending)
         except BaseException as e:
             # the open transaction holds every uncommitted statement; roll

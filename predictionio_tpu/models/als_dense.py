@@ -915,6 +915,8 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     single-device path."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from predictionio_tpu.parallel.mesh import shard_map
+
     from predictionio_tpu.models.als import _init_factors
 
     p = params
@@ -1024,7 +1026,7 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
         uf_l, itf = jax.lax.fori_loop(0, iters, body, (uf_l, itf))
         return uf_l, itf
 
-    shard_fn = jax.jit(jax.shard_map(
+    shard_fn = jax.jit(shard_map(
         spmd_train, mesh=mesh,
         in_specs=(P(), P("data", None), P("data", None), P("data", None),
                   P("data"), P("data", None), P(), P(), P()),

@@ -30,7 +30,12 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.parallel.mesh import ComputeContext, DATA_AXIS, MODEL_AXIS
+from predictionio_tpu.parallel.mesh import (
+    ComputeContext,
+    DATA_AXIS,
+    MODEL_AXIS,
+    shard_map,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -274,7 +279,7 @@ def make_train_step(ctx: ComputeContext, p: TwoTowerParams, tx):
                 ]
             return jax.lax.pmean(losses.mean(), DATA_AXIS)
 
-        return jax.shard_map(
+        return shard_map(
             shard_loss,
             mesh=mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),

@@ -1,0 +1,40 @@
+"""Process-wide observability layer (L0, no deps on other layers).
+
+The reference leans on per-query bookkeeping and the Spark UI for
+visibility (ref: CreateServer.scala:418-420,603-610); this port serves
+heavy traffic from long-lived Python processes, where the prerequisite
+for every perf PR is quantified hot paths. This package provides:
+
+  * :class:`MetricsRegistry` — thread-safe process registry of
+    :class:`Counter` / :class:`Gauge` / :class:`Histogram` metrics.
+    Histograms are log-bucketed (fixed exponential bounds, no per-sample
+    storage) and answer p50/p90/p99 queries by in-bucket interpolation.
+  * Prometheus text exposition (:meth:`MetricsRegistry.expose`), mounted
+    as ``GET /metrics`` on every server via
+    :func:`predictionio_tpu.utils.http.add_metrics_route`.
+  * A request-id context (:mod:`predictionio_tpu.obs.context`): honor an
+    incoming ``X-Request-ID``, else generate one; the id flows through
+    log records and the feedback loop (query server → event server).
+  * JAX compile hooks (:mod:`predictionio_tpu.obs.jax_hooks`): compile
+    count and cumulative compile seconds as registry metrics.
+
+Naming convention (enforced at registration): ``pio_`` prefix +
+snake_case, so metric names stay scrape-stable across PRs
+(tests/test_obs.py guards it).
+"""
+
+from predictionio_tpu.obs.context import (  # noqa: F401
+    REQUEST_ID_HEADER,
+    current_request_id,
+    ensure_request_id,
+    new_request_id,
+    request_id_var,
+)
+from predictionio_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    validate_metric_name,
+)

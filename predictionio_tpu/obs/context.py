@@ -1,0 +1,94 @@
+"""Request-id context: one id per request, honored end to end.
+
+The id rides a ``contextvars.ContextVar`` so it follows the request
+through handler code without threading a parameter everywhere (each
+HTTP connection is served on its own thread, and contextvars are
+per-thread by default — no cross-request bleed).
+
+Flow: :meth:`utils.http.AppServer` sets the var from the incoming
+``X-Request-ID`` header (generating one when absent), echoes it on the
+response, and resets it after the response is written. The query server
+forwards it on the feedback POST to the event server and attaches it to
+the feedback event, so one user query is traceable across both services
+and the event store.
+
+Log records grow a ``request_id`` attribute (``-`` outside a request)
+via a record factory installed on first import, so any format string
+can include ``%(request_id)s``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import uuid
+
+__all__ = [
+    "REQUEST_ID_HEADER",
+    "request_id_var",
+    "new_request_id",
+    "ensure_request_id",
+    "current_request_id",
+]
+
+REQUEST_ID_HEADER = "X-Request-ID"
+
+#: Caps a client-supplied id; longer ids are truncated, not rejected —
+#: an oversized tracing header should never fail the request itself.
+MAX_REQUEST_ID_LEN = 128
+
+request_id_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "pio_request_id", default=None
+)
+
+
+def new_request_id() -> str:
+    """16 hex chars — short enough for logs, unique enough per process
+    fleet (64 random bits)."""
+    return uuid.uuid4().hex[:16]
+
+
+def _sanitize(raw: str) -> str | None:
+    """Printable ASCII, header-safe, bounded; None when nothing survives.
+    ASCII-only is load-bearing: the id is written back into a response
+    header block encoded as iso-8859-1, so wider characters would crash
+    the response write after the handler already succeeded."""
+    cleaned = "".join(
+        ch for ch in raw.strip()
+        if " " <= ch <= "~" and ch not in '",\\'
+    )
+    return cleaned[:MAX_REQUEST_ID_LEN] or None
+
+
+def ensure_request_id(incoming: str | None = None) -> str:
+    """The id for this request: a sanitized incoming ``X-Request-ID``
+    when the client sent one, else a fresh id. Does NOT set the
+    contextvar — callers hold the reset token (utils/http.py)."""
+    if incoming:
+        cleaned = _sanitize(incoming)
+        if cleaned:
+            return cleaned
+    return new_request_id()
+
+
+def current_request_id() -> str | None:
+    """The id of the request being served on this thread, or None."""
+    return request_id_var.get()
+
+
+def _install_record_factory() -> None:
+    """Give every LogRecord a ``request_id`` attribute (idempotent)."""
+    old = logging.getLogRecordFactory()
+    if getattr(old, "_pio_request_id_factory", False):
+        return
+
+    def factory(*args, **kwargs):
+        record = old(*args, **kwargs)
+        record.request_id = request_id_var.get() or "-"
+        return record
+
+    factory._pio_request_id_factory = True
+    logging.setLogRecordFactory(factory)
+
+
+_install_record_factory()

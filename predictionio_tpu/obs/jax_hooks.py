@@ -1,0 +1,78 @@
+"""JAX compile-time telemetry → registry metrics.
+
+XLA compiles are the dominant cold-path cost on a TPU deploy (the
+post-deploy batch-shape warmup exists because of them). ``jax.monitoring``
+emits a duration event per backend compile; this hook folds them into:
+
+  * ``pio_jax_compiles_total`` — backend compiles since install
+  * ``pio_jax_compile_seconds_total`` — cumulative backend compile time
+
+The training workflow snapshots these around a train run and publishes
+the deltas into the engine-instance record; the query server's warmup
+compiles show up on ``/metrics`` the same way.
+
+Everything is best-effort: jax versions move the monitoring surface, and
+observability must never sink a train or a deploy.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+#: The duration event one XLA backend compile emits (jax >= 0.4.x).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_install_lock = threading.Lock()
+#: Registries a listener already feeds — idempotent PER REGISTRY, so a
+#: private registry installed after the global one still gets events.
+#: Strong refs on purpose: an id()-keyed set could collide after GC.
+_installed: list[MetricsRegistry] = []
+
+
+def install_jax_compile_hook(registry: MetricsRegistry = REGISTRY) -> bool:
+    """Register a monitoring listener feeding ``registry`` (idempotent
+    per registry). Returns whether the hook is active for it."""
+    with _install_lock:
+        if any(r is registry for r in _installed):
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # jax absent/stripped: run unobserved
+            logger.debug("jax.monitoring unavailable", exc_info=True)
+            return False
+        compiles = registry.counter(
+            "pio_jax_compiles_total", "XLA backend compiles")
+        seconds = registry.counter(
+            "pio_jax_compile_seconds_total",
+            "Cumulative XLA backend compile seconds")
+
+        def on_duration(event: str, duration: float, **kw) -> None:
+            if event == _COMPILE_EVENT:
+                compiles.inc()
+                seconds.inc(max(duration, 0.0))
+
+        try:
+            monitoring.register_event_duration_secs_listener(on_duration)
+        except Exception:
+            logger.debug("jax monitoring listener rejected", exc_info=True)
+            return False
+        _installed.append(registry)
+        return True
+
+
+def jax_compile_stats(registry: MetricsRegistry = REGISTRY) -> dict:
+    """Current totals: ``{"compiles": int, "compile_seconds": float}``
+    (zeros when the hook never installed)."""
+    compiles = registry.get("pio_jax_compiles_total")
+    seconds = registry.get("pio_jax_compile_seconds_total")
+    return {
+        "compiles": int(compiles.total()) if compiles is not None else 0,
+        "compile_seconds": (
+            round(seconds.total(), 4) if seconds is not None else 0.0
+        ),
+    }

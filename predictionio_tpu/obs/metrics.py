@@ -1,0 +1,443 @@
+"""Thread-safe metrics primitives + Prometheus text exposition.
+
+Design notes:
+
+  * One process-global default registry (:data:`REGISTRY`); every server
+    in the process exposes the same registry on its ``/metrics``. The
+    registry is also instantiable for isolated counter sets (the event
+    server's per-instance ``/stats.json`` bookkeeping uses a private
+    one so "since server start" semantics survive in a process that
+    creates several servers).
+  * Registration is get-or-create: module-level metric definitions in
+    different files share one object by name (name/type/label mismatch
+    raises — silent divergence would corrupt the scrape).
+  * Histograms keep ONLY per-bucket counts + sum + count: fixed
+    exponential bounds, so the hot-path cost is a bisect + two adds and
+    memory is O(buckets), never O(samples). Quantiles interpolate
+    linearly inside the containing bucket — the standard Prometheus
+    ``histogram_quantile`` estimate, computed server-side for status
+    pages.
+  * Metric names must match ``pio_`` + snake_case (scrape stability;
+    guarded by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from bisect import bisect_left
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "validate_metric_name",
+    "DEFAULT_SECONDS_BUCKETS",
+    "DEFAULT_SIZE_BUCKETS",
+]
+
+_NAME_RE = re.compile(r"^pio(_[a-z0-9]+)+$")
+_LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: Latency bounds: 50 µs → ~105 s, ×2 per bucket (22 buckets + +Inf).
+#: Covers a 0.1 ms HTTP parse and a multi-second cold XLA compile alike
+#: with ≤ ~41% worst-case quantile error (half a log2 step).
+DEFAULT_SECONDS_BUCKETS: tuple[float, ...] = tuple(
+    5e-05 * 2.0**i for i in range(22)
+)
+
+#: Size/count bounds: 1 → 4096, ×2 (batch sizes, queue depths).
+DEFAULT_SIZE_BUCKETS: tuple[float, ...] = tuple(float(2**i) for i in range(13))
+
+
+def validate_metric_name(name: str) -> str:
+    """Return ``name`` or raise: ``pio_`` prefix + snake_case only."""
+    if not _NAME_RE.match(name):
+        raise ValueError(
+            f"metric name {name!r} violates the naming convention: "
+            "'pio_' prefix + snake_case ([a-z0-9_], no leading/trailing/"
+            "double underscores)"
+        )
+    return name
+
+
+def _validate_labels(label_names: Iterable[str]) -> tuple[str, ...]:
+    names = tuple(label_names)
+    for n in names:
+        if not _LABEL_RE.match(n):
+            raise ValueError(f"label name {n!r} must be snake_case")
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate label names in {names}")
+    return names
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers without a trailing ``.0``."""
+    if v != v or v in (float("inf"), float("-inf")):
+        return {float("inf"): "+Inf", float("-inf"): "-Inf"}.get(v, "NaN")
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Metric:
+    """Base: name/help/labels + one lock guarding the children dict and
+    every value mutation (uncontended CPython lock ≈ 100 ns — noise next
+    to the request path's JSON work)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: Iterable[str] = ()):
+        self.name = validate_metric_name(name)
+        self.help = help
+        self.label_names = _validate_labels(labels)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def _labelstr(self, key: tuple[str, ...], extra: str = "") -> str:
+        parts = [
+            f'{n}="{_escape_label(v)}"' for n, v in zip(self.label_names, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _ScalarMetric(_Metric):
+    """Shared store + snapshot + exposition for the single-value kinds
+    (Counter/Gauge): one copy of the locking and formatting rules."""
+
+    def __init__(self, name, help="", labels=()):
+        super().__init__(name, help, labels)
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def _add(self, amount: float, labels: dict[str, str]) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def items(self) -> list[tuple[tuple[str, ...], float]]:
+        """Snapshot of (label-values, value) pairs."""
+        with self._lock:
+            return list(self._values.items())
+
+    def total(self) -> float:
+        """Sum over every label combination."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def sample_lines(self) -> Iterator[str]:
+        samples = self.items()
+        for key, v in sorted(samples):
+            yield f"{self.name}{self._labelstr(key)} {_fmt(v)}"
+        if not self.label_names and not samples:
+            yield f"{self.name} 0"
+
+
+class Counter(_ScalarMetric):
+    """Monotonic counter. Name by convention ends in ``_total``."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        self._add(amount, labels)
+
+
+class Gauge(_ScalarMetric):
+    """Last-written value (set/inc/dec)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        self._add(amount, labels)
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self._add(-amount, labels)
+
+
+class _HistData:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1 = the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Log-bucketed histogram: fixed exponential bounds, cumulative
+    Prometheus exposition, server-side quantile estimates."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labels=(),
+                 buckets: Iterable[float] | None = None):
+        super().__init__(name, help, labels)
+        bounds = tuple(sorted(buckets or DEFAULT_SECONDS_BUCKETS))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        self._data: dict[tuple[str, ...], _HistData] = {}
+
+    def observe(self, value: float, times: int = 1, **labels: str) -> None:
+        """Record ``value`` (``times`` repetitions share one lock
+        round-trip — the per-request accounting of a coalesced batch)."""
+        key = self._key(labels)
+        idx = bisect_left(self.bounds, value)  # bounds are upper edges
+        with self._lock:
+            d = self._data.get(key)
+            if d is None:
+                d = self._data[key] = _HistData(len(self.bounds))
+            d.counts[idx] += times
+            d.sum += value * times
+            d.count += times
+
+    class _Timer:
+        __slots__ = ("_hist", "_labels", "_t0")
+
+        def __init__(self, hist, labels):
+            self._hist = hist
+            self._labels = labels
+
+        def __enter__(self):
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._hist.observe(
+                time.perf_counter() - self._t0, **self._labels)
+            return False
+
+    def time(self, **labels: str) -> "Histogram._Timer":
+        """``with hist.time(stage="parse"): ...`` — observe the elapsed
+        wall seconds on exit (exceptions included: error paths are
+        exactly the latencies worth recording)."""
+        return Histogram._Timer(self, labels)
+
+    def _merged(self, labels: dict[str, str] | None):
+        """One _HistData view: a specific child, or all children merged
+        (process-wide quantiles for status pages)."""
+        with self._lock:
+            if labels is not None:
+                d = self._data.get(self._key(labels))
+                if d is None:
+                    return None
+                out = _HistData(len(self.bounds))
+                out.counts = list(d.counts)
+                out.sum, out.count = d.sum, d.count
+                return out
+            if not self._data:
+                return None
+            out = _HistData(len(self.bounds))
+            for d in self._data.values():
+                for i, c in enumerate(d.counts):
+                    out.counts[i] += c
+                out.sum += d.sum
+                out.count += d.count
+            return out
+
+    def _quantile_of(self, q: float, counts, count: int) -> float | None:
+        if count <= 0:
+            return None
+        rank = q * count
+        cum = 0
+        for i, c in enumerate(counts):
+            if cum + c >= rank and c > 0:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
+                frac = (rank - cum) / c
+                return lo + frac * (hi - lo)
+            cum += c
+        return self.bounds[-1]
+
+    def quantile(self, q: float, **labels: str) -> float | None:
+        """Estimated q-quantile (0 < q < 1) from the bucket counts, or
+        None with no observations. Labels select one child; with no
+        labels given on a labelled histogram, children are merged."""
+        d = self._merged(labels if (labels or not self.label_names) else None)
+        if d is None:
+            return None
+        return self._quantile_of(q, d.counts, d.count)
+
+    def state(self, **labels: str) -> _HistData:
+        """Frozen copy of the (merged) bucket counts — a baseline for
+        :meth:`quantile_since`, so a consumer created mid-process (a
+        fresh QueryService in a long-lived test process) can report
+        quantiles over ONLY its own lifetime's observations."""
+        d = self._merged(labels if (labels or not self.label_names) else None)
+        return d if d is not None else _HistData(len(self.bounds))
+
+    def quantile_since(self, q: float, baseline: _HistData,
+                       **labels: str) -> float | None:
+        """Quantile of the observations made AFTER ``baseline`` was
+        captured with :meth:`state` (bucket-count subtraction — counts
+        only grow, so the delta is itself a valid histogram)."""
+        d = self._merged(labels if (labels or not self.label_names) else None)
+        if d is None:
+            return None
+        delta = [c - b for c, b in zip(d.counts, baseline.counts)]
+        return self._quantile_of(q, delta, d.count - baseline.count)
+
+    def count(self, **labels: str) -> int:
+        d = self._merged(labels if (labels or not self.label_names) else None)
+        return 0 if d is None else d.count
+
+    def sum(self, **labels: str) -> float:
+        d = self._merged(labels if (labels or not self.label_names) else None)
+        return 0.0 if d is None else d.sum
+
+    def items(self) -> list[tuple[tuple[str, ...], _HistData]]:
+        with self._lock:
+            out = []
+            for key, d in self._data.items():
+                copy = _HistData(len(self.bounds))
+                copy.counts = list(d.counts)
+                copy.sum, copy.count = d.sum, d.count
+                out.append((key, copy))
+            return out
+
+    def sample_lines(self) -> Iterator[str]:
+        for key, d in sorted(self.items()):
+            cum = 0
+            for bound, c in zip(self.bounds, d.counts):
+                cum += c
+                le = f'le="{_fmt(bound)}"'
+                yield (f"{self.name}_bucket"
+                       f"{self._labelstr(key, le)} {cum}")
+            cum += d.counts[-1]
+            inf_labels = self._labelstr(key, 'le="+Inf"')
+            yield f"{self.name}_bucket{inf_labels} {cum}"
+            yield f"{self.name}_sum{self._labelstr(key)} {_fmt(d.sum)}"
+            yield f"{self.name}_count{self._labelstr(key)} {d.count}"
+
+
+class MetricsRegistry:
+    """Named metrics with get-or-create registration and Prometheus
+    text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, labels, **kw) -> _Metric:
+        labels = tuple(labels)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls:
+                    raise ValueError(
+                        f"{name} already registered as {existing.kind}, "
+                        f"not {cls.kind}"
+                    )
+                if existing.label_names != labels:
+                    raise ValueError(
+                        f"{name} already registered with labels "
+                        f"{existing.label_names}, not {labels}"
+                    )
+                if cls is Histogram:
+                    want = tuple(sorted(
+                        kw.get("buckets") or DEFAULT_SECONDS_BUCKETS))
+                    if existing.bounds != want:
+                        # silent divergence here would bucket one
+                        # registrant's samples against the other's bounds
+                        raise ValueError(
+                            f"{name} already registered with different "
+                            "buckets"
+                        )
+                return existing
+            metric = cls(name, help, labels, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labels: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Iterable[float] | None = None) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labels, buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def expose(self) -> str:
+        """Prometheus text format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.sample_lines())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-friendly dump: counters/gauges as {labels: value} maps,
+        histograms as count/sum/p50/p90/p99 (bench captures, status
+        pages)."""
+        out: dict = {}
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            if isinstance(m, Histogram):
+                entry: dict = {}
+                for key, d in sorted(m.items()):
+                    labels = dict(zip(m.label_names, key))
+                    child = {
+                        "count": d.count,
+                        "sum": round(d.sum, 6),
+                    }
+                    for q, tag in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+                        v = m.quantile(q, **labels) if m.label_names else \
+                            m.quantile(q)
+                        if v is not None:
+                            child[tag] = round(v, 6)
+                    entry[",".join(key) or "_"] = child
+                out[m.name] = entry
+            else:
+                out[m.name] = {
+                    ",".join(key) or "_": v for key, v in sorted(m.items())
+                }
+        return out
+
+
+#: The process-global default registry every server exposes.
+REGISTRY = MetricsRegistry()

@@ -9,7 +9,36 @@ raw lax calls, and so the axis-name conventions stay in one place.
 
 from __future__ import annotations
 
+import jax
 from jax import lax
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis from inside a shard_map body.
+    ``lax.axis_size`` where jax ships it; ``psum(1)`` on older versions
+    (constant-folded to the same static int under manual sharding)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pvary(x, axes):
+    """Mark ``x`` varying over manual mesh ``axes`` (scan-carry typing on
+    jax >= 0.6's varying-manual-axes tracer). Older jax has no vma types
+    — identity there."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axes)
+    return x
+
+
+def vma_axes(x, default):
+    """The varying-manual-axes set of ``x`` (what a fresh scan-carry zero
+    must be pvary'd to), or ``default`` on jax without vma typing."""
+    if hasattr(jax, "typeof"):
+        return tuple(jax.typeof(x).vma)
+    return tuple(default)
 
 
 def all_gather_rows(x, axis_name: str):
@@ -26,7 +55,7 @@ def psum_mean(x, axis_name: str):
 
 def ring_permute(x, axis_name: str, *, reverse: bool = False):
     """Rotate blocks one hop around the ring (ICI neighbor exchange)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if reverse:
         perm = [(i, (i - 1) % n) for i in range(n)]
     else:

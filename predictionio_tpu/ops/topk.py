@@ -29,6 +29,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.parallel.mesh import shard_map
+
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
 def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192,
@@ -175,7 +177,7 @@ def _sharded_topk_fn(mesh, axis: str, k: int, n: int, local_n: int,
             return local_topk(q, it, None)
 
         in_specs = (P(), P(axis, None))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
         check_vma=False,
     ))

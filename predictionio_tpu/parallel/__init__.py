@@ -11,4 +11,5 @@ from predictionio_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     compute_context,
     replicated,
+    shard_map,
 )

@@ -33,6 +33,23 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """``jax.shard_map`` across jax versions: the public top-level API
+    (jax >= 0.6) when present, else ``jax.experimental.shard_map`` —
+    whose replication-check kwarg is spelled ``check_rep``. All product
+    call sites route through here so a version bump is one-file."""
+    native = getattr(jax, "shard_map", None)
+    kw = {}
+    if native is None:
+        from jax.experimental.shard_map import shard_map as native
+
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+    elif check_vma is not None:
+        kw["check_vma"] = check_vma
+    return native(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 @dataclass(frozen=True)
 class ComputeContext:
     """Mesh + sharding helpers handed to every DASE component at train time

@@ -21,7 +21,13 @@ from predictionio_tpu.data.storage.base import (
     Channel,
     StorageError,
 )
-from predictionio_tpu.utils.http import AppServer, HTTPError, Request, Router
+from predictionio_tpu.utils.http import (
+    AppServer,
+    HTTPError,
+    Request,
+    Router,
+    add_metrics_route,
+)
 
 
 def _app_json(app: App) -> dict:
@@ -115,9 +121,10 @@ def build_router() -> Router:
     r.add("POST", "/cmd/app", new_app)
     r.add("DELETE", "/cmd/app/{name}/data", delete_app_data)
     r.add("DELETE", "/cmd/app/{name}", delete_app)
+    add_metrics_route(r)
     return r
 
 
 def create_admin_server(ip: str = "127.0.0.1", port: int = 7071) -> AppServer:
     """ref: AdminAPI.scala (admin server port 7071)."""
-    return AppServer(build_router(), host=ip, port=port)
+    return AppServer(build_router(), host=ip, port=port, server_name="admin")

@@ -13,12 +13,14 @@ import html
 
 from predictionio_tpu.data.storage import Storage
 from predictionio_tpu.data.storage.base import EvaluationInstance
+from predictionio_tpu.obs import REGISTRY
 from predictionio_tpu.utils.http import (
     AppServer,
     HTTPError,
     RawResponse,
     Request,
     Router,
+    add_metrics_route,
 )
 
 _PAGE = """<!DOCTYPE html>
@@ -37,7 +39,24 @@ _PAGE = """<!DOCTYPE html>
 <th>Params generator</th><th>Batch</th><th>Result</th><th></th></tr>
 {rows}
 </table>
+{metrics}
 </body></html>"""
+
+_METRICS_FOOTER = ('<p>Serving latency (this process): {latency} &middot; '
+                   '<a href="/metrics">Prometheus metrics</a></p>')
+
+
+def _metrics_footer() -> str:
+    """Top-line serve p50/p99 when the query server shares this process
+    (combined deployments / tests); always links the scrape endpoint."""
+    hist = REGISTRY.get("pio_query_seconds")
+    p50 = hist.quantile(0.5) if hist is not None else None
+    p99 = hist.quantile(0.99) if hist is not None else None
+    if p50 is None or p99 is None:
+        latency = "no queries served"
+    else:
+        latency = f"p50 {p50 * 1e3:.2f} ms / p99 {p99 * 1e3:.2f} ms"
+    return _METRICS_FOOTER.format(latency=latency)
 
 _ROW = ("<tr><td>{id}</td><td>{start}</td><td>{end}</td><td>{cls}</td>"
         "<td>{gen}</td><td>{batch}</td><td>{result}</td>"
@@ -67,7 +86,8 @@ def build_router() -> Router:
             )
             for i in instances
         )
-        return 200, RawResponse(_PAGE.format(count=len(instances), rows=rows))
+        return 200, RawResponse(_PAGE.format(
+            count=len(instances), rows=rows, metrics=_metrics_footer()))
 
     def _get(request: Request) -> EvaluationInstance:
         iid = request.path_params["instance_id"]
@@ -90,9 +110,11 @@ def build_router() -> Router:
           results_html)
     r.add("GET", "/engine_instances/{instance_id}/evaluator_results.json",
           results_json)
+    add_metrics_route(r)
     return r
 
 
 def create_dashboard(ip: str = "0.0.0.0", port: int = 9000) -> AppServer:
     """ref: Dashboard.scala:36-141 (port 9000 default at :35)."""
-    return AppServer(build_router(), host=ip, port=port)
+    return AppServer(build_router(), host=ip, port=port,
+                     server_name="dashboard")

@@ -20,7 +20,28 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
+from predictionio_tpu.obs import (
+    REGISTRY,
+    REQUEST_ID_HEADER,
+    ensure_request_id,
+    request_id_var,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+
 logger = logging.getLogger(__name__)
+
+# Per-server HTTP telemetry, shared by every AppServer in the process
+# (the ``server`` label separates event/query/admin/dashboard traffic).
+_HTTP_REQUESTS = REGISTRY.counter(
+    "pio_http_requests_total",
+    "HTTP responses by server and status code",
+    labels=("server", "status"),
+)
+_HTTP_SECONDS = REGISTRY.histogram(
+    "pio_http_request_seconds",
+    "Wall seconds from request dispatch to response written",
+    labels=("server",),
+)
 
 
 @dataclass
@@ -35,12 +56,24 @@ class Request:
     def json(self) -> Any:
         if not self.body:
             return None
-        return json.loads(self.body)  # accepts UTF-8 bytes directly
+        try:
+            return json.loads(self.body)  # accepts UTF-8 bytes directly
+        except UnicodeDecodeError as e:
+            # undecodable bytes are the client's malformed body, same as
+            # malformed JSON: surface as the error class every layer
+            # already maps to 400 — a wide UnicodeDecodeError catch at
+            # dispatch level would misclassify handler-internal decode
+            # bugs as client errors
+            raise json.JSONDecodeError(f"invalid UTF-8 body: {e}", "", 0) \
+                from e
 
     def form(self) -> dict[str, str]:
-        parsed = urllib.parse.parse_qs(
-            self.body.decode("utf-8"), keep_blank_values=True
-        )
+        try:
+            decoded = self.body.decode("utf-8")
+        except UnicodeDecodeError as e:
+            # ValueError flows through the ingest handlers' 400 paths
+            raise ValueError(f"invalid UTF-8 form body: {e}") from e
+        parsed = urllib.parse.parse_qs(decoded, keep_blank_values=True)
         return {k: v[0] for k, v in parsed.items()}
 
 
@@ -223,16 +256,19 @@ class AppServer:
     worker; a single process is GIL-bound at ~3k events/s)."""
 
     def __init__(self, router: Router, host: str = "0.0.0.0",
-                 port: int = 8000, reuse_port: bool = False):
+                 port: int = 8000, reuse_port: bool = False,
+                 server_name: str = "app"):
         self.router = router
         self.host = host
         self.port = port
         self.reuse_port = reuse_port
+        self.server_name = server_name
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     def _make_handler(self):
         router = self.router
+        server_name = self.server_name
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -243,6 +279,14 @@ class AppServer:
 
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 logger.debug("%s %s", self.address_string(), fmt % args)
+
+            def send_error(self, code, message=None, explain=None):
+                # protocol-level rejects (bad request line, oversized or
+                # conflicting headers, bad Content-Length) never reach the
+                # instrumented writer in _handle — count them here so a
+                # flood of malformed requests stays visible on /metrics
+                _HTTP_REQUESTS.inc(server=server_name, status=str(code))
+                super().send_error(code, message, explain)
 
             def parse_request(self) -> bool:
                 """Fast-path replacement for the stdlib parse_request: raw
@@ -352,6 +396,7 @@ class AppServer:
                 return True
 
             def _handle(self):
+                t0 = time.perf_counter()
                 path, query = _parse_target(self.path)
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
@@ -372,39 +417,56 @@ class AppServer:
                     headers=_first_wins_dict(self.headers.items()),
                     body=body,
                 )
+                # request id: honor the incoming header, else mint one; the
+                # contextvar scopes it to this handler thread so logs and
+                # the feedback loop can pick it up without plumbing
+                rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
+                rid_token = request_id_var.set(rid)
                 try:
-                    status, payload = router.dispatch(request)
-                except HTTPError as e:
-                    status, payload = e.status, {"message": e.message}
-                except json.JSONDecodeError as e:
-                    status, payload = 400, {"message": f"Invalid JSON: {e}"}
-                except Exception as e:  # last-resort 500, mirror exceptionHandler
-                    logger.exception("handler error")
-                    status, payload = 500, {"message": str(e)}
-                if isinstance(payload, RawResponse):
-                    data = (
-                        payload.body.encode("utf-8")
-                        if isinstance(payload.body, str)
-                        else payload.body
-                    )
-                    content_type = payload.content_type
-                else:
-                    data = json.dumps(payload).encode("utf-8")
-                    content_type = "application/json; charset=UTF-8"
-                # ONE buffer, ONE sendall: status line + headers + body (the
-                # stdlib send_response/send_header path flushes headers and
-                # body as separate writes — two syscalls and TCP segments
-                # per response; measured ~25% of server CPU on ingest)
-                phrase = self.responses.get(status, ("", ""))[0]
-                resp = (
-                    f"HTTP/1.1 {status} {phrase}\r\n"
-                    f"Server: {self.version_string()}\r\n"
-                    f"Date: {_http_date(time.time())}\r\n"
-                    f"Content-Type: {content_type}\r\n"
-                    f"Content-Length: {len(data)}\r\n\r\n"
-                ).encode("iso-8859-1") + data
-                self.wfile.write(resp)
-                self.log_request(status, len(data))
+                    try:
+                        status, payload = router.dispatch(request)
+                    except HTTPError as e:
+                        status, payload = e.status, {"message": e.message}
+                    except json.JSONDecodeError as e:
+                        # includes invalid UTF-8 bodies: Request.json()
+                        # translates UnicodeDecodeError to this class
+                        status, payload = 400, {"message": f"Invalid JSON: {e}"}
+                    except Exception as e:  # last-resort 500, mirror exceptionHandler
+                        logger.exception("handler error")
+                        status, payload = 500, {"message": str(e)}
+                    if isinstance(payload, RawResponse):
+                        data = (
+                            payload.body.encode("utf-8")
+                            if isinstance(payload.body, str)
+                            else payload.body
+                        )
+                        content_type = payload.content_type
+                    else:
+                        data = json.dumps(payload).encode("utf-8")
+                        content_type = "application/json; charset=UTF-8"
+                    # ONE buffer, ONE sendall: status line + headers + body (the
+                    # stdlib send_response/send_header path flushes headers and
+                    # body as separate writes — two syscalls and TCP segments
+                    # per response; measured ~25% of server CPU on ingest)
+                    phrase = self.responses.get(status, ("", ""))[0]
+                    resp = (
+                        f"HTTP/1.1 {status} {phrase}\r\n"
+                        f"Server: {self.version_string()}\r\n"
+                        f"Date: {_http_date(time.time())}\r\n"
+                        f"{REQUEST_ID_HEADER}: {rid}\r\n"
+                        f"Content-Type: {content_type}\r\n"
+                        f"Content-Length: {len(data)}\r\n\r\n"
+                    ).encode("iso-8859-1") + data
+                    self.wfile.write(resp)
+                    _HTTP_REQUESTS.inc(
+                        server=server_name, status=str(status))
+                    _HTTP_SECONDS.observe(
+                        time.perf_counter() - t0, server=server_name)
+                    # log while the contextvar still holds the id, so the
+                    # access-log record carries %(request_id)s
+                    self.log_request(status, len(data))
+                finally:
+                    request_id_var.reset(rid_token)
 
             do_GET = do_POST = do_DELETE = do_PUT = _handle
 
@@ -441,6 +503,26 @@ class AppServer:
     def wait(self) -> None:
         if self._thread is not None:
             self._thread.join()
+
+
+#: Prometheus text exposition content type (format 0.0.4).
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def add_metrics_route(router: Router,
+                      registry: MetricsRegistry = REGISTRY) -> Router:
+    """Mount ``GET /metrics`` (Prometheus text format) on ``router``.
+
+    Shared by the event server, query server, admin API, and dashboard
+    so every process exposes the same scrape surface. Unauthenticated by
+    design, like the reference's status pages: the payload is aggregate
+    numbers, and scrapers don't carry app access keys."""
+
+    def metrics(request: Request):
+        return 200, RawResponse(registry.expose(), METRICS_CONTENT_TYPE)
+
+    router.add("GET", "/metrics", metrics)
+    return router
 
 
 def free_port() -> int:

@@ -48,7 +48,14 @@ class PhaseTimer:
             self.phases.append((name, time.perf_counter() - t0))
 
     def report(self) -> dict[str, float]:
-        out = {name: round(dt, 4) for name, dt in self.phases}
+        """Total seconds per phase name, aggregated in first-seen order —
+        a phase entered repeatedly (``read``/``train`` once per algorithm
+        in a multi-algorithm engine) reports the SUM of its runs, not
+        just the last one."""
+        agg: dict[str, float] = {}
         for name, dt in self.phases:
+            agg[name] = agg.get(name, 0.0) + dt
+        out = {name: round(dt, 4) for name, dt in agg.items()}
+        for name, dt in agg.items():
             logger.info("phase %-20s %8.3fs", name, dt)
         return out

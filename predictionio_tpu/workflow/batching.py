@@ -19,10 +19,33 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Callable, Sequence
 
+from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
+
 __all__ = ["MicroBatcher"]
+
+# Serving telemetry. queue_wait is a stage of the same histogram the
+# query server's other stages land in — ONE definition here, imported by
+# create_server.py, so the name/labels can never drift between the two
+# registrants (a mismatch would raise at import time).
+QUERY_STAGE_SECONDS = REGISTRY.histogram(
+    "pio_query_stage_seconds",
+    "Per-stage query latency: parse, queue_wait, predict, serve, feedback",
+    labels=("stage",),
+)
+_BATCH_SIZE = REGISTRY.histogram(
+    "pio_microbatch_size",
+    "Requests coalesced per drained micro-batch",
+    buckets=DEFAULT_SIZE_BUCKETS,
+)
+_QUEUE_DEPTH = REGISTRY.gauge(
+    "pio_microbatch_queue_depth",
+    "Submitted queries still waiting after the last drain (occupancy)",
+)
 
 
 class MicroBatcher:
@@ -53,7 +76,7 @@ class MicroBatcher:
         """Block until the consumer thread has processed ``item``; returns
         its result or re-raises its exception in the caller thread."""
         f: Future = Future()
-        self._q.put((item, f))
+        self._q.put((item, f, time.perf_counter()))
         return f.result()
 
     def _loop(self) -> None:
@@ -64,8 +87,14 @@ class MicroBatcher:
                     pairs.append(self._q.get_nowait())
                 except queue.Empty:
                     break
+            drained = time.perf_counter()
             items = [p[0] for p in pairs]
             futures = [p[1] for p in pairs]
+            for _, _, submitted in pairs:
+                QUERY_STAGE_SECONDS.observe(drained - submitted,
+                                            stage="queue_wait")
+            _BATCH_SIZE.observe(float(len(pairs)))
+            _QUEUE_DEPTH.set(self._q.qsize())
             self.batch_count += 1
             self.request_count += len(items)
             self.max_batch_seen = max(self.max_batch_seen, len(items))

@@ -36,39 +36,55 @@ def run_train(
     """Train → persist models → mark instance COMPLETED
     (ref: CoreWorkflow.runTrain:42-99). Returns the instance id.
     ``trace_dir`` wraps training in a JAX device trace (xprof)."""
+    from predictionio_tpu.obs import REGISTRY
+    from predictionio_tpu.obs.jax_hooks import (
+        install_jax_compile_hook,
+        jax_compile_stats,
+    )
     from predictionio_tpu.utils.profiling import PhaseTimer, device_trace
 
     wp = params or WorkflowParams()
     instances = Storage.get_meta_data_engine_instances()
     instance_id = instances.insert(engine_instance)
     logger.info("engine instance %s: INIT", instance_id)
+    install_jax_compile_hook()
+    compile_before = jax_compile_stats()
     try:
         ctx = workflow_context(batch=wp.batch, mode="Training")
         timer = PhaseTimer()
-        with device_trace(trace_dir), timer.phase("train"):
-            models = engine.train(ctx, engine_params, wp)
-        timer.report()
-        # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
-        algorithms = engine._algorithms(engine_params)
-        persisted = []
-        for algo, model in zip(algorithms, models):
-            p = algo.make_persistent_model(ctx, instance_id, model)
-            if isinstance(p, PersistentModel):
-                saved = p.save(instance_id, None)
-                p = (
-                    PersistentModelManifest(class_path(type(p)))
-                    if saved
-                    else model
-                )
-            persisted.append(p)
-        blob = serialize_models(persisted)
-        Storage.get_model_data_models().insert(Model(instance_id, blob))
+        try:
+            with device_trace(trace_dir), timer.phase("train"):
+                models = engine.train(ctx, engine_params, wp)
+            # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
+            with timer.phase("persist"):
+                algorithms = engine._algorithms(engine_params)
+                persisted = []
+                for algo, model in zip(algorithms, models):
+                    p = algo.make_persistent_model(ctx, instance_id, model)
+                    if isinstance(p, PersistentModel):
+                        saved = p.save(instance_id, None)
+                        p = (
+                            PersistentModelManifest(class_path(type(p)))
+                            if saved
+                            else model
+                        )
+                    persisted.append(p)
+                blob = serialize_models(persisted)
+                Storage.get_model_data_models().insert(Model(instance_id, blob))
+        finally:
+            # report in a finally so a persist-stage failure still logs
+            # where the (possibly hours-long) train spent its time
+            phases = timer.report()
         logger.info("model data saved: %d bytes", len(blob))
+        train_env = _publish_train_telemetry(
+            REGISTRY, phases, compile_before, jax_compile_stats())
+        current = instances.get(instance_id)
         done = EngineInstance(
             **{
-                **instances.get(instance_id).__dict__,
+                **current.__dict__,
                 "status": "COMPLETED",
                 "end_time": now(),
+                "env": {**current.env, **train_env},
             }
         )
         instances.update(done)
@@ -85,6 +101,40 @@ def run_train(
         )
         instances.update(aborted)
         raise
+
+
+def _publish_train_telemetry(
+    registry, phases: dict[str, float], before: dict, after: dict,
+) -> dict[str, str]:
+    """Phase wall-times and the run's JAX compile delta, published twice:
+    as registry gauges (the trainer process's /metrics, when it serves
+    one) and as the string map merged into the engine-instance ``env``
+    record — so the dashboard/admin API can show where a historical train
+    spent its time without scraping the (long-gone) trainer process."""
+    phase_gauge = registry.gauge(
+        "pio_train_phase_seconds",
+        "Wall seconds per phase of the last completed train",
+        labels=("phase",),
+    )
+    env: dict[str, str] = {}
+    for name, dt in phases.items():
+        phase_gauge.set(dt, phase=name)
+        env[f"pio_train_phase_{name}_seconds"] = str(dt)
+    compiles = int(after["compiles"] - before["compiles"])
+    compile_sec = round(after["compile_seconds"] - before["compile_seconds"], 4)
+    compile_gauge = registry.gauge(
+        "pio_train_jax_compiles",
+        "XLA backend compiles during the last completed train",
+    )
+    compile_sec_gauge = registry.gauge(
+        "pio_train_jax_compile_seconds",
+        "XLA backend compile seconds during the last completed train",
+    )
+    compile_gauge.set(compiles)
+    compile_sec_gauge.set(compile_sec)
+    env["pio_train_jax_compiles"] = str(compiles)
+    env["pio_train_jax_compile_seconds"] = str(compile_sec)
+    return env
 
 
 def new_engine_instance(

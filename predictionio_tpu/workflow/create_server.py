@@ -31,14 +31,19 @@ import html
 from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
 from predictionio_tpu.core.persistent_model import deserialize_models
 from predictionio_tpu.data.storage import Storage
+from predictionio_tpu.obs import REGISTRY, REQUEST_ID_HEADER, current_request_id
 from predictionio_tpu.utils.http import (
     AppServer,
     HTTPError,
     RawResponse,
     Request,
     Router,
+    add_metrics_route,
 )
 from predictionio_tpu.utils.time import format_datetime, now
+from predictionio_tpu.workflow.batching import (
+    QUERY_STAGE_SECONDS as _STAGE_SECONDS,
+)
 from predictionio_tpu.workflow.context import workflow_context
 from predictionio_tpu.workflow.engine_loader import get_engine
 from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
@@ -46,6 +51,42 @@ from predictionio_tpu.workflow.server_plugins import EngineServerPluginContext
 logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 8000  # ref: CreateServer.scala:88
+
+# Serving hot-path telemetry. The per-stage histogram is DEFINED in
+# workflow/batching.py (which observes stage="queue_wait") and imported
+# above; the reference exposes only a running average
+# (CreateServer.scala:603-610), which hides exactly the tail behavior
+# micro-batching exists to fix.
+_QUERY_SECONDS = REGISTRY.histogram(
+    "pio_query_seconds",
+    "End-to-end POST /queries.json latency (success paths)",
+)
+_QUERY_REQUESTS = REGISTRY.counter(
+    "pio_query_requests_total",
+    "Every /queries.json request, error paths included",
+)
+_QUERY_ERRORS = REGISTRY.counter(
+    "pio_query_errors_total",
+    "Failed /queries.json requests by kind (bad_request, predict, plugin)",
+    labels=("kind",),
+)
+
+#: Set on the batch-shape warmup thread: its replays pay deliberate XLA
+#: compiles that must NOT land in the live-serving stage histograms (a
+#: multi-second warmup compile would read as a device regression).
+_warmup_thread = threading.local()
+
+
+def _observe_stage(stage: str, seconds: float, times: int = 1) -> None:
+    """Explicit stage observation honoring the warmup-thread gate.
+
+    ``times`` keeps every stage PER-REQUEST: a coalesced micro-batch's
+    device call is observed once per request riding it, like queue_wait
+    — otherwise _sum/_count units would differ across stages of the same
+    histogram and a queueing-vs-device ratio would skew by the
+    coalescing factor."""
+    if not getattr(_warmup_thread, "active", False):
+        _STAGE_SECONDS.observe(seconds, times=max(times, 1), stage=stage)
 
 
 @dataclass
@@ -87,6 +128,11 @@ def _query_to_obj(query_class: type | None, data: dict):
     return query_class(**data)
 
 
+def _fmt_quantile(v: float | None) -> str:
+    """Status-page rendering of a histogram quantile (n/a pre-traffic)."""
+    return "n/a" if v is None else f"{v:.4f} seconds"
+
+
 def _result_to_json(result):
     if dataclasses.is_dataclass(result) and not isinstance(result, type):
         return dataclasses.asdict(result)
@@ -104,8 +150,13 @@ class QueryService:
         self.lock = threading.RLock()
         self.start_time = now()
         self.request_count = 0
+        self.error_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        # histogram baseline at service start: the registry is
+        # process-global, so without the delta a fresh service in a
+        # long-lived process would report a predecessor's latencies
+        self._latency_baseline = _QUERY_SECONDS.state()
         self.plugin_context = EngineServerPluginContext()
         self._stop_event = threading.Event()
         self._batch_shapes_warmed = False
@@ -223,6 +274,7 @@ class QueryService:
             "GET", "/plugins.json",
             lambda req: (200, self.plugin_context.to_json()),
         )
+        add_metrics_route(r)
         return r
 
     def get_status(self, request: Request):
@@ -239,9 +291,17 @@ class QueryService:
                 "engineFactory": self.instance.engine_factory,
                 "startTime": format_datetime(self.start_time),
                 "requestCount": self.request_count,
+                "errorCount": self.error_count,
                 "avgServingSec": round(self.avg_serving_sec, 6),
                 "lastServingSec": round(self.last_serving_sec, 6),
             }
+        # top-line latency quantiles over THIS service's lifetime, from
+        # the log-bucketed histogram (no per-sample storage behind them)
+        p50 = _QUERY_SECONDS.quantile_since(0.5, self._latency_baseline)
+        p99 = _QUERY_SECONDS.quantile_since(0.99, self._latency_baseline)
+        if p50 is not None and p99 is not None:
+            body["p50ServingSec"] = round(p50, 6)
+            body["p99ServingSec"] = round(p99, 6)
         if self.batcher is not None:
             body["batching"] = {
                 "batches": self.batcher.batch_count,
@@ -307,8 +367,13 @@ class QueryService:
     ("Request Count", request_count),
     ("Average Serving Time", f"{avg_s:.4f} seconds"),
     ("Last Serving Time", f"{last_s:.4f} seconds"),
+    ("p50 Serving Time", _fmt_quantile(
+        _QUERY_SECONDS.quantile_since(0.5, self._latency_baseline))),
+    ("p99 Serving Time", _fmt_quantile(
+        _QUERY_SECONDS.quantile_since(0.99, self._latency_baseline))),
     ("Engine Factory Class", inst.engine_factory),
 ])}
+<p><a href="/metrics">Prometheus metrics</a></p>
 <h2>Data Source</h2>
 {table([("Parameters", inst.data_source_params)])}
 <h2>Data Preparator</h2>
@@ -333,32 +398,59 @@ class QueryService:
         reference's sequential predict loop, CreateServer.scala:513-520,
         is what this beats)."""
         t0 = time.perf_counter()
-        data = request.json()
-        if not isinstance(data, dict):
-            return 400, {"message": "JSON object expected."}
-        with self.lock:
-            algorithms = self.algorithms
-            models = self.models
-            serving = self.serving
-        query_class = algorithms[0].query_class
+        _QUERY_REQUESTS.inc()
         try:
-            query = _query_to_obj(query_class, data)
-        except TypeError as e:
-            return 400, {"message": str(e)}
-        if self.batcher is not None:
-            prediction = self.batcher.submit(query)
-            self._maybe_warm_batch_shapes(query)
-        else:
-            supplemented = serving.supplement(query)
-            predictions = [
-                algo.predict(model, supplemented)
-                for algo, model in zip(algorithms, models)
-            ]
-            prediction = serving.serve(query, predictions)
+            with _STAGE_SECONDS.time(stage="parse"):
+                data = request.json()
+                if not isinstance(data, dict):
+                    self._count_error("bad_request")
+                    return 400, {"message": "JSON object expected."}
+                with self.lock:
+                    algorithms = self.algorithms
+                    models = self.models
+                    serving = self.serving
+                query_class = algorithms[0].query_class
+                try:
+                    query = _query_to_obj(query_class, data)
+                except (TypeError, ValueError) as e:
+                    # wrong fields OR a Query dataclass rejecting values
+                    # in __post_init__ — the client's data either way: a
+                    # 400 here keeps the bad_request count matching the
+                    # actual response status
+                    self._count_error("bad_request")
+                    return 400, {"message": str(e)}
+        except HTTPError:  # unknown query fields
+            self._count_error("bad_request")
+            raise
+        except ValueError:  # malformed JSON / invalid UTF-8 body: the
+            self._count_error("bad_request")  # http layer answers 400
+            raise
+        try:
+            if self.batcher is not None:
+                prediction = self.batcher.submit(query)
+                self._maybe_warm_batch_shapes(query)
+            else:
+                with _STAGE_SECONDS.time(stage="predict"):
+                    supplemented = serving.supplement(query)
+                    predictions = [
+                        algo.predict(model, supplemented)
+                        for algo, model in zip(algorithms, models)
+                    ]
+                with _STAGE_SECONDS.time(stage="serve"):
+                    prediction = serving.serve(query, predictions)
+        except Exception:
+            # the paths that used to bypass all bookkeeping: a raised
+            # predict/serve error 500s via the http layer, now counted
+            self._count_error("predict")
+            raise
         result = _result_to_json(prediction)
         # output plugins (ref: CreateServer.scala:598-601)
-        for blocker in self.plugin_context.output_blockers.values():
-            result = blocker.process(query, result, self.plugin_context)
+        try:
+            for blocker in self.plugin_context.output_blockers.values():
+                result = blocker.process(query, result, self.plugin_context)
+        except Exception:
+            self._count_error("plugin")  # a rejecting/broken output
+            raise                        # blocker is still a failed query
         for sniffer in self.plugin_context.output_sniffers.values():
             try:
                 sniffer.process(query, result, self.plugin_context)
@@ -366,15 +458,22 @@ class QueryService:
                 logger.exception("output sniffer failed")
         pr_id = None
         if self.config.feedback:
-            pr_id = self._send_feedback(data, result)
+            with _STAGE_SECONDS.time(stage="feedback"):
+                pr_id = self._send_feedback(data, result)
             if pr_id is not None and isinstance(result, dict):
                 result = {**result, "prId": pr_id}
         dt = time.perf_counter() - t0
+        _QUERY_SECONDS.observe(dt)
         with self.lock:
             self.request_count += 1
             self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
             self.last_serving_sec = dt
         return 200, result
+
+    def _count_error(self, kind: str) -> None:
+        _QUERY_ERRORS.inc(kind=kind)
+        with self.lock:
+            self.error_count += 1
 
     def _maybe_warm_batch_shapes(self, query) -> None:
         """After the first successful query, replay it at every batch
@@ -392,6 +491,7 @@ class QueryService:
             self._batch_shapes_warmed = True
 
         def warm():
+            _warmup_thread.active = True
             top = max(self.config.max_batch, 1)
             sizes = []
             size = 2
@@ -448,7 +548,13 @@ class QueryService:
                 padded = queries + [queries[-1]] * (bp - n)
         supplemented = [serving.supplement(q) for q in padded]
         per_algo: list[list] = []
+        # timing starts AFTER the lock (waiting for the device is queueing,
+        # not device time) and observes only on SUCCESS: a poisoned batch
+        # raises here and gets re-run per query by _predict_batch — an
+        # aborted attempt observing too would double-count the stage and
+        # skew its quantiles exactly during error bursts
         with self._device_lock:
+            t_pred = time.perf_counter()
             for algo, model in zip(algorithms, models):
                 if n > 1 and self._overrides_batch_predict(algo):
                     indexed = algo.batch_predict(
@@ -460,26 +566,39 @@ class QueryService:
                     per_algo.append(
                         [algo.predict(model, q) for q in supplemented[:n]]
                     )
+            _observe_stage("predict", time.perf_counter() - t_pred, times=n)
         out: list = []
+        t_serve = time.perf_counter()
         for i, query in enumerate(queries):
             try:
-                out.append(serving.serve(query, [pa[i] for pa in per_algo]))
+                out.append(
+                    serving.serve(query, [pa[i] for pa in per_algo]))
             except Exception as e:  # noqa: BLE001 — isolate per-request
                 out.append(e)
+        _observe_stage("serve", time.perf_counter() - t_serve, times=n)
         return out
 
     def _send_feedback(self, query_json: dict, result) -> str | None:
         """POST the predict event back to the Event Server with prId
-        (ref: ServerActor:534-596)."""
+        (ref: ServerActor:534-596). The serving request's id travels
+        along — as the outgoing ``X-Request-ID`` header AND a property on
+        the feedback event — so one user query is traceable from the
+        query server's logs to the stored predict event."""
         cfg = self.config
         import uuid
 
         pr_id = uuid.uuid4().hex[:12]
+        properties = {"query": query_json, "prediction": result}
+        headers = {"Content-Type": "application/json"}
+        rid = current_request_id()
+        if rid:
+            properties["requestId"] = rid
+            headers[REQUEST_ID_HEADER] = rid
         event = {
             "event": "predict",
             "entityType": "pio_pr",
             "entityId": pr_id,
-            "properties": {"query": query_json, "prediction": result},
+            "properties": properties,
             "eventTime": format_datetime(now()),
         }
         url = (
@@ -490,7 +609,7 @@ class QueryService:
             req = urllib.request.Request(
                 url,
                 data=json.dumps(event).encode(),
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 method="POST",
             )
             with urllib.request.urlopen(req, timeout=5):
@@ -562,5 +681,6 @@ def undeploy(ip: str, port: int) -> None:
 
 def create_server(config: ServerConfig) -> tuple[AppServer, QueryService]:
     service = QueryService(config)
-    server = AppServer(service.router, config.ip, config.port)
+    server = AppServer(service.router, config.ip, config.port,
+                       server_name="query")
     return server, service

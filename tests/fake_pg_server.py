@@ -320,12 +320,30 @@ class FakePostgresServer:
 
     def _run_query(self, conn, sql: str) -> None:
         translated = translate_sql(sql)
+        # real Postgres always supports INSERT ... RETURNING <col>; the
+        # backing sqlite only grew RETURNING in 3.35 — emulate it there
+        # so the fake stays faithful on older interpreters
+        returning_col = None
+        if sqlite3.sqlite_version_info < (3, 35):
+            m = re.search(
+                r"^\s*INSERT\b.*\s+RETURNING\s+(\w+)\s*$",
+                translated, re.I | re.S,
+            )
+            if m:
+                returning_col = m.group(1)
+                translated = re.sub(
+                    r"\s+RETURNING\s+\w+\s*$", "", translated,
+                    flags=re.I)
         try:
             with self._db_lock:
                 cur = self._db.execute(translated)
                 rows = cur.fetchall()
                 desc = cur.description
                 rowcount = cur.rowcount
+                if returning_col is not None:
+                    rows = [(cur.lastrowid,)]
+                    desc = [(returning_col, None, None, None, None, None,
+                             None)]
         except sqlite3.IntegrityError as e:
             conn.sendall(self._error("23505", str(e)))
             conn.sendall(_msg(b"Z", b"I"))

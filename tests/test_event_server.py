@@ -110,6 +110,23 @@ def test_post_malformed_json_400(server):
     assert ei.value.code == 400
 
 
+def test_invalid_utf8_body_400_not_500(server):
+    """Undecodable bytes are the client's malformed body, like malformed
+    JSON: 400 from the http layer (UnicodeDecodeError is a ValueError
+    but NOT a JSONDecodeError), and the batch route's stats record of a
+    400 stays truthful."""
+    port, key = server["port"], server["key"]
+    for path in ("/events.json", "/batch/events.json"):
+        url = f"http://127.0.0.1:{port}{path}?accessKey={key}"
+        req = urllib.request.Request(
+            url, data=b'\xff\xfe{"a": 1}',
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400, path
+
+
 def test_get_single_event_and_delete(server):
     port, key = server["port"], server["key"]
     _, body = call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
@@ -578,6 +595,100 @@ def test_repeated_query_strings_stay_independent(server):
     status, body = call(server["port"], "GET", "/events.json",
                         {"accessKey": server["key"], "limit": "2"})
     assert status == 200 and len(body) <= 2
+
+
+def test_metrics_endpoint_prometheus_scrape(server):
+    """GET /metrics (no auth) serves Prometheus text format with the
+    ingest counters/histograms; every sample line parses."""
+    import re as _re
+
+    port, key = server["port"], server["key"]
+    call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
+    bad = dict(EVENT, event="$custom")
+    call(port, "POST", "/events.json", {"accessKey": key}, bad)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics"
+    ) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    sample = _re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+        r"(-?[0-9.e+-]+|\+Inf|NaN)$"
+    )
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert sample.match(line), f"unparseable line: {line!r}"
+    assert 'pio_events_ingested_total{status="201"}' in text
+    assert 'pio_events_ingested_total{status="400"}' in text
+    assert "pio_ingest_seconds_bucket" in text
+    assert 'pio_http_requests_total{server="event"' in text
+
+
+def test_stats_status_codes_truthful(server):
+    """4xx outcomes land in /stats.json's statusCode section — not only
+    the 201s (the section used to claim a server that never errs)."""
+    port, key = server["port"], server["key"]
+    call(port, "POST", "/events.json", {"accessKey": key}, EVENT)
+    call(port, "POST", "/events.json", {"accessKey": key},
+         dict(EVENT, event="$custom"))  # 400: reserved name
+    call(port, "POST", "/batch/events.json", {"accessKey": key}, EVENT)  # 400
+    status, body = call(port, "GET", "/stats.json", {"accessKey": key})
+    assert status == 200
+    counts = {d["status"]: d["count"] for d in body["statusCode"]}
+    assert counts.get(201) == 1
+    assert counts.get(400) == 2
+    # basic section only counts accepted events
+    assert sum(d["count"] for d in body["basic"]) == 1
+
+
+def test_batch_storage_failure_recorded(server, monkeypatch):
+    """A storage failure mid insert_batch 500s the request AND records
+    every valid event of the batch — monitoring must not under-report
+    during exactly the incidents it exists for."""
+    from predictionio_tpu.data.api import event_server as es_mod
+
+    from predictionio_tpu.data.storage.memory import MemEvents
+
+    port, key = server["port"], server["key"]
+    before = es_mod._INGESTED.value(status="500")
+
+    def boom(self, events, app_id, channel_id=None):
+        raise RuntimeError("disk full (simulated)")
+
+    monkeypatch.setattr(MemEvents, "insert_batch", boom)
+    batch = [dict(EVENT, entityId=f"f{i}") for i in range(3)]
+    status, body = call(
+        port, "POST", "/batch/events.json", {"accessKey": key}, batch)
+    assert status == 500
+    assert es_mod._INGESTED.value(status="500") == before + 3
+    stats_status, stats_body = call(
+        port, "GET", "/stats.json", {"accessKey": key})
+    counts = {d["status"]: d["count"] for d in stats_body["statusCode"]}
+    assert counts.get(500) == 3
+
+
+def test_request_id_echoed_and_generated(server):
+    port, key = server["port"], server["key"]
+    url = f"http://127.0.0.1:{port}/events.json?accessKey={key}"
+    req = urllib.request.Request(
+        url, data=json.dumps(EVENT).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-ID": "trace-abc-1"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 201
+        assert resp.headers["X-Request-ID"] == "trace-abc-1"
+    # absent header -> server mints one
+    req = urllib.request.Request(
+        url, data=json.dumps(EVENT).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 201
+        assert len(resp.headers["X-Request-ID"]) == 16
 
 
 def test_concurrent_ingest_over_live_http_durable(sqlite_storage, tmp_path):

@@ -1,0 +1,361 @@
+"""Observability subsystem: histogram math, Prometheus exposition,
+request-id context, metric-naming convention guard.
+
+The naming guard is deliberately strict: metric names are a scrape
+contract (dashboards and PromQL recording rules reference them by
+string), so any registered name violating ``pio_`` + snake_case fails
+this file — keeping names scrape-stable across future PRs.
+"""
+
+import re
+import threading
+
+import pytest
+
+from predictionio_tpu.obs import (
+    REGISTRY,
+    MetricsRegistry,
+    ensure_request_id,
+    request_id_var,
+    validate_metric_name,
+)
+from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
+
+NAME_RE = re.compile(r"^pio(_[a-z0-9]+)+$")
+
+# One line of Prometheus text format 0.0.4: comment, or
+# name[{labels}] value — the format a scraper must be able to parse.
+SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'  # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
+    r" (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$"
+)
+
+
+# -- counters / gauges -------------------------------------------------------
+
+
+def test_counter_semantics():
+    r = MetricsRegistry()
+    c = r.counter("pio_test_total", "help", labels=("status",))
+    c.inc(status="201")
+    c.inc(2, status="201")
+    c.inc(status="400")
+    assert c.value(status="201") == 3
+    assert c.value(status="400") == 1
+    assert c.total() == 4
+    with pytest.raises(ValueError):
+        c.inc(-1, status="201")  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc(code="201")  # wrong label name
+
+
+def test_gauge_set_inc_dec():
+    r = MetricsRegistry()
+    g = r.gauge("pio_test_depth")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g.value() == 4
+
+
+def test_registration_is_get_or_create_and_type_safe():
+    r = MetricsRegistry()
+    a = r.counter("pio_shared_total", labels=("x",))
+    b = r.counter("pio_shared_total", labels=("x",))
+    assert a is b
+    with pytest.raises(ValueError):
+        r.gauge("pio_shared_total")  # type conflict
+    with pytest.raises(ValueError):
+        r.counter("pio_shared_total", labels=("y",))  # label conflict
+
+
+def test_counter_thread_safety():
+    r = MetricsRegistry()
+    c = r.counter("pio_race_total")
+
+    def spin():
+        for _ in range(5000):
+            c.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 40_000
+
+
+# -- histogram bucket/quantile math ------------------------------------------
+
+
+def test_histogram_buckets_and_quantiles():
+    r = MetricsRegistry()
+    h = r.histogram("pio_test_seconds")
+    # uniform 1..100 ms: known quantiles, log buckets
+    for i in range(100):
+        h.observe(0.001 * (i + 1))
+    assert h.count() == 100
+    assert h.sum() == pytest.approx(5.05, rel=1e-6)
+    # estimates interpolate inside a x2 bucket: generous-but-real bounds
+    assert h.quantile(0.5) == pytest.approx(0.0505, rel=0.25)
+    assert h.quantile(0.99) == pytest.approx(0.1, rel=0.25)
+    # monotone in q
+    qs = [h.quantile(q) for q in (0.1, 0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+
+
+def test_histogram_empty_and_overflow():
+    r = MetricsRegistry()
+    h = r.histogram("pio_test_seconds", buckets=(0.001, 0.01))
+    assert h.quantile(0.5) is None
+    h.observe(100.0)  # lands in +Inf bucket
+    assert h.count() == 1
+    # quantile of an overflow-only histogram clamps to the top bound
+    assert h.quantile(0.5) == 0.01
+
+
+def test_histogram_labeled_children_and_merge():
+    r = MetricsRegistry()
+    h = r.histogram("pio_test_stage_seconds", labels=("stage",))
+    for _ in range(10):
+        h.observe(0.001, stage="fast")
+        h.observe(1.0, stage="slow")
+    assert h.count(stage="fast") == 10
+    assert h.count() == 20  # merged across children
+    assert h.quantile(0.5, stage="fast") < 0.01
+    assert h.quantile(0.5, stage="slow") > 0.1
+
+
+def test_histogram_size_buckets_exact_powers():
+    r = MetricsRegistry()
+    h = r.histogram("pio_test_batch_size", buckets=DEFAULT_SIZE_BUCKETS)
+    h.observe(1.0)
+    h.observe(64.0)
+    assert h.count() == 2
+
+
+def test_histogram_quantile_since_baseline():
+    r = MetricsRegistry()
+    h = r.histogram("pio_test_delta_seconds")
+    for _ in range(50):
+        h.observe(1.0)  # a predecessor's slow traffic
+    baseline = h.state()
+    assert h.quantile_since(0.5, baseline) is None  # nothing since
+    for _ in range(50):
+        h.observe(0.001)  # this consumer's fast traffic
+    # delta quantile sees only the fast samples; the merged histogram
+    # still carries the slow mode (p90 of the 50/50 mix is in it)
+    assert h.quantile_since(0.9, baseline) < 0.01
+    assert h.quantile(0.9) > 0.01
+
+
+def test_histogram_timer_records_exceptions_too():
+    r = MetricsRegistry()
+    h = r.histogram("pio_test_timed_seconds")
+    with pytest.raises(RuntimeError):
+        with h.time():
+            raise RuntimeError("error paths are latencies too")
+    assert h.count() == 1
+
+
+# -- Prometheus exposition format --------------------------------------------
+
+
+def test_exposition_line_format():
+    r = MetricsRegistry()
+    c = r.counter("pio_fmt_total", "requests", labels=("server", "status"))
+    c.inc(server="event", status="201")
+    g = r.gauge("pio_fmt_depth", "queue depth")
+    g.set(3)
+    h = r.histogram("pio_fmt_seconds", "latency", labels=("stage",))
+    h.observe(0.002, stage="parse")
+    text = r.expose()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+    # histogram carries the full bucket/sum/count series
+    assert 'pio_fmt_seconds_bucket{stage="parse",le="+Inf"} 1' in text
+    assert 'pio_fmt_seconds_count{stage="parse"} 1' in text
+    assert 'pio_fmt_seconds_sum{stage="parse"}' in text
+    # TYPE declarations present
+    assert "# TYPE pio_fmt_total counter" in text
+    assert "# TYPE pio_fmt_depth gauge" in text
+    assert "# TYPE pio_fmt_seconds histogram" in text
+
+
+def test_exposition_bucket_counts_are_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("pio_cum_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.05, 5.0):
+        h.observe(v)
+    lines = [l for l in r.expose().splitlines() if "_bucket" in l]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in lines]
+    assert counts == sorted(counts)  # cumulative => monotone
+    assert counts[-1] == 4  # +Inf bucket sees everything
+
+
+def test_label_value_escaping():
+    r = MetricsRegistry()
+    c = r.counter("pio_esc_total", labels=("path",))
+    c.inc(path='we"ird\\pa\nth')
+    text = r.expose()
+    assert 'path="we\\"ird\\\\pa\\nth"' in text
+
+
+# -- naming convention guard (scrape stability across PRs) -------------------
+
+
+def test_invalid_names_rejected():
+    r = MetricsRegistry()
+    for bad in ("events_total", "pio_CamelCase", "pio__double", "pio_",
+                "pio_trailing_", "Pio_x", "pio-dash"):
+        with pytest.raises(ValueError):
+            validate_metric_name(bad)
+        with pytest.raises(ValueError):
+            r.counter(bad)
+
+
+def test_all_registered_metric_names_follow_convention():
+    """Import every wired module so its module-level metrics register,
+    then assert the whole process registry obeys pio_ + snake_case."""
+    import predictionio_tpu.data.api.event_server  # noqa: F401
+    import predictionio_tpu.data.storage.sql  # noqa: F401
+    import predictionio_tpu.utils.http  # noqa: F401
+    import predictionio_tpu.workflow.batching  # noqa: F401
+    import predictionio_tpu.workflow.create_server  # noqa: F401
+
+    names = REGISTRY.names()
+    assert names, "default registry unexpectedly empty"
+    for name in names:
+        assert NAME_RE.match(name), (
+            f"metric {name!r} violates the pio_ + snake_case convention"
+        )
+    # the acceptance-critical names exist with stable spellings
+    for required in ("pio_events_ingested_total", "pio_query_stage_seconds",
+                     "pio_http_requests_total"):
+        assert required in names
+
+
+# -- request-id context ------------------------------------------------------
+
+
+def test_ensure_request_id_honors_incoming():
+    assert ensure_request_id("abc-123") == "abc-123"
+    # control chars / header-breaking chars are stripped
+    assert ensure_request_id('a\r\nb"c') == "abc"
+    # non-ASCII is stripped too: the id is echoed inside an iso-8859-1
+    # response header block, which must never fail to encode
+    assert ensure_request_id("trace-日本語-7") == "trace--7"
+    # oversized ids are truncated, not rejected
+    assert len(ensure_request_id("x" * 1000)) == 128
+    # nothing usable -> generated
+    generated = ensure_request_id("\r\n")
+    assert generated and len(generated) == 16
+
+
+def test_request_id_var_scoping():
+    assert request_id_var.get() is None
+    token = request_id_var.set("rid-1")
+    try:
+        assert request_id_var.get() == "rid-1"
+    finally:
+        request_id_var.reset(token)
+    assert request_id_var.get() is None
+
+
+def test_log_records_carry_request_id():
+    import logging
+
+    record = logging.getLogger("t").makeRecord(
+        "t", logging.INFO, "f", 1, "m", (), None)
+    assert record.request_id == "-"
+    token = request_id_var.set("rid-log")
+    try:
+        record = logging.getLogger("t").makeRecord(
+            "t", logging.INFO, "f", 1, "m", (), None)
+        assert record.request_id == "rid-log"
+    finally:
+        request_id_var.reset(token)
+
+
+# -- stats facade + phase timer ----------------------------------------------
+
+
+def test_stats_records_non_201_outcomes():
+    from predictionio_tpu.data.api.stats import Stats
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+
+    s = Stats()
+    ev = Event(event="buy", entity_type="user", entity_id="u1",
+               properties=DataMap({}))
+    s.update(7, 201, ev)
+    s.update(7, 400, None)
+    s.update(7, 500, None)
+    s.update(8, 201, ev)  # different app must not leak into app 7
+    snap = s.get(7)
+    statuses = {d["status"]: d["count"] for d in snap["statusCode"]}
+    assert statuses == {201: 1, 400: 1, 500: 1}
+    assert snap["basic"] == [{
+        "entityType": "user", "event": "buy",
+        "targetEntityType": None, "count": 1,
+    }]
+
+
+def test_phase_timer_aggregates_duplicate_names():
+    from predictionio_tpu.utils.profiling import PhaseTimer
+
+    t = PhaseTimer()
+    t.phases = [("read", 1.0), ("train", 2.0), ("read", 3.0),
+                ("train", 4.0)]
+    out = t.report()
+    assert out == {"read": 4.0, "train": 6.0}
+
+
+def test_jax_compile_hook_counts_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.obs.jax_hooks import (
+        install_jax_compile_hook,
+        jax_compile_stats,
+    )
+
+    assert install_jax_compile_hook()
+    before = jax_compile_stats()
+
+    @jax.jit
+    def f(x):
+        return x * 3 + 1  # fresh jaxpr -> guaranteed new compile
+
+    f(jnp.arange(7)).block_until_ready()
+    after = jax_compile_stats()
+    assert after["compiles"] >= before["compiles"] + 1
+    assert after["compile_seconds"] >= before["compile_seconds"]
+
+
+def test_jax_compile_hook_per_registry():
+    """Installing for a second (private) registry after the global one
+    must feed BOTH — the guard is per registry, not process-wide."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.obs.jax_hooks import (
+        install_jax_compile_hook,
+        jax_compile_stats,
+    )
+
+    assert install_jax_compile_hook()  # global (may be installed already)
+    private = MetricsRegistry()
+    assert install_jax_compile_hook(private)
+
+    @jax.jit
+    def g(x):
+        return x * 5 - 2  # fresh jaxpr -> new compile
+
+    g(jnp.arange(3)).block_until_ready()
+    assert jax_compile_stats(private)["compiles"] >= 1

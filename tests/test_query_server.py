@@ -177,9 +177,12 @@ def test_first_query_warms_batch_shapes(server):
     compiles."""
     import time as _time
 
+    from predictionio_tpu.workflow.create_server import _STAGE_SECONDS
+
     service = server["service"]
     assert service.batcher is not None
     assert not service._batch_shapes_warmed
+    predict_obs_before = _STAGE_SECONDS.count(stage="predict")
     status, _ = call(server["port"], "POST", "/queries.json",
                      {"user": "u1", "num": 3})
     assert status == 200
@@ -196,6 +199,10 @@ def test_first_query_warms_batch_shapes(server):
     # warmup must not count as served requests
     status, body = call(server["port"], "GET", "/")
     assert body["requestCount"] == 1
+    # ... nor pollute the live stage histograms: the warmup's pow2
+    # replays (with their compiles) must not observe stage="predict",
+    # only the one real query does
+    assert _STAGE_SECONDS.count(stage="predict") == predict_obs_before + 1
 
 
 def test_microbatched_concurrent_queries(server):
@@ -314,6 +321,129 @@ def test_feedback_loop(memory_storage):
         assert fed[0].entity_type == "pio_pr"
         assert fed[0].entity_id == body["prId"]
         assert fed[0].properties.get("query")["user"] == "u1"
+    finally:
+        srv.stop()
+        es.stop()
+
+
+def test_metrics_scrape_stage_histograms(server):
+    """After traffic, GET /metrics exposes pio_query_stage_seconds with
+    the queue-wait and device-predict stages populated (acceptance
+    criterion) plus the request/error counters."""
+    for _ in range(3):
+        call(server["port"], "POST", "/queries.json", {"user": "u1", "num": 2})
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server['port']}/metrics"
+    ) as resp:
+        assert resp.status == 200
+        text = resp.read().decode()
+
+    def stage_count(stage: str) -> int:
+        needle = f'pio_query_stage_seconds_count{{stage="{stage}"}} '
+        for line in text.splitlines():
+            if line.startswith(needle):
+                return int(line.rsplit(" ", 1)[1])
+        return 0
+
+    # these queries ride the MicroBatcher (ALS has a batched path), so
+    # both the queue-wait and the device stage must have observations
+    assert stage_count("queue_wait") >= 3
+    assert stage_count("predict") >= 3
+    assert stage_count("parse") >= 3
+    assert "pio_query_requests_total" in text
+    assert "pio_query_seconds_bucket" in text
+    assert 'pio_http_requests_total{server="query"' in text
+    assert "pio_microbatch_size_bucket" in text
+
+
+def test_status_reports_percentiles_and_errors(server):
+    call(server["port"], "POST", "/queries.json", {"user": "u1", "num": 2})
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"usr": "oops"})
+    assert status == 400
+    status, body = call(server["port"], "GET", "/")
+    assert status == 200
+    assert body["errorCount"] == 1  # the 400 counted (no longer invisible)
+    assert body["requestCount"] == 1  # success bookkeeping unchanged
+    assert body["p50ServingSec"] > 0
+    assert body["p99ServingSec"] >= body["p50ServingSec"]
+
+
+def test_error_paths_count_in_error_counter(server):
+    from predictionio_tpu.workflow.create_server import _QUERY_ERRORS
+
+    before = _QUERY_ERRORS.value(kind="bad_request")
+    call(server["port"], "POST", "/queries.json", {"usr": "u1"})  # 400
+    call(server["port"], "POST", "/queries.json", ["not", "a", "dict"])  # 400
+    assert _QUERY_ERRORS.value(kind="bad_request") == before + 2
+    assert server["service"].error_count == 2
+
+
+def test_output_blocker_failure_counts_as_error(server):
+    """A raising output blocker 500s the request AND lands in the error
+    accounting — the counters' 'error paths included' contract covers
+    the plugin stage too."""
+    from predictionio_tpu.workflow.create_server import _QUERY_ERRORS
+
+    service = server["service"]
+
+    class Boom:
+        def process(self, query, result, ctx):
+            raise RuntimeError("rejected by blocker")
+
+    before = _QUERY_ERRORS.value(kind="plugin")
+    service.plugin_context.output_blockers["boom"] = Boom()
+    try:
+        status, _ = call(server["port"], "POST", "/queries.json",
+                         {"user": "u1", "num": 2})
+        assert status == 500
+        assert _QUERY_ERRORS.value(kind="plugin") == before + 1
+        assert service.error_count == 1
+    finally:
+        del service.plugin_context.output_blockers["boom"]
+
+
+def test_request_id_propagates_to_feedback_event(memory_storage):
+    """A query sent with X-Request-ID is echoed on the response AND
+    attached to the stored feedback event (acceptance criterion): one
+    user request is traceable across both servers."""
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+
+    seed_and_train(memory_storage)
+    app_id = memory_storage.get_meta_data_apps().get_by_name("qsapp").id
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ())
+    )
+    es = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    es.start()
+    srv, service = create_server(
+        ServerConfig(
+            ip="127.0.0.1", port=0, feedback=True,
+            event_server_ip="127.0.0.1", event_server_port=es.port,
+            accesskey=key,
+        )
+    )
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/queries.json",
+            data=json.dumps({"user": "u1", "num": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": "abc"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-Request-ID"] == "abc"
+            body = json.loads(resp.read())
+        assert "prId" in body
+        fed = list(memory_storage.get_events().find(
+            app_id=app_id, event_names=["predict"]))
+        assert len(fed) == 1
+        assert fed[0].properties.get("requestId") == "abc"
     finally:
         srv.stop()
         es.stop()

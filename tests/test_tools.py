@@ -182,6 +182,13 @@ class TestDashboard:
             _get(server, "/engine_instances/nope/evaluator_results.html")
         assert ei.value.code == 404
 
+    def test_metrics_endpoint_and_footer(self, memory_storage, server):
+        status, body, _ = _get(server, "/")
+        assert '<a href="/metrics">' in body
+        status, body, ctype = _get(server, "/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert 'pio_http_requests_total{server="dashboard"' in body
+
 
 class TestAdminAPI:
     @pytest.fixture()
@@ -192,6 +199,12 @@ class TestAdminAPI:
         s.start()
         yield s
         s.stop()
+
+    def test_metrics_endpoint(self, memory_storage, server):
+        _req(server, "GET", "/")  # ensure at least one counted response
+        status, body, ctype = _get(server, "/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert 'pio_http_requests_total{server="admin"' in body
 
     def test_app_lifecycle(self, memory_storage, server):
         status, body = _req(server, "GET", "/")
