@@ -253,6 +253,191 @@ def bench_query_latency(
         Storage.reset()
 
 
+def _run_query_workload(port: int, threads: int, per_thread: int,
+                        users: int, num: int = 10) -> dict:
+    """Fire threads*per_thread queries cycling over ``users`` distinct
+    user ids (so the SAME workload replays against a bare replica and
+    against the gateway); returns latency percentiles + qps.
+
+    Raw keep-alive sockets with pre-serialized requests, same rationale
+    as :func:`_ingest_worker`: clients share the core with the servers
+    under test, so client-side http.client CPU (~2/3 of a loopback
+    round trip, measured) would be billed as serving capacity lost."""
+    import socket as _socket
+
+    all_lat: list[list[float]] = [[] for _ in range(threads)]
+    errors: list[Exception] = []
+
+    def serialize(uid: str) -> bytes:
+        body = json.dumps({"user": uid, "num": num}).encode()
+        return (
+            f"POST /queries.json HTTP/1.1\r\nHost: 127.0.0.1\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+
+    reqs = [serialize(f"u{u}") for u in range(users)]
+
+    def worker(tid: int):
+        try:
+            sock = _socket.create_connection(("127.0.0.1", port), timeout=60)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            buf = bytearray()
+
+            def roundtrip(req: bytes) -> None:
+                nonlocal buf
+                sock.sendall(req)
+                while True:  # frame by headers + Content-Length
+                    end = buf.find(b"\r\n\r\n")
+                    if end >= 0:
+                        break
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise AssertionError("server closed connection")
+                    buf += chunk
+                head = bytes(buf[:end])
+                status = head.split(b" ", 2)[1]
+                assert status == b"200", status
+                clen = 0
+                for line in head.split(b"\r\n")[1:]:
+                    k, _, v = line.partition(b":")
+                    if k.lower() == b"content-length":
+                        clen = int(v)
+                need = end + 4 + clen
+                while len(buf) < need:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        raise AssertionError("server closed connection")
+                    buf += chunk
+                del buf[:need]
+
+            for k in range(per_thread):
+                # distinct per-thread offsets (stride 3) so threads walk
+                # shifted cycles over the same user set rather than
+                # identical sequences in lockstep
+                t0 = time.perf_counter()
+                roundtrip(reqs[(tid * 3 + k) % users])
+                all_lat[tid].append(time.perf_counter() - t0)
+            sock.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    lat = np.asarray([x for xs in all_lat for x in xs]) * 1e3
+    return {
+        "qps": round(len(lat) / wall, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "requests": len(lat),
+    }
+
+
+def _wait_batch_warmup(timeout: float = 300.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline and any(
+        t.name == "batch-warmup" for t in threading.enumerate()
+    ):
+        time.sleep(0.2)
+
+
+def bench_gateway_scaling(replicas: int = 2, threads: int = 8,
+                          per_thread: int = 100, users: int = 12) -> dict:
+    """Throughput scaling of the serving gateway (serve/gateway.py):
+    the same concurrent workload against one bare replica and against
+    ``replicas`` replicas behind the gateway (least-outstanding routing,
+    hedged retries, result cache). The workload repeats each distinct
+    query ~threads*per_thread/users times, which is what the result
+    cache exists for — a bare replica pays the device on every repeat.
+
+    Warmup queries use user ids DISJOINT from the workload's so the
+    gateway's cache starts cold for the measured run: the reported hit
+    rate is earned inside the timed window."""
+    import json as _json
+    import urllib.request as _url
+
+    from predictionio_tpu.serve.gateway import (
+        GatewayConfig,
+        create_gateway_deployment,
+    )
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    storage = _setup_storage()
+    try:
+        _seed_and_train(storage)
+        out: dict = {
+            "gateway_replicas": replicas,
+            "gateway_workload_users": users,
+            "gateway_workload_requests": threads * per_thread,
+        }
+
+        # -- baseline: one bare replica, no gateway
+        srv, _service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+        srv.start()
+        try:
+            c = _Client(srv.port)
+            for k in range(20):  # compile/warm outside the workload set
+                c.query(f"u{500 + k}", 10)
+            c.close()
+            _wait_batch_warmup()
+            single = _run_query_workload(srv.port, threads, per_thread, users)
+        finally:
+            srv.stop()
+        out["single_qps"] = single["qps"]
+        out["single_p50_ms"] = single["p50_ms"]
+        out["single_p99_ms"] = single["p99_ms"]
+
+        # -- gateway over N replicas, same workload
+        dep = create_gateway_deployment(
+            ServerConfig(ip="127.0.0.1", port=0),
+            replicas,
+            GatewayConfig(
+                ip="127.0.0.1", port=0, health_interval_sec=0.5,
+                cache_max_entries=4096, cache_ttl_sec=120.0,
+            ),
+        )
+        dep.start()
+        try:
+            c = _Client(dep.port)
+            for k in range(20 * replicas):  # warm every replica's shapes
+                c.query(f"u{500 + k % 40}", 10)
+            c.close()
+            _wait_batch_warmup()
+            gw = _run_query_workload(dep.port, threads, per_thread, users)
+            with _url.urlopen(
+                f"http://127.0.0.1:{dep.port}/", timeout=10
+            ) as resp:
+                status = _json.loads(resp.read())
+        finally:
+            dep.stop()
+        out["gateway_qps"] = gw["qps"]
+        out["gateway_p50_ms"] = gw["p50_ms"]
+        out["gateway_p99_ms"] = gw["p99_ms"]
+        out["gateway_speedup"] = round(gw["qps"] / max(single["qps"], 1e-9), 2)
+        cache = status.get("cache", {})
+        lookups = cache.get("hits", 0) + cache.get("misses", 0)
+        out["gateway_cache_hit_rate"] = round(
+            cache.get("hits", 0) / lookups, 3) if lookups else 0.0
+        out["gateway_hedges_fired"] = status.get("hedgesFired", 0)
+        out["gateway_hedges_won"] = status.get("hedgesWon", 0)
+        out["gateway_retries"] = status.get("retries", 0)
+        return out
+    finally:
+        from predictionio_tpu.data.storage import Storage
+
+        Storage.reset()
+
+
 def _ingest_worker(port: int, key: str, n: int, barrier, out_q,
                    batch: int = 1) -> None:
     """One client process: connect, sync on the barrier, POST n events
@@ -597,7 +782,18 @@ def bench_event_scan(n_events: int = 200_000) -> dict:
 
 
 if __name__ == "__main__":
-    results = bench_query_latency()
-    results.update(bench_event_ingest())
-    results.update(bench_event_scan())
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gateway", action="store_true",
+                    help="bench the serving gateway: same workload against "
+                         "one bare replica vs --replicas behind the gateway")
+    ap.add_argument("--replicas", type=int, default=2)
+    cli = ap.parse_args()
+    if cli.gateway:
+        results = bench_gateway_scaling(replicas=cli.replicas)
+    else:
+        results = bench_query_latency()
+        results.update(bench_event_ingest())
+        results.update(bench_event_scan())
     print(json.dumps(results))

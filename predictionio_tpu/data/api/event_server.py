@@ -120,12 +120,17 @@ class EventService:
 
     # -- auth (ref: withAccessKey) ------------------------------------------
     #: Positive access-key lookups are cached this long (seconds); 0
-    #: disables. Every request authenticates, so without a cache each event
-    #: costs one metadata SELECT (~15% of single-event ingest CPU). Only
-    #: *hits* are cached — an unknown key is re-checked every time, so a
-    #: freshly created key works immediately; a revoked key drains within
-    #: the TTL (the reference holds keys in a JVM-heap map with the same
-    #: eventual-revocation behavior).
+    #: disables. DELIBERATE DIVERGENCE from the reference, which queries
+    #: the access-key store on every request (withAccessKey →
+    #: accessKeysClient.get), so upstream a revoked key stops working
+    #: immediately. Here every request authenticating against the store
+    #: costs one metadata SELECT (~15% of single-event ingest CPU), so
+    #: positive hits are cached and a revoked key keeps ingesting for up
+    #: to PIO_ACCESSKEY_CACHE_TTL seconds (default 5; set 0 to restore
+    #: the reference's immediate-revocation semantics at the reference's
+    #: per-request cost). Only *hits* are cached — an unknown key is
+    #: re-checked every time, so a freshly created key works immediately.
+    #: Recorded in PARITY.md and docs/rest-api.md.
     AUTH_CACHE_TTL = float(os.environ.get("PIO_ACCESSKEY_CACHE_TTL", "5"))
 
     def _auth(self, request: Request) -> AuthData:
@@ -436,6 +441,7 @@ def create_event_server(config: EventServerConfig | None = None,
     service = EventService(config)
     server = AppServer(service.router, config.ip, config.port,
                        reuse_port=reuse_port, server_name="event")
+    server.service = service  # tests/operators reach the live service
     return server
 
 

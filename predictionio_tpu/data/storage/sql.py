@@ -237,6 +237,14 @@ class SQLClient:
                 self._gc_committed = max(self._gc_committed, pending)
             if rolled_back:
                 raise
+            if not isinstance(e, Exception):
+                # the transaction proved durable (a concurrent plain
+                # execute()'s commit covered the group), so the caller's
+                # row IS stored — but KeyboardInterrupt/SystemExit are
+                # control flow, not commit outcomes: re-raise them now
+                # that the committed state is recorded, or a Ctrl-C
+                # landing in the commit window would be swallowed
+                raise
         finally:
             with self._gc_cv:
                 self._gc_leader = False

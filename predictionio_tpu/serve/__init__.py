@@ -1,0 +1,36 @@
+"""Serving gateway subsystem: replica routing, hedged retries, circuit
+breaking, and a query-result cache in front of N query-server replicas.
+
+``pio deploy --replicas N`` (tools/cli.py) assembles the whole topology;
+the pieces compose independently:
+
+  * :mod:`predictionio_tpu.serve.registry` — replica set with periodic
+    health checks (healthy -> suspect -> down state machine, graceful
+    drain) and least-outstanding acquisition;
+  * :mod:`predictionio_tpu.serve.gateway` — the HTTP front door:
+    balancing, per-request deadline budget, one hedged retry after a
+    p99-derived delay, exponential-backoff failover on connect failure,
+    per-replica circuit breaker;
+  * :mod:`predictionio_tpu.serve.cache` — LRU+TTL query-result cache
+    keyed on canonicalized query JSON + engine-instance id, invalidated
+    on ``/reload`` and redeploy.
+
+Everything exposes ``pio_gateway_*`` metrics through the process
+registry (``GET /metrics`` on the gateway port).
+"""
+
+from predictionio_tpu.serve.cache import (  # noqa: F401
+    QueryCache,
+    canonical_query_key,
+)
+from predictionio_tpu.serve.gateway import (  # noqa: F401
+    CircuitBreaker,
+    Gateway,
+    GatewayConfig,
+    GatewayDeployment,
+    create_gateway_deployment,
+)
+from predictionio_tpu.serve.registry import (  # noqa: F401
+    Replica,
+    ReplicaRegistry,
+)

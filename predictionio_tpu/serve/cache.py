@@ -1,0 +1,129 @@
+"""Query-result cache for the serving gateway: LRU + TTL.
+
+Predict is a pure function of (query, deployed engine instance): the same
+canonicalized query against the same instance id returns the same
+prediction, so the gateway can answer repeats without a replica round
+trip — the result-cache layer of Cloudflow-style prediction serving
+(arXiv:2007.05832 §4). Keys carry the engine-instance id, so a redeploy
+(new instance id observed by the health checker) or an explicit
+``/reload`` naturally invalidates every cached answer.
+
+NOT safe with the feedback loop: a cache hit skips the replica, so no
+predict event is logged and no fresh ``prId`` is minted. `pio deploy
+--feedback --replicas N` therefore disables the cache (tools/cli.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Any
+
+from predictionio_tpu.obs import REGISTRY
+
+_CACHE_HITS = REGISTRY.counter(
+    "pio_gateway_cache_hits_total",
+    "Gateway query-result cache hits (answered without a replica)",
+)
+_CACHE_MISSES = REGISTRY.counter(
+    "pio_gateway_cache_misses_total",
+    "Gateway query-result cache misses (expired entries count here too)",
+)
+_CACHE_EVICTIONS = REGISTRY.counter(
+    "pio_gateway_cache_evictions_total",
+    "Gateway cache entries evicted by capacity (TTL expiry not counted)",
+)
+_CACHE_ENTRIES = REGISTRY.gauge(
+    "pio_gateway_cache_entries",
+    "Live entries in the gateway query-result cache",
+)
+
+
+def canonical_query_key(body: bytes, instance_id: str) -> str | None:
+    """Cache key for a raw ``/queries.json`` body against one deployed
+    engine instance, or None when the body isn't a JSON object (those
+    requests 400 at the replica; never cache them). Canonicalization is
+    key-order-insensitive: ``{"user":"u1","num":3}`` and
+    ``{"num":3,"user":"u1"}`` share an entry."""
+    try:
+        obj = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(obj, dict):
+        return None
+    return instance_id + "|" + json.dumps(
+        obj, sort_keys=True, separators=(",", ":")
+    )
+
+
+class QueryCache:
+    """Thread-safe LRU + TTL map from canonical query key to the
+    replica's 200 payload. Per-instance hit/miss/eviction counts feed the
+    gateway status page; the module-level ``pio_gateway_cache_*`` metrics
+    aggregate across gateways for ``/metrics``."""
+
+    def __init__(self, max_entries: int = 1024, ttl_sec: float = 30.0):
+        self.max_entries = max_entries
+        self.ttl_sec = ttl_sec
+        self._lock = threading.Lock()
+        self._data: OrderedDict[str, tuple[float, Any]] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_entries > 0 and self.ttl_sec > 0
+
+    def get(self, key: str) -> Any | None:
+        """The cached payload, or None on miss/expiry. A live hit is
+        refreshed to most-recently-used."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._data.get(key)
+            if entry is not None and entry[0] > now:
+                self._data.move_to_end(key)
+                self.hits += 1
+                _CACHE_HITS.inc()
+                return entry[1]
+            if entry is not None:  # expired: drop so capacity stays honest
+                del self._data[key]
+                _CACHE_ENTRIES.set(len(self._data))
+            self.misses += 1
+            _CACHE_MISSES.inc()
+            return None
+
+    def put(self, key: str, payload: Any) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+            elif len(self._data) >= self.max_entries:
+                self._data.popitem(last=False)  # LRU out
+                self.evictions += 1
+                _CACHE_EVICTIONS.inc()
+            self._data[key] = (time.monotonic() + self.ttl_sec, payload)
+            _CACHE_ENTRIES.set(len(self._data))
+
+    def invalidate(self) -> int:
+        """Drop everything (on ``/reload`` and on redeploy, i.e. an
+        engine-instance-id change); returns the number dropped."""
+        with self._lock:
+            n = len(self._data)
+            self._data.clear()
+            _CACHE_ENTRIES.set(0)
+            return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._data),
+                "maxEntries": self.max_entries,
+                "ttlSec": self.ttl_sec,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }

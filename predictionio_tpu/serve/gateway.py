@@ -1,0 +1,704 @@
+"""Serving gateway: the front door for N query-server replicas.
+
+``pio deploy --replicas N`` puts this HTTP server (built on
+:mod:`predictionio_tpu.utils.http`, same stack as every other server in
+the process) in front of N replicas and gives ``POST /queries.json``
+the tail-latency toolkit single-replica serving lacks:
+
+  * **least-outstanding balancing** — pick the replica with the fewest
+    in-flight requests (registration order breaks ties), acquired
+    atomically under the registry lock;
+  * **per-request deadline budget** — every retry/hedge fits inside one
+    end-to-end deadline, so a struggling fleet degrades to bounded
+    latency instead of unbounded queueing;
+  * **hedged retry** — when the primary hasn't answered after a
+    p99-derived delay, fire the SAME query at a second replica and take
+    whichever answers first (the classic tail-at-scale hedge; predict is
+    read-only, so duplicated work is safe);
+  * **connect-failure retry** — a replica that can't be reached fails
+    over to the next with exponential backoff, inside the deadline;
+  * **per-replica circuit breaker** — K consecutive transport failures
+    open the breaker and shed that replica; after a cooldown one
+    half-open probe decides whether to close it again;
+  * **query-result cache** — :mod:`predictionio_tpu.serve.cache`,
+    invalidated on ``/reload`` and on redeploy (instance-id change seen
+    by the health checker).
+
+Replica HTTP errors (4xx/5xx with a response) pass through untouched —
+they are the *query's* problem, not the replica's, and must not trip the
+breaker or burn retries.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from predictionio_tpu.obs import REGISTRY, REQUEST_ID_HEADER, current_request_id
+from predictionio_tpu.serve.cache import QueryCache, canonical_query_key
+from predictionio_tpu.serve.registry import Replica, ReplicaRegistry
+from predictionio_tpu.utils.http import (
+    AppServer,
+    Request,
+    Router,
+    add_metrics_route,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_GATEWAY_PORT = 8000  # the gateway takes the engine server's door
+
+_GW_REQUESTS = REGISTRY.counter(
+    "pio_gateway_requests_total",
+    "Gateway /queries.json outcomes (cache_hit answered locally; "
+    "no_replica/deadline/error are gateway-side failures)",
+    labels=("outcome",),
+)
+_GW_SECONDS = REGISTRY.histogram(
+    "pio_gateway_seconds",
+    "End-to-end gateway /queries.json latency, cache hits included",
+)
+_GW_UPSTREAM_SECONDS = REGISTRY.histogram(
+    "pio_gateway_upstream_seconds",
+    "Per-attempt replica round-trip latency (hedges and retries each "
+    "observe; the merged p99 derives the hedge delay)",
+    labels=("replica",),
+)
+_GW_HEDGES = REGISTRY.counter(
+    "pio_gateway_hedges_total",
+    "Hedged second requests: fired, and won (hedge answered first)",
+    labels=("result",),
+)
+_GW_RETRIES = REGISTRY.counter(
+    "pio_gateway_retries_total",
+    "Connect-failure failovers to another replica",
+)
+_GW_BREAKER_OPEN = REGISTRY.gauge(
+    "pio_gateway_breaker_open",
+    "1 while a replica's circuit breaker is open",
+    labels=("replica",),
+)
+_GW_COALESCED = REGISTRY.counter(
+    "pio_gateway_coalesced_total",
+    "Requests that waited on an identical in-flight query instead of "
+    "going upstream (cache singleflight)",
+)
+
+
+class CircuitBreaker:
+    """Per-replica breaker: closed -> open after ``failures_to_open``
+    CONSECUTIVE transport failures; after ``cooldown_sec`` one half-open
+    probe is admitted — success closes, failure re-opens. ``now`` is
+    injectable for deterministic tests."""
+
+    def __init__(self, failures_to_open: int = 5, cooldown_sec: float = 5.0,
+                 now=time.monotonic):
+        self.failures_to_open = failures_to_open
+        self.cooldown_sec = cooldown_sec
+        self._now = now
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def allow(self) -> bool:
+        """Whether a request may pass NOW. In half-open this admits (and
+        consumes) the single probe slot, so call it only on the replica
+        actually being routed to."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if self._now() - self._opened_at >= self.cooldown_sec:
+                    self.state = "half_open"
+                    self._probing = True
+                    return True
+                return False
+            # half_open: one probe at a time
+            if not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != "closed":
+                logger.info("breaker closing (%s -> closed)", self.state)
+            self.state = "closed"
+            self._consecutive = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self.state == "half_open" or (
+                self._consecutive >= self.failures_to_open
+            ):
+                if self.state != "open":
+                    logger.warning(
+                        "breaker opening after %d consecutive failures",
+                        self._consecutive,
+                    )
+                self.state = "open"
+                self._opened_at = self._now()
+                self._probing = False
+
+    def cancel_probe(self) -> None:
+        """Hand back an admitted-but-unused half-open probe slot (the
+        caller decided not to send the request after all — e.g. the
+        deadline couldn't absorb the retry backoff). Without this the
+        slot would stay consumed forever and the replica would never be
+        probed again."""
+        with self._lock:
+            if self.state == "half_open":
+                self._probing = False
+
+    def reset(self) -> None:
+        """Close unconditionally (a successful health probe proved the
+        transport works again)."""
+        self.record_success()
+
+
+@dataclass
+class GatewayConfig:
+    ip: str = "0.0.0.0"
+    port: int = DEFAULT_GATEWAY_PORT
+    #: end-to-end budget per /queries.json request; every retry and
+    #: hedge fits inside it
+    deadline_sec: float = 10.0
+    #: hedged retry: fire a second attempt after the (clamped) merged
+    #: p99 of replica round trips. hedge_delay_sec pins the delay
+    #: (tests, operators who know their tail); None derives it.
+    hedge: bool = True
+    hedge_delay_sec: float | None = None
+    hedge_min_delay_sec: float = 0.01
+    hedge_max_delay_sec: float = 1.0
+    #: connect-failure failover backoff: base * 2^attempt, capped
+    retry_backoff_base_sec: float = 0.02
+    retry_backoff_max_sec: float = 0.5
+    #: circuit breaker tunables
+    breaker_failures: int = 5
+    breaker_cooldown_sec: float = 5.0
+    #: result cache (0 entries or 0 TTL disables)
+    cache_max_entries: int = 1024
+    cache_ttl_sec: float = 30.0
+    #: replica health checking
+    health_interval_sec: float = 1.0
+    health_timeout_sec: float = 2.0
+    health_down_after: int = 3
+
+
+class Gateway:
+    """Routing/hedging/caching front end over a ReplicaRegistry.
+
+    Build, ``add_replica()`` for each backend, then ``start()`` — or let
+    :func:`create_gateway_deployment` assemble the whole in-process
+    topology (N replicas + gateway) in one call."""
+
+    def __init__(self, config: GatewayConfig | None = None):
+        self.config = config or GatewayConfig()
+        self.cache = QueryCache(self.config.cache_max_entries,
+                                self.config.cache_ttl_sec)
+        self.registry = ReplicaRegistry(
+            health_interval_sec=self.config.health_interval_sec,
+            check_timeout_sec=self.config.health_timeout_sec,
+            down_after=self.config.health_down_after,
+            on_instance_change=self._on_instance_change,
+            on_probe_result=self._on_probe_result,
+        )
+        self.start_time = time.time()
+        self._stop_event = threading.Event()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._pools: dict[str, list[http.client.HTTPConnection]] = {}
+        self._pool_lock = threading.Lock()
+        # singleflight: cache key -> Event for queries in flight, so N
+        # concurrent identical misses cost ONE replica round trip
+        self._inflight: dict[str, threading.Event] = {}
+        self._inflight_lock = threading.Lock()
+        # per-gateway counters (the pio_gateway_* metrics are process-
+        # global; tests and the status page want THIS gateway's numbers)
+        self._stats_lock = threading.Lock()
+        self.request_count = 0
+        self.error_count = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.retries = 0
+        self.router = self._build_router()
+
+    # -- assembly -----------------------------------------------------------
+    def add_replica(self, host: str, port: int) -> Replica:
+        r = self.registry.add(host, port)
+        self._breakers[r.id] = CircuitBreaker(
+            self.config.breaker_failures, self.config.breaker_cooldown_sec
+        )
+        _GW_BREAKER_OPEN.set(0, replica=r.id)
+        return r
+
+    def start(self) -> None:
+        # one synchronous sweep so routing state and the fleet instance
+        # id are populated before the first proxied query (probe-ok
+        # results also clear breakers, via _on_probe_result)
+        self.registry.check_once()
+        self.registry.start()
+
+    def stop(self) -> None:
+        self.registry.stop()
+        self._stop_event.set()
+        with self._pool_lock:
+            for conns in self._pools.values():
+                for c in conns:
+                    c.close()
+            self._pools.clear()
+
+    def wait_for_stop(self) -> None:
+        self._stop_event.wait()
+
+    def _on_instance_change(self, instance_id: str) -> None:
+        dropped = self.cache.invalidate()
+        if dropped:
+            logger.info(
+                "engine instance changed to %s: dropped %d cached results",
+                instance_id, dropped,
+            )
+
+    def _on_probe_result(self, replica: Replica, ok: bool) -> None:
+        """A successful health probe is transport-level proof the replica
+        is reachable again: close its breaker so recovery doesn't wait
+        for the request path's half-open lottery. Failed probes do NOT
+        trip the breaker — the health state machine handles downing, and
+        double-counting would open breakers for replicas that merely
+        answered a probe slowly."""
+        if not ok:
+            return
+        breaker = self._breakers.get(replica.id)
+        if breaker is not None and breaker.state != "closed":
+            breaker.reset()
+            _GW_BREAKER_OPEN.set(0, replica=replica.id)
+
+    # -- routes -------------------------------------------------------------
+    def _build_router(self) -> Router:
+        r = Router()
+        r.add("GET", "/", self.get_status)
+        r.add("POST", "/queries.json", self.post_query)
+        r.add("GET", "/reload", self.get_reload)
+        r.add("GET", "/stop", self.get_stop)
+        add_metrics_route(r)
+        return r
+
+    def get_status(self, request: Request):
+        with self._stats_lock:
+            body = {
+                "status": "alive",
+                "role": "gateway",
+                "engineInstanceId": self.registry.instance_id(),
+                "requestCount": self.request_count,
+                "errorCount": self.error_count,
+                "hedgesFired": self.hedges_fired,
+                "hedgesWon": self.hedges_won,
+                "retries": self.retries,
+            }
+        body["replicas"] = [
+            {**snap, "breaker": self._breakers[snap["replica"]].state}
+            for snap in self.registry.snapshot()
+        ]
+        body["cache"] = self.cache.stats()
+        p99 = _GW_UPSTREAM_SECONDS.quantile(0.99)
+        body["hedgeDelaySec"] = round(self._hedge_delay(), 6)
+        if p99 is not None:
+            body["upstreamP99Sec"] = round(p99, 6)
+        return 200, body
+
+    def _replica_control(self, replica: Replica, path: str,
+                         timeout: float) -> tuple[int, dict]:
+        """GET a control endpoint (/reload, /stop) on a replica over a
+        fresh direct connection — NOT urllib, whose proxy env-var
+        handling could reroute gateway→replica traffic that
+        /queries.json (http.client, direct) sends straight through."""
+        conn = http.client.HTTPConnection(replica.host, replica.port,
+                                          timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = json.loads(resp.read() or b"{}")
+            return resp.status, body if isinstance(body, dict) else {}
+        finally:
+            conn.close()
+
+    def get_reload(self, request: Request):
+        """Fan /reload out to every replica CONCURRENTLY (a model
+        hot-swap takes seconds per replica; paying the max beats paying
+        the sum), then invalidate the cache."""
+        replicas = [r for r in self.registry.replicas()
+                    if r.state != "draining"]
+        results: list[dict | None] = [None] * len(replicas)
+
+        def reload_one(i: int, r: Replica) -> None:
+            try:
+                status, body = self._replica_control(r, "/reload", 30.0)
+                if status == 200:
+                    results[i] = {"replica": r.id, **body}
+                else:
+                    results[i] = {"replica": r.id,
+                                  "error": f"HTTP {status}", **body}
+            except (OSError, ValueError) as e:
+                results[i] = {"replica": r.id, "error": str(e)}
+
+        threads = [
+            threading.Thread(target=reload_one, args=(i, r), daemon=True)
+            for i, r in enumerate(replicas)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.cache.invalidate()
+        # pick up the new instance id right away (also re-invalidates
+        # via the change callback, which is idempotent on an empty cache)
+        self.registry.check_once()
+        return 200, {"reloaded": True, "replicas": results}
+
+    def get_stop(self, request: Request):
+        """Graceful undeploy: answer 200 immediately, then on a
+        background thread drain in-flight traffic, forward /stop to
+        every replica, and release ``wait_for_stop``."""
+
+        def shutdown():
+            self.registry.stop()
+            self.registry.drain(timeout_sec=10.0)
+            for r in self.registry.replicas():
+                try:
+                    self._replica_control(r, "/stop", 5.0)
+                except (OSError, ValueError):
+                    logger.debug("replica %s already gone", r.id)
+            self._stop_event.set()
+
+        threading.Thread(target=shutdown, name="gateway-stop",
+                         daemon=True).start()
+        return 200, {"message": "Shutting down."}
+
+    # -- the proxied hot path ----------------------------------------------
+    def post_query(self, request: Request):
+        t0 = time.perf_counter()
+        with self._stats_lock:
+            self.request_count += 1
+        try:
+            status, payload = self._proxy_query(request)
+        except Exception:
+            with self._stats_lock:
+                self.error_count += 1
+            _GW_REQUESTS.inc(outcome="error")
+            raise
+        if status >= 500:
+            with self._stats_lock:
+                self.error_count += 1
+        _GW_SECONDS.observe(time.perf_counter() - t0)
+        return status, payload
+
+    def _proxy_query(self, request: Request) -> tuple[int, object]:
+        deadline = time.monotonic() + self.config.deadline_sec
+        key = None
+        leader = False
+        if self.cache.enabled:
+            instance = self.registry.instance_id()
+            if instance:
+                key = canonical_query_key(request.body, instance)
+            if key is not None:
+                hit = self.cache.get(key)
+                if hit is not None:
+                    _GW_REQUESTS.inc(outcome="cache_hit")
+                    return 200, hit
+                # singleflight: one of N concurrent identical misses
+                # goes upstream (the leader); the rest wait for its
+                # cached result — a herd of repeats must not multiply
+                # device work across the fleet
+                while True:
+                    with self._inflight_lock:
+                        ev = self._inflight.get(key)
+                        if ev is None:
+                            self._inflight[key] = threading.Event()
+                            leader = True
+                            break
+                    _GW_COALESCED.inc()
+                    ev.wait(timeout=max(deadline - time.monotonic(), 0.0))
+                    hit = self.cache.get(key)
+                    if hit is not None:
+                        _GW_REQUESTS.inc(outcome="cache_hit")
+                        return 200, hit
+                    # leader failed or the result wasn't cacheable (non-
+                    # 200): fall through and fetch (or re-lead) ourselves
+                    if deadline - time.monotonic() <= 0:
+                        break
+        try:
+            status, payload = self._fetch(request.body, deadline)
+            if status == 200 and key is not None:
+                self.cache.put(key, payload)
+        finally:
+            if leader:
+                with self._inflight_lock:
+                    ev = self._inflight.pop(key, None)
+                if ev is not None:
+                    ev.set()
+        if isinstance(payload, dict) and "pioGatewayOutcome" in payload:
+            outcome = payload.pop("pioGatewayOutcome")  # gateway-side fail
+        elif status >= 500:
+            outcome = "upstream_error"  # the replica answered 5xx
+        else:
+            outcome = "ok"
+        _GW_REQUESTS.inc(outcome=outcome)
+        return status, payload
+
+    def _hedge_delay(self) -> float:
+        if self.config.hedge_delay_sec is not None:
+            return self.config.hedge_delay_sec
+        p99 = _GW_UPSTREAM_SECONDS.quantile(0.99)
+        if p99 is None:  # no traffic yet: be conservative, hedge late
+            return self.config.hedge_max_delay_sec
+        return min(max(p99, self.config.hedge_min_delay_sec),
+                   self.config.hedge_max_delay_sec)
+
+    def _launch(self, replica: Replica, body: bytes, rid: str | None,
+                deadline: float, resq: "queue.Queue", kind: str) -> None:
+        """Fire one upstream attempt on its own thread; results land on
+        ``resq`` as ('ok', status, payload, replica, kind) or
+        ('err', exc, None, replica, kind)."""
+
+        def run():
+            t0 = time.perf_counter()
+            try:
+                timeout = max(deadline - time.monotonic(), 0.05)
+                status, payload = self._upstream_query(
+                    replica, body, rid, timeout)
+            except Exception as e:  # noqa: BLE001 — transport failure
+                self._record_transport(replica, ok=False)
+                resq.put(("err", e, None, replica, kind))
+            else:
+                self._record_transport(replica, ok=True)
+                _GW_UPSTREAM_SECONDS.observe(
+                    time.perf_counter() - t0, replica=replica.id)
+                resq.put(("ok", status, payload, replica, kind))
+            finally:
+                self.registry.release(replica)
+
+        threading.Thread(target=run, name=f"gw-{kind}", daemon=True).start()
+
+    def _record_transport(self, replica: Replica, ok: bool) -> None:
+        breaker = self._breakers[replica.id]
+        if ok:
+            breaker.record_success()
+        else:
+            breaker.record_failure()
+        _GW_BREAKER_OPEN.set(
+            1 if breaker.state == "open" else 0, replica=replica.id)
+
+    def _acquire(self, exclude: set[str]) -> Replica | None:
+        return self.registry.acquire_least_outstanding(
+            admit=lambda r: self._breakers[r.id].allow(), exclude=exclude
+        )
+
+    def _fetch(self, body: bytes, deadline: float) -> tuple[int, object]:
+        """Balanced + hedged + retried fetch of one query against the
+        fleet, inside ``deadline``."""
+        cfg = self.config
+        if deadline - time.monotonic() <= 0:
+            # e.g. a singleflight follower that waited out its whole
+            # budget: don't burn a replica's device time on a response
+            # nobody will read
+            return 504, {"message": "Deadline exceeded.",
+                         "pioGatewayOutcome": "deadline"}
+        rid = current_request_id()
+        resq: "queue.Queue" = queue.Queue()
+        tried: set[str] = set()
+        primary = self._acquire(exclude=tried)
+        if primary is None:
+            return 503, {"message": "No replica available.",
+                         "pioGatewayOutcome": "no_replica"}
+        tried.add(primary.id)
+        self._launch(primary, body, rid, deadline, resq, "primary")
+        pending = 1
+        hedged = not cfg.hedge  # True = don't (or can't) hedge anymore
+        backoff = cfg.retry_backoff_base_sec
+        last_err: Exception | None = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            wait = remaining if hedged else min(self._hedge_delay(),
+                                                remaining)
+            try:
+                res = resq.get(timeout=wait)
+            except queue.Empty:
+                if hedged:
+                    break  # deadline spent with attempts still in flight
+                hedged = True  # one hedge per request
+                hedge_replica = self._acquire(exclude=tried)
+                if hedge_replica is not None:
+                    tried.add(hedge_replica.id)
+                    with self._stats_lock:
+                        self.hedges_fired += 1
+                    _GW_HEDGES.inc(result="fired")
+                    self._launch(hedge_replica, body, rid, deadline, resq,
+                                 "hedge")
+                    pending += 1
+                continue
+            tag, a, b, replica, kind = res
+            if tag == "ok":
+                if kind == "hedge":
+                    with self._stats_lock:
+                        self.hedges_won += 1
+                    _GW_HEDGES.inc(result="won")
+                return a, b  # replica's status/payload, 4xx/5xx included
+            last_err = a
+            pending -= 1
+            if pending > 0:
+                continue  # a hedge twin is still running: let it race
+            # every launched attempt failed at the transport level:
+            # failover with exponential backoff while the budget lasts
+            retry = self._acquire(exclude=tried)
+            if retry is None:
+                tried.clear()  # all breakers/replicas burned: allow
+                retry = self._acquire(exclude=tried)  # a second lap
+            if retry is None:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= backoff:
+                # un-acquire: the budget can't absorb the backoff sleep.
+                # Hand back any half-open probe slot allow() consumed,
+                # or the unprobed replica would be shed forever
+                self.registry.release(retry)
+                self._breakers[retry.id].cancel_probe()
+                break
+            time.sleep(backoff)
+            backoff = min(backoff * 2, cfg.retry_backoff_max_sec)
+            tried.add(retry.id)
+            with self._stats_lock:
+                self.retries += 1
+            _GW_RETRIES.inc()
+            self._launch(retry, body, rid, deadline, resq, "retry")
+            pending += 1
+        if last_err is not None:
+            logger.warning("query failed against all replicas: %s", last_err)
+            return 502, {"message": f"All replicas failed: {last_err}",
+                         "pioGatewayOutcome": "error"}
+        return 504, {"message": "Deadline exceeded.",
+                     "pioGatewayOutcome": "deadline"}
+
+    # -- upstream transport (pooled keep-alive) -----------------------------
+    def _pool_get(self, replica: Replica) -> http.client.HTTPConnection | None:
+        with self._pool_lock:
+            conns = self._pools.get(replica.id)
+            if conns:
+                return conns.pop()
+            return None
+
+    def _pool_put(self, replica: Replica,
+                  conn: http.client.HTTPConnection) -> None:
+        with self._pool_lock:
+            self._pools.setdefault(replica.id, []).append(conn)
+
+    def _upstream_query(self, replica: Replica, body: bytes,
+                        rid: str | None, timeout: float):
+        """One POST /queries.json round trip. Raises on transport
+        failure (connect/read error, malformed response); a pooled
+        keep-alive connection that went stale surfaces here too and the
+        caller's retry path covers it (predict is read-only, so a
+        resend is always safe)."""
+        conn = self._pool_get(replica)
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                replica.host, replica.port, timeout=timeout)
+        elif conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        headers = {"Content-Type": "application/json"}
+        if rid:
+            headers[REQUEST_ID_HEADER] = rid
+        try:
+            conn.request("POST", "/queries.json", body, headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            status = resp.status
+        except BaseException:
+            conn.close()
+            raise
+        self._pool_put(replica, conn)
+        try:
+            payload = json.loads(data or b"null")
+        except ValueError:
+            payload = {"message": data.decode("utf-8", "replace")}
+        return status, payload
+
+
+class GatewayDeployment:
+    """One in-process serving topology: N replica query servers plus the
+    gateway fronting them. start()/stop() manage every server; the
+    gateway's ``/stop`` (hit by ``pio undeploy``) releases
+    ``wait_for_stop`` after the graceful drain."""
+
+    def __init__(self, gateway: Gateway, gateway_server: AppServer,
+                 replicas: list):
+        self.gateway = gateway
+        self.server = gateway_server
+        self.replicas = replicas  # [(AppServer, QueryService), ...]
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> None:
+        for srv, _service in self.replicas:
+            srv.start()
+            self.gateway.add_replica(
+                "127.0.0.1" if srv.host in ("0.0.0.0", "::") else srv.host,
+                srv.port,
+            )
+        self.gateway.start()
+        self.server.start()
+
+    def wait_for_stop(self) -> None:
+        self.gateway.wait_for_stop()
+
+    def stop(self) -> None:
+        self.gateway.stop()
+        self.server.stop()
+        for srv, _service in self.replicas:
+            srv.stop()
+
+
+def create_gateway_deployment(server_config, n_replicas: int,
+                              gateway_config: GatewayConfig | None = None
+                              ) -> GatewayDeployment:
+    """Assemble gateway + N in-process replicas from one engine
+    ServerConfig. Replica ports: consecutive after the gateway's port
+    (gateway 8000 -> replicas 8001..8000+N), or all ephemeral when the
+    gateway binds port 0 (tests/bench).
+
+    In-process replicas each load their own model copy and serve on
+    their own port — on a multi-core host the device calls and HTTP
+    handling overlap across replicas; process-per-replica layouts can
+    point the same gateway at remote ports instead (add_replica)."""
+    import dataclasses
+
+    from predictionio_tpu.workflow.create_server import create_server
+
+    if n_replicas < 1:
+        raise ValueError("need at least one replica")
+    gateway_config = gateway_config or GatewayConfig()
+    replicas = []
+    for i in range(n_replicas):
+        rport = 0 if gateway_config.port == 0 else gateway_config.port + 1 + i
+        rcfg = dataclasses.replace(
+            server_config, port=rport, server_name=f"query_r{i}",
+            # one upgrade probe per deployment is plenty; replica 0 keeps
+            # the daily check, siblings skip the redundant timers
+            upgrade_check=server_config.upgrade_check and i == 0,
+        )
+        replicas.append(create_server(rcfg))
+    gateway = Gateway(gateway_config)
+    server = AppServer(gateway.router, gateway_config.ip,
+                       gateway_config.port, server_name="gateway")
+    return GatewayDeployment(gateway, server, replicas)

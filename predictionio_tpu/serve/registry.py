@@ -1,0 +1,254 @@
+"""Replica registry for the serving gateway: health checks + routing state.
+
+Each query-server replica runs through a per-replica state machine driven
+by periodic probes of its ``GET /`` status endpoint:
+
+    healthy --(failed check)--> suspect --(more failures)--> down
+       ^                          |                            |
+       +-------(successful check)-+----------------------------+
+
+``suspect`` replicas still take traffic (one blip shouldn't halve
+capacity); ``down`` replicas are skipped by routing until a probe
+succeeds — the fleet-level health-checking layer of large serving
+systems (arXiv:2501.10546 §3). ``draining`` is the terminal state used
+by graceful undeploy: no new requests, wait for outstanding to hit zero,
+then forward ``/stop``.
+
+The registry also tracks per-replica outstanding request counts (the
+gateway's least-outstanding balancing reads them under the registry
+lock) and the engine-instance id each replica reports, so the gateway
+can invalidate its result cache when a redeploy swaps the instance.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from predictionio_tpu.obs import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+STATES = ("healthy", "suspect", "down", "draining")
+
+_HEALTH_CHECKS = REGISTRY.counter(
+    "pio_gateway_health_checks_total",
+    "Replica health-probe outcomes",
+    labels=("result",),
+)
+_REPLICA_STATES = REGISTRY.gauge(
+    "pio_gateway_replicas",
+    "Replicas per health state after the last sweep",
+    labels=("state",),
+)
+
+
+@dataclass
+class Replica:
+    host: str
+    port: int
+    seq: int  # registration order: the stable tie-break for balancing
+    state: str = "healthy"
+    outstanding: int = 0
+    consecutive_failures: int = 0
+    instance_id: str | None = None
+
+    @property
+    def id(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def snapshot(self) -> dict:
+        return {
+            "replica": self.id,
+            "state": self.state,
+            "outstanding": self.outstanding,
+            "consecutiveFailures": self.consecutive_failures,
+            "engineInstanceId": self.instance_id,
+        }
+
+
+class ReplicaRegistry:
+    """Thread-safe replica set + background health checker."""
+
+    def __init__(self, health_interval_sec: float = 1.0,
+                 check_timeout_sec: float = 2.0, down_after: int = 3,
+                 on_instance_change: Callable[[str], None] | None = None,
+                 on_probe_result: Callable[["Replica", bool], None] | None
+                 = None):
+        self.health_interval_sec = health_interval_sec
+        self.check_timeout_sec = check_timeout_sec
+        #: consecutive failed probes before suspect becomes down (the
+        #: first failure is always just suspect)
+        self.down_after = max(down_after, 2)
+        self.on_instance_change = on_instance_change
+        #: called after every probe with (replica, probe_ok) — the
+        #: gateway closes a recovered replica's circuit breaker here
+        self.on_probe_result = on_probe_result
+        self.lock = threading.Lock()
+        self._replicas: list[Replica] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._instance_id: str | None = None
+
+    # -- membership ---------------------------------------------------------
+    def add(self, host: str, port: int) -> Replica:
+        with self.lock:
+            r = Replica(host=host, port=port, seq=len(self._replicas))
+            self._replicas.append(r)
+            return r
+
+    def replicas(self) -> list[Replica]:
+        with self.lock:
+            return list(self._replicas)
+
+    def snapshot(self) -> list[dict]:
+        with self.lock:
+            return [r.snapshot() for r in self._replicas]
+
+    def instance_id(self) -> str | None:
+        """The engine-instance id the fleet last reported (None before
+        the first successful probe)."""
+        with self.lock:
+            return self._instance_id
+
+    # -- routing-side bookkeeping ------------------------------------------
+    def acquire_least_outstanding(
+        self, admit: Callable[[Replica], bool], exclude: set[str] = frozenset()
+    ) -> Replica | None:
+        """Pick the routable replica with the fewest outstanding requests
+        (registration order breaks ties), skipping ``exclude`` and any
+        the ``admit`` predicate (the breaker) rejects, and bump its
+        outstanding count atomically — selection and acquisition share
+        the registry lock so concurrent handlers can't all pick the same
+        idle replica before any increment lands.
+
+        Falls back to down/suspect replicas (still honoring ``admit`` and
+        ``exclude``) when nothing routable remains: stale health state
+        must degrade to a live-fire probe, not a guaranteed 503."""
+        with self.lock:
+            for pool in (
+                [r for r in self._replicas
+                 if r.state in ("healthy", "suspect")],
+                [r for r in self._replicas if r.state == "down"],
+            ):
+                for r in sorted(pool, key=lambda r: (r.outstanding, r.seq)):
+                    if r.id in exclude:
+                        continue
+                    if admit(r):
+                        r.outstanding += 1
+                        return r
+            return None
+
+    def release(self, replica: Replica) -> None:
+        with self.lock:
+            replica.outstanding = max(replica.outstanding - 1, 0)
+
+    # -- health checking ----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="gateway-health", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.health_interval_sec):
+            try:
+                self.check_once()
+            except Exception:  # the checker must never die
+                logger.exception("health sweep failed")
+
+    def probe(self, replica: Replica) -> dict | None:
+        """One GET / against a replica; its status JSON or None."""
+        try:
+            conn = http.client.HTTPConnection(
+                replica.host, replica.port, timeout=self.check_timeout_sec
+            )
+            try:
+                conn.request("GET", "/")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return None
+                data = json.loads(body or b"{}")
+                return data if isinstance(data, dict) else {}
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return None
+
+    def check_once(self) -> None:
+        """One sweep: probe every non-draining replica and advance its
+        state machine. Probes run outside the lock (they block on the
+        network); transitions apply under it."""
+        for r in self.replicas():
+            if r.state == "draining":
+                continue
+            status = self.probe(r)
+            changed_instance = None
+            with self.lock:
+                if status is not None:
+                    _HEALTH_CHECKS.inc(result="ok")
+                    if r.state != "healthy":
+                        logger.info("replica %s recovered (%s -> healthy)",
+                                    r.id, r.state)
+                    r.state = "healthy"
+                    r.consecutive_failures = 0
+                    iid = status.get("engineInstanceId")
+                    if isinstance(iid, str):
+                        r.instance_id = iid
+                        if self._instance_id != iid:
+                            changed_instance = iid
+                            self._instance_id = iid
+                else:
+                    _HEALTH_CHECKS.inc(result="fail")
+                    r.consecutive_failures += 1
+                    if r.consecutive_failures >= self.down_after:
+                        if r.state != "down":
+                            logger.warning("replica %s is down "
+                                           "(%d consecutive failed probes)",
+                                           r.id, r.consecutive_failures)
+                        r.state = "down"
+                    else:
+                        if r.state == "healthy":
+                            logger.warning("replica %s is suspect", r.id)
+                        r.state = "suspect"
+            if self.on_probe_result is not None:
+                self.on_probe_result(r, status is not None)
+            if changed_instance is not None and self.on_instance_change:
+                # a redeploy swapped the engine instance: stale cached
+                # answers must go (the cache key carries the id, but
+                # dropping them bounds memory and the status page's lie)
+                self.on_instance_change(changed_instance)
+        counts = {s: 0 for s in STATES}
+        for r in self.replicas():
+            counts[r.state] += 1
+        for s, n in counts.items():
+            _REPLICA_STATES.set(n, state=s)
+
+    # -- graceful drain (undeploy path) -------------------------------------
+    def drain(self, timeout_sec: float = 10.0) -> bool:
+        """Stop routing (every replica -> draining), then wait for
+        outstanding requests to finish. True when fully drained."""
+        import time
+
+        with self.lock:
+            for r in self._replicas:
+                r.state = "draining"
+        deadline = time.monotonic() + timeout_sec
+        while time.monotonic() < deadline:
+            with self.lock:
+                if all(r.outstanding == 0 for r in self._replicas):
+                    return True
+            time.sleep(0.05)
+        with self.lock:
+            leftover = sum(r.outstanding for r in self._replicas)
+        logger.warning("drain timed out with %d requests outstanding",
+                       leftover)
+        return False

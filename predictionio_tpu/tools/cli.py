@@ -108,6 +108,38 @@ def build_parser() -> argparse.ArgumentParser:
     p_deploy.add_argument("--event-server-ip", default="0.0.0.0")
     p_deploy.add_argument("--event-server-port", type=int, default=7070)
     p_deploy.add_argument("--accesskey", default="")
+    # -- scaling out: gateway + N replicas (serve/gateway.py) ---------------
+    p_deploy.add_argument(
+        "--replicas", type=int, default=1,
+        help="run N query-server replicas behind a serving gateway on "
+             "--port (replicas bind consecutive ports after it)")
+    p_deploy.add_argument(
+        "--deadline", type=float, default=10.0, metavar="SEC",
+        help="gateway per-request deadline budget (retries and hedges "
+             "fit inside it)")
+    p_deploy.add_argument(
+        "--no-hedge", action="store_true",
+        help="disable the hedged second request to another replica")
+    p_deploy.add_argument(
+        "--hedge-delay-ms", type=float, default=None, metavar="MS",
+        help="fix the hedge delay (default: derived from the observed "
+             "p99 replica round trip)")
+    p_deploy.add_argument(
+        "--breaker-failures", type=int, default=5, metavar="K",
+        help="consecutive transport failures before a replica's circuit "
+             "breaker opens")
+    p_deploy.add_argument(
+        "--breaker-cooldown", type=float, default=5.0, metavar="SEC",
+        help="seconds an open breaker waits before its half-open probe")
+    p_deploy.add_argument(
+        "--no-cache", action="store_true",
+        help="disable the gateway query-result cache")
+    p_deploy.add_argument(
+        "--cache-ttl", type=float, default=30.0, metavar="SEC",
+        help="gateway query-result cache TTL")
+    p_deploy.add_argument(
+        "--cache-size", type=int, default=1024, metavar="N",
+        help="gateway query-result cache capacity (entries)")
     p_deploy.set_defaults(func=cmd_deploy)
 
     p_undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -372,6 +404,8 @@ def cmd_deploy(args) -> int:
         event_server_port=args.event_server_port,
         accesskey=args.accesskey,
     )
+    if getattr(args, "replicas", 1) > 1:
+        return _deploy_gateway(args, config)
     try:
         server, service = create_server(config)
     except RuntimeError as e:
@@ -386,6 +420,65 @@ def cmd_deploy(args) -> int:
         pass
     server.stop()
     print("[INFO] Engine server shut down.")
+    return 0
+
+
+def _deploy_gateway(args, config) -> int:
+    """`pio deploy --replicas N`: N in-process replica servers on
+    consecutive ports after --port, fronted by the serving gateway ON
+    --port (so clients, `pio undeploy`, and the redeploy script keep
+    their one address). See docs/operations.md § Scaling out serving."""
+    from predictionio_tpu.serve.gateway import (
+        GatewayConfig,
+        create_gateway_deployment,
+    )
+    from predictionio_tpu.tools.start_stop import (
+        clear_pidfile,
+        register_pidfile,
+    )
+
+    # a cache hit skips the replica (no feedback event, no fresh prId)
+    # and a hedged duplicate predict would LOG TWO feedback events with
+    # distinct prIds — with --feedback both must go
+    cache_on = not args.no_cache and not args.feedback
+    hedge_on = not args.no_hedge and not args.feedback
+    if args.feedback and not args.no_cache:
+        print("[INFO] --feedback disables the gateway result cache "
+              "(cached hits would skip the feedback loop).")
+    if args.feedback and not args.no_hedge:
+        print("[INFO] --feedback disables hedged retries (a duplicated "
+              "predict would log duplicate feedback events).")
+    gw_config = GatewayConfig(
+        ip=args.ip,
+        port=args.port,
+        deadline_sec=args.deadline,
+        hedge=hedge_on,
+        hedge_delay_sec=(None if args.hedge_delay_ms is None
+                         else args.hedge_delay_ms / 1e3),
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_sec=args.breaker_cooldown,
+        cache_max_entries=args.cache_size if cache_on else 0,
+        cache_ttl_sec=args.cache_ttl if cache_on else 0.0,
+    )
+    try:
+        dep = create_gateway_deployment(config, args.replicas, gw_config)
+    except RuntimeError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    dep.start()
+    replica_ports = ", ".join(str(srv.port) for srv, _ in dep.replicas)
+    print(f"[INFO] Engine is deployed: gateway at "
+          f"http://{args.ip}:{dep.port} over {args.replicas} replicas "
+          f"(ports {replica_ports}).")
+    pidfile = register_pidfile(f"deploy-gateway-{dep.port}")
+    try:
+        dep.wait_for_stop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        clear_pidfile(pidfile.stem)
+        dep.stop()
+    print("[INFO] Gateway and replicas shut down.")
     return 0
 
 

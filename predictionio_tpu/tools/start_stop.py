@@ -97,40 +97,68 @@ def cmd_start_all(args) -> int:
     return rc
 
 
+def register_pidfile(name: str, pid: int | None = None) -> Path:
+    """Record ``pid`` (default: this process) under ``$PIO_TPU_HOME/pids``
+    so ``pio stop-all`` can tear it down. Used by ``pio deploy
+    --replicas N``, whose gateway process is long-lived like the
+    start-all services but launched in the foreground by the operator."""
+    pidfile = _pid_dir() / f"{name}.pid"
+    pidfile.write_text(str(pid if pid is not None else os.getpid()) + "\n")
+    return pidfile
+
+
+def clear_pidfile(name: str) -> None:
+    try:
+        (_pid_dir() / f"{name}.pid").unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _stop_pidfile(pidfile: Path, name: str) -> int:
+    """SIGTERM (then SIGKILL) the pid recorded in ``pidfile``; returns 1
+    when a live process was stopped."""
+    try:
+        pid = int(pidfile.read_text().strip())
+    except ValueError:
+        pidfile.unlink()
+        return 0
+    stopped = 0
+    if _alive(pid):
+        print(f"[INFO] Stopping {name} (pid {pid})")
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        for _ in range(20):
+            if not _alive(pid):
+                break
+            time.sleep(0.1)
+        if _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        try:  # reap our own child so no zombie outlives stop-all
+            os.waitpid(pid, 0)
+        except (ChildProcessError, OSError):
+            pass
+        stopped = 1
+    pidfile.unlink()
+    return stopped
+
+
 def cmd_stop_all(args) -> int:
-    """Stop every service started by ``pio start-all``."""
+    """Stop every service started by ``pio start-all``, plus any gateway
+    deployment that registered a ``deploy-*.pid``."""
     pid_dir = _pid_dir()
     stopped = 0
+    for pidfile in sorted(pid_dir.glob("deploy-*.pid")):
+        stopped += _stop_pidfile(pidfile, pidfile.stem)
     for name, _verb, _port in SERVICES:
         pidfile = pid_dir / f"{name}.pid"
         if not pidfile.exists():
             continue
-        try:
-            pid = int(pidfile.read_text().strip())
-        except ValueError:
-            pidfile.unlink()
-            continue
-        if _alive(pid):
-            print(f"[INFO] Stopping {name} (pid {pid})")
-            try:
-                os.kill(pid, signal.SIGTERM)
-            except ProcessLookupError:
-                pass
-            for _ in range(20):
-                if not _alive(pid):
-                    break
-                time.sleep(0.1)
-            if _alive(pid):
-                try:
-                    os.kill(pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
-            try:  # reap our own child so no zombie outlives stop-all
-                os.waitpid(pid, 0)
-            except (ChildProcessError, OSError):
-                pass
-            stopped += 1
-        pidfile.unlink()
+        stopped += _stop_pidfile(pidfile, name)
     print(f"[INFO] Stopped {stopped} service(s).")
     return 0
 

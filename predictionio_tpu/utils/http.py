@@ -168,6 +168,14 @@ def _first_wins_dict(pairs) -> dict:
 #: urlsplit + parse_qs work is memoized on the raw target string. The
 #: hit path copies the query dict (handlers may mutate their Request's
 #: view). Bounded; wiped wholesale when full.
+#:
+#: Retention note: cached targets include their query strings, so up to
+#: _TARGET_CACHE_MAX accessKey-bearing URLs sit in process memory for
+#: the server's lifetime (same exposure class as the auth cache's key
+#: map in data/api/event_server.py). Keys are never logged or exposed
+#: from here; a process dump reveals them either way. Revoking a key
+#: does NOT purge it from this cache — irrelevant for auth (entries are
+#: parse results, not grants), but worth knowing in a forensic context.
 _target_cache: dict[str, tuple[str, dict[str, str]]] = {}
 _TARGET_CACHE_MAX = 256
 
@@ -211,7 +219,16 @@ class Router:
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         """``{name}`` matches one path segment; ``{name:path}`` matches the
-        rest of the path (for trailing-args routes)."""
+        rest of the path (for trailing-args routes).
+
+        Dispatch precedence: parameterless patterns also land in an
+        exact-match table that :meth:`dispatch` consults FIRST, so an
+        exact route beats a parameterized one for the same concrete path
+        REGARDLESS of registration order (``/events/special.json`` wins
+        over ``/events/{id}.json`` even if registered after it).
+        Parameterized routes then match in registration order. Exact
+        patterns are registered in the regex list too, so 405-vs-404
+        semantics don't depend on which table matched."""
         if "{" not in pattern:
             self._exact[(method.upper(), pattern)] = handler
         escaped = re.escape(pattern).replace(r"\{", "{").replace(r"\}", "}")

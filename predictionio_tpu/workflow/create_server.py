@@ -111,6 +111,11 @@ class ServerConfig:
     #: same offline-safe version probe as `pio upgrade`.
     upgrade_check: bool = True
     upgrade_check_interval_sec: float = 86400.0
+    #: ``server`` label on the shared pio_http_* metrics. The gateway
+    #: deployment gives each in-process replica its own label
+    #: (query_r0, query_r1, ...) so per-replica traffic stays separable
+    #: on one /metrics scrape.
+    server_name: str = "query"
 
 
 def _query_to_obj(query_class: type | None, data: dict):
@@ -682,5 +687,5 @@ def undeploy(ip: str, port: int) -> None:
 def create_server(config: ServerConfig) -> tuple[AppServer, QueryService]:
     service = QueryService(config)
     server = AppServer(service.router, config.ip, config.port,
-                       server_name="query")
+                       server_name=config.server_name)
     return server, service

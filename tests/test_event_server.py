@@ -6,7 +6,6 @@ server on an ephemeral port — same contract, real sockets.
 """
 
 import json
-import os
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -53,7 +52,8 @@ def server(memory_storage):
     events.init(app_id, channel_id)
     srv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0, stats=True))
     srv.start()
-    yield {"port": srv.port, "key": key, "app_id": app_id}
+    yield {"port": srv.port, "key": key, "app_id": app_id,
+           "service": srv.service}
     srv.stop()
 
 
@@ -498,11 +498,13 @@ def test_batch_events_rejects_non_array_and_oversize(server):
 def test_sql_insert_batch_matches_looped_inserts(tmp_path, monkeypatch):
     """The transactional sqlite insert_batch stores exactly what N single
     inserts would."""
+    import os
+
     from predictionio_tpu.data.event import Event as Ev
     from predictionio_tpu.data.datamap import DataMap
     from predictionio_tpu.data.storage import Storage
 
-    for k in list(__import__("os").environ):
+    for k in list(os.environ):
         if k.startswith("PIO_STORAGE_"):
             monkeypatch.delenv(k)
     monkeypatch.setenv("PIO_STORAGE_SOURCES_S_TYPE", "sqlite")
@@ -531,14 +533,16 @@ def test_sql_insert_batch_matches_looped_inserts(tmp_path, monkeypatch):
         Storage.reset()
 
 
-def test_auth_cache_ttl_semantics(server, memory_storage, monkeypatch):
+def test_auth_cache_ttl_semantics(server, memory_storage):
     """Positive access-key lookups are cached for the TTL (a deleted key
     drains within it); unknown keys are never cached, so a key created
     after a 401 works immediately."""
-    from predictionio_tpu.data.api import event_server as es_mod
-
     port, key = server["port"], server["key"]
     keys = memory_storage.get_meta_data_access_keys()
+    # pin the TTL on THIS service instance: the assertions below depend
+    # on a multi-second window, not on whatever PIO_ACCESSKEY_CACHE_TTL
+    # happened to be when the module imported
+    server["service"].AUTH_CACHE_TTL = 5.0
 
     # unknown key: 401 now, works the moment it exists (no negative cache)
     status, _ = call(port, "POST", "/events.json", {"accessKey": "nope"}, EVENT)

@@ -1,0 +1,572 @@
+"""Serving gateway tests: balancing, breaker, hedging, cache, drain,
+and the replica-kill e2e (serve/gateway.py, registry.py, cache.py).
+
+Unit-level tests run against lightweight fake replicas (a Router with
+scripted handlers on a real socket) so they exercise the real HTTP
+transport without training engines; the e2e test deploys two real
+trained replicas and kills one mid-traffic."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from predictionio_tpu.serve.cache import QueryCache, canonical_query_key
+from predictionio_tpu.serve.gateway import (
+    CircuitBreaker,
+    Gateway,
+    GatewayConfig,
+    create_gateway_deployment,
+)
+from predictionio_tpu.serve.registry import ReplicaRegistry
+from predictionio_tpu.utils.http import AppServer, Router, free_port
+
+
+def call(port, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+class FakeReplica:
+    """A scripted query-server stand-in on a real port: answers the
+    status/queries/reload/stop surface the gateway talks to, counts
+    traffic, and can delay or block its query handler."""
+
+    def __init__(self, tag: str, instance_id: str = "inst-1",
+                 delay: float = 0.0, port: int = 0):
+        self.tag = tag
+        self.instance_id = instance_id
+        self.delay = delay
+        self.query_count = 0
+        self.reload_count = 0
+        self.stop_count = 0
+        self.hold: threading.Event | None = None
+        self.entered = threading.Event()  # set when a query is in-handler
+        r = Router()
+        r.add("GET", "/", lambda req: (200, {
+            "status": "alive", "engineInstanceId": self.instance_id,
+        }))
+        r.add("POST", "/queries.json", self._query)
+        r.add("GET", "/reload", self._reload)
+        r.add("GET", "/stop", self._stop)
+        self.server = AppServer(r, "127.0.0.1", port, server_name="fake")
+
+    def _query(self, req):
+        self.query_count += 1
+        self.entered.set()
+        if self.hold is not None:
+            self.hold.wait(timeout=30)
+        if self.delay:
+            time.sleep(self.delay)
+        return 200, {"from": self.tag,
+                     "rid": req.headers.get("X-Request-ID"),
+                     "echo": req.json()}
+
+    def _reload(self, req):
+        self.reload_count += 1
+        return 200, {"reloaded": True}
+
+    def _stop(self, req):
+        self.stop_count += 1
+        return 200, {"message": "Shutting down."}
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def stop(self):
+        self.server.stop()
+
+    @property
+    def port(self):
+        return self.server.port
+
+
+def make_gateway(replicas, **cfg_overrides):
+    """Gateway + its AppServer over already-started fake replicas. The
+    long default health interval keeps sweeps out of timing-sensitive
+    tests; the sweep logic itself is tested directly via check_once()."""
+    defaults = dict(ip="127.0.0.1", port=0, health_interval_sec=60.0,
+                    cache_ttl_sec=0.0, cache_max_entries=0, hedge=False)
+    defaults.update(cfg_overrides)
+    gw = Gateway(GatewayConfig(**defaults))
+    for rep in replicas:
+        host_port = rep.port if isinstance(rep, FakeReplica) else rep
+        gw.add_replica("127.0.0.1", host_port)
+    gw.start()
+    srv = AppServer(gw.router, "127.0.0.1", 0, server_name="gateway")
+    srv.start()
+    return gw, srv
+
+
+# -- cache unit ---------------------------------------------------------------
+
+
+def test_canonical_query_key_is_order_insensitive():
+    a = canonical_query_key(b'{"user":"u1","num":3}', "i1")
+    b = canonical_query_key(b'{"num":3,"user":"u1"}', "i1")
+    assert a == b and a is not None
+    # different instance -> different key (redeploy never serves stale)
+    assert canonical_query_key(b'{"user":"u1","num":3}', "i2") != a
+    # non-object bodies are never cached
+    assert canonical_query_key(b'[1,2]', "i1") is None
+    assert canonical_query_key(b'not json', "i1") is None
+
+
+def test_query_cache_lru_ttl_and_counters():
+    cache = QueryCache(max_entries=2, ttl_sec=30.0)
+    cache.put("a", 1)
+    cache.put("b", 2)
+    assert cache.get("a") == 1  # refreshes a to MRU
+    cache.put("c", 3)  # capacity: evicts b (LRU)
+    assert cache.get("b") is None
+    assert cache.get("a") == 1 and cache.get("c") == 3
+    stats = cache.stats()
+    assert stats["evictions"] == 1
+    assert stats["hits"] == 3 and stats["misses"] == 1
+    # TTL expiry: an expired entry is a miss and frees its slot
+    short = QueryCache(max_entries=2, ttl_sec=0.05)
+    short.put("x", 9)
+    assert short.get("x") == 9
+    time.sleep(0.08)
+    assert short.get("x") is None
+    assert short.stats()["entries"] == 0
+    # invalidate drops everything
+    assert cache.invalidate() == 2
+    assert cache.get("a") is None
+
+
+# -- breaker unit -------------------------------------------------------------
+
+
+def test_breaker_opens_after_k_failures_and_half_opens_after_cooldown():
+    clock = [0.0]
+    br = CircuitBreaker(failures_to_open=3, cooldown_sec=5.0,
+                        now=lambda: clock[0])
+    assert br.state == "closed"
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()  # K-1 failures: still closed
+    br.record_failure()  # K-th consecutive failure opens it
+    assert br.state == "open"
+    assert not br.allow()
+    clock[0] = 4.9
+    assert not br.allow()  # cooldown not elapsed
+    clock[0] = 5.1
+    assert br.allow()  # the single half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()  # second request during the probe is shed
+    br.record_failure()  # probe failed: re-open, cooldown restarts
+    assert br.state == "open"
+    clock[0] = 10.3
+    assert br.allow()
+    br.record_success()  # probe succeeded: closed, counter reset
+    assert br.state == "closed"
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # consecutive count restarted
+
+
+def test_success_resets_consecutive_failure_count():
+    br = CircuitBreaker(failures_to_open=2)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"  # failures were not consecutive
+
+
+def test_cancel_probe_returns_the_half_open_slot():
+    clock = [0.0]
+    br = CircuitBreaker(failures_to_open=1, cooldown_sec=1.0,
+                        now=lambda: clock[0])
+    br.record_failure()
+    clock[0] = 1.5
+    assert br.allow()  # consumes the half-open probe slot
+    assert not br.allow()
+    br.cancel_probe()  # admitted request was never sent: hand it back
+    assert br.allow()  # probe available again, not shed forever
+
+
+def test_health_probe_success_closes_open_breaker():
+    """A replica that died (breaker open) and came back is closed by the
+    next successful health sweep — recovery doesn't wait for the request
+    path's half-open cooldown lottery."""
+    a = FakeReplica("a").start()
+    gw, srv = make_gateway([a])
+    try:
+        br = gw._breakers[f"127.0.0.1:{a.port}"]
+        for _ in range(gw.config.breaker_failures):
+            br.record_failure()  # simulate a transport-failure streak
+        assert br.state == "open"
+        gw.registry.check_once()  # probe succeeds against the live fake
+        assert br.state == "closed"
+    finally:
+        gw.stop(); srv.stop(); a.stop()
+
+
+# -- registry health state machine --------------------------------------------
+
+
+def test_registry_health_state_machine_and_recovery():
+    reg = ReplicaRegistry(down_after=3, check_timeout_sec=0.5)
+    port = free_port()
+    r = reg.add("127.0.0.1", port)  # nothing listening there yet
+    reg.check_once()
+    assert r.state == "suspect"  # first failure: degraded, still routable
+    reg.check_once()
+    assert r.state == "suspect"
+    reg.check_once()
+    assert r.state == "down"  # third consecutive failure
+    # a replica comes up on that port: next sweep recovers it
+    rep = FakeReplica("back", instance_id="inst-9", port=port).start()
+    try:
+        reg.check_once()
+        assert r.state == "healthy"
+        assert r.consecutive_failures == 0
+        assert r.instance_id == "inst-9"
+        assert reg.instance_id() == "inst-9"
+    finally:
+        rep.stop()
+
+
+# -- gateway behavior over fake replicas --------------------------------------
+
+
+def test_balancing_picks_least_outstanding():
+    a = FakeReplica("a").start()
+    b = FakeReplica("b").start()
+    a.hold = threading.Event()  # a's next query blocks in-handler
+    gw, srv = make_gateway([a, b])
+    try:
+        got = {}
+
+        def blocked():
+            got["first"] = call(srv.port, "POST", "/queries.json",
+                                {"user": "u1"})
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        # a registered first, both idle -> the blocked query went to a
+        assert a.entered.wait(timeout=10)
+        # a now has 1 outstanding, so the next query must pick b
+        status, body = call(srv.port, "POST", "/queries.json", {"user": "u2"})
+        assert status == 200 and body["from"] == "b"
+        assert b.query_count == 1
+        a.hold.set()
+        t.join(timeout=10)
+        assert got["first"][0] == 200 and got["first"][1]["from"] == "a"
+    finally:
+        a.hold.set()
+        gw.stop(); srv.stop(); a.stop(); b.stop()
+
+
+def test_breaker_sheds_dead_replica_to_remaining():
+    dead_port = free_port()  # nothing listening: connect refused
+    b = FakeReplica("b").start()
+    gw, srv = make_gateway(
+        [dead_port, b],
+        breaker_failures=2, breaker_cooldown_sec=60.0,
+        retry_backoff_base_sec=0.005,
+    )
+    try:
+        # queries 1-2: the dead replica is preferred (registered first,
+        # both idle), fails at connect, and fails over to b
+        for k in range(2):
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"user": f"u{k}"})
+            assert status == 200 and body["from"] == "b"
+        assert gw.retries == 2
+        dead_id = f"127.0.0.1:{dead_port}"
+        assert gw._breakers[dead_id].state == "open"
+        # breaker now open: traffic goes straight to b, no more retries
+        status, body = call(srv.port, "POST", "/queries.json", {"user": "u3"})
+        assert status == 200 and body["from"] == "b"
+        assert gw.retries == 2
+        status, st = call(srv.port, "GET", "/")
+        by_id = {r["replica"]: r for r in st["replicas"]}
+        assert by_id[dead_id]["breaker"] == "open"
+    finally:
+        gw.stop(); srv.stop(); b.stop()
+
+
+def test_hedge_fires_only_after_delay():
+    slow = FakeReplica("slow", delay=0.6).start()
+    fast = FakeReplica("fast").start()
+    gw, srv = make_gateway([slow, fast], hedge=True, hedge_delay_sec=0.15)
+    try:
+        t0 = time.perf_counter()
+        status, body = call(srv.port, "POST", "/queries.json", {"user": "u1"})
+        dt = time.perf_counter() - t0
+        # the hedge (to fast) answered; the primary was still sleeping
+        assert status == 200 and body["from"] == "fast"
+        assert dt < 0.6, f"hedge should beat the slow primary ({dt:.3f}s)"
+        assert gw.hedges_fired == 1 and gw.hedges_won == 1
+        assert slow.query_count == 1  # the primary WAS fired first
+        # a fast primary answers inside the delay: no hedge fires
+        slow.delay = 0.0
+        status, body = call(srv.port, "POST", "/queries.json", {"user": "u2"})
+        assert status == 200
+        assert gw.hedges_fired == 1  # unchanged
+    finally:
+        gw.stop(); srv.stop(); slow.stop(); fast.stop()
+
+
+def test_cache_hit_skips_replica_and_reload_invalidates():
+    a = FakeReplica("a").start()
+    gw, srv = make_gateway([a], cache_ttl_sec=30.0, cache_max_entries=64)
+    try:
+        q = {"user": "u1", "num": 3}
+        call(srv.port, "POST", "/queries.json", q)
+        assert a.query_count == 1
+        # same query, different key order: served from cache
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"num": 3, "user": "u1"})
+        assert status == 200 and body["from"] == "a"
+        assert a.query_count == 1
+        assert gw.cache.stats()["hits"] == 1
+        # /reload fans out to replicas and invalidates the cache
+        status, body = call(srv.port, "GET", "/reload")
+        assert status == 200 and a.reload_count == 1
+        call(srv.port, "POST", "/queries.json", q)
+        assert a.query_count == 2
+    finally:
+        gw.stop(); srv.stop(); a.stop()
+
+
+def test_concurrent_identical_misses_coalesce_to_one_upstream():
+    """Singleflight: N concurrent requests for the same uncached query
+    cost ONE replica round trip — the rest wait for the leader's cached
+    result (herd protection for hot keys)."""
+    a = FakeReplica("a").start()
+    a.hold = threading.Event()
+    gw, srv = make_gateway([a], cache_ttl_sec=30.0, cache_max_entries=64)
+    try:
+        results = []
+
+        def fire():
+            results.append(call(srv.port, "POST", "/queries.json",
+                                {"user": "hot"}))
+
+        ts = [threading.Thread(target=fire) for _ in range(4)]
+        for t in ts:
+            t.start()
+        assert a.entered.wait(timeout=10)  # the leader is upstream
+        time.sleep(0.1)  # let the other three reach the singleflight wait
+        a.hold.set()
+        for t in ts:
+            t.join(timeout=15)
+        assert len(results) == 4
+        assert all(s == 200 and b["from"] == "a" for s, b in results)
+        assert a.query_count == 1  # one upstream trip served all four
+    finally:
+        a.hold.set()
+        gw.stop(); srv.stop(); a.stop()
+
+
+def test_redeploy_instance_change_invalidates_cache():
+    a = FakeReplica("a", instance_id="inst-1").start()
+    gw, srv = make_gateway([a], cache_ttl_sec=30.0, cache_max_entries=64)
+    try:
+        q = {"user": "u1"}
+        call(srv.port, "POST", "/queries.json", q)
+        call(srv.port, "POST", "/queries.json", q)
+        assert a.query_count == 1  # second was a hit
+        a.instance_id = "inst-2"  # a redeploy swapped the instance
+        gw.registry.check_once()  # the health sweep notices
+        assert gw.cache.stats()["entries"] == 0
+        call(srv.port, "POST", "/queries.json", q)
+        assert a.query_count == 2  # keyed under the new instance now
+    finally:
+        gw.stop(); srv.stop(); a.stop()
+
+
+def test_request_id_propagates_gateway_to_replica():
+    a = FakeReplica("a").start()
+    gw, srv = make_gateway([a])
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/queries.json",
+            data=b'{"user":"u1"}',
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": "gw-rid-7"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["X-Request-ID"] == "gw-rid-7"  # echoed
+            body = json.loads(resp.read())
+        assert body["rid"] == "gw-rid-7"  # forwarded to the replica
+    finally:
+        gw.stop(); srv.stop(); a.stop()
+
+
+def test_gateway_stop_drains_and_stops_replicas():
+    a = FakeReplica("a").start()
+    b = FakeReplica("b").start()
+    gw, srv = make_gateway([a, b])
+    try:
+        status, body = call(srv.port, "GET", "/stop")
+        assert status == 200
+        done = threading.Event()
+        threading.Thread(
+            target=lambda: (gw.wait_for_stop(), done.set()), daemon=True
+        ).start()
+        assert done.wait(timeout=15)
+        assert a.stop_count == 1 and b.stop_count == 1
+    finally:
+        gw.stop(); srv.stop(); a.stop(); b.stop()
+
+
+def test_all_replicas_unreachable_returns_502():
+    gw, srv = make_gateway([free_port(), free_port()],
+                           breaker_failures=10, deadline_sec=2.0,
+                           retry_backoff_base_sec=0.005)
+    try:
+        status, body = call(srv.port, "POST", "/queries.json", {"user": "u1"})
+        assert status == 502
+        assert "message" in body
+    finally:
+        gw.stop(); srv.stop()
+
+
+def test_cli_deploy_replicas_starts_gateway(memory_storage, tmp_path,
+                                            monkeypatch):
+    """`pio deploy --replicas 2` brings up the gateway on --port with two
+    replicas behind it, registers a stop-all pidfile, serves predictions,
+    and shuts everything down on the gateway's /stop (the pio undeploy
+    path)."""
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.tools.cli import build_parser, cmd_deploy
+
+    seed_and_train(memory_storage)
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    engine_json = tmp_path / "engine.json"
+    engine_json.write_text(json.dumps({
+        "id": "default", "version": "1",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation:engine_factory",
+    }))
+    gport = free_port()
+    args = build_parser().parse_args([
+        "deploy", "--engine-json", str(engine_json), "--ip", "127.0.0.1",
+        "--port", str(gport), "--replicas", "2", "--cache-ttl", "5",
+    ])
+    rc: dict = {}
+
+    def run():
+        rc["rc"] = cmd_deploy(args)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 60
+        status = None
+        while time.time() < deadline:
+            try:
+                status, body = call(gport, "GET", "/")
+                break
+            except OSError:
+                time.sleep(0.2)
+        assert status == 200 and body["role"] == "gateway"
+        assert len(body["replicas"]) == 2
+        pidfile = tmp_path / "pids" / f"deploy-gateway-{gport}.pid"
+        assert pidfile.exists()
+        status, pred = call(gport, "POST", "/queries.json",
+                            {"user": "u1", "num": 3})
+        assert status == 200 and len(pred["itemScores"]) == 3
+        status, _ = call(gport, "GET", "/stop")
+        assert status == 200
+        t.join(timeout=30)
+        assert not t.is_alive() and rc["rc"] == 0
+        assert not pidfile.exists()  # cleared on the way out
+    finally:
+        if t.is_alive():  # belt and braces: don't leak the deployment
+            try:
+                call(gport, "GET", "/stop")
+            except OSError:
+                pass
+            t.join(timeout=10)
+
+
+# -- e2e: real replicas, one killed mid-traffic -------------------------------
+
+
+def test_gateway_e2e_replica_kill_zero_failed_queries(memory_storage):
+    """Two real trained replicas behind the gateway; one dies mid-burst.
+    Connect-failure failover + the breaker must absorb it: every query
+    answers 200 with a well-formed prediction (the acceptance
+    criterion's zero dropped queries)."""
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.workflow.create_server import ServerConfig
+
+    seed_and_train(memory_storage)
+    dep = create_gateway_deployment(
+        ServerConfig(ip="127.0.0.1", port=0),
+        2,
+        GatewayConfig(
+            ip="127.0.0.1", port=0, health_interval_sec=0.3,
+            cache_ttl_sec=0.0, cache_max_entries=0,  # force real routing
+            hedge=True, hedge_delay_sec=0.2,
+            breaker_failures=3, retry_backoff_base_sec=0.01,
+        ),
+    )
+    dep.start()
+    try:
+        # warm both replicas' compiled shapes with a concurrent burst
+        warm_errs = []
+
+        def warm(k):
+            try:
+                s, _ = call(dep.port, "POST", "/queries.json",
+                            {"user": f"u{k}", "num": 2})
+                assert s == 200
+            except Exception as e:  # noqa: BLE001
+                warm_errs.append(e)
+
+        ws = [threading.Thread(target=warm, args=(k,)) for k in range(8)]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        assert not warm_errs
+
+        results: dict[int, tuple] = {}
+        errors: list[Exception] = []
+
+        def worker(tid):
+            try:
+                for k in range(15):
+                    status, body = call(
+                        dep.port, "POST", "/queries.json",
+                        {"user": f"u{(tid * 5 + k) % 20}", "num": 3},
+                    )
+                    results[(tid, k)] = (status, body)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+        for t in ts:
+            t.start()
+        time.sleep(0.15)
+        dep.replicas[1][0].stop()  # kill replica 1 mid-traffic
+        for t in ts:
+            t.join()
+        assert not errors
+        assert len(results) == 60
+        for status, body in results.values():
+            assert status == 200, f"dropped query: {status} {body}"
+            assert "itemScores" in body
+    finally:
+        dep.stop()

@@ -224,6 +224,9 @@ def test_all_registered_metric_names_follow_convention():
     then assert the whole process registry obeys pio_ + snake_case."""
     import predictionio_tpu.data.api.event_server  # noqa: F401
     import predictionio_tpu.data.storage.sql  # noqa: F401
+    import predictionio_tpu.serve.cache  # noqa: F401
+    import predictionio_tpu.serve.gateway  # noqa: F401
+    import predictionio_tpu.serve.registry  # noqa: F401
     import predictionio_tpu.utils.http  # noqa: F401
     import predictionio_tpu.workflow.batching  # noqa: F401
     import predictionio_tpu.workflow.create_server  # noqa: F401
@@ -236,7 +239,19 @@ def test_all_registered_metric_names_follow_convention():
         )
     # the acceptance-critical names exist with stable spellings
     for required in ("pio_events_ingested_total", "pio_query_stage_seconds",
-                     "pio_http_requests_total"):
+                     "pio_http_requests_total",
+                     # serving-gateway scrape surface (ISSUE 2)
+                     "pio_gateway_requests_total", "pio_gateway_seconds",
+                     "pio_gateway_upstream_seconds",
+                     "pio_gateway_hedges_total", "pio_gateway_retries_total",
+                     "pio_gateway_breaker_open",
+                     "pio_gateway_health_checks_total",
+                     "pio_gateway_replicas",
+                     "pio_gateway_cache_hits_total",
+                     "pio_gateway_cache_misses_total",
+                     "pio_gateway_cache_evictions_total",
+                     "pio_gateway_cache_entries",
+                     "pio_gateway_coalesced_total"):
         assert required in names
 
 
