@@ -293,6 +293,10 @@ def bench_als_cold(ctx, ui, ii, r, n_users, n_items, rank: int,
     for k, v in als_dense.last_train_phases.items():
         if k != "cache_hit":
             out[f"train_cold_{k}"] = v
+    # the overlap fraction must always be present for the cold probe —
+    # 0.0 when the pipeline was disabled or degenerate (one chunk, no
+    # staging), so a disappearing overlap is visible, not just absent
+    out.setdefault("train_cold_overlap_frac", 0.0)
     return out
 
 
@@ -680,7 +684,12 @@ def _check_readme_cli(paths: list[str]) -> int:
     return rc
 
 
-def main(metrics_snapshot: bool = False) -> None:
+def _collect(metrics_snapshot: bool = False) -> dict:
+    """Run every bench section and return the headline doc. All stdout
+    writes made in here land on stderr (main() redirects them): the
+    process stdout contract is ONE final JSON line, nothing else —
+    BENCH_r01..r05 all recorded ``"parsed": null`` because stray output
+    shared stdout with the headline line."""
     from predictionio_tpu.models.als import ALSParams
     from predictionio_tpu.parallel.mesh import compute_context
 
@@ -860,7 +869,45 @@ def main(metrics_snapshot: bool = False) -> None:
             json.dump(doc, f, indent=1)
     except Exception:
         pass  # capture bookkeeping must never sink the bench output
-    print(json.dumps(doc))
+    return doc
+
+
+def _dry_run_doc() -> dict:
+    """``--dry-run``: no device sections, no captures — a structurally
+    complete headline doc emitted fast, so the stdout contract (final
+    line = parseable JSON, strays on stderr) is testable in tier-1
+    without hardware."""
+    # deliberately on stdout: proves main()'s redirect routes stray
+    # prints to stderr instead of corrupting the JSON line
+    print("[bench] dry-run: skipping all device sections")
+    return {
+        "metric": "ml20m_als_rank10_iterations_per_sec",
+        "value": 0.0,
+        "unit": "iter/s",
+        "vs_baseline": 0.0,
+        "extra": {"dry_run": True},
+    }
+
+
+def main(metrics_snapshot: bool = False, dry_run: bool = False) -> None:
+    """Emit the headline JSON as the FINAL stdout line with nothing after
+    it. Everything the run prints to stdout along the way (library
+    banners, stray logging, section chatter) is redirected to stderr —
+    every BENCH_r0*.json capture so far recorded ``"parsed": null``
+    because the driver could not parse the last stdout line."""
+    import contextlib
+    import logging as _logging
+    import sys as _sys
+
+    # stray logging (incl. any basicConfig a library sneaks in) belongs
+    # on stderr; the default lastResort handler already goes there, this
+    # pins any root configuration the bench itself triggers
+    _logging.basicConfig(stream=_sys.stderr)
+    real_stdout = _sys.stdout
+    with contextlib.redirect_stdout(_sys.stderr):
+        doc = _dry_run_doc() if dry_run else _collect(metrics_snapshot)
+    print(json.dumps(doc), file=real_stdout)
+    real_stdout.flush()
 
 
 if __name__ == "__main__":
@@ -870,4 +917,5 @@ if __name__ == "__main__":
         args = [a for a in _sys.argv[1:]
                 if a not in ("--check-readme", "--metrics-snapshot")]
         _sys.exit(_check_readme_cli(args))
-    main(metrics_snapshot="--metrics-snapshot" in _sys.argv)
+    main(metrics_snapshot="--metrics-snapshot" in _sys.argv,
+         dry_run="--dry-run" in _sys.argv)

@@ -23,9 +23,18 @@ from typing import Any, Callable, Mapping, Sequence
 import numpy as np
 
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.io.transfer import ChunkStager, iter_chunks
 from predictionio_tpu.utils.time import now
 
 logger = logging.getLogger(__name__)
+
+#: Events per prefetched scan chunk. The stager's producer thread pulls
+#: (and decodes) the next chunk from the event store while the consumer
+#: runs the conversion function over the previous one — the C record
+#: decode drops the GIL, so on a multi-core host the scan fully hides
+#: behind the ETL (BENCH scan_etl_concurrent_vs_max showed ~2.2x
+#: headroom between the serial sum and the concurrent wall).
+_SCAN_CHUNK_EVENTS = 2048
 
 
 class DataView:
@@ -68,25 +77,31 @@ class DataView:
             logger.info("Cached copy not found, reading from DB.")
         columns: dict[str, list] = {}
         n = 0
-        for event in PEventStore.find(
+        scan = PEventStore.find(
             app_name,
             channel_name=channel_name,
             start_time=start_time,
             until_time=end_time,
-        ):
-            row = conversion_function(event)
-            if row is None:
-                continue
-            if not columns:
-                columns = {k: [] for k in row}
-            elif set(row) != set(columns):
-                raise ValueError(
-                    f"conversion function returned inconsistent columns: "
-                    f"{sorted(row)} vs {sorted(columns)}"
-                )
-            for k, v in row.items():
-                columns[k].append(v)
-            n += 1
+        )
+        # scan-ETL prefetch: the store scan advances on the stager's
+        # producer thread while this thread converts the previous chunk
+        stager = ChunkStager(name="view_scan")
+        for _idx, batch in stager.stream(
+                iter_chunks(scan, _SCAN_CHUNK_EVENTS), pack=lambda b: b):
+            for event in batch:
+                row = conversion_function(event)
+                if row is None:
+                    continue
+                if not columns:
+                    columns = {k: [] for k in row}
+                elif set(row) != set(columns):
+                    raise ValueError(
+                        f"conversion function returned inconsistent "
+                        f"columns: {sorted(row)} vs {sorted(columns)}"
+                    )
+                for k, v in row.items():
+                    columns[k].append(v)
+                n += 1
         out = {k: np.asarray(v) for k, v in columns.items()}
         if cache is not None:
             np.savez(cache, **out)

@@ -1,0 +1,23 @@
+"""Host↔device transfer pipeline (see :mod:`predictionio_tpu.io.transfer`).
+
+The package exists because round-5 phase accounting (BENCH_r05) showed
+over half of a cold ML-20M train was host↔device transfer that never
+overlapped compute; the stager/readback primitives here are shared by the
+dense ALS staging path and the data/view scan ETL.
+"""
+
+from predictionio_tpu.io.transfer import (  # noqa: F401
+    ChunkStager,
+    async_readback,
+    iter_chunks,
+    transfer_chunk_bytes,
+    transfer_slots,
+)
+
+__all__ = [
+    "ChunkStager",
+    "async_readback",
+    "iter_chunks",
+    "transfer_chunk_bytes",
+    "transfer_slots",
+]

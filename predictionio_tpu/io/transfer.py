@@ -1,0 +1,476 @@
+"""Overlapped host↔device transfer pipeline.
+
+Round-5 phase accounting (BENCH_r05) showed a cold ML-20M ALS train spends
+38.5 s uploading+densifying and 3.8 s preparing strictly *before* the
+36.3 s solve starts, plus 1.7 s of serialized readback after it — over
+half the cold wall-clock is transfer that never overlaps compute. ALX
+(arxiv 2112.02194) and Google's ads-training infrastructure paper (arxiv
+2501.10546) both identify overlapped input staging as the difference
+between transfer-bound and compute-bound TPU matrix-factorization
+training. This module is the reusable half of that fix:
+
+:class:`ChunkStager`
+    A chunked, double-buffered host→device stager: a background producer
+    thread walks the chunk stream and a small worker pool packs (and
+    optionally uploads) chunk ``k+1`` while the caller consumes chunk
+    ``k`` — e.g. enqueues its device densify. In-flight chunks are
+    bounded by a slot semaphore (``PIO_TRANSFER_SLOTS``), so host staging
+    buffers and un-consumed device uploads can never pile up unbounded.
+    Chunks are yielded strictly in order; a worker exception propagates
+    to the consumer (never a hang, never a silent partial result), and a
+    consumer that stops early (error or ``break``) drains every in-flight
+    slot before the generator closes.
+
+:func:`async_readback`
+    Chunked device→host readback: every row-chunk's ``copy_to_host_async``
+    is started before the first blocking fetch, so the copies run behind
+    whatever device work is still queued (e.g. the final solve half-step)
+    and behind each other.
+
+Chunk sizing rides ``PIO_TRANSFER_CHUNK_MB`` (MiB of payload per chunk);
+both tunables are read at call time so tests and operators can adjust a
+live process. The ``pio_transfer_*`` metrics (chunk bytes, per-stage
+seconds, consumer queue-wait seconds, in-flight slots) land in the
+process-global obs registry, labelled by pipeline name.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from predictionio_tpu.obs import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ChunkStager",
+    "async_readback",
+    "iter_chunks",
+    "transfer_chunk_bytes",
+    "transfer_slots",
+]
+
+#: Default MiB per staged chunk (``PIO_TRANSFER_CHUNK_MB``). 512 MiB of
+#: densified A-cells splits ML-20M (~3.7 GB) into ~8 chunks — enough
+#: granularity that pack/upload of chunk k+1 hides behind the device
+#: densify of chunk k, while each scatter stays far above the TPU
+#: scatter-strategy cliff (docs/perf.md §3).
+DEFAULT_CHUNK_MB = 512
+
+#: Default in-flight chunk slots (``PIO_TRANSFER_SLOTS``): 2 = classic
+#: double buffering (one chunk being consumed, one being staged).
+DEFAULT_SLOTS = 2
+
+#: Byte-size buckets: 1 KiB → 4 GiB, ×2 per bucket.
+BYTES_BUCKETS: tuple[float, ...] = tuple(1024.0 * 2.0**i for i in range(23))
+
+#: Host seconds per chunk, by pipeline and stage (pack/upload/readback).
+STAGE_SECONDS = REGISTRY.histogram(
+    "pio_transfer_stage_seconds",
+    "Host seconds spent per transfer-pipeline chunk, by stage",
+    labels=("pipeline", "stage"),
+)
+
+#: Seconds the consumer blocked waiting for the next staged chunk — the
+#: un-overlapped remainder of the pipeline (0 on a perfectly hidden
+#: stage; equals the full stage time when nothing overlaps).
+QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "pio_transfer_queue_wait_seconds",
+    "Seconds the transfer-pipeline consumer blocked awaiting a chunk",
+    labels=("pipeline",),
+)
+
+#: Payload bytes per staged chunk.
+CHUNK_BYTES = REGISTRY.histogram(
+    "pio_transfer_chunk_bytes",
+    "Host payload bytes per transfer-pipeline chunk",
+    labels=("pipeline",),
+    buckets=BYTES_BUCKETS,
+)
+
+#: Currently-held in-flight chunk slots per pipeline.
+INFLIGHT_SLOTS = REGISTRY.gauge(
+    "pio_transfer_inflight_slots",
+    "Transfer-pipeline chunk slots currently in flight",
+    labels=("pipeline",),
+)
+
+
+def transfer_chunk_bytes() -> int:
+    """Target payload bytes per chunk (``PIO_TRANSFER_CHUNK_MB``), read
+    at call time so a live process can be retuned."""
+    mb = float(os.environ.get("PIO_TRANSFER_CHUNK_MB", DEFAULT_CHUNK_MB))
+    return max(int(mb * 2**20), 1)
+
+
+def transfer_slots() -> int:
+    """In-flight chunk bound (``PIO_TRANSFER_SLOTS``), floor 1."""
+    return max(int(os.environ.get("PIO_TRANSFER_SLOTS", DEFAULT_SLOTS)), 1)
+
+
+def iter_chunks(items: Iterable, n: int) -> Iterator[list]:
+    """Lists of up to ``n`` consecutive items — the stager's unit for
+    record streams (event scans). Pulls lazily: inside a stager stream
+    the pulls happen on the producer thread, off the consumer's path."""
+    if n < 1:
+        raise ValueError("chunk size must be >= 1")
+    it = iter(items)
+    while True:
+        chunk = list(itertools.islice(it, n))
+        if not chunk:
+            return
+        yield chunk
+
+
+def _nbytes(staged: Any) -> int:
+    """Payload bytes of a packed chunk: any nesting of sequences/dicts of
+    objects with ``nbytes`` (numpy or device arrays)."""
+    if staged is None:
+        return 0
+    nb = getattr(staged, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(staged, dict):
+        return sum(_nbytes(v) for v in staged.values())
+    if isinstance(staged, (tuple, list)):
+        return sum(_nbytes(v) for v in staged)
+    return 0
+
+
+class _Cancelled(Exception):
+    """Raised inside a worker when the stream was closed under it — never
+    surfaces to the consumer (the drain swallows it)."""
+
+
+_DONE = object()
+
+
+class ChunkStager:
+    """Ordered, slot-bounded background staging of a chunk stream.
+
+    One stager instance carries the counters for one pipeline run
+    (``staged_s``/``wait_s``/``chunks``/``bytes``/``max_inflight``), so a
+    caller can compute its overlap after the stream completes; the
+    process-global ``pio_transfer_*`` metrics are recorded as well,
+    labelled with ``name``.
+
+    Slot semantics: a slot is held from just before a chunk's pack starts
+    until the consumer finishes the loop body that received it (i.e. has
+    *dispatched* whatever consumes the chunk). With device uploads the
+    bound therefore covers every chunk whose host staging buffers are
+    alive or whose device consumption has not yet been enqueued — the
+    quantity that must stay bounded for host RAM and HBM staging alike.
+    """
+
+    def __init__(self, slots: int | None = None, workers: int | None = None,
+                 name: str = "stager"):
+        self.slots = int(slots) if slots is not None else transfer_slots()
+        if self.slots < 1:
+            raise ValueError("ChunkStager needs at least one slot")
+        # pack/upload are usually GIL-dropping (numpy slicing, device
+        # puts); more workers than slots can never run, so cap there
+        self.workers = (int(workers) if workers is not None
+                        else min(self.slots, 2))
+        self.name = name
+        self.staged_s = 0.0  # summed worker seconds packing + uploading
+        self.busy_s = 0.0  # WALL seconds with >= 1 worker staging (the
+        # interval union — overlap_frac's denominator; summed worker
+        # seconds would overstate hidden time whenever workers run
+        # concurrently with each other instead of with the consumer)
+        self.wait_s = 0.0  # consumer seconds blocked on the queue
+        self.chunks = 0
+        self.bytes = 0
+        self.max_inflight = 0
+        self._inflight = 0
+        self._busy_depth = 0
+        self._busy_since = 0.0
+        self._lock = threading.Lock()
+
+    # -- slot bookkeeping (counter + gauge + high-water mark) ---------------
+
+    def _slot_taken(self) -> None:
+        with self._lock:
+            self._inflight += 1
+            self.max_inflight = max(self.max_inflight, self._inflight)
+        INFLIGHT_SLOTS.inc(pipeline=self.name)
+
+    def _slot_freed(self, sem: threading.Semaphore) -> None:
+        with self._lock:
+            self._inflight -= 1
+        INFLIGHT_SLOTS.dec(pipeline=self.name)
+        sem.release()
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def _busy_enter(self) -> None:
+        with self._lock:
+            if self._busy_depth == 0:
+                self._busy_since = time.perf_counter()
+            self._busy_depth += 1
+
+    def _busy_exit(self) -> None:
+        with self._lock:
+            self._busy_depth -= 1
+            if self._busy_depth == 0:
+                self.busy_s += time.perf_counter() - self._busy_since
+
+    def overlap_frac(self) -> float:
+        """Fraction of staging WALL time hidden behind the consumer:
+        ``(busy_s - wait_s) / busy_s`` clamped to [0, 1] (0 with no
+        staging at all). ``busy_s`` is the interval union over workers,
+        so concurrent workers hiding only each other do not inflate the
+        figure; consumer queue/future waits are exactly the staging
+        seconds that could NOT be overlapped — the first chunk's wait is
+        inherent pipeline fill and correctly counts against it."""
+        if self.busy_s <= 0.0:
+            return 0.0
+        return max(0.0, min(1.0, (self.busy_s - self.wait_s)
+                            / self.busy_s))
+
+    # -- the stream ---------------------------------------------------------
+
+    def stream(self, items: Iterable, pack: Callable[[Any], Any],
+               upload: Callable[[Any], Any] | None = None):
+        """Yield ``(index, staged)`` for every item, in order.
+
+        ``pack(item)`` runs on a worker thread (host-side chunk build);
+        ``upload(packed)``, when given, runs on the same worker right
+        after (device puts — async in jax, so the worker returns once the
+        transfer is enqueued). The producer thread advances ``items``
+        itself, so an expensive source iterator (an event-store scan) is
+        also off the consumer's thread.
+
+        Error contract: an exception from ``items``, ``pack`` or
+        ``upload`` re-raises at the consumer's next iteration — after the
+        failing chunk's slot is returned, so nothing leaks. Closing the
+        generator early (consumer ``break``/exception) stops the
+        producer, waits out in-flight workers, and drains every held
+        slot.
+        """
+        sem = threading.Semaphore(self.slots)
+        stop = threading.Event()
+        q: queue.Queue = queue.Queue()
+
+        def stage(item):
+            if stop.is_set():
+                raise _Cancelled()
+            self._busy_enter()
+            try:
+                t0 = time.perf_counter()
+                staged = pack(item)
+                t1 = time.perf_counter()
+                STAGE_SECONDS.observe(t1 - t0, pipeline=self.name,
+                                      stage="pack")
+                nb = _nbytes(staged)
+                if nb > 0:  # opaque payloads (event batches) have no
+                    # byte size — all-zero samples would be histogram noise
+                    CHUNK_BYTES.observe(float(nb), pipeline=self.name)
+                if upload is not None and not stop.is_set():
+                    staged = upload(staged)
+                    STAGE_SECONDS.observe(time.perf_counter() - t1,
+                                          pipeline=self.name,
+                                          stage="upload")
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self.staged_s += dt
+                    self.chunks += 1
+                    self.bytes += nb
+                return staged
+            finally:
+                self._busy_exit()
+
+        # stage workers are hand-rolled DAEMON threads, not a
+        # ThreadPoolExecutor: executor workers are non-daemon and joined
+        # by an atexit hook, so a worker wedged in a dead device link
+        # would hang interpreter exit even after the drain below
+        # abandoned it — exactly the hang the deadline exists to prevent
+        tasks: queue.Queue = queue.Queue()
+
+        def work():
+            while True:
+                task = tasks.get()
+                if task is None:
+                    return
+                fut, item = task
+                try:
+                    fut.set_result(stage(item))
+                except BaseException as e:
+                    fut.set_exception(e)
+
+        workers = [
+            threading.Thread(
+                target=work, daemon=True,
+                name=f"pio-stager-{self.name}-{w}")
+            for w in range(self.workers)
+        ]
+        for w in workers:
+            w.start()
+
+        def produce():
+            try:
+                for idx, item in enumerate(items):
+                    while not sem.acquire(timeout=0.05):
+                        if stop.is_set():
+                            q.put(_DONE)
+                            return
+                    if stop.is_set():
+                        sem.release()
+                        q.put(_DONE)
+                        return
+                    self._slot_taken()
+                    fut: Future = Future()
+                    tasks.put((fut, item))
+                    q.put((idx, fut))
+                q.put(_DONE)
+            except BaseException as e:  # the source iterator itself raised
+                q.put(e)
+
+        producer = threading.Thread(
+            target=produce, daemon=True,
+            name=f"pio-stager-{self.name}-producer")
+        producer.start()
+        def note_wait(t0: float) -> None:
+            # consumer-blocked seconds: the queue get AND the wait for
+            # the chunk's future — both are staging time the consumer
+            # could not overlap (fut.result() on an unfinished chunk is
+            # exactly the pipeline running dry)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.wait_s += dt
+            QUEUE_WAIT_SECONDS.observe(dt, pipeline=self.name)
+
+        try:
+            while True:
+                t0 = time.perf_counter()
+                msg = q.get()
+                if msg is _DONE:
+                    note_wait(t0)
+                    return
+                if isinstance(msg, BaseException):
+                    note_wait(t0)
+                    raise msg
+                idx, fut = msg
+                try:
+                    staged = fut.result()  # worker exceptions surface here
+                except BaseException:
+                    note_wait(t0)
+                    self._slot_freed(sem)
+                    raise
+                note_wait(t0)
+                try:
+                    yield idx, staged
+                finally:
+                    self._slot_freed(sem)
+        finally:
+            stop.set()
+            # drain: slots of staged-but-unconsumed chunks must come back
+            # even when the consumer bailed mid-stream. The whole drain
+            # is deadline-bounded: a source iterator or worker stage
+            # wedged in a blocking call must not convert a consumer
+            # error into an indefinite hang — past the deadline the
+            # daemon threads are abandoned (and said so), because
+            # surfacing the caller's exception beats a perfect cleanup
+            deadline = time.monotonic() + 10.0
+            while True:
+                # aliveness BEFORE the poll: an Empty seen after the
+                # producer was already dead is conclusive (nothing can
+                # enqueue anymore) — checking after would race a final
+                # put-then-exit and leak that chunk's slot
+                alive = producer.is_alive()
+                try:
+                    msg = q.get_nowait()
+                except queue.Empty:
+                    if alive and time.monotonic() < deadline:
+                        producer.join(timeout=0.05)
+                        continue
+                    break
+                if msg is _DONE or isinstance(msg, BaseException):
+                    continue
+                _idx, fut = msg
+                try:
+                    fut.result(timeout=max(deadline - time.monotonic(),
+                                           0.05))
+                except BaseException:
+                    pass  # cancellation path: result is irrelevant
+                self._slot_freed(sem)
+            producer.join(timeout=max(deadline - time.monotonic(), 0.0))
+            for _w in workers:
+                tasks.put(None)
+            if producer.is_alive():
+                logger.warning(
+                    "transfer stager %r: source/stage still blocked %.0fs "
+                    "after cancellation; abandoning its daemon threads",
+                    self.name, 10.0)
+            # gauge reconciliation: any slot still held here belongs to
+            # an abandoned chunk (the stream is over, nothing can free it
+            # later) — a process-global gauge must not report phantom
+            # in-flight slots for the rest of the process lifetime
+            with self._lock:
+                leaked, self._inflight = self._inflight, 0
+            if leaked:
+                INFLIGHT_SLOTS.dec(float(leaked), pipeline=self.name)
+                logger.warning(
+                    "transfer stager %r: reconciled %d abandoned "
+                    "in-flight slot(s)", self.name, leaked)
+
+
+def _row_chunks(a, chunk_bytes: int) -> list:
+    """Row-major chunks of a device/host array, each ≲ ``chunk_bytes``
+    (whole array when small, not row-splittable, or of unknown size)."""
+    shape = getattr(a, "shape", None)
+    nbytes = getattr(a, "nbytes", None)
+    if not shape or nbytes is None or nbytes <= chunk_bytes:
+        return [a]
+    rows = int(shape[0])
+    n_chunks = min(rows, -(-int(nbytes) // chunk_bytes))
+    if n_chunks <= 1:
+        return [a]
+    per = -(-rows // n_chunks)
+    return [a[i: i + per] for i in range(0, rows, per)]
+
+
+def async_readback(arrays: Sequence, chunk_bytes: int | None = None,
+                   name: str = "readback") -> list[np.ndarray]:
+    """Fetch device arrays to host numpy with overlapped, chunked copies.
+
+    Every row-chunk's ``copy_to_host_async`` is issued before the first
+    blocking ``np.asarray``, so the device→host copies run concurrently
+    with each other AND with any device work still queued behind the
+    arrays (jax only starts a copy once its array is ready — which is
+    exactly what lets a user-factor fetch overlap the final item-factor
+    half-step). Plain numpy arrays pass through untouched. Returns one
+    ``np.ndarray`` per input, in order.
+    """
+    chunk_bytes = chunk_bytes or transfer_chunk_bytes()
+    staged: list[list] = []
+    for a in arrays:
+        parts = _row_chunks(a, chunk_bytes)
+        for p in parts:
+            start = getattr(p, "copy_to_host_async", None)
+            if start is not None:
+                start()
+            CHUNK_BYTES.observe(float(getattr(p, "nbytes", 0) or 0),
+                                pipeline=name)
+        staged.append(parts)
+    out: list[np.ndarray] = []
+    t0 = time.perf_counter()
+    for parts in staged:
+        if len(parts) == 1:
+            out.append(np.asarray(parts[0]))
+        else:
+            out.append(np.concatenate([np.asarray(p) for p in parts]))
+    STAGE_SECONDS.observe(time.perf_counter() - t0, pipeline=name,
+                          stage="readback")
+    return out

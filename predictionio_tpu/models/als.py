@@ -1059,11 +1059,24 @@ class ALS:
                     ctx, p, user_idx, item_idx, ratings, n_users, n_items,
                     callback)
                 t0 = time.perf_counter()
-                packed = np.asarray(
-                    jnp.concatenate([user_f, item_f], axis=0))
+                if als_dense._pipeline_enabled():
+                    # chunked async readback: train_dense already started
+                    # the user-factor copy while the final item half-step
+                    # was still executing, so this mostly waits on the
+                    # item side
+                    from predictionio_tpu.io import transfer
+
+                    uf_host, if_host = transfer.async_readback(
+                        (user_f, item_f), name="als_factors")
+                else:
+                    # PIO_TRANSFER_PIPELINE=0 restores the round-5
+                    # monolithic path END TO END — readback included
+                    packed = np.asarray(
+                        jnp.concatenate([user_f, item_f], axis=0))
+                    uf_host, if_host = packed[:n_users], packed[n_users:]
                 als_dense.last_train_phases["readback_s"] = round(
                     time.perf_counter() - t0, 3)
-                return ALSFactors(packed[:n_users], packed[n_users:])
+                return ALSFactors(uf_host, if_host)
 
         multi = ctx.mesh.devices.size > 1
         key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)

@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.io import transfer
+
 logger = logging.getLogger(__name__)
 
 #: Auto-gate budget for the densified rating matrix, in bytes (int8: one
@@ -214,16 +216,12 @@ def _collapse_corrections(su, si, sv, main_mask):
     return u_side, i_side
 
 
-def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
-                   scale: int | None = None,
-                   nb: int | None = None,
-                   uniform_m: bool = False) -> _DensePlan:
-    """``nb`` forces the row-block count (the SPMD path wants one block
-    per device); ``uniform_m`` pads every block's COO to one common size
-    (stackable into a [nb, m] sharded array)."""
-    if scale is None:
-        scale = _int8_scale(vals)
-    assert scale, "dense solver requires int8-encodable ratings"
+def _sorted_main_and_corrections(ui, ii, vals, n_users: int, n_items: int,
+                                 scale: int):
+    """The host sort + correction collapse shared by the plan builder and
+    the streamed staging path: (mu, mi, mv, dup_u, dup_i) — the cell-
+    sorted densifiable edges (mv already int8-scaled) plus the
+    per-direction correction sides."""
     su, si, sv = _sort_by_cell(ui, ii, vals, n_users, n_items)
     first = np.concatenate(
         [[True], (su[1:] != su[:-1]) | (si[1:] != si[:-1])])
@@ -236,34 +234,70 @@ def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
         mv = (sv * scale).astype(np.int8) if scale != 1 else sv.astype(np.int8)
     else:
         mu, mi, mv = su[main], si[main], (sv[main] * scale).astype(np.int8)
+    return mu, mi, mv, dup_u, dup_i
+
+
+def _block_split(mu, n_users: int, n_items: int, nb: int | None,
+                 max_block_bytes: int | None = None):
+    """(nb, ub, starts, item_dtype): the row-block layout over the
+    cell-sorted edges. ``max_block_bytes`` caps the per-block cell bytes
+    when ``nb`` is not forced (defaults to _BLOCK_BYTES)."""
     if nb is None:
-        ub = max(_BLOCK_BYTES // max(n_items, 1), 1)
+        cap = _BLOCK_BYTES if max_block_bytes is None else max_block_bytes
+        ub = max(cap // max(n_items, 1), 1)
         nb = max((n_users + ub - 1) // ub, 1)
     ub = (n_users + nb - 1) // nb
     bounds = np.searchsorted(mu, np.arange(1, nb) * ub)
     starts = np.concatenate([[0], bounds, [len(mu)]])
     item_dtype = np.uint16 if n_items <= np.iinfo(np.uint16).max else np.int32
+    return nb, ub, starts, item_dtype
+
+
+def _pack_block(b: int, mu, mi, mv, starts, ub: int, m: int | None,
+                item_dtype):
+    """One row-block's compact COO payload: (items, vals, row_starts, k).
+    ``m`` forces the padded size (uniform blocks); None pads to the next
+    multiple of 1024: XLA's TPU scatter strategy choice is size-sensitive
+    (awkward update counts fall off a ~40x perf cliff — measured round
+    3); padding entries become ascending distinct out-of-range flat ids
+    on device, dropped by the scatter while keeping
+    indices_are_sorted/unique_indices true."""
+    lo, hi = starts[b], starts[b + 1]
+    k = int(hi - lo)
+    if m is None:
+        m = max((k + 1023) // 1024 * 1024, 1024)
+    f = np.zeros(m, item_dtype)
+    v = np.zeros(m, np.int8)
+    f[:k] = mi[lo:hi].astype(item_dtype)
+    v[:k] = mv[lo:hi]
+    row_starts = np.searchsorted(
+        mu[lo:hi], b * ub + np.arange(ub + 1)).astype(np.int32)
+    return f, v, row_starts, k
+
+
+def _dense_prepare(ui, ii, vals, n_users: int, n_items: int,
+                   scale: int | None = None,
+                   nb: int | None = None,
+                   uniform_m: bool = False) -> _DensePlan:
+    """``nb`` forces the row-block count (the SPMD path wants one block
+    per device); ``uniform_m`` pads every block's COO to one common size
+    (stackable into a [nb, m] sharded array)."""
+    if scale is None:
+        scale = _int8_scale(vals)
+    assert scale, "dense solver requires int8-encodable ratings"
+    mu, mi, mv, dup_u, dup_i = _sorted_main_and_corrections(
+        ui, ii, vals, n_users, n_items, scale)
+    nb, ub, starts, item_dtype = _block_split(mu, n_users, n_items, nb)
     sizes = np.diff(starts)
     common_m = max(int(sizes.max()) + 1023, 1024) // 1024 * 1024
     items, bvals, row_starts, counts = [], [], [], []
     for b in range(nb):
-        lo, hi = starts[b], starts[b + 1]
-        k = int(hi - lo)
-        # padded to a multiple of 1024: XLA's TPU scatter strategy choice
-        # is size-sensitive (awkward update counts fall off a ~40x perf
-        # cliff — measured round 3); padding entries become ascending
-        # distinct out-of-range flat ids on device, dropped by the
-        # scatter while keeping indices_are_sorted/unique_indices true
-        m = common_m if uniform_m else max(
-            (k + 1023) // 1024 * 1024, 1024)
-        f = np.zeros(m, item_dtype)
-        v = np.zeros(m, np.int8)
-        f[:k] = mi[lo:hi].astype(item_dtype)
-        v[:k] = mv[lo:hi]
+        f, v, rs, k = _pack_block(
+            b, mu, mi, mv, starts, ub, common_m if uniform_m else None,
+            item_dtype)
         items.append(f)
         bvals.append(v)
-        row_starts.append(np.searchsorted(
-            mu[lo:hi], b * ub + np.arange(ub + 1)).astype(np.int32))
+        row_starts.append(rs)
         counts.append(k)
     return _DensePlan(nb, ub, items, bvals, row_starts, counts, scale,
                       dup_u, dup_i, n_users, n_items)
@@ -598,6 +632,40 @@ def _dense_iteration(
         rank, scale, ub, exact, kernel)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
+    donate_argnums=(0,),
+)
+def _dense_user_half(
+    user_f, item_f, blocks, dup_u, lambda_, alpha,
+    *, implicit: bool, rank: int, scale: int, ub: int,
+    exact: bool = False, kernel: bool = False,
+):
+    """The user half-step as its own dispatch — the pipelined train runs
+    the FINAL iteration as two half dispatches so the finished user
+    factors' device→host copy overlaps the item half still executing."""
+    return _dense_half_solve(
+        user_f, item_f, blocks, None, dup_u, lambda_, alpha, implicit,
+        rank, scale, ub, exact, kernel)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
+    donate_argnums=(0,),
+)
+def _dense_item_half(
+    item_f, user_f, blocks, dup_i, lambda_, alpha,
+    *, implicit: bool, rank: int, scale: int, ub: int,
+    exact: bool = False, kernel: bool = False,
+):
+    """The item half-step twin of :func:`_dense_user_half`."""
+    return _dense_half_solve(
+        item_f, user_f, None, blocks, dup_i, lambda_, alpha, implicit,
+        rank, scale, ub, exact, kernel)
+
+
 #: Merged-A gate: concatenating the row blocks into ONE [nb*ub, n_items]
 #: array needs headroom for the in-place build (the full array plus one
 #: block's scatter transient); past this many cells the per-block layout
@@ -661,12 +729,7 @@ def prepare_device_inputs(plan: _DensePlan, pad_for_kernel: bool = False,
                             (0, items_p - plan.n_items)))
                 for a in blocks
             )
-    dup_u = dup_i = None
-    if plan.dup_u is not None:
-        dup_u = tuple(jax.device_put(x) for x in (
-            plan.dup_u.seg, plan.dup_u.nbr, plan.dup_u.cnt, plan.dup_u.val))
-        dup_i = tuple(jax.device_put(x) for x in (
-            plan.dup_i.seg, plan.dup_i.nbr, plan.dup_i.cnt, plan.dup_i.val))
+    dup_u, dup_i = _device_dups(plan.dup_u, plan.dup_i)
     return blocks, dup_u, dup_i
 
 
@@ -675,14 +738,114 @@ def should_merge(plan: _DensePlan, kernel: bool) -> bool:
     kernel path (per-block tile padding) or the in-place build headroom
     (_MERGE_MAX_CELLS) says otherwise. Shared by train_dense and bench's
     steady timer so both run the same program."""
-    return (not kernel and plan.nb > 1
-            and plan.nb * plan.ub * plan.n_items <= _MERGE_MAX_CELLS)
+    return should_merge_dims(plan.nb, plan.ub, plan.n_items, kernel)
 
 
 def merged_ub(plan: _DensePlan, merged: bool) -> int:
     """Rows-per-block the solver should assume: the whole padded row
     count when the blocks were merged into one."""
     return plan.nb * plan.ub if merged else plan.ub
+
+
+def _pipeline_enabled() -> bool:
+    """Whether staging/readback ride the overlapped transfer pipeline
+    (``PIO_TRANSFER_PIPELINE``, default on). The ``0`` escape hatch keeps
+    the round-5 monolithic path runnable for A/B measurement and as a
+    fallback if a backend misbehaves under threaded device puts."""
+    import os
+
+    return os.environ.get("PIO_TRANSFER_PIPELINE", "1") != "0"
+
+
+def _device_dups(dup_u, dup_i):
+    """Correction sides as device arrays (tiny; one put each)."""
+    if dup_u is None:
+        return None, None
+    du = tuple(jax.device_put(x) for x in (
+        dup_u.seg, dup_u.nbr, dup_u.cnt, dup_u.val))
+    di = tuple(jax.device_put(x) for x in (
+        dup_i.seg, dup_i.nbr, dup_i.cnt, dup_i.val))
+    return du, di
+
+
+def _stream_device_inputs(mu, mi, mv, dup_u, dup_i, scale: int,
+                          n_users: int, n_items: int, kernel: bool,
+                          phases: dict) -> dict:
+    """Chunk-streamed build of the densified device inputs: a background
+    worker packs + uploads row-block ``k+1``'s compact COO while this
+    thread enqueues the device densify of block ``k`` — so host prepare,
+    the host→device copies, and the device scatters all overlap instead
+    of running as three serial phases. Returns the same entry dict as the
+    monolithic ``prepare_device_inputs`` path and records the stager's
+    overlap accounting into ``phases`` (``overlap_frac`` is the fraction
+    of host staging time hidden behind device consumption).
+
+    Chunk sizing: PIO_TRANSFER_CHUNK_MB refines the streaming unit ONLY
+    when the chunks merge into one A (each chunk is then a transient
+    scatter+place — the solve program never sees it). Non-merged
+    configs (kernel path, matrices past _MERGE_MAX_CELLS) keep the
+    _BLOCK_BYTES solve-block layout: their blocks feed _dense_half_solve
+    directly, and letting a *staging* tunable multiply the per-iteration
+    dot dispatches would be a silent solve regression."""
+    nb, ub, starts, item_dtype = _block_split(
+        mu, n_users, n_items, None,
+        max_block_bytes=min(_BLOCK_BYTES, transfer.transfer_chunk_bytes()))
+    merge = should_merge_dims(nb, ub, n_items, kernel)
+    if not merge:
+        nb, ub, starts, item_dtype = _block_split(mu, n_users, n_items,
+                                                  None)
+
+    def pack(b: int):
+        return b, _pack_block(b, mu, mi, mv, starts, ub, None, item_dtype)
+
+    def upload(packed):
+        b, (f, v, rs, k) = packed
+        return (b, jax.device_put(f), jax.device_put(v),
+                jax.device_put(rs), jnp.int32(k))
+
+    ub_p = items_p = None
+    if kernel:
+        from predictionio_tpu.ops.dense_dots import PAD_MULTIPLE
+
+        ub_p = -(-ub // PAD_MULTIPLE) * PAD_MULTIPLE
+        items_p = -(-n_items // PAD_MULTIPLE) * PAD_MULTIPLE
+
+    stager = transfer.ChunkStager(name="als_densify")
+    acc = jnp.zeros((nb * ub, n_items), jnp.int8) if merge else None
+    blocks_list = []
+    for _idx, (b, fd, vd, rsd, kd) in stager.stream(
+            range(nb), pack, upload=upload):
+        if merge:
+            acc = _place_block(fd, vd, rsd, kd, acc, b,
+                               ub=ub, n_items=n_items)
+        else:
+            a = _scatter_block(fd, vd, rsd, kd, ub=ub, n_items=n_items)
+            if kernel and (ub_p, items_p) != (ub, n_items):
+                a = jnp.pad(a, ((0, ub_p - ub), (0, items_p - n_items)))
+            blocks_list.append(a)
+    blocks = (acc,) if merge else tuple(blocks_list)
+    du, di = _device_dups(dup_u, dup_i)
+    nd = 0 if dup_u is None else len(dup_u.seg)
+    phases["transfer_chunks"] = nb
+    phases["transfer_stage_s"] = round(stager.staged_s, 3)
+    phases["transfer_wait_s"] = round(stager.wait_s, 3)
+    phases["overlap_frac"] = round(stager.overlap_frac(), 3)
+    logger.info(
+        "ALS(dense): %d edges -> %d x %d int8 cells streamed in %d "
+        "chunk(s)%s, %d correction cells, scale %d, dots=%s, "
+        "overlap %.0f%%",
+        len(mu), n_users, n_items, nb, " (merged)" if merge else "",
+        nd, scale, "pallas" if kernel else "xla",
+        100 * phases["overlap_frac"])
+    return dict(blocks=blocks, dup_u=du, dup_i=di, scale=scale,
+                ub=nb * ub if merge else ub, nb=nb, nd=nd)
+
+
+def should_merge_dims(nb: int, ub: int, n_items: int, kernel: bool) -> bool:
+    """`should_merge` on raw block dimensions (the streamed path has no
+    _DensePlan to hand over)."""
+    return (not kernel and nb > 1
+            and nb * ub * n_items <= _MERGE_MAX_CELLS)
 
 
 #: Phase seconds of the most recent train_dense call, for bench/ops
@@ -760,7 +923,29 @@ def acquire_device_inputs(ui, ii, ratings, n_users: int, n_items: int,
         entry = _A_CACHE.get(key)
     phases["cache_hit"] = entry is not None
 
-    if entry is None:
+    if entry is None and _pipeline_enabled():
+        # streamed path: the blocking host work is just the cell sort +
+        # correction collapse (prepare); per-block packing and the
+        # host→device copies then overlap the device densify inside
+        # _stream_device_inputs, so upload_densify_s is pipeline wall
+        # time, not a serial sum
+        scale = _int8_scale(ratings)
+        assert scale, "dense solver requires int8-encodable ratings"
+        t0 = time.perf_counter()
+        mu, mi, mv, dup_u, dup_i = _sorted_main_and_corrections(
+            ui, ii, ratings, n_users, n_items, scale)
+        phases["prepare_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        entry = _stream_device_inputs(
+            mu, mi, mv, dup_u, dup_i, scale, n_users, n_items, kernel,
+            phases)
+        if sync_timing:
+            _phase_sync(entry["blocks"][0])
+        phases["upload_densify_s"] = round(time.perf_counter() - t0, 3)
+        if key is not None:
+            _A_CACHE.clear()  # one entry: evict before pinning a new A
+            _A_CACHE[key] = entry
+    elif entry is None:
         t0 = time.perf_counter()
         plan = _dense_prepare(ui, ii, ratings, n_users, n_items)
         phases["prepare_s"] = round(time.perf_counter() - t0, 3)
@@ -824,7 +1009,33 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                   exact=p.gather_dtype == "float32",
                   kernel=kernel)
     t0 = time.perf_counter()
-    if callback is None:
+    if callback is None and _pipeline_enabled() and p.num_iterations >= 1:
+        # the final iteration runs as two half dispatches: once the user
+        # half lands, its factors' d2h copy is kicked off and proceeds
+        # concurrently with the item half still executing on device —
+        # the readback overlap half of the transfer pipeline (the caller
+        # collects both arrays via io.transfer.async_readback)
+        user_f, item_f = _dense_train(
+            user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
+            p.num_iterations - 1, **static)
+
+        def start_fetch(x):
+            # whole-array d2h copy, started early (pure DMA — overlaps
+            # the compute still queued behind it). Only when the caller's
+            # async_readback will NOT row-chunk the array: above the
+            # chunk threshold it slices and copies per chunk, and a
+            # redundant whole-array copy here would double the d2h bytes
+            if (hasattr(x, "copy_to_host_async")
+                    and x.nbytes <= transfer.transfer_chunk_bytes()):
+                x.copy_to_host_async()
+
+        user_f = _dense_user_half(
+            user_f, item_f, blocks, dup_u, p.lambda_, p.alpha, **static)
+        start_fetch(user_f)
+        item_f = _dense_item_half(
+            item_f, user_f, blocks, dup_i, p.lambda_, p.alpha, **static)
+        start_fetch(item_f)
+    elif callback is None:
         user_f, item_f = _dense_train(
             user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
             p.num_iterations, **static)
@@ -835,7 +1046,7 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                 **static)
             callback(it, user_f, item_f)
     if sync_timing:
-        _phase_sync(user_f)
+        _phase_sync(item_f)
     phases["solve_s"] = round(time.perf_counter() - t0, 3)
     global last_train_phases
     last_train_phases = phases

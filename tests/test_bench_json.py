@@ -1,0 +1,33 @@
+"""bench.py stdout contract: the headline JSON is the FINAL stdout line.
+
+Every driver capture through BENCH_r05 recorded ``"parsed": null``
+because stray output shared stdout with the headline line. main() now
+redirects all collection-time stdout to stderr and prints the doc last;
+``--dry-run`` exercises exactly that emission path (including a
+deliberate stray print) without any device work, so this guard runs in
+tier-1 on a CPU host."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_dry_run_last_stdout_line_is_the_headline_json():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py"), "--dry-run"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.splitlines()
+    assert lines, "no stdout at all"
+    doc = json.loads(lines[-1])  # the contract the driver relies on
+    assert doc["metric"] == "ml20m_als_rank10_iterations_per_sec"
+    assert set(doc) >= {"metric", "value", "unit", "vs_baseline", "extra"}
+    assert doc["extra"]["dry_run"] is True
+    # nothing after the JSON — and nothing before it either: the stray
+    # dry-run print must have been routed to stderr
+    assert [l for l in lines if l.strip()] == [lines[-1]]
+    assert "dry-run" in proc.stderr

@@ -224,6 +224,7 @@ def test_all_registered_metric_names_follow_convention():
     then assert the whole process registry obeys pio_ + snake_case."""
     import predictionio_tpu.data.api.event_server  # noqa: F401
     import predictionio_tpu.data.storage.sql  # noqa: F401
+    import predictionio_tpu.io.transfer  # noqa: F401
     import predictionio_tpu.serve.cache  # noqa: F401
     import predictionio_tpu.serve.gateway  # noqa: F401
     import predictionio_tpu.serve.registry  # noqa: F401
@@ -251,8 +252,31 @@ def test_all_registered_metric_names_follow_convention():
                      "pio_gateway_cache_misses_total",
                      "pio_gateway_cache_evictions_total",
                      "pio_gateway_cache_entries",
-                     "pio_gateway_coalesced_total"):
+                     "pio_gateway_coalesced_total",
+                     # transfer-pipeline scrape surface (ISSUE 3)
+                     "pio_transfer_stage_seconds",
+                     "pio_transfer_queue_wait_seconds",
+                     "pio_transfer_chunk_bytes",
+                     "pio_transfer_inflight_slots"):
         assert required in names
+
+
+def test_transfer_stage_histogram_registers_once():
+    """Both transfer-pipeline consumers (dense ALS staging and the
+    data/view scan ETL) must share ONE set of pio_transfer_* metric
+    objects — get-or-create registration, not per-importer duplicates
+    whose samples would split across instances."""
+    import predictionio_tpu.data.view.data_view  # noqa: F401
+    import predictionio_tpu.models.als_dense  # noqa: F401
+    from predictionio_tpu.io import transfer
+
+    assert REGISTRY.get("pio_transfer_stage_seconds") \
+        is transfer.STAGE_SECONDS
+    assert REGISTRY.get("pio_transfer_chunk_bytes") is transfer.CHUNK_BYTES
+    assert REGISTRY.get("pio_transfer_queue_wait_seconds") \
+        is transfer.QUEUE_WAIT_SECONDS
+    assert REGISTRY.get("pio_transfer_inflight_slots") \
+        is transfer.INFLIGHT_SLOTS
 
 
 # -- request-id context ------------------------------------------------------
