@@ -889,12 +889,14 @@ def _dry_run_doc() -> dict:
     }
 
 
-def main(metrics_snapshot: bool = False, dry_run: bool = False) -> None:
-    """Emit the headline JSON as the FINAL stdout line with nothing after
-    it. Everything the run prints to stdout along the way (library
+def emit_headline(collect) -> None:
+    """Emit ``collect()``'s doc as the FINAL stdout line with nothing
+    after it. Everything the run prints to stdout along the way (library
     banners, stray logging, section chatter) is redirected to stderr —
     every BENCH_r0*.json capture so far recorded ``"parsed": null``
-    because the driver could not parse the last stdout line."""
+    because the driver could not parse the last stdout line. The ONE
+    implementation of that contract, shared by every bench entrypoint
+    (bench.py, bench_sweep.py)."""
     import contextlib
     import logging as _logging
     import sys as _sys
@@ -905,9 +907,14 @@ def main(metrics_snapshot: bool = False, dry_run: bool = False) -> None:
     _logging.basicConfig(stream=_sys.stderr)
     real_stdout = _sys.stdout
     with contextlib.redirect_stdout(_sys.stderr):
-        doc = _dry_run_doc() if dry_run else _collect(metrics_snapshot)
+        doc = collect()
     print(json.dumps(doc), file=real_stdout)
     real_stdout.flush()
+
+
+def main(metrics_snapshot: bool = False, dry_run: bool = False) -> None:
+    emit_headline(
+        lambda: _dry_run_doc() if dry_run else _collect(metrics_snapshot))
 
 
 if __name__ == "__main__":

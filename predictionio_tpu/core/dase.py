@@ -132,6 +132,11 @@ class LServing(BaseServing[Q, P]):
 class FirstServing(LServing[Q, P]):
     """Serve the first algorithm's prediction (ref: LFirstServing.scala:25)."""
 
+    #: identity supplement + first-prediction serve — the device-batched
+    #: sweep (core/sweep.py) may skip serve() for single-algorithm
+    #: candidates without changing results
+    batch_passthrough = True
+
     def __init__(self, params=None):
         pass
 

@@ -52,6 +52,12 @@ class MetricEvaluatorResult(BaseEvaluatorResult):
     engine_params_scores: list[tuple[EngineParams, MetricScores]] = field(
         default_factory=list
     )
+    #: wall seconds spent per candidate, in candidate order (batched sweep
+    #: candidates report their bucket's wall divided across the bucket)
+    candidate_seconds: list[float] = field(default_factory=list)
+    #: execution summary from the sweep executor: how many candidates ran
+    #: device-batched vs sequential, bucket shapes, stage seconds
+    sweep: dict = field(default_factory=dict)
 
     def to_one_liner(self) -> str:
         return f"[{self.best_score.score}] {self.metric_header}"
@@ -73,6 +79,10 @@ class MetricEvaluatorResult(BaseEvaluatorResult):
                 }
                 for ep, ms in self.engine_params_scores
             ],
+            # sweep-progress surface for the dashboard (ISSUE 4): how long
+            # each candidate took and how the sweep executed
+            "candidateSeconds": [round(s, 3) for s in self.candidate_seconds],
+            "sweep": self.sweep,
         }
 
     def to_html(self) -> str:
@@ -122,6 +132,15 @@ class MetricEvaluator(BaseEvaluator):
             )
             logger.info("candidate %d: %s = %s", i, self.metric.header, ms.score)
             scores.append((engine_params, ms))
+        return self.result_from_scores(scores)
+
+    def result_from_scores(
+        self, scores: list[tuple[EngineParams, MetricScores]]
+    ) -> MetricEvaluatorResult:
+        """Best-candidate selection + best.json from already-computed
+        per-candidate scores — the shared tail of :meth:`evaluate` and the
+        device-batched sweep executor (which never materializes an
+        eval_data_set for batched candidates)."""
         best_idx, (best_params, best_score) = max(
             enumerate(scores),
             key=lambda t: self.metric.compare_key(t[1][1].score),
@@ -177,14 +196,47 @@ class Evaluation:
         return MetricEvaluator(self.metric, self.other_metrics, self.output_path)
 
     def run(
-        self, ctx: ComputeContext, params: WorkflowParams | None = None
+        self,
+        ctx: ComputeContext,
+        params: WorkflowParams | None = None,
+        progress=None,
     ) -> MetricEvaluatorResult:
-        """batchEval + evaluateBase (ref: EvaluationWorkflow.scala:31-41)."""
+        """batchEval + evaluateBase (ref: EvaluationWorkflow.scala:31-41).
+
+        Candidates whose algorithm, serving, and metric all support the
+        device-batched sweep protocol are grouped by shared
+        (dataSource, preparator) params, bucketed by batch signature
+        (e.g. ALS rank), and trained/scored as ONE stacked device program
+        per bucket (core/sweep.py); everything else runs the sequential
+        per-candidate path. ``PIO_SWEEP_BATCH=0`` disables batching
+        entirely. ``progress(done, total, detail)`` is called as
+        candidates complete (the evaluation workflow persists it so the
+        dashboard can show sweep progress)."""
         if self.engine is None:
             raise ValueError("Evaluation has no engine")
         if not self.engine_params_list:
             raise ValueError("Evaluation has no engine params candidates")
-        engine_eval_data_set = self.engine.batch_eval(
-            ctx, self.engine_params_list, params
+        from predictionio_tpu.core.fast_eval import FastEvalEngine
+
+        evaluator = self.evaluator
+        # custom BaseEvaluator subclasses (e.g. the stock example's
+        # backtester), overridden MetricEvaluator.evaluate hooks, and
+        # overridden Engine.batch_eval implementations keep the legacy
+        # whole-sweep contract: one batch_eval over the full candidate
+        # list, one evaluate over every candidate's full eval_data_set
+        legacy = (
+            not isinstance(evaluator, MetricEvaluator)
+            or type(evaluator).evaluate is not MetricEvaluator.evaluate
+            or type(self.engine).batch_eval not in (
+                Engine.batch_eval, FastEvalEngine.batch_eval)
         )
-        return self.evaluator.evaluate(ctx, self, engine_eval_data_set, params)
+        if legacy:
+            engine_eval_data_set = self.engine.batch_eval(
+                ctx, self.engine_params_list, params
+            )
+            return evaluator.evaluate(
+                ctx, self, engine_eval_data_set, params
+            )
+        from predictionio_tpu.core import sweep
+
+        return sweep.execute(self, ctx, params, progress)

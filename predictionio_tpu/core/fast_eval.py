@@ -60,6 +60,28 @@ class FastEvalEngineWorkflow:
             ]
         return self.preparator_cache[key]
 
+    def algorithms_key(self, engine_params: EngineParams) -> str:
+        """The algorithms-stage cache key of one candidate — lets callers
+        plan model-cache eviction (see :meth:`release_algorithms`)."""
+        return _key(
+            engine_params.data_source_params,
+            engine_params.preparator_params,
+            list(engine_params.algorithms_params),
+        )
+
+    def release_algorithms(self, engine_params: EngineParams) -> bool:
+        """Drop one candidate's trained models from ``algorithms_cache``.
+
+        The prefix memoization otherwise pins EVERY candidate's models (and
+        whatever device memory they reference through the serving device
+        cache) for the whole sweep; the sweep executor calls this once a
+        candidate's host-side scores are extracted and no later candidate
+        shares the algorithms prefix. Returns whether an entry was freed."""
+        return (
+            self.algorithms_cache.pop(self.algorithms_key(engine_params), None)
+            is not None
+        )
+
     # ref: computeAlgorithmsResult:128
     def get_algorithms_result(self, dsp, pp, algo_params_list):
         key = _key(dsp, pp, list(algo_params_list))

@@ -12,12 +12,22 @@ import math
 from abc import ABC, abstractmethod
 from typing import Any, Generic, Sequence, TypeVar
 
+import numpy as np
+
 EI = TypeVar("EI")
 Q = TypeVar("Q")
 P = TypeVar("P")
 A = TypeVar("A")
 
 EvalDataSet = Sequence[tuple[Any, Sequence[tuple[Any, Any, Any]]]]
+
+#: Column layout of the per-fold, per-candidate statistics a device-batched
+#: metric returns from :meth:`Metric.batched_fold_stats`: per candidate the
+#: (sum, sum-of-squares, count) of its per-query scores. Sums are enough to
+#: reproduce every QPA reduction this module ships (mean / population stdev /
+#: sum), and they ADD across folds — the sweep executor accumulates one
+#: [n_candidates, 3] array per metric and finalizes once at the end.
+BATCHED_STAT_COLS = 3
 
 
 class Metric(ABC, Generic[EI, Q, P, A]):
@@ -29,6 +39,37 @@ class Metric(ABC, Generic[EI, Q, P, A]):
     @abstractmethod
     def calculate(self, eval_data_set: EvalDataSet) -> float:
         """Fold the whole evaluation result set into a score."""
+
+    # -- device-batched sweep protocol (core/sweep.py) -----------------------
+
+    def batched_fold_stats(self, trained: Any, qa_pairs) -> "np.ndarray | None":
+        """Score EVERY sweep candidate's fold in one device dispatch.
+
+        ``trained`` is whatever the algorithm's ``batch_train`` returned
+        (typically stacked device factors); ``qa_pairs`` the fold's
+        (query, actual) list. Returns [n_candidates, BATCHED_STAT_COLS]
+        host stats — (sum, sumsq, count) of per-query scores, matching
+        ``calculate_qpa`` semantics exactly (None scores excluded from all
+        three columns) — or None when this metric cannot score the fold on
+        device (the sweep then falls back to the per-query Python loop).
+        The base implementation is that fallback signal.
+
+        Raw-moment caveat: the (sum, sumsq) columns finalize via
+        ``sumsq/n − mean²``, which cancels catastrophically when
+        ``|mean| ≫ spread`` (scores ~1e6+ with small variance).
+        Implementations with large-offset scores should subtract a fixed
+        shift before summing (stdev is shift-invariant; for Average, add
+        the shift back in a custom ``batched_finalize``) or return None to
+        keep the sequential two-pass path."""
+        return None
+
+    def batched_finalize(self, stats: "np.ndarray") -> "np.ndarray":
+        """[n_candidates] scores from accumulated ``batched_fold_stats``
+        output. Implemented by the reduction base classes below; a metric
+        without a finalizer cannot take the batched path."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support batched sweep scoring"
+        )
 
     def compare_key(self, score: float) -> float:
         if score is None or (isinstance(score, float) and math.isnan(score)):
@@ -64,6 +105,12 @@ class AverageMetric(QPAMetric[EI, Q, P, A]):
         scores = self._scores(eval_data_set)
         return sum(scores) / len(scores) if scores else float("nan")
 
+    def batched_finalize(self, stats: "np.ndarray") -> "np.ndarray":
+        s, _ss, n = np.asarray(stats, np.float64).T
+        # zero-count candidates score NaN — the same empty-scores path as
+        # calculate() above (compare_key orders NaN below every real score)
+        return np.where(n > 0, s / np.maximum(n, 1.0), np.nan)
+
 
 class OptionAverageMetric(AverageMetric[EI, Q, P, A]):
     """ref: Metric.scala OptionAverageMetric:132 — None scores are excluded
@@ -80,12 +127,27 @@ class StdevMetric(QPAMetric[EI, Q, P, A]):
         mean = sum(scores) / len(scores)
         return math.sqrt(sum((s - mean) ** 2 for s in scores) / len(scores))
 
+    def batched_finalize(self, stats: "np.ndarray") -> "np.ndarray":
+        # raw-moment formula: fine for the O(1)-scale scores the shipped
+        # batched metrics produce, but loses precision when |mean| ≫
+        # spread — see the Metric.batched_fold_stats caveat (implementers
+        # should shift-center large-offset scores; stdev is
+        # shift-invariant)
+        s, ss, n = np.asarray(stats, np.float64).T
+        nn = np.maximum(n, 1.0)
+        mean = s / nn
+        var = np.maximum(ss / nn - mean * mean, 0.0)
+        return np.where(n > 0, np.sqrt(var), np.nan)
+
 
 class SumMetric(QPAMetric[EI, Q, P, A]):
     """ref: Metric.scala SumMetric:217"""
 
     def calculate(self, eval_data_set: EvalDataSet) -> float:
         return float(sum(self._scores(eval_data_set)))
+
+    def batched_finalize(self, stats: "np.ndarray") -> "np.ndarray":
+        return np.asarray(stats, np.float64)[:, 0]
 
 
 class ZeroMetric(Metric[EI, Q, P, A]):

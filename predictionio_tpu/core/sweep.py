@@ -1,0 +1,405 @@
+"""Device-batched hyperparameter sweep execution.
+
+The sequential sweep (core/fast_eval.py) memoizes DataSource/Preparator
+stages but still trains every candidate serially — each with its own
+device upload, compile, and per-query Python metric loop. ALX (arxiv
+2112.02194) shows TPU matrix factorization wins by batching many small
+solves into one large static-shape program, and Google's ads-training
+infrastructure paper (arxiv 2501.10546) makes the same case for
+amortizing input staging across many candidate models — exactly the
+shape a hyperparameter sweep has. This module is that execution path:
+
+1. Candidates are grouped by shared (dataSource, preparator) params so
+   each group's folds are read and prepared once (the FastEval caches).
+2. Within a group, candidates whose single algorithm supports the batch
+   protocol are bucketed by the algorithm's ``batch_signature()`` —
+   for ALS that is (rank, iterations, implicit): everything that must be
+   a static shape or branch in the stacked program. Per-candidate
+   *scalars* (regularization, alpha, seed) ride a leading candidate axis.
+3. Each bucket trains as ONE stacked device program (``batch_train`` —
+   for ALS a vmapped dense solve sharing a single staged A upload through
+   the PR-3 ChunkStager/dense-A cache) and scores as ONE batched metric
+   dispatch (``Metric.batched_fold_stats``) that reads back a single
+   [n_candidates] stats vector — no per-query Python loop.
+4. Everything else (custom metrics, multi-algorithm candidates, custom
+   serving, singleton buckets) falls back to the sequential per-candidate
+   path, still sharing the stage caches. ``PIO_SWEEP_BATCH=0`` forces the
+   sequential path end to end.
+
+The executor also bounds the FastEval model cache: sequential candidates
+release their trained models as soon as their host-side scores are
+extracted and no later candidate shares the algorithms prefix, and
+batched buckets free their stacked device factors the moment the metric
+vector is read back.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from predictionio_tpu.core.engine import EngineParams, WorkflowParams, _instantiate
+from predictionio_tpu.core.evaluation import MetricScores
+from predictionio_tpu.core.fast_eval import FastEvalEngine, FastEvalEngineWorkflow, _key
+from predictionio_tpu.core.metrics import BATCHED_STAT_COLS, Metric
+from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
+
+logger = logging.getLogger(__name__)
+
+#: Wall seconds per sweep-bucket stage. ONE histogram for all stages
+#: (label-split, the pio_transfer_* convention): ``stage`` = fold read +
+#: prepare for a candidate group, ``solve`` = a bucket chunk's stacked
+#: train (including its shared A staging), ``score`` = the batched device
+#: metric dispatch + [n_candidates] readback.
+SWEEP_STAGE_SECONDS = REGISTRY.histogram(
+    "pio_sweep_stage_seconds",
+    "Wall seconds per device-batched sweep stage",
+    labels=("stage",),
+)
+
+#: Candidates per executed bucket chunk (how much stacking the sweep
+#: actually achieved; 1-wide observations mean the memory cap or bucket
+#: shapes degraded the batching).
+BUCKET_CANDIDATES = REGISTRY.histogram(
+    "pio_sweep_candidates_per_bucket",
+    "Candidates per stacked sweep-bucket solve",
+    buckets=DEFAULT_SIZE_BUCKETS,
+)
+
+#: Sweep candidates by execution path (batched vs sequential fallback).
+CANDIDATES_TOTAL = REGISTRY.counter(
+    "pio_sweep_candidates_total",
+    "Sweep candidates evaluated, by execution path",
+    labels=("path",),
+)
+
+
+def sweep_enabled() -> bool:
+    """``PIO_SWEEP_BATCH`` (default on), read at call time so a live
+    process — and the A/B bench — can flip paths without restarting."""
+    return os.environ.get("PIO_SWEEP_BATCH", "1") != "0"
+
+
+#: Buckets below this many candidates run sequentially: a 1-wide stacked
+#: program pays vmap compile variance for no amortization.
+MIN_BUCKET = 2
+
+
+def _defining_class(cls: type, name: str) -> type | None:
+    """The MRO class that defines ``name`` (None when nowhere)."""
+    for c in cls.__mro__:
+        if name in c.__dict__:
+            return c
+    return None
+
+
+def _hooks_consistent(cls: type, device_attr: str,
+                      sequential_attrs: tuple) -> bool:
+    """The device-path hook must be defined AT OR BELOW every sequential
+    hook in the MRO: a subclass that overrides sequential behavior (a
+    custom serve(), calculate_qpa(), train(), ...) without re-declaring
+    the device hook would otherwise be silently batched with the BASE
+    class's kernels — different results than ``PIO_SWEEP_BATCH=0``,
+    which must never happen. Such subclasses fall back to sequential."""
+    dev = _defining_class(cls, device_attr)
+    if dev is None:
+        return False
+    for name in sequential_attrs:
+        seq = _defining_class(cls, name)
+        if seq is not None and not issubclass(dev, seq):
+            return False
+    return True
+
+
+def _metric_batchable(m: Metric) -> bool:
+    """Whether ``m`` implements the device-batched scoring hooks (the
+    base ``batched_fold_stats`` is the not-supported signal) and no
+    subclass changed the sequential semantics underneath them."""
+    cls = type(m)
+    return (
+        cls.batched_fold_stats is not Metric.batched_fold_stats
+        and cls.batched_finalize is not Metric.batched_finalize
+        and _hooks_consistent(cls, "batched_fold_stats",
+                              ("calculate", "calculate_qpa", "_scores"))
+    )
+
+
+def _serving_batchable(serving_cls: type) -> bool:
+    """A pass-through serving layer the sweep may skip: the class
+    carrying ``batch_passthrough = True`` must also be the one (or a
+    descendant of the ones) defining serve/supplement."""
+    return bool(getattr(serving_cls, "batch_passthrough", False)) and \
+        _hooks_consistent(serving_cls, "batch_passthrough",
+                          ("serve", "supplement"))
+
+
+def _algo_batchable(cls: type | None) -> bool:
+    """An algorithm class implementing the batch protocol whose
+    sequential train/predict path was not overridden underneath it."""
+    return (
+        cls is not None
+        and hasattr(cls, "batch_train")
+        and hasattr(cls, "batch_signature")
+        # _query_mask is the template ALS predict-time exclusion hook: a
+        # subclass changing it changes sequential predictions, so it is a
+        # sequential hook for consistency purposes (absent names are
+        # skipped for other algorithm classes)
+        and _hooks_consistent(cls, "batch_train",
+                              ("train", "batch_predict", "predict",
+                               "_query_mask"))
+    )
+
+
+@dataclass
+class _Bucket:
+    """One stackable candidate set: same stage prefix + batch signature."""
+
+    indices: list[int] = field(default_factory=list)  # candidate positions
+    algos: list[Any] = field(default_factory=list)  # instantiated algorithms
+    signature: tuple = ()
+
+
+@dataclass
+class _Group:
+    """Candidates sharing (dataSource, preparator) params."""
+
+    dsp: Any = None
+    pp: Any = None
+    buckets: dict = field(default_factory=dict)  # signature key -> _Bucket
+
+
+def _plan(engine, eps: list[EngineParams], metrics: list[Metric]):
+    """(groups, sequential candidate indices). A candidate is batchable
+    when it names exactly one algorithm whose class implements the batch
+    protocol (``batch_train`` + ``batch_signature``), the engine's serving
+    class is a declared pass-through, and every metric scores on device."""
+    groups: dict[str, _Group] = {}
+    sequential: list[int] = []
+    serving_ok = _serving_batchable(engine.serving_class)
+    metrics_ok = all(_metric_batchable(m) for m in metrics)
+    for i, ep in enumerate(eps):
+        algo = None
+        if serving_ok and metrics_ok and len(ep.algorithms_params) == 1:
+            name, ap = ep.algorithms_params[0]
+            cls = engine.algorithm_class_map.get(name)
+            if _algo_batchable(cls):
+                algo = _instantiate(cls, ap)
+        if algo is None:
+            sequential.append(i)
+            continue
+        gkey = _key(ep.data_source_params, ep.preparator_params)
+        group = groups.setdefault(
+            gkey, _Group(ep.data_source_params, ep.preparator_params))
+        name = ep.algorithms_params[0][0]
+        sig = (name, algo.batch_signature())
+        bucket = group.buckets.setdefault(sig, _Bucket(signature=sig))
+        bucket.indices.append(i)
+        bucket.algos.append(algo)
+    # singleton buckets amortize nothing — run them sequentially
+    for group in list(groups.values()):
+        for sig in list(group.buckets):
+            if len(group.buckets[sig].indices) < MIN_BUCKET:
+                sequential.extend(group.buckets.pop(sig).indices)
+    for gkey in [k for k, g in groups.items() if not g.buckets]:
+        groups.pop(gkey)
+    return groups, sorted(sequential)
+
+
+def _chunks(seq: list, n: int):
+    for i in range(0, len(seq), max(n, 1)):
+        yield seq[i: i + max(n, 1)]
+
+
+def _run_buckets(ctx, wf: FastEvalEngineWorkflow, groups, metrics,
+                 out_scores, out_secs, done_cb):
+    """Execute every planned bucket; returns ``(fallback, executed)`` —
+    the candidate indices that must fall back to the sequential path
+    (batch_train or a metric declined at runtime) and the summaries of
+    the buckets that actually ran stacked. Folds iterate OUTSIDE buckets
+    so every bucket of a fold reuses the same staged device inputs (for
+    dense ALS, the same cached A — one upload per fold instead of one
+    per candidate)."""
+    fallback: list[int] = []
+    executed: list[dict] = []
+    for group in groups.values():
+        t0 = time.perf_counter()
+        folds = wf.get_preparator_result(group.dsp, group.pp)
+        SWEEP_STAGE_SECONDS.observe(time.perf_counter() - t0, stage="stage")
+        stats = {
+            sig: [np.zeros((len(b.indices), BATCHED_STAT_COLS)) for _ in metrics]
+            for sig, b in group.buckets.items()
+        }
+        secs = {sig: 0.0 for sig in group.buckets}
+        failed: set = set()
+        for pd, _ei, qa_pairs in folds:
+            for sig, bucket in group.buckets.items():
+                if sig in failed:
+                    continue
+                limit_fn = getattr(bucket.algos[0], "batch_limit", None)
+                limit = limit_fn(ctx, pd) if limit_fn is not None else None
+                if limit is None:
+                    limit = len(bucket.indices)
+                # 0 means "nothing fits" — run the smallest chunk, never
+                # silently the WHOLE bucket
+                limit = max(int(limit), 1)
+                for pos_chunk in _chunks(list(range(len(bucket.indices))),
+                                         limit):
+                    t0 = time.perf_counter()
+                    trained = bucket.algos[0].batch_train(
+                        ctx, pd, [bucket.algos[p].params for p in pos_chunk])
+                    solve_s = time.perf_counter() - t0
+                    if trained is None:
+                        failed.add(sig)
+                        break
+                    SWEEP_STAGE_SECONDS.observe(solve_s, stage="solve")
+                    t0 = time.perf_counter()
+                    fold_stats = [
+                        m.batched_fold_stats(trained, qa_pairs)
+                        for m in metrics
+                    ]
+                    trained.free()  # device factors die with the scores:
+                    # the bucket never pins more than one chunk's stack
+                    score_s = time.perf_counter() - t0
+                    if any(fs is None for fs in fold_stats):
+                        failed.add(sig)
+                        break
+                    SWEEP_STAGE_SECONDS.observe(score_s, stage="score")
+                    BUCKET_CANDIDATES.observe(float(len(pos_chunk)))
+                    for mi, fs in enumerate(fold_stats):
+                        stats[sig][mi][pos_chunk] += np.asarray(
+                            fs, np.float64)
+                    secs[sig] += solve_s + score_s
+                if sig in failed:
+                    # only THIS bucket is done for (the guard at the top
+                    # of the bucket loop skips it on later folds) — the
+                    # group's other buckets must still see this fold
+                    continue
+        for sig, bucket in group.buckets.items():
+            if sig in failed:
+                logger.info(
+                    "sweep: bucket %s declined batching at runtime; "
+                    "falling back to the sequential path for %d candidate(s)",
+                    sig, len(bucket.indices))
+                fallback.extend(bucket.indices)
+                continue
+            per_metric = [
+                m.batched_finalize(stats[sig][mi])
+                for mi, m in enumerate(metrics)
+            ]
+            per_cand_s = secs[sig] / max(len(bucket.indices), 1)
+            CANDIDATES_TOTAL.inc(len(bucket.indices), path="batched")
+            executed.append({
+                "signature": repr(bucket.signature),
+                "candidates": len(bucket.indices),
+                "seconds": round(secs[sig], 3),
+            })
+            for row, i in enumerate(bucket.indices):
+                out_scores[i] = MetricScores(
+                    score=float(per_metric[0][row]),
+                    other_scores=[float(v[row]) for v in per_metric[1:]],
+                )
+                out_secs[i] = per_cand_s
+                done_cb(i, "batched", per_cand_s)
+    return fallback, executed
+
+
+def execute(evaluation, ctx, params: WorkflowParams | None = None,
+            progress=None):
+    """Run an Evaluation's sweep: batched buckets where the protocol
+    allows, sequential per-candidate everywhere else. Returns the
+    MetricEvaluatorResult (same contract as the legacy
+    batch_eval + evaluate flow)."""
+    engine = evaluation.engine
+    eps = list(evaluation.engine_params_list)
+    metrics: list[Metric] = [evaluation.metric, *evaluation.other_metrics]
+    total = len(eps)
+    if sweep_enabled():
+        groups, sequential = _plan(engine, eps, metrics)
+    else:
+        groups, sequential = {}, list(range(total))
+
+    out_scores: list[MetricScores | None] = [None] * total
+    out_secs: list[float] = [0.0] * total
+    done = 0
+
+    def done_cb(i: int, path: str, seconds: float) -> None:
+        nonlocal done
+        done += 1
+        if progress is not None:
+            progress(done, total, {
+                "candidate": i, "path": path, "seconds": round(seconds, 3)})
+
+    n_buckets = sum(len(g.buckets) for g in groups.values())
+    # the shared stage-cache workflow: always for batched groups; for the
+    # sequential path only when the engine opted into prefix memoization
+    # (FastEvalEngine) — a plain Engine keeps its read-per-candidate
+    # semantics (custom batch_eval overrides never reach this executor:
+    # Evaluation.run routes them through the legacy whole-sweep flow)
+    fast = isinstance(engine, FastEvalEngine)
+    wf = (FastEvalEngineWorkflow(engine, ctx, params)
+          if (fast or n_buckets) else None)
+
+    executed_buckets: list[dict] = []
+    if n_buckets:
+        logger.info(
+            "sweep: %d candidate(s) in %d stacked bucket(s) across %d "
+            "group(s), %d sequential", total - len(sequential), n_buckets,
+            len(groups), len(sequential))
+        fallback, executed_buckets = _run_buckets(
+            ctx, wf, groups, metrics, out_scores, out_secs, done_cb)
+        sequential = sorted(sequential + fallback)
+
+    released = 0
+    if sequential:
+        # only a FastEvalEngine opted into prefix memoization for its
+        # sequential candidates; a plain Engine keeps read-per-candidate
+        # semantics even when other candidates batched — PIO_SWEEP_BATCH=0
+        # and the fallback path must produce identical folds
+        use_wf = wf if fast else None
+        if use_wf is not None:
+            # model-cache bound: release a candidate's trained models once
+            # nothing later shares its algorithms prefix
+            last_use = {
+                use_wf.algorithms_key(eps[i]): i for i in sequential
+            }
+        for i in sequential:
+            ep = eps[i]
+            t0 = time.perf_counter()
+            if use_wf is not None:
+                eval_data_set = use_wf.get_result(ep)
+                if last_use[use_wf.algorithms_key(ep)] == i:
+                    released += use_wf.release_algorithms(ep)
+            else:
+                eval_data_set = engine.batch_eval(ctx, [ep], params)[0][1]
+            out_scores[i] = MetricScores(
+                score=metrics[0].calculate(eval_data_set),
+                other_scores=[m.calculate(eval_data_set)
+                              for m in metrics[1:]],
+            )
+            out_secs[i] = time.perf_counter() - t0
+            CANDIDATES_TOTAL.inc(path="sequential")
+            done_cb(i, "sequential", out_secs[i])
+
+    for i, (ep, ms) in enumerate(zip(eps, out_scores)):
+        logger.info("candidate %d: %s = %s", i, metrics[0].header,
+                    None if ms is None else ms.score)
+    scores = [(ep, ms) for ep, ms in zip(eps, out_scores)]
+    result = evaluation.evaluator.result_from_scores(scores)
+    result.candidate_seconds = list(out_secs)
+    result.sweep = {
+        "batched": total - len(sequential),
+        "sequential": len(sequential),
+        # only buckets that actually ran stacked: a bucket that declined
+        # at runtime executed sequentially and must not be reported as
+        # batched to the dashboard
+        "buckets": executed_buckets,
+        "released_models": released,
+        "enabled": sweep_enabled(),
+    }
+    return result

@@ -1380,6 +1380,64 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     return scores, idx
 
 
+# ---------------------------------------------------------------------------
+# Batched sweep metric kernels (candidate axis)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("k",))
+def batched_topk_hit_counts(user_stack, item_stack, uidx, target, kq,
+                            hit_mask, k: int):
+    """Held-out top-k hit counts for EVERY sweep candidate in one dispatch.
+
+    ``user_stack`` [C, n_users, r] / ``item_stack`` [C, n_items, r] are the
+    stacked per-candidate factors; ``uidx`` [Q] the queries' user rows,
+    ``target`` [Q] each query's held-out item (−1 = unseen in training:
+    can never match a catalog index), ``kq`` [Q] the per-query cutoff
+    (min(query.num, metric k)), ``hit_mask`` [Q] whether a hit may count
+    (False for threshold-excluded actuals and unknown users — the latter
+    still enter the metric denominator host-side, scoring 0, exactly like
+    the sequential empty-prediction path). Returns [C] float hit counts —
+    the only readback a sweep's scoring needs, replacing Q×C Python
+    ``calculate_qpa`` calls. Catalogs above the serving chunk threshold
+    stream through the same chunked MIPS scan the predict path uses."""
+    from predictionio_tpu.ops.topk import chunked_topk_scores
+
+    n_items = item_stack.shape[1]
+    in_cut = jnp.arange(k, dtype=jnp.int32)[None, :] < kq[:, None]
+
+    def per_cand(uf, itf):
+        q = uf[uidx]  # [Q, r]
+        if n_items > CHUNKED_TOPK_THRESHOLD:
+            _s, idx = chunked_topk_scores(
+                q, itf, k=k, chunk=CHUNKED_TOPK_CHUNK)
+        else:
+            _s, idx = jax.lax.top_k(q @ itf.T, k)
+        hit = (idx == target[:, None]) & in_cut
+        return (hit.any(axis=1) & hit_mask).sum().astype(jnp.float32)
+
+    return jax.vmap(per_cand)(user_stack, item_stack)
+
+
+@jax.jit
+def batched_rmse(user_stack, item_stack, u_idx, i_idx, ratings):
+    """Held-out RMSE for every sweep candidate in one dispatch:
+    [C] root-mean-square error of ``dot(u, i)`` predictions against the
+    held-out ratings — the candidate-axis twin of :meth:`ALS.rmse`.
+    An empty held-out set scores NaN (the sweep's empty-scores
+    convention: compare_key orders NaN last), never a perfect 0.0."""
+
+    def per_cand(uf, itf):
+        pred = jnp.einsum("nr,nr->n", uf[u_idx], itf[i_idx])
+        return ((pred - ratings) ** 2).sum()
+
+    sq = jax.vmap(per_cand)(user_stack, item_stack)
+    n = ratings.shape[0]
+    if n == 0:  # static shape: decided at trace time
+        return jnp.full(sq.shape, jnp.nan, sq.dtype)
+    return jnp.sqrt(sq / n)
+
+
 @partial(jax.jit)
 def _l2_normalize(x):
     return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-9)

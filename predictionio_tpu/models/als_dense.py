@@ -1054,6 +1054,155 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
 
 
 # ---------------------------------------------------------------------------
+# Stacked multi-candidate training (hyperparameter sweeps)
+# ---------------------------------------------------------------------------
+#
+# A sweep bucket's candidates share EVERYTHING static — the rating matrix,
+# rank, iteration count, implicit flag — and differ only in per-candidate
+# scalars (lambda, alpha, seed). Training them serially re-dispatches the
+# same program N times; instead the whole bucket runs as ONE fused
+# program: a leading candidate axis over the factors and a vmap of the
+# dense iteration, with the int8 A blocks closed over UNBATCHED (the MXU
+# contracts each candidate's payload against the same operand — no A
+# duplication in HBM, and the staged upload through acquire_device_inputs'
+# ChunkStager/dense-A cache is paid once per ratings fingerprint, not once
+# per candidate).
+
+
+@partial(
+    jax.jit,
+    static_argnames=("implicit", "rank", "scale", "ub", "exact"),
+    donate_argnums=(0, 1),
+)
+def _dense_train_stacked(
+    uf_stack,  # [C, n_users, r] per-candidate factors
+    if_stack,  # [C, n_items, r]
+    blocks, dup_u, dup_i,
+    lambdas,  # [C] per-candidate regularization
+    alphas,  # [C] per-candidate implicit confidence weight
+    iters,  # traced loop bound (shared across the bucket)
+    *, implicit: bool, rank: int, scale: int, ub: int, exact: bool = False,
+):
+    """The whole bucket's training as one XLA dispatch: fori_loop over a
+    vmapped dense iteration. ``blocks``/``dup_*`` are closed over without
+    a batch axis — shared operands, per-candidate payloads."""
+
+    def one(uf, itf, lam, al):
+        return _iteration_dense(uf, itf, blocks, dup_u, dup_i, lam, al,
+                                implicit, rank, scale, ub, exact, False)
+
+    def body(_i, carry):
+        u, v = carry
+        return jax.vmap(one, in_axes=(0, 0, 0, 0))(u, v, lambdas, alphas)
+
+    return jax.lax.fori_loop(0, iters, body, (uf_stack, if_stack))
+
+
+#: HBM budget (MiB) for one stacked sweep chunk's per-candidate payload
+#: transients (``PIO_SWEEP_HBM_MB``). The A blocks are shared; what scales
+#: with the candidate axis is each half-step's payload + gram/rhs
+#: temporaries, roughly 4 payload-sized f32 arrays per candidate.
+DEFAULT_SWEEP_HBM_MB = 2048
+
+
+def stacked_candidate_limit(rank: int, n_users: int, n_items: int) -> int:
+    """Candidate-axis chunk cap for one stacked solve. Per candidate the
+    dominant transients are the [n, pairs+rank+1] f32 payload/gram/rhs
+    arrays on both sides (~4 live at a half-step peak); the cap divides
+    the ``PIO_SWEEP_HBM_MB`` budget by that footprint (floor 1)."""
+    import os
+
+    budget = float(os.environ.get("PIO_SWEEP_HBM_MB",
+                                  DEFAULT_SWEEP_HBM_MB)) * 2**20
+    cols = rank * (rank + 1) // 2 + rank + 1
+    per_cand = 4.0 * (n_users + n_items) * cols * 4.0
+    return max(int(budget // max(per_cand, 1.0)), 1)
+
+
+def stacked_eligible(ctx, n_users: int, n_items: int,
+                     ratings: np.ndarray) -> bool:
+    """Whether a sweep bucket can take the stacked dense path: a
+    SINGLE-device context where the ``solver="auto"`` gate itself
+    (:func:`auto_pick` — the single source of truth, so the two routes
+    can never drift) would pick dense, on the XLA dot path (the Pallas
+    kernel is not vmap-validated). A bucket therefore batches exactly
+    when its sequential candidates would have run the same dense
+    solver; on a mesh the sequential path routes to the SPMD train and
+    the stacked program declines rather than funnel the bucket onto one
+    chip."""
+    return (
+        ctx.mesh.devices.size == 1
+        and auto_pick(ctx, n_users, n_items, ratings)
+        and not use_kernel()
+    )
+
+
+def train_dense_stacked(ctx, params_list, ui, ii, ratings,
+                        n_users: int, n_items: int):
+    """Train one sweep bucket's candidates as a single stacked dense solve.
+
+    ``params_list`` (ALSParams) must agree on rank / num_iterations /
+    implicit_prefs / gather_dtype (the bucket signature); lambda_, alpha
+    and seed vary per candidate. Returns ``(user_stack [C, n_users, r],
+    item_stack [C, n_items, r])`` as DEVICE arrays — metric evaluation is
+    expected to happen on device before any readback — or None when the
+    stacked path does not apply (caller falls back to sequential trains).
+
+    The densified A is acquired through :func:`acquire_device_inputs`:
+    one ChunkStager-streamed upload per ratings fingerprint, shared by
+    every candidate of every bucket evaluated on the same fold."""
+    from predictionio_tpu.models.als import _init_factors
+
+    p0 = params_list[0]
+    for p in params_list[1:]:
+        if (p.rank, p.num_iterations, p.implicit_prefs, p.gather_dtype) != (
+                p0.rank, p0.num_iterations, p0.implicit_prefs,
+                p0.gather_dtype):
+            raise ValueError(
+                "train_dense_stacked needs a homogeneous bucket: rank/"
+                "iterations/implicit/gather_dtype must match across "
+                "candidates")
+    ui = np.asarray(ui, np.int32)
+    ii = np.asarray(ii, np.int32)
+    ratings = np.asarray(ratings, np.float32)
+    if ratings.size == 0 or not stacked_eligible(ctx, n_users, n_items,
+                                                 ratings):
+        return None
+
+    phases: dict = {}
+    entry = acquire_device_inputs(ui, ii, ratings, n_users, n_items,
+                                  phases=phases)
+    inits_u, inits_i = [], []
+    for p in params_list:
+        key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+        ku, ki = jax.random.split(key)
+        # per-candidate seeds reproduce the sequential path's init exactly
+        inits_u.append(_init_factors(ku, n_users, p0.rank))
+        inits_i.append(_init_factors(ki, n_items, p0.rank))
+    uf_stack = jnp.stack(inits_u)
+    if_stack = jnp.stack(inits_i)
+    lambdas = jnp.asarray([p.lambda_ for p in params_list], jnp.float32)
+    alphas = jnp.asarray([p.alpha for p in params_list], jnp.float32)
+    logger.info(
+        "ALS(dense,stacked): %d candidate(s), rank %d, %d iteration(s), "
+        "A %s", len(params_list), p0.rank, p0.num_iterations,
+        "cache hit" if phases.get("cache_hit") else "staged")
+    uf_stack, if_stack = _dense_train_stacked(
+        uf_stack, if_stack, entry["blocks"], entry["dup_u"], entry["dup_i"],
+        lambdas, alphas, p0.num_iterations,
+        implicit=p0.implicit_prefs, rank=p0.rank, scale=entry["scale"],
+        ub=entry["ub"], exact=p0.gather_dtype == "float32")
+    # sync before returning so the caller's solve timer measures the
+    # solve, not just its dispatch — otherwise the whole stacked train
+    # would be paid inside the metric stage's first blocking readback and
+    # pio_sweep_stage_seconds{stage=solve|score} would invert. A tiny
+    # readback, not block_until_ready: the latter does not actually block
+    # through the axon tunnel.
+    np.asarray(jax.device_get(uf_stack[:, :1, :1]))
+    return uf_stack, if_stack
+
+
+# ---------------------------------------------------------------------------
 # SPMD dense training (mesh data axis)
 # ---------------------------------------------------------------------------
 #

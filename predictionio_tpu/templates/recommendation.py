@@ -118,28 +118,82 @@ class DataSource(PDataSource):
     def read_eval(self, ctx: ComputeContext):
         """k-fold split for `pio eval` via the shared splitter
         (ref: evaluation variants of the template; e2 CrossValidation)."""
-        from predictionio_tpu.models.cross_validation import split_data
-
         k = self.params.eval_k
         if not k:
             raise NotImplementedError("set eval_k in datasource params to evaluate")
-        td = self._read()
-        rows = list(zip(td.users, td.items, td.ratings.tolist()))
-        return split_data(
-            k,
-            rows,
-            make_training_data=lambda rs: TrainingData(
-                [u for u, _, _ in rs],
-                [i for _, i, _ in rs],
-                np.asarray([r for _, _, r in rs], np.float32),
-            ),
-            make_eval_info=lambda rs: {"n_train": len(rs)},
-            make_query_actual=lambda row: (
-                Query(user=row[0], num=10),
-                ActualRating(item=row[1], rating=float(row[2])),
-            ),
-            seed=self.params.seed,
-        )
+        return _kfold_read_eval(self._read(), k, self.params.seed)
+
+
+def _kfold_read_eval(td: "TrainingData", k: int, seed: int):
+    """k-fold eval folds from one TrainingData — shared by the event-store
+    DataSource above and the in-memory ArrayDataSource below."""
+    from predictionio_tpu.models.cross_validation import split_data
+
+    rows = list(zip(td.users, td.items, td.ratings.tolist()))
+    return split_data(
+        k,
+        rows,
+        make_training_data=lambda rs: TrainingData(
+            [u for u, _, _ in rs],
+            [i for _, i, _ in rs],
+            np.asarray([r for _, _, r in rs], np.float32),
+        ),
+        make_eval_info=lambda rs: {"n_train": len(rs)},
+        make_query_actual=lambda row: (
+            Query(user=row[0], num=10),
+            ActualRating(item=row[1], rating=float(row[2])),
+        ),
+        seed=seed,
+    )
+
+
+#: In-memory datasets for ArrayDataSource, by name. Sweep benches and
+#: tests register (users, items, ratings) triples here so an Evaluation
+#: can run without an event store behind it.
+_DATASETS: dict[str, tuple] = {}
+
+
+def register_dataset(name: str, users, items, ratings) -> None:
+    """Register an in-memory (users, items, ratings) triple for
+    :class:`ArrayDataSource`. ``users``/``items`` are id sequences,
+    ``ratings`` a float sequence of the same length."""
+    _DATASETS[name] = (list(users), list(items),
+                       np.asarray(ratings, np.float32))
+
+
+@dataclass(frozen=True)
+class ArrayDataSourceParams(Params):
+    dataset: str = ""  # register_dataset name
+    eval_k: int = 2
+    seed: int = 7
+
+
+class ArrayDataSource(PDataSource):
+    """DataSource over a registered in-memory dataset — the sweep bench /
+    test path that skips event-store ingestion. Params stay JSON-able
+    (the dataset rides by name), so the FastEval prefix caches key it
+    like any other DataSource."""
+
+    params_class = ArrayDataSourceParams
+
+    def __init__(self, params: ArrayDataSourceParams):
+        self.params = params
+
+    def _read(self) -> TrainingData:
+        if self.params.dataset not in _DATASETS:
+            raise KeyError(
+                f"ArrayDataSource dataset {self.params.dataset!r} is not "
+                "registered; call recommendation.register_dataset first")
+        users, items, ratings = _DATASETS[self.params.dataset]
+        return TrainingData(list(users), list(items),
+                            np.asarray(ratings, np.float32))
+
+    def read_training(self, ctx: ComputeContext) -> TrainingData:
+        return self._read()
+
+    def read_eval(self, ctx: ComputeContext):
+        return _kfold_read_eval(self._read(), self.params.eval_k,
+                                self.params.seed)
 
 
 @dataclass(frozen=True)
@@ -200,6 +254,26 @@ class ALSModel:
     item_categories: dict[str, tuple[str, ...]] = field(default_factory=dict)
 
 
+@dataclass
+class BatchedALSModels:
+    """One sweep bucket's stacked candidate factors, DEVICE-resident:
+    ``user_stack`` [C, n_users, r] / ``item_stack`` [C, n_items, r].
+    Metrics score against the stacks on device (one dispatch for the
+    whole bucket); :meth:`free` drops the device references once the
+    metric vector is read back so a sweep never pins more than one
+    bucket chunk's factors in HBM."""
+
+    user_stack: object
+    item_stack: object
+    user_ids: BiMap
+    item_ids: BiMap
+    n_candidates: int
+
+    def free(self) -> None:
+        self.user_stack = None
+        self.item_stack = None
+
+
 class ALSAlgorithm(PAlgorithm):
     params_class = AlgorithmParams
     query_class = Query
@@ -207,18 +281,22 @@ class ALSAlgorithm(PAlgorithm):
     def __init__(self, params: AlgorithmParams):
         self.params = params
 
-    def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
-        als = ALS(
-            ctx,
-            ALSParams(
-                rank=self.params.rank,
-                num_iterations=self.params.numIterations,
-                lambda_=self.params.lambda_,
-                implicit_prefs=self.params.implicitPrefs,
-                alpha=self.params.alpha,
-                seed=self.params.seed,
-            ),
+    @staticmethod
+    def _als_params(p: AlgorithmParams) -> ALSParams:
+        """The ONE AlgorithmParams → ALSParams mapping — shared by train
+        and batch_train so the batched-vs-sequential parity contract can
+        never drift on a field added to only one path."""
+        return ALSParams(
+            rank=p.rank,
+            num_iterations=p.numIterations,
+            lambda_=p.lambda_,
+            implicit_prefs=p.implicitPrefs,
+            alpha=p.alpha,
+            seed=p.seed,
         )
+
+    def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
+        als = ALS(ctx, self._als_params(self.params))
         factors = als.train(
             pd.user_idx,
             pd.item_idx,
@@ -227,6 +305,43 @@ class ALSAlgorithm(PAlgorithm):
             n_items=len(pd.item_ids),
         )
         return ALSModel(factors, pd.user_ids, pd.item_ids, pd.item_categories)
+
+    # -- device-batched sweep protocol (core/sweep.py) -----------------------
+
+    def batch_signature(self) -> tuple:
+        """What must be STATIC across a stacked sweep bucket: rank sets
+        every array shape in the solve, iteration count the loop bound,
+        implicit the program branch. lambda_/alpha/seed are per-candidate
+        operands and deliberately absent — they ride the candidate axis."""
+        p = self.params
+        return ("als-dense", p.rank, p.numIterations, p.implicitPrefs)
+
+    def batch_limit(self, ctx: ComputeContext, pd: PreparedData) -> int:
+        """Candidate-axis chunk cap from the sweep HBM budget
+        (``PIO_SWEEP_HBM_MB``; see als_dense.stacked_candidate_limit)."""
+        from predictionio_tpu.models import als_dense
+
+        return als_dense.stacked_candidate_limit(
+            self.params.rank, len(pd.user_ids), len(pd.item_ids))
+
+    def batch_train(self, ctx: ComputeContext, pd: PreparedData,
+                    params_list) -> BatchedALSModels | None:
+        """Train a whole sweep bucket as ONE stacked dense solve (shared
+        staged A, vmapped candidate axis — als_dense.train_dense_stacked).
+        Returns None when the stacked dense path does not apply (the sweep
+        executor then falls back to sequential per-candidate trains)."""
+        from predictionio_tpu.models import als_dense
+
+        als_params = [self._als_params(p) for p in params_list]
+        stacks = als_dense.train_dense_stacked(
+            ctx, als_params, pd.user_idx, pd.item_idx, pd.ratings,
+            len(pd.user_ids), len(pd.item_ids))
+        if stacks is None:
+            return None
+        return BatchedALSModels(
+            user_stack=stacks[0], item_stack=stacks[1],
+            user_ids=pd.user_ids, item_ids=pd.item_ids,
+            n_candidates=len(als_params))
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
@@ -331,6 +446,10 @@ class FileBlacklistServing(LServing):
 
 
 class Serving(LServing):
+    #: identity supplement + first-prediction serve: the device-batched
+    #: sweep may bypass serve() entirely (core/sweep.py eligibility)
+    batch_passthrough = True
+
     def __init__(self, params=None):
         pass
 
@@ -371,6 +490,52 @@ class PrecisionAtK(OptionAverageMetric):
             return None  # excluded from the average (OptionAverageMetric)
         top = [s.item for s in p.itemScores[: self.k]]
         return 1.0 if a.item in top else 0.0
+
+    def batched_fold_stats(self, trained, qa_pairs):
+        """Score a whole sweep bucket's fold in ONE batched top-k dispatch
+        (models/als.batched_topk_hit_counts), reading back a single
+        [n_candidates] hit vector instead of running Q×C calculate_qpa
+        calls. Semantics mirror the sequential path exactly: threshold-
+        excluded actuals leave the denominator, unknown users and unseen
+        held-out items score 0.0, the effective cutoff per query is
+        min(query.num, k). Returns None (→ sequential fallback) for
+        models this metric does not understand or queries carrying
+        serve-time filters the kernel does not reproduce."""
+        if not isinstance(trained, BatchedALSModels) \
+                or trained.user_stack is None:
+            return None
+        if any(q.categories is not None or q.blackList
+               for q, _a in qa_pairs):
+            return None
+        from predictionio_tpu.models.als import batched_topk_hit_counts
+
+        c = trained.n_candidates
+        n_items = len(trained.item_ids)
+        valid = np.array([a.rating >= self.rating_threshold
+                          for _q, a in qa_pairs], bool)
+        count = float(valid.sum())
+        stats = np.zeros((c, 3))
+        stats[:, 2] = count
+        if count == 0.0 or n_items == 0:
+            # count == 0 is the empty-scores NaN path; an empty catalog
+            # instead leaves hits at 0 with count intact — every valid
+            # query scores 0.0, the sequential empty-prediction behavior
+            return stats
+        known = np.array([q.user in trained.user_ids
+                          for q, _a in qa_pairs], bool)
+        uidx = np.array([trained.user_ids(q.user) if ok else 0
+                         for ok, (q, _a) in zip(known, qa_pairs)], np.int32)
+        target = np.array(
+            [trained.item_ids(a.item) if a.item in trained.item_ids else -1
+             for _q, a in qa_pairs], np.int32)
+        kq = np.array([min(q.num, self.k) for q, _a in qa_pairs], np.int32)
+        k = int(min(max(int(kq.max()), 1), n_items))
+        hits = np.asarray(batched_topk_hit_counts(
+            trained.user_stack, trained.item_stack, uidx, target, kq,
+            valid & known, k=k), np.float64)
+        stats[:, 0] = hits
+        stats[:, 1] = hits  # scores are 0/1: sumsq == sum
+        return stats
 
 
 def evaluation(

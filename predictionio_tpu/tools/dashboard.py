@@ -89,10 +89,18 @@ def build_router() -> Router:
         return 200, RawResponse(_PAGE.format(
             count=len(instances), rows=rows, metrics=_metrics_footer()))
 
-    def _get(request: Request) -> EvaluationInstance:
+    def _get(request: Request, running: bool = False) -> EvaluationInstance:
         iid = request.path_params["instance_id"]
         inst = Storage.get_meta_data_evaluation_instances().get(iid)
-        if inst is None or inst.status != "EVALCOMPLETED":
+        # EVALRUNNING instances carry the live sweepProgress JSON the
+        # evaluation workflow persists per finished candidate — the
+        # dashboard must be able to show a sweep WHILE it runs, not only
+        # its final results. Only the .json route opts in: the progress
+        # writes never populate evaluator_results_html, so serving the
+        # .html route mid-sweep would be a blank 200.
+        ok = ("EVALCOMPLETED", "EVALRUNNING") if running else (
+            "EVALCOMPLETED",)
+        if inst is None or inst.status not in ok:
             raise HTTPError(404, f"Invalid instance ID: {iid}")
         return inst
 
@@ -101,7 +109,7 @@ def build_router() -> Router:
 
     def results_json(request: Request):
         return 200, RawResponse(
-            _get(request).evaluator_results_json,
+            _get(request, running=True).evaluator_results_json,
             content_type="application/json; charset=UTF-8",
         )
 

@@ -42,9 +42,48 @@ def run_evaluation(
         )
     )
     logger.info("evaluation instance %s: INIT", instance_id)
+
+    progress_log: list[dict] = []
+
+    def progress(done: int, total: int, detail: dict) -> None:
+        """Persist sweep progress into the instance as candidates finish,
+        so the dashboard can show a live sweep instead of only the final
+        one-liner. The persisted log is bounded to the most recent 100
+        candidates — done/total carry overall progress, and an unbounded
+        log would make each metadata write grow with the sweep (O(n²)
+        bytes over a large grid). Best-effort: a metadata hiccup must not
+        abort the evaluation itself."""
+        progress_log.append(detail)
+        del progress_log[:-100]
+        try:
+            inst = instances.get(instance_id)
+            running = EvaluationInstance(**{
+                **inst.__dict__,
+                "status": "EVALRUNNING",
+                "evaluator_results_json": json.dumps({
+                    "sweepProgress": {
+                        "done": done, "total": total,
+                        "candidates": progress_log,
+                    },
+                }),
+            })
+            instances.update(running)
+        except Exception:
+            logger.exception("evaluation progress update failed")
+
     try:
         ctx = workflow_context(batch=wp.batch, mode="Evaluation")
-        result = evaluation.run(ctx, wp)
+        # user Evaluation subclasses may override run() without the
+        # progress hook — only pass it where it is accepted
+        import inspect
+
+        run_kwargs = {}
+        try:
+            if "progress" in inspect.signature(evaluation.run).parameters:
+                run_kwargs["progress"] = progress
+        except (TypeError, ValueError):
+            pass
+        result = evaluation.run(ctx, wp, **run_kwargs)
         if not result.no_save:
             done = EvaluationInstance(
                 **{
@@ -57,6 +96,18 @@ def run_evaluation(
                 }
             )
             instances.update(done)
+        elif progress_log:
+            # no_save: nothing of the result may persist — but the
+            # progress callback already wrote EVALRUNNING + sweepProgress,
+            # which would strand the instance "running" forever. Restore
+            # the pre-run record shape (INIT, no results).
+            inst = instances.get(instance_id)
+            instances.update(EvaluationInstance(**{
+                **inst.__dict__,
+                "status": "INIT",
+                "end_time": now(),
+                "evaluator_results_json": "",
+            }))
         logger.info("evaluation instance %s: EVALCOMPLETED", instance_id)
         return instance_id, result
     except Exception:

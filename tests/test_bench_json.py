@@ -15,19 +15,31 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def test_dry_run_last_stdout_line_is_the_headline_json():
+def _dry_run_doc(script: str, expected_metric: str) -> dict:
     proc = subprocess.run(
-        [sys.executable, str(REPO_ROOT / "bench.py"), "--dry-run"],
+        [sys.executable, str(REPO_ROOT / script), "--dry-run"],
         capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
     lines = proc.stdout.splitlines()
     assert lines, "no stdout at all"
     doc = json.loads(lines[-1])  # the contract the driver relies on
-    assert doc["metric"] == "ml20m_als_rank10_iterations_per_sec"
+    assert doc["metric"] == expected_metric
     assert set(doc) >= {"metric", "value", "unit", "vs_baseline", "extra"}
     assert doc["extra"]["dry_run"] is True
     # nothing after the JSON — and nothing before it either: the stray
     # dry-run print must have been routed to stderr
     assert [l for l in lines if l.strip()] == [lines[-1]]
     assert "dry-run" in proc.stderr
+    return doc
+
+
+def test_dry_run_last_stdout_line_is_the_headline_json():
+    _dry_run_doc("bench.py", "ml20m_als_rank10_iterations_per_sec")
+
+
+def test_sweep_bench_dry_run_last_stdout_line_is_the_headline_json():
+    """bench_sweep.py inherits the same stdout contract: final line =
+    parseable headline JSON, stray prints on stderr."""
+    doc = _dry_run_doc("bench_sweep.py", "ml100k_sweep_candidates_per_sec")
+    assert doc["unit"] == "candidates/s"

@@ -151,6 +151,33 @@ class TestMetricEvaluator:
         result = ev.run(ctx)
         assert result.best_engine_params.algorithms_params[0][1].id == 1
 
+    def test_custom_evaluator_subclass_keeps_legacy_contract(self, ctx,
+                                                             engine):
+        """An overridden MetricEvaluator.evaluate must still be the one
+        that runs — the sweep executor only replaces the stock
+        evaluate."""
+
+        class MarkingEvaluator(MetricEvaluator):
+            def evaluate(self, ctx_, evaluation, data, params):
+                result = super().evaluate(ctx_, evaluation, data, params)
+                result.sweep = {"custom_evaluate": True}
+                return result
+
+        class CustomEvaluation(Evaluation):
+            @property
+            def evaluator(self):
+                return MarkingEvaluator(self.metric, self.other_metrics,
+                                        None)
+
+        ev = CustomEvaluation(
+            engine=engine,
+            engine_params_list=candidates([1, 3]),
+            metric=QCountMetric(),
+        )
+        result = ev.run(ctx)
+        assert result.sweep == {"custom_evaluate": True}
+        assert result.best_engine_params.algorithms_params[0][1].id == 3
+
     def test_params_generator(self, ctx, engine):
         class Gen(EngineParamsGenerator):
             engine_params_list = candidates([4, 2])
@@ -220,3 +247,364 @@ class TestFastEvalEngine:
         ev.output_path = None
         ev.run(ctx)
         assert CountingDataSource.reads == 1
+
+    def test_workflow_releases_trained_models(self, ctx):
+        """Sequential sweeps release each candidate's models once no later
+        candidate shares the algorithms prefix — the cache must not pin
+        every trained model for the whole sweep."""
+        from predictionio_tpu.core.fast_eval import FastEvalEngineWorkflow
+
+        engine = FastEvalEngine(
+            CountingDataSource, Preparator0, {"algo0": CountingAlgo}, Serving0
+        )
+        wf = FastEvalEngineWorkflow(engine, ctx)
+        ep = candidates([1])[0]
+        wf.get_result(ep)
+        assert len(wf.algorithms_cache) == 1
+        assert wf.release_algorithms(ep)
+        assert wf.algorithms_cache == {}
+        assert not wf.release_algorithms(ep)  # idempotent
+
+    def test_sequential_run_releases_without_breaking_memoization(self, ctx):
+        """Evaluation.run's eviction frees models AFTER their last sharing
+        candidate: c1/c2 share algo params (must still train once per
+        fold), c3 differs — 2 folds x 2 distinct = 4 trains, and both
+        distinct entries were released by the end."""
+        CountingAlgo.trains = 0
+        engine = FastEvalEngine(
+            CountingDataSource, Preparator0, {"algo0": CountingAlgo}, Serving0
+        )
+        shared = (("algo0", AlgoParams(id=1, v=10)),)
+        eps = [
+            EngineParams(DSParams(0), PrepParams(0), shared, ServingParams(1)),
+            EngineParams(DSParams(0), PrepParams(0), shared, ServingParams(2)),
+            EngineParams(DSParams(0), PrepParams(0),
+                         (("algo0", AlgoParams(id=2, v=20)),),
+                         ServingParams(1)),
+        ]
+        ev = Evaluation(engine=engine, engine_params_list=eps,
+                        metric=QCountMetric())
+        ev.output_path = None
+        result = ev.run(ctx)
+        assert CountingAlgo.trains == 4
+        assert result.sweep["released_models"] == 2
+        assert len(result.candidate_seconds) == 3
+
+
+# -- device-batched sweep (ISSUE 4) ------------------------------------------
+
+
+import json as _json
+
+import numpy as np
+
+
+def _one_device_ctx():
+    """Single CPU device: the sequential comparator then runs the SAME
+    single-device dense formulation the stacked path vmaps, so parity is
+    a numerics statement, not a solver-routing one."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("data", "model")))
+
+
+@pytest.fixture(scope="module")
+def one_ctx():
+    return _one_device_ctx()
+
+
+def _register_sweep_dataset(name: str, n: int = 600, n_users: int = 40,
+                            n_items: int = 30, seed: int = 0) -> str:
+    from predictionio_tpu.templates.recommendation import register_dataset
+
+    rng = np.random.default_rng(seed)
+    register_dataset(
+        name,
+        [f"u{u}" for u in rng.integers(0, n_users, n)],
+        [f"i{i}" for i in rng.integers(0, n_items, n)],
+        rng.integers(1, 6, n).astype(np.float32),
+    )
+    return name
+
+
+def _sweep_evaluation(dataset: str, metric=None, ranks=(4, 6),
+                      lambdas=(0.01, 0.1), iters=3, eval_k=2):
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        AlgorithmParams,
+        ArrayDataSource,
+        ArrayDataSourceParams,
+        PrecisionAtK,
+        Preparator,
+        Serving,
+    )
+
+    eps = [
+        EngineParams(
+            data_source_params=ArrayDataSourceParams(
+                dataset=dataset, eval_k=eval_k),
+            algorithms_params=(
+                ("als", AlgorithmParams(rank=r, numIterations=iters,
+                                        lambda_=l, seed=3)),
+            ),
+        )
+        for r in ranks
+        for l in lambdas
+    ]
+    engine = FastEvalEngine(
+        ArrayDataSource, Preparator, {"als": ALSAlgorithm}, Serving)
+    ev = Evaluation(
+        engine=engine, engine_params_list=eps,
+        metric=metric or PrecisionAtK(k=10, rating_threshold=4.0))
+    ev.output_path = None
+    return ev
+
+
+def _scores(result):
+    return [ms.score for _ep, ms in result.engine_params_scores]
+
+
+class TestBatchedSweep:
+    def test_batched_matches_sequential(self, one_ctx, monkeypatch):
+        """The acceptance parity pin: stacked bucket scores must match the
+        sequential FastEvalEngine scores per candidate."""
+        ds = _register_sweep_dataset("sweep-parity")
+        ev = _sweep_evaluation(ds)
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "0")
+        seq = ev.run(one_ctx)
+        assert seq.sweep["batched"] == 0
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        bat = ev.run(one_ctx)
+        # the batched path actually ran — rank-bucketed, all candidates
+        assert bat.sweep["batched"] == 4
+        assert len(bat.sweep["buckets"]) == 2  # one bucket per rank
+        for b, s in zip(_scores(bat), _scores(seq)):
+            assert b == pytest.approx(s, abs=1e-6)
+        assert bat.best_idx == seq.best_idx
+        assert len(bat.candidate_seconds) == 4
+        assert all(s > 0 for s in bat.candidate_seconds)
+        # the result JSON carries the sweep-progress surface
+        doc = bat.to_json()
+        assert len(doc["candidateSeconds"]) == 4
+        assert doc["sweep"]["batched"] == 4
+        _json.dumps(doc)  # dashboard-serializable
+
+    def test_flag_restores_sequential_end_to_end(self, one_ctx, monkeypatch):
+        ds = _register_sweep_dataset("sweep-flag")
+        ev = _sweep_evaluation(ds, ranks=(4,), lambdas=(0.01, 0.1))
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "0")
+        result = ev.run(one_ctx)
+        assert result.sweep == {
+            "batched": 0, "sequential": 2, "buckets": [],
+            "released_models": 2, "enabled": False,
+        }
+
+    def test_empty_scores_nan_parity(self, one_ctx, monkeypatch):
+        """A threshold excluding every actual must yield NaN on BOTH paths
+        (the AverageMetric empty-scores contract), and best-candidate
+        selection must still resolve (compare_key orders NaN last)."""
+        from predictionio_tpu.templates.recommendation import PrecisionAtK
+
+        ds = _register_sweep_dataset("sweep-nan")
+        metric = PrecisionAtK(k=10, rating_threshold=99.0)
+        ev = _sweep_evaluation(ds, metric=metric, ranks=(4,),
+                               lambdas=(0.01, 0.1))
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        bat = ev.run(one_ctx)
+        assert bat.sweep["batched"] == 2
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "0")
+        seq = ev.run(one_ctx)
+        assert all(math.isnan(s) for s in _scores(bat))
+        assert all(math.isnan(s) for s in _scores(seq))
+        assert bat.best_idx == seq.best_idx == 0
+
+    def test_multi_device_mesh_falls_back(self, ctx, monkeypatch):
+        """On a mesh the sequential candidates run the SPMD dense train;
+        the stacked single-device path must decline rather than silently
+        reroute a bucket onto one chip."""
+        assert ctx.mesh.devices.size > 1
+        ds = _register_sweep_dataset("sweep-mesh")
+        ev = _sweep_evaluation(ds, ranks=(4,), lambdas=(0.01, 0.1))
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        result = ev.run(ctx)
+        assert result.sweep["batched"] == 0
+        assert result.sweep["buckets"] == []  # only EXECUTED buckets listed
+        assert len(_scores(result)) == 2
+
+    def test_subclass_overrides_disable_batching(self, one_ctx, monkeypatch):
+        """Subclasses that change sequential semantics (a filtering
+        serve(), a redefined calculate_qpa) without re-implementing the
+        device hooks must fall back — batched and PIO_SWEEP_BATCH=0 may
+        never disagree."""
+        from predictionio_tpu.templates.recommendation import (
+            ALSAlgorithm,
+            ArrayDataSource,
+            PredictedResult,
+            Preparator,
+            PrecisionAtK,
+            Serving,
+        )
+
+        class FilteringServing(Serving):  # inherits batch_passthrough
+            def serve(self, query, predictions):
+                return PredictedResult(predictions[0].itemScores[:1])
+
+        ds = _register_sweep_dataset("sweep-override")
+        ev = _sweep_evaluation(ds, ranks=(4,), lambdas=(0.01, 0.1))
+        ev.engine = FastEvalEngine(
+            ArrayDataSource, Preparator, {"als": ALSAlgorithm},
+            FilteringServing)
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        assert ev.run(one_ctx).sweep["batched"] == 0
+
+        class StricterPrecision(PrecisionAtK):
+            def calculate_qpa(self, q, p, a):  # changed semantics only
+                base = super().calculate_qpa(q, p, a)
+                return None if base == 0.0 else base
+
+        ev2 = _sweep_evaluation(ds, metric=StricterPrecision(k=10),
+                                ranks=(4,), lambdas=(0.01, 0.1))
+        assert ev2.run(one_ctx).sweep["batched"] == 0
+
+        # private sequential helpers count too: a predict-time exclusion
+        # hook or a score filter changes sequential results without
+        # touching the public hook names
+        class MaskingALS(ALSAlgorithm):
+            @staticmethod
+            def _query_mask(model, q):
+                return np.zeros((1, len(model.item_ids)), bool)
+
+        ev3 = _sweep_evaluation(ds, ranks=(4,), lambdas=(0.01, 0.1))
+        ev3.engine = FastEvalEngine(
+            ArrayDataSource, Preparator, {"als": MaskingALS}, Serving)
+        assert ev3.run(one_ctx).sweep["batched"] == 0
+
+        class FilteredScores(PrecisionAtK):
+            def _scores(self, eval_data_set):
+                return [s for s in super()._scores(eval_data_set) if s > 0]
+
+        ev4 = _sweep_evaluation(ds, metric=FilteredScores(k=10),
+                                ranks=(4,), lambdas=(0.01, 0.1))
+        assert ev4.run(one_ctx).sweep["batched"] == 0
+
+    def test_custom_metric_falls_back_to_sequential(self, one_ctx,
+                                                    monkeypatch):
+        """A metric without the device hooks keeps the per-query Python
+        loop — same scores, zero batched candidates."""
+
+        class TopLength(AverageMetric):
+            def calculate_qpa(self, q, p, a):
+                return float(len(p.itemScores))
+
+        ds = _register_sweep_dataset("sweep-custom")
+        ev = _sweep_evaluation(ds, metric=TopLength(), ranks=(4,),
+                               lambdas=(0.01, 0.1))
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        result = ev.run(one_ctx)
+        assert result.sweep["batched"] == 0
+        assert result.sweep["sequential"] == 2
+
+    def test_candidate_axis_memory_cap_chunks(self, one_ctx, monkeypatch):
+        """PIO_SWEEP_HBM_MB=0 forces 1-candidate chunks; results must not
+        change — the cap only bounds HBM, never semantics."""
+        ds = _register_sweep_dataset("sweep-chunked")
+        ev = _sweep_evaluation(ds, ranks=(4,), lambdas=(0.01, 0.1, 0.3))
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        wide = ev.run(one_ctx)
+        monkeypatch.setenv("PIO_SWEEP_HBM_MB", "0")
+        narrow = ev.run(one_ctx)
+        assert narrow.sweep["batched"] == wide.sweep["batched"] == 3
+        for a, b in zip(_scores(wide), _scores(narrow)):
+            assert a == pytest.approx(b, abs=1e-6)
+
+    def test_batched_rmse_matches_numpy(self):
+        """The candidate-axis RMSE kernel against a float64 host
+        reference, per candidate."""
+        import jax.numpy as jnp
+
+        from predictionio_tpu.models.als import batched_rmse
+
+        rng = np.random.default_rng(5)
+        c, nu, ni, r, n = 3, 20, 15, 4, 100
+        ufs = rng.normal(size=(c, nu, r)).astype(np.float32)
+        ifs = rng.normal(size=(c, ni, r)).astype(np.float32)
+        u = rng.integers(0, nu, n).astype(np.int32)
+        i = rng.integers(0, ni, n).astype(np.int32)
+        rat = rng.integers(1, 6, n).astype(np.float32)
+        got = np.asarray(batched_rmse(
+            jnp.asarray(ufs), jnp.asarray(ifs), u, i, rat))
+        for cc in range(c):
+            pred = np.einsum("nr,nr->n", ufs[cc][u].astype(np.float64),
+                             ifs[cc][i].astype(np.float64))
+            want = np.sqrt(np.mean((pred - rat) ** 2))
+            assert got[cc] == pytest.approx(want, rel=1e-5)
+        # an empty held-out set scores NaN (never a winning 0.0) — the
+        # same empty-scores convention as the Average/Stdev finalizers
+        empty = np.asarray(batched_rmse(
+            jnp.asarray(ufs), jnp.asarray(ifs),
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.float32)))
+        assert empty.shape == (c,) and np.isnan(empty).all()
+
+    def test_batched_finalize_matches_sequential_reductions(self):
+        """(sum, sumsq, count) finalizers reproduce the per-query
+        reductions — including the zero-count NaN path of Average and
+        Stdev."""
+        scores = [0.5, 2.0, 3.5, 3.5]
+        stats = np.array([
+            [sum(scores), sum(s * s for s in scores), len(scores)],
+            [0.0, 0.0, 0.0],  # the empty-scores candidate
+        ])
+        data = fake_eval_data(scores)
+        avg = PMetric().batched_finalize(stats)
+        assert avg[0] == pytest.approx(PMetric().calculate(data))
+        assert math.isnan(avg[1])
+        sd = PStdev().batched_finalize(stats)
+        assert sd[0] == pytest.approx(PStdev().calculate(data))
+        assert math.isnan(sd[1])
+        sm = PSum().batched_finalize(stats)
+        assert sm[0] == pytest.approx(PSum().calculate(data))
+        assert sm[1] == 0.0
+
+    def test_run_evaluation_records_timings_and_best(self, memory_storage):
+        """The EvaluationInstance JSON must carry per-candidate timings,
+        the sweep summary, and the chosen best params — the dashboard's
+        sweep view, not just the final one-liner."""
+        from predictionio_tpu.workflow.evaluation_workflow import (
+            run_evaluation,
+        )
+
+        engine = Engine(DataSource0, Preparator0, {"algo0": Algo0}, Serving0)
+        ev = Evaluation(
+            engine=engine,
+            engine_params_list=candidates([1, 3]),
+            metric=QCountMetric(),
+        )
+        ev.output_path = None
+        iid, _result = run_evaluation(ev, evaluation_class="t")
+        inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+        assert inst.status == "EVALCOMPLETED"
+        doc = _json.loads(inst.evaluator_results_json)
+        assert len(doc["candidateSeconds"]) == 2
+        assert doc["bestEngineParams"]["algorithms"][0]["params"]["id"] == 3
+        assert doc["sweep"]["sequential"] == 2
+
+    @pytest.mark.slow
+    def test_large_sweep_parity_stress(self, one_ctx, monkeypatch):
+        """8 candidates, two rank buckets, bigger catalog — the
+        acceptance-shaped sweep, parity pinned."""
+        ds = _register_sweep_dataset("sweep-stress", n=20_000, n_users=300,
+                                     n_items=200, seed=2)
+        ev = _sweep_evaluation(ds, ranks=(8, 16),
+                               lambdas=(0.01, 0.03, 0.1, 0.3), iters=5)
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "1")
+        bat = ev.run(one_ctx)
+        monkeypatch.setenv("PIO_SWEEP_BATCH", "0")
+        seq = ev.run(one_ctx)
+        assert bat.sweep["batched"] == 8
+        for b, s in zip(_scores(bat), _scores(seq)):
+            assert b == pytest.approx(s, abs=1e-6)

@@ -222,6 +222,7 @@ def test_invalid_names_rejected():
 def test_all_registered_metric_names_follow_convention():
     """Import every wired module so its module-level metrics register,
     then assert the whole process registry obeys pio_ + snake_case."""
+    import predictionio_tpu.core.sweep  # noqa: F401
     import predictionio_tpu.data.api.event_server  # noqa: F401
     import predictionio_tpu.data.storage.sql  # noqa: F401
     import predictionio_tpu.io.transfer  # noqa: F401
@@ -257,8 +258,28 @@ def test_all_registered_metric_names_follow_convention():
                      "pio_transfer_stage_seconds",
                      "pio_transfer_queue_wait_seconds",
                      "pio_transfer_chunk_bytes",
-                     "pio_transfer_inflight_slots"):
+                     "pio_transfer_inflight_slots",
+                     # device-batched sweep scrape surface (ISSUE 4)
+                     "pio_sweep_stage_seconds",
+                     "pio_sweep_candidates_per_bucket",
+                     "pio_sweep_candidates_total"):
         assert required in names
+
+
+def test_sweep_stage_histogram_registers_once():
+    """Every sweep stage (stage/solve/score) must record into ONE
+    label-split ``pio_sweep_stage_seconds`` histogram — the same
+    one-histogram-per-family convention as ``pio_transfer_*`` — so
+    dashboards can compare stages without cross-metric joins."""
+    from predictionio_tpu.core import sweep
+
+    h = REGISTRY.get("pio_sweep_stage_seconds")
+    assert h is sweep.SWEEP_STAGE_SECONDS
+    assert h.label_names == ("stage",)
+    assert REGISTRY.get("pio_sweep_candidates_per_bucket") \
+        is sweep.BUCKET_CANDIDATES
+    assert REGISTRY.get("pio_sweep_candidates_total") \
+        is sweep.CANDIDATES_TOTAL
 
 
 def test_transfer_stage_histogram_registers_once():

@@ -182,6 +182,28 @@ class TestDashboard:
             _get(server, "/engine_instances/nope/evaluator_results.html")
         assert ei.value.code == 404
 
+    def test_running_sweep_progress_is_readable(self, memory_storage,
+                                                server):
+        """The evaluation workflow persists live sweepProgress under
+        status EVALRUNNING — the dashboard must serve it mid-sweep, while
+        still 404ing instances that never started evaluating."""
+        dao = memory_storage.get_meta_data_evaluation_instances()
+        iid = dao.insert(EvaluationInstance(
+            status="EVALRUNNING",
+            evaluation_class="my.Eval",
+            evaluator_results_json=(
+                '{"sweepProgress": {"done": 2, "total": 8}}'),
+        ))
+        status, body, _ = _get(
+            server, f"/engine_instances/{iid}/evaluator_results.json")
+        assert status == 200
+        assert json.loads(body)["sweepProgress"]["done"] == 2
+        init_iid = dao.insert(EvaluationInstance(status="INIT"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server,
+                 f"/engine_instances/{init_iid}/evaluator_results.json")
+        assert ei.value.code == 404
+
     def test_metrics_endpoint_and_footer(self, memory_storage, server):
         status, body, _ = _get(server, "/")
         assert '<a href="/metrics">' in body
