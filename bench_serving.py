@@ -244,6 +244,7 @@ def bench_query_latency(
                         del os.environ["PIO_SERVING_DEVICE"]
                     else:
                         os.environ["PIO_SERVING_DEVICE"] = prev
+            out.update(_trace_overhead(srv.port))
             return out
         finally:
             srv.stop()
@@ -251,6 +252,156 @@ def bench_query_latency(
         from predictionio_tpu.data.storage import Storage
 
         Storage.reset()
+
+
+def _trace_overhead(port: int, requests: int = 200) -> dict:
+    """The span layer's disabled-path cost — the ISSUE 5 acceptance
+    guard (``trace_overhead_frac`` ≤ 0.01: turning tracing off must
+    cost nothing).
+
+    The delta being guarded is microseconds per request; an end-to-end
+    p50 A/B cannot resolve it: on a shared host the loopback p50 drifts
+    by ~50% (milliseconds) across back-to-back rounds, so off-vs-stub
+    comparisons came out anywhere from −44% to +37% run to run — pure
+    weather. So the guard measures the off path DIRECTLY, in two parts
+    that are each drift-immune:
+
+      1. a call census: the trace entry points (and the histogram
+         exemplar hook) are wrapped with counting delegates and real
+         queries driven through the live server with ``PIO_TRACE=off``
+         — how many disabled-path trace calls one request actually
+         makes, self-updating as span sites come and go;
+      2. unit costs: each entry point's off-mode cost timed in a tight
+         loop (the real functions — env read, memoized mode parse,
+         shared-NOOP return).
+
+    ``trace_off_cost_us`` = Σ census × unit is what ``PIO_TRACE=off``
+    adds to one request vs a server with no span layer at all, and
+    ``trace_overhead_frac`` prices it against the measured off-mode
+    p50 (min-of-rounds — the smallest, least flattering denominator).
+    The live A/B p50s still ride along (``serve_trace_off_p50_ms``,
+    ``serve_trace_all_p50_ms``, per-round values) as the informational
+    cost of PIO_TRACE=all and as drift evidence; the env var is read
+    per request, so the A/B flips a live server."""
+    import collections
+
+    from predictionio_tpu.obs import metrics as _metrics
+    from predictionio_tpu.obs import trace as _trace
+
+    def measure(n: int) -> float:
+        c = _Client(port)
+        for k in range(30):  # settle caches/branches for this mode
+            c.query(f"u{k % 900}", 10)
+        lat = [c.query(f"u{k % 900}", 10) for k in range(n)]
+        c.close()
+        return float(np.percentile(np.asarray(lat) * 1e3, 50))
+
+    prev = os.environ.get("PIO_TRACE")
+    rounds: dict[str, list[float]] = {"off": [], "all": []}
+    names = ("span", "server_span", "child_span", "capture",
+             "record_span", "record", "add_event", "inject_headers",
+             "current_trace_id")
+    counts: collections.Counter = collections.Counter()
+    count_lock = threading.Lock()
+
+    def counted(name, fn):
+        def wrapper(*a, **kw):
+            with count_lock:  # census only — never on a timed path
+                counts[name] += 1
+            return fn(*a, **kw)
+        return wrapper
+
+    census_n = 50
+    try:
+        # interleaved rounds + min-of-rounds p50: back-to-back sections
+        # drift by more than the machinery being priced; the minimum is
+        # the standard drift-robust timing floor
+        for _ in range(2):
+            os.environ["PIO_TRACE"] = "off"
+            rounds["off"].append(measure(requests))
+            os.environ["PIO_TRACE"] = "all"
+            rounds["all"].append(measure(requests))
+
+        # -- census: real requests, counting delegates, tracing off
+        os.environ["PIO_TRACE"] = "off"
+        saved = {k: getattr(_trace, k) for k in names}
+        try:
+            for k, fn in saved.items():
+                setattr(_trace, k, counted(k, fn))
+            _metrics.set_exemplar_hook(
+                counted("exemplar_hook", _trace._exemplar))
+            c = _Client(port)
+            for k in range(census_n):
+                c.query(f"u{k % 900}", 10)
+            c.close()
+        finally:
+            for k, fn in saved.items():
+                setattr(_trace, k, fn)
+            _metrics.set_exemplar_hook(_trace._exemplar)
+
+        # -- unit costs, µs/call, off path (PIO_TRACE still off)
+        def u_span():
+            with _trace.span("bench"):
+                pass
+
+        def u_server_span():
+            with _trace.server_span("http", "benchid", None, None):
+                pass
+
+        def u_child_span():
+            with _trace.child_span(None, "bench"):
+                pass
+
+        hdrs: dict = {}
+        unit_fns = {
+            "span": u_span,
+            "server_span": u_server_span,
+            "child_span": u_child_span,
+            "capture": _trace.capture,
+            "record_span": lambda: _trace.record_span(None, "b", 0.0, 0.0),
+            # `record` nests capture+record_span: the census counts the
+            # nested calls too, so summing all three overstates — the
+            # conservative direction for a ≤-bound guard
+            "record": lambda: _trace.record("b", 0.0, 0.0),
+            "add_event": lambda: _trace.add_event("b"),
+            "inject_headers": lambda: _trace.inject_headers(hdrs),
+            "current_trace_id": _trace.current_trace_id,
+            "exemplar_hook": _trace._exemplar,
+        }
+
+        def unit_us(fn, iters: int = 20_000) -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fn()
+                best = min(best, time.perf_counter() - t0)
+            return best / iters * 1e6
+
+        per_request = {
+            k: counts[k] / census_n for k in unit_fns if counts[k]}
+        cost_us = sum(
+            n * unit_us(unit_fns[k]) for k, n in per_request.items())
+    finally:
+        if prev is None:
+            os.environ.pop("PIO_TRACE", None)
+        else:
+            os.environ["PIO_TRACE"] = prev
+    p50 = {k: min(v) for k, v in rounds.items()}
+    return {
+        "serve_trace_off_p50_ms": round(p50["off"], 3),
+        "serve_trace_all_p50_ms": round(p50["all"], 3),
+        "trace_off_calls_per_request": {
+            k: round(v, 2) for k, v in sorted(per_request.items())},
+        "trace_off_cost_us": round(cost_us, 2),
+        "trace_overhead_frac": round(cost_us / (p50["off"] * 1e3), 4),
+        "trace_all_overhead_frac": round(p50["all"] / p50["off"] - 1.0, 4),
+        # per-round p50s: lets a reader judge the A/B's drift vs signal
+        # without rerunning (and documents why the guard is the direct
+        # measurement, not this A/B)
+        "trace_p50_rounds_ms": {
+            k: [round(x, 3) for x in v] for k, v in rounds.items()},
+    }
 
 
 def _run_query_workload(port: int, threads: int, per_thread: int,
@@ -781,19 +932,68 @@ def bench_event_scan(n_events: int = 200_000) -> dict:
         tmp.cleanup()
 
 
+HEADLINE_METRIC = "ml100k_rest_predict_p50_ms"
+#: --gateway measures a different topology (gateway-fronted vs direct
+#: replica) — a distinct metric name keeps capture tooling from charting
+#: the two as one series and misreading gateway overhead as a regression
+GATEWAY_HEADLINE_METRIC = "ml100k_gateway_predict_p50_ms"
+
+
+def _headline(results: dict, metric: str = HEADLINE_METRIC) -> dict:
+    """The driver's stdout contract (same shape as bench.py): metric /
+    value / unit / vs_baseline / extra, with the full section results
+    riding in ``extra`` (including ``trace_overhead_frac``)."""
+    value = results.get("serve_p50_ms", results.get("gateway_p50_ms", 0.0))
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": "ms",
+        # BASELINE.json's "p50 REST predict latency" has no reference
+        # measurement to divide by ("to be measured"): 0.0 = unscored
+        "vs_baseline": 0.0,
+        "extra": results,
+    }
+
+
+def _dry_run_doc(gateway: bool = False) -> dict:
+    """``--dry-run``: a structurally complete headline doc with no
+    servers, storage, or device work — tier-1 guards the stdout
+    contract with it (tests/test_bench_json.py). Carries the same
+    metric name the real run would, so tooling validating the
+    ``--gateway`` pipeline sees the gateway series, not the replica
+    one."""
+    # deliberately on stdout: proves the redirect routes stray prints
+    # to stderr instead of corrupting the final JSON line
+    print("[bench_serving] dry-run: skipping all serving sections")
+    return _headline(
+        {"dry_run": True, "trace_overhead_frac": 0.0},
+        metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
+
+
+def _collect(gateway: bool, replicas: int) -> dict:
+    if gateway:
+        return _headline(bench_gateway_scaling(replicas=replicas),
+                         metric=GATEWAY_HEADLINE_METRIC)
+    results = bench_query_latency()
+    results.update(bench_event_ingest())
+    results.update(bench_event_scan())
+    return _headline(results)
+
+
 if __name__ == "__main__":
     import argparse
+
+    from bench import emit_headline
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--gateway", action="store_true",
                     help="bench the serving gateway: same workload against "
                          "one bare replica vs --replicas behind the gateway")
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="emit the headline doc without running anything "
+                         "(stdout-contract guard)")
     cli = ap.parse_args()
-    if cli.gateway:
-        results = bench_gateway_scaling(replicas=cli.replicas)
-    else:
-        results = bench_query_latency()
-        results.update(bench_event_ingest())
-        results.update(bench_event_scan())
-    print(json.dumps(results))
+    emit_headline(
+        lambda: _dry_run_doc(cli.gateway) if cli.dry_run
+        else _collect(cli.gateway, cli.replicas))

@@ -47,7 +47,7 @@ from predictionio_tpu.core.engine import EngineParams, WorkflowParams, _instanti
 from predictionio_tpu.core.evaluation import MetricScores
 from predictionio_tpu.core.fast_eval import FastEvalEngine, FastEvalEngineWorkflow, _key
 from predictionio_tpu.core.metrics import BATCHED_STAT_COLS, Metric
-from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs import REGISTRY, trace
 from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 
 logger = logging.getLogger(__name__)
@@ -230,7 +230,9 @@ def _run_buckets(ctx, wf: FastEvalEngineWorkflow, groups, metrics,
     for group in groups.values():
         t0 = time.perf_counter()
         folds = wf.get_preparator_result(group.dsp, group.pp)
-        SWEEP_STAGE_SECONDS.observe(time.perf_counter() - t0, stage="stage")
+        stage_s = time.perf_counter() - t0
+        SWEEP_STAGE_SECONDS.observe(stage_s, stage="stage")
+        trace.record("sweep_stage", t0, stage_s, folds=len(folds))
         stats = {
             sig: [np.zeros((len(b.indices), BATCHED_STAT_COLS)) for _ in metrics]
             for sig, b in group.buckets.items()
@@ -258,6 +260,8 @@ def _run_buckets(ctx, wf: FastEvalEngineWorkflow, groups, metrics,
                         failed.add(sig)
                         break
                     SWEEP_STAGE_SECONDS.observe(solve_s, stage="solve")
+                    trace.record("sweep_solve", t0, solve_s,
+                                 candidates=len(pos_chunk))
                     t0 = time.perf_counter()
                     fold_stats = [
                         m.batched_fold_stats(trained, qa_pairs)
@@ -270,6 +274,8 @@ def _run_buckets(ctx, wf: FastEvalEngineWorkflow, groups, metrics,
                         failed.add(sig)
                         break
                     SWEEP_STAGE_SECONDS.observe(score_s, stage="score")
+                    trace.record("sweep_score", t0, score_s,
+                                 candidates=len(pos_chunk))
                     BUCKET_CANDIDATES.observe(float(len(pos_chunk)))
                     for mi, fs in enumerate(fold_stats):
                         stats[sig][mi][pos_chunk] += np.asarray(
@@ -314,7 +320,16 @@ def execute(evaluation, ctx, params: WorkflowParams | None = None,
     """Run an Evaluation's sweep: batched buckets where the protocol
     allows, sequential per-candidate everywhere else. Returns the
     MetricEvaluatorResult (same contract as the legacy
-    batch_eval + evaluate flow)."""
+    batch_eval + evaluate flow). The whole sweep runs under one trace
+    span (``sweep``) with stage/solve/score child spans mirroring the
+    ``pio_sweep_stage_seconds`` phases, so a slow sweep explains itself
+    on the same waterfall surface as a slow query."""
+    with trace.span("sweep", candidates=len(evaluation.engine_params_list)):
+        return _execute(evaluation, ctx, params, progress)
+
+
+def _execute(evaluation, ctx, params: WorkflowParams | None = None,
+             progress=None):
     engine = evaluation.engine
     eps = list(evaluation.engine_params_list)
     metrics: list[Metric] = [evaluation.metric, *evaluation.other_metrics]

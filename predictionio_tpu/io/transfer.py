@@ -47,7 +47,7 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
-from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs import REGISTRY, trace
 
 logger = logging.getLogger(__name__)
 
@@ -262,6 +262,10 @@ class ChunkStager:
         sem = threading.Semaphore(self.slots)
         stop = threading.Event()
         q: queue.Queue = queue.Queue()
+        # trace handle of the CONSUMER (the traced request/train, if
+        # any): worker threads retro-record their pack/upload spans
+        # against it, so a transfer stall shows up on the waterfall
+        tr_handle = trace.capture()
 
         def stage(item):
             if stop.is_set():
@@ -277,11 +281,16 @@ class ChunkStager:
                 if nb > 0:  # opaque payloads (event batches) have no
                     # byte size — all-zero samples would be histogram noise
                     CHUNK_BYTES.observe(float(nb), pipeline=self.name)
+                trace.record_span(tr_handle, "transfer_pack", t0, t1 - t0,
+                                  pipeline=self.name, bytes=nb)
                 if upload is not None and not stop.is_set():
                     staged = upload(staged)
-                    STAGE_SECONDS.observe(time.perf_counter() - t1,
+                    t2 = time.perf_counter()
+                    STAGE_SECONDS.observe(t2 - t1,
                                           pipeline=self.name,
                                           stage="upload")
+                    trace.record_span(tr_handle, "transfer_upload", t1,
+                                      t2 - t1, pipeline=self.name)
                 dt = time.perf_counter() - t0
                 with self._lock:
                     self.staged_s += dt
@@ -350,6 +359,10 @@ class ChunkStager:
             with self._lock:
                 self.wait_s += dt
             QUEUE_WAIT_SECONDS.observe(dt, pipeline=self.name)
+            if dt > 1e-3:  # only waits that could matter on a
+                # waterfall; sub-ms polls would be span spam
+                trace.record_span(tr_handle, "transfer_wait", t0, dt,
+                                  pipeline=self.name)
 
         try:
             while True:
@@ -471,6 +484,10 @@ def async_readback(arrays: Sequence, chunk_bytes: int | None = None,
             out.append(np.asarray(parts[0]))
         else:
             out.append(np.concatenate([np.asarray(p) for p in parts]))
-    STAGE_SECONDS.observe(time.perf_counter() - t0, pipeline=name,
-                          stage="readback")
+    wait_s = time.perf_counter() - t0
+    STAGE_SECONDS.observe(wait_s, pipeline=name, stage="readback")
+    # the blocking tail of the d2h fetch, on the caller's trace (the
+    # un-overlapped remainder the async copies could not hide)
+    trace.record("transfer_readback", t0, wait_s, pipeline=name,
+                 arrays=len(staged))
     return out

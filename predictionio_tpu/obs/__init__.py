@@ -16,7 +16,13 @@ for every perf PR is quantified hot paths. This package provides:
     incoming ``X-Request-ID``, else generate one; the id flows through
     log records and the feedback loop (query server → event server).
   * JAX compile hooks (:mod:`predictionio_tpu.obs.jax_hooks`): compile
-    count and cumulative compile seconds as registry metrics.
+    count and cumulative compile seconds as registry metrics, plus an
+    ``xla_compile`` event on the active trace span.
+  * Request tracing (:mod:`predictionio_tpu.obs.trace`): sampled span
+    timelines riding the request id across gateway → replica →
+    batcher → device, kept in a bounded ring + slowest-N reservoir and
+    served as ``GET /debug/traces`` / ``pio trace``; histograms carry
+    OpenMetrics trace-id exemplars while a sampled span is active.
 
 Naming convention (enforced at registration): ``pio_`` prefix +
 snake_case, so metric names stay scrape-stable across PRs
@@ -38,3 +44,7 @@ from predictionio_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     validate_metric_name,
 )
+# Imported last: trace rides metrics (exemplar hook) and context
+# (trace id = request id). Importing the package activates the span
+# layer everywhere the registry is already active.
+from predictionio_tpu.obs import trace  # noqa: E402,F401

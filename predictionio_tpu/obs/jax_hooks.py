@@ -51,10 +51,21 @@ def install_jax_compile_hook(registry: MetricsRegistry = REGISTRY) -> bool:
             "pio_jax_compile_seconds_total",
             "Cumulative XLA backend compile seconds")
 
+        # only the default-registry listener stamps trace events: a
+        # second (private-registry) listener firing for the same compile
+        # would duplicate every xla_compile annotation on the span
+        emit_trace_event = registry is REGISTRY
+
         def on_duration(event: str, duration: float, **kw) -> None:
             if event == _COMPILE_EVENT:
                 compiles.inc()
                 seconds.inc(max(duration, 0.0))
+                if emit_trace_event:
+                    # a compile inside a traced request is exactly the
+                    # "why was this one slow" answer: stamp the span
+                    from predictionio_tpu.obs.trace import add_event
+
+                    add_event("xla_compile", seconds=round(duration, 4))
 
         try:
             monitoring.register_event_duration_secs_listener(on_duration)

@@ -27,7 +27,7 @@ import re
 import threading
 import time
 from bisect import bisect_left
-from typing import Iterable, Iterator
+from typing import Callable, Iterable, Iterator
 
 __all__ = [
     "Counter",
@@ -35,6 +35,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
+    "set_exemplar_hook",
     "validate_metric_name",
     "DEFAULT_SECONDS_BUCKETS",
     "DEFAULT_SIZE_BUCKETS",
@@ -85,7 +86,28 @@ def _fmt(v: float) -> str:
 
 
 def _escape_label(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash first,
+    then double-quote and newline — a hostile value (a ``server_name``
+    carrying any of the three) must never break a scrape."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping (backslash and newline only, per the format —
+    quotes are legal in help text)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+#: Trace-exemplar hook (installed by obs/trace.py): returns the active
+#: sampled trace id, or None. Kept as a module global read per
+#: observation so metrics has no import dependency on the trace layer
+#: and the un-traced path costs one None-check.
+_exemplar_fn: Callable[[], "str | None"] | None = None
+
+
+def set_exemplar_hook(fn: Callable[[], "str | None"] | None) -> None:
+    global _exemplar_fn
+    _exemplar_fn = fn
 
 
 class _Metric:
@@ -145,7 +167,7 @@ class _ScalarMetric(_Metric):
         with self._lock:
             return sum(self._values.values())
 
-    def sample_lines(self) -> Iterator[str]:
+    def sample_lines(self, openmetrics: bool = False) -> Iterator[str]:
         samples = self.items()
         for key, v in sorted(samples):
             yield f"{self.name}{self._labelstr(key)} {_fmt(v)}"
@@ -182,12 +204,17 @@ class Gauge(_ScalarMetric):
 
 
 class _HistData:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)  # +1 = the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (trace_id, value): the LAST sampled-trace
+        # observation per bucket, exposed as an OpenMetrics exemplar.
+        # None until the first exemplar, so un-traced processes pay and
+        # store nothing.
+        self.exemplars: dict[int, tuple[str, float]] | None = None
 
 
 class Histogram(_Metric):
@@ -210,6 +237,8 @@ class Histogram(_Metric):
         round-trip — the per-request accounting of a coalesced batch)."""
         key = self._key(labels)
         idx = bisect_left(self.bounds, value)  # bounds are upper edges
+        ex = _exemplar_fn
+        trace_id = ex() if ex is not None else None
         with self._lock:
             d = self._data.get(key)
             if d is None:
@@ -217,6 +246,10 @@ class Histogram(_Metric):
             d.counts[idx] += times
             d.sum += value * times
             d.count += times
+            if trace_id is not None:
+                if d.exemplars is None:
+                    d.exemplars = {}
+                d.exemplars[idx] = (trace_id, value)
 
     class _Timer:
         __slots__ = ("_hist", "_labels", "_t0")
@@ -319,20 +352,35 @@ class Histogram(_Metric):
                 copy = _HistData(len(self.bounds))
                 copy.counts = list(d.counts)
                 copy.sum, copy.count = d.sum, d.count
+                if d.exemplars:
+                    copy.exemplars = dict(d.exemplars)
                 out.append((key, copy))
             return out
 
-    def sample_lines(self) -> Iterator[str]:
+    @staticmethod
+    def _exemplar_suffix(d: _HistData, idx: int) -> str:
+        """OpenMetrics exemplar comment for one bucket line (empty when
+        the bucket never saw a sampled-trace observation — exposition is
+        byte-identical to the pre-exemplar format then)."""
+        if not d.exemplars or idx not in d.exemplars:
+            return ""
+        trace_id, value = d.exemplars[idx]
+        return (f' # {{trace_id="{_escape_label(trace_id)}"}}'
+                f" {_fmt(value)}")
+
+    def sample_lines(self, openmetrics: bool = False) -> Iterator[str]:
         for key, d in sorted(self.items()):
             cum = 0
-            for bound, c in zip(self.bounds, d.counts):
+            for i, (bound, c) in enumerate(zip(self.bounds, d.counts)):
                 cum += c
                 le = f'le="{_fmt(bound)}"'
                 yield (f"{self.name}_bucket"
-                       f"{self._labelstr(key, le)} {cum}")
+                       f"{self._labelstr(key, le)} {cum}"
+                       f"{self._exemplar_suffix(d, i) if openmetrics else ''}")
             cum += d.counts[-1]
             inf_labels = self._labelstr(key, 'le="+Inf"')
-            yield f"{self.name}_bucket{inf_labels} {cum}"
+            yield (f"{self.name}_bucket{inf_labels} {cum}"
+                   f"{self._exemplar_suffix(d, len(self.bounds)) if openmetrics else ''}")
             yield f"{self.name}_sum{self._labelstr(key)} {_fmt(d.sum)}"
             yield f"{self.name}_count{self._labelstr(key)} {d.count}"
 
@@ -397,16 +445,34 @@ class MetricsRegistry:
         with self._lock:
             return sorted(self._metrics)
 
-    def expose(self) -> str:
-        """Prometheus text format 0.0.4."""
+    def expose(self, openmetrics: bool = False) -> str:
+        """Prometheus text format 0.0.4, or (``openmetrics=True``) the
+        OpenMetrics variant with histogram trace-id exemplars and the
+        ``# EOF`` terminator. Exemplar comments are a hard parse error
+        for the classic 0.0.4 parser — a stock Prometheus scraping the
+        default content type would fail the WHOLE scrape — so they are
+        emitted only under the negotiated OpenMetrics content type
+        (utils/http.py checks the Accept header)."""
         lines: list[str] = []
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         for m in metrics:
+            family = m.name
+            if openmetrics and m.kind == "counter" \
+                    and family.endswith("_total"):
+                # OpenMetrics names a counter FAMILY without the
+                # ``_total`` suffix; the sample keeps it (family +
+                # "_total"). Announcing the family AS ``pio_x_total``
+                # is a "clashing name" hard error in the reference
+                # parser — it would fail the whole negotiated scrape,
+                # the only one that carries exemplars.
+                family = family[: -len("_total")]
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m.sample_lines())
+                lines.append(f"# HELP {family} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {family} {m.kind}")
+            lines.extend(m.sample_lines(openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict:

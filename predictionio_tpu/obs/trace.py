@@ -1,0 +1,722 @@
+"""Sampled per-request span tracing: the "why was THIS query slow" layer.
+
+PR 1 gave every server aggregate ``pio_*`` histograms; those answer
+"how slow is the fleet" but not "why was this one request slow — hedge,
+breaker, cache miss, queue wait, compile, or transfer stall?".  This
+module is the Dapper-style answer, sized for a single long-lived Python
+process:
+
+  * :func:`span` — a context manager recording name, monotonic
+    start/duration, a bounded attribute dict, and point events
+    (:meth:`_Span.add_event`).  Parent linkage rides a
+    ``contextvars.ContextVar``, so nesting needs no plumbing; the trace
+    id IS the request id (:mod:`predictionio_tpu.obs.context`), so one
+    trace spans gateway → replica → batcher → device inside a process,
+    and the id in a log line, a histogram exemplar, and ``pio trace``
+    all mean the same request.
+  * :class:`Tracer` — a process-global bounded ring buffer of finished
+    traces plus an always-keep reservoir of the slowest N, surfaced as
+    ``GET /debug/traces`` on every server (utils/http.py), the
+    dashboard's slow-traces panel, and the ``pio trace`` CLI.
+  * Cross-server propagation: outbound HTTP calls carry
+    ``X-Trace-Sampled`` (so the callee joins the caller's sampling
+    decision) and ``X-Parent-Span`` next to the existing
+    ``X-Request-ID``; the HTTP layer opens a server span per request
+    with those as the remote parent.
+  * Histogram exemplars: while a sampled span is active, every
+    histogram observation stamps its bucket with the trace id
+    (obs/metrics.py), exposed as OpenMetrics ``# {trace_id=...}``
+    exemplar comments — the p99 bucket links straight back to a
+    concrete trace.
+
+Sampling rides ``PIO_TRACE`` (read per request, so a live process can
+be retuned): ``off`` | ``slow`` (default — trace everything, keep the
+recent ring only for traces ≥ ``PIO_TRACE_SLOW_MS``; the slowest-N
+reservoir always competes) | a probability in (0, 1) | ``all``.  The
+``off`` path is a true no-op: :func:`span` returns one shared
+:data:`NOOP` object — no span allocation, no dict churn, no lock
+(guarded by the identity test in tests/test_trace.py and the
+``trace_overhead_frac`` bench guard in bench_serving.py).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import heapq
+import itertools
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+
+from predictionio_tpu.obs import metrics as _metrics
+from predictionio_tpu.obs.context import current_request_id, new_request_id
+from predictionio_tpu.obs.metrics import REGISTRY
+
+__all__ = [
+    "NOOP",
+    "PARENT_SPAN_HEADER",
+    "SAMPLED_HEADER",
+    "TRACER",
+    "Tracer",
+    "add_event",
+    "capture",
+    "child_span",
+    "current_trace_id",
+    "hold",
+    "inject_headers",
+    "record_span",
+    "release",
+    "render_waterfall_text",
+    "server_span",
+    "span",
+    "trace_enabled",
+    "trace_mode",
+]
+
+logger = logging.getLogger(__name__)
+
+TRACE_ENV = "PIO_TRACE"
+SAMPLED_HEADER = "X-Trace-Sampled"
+PARENT_SPAN_HEADER = "X-Parent-Span"
+
+#: ``slow`` mode: traces at least this slow enter the recent ring.
+SLOW_MS_ENV = "PIO_TRACE_SLOW_MS"
+DEFAULT_SLOW_MS = 25.0
+
+#: Hard bounds — tracing must never grow without limit on a hot server.
+MAX_SPANS_PER_TRACE = 256
+MAX_ATTRS_PER_SPAN = 16
+MAX_EVENTS_PER_SPAN = 32
+MAX_ATTR_CHARS = 200
+MAX_ACTIVE_TRACES = 1024
+
+_SPANS_TOTAL = REGISTRY.counter(
+    "pio_trace_spans_total", "Finished spans recorded into traces")
+_TRACES_TOTAL = REGISTRY.counter(
+    "pio_trace_traces_total",
+    "Finished traces by retention outcome (recent ring / slowest "
+    "reservoir only / dropped)",
+    labels=("outcome",),
+)
+_RING_ENTRIES = REGISTRY.gauge(
+    "pio_trace_ring_entries", "Finished traces currently in the ring")
+
+
+#: (last raw env value, parsed mode) — parsing is memoized on the raw
+#: string (re-read every call, so a live retune still lands on the next
+#: request) because this runs at EVERY span site on the serving hot path.
+_mode_cache: tuple[str | None, str] = (None, "slow")
+
+
+def trace_mode() -> str:
+    """Effective ``PIO_TRACE`` mode: ``off`` | ``slow`` | ``all`` | a
+    probability string. Read per call so a live process can be retuned
+    (the bench's A/B toggle relies on this)."""
+    global _mode_cache
+    env = os.environ.get(TRACE_ENV)
+    cached_env, cached_mode = _mode_cache
+    if env == cached_env:
+        return cached_mode
+    raw = (env if env is not None else "slow").strip().lower()
+    if raw in ("off", "0", "false", "none", ""):
+        mode = "off"
+    elif raw in ("all", "1", "true"):
+        mode = "all"
+    elif raw == "slow" or _as_prob(raw) is not None:
+        mode = raw
+    else:
+        try:
+            # numeric but outside (0, 1): the operator's intent is
+            # plain — ≤ 0 disables, ≥ 1 traces everything — so honor it
+            # instead of silently tracing under the "slow" default
+            mode = "off" if float(raw) <= 0.0 else "all"
+        except ValueError:
+            logger.warning(
+                "unrecognized %s=%r; falling back to 'slow' "
+                "(valid: off | slow | all | probability in (0,1))",
+                TRACE_ENV, env)
+            mode = "slow"
+    _mode_cache = (env, mode)
+    return mode
+
+
+def _as_prob(raw: str) -> float | None:
+    try:
+        p = float(raw)
+    except ValueError:
+        return None
+    return p if 0.0 < p < 1.0 else None
+
+
+def trace_enabled() -> bool:
+    return trace_mode() != "off"
+
+
+def _slow_threshold_s() -> float:
+    try:
+        return float(os.environ.get(SLOW_MS_ENV, DEFAULT_SLOW_MS)) / 1e3
+    except ValueError:
+        return DEFAULT_SLOW_MS / 1e3
+
+
+def _sample(mode: str) -> bool:
+    """Head sampling decision for a NEW trace under ``mode`` (callers
+    handle ``off``)."""
+    if mode in ("all", "slow"):
+        return True
+    p = _as_prob(mode)
+    if p is None:
+        return True
+    return random.random() < p
+
+
+def _clip(value: object) -> object:
+    """Attribute/event values: JSON scalars pass, everything else is a
+    bounded str() — a trace must serialize no matter what rode in."""
+    if isinstance(value, bool) or value is None:
+        return value
+    if isinstance(value, (int, float)):
+        return value if value == value else None  # NaN is invalid JSON
+    s = str(value)
+    return s if len(s) <= MAX_ATTR_CHARS else s[:MAX_ATTR_CHARS] + "…"
+
+
+class _TraceState:
+    """Mutable collection point for one trace id's spans. Shared by
+    every span of the trace (across threads: gateway handler, hedge
+    threads, the micro-batcher consumer), so all mutation happens under
+    the tracer lock."""
+
+    __slots__ = ("trace_id", "t0_wall", "t0_mono", "spans", "open",
+                 "dropped", "committed")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.t0_wall = time.time()
+        self.t0_mono = time.perf_counter()
+        self.spans: list[dict] = []
+        self.open = 0
+        self.dropped = 0
+        self.committed = False
+
+
+class _NoopSpan:
+    """The disabled path: one shared instance, every method a constant
+    no-op. ``span()`` must return THIS object (identity-tested) when
+    tracing is off or the request is unsampled."""
+
+    __slots__ = ()
+    sampled = False
+    trace_id = None
+    span_id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add_event(self, name, **attrs):
+        pass
+
+    def set_attr(self, key, value):
+        pass
+
+
+NOOP = _NoopSpan()
+
+
+class _SuppressedScope:
+    """Request-scope "not sampled" marker. :func:`server_span` returns
+    one (instead of the bare :data:`NOOP`) when the request is
+    explicitly suppressed (``X-Trace-Sampled: 0``), loses the
+    probability coin, or is load-shed: nested :func:`span` calls then
+    see the REQUEST's head decision instead of re-sampling per stage
+    (which would fragment one unsampled request into single-span
+    traces), and :func:`inject_headers` propagates the ``0``
+    downstream. One tiny allocation per unsampled request — never on
+    the ``off`` path, which keeps returning :data:`NOOP` itself."""
+
+    __slots__ = ("_token",)
+    sampled = False
+    trace_id = None
+    span_id = None
+    state = None
+
+    def __enter__(self):
+        self._token = _span_var.set(self)
+        return self
+
+    def __exit__(self, *exc):
+        _span_var.reset(self._token)
+        return False
+
+    def add_event(self, name, **attrs):
+        pass
+
+    def set_attr(self, key, value):
+        pass
+
+#: Span-id source: a counter on a random epoch. ``uuid.uuid4`` costs an
+#: entropy syscall (~30 µs in sandboxed environments — measured 8 ids ≈
+#: 0.25 ms per traced request); span ids only need uniqueness within a
+#: retained trace, and CPython's ``itertools.count.__next__`` is atomic,
+#: so this is both thread-safe and ~300x cheaper.
+_span_ids = itertools.count(random.getrandbits(31))
+
+
+def _new_span_id() -> str:
+    return f"{next(_span_ids) & 0xFFFFFFFF:08x}"
+
+#: The innermost active span on this thread/context (None = untraced).
+_span_var: contextvars.ContextVar["_Span | None"] = contextvars.ContextVar(
+    "pio_trace_span", default=None
+)
+
+
+class _Span:
+    """A live span: collects attrs/events locally (no lock — a span is
+    used by the thread that opened it) and hands one finished record to
+    the tracer on exit."""
+
+    __slots__ = ("state", "name", "span_id", "parent_id", "_attrs",
+                 "_events", "_t0", "_token")
+
+    sampled = True
+
+    def __init__(self, state: _TraceState, name: str,
+                 parent_id: str | None, attrs: dict | None = None):
+        self.state = state
+        self.name = name
+        self.span_id = _new_span_id()
+        self.parent_id = parent_id
+        self._attrs = {}
+        if attrs:
+            for k, v in attrs.items():
+                self.set_attr(k, v)
+        self._events: list[tuple[str, float, dict | None]] = []
+        self._t0 = 0.0
+        self._token = None
+
+    @property
+    def trace_id(self) -> str:
+        return self.state.trace_id
+
+    def set_attr(self, key: str, value: object) -> None:
+        if len(self._attrs) < MAX_ATTRS_PER_SPAN or key in self._attrs:
+            self._attrs[key] = _clip(value)
+
+    def add_event(self, name: str, **attrs) -> None:
+        """Point annotation at now (hedge_fired, cache_hit,
+        xla_compile, ...)."""
+        if len(self._events) < MAX_EVENTS_PER_SPAN:
+            self._events.append((
+                name, time.perf_counter(),
+                {k: _clip(v) for k, v in attrs.items()} or None,
+            ))
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        TRACER._span_opened(self.state)
+        self._token = _span_var.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        end = time.perf_counter()
+        if self._token is not None:
+            _span_var.reset(self._token)
+        if exc_type is not None:
+            self.set_attr("error", f"{exc_type.__name__}: {exc}")
+        TRACER._span_closed(self.state, self._record(self._t0, end))
+        return False
+
+    def _record(self, start: float, end: float) -> dict:
+        return {
+            "name": self.name,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "start": start,
+            "duration": end - start,
+            "attrs": self._attrs or None,
+            "events": self._events or None,
+        }
+
+
+class Tracer:
+    """Finished-trace retention: a recent ring (``deque``) plus a
+    slowest-N min-heap reservoir, behind one lock (touched only on the
+    sampled path)."""
+
+    def __init__(self, ring_size: int = 128, slowest_size: int = 16):
+        self.ring_size = ring_size
+        self.slowest_size = slowest_size
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=ring_size)
+        self._slowest: list[tuple[float, int, dict]] = []
+        self._active: dict[str, _TraceState] = {}
+        self._seq = 0
+
+    # -- span bookkeeping ---------------------------------------------------
+
+    def _state_for(self, trace_id: str) -> _TraceState | None:
+        """Get-or-create the collection state for ``trace_id``; None
+        when the active table is full (load-shed: tracing must degrade,
+        never grow unbounded)."""
+        with self._lock:
+            state = self._active.get(trace_id)
+            if state is not None:
+                return state
+            if len(self._active) >= MAX_ACTIVE_TRACES:
+                return None
+            state = _TraceState(trace_id)
+            self._active[trace_id] = state
+            return state
+
+    def _span_opened(self, state: _TraceState) -> None:
+        with self._lock:
+            state.open += 1
+
+    def _span_closed(self, state: _TraceState,
+                     record: dict | None) -> None:
+        """Drop the open count by one, appending ``record`` when this
+        is a real span exit (None = a :func:`hold` being released)."""
+        commit = None
+        with self._lock:
+            state.open -= 1
+            if not state.committed:
+                if record is None:
+                    pass
+                elif len(state.spans) < MAX_SPANS_PER_TRACE:
+                    state.spans.append(record)
+                    _SPANS_TOTAL.inc()
+                else:
+                    state.dropped += 1
+                if state.open <= 0:
+                    # the outermost span closed: the trace is done (a
+                    # hedge loser still in flight holds open > 0, so its
+                    # span lands before commit)
+                    state.committed = True
+                    self._active.pop(state.trace_id, None)
+                    commit = state
+        if commit is not None:
+            self._commit(commit)
+
+    def _record_finished(self, state: _TraceState, record: dict) -> None:
+        """A retroactive span (timed elsewhere, e.g. per micro-batch
+        rider on the consumer thread) — appended without touching the
+        open count."""
+        with self._lock:
+            if state.committed:
+                return  # the trace already shipped; drop, never resurrect
+            if len(state.spans) < MAX_SPANS_PER_TRACE:
+                state.spans.append(record)
+                _SPANS_TOTAL.inc()
+            else:
+                state.dropped += 1
+
+    # -- retention ----------------------------------------------------------
+
+    def _commit(self, state: _TraceState) -> None:
+        doc = self._doc(state)
+        duration_s = doc["durationMs"] / 1e3
+        keep_recent = (trace_mode() != "slow"
+                       or duration_s >= _slow_threshold_s())
+        with self._lock:
+            self._seq += 1
+            entry = (duration_s, self._seq, doc)
+            in_reservoir = False
+            if len(self._slowest) < self.slowest_size:
+                heapq.heappush(self._slowest, entry)
+                in_reservoir = True
+            elif self._slowest and duration_s > self._slowest[0][0]:
+                heapq.heappushpop(self._slowest, entry)
+                in_reservoir = True
+            if keep_recent:
+                self._ring.append(doc)
+            _RING_ENTRIES.set(len(self._ring))
+        outcome = ("recent" if keep_recent
+                   else "reservoir" if in_reservoir else "dropped")
+        _TRACES_TOTAL.inc(outcome=outcome)
+
+    def _doc(self, state: _TraceState) -> dict:
+        t0 = state.t0_mono
+        spans = sorted(state.spans, key=lambda r: r["start"])
+        start = spans[0]["start"] if spans else t0
+        end = max((r["start"] + r["duration"] for r in spans), default=t0)
+        out_spans = []
+        for r in spans:
+            s = {
+                "name": r["name"],
+                "spanId": r["spanId"],
+                "parentId": r["parentId"],
+                "offsetMs": round((r["start"] - t0) * 1e3, 3),
+                "durationMs": round(r["duration"] * 1e3, 3),
+            }
+            if r["attrs"]:
+                s["attrs"] = r["attrs"]
+            if r["events"]:
+                s["events"] = [
+                    {"name": n, "offsetMs": round((t - t0) * 1e3, 3),
+                     **({"attrs": a} if a else {})}
+                    for n, t, a in r["events"]
+                ]
+            out_spans.append(s)
+        return {
+            "traceId": state.trace_id,
+            "startTime": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(state.t0_wall)) + "Z",
+            "durationMs": round(max(end - start, 0.0) * 1e3, 3),
+            "spans": out_spans,
+            "droppedSpans": state.dropped,
+        }
+
+    # -- query surface (/debug/traces, dashboard, pio trace) ----------------
+
+    def traces(self, min_duration_ms: float = 0.0,
+               trace_id: str | None = None, limit: int = 50) -> dict:
+        """Snapshot for ``GET /debug/traces``: recent (newest first) and
+        slowest (slowest first), optionally filtered."""
+        with self._lock:
+            recent = list(self._ring)
+            slowest = [doc for _, _, doc in
+                       sorted(self._slowest, reverse=True)]
+
+        def keep(doc: dict) -> bool:
+            if trace_id is not None and doc["traceId"] != trace_id:
+                return False
+            return doc["durationMs"] >= min_duration_ms
+
+        limit = max(int(limit), 1)
+        return {
+            "mode": trace_mode(),
+            "slowMs": round(_slow_threshold_s() * 1e3, 3),
+            "recent": [d for d in reversed(recent) if keep(d)][:limit],
+            "slowest": [d for d in slowest if keep(d)][:limit],
+        }
+
+    def find(self, trace_id: str) -> dict | None:
+        got = self.traces(trace_id=trace_id, limit=1)
+        hits = got["recent"] or got["slowest"]
+        return hits[0] if hits else None
+
+    def reset(self) -> None:
+        """Drop everything (tests)."""
+        with self._lock:
+            self._ring.clear()
+            self._slowest.clear()
+            self._active.clear()
+            _RING_ENTRIES.set(0)
+
+
+#: The process-global tracer every server surfaces.
+TRACER = Tracer()
+
+
+# -- public span API ---------------------------------------------------------
+
+
+def span(name: str, **attrs):
+    """Open a span under the current one, or start a new sampled trace
+    when none is active. Returns :data:`NOOP` (shared, lock-free,
+    allocation-free) when tracing is off or the trace is unsampled."""
+    mode = trace_mode()
+    if mode == "off":
+        return NOOP
+    parent = _span_var.get()
+    if parent is not None:
+        if not parent.sampled:  # the request's head decision wins
+            return NOOP
+        return _Span(parent.state, name, parent.span_id, attrs or None)
+    if not _sample(mode):
+        return NOOP
+    state = TRACER._state_for(current_request_id() or new_request_id())
+    if state is None:
+        return NOOP
+    return _Span(state, name, None, attrs or None)
+
+
+def server_span(name: str, trace_id: str, sampled_header: str | None,
+                parent_id: str | None):
+    """The HTTP layer's per-request root: joins the caller's sampling
+    decision when ``X-Trace-Sampled`` rode in (``"1"`` forces sampling,
+    ``"0"`` suppresses it), else samples per ``PIO_TRACE``. The trace id
+    is the request id, so gateway and replica spans of one user query
+    land in one trace."""
+    mode = trace_mode()
+    if mode == "off":
+        return NOOP
+    if sampled_header == "0":
+        return _SuppressedScope()
+    if sampled_header != "1" and not _sample(mode):
+        return _SuppressedScope()
+    state = TRACER._state_for(trace_id)
+    if state is None:
+        return _SuppressedScope()
+    return _Span(state, name, parent_id)
+
+
+def capture():
+    """Handle for cross-thread span creation: ``(state, span_id)`` of
+    the current span, or None. Pass to :func:`child_span` /
+    :func:`record_span` on another thread."""
+    sp = _span_var.get()
+    return (sp.state, sp.span_id) \
+        if sp is not None and sp.sampled else None
+
+
+def child_span(handle, name: str, **attrs):
+    """A span parented on a :func:`capture` handle — for work that hops
+    threads (the gateway's hedge/retry attempt threads)."""
+    if handle is None or trace_mode() == "off":
+        return NOOP
+    state, parent_id = handle
+    return _Span(state, name, parent_id, attrs or None)
+
+
+def hold(handle):
+    """Keep a trace uncommitted across a thread handoff: call on the
+    LAUNCHING thread (before ``Thread.start``) with a :func:`capture`
+    handle, and pair with :func:`release` in the worker's ``finally``.
+    Without the hold, the root span can close — and the trace commit —
+    in the scheduling gap before the worker's :func:`child_span`
+    enters, silently dropping the worker's span (a hedge attempt's
+    ``upstream``, for example). Returns None (a no-op to release) for
+    an untraced handle."""
+    if handle is None:
+        return None
+    state, _ = handle
+    TRACER._span_opened(state)
+    return state
+
+
+def release(held) -> None:
+    """Release a :func:`hold` (None-safe). Runs the same
+    commit-on-last-close logic as a span exit, without a record."""
+    if held is not None:
+        TRACER._span_closed(held, None)
+
+
+def record_span(handle, name: str, start: float, duration: float,
+                **attrs) -> None:
+    """Retroactively record a completed span (perf_counter ``start`` +
+    ``duration``) under a handle — the micro-batcher uses this to give
+    every rider its own queue_wait/predict/serve spans even though the
+    timing happened once on the consumer thread."""
+    if handle is None:
+        return
+    state, parent_id = handle
+    record = {
+        "name": name,
+        "spanId": _new_span_id(),
+        "parentId": parent_id,
+        "start": start,
+        "duration": max(duration, 0.0),
+        "attrs": {k: _clip(v) for k, v in attrs.items()} or None,
+        "events": None,
+    }
+    TRACER._record_finished(state, record)
+
+
+def record(name: str, start: float, duration: float, **attrs) -> None:
+    """:func:`record_span` under the CURRENT span (same thread)."""
+    record_span(capture(), name, start, duration, **attrs)
+
+
+def add_event(name: str, **attrs) -> None:
+    """Annotate the current span (no-op when untraced)."""
+    sp = _span_var.get()
+    if sp is not None:
+        sp.add_event(name, **attrs)
+
+
+def current_trace_id() -> str | None:
+    sp = _span_var.get()
+    return sp.state.trace_id if sp is not None and sp.sampled else None
+
+
+def inject_headers(headers: dict) -> None:
+    """Stamp outbound-call headers with the active trace's sampling
+    decision and parent span (callers already send ``X-Request-ID``).
+    A request whose head decision was "don't sample" propagates the
+    suppression (``0``) so the callee doesn't re-sample its half of an
+    unsampled request; contexts with no request at all (background
+    work, ``off`` mode) send nothing — the callee decides for
+    itself."""
+    sp = _span_var.get()
+    if sp is None:
+        return
+    if sp.sampled:
+        headers[SAMPLED_HEADER] = "1"
+        headers[PARENT_SPAN_HEADER] = sp.span_id
+    else:
+        headers[SAMPLED_HEADER] = "0"
+
+
+# -- histogram exemplars ------------------------------------------------------
+
+def _exemplar() -> str | None:
+    sp = _span_var.get()
+    return sp.state.trace_id if sp is not None and sp.sampled else None
+
+
+# Installed at import: every Histogram.observe made under a sampled span
+# stamps its bucket with the trace id (obs/metrics.py emits them as
+# OpenMetrics exemplar comments). With tracing off the hook returns None
+# and the exposition stays byte-identical.
+_metrics.set_exemplar_hook(_exemplar)
+
+
+# -- rendering (pio trace / dashboard share the layout math) ------------------
+
+def waterfall_rows(doc: dict) -> list[dict]:
+    """Depth-annotated spans in start order: adds ``depth`` (parent
+    chain length, remote/unknown parents count as roots) to each span
+    dict — the shared layout pass for text and HTML waterfalls."""
+    by_id = {s["spanId"]: s for s in doc.get("spans", ())}
+    rows = []
+    for s in doc.get("spans", ()):
+        depth, seen, cur = 0, set(), s
+        while cur.get("parentId") in by_id and cur["spanId"] not in seen:
+            seen.add(cur["spanId"])
+            cur = by_id[cur["parentId"]]
+            depth += 1
+        rows.append({**s, "depth": depth})
+    return rows
+
+
+def render_waterfall_text(doc: dict, width: int = 40) -> str:
+    """One trace as an aligned text waterfall (the ``pio trace``
+    output)."""
+    total = max(doc.get("durationMs", 0.0), 1e-6)
+    lines = [
+        f"trace {doc['traceId']}  {doc.get('startTime', '?')}  "
+        f"{doc['durationMs']:.2f} ms  ({len(doc.get('spans', ()))} spans)"
+    ]
+    for s in waterfall_rows(doc):
+        left = int(width * s["offsetMs"] / total)
+        bar = max(int(width * s["durationMs"] / total), 1)
+        bar = min(bar, width - min(left, width - 1))
+        label = "  " * s["depth"] + s["name"]
+        attrs = s.get("attrs") or {}
+        suffix = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(
+            f"  {label:<28} {s['offsetMs']:>9.2f}ms "
+            f"|{' ' * min(left, width - 1)}{'#' * bar}"
+            f"{' ' * max(width - left - bar, 0)}| "
+            f"{s['durationMs']:>8.2f}ms{('  ' + suffix) if suffix else ''}"
+        )
+        for ev in s.get("events", ()) or ():
+            ev_attrs = ev.get("attrs") or {}
+            ev_suffix = " ".join(f"{k}={v}" for k, v in ev_attrs.items())
+            lines.append(
+                f"  {'  ' * s['depth']}  * {ev['name']} "
+                f"@{ev['offsetMs']:.2f}ms"
+                f"{('  ' + ev_suffix) if ev_suffix else ''}"
+            )
+    if doc.get("droppedSpans"):
+        lines.append(f"  ({doc['droppedSpans']} span(s) dropped: "
+                     f"per-trace cap {MAX_SPANS_PER_TRACE})")
+    return "\n".join(lines)

@@ -39,7 +39,12 @@ import threading
 import time
 from dataclasses import dataclass
 
-from predictionio_tpu.obs import REGISTRY, REQUEST_ID_HEADER, current_request_id
+from predictionio_tpu.obs import (
+    REGISTRY,
+    REQUEST_ID_HEADER,
+    current_request_id,
+    trace,
+)
 from predictionio_tpu.serve.cache import QueryCache, canonical_query_key
 from predictionio_tpu.serve.registry import Replica, ReplicaRegistry
 from predictionio_tpu.utils.http import (
@@ -412,6 +417,7 @@ class Gateway:
                 hit = self.cache.get(key)
                 if hit is not None:
                     _GW_REQUESTS.inc(outcome="cache_hit")
+                    trace.add_event("cache_hit")
                     return 200, hit
                 # singleflight: one of N concurrent identical misses
                 # goes upstream (the leader); the rest wait for its
@@ -425,10 +431,12 @@ class Gateway:
                             leader = True
                             break
                     _GW_COALESCED.inc()
+                    trace.add_event("singleflight_coalesced")
                     ev.wait(timeout=max(deadline - time.monotonic(), 0.0))
                     hit = self.cache.get(key)
                     if hit is not None:
                         _GW_REQUESTS.inc(outcome="cache_hit")
+                        trace.add_event("cache_hit", coalesced=True)
                         return 200, hit
                     # leader failed or the result wasn't cacheable (non-
                     # 200): fall through and fetch (or re-lead) ourselves
@@ -467,23 +475,36 @@ class Gateway:
         """Fire one upstream attempt on its own thread; results land on
         ``resq`` as ('ok', status, payload, replica, kind) or
         ('err', exc, None, replica, kind)."""
+        # the attempt runs on a fresh thread, where contextvars don't
+        # follow — capture the gateway server span HERE (the handler
+        # thread) so the upstream client span parents correctly, and
+        # hold the trace open so a hedge attempt that hasn't been
+        # scheduled yet when the handler answers (primary won) still
+        # lands its span before the trace commits
+        handle = trace.capture()
+        held = trace.hold(handle)
 
         def run():
             t0 = time.perf_counter()
             try:
-                timeout = max(deadline - time.monotonic(), 0.05)
-                status, payload = self._upstream_query(
-                    replica, body, rid, timeout)
-            except Exception as e:  # noqa: BLE001 — transport failure
-                self._record_transport(replica, ok=False)
-                resq.put(("err", e, None, replica, kind))
-            else:
-                self._record_transport(replica, ok=True)
-                _GW_UPSTREAM_SECONDS.observe(
-                    time.perf_counter() - t0, replica=replica.id)
-                resq.put(("ok", status, payload, replica, kind))
+                with trace.child_span(handle, "upstream",
+                                      replica=replica.id, kind=kind):
+                    try:
+                        timeout = max(deadline - time.monotonic(), 0.05)
+                        status, payload = self._upstream_query(
+                            replica, body, rid, timeout)
+                    except Exception as e:  # noqa: BLE001 — transport failure
+                        self._record_transport(replica, ok=False)
+                        resq.put(("err", e, None, replica, kind))
+                    else:
+                        self._record_transport(replica, ok=True)
+                        _GW_UPSTREAM_SECONDS.observe(
+                            time.perf_counter() - t0, replica=replica.id)
+                        resq.put(("ok", status, payload, replica, kind))
+                    finally:
+                        self.registry.release(replica)
             finally:
-                self.registry.release(replica)
+                trace.release(held)
 
         threading.Thread(target=run, name=f"gw-{kind}", daemon=True).start()
 
@@ -514,6 +535,14 @@ class Gateway:
         rid = current_request_id()
         resq: "queue.Queue" = queue.Queue()
         tried: set[str] = set()
+        if trace.current_trace_id() is not None:
+            # the breaker scan runs only under an active span: untraced
+            # queries must not pay for building an event they can't keep
+            open_breakers = sorted(
+                r for r, b in self._breakers.items() if b.state == "open")
+            if open_breakers:  # shed replicas this request routes around
+                trace.add_event("breaker_open",
+                                replicas=",".join(open_breakers))
         primary = self._acquire(exclude=tried)
         if primary is None:
             return 503, {"message": "No replica available.",
@@ -542,6 +571,8 @@ class Gateway:
                     with self._stats_lock:
                         self.hedges_fired += 1
                     _GW_HEDGES.inc(result="fired")
+                    trace.add_event("hedge_fired",
+                                    replica=hedge_replica.id)
                     self._launch(hedge_replica, body, rid, deadline, resq,
                                  "hedge")
                     pending += 1
@@ -552,6 +583,7 @@ class Gateway:
                     with self._stats_lock:
                         self.hedges_won += 1
                     _GW_HEDGES.inc(result="won")
+                    trace.add_event("hedge_won", replica=replica.id)
                 return a, b  # replica's status/payload, 4xx/5xx included
             last_err = a
             pending -= 1
@@ -579,6 +611,7 @@ class Gateway:
             with self._stats_lock:
                 self.retries += 1
             _GW_RETRIES.inc()
+            trace.add_event("retry_fired", replica=retry.id)
             self._launch(retry, body, rid, deadline, resq, "retry")
             pending += 1
         if last_err is not None:
@@ -617,6 +650,9 @@ class Gateway:
         headers = {"Content-Type": "application/json"}
         if rid:
             headers[REQUEST_ID_HEADER] = rid
+        # the replica joins this trace: sampled flag + the upstream
+        # span (active on this attempt thread) as the remote parent
+        trace.inject_headers(headers)
         try:
             conn.request("POST", "/queries.json", body, headers)
             resp = conn.getresponse()

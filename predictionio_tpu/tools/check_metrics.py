@@ -1,0 +1,135 @@
+"""Metric/doc drift checker: ``python -m predictionio_tpu.tools.check_metrics``.
+
+Metric names are a scrape contract (dashboards and recording rules
+reference them by string), and docs/operations.md § Monitoring is the
+operator-facing side of that contract. This tool keeps the two — and
+the source tree itself — from drifting:
+
+  1. every ``pio_*`` metric declared in the source is documented in
+     docs/operations.md, and every documented name is still declared
+     (stale doc rows are exactly as misleading as missing ones);
+  2. no metric name literal is re-declared at a second call site —
+     get-or-create registration makes duplicates *work*, which is why
+     they slip in, but two declaration sites can silently diverge in
+     help text or bucket choice and are the drift this repo's
+     convention (define once, import everywhere: see
+     workflow/batching.py's ``QUERY_STAGE_SECONDS``) exists to prevent.
+
+Wired into tier-1 as tests/test_check_metrics.py, so a PR adding a
+metric without its docs row (or vice versa) fails fast.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+from predictionio_tpu.obs.metrics import _NAME_RE
+
+#: A registration call with its name literal (the name may sit on the
+#: line after the open paren — \s* crosses newlines).
+_DECL_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"'](pio_[a-z0-9_]+)[\"']"
+)
+
+#: Candidate metric tokens anywhere in the doc text (names only ever
+#: appear as themselves — tables, prose backticks, PromQL examples),
+#: brace groups still intact (``pio_gateway_cache_{hits,misses}_total``).
+_DOC_TOKEN_RE = re.compile(r"pio_[a-z0-9_]+(?:\{[a-z0-9_,]+\}[a-z0-9_]*)?")
+
+#: Histogram series the exposition derives from one declared name —
+#: a PromQL example referencing ``pio_x_seconds_bucket`` documents
+#: ``pio_x_seconds``, not a separate metric.
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+DOCS_REL = "docs/operations.md"
+PACKAGE_REL = "predictionio_tpu"
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def declared_metrics(package_dir: Path) -> dict[str, list[str]]:
+    """Every ``pio_*`` name passed to a counter/gauge/histogram
+    registration call in the package, mapped to its declaration sites
+    (``file:line``)."""
+    sites: dict[str, list[str]] = {}
+    for path in sorted(package_dir.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for m in _DECL_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            sites.setdefault(m.group(1), []).append(
+                f"{path.relative_to(package_dir.parent)}:{line}")
+    return sites
+
+
+def expand_braces(token: str) -> list[str]:
+    """``a_{x,y}_b`` → ``[a_x_b, a_y_b]`` (single group, the docs-table
+    shorthand)."""
+    m = re.search(r"\{([^{}]+)\}", token)
+    if m is None:
+        return [token]
+    head, tail = token[: m.start()], token[m.end():]
+    return [v for part in m.group(1).split(",")
+            for v in expand_braces(head + part + tail)]
+
+
+def documented_metrics(doc_path: Path) -> set[str]:
+    """Valid metric names mentioned anywhere in the doc (brace
+    shorthand expanded; prose fragments like ``pio_train_*`` filtered
+    by the registration-name regex)."""
+    names: set[str] = set()
+    for token in _DOC_TOKEN_RE.findall(
+            doc_path.read_text(encoding="utf-8")):
+        for name in expand_braces(token):
+            if _NAME_RE.match(name):
+                names.add(name)
+    return names
+
+
+def check(root: Path | None = None) -> list[str]:
+    """All drift problems (empty list = in sync)."""
+    root = root or repo_root()
+    declared = declared_metrics(root / PACKAGE_REL)
+    documented = documented_metrics(root / DOCS_REL)
+    problems: list[str] = []
+    for name, sites in sorted(declared.items()):
+        if len(sites) > 1:
+            problems.append(
+                f"{name}: declared at {len(sites)} call sites "
+                f"({', '.join(sites)}) — define it once and import it "
+                "(the QUERY_STAGE_SECONDS convention), or the two sites' "
+                "help/buckets can silently diverge"
+            )
+    for name in sorted(set(declared) - documented):
+        problems.append(
+            f"{name}: declared at {declared[name][0]} but missing from "
+            f"{DOCS_REL} § Monitoring"
+        )
+    for name in sorted(documented - set(declared)):
+        if any(name.endswith(sfx) and name[: -len(sfx)] in declared
+               for sfx in _DERIVED_SUFFIXES):
+            continue  # a derived histogram series of a declared name
+        problems.append(
+            f"{name}: documented in {DOCS_REL} but no longer declared "
+            "anywhere — delete the stale row or restore the metric"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"[ERROR] {p}", file=sys.stderr)
+    if problems:
+        print(f"[ERROR] {len(problems)} metric/doc drift problem(s).",
+              file=sys.stderr)
+        return 1
+    print("[INFO] metrics and docs/operations.md are in sync.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

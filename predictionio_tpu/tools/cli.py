@@ -147,6 +147,28 @@ def build_parser() -> argparse.ArgumentParser:
     p_undeploy.add_argument("--port", type=int, default=8000)
     p_undeploy.set_defaults(func=cmd_undeploy)
 
+    # -- trace inspection (GET /debug/traces on any server) -----------------
+    p_trace = sub.add_parser(
+        "trace",
+        help="render span waterfalls from a server's /debug/traces")
+    p_trace.add_argument(
+        "request_id", nargs="?",
+        help="X-Request-ID / trace id to look up (searches the recent "
+             "ring and the slowest-N reservoir)")
+    p_trace.add_argument(
+        "--slowest", type=int, default=None, metavar="K",
+        help="show the K slowest retained traces instead of one id")
+    p_trace.add_argument(
+        "--min-ms", type=float, default=0.0, metavar="MS",
+        help="only traces at least this slow")
+    p_trace.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server to query (gateway, replica, event server, ... — "
+             "each process retains its own spans)")
+    p_trace.add_argument("--json", action="store_true",
+                         help="raw JSON instead of the text waterfall")
+    p_trace.set_defaults(func=cmd_trace)
+
     # -- eval (ref: Console.scala:279-306) ----------------------------------
     p_eval = sub.add_parser("eval", help="run an evaluation (parameter sweep)")
     p_eval.add_argument("evaluation_class",
@@ -479,6 +501,64 @@ def _deploy_gateway(args, config) -> int:
         clear_pidfile(pidfile.stem)
         dep.stop()
     print("[INFO] Gateway and replicas shut down.")
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """``pio trace <request-id>`` / ``pio trace --slowest K``: fetch
+    span timelines from a live server's ``GET /debug/traces`` and render
+    them as text waterfalls (the Dapper-style "why was this one query
+    slow" view; see docs/operations.md § Tracing)."""
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from predictionio_tpu.obs.trace import render_waterfall_text
+
+    if not args.request_id and args.slowest is None:
+        print("[ERROR] give a request id or --slowest K.", file=sys.stderr)
+        return 1
+    params = {"limit": args.slowest or 1, "min_ms": args.min_ms}
+    if args.request_id:
+        params["request_id"] = args.request_id
+    url = (f"{args.url.rstrip('/')}/debug/traces?"
+           f"{urllib.parse.urlencode(params)}")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        detail = ""
+        try:
+            detail = json.loads(e.read() or b"{}").get("message", "")
+        except ValueError:
+            pass
+        print(f"[ERROR] {url}: HTTP {e.code} {detail}".rstrip(),
+              file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"[ERROR] cannot reach {args.url}: {e}", file=sys.stderr)
+        return 1
+    if args.request_id:
+        docs = body.get("recent") or body.get("slowest") or []
+        if not docs:
+            print(f"[ERROR] no retained trace for {args.request_id} at "
+                  f"{args.url} (ring evicted, unsampled, or a different "
+                  "process handled it).", file=sys.stderr)
+            return 1
+        docs = docs[:1]
+    else:
+        docs = (body.get("slowest") or [])[: args.slowest]
+        if not docs:
+            print("[INFO] no traces retained yet "
+                  f"(mode={body.get('mode')}).")
+            return 0
+    if args.json:
+        print(json.dumps(docs if args.slowest else docs[0], indent=2))
+        return 0
+    for doc in docs:
+        print(render_waterfall_text(doc))
+        print()
     return 0
 
 

@@ -13,7 +13,7 @@ import html
 
 from predictionio_tpu.data.storage import Storage
 from predictionio_tpu.data.storage.base import EvaluationInstance
-from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs import REGISTRY, trace
 from predictionio_tpu.utils.http import (
     AppServer,
     HTTPError,
@@ -40,6 +40,7 @@ _PAGE = """<!DOCTYPE html>
 {rows}
 </table>
 {metrics}
+{traces}
 </body></html>"""
 
 _METRICS_FOOTER = ('<p>Serving latency (this process): {latency} &middot; '
@@ -57,6 +58,55 @@ def _metrics_footer() -> str:
     else:
         latency = f"p50 {p50 * 1e3:.2f} ms / p99 {p99 * 1e3:.2f} ms"
     return _METRICS_FOOTER.format(latency=latency)
+
+
+def _traces_panel(limit: int = 5) -> str:
+    """The "slow traces" panel: span waterfalls for this process's
+    slowest retained traces (obs/trace.py reservoir), each span a
+    proportional inline bar — the visual twin of ``pio trace --slowest``.
+    Empty-state text when tracing is off or nothing is retained yet; in
+    a split deployment the panel covers only THIS process's spans (use
+    `pio trace --url` against the gateway for the serving fleet)."""
+    if not trace.trace_enabled():
+        return "<h2>Slow traces</h2><p>Tracing is off (PIO_TRACE=off).</p>"
+    docs = trace.TRACER.traces(limit=limit)["slowest"]
+    if not docs:
+        return ("<h2>Slow traces</h2><p>No traces retained yet "
+                "(<code>GET /debug/traces</code>).</p>")
+    blocks = []
+    for doc in docs[:limit]:
+        total = max(doc["durationMs"], 1e-6)
+        rows = []
+        for s in trace.waterfall_rows(doc):
+            left = min(s["offsetMs"] / total * 100.0, 99.0)
+            width = max(min(s["durationMs"] / total * 100.0, 100.0 - left),
+                        0.5)
+            attrs = ", ".join(
+                f"{html.escape(str(k))}={html.escape(str(v))}"
+                for k, v in (s.get("attrs") or {}).items())
+            events = " ".join(
+                f"&#9679;{html.escape(ev['name'])}@{ev['offsetMs']:.1f}ms"
+                for ev in s.get("events") or ())
+            # class-tagged rows: the evaluation table's plain <tr> rows
+            # stay countable/scrapable on their own
+            rows.append(
+                f"<tr class='trace-span'>"
+                f"<td style='padding-left:{s['depth'] * 14 + 4}px'>"
+                f"{html.escape(s['name'])}</td>"
+                f"<td>{s['durationMs']:.2f} ms</td>"
+                f"<td style='width:50%'><div style='margin-left:{left:.1f}%;"
+                f"width:{width:.1f}%;background:#69c;height:10px'></div>"
+                f"</td><td>{attrs} {events}</td></tr>"
+            )
+        blocks.append(
+            f"<h3>trace <code>{html.escape(doc['traceId'])}</code> — "
+            f"{doc['durationMs']:.2f} ms, {len(doc['spans'])} span(s), "
+            f"{html.escape(doc['startTime'])}</h3>"
+            f"<table>{''.join(rows)}</table>"
+        )
+    return ("<h2>Slow traces</h2><p>Slowest retained traces in this "
+            "process (<code>/debug/traces</code>, <code>pio trace</code>)."
+            "</p>" + "".join(blocks))
 
 _ROW = ("<tr><td>{id}</td><td>{start}</td><td>{end}</td><td>{cls}</td>"
         "<td>{gen}</td><td>{batch}</td><td>{result}</td>"
@@ -87,7 +137,8 @@ def build_router() -> Router:
             for i in instances
         )
         return 200, RawResponse(_PAGE.format(
-            count=len(instances), rows=rows, metrics=_metrics_footer()))
+            count=len(instances), rows=rows, metrics=_metrics_footer(),
+            traces=_traces_panel()))
 
     def _get(request: Request, running: bool = False) -> EvaluationInstance:
         iid = request.path_params["instance_id"]
@@ -125,4 +176,4 @@ def build_router() -> Router:
 def create_dashboard(ip: str = "0.0.0.0", port: int = 9000) -> AppServer:
     """ref: Dashboard.scala:36-141 (port 9000 default at :35)."""
     return AppServer(build_router(), host=ip, port=port,
-                     server_name="dashboard")
+                     server_name="dashboard", traced=False)

@@ -25,10 +25,17 @@ from predictionio_tpu.obs import (
     REQUEST_ID_HEADER,
     ensure_request_id,
     request_id_var,
+    trace,
 )
 from predictionio_tpu.obs.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
+
+#: Monitoring routes never open server spans: a Prometheus scrape or a
+#: trace-browser request is often slower than a cached query hit, and
+#: tracing them would let scrape traffic crowd real requests out of the
+#: slowest-N reservoir (and the recent ring) it exists to render.
+UNTRACED_PATHS = frozenset({"/metrics", "/debug/traces"})
 
 # Per-server HTTP telemetry, shared by every AppServer in the process
 # (the ``server`` label separates event/query/admin/dashboard traffic).
@@ -274,18 +281,23 @@ class AppServer:
 
     def __init__(self, router: Router, host: str = "0.0.0.0",
                  port: int = 8000, reuse_port: bool = False,
-                 server_name: str = "app"):
+                 server_name: str = "app", traced: bool = True):
         self.router = router
         self.host = host
         self.port = port
         self.reuse_port = reuse_port
         self.server_name = server_name
+        #: False = never open server spans (the dashboard: a pure
+        #: observability surface must not compete with the traffic it
+        #: renders for ring/reservoir slots)
+        self.traced = traced
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     def _make_handler(self):
         router = self.router
         server_name = self.server_name
+        traced = self.traced
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -439,49 +451,76 @@ class AppServer:
                 # the feedback loop can pick it up without plumbing
                 rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
                 rid_token = request_id_var.set(rid)
+                # server span per request: the trace id IS the request
+                # id, the remote parent rides X-Parent-Span, and the
+                # caller's sampling decision rides X-Trace-Sampled (so a
+                # gateway-sampled query is also sampled at its replica).
+                # With PIO_TRACE=off this is the shared no-op object —
+                # no allocation, no lock. Monitoring routes never trace
+                # themselves: a 15s /metrics scrape is slower than a
+                # cached query hit and would crowd real traffic out of
+                # the slowest-N reservoir the feature exists to surface.
+                if not traced or path in UNTRACED_PATHS:
+                    sp = trace.NOOP
+                else:
+                    sp = trace.server_span(
+                        server_name, rid,
+                        self.headers.get(trace.SAMPLED_HEADER),
+                        self.headers.get(trace.PARENT_SPAN_HEADER),
+                    )
                 try:
-                    try:
-                        status, payload = router.dispatch(request)
-                    except HTTPError as e:
-                        status, payload = e.status, {"message": e.message}
-                    except json.JSONDecodeError as e:
-                        # includes invalid UTF-8 bodies: Request.json()
-                        # translates UnicodeDecodeError to this class
-                        status, payload = 400, {"message": f"Invalid JSON: {e}"}
-                    except Exception as e:  # last-resort 500, mirror exceptionHandler
-                        logger.exception("handler error")
-                        status, payload = 500, {"message": str(e)}
-                    if isinstance(payload, RawResponse):
-                        data = (
-                            payload.body.encode("utf-8")
-                            if isinstance(payload.body, str)
-                            else payload.body
-                        )
-                        content_type = payload.content_type
-                    else:
-                        data = json.dumps(payload).encode("utf-8")
-                        content_type = "application/json; charset=UTF-8"
-                    # ONE buffer, ONE sendall: status line + headers + body (the
-                    # stdlib send_response/send_header path flushes headers and
-                    # body as separate writes — two syscalls and TCP segments
-                    # per response; measured ~25% of server CPU on ingest)
-                    phrase = self.responses.get(status, ("", ""))[0]
-                    resp = (
-                        f"HTTP/1.1 {status} {phrase}\r\n"
-                        f"Server: {self.version_string()}\r\n"
-                        f"Date: {_http_date(time.time())}\r\n"
-                        f"{REQUEST_ID_HEADER}: {rid}\r\n"
-                        f"Content-Type: {content_type}\r\n"
-                        f"Content-Length: {len(data)}\r\n\r\n"
-                    ).encode("iso-8859-1") + data
-                    self.wfile.write(resp)
-                    _HTTP_REQUESTS.inc(
-                        server=server_name, status=str(status))
-                    _HTTP_SECONDS.observe(
-                        time.perf_counter() - t0, server=server_name)
-                    # log while the contextvar still holds the id, so the
-                    # access-log record carries %(request_id)s
-                    self.log_request(status, len(data))
+                    with sp:
+                        if sp.sampled:
+                            sp.set_attr("method", self.command)
+                            sp.set_attr("path", path)
+                        try:
+                            status, payload = router.dispatch(request)
+                        except HTTPError as e:
+                            status, payload = e.status, {"message": e.message}
+                        except json.JSONDecodeError as e:
+                            # includes invalid UTF-8 bodies: Request.json()
+                            # translates UnicodeDecodeError to this class
+                            status, payload = 400, {"message": f"Invalid JSON: {e}"}
+                        except Exception as e:  # last-resort 500, mirror exceptionHandler
+                            logger.exception("handler error")
+                            status, payload = 500, {"message": str(e)}
+                        if isinstance(payload, RawResponse):
+                            data = (
+                                payload.body.encode("utf-8")
+                                if isinstance(payload.body, str)
+                                else payload.body
+                            )
+                            content_type = payload.content_type
+                        else:
+                            data = json.dumps(payload).encode("utf-8")
+                            content_type = "application/json; charset=UTF-8"
+                        # ONE buffer, ONE sendall: status line + headers + body (the
+                        # stdlib send_response/send_header path flushes headers and
+                        # body as separate writes — two syscalls and TCP segments
+                        # per response; measured ~25% of server CPU on ingest)
+                        phrase = self.responses.get(status, ("", ""))[0]
+                        if sp.sampled:
+                            sp.set_attr("status", status)
+                            tr_hdr = f"{trace.SAMPLED_HEADER}: 1\r\n"
+                        else:  # untraced responses are byte-identical
+                            tr_hdr = ""  # to the pre-tracing format
+                        resp = (
+                            f"HTTP/1.1 {status} {phrase}\r\n"
+                            f"Server: {self.version_string()}\r\n"
+                            f"Date: {_http_date(time.time())}\r\n"
+                            f"{REQUEST_ID_HEADER}: {rid}\r\n"
+                            f"{tr_hdr}"
+                            f"Content-Type: {content_type}\r\n"
+                            f"Content-Length: {len(data)}\r\n\r\n"
+                        ).encode("iso-8859-1") + data
+                        self.wfile.write(resp)
+                        _HTTP_REQUESTS.inc(
+                            server=server_name, status=str(status))
+                        _HTTP_SECONDS.observe(
+                            time.perf_counter() - t0, server=server_name)
+                        # log while the contextvar still holds the id, so the
+                        # access-log record carries %(request_id)s
+                        self.log_request(status, len(data))
                 finally:
                     request_id_var.reset(rid_token)
 
@@ -524,21 +563,53 @@ class AppServer:
 
 #: Prometheus text exposition content type (format 0.0.4).
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 def add_metrics_route(router: Router,
                       registry: MetricsRegistry = REGISTRY) -> Router:
-    """Mount ``GET /metrics`` (Prometheus text format) on ``router``.
+    """Mount ``GET /metrics`` (Prometheus text format) and
+    ``GET /debug/traces`` (recent + slowest span timelines, JSON) on
+    ``router``.
 
-    Shared by the event server, query server, admin API, and dashboard
-    so every process exposes the same scrape surface. Unauthenticated by
-    design, like the reference's status pages: the payload is aggregate
-    numbers, and scrapers don't carry app access keys."""
+    Shared by the event server, query server, gateway, admin API, and
+    dashboard so every process exposes the same scrape-and-debug
+    surface. Unauthenticated by design, like the reference's status
+    pages: the payload is aggregate numbers and timing structure;
+    scrapers don't carry app access keys."""
 
     def metrics(request: Request):
+        # content negotiation: histogram trace-id exemplars are legal
+        # ONLY in OpenMetrics (the classic 0.0.4 parser hard-fails on
+        # the `# {...}` suffix, losing the whole scrape), so they ride
+        # only when the scraper asks for application/openmetrics-text —
+        # exactly how Prometheus itself gates exemplar ingestion
+        accept = next((v for k, v in request.headers.items()
+                       if k.lower() == "accept"), "")
+        if "application/openmetrics-text" in accept:
+            return 200, RawResponse(registry.expose(openmetrics=True),
+                                    OPENMETRICS_CONTENT_TYPE)
         return 200, RawResponse(registry.expose(), METRICS_CONTENT_TYPE)
 
+    def debug_traces(request: Request):
+        if not trace.trace_enabled():
+            # tracing off must look exactly like the feature not being
+            # there (404, same as an unrouted path)
+            raise HTTPError(404, "tracing disabled (PIO_TRACE=off)")
+        try:
+            min_ms = float(request.query.get("min_ms", 0.0))
+            limit = int(request.query.get("limit", 50))
+        except ValueError as e:
+            raise HTTPError(400, f"bad filter: {e}") from e
+        return 200, trace.TRACER.traces(
+            min_duration_ms=min_ms,
+            trace_id=request.query.get("request_id"),
+            limit=limit,
+        )
+
     router.add("GET", "/metrics", metrics)
+    router.add("GET", "/debug/traces", debug_traces)
     return router
 
 

@@ -23,7 +23,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Sequence
 
-from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs import REGISTRY, trace
 from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 
 __all__ = ["MicroBatcher"]
@@ -69,6 +69,13 @@ class MicroBatcher:
         self.batch_count = 0
         self.request_count = 0
         self.max_batch_seen = 0
+        #: Set by process_batch before it returns: ``[(stage, start,
+        #: duration), ...]`` perf_counter marks for the shared device
+        #: stages of the batch it just ran (create_server fills predict/
+        #: serve). The consumer replays them as one retro span per rider
+        #: — every request on the batch gets its own predict/serve spans
+        #: even though the device call happened once.
+        self.last_stage_marks: list[tuple[str, float, float]] | None = None
         self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
         self._thread.start()
 
@@ -76,7 +83,10 @@ class MicroBatcher:
         """Block until the consumer thread has processed ``item``; returns
         its result or re-raises its exception in the caller thread."""
         f: Future = Future()
-        self._q.put((item, f, time.perf_counter()))
+        # trace handle of the submitting request (None when untraced):
+        # the consumer thread records this rider's queue_wait/predict/
+        # serve spans against it — contextvars don't cross the queue
+        self._q.put((item, f, time.perf_counter(), trace.capture()))
         return f.result()
 
     def _loop(self) -> None:
@@ -90,16 +100,33 @@ class MicroBatcher:
             drained = time.perf_counter()
             items = [p[0] for p in pairs]
             futures = [p[1] for p in pairs]
-            for _, _, submitted in pairs:
+            batch_id = self.batch_count
+            # the shared batch execution runs as a child span of the
+            # FIRST traced rider: the consumer thread has no request
+            # context of its own, and without an active span here the
+            # predict/serve stage histograms could never stamp
+            # trace-id exemplars (nor xla_compile events) for batched
+            # traffic. One representative trace carries the shared
+            # span; every rider still gets its own retro stage spans.
+            lead_ctx = next(
+                (p[3] for p in pairs if p[3] is not None), None)
+            for _, _, submitted, ctx in pairs:
                 QUERY_STAGE_SECONDS.observe(drained - submitted,
                                             stage="queue_wait")
+                trace.record_span(ctx, "queue_wait", submitted,
+                                  drained - submitted, batch_id=batch_id,
+                                  batch_size=len(pairs))
             _BATCH_SIZE.observe(float(len(pairs)))
             _QUEUE_DEPTH.set(self._q.qsize())
             self.batch_count += 1
             self.request_count += len(items)
             self.max_batch_seen = max(self.max_batch_seen, len(items))
+            self.last_stage_marks = None
             try:
-                results = self._process(items)
+                with trace.child_span(lead_ctx, "batch",
+                                      batch_id=batch_id,
+                                      batch_size=len(pairs)):
+                    results = self._process(items)
                 if len(results) != len(items):
                     raise RuntimeError(
                         f"process_batch returned {len(results)} results "
@@ -109,6 +136,15 @@ class MicroBatcher:
                 for f in futures:
                     f.set_exception(e)
                 continue
+            # replay the batch's shared stage marks as one retro span
+            # per rider BEFORE releasing the futures, so a rider's trace
+            # can't commit while its spans are still being written
+            marks = self.last_stage_marks or ()
+            for stage, start, duration in marks:
+                for _, _, _, ctx in pairs:
+                    trace.record_span(ctx, stage, start, duration,
+                                      batch_id=batch_id,
+                                      batch_size=len(pairs))
             for f, r in zip(futures, results):
                 if isinstance(r, Exception):
                     f.set_exception(r)

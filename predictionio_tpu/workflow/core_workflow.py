@@ -36,7 +36,7 @@ def run_train(
     """Train → persist models → mark instance COMPLETED
     (ref: CoreWorkflow.runTrain:42-99). Returns the instance id.
     ``trace_dir`` wraps training in a JAX device trace (xprof)."""
-    from predictionio_tpu.obs import REGISTRY
+    from predictionio_tpu.obs import REGISTRY, trace
     from predictionio_tpu.obs.jax_hooks import (
         install_jax_compile_hook,
         jax_compile_stats,
@@ -52,25 +52,34 @@ def run_train(
     try:
         ctx = workflow_context(batch=wp.batch, mode="Training")
         timer = PhaseTimer()
+        # one trace per train run, phases as child spans: the same
+        # waterfall surface as a slow query, with the run's XLA compile
+        # deltas landing as xla_compile events (obs/jax_hooks.py) and
+        # the dense-ALS transfer pipeline's pack/upload/readback spans
+        # (io/transfer.py) nested under the train phase
         try:
-            with device_trace(trace_dir), timer.phase("train"):
-                models = engine.train(ctx, engine_params, wp)
-            # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
-            with timer.phase("persist"):
-                algorithms = engine._algorithms(engine_params)
-                persisted = []
-                for algo, model in zip(algorithms, models):
-                    p = algo.make_persistent_model(ctx, instance_id, model)
-                    if isinstance(p, PersistentModel):
-                        saved = p.save(instance_id, None)
-                        p = (
-                            PersistentModelManifest(class_path(type(p)))
-                            if saved
-                            else model
-                        )
-                    persisted.append(p)
-                blob = serialize_models(persisted)
-                Storage.get_model_data_models().insert(Model(instance_id, blob))
+            with trace.span("run_train", instance=instance_id):
+                with device_trace(trace_dir), timer.phase("train"), \
+                        trace.span("train"):
+                    models = engine.train(ctx, engine_params, wp)
+                # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
+                with timer.phase("persist"), trace.span("persist"):
+                    algorithms = engine._algorithms(engine_params)
+                    persisted = []
+                    for algo, model in zip(algorithms, models):
+                        p = algo.make_persistent_model(
+                            ctx, instance_id, model)
+                        if isinstance(p, PersistentModel):
+                            saved = p.save(instance_id, None)
+                            p = (
+                                PersistentModelManifest(class_path(type(p)))
+                                if saved
+                                else model
+                            )
+                        persisted.append(p)
+                    blob = serialize_models(persisted)
+                    Storage.get_model_data_models().insert(
+                        Model(instance_id, blob))
         finally:
             # report in a finally so a persist-stage failure still logs
             # where the (possibly hours-long) train spent its time

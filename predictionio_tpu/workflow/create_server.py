@@ -31,7 +31,12 @@ import html
 from predictionio_tpu.core.engine import Engine, EngineParams, WorkflowParams
 from predictionio_tpu.core.persistent_model import deserialize_models
 from predictionio_tpu.data.storage import Storage
-from predictionio_tpu.obs import REGISTRY, REQUEST_ID_HEADER, current_request_id
+from predictionio_tpu.obs import (
+    REGISTRY,
+    REQUEST_ID_HEADER,
+    current_request_id,
+    trace,
+)
 from predictionio_tpu.utils.http import (
     AppServer,
     HTTPError,
@@ -301,12 +306,14 @@ class QueryService:
                 "lastServingSec": round(self.last_serving_sec, 6),
             }
         # top-line latency quantiles over THIS service's lifetime, from
-        # the log-bucketed histogram (no per-sample storage behind them)
+        # the log-bucketed histogram (no per-sample storage behind them).
+        # Always-present keys: an empty observation window reports an
+        # explicit JSON null, never NaN and never a missing key —
+        # /stats.json-style consumers parse the same shape pre-traffic
         p50 = _QUERY_SECONDS.quantile_since(0.5, self._latency_baseline)
         p99 = _QUERY_SECONDS.quantile_since(0.99, self._latency_baseline)
-        if p50 is not None and p99 is not None:
-            body["p50ServingSec"] = round(p50, 6)
-            body["p99ServingSec"] = round(p99, 6)
+        body["p50ServingSec"] = round(p50, 6) if p50 is not None else None
+        body["p99ServingSec"] = round(p99, 6) if p99 is not None else None
         if self.batcher is not None:
             body["batching"] = {
                 "batches": self.batcher.batch_count,
@@ -405,7 +412,7 @@ class QueryService:
         t0 = time.perf_counter()
         _QUERY_REQUESTS.inc()
         try:
-            with _STAGE_SECONDS.time(stage="parse"):
+            with _STAGE_SECONDS.time(stage="parse"), trace.span("parse"):
                 data = request.json()
                 if not isinstance(data, dict):
                     self._count_error("bad_request")
@@ -432,16 +439,21 @@ class QueryService:
             raise
         try:
             if self.batcher is not None:
+                # queue_wait/predict/serve spans for this rider are
+                # recorded retroactively by the batcher consumer (one
+                # span per rider, batch-id attribute)
                 prediction = self.batcher.submit(query)
                 self._maybe_warm_batch_shapes(query)
             else:
-                with _STAGE_SECONDS.time(stage="predict"):
+                with _STAGE_SECONDS.time(stage="predict"), \
+                        trace.span("predict"):
                     supplemented = serving.supplement(query)
                     predictions = [
                         algo.predict(model, supplemented)
                         for algo, model in zip(algorithms, models)
                     ]
-                with _STAGE_SECONDS.time(stage="serve"):
+                with _STAGE_SECONDS.time(stage="serve"), \
+                        trace.span("serve"):
                     prediction = serving.serve(query, predictions)
         except Exception:
             # the paths that used to bypass all bookkeeping: a raised
@@ -463,7 +475,8 @@ class QueryService:
                 logger.exception("output sniffer failed")
         pr_id = None
         if self.config.feedback:
-            with _STAGE_SECONDS.time(stage="feedback"):
+            with _STAGE_SECONDS.time(stage="feedback"), \
+                    trace.span("feedback"):
                 pr_id = self._send_feedback(data, result)
             if pr_id is not None and isinstance(result, dict):
                 result = {**result, "prId": pr_id}
@@ -528,6 +541,13 @@ class QueryService:
             out = []
             for q in queries:
                 out.extend(self._predict_batch([q]))
+            if self.batcher is not None:
+                # every singleton re-run above overwrote the shared
+                # stage marks with ITS timings; replaying the last one
+                # against all riders would stamp wrong predict/serve
+                # spans on every other trace — on this error-burst path
+                # riders keep queue_wait + error attrs only
+                self.batcher.last_stage_marks = None
             return out
 
     def _predict_batch_shared(self, queries: list) -> list:
@@ -571,7 +591,8 @@ class QueryService:
                     per_algo.append(
                         [algo.predict(model, q) for q in supplemented[:n]]
                     )
-            _observe_stage("predict", time.perf_counter() - t_pred, times=n)
+            pred_s = time.perf_counter() - t_pred
+            _observe_stage("predict", pred_s, times=n)
         out: list = []
         t_serve = time.perf_counter()
         for i, query in enumerate(queries):
@@ -580,7 +601,15 @@ class QueryService:
                     serving.serve(query, [pa[i] for pa in per_algo]))
             except Exception as e:  # noqa: BLE001 — isolate per-request
                 out.append(e)
-        _observe_stage("serve", time.perf_counter() - t_serve, times=n)
+        serve_s = time.perf_counter() - t_serve
+        _observe_stage("serve", serve_s, times=n)
+        # hand the shared stage timings to the batcher, which replays
+        # them as per-rider trace spans (warmup replays are synthetic
+        # traffic and must not be attributed to any rider)
+        if self.batcher is not None and \
+                not getattr(_warmup_thread, "active", False):
+            self.batcher.last_stage_marks = [
+                ("predict", t_pred, pred_s), ("serve", t_serve, serve_s)]
         return out
 
     def _send_feedback(self, query_json: dict, result) -> str | None:
@@ -599,6 +628,8 @@ class QueryService:
         if rid:
             properties["requestId"] = rid
             headers[REQUEST_ID_HEADER] = rid
+        # the event server's ingest span joins this query's trace
+        trace.inject_headers(headers)
         event = {
             "event": "predict",
             "entityType": "pio_pr",

@@ -15,9 +15,9 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def _dry_run_doc(script: str, expected_metric: str) -> dict:
+def _dry_run_doc(script: str, expected_metric: str, *extra_args) -> dict:
     proc = subprocess.run(
-        [sys.executable, str(REPO_ROOT / script), "--dry-run"],
+        [sys.executable, str(REPO_ROOT / script), "--dry-run", *extra_args],
         capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
@@ -43,3 +43,21 @@ def test_sweep_bench_dry_run_last_stdout_line_is_the_headline_json():
     parseable headline JSON, stray prints on stderr."""
     doc = _dry_run_doc("bench_sweep.py", "ml100k_sweep_candidates_per_sec")
     assert doc["unit"] == "candidates/s"
+
+
+def test_serving_bench_dry_run_last_stdout_line_is_the_headline_json():
+    """bench_serving.py joined the stdout contract in ISSUE 5 (it used
+    to print a bare section dict): final line = parseable headline JSON
+    whose extra carries the tracing-overhead guard figure."""
+    doc = _dry_run_doc("bench_serving.py", "ml100k_rest_predict_p50_ms")
+    assert doc["unit"] == "ms"
+    # the tracing-off overhead guard figure must always ride the headline
+    assert "trace_overhead_frac" in doc["extra"]
+
+
+def test_serving_bench_gateway_dry_run_uses_gateway_metric_name():
+    """--gateway --dry-run must emit the gateway series name — the
+    distinct name exists so capture tooling never charts the
+    gateway-fronted and direct-replica topologies as one series."""
+    _dry_run_doc("bench_serving.py", "ml100k_gateway_predict_p50_ms",
+                 "--gateway")

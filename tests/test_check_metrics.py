@@ -1,0 +1,101 @@
+"""Metric/doc drift checker (tools/check_metrics.py): the tier-1 wiring
+that makes docs/operations.md § Monitoring an enforced contract, plus
+unit coverage of the parsing pieces on a synthetic tree."""
+
+from pathlib import Path
+
+from predictionio_tpu.tools.check_metrics import (
+    check,
+    declared_metrics,
+    documented_metrics,
+    expand_braces,
+)
+
+
+def test_repo_metrics_and_docs_are_in_sync():
+    """THE guard: every declared pio_* metric has a docs row, every
+    documented name is still declared, and no name is declared at two
+    call sites."""
+    assert check() == []
+
+
+def test_expand_braces():
+    assert expand_braces("pio_x_total") == ["pio_x_total"]
+    assert expand_braces("pio_cache_{hits,misses}_total") == [
+        "pio_cache_hits_total", "pio_cache_misses_total"]
+
+
+def _write_tree(root: Path, sources: dict[str, str], doc: str) -> None:
+    pkg = root / "predictionio_tpu"
+    pkg.mkdir()
+    for name, text in sources.items():
+        (pkg / name).write_text(text)
+    (root / "docs").mkdir()
+    (root / "docs" / "operations.md").write_text(doc)
+
+
+def test_duplicate_declaration_flagged(tmp_path):
+    _write_tree(
+        tmp_path,
+        {
+            "a.py": 'X = REGISTRY.counter(\n    "pio_dup_total", "h")\n',
+            "b.py": 'Y = REGISTRY.counter("pio_dup_total", "h")\n',
+        },
+        "| `pio_dup_total` | counter | dup |\n",
+    )
+    problems = check(tmp_path)
+    assert len(problems) == 1
+    assert "2 call sites" in problems[0] and "pio_dup_total" in problems[0]
+
+
+def test_undocumented_and_stale_names_flagged(tmp_path):
+    _write_tree(
+        tmp_path,
+        {"a.py": 'X = r.gauge("pio_real_depth")\n'},
+        "| `pio_ghost_total` | counter | gone |\n",
+    )
+    problems = check(tmp_path)
+    assert any("pio_real_depth" in p and "missing from" in p
+               for p in problems)
+    assert any("pio_ghost_total" in p and "no longer declared" in p
+               for p in problems)
+
+
+def test_derived_histogram_series_are_not_stale(tmp_path):
+    """A PromQL example using `_bucket`/`_sum`/`_count` series documents
+    the base histogram, not a phantom metric."""
+    _write_tree(
+        tmp_path,
+        {"a.py": 'H = r.histogram("pio_lat_seconds", "h")\n'},
+        "`pio_lat_seconds` and rate(pio_lat_seconds_bucket[5m]) "
+        "with pio_lat_seconds_sum / pio_lat_seconds_count\n",
+    )
+    assert check(tmp_path) == []
+
+
+def test_documented_metrics_parses_tables_prose_and_fences(tmp_path):
+    doc = tmp_path / "ops.md"
+    doc.write_text(
+        "| `pio_a_total` | counter |\n"
+        "prose mentions `pio_b_seconds` here, the `pio_c_*` family\n"
+        "```promql\nrate(pio_d_total[5m])\n```\n"
+        "and `pio_e_{x,y}_total` shorthand\n"
+    )
+    names = documented_metrics(doc)
+    assert names == {"pio_a_total", "pio_b_seconds", "pio_d_total",
+                     "pio_e_x_total", "pio_e_y_total"}
+
+
+def test_declared_metrics_finds_multiline_calls(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "A = REGISTRY.histogram(\n"
+        '    "pio_multi_seconds",\n'
+        '    "help",\n'
+        ")\n"
+        'B = private.counter("pio_inline_total")\n'
+    )
+    got = declared_metrics(pkg)
+    assert set(got) == {"pio_multi_seconds", "pio_inline_total"}
+    assert got["pio_multi_seconds"] == ["pkg/m.py:1"]

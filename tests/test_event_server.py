@@ -619,7 +619,9 @@ def test_metrics_endpoint_prometheus_scrape(server):
     sample = _re.compile(
         r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
         r"(-?[0-9.e+-]+|\+Inf|NaN)$"
-    )
+    )  # strict: the DEFAULT scrape must never carry exemplar suffixes
+    # (they're a parse error for the classic 0.0.4 parser; exemplars
+    # ride only the negotiated OpenMetrics content type)
     for line in text.splitlines():
         if line.startswith("#"):
             continue

@@ -24,12 +24,16 @@ from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 NAME_RE = re.compile(r"^pio(_[a-z0-9]+)+$")
 
 # One line of Prometheus text format 0.0.4: comment, or
-# name[{labels}] value — the format a scraper must be able to parse.
+# name[{labels}] value — plus the optional OpenMetrics exemplar suffix
+# histogram bucket lines may carry (`# {trace_id="..."} value`) — the
+# format a scraper must be able to parse.
+_LABEL_VALUE = r'"(?:[^"\\\n]|\\.)*"'  # escaped quotes/backslashes legal
 SAMPLE_RE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'  # first label
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
-    r" (-?[0-9.e+-]+|\+Inf|-Inf|NaN)$"
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"                    # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VALUE +  # first label
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=" + _LABEL_VALUE + r")*\})?"
+    r" (-?[0-9.e+-]+|\+Inf|-Inf|NaN)"
+    r"( # \{trace_id=" + _LABEL_VALUE + r"\} -?[0-9.e+-]+)?$"
 )
 
 
@@ -187,6 +191,29 @@ def test_exposition_line_format():
     assert "# TYPE pio_fmt_seconds histogram" in text
 
 
+def test_openmetrics_counter_family_drops_total_suffix():
+    """OpenMetrics names a counter FAMILY without ``_total`` (the
+    sample keeps it); announcing ``# TYPE pio_x_total counter`` is a
+    "clashing name" hard error in the reference parser that would fail
+    the whole negotiated scrape — the only one carrying exemplars.
+    Classic 0.0.4 exposition keeps the full name."""
+    r = MetricsRegistry()
+    r.counter("pio_fam_total", "requests").inc()
+    om = r.expose(openmetrics=True)
+    assert "# TYPE pio_fam counter" in om
+    assert "# TYPE pio_fam_total" not in om
+    assert "\npio_fam_total 1" in om  # the sample keeps the suffix
+    classic = r.expose()
+    assert "# TYPE pio_fam_total counter" in classic
+    # reference-parser round trip when available in the environment
+    try:
+        from prometheus_client.openmetrics import parser
+    except ImportError:
+        return
+    assert "pio_fam" in {f.name for f
+                         in parser.text_string_to_metric_families(om)}
+
+
 def test_exposition_bucket_counts_are_cumulative():
     r = MetricsRegistry()
     h = r.histogram("pio_cum_seconds", buckets=(0.001, 0.01, 0.1))
@@ -204,6 +231,59 @@ def test_label_value_escaping():
     c.inc(path='we"ird\\pa\nth')
     text = r.expose()
     assert 'path="we\\"ird\\\\pa\\nth"' in text
+
+
+def test_hostile_server_name_label_survives_exposition():
+    """Regression (ISSUE 5 satellite): a hostile ``server_name`` — the
+    one label value that flows straight from operator CLI input into
+    every ``pio_http_*`` sample — must come out escaped per the
+    exposition format (backslash, double-quote, newline) and every
+    emitted line must stay single-line parseable."""
+    r = MetricsRegistry()
+    hostile = 'q\\r0"\ninjected_metric 1'
+    c = r.counter("pio_http_test_total", "by server",
+                  labels=("server", "status"))
+    c.inc(server=hostile, status="200")
+    h = r.histogram("pio_http_test_seconds", labels=("server",))
+    h.observe(0.005, server=hostile)
+    text = r.expose()
+    assert 'server="q\\\\r0\\"\\ninjected_metric 1"' in text
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert SAMPLE_RE.match(line), f"unparseable sample line: {line!r}"
+    # the raw newline must NOT have produced a forged sample line
+    assert not any(line.startswith("injected_metric")
+                   for line in text.splitlines())
+
+
+def test_help_text_escaping():
+    """HELP text with backslashes/newlines must stay one line (format
+    rule: ``\\`` and ``\\n`` escaped in HELP)."""
+    r = MetricsRegistry()
+    r.counter("pio_help_total", "line one\nline two \\ slash")
+    text = r.expose()
+    assert "# HELP pio_help_total line one\\nline two \\\\ slash" in text
+    assert "\nline two" not in text
+
+
+def test_quantile_since_empty_window_is_none_never_nan():
+    """An empty observation window must report "no data" (None → JSON
+    null), never NaN — NaN is invalid JSON and breaks /stats.json-style
+    consumers (ISSUE 5 satellite)."""
+    import json as _json
+
+    r = MetricsRegistry()
+    h = r.histogram("pio_empty_seconds")
+    baseline = h.state()
+    assert h.quantile_since(0.5, baseline) is None
+    h.observe(0.01)
+    captured = h.state()
+    # window captured AFTER traffic, nothing since: still None
+    assert h.quantile_since(0.99, captured) is None
+    v = h.quantile_since(0.5, baseline)
+    assert v is not None and v == v  # a real number once data exists
+    _json.dumps({"p50": h.quantile_since(0.5, captured)})  # null-safe
 
 
 # -- naming convention guard (scrape stability across PRs) -------------------
@@ -262,7 +342,11 @@ def test_all_registered_metric_names_follow_convention():
                      # device-batched sweep scrape surface (ISSUE 4)
                      "pio_sweep_stage_seconds",
                      "pio_sweep_candidates_per_bucket",
-                     "pio_sweep_candidates_total"):
+                     "pio_sweep_candidates_total",
+                     # request-tracing scrape surface (ISSUE 5)
+                     "pio_trace_spans_total",
+                     "pio_trace_traces_total",
+                     "pio_trace_ring_entries"):
         assert required in names
 
 

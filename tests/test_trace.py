@@ -1,0 +1,686 @@
+"""Request tracing (obs/trace.py): span mechanics, sampling modes,
+ring/reservoir retention, HTTP propagation (gateway → replica over
+FakeReplica), gateway events, micro-batcher rider spans, histogram
+exemplars, /debug/traces, and the pio trace CLI.
+
+The off-path guarantee is structural here (span() returns the ONE
+shared no-op object) and quantitative in bench_serving.py
+(``trace_overhead_frac``)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import trace
+from predictionio_tpu.obs.metrics import MetricsRegistry, set_exemplar_hook
+from predictionio_tpu.utils.http import (
+    AppServer,
+    Router,
+    add_metrics_route,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer(monkeypatch):
+    """Deterministic sampling per test + a clean retention state."""
+    monkeypatch.setenv("PIO_TRACE", "all")
+    trace.TRACER.reset()
+    yield
+    trace.TRACER.reset()
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"null")
+
+
+def _wait_trace(trace_id, timeout=5.0):
+    """Commit happens just after the response is written — poll for the
+    finished trace instead of racing the handler thread's last µs."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        doc = trace.TRACER.find(trace_id)
+        if doc is not None:
+            return doc
+        time.sleep(0.01)
+    raise AssertionError(f"trace {trace_id} never committed")
+
+
+# -- core span mechanics ------------------------------------------------------
+
+
+def test_off_mode_span_is_the_shared_noop(monkeypatch):
+    monkeypatch.setenv("PIO_TRACE", "off")
+    assert trace.span("anything") is trace.NOOP
+    assert trace.child_span(None, "x") is trace.NOOP
+    assert trace.capture() is None
+    assert trace.current_trace_id() is None
+    trace.add_event("ignored")  # must not raise
+    with trace.span("nested"):
+        assert trace.capture() is None
+    headers = {}
+    trace.inject_headers(headers)
+    assert headers == {}
+
+
+def test_span_nesting_parent_linkage_and_events():
+    with trace.span("root", kind="test") as root:
+        root.add_event("started", step=1)
+        with trace.span("child") as child:
+            assert child.trace_id == root.trace_id
+            time.sleep(0.002)
+    doc = _wait_trace(root.trace_id)
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert by_name["root"]["parentId"] is None
+    assert by_name["child"]["parentId"] == by_name["root"]["spanId"]
+    assert by_name["root"]["attrs"] == {"kind": "test"}
+    assert by_name["child"]["durationMs"] >= 2.0
+    assert by_name["root"]["durationMs"] >= by_name["child"]["durationMs"]
+    assert by_name["root"]["events"][0]["name"] == "started"
+    # ordering: offsets are monotone in start order
+    offsets = [s["offsetMs"] for s in doc["spans"]]
+    assert offsets == sorted(offsets)
+
+
+def test_attr_and_event_bounds():
+    with trace.span("root") as sp:
+        for i in range(trace.MAX_ATTRS_PER_SPAN + 10):
+            sp.set_attr(f"k{i}", "x" * 1000)
+        for i in range(trace.MAX_EVENTS_PER_SPAN + 10):
+            sp.add_event(f"e{i}")
+    doc = _wait_trace(sp.trace_id)
+    root = doc["spans"][0]
+    assert len(root["attrs"]) == trace.MAX_ATTRS_PER_SPAN
+    assert len(root["events"]) == trace.MAX_EVENTS_PER_SPAN
+    assert all(len(v) <= trace.MAX_ATTR_CHARS + 1
+               for v in root["attrs"].values())
+
+
+def test_record_span_and_cross_thread_child_span():
+    done = threading.Event()
+    with trace.span("root") as root:
+        handle = trace.capture()
+
+        def work():
+            with trace.child_span(handle, "threaded", kind="hedge"):
+                time.sleep(0.001)
+            done.set()
+
+        threading.Thread(target=work).start()
+        assert done.wait(5)
+        t0 = time.perf_counter() - 0.01
+        trace.record_span(handle, "retro", t0, 0.01, batch_id=3)
+    doc = _wait_trace(root.trace_id)
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert by_name["threaded"]["parentId"] == by_name["root"]["spanId"]
+    assert by_name["retro"]["parentId"] == by_name["root"]["spanId"]
+    assert by_name["retro"]["attrs"] == {"batch_id": 3}
+
+
+def test_ring_and_slowest_reservoir_retention():
+    tr = trace.Tracer(ring_size=4, slowest_size=2)
+    for i in range(10):
+        st = tr._state_for(f"t{i}")
+        tr._span_opened(st)
+        tr._span_closed(st, {
+            "name": "root", "spanId": f"s{i}", "parentId": None,
+            "start": st.t0_mono, "duration": i * 0.01,
+            "attrs": None, "events": None,
+        })
+    got = tr.traces(limit=50)
+    # ring: bounded, newest first
+    assert [d["traceId"] for d in got["recent"]] == \
+        ["t9", "t8", "t7", "t6"]
+    # reservoir: the two slowest EVER, slowest first, even though t5
+    # fell out of the ring long ago it would be here if slow enough
+    assert [d["traceId"] for d in got["slowest"]] == ["t9", "t8"]
+    # filters
+    assert all(d["durationMs"] >= 80.0
+               for d in tr.traces(min_duration_ms=80.0)["recent"])
+    assert [d["traceId"] for d in
+            tr.traces(trace_id="t7")["recent"]] == ["t7"]
+
+
+def test_slow_mode_keeps_only_slow_traces_in_ring(monkeypatch):
+    monkeypatch.setenv("PIO_TRACE", "slow")
+    monkeypatch.setenv("PIO_TRACE_SLOW_MS", "50")
+    with trace.span("fast") as fast:
+        pass
+    with trace.span("slow") as slow:
+        time.sleep(0.06)
+    got = trace.TRACER.traces(limit=50)
+    recent_ids = [d["traceId"] for d in got["recent"]]
+    slowest_ids = [d["traceId"] for d in got["slowest"]]
+    assert slow.trace_id in recent_ids
+    assert fast.trace_id not in recent_ids
+    # the reservoir still saw the fast trace compete (kept here because
+    # the reservoir was empty)
+    assert fast.trace_id in slowest_ids
+
+
+def test_sampled_header_decides(monkeypatch):
+    # "0" suppresses even in all mode — for the WHOLE request: nested
+    # stage spans must not start fragment traces of their own, and
+    # outbound calls propagate the suppression downstream
+    sup = trace.server_span("s", "rid-a", "0", None)
+    assert not sup.sampled
+    with sup:
+        assert trace.span("parse") is trace.NOOP
+        assert trace.capture() is None
+        assert trace.current_trace_id() is None
+        headers = {}
+        trace.inject_headers(headers)
+        assert headers == {trace.SAMPLED_HEADER: "0"}
+    assert trace.TRACER.find("rid-a") is None
+    monkeypatch.setenv("PIO_TRACE", "0.000001")
+    # probability mode: the head coin is flipped ONCE per request — an
+    # unsampled request's stage spans all see the suppressed scope
+    # instead of re-flipping per span
+    sp2 = trace.server_span("s", "rid-c", None, None)
+    assert not sp2.sampled  # p = 1e-6
+    with sp2:
+        assert trace.span("predict") is trace.NOOP
+    assert trace.TRACER.find("rid-c") is None
+    # "1" forces even at p≈0
+    sp = trace.server_span("s", "rid-b", "1", "parent123")
+    assert sp.sampled and sp.parent_id == "parent123"
+    with sp:
+        headers = {}
+        trace.inject_headers(headers)
+    assert headers[trace.SAMPLED_HEADER] == "1"
+    assert headers[trace.PARENT_SPAN_HEADER] == sp.span_id
+
+
+def test_trace_mode_numeric_edge_values(monkeypatch):
+    """Numeric PIO_TRACE outside (0,1) honors the operator's plain
+    intent (≤0 disables, ≥1 traces everything) instead of silently
+    coercing to 'slow'; unrecognizable text still falls back to the
+    default."""
+    for raw, want in (("0.0", "off"), ("-1", "off"), ("0.000", "off"),
+                      ("1.0", "all"), ("2", "all"), ("1.5", "all"),
+                      ("0.25", "0.25"), ("offf", "slow")):
+        monkeypatch.setenv("PIO_TRACE", raw)
+        assert trace.trace_mode() == want, raw
+
+
+def test_hold_keeps_trace_open_across_thread_handoff():
+    """The launching thread reserves the trace's open slot BEFORE
+    starting a worker (gateway _launch): even when the root span closes
+    first — primary answered before the hedge thread was ever
+    scheduled — the worker's span still lands before the trace
+    commits."""
+    with trace.span("root") as root:
+        handle = trace.capture()
+        held = trace.hold(handle)
+    # root closed, but the hold keeps the trace uncommitted
+    assert trace.TRACER.find(root.trace_id) is None
+    with trace.child_span(handle, "upstream", kind="hedge"):
+        pass
+    trace.release(held)
+    doc = _wait_trace(root.trace_id)
+    assert {"root", "upstream"} <= {s["name"] for s in doc["spans"]}
+    # an untraced handle holds nothing and release is None-safe
+    trace.release(trace.hold(None))
+
+
+# -- tracing off: byte-identical metrics + 404 debug endpoint ----------------
+
+
+def test_off_mode_registry_byte_identical(monkeypatch):
+    def observe_all(r):
+        h = r.histogram("pio_t_seconds", "h", labels=("stage",))
+        h.observe(0.01, stage="predict")
+        h.observe(2.0, stage="predict")
+        r.counter("pio_t_total").inc()
+        # openmetrics exposition is the one that CAN carry exemplars —
+        # off-mode must keep even it byte-identical to hook-absent
+        return r.expose(openmetrics=True)
+
+    monkeypatch.setenv("PIO_TRACE", "off")
+    with trace.span("ignored"):  # NOOP: must not produce exemplars
+        text_off = observe_all(MetricsRegistry())
+    # reference exposition with the exemplar hook physically absent
+    set_exemplar_hook(None)
+    try:
+        text_ref = observe_all(MetricsRegistry())
+    finally:
+        set_exemplar_hook(trace._exemplar)
+    assert text_off == text_ref
+    assert "# {" not in text_off
+
+
+def test_debug_traces_404_when_off(monkeypatch):
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0,
+                    server_name="t")
+    srv.start()
+    try:
+        monkeypatch.setenv("PIO_TRACE", "off")
+        status, _, body = _get(srv.port, "/debug/traces")
+        assert status == 404
+        monkeypatch.setenv("PIO_TRACE", "all")
+        status, _, body = _get(srv.port, "/debug/traces")
+        assert status == 200
+        assert set(body) >= {"mode", "recent", "slowest"}
+    finally:
+        srv.stop()
+
+
+# -- exemplars ---------------------------------------------------------------
+
+
+def test_histogram_exemplars_carry_resolvable_trace_id():
+    r = MetricsRegistry()
+    h = r.histogram("pio_ex_seconds", labels=("stage",))
+    with trace.span("root") as sp:
+        h.observe(0.004, stage="predict")
+    text = r.expose(openmetrics=True)
+    assert text.rstrip().endswith("# EOF")
+    ex_lines = [l for l in text.splitlines() if "# {" in l]
+    assert ex_lines, "no exemplar emitted"
+    assert f'# {{trace_id="{sp.trace_id}"}} 0.004' in ex_lines[0]
+    assert ex_lines[0].startswith("pio_ex_seconds_bucket")
+    # the DEFAULT (classic 0.0.4) exposition must never carry the
+    # suffix — it is a hard parse error for the classic parser, which
+    # would fail a stock Prometheus's entire scrape
+    classic = r.expose()
+    assert "# {" not in classic and "# EOF" not in classic
+    # the exemplar's trace id resolves to a retained trace — the
+    # p99-bucket → `pio trace <id>` acceptance path
+    assert _wait_trace(sp.trace_id)["traceId"] == sp.trace_id
+    # observations OUTSIDE a span leave no exemplar on their bucket
+    h.observe(100.0, stage="other")
+    inf_lines = [l for l in r.expose(openmetrics=True).splitlines()
+                 if 'stage="other"' in l and "# {" in l]
+    assert not inf_lines
+
+
+def test_metrics_route_negotiates_openmetrics_for_exemplars():
+    """/metrics serves exemplars only to a scraper that Accepts
+    application/openmetrics-text (Prometheus's exemplar negotiation);
+    everyone else gets the classic format untouched."""
+    from predictionio_tpu.obs import REGISTRY
+
+    srv = AppServer(_ok_router(), "127.0.0.1", 0, server_name="negsrv")
+    srv.start()
+    try:
+        _get(srv.port, "/ping", {"X-Request-ID": "rid-neg-1"})
+        _wait_trace("rid-neg-1")
+        # ensure at least one exemplar exists in the registry
+        assert any("# {" in l for l in
+                   REGISTRY.expose(openmetrics=True).splitlines())
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            om = resp.read().decode()
+        assert "# {" in om and om.rstrip().endswith("# EOF")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            classic = resp.read().decode()
+        assert "# {" not in classic and "# EOF" not in classic
+    finally:
+        srv.stop()
+
+
+# -- HTTP layer: server spans, response header, gateway hop ------------------
+
+
+def _ok_router():
+    r = Router()
+    r.add("GET", "/ping", lambda req: (200, {"ok": True}))
+    return add_metrics_route(r)
+
+
+def test_http_server_span_and_sampled_response_header():
+    srv = AppServer(_ok_router(), "127.0.0.1", 0, server_name="pingsrv")
+    srv.start()
+    try:
+        status, headers, _ = _get(srv.port, "/ping",
+                                  {"X-Request-ID": "rid-http-1"})
+        assert status == 200
+        assert headers.get("X-Trace-Sampled") == "1"
+        doc = _wait_trace("rid-http-1")
+        root = doc["spans"][0]
+        assert root["name"] == "pingsrv"
+        assert root["attrs"]["method"] == "GET"
+        assert root["attrs"]["path"] == "/ping"
+        assert root["attrs"]["status"] == 200
+    finally:
+        srv.stop()
+
+
+def test_monitoring_routes_do_not_trace_themselves():
+    """/metrics and /debug/traces never open server spans (scrape
+    traffic must not crowd real requests out of the ring/reservoir),
+    and a traced=False server (the dashboard) opens none at all."""
+    srv = AppServer(_ok_router(), "127.0.0.1", 0, server_name="monsrv")
+    srv.start()
+    try:
+        trace.TRACER.reset()
+        for path in ("/metrics", "/debug/traces"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}{path}",
+                headers={"X-Request-ID": f"rid-mon{path.replace('/', '-')}"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("X-Trace-Sampled") is None
+                resp.read()
+        # a real route on the same server still traces
+        _get(srv.port, "/ping", {"X-Request-ID": "rid-mon-real"})
+        _wait_trace("rid-mon-real")
+        got = trace.TRACER.traces(limit=50)
+        ids = {d["traceId"] for d in got["recent"] + got["slowest"]}
+        assert ids == {"rid-mon-real"}
+    finally:
+        srv.stop()
+    untraced = AppServer(_ok_router(), "127.0.0.1", 0,
+                         server_name="dash", traced=False)
+    untraced.start()
+    try:
+        trace.TRACER.reset()
+        status, headers, _ = _get(untraced.port, "/ping",
+                                  {"X-Request-ID": "rid-dash-1"})
+        assert status == 200
+        assert headers.get("X-Trace-Sampled") is None
+        assert trace.TRACER.find("rid-dash-1") is None
+    finally:
+        untraced.stop()
+
+
+def test_gateway_to_replica_hop_parent_linked(monkeypatch):
+    from tests.test_gateway import FakeReplica, make_gateway
+
+    a = FakeReplica("a", delay=0.005).start()
+    gw, srv = make_gateway([a])
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/queries.json",
+            data=b'{"user":"u1"}',
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": "rid-hop-1"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+        doc = _wait_trace("rid-hop-1")
+        by_name = {s["name"]: s for s in doc["spans"]}
+        # gateway server span is the root; the upstream client span
+        # parents on it; the (in-process) replica's server span parents
+        # on the upstream span via X-Parent-Span
+        gw_span = by_name["gateway"]
+        up_span = by_name["upstream"]
+        replica_span = by_name["fake"]
+        assert gw_span["parentId"] is None
+        assert up_span["parentId"] == gw_span["spanId"]
+        assert replica_span["parentId"] == up_span["spanId"]
+        assert up_span["attrs"]["kind"] == "primary"
+        assert str(a.port) in up_span["attrs"]["replica"]
+        # ordering: gateway opens first, then upstream, then replica
+        assert gw_span["offsetMs"] <= up_span["offsetMs"] \
+            <= replica_span["offsetMs"]
+        # and the replica span nests inside the upstream round trip
+        assert replica_span["durationMs"] <= up_span["durationMs"] + 1.0
+    finally:
+        gw.stop(); srv.stop(); a.stop()
+
+
+def test_gateway_cache_and_hedge_events(monkeypatch):
+    from tests.test_gateway import FakeReplica, make_gateway
+
+    slow = FakeReplica("slow", delay=0.6).start()
+    fast = FakeReplica("fast").start()
+    gw, srv = make_gateway([slow, fast], hedge=True, hedge_delay_sec=0.1,
+                           cache_ttl_sec=30.0, cache_max_entries=64)
+    try:
+        def post(rid):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/queries.json",
+                data=b'{"user":"u1"}',
+                headers={"Content-Type": "application/json",
+                         "X-Request-ID": rid},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+
+        post("rid-hedge-1")  # slow primary → hedge fires to fast
+        doc = _wait_trace("rid-hedge-1")
+        gw_events = {e["name"] for s in doc["spans"]
+                     for e in s.get("events", ()) or ()}
+        assert "hedge_fired" in gw_events
+        assert "hedge_won" in gw_events
+
+        post("rid-cache-1")  # identical query: answered from the cache
+        doc = _wait_trace("rid-cache-1")
+        events = {e["name"] for s in doc["spans"]
+                  for e in s.get("events", ()) or ()}
+        assert "cache_hit" in events
+    finally:
+        slow.delay = 0.0
+        gw.stop(); srv.stop(); slow.stop(); fast.stop()
+
+
+def test_gateway_breaker_open_event():
+    from predictionio_tpu.utils.http import free_port
+    from tests.test_gateway import FakeReplica, make_gateway
+
+    live = FakeReplica("live").start()
+    dead_port = free_port()  # nothing listening: transport failures
+    # dead replica FIRST: least-outstanding ties break by registration
+    # order, so the dead one takes the primary hit and trips its breaker
+    gw, srv = make_gateway([dead_port, live], breaker_failures=1,
+                           breaker_cooldown_sec=60.0)
+    try:
+        def post(rid):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/queries.json",
+                data=b'{"user":"u1"}',
+                headers={"Content-Type": "application/json",
+                         "X-Request-ID": rid},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+
+        # burn the dead replica's breaker (may take a couple of
+        # requests depending on which replica is picked first)
+        for i in range(4):
+            post(f"rid-burn-{i}")
+        assert any(b.state == "open" for b in gw._breakers.values())
+        post("rid-breaker-1")  # routed around the open breaker
+        doc = _wait_trace("rid-breaker-1")
+        events = {e["name"] for s in doc["spans"]
+                  for e in s.get("events", ()) or ()}
+        assert "breaker_open" in events
+    finally:
+        gw.stop(); srv.stop(); live.stop()
+
+
+# -- query server: the five stages on a real deployment ----------------------
+
+
+def test_query_server_stage_spans_parent_linked(memory_storage):
+    """A real trained query server: one traced query yields the server
+    span plus parse/queue_wait/predict/serve stage spans, all
+    parent-linked (the acceptance waterfall's replica half; feedback is
+    exercised structurally in create_server and off in this config)."""
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+    from tests.test_query_server import seed_and_train
+
+    seed_and_train(memory_storage)
+    srv, _service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/queries.json",
+            data=json.dumps({"user": "u1", "num": 3}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": "rid-stages-1"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            assert resp.headers.get("X-Trace-Sampled") == "1"
+        doc = _wait_trace("rid-stages-1")
+        by_name = {s["name"]: s for s in doc["spans"]}
+        assert {"query", "parse", "queue_wait", "predict", "serve"} \
+            <= set(by_name)
+        root_id = by_name["query"]["spanId"]
+        for stage in ("parse", "queue_wait", "predict", "serve"):
+            assert by_name[stage]["parentId"] == root_id
+        # stage ordering on the waterfall
+        assert by_name["parse"]["offsetMs"] \
+            <= by_name["queue_wait"]["offsetMs"] \
+            <= by_name["predict"]["offsetMs"] \
+            <= by_name["serve"]["offsetMs"]
+        # acceptance: the predict-stage histogram bucket carries an
+        # exemplar naming this very trace (batched traffic observes on
+        # the consumer thread, bound to the lead rider's batch span)
+        from predictionio_tpu.obs import REGISTRY
+
+        predict_lines = [
+            l for l in REGISTRY.expose(openmetrics=True).splitlines()
+            if l.startswith("pio_query_stage_seconds_bucket")
+            and 'stage="predict"' in l and "# {" in l
+        ]
+        assert any('trace_id="rid-stages-1"' in l for l in predict_lines)
+    finally:
+        srv.stop()
+
+
+def test_feedback_stage_span_joins_the_trace(memory_storage):
+    """feedback=True deployment: the fifth stage span (feedback) is
+    parent-linked under the query root, and the event server's ingest
+    span joins the SAME trace via injected headers — one user query
+    traced across the query→event-server hop."""
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+    from tests.test_query_server import seed_and_train
+
+    seed_and_train(memory_storage)
+    app_id = memory_storage.get_meta_data_apps().get_by_name("qsapp").id
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    es = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    es.start()
+    srv, _service = create_server(ServerConfig(
+        ip="127.0.0.1", port=0, feedback=True,
+        event_server_ip="127.0.0.1", event_server_port=es.port,
+        accesskey=key,
+    ))
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/queries.json",
+            data=json.dumps({"user": "u1", "num": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-ID": "rid-feedback-1"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+        doc = _wait_trace("rid-feedback-1")
+        by_name = {s["name"]: s for s in doc["spans"]}
+        assert {"query", "parse", "queue_wait", "predict", "serve",
+                "feedback"} <= set(by_name)
+        root_id = by_name["query"]["spanId"]
+        for stage in ("parse", "queue_wait", "predict", "serve", "feedback"):
+            assert by_name[stage]["parentId"] == root_id
+        # cross-server linkage: the event server's ingest span rode the
+        # injected headers into this same trace, under the feedback span
+        assert by_name["event"]["parentId"] == by_name["feedback"]["spanId"]
+    finally:
+        srv.stop()
+        es.stop()
+
+
+# -- micro-batcher rider spans ------------------------------------------------
+
+
+def test_microbatcher_records_per_rider_stage_spans():
+    from predictionio_tpu.workflow.batching import MicroBatcher
+
+    holder = {}
+
+    def process(items):
+        t0 = time.perf_counter()
+        time.sleep(0.002)
+        t1 = time.perf_counter()
+        holder["mb"].last_stage_marks = [
+            ("predict", t0, t1 - t0), ("serve", t1, 0.0005)]
+        return list(items)
+
+    holder["mb"] = MicroBatcher(process, max_batch=4, name="test-mb")
+    with trace.span("rider") as sp:
+        assert holder["mb"].submit("q1") == "q1"
+    doc = _wait_trace(sp.trace_id)
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert {"rider", "queue_wait", "predict", "serve"} <= set(by_name)
+    root_id = by_name["rider"]["spanId"]
+    for stage in ("queue_wait", "predict", "serve"):
+        assert by_name[stage]["parentId"] == root_id
+        assert by_name[stage]["attrs"]["batch_size"] == 1
+    assert by_name["predict"]["durationMs"] >= 1.5
+
+
+# -- rendering + CLI ----------------------------------------------------------
+
+
+def test_render_waterfall_text_layout():
+    with trace.span("root") as root:
+        root.add_event("mark", note="hello")
+        with trace.span("child", stage="predict"):
+            time.sleep(0.001)
+    doc = _wait_trace(root.trace_id)
+    text = trace.render_waterfall_text(doc)
+    lines = text.splitlines()
+    assert root.trace_id in lines[0]
+    assert any("root" in l and "ms" in l for l in lines)
+    child_line = next(l for l in lines if "child" in l)
+    assert "stage=predict" in child_line
+    assert "  child" in child_line  # indented under its parent
+    assert any("* mark" in l for l in lines)
+
+
+def test_cli_pio_trace_renders_from_live_server(capsys):
+    from predictionio_tpu.tools.cli import main
+
+    srv = AppServer(_ok_router(), "127.0.0.1", 0, server_name="clisrv")
+    srv.start()
+    try:
+        _get(srv.port, "/ping", {"X-Request-ID": "rid-cli-1"})
+        _wait_trace("rid-cli-1")
+        url = f"http://127.0.0.1:{srv.port}"
+        assert main(["trace", "rid-cli-1", "--url", url]) == 0
+        out = capsys.readouterr().out
+        assert "rid-cli-1" in out and "clisrv" in out
+        # --slowest renders the reservoir
+        assert main(["trace", "--slowest", "3", "--url", url]) == 0
+        assert "trace " in capsys.readouterr().out
+        # unknown id: clean error, not a traceback
+        assert main(["trace", "nope", "--url", url]) == 1
+    finally:
+        srv.stop()
