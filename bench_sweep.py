@@ -145,6 +145,17 @@ def _collect() -> dict:
         extra["sweep_warning"] = (
             "not every candidate took the batched path: "
             f"{res_b.sweep}")
+    # device-runtime accounting (ISSUE 6): same headline fields as
+    # bench.py so a sweep PR that quietly inflates stacked-factor HBM
+    # or reintroduces per-candidate retracing shows in the capture diff
+    try:
+        from predictionio_tpu.obs import device as device_obs
+
+        device_obs.hbm_snapshot()
+        extra["peak_hbm_bytes"] = int(device_obs.peak_total_bytes())
+        extra["retraces"] = int(device_obs.total_retraces())
+    except Exception as e:
+        extra["device_obs_error"] = repr(e)
     return {
         "metric": "ml100k_sweep_candidates_per_sec",
         "value": round(rate_b, 3),
@@ -166,7 +177,10 @@ def _dry_run_doc() -> dict:
         "value": 0.0,
         "unit": "candidates/s",
         "vs_baseline": 0.0,
-        "extra": {"dry_run": True},
+        # device-accounting keys present-with-nulls: stable schema for
+        # capture tooling whether or not device sections ran
+        "extra": {"dry_run": True, "peak_hbm_bytes": None,
+                  "retraces": None},
     }
 
 

@@ -47,7 +47,7 @@ from predictionio_tpu.core.engine import EngineParams, WorkflowParams, _instanti
 from predictionio_tpu.core.evaluation import MetricScores
 from predictionio_tpu.core.fast_eval import FastEvalEngine, FastEvalEngineWorkflow, _key
 from predictionio_tpu.core.metrics import BATCHED_STAT_COLS, Metric
-from predictionio_tpu.obs import REGISTRY, trace
+from predictionio_tpu.obs import REGISTRY, device as device_obs, trace
 from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 
 logger = logging.getLogger(__name__)
@@ -369,6 +369,10 @@ def _execute(evaluation, ctx, params: WorkflowParams | None = None,
         fallback, executed_buckets = _run_buckets(
             ctx, wf, groups, metrics, out_scores, out_secs, done_cb)
         sequential = sorted(sequential + fallback)
+        # every bucket chunk's stacked factors must be freed by the
+        # metric-readback `trained.free()` above — an HBM leak here
+        # compounds per sweep in a long-lived evaluation process
+        device_obs.arena("sweep_factors").warn_if_leaked()
 
     released = 0
     if sequential:

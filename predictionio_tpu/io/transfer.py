@@ -47,9 +47,27 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
-from predictionio_tpu.obs import REGISTRY, trace
+from predictionio_tpu.obs import REGISTRY, device as device_obs, trace
 
 logger = logging.getLogger(__name__)
+
+#: HBM arena for staged-but-not-yet-consumed upload chunks: the slot
+#: semaphore bounds them, and this makes the bound's actual byte cost
+#: visible next to the other device-memory owners
+#: (``pio_device_hbm_bytes{arena="transfer_staging"}``).
+_STAGING_ARENA = device_obs.arena("transfer_staging")
+
+
+def _free_staged_alloc(fut) -> None:
+    """Future done-callback for abandoned chunks whose worker outlived
+    the cancellation drain's deadline: release the arena registration
+    whenever the upload finally lands (no-op for failed stages)."""
+    try:
+        if fut.cancelled() or fut.exception() is not None:
+            return
+        _STAGING_ARENA.free(fut.result()[1])
+    except Exception:
+        logger.debug("abandoned-chunk arena free failed", exc_info=True)
 
 __all__ = [
     "ChunkStager",
@@ -283,8 +301,10 @@ class ChunkStager:
                     CHUNK_BYTES.observe(float(nb), pipeline=self.name)
                 trace.record_span(tr_handle, "transfer_pack", t0, t1 - t0,
                                   pipeline=self.name, bytes=nb)
+                did_upload = False
                 if upload is not None and not stop.is_set():
                     staged = upload(staged)
+                    did_upload = True
                     t2 = time.perf_counter()
                     STAGE_SECONDS.observe(t2 - t1,
                                           pipeline=self.name,
@@ -296,7 +316,14 @@ class ChunkStager:
                     self.staged_s += dt
                     self.chunks += 1
                     self.bytes += nb
-                return staged
+                # device memory is held from upload completion — a chunk
+                # queued ahead of a busy consumer must show as attributed
+                # staging bytes, not unattributed residual. Registered
+                # LAST: an exception past this point would orphan the
+                # registration (no free path ever sees the alloc)
+                alloc = (_STAGING_ARENA.register(staged, label=self.name)
+                         if did_upload else None)
+                return staged, alloc
             finally:
                 self._busy_exit()
 
@@ -376,7 +403,8 @@ class ChunkStager:
                     raise msg
                 idx, fut = msg
                 try:
-                    staged = fut.result()  # worker exceptions surface here
+                    # worker exceptions surface here
+                    staged, alloc = fut.result()
                 except BaseException:
                     note_wait(t0)
                     self._slot_freed(sem)
@@ -385,6 +413,7 @@ class ChunkStager:
                 try:
                     yield idx, staged
                 finally:
+                    _STAGING_ARENA.free(alloc)
                     self._slot_freed(sem)
         finally:
             stop.set()
@@ -413,10 +442,18 @@ class ChunkStager:
                     continue
                 _idx, fut = msg
                 try:
-                    fut.result(timeout=max(deadline - time.monotonic(),
-                                           0.05))
+                    _staged, alloc = fut.result(
+                        timeout=max(deadline - time.monotonic(), 0.05))
+                    # abandoned chunk: its arrays die with the future,
+                    # so the attribution must come down with them
+                    _STAGING_ARENA.free(alloc)
                 except BaseException:
-                    pass  # cancellation path: result is irrelevant
+                    # cancellation path: result is irrelevant — but a
+                    # worker slow in upload() can still REGISTER after
+                    # this timeout, so the free must chase the future
+                    # (Allocation.free is idempotent; an exception
+                    # result makes this a no-op)
+                    fut.add_done_callback(_free_staged_alloc)
                 self._slot_freed(sem)
             producer.join(timeout=max(deadline - time.monotonic(), 0.0))
             for _w in workers:

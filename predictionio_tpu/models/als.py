@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.parallel.mesh import ComputeContext
 # host-array-identity device cache: without it each query would re-ship
 # the whole catalog over the host link (~RTT-sized latency per call
@@ -1248,6 +1249,16 @@ CHUNKED_TOPK_THRESHOLD = 32768
 CHUNKED_TOPK_CHUNK = 8192
 
 
+@device_obs.profiled_program(
+    "topk_dense",
+    # the serving hot program: buckets are the pow2-padded batch ladder
+    # times catalog shape and k — exactly the expected-compile set the
+    # tier-1 retrace guard (tests/test_retrace_guard.py) pins. A new
+    # signature INSIDE a bucket (dtype drift, mask flapping per shape)
+    # is the per-request-retrace regression the guard exists to catch.
+    bucket=lambda q, items, k, exclude_mask=None: (
+        tuple(q.shape), tuple(items.shape), k, exclude_mask is not None),
+)
 @partial(jax.jit, static_argnames=("k",))
 def _top_k_dense(query_vecs, item_features, k: int, exclude_mask=None):
     scores = query_vecs @ item_features.T  # [b, n_items]
@@ -1385,6 +1396,12 @@ def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
 # ---------------------------------------------------------------------------
 
 
+@device_obs.profiled_program(
+    "sweep_topk",
+    bucket=lambda user_stack, item_stack, uidx, *a, k=None, **kw: (
+        tuple(user_stack.shape), tuple(item_stack.shape),
+        tuple(uidx.shape), k),
+)
 @partial(jax.jit, static_argnames=("k",))
 def batched_topk_hit_counts(user_stack, item_stack, uidx, target, kq,
                             hit_mask, k: int):

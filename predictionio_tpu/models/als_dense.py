@@ -50,8 +50,39 @@ import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.io import transfer
+from predictionio_tpu.obs import device as device_obs
 
 logger = logging.getLogger(__name__)
+
+#: HBM arena for the densified-A cache entries (_A_CACHE below): the
+#: single biggest long-lived device allocation in a training process.
+_A_ARENA = device_obs.arena("dense_a_cache")
+
+#: HBM arena for the factor matrices alive during a dense solve.
+_FACTORS_ARENA = device_obs.arena("train_factors")
+
+
+def iteration_flops(n_users: int, n_items: int, rank: int) -> float:
+    """Executed FLOPs of one dense-solver iteration: both half-steps run
+    an indicator dot (pairs + count column) and a value dot (rhs) over
+    every user x item cell — 2·U·I·C per dot. The SINGLE source of the
+    dense FLOP model: bench.py's offline MFU and the live
+    ``pio_device_mfu`` gauge (obs/device.py, via the profiled entry
+    points below) both read it, so the two figures cannot drift."""
+    c_ind = rank * (rank + 1) // 2 + 1
+    c_val = rank
+    per_side = 2.0 * n_users * n_items * (c_ind + c_val)
+    solve = (n_users + n_items) * (rank**3 / 3 + 2 * rank * rank)
+    return 2 * per_side + solve
+
+
+def _dense_bucket(*args, **kw) -> tuple:
+    """Retrace bucket for the dense programs: every operand leaf's
+    shape (the correction-cell count is data-dependent — new ratings
+    are an EXPECTED recompile axis) plus the shape/branch-static
+    kwargs. A new abstract signature within one bucket — same shapes,
+    drifted dtype or weak-type — is the anomaly."""
+    return (device_obs.shape_bucket(*args), tuple(sorted(kw.items())))
 
 #: Auto-gate budget for the densified rating matrix, in bytes (int8: one
 #: byte per user x item cell). ML-20M is ~3.7 GB; a v5e chip has ~15 GB
@@ -593,6 +624,17 @@ def _iteration_dense(user_f, item_f, blocks, dup_u, dup_i, lambda_, alpha,
     return user_f, item_f
 
 
+@device_obs.profiled_program(
+    # rank-labelled program: "als_dense_rank64" is the MFU series the
+    # bench headline reads back (obs/device.program_mfu)
+    lambda *a, **kw: f"als_dense_rank{kw['rank']}",
+    flops=lambda user_f, item_f, blocks, dup_u, dup_i, lam, al, iters,
+    **kw: float(iters) * iteration_flops(
+        user_f.shape[0], item_f.shape[0], kw["rank"]),
+    bucket=_dense_bucket,
+    sync=True,  # seconds-scale dispatch: one tiny-readback RTT makes
+    # the recorded wall time device-true (and feeds the MFU gauge)
+)
 @partial(
     jax.jit,
     static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
@@ -632,6 +674,12 @@ def _dense_iteration(
         rank, scale, ub, exact, kernel)
 
 
+@device_obs.profiled_program(
+    lambda *a, **kw: f"als_dense_user_half_rank{kw['rank']}",
+    bucket=_dense_bucket,
+    # NO sync: the pipelined final iteration exists so the user-factor
+    # d2h copy overlaps the item half — the histogram measures enqueue
+)
 @partial(
     jax.jit,
     static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
@@ -650,6 +698,10 @@ def _dense_user_half(
         rank, scale, ub, exact, kernel)
 
 
+@device_obs.profiled_program(
+    lambda *a, **kw: f"als_dense_item_half_rank{kw['rank']}",
+    bucket=_dense_bucket,
+)
 @partial(
     jax.jit,
     static_argnames=("implicit", "rank", "scale", "ub", "exact", "kernel"),
@@ -867,9 +919,28 @@ last_train_phases: dict = {}
 _A_CACHE: dict = {}
 
 
+def _evict_a_cache() -> None:
+    """Drop every cached entry, releasing its HBM-arena registration
+    first so ``pio_device_hbm_bytes{arena="dense_a_cache"}`` tracks the
+    eviction (the arrays themselves die with the dict reference)."""
+    for entry in _A_CACHE.values():
+        _A_ARENA.free(entry.get("arena_alloc"))
+    _A_CACHE.clear()
+
+
 def clear_dense_cache() -> None:
     """Drop the cached densified inputs (frees the device A)."""
-    _A_CACHE.clear()
+    _evict_a_cache()
+
+
+def _cache_entry(key: str, entry: dict) -> None:
+    """Pin one entry (the cache holds exactly one): evict the old A
+    before registering the new one under the dense_a_cache arena."""
+    _evict_a_cache()
+    entry["arena_alloc"] = _A_ARENA.register(
+        (entry["blocks"], entry["dup_u"], entry["dup_i"]),
+        label=key[:12])
+    _A_CACHE[key] = entry
 
 
 def _cache_enabled() -> bool:
@@ -943,8 +1014,7 @@ def acquire_device_inputs(ui, ii, ratings, n_users: int, n_items: int,
             _phase_sync(entry["blocks"][0])
         phases["upload_densify_s"] = round(time.perf_counter() - t0, 3)
         if key is not None:
-            _A_CACHE.clear()  # one entry: evict before pinning a new A
-            _A_CACHE[key] = entry
+            _cache_entry(key, entry)  # one entry: evicts the old A
     elif entry is None:
         t0 = time.perf_counter()
         plan = _dense_prepare(ui, ii, ratings, n_users, n_items)
@@ -961,8 +1031,7 @@ def acquire_device_inputs(ui, ii, ratings, n_users: int, n_items: int,
                      scale=plan.scale, ub=merged_ub(plan, merged),
                      nb=plan.nb, nd=nd)
         if key is not None:
-            _A_CACHE.clear()  # one entry: evict before pinning a new A
-            _A_CACHE[key] = entry
+            _cache_entry(key, entry)  # one entry: evicts the old A
         logger.info(
             "ALS(dense): %d ratings -> %d x %d int8 cells in %d blocks"
             "%s, %d correction cells, scale %d, dots=%s",
@@ -1009,44 +1078,51 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                   exact=p.gather_dtype == "float32",
                   kernel=kernel)
     t0 = time.perf_counter()
-    if callback is None and _pipeline_enabled() and p.num_iterations >= 1:
-        # the final iteration runs as two half dispatches: once the user
-        # half lands, its factors' d2h copy is kicked off and proceeds
-        # concurrently with the item half still executing on device —
-        # the readback overlap half of the transfer pipeline (the caller
-        # collects both arrays via io.transfer.async_readback)
-        user_f, item_f = _dense_train(
-            user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
-            p.num_iterations - 1, **static)
-
-        def start_fetch(x):
-            # whole-array d2h copy, started early (pure DMA — overlaps
-            # the compute still queued behind it). Only when the caller's
-            # async_readback will NOT row-chunk the array: above the
-            # chunk threshold it slices and copies per chunk, and a
-            # redundant whole-array copy here would double the d2h bytes
-            if (hasattr(x, "copy_to_host_async")
-                    and x.nbytes <= transfer.transfer_chunk_bytes()):
-                x.copy_to_host_async()
-
-        user_f = _dense_user_half(
-            user_f, item_f, blocks, dup_u, p.lambda_, p.alpha, **static)
-        start_fetch(user_f)
-        item_f = _dense_item_half(
-            item_f, user_f, blocks, dup_i, p.lambda_, p.alpha, **static)
-        start_fetch(item_f)
-    elif callback is None:
-        user_f, item_f = _dense_train(
-            user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
-            p.num_iterations, **static)
-    else:
-        for it in range(p.num_iterations):
-            user_f, item_f = _dense_iteration(
+    # factor matrices live in HBM for the whole solve; past the return
+    # they belong to the caller (readback) and show as unattributed
+    factors_alloc = _FACTORS_ARENA.register(
+        (n_users + n_items) * p.rank * 4, label=f"rank{p.rank}")
+    try:
+        if callback is None and _pipeline_enabled() and p.num_iterations >= 1:
+            # the final iteration runs as two half dispatches: once the user
+            # half lands, its factors' d2h copy is kicked off and proceeds
+            # concurrently with the item half still executing on device —
+            # the readback overlap half of the transfer pipeline (the caller
+            # collects both arrays via io.transfer.async_readback)
+            user_f, item_f = _dense_train(
                 user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
-                **static)
-            callback(it, user_f, item_f)
-    if sync_timing:
-        _phase_sync(item_f)
+                p.num_iterations - 1, **static)
+
+            def start_fetch(x):
+                # whole-array d2h copy, started early (pure DMA — overlaps
+                # the compute still queued behind it). Only when the caller's
+                # async_readback will NOT row-chunk the array: above the
+                # chunk threshold it slices and copies per chunk, and a
+                # redundant whole-array copy here would double the d2h bytes
+                if (hasattr(x, "copy_to_host_async")
+                        and x.nbytes <= transfer.transfer_chunk_bytes()):
+                    x.copy_to_host_async()
+
+            user_f = _dense_user_half(
+                user_f, item_f, blocks, dup_u, p.lambda_, p.alpha, **static)
+            start_fetch(user_f)
+            item_f = _dense_item_half(
+                item_f, user_f, blocks, dup_i, p.lambda_, p.alpha, **static)
+            start_fetch(item_f)
+        elif callback is None:
+            user_f, item_f = _dense_train(
+                user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
+                p.num_iterations, **static)
+        else:
+            for it in range(p.num_iterations):
+                user_f, item_f = _dense_iteration(
+                    user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
+                    **static)
+                callback(it, user_f, item_f)
+        if sync_timing:
+            _phase_sync(item_f)
+    finally:
+        _FACTORS_ARENA.free(factors_alloc)
     phases["solve_s"] = round(time.perf_counter() - t0, 3)
     global last_train_phases
     last_train_phases = phases
@@ -1069,6 +1145,14 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
 # per candidate).
 
 
+@device_obs.profiled_program(
+    lambda *a, **kw: f"als_dense_stacked_rank{kw['rank']}",
+    flops=lambda uf_stack, if_stack, blocks, dup_u, dup_i, lambdas,
+    alphas, iters, **kw: float(iters) * uf_stack.shape[0]
+    * iteration_flops(uf_stack.shape[1], if_stack.shape[1], kw["rank"]),
+    bucket=_dense_bucket,
+    sync=True,
+)
 @partial(
     jax.jit,
     static_argnames=("implicit", "rank", "scale", "ub", "exact"),

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.ops.attention import flash_attention, mha_attention
 from predictionio_tpu.parallel.mesh import ComputeContext
 
@@ -246,6 +247,14 @@ def _raw_train_step(params, opt_state, seqs, pos, neg, key, tx_lr,
     return optax.apply_updates(params, updates), opt_state, loss
 
 
+@device_obs.profiled_program(
+    "sasrec_epoch",
+    bucket=lambda params, opt_state, seqs, *a, **kw: (
+        tuple(seqs.shape), tuple(sorted(
+            (k, repr(v)) for k, v in kw.items()))),
+    sync=True,  # per-epoch dispatch: one tiny readback per epoch is
+    # noise, and callers read float(loss) right after anyway
+)
 @partial(
     jax.jit,
     static_argnames=("p", "steps_per_epoch", "bs", "n_items"),
@@ -293,6 +302,15 @@ def _score_last(item_emb, last, k: int, exclude_mask=None):
     return jax.lax.top_k(scores, k)
 
 
+@device_obs.profiled_program(
+    "sasrec_predict",
+    # params join via shape_bucket: the item-table row count is a model
+    # property p alone doesn't pin, and a second model in one process
+    # is an expected recompile, not a retrace
+    bucket=lambda params, seqs, k, p, exclude_mask=None: (
+        device_obs.shape_bucket(params, seqs), k, repr(p),
+        exclude_mask is not None),
+)
 @partial(jax.jit, static_argnames=("k", "p"))
 def _predict_top_k_jit(params, seqs, k: int, p: SASRecParams,
                        exclude_mask=None):
@@ -398,16 +416,30 @@ class SASRec:
         seqs_d = jnp.asarray(seqs)  # dataset resident on device for the run
         pos_d = jnp.asarray(pos)
         loss = None
-        for epoch in range(start_epoch, p.num_epochs):
-            params, opt_state, loss = _train_epoch(
-                params, opt_state, seqs_d, pos_d, key, epoch,
-                p.learning_rate,
-                p=p, steps_per_epoch=steps_per_epoch, bs=bs, n_items=n_items,
-            )
-            if callback is not None:
-                callback(epoch, float(loss))
-            if checkpointer is not None and checkpointer.should_save(epoch):
-                checkpointer.save(epoch, (params, opt_state), fingerprint)
+        # params + optimizer state under neural_params (the adam-traffic
+        # figure, same as two_tower); the device-resident dataset — which
+        # can dwarf the model — is its own arena so neither number lies
+        alloc = device_obs.arena("neural_params").register(
+            (params, opt_state), label="sasrec")
+        data_alloc = device_obs.arena("train_data").register(
+            (seqs_d, pos_d), label="sasrec")
+        try:
+            for epoch in range(start_epoch, p.num_epochs):
+                params, opt_state, loss = _train_epoch(
+                    params, opt_state, seqs_d, pos_d, key, epoch,
+                    p.learning_rate,
+                    p=p, steps_per_epoch=steps_per_epoch, bs=bs,
+                    n_items=n_items,
+                )
+                if callback is not None:
+                    callback(epoch, float(loss))
+                if checkpointer is not None \
+                        and checkpointer.should_save(epoch):
+                    checkpointer.save(
+                        epoch, (params, opt_state), fingerprint)
+        finally:
+            device_obs.arena("neural_params").free(alloc)
+            device_obs.arena("train_data").free(data_alloc)
         return jax.tree_util.tree_map(np.asarray, params)
 
 

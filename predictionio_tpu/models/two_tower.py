@@ -30,6 +30,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.parallel.mesh import (
     ComputeContext,
     DATA_AXIS,
@@ -76,6 +77,38 @@ _AUTO_CHUNK = 2048
 #: smallest worthwhile chunk: below this the scan degenerates toward
 #: per-column work and dense logits are the lesser evil
 _MIN_CHUNK = 64
+
+
+def n_params(p: TwoTowerParams, n_users: int, n_items: int) -> int:
+    """Parameter count shared by the MFU and HBM roofline models
+    (moved here from bench.py so the live ``pio_device_mfu`` accounting
+    and the bench figures read ONE model)."""
+    dims = [p.embed_dim, *p.hidden_dims, p.out_dim]
+    return (n_users + n_items) * p.embed_dim + 2 * sum(
+        (a + 1) * b for a, b in zip(dims, dims[1:]))
+
+
+def flops_per_step(p: TwoTowerParams, n_users: int, n_items: int,
+                   batch: int) -> float:
+    """Model FLOPs of one training step: both towers' MLPs (forward +
+    dx/dW backward = 3x forward), the in-batch logits (forward + both
+    operand grads = 3x; +1x recompute when the chunked CE is active),
+    and the dense adam update over every parameter (~10 ops/param — the
+    embedding tables dominate the count)."""
+    dims = [p.embed_dim, *p.hidden_dims, p.out_dim]
+    mlp = sum(2 * a * b for a, b in zip(dims, dims[1:]))  # per example
+    towers = 2 * 3 * batch * mlp
+    logit_passes = 4 if batch > _DENSE_LOGITS_MAX else 3
+    logits = logit_passes * 2 * batch * batch * p.out_dim
+    return towers + logits + 10.0 * n_params(p, n_users, n_items)
+
+
+def adam_bytes_per_step(p: TwoTowerParams, n_users: int,
+                        n_items: int) -> float:
+    """HBM bytes of the dense adam update: params + dense grads + two
+    moment tensors, read and written (~7 array passes of 4 bytes/param).
+    The embedding tables make this the step's true roofline."""
+    return 7.0 * 4.0 * n_params(p, n_users, n_items)
 
 
 def _resolve_chunk(p: TwoTowerParams, n_negatives: int) -> int | None:
@@ -403,6 +436,27 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
         u, i = sample_batch(u_all, i_all, key, s)
         return raw_step(params, opt_state, u, i)
 
+    # device-runtime accounting for the fused run (obs/device.py): each
+    # trainer-cache entry is its own expected-compile bucket; steps ride
+    # the flops model so a 2-step warmup and a 2000-step run report the
+    # same utilization series
+    trainer_bucket = (batch, ctx.model_axis_size,
+                      repr(dataclasses.replace(p, steps=0, seed=0)))
+    run = device_obs.profiled_program(
+        "two_tower_step",
+        flops=lambda params, opt_state, u_all, i_all, key, steps,
+        start=0: float(steps) * flops_per_step(
+            p, params["user"]["embed"].shape[0],
+            params["item"]["embed"].shape[0], batch),
+        # operand shapes join the bucket: one cached trainer can serve
+        # datasets of different sizes (embed tables, event count), and
+        # those recompiles are expected — only a same-shape re-lowering
+        # (dtype/weak-type flap) should read as a retrace
+        bucket=lambda *a, **kw: (
+            trainer_bucket, device_obs.shape_bucket(*a)),
+        sync=True,
+    )(run)
+
     entry = (tx, run, one_step)
     if len(_TRAINER_CACHE) >= _TRAINER_CACHE_MAX:
         _TRAINER_CACHE.pop(next(iter(_TRAINER_CACHE)))
@@ -475,46 +529,58 @@ def train_two_tower(
         np.ascontiguousarray(item_idx.astype(np.int32)), ctx.replicated
     )
     key = jax.random.PRNGKey(p.seed)
-    loss = None
-    if callback is None:
-        step = start_step
-        while step < p.steps:  # whole run = ONE dispatch per segment
-            seg = (
-                min(checkpointer.every, p.steps - step)
-                if checkpointer is not None
-                else p.steps - step
-            )
-            params, opt_state, loss = run(
-                params, opt_state, u_all, i_all, key, seg, step
-            )
-            step += seg
-            if checkpointer is not None:
-                # also save the final segment so fused and callback modes
-                # leave identical checkpoint state behind
-                checkpointer.save(step - 1, (params, opt_state), fingerprint)
-    else:
-        # per-step dispatch so the callback sees progress; at most one step
-        # in flight (on oversubscribed CPU test meshes async pile-up
-        # starves the collective rendezvous and XLA aborts on its
-        # stuck-timeout)
-        last_saved = None
-        for step in range(start_step, p.steps):
-            params, opt_state, loss = one_step(
-                params, opt_state, u_all, i_all, key, step
-            )
-            loss.block_until_ready()
-            if (step + 1) % 100 == 0:
-                callback(step, float(loss))
-            if checkpointer is not None and checkpointer.should_save(step):
-                checkpointer.save(step, (params, opt_state), fingerprint)
-                last_saved = step
-        # save the final (possibly partial) segment too, mirroring the
-        # fused path — both modes leave identical checkpoint state behind
-        if (checkpointer is not None and p.steps > start_step
-                and last_saved != p.steps - 1):
-            checkpointer.save(p.steps - 1, (params, opt_state), fingerprint)
-    if loss is not None:
-        logger.info("two-tower final loss: %.4f", float(loss))
+    # params + optimizer state own HBM for the whole training run
+    # (the 297 MB/step adam-traffic story of ROADMAP item 4 starts
+    # with seeing this number live on the hbm gauge); the replicated
+    # index datasets ride train_data like sasrec's sequence tensors
+    _params_alloc = device_obs.arena("neural_params").register(
+        (params, opt_state), label="two_tower")
+    _data_alloc = device_obs.arena("train_data").register(
+        (u_all, i_all), label="two_tower")
+    try:
+        loss = None
+        if callback is None:
+            step = start_step
+            while step < p.steps:  # whole run = ONE dispatch per segment
+                seg = (
+                    min(checkpointer.every, p.steps - step)
+                    if checkpointer is not None
+                    else p.steps - step
+                )
+                params, opt_state, loss = run(
+                    params, opt_state, u_all, i_all, key, seg, step
+                )
+                step += seg
+                if checkpointer is not None:
+                    # also save the final segment so fused and callback modes
+                    # leave identical checkpoint state behind
+                    checkpointer.save(step - 1, (params, opt_state), fingerprint)
+        else:
+            # per-step dispatch so the callback sees progress; at most one step
+            # in flight (on oversubscribed CPU test meshes async pile-up
+            # starves the collective rendezvous and XLA aborts on its
+            # stuck-timeout)
+            last_saved = None
+            for step in range(start_step, p.steps):
+                params, opt_state, loss = one_step(
+                    params, opt_state, u_all, i_all, key, step
+                )
+                loss.block_until_ready()
+                if (step + 1) % 100 == 0:
+                    callback(step, float(loss))
+                if checkpointer is not None and checkpointer.should_save(step):
+                    checkpointer.save(step, (params, opt_state), fingerprint)
+                    last_saved = step
+            # save the final (possibly partial) segment too, mirroring the
+            # fused path — both modes leave identical checkpoint state behind
+            if (checkpointer is not None and p.steps > start_step
+                    and last_saved != p.steps - 1):
+                checkpointer.save(p.steps - 1, (params, opt_state), fingerprint)
+        if loss is not None:
+            logger.info("two-tower final loss: %.4f", float(loss))
+    finally:
+        device_obs.arena("neural_params").free(_params_alloc)
+        device_obs.arena("train_data").free(_data_alloc)
 
     # precompute BOTH serving corpora at train time: queries at serve time
     # are then pure embedding lookups + one matmul — no tower forward, no

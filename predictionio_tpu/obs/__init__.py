@@ -48,3 +48,8 @@ from predictionio_tpu.obs.metrics import (  # noqa: F401
 # (trace id = request id). Importing the package activates the span
 # layer everywhere the registry is already active.
 from predictionio_tpu.obs import trace  # noqa: E402,F401
+# Device-runtime pillar (ISSUE 6): HBM arenas + per-program MFU/retrace
+# accounting, and the on-demand profiler capture. Importing here
+# registers their gauges and the unattributed-HBM collect hook in the
+# same breath as the rest of the scrape surface.
+from predictionio_tpu.obs import device, profile  # noqa: E402,F401

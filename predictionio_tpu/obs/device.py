@@ -1,0 +1,773 @@
+"""Device-runtime observability: HBM attribution, per-program MFU,
+retrace detection.
+
+PR 1 instrumented the host side and PR 5 the request path; the device
+itself stayed a black box — nothing said who owned HBM (the dense-A
+cache? stager slots? stacked sweep factors? serving-resident models?),
+MFU existed only as an offline bench.py calculation, and a silent XLA
+retrace burned minutes invisibly. ALX (arxiv 2112.02194) and TurboGR
+(arxiv 2605.13433) both treat per-program device-time/HBM accounting as
+the prerequisite for TPU tuning campaigns; this module is that layer:
+
+:class:`DeviceArena`
+    Named HBM ownership registry. Every subsystem holding device memory
+    registers its allocations (``arena(name).register(payload, label)``)
+    and frees them when the owner lets go; the live per-arena byte totals
+    ride ``pio_device_hbm_bytes{arena=...}`` with per-arena peaks, a
+    leak check (``warn_if_leaked``/``assert_empty``) for owner teardown,
+    and an ``unattributed`` residual computed against
+    ``jax.live_arrays()`` at scrape time (registry collect hook).
+
+:func:`profiled_program`
+    Wrapper for the jitted device entry points (dense ALS solves, the
+    stacked sweep train, batched top-k, neural train steps). Per call it
+    records ``pio_device_dispatch_seconds{program=...}``; per new
+    abstract signature it captures a FLOPs estimate once via
+    ``lowered.cost_analysis()`` (an analytic ``flops=`` model overrides
+    it — bench.py and the live gauge then share ONE accounting); sync'd
+    programs publish a live ``pio_device_mfu{program=...}`` gauge
+    (window flops / window seconds / device peak, XLA compile seconds
+    attributed to the call subtracted).
+
+Retrace detection
+    Each program tracks the set of abstract call signatures per *bucket*
+    (``bucket=`` callable naming the axes EXPECTED to vary — the serving
+    top-k's pow2 batch ladder, a dense train's problem shape). A second
+    distinct signature inside one bucket, or a backend compile event
+    beyond one-per-signature (jit cache eviction, weak-type flapping),
+    counts ``pio_jax_retraces_total{program=...}`` and warns once with
+    the differing avals. obs/jax_hooks.py feeds the compile events and
+    labels its compile counters with the active program.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+import logging
+import os
+import threading
+import time
+
+from predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "DeviceArena",
+    "DeviceLeakError",
+    "arena",
+    "arena_bytes",
+    "device_bytes",
+    "device_peak_flops",
+    "hbm_snapshot",
+    "observe_program",
+    "peak_total_bytes",
+    "profiled_program",
+    "program_mfu",
+    "program_report",
+    "refresh_unattributed",
+    "reset_program",
+    "reset_program_window",
+    "shape_bucket",
+    "total_retraces",
+]
+
+# -- scrape surface ----------------------------------------------------------
+
+HBM_BYTES = REGISTRY.gauge(
+    "pio_device_hbm_bytes",
+    "Live device memory attributed per named arena (plus the "
+    "unattributed residual vs jax.live_arrays, refreshed at scrape)",
+    labels=("arena",),
+)
+HBM_PEAK_BYTES = REGISTRY.gauge(
+    "pio_device_hbm_peak_bytes",
+    "High-water mark of each arena's attributed device bytes",
+    labels=("arena",),
+)
+DISPATCH_SECONDS = REGISTRY.histogram(
+    "pio_device_dispatch_seconds",
+    "Host wall seconds per profiled device-program call (sync'd "
+    "programs include results-ready; others measure enqueue)",
+    labels=("program",),
+)
+MFU_GAUGE = REGISTRY.gauge(
+    "pio_device_mfu",
+    "Model FLOPs utilization per profiled program: window flops / "
+    "window seconds / device bf16 peak (sync'd programs only)",
+    labels=("program",),
+)
+PROGRAM_FLOPS = REGISTRY.gauge(
+    "pio_device_program_flops",
+    "FLOPs per dispatch of each profiled program (analytic model when "
+    "provided, else lowered.cost_analysis captured once per compile)",
+    labels=("program",),
+)
+RETRACES = REGISTRY.counter(
+    "pio_jax_retraces_total",
+    "Unexpected re-lowerings of a profiled program: a new abstract "
+    "signature inside an existing shape bucket, or a backend compile "
+    "beyond one-per-signature",
+    labels=("program",),
+)
+ARENA_LEAKS = REGISTRY.counter(
+    "pio_device_arena_leaks_total",
+    "Allocations still registered when their arena's owner freed it",
+    labels=("arena",),
+)
+
+
+# -- device peak FLOP/s (single source; bench.py imports these) --------------
+
+#: bf16 peak FLOP/s by TPU generation (public numbers; conservative
+#: denominator — the ALS solves run in f32). v5e = "TFRT TPU v5 lite".
+PEAK_BF16_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+_peak_cache: list = []  # [float | None] once probed
+
+
+def peak_flops_for(device) -> float | None:
+    """bf16 peak for one jax device object (None when unrecognized)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in PEAK_BF16_FLOPS.items():
+        if tag in kind:
+            return peak
+    return None
+
+
+def device_peak_flops() -> float | None:
+    """Peak FLOP/s of the default device, probed once per process.
+    ``PIO_DEVICE_PEAK_FLOPS`` overrides (unknown device kinds, tests)."""
+    env = os.environ.get("PIO_DEVICE_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            logger.warning("ignoring bad PIO_DEVICE_PEAK_FLOPS=%r", env)
+    if not _peak_cache:
+        try:
+            import jax
+
+            _peak_cache.append(peak_flops_for(jax.devices()[0]))
+        except Exception:
+            _peak_cache.append(None)
+    return _peak_cache[0]
+
+
+# -- HBM arenas --------------------------------------------------------------
+
+
+class DeviceLeakError(AssertionError):
+    """An arena the owner declared empty still holds allocations."""
+
+
+def device_bytes(payload) -> int:
+    """Total bytes of every array leaf in ``payload`` (any pytree of
+    objects with ``nbytes``; plain ints pass through as explicit byte
+    counts for state whose arrays are awkward to hand over)."""
+    if payload is None:
+        return 0
+    if isinstance(payload, (int, float)):
+        return int(payload)
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(payload)
+    except Exception:
+        leaves = payload if isinstance(payload, (list, tuple)) else [payload]
+    total = 0
+    for leaf in leaves:
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+class Allocation:
+    """One registered device allocation (free exactly once; idempotent)."""
+
+    __slots__ = ("arena_name", "label", "nbytes", "freed")
+
+    def __init__(self, arena_name: str, label: str, nbytes: int):
+        self.arena_name = arena_name
+        self.label = label
+        self.nbytes = int(nbytes)
+        self.freed = False
+
+    def __repr__(self) -> str:  # leak reports show these
+        return f"<{self.arena_name}:{self.label or 'alloc'} {self.nbytes}B>"
+
+
+class DeviceArena:
+    """Named set of live device allocations feeding one
+    ``pio_device_hbm_bytes`` gauge child."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._live: dict[int, Allocation] = {}
+        self._bytes = 0
+        self.peak = 0
+
+    def register(self, payload, label: str = "") -> Allocation:
+        """Track ``payload`` (pytree of arrays, or an int byte count)
+        under this arena until :meth:`free`. Zero-byte payloads are
+        tracked too (their free keeps the balance auditable)."""
+        alloc = Allocation(self.name, label, device_bytes(payload))
+        with self._lock:
+            self._live[id(alloc)] = alloc
+            self._bytes += alloc.nbytes
+            self.peak = max(self.peak, self._bytes)
+            # publish under the lock: a set() after release could land
+            # out of order with a concurrent mutation's and leave the
+            # gauge stale until the next change
+            HBM_BYTES.set(self._bytes, arena=self.name)
+            HBM_PEAK_BYTES.set(self.peak, arena=self.name)
+        _note_total_peak()
+        return alloc
+
+    def free(self, alloc: Allocation | None) -> None:
+        """Release one allocation (None / double-free are no-ops: tear-
+        down paths run from error handlers and must stay idempotent)."""
+        if alloc is None or alloc.freed:
+            return
+        with self._lock:
+            if self._live.pop(id(alloc), None) is None:
+                return
+            alloc.freed = True
+            self._bytes -= alloc.nbytes
+            HBM_BYTES.set(self._bytes, arena=self.name)
+
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def allocations(self) -> list[Allocation]:
+        with self._lock:
+            return list(self._live.values())
+
+    def warn_if_leaked(self) -> int:
+        """Owner-teardown leak check: log + count any allocation still
+        registered, returning the leaked byte total. The allocations stay
+        registered (they ARE still alive — the gauge must keep telling
+        the truth); the counter is the alarm."""
+        leaked = self.allocations()
+        if not leaked:
+            return 0
+        total = sum(a.nbytes for a in leaked)
+        ARENA_LEAKS.inc(len(leaked), arena=self.name)
+        logger.warning(
+            "device arena %r: %d allocation(s) (%d bytes) still "
+            "registered at owner free: %s",
+            self.name, len(leaked), total, leaked[:8])
+        return total
+
+    def assert_empty(self) -> None:
+        """Raise :class:`DeviceLeakError` listing any live allocations —
+        the strict form of :meth:`warn_if_leaked` for tests and explicit
+        teardown contracts."""
+        leaked = self.allocations()
+        if leaked:
+            self.warn_if_leaked()
+            raise DeviceLeakError(
+                f"arena {self.name!r} leaked {len(leaked)} allocation(s): "
+                f"{leaked[:8]}")
+
+
+_arena_lock = threading.Lock()
+_ARENAS: dict[str, DeviceArena] = {}
+
+#: Process high-water mark of total device bytes (attributed arenas +
+#: the unattributed residual at its last refresh) — bench.py's
+#: ``peak_hbm_bytes`` headline field.
+_peak_total = 0
+_last_unattributed = 0
+
+
+def arena(name: str) -> DeviceArena:
+    """Get-or-create the named arena (module-level convention mirrors
+    the metric registry: one object per name, shared by every caller)."""
+    with _arena_lock:
+        a = _ARENAS.get(name)
+        if a is None:
+            a = _ARENAS[name] = DeviceArena(name)
+        return a
+
+
+def arena_bytes() -> dict[str, int]:
+    with _arena_lock:
+        arenas = list(_ARENAS.values())
+    return {a.name: a.bytes() for a in arenas}
+
+
+def _note_total_peak() -> None:
+    global _peak_total
+    total = sum(arena_bytes().values()) + _last_unattributed
+    if total > _peak_total:
+        _peak_total = total
+
+
+def peak_total_bytes() -> int:
+    """Process peak of (attributed + last-refreshed unattributed) device
+    bytes."""
+    return _peak_total
+
+
+def live_device_bytes() -> int:
+    """Total bytes of every live jax array in the process (deleted /
+    donated buffers excluded)."""
+    try:
+        import jax
+
+        total = 0
+        for a in jax.live_arrays():
+            try:
+                if a.is_deleted():
+                    continue
+                total += int(a.nbytes)
+            except Exception:
+                continue
+        return total
+    except Exception:
+        return 0
+
+
+def refresh_unattributed() -> int:
+    """Recompute the ``unattributed`` residual: live jax bytes minus the
+    attributed arena total, clamped at 0 (an arena whose arrays died
+    before their free would otherwise push it negative — the leak
+    counter owns that story). Runs as a registry collect hook so every
+    scrape/snapshot sees a current figure."""
+    global _last_unattributed
+    live = live_device_bytes()
+    attributed = sum(arena_bytes().values())
+    resid = max(live - attributed, 0)
+    _last_unattributed = resid
+    HBM_BYTES.set(resid, arena="unattributed")
+    current_peak = float(
+        HBM_PEAK_BYTES.value(arena="unattributed"))
+    if resid > current_peak:
+        HBM_PEAK_BYTES.set(resid, arena="unattributed")
+    _note_total_peak()
+    return resid
+
+
+REGISTRY.add_collect_hook(refresh_unattributed)
+
+
+def hbm_snapshot() -> dict:
+    """One JSON-friendly view of device memory: per-arena live/peak
+    bytes, the refreshed unattributed residual, and process totals —
+    the dashboard panel and ``pio status`` both render this."""
+    resid = refresh_unattributed()
+    arenas = {
+        name: {"bytes": b, "peak_bytes": arena(name).peak}
+        for name, b in sorted(arena_bytes().items())
+    }
+    return {
+        "arenas": arenas,
+        "unattributed_bytes": resid,
+        "unattributed_peak_bytes": int(
+            HBM_PEAK_BYTES.value(arena="unattributed")),
+        "live_bytes": resid + sum(a["bytes"] for a in arenas.values()),
+        "peak_total_bytes": _peak_total,
+    }
+
+
+# -- per-program accounting --------------------------------------------------
+
+
+class _ActiveCall:
+    """Thread/context-scoped marker while a profiled program executes:
+    obs/jax_hooks.py labels compile counters with ``name`` and streams
+    compile seconds back here so MFU can subtract them."""
+
+    __slots__ = ("name", "bucket", "compile_s", "compiles")
+
+    def __init__(self, name: str, bucket):
+        self.name = name
+        self.bucket = bucket
+        self.compile_s = 0.0
+        self.compiles = 0
+
+
+_ACTIVE: contextvars.ContextVar[_ActiveCall | None] = contextvars.ContextVar(
+    "pio_device_active_program", default=None)
+
+
+def current_program_name() -> str | None:
+    """Name of the profiled program executing on this thread (None
+    outside any)."""
+    active = _ACTIVE.get()
+    return active.name if active is not None else None
+
+
+class _Program:
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        # bucket key -> list of signatures seen (list, not set: the
+        # FIRST signature is the reference shown in retrace warnings)
+        self.signatures: dict = {}
+        self.compiles: dict = {}  # bucket key -> backend compiles
+        self.retraces = 0
+        # signature -> cost-analysis FLOPs (per signature, not per
+        # program: a second dataset's shapes are a new program body
+        # whose FLOPs the first capture says nothing about)
+        self.flops_by_sig: dict = {}
+        self.calls = 0
+        self.seconds = 0.0
+        self.flops = 0.0
+        self.window_seconds = 0.0  # resettable MFU window
+        self.window_flops = 0.0
+        self._warned = False
+
+    def _warn_retrace(self, why: str) -> None:
+        if not self._warned:
+            self._warned = True
+            logger.warning(
+                "device program %r retraced: %s (further retraces for "
+                "this program counted silently on "
+                "pio_jax_retraces_total)", self.name, why)
+
+    def note_signature(self, bucket, sig) -> bool:
+        """Record one call's (bucket, signature); returns True when the
+        signature is NEW (→ capture a FLOPs estimate). A second distinct
+        signature in an existing bucket is a retrace."""
+        with self.lock:
+            sigs = self.signatures.setdefault(bucket, [])
+            if sig in sigs:
+                return False
+            sigs.append(sig)
+            is_retrace = len(sigs) > 1
+        if is_retrace:
+            RETRACES.inc(program=self.name)
+            with self.lock:
+                self.retraces += 1
+            self._warn_retrace(
+                f"bucket {bucket!r} saw a second abstract signature\n"
+                f"  first: {sigs[0]}\n  now:   {sig}")
+        return True
+
+    def note_compile(self, seconds: float) -> None:
+        """One backend compile attributed to this program's active call.
+        Compiles beyond one-per-signature in a bucket mean jax re-lowered
+        something it had already compiled (cache eviction, weak-type
+        flap) — a retrace the signature set alone cannot see."""
+        active = _ACTIVE.get()
+        bucket = active.bucket if active is not None else None
+        if active is not None:
+            active.compile_s += seconds
+            active.compiles += 1
+        with self.lock:
+            n = self.compiles.get(bucket, 0) + 1
+            self.compiles[bucket] = n
+            over = n > len(self.signatures.get(bucket, ()))
+        if over:
+            RETRACES.inc(program=self.name)
+            with self.lock:
+                self.retraces += 1
+            self._warn_retrace(
+                f"bucket {bucket!r}: backend compile #{n} exceeds its "
+                "signature count (jit cache eviction or weak-type flap)")
+
+    def observe(self, dt: float, flops: float | None, synced: bool,
+                compile_s: float = 0.0) -> None:
+        DISPATCH_SECONDS.observe(dt, program=self.name)
+        if flops is not None and flops > 0:
+            PROGRAM_FLOPS.set(flops, program=self.name)
+        with self.lock:
+            self.calls += 1
+            self.seconds += dt
+            if flops:
+                self.flops += flops
+            if synced and flops:
+                # compile seconds are one-time cost, not program rate:
+                # leave them in the dispatch histogram, keep them out of
+                # the utilization figure
+                self.window_seconds += max(dt - compile_s, 1e-9)
+                self.window_flops += flops
+            ws, wf = self.window_seconds, self.window_flops
+        if synced and flops:
+            peak = device_peak_flops()
+            if peak and ws > 0:
+                MFU_GAUGE.set(wf / ws / peak, program=self.name)
+
+    def mfu(self) -> float | None:
+        peak = device_peak_flops()
+        with self.lock:
+            if not peak or self.window_seconds <= 0 \
+                    or self.window_flops <= 0:
+                return None
+            return self.window_flops / self.window_seconds / peak
+
+
+_program_lock = threading.Lock()
+_PROGRAMS: dict[str, _Program] = {}
+
+
+def _program(name: str) -> _Program:
+    with _program_lock:
+        p = _PROGRAMS.get(name)
+        if p is None:
+            p = _PROGRAMS[name] = _Program(name)
+        return p
+
+
+def note_compile(seconds: float) -> str | None:
+    """Called by obs/jax_hooks.py per backend compile event; returns the
+    active program name (the compile counters' label) or None."""
+    name = current_program_name()
+    if name is not None:
+        _program(name).note_compile(seconds)
+    return name
+
+
+def program_mfu(name: str) -> float | None:
+    """Current MFU of a profiled program (None before any sync'd
+    observation with a FLOPs estimate, or with no known device peak) —
+    bench.py reads its headline MFU here so the gauge and the bench
+    figure share one accounting."""
+    with _program_lock:
+        p = _PROGRAMS.get(name)
+    return p.mfu() if p is not None else None
+
+
+def program_report(name: str) -> dict:
+    """Introspection for tests and ``pio status``: per-bucket signature/
+    compile counts plus the accounting totals."""
+    with _program_lock:
+        p = _PROGRAMS.get(name)
+    if p is None:
+        return {"buckets": {}, "retraces": 0, "calls": 0}
+    with p.lock:
+        return {
+            "buckets": {
+                repr(b): {
+                    "signatures": len(sigs),
+                    "compiles": p.compiles.get(b, 0),
+                }
+                for b, sigs in p.signatures.items()
+            },
+            "retraces": p.retraces,
+            "calls": p.calls,
+            "seconds": round(p.seconds, 6),
+            "flops": p.flops,
+        }
+
+
+def program_names() -> list[str]:
+    with _program_lock:
+        return sorted(_PROGRAMS)
+
+
+def total_retraces() -> int:
+    """Process-lifetime retrace count across every profiled program."""
+    return int(RETRACES.total())
+
+
+def reset_program(name: str) -> None:
+    """Drop a program's accounting (tests pair this with the wrapped
+    function's ``__wrapped__.clear_cache()`` so compiles-per-bucket
+    restart from zero together)."""
+    with _program_lock:
+        _PROGRAMS.pop(name, None)
+
+
+def reset_program_window(name: str) -> None:
+    """Reset only the MFU window (bench.py: the steady-state section
+    measures utilization without the warm-up trains' syncs)."""
+    with _program_lock:
+        p = _PROGRAMS.get(name)
+    if p is not None:
+        with p.lock:
+            p.window_seconds = 0.0
+            p.window_flops = 0.0
+
+
+def observe_program(name: str, seconds: float, flops: float | None = None,
+                    synced: bool = True) -> None:
+    """Feed an externally timed dispatch into a program's accounting —
+    for callers whose own timing already brackets the sync (bench
+    steady-state timers)."""
+    _program(name).observe(seconds, flops, synced)
+
+
+# -- the profiled_program wrapper -------------------------------------------
+
+
+def _describe(x):
+    """Hashable abstract description of one positional argument: arrays
+    by dtype/shape (their values never retrace), python scalars by type
+    (they trace as weak-typed operands), containers recursively."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("a", str(x.dtype), tuple(x.shape))
+    if isinstance(x, (tuple, list)):
+        return ("t", tuple(_describe(v) for v in x))
+    if isinstance(x, dict):
+        return ("d", tuple(sorted(
+            (k, _describe(v)) for k, v in x.items())))
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return ("s", type(x).__name__)
+    return ("o", type(x).__name__)
+
+
+def _describe_kw(x):
+    """Keyword arguments are static at every wrap site (keyword-only
+    static_argnames), so their VALUES are part of the signature."""
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return repr(x)
+
+
+def _signature(args, kwargs):
+    return (
+        tuple(_describe(a) for a in args),
+        tuple(sorted((k, _describe_kw(v)) for k, v in kwargs.items())),
+    )
+
+
+def shape_bucket(*args) -> tuple:
+    """Bucket key from every array leaf's shape in ``args`` — for
+    programs whose operand shapes are data-dependent (a dense train's
+    correction-cell count varies with the ratings): new data = new
+    bucket = expected compile, while a dtype or weak-type flap at
+    IDENTICAL shapes still lands in the same bucket and counts as the
+    retrace it is."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(args)
+    except Exception:
+        leaves = list(args)
+    return tuple(
+        tuple(leaf.shape) for leaf in leaves if hasattr(leaf, "shape"))
+
+
+def _sync_outputs(out) -> None:
+    """Order a results-ready boundary with a tiny readback of the first
+    array leaf — the repo's phase-sync idiom (``block_until_ready`` does
+    not block through this environment's TPU tunnel; a 4-element fetch
+    does — see als_dense._phase_sync)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "dtype") and getattr(leaf, "size", 0):
+                np.asarray(jax.device_get(jnp.ravel(leaf)[:4]))
+                return
+    except Exception:
+        logger.debug("profiled-program sync failed", exc_info=True)
+
+
+def _cost_analysis_flops(fn, args, kwargs) -> float | None:
+    """Best-effort per-dispatch FLOPs from ``fn.lower(...).cost_analysis()``
+    (no backend compile — lowering only), captured once per new
+    signature. Returns None when the backend has no cost model or the
+    function does not expose ``lower`` (non-jit callables)."""
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        return None
+    try:
+        cost = lower(*args, **kwargs).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not cost:
+            return None
+        fl = float(cost.get("flops", 0.0))
+        return fl if fl > 0 else None
+    except Exception:
+        logger.debug("cost_analysis failed for %r", fn, exc_info=True)
+        return None
+
+
+def profiled_program(name, flops=None, bucket=None, sync: bool = False,
+                     estimate: bool = True):
+    """Wrap a jitted device entry point with program accounting.
+
+    ``name``: str, or callable(*args, **kwargs) -> str (programs whose
+    identity depends on a static arg, e.g. ``als_dense_rank{rank}``).
+    ``flops``: callable(*args, **kwargs) -> float — analytic FLOPs per
+    dispatch; overrides the cost-analysis capture as the MFU numerator
+    (the model bench.py shares, so the two accountings cannot drift).
+    ``bucket``: callable -> hashable naming the axes EXPECTED to vary
+    (serving batch ladder, problem shape). Default: the full abstract
+    signature is its own bucket — safe (no false retraces), and
+    compile-beyond-signature detection still fires. A static scalar the
+    jit takes POSITIONALLY (e.g. top-k's ``k``) MUST appear in
+    ``bucket``: scalar values are not part of the abstract signature,
+    and the recompile such a value forces would otherwise read as a
+    retrace.
+    ``sync``: time to results-ready via a tiny readback (feeds MFU).
+    Only set it on seconds-scale dispatches — it costs one host-link
+    round trip, which is why the overlapped half-step dispatches stay
+    un-synced (their histogram measures enqueue, documented as such).
+    ``estimate``: set False to skip the cost-analysis lowering (entry
+    points whose re-lowering is expensive relative to their dispatch).
+    The capture only happens for ``sync=True`` programs at all — MFU is
+    its sole consumer, and paying a re-lowering per new signature on an
+    un-synced hot path (the serving top-k's ever-growing batch-shape
+    set) would tax exactly the dispatches this module exists to watch.
+    """
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            pname = name(*args, **kwargs) if callable(name) else name
+            rec = _program(pname)
+            bkey = bucket(*args, **kwargs) if bucket is not None else None
+            # the bucket key rides inside the signature: python scalars
+            # describe by TYPE (traced operands recompile on aval, not
+            # value), so a wrap site whose jit takes a static scalar
+            # POSITIONALLY must name it in ``bucket`` — the fold-in then
+            # keeps one-compile-per-signature accounting truthful
+            sig = (_signature(args, kwargs), bkey)
+            if bkey is None:
+                bkey = sig
+            new_sig = rec.note_signature(bkey, sig)
+            # sync'd programs only: MFU is the estimate's sole consumer,
+            # and the capture costs a re-lowering per new signature —
+            # unaffordable on un-synced hot paths like the serving
+            # top-k, whose signature set grows with every batch shape
+            if new_sig and estimate and sync and flops is None:
+                rec.flops_by_sig[sig] = _cost_analysis_flops(
+                    fn, args, kwargs)
+            fl = None
+            if flops is not None:
+                try:
+                    fl = float(flops(*args, **kwargs))
+                except Exception:
+                    logger.debug("flops model failed for %r", pname,
+                                 exc_info=True)
+            else:
+                fl = rec.flops_by_sig.get(sig)
+            active = _ActiveCall(pname, bkey)
+            token = _ACTIVE.set(active)
+            t0 = time.perf_counter()
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                # reset BEFORE the sync: the tiny readback's own helper
+                # ops compile on first use, and attributing those events
+                # here would trip the compile-beyond-signature rule
+                _ACTIVE.reset(token)
+            if sync:
+                _sync_outputs(out)
+            dt = time.perf_counter() - t0
+            rec.observe(dt, fl, synced=sync, compile_s=active.compile_s)
+            return out
+
+        inner.__wrapped__ = fn
+        inner.program_name = name if isinstance(name, str) else None
+        return inner
+
+    return wrap

@@ -4,12 +4,19 @@ XLA compiles are the dominant cold-path cost on a TPU deploy (the
 post-deploy batch-shape warmup exists because of them). ``jax.monitoring``
 emits a duration event per backend compile; this hook folds them into:
 
-  * ``pio_jax_compiles_total`` — backend compiles since install
-  * ``pio_jax_compile_seconds_total`` — cumulative backend compile time
+  * ``pio_jax_compiles_total{program=...}`` — backend compiles since
+    install, labelled with the profiled device program active on the
+    compiling thread (obs/device.py), ``unattributed`` otherwise
+  * ``pio_jax_compile_seconds_total{program=...}`` — cumulative backend
+    compile time, same labels
 
-The training workflow snapshots these around a train run and publishes
-the deltas into the engine-instance record; the query server's warmup
-compiles show up on ``/metrics`` the same way.
+The training workflow snapshots the cross-program totals around a train
+run and publishes the deltas into the engine-instance record (keys
+unchanged — :func:`jax_compile_stats` sums over programs); the query
+server's warmup compiles show up on ``/metrics`` under the warmed
+programs. The default-registry listener also streams each compile into
+the device layer's per-(program, bucket) accounting, which is what the
+retrace-regression guard asserts over.
 
 Everything is best-effort: jax versions move the monitoring surface, and
 observability must never sink a train or a deploy.
@@ -26,6 +33,10 @@ logger = logging.getLogger(__name__)
 
 #: The duration event one XLA backend compile emits (jax >= 0.4.x).
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: Label value for compiles outside any profiled program (module-init
+#: jits, helper ops, un-wrapped entry points).
+_UNATTRIBUTED = "unattributed"
 
 _install_lock = threading.Lock()
 #: Registries a listener already feeds — idempotent PER REGISTRY, so a
@@ -46,21 +57,37 @@ def install_jax_compile_hook(registry: MetricsRegistry = REGISTRY) -> bool:
             logger.debug("jax.monitoring unavailable", exc_info=True)
             return False
         compiles = registry.counter(
-            "pio_jax_compiles_total", "XLA backend compiles")
+            "pio_jax_compiles_total", "XLA backend compiles, by the "
+            "profiled device program active on the compiling thread",
+            labels=("program",))
         seconds = registry.counter(
             "pio_jax_compile_seconds_total",
-            "Cumulative XLA backend compile seconds")
+            "Cumulative XLA backend compile seconds, by profiled program",
+            labels=("program",))
 
-        # only the default-registry listener stamps trace events: a
-        # second (private-registry) listener firing for the same compile
-        # would duplicate every xla_compile annotation on the span
-        emit_trace_event = registry is REGISTRY
+        # only the default-registry listener drives the per-program
+        # device accounting and stamps trace events: a second
+        # (private-registry) listener firing for the same compile would
+        # double-count retrace detection and duplicate every xla_compile
+        # annotation on the span
+        is_primary = registry is REGISTRY
 
         def on_duration(event: str, duration: float, **kw) -> None:
             if event == _COMPILE_EVENT:
-                compiles.inc()
-                seconds.inc(max(duration, 0.0))
-                if emit_trace_event:
+                from predictionio_tpu.obs import device as device_obs
+
+                dur = max(duration, 0.0)
+                if is_primary:
+                    # feeds per-(program, bucket) compile counts + the
+                    # active call's compile-second accumulator (MFU
+                    # subtracts one-time compile cost from program rate)
+                    program = device_obs.note_compile(dur)
+                else:
+                    program = device_obs.current_program_name()
+                label = program or _UNATTRIBUTED
+                compiles.inc(program=label)
+                seconds.inc(dur, program=label)
+                if is_primary:
                     # a compile inside a traced request is exactly the
                     # "why was this one slow" answer: stamp the span
                     from predictionio_tpu.obs.trace import add_event
@@ -77,8 +104,11 @@ def install_jax_compile_hook(registry: MetricsRegistry = REGISTRY) -> bool:
 
 
 def jax_compile_stats(registry: MetricsRegistry = REGISTRY) -> dict:
-    """Current totals: ``{"compiles": int, "compile_seconds": float}``
-    (zeros when the hook never installed)."""
+    """Current totals summed across program labels:
+    ``{"compiles": int, "compile_seconds": float}`` (zeros when the hook
+    never installed). The engine-instance ``env`` parity keys
+    (``pio_train_jax_compiles*``) derive from these totals, so the
+    per-program label split changes nothing downstream."""
     compiles = registry.get("pio_jax_compiles_total")
     seconds = registry.get("pio_jax_compile_seconds_total")
     return {

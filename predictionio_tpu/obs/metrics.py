@@ -392,6 +392,30 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
+        self._collect_hooks: list[Callable[[], None]] = []
+
+    def add_collect_hook(self, fn: Callable[[], None]) -> None:
+        """Register a refresher run before every exposition/snapshot —
+        for gauges whose truth is computed on demand rather than pushed
+        (the device-HBM "unattributed" residual walks ``jax.live_arrays``
+        and must be current at scrape time, not at last-mutation time).
+        Idempotent per function object; hook failures never sink a
+        scrape."""
+        with self._lock:
+            if all(h is not fn for h in self._collect_hooks):
+                self._collect_hooks.append(fn)
+
+    def _run_collect_hooks(self) -> None:
+        with self._lock:
+            hooks = list(self._collect_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # a broken refresher must not fail /metrics
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "metrics collect hook failed", exc_info=True)
 
     def _get_or_create(self, cls, name, help, labels, **kw) -> _Metric:
         labels = tuple(labels)
@@ -453,6 +477,7 @@ class MetricsRegistry:
         default content type would fail the WHOLE scrape — so they are
         emitted only under the negotiated OpenMetrics content type
         (utils/http.py checks the Accept header)."""
+        self._run_collect_hooks()
         lines: list[str] = []
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
@@ -479,6 +504,7 @@ class MetricsRegistry:
         """JSON-friendly dump: counters/gauges as {labels: value} maps,
         histograms as count/sum/p50/p90/p99 (bench captures, status
         pages)."""
+        self._run_collect_hooks()
         out: dict = {}
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]

@@ -1,0 +1,129 @@
+"""On-demand device profiler capture (``POST /debug/profile``).
+
+``pio train --profile DIR`` already wraps a whole train in
+``jax.profiler.trace``; production questions arrive differently — a
+serving replica is slow NOW and the operator wants a bounded device
+trace of live traffic without redeploying. This module is that capture:
+a duration-bounded ``jax.profiler`` trace started over HTTP (every
+server mounts the route via utils/http.add_metrics_route) or the
+``pio profile`` CLI, returning the artifact directory for
+TensorBoard's profile plugin / xprof.
+
+Semantics:
+
+  * One capture at a time per process (the profiler is a process-global
+    singleton; a second request gets 409).
+  * Duration is clamped to [0.05, 60] seconds — the capture thread
+    sleeps while the profiler records every other thread's device
+    activity, so an unbounded duration would pin an HTTP worker and an
+    ever-growing trace buffer.
+  * ``PIO_PROFILE=0`` disables the surface entirely; the route then
+    404s exactly like a feature that is not there (the same contract as
+    ``/debug/traces`` under ``PIO_TRACE=off``).
+  * Artifacts land under ``PIO_PROFILE_DIR`` (default
+    ``<tmpdir>/pio-profiles``), one timestamped directory per capture.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+
+from predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CaptureBusy",
+    "MAX_SECONDS",
+    "capture",
+    "profile_dir",
+    "profiling_enabled",
+]
+
+#: Capture outcomes (ok / busy / error) — a quiet failure in a feature
+#: operators reach for under incident pressure would be the worst kind.
+CAPTURES_TOTAL = REGISTRY.counter(
+    "pio_profile_captures_total",
+    "On-demand device profiler captures by outcome",
+    labels=("outcome",),
+)
+
+MAX_SECONDS = 60.0
+MIN_SECONDS = 0.05
+
+_capture_lock = threading.Lock()
+_capture_seq = 0  # disambiguates captures within one wall-clock second
+
+
+class CaptureBusy(RuntimeError):
+    """A capture is already running in this process."""
+
+
+def profiling_enabled() -> bool:
+    """``PIO_PROFILE`` gate (default on), read at call time like the
+    other obs toggles."""
+    return os.environ.get("PIO_PROFILE", "1").lower() not in ("0", "off")
+
+
+def profile_dir() -> str:
+    return os.environ.get("PIO_PROFILE_DIR") or os.path.join(
+        tempfile.gettempdir(), "pio-profiles")
+
+
+def _artifact_files(path: str) -> list[str]:
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            out.append(os.path.relpath(os.path.join(root, f), path))
+    return sorted(out)
+
+
+def capture(seconds: float = 1.0) -> dict:
+    """Record a ``seconds``-bounded ``jax.profiler`` trace and return
+    ``{"artifact": dir, "seconds": s, "files": [...]}``. Raises
+    :class:`CaptureBusy` when a capture is already in flight, ValueError
+    on a non-finite duration; any profiler failure (e.g. a ``pio train
+    --profile`` trace already active in-process) propagates after being
+    counted."""
+    try:
+        seconds = float(seconds)
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"bad duration: {seconds!r}") from e
+    if seconds != seconds:  # NaN
+        raise ValueError("bad duration: NaN")
+    seconds = min(max(seconds, MIN_SECONDS), MAX_SECONDS)
+    if not _capture_lock.acquire(blocking=False):
+        CAPTURES_TOTAL.inc(outcome="busy")
+        raise CaptureBusy("a profiler capture is already running")
+    try:
+        import jax
+
+        global _capture_seq
+        _capture_seq += 1  # under _capture_lock: two sub-second captures
+        # must not share one artifact directory (interleaved traces
+        # would load as a single garbled timeline)
+        stamp = (time.strftime("%Y%m%d-%H%M%S")
+                 + f"-{os.getpid()}-{_capture_seq}")
+        path = os.path.join(profile_dir(), stamp)
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        try:
+            # the capture thread only keeps time; the profiler records
+            # every OTHER thread's dispatches for the window
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        files = _artifact_files(path)
+        CAPTURES_TOTAL.inc(outcome="ok")
+        logger.info("profiler capture: %.2fs -> %s (%d file(s))",
+                    seconds, path, len(files))
+        return {"artifact": path, "seconds": seconds, "files": files}
+    except Exception:
+        CAPTURES_TOTAL.inc(outcome="error")
+        raise
+    finally:
+        _capture_lock.release()

@@ -41,7 +41,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from predictionio_tpu.obs import device as device_obs
+
 logger = logging.getLogger(__name__)
+
+#: HBM arena for serving-resident model state: every device copy the
+#: identity cache below pins (factor catalogs, NB tables, SASRec params)
+#: registers here and deregisters when its host array dies — the
+#: device-resident-serving campaign (ROADMAP item 3) tunes against this
+#: gauge.
+_SERVING_ARENA = device_obs.arena("serving_models")
 
 __all__ = [
     "link_rtt",
@@ -72,7 +81,17 @@ def _identity_cached(arr: np.ndarray, key: tuple, build):
     if hit is not None and hit[0]() is arr:
         return hit[1]
     val = build()
-    ref = weakref.ref(arr, lambda _r, key=key: _IDENTITY_CACHE.pop(key, None))
+    # host-side transform caches (device="host" key tag) hold no HBM;
+    # everything else is serving-resident device state — attribute it
+    alloc = None
+    if key[-1] != "host":
+        alloc = _SERVING_ARENA.register(val, label=str(key[1] or "model"))
+
+    def _expire(_r, key=key, alloc=alloc):
+        _IDENTITY_CACHE.pop(key, None)
+        _SERVING_ARENA.free(alloc)
+
+    ref = weakref.ref(arr, _expire)
     _IDENTITY_CACHE[key] = (ref, val)
     return val
 

@@ -32,7 +32,13 @@ from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import PEventStore
 from predictionio_tpu.models.als import ALS, ALSFactors, ALSParams, top_k_scores
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.parallel.mesh import ComputeContext
+
+#: HBM arena for stacked sweep-bucket factors (BatchedALSModels): the
+#: sweep executor frees each chunk's stack at metric readback, and
+#: core/sweep.py leak-checks the arena when a sweep finishes.
+_SWEEP_ARENA = device_obs.arena("sweep_factors")
 
 
 # -- queries / results (ref: Engine.scala Query/PredictedResult) ------------
@@ -268,8 +274,11 @@ class BatchedALSModels:
     user_ids: BiMap
     item_ids: BiMap
     n_candidates: int
+    arena_alloc: object = None  # sweep_factors HBM-arena registration
 
     def free(self) -> None:
+        _SWEEP_ARENA.free(self.arena_alloc)
+        self.arena_alloc = None
         self.user_stack = None
         self.item_stack = None
 
@@ -341,7 +350,9 @@ class ALSAlgorithm(PAlgorithm):
         return BatchedALSModels(
             user_stack=stacks[0], item_stack=stacks[1],
             user_ids=pd.user_ids, item_ids=pd.item_ids,
-            n_candidates=len(als_params))
+            n_candidates=len(als_params),
+            arena_alloc=_SWEEP_ARENA.register(
+                stacks, label=f"c{len(als_params)}"))
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]

@@ -169,6 +169,20 @@ def build_parser() -> argparse.ArgumentParser:
                          help="raw JSON instead of the text waterfall")
     p_trace.set_defaults(func=cmd_trace)
 
+    # -- on-demand device profiler capture (POST /debug/profile) ------------
+    p_prof = sub.add_parser(
+        "profile",
+        help="capture a duration-bounded device profiler trace from a "
+             "live server (POST /debug/profile)")
+    p_prof.add_argument(
+        "--seconds", type=float, default=1.0, metavar="SEC",
+        help="capture window (clamped server-side to [0.05, 60])")
+    p_prof.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server to profile (the capture records THAT process's "
+             "device activity)")
+    p_prof.set_defaults(func=cmd_profile)
+
     # -- eval (ref: Console.scala:279-306) ----------------------------------
     p_eval = sub.add_parser("eval", help="run an evaluation (parameter sweep)")
     p_eval.add_argument("evaluation_class",
@@ -562,6 +576,46 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """``pio profile --url http://host:port --seconds N``: trigger a
+    bounded ``jax.profiler`` capture on a live server and print the
+    artifact directory (TensorBoard profile plugin / xprof loads it).
+    See docs/operations.md § Device profiling."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    url = f"{args.url.rstrip('/')}/debug/profile"
+    payload = json.dumps({"seconds": args.seconds}).encode()
+    try:
+        req = urllib.request.Request(
+            url, data=payload,
+            headers={"Content-Type": "application/json"}, method="POST")
+        # the server sleeps for the capture window before answering —
+        # plus profiler init/export, which can take tens of seconds on
+        # a loaded host (first capture races the warmup compiles)
+        with urllib.request.urlopen(
+                req, timeout=args.seconds + 120) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        detail = ""
+        try:
+            detail = json.loads(e.read() or b"{}").get("message", "")
+        except ValueError:
+            pass
+        print(f"[ERROR] {url}: HTTP {e.code} {detail}".rstrip(),
+              file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as e:
+        print(f"[ERROR] cannot reach {args.url}: {e}", file=sys.stderr)
+        return 1
+    print(f"[INFO] captured {body.get('seconds')}s device trace: "
+          f"{body.get('artifact')} ({len(body.get('files', []))} file(s))")
+    print("[INFO] load it with TensorBoard's profile plugin "
+          "(tensorboard --logdir <artifact>).")
+    return 0
+
+
 def cmd_undeploy(args) -> int:
     """ref: Console.undeploy:896-922 — HTTP GET /stop."""
     import urllib.error
@@ -904,6 +958,27 @@ def cmd_status(args) -> int:
                 )
     except Exception as e:  # a broken accelerator must not fail status
         print(f"[WARN] JAX backend probe failed: {e}", file=sys.stderr)
+    try:
+        from predictionio_tpu.obs import device as device_obs
+
+        snap = device_obs.hbm_snapshot()
+        mb = snap["live_bytes"] / 2**20
+        print(f"[INFO] Device HBM (this process): {mb:.1f} MiB live "
+              f"({len(snap['arenas'])} attributed arena(s), "
+              f"{snap['unattributed_bytes'] / 2**20:.1f} MiB unattributed)")
+        for name, ar in snap["arenas"].items():
+            print(f"[INFO]   arena {name}: {ar['bytes'] / 2**20:.1f} MiB "
+                  f"(peak {ar['peak_bytes'] / 2**20:.1f} MiB)")
+        for prog in device_obs.program_names():
+            mfu = device_obs.program_mfu(prog)
+            rep = device_obs.program_report(prog)
+            mfu_s = f", mfu {mfu:.3f}" if mfu is not None else ""
+            print(f"[INFO]   program {prog}: {rep['calls']} dispatch(es), "
+                  f"{rep['retraces']} retrace(s){mfu_s}")
+        print("[INFO] Live servers expose the same under GET /metrics "
+              "(pio_device_*); capture a device trace with `pio profile`.")
+    except Exception as e:  # observability must not fail status
+        print(f"[WARN] device telemetry probe failed: {e}", file=sys.stderr)
     s = Storage.instance()
     for name, src in s.sources.items():
         print(f"[INFO] Storage source {name}: type={src.type}")

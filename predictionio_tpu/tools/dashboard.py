@@ -40,6 +40,7 @@ _PAGE = """<!DOCTYPE html>
 {rows}
 </table>
 {metrics}
+{device}
 {traces}
 </body></html>"""
 
@@ -58,6 +59,52 @@ def _metrics_footer() -> str:
     else:
         latency = f"p50 {p50 * 1e3:.2f} ms / p99 {p99 * 1e3:.2f} ms"
     return _METRICS_FOOTER.format(latency=latency)
+
+
+def _device_panel() -> str:
+    """Device-runtime panel: the HBM breakdown by arena (live + peak,
+    proportional bars) and per-program MFU / dispatch latency — the
+    obs/device.py accounting this process carries. In a split deployment
+    each process owns its own numbers; scrape the serving fleet's
+    ``pio_device_*`` series for the cluster view."""
+    from predictionio_tpu.obs import device as device_obs
+
+    snap = device_obs.hbm_snapshot()
+    total = max(snap["live_bytes"], 1)
+    rows = []
+    entries = list(snap["arenas"].items()) + [
+        ("unattributed", {"bytes": snap["unattributed_bytes"],
+                          "peak_bytes": snap["unattributed_peak_bytes"]})]
+    for name, ar in entries:
+        width = max(min(ar["bytes"] / total * 100.0, 100.0), 0.3)
+        rows.append(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td>{ar['bytes'] / 2**20:.1f} MiB</td>"
+            f"<td>{ar['peak_bytes'] / 2**20:.1f} MiB</td>"
+            f"<td style='width:40%'><div style='width:{width:.1f}%;"
+            f"background:#6a9;height:10px'></div></td></tr>")
+    hbm = ("<table><tr><th>arena</th><th>live</th><th>peak</th>"
+           f"<th>share</th></tr>{''.join(rows)}</table>")
+    disp = REGISTRY.get("pio_device_dispatch_seconds")
+    prog_rows = []
+    for prog in device_obs.program_names():
+        rep = device_obs.program_report(prog)
+        mfu = device_obs.program_mfu(prog)
+        p50 = disp.quantile(0.5, program=prog) if disp is not None else None
+        prog_rows.append(
+            f"<tr><td>{html.escape(prog)}</td><td>{rep['calls']}</td>"
+            f"<td>{'n/a' if p50 is None else f'{p50 * 1e3:.2f} ms'}</td>"
+            f"<td>{'n/a' if mfu is None else f'{mfu:.3f}'}</td>"
+            f"<td>{rep['retraces']}</td></tr>")
+    progs = ("<p>No profiled device programs have run in this process "
+             "yet.</p>" if not prog_rows else
+             "<table><tr><th>program</th><th>dispatches</th>"
+             "<th>p50 dispatch</th><th>MFU</th><th>retraces</th></tr>"
+             + "".join(prog_rows) + "</table>")
+    return ("<h2>Device runtime</h2><p>HBM attribution and per-program "
+            "utilization for this process (<code>pio_device_*</code> on "
+            "<a href='/metrics'>/metrics</a>; capture a trace with "
+            "<code>pio profile</code>).</p>" + hbm + progs)
 
 
 def _traces_panel(limit: int = 5) -> str:
@@ -138,7 +185,7 @@ def build_router() -> Router:
         )
         return 200, RawResponse(_PAGE.format(
             count=len(instances), rows=rows, metrics=_metrics_footer(),
-            traces=_traces_panel()))
+            device=_device_panel(), traces=_traces_panel()))
 
     def _get(request: Request, running: bool = False) -> EvaluationInstance:
         iid = request.path_params["instance_id"]

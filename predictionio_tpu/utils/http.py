@@ -35,7 +35,9 @@ logger = logging.getLogger(__name__)
 #: trace-browser request is often slower than a cached query hit, and
 #: tracing them would let scrape traffic crowd real requests out of the
 #: slowest-N reservoir (and the recent ring) it exists to render.
-UNTRACED_PATHS = frozenset({"/metrics", "/debug/traces"})
+#: ``/debug/profile`` qualifies twice over — its handler deliberately
+#: sleeps for the capture window.
+UNTRACED_PATHS = frozenset({"/metrics", "/debug/traces", "/debug/profile"})
 
 # Per-server HTTP telemetry, shared by every AppServer in the process
 # (the ``server`` label separates event/query/admin/dashboard traffic).
@@ -569,9 +571,10 @@ OPENMETRICS_CONTENT_TYPE = \
 
 def add_metrics_route(router: Router,
                       registry: MetricsRegistry = REGISTRY) -> Router:
-    """Mount ``GET /metrics`` (Prometheus text format) and
-    ``GET /debug/traces`` (recent + slowest span timelines, JSON) on
-    ``router``.
+    """Mount ``GET /metrics`` (Prometheus text format),
+    ``GET /debug/traces`` (recent + slowest span timelines, JSON) and
+    ``POST /debug/profile`` (duration-bounded on-demand device profiler
+    capture, obs/profile.py) on ``router``.
 
     Shared by the event server, query server, gateway, admin API, and
     dashboard so every process exposes the same scrape-and-debug
@@ -608,8 +611,33 @@ def add_metrics_route(router: Router,
             limit=limit,
         )
 
+    def debug_profile(request: Request):
+        from predictionio_tpu.obs import profile
+
+        if not profile.profiling_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404, same as an unrouted path) — the /debug/traces
+            # contract under PIO_TRACE=off
+            raise HTTPError(404, "profiling disabled (PIO_PROFILE=0)")
+        body = request.json()
+        if body is not None and not isinstance(body, dict):
+            raise HTTPError(400, "JSON object expected")
+        seconds = (body or {}).get(
+            "seconds", request.query.get("seconds", 1.0))
+        try:
+            return 200, profile.capture(seconds)
+        except ValueError as e:
+            raise HTTPError(400, str(e)) from e
+        except profile.CaptureBusy as e:
+            raise HTTPError(409, str(e)) from e
+        except Exception as e:
+            # e.g. a `pio train --profile` trace already active in this
+            # process — the profiler is a process-global singleton
+            raise HTTPError(503, f"profiler capture failed: {e}") from e
+
     router.add("GET", "/metrics", metrics)
     router.add("GET", "/debug/traces", debug_traces)
+    router.add("POST", "/debug/profile", debug_profile)
     return router
 
 

@@ -47,8 +47,11 @@ def run_train(
     instances = Storage.get_meta_data_engine_instances()
     instance_id = instances.insert(engine_instance)
     logger.info("engine instance %s: INIT", instance_id)
+    from predictionio_tpu.obs import device as device_obs
+
     install_jax_compile_hook()
     compile_before = jax_compile_stats()
+    retraces_before = device_obs.total_retraces()
     try:
         ctx = workflow_context(batch=wp.batch, mode="Training")
         timer = PhaseTimer()
@@ -86,7 +89,8 @@ def run_train(
             phases = timer.report()
         logger.info("model data saved: %d bytes", len(blob))
         train_env = _publish_train_telemetry(
-            REGISTRY, phases, compile_before, jax_compile_stats())
+            REGISTRY, phases, compile_before, jax_compile_stats(),
+            device_obs.total_retraces() - retraces_before)
         current = instances.get(instance_id)
         done = EngineInstance(
             **{
@@ -114,12 +118,17 @@ def run_train(
 
 def _publish_train_telemetry(
     registry, phases: dict[str, float], before: dict, after: dict,
+    retraces: int = 0,
 ) -> dict[str, str]:
     """Phase wall-times and the run's JAX compile delta, published twice:
     as registry gauges (the trainer process's /metrics, when it serves
     one) and as the string map merged into the engine-instance ``env``
     record — so the dashboard/admin API can show where a historical train
-    spent its time without scraping the (long-gone) trainer process."""
+    spent its time without scraping the (long-gone) trainer process.
+    The existing compile-delta keys are a parity contract (ISSUE 6:
+    per-program labels on the underlying counters must not change them);
+    ``retraces`` adds the run's unexpected-relowering count next to
+    them."""
     phase_gauge = registry.gauge(
         "pio_train_phase_seconds",
         "Wall seconds per phase of the last completed train",
@@ -141,8 +150,14 @@ def _publish_train_telemetry(
     )
     compile_gauge.set(compiles)
     compile_sec_gauge.set(compile_sec)
+    retrace_gauge = registry.gauge(
+        "pio_train_jax_retraces",
+        "Unexpected XLA re-lowerings during the last completed train",
+    )
+    retrace_gauge.set(retraces)
     env["pio_train_jax_compiles"] = str(compiles)
     env["pio_train_jax_compile_seconds"] = str(compile_sec)
+    env["pio_train_jax_retraces"] = str(int(retraces))
     return env
 
 

@@ -35,7 +35,11 @@ def _dry_run_doc(script: str, expected_metric: str, *extra_args) -> dict:
 
 
 def test_dry_run_last_stdout_line_is_the_headline_json():
-    _dry_run_doc("bench.py", "ml20m_als_rank10_iterations_per_sec")
+    doc = _dry_run_doc("bench.py", "ml20m_als_rank10_iterations_per_sec")
+    # ISSUE 6: the device-accounting keys ride every capture — dry runs
+    # emit them as nulls so the schema is stable for capture tooling
+    assert doc["extra"]["peak_hbm_bytes"] is None
+    assert doc["extra"]["retraces"] is None
 
 
 def test_sweep_bench_dry_run_last_stdout_line_is_the_headline_json():
@@ -43,6 +47,8 @@ def test_sweep_bench_dry_run_last_stdout_line_is_the_headline_json():
     parseable headline JSON, stray prints on stderr."""
     doc = _dry_run_doc("bench_sweep.py", "ml100k_sweep_candidates_per_sec")
     assert doc["unit"] == "candidates/s"
+    assert doc["extra"]["peak_hbm_bytes"] is None
+    assert doc["extra"]["retraces"] is None
 
 
 def test_serving_bench_dry_run_last_stdout_line_is_the_headline_json():

@@ -1,0 +1,498 @@
+"""Device-runtime observability (obs/device.py + obs/profile.py):
+HBM arena lifecycle, per-program dispatch/MFU accounting, retrace
+detection, per-program compile labels, and the on-demand profiler
+capture surface.
+
+The arena gauges and program counters live on the process-global
+REGISTRY (they are a scrape contract), so tests use uniquely named
+arenas/programs instead of resetting shared state.
+"""
+
+import threading
+import urllib.error
+import urllib.request
+
+import json
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs import profile
+from predictionio_tpu.obs.device import (
+    ARENA_LEAKS,
+    DeviceLeakError,
+    HBM_BYTES,
+    HBM_PEAK_BYTES,
+    RETRACES,
+    arena,
+    device_bytes,
+    profiled_program,
+)
+from predictionio_tpu.utils.http import (
+    AppServer,
+    Router,
+    add_metrics_route,
+)
+
+
+# -- byte attribution --------------------------------------------------------
+
+
+def test_device_bytes_walks_pytrees_and_passes_ints_through():
+    a = np.zeros((4, 8), dtype=np.float32)  # 128 B
+    b = np.zeros(16, dtype=np.int8)  # 16 B
+    assert device_bytes(a) == 128
+    assert device_bytes((a, b)) == 144
+    assert device_bytes({"x": a, "y": [b, b]}) == 160
+    assert device_bytes(None) == 0
+    assert device_bytes(12345) == 12345  # explicit byte count
+
+
+# -- arena lifecycle ---------------------------------------------------------
+
+
+def test_arena_register_free_balance_and_gauge():
+    ar = arena("t_balance")
+    a1 = ar.register(np.zeros(256, dtype=np.float32), label="x")  # 1 KiB
+    a2 = ar.register(np.zeros(64, dtype=np.float32), label="y")  # 256 B
+    assert ar.bytes() == 1024 + 256
+    assert HBM_BYTES.value(arena="t_balance") == 1024 + 256
+    ar.free(a1)
+    assert ar.bytes() == 256
+    assert HBM_BYTES.value(arena="t_balance") == 256
+    ar.free(a2)
+    assert ar.bytes() == 0
+    # peak sticks at the high-water mark after everything is freed
+    assert ar.peak == 1024 + 256
+    assert HBM_PEAK_BYTES.value(arena="t_balance") == 1024 + 256
+
+
+def test_arena_free_is_idempotent_and_none_safe():
+    ar = arena("t_idem")
+    a = ar.register(np.zeros(8, dtype=np.float32))
+    ar.free(a)
+    ar.free(a)  # double-free: no-op
+    ar.free(None)  # teardown-from-error-handler path
+    assert ar.bytes() == 0
+
+
+def test_arena_is_get_or_create_shared_object():
+    assert arena("t_shared") is arena("t_shared")
+
+
+def test_leak_assertion_fires_on_unfreed_allocation():
+    ar = arena("t_leak")
+    leaked_before = ARENA_LEAKS.value(arena="t_leak")
+    a = ar.register(np.zeros(32, dtype=np.float32), label="oops")
+    with pytest.raises(DeviceLeakError) as exc:
+        ar.assert_empty()
+    assert "t_leak" in str(exc.value)
+    assert "oops" in str(exc.value)
+    assert ARENA_LEAKS.value(arena="t_leak") == leaked_before + 1
+    # the allocation stays registered (it IS still live); the gauge
+    # keeps telling the truth until the owner actually frees it
+    assert ar.bytes() == 128
+    ar.free(a)
+    ar.assert_empty()  # clean now
+
+
+def test_warn_if_leaked_returns_leaked_bytes_without_raising():
+    ar = arena("t_warn")
+    a = ar.register(np.zeros(16, dtype=np.float32))
+    assert ar.warn_if_leaked() == 64
+    ar.free(a)
+    assert ar.warn_if_leaked() == 0
+
+
+def test_unattributed_residual_refreshes_at_snapshot():
+    import jax.numpy as jnp
+
+    pinned = jnp.arange(1024, dtype=jnp.float32)  # live, unregistered
+    snap = device_obs.hbm_snapshot()
+    assert snap["unattributed_bytes"] >= pinned.nbytes
+    assert snap["live_bytes"] >= snap["unattributed_bytes"]
+    assert snap["peak_total_bytes"] >= snap["live_bytes"] - sum(
+        a["bytes"] for a in snap["arenas"].values())
+    # attributing the array shrinks the residual by exactly its bytes
+    ar = arena("t_resid")
+    alloc = ar.register(pinned)
+    resid_attr = device_obs.refresh_unattributed()
+    assert resid_attr <= snap["unattributed_bytes"] - pinned.nbytes \
+        + 1024  # small slack: unrelated test arrays may die between calls
+    ar.free(alloc)
+
+
+def test_registry_collect_hook_refreshes_unattributed_on_expose():
+    import jax.numpy as jnp
+
+    from predictionio_tpu.obs import REGISTRY
+
+    pinned = jnp.ones(2048, dtype=jnp.float32)
+    text = REGISTRY.expose()
+    line = [l for l in text.splitlines()
+            if l.startswith('pio_device_hbm_bytes{arena="unattributed"}')]
+    assert line, "unattributed series missing from exposition"
+    assert float(line[0].split()[-1]) >= pinned.nbytes
+
+
+# -- dense-A cache arena -----------------------------------------------------
+
+
+def _one_device_ctx():
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("data", "model")))
+
+
+def test_dense_a_cache_hit_registers_nothing_new():
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.models.als import ALS, ALSParams
+
+    one = _one_device_ctx()
+    rng = np.random.default_rng(31)
+    n_users, n_items, nnz = 40, 25, 400
+    ui = rng.integers(0, n_users, nnz).astype(np.int32)
+    ii = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    params = ALSParams(rank=4, num_iterations=2, seed=3, solver="dense")
+    cache_arena = arena("dense_a_cache")
+    als_dense.clear_dense_cache()
+    assert cache_arena.bytes() == 0
+    ALS(one, params).train(ui, ii, r, n_users, n_items)
+    assert als_dense.last_train_phases["cache_hit"] is False
+    cold_allocs = cache_arena.allocations()
+    assert len(cold_allocs) == 1  # the one-entry cache, attributed
+    assert cache_arena.bytes() > 0
+    ALS(one, params).train(ui, ii, r, n_users, n_items)
+    assert als_dense.last_train_phases["cache_hit"] is True
+    warm_allocs = cache_arena.allocations()
+    # the hit path must not have registered (or re-registered) anything
+    assert warm_allocs == cold_allocs
+    als_dense.clear_dense_cache()
+    assert cache_arena.bytes() == 0
+    cache_arena.assert_empty()
+
+
+def test_train_factors_arena_frees_after_solve():
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.models.als import ALS, ALSParams
+
+    one = _one_device_ctx()
+    ui = np.array([0, 1, 2, 0, 3], dtype=np.int32)
+    ii = np.array([0, 1, 0, 1, 2], dtype=np.int32)
+    r = np.array([5.0, 3.0, 4.0, 2.0, 1.0], dtype=np.float32)
+    als_dense.clear_dense_cache()
+    ALS(one, ALSParams(rank=3, num_iterations=2, seed=0,
+                       solver="dense")).train(ui, ii, r, 5, 4)
+    factors = arena("train_factors")
+    assert factors.bytes() == 0
+    factors.assert_empty()
+    assert factors.peak >= (5 + 4) * 3 * 4  # (U+I)·r·4B was attributed
+    als_dense.clear_dense_cache()
+
+
+# -- per-program accounting --------------------------------------------------
+
+
+def test_profiled_program_records_dispatch_and_flops(monkeypatch):
+    monkeypatch.setenv("PIO_DEVICE_PEAK_FLOPS", "1e9")
+    device_obs.reset_program("t_prog_basic")
+
+    @profiled_program("t_prog_basic", flops=lambda x: 2.0 * x.size,
+                      sync=True)
+    def f(x):
+        return x * 2.0
+
+    f(np.ones(512, dtype=np.float32))
+    f(np.ones(512, dtype=np.float32))
+    rep = device_obs.program_report("t_prog_basic")
+    assert rep["calls"] == 2
+    assert rep["retraces"] == 0
+    assert rep["flops"] == 2 * 2.0 * 512
+    assert list(rep["buckets"].values())[0]["signatures"] == 1
+    mfu = device_obs.program_mfu("t_prog_basic")
+    assert mfu is not None and 0 < mfu < 1
+    assert device_obs.MFU_GAUGE.value(program="t_prog_basic") \
+        == pytest.approx(mfu, rel=1e-6)
+    device_obs.reset_program_window("t_prog_basic")
+    assert device_obs.program_mfu("t_prog_basic") is None
+    device_obs.reset_program("t_prog_basic")
+
+
+def test_second_signature_in_one_bucket_counts_a_retrace():
+    device_obs.reset_program("t_prog_retrace")
+    before = RETRACES.value(program="t_prog_retrace")
+
+    @profiled_program("t_prog_retrace", bucket=lambda x: "fixed",
+                      estimate=False)
+    def f(x):
+        return x
+
+    f(np.ones(8, dtype=np.float32))
+    assert RETRACES.value(program="t_prog_retrace") == before
+    f(np.ones(16, dtype=np.float32))  # new shape, SAME bucket: retrace
+    assert RETRACES.value(program="t_prog_retrace") == before + 1
+    assert device_obs.program_report("t_prog_retrace")["retraces"] == 1
+    # the same signature again is a cache hit, not another retrace
+    f(np.ones(16, dtype=np.float32))
+    assert RETRACES.value(program="t_prog_retrace") == before + 1
+    device_obs.reset_program("t_prog_retrace")
+
+
+def test_expected_bucket_ladder_does_not_retrace():
+    device_obs.reset_program("t_prog_ladder")
+    before = RETRACES.value(program="t_prog_ladder")
+
+    @profiled_program("t_prog_ladder", bucket=lambda x: x.shape,
+                      estimate=False)
+    def f(x):
+        return x
+
+    for n in (8, 16, 32, 64):  # the pow2 ladder: expected recompiles
+        f(np.ones(n, dtype=np.float32))
+    assert RETRACES.value(program="t_prog_ladder") == before
+    rep = device_obs.program_report("t_prog_ladder")
+    assert len(rep["buckets"]) == 4
+    device_obs.reset_program("t_prog_ladder")
+
+
+def test_compile_beyond_signature_count_is_a_retrace():
+    device_obs.reset_program("t_prog_evict")
+    p = device_obs._program("t_prog_evict")
+    p.note_signature("b", "sig1")
+    active = device_obs._ActiveCall("t_prog_evict", "b")
+    token = device_obs._ACTIVE.set(active)
+    try:
+        before = RETRACES.value(program="t_prog_evict")
+        p.note_compile(0.01)  # compile #1 for 1 signature: fine
+        assert RETRACES.value(program="t_prog_evict") == before
+        p.note_compile(0.01)  # compile #2: cache eviction / weak-type flap
+        assert RETRACES.value(program="t_prog_evict") == before + 1
+        assert active.compile_s == pytest.approx(0.02)
+    finally:
+        device_obs._ACTIVE.reset(token)
+    device_obs.reset_program("t_prog_evict")
+
+
+def test_compile_hook_labels_compiles_with_the_active_program():
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.obs import REGISTRY
+    from predictionio_tpu.obs.jax_hooks import install_jax_compile_hook
+
+    assert install_jax_compile_hook()
+    device_obs.reset_program("t_prog_label")
+
+    @profiled_program("t_prog_label", estimate=False)
+    @jax.jit
+    def f(x):
+        return x * 7 + 3  # fresh jaxpr -> guaranteed new compile
+
+    f(jnp.arange(11))
+    compiles = REGISTRY.get("pio_jax_compiles_total")
+    assert compiles.value(program="t_prog_label") >= 1
+    seconds = REGISTRY.get("pio_jax_compile_seconds_total")
+    assert seconds.value(program="t_prog_label") > 0
+    # exactly one compile for the one signature: no retrace
+    assert device_obs.program_report("t_prog_label")["retraces"] == 0
+    device_obs.reset_program("t_prog_label")
+
+
+def test_jax_compile_stats_sums_across_program_labels():
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.obs.jax_hooks import (
+        install_jax_compile_hook,
+        jax_compile_stats,
+    )
+
+    assert install_jax_compile_hook()
+    before = jax_compile_stats()
+    device_obs.reset_program("t_prog_sum")
+
+    @profiled_program("t_prog_sum", estimate=False)
+    @jax.jit
+    def f(x):
+        return x * 13 - 5
+
+    f(jnp.arange(5))
+
+    @jax.jit
+    def g(x):  # unattributed compile
+        return x * 17 + 9
+
+    g(jnp.arange(5)).block_until_ready()
+    after = jax_compile_stats()
+    # the parity keys see BOTH the labelled and unattributed compiles
+    assert after["compiles"] >= before["compiles"] + 2
+    assert after["compile_seconds"] > before["compile_seconds"]
+    device_obs.reset_program("t_prog_sum")
+
+
+def test_cost_analysis_flops_captured_for_jitted_programs():
+    import jax
+    import jax.numpy as jnp
+
+    device_obs.reset_program("t_prog_cost")
+
+    @profiled_program("t_prog_cost", sync=True)
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    a = jnp.ones((32, 32), dtype=jnp.float32)
+    mm(a, a)
+    rep = device_obs.program_report("t_prog_cost")
+    # XLA's CPU cost model prices the 32x32 matmul at ~2·32^3 flops
+    assert rep["flops"] > 32 ** 3
+    device_obs.reset_program("t_prog_cost")
+
+
+def test_device_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("PIO_DEVICE_PEAK_FLOPS", "5e12")
+    assert device_obs.device_peak_flops() == 5e12
+    monkeypatch.setenv("PIO_DEVICE_PEAK_FLOPS", "not-a-number")
+    # bad override ignored, falls back to the probed device (CPU: None)
+    assert device_obs.device_peak_flops() != 5e12
+
+
+# -- on-demand profiler capture ----------------------------------------------
+
+
+def test_profile_capture_busy_and_bad_duration(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_PROFILE_DIR", str(tmp_path))
+    with pytest.raises(ValueError):
+        profile.capture("nope")
+    with pytest.raises(ValueError):
+        profile.capture(float("nan"))
+    assert profile._capture_lock.acquire(blocking=False)
+    try:
+        with pytest.raises(profile.CaptureBusy):
+            profile.capture(0.05)
+    finally:
+        profile._capture_lock.release()
+
+
+def _post(port, path, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def test_debug_profile_route(tmp_path, monkeypatch):
+    """The ONE real profiler capture in the suite: `jax.profiler`'s
+    stop_trace exports metadata for every program the process compiled
+    so far — tens of seconds late in a full run — so the HTTP
+    acceptance round-trip carries the artifact assertions for every
+    other surface (the CLI test stubs the capture)."""
+    monkeypatch.setenv("PIO_PROFILE_DIR", str(tmp_path))
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0,
+                    server_name="profsrv")
+    srv.start()
+    try:
+        monkeypatch.setenv("PIO_PROFILE", "0")
+        status, _ = _post(srv.port, "/debug/profile", {"seconds": 0.05})
+        assert status == 404  # disabled == not there
+        monkeypatch.delenv("PIO_PROFILE")
+        status, body = _post(srv.port, "/debug/profile",
+                             {"seconds": 0.05}, timeout=180)
+        assert status == 200
+        assert body["artifact"].startswith(str(tmp_path))
+        assert body["files"], "capture produced no artifact files"
+        # the profile plugin's loadable unit is the xplane protobuf
+        assert any(f.endswith(".xplane.pb") for f in body["files"])
+        status, _ = _post(srv.port, "/debug/profile", {"seconds": [1]})
+        assert status == 400
+        # a concurrent capture gets 409, not a second profiler session
+        assert profile._capture_lock.acquire(blocking=False)
+        try:
+            status, _ = _post(srv.port, "/debug/profile",
+                              {"seconds": 0.05})
+            assert status == 409
+        finally:
+            profile._capture_lock.release()
+    finally:
+        srv.stop()
+
+
+def test_pio_profile_cli_prints_artifact(tmp_path, monkeypatch, capsys):
+    from predictionio_tpu.obs import profile as profile_mod
+    from predictionio_tpu.tools.cli import build_parser
+
+    # stub the capture: the AppServer runs in-process, and a second
+    # REAL profiler capture would re-pay the tens-of-seconds xplane
+    # export the route test above already covers
+    monkeypatch.setattr(
+        profile_mod, "capture",
+        lambda seconds=1.0: {"artifact": str(tmp_path / "stub"),
+                             "seconds": float(seconds),
+                             "files": ["runsc.xplane.pb"]})
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0,
+                    server_name="profclisrv")
+    srv.start()
+    try:
+        args = build_parser().parse_args(
+            ["profile", "--url", f"http://127.0.0.1:{srv.port}",
+             "--seconds", "0.05"])
+        assert args.func(args) == 0
+        out = capsys.readouterr().out
+        assert str(tmp_path) in out
+    finally:
+        srv.stop()
+
+
+def test_pio_profile_cli_reports_unreachable(capsys):
+    from predictionio_tpu.tools.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["profile", "--url", "http://127.0.0.1:9", "--seconds", "0.05"])
+    assert args.func(args) == 1
+    assert "cannot reach" in capsys.readouterr().err
+
+
+# -- snapshot / status surfaces ----------------------------------------------
+
+
+def test_hbm_snapshot_shape_and_status_render():
+    snap = device_obs.hbm_snapshot()
+    assert set(snap) == {"arenas", "unattributed_bytes",
+                        "unattributed_peak_bytes", "live_bytes",
+                        "peak_total_bytes"}
+    assert snap["unattributed_peak_bytes"] >= snap["unattributed_bytes"]
+    for entry in snap["arenas"].values():
+        assert set(entry) == {"bytes", "peak_bytes"}
+
+
+def test_dashboard_device_panel_renders():
+    from predictionio_tpu.tools.dashboard import _device_panel
+
+    ar = arena("t_panel")
+    alloc = ar.register(np.zeros(64, dtype=np.float32), label="panel")
+    try:
+        html_text = _device_panel()
+        assert "Device runtime" in html_text
+        assert "t_panel" in html_text
+        assert "unattributed" in html_text
+    finally:
+        ar.free(alloc)
+
+
+def test_observe_program_feeds_external_timings(monkeypatch):
+    monkeypatch.setenv("PIO_DEVICE_PEAK_FLOPS", "1e12")
+    device_obs.reset_program("t_prog_ext")
+    device_obs.observe_program("t_prog_ext", 0.5, flops=1e11)
+    assert device_obs.program_mfu("t_prog_ext") == pytest.approx(0.2)
+    device_obs.reset_program("t_prog_ext")

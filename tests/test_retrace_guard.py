@@ -1,0 +1,137 @@
+"""Tier-1 retrace-regression guard (ISSUE 6).
+
+One compile per (program, shape-bucket) is the device-runtime contract
+(docs/perf.md §12, §15): the serving top-k reuses a handful of
+pow2-padded programs across the micro-batcher's varying drain sizes,
+and a dense train compiles once per problem shape. A future PR that
+lets a host float creep into a weak-typed operand, flips a dtype, or
+feeds an unpadded shape would silently re-lower per request — minutes
+of invisible compile time. This guard drives both hot paths across
+their expected shape buckets and pins, via the obs/device.py
+accounting, that every dispatch beyond the first per bucket was a jit
+cache hit.
+
+Order-proofing: every dataset/catalog shape here is UNIQUE to this
+file, so the guard's buckets are cold in the process-wide jit cache no
+matter what ran before — ``reset_program`` restarts the accounting and
+the first dispatch per bucket must then compile exactly once. (Unique
+shapes instead of ``clear_cache()``: clearing would evict other tests'
+compiled programs and re-pay their compiles suite-wide.)
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs.jax_hooks import install_jax_compile_hook
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _compile_hook():
+    assert install_jax_compile_hook()
+
+
+def _one_device_ctx():
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("data", "model")))
+
+
+def _assert_one_compile_per_bucket(program: str, marker: str = "") -> dict:
+    """Assert the invariant over the buckets THIS test drove — `marker`
+    (a shape fragment unique to the test's data) filters out buckets a
+    leaked warmup thread from an earlier test file may inject into the
+    same program while the guard runs."""
+    rep = device_obs.program_report(program)
+    assert rep["calls"] > 0, f"{program}: guard drove no dispatches"
+    assert rep["retraces"] == 0, f"{program}: {rep}"
+    mine = {b: c for b, c in rep["buckets"].items() if marker in b}
+    assert mine, f"{program}: no buckets matched {marker!r}: {rep}"
+    for bucket, counts in mine.items():
+        assert counts["signatures"] == 1, (program, bucket, counts)
+        assert counts["compiles"] == 1, (program, bucket, counts)
+    rep["buckets"] = mine
+    return rep
+
+
+def test_serving_topk_ladder_compiles_once_per_bucket():
+    """The serving predict hot path: every micro-batcher drain size in
+    a pow2 bucket must reuse that bucket's ONE compiled program —
+    per-request retracing here is the regression that turns a 2 ms
+    predict into a 2 s compile."""
+    from predictionio_tpu.models.als import top_k_scores
+
+    device_obs.reset_program("topk_dense")
+    items = np.random.default_rng(7).normal(
+        size=(97, 8)).astype(np.float32)  # unique catalog shape: cold
+    # one pass over the ladder, then a second pass re-visiting every
+    # bucket: the second pass may add NO signatures and NO compiles
+    for b in (1, 2, 3, 5, 6, 8, 4, 7, 3, 1, 5, 8):
+        scores, idx = top_k_scores(
+            np.ones((b, 8), np.float32), items, 5)
+        assert scores.shape == (b, 5)
+    rep = _assert_one_compile_per_bucket("topk_dense", marker="(97, 8)")
+    # pow2 padding collapses 8 distinct drain sizes onto 4 programs
+    assert len(rep["buckets"]) == 4
+    assert rep["calls"] >= 12
+
+
+def test_serving_topk_exclude_mask_is_its_own_bucket():
+    """The mask/no-mask serve-time filter split is an expected compile
+    axis (it changes the traced branch), not a retrace."""
+    from predictionio_tpu.models.als import top_k_scores
+
+    device_obs.reset_program("topk_dense")
+    items = np.random.default_rng(8).normal(
+        size=(59, 8)).astype(np.float32)  # unique catalog shape: cold
+    q = np.ones((4, 8), np.float32)
+    mask = np.zeros((4, 59), bool)
+    for _ in range(2):
+        top_k_scores(q, items, 5)
+        top_k_scores(q, items, 5, exclude_mask=mask)
+    rep = _assert_one_compile_per_bucket("topk_dense", marker="(59, 8)")
+    assert len(rep["buckets"]) == 2
+
+
+def test_dense_als_train_compiles_once_per_shape_bucket():
+    """One dense-ALS train per problem shape compiles each of the three
+    entry points (fused train + the two pipelined halves) exactly once;
+    a re-train on the same data is all cache hits."""
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.models.als import ALS, ALSParams
+
+    programs = (
+        "als_dense_rank4",
+        "als_dense_user_half_rank4",
+        "als_dense_item_half_rank4",
+    )
+    for name in programs:
+        device_obs.reset_program(name)
+    one = _one_device_ctx()
+    rng = np.random.default_rng(11)
+    params = ALSParams(rank=4, num_iterations=2, seed=1, solver="dense")
+    datasets = []
+    for nu, ni in ((37, 23), (53, 31)):  # two UNIQUE shape buckets
+        nnz = nu * ni // 3
+        datasets.append((
+            rng.integers(0, nu, nnz).astype(np.int32),
+            rng.integers(0, ni, nnz).astype(np.int32),
+            rng.integers(1, 6, nnz).astype(np.float32), nu, ni))
+    for ui, ii, r, nu, ni in datasets:
+        als_dense.clear_dense_cache()
+        ALS(one, params).train(ui, ii, r, nu, ni)
+    # warm re-trains over BOTH shapes: zero new compiles allowed
+    for ui, ii, r, nu, ni in datasets:
+        als_dense.clear_dense_cache()
+        ALS(one, params).train(ui, ii, r, nu, ni)
+    for name in programs:
+        # factor-shape fragment: rank-4 factors over 37 or 53 entities
+        # appear in every bucket of both datasets and nothing else's
+        rep = _assert_one_compile_per_bucket(name, marker=", 4)")
+        assert len(rep["buckets"]) == 2
+        assert rep["calls"] == 4
+    als_dense.clear_dense_cache()
