@@ -134,8 +134,8 @@ def bench_query_latency(
             # the breakdown below reports only the timed traffic
             from predictionio_tpu.obs import REGISTRY
 
-            _STAGES = ("parse", "queue_wait", "predict", "serve",
-                       "feedback")
+            _STAGES = ("parse", "queue_wait", "predict", "readback",
+                       "serve", "feedback")
             stage_hist = REGISTRY.get("pio_query_stage_seconds")
             stage_base = (
                 {s: stage_hist.state(stage=s) for s in _STAGES}
@@ -205,45 +205,53 @@ def bench_query_latency(
                 if stages:
                     out["serve_stage_breakdown_ms"] = stages
 
-            # placement telemetry: what the latency-aware policy decided
-            # for this catalog (parallel/placement.py), the measured link
-            # RTT it decided on, and — when it picked the host — the
-            # accelerator-pinned latency for comparison.
-            from predictionio_tpu.parallel.placement import (
-                link_rtt,
-                serving_device,
-            )
+            # placement telemetry: the route ACTUALLY served (ground
+            # truth from the batcher's tick accounting, not a re-run of
+            # the decision function), the measured link RTT the decision
+            # used, and the opposite-pinned latency for comparison.
+            from predictionio_tpu.parallel.placement import link_rtt
 
             out["serve_link_rtt_ms"] = round(link_rtt() * 1e3, 3)
-            # the decision is per padded batch size: report it for the
-            # sequential phase (b=1) and the concurrent phase's largest
-            # drained batch, which may differ near the RTT crossover
-            picked_host = serving_device(2.0 * 1 * n_items * rank) is not None
-            out["serve_placement"] = "host" if picked_host else "default"
-            bmax = out.get("serve_max_batch_seen", threads)
-            bp = 1 << max(bmax - 1, 0).bit_length()  # pow2 pad, as served
-            conc_host = (
-                serving_device(2.0 * bp * n_items * rank) is not None
-            )
-            out["serve_conc_placement"] = "host" if conc_host else "default"
-            if picked_host:
-                prev = os.environ.get("PIO_SERVING_DEVICE")
-                os.environ["PIO_SERVING_DEVICE"] = "default"
-                try:
-                    c2 = _Client(srv.port)
-                    for k in range(5):  # compile/warm the device program
-                        c2.query(f"u{k}", 10)
-                    lat = [c2.query(f"u{k % 900}", 10) for k in range(50)]
-                    c2.close()
-                    accel = np.asarray(lat) * 1e3
-                    out["serve_accel_pinned_p50_ms"] = round(
-                        float(np.percentile(accel, 50)), 2
-                    )
-                finally:
-                    if prev is None:
-                        del os.environ["PIO_SERVING_DEVICE"]
-                    else:
-                        os.environ["PIO_SERVING_DEVICE"] = prev
+            batcher = service.batcher
+            device_ticks = getattr(batcher, "device_ticks", 0) \
+                if batcher is not None else 0
+            host_route = device_ticks == 0
+            out["serve_placement"] = "host" if host_route else "device"
+            if host_route:
+                out["serve_device_qps"] = None
+                out["serve_device_p50_ms"] = None
+                out["serve_readback_overlap_frac"] = None
+            else:
+                # single-replica device-route figures: the headline run
+                # above IS the device route (fused per-tick dispatch,
+                # deferred readback), so the keys alias its numbers and
+                # the overlap fraction says how often tick N's readback
+                # actually hid behind tick N+1's dispatch
+                out["serve_device_qps"] = out["serve_qps"]
+                out["serve_device_p50_ms"] = out["serve_p50_ms"]
+                out["serve_readback_overlap_frac"] = round(
+                    batcher.overlapped_ticks / device_ticks, 3)
+            # opposite-pinned comparison: what the OTHER route costs on
+            # this host (PIO_SERVING_DEVICE is read per request, so the
+            # pin flips the live server)
+            pin = "default" if host_route else "cpu"
+            key = ("serve_accel_pinned_p50_ms" if host_route
+                   else "serve_host_pinned_p50_ms")
+            prev = os.environ.get("PIO_SERVING_DEVICE")
+            os.environ["PIO_SERVING_DEVICE"] = pin
+            try:
+                c2 = _Client(srv.port)
+                for k in range(5):  # compile/warm the pinned route
+                    c2.query(f"u{k}", 10)
+                lat = [c2.query(f"u{k % 900}", 10) for k in range(50)]
+                c2.close()
+                pinned = np.asarray(lat) * 1e3
+                out[key] = round(float(np.percentile(pinned, 50)), 2)
+            finally:
+                if prev is None:
+                    del os.environ["PIO_SERVING_DEVICE"]
+                else:
+                    os.environ["PIO_SERVING_DEVICE"] = prev
             out.update(_trace_overhead(srv.port))
             return out
         finally:
@@ -966,7 +974,17 @@ def _dry_run_doc(gateway: bool = False) -> dict:
     # to stderr instead of corrupting the final JSON line
     print("[bench_serving] dry-run: skipping all serving sections")
     return _headline(
-        {"dry_run": True, "trace_overhead_frac": 0.0},
+        {
+            "dry_run": True,
+            "trace_overhead_frac": 0.0,
+            # device-resident-serving keys ride every capture (ISSUE 8);
+            # dry runs emit them as nulls so the schema is stable for
+            # capture tooling
+            "serve_placement": None,
+            "serve_device_qps": None,
+            "serve_device_p50_ms": None,
+            "serve_readback_overlap_frac": None,
+        },
         metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
 
 

@@ -72,6 +72,7 @@ def _free_staged_alloc(fut) -> None:
 __all__ = [
     "ChunkStager",
     "async_readback",
+    "begin_readback",
     "iter_chunks",
     "transfer_chunk_bytes",
     "transfer_slots",
@@ -491,17 +492,21 @@ def _row_chunks(a, chunk_bytes: int) -> list:
     return [a[i: i + per] for i in range(0, rows, per)]
 
 
-def async_readback(arrays: Sequence, chunk_bytes: int | None = None,
-                   name: str = "readback") -> list[np.ndarray]:
-    """Fetch device arrays to host numpy with overlapped, chunked copies.
+def begin_readback(arrays: Sequence, chunk_bytes: int | None = None,
+                   name: str = "readback") -> Callable[[], list[np.ndarray]]:
+    """Start an overlapped device→host fetch NOW; block for it later.
 
-    Every row-chunk's ``copy_to_host_async`` is issued before the first
-    blocking ``np.asarray``, so the device→host copies run concurrently
-    with each other AND with any device work still queued behind the
-    arrays (jax only starts a copy once its array is ready — which is
-    exactly what lets a user-factor fetch overlap the final item-factor
-    half-step). Plain numpy arrays pass through untouched. Returns one
+    Every row-chunk's ``copy_to_host_async`` is issued before this
+    function returns, so the d2h copies run behind whatever device work
+    is still queued — and behind whatever the CALLER does next. Returns a
+    zero-arg resolver that performs the blocking gather and returns one
     ``np.ndarray`` per input, in order.
+
+    This is the serving tick pipeline's half of the transfer layer: the
+    micro-batcher dispatches tick N, begins its readback, and goes
+    straight back to draining tick N+1 — the resolver runs on the
+    batcher's finalizer thread, so tick N's copy wall-time overlaps tick
+    N+1's dispatch instead of serializing the consumer.
     """
     chunk_bytes = chunk_bytes or transfer_chunk_bytes()
     staged: list[list] = []
@@ -514,17 +519,38 @@ def async_readback(arrays: Sequence, chunk_bytes: int | None = None,
             CHUNK_BYTES.observe(float(getattr(p, "nbytes", 0) or 0),
                                 pipeline=name)
         staged.append(parts)
-    out: list[np.ndarray] = []
-    t0 = time.perf_counter()
-    for parts in staged:
-        if len(parts) == 1:
-            out.append(np.asarray(parts[0]))
-        else:
-            out.append(np.concatenate([np.asarray(p) for p in parts]))
-    wait_s = time.perf_counter() - t0
-    STAGE_SECONDS.observe(wait_s, pipeline=name, stage="readback")
-    # the blocking tail of the d2h fetch, on the caller's trace (the
-    # un-overlapped remainder the async copies could not hide)
-    trace.record("transfer_readback", t0, wait_s, pipeline=name,
-                 arrays=len(staged))
-    return out
+
+    def resolve() -> list[np.ndarray]:
+        out: list[np.ndarray] = []
+        t0 = time.perf_counter()
+        for parts in staged:
+            if len(parts) == 1:
+                out.append(np.asarray(parts[0]))
+            else:
+                out.append(np.concatenate([np.asarray(p) for p in parts]))
+        wait_s = time.perf_counter() - t0
+        STAGE_SECONDS.observe(wait_s, pipeline=name, stage="readback")
+        # the blocking tail of the d2h fetch, on the caller's trace (the
+        # un-overlapped remainder the async copies could not hide)
+        trace.record("transfer_readback", t0, wait_s, pipeline=name,
+                     arrays=len(staged))
+        return out
+
+    return resolve
+
+
+def async_readback(arrays: Sequence, chunk_bytes: int | None = None,
+                   name: str = "readback") -> list[np.ndarray]:
+    """Fetch device arrays to host numpy with overlapped, chunked copies.
+
+    Every row-chunk's ``copy_to_host_async`` is issued before the first
+    blocking ``np.asarray``, so the device→host copies run concurrently
+    with each other AND with any device work still queued behind the
+    arrays (jax only starts a copy once its array is ready — which is
+    exactly what lets a user-factor fetch overlap the final item-factor
+    half-step). Plain numpy arrays pass through untouched. Returns one
+    ``np.ndarray`` per input, in order. (:func:`begin_readback` is the
+    split form for callers that dispatch more device work between the
+    issue and the blocking wait.)
+    """
+    return begin_readback(arrays, chunk_bytes, name)()

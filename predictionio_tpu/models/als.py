@@ -1272,6 +1272,142 @@ def _pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
 
 
+@device_obs.profiled_program(
+    "serving_fused_topk",
+    # the device-resident serving hot program: ONE dispatch per drained
+    # micro-batcher tick. Expected compile axes: the pow2-padded batch
+    # ladder (uidx shape), the resident factor/catalog shapes, k, and the
+    # mask/no-mask branch split — the tier-1 retrace guard drives exactly
+    # this set and pins one compile per bucket under concurrent load.
+    # ``k`` and ``chunk`` are static PROGRAM axes the abstract signature
+    # cannot see — they must ride the bucket key or their recompiles
+    # would read as retraces (profiled_program docstring contract)
+    bucket=lambda user_f, item_f, uidx, k, exclude_mask=None, chunk=None: (
+        tuple(user_f.shape), tuple(item_f.shape), tuple(uidx.shape), k,
+        exclude_mask is not None, chunk),
+)
+@partial(jax.jit, static_argnames=("k", "chunk"))
+def _serving_fused_topk(user_f, item_f, uidx, k, exclude_mask=None,
+                        chunk=None):
+    from predictionio_tpu.ops.topk import fused_gather_topk
+
+    return fused_gather_topk(user_f, item_f, uidx, k=k, chunk=chunk,
+                             exclude_mask=exclude_mask)
+
+
+def serving_tick_on_device(n_queries: int, n_items: int, rank: int) -> bool:
+    """Cheap pre-gate for ``batch_predict_deferred`` implementations:
+    would a tick of this shape route to the device? Decided WITHOUT the
+    mask-upload term, which only ever makes the accelerator look worse —
+    so a False here is final (skip the per-query host prep entirely and
+    fall back), while a True still gets the exact decision, mask bytes
+    included, inside :func:`serve_top_k_batched`."""
+    bp = _pow2(max(n_queries, 1))
+    return serving_device(2.0 * bp * n_items * rank, bp * 4,
+                          overlapped=True) is None
+
+
+def pin_serving_factors(user_features, item_features,
+                        max_batch: int = 64) -> int:
+    """Deploy-time HBM promotion of an engine's factor matrices.
+
+    Puts both factor matrices device-resident through the identity cache
+    (``serving_models`` arena) so the first real serving tick finds them
+    pinned instead of paying the catalog upload inline. The decision uses
+    the batched-amortization placement model at a representative full
+    tick (``max_batch`` queries): when even an amortized tick belongs on
+    the host (``PIO_SERVING_DEVICE=cpu``, dead accelerator link), nothing
+    is pinned and 0 is returned — the host route holds. Returns the
+    pinned byte count."""
+    if not (isinstance(user_features, np.ndarray)
+            and isinstance(item_features, np.ndarray)):
+        return 0
+    n_items, rank = item_features.shape
+    bp = _pow2(max_batch)
+    place = serving_device(2.0 * bp * n_items * rank, bp * 4,
+                           overlapped=True)
+    if place is not None:
+        return 0
+    _as_device(user_features, tag="serve")
+    _as_device(item_features)
+    return int(user_features.nbytes) + int(item_features.nbytes)
+
+
+def serve_top_k_batched(user_features, item_features, uidx, k,
+                        exclude_mask=None):
+    """One FUSED device dispatch for a drained serving tick, or None.
+
+    ``uidx`` [b] are the tick's query rows into ``user_features``; the
+    factor gather, the (chunked) MIPS against the resident catalog, the
+    per-row ``exclude_mask`` [b, n_items] (seen items, blacklists,
+    category filters) and the top-k all run in ONE jitted program against
+    the HBM-pinned matrices — the host ships only the int32 row ids and
+    the masks. The batch pads to the pow2 ladder and k to pow2, so the
+    micro-batcher's varying drain sizes reuse a handful of compiled
+    programs (the post-deploy warmup compiles exactly these).
+
+    Returns None when the tick belongs on the host (the batched-
+    amortization placement decision picked the CPU backend, the catalog
+    is mesh-sharded, or the factors aren't plain host arrays) — the
+    caller then falls back to the legacy :func:`top_k_scores` route.
+    Otherwise returns a zero-arg ``finalize`` whose blocking readback the
+    caller may defer: the dispatch AND its async d2h copies
+    (io/transfer.begin_readback) are already in flight when this function
+    returns, so calling ``finalize()`` from a separate thread overlaps
+    tick N's readback with tick N+1's dispatch. ``finalize()`` returns
+    (scores [b, k], indices [b, k]) as host numpy."""
+    from predictionio_tpu.ops.topk import ShardedCatalog
+
+    if isinstance(item_features, ShardedCatalog):
+        return None  # the catalog's mesh IS the placement — legacy route
+    if not (isinstance(user_features, np.ndarray)
+            and isinstance(item_features, np.ndarray)):
+        return None
+    uidx = np.asarray(uidx, np.int32)
+    b = int(uidx.shape[0])
+    if b == 0:
+        return None
+    n_items, rank = item_features.shape
+    k = min(k, n_items)
+    if k <= 0:
+        # e.g. query num=0: nothing to dispatch — fall back to the legacy
+        # route (which answers empty) rather than minting a no-op
+        # "device" tick that would skew the route counters even under
+        # PIO_SERVING_DEVICE=cpu
+        return None
+    bp = _pow2(b)
+    upload = bp * 4  # the padded uidx row ids
+    if exclude_mask is not None:
+        exclude_mask = np.asarray(exclude_mask, bool)
+        upload += bp * n_items  # per-row bool masks ship per tick
+    place = serving_device(2.0 * bp * n_items * rank, upload,
+                           overlapped=True)
+    if place is not None:
+        return None  # host route: legacy per-tick host math wins
+    uf = _as_device(user_features, tag="serve")
+    items = _as_device(item_features)
+    kp = min(_pow2(k), n_items)
+    if bp != b:
+        # padding rows repeat the last real query's row: always a valid
+        # gather index, and their results are sliced off at finalize
+        uidx = np.concatenate([uidx, np.full(bp - b, uidx[-1], np.int32)])
+        if exclude_mask is not None:
+            exclude_mask = np.concatenate(
+                [exclude_mask, np.zeros((bp - b, n_items), bool)])
+    chunk = CHUNKED_TOPK_CHUNK if n_items > CHUNKED_TOPK_THRESHOLD else None
+    scores, idx = _serving_fused_topk(uf, items, uidx, kp, exclude_mask,
+                                      chunk)
+    from predictionio_tpu.io import transfer
+
+    resolve = transfer.begin_readback((scores, idx), name="serving")
+
+    def finalize():
+        s, i = resolve()
+        return s[:b, :k], i[:b, :k]
+
+    return finalize
+
+
 def top_k_scores(query_vecs, item_features, k: int, exclude_mask=None):
     """Batched recommend: scores = q @ Yᵀ (one MXU matmul) + lax.top_k.
     ``exclude_mask`` [b, n_items] True → drop (seen items, blacklists — the

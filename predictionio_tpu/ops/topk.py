@@ -94,6 +94,34 @@ def chunked_topk_scores(queries, items, *, k: int = 10, chunk: int = 8192,
     return best_s, best_i
 
 
+def fused_gather_topk(user_f, item_f, uidx, *, k: int, chunk: int | None = None,
+                      exclude_mask=None):
+    """One serving tick as a single traced program: gather the query rows
+    from the resident user-factor matrix, score them against the resident
+    catalog (dense, or the chunked MIPS scan when ``chunk`` is given and
+    the catalog exceeds it), apply per-row exclusion masks on device, and
+    take top-k.
+
+    user_f: [n_users, D]; item_f: [N, D]; uidx: [B] int32;
+    exclude_mask: [B, N] bool, True → drop. Returns
+    (scores [B, k], indices [B, k]).
+
+    Deliberately NOT jitted here: the serving layer (models/als.py) wraps
+    it in one ``profiled_program``-accounted jit so the whole tick —
+    gather included — is a single XLA dispatch with retrace-guarded
+    pow2 shape buckets, instead of a host-side factor gather feeding a
+    separate score program.
+    """
+    q = user_f[uidx]  # [B, D] on-device gather from the pinned factors
+    if chunk is not None and item_f.shape[0] > chunk:
+        return chunked_topk_scores(q, item_f, k=k, chunk=chunk,
+                                   exclude_mask=exclude_mask)
+    scores = q @ item_f.T  # [B, N]
+    if exclude_mask is not None:
+        scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    return lax.top_k(scores, min(k, item_f.shape[0]))
+
+
 # ---------------------------------------------------------------------------
 # Mesh-sharded catalog MIPS
 # ---------------------------------------------------------------------------

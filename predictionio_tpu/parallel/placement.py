@@ -27,6 +27,24 @@ measurements, so the first user query doesn't pay them inline.)
 
 ``PIO_SERVING_DEVICE`` overrides: ``auto`` (default), ``default`` (always
 the default JAX backend), ``cpu`` (always host).
+
+Device-resident serving (ROADMAP item 3) adds two pieces on top of the
+per-call decision:
+
+- **Pinned catalogs with explicit eviction.** The identity cache below is
+  how model state becomes HBM-resident; :func:`set_serving_instance` ties
+  its lifetime to the deployed engine instance, so a ``/reload`` hot-swap
+  evicts the previous instance's device copies *eagerly* (weakref expiry
+  — the old backstop — waits on GC, and until then a hot-swap
+  double-holds HBM: old + new catalog at once).
+- **Batched amortization.** A micro-batched serving tick pays the link
+  round trip once per *tick*, not per query, and with the overlapped
+  readback pipeline (io/transfer.begin_readback + the batcher's finalizer
+  thread) tick N's d2h copy rides behind tick N+1's dispatch — so the
+  serialized accelerator cost per tick is ``max(rtt, upload)``, not
+  ``rtt + upload``. :func:`serving_device` models that with
+  ``overlapped=True``; callers pass the whole tick's FLOPs, which is what
+  amortizes the round trip across the drained queries.
 """
 
 from __future__ import annotations
@@ -59,6 +77,9 @@ __all__ = [
     "serving_device",
     "device_cache_put",
     "host_cache_transform",
+    "evict_serving_models",
+    "set_serving_instance",
+    "serving_arena_bytes",
     "reset_measurements",
 ]
 
@@ -67,12 +88,14 @@ __all__ = [
 # Identity-keyed caches for immutable-after-training host arrays
 # ---------------------------------------------------------------------------
 
-#: (id(host array), tag, device) → (weakref to host array, cached value).
-#: Serving passes the SAME model arrays on every request; without this
-#: cache each query would re-ship them over the host link (~RTT-sized
-#: latency per call through a tunneled TPU) or redo host transforms.
-#: Entries die with their host array; cached values are treated as
-#: immutable-after-training (model state is replaced wholesale on reload).
+#: (id(host array), tag, device) → (weakref to host array, cached value,
+#: arena allocation or None). Serving passes the SAME model arrays on
+#: every request; without this cache each query would re-ship them over
+#: the host link (~RTT-sized latency per call through a tunneled TPU) or
+#: redo host transforms. Cached values are treated as immutable-after-
+#: training (model state is replaced wholesale on reload); entries are
+#: evicted EAGERLY on engine-instance change (:func:`set_serving_instance`)
+#: with weakref expiry as the backstop for arrays that die outside a swap.
 _IDENTITY_CACHE: dict = {}
 
 
@@ -86,14 +109,84 @@ def _identity_cached(arr: np.ndarray, key: tuple, build):
     alloc = None
     if key[-1] != "host":
         alloc = _SERVING_ARENA.register(val, label=str(key[1] or "model"))
+    ref = None
 
-    def _expire(_r, key=key, alloc=alloc):
-        _IDENTITY_CACHE.pop(key, None)
+    def _expire(_r):
+        # pop only if the cache still holds THIS entry: eviction may have
+        # already cleared it and a new engine instance re-keyed the slot
+        # (Allocation.free is idempotent, so the free is safe either way)
+        cur = _IDENTITY_CACHE.get(key)
+        if cur is not None and cur[0] is ref:
+            _IDENTITY_CACHE.pop(key, None)
         _SERVING_ARENA.free(alloc)
 
     ref = weakref.ref(arr, _expire)
-    _IDENTITY_CACHE[key] = (ref, val)
+    _IDENTITY_CACHE[key] = (ref, val, alloc)
     return val
+
+
+def evict_serving_models() -> int:
+    """Eagerly drop every identity-cached device copy and host transform,
+    freeing their ``serving_models`` arena registrations; returns the HBM
+    bytes released. The device buffers themselves die when the last
+    in-flight serving call's references go — what this guarantees is that
+    the *cache* no longer pins them, so a hot-swap never double-holds old
+    and new catalogs for longer than the queries already in flight."""
+    freed = 0
+    while _IDENTITY_CACHE:
+        try:
+            _key, (ref, _val, alloc) = _IDENTITY_CACHE.popitem()
+        except KeyError:  # racing weakref expiry
+            break
+        if alloc is not None and not alloc.freed:
+            freed += alloc.nbytes
+            _SERVING_ARENA.free(alloc)
+    return freed
+
+
+#: Engine instance the pinned serving state belongs to (None before the
+#: first deploy).
+_serving_instance: dict = {"id": None}
+
+
+def current_serving_instance():
+    """The instance id last declared via :func:`set_serving_instance`
+    (None before the first deploy) — promotion threads check it to
+    notice a hot-swap racing past them."""
+    return _serving_instance["id"]
+
+
+def set_serving_instance(instance_id) -> int:
+    """Declare the engine instance now being served. On a CHANGE (a
+    ``/reload`` hot-swap), every cached device copy of the previous
+    instance's model state is evicted eagerly — stale catalogs must not
+    linger in the ``serving_models`` arena until GC notices the old host
+    arrays died. Returns the HBM bytes evicted (0 on first deploy or
+    same-instance redeploys).
+
+    Scope: PROCESS-global, like the identity cache itself — one deployed
+    engine instance per process is the serving topology (gateway
+    replicas are separate processes or share one instance id). A second
+    QueryService deploying a *different* instance in the same process
+    evicts the first's pins; the first simply re-caches on its next tick
+    (latency churn, never wrong results), which is the deliberate trade
+    against per-entry instance bookkeeping."""
+    prev = _serving_instance["id"]
+    _serving_instance["id"] = instance_id
+    if prev is not None and instance_id != prev:
+        freed = evict_serving_models()
+        if freed:
+            logger.info(
+                "serving instance %s -> %s: evicted %d bytes of pinned "
+                "device model state", prev, instance_id, freed)
+        return freed
+    return 0
+
+
+def serving_arena_bytes() -> int:
+    """Live bytes attributed to the ``serving_models`` HBM arena — the
+    gauge the hot-swap acceptance pins (before == after a /reload)."""
+    return _SERVING_ARENA.bytes()
 
 
 def device_cache_put(arr, tag: str = "", transform=None, device=None):
@@ -369,13 +462,24 @@ def _cpu_device():
         return None
 
 
-def serving_device(flops: float, upload_bytes: float = 0.0):
+def serving_device(flops: float, upload_bytes: float = 0.0,
+                   overlapped: bool = False):
     """Device to run a serving call of ``flops`` on, or None for the
     default backend. Decision per the module docstring's cost model;
     ``upload_bytes`` (the query batch the call must ship host->device)
     adds a measured-uplink term to the accelerator side, so large drained
     micro-batches over a slow link don't get mis-placed by the bare
-    one-RTT approximation."""
+    one-RTT approximation.
+
+    ``overlapped=True`` is the batched-amortization form for micro-
+    batched serving ticks: the caller passes the WHOLE tick's FLOPs (one
+    round trip amortizes across every drained query), and because the
+    overlapped-readback pipeline hides tick N's d2h copy behind tick
+    N+1's dispatch, the serialized accelerator cost per tick is
+    ``max(rtt, upload)`` — only the longer of the two link legs stays on
+    the critical path — instead of ``rtt + upload``. This is what lets
+    ``auto`` pick the accelerator under concurrency where the per-query
+    sequential decision correctly stays on the host."""
     mode = os.environ.get("PIO_SERVING_DEVICE", "auto")
     if mode == "default":
         return None
@@ -394,9 +498,9 @@ def serving_device(flops: float, upload_bytes: float = 0.0):
         return cpu
     if default_is_cpu:
         return None
-    accel_cost = link_rtt() + (
-        upload_bytes / uplink_rate() if upload_bytes else 0.0
-    )
+    upload_s = upload_bytes / uplink_rate() if upload_bytes else 0.0
+    rtt = link_rtt()
+    accel_cost = max(rtt, upload_s) if overlapped else rtt + upload_s
     if flops / host_flops_rate() > accel_cost:
         return None  # accelerator FLOPs out-pay round trip + upload
     return cpu

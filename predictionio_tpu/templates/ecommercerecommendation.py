@@ -259,19 +259,15 @@ class ECommAlgorithm(P2LAlgorithm):
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
 
-    def batch_predict(self, model: ECommModel, queries):
-        """Micro-batched serving. The serve-time event-store reads
-        (unavailable items, seen items, recent views — host I/O) stay
-        per-query like the reference's predict (ref ALSAlgorithm.scala
-        :194-221); the device work batches into at most two calls per
-        drained batch: one top_k_scores for warm users, one top_k_cosine
-        for cold-start users."""
+    def _prep_batch(self, model: ECommModel, queries):
+        """Per-query host work for one drained batch: event-store reads
+        and mask builds, memoized by query OBJECT identity (the serving
+        layer pads a drained batch by repeating its LAST query object, so
+        duplicates are free). Returns ``(out, warm, cold)`` — resolved
+        empty results plus the warm/cold row plans."""
         out = []
         warm = []  # (index, query, uidx, mask)
         cold = []  # (index, query, mean-vec, mask)
-        # the serving layer pads a drained batch by repeating its LAST
-        # query object — memoize the per-query host work (event-store
-        # reads, mask build) by object identity so duplicates are free
         prepped: dict[int, tuple] = {}
         for i, q in queries:
             hit = prepped.get(id(q))
@@ -301,6 +297,78 @@ class ECommAlgorithm(P2LAlgorithm):
                 cold.append((i, q, hit[1], hit[2]))
             else:
                 out.append((i, PredictedResult(())))
+        return out, warm, cold
+
+    # -- device-resident serving protocol (ROADMAP item 3) -------------------
+
+    def pin_serving_state(self, model: ECommModel,
+                          max_batch: int = 64) -> int:
+        """Deploy-time HBM promotion of the warm-path catalogs (the
+        cold-start cosine route keeps its own identity-cached normalized
+        catalog and stays on the legacy path). ``max_batch`` is the
+        server's drain ceiling, the tick the placement decision
+        amortizes over."""
+        from predictionio_tpu.models.als import pin_serving_factors
+
+        return pin_serving_factors(
+            model.user_features, model.item_features, max_batch=max_batch)
+
+    def batch_predict_deferred(self, model: ECommModel, queries):
+        """Device-resident tick for WARM-only drained batches: the factor
+        gather, the per-row seen-item/constraint masks (host event-store
+        reads stay per query — only the mask APPLICATION moves on device)
+        and the top-k run as one fused dispatch with deferred readback.
+        Any cold-start rider in the batch falls back to the legacy
+        two-call path (its query vector is a host mean over recent
+        views, a different program); such mixed ticks pay the host prep
+        twice — once here to discover the cold rider, once on the
+        fallback — the deliberate trade for keeping warm-majority
+        traffic on the one-dispatch route."""
+        from predictionio_tpu.models.als import (
+            serve_top_k_batched,
+            serving_tick_on_device,
+        )
+
+        # pre-gate BEFORE the per-query host prep: host-routed ticks
+        # (PIO_SERVING_DEVICE=cpu, high-RTT link) must not pay the
+        # event-store reads twice — here and on the legacy fallback
+        if not serving_tick_on_device(
+                len(queries), len(model.item_ids),
+                model.item_features.shape[1]):
+            return None
+        out, warm, cold = self._prep_batch(model, queries)
+        if cold or not warm:
+            return None
+        uidx = np.array([u for _, _, u, _ in warm], np.int32)
+        masks = np.concatenate([m for _, _, _, m in warm], axis=0)
+        k = min(max(q.num for _, q, _, _ in warm), len(model.item_ids))
+        finalize = serve_top_k_batched(
+            model.user_features, model.item_features, uidx, k, masks)
+        if finalize is None:
+            return None
+
+        def resolve():
+            scores, idx = finalize()
+            res = list(out)
+            for row, (i, q, _u, _m) in enumerate(warm):
+                res.append(
+                    (i, PredictedResult(topk_to_item_scores(
+                        scores[row], idx[row], model.item_ids, q.num,
+                        ItemScore,
+                    )))
+                )
+            return res
+
+        return resolve
+
+    def batch_predict(self, model: ECommModel, queries):
+        """Micro-batched serving. The serve-time event-store reads
+        (unavailable items, seen items, recent views — host I/O) stay
+        per-query like the reference's predict (ref ALSAlgorithm.scala
+        :194-221); the device work batches into at most two calls per
+        drained batch: one top_k_scores for warm users, one top_k_cosine
+        for cold-start users."""
+        out, warm, cold = self._prep_batch(model, queries)
 
         def emit(rows, scores, idx):
             for row, (i, q, _x, _m) in enumerate(rows):

@@ -31,7 +31,14 @@ from predictionio_tpu.core.metrics import OptionAverageMetric
 from predictionio_tpu.core.params import Params
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.data.store import PEventStore
-from predictionio_tpu.models.als import ALS, ALSFactors, ALSParams, top_k_scores
+from predictionio_tpu.models.als import (
+    ALS,
+    ALSFactors,
+    ALSParams,
+    pin_serving_factors,
+    serve_top_k_batched,
+    top_k_scores,
+)
 from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.parallel.mesh import ComputeContext
 
@@ -377,6 +384,26 @@ class ALSAlgorithm(PAlgorithm):
             item_categories=getattr(model, "item_categories", {}),
         )
 
+    def _stacked_masks(self, model: ALSModel, queries_seq):
+        """[b, n_items] exclusion mask stack for a batch's queries, or
+        None when no query filters. Memoized per query OBJECT: the
+        serving layer pads drained batches by repeating the LAST query,
+        and mask building is a catalog-sized host loop."""
+        mask_memo: dict[int, object] = {}
+        masks = []
+        for q in queries_seq:
+            if id(q) not in mask_memo:
+                mask_memo[id(q)] = self._query_mask(model, q)
+            masks.append(mask_memo[id(q)])
+        if not any(m is not None for m in masks):
+            return None
+        n = len(model.item_ids)
+        return np.concatenate(
+            [m if m is not None else np.zeros((1, n), bool)
+             for m in masks],
+            axis=0,
+        )
+
     def batch_predict(self, model: ALSModel, queries):
         """Batched serving/eval path: one matmul for all known users,
         with per-query variant filters stacked into one mask."""
@@ -386,23 +413,7 @@ class ALSAlgorithm(PAlgorithm):
         if known:
             uidx = np.array([model.user_ids(q.user) for _, q in known], np.int32)
             k = min(max(q.num for _, q in known), len(model.item_ids))
-            # memoize per query object: the serving layer pads drained
-            # batches by repeating the LAST query, and mask building is a
-            # catalog-sized host loop
-            mask_memo: dict[int, object] = {}
-            masks = []
-            for _, q in known:
-                if id(q) not in mask_memo:
-                    mask_memo[id(q)] = self._query_mask(model, q)
-                masks.append(mask_memo[id(q)])
-            exclude = None
-            if any(m is not None for m in masks):
-                n = len(model.item_ids)
-                exclude = np.concatenate(
-                    [m if m is not None else np.zeros((1, n), bool)
-                     for m in masks],
-                    axis=0,
-                )
+            exclude = self._stacked_masks(model, [q for _, q in known])
             scores, idx = top_k_scores(
                 model.factors.user_features[uidx],
                 model.factors.item_features, k, exclude,
@@ -419,6 +430,72 @@ class ALSAlgorithm(PAlgorithm):
                     )))
                 )
         return out
+
+    # -- device-resident serving protocol (ROADMAP item 3) -------------------
+
+    def pin_serving_state(self, model: ALSModel, max_batch: int = 64) -> int:
+        """Deploy-time HBM promotion: pin both factor matrices device-
+        resident (``serving_models`` arena) so the first serving tick
+        finds its catalogs warm. ``max_batch`` is the server's configured
+        drain ceiling — the representative tick the placement decision
+        amortizes over. Returns the pinned byte count (0 = the placement
+        decision keeps serving on the host)."""
+        return pin_serving_factors(
+            model.factors.user_features, model.factors.item_features,
+            max_batch=max_batch)
+
+    def batch_predict_deferred(self, model: ALSModel, queries):
+        """Device-resident serving tick: the factor gather, MIPS, per-row
+        masks and top-k for the whole drained batch run as ONE fused
+        device program against the HBM-pinned catalogs, and the blocking
+        readback is deferred (the server's finalizer thread overlaps it
+        with the next tick's dispatch). Returns None whenever the fused
+        route does not apply — host placement, no known users — and the
+        server falls back to :meth:`batch_predict`; the resolved results
+        are exactly the host route's (parity pinned in test_query_server).
+        """
+        from predictionio_tpu.models.als import serving_tick_on_device
+
+        known = [(i, q) for i, q in queries if q.user in model.user_ids]
+        if not known:
+            return None  # nothing to dispatch: the legacy path is free
+        # pre-gate BEFORE the per-query host prep: a host-routed tick
+        # (PIO_SERVING_DEVICE=cpu, high-RTT link at this tick size) must
+        # not pay the mask builds twice — here and again in the
+        # batch_predict fallback
+        if not serving_tick_on_device(
+                len(known), len(model.item_ids),
+                model.factors.item_features.shape[1]):
+            return None
+        uidx = np.array([model.user_ids(q.user) for _, q in known], np.int32)
+        k = min(max(q.num for _, q in known), len(model.item_ids))
+        exclude = self._stacked_masks(model, [q for _, q in known])
+        finalize = serve_top_k_batched(
+            model.factors.user_features, model.factors.item_features,
+            uidx, k, exclude,
+        )
+        if finalize is None:
+            return None
+        out = [(i, PredictedResult(())) for i, q in queries
+               if q.user not in model.user_ids]
+
+        def resolve():
+            scores, idx = finalize()
+            from predictionio_tpu.models.serving_filters import (
+                topk_to_item_scores,
+            )
+
+            res = list(out)
+            for row, (i, q) in enumerate(known):
+                res.append(
+                    (i, PredictedResult(topk_to_item_scores(
+                        scores[row], idx[row], model.item_ids, q.num,
+                        ItemScore,
+                    )))
+                )
+            return res
+
+        return resolve
 
 
 # -- serving ----------------------------------------------------------------

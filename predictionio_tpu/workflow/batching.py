@@ -13,6 +13,14 @@ Greedy drain, no timed window: an idle server answers a lone query
 immediately (zero added latency); batches form exactly when concurrency
 exists — while one batch is on the device, arrivals accumulate and become
 the next batch.
+
+Device-resident ticks (ROADMAP item 3) add a second pipeline stage:
+``process_batch`` may return a :class:`DeferredBatch` — the tick's fused
+device dispatch and its async d2h copies are already enqueued, but the
+blocking readback is not. The consumer hands it to a dedicated finalizer
+thread and immediately drains the next batch, so tick N's device→host
+copy (and its per-query serve) overlaps tick N+1's dispatch instead of
+serializing the consumer behind the link round trip.
 """
 
 from __future__ import annotations
@@ -26,7 +34,7 @@ from typing import Callable, Sequence
 from predictionio_tpu.obs import REGISTRY, trace
 from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
 
-__all__ = ["MicroBatcher"]
+__all__ = ["DeferredBatch", "MicroBatcher"]
 
 # Serving telemetry. queue_wait is a stage of the same histogram the
 # query server's other stages land in — ONE definition here, imported by
@@ -34,7 +42,8 @@ __all__ = ["MicroBatcher"]
 # registrants (a mismatch would raise at import time).
 QUERY_STAGE_SECONDS = REGISTRY.histogram(
     "pio_query_stage_seconds",
-    "Per-stage query latency: parse, queue_wait, predict, serve, feedback",
+    "Per-stage query latency: parse, queue_wait, predict, readback, "
+    "serve, feedback (readback only on device-resident deferred ticks)",
     labels=("stage",),
 )
 _BATCH_SIZE = REGISTRY.histogram(
@@ -46,6 +55,40 @@ _QUEUE_DEPTH = REGISTRY.gauge(
     "pio_microbatch_queue_depth",
     "Submitted queries still waiting after the last drain (occupancy)",
 )
+_SERVING_TICKS = REGISTRY.counter(
+    "pio_serving_ticks_total",
+    "Drained micro-batch ticks by serving route: device = one fused "
+    "device-resident dispatch with deferred readback, host = legacy "
+    "host-path predict",
+    labels=("route",),
+)
+_OVERLAPPED_READBACKS = REGISTRY.counter(
+    "pio_serving_overlapped_readbacks_total",
+    "Device ticks whose dispatch ran while a previous tick's readback/"
+    "finalize was still in flight — the overlap the deferred pipeline "
+    "buys over a serialized consumer",
+)
+
+
+class DeferredBatch:
+    """``process_batch`` may return this instead of a results list.
+
+    Contract: the drained batch's device dispatch (and its async d2h
+    copies) are already ENQUEUED; ``finalize()`` performs the blocking
+    readback plus any per-query tail work and returns the results list
+    (an Exception instance fails only its own rider; a raise fails the
+    whole batch — exactly the list-return error contract). The batcher
+    runs ``finalize`` on its finalizer thread, so the consumer is free to
+    drain the next tick meanwhile. ``finalize`` may set ``stage_marks``
+    (``[(stage, start, duration), ...]``) on the instance before
+    returning; the finalizer replays them as retro per-rider trace
+    spans, mirroring ``MicroBatcher.last_stage_marks``."""
+
+    __slots__ = ("finalize", "stage_marks")
+
+    def __init__(self, finalize: Callable[[], list]):
+        self.finalize = finalize
+        self.stage_marks: list[tuple[str, float, float]] | None = None
 
 
 class MicroBatcher:
@@ -76,8 +119,19 @@ class MicroBatcher:
         #: — every request on the batch gets its own predict/serve spans
         #: even though the device call happened once.
         self.last_stage_marks: list[tuple[str, float, float]] | None = None
+        #: deferred-tick accounting (bench_serving reads these): ticks
+        #: served by the fused device route, and how many of them
+        #: dispatched while a previous tick's readback was in flight
+        self.device_ticks = 0
+        self.overlapped_ticks = 0
+        self._inflight_finalizes = 0
+        self._finalize_lock = threading.Lock()
+        self._finalize_q: queue.SimpleQueue = queue.SimpleQueue()
         self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
         self._thread.start()
+        self._finalizer = threading.Thread(
+            target=self._finalize_loop, daemon=True, name=name + "-finalize")
+        self._finalizer.start()
 
     def submit(self, item):
         """Block until the consumer thread has processed ``item``; returns
@@ -122,11 +176,32 @@ class MicroBatcher:
             self.request_count += len(items)
             self.max_batch_seen = max(self.max_batch_seen, len(items))
             self.last_stage_marks = None
+            with self._finalize_lock:
+                readback_inflight = self._inflight_finalizes > 0
             try:
                 with trace.child_span(lead_ctx, "batch",
                                       batch_id=batch_id,
                                       batch_size=len(pairs)):
                     results = self._process(items)
+                if isinstance(results, DeferredBatch):
+                    # the tick's dispatch + async d2h are in flight; hand
+                    # the blocking readback to the finalizer thread and
+                    # go straight back to draining the next tick
+                    with self._finalize_lock:
+                        self._inflight_finalizes += 1
+                    self.device_ticks += 1
+                    _SERVING_TICKS.inc(route="device")
+                    if readback_inflight:
+                        # a previous tick's readback/finalize was still
+                        # running while THIS dispatch executed: the link
+                        # round trip got hidden, which is the pipeline's
+                        # whole point — count it
+                        self.overlapped_ticks += 1
+                        _OVERLAPPED_READBACKS.inc()
+                    self._finalize_q.put(
+                        (pairs, futures, batch_id, results))
+                    continue
+                _SERVING_TICKS.inc(route="host")
                 if len(results) != len(items):
                     raise RuntimeError(
                         f"process_batch returned {len(results)} results "
@@ -150,3 +225,40 @@ class MicroBatcher:
                     f.set_exception(r)
                 else:
                     f.set_result(r)
+
+    def _finalize_loop(self) -> None:
+        """Second pipeline stage: blocking readback + per-query tail of
+        deferred ticks, strictly FIFO, off the consumer thread. A
+        finalize that raises fails ONLY its own batch's riders — the
+        drained-batch failure contract carries over unchanged — and the
+        loop keeps serving later ticks."""
+        while True:
+            pairs, futures, batch_id, deferred = self._finalize_q.get()
+            try:
+                try:
+                    results = deferred.finalize()
+                    if len(results) != len(futures):
+                        raise RuntimeError(
+                            f"finalize returned {len(results)} results "
+                            f"for {len(futures)} items"
+                        )
+                except Exception as e:
+                    for f in futures:
+                        f.set_exception(e)
+                    continue
+                # replay the deferred tick's stage marks as retro spans
+                # per rider BEFORE releasing the futures (same ordering
+                # contract as the eager path's last_stage_marks replay)
+                for stage, start, duration in deferred.stage_marks or ():
+                    for _, _, _, ctx in pairs:
+                        trace.record_span(ctx, stage, start, duration,
+                                          batch_id=batch_id,
+                                          batch_size=len(pairs))
+                for f, r in zip(futures, results):
+                    if isinstance(r, Exception):
+                        f.set_exception(r)
+                    else:
+                        f.set_result(r)
+            finally:
+                with self._finalize_lock:
+                    self._inflight_finalizes -= 1

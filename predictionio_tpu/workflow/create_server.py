@@ -48,6 +48,7 @@ from predictionio_tpu.utils.http import (
 from predictionio_tpu.utils.time import format_datetime, now
 from predictionio_tpu.workflow.batching import (
     QUERY_STAGE_SECONDS as _STAGE_SECONDS,
+    DeferredBatch,
 )
 from predictionio_tpu.workflow.context import workflow_context
 from predictionio_tpu.workflow.engine_loader import get_engine
@@ -255,6 +256,7 @@ class QueryService:
             ctx, engine_params, instance.id, persisted, WorkflowParams()
         )
         from predictionio_tpu.core.engine import _instantiate
+        from predictionio_tpu.parallel import placement
 
         algo_instances = engine._algorithms(engine_params)
         serving = _instantiate(engine.serving_class, engine_params.serving_params)
@@ -268,10 +270,66 @@ class QueryService:
             # fresh models mean fresh device programs: let the next query
             # re-trigger the batch-shape warmup
             self._batch_shapes_warmed = False
+            # the previous instance's HBM-pinned catalogs are evicted
+            # EAGERLY on the swap (not left to weakref/GC), so a hot-swap
+            # never double-holds old + new device model state
+            self.last_evicted_bytes = placement.set_serving_instance(
+                instance.id)
+        self._start_serving_promotion()
         logger.info(
             "deployed engine instance %s (trained %s)",
             instance.id, format_datetime(instance.start_time),
         )
+
+    def _start_serving_promotion(self) -> None:
+        """Deploy-time HBM promotion (ROADMAP item 3): pin the fresh
+        engine's factor catalogs device-resident on a background thread
+        — through a tunneled accelerator the catalog puts are RTT-bound,
+        and they must not gate the deploy or the first query. Algorithms
+        opt in via a ``pin_serving_state(model) -> int`` method; the
+        promotion itself goes through the same identity cache the serve
+        route uses, so the first tick simply finds its catalogs warm."""
+        from predictionio_tpu.parallel import placement
+
+        with self.lock:
+            algorithms = self.algorithms
+            models = self.models
+            instance_id = self.instance.id
+        max_batch = self.config.max_batch
+
+        def promote():
+            pinned = 0
+            for algo, model in zip(algorithms, models):
+                # a /reload racing past this thread already evicted the
+                # instance these models belong to — pinning them now
+                # would resurrect stale catalogs in the arena
+                if placement.current_serving_instance() != instance_id:
+                    return
+                pin = getattr(algo, "pin_serving_state", None)
+                if pin is None:
+                    continue
+                try:
+                    # the pin decision must see the REAL tick ceiling:
+                    # --max-batch bounds both the drain and the
+                    # amortization the placement model charges
+                    pinned += int(pin(model, max_batch=max_batch) or 0)
+                except Exception:  # promotion must never sink a deploy
+                    logger.debug("serving-state promotion failed",
+                                 exc_info=True)
+            if placement.current_serving_instance() != instance_id:
+                # swap landed between our pins: drop everything — the
+                # new instance's ticks re-pin their own catalogs lazily,
+                # and the arena must never hold two instances at once
+                placement.evict_serving_models()
+                return
+            if pinned:
+                logger.info(
+                    "pinned %d bytes of serving model state device-"
+                    "resident (serving_models arena)", pinned)
+
+        threading.Thread(
+            target=promote, name="serving-promote", daemon=True
+        ).start()
 
     # -- routes -------------------------------------------------------------
     def _build_router(self) -> Router:
@@ -319,6 +377,10 @@ class QueryService:
                 "batches": self.batcher.batch_count,
                 "requests": self.batcher.request_count,
                 "maxBatchSize": self.batcher.max_batch_seen,
+                # device-resident serving: fused-dispatch ticks and how
+                # many overlapped a previous tick's readback
+                "deviceTicks": self.batcher.device_ticks,
+                "overlappedReadbacks": self.batcher.overlapped_ticks,
             }
         return 200, body
 
@@ -519,7 +581,11 @@ class QueryService:
             sizes.append(top)  # the exact max drain, pow2 or not
             for s in sizes:
                 try:
-                    self._predict_batch_shared([query] * s)
+                    r = self._predict_batch_shared([query] * s)
+                    if isinstance(r, DeferredBatch):
+                        # resolve inline: the warmup must compile AND run
+                        # the fused program + readback for this shape
+                        r.finalize()
                 except Exception:  # warmup must never surface
                     logger.debug("batch warmup failed", exc_info=True)
                     return
@@ -540,7 +606,16 @@ class QueryService:
                 return [e]
             out = []
             for q in queries:
-                out.extend(self._predict_batch([q]))
+                r = self._predict_batch([q])
+                if isinstance(r, DeferredBatch):
+                    # the error-burst path resolves deferred singletons
+                    # inline — overlap is a steady-state optimization and
+                    # this path must keep its simple list contract
+                    try:
+                        r = r.finalize()
+                    except Exception as ee:  # noqa: BLE001
+                        r = [ee]
+                out.extend(r)
             if self.batcher is not None:
                 # every singleton re-run above overwrote the shared
                 # stage marks with ITS timings; replaying the last one
@@ -550,29 +625,33 @@ class QueryService:
                 self.batcher.last_stage_marks = None
             return out
 
-    def _predict_batch_shared(self, queries: list) -> list:
+    def _predict_batch_shared(self, queries: list):
         """One supplement + one (batched) predict per algorithm over the
         whole drained batch; serve per query. Per-query serve errors fail
         only their own request.
 
-        Batches are PADDED to a power of two (repeating the last query) so
-        the micro-batcher's arbitrary drain sizes map onto a handful of
-        device program shapes — these are exactly the shapes the
-        post-deploy warmup compiles. The device lock serializes this path
-        with the background warmup (one batch on the device at a time, the
-        micro-batcher's own invariant)."""
+        Device-resident route (ROADMAP item 3): a lone algorithm exposing
+        ``batch_predict_deferred`` gets the tick dispatched as ONE fused
+        device program against its HBM-pinned catalogs, and this method
+        returns a :class:`DeferredBatch` — the batcher's finalizer thread
+        then overlaps the blocking readback (+ per-query serve) with the
+        next tick's dispatch. The algorithm returns None whenever the
+        placement decision keeps the tick on the host, which falls
+        through to the legacy path below.
+
+        Legacy batches are PADDED to a power of two (repeating the last
+        query) so the micro-batcher's arbitrary drain sizes map onto a
+        handful of device program shapes — these are exactly the shapes
+        the post-deploy warmup compiles; the deferred route pads its
+        device operands to the same ladder internally. The device lock
+        serializes dispatch with the background warmup (one batch on the
+        device at a time, the micro-batcher's own invariant)."""
         with self.lock:
             algorithms = self.algorithms
             models = self.models
             serving = self.serving
         n = len(queries)
-        padded = queries
-        if n > 1:
-            bp = 1 << (n - 1).bit_length()
-            if bp != n:
-                padded = queries + [queries[-1]] * (bp - n)
-        supplemented = [serving.supplement(q) for q in padded]
-        per_algo: list[list] = []
+        supplemented = [serving.supplement(q) for q in queries]
         # timing starts AFTER the lock (waiting for the device is queueing,
         # not device time) and observes only on SUCCESS: a poisoned batch
         # raises here and gets re-run per query by _predict_batch — an
@@ -580,16 +659,39 @@ class QueryService:
         # skew its quantiles exactly during error bursts
         with self._device_lock:
             t_pred = time.perf_counter()
+            if len(algorithms) == 1:
+                deferred = getattr(
+                    algorithms[0], "batch_predict_deferred", None)
+                if deferred is not None:
+                    pending = deferred(
+                        models[0], list(enumerate(supplemented)))
+                    if pending is not None:
+                        # dispatch + async d2h are enqueued; the stage
+                        # covers exactly the device-call hand-off (the
+                        # readback tail gets its own stage below)
+                        pred_s = time.perf_counter() - t_pred
+                        _observe_stage("predict", pred_s, times=n)
+                        return self._deferred_batch(
+                            queries, pending, serving, n, t_pred, pred_s)
+            padded = supplemented
+            if n > 1:
+                bp = 1 << (n - 1).bit_length()
+                if bp != n:
+                    # repeat the last SUPPLEMENTED object: pad rows stay
+                    # identity-equal to a real one, so per-query host
+                    # work memoized by id() (mask builds) is free
+                    padded = supplemented + [supplemented[-1]] * (bp - n)
+            per_algo: list[list] = []
             for algo, model in zip(algorithms, models):
                 if n > 1 and self._overrides_batch_predict(algo):
                     indexed = algo.batch_predict(
-                        model, list(enumerate(supplemented))
+                        model, list(enumerate(padded))
                     )
                     got = dict(indexed)
                     per_algo.append([got[i] for i in range(n)])
                 else:
                     per_algo.append(
-                        [algo.predict(model, q) for q in supplemented[:n]]
+                        [algo.predict(model, q) for q in supplemented]
                     )
             pred_s = time.perf_counter() - t_pred
             _observe_stage("predict", pred_s, times=n)
@@ -611,6 +713,39 @@ class QueryService:
             self.batcher.last_stage_marks = [
                 ("predict", t_pred, pred_s), ("serve", t_serve, serve_s)]
         return out
+
+    def _deferred_batch(self, queries: list, pending, serving, n: int,
+                        t_pred: float, pred_s: float) -> DeferredBatch:
+        """Wrap a device-resident tick's pending results for the batcher's
+        finalizer thread: blocking readback, per-query serve (errors
+        isolated per rider), stage observations and retro span marks all
+        happen there — overlapped with the consumer's next dispatch."""
+
+        def finalize() -> list:
+            t_rb = time.perf_counter()
+            got = dict(pending())
+            preds = [got[i] for i in range(n)]
+            rb_s = time.perf_counter() - t_rb
+            _observe_stage("readback", rb_s, times=n)
+            t_serve = time.perf_counter()
+            out: list = []
+            for i, query in enumerate(queries):
+                try:
+                    out.append(serving.serve(query, [preds[i]]))
+                except Exception as e:  # noqa: BLE001 — per-request
+                    out.append(e)
+            serve_s = time.perf_counter() - t_serve
+            _observe_stage("serve", serve_s, times=n)
+            if not getattr(_warmup_thread, "active", False):
+                d.stage_marks = [
+                    ("predict", t_pred, pred_s),
+                    ("readback", t_rb, rb_s),
+                    ("serve", t_serve, serve_s),
+                ]
+            return out
+
+        d = DeferredBatch(finalize)
+        return d
 
     def _send_feedback(self, query_json: dict, result) -> str | None:
         """POST the predict event back to the Event Server with prId
@@ -676,10 +811,18 @@ class QueryService:
         ).start()
 
     def get_reload(self, request: Request):
-        """Hot-swap to the latest completed instance (ref: ReloadServer)."""
+        """Hot-swap to the latest completed instance (ref: ReloadServer).
+        ``evictedBytes`` reports the previous instance's device-pinned
+        model state released by the swap — the operator-visible proof the
+        serving_models arena holds exactly one instance's catalogs."""
         old = self.instance.id
         self._load()
-        return 200, {"reloaded": True, "previous": old, "current": self.instance.id}
+        return 200, {
+            "reloaded": True,
+            "previous": old,
+            "current": self.instance.id,
+            "evictedBytes": self.last_evicted_bytes,
+        }
 
     def get_stop(self, request: Request):
         self._stop_event.set()

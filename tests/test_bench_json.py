@@ -59,6 +59,11 @@ def test_serving_bench_dry_run_last_stdout_line_is_the_headline_json():
     assert doc["unit"] == "ms"
     # the tracing-off overhead guard figure must always ride the headline
     assert "trace_overhead_frac" in doc["extra"]
+    # ISSUE 8: the device-resident-serving keys ride every capture —
+    # dry runs emit them as explicit nulls so the schema is stable
+    for key in ("serve_placement", "serve_device_qps",
+                "serve_device_p50_ms", "serve_readback_overlap_frac"):
+        assert key in doc["extra"] and doc["extra"][key] is None
 
 
 def test_serving_bench_gateway_dry_run_uses_gateway_metric_name():

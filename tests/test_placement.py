@@ -50,6 +50,62 @@ def test_cost_model_crossover(monkeypatch):
     assert placement.serving_device(1e10) is None
 
 
+def test_cost_model_batched_amortization_term(monkeypatch):
+    """``overlapped=True`` (micro-batched ticks with deferred readback)
+    charges the accelerator ``max(rtt, upload)`` instead of
+    ``rtt + upload``: the tick's d2h copy rides behind the next tick's
+    dispatch, so only the longer link leg stays on the critical path.
+    A tick that loses sequentially can win amortized."""
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    monkeypatch.setattr(placement.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(placement, "link_rtt", lambda: 0.1)
+    monkeypatch.setattr(placement, "uplink_rate", lambda: 1e6)  # B/s
+    monkeypatch.setattr(placement, "host_flops_rate", lambda: 1e10)
+    flops, upload = 1.2e9, 50_000  # host 120 ms; rtt 100 ms + upload 50 ms
+    # sequential: 120 ms host < 150 ms (rtt + upload) -> host
+    assert placement.serving_device(flops, upload) is not None
+    # overlapped tick: 120 ms host > 100 ms max(rtt, upload) -> device
+    assert placement.serving_device(flops, upload, overlapped=True) is None
+
+
+def test_set_serving_instance_evicts_pinned_state_eagerly():
+    """An engine-instance change must evict the identity cache's device
+    copies EAGERLY (freeing their serving_models arena bytes), not wait
+    for weakref/GC — and re-caching after the swap starts cold."""
+    arr = np.ones((8, 4), np.float32)
+    placement.evict_serving_models()  # isolate from other tests' pins
+    placement.set_serving_instance("inst-a")
+    base = placement.serving_arena_bytes()
+    a = placement.device_cache_put(arr, tag="swap-test")
+    assert placement.device_cache_put(arr, tag="swap-test") is a
+    assert placement.serving_arena_bytes() == base + arr.nbytes
+    assert placement.set_serving_instance("inst-a") == 0  # same: no evict
+    freed = placement.set_serving_instance("inst-b")
+    assert freed >= arr.nbytes  # the pinned copy came down with the swap
+    assert placement.serving_arena_bytes() == 0
+    b = placement.device_cache_put(arr, tag="swap-test")
+    assert b is not a  # cold: the evicted entry is gone, not resurrected
+    placement.evict_serving_models()
+    placement.set_serving_instance(None)
+
+
+def test_evict_serving_models_idempotent_with_weakref_backstop():
+    """Eager eviction and the weakref-expiry backstop must compose:
+    evicting then dropping the host array double-frees nothing (the
+    arena gauge stays balanced)."""
+    import gc
+
+    arr = np.ones((16, 4), np.float32)
+    placement.evict_serving_models()
+    placement.device_cache_put(arr, tag="backstop-test")
+    assert placement.serving_arena_bytes() >= arr.nbytes
+    assert placement.evict_serving_models() >= arr.nbytes
+    assert placement.serving_arena_bytes() == 0
+    del arr  # weakref fires after eviction: Allocation.free is idempotent
+    gc.collect()
+    assert placement.serving_arena_bytes() == 0
+
+
 def test_link_rtt_zero_on_cpu_backend():
     assert placement.link_rtt() == 0.0
 

@@ -477,6 +477,178 @@ def test_request_id_propagates_to_feedback_event(memory_storage):
         es.stop()
 
 
+def _wait_for_thread(name: str, timeout: float = 30.0) -> None:
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline and any(
+        t.name == name for t in threading.enumerate()
+    ):
+        _time.sleep(0.05)
+    assert name not in [t.name for t in threading.enumerate()]
+
+
+def _als_model(n_users=20, n_items=50, rank=8, seed=0, categories=None):
+    """A hand-built ALSModel for route-parity tests (no training)."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSFactors
+    from predictionio_tpu.templates.recommendation import ALSModel
+
+    rng = np.random.default_rng(seed)
+    factors = ALSFactors(
+        rng.normal(size=(n_users, rank)).astype(np.float32),
+        rng.normal(size=(n_items, rank)).astype(np.float32),
+    )
+    users = BiMap.string_int(f"u{i}" for i in range(n_users))
+    items = BiMap.string_int(f"i{i}" for i in range(n_items))
+    return ALSModel(factors, users, items, categories or {})
+
+
+def test_device_route_parity_masks_and_ragged_batch(monkeypatch):
+    """The fused device route (one gather+MIPS+mask+top-k dispatch per
+    tick, HBM-resident catalogs) must return EXACTLY the host route's
+    ids and scores — including per-row masks (blacklists) and a ragged
+    final batch that pads onto the pow2 ladder."""
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        AlgorithmParams,
+        Query,
+    )
+
+    model = _als_model()
+    algo = ALSAlgorithm(AlgorithmParams())
+    queries = [
+        (0, Query(user="u1", num=5)),
+        (1, Query(user="u3", num=3, blackList=("i0", "i7", "i9"))),
+        (2, Query(user="nobody", num=4)),          # unknown user
+        (3, Query(user="u5", num=6)),
+        (4, Query(user="u1", num=2, blackList=("i4",))),
+    ]  # 4 known riders -> ragged, pads to 4... then 8 on the ladder
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    resolve = algo.batch_predict_deferred(model, queries)
+    assert resolve is not None  # CPU default backend IS the device route
+    device = dict(resolve())
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    host = dict(algo.batch_predict(model, queries))
+    assert device.keys() == host.keys()
+    for i in device:
+        d_scores = device[i].itemScores
+        h_scores = host[i].itemScores
+        assert [s.item for s in d_scores] == [s.item for s in h_scores]
+        assert [s.score for s in d_scores] == [s.score for s in h_scores]
+    assert device[2].itemScores == ()  # unknown user: empty either route
+    assert all(s.item not in ("i0", "i7", "i9")
+               for s in device[1].itemScores)
+
+
+def test_device_route_parity_chunked_mips(monkeypatch):
+    """Catalogs over the chunk threshold take the chunked-MIPS scan in
+    BOTH routes; parity must hold there too (thresholds shrunk so the
+    scan runs at test scale)."""
+    from predictionio_tpu.models import als
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        AlgorithmParams,
+        Query,
+    )
+
+    monkeypatch.setattr(als, "CHUNKED_TOPK_THRESHOLD", 16)
+    monkeypatch.setattr(als, "CHUNKED_TOPK_CHUNK", 8)
+    model = _als_model(n_items=53, seed=1)  # 53 > 16 -> 7-chunk scan
+    algo = ALSAlgorithm(AlgorithmParams())
+    queries = [
+        (0, Query(user="u2", num=6)),
+        (1, Query(user="u4", num=4, blackList=("i1", "i2"))),
+        (2, Query(user="u6", num=5)),
+    ]
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    resolve = algo.batch_predict_deferred(model, queries)
+    assert resolve is not None
+    device = dict(resolve())
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    host = dict(algo.batch_predict(model, queries))
+    for i in device:
+        assert [s.item for s in device[i].itemScores] == \
+            [s.item for s in host[i].itemScores]
+        assert [s.score for s in device[i].itemScores] == \
+            [s.score for s in host[i].itemScores]
+
+
+def test_forced_cpu_restores_host_route_with_parity(server, monkeypatch):
+    """PIO_SERVING_DEVICE=cpu must fall every tick back to the legacy
+    host route (no fused dispatches) and answer identically."""
+    monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+    _, auto_body = call(server["port"], "POST", "/queries.json",
+                        {"user": "u1", "num": 4})
+    batcher = server["service"].batcher
+    ticks_before = batcher.device_ticks
+    assert ticks_before > 0  # default backend serves device-resident
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    _, host_body = call(server["port"], "POST", "/queries.json",
+                        {"user": "u1", "num": 4})
+    assert batcher.device_ticks == ticks_before  # host route: no ticks
+    assert host_body == auto_body  # pinned parity
+
+
+def test_reload_evicts_pinned_catalogs_no_residual(server):
+    """The serving_models arena must hold exactly one instance's pinned
+    catalog bytes across a /reload hot-swap: the swap eagerly evicts the
+    old instance's device copies (reported as ``evictedBytes``) and the
+    re-pinned new catalogs land at the same level — no residual."""
+    from predictionio_tpu.parallel import placement
+
+    service = server["service"]
+    _wait_for_thread("serving-promote")  # deploy-time promotion done
+    placement.evict_serving_models()  # clean slate vs other tests' pins
+    status, _ = call(server["port"], "POST", "/queries.json",
+                     {"user": "u1", "num": 3})
+    assert status == 200
+    _wait_for_thread("batch-warmup")
+    factors = service.models[0].factors
+    expected = factors.user_features.nbytes + factors.item_features.nbytes
+    assert placement.serving_arena_bytes() == expected
+    # hot-swap to a fresh instance
+    seed_and_train(server["storage"], seed=5)
+    status, body = call(server["port"], "GET", "/reload")
+    assert status == 200
+    assert body["evictedBytes"] == expected  # old catalogs evicted eagerly
+    _wait_for_thread("serving-promote")
+    status, _ = call(server["port"], "POST", "/queries.json",
+                     {"user": "u1", "num": 3})
+    assert status == 200
+    _wait_for_thread("batch-warmup")
+    new_factors = service.models[0].factors
+    assert new_factors is not factors
+    expected_new = (new_factors.user_features.nbytes
+                    + new_factors.item_features.nbytes)
+    # the gauge matches the NEW instance's pinned bytes exactly: the old
+    # catalogs left no residual behind the swap
+    assert placement.serving_arena_bytes() == expected_new
+
+
+def test_deferred_finalize_failure_fails_only_its_batch():
+    """A deferred tick whose readback/finalize raises must fail ONLY the
+    drained batch that produced it — later batches (deferred or host)
+    keep serving (the MicroBatcher failure contract, extended to the
+    finalizer thread)."""
+    from predictionio_tpu.workflow.batching import DeferredBatch, MicroBatcher
+
+    calls = {"n": 0}
+
+    def process(items):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return DeferredBatch(
+                lambda: (_ for _ in ()).throw(RuntimeError("readback died")))
+        return DeferredBatch(lambda: [f"ok:{x}" for x in items])
+
+    mb = MicroBatcher(process, max_batch=4, name="test-deferred-fail")
+    with pytest.raises(RuntimeError, match="readback died"):
+        mb.submit("a")
+    assert mb.submit("b") == "ok:b"  # the batcher survived the failure
+    assert mb.device_ticks == 2
+
+
 def test_serving_degrades_to_host_when_accelerator_wedged(
     memory_storage, monkeypatch
 ):

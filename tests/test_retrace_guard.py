@@ -97,6 +97,65 @@ def test_serving_topk_exclude_mask_is_its_own_bucket():
     assert len(rep["buckets"]) == 2
 
 
+def test_fused_serving_program_ladder_under_concurrent_load():
+    """The device-resident serving program (ISSUE 8): one fused
+    gather+MIPS+mask+top-k dispatch per micro-batcher tick must compile
+    exactly once per (pow2 batch, mask-variant) bucket — a serial pass
+    over the full ladder pays the expected compiles, then sustained
+    concurrent load re-visiting every bucket may add NO signatures and
+    NO compiles (zero retraces). Per-tick retracing here is the
+    regression that turns sub-ms device serving into seconds of
+    invisible compile."""
+    import threading
+
+    from predictionio_tpu.models.als import serve_top_k_batched
+
+    device_obs.reset_program("serving_fused_topk")
+    rng = np.random.default_rng(13)
+    uf = rng.normal(size=(43, 8)).astype(np.float32)  # unique shapes:
+    items = rng.normal(size=(103, 8)).astype(np.float32)  # cold buckets
+    ladder = (1, 2, 3, 4, 5, 6, 7, 8)
+
+    def drive(b: int, masked: bool):
+        uidx = rng.integers(0, 43, b).astype(np.int32)
+        mask = np.zeros((b, 103), bool) if masked else None
+        if masked:
+            mask[:, :11] = True
+        fin = serve_top_k_batched(uf, items, uidx, 5, mask)
+        assert fin is not None  # CPU default backend = device route
+        scores, idx = fin()
+        assert idx.shape == (b, 5)
+        if masked:
+            assert (idx >= 11).all()
+
+    for b in ladder:  # serial warm pass: the expected compile set
+        drive(b, False)
+        drive(b, True)
+
+    errors: list = []
+
+    def load(seed: int):
+        try:
+            r = np.random.default_rng(seed)
+            for _ in range(6):
+                drive(int(r.choice(ladder)), bool(r.integers(0, 2)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=load, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    rep = _assert_one_compile_per_bucket(
+        "serving_fused_topk", marker="(103, 8)")
+    # pow2 padding collapses 8 drain sizes onto 4 buckets, x2 for the
+    # mask/no-mask program split
+    assert len(rep["buckets"]) == 8
+    assert rep["calls"] >= 16 + 24
+
+
 def test_dense_als_train_compiles_once_per_shape_bucket():
     """One dense-ALS train per problem shape compiles each of the three
     entry points (fused train + the two pipelined halves) exactly once;

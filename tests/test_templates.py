@@ -318,6 +318,42 @@ class TestECommerce:
         filtered = algo.predict(model, Query(user="u1", num=3))
         assert top_item not in {s.item for s in filtered.itemScores}
 
+    def test_deferred_device_route_warm_parity_cold_fallback(
+        self, ctx, app, monkeypatch
+    ):
+        """ISSUE 8: a warm-only drained batch takes the fused device
+        route (seen-item masks applied ON DEVICE) and resolves to exactly
+        the legacy route's results; a batch containing a cold-start rider
+        returns None — the two-call legacy path owns it."""
+        from predictionio_tpu.templates.ecommercerecommendation import Query
+
+        monkeypatch.delenv("PIO_SERVING_DEVICE", raising=False)
+        algo, model = self.engine_and_model(ctx)
+        warm_queries = [(0, Query(user="u0", num=4)),
+                        (1, Query(user="u1", num=3)),
+                        (2, Query(user="u2", num=5))]
+        resolve = algo.batch_predict_deferred(model, warm_queries)
+        assert resolve is not None
+        device = dict(resolve())
+        legacy = dict(algo.batch_predict(model, warm_queries))
+        assert device == legacy  # ids AND scores, seen-items masked
+        # a no-history rider resolves empty host-side and still rides
+        # the deferred tick
+        with_ghost = warm_queries + [(3, Query(user="ghost", num=3))]
+        resolve = algo.batch_predict_deferred(model, with_ghost)
+        assert resolve is not None
+        assert dict(resolve())[3].itemScores == ()
+        # a true cold-start rider (unknown user WITH recent views → the
+        # cosine route) sends the whole tick back to the two-call path
+        app_id = app.get_meta_data_apps().get_by_name("ecomapp").id
+        app.get_events().insert(
+            Event(event="view", entity_type="user", entity_id="newbie",
+                  target_entity_type="item", target_entity_id="i1"),
+            app_id,
+        )
+        mixed = warm_queries + [(3, Query(user="newbie", num=3))]
+        assert algo.batch_predict_deferred(model, mixed) is None
+
     def test_cold_start_user_via_recent_views(self, ctx, app):
         from predictionio_tpu.templates.ecommercerecommendation import Query
 
