@@ -53,6 +53,13 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    #: crash-safe training (`pio train --checkpoint-dir/--resume`):
+    #: run_train publishes these as the workflow checkpoint scope
+    #: (utils/checkpoint.train_checkpoint_scope); checkpoint-capable
+    #: algorithms without their own checkpoint params pick them up
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 1
+    resume: bool = False
 
 
 def _bind_params(cls: type | None, params: Any):

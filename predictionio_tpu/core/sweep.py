@@ -35,6 +35,7 @@ vector is read back.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import time
@@ -216,6 +217,86 @@ def _chunks(seq: list, n: int):
         yield seq[i: i + max(n, 1)]
 
 
+class _SweepResume:
+    """Per-candidate completion log: a killed sweep resumes with its
+    finished candidates cached (``PIO_SWEEP_RESUME_DIR`` /
+    ``pio eval --resume-dir``).
+
+    Each candidate is keyed by a hash of its full engine params JSON +
+    the metric set, so the log is immune to candidate REORDERING and a
+    changed candidate simply misses (and re-runs). The log file is
+    rewritten atomically (tmp + rename) after every completion — a kill
+    mid-record costs one candidate, never the log."""
+
+    FILE = "sweep-progress.json"
+
+    def __init__(self, directory: str, eps: list[EngineParams],
+                 metrics: list[Metric]):
+        from pathlib import Path
+
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.path = self.dir / self.FILE
+        self.keys = [self._candidate_key(ep, metrics) for ep in eps]
+        self.records: dict = {}
+        if self.path.exists():
+            try:
+                data = json.loads(self.path.read_text())
+                if isinstance(data, dict):
+                    self.records = data
+            except ValueError:
+                logger.warning(
+                    "sweep resume log %s is unreadable; starting the "
+                    "sweep from scratch", self.path)
+
+    @classmethod
+    def from_env(cls, eps, metrics) -> "_SweepResume | None":
+        directory = os.environ.get("PIO_SWEEP_RESUME_DIR", "")
+        return cls(directory, eps, metrics) if directory else None
+
+    @staticmethod
+    def _candidate_key(ep: EngineParams, metrics: list[Metric]) -> str:
+        import hashlib
+
+        from predictionio_tpu.core.engine import Engine
+
+        payload = json.dumps(
+            {
+                "params": Engine.engine_params_to_json(ep),
+                "metrics": [f"{type(m).__name__}:{m.header}"
+                            for m in metrics],
+            },
+            sort_keys=True, default=repr,
+        )
+        return hashlib.sha1(payload.encode()).hexdigest()
+
+    def lookup(self, i: int) -> dict | None:
+        rec = self.records.get(self.keys[i])
+        return rec if isinstance(rec, dict) and "score" in rec else None
+
+    def record(self, i: int, ms: MetricScores | None, seconds: float,
+               path: str) -> None:
+        if ms is None:
+            return
+        self.records[self.keys[i]] = {
+            "score": ms.score,
+            "other": list(ms.other_scores),
+            "seconds": round(seconds, 4),
+            "path": path,
+        }
+        tmp = self.path.with_name(self.FILE + ".tmp")
+        tmp.write_text(json.dumps(self.records))
+        tmp.replace(self.path)
+
+    def clear(self) -> None:
+        """A completed sweep's log is obsolete — a later identical sweep
+        should recompute, not answer from a stale cache."""
+        try:
+            self.path.unlink()
+        except FileNotFoundError:
+            pass
+
+
 def _run_buckets(ctx, wf: FastEvalEngineWorkflow, groups, metrics,
                  out_scores, out_secs, done_cb):
     """Execute every planned bucket; returns ``(fallback, executed)`` —
@@ -342,13 +423,48 @@ def _execute(evaluation, ctx, params: WorkflowParams | None = None,
     out_scores: list[MetricScores | None] = [None] * total
     out_secs: list[float] = [0.0] * total
     done = 0
+    resume = _SweepResume.from_env(eps, metrics)
 
     def done_cb(i: int, path: str, seconds: float) -> None:
         nonlocal done
         done += 1
+        if resume is not None and path != "resumed":
+            # persist AFTER the candidate's score landed in out_scores —
+            # a kill between candidates loses at most the one in flight
+            resume.record(i, out_scores[i], seconds, path)
         if progress is not None:
             progress(done, total, {
                 "candidate": i, "path": path, "seconds": round(seconds, 3)})
+
+    resumed: set[int] = set()
+    if resume is not None:
+        for i in range(total):
+            rec = resume.lookup(i)
+            if rec is None:
+                continue
+            out_scores[i] = MetricScores(
+                score=rec["score"], other_scores=list(rec["other"]))
+            out_secs[i] = float(rec.get("seconds", 0.0))
+            resumed.add(i)
+            CANDIDATES_TOTAL.inc(path="resumed")
+            done_cb(i, "resumed", out_secs[i])
+        if resumed:
+            logger.info(
+                "sweep resume: %d of %d candidate(s) answered from %s",
+                len(resumed), total, resume.path)
+            sequential = [i for i in sequential if i not in resumed]
+            for gkey in list(groups):
+                group = groups[gkey]
+                for sig in list(group.buckets):
+                    b = group.buckets[sig]
+                    keep = [(i, a) for i, a in zip(b.indices, b.algos)
+                            if i not in resumed]
+                    b.indices = [i for i, _ in keep]
+                    b.algos = [a for _, a in keep]
+                    if not b.indices:
+                        group.buckets.pop(sig)
+                if not group.buckets:
+                    groups.pop(gkey)
 
     n_buckets = sum(len(g.buckets) for g in groups.values())
     # the shared stage-cache workflow: always for batched groups; for the
@@ -411,9 +527,12 @@ def _execute(evaluation, ctx, params: WorkflowParams | None = None,
     scores = [(ep, ms) for ep, ms in zip(eps, out_scores)]
     result = evaluation.evaluator.result_from_scores(scores)
     result.candidate_seconds = list(out_secs)
+    if resume is not None:
+        resume.clear()  # the sweep completed; the log is obsolete
     result.sweep = {
-        "batched": total - len(sequential),
+        "batched": total - len(sequential) - len(resumed),
         "sequential": len(sequential),
+        "resumed": len(resumed),
         # only buckets that actually ran stacked: a bucket that declined
         # at runtime executed sequentially and must not be reported as
         # batched to the dashboard

@@ -116,6 +116,14 @@ class EventService:
         self.json_connectors = json_connectors()
         self.form_connectors = form_connectors()
         self._auth_cache: dict[str, tuple[float, object]] = {}
+        # bounded admission on the ingest write paths: beyond this many
+        # in-flight POSTs the server sheds with 429 + Retry-After — an
+        # ingest burst degrades to explicit backpressure, never an
+        # unbounded pile of blocked handler threads
+        from predictionio_tpu.resilience import AdmissionGate
+
+        self.admission = AdmissionGate.from_env(
+            "PIO_INGEST_ADMISSION_LIMIT", 128, name="event")
         self.router = self._build_router()
 
     # -- auth (ref: withAccessKey) ------------------------------------------
@@ -252,8 +260,10 @@ class EventService:
         return 201, {"eventId": event_id}
 
     def post_event(self, request: Request):
-        auth = self._auth(request)
-        return self._ingest(auth, lambda: Event.from_json(request.json() or {}))
+        with self.admission.admit():  # 429 + Retry-After when full
+            auth = self._auth(request)
+            return self._ingest(
+                auth, lambda: Event.from_json(request.json() or {}))
 
     #: Max events per /batch/events.json request, matching the upstream
     #: successor API's limit (apache/predictionio 0.10 batch endpoint).
@@ -268,6 +278,10 @@ class EventService:
         round trip + one storage transaction per event caps single-core
         ingestion — batched, the same host moves ~an order of magnitude
         more events/s."""
+        with self.admission.admit():  # 429 + Retry-After when full
+            return self._post_batch_admitted(request)
+
+    def _post_batch_admitted(self, request: Request):
         auth = self._auth(request)
         t0 = time.perf_counter()
 
@@ -408,7 +422,8 @@ class EventService:
         data = request.json()
         if not isinstance(data, dict):
             return 400, {"message": "JSON object expected."}
-        return self._ingest(auth, lambda: to_event(connector, data))
+        with self.admission.admit():  # same bound as the event POSTs
+            return self._ingest(auth, lambda: to_event(connector, data))
 
     def get_webhook_json(self, request: Request):
         self._auth(request)
@@ -423,7 +438,9 @@ class EventService:
         connector = self.form_connectors.get(web)
         if connector is None:
             return 404, {"message": f"webhooks connection for {web} is not supported."}
-        return self._ingest(auth, lambda: to_event(connector, request.form()))
+        with self.admission.admit():  # same bound as the event POSTs
+            return self._ingest(
+                auth, lambda: to_event(connector, request.form()))
 
     def get_webhook_form(self, request: Request):
         self._auth(request)

@@ -197,6 +197,12 @@ class SQLClient:
             if concurrent and self.GROUP_WINDOW_S > 0:
                 time.sleep(self.GROUP_WINDOW_S)  # no locks held: stragglers
                 # execute behind us and ride this commit
+            # chaos site: an injected error here is a failed WAL commit —
+            # it must roll the whole group back and fail exactly the
+            # waiters whose rows were discarded (the except below)
+            from predictionio_tpu.resilience import faults
+
+            faults.fault_point("eventstore.commit")
             with self.lock:
                 pending = self._gc_pending
                 self.conn.commit()

@@ -287,12 +287,17 @@ class ChunkStager:
         tr_handle = trace.capture()
 
         def stage(item):
+            from predictionio_tpu.resilience import faults
+
             if stop.is_set():
                 raise _Cancelled()
             self._busy_enter()
             try:
                 t0 = time.perf_counter()
-                staged = pack(item)
+                # payload-bearing chaos site: error/delay fire here, and
+                # corrupt-shape truncates the packed chunk so downstream
+                # shape validation gets exercised for real
+                staged = faults.fault_point("transfer.pack", pack(item))
                 t1 = time.perf_counter()
                 STAGE_SECONDS.observe(t1 - t0, pipeline=self.name,
                                       stage="pack")
@@ -304,6 +309,7 @@ class ChunkStager:
                                   pipeline=self.name, bytes=nb)
                 did_upload = False
                 if upload is not None and not stop.is_set():
+                    faults.fault_point("transfer.upload")
                     staged = upload(staged)
                     did_upload = True
                     t2 = time.perf_counter()
@@ -521,6 +527,9 @@ def begin_readback(arrays: Sequence, chunk_bytes: int | None = None,
         staged.append(parts)
 
     def resolve() -> list[np.ndarray]:
+        from predictionio_tpu.resilience import faults
+
+        faults.fault_point("transfer.readback")
         out: list[np.ndarray] = []
         t0 = time.perf_counter()
         for parts in staged:

@@ -1003,7 +1003,15 @@ class ALS:
         n_users: int,
         n_items: int,
         callback=None,
+        resume=None,
     ) -> ALSFactors:
+        """``resume`` = ``(start_iter, user_f, item_f)`` restores a
+        crash-safe checkpoint (utils/checkpoint.TrainCheckpointer): the
+        solve continues from ``start_iter`` on the given host factors
+        instead of the seeded init. Supported on the single-device dense
+        path (the one the checkpoint callback runs on); other solvers
+        log and start fresh — a resume must never silently corrupt a
+        solver that can't honor it."""
         p = self.params
         ctx = self.ctx
         user_idx = np.asarray(user_idx, dtype=np.int32)
@@ -1017,6 +1025,11 @@ class ALS:
                 "ALSParams.solver must be auto/dense/bucket/segment, "
                 f"got {p.solver!r}"
             )
+        if resume is not None and p.solver == "segment":
+            logger.warning(
+                "ALS resume is only supported on the dense solver; "
+                "solver=%r starts from scratch", p.solver)
+            resume = None
         if p.solver == "segment":
             return self._train_segment(
                 user_idx, item_idx, ratings, n_users, n_items, callback
@@ -1037,6 +1050,10 @@ class ALS:
                 if ctx.mesh.devices.size > 1:
                     if als_dense.sharded_block_fits(
                             ctx, n_users, n_items, ratings.size):
+                        if resume is not None:
+                            logger.warning(
+                                "ALS resume is not supported on the SPMD "
+                                "sharded dense path; starting from scratch")
                         # SPMD: one A row-block per device, item normal
                         # equations completed by a psum over `data`
                         user_f, item_f = als_dense.train_dense_sharded(
@@ -1058,7 +1075,7 @@ class ALS:
                         ctx.mesh.devices.size, n_users, n_items)
                 user_f, item_f = als_dense.train_dense(
                     ctx, p, user_idx, item_idx, ratings, n_users, n_items,
-                    callback)
+                    callback, resume=resume)
                 t0 = time.perf_counter()
                 if als_dense._pipeline_enabled():
                     # chunked async readback: train_dense already started
@@ -1079,6 +1096,10 @@ class ALS:
                     time.perf_counter() - t0, 3)
                 return ALSFactors(uf_host, if_host)
 
+        if resume is not None:
+            logger.warning(
+                "ALS resume is only supported on the dense solver path; "
+                "the bucketed solver starts from scratch")
         multi = ctx.mesh.devices.size > 1
         key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
         ku, ki = jax.random.split(key)
@@ -1152,7 +1173,13 @@ class ALS:
                 **static,
             )
         else:
+            from predictionio_tpu.resilience import faults
+
             for it in range(p.num_iterations):
+                # crash-safe-training chaos site (same name as the dense
+                # path's): an injected error is a mid-train kill between
+                # checkpoint intervals
+                faults.fault_point("train.iteration")
                 user_f, item_f = _als_iteration(
                     user_f, item_f, u_nbr, u_val, i_nbr, i_val,
                     u_tiles, i_tiles, p.lambda_, p.alpha, **static,
@@ -1270,6 +1297,12 @@ def _top_k_dense(query_vecs, item_features, k: int, exclude_mask=None):
 
 def _pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length()
+
+
+#: Per-tick serving result buffers ([b, k] scores + indices) — tiny, but
+#: registered so a failed dispatch/finalize is leak-CHECKABLE: the
+#: resilience tests assert this arena is empty after injected failures.
+_TICK_ARENA = device_obs.arena("serving_ticks")
 
 
 @device_obs.profiled_program(
@@ -1395,14 +1428,30 @@ def serve_top_k_batched(user_features, item_features, uidx, k,
             exclude_mask = np.concatenate(
                 [exclude_mask, np.zeros((bp - b, n_items), bool)])
     chunk = CHUNKED_TOPK_CHUNK if n_items > CHUNKED_TOPK_THRESHOLD else None
+    from predictionio_tpu.resilience import faults
+
+    # the chaos suite's device-dispatch site: an injected error here is
+    # indistinguishable from the fused program failing to launch, which
+    # is exactly what the device-route breaker must absorb; corrupt-shape
+    # truncates the tick's row ids, so the readback comes up short and
+    # the finalize-failure heal path fires instead
+    uidx = faults.fault_point("serving.dispatch", uidx)
     scores, idx = _serving_fused_topk(uf, items, uidx, kp, exclude_mask,
                                       chunk)
     from predictionio_tpu.io import transfer
 
     resolve = transfer.begin_readback((scores, idx), name="serving")
+    # the tick's result buffers are the only per-tick HBM this route
+    # allocates; registering them makes "a failed tick leaked nothing"
+    # an assertable invariant (freed in finalize's finally — failure
+    # paths included, since the buffers die with the dropped resolver)
+    alloc = _TICK_ARENA.register((scores, idx), label=f"b{bp}")
 
     def finalize():
-        s, i = resolve()
+        try:
+            s, i = resolve()
+        finally:
+            _TICK_ARENA.free(alloc)
         return s[:b, :k], i[:b, :k]
 
     return finalize

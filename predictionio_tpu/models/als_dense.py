@@ -1046,10 +1046,14 @@ def acquire_device_inputs(ui, ii, ratings, n_users: int, n_items: int,
 
 
 def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
-                callback=None):
+                callback=None, resume=None):
     """Driver: fingerprint + (prepare + densify | cache hit) + train.
     Returns (user_f, item_f) as device arrays; models/als.ALS.train
-    wraps this."""
+    wraps this. ``resume`` = ``(start_iter, user_f, item_f)`` continues
+    a checkpointed solve from iteration ``start_iter`` on the given
+    host factors (crash-safe training: the math is iteration-for-
+    iteration identical to an uninterrupted run, so a resumed train
+    reproduces the uninterrupted factors exactly)."""
     import time
 
     from predictionio_tpu.models.als import _init_factors
@@ -1063,10 +1067,16 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
     entry = acquire_device_inputs(ui, ii, ratings, n_users, n_items,
                                   phases=phases)
 
-    prng = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
-    ku, ki = jax.random.split(prng)
-    user_f = _init_factors(ku, n_users, p.rank)
-    item_f = _init_factors(ki, n_items, p.rank)
+    start_iter = 0
+    if resume is not None:
+        start_iter, uf0, if0 = resume
+        user_f = jnp.asarray(np.asarray(uf0, np.float32))
+        item_f = jnp.asarray(np.asarray(if0, np.float32))
+    else:
+        prng = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+        ku, ki = jax.random.split(prng)
+        user_f = _init_factors(ku, n_users, p.rank)
+        item_f = _init_factors(ki, n_items, p.rank)
     blocks, dup_u, dup_i = entry["blocks"], entry["dup_u"], entry["dup_i"]
 
     # gather_dtype="float32" is the parity-study mode: every dot at
@@ -1083,7 +1093,20 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
     factors_alloc = _FACTORS_ARENA.register(
         (n_users + n_items) * p.rank * 4, label=f"rank{p.rank}")
     try:
-        if callback is None and _pipeline_enabled() and p.num_iterations >= 1:
+        if resume is not None:
+            # checkpointed solves run the per-iteration path (the fused
+            # fori_loop cannot start mid-loop); callback may still be
+            # None when the caller only resumes without re-checkpointing
+            from predictionio_tpu.resilience import faults
+
+            for it in range(start_iter, p.num_iterations):
+                faults.fault_point("train.iteration")
+                user_f, item_f = _dense_iteration(
+                    user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
+                    **static)
+                if callback is not None:
+                    callback(it, user_f, item_f)
+        elif callback is None and _pipeline_enabled() and p.num_iterations >= 1:
             # the final iteration runs as two half dispatches: once the user
             # half lands, its factors' d2h copy is kicked off and proceeds
             # concurrently with the item half still executing on device —
@@ -1114,7 +1137,12 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                 user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
                 p.num_iterations, **static)
         else:
+            from predictionio_tpu.resilience import faults
+
             for it in range(p.num_iterations):
+                # the crash-safe-training chaos site: an error here is a
+                # mid-train kill between checkpoint intervals
+                faults.fault_point("train.iteration")
                 user_f, item_f = _dense_iteration(
                     user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
                     **static)

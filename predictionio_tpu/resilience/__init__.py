@@ -1,0 +1,44 @@
+"""Resilience layer: fault injection, self-healing routes, admission.
+
+Three pillars, wired through every layer of the stack (ISSUE 9):
+
+:mod:`predictionio_tpu.resilience.faults`
+    Named fault points (``fault_point("serving.dispatch")`` etc.)
+    checked at the transfer pipeline, fused serving dispatch, replica
+    sockets, event-store group commit, checkpoint writes and the
+    per-iteration train loop. Specs ride ``PIO_FAULTS`` (or the
+    ``/debug/faults`` chaos API under ``PIO_CHAOS=1``), so a live
+    deployment can be driven through a scripted failure schedule
+    (``pio chaos``) without code changes.
+
+:mod:`predictionio_tpu.resilience.routebreaker`
+    The device-route breaker behind self-healing serving: a failed
+    fused dispatch or deferred readback retries the SAME tick on the
+    legacy host path (bit-exact answers, zero dropped queries), K
+    consecutive device failures trip the route to host, and a
+    synthetic probe tick re-closes it after cooldown.
+
+:mod:`predictionio_tpu.resilience.admission`
+    Bounded admission for the ingest and query hot paths: beyond the
+    in-flight bound a request is shed with ``429`` + ``Retry-After``
+    instead of queueing unboundedly; the gateway treats an upstream
+    429 as backpressure (failover candidate), never as a replica
+    transport failure.
+"""
+
+from predictionio_tpu.resilience.admission import AdmissionGate, Overloaded
+from predictionio_tpu.resilience.faults import (
+    InjectedFault,
+    InjectedOOM,
+    fault_point,
+)
+from predictionio_tpu.resilience.routebreaker import DeviceRouteBreaker
+
+__all__ = [
+    "AdmissionGate",
+    "DeviceRouteBreaker",
+    "InjectedFault",
+    "InjectedOOM",
+    "Overloaded",
+    "fault_point",
+]

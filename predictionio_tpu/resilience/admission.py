@@ -1,0 +1,110 @@
+"""Bounded admission: shed load with 429 + Retry-After, never queue
+unboundedly.
+
+The event server and query server run on a thread-per-connection HTTP
+stack; without a bound, an ingest burst (or a stalled device) turns
+into an unbounded pile of blocked handler threads and queued work — the
+system "fails" by falling over minutes later instead of degrading now.
+An :class:`AdmissionGate` caps concurrent in-flight requests on the
+guarded hot paths; a request beyond the bound is rejected immediately
+with ``429`` and a ``Retry-After`` hint (the serving gateway translates
+an upstream 429 into failover/backoff — backpressure, not a replica
+fault).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from contextlib import contextmanager
+
+from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.utils.http import HTTPError
+
+ADMISSION_REJECTED = REGISTRY.counter(
+    "pio_admission_rejected_total",
+    "Requests shed with 429 because the server's in-flight admission "
+    "bound was full, by server",
+    labels=("server",),
+)
+ADMISSION_INFLIGHT = REGISTRY.gauge(
+    "pio_admission_inflight",
+    "Requests currently holding an admission slot, by server",
+    labels=("server",),
+)
+
+
+class Overloaded(HTTPError):
+    """429 with a Retry-After header AND a ``retryAfterSec`` body field
+    (the gateway reads the body field; HTTP clients read the header)."""
+
+    def __init__(self, retry_after_sec: float, name: str):
+        sec = max(retry_after_sec, 0.0)
+        super().__init__(
+            429,
+            f"Overloaded: {name} admission queue is full; retry after "
+            f"{sec:g}s.",
+            headers={"Retry-After": str(int(math.ceil(sec)) or 1)},
+            extra={"retryAfterSec": sec},
+        )
+
+
+class AdmissionGate:
+    """Cap concurrent admissions at ``limit``; excess raises
+    :class:`Overloaded`. ``limit <= 0`` disables the gate (always
+    admits)."""
+
+    def __init__(self, limit: int, retry_after_sec: float = 1.0,
+                 name: str = "server"):
+        self.limit = int(limit)
+        self.retry_after_sec = retry_after_sec
+        self.name = name
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.rejected = 0  # this gate's own count (metrics are global)
+
+    @classmethod
+    def from_env(cls, env_var: str, default: int,
+                 name: str) -> "AdmissionGate":
+        """Gate bounded by ``env_var`` (read once, at server build) with
+        the shared ``PIO_ADMISSION_RETRY_AFTER`` hint (default 1s)."""
+        limit = int(os.environ.get(env_var, default))
+        retry = float(os.environ.get("PIO_ADMISSION_RETRY_AFTER", "1.0"))
+        return cls(limit, retry_after_sec=retry, name=name)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def try_enter(self) -> bool:
+        if self.limit <= 0:
+            return True
+        with self._lock:
+            if self._inflight >= self.limit:
+                return False
+            self._inflight += 1
+        ADMISSION_INFLIGHT.set(self._inflight, server=self.name)
+        return True
+
+    def exit(self) -> None:
+        if self.limit <= 0:
+            return
+        with self._lock:
+            self._inflight -= 1
+        ADMISSION_INFLIGHT.set(self._inflight, server=self.name)
+
+    @contextmanager
+    def admit(self):
+        """Hold one admission slot for the block, or raise
+        :class:`Overloaded` (→ 429 + Retry-After at the HTTP layer)."""
+        if not self.try_enter():
+            with self._lock:
+                self.rejected += 1
+            ADMISSION_REJECTED.inc(server=self.name)
+            raise Overloaded(self.retry_after_sec, self.name)
+        try:
+            yield
+        finally:
+            self.exit()

@@ -1,0 +1,272 @@
+"""Fault-injection registry: named fault points driven by ``PIO_FAULTS``.
+
+The reference stack has no story for "what happens when things break" —
+and neither did this one until round 9: a failed device dispatch failed
+the whole serving tick, a killed train restarted from zero. The chaos
+tooling that proves the resilience layer needs a way to MAKE things
+break, deterministically, in a live process. That is this module:
+
+* Code registers **fault points** by calling :func:`fault_point` at the
+  named site (``transfer.pack``, ``serving.dispatch``,
+  ``eventstore.commit``, ...). With no active spec the call is a dict
+  lookup and an env read — cheap enough for hot paths.
+* Operators/tests activate faults with a **spec**, either the compact
+  form ``site:kind:rate[:count[:skip]]`` (comma-separated for several)
+  or a JSON list of ``{"site", "kind", "rate", "count", "skip",
+  "delay_ms"}`` objects. The spec rides the ``PIO_FAULTS`` env var (re-
+  read on every check, so tests and ``pio chaos`` can retune a live
+  process) or a programmatic :func:`install` (which overrides the env
+  until :func:`clear`).
+
+Kinds:
+
+``error``
+    raise :class:`InjectedFault` at the site;
+``oom``
+    raise :class:`InjectedOOM`, whose message mimics an XLA
+    ``RESOURCE_EXHAUSTED`` so OOM-handling code paths exercise for real;
+``delay``
+    sleep ``delay_ms`` (default 50) at the site — the slow-link /
+    wedged-worker simulation;
+``corrupt-shape``
+    return the site's payload with its leading axis truncated (arrays
+    only) — downstream shape validation must catch it, not silently
+    mis-serve. Only meaningful at payload-bearing sites
+    (``transfer.pack``, ``serving.dispatch``); at payload-less sites
+    the kind still counts an injection but changes nothing.
+
+``rate`` is the per-check injection probability (1 = always), ``count``
+bounds total injections (blank = unbounded), ``skip`` arms the spec only
+after N matching checks pass clean — the deterministic "kill the train
+at iteration 4" knob. ``PIO_FAULTS_SEED`` pins the RNG for reproducible
+schedules. Every injection counts in
+``pio_faults_injected_total{site,kind}``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from predictionio_tpu.obs import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+FAULT_KINDS = ("error", "delay", "corrupt-shape", "oom")
+
+INJECTED = REGISTRY.counter(
+    "pio_faults_injected_total",
+    "Faults injected by the resilience chaos registry, by site and kind "
+    "(error, delay, corrupt-shape, oom)",
+    labels=("site", "kind"),
+)
+
+
+class InjectedFault(RuntimeError):
+    """An ``error``-kind fault fired at a fault point."""
+
+
+class InjectedOOM(InjectedFault):
+    """An ``oom``-kind fault: message mimics XLA's RESOURCE_EXHAUSTED so
+    code that pattern-matches device OOMs treats it like the real one."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected oom at fault point {site!r} "
+            "(simulated device out-of-memory)"
+        )
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    kind: str
+    rate: float = 1.0
+    count: int | None = None  # None = unbounded injections
+    skip: int = 0  # matching checks to pass clean before arming
+    delay_ms: float = 50.0
+    injected: int = field(default=0, compare=False)
+    seen: int = field(default=0, compare=False)
+
+    def spent(self) -> bool:
+        return self.count is not None and self.injected >= self.count
+
+
+def parse_spec(spec) -> list[FaultSpec]:
+    """``site:kind:rate[:count[:skip]]`` (comma-separated) or a JSON list
+    of spec objects. Raises ValueError on malformed input — a chaos
+    schedule with a typo must fail loudly, not silently inject nothing."""
+    if spec is None:
+        return []
+    if isinstance(spec, str):
+        text = spec.strip()
+        if not text:
+            return []
+        if text.startswith(("[", "{")):
+            spec = json.loads(text)
+            if isinstance(spec, dict):
+                spec = [spec]
+        else:
+            out = []
+            for part in text.split(","):
+                fields = part.strip().split(":")
+                if len(fields) < 2:
+                    raise ValueError(
+                        f"fault spec {part!r}: want site:kind:rate"
+                        "[:count[:skip]]")
+                site, kind = fields[0], fields[1]
+                rate = float(fields[2]) if len(fields) > 2 else 1.0
+                count = (int(fields[3])
+                         if len(fields) > 3 and fields[3] != "" else None)
+                skip = int(fields[4]) if len(fields) > 4 else 0
+                out.append(FaultSpec(site, kind, rate, count, skip))
+            spec = out
+    result = []
+    for s in spec:
+        if isinstance(s, dict):
+            s = FaultSpec(
+                site=s["site"], kind=s["kind"],
+                rate=float(s.get("rate", 1.0)),
+                count=(int(s["count"]) if s.get("count") is not None
+                       else None),
+                skip=int(s.get("skip", 0)),
+                delay_ms=float(s.get("delay_ms", 50.0)),
+            )
+        if s.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault kind {s.kind!r} not one of {FAULT_KINDS}")
+        if not s.site:
+            raise ValueError("fault spec needs a site")
+        result.append(s)
+    return result
+
+
+_LOCK = threading.Lock()
+#: programmatic spec (install()): overrides the env until clear()
+_installed: list[FaultSpec] | None = None
+#: cache of the last-parsed PIO_FAULTS value
+_env_raw: str = ""
+_env_specs: list[FaultSpec] = []
+_rng = random.Random()
+
+
+def _reseed() -> None:
+    """Re-seed the injection RNG from ``PIO_FAULTS_SEED`` whenever a new
+    spec set activates — the same spec + seed then yields the same
+    injection schedule, which is what makes a chaos run reproducible."""
+    seed = os.environ.get("PIO_FAULTS_SEED")
+    if seed is not None:
+        _rng.seed(seed)
+
+
+def install(spec) -> list[FaultSpec]:
+    """Activate ``spec`` programmatically (overrides ``PIO_FAULTS`` until
+    :func:`clear`). Returns the parsed specs."""
+    global _installed
+    parsed = parse_spec(spec)
+    with _LOCK:
+        _installed = parsed
+        _reseed()
+    logger.info("fault injection installed: %d spec(s)", len(parsed))
+    return parsed
+
+
+def clear() -> None:
+    """Drop the programmatic spec; ``PIO_FAULTS`` (if set) reapplies."""
+    global _installed, _env_raw, _env_specs
+    with _LOCK:
+        _installed = None
+        # force an env re-parse so counters restart with the next spec
+        _env_raw = ""
+        _env_specs = []
+
+
+def _active_specs() -> list[FaultSpec]:
+    global _env_raw, _env_specs
+    if _installed is not None:
+        return _installed
+    raw = os.environ.get("PIO_FAULTS", "")
+    if raw != _env_raw:
+        with _LOCK:
+            if raw != _env_raw:  # double-checked: parse once per change
+                try:
+                    _env_specs = parse_spec(raw)
+                except ValueError:
+                    logger.warning(
+                        "PIO_FAULTS unparsable (%r); injecting nothing",
+                        raw, exc_info=True)
+                    _env_specs = []
+                _env_raw = raw
+                _reseed()
+    return _env_specs
+
+
+def active_spec_text() -> str:
+    """The raw active spec for the chaos API (programmatic installs
+    render as JSON)."""
+    if _installed is not None:
+        return json.dumps([
+            {"site": s.site, "kind": s.kind, "rate": s.rate,
+             "count": s.count, "skip": s.skip, "delay_ms": s.delay_ms}
+            for s in _installed
+        ])
+    return os.environ.get("PIO_FAULTS", "")
+
+
+def injected_counts() -> dict[str, int]:
+    """``{"site:kind": n}`` for every spec that has fired — the chaos
+    CLI's post-schedule report."""
+    out: dict[str, int] = {}
+    with _LOCK:
+        for s in (_installed if _installed is not None else _env_specs):
+            if s.injected:
+                key = f"{s.site}:{s.kind}"
+                out[key] = out.get(key, 0) + s.injected
+    return out
+
+
+def chaos_enabled() -> bool:
+    """Whether the ``/debug/faults`` control surface is mounted
+    (``PIO_CHAOS=1``). Off by default: remote fault injection is an
+    operator tool, not something an internet-facing deploy exposes."""
+    return os.environ.get("PIO_CHAOS", "0") == "1"
+
+
+def fault_point(site: str, payload=None):
+    """Check fault point ``site``; returns ``payload`` (possibly shape-
+    corrupted) or raises/delays per the active spec. The no-spec fast
+    path costs one env read — safe on hot paths."""
+    specs = _active_specs()
+    if not specs:
+        return payload
+    for s in specs:
+        if s.site != site or s.spent():
+            continue
+        with _LOCK:
+            s.seen += 1
+            if s.seen <= s.skip:
+                continue
+            if s.rate < 1.0 and _rng.random() >= s.rate:
+                continue
+            if s.spent():
+                continue
+            s.injected += 1
+        INJECTED.inc(site=site, kind=s.kind)
+        logger.warning("injected %s fault at %s (#%d)",
+                       s.kind, site, s.injected)
+        if s.kind == "error":
+            raise InjectedFault(f"injected error at fault point {site!r}")
+        if s.kind == "oom":
+            raise InjectedOOM(site)
+        if s.kind == "delay":
+            time.sleep(s.delay_ms / 1e3)
+        elif s.kind == "corrupt-shape" and payload is not None:
+            shape = getattr(payload, "shape", None)
+            if shape and shape[0] > 0:
+                payload = payload[:-1]  # truncate the leading axis
+    return payload

@@ -1,0 +1,124 @@
+"""Device-route breaker: self-healing serving's trip-and-reprobe logic.
+
+The serving gateway already breaks circuits per REPLICA (transport
+failures); this breaker guards the other failure axis — the device
+ROUTE inside one replica. A fused ``serving_fused_topk`` dispatch or
+its deferred readback can start failing while the host path stays
+perfectly healthy (wedged accelerator link, HBM pressure, a driver
+fault): every such tick is retried on the legacy host path the same
+tick (bit-exact answers, zero dropped queries), and after
+``failures_to_open`` CONSECUTIVE device failures the route trips to
+host so live traffic stops paying a doomed dispatch per tick. After
+``cooldown_sec`` the server re-probes the device with a SYNTHETIC tick
+(a replay of the last known-good query, off the live path); success
+closes the route, failure re-arms the cooldown.
+
+Distinct from :class:`predictionio_tpu.serve.gateway.CircuitBreaker`
+by design, not oversight: that breaker admits live half-open probes
+(a replica answering slowly still answers), while the device route
+must never send live traffic to a tripped device — the probe is
+synthetic, so ``allow_device()`` is strictly closed-state.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from predictionio_tpu.obs import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+BREAKER_OPEN = REGISTRY.gauge(
+    "pio_serving_route_breaker_open",
+    "1 while the device serving route is tripped to the host path "
+    "(consecutive fused-dispatch/readback failures exceeded the bound); "
+    "one series per in-process replica",
+    labels=("server",),
+)
+DEVICE_FAILURES = REGISTRY.counter(
+    "pio_serving_device_failures_total",
+    "Device-route serving failures by stage (dispatch = the fused "
+    "program, finalize = the deferred readback); every one was retried "
+    "on the host path the same tick",
+    labels=("stage",),
+)
+
+
+class DeviceRouteBreaker:
+    """closed → open after ``failures_to_open`` consecutive device
+    failures; a synthetic probe after ``cooldown_sec`` decides reopening.
+    ``now`` is injectable for deterministic tests."""
+
+    def __init__(self, failures_to_open: int = 3, cooldown_sec: float = 5.0,
+                 now=time.monotonic, name: str = "query"):
+        self.failures_to_open = max(int(failures_to_open), 1)
+        self.cooldown_sec = cooldown_sec
+        self._now = now
+        #: label on the breaker gauge — each in-process replica gets its
+        #: own series (ServerConfig.server_name), so replica A's probe
+        #: success can never clear replica B's open alarm
+        self.name = name
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        BREAKER_OPEN.set(0, server=name)
+
+    def allow_device(self) -> bool:
+        """Whether live ticks may take the device route. Strictly
+        closed-state: an open route never admits live traffic — recovery
+        goes through the synthetic probe."""
+        with self._lock:
+            return self.state == "closed"
+
+    def record_failure(self, stage: str = "dispatch") -> None:
+        DEVICE_FAILURES.inc(stage=stage)
+        with self._lock:
+            self._consecutive += 1
+            self._probing = False
+            if self.state == "open":
+                # a probe failed (live ticks can't reach the device while
+                # open): re-arm the cooldown
+                self._opened_at = self._now()
+                return
+            if self._consecutive >= self.failures_to_open:
+                self.state = "open"
+                self._opened_at = self._now()
+                BREAKER_OPEN.set(1, server=self.name)
+                logger.warning(
+                    "device serving route tripped to host after %d "
+                    "consecutive device failures; re-probing in %.1fs",
+                    self._consecutive, self.cooldown_sec)
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != "closed":
+                logger.info("device serving route recovered (probe ok)")
+            self.state = "closed"
+            self._consecutive = 0
+            self._probing = False
+            BREAKER_OPEN.set(0, server=self.name)
+
+    def probe_due(self) -> bool:
+        """True exactly once per cooldown window while open — the caller
+        that sees True owns launching the synthetic probe tick. The slot
+        stays claimed until record_success/record_failure/
+        probe_inconclusive."""
+        with self._lock:
+            if (self.state == "open" and not self._probing
+                    and self._now() - self._opened_at >= self.cooldown_sec):
+                self._probing = True
+                return True
+            return False
+
+    def probe_inconclusive(self) -> None:
+        """The probe couldn't exercise the device (no replayable query,
+        placement routed the probe to host): hand the slot back and wait
+        out another cooldown rather than hot-spinning probes."""
+        with self._lock:
+            if self.state == "open":
+                self._opened_at = self._now()
+            self._probing = False

@@ -49,6 +49,7 @@ from predictionio_tpu.serve.cache import QueryCache, canonical_query_key
 from predictionio_tpu.serve.registry import Replica, ReplicaRegistry
 from predictionio_tpu.utils.http import (
     AppServer,
+    RawResponse,
     Request,
     Router,
     add_metrics_route,
@@ -403,6 +404,18 @@ class Gateway:
             with self._stats_lock:
                 self.error_count += 1
         _GW_SECONDS.observe(time.perf_counter() - t0)
+        if status in (429, 503) and isinstance(payload, dict) \
+                and payload.get("retryAfterSec") is not None:
+            # shed/unavailable responses carry the backoff hint as a
+            # real Retry-After header, not just a body field
+            import math
+
+            sec = max(int(math.ceil(float(payload["retryAfterSec"]))), 1)
+            return status, RawResponse(
+                json.dumps(payload),
+                "application/json; charset=UTF-8",
+                headers={"Retry-After": str(sec)},
+            )
         return status, payload
 
     def _proxy_query(self, request: Request) -> tuple[int, object]:
@@ -546,6 +559,7 @@ class Gateway:
         primary = self._acquire(exclude=tried)
         if primary is None:
             return 503, {"message": "No replica available.",
+                         "retryAfterSec": self.config.breaker_cooldown_sec,
                          "pioGatewayOutcome": "no_replica"}
         tried.add(primary.id)
         self._launch(primary, body, rid, deadline, resq, "primary")
@@ -553,6 +567,7 @@ class Gateway:
         hedged = not cfg.hedge  # True = don't (or can't) hedge anymore
         backoff = cfg.retry_backoff_base_sec
         last_err: Exception | None = None
+        last_shed: tuple[int, object] | None = None
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -578,23 +593,33 @@ class Gateway:
                     pending += 1
                 continue
             tag, a, b, replica, kind = res
-            if tag == "ok":
+            if tag == "ok" and a == 429:
+                # upstream admission shed: BACKPRESSURE, not a replica
+                # fault — the breaker already recorded the transport
+                # success. Fail over to another replica inside the
+                # budget; if none answers, the 429 (with its Retry-After
+                # hint) surfaces to the client.
+                trace.add_event("upstream_backpressure",
+                                replica=replica.id)
+                last_shed = (a, b)
+            elif tag == "ok":
                 if kind == "hedge":
                     with self._stats_lock:
                         self.hedges_won += 1
                     _GW_HEDGES.inc(result="won")
                     trace.add_event("hedge_won", replica=replica.id)
                 return a, b  # replica's status/payload, 4xx/5xx included
-            last_err = a
+            else:
+                last_err = a
             pending -= 1
             if pending > 0:
                 continue  # a hedge twin is still running: let it race
-            # every launched attempt failed at the transport level:
-            # failover with exponential backoff while the budget lasts
+            # every launched attempt failed (transport) or shed (429):
+            # failover with exponential backoff while the budget lasts.
+            # No second lap through already-failed replicas — a fleet
+            # that just failed everywhere answers faster with an honest
+            # 503 + Retry-After than with more doomed connects.
             retry = self._acquire(exclude=tried)
-            if retry is None:
-                tried.clear()  # all breakers/replicas burned: allow
-                retry = self._acquire(exclude=tried)  # a second lap
             if retry is None:
                 break
             remaining = deadline - time.monotonic()
@@ -614,10 +639,21 @@ class Gateway:
             trace.add_event("retry_fired", replica=retry.id)
             self._launch(retry, body, rid, deadline, resq, "retry")
             pending += 1
+        if last_shed is not None:
+            # the fleet is shedding everywhere: pass the backpressure
+            # through (429 + Retry-After), never convert it into a 5xx
+            status, payload = last_shed
+            if isinstance(payload, dict):
+                payload = {**payload, "pioGatewayOutcome": "backpressure"}
+            return status, payload
         if last_err is not None:
             logger.warning("query failed against all replicas: %s", last_err)
-            return 502, {"message": f"All replicas failed: {last_err}",
-                         "pioGatewayOutcome": "error"}
+            # every replica failed at the transport level: an honest
+            # 503 + Retry-After, well inside the deadline budget — the
+            # client backs off instead of piling onto a down fleet
+            return 503, {"message": f"All replicas unavailable: {last_err}",
+                         "retryAfterSec": self.config.breaker_cooldown_sec,
+                         "pioGatewayOutcome": "all_down"}
         return 504, {"message": "Deadline exceeded.",
                      "pioGatewayOutcome": "deadline"}
 
@@ -641,6 +677,12 @@ class Gateway:
         keep-alive connection that went stale surfaces here too and the
         caller's retry path covers it (predict is read-only, so a
         resend is always safe)."""
+        from predictionio_tpu.resilience import faults
+
+        # the chaos suite's replica-transport site: an injected error is
+        # indistinguishable from a connect/read failure and exercises the
+        # breaker + failover machinery for real
+        faults.fault_point("replica.socket")
         conn = self._pool_get(replica)
         if conn is None:
             conn = http.client.HTTPConnection(
@@ -658,6 +700,7 @@ class Gateway:
             resp = conn.getresponse()
             data = resp.read()
             status = resp.status
+            retry_after = resp.getheader("Retry-After")
         except BaseException:
             conn.close()
             raise
@@ -666,6 +709,13 @@ class Gateway:
             payload = json.loads(data or b"null")
         except ValueError:
             payload = {"message": data.decode("utf-8", "replace")}
+        if retry_after is not None and isinstance(payload, dict):
+            # surface the replica's backoff hint to the failover logic
+            # and (on passthrough) to the client
+            try:
+                payload.setdefault("retryAfterSec", float(retry_after))
+            except ValueError:
+                pass  # HTTP-date form: ignore, the hint is best-effort
         return status, payload
 
 
@@ -701,8 +751,14 @@ class GatewayDeployment:
     def stop(self) -> None:
         self.gateway.stop()
         self.server.stop()
-        for srv, _service in self.replicas:
+        for srv, service in self.replicas:
             srv.stop()
+            # drain each replica's micro-batcher (a mid-flight deferred
+            # finalize completes) and join its worker threads, so a
+            # `pio stop-all`-driven teardown can't race them
+            shutdown = getattr(service, "shutdown", None)
+            if shutdown is not None:
+                shutdown()
 
 
 def create_gateway_deployment(server_config, n_replicas: int,

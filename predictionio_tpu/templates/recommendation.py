@@ -42,6 +42,10 @@ from predictionio_tpu.models.als import (
 from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.parallel.mesh import ComputeContext
 
+import logging
+
+logger = logging.getLogger(__name__)
+
 #: HBM arena for stacked sweep-bucket factors (BatchedALSModels): the
 #: sweep executor frees each chunk's stack at metric readback, and
 #: core/sweep.py leak-checks the arena when a sweep finishes.
@@ -257,6 +261,14 @@ class AlgorithmParams(Params):
     seed: int | None = None
     implicitPrefs: bool = False
     alpha: float = 1.0
+    # crash-safe training (utils.checkpoint.TrainCheckpointer): empty =
+    # off unless `pio train --checkpoint-dir` published a workflow-level
+    # scope. With a directory set, factors snapshot every
+    # checkpointEvery iterations (atomic rename + content hash) and a
+    # killed train resumes from the newest VALID snapshot — a truncated
+    # latest falls back to the previous one.
+    checkpointDir: str = ""
+    checkpointEvery: int = 1
 
 
 @dataclass
@@ -311,15 +323,84 @@ class ALSAlgorithm(PAlgorithm):
             seed=p.seed,
         )
 
+    def _train_checkpointer(self):
+        """(TrainCheckpointer, resume_allowed) — the algorithm's own
+        checkpointDir wins (and auto-resumes, the SASRec idiom: the
+        fingerprint makes that safe); otherwise the workflow scope
+        published by `pio train --checkpoint-dir` applies, resuming only
+        under --resume. (None, False) = checkpointing off."""
+        from predictionio_tpu.utils.checkpoint import (
+            TrainCheckpointer,
+            current_train_checkpoint,
+        )
+
+        if self.params.checkpointDir:
+            return TrainCheckpointer(
+                self.params.checkpointDir,
+                every=max(self.params.checkpointEvery, 1)), True
+        cfg = current_train_checkpoint()
+        if cfg is not None and cfg.directory:
+            return TrainCheckpointer(cfg.directory, every=cfg.every), \
+                cfg.resume
+        return None, False
+
     def train(self, ctx: ComputeContext, pd: PreparedData) -> ALSModel:
-        als = ALS(ctx, self._als_params(self.params))
+        als_p = self._als_params(self.params)
+        als = ALS(ctx, als_p)
+        ck, resume_allowed = self._train_checkpointer()
+        if ck is None:
+            factors = als.train(
+                pd.user_idx,
+                pd.item_idx,
+                pd.ratings,
+                n_users=len(pd.user_ids),
+                n_items=len(pd.item_ids),
+            )
+            return ALSModel(
+                factors, pd.user_ids, pd.item_ids, pd.item_categories)
+        from predictionio_tpu.utils.checkpoint import fingerprint_arrays
+
+        # bind checkpoints to the data + per-iteration math; the
+        # iteration COUNT is deliberately excluded so a resumed run can
+        # complete (or extend) the interrupted one — each iteration's
+        # update is identical regardless of how many follow it
+        fp = fingerprint_arrays(
+            pd.user_idx, pd.item_idx, pd.ratings,
+            ("als-dense", als_p.rank, als_p.lambda_, als_p.alpha,
+             als_p.implicit_prefs, als_p.seed),
+        )
+        resume = None
+        if resume_allowed:
+            like = {
+                "user": np.zeros((len(pd.user_ids), als_p.rank),
+                                 np.float32),
+                "item": np.zeros((len(pd.item_ids), als_p.rank),
+                                 np.float32),
+            }
+            got = ck.load_latest(like, fingerprint=fp)
+            if got is not None:
+                step, state = got
+                resume = (step + 1, state["user"], state["item"])
+                logger.info(
+                    "ALS train resuming from checkpoint step %d "
+                    "(iteration %d of %d)", step, step + 1,
+                    als_p.num_iterations)
+
+        def checkpoint_cb(it, user_f, item_f):
+            if ck.should_save(it):
+                ck.save(it, {"user": np.asarray(user_f),
+                             "item": np.asarray(item_f)}, fingerprint=fp)
+
         factors = als.train(
             pd.user_idx,
             pd.item_idx,
             pd.ratings,
             n_users=len(pd.user_ids),
             n_items=len(pd.item_ids),
+            callback=checkpoint_cb,
+            resume=resume,
         )
+        ck.clear()  # the run completed; its snapshots are obsolete
         return ALSModel(factors, pd.user_ids, pd.item_ids, pd.item_categories)
 
     # -- device-batched sweep protocol (core/sweep.py) -----------------------

@@ -97,6 +97,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("--stop-after-prepare", action="store_true")
     p_train.add_argument("--profile", metavar="DIR", default=None,
                          help="write a JAX device trace (xprof) to DIR")
+    # -- crash-safe training (utils/checkpoint.py) --------------------------
+    p_train.add_argument(
+        "--checkpoint-dir", metavar="DIR", default="",
+        help="snapshot model state here every --checkpoint-every "
+             "intervals (atomic rename + content hash); without "
+             "--resume any previous snapshots are cleared first")
+    p_train.add_argument(
+        "--checkpoint-every", type=int, default=1, metavar="N",
+        help="iterations/epochs between snapshots (default 1)")
+    p_train.add_argument(
+        "--resume", action="store_true",
+        help="continue from the newest VALID snapshot in "
+             "--checkpoint-dir (a corrupt/truncated latest falls back "
+             "to the previous one) instead of training from scratch")
     p_train.set_defaults(func=cmd_train)
 
     # -- deploy / undeploy (ref: Console.scala:835-922) ---------------------
@@ -190,7 +204,35 @@ def build_parser() -> argparse.ArgumentParser:
     p_eval.add_argument("params_generator_class", nargs="?",
                         help="module:attr of an EngineParamsGenerator")
     p_eval.add_argument("--batch", default="")
+    p_eval.add_argument(
+        "--resume-dir", metavar="DIR", default="",
+        help="persist per-candidate completion here (atomic JSON log); "
+             "a killed sweep re-run with the same DIR answers finished "
+             "candidates from the log instead of retraining them")
     p_eval.set_defaults(func=cmd_eval)
+
+    # -- chaos: scripted fault schedules against a live deploy --------------
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="drive a fault-injection schedule against a live server "
+             "(needs PIO_CHAOS=1 in the target process)")
+    p_chaos.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server whose /debug/faults to drive (gateway, replica, "
+             "event server — faults act in THAT process)")
+    p_chaos.add_argument(
+        "--fault", action="append", default=[], metavar="SPEC",
+        help="fault spec site:kind:rate[:count[:skip]] (repeatable); "
+             "kinds: error, delay, corrupt-shape, oom")
+    p_chaos.add_argument(
+        "--duration", type=float, default=10.0, metavar="SEC",
+        help="how long to leave --fault specs active (default 10)")
+    p_chaos.add_argument(
+        "--schedule", metavar="FILE", default=None,
+        help="JSON schedule instead of --fault/--duration: a list of "
+             "{\"at\": seconds, \"spec\": ...} steps; faults clear when "
+             "the schedule ends")
+    p_chaos.set_defaults(func=cmd_chaos)
 
     # -- template scaffolding (ref: Console.scala template get) -------------
     p_tpl = sub.add_parser("template", help="manage engine templates")
@@ -392,11 +434,17 @@ def cmd_train(args) -> int:
     factory = variant["engineFactory"]
     engine = get_engine(factory, os.getcwd())
     engine_params = engine.engine_params_from_json(variant)
+    if args.resume and not args.checkpoint_dir:
+        print("[ERROR] --resume needs --checkpoint-dir.", file=sys.stderr)
+        return 1
     wp = WorkflowParams(
         batch=args.batch,
         skip_sanity_check=args.skip_sanity_check,
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
     )
     instance = new_engine_instance(
         engine_id=variant.get("id", "default"),
@@ -450,13 +498,29 @@ def cmd_deploy(args) -> int:
     server.start()
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
           f"http://{args.ip}:{server.port}.")
+    _install_sigterm(service._stop_event.set)
     try:
         service.wait_for_stop()
     except KeyboardInterrupt:
         pass
     server.stop()
+    # drain the micro-batcher (mid-flight deferred finalizes complete)
+    # and join its threads before the process exits
+    service.shutdown()
     print("[INFO] Engine server shut down.")
     return 0
+
+
+def _install_sigterm(callback) -> None:
+    """Route SIGTERM (what `pio stop-all` sends) into a graceful stop so
+    in-flight work drains instead of dying mid-readback. No-op off the
+    main thread (tests drive the CLI from worker threads)."""
+    import signal
+
+    try:
+        signal.signal(signal.SIGTERM, lambda _sig, _frm: callback())
+    except ValueError:
+        pass
 
 
 def _deploy_gateway(args, config) -> int:
@@ -507,6 +571,10 @@ def _deploy_gateway(args, config) -> int:
           f"http://{args.ip}:{dep.port} over {args.replicas} replicas "
           f"(ports {replica_ports}).")
     pidfile = register_pidfile(f"deploy-gateway-{dep.port}")
+    # `pio stop-all` SIGTERMs this process: translate it into the same
+    # graceful stop as GET /stop, so replicas drain their micro-batchers
+    # (no race against a mid-flight deferred finalize) before exit
+    _install_sigterm(dep.gateway._stop_event.set)
     try:
         dep.wait_for_stop()
     except KeyboardInterrupt:
@@ -676,6 +744,10 @@ def cmd_eval(args) -> int:
         if isinstance(gen, type) or not hasattr(gen, "engine_params_list"):
             gen = gen()  # class or factory function → instantiate
         evaluation.engine_params_list = gen.engine_params_list
+    if getattr(args, "resume_dir", ""):
+        # the sweep executor reads the env at run time (core/sweep.py
+        # _SweepResume); the flag is just its CLI face
+        os.environ["PIO_SWEEP_RESUME_DIR"] = args.resume_dir
     instance_id, result = run_evaluation(
         evaluation,
         evaluation_class=args.evaluation_class,
@@ -684,6 +756,92 @@ def cmd_eval(args) -> int:
     )
     print(f"[INFO] {result.to_one_liner()}")
     print(f"[INFO] Evaluation completed. Instance ID: {instance_id}")
+    return 0
+
+
+def cmd_chaos(args) -> int:
+    """Drive a scripted failure schedule against a live deploy via the
+    ``/debug/faults`` chaos API (mounted only under ``PIO_CHAOS=1``)."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    def post_spec(spec) -> dict:
+        req = urllib.request.Request(
+            f"{args.url}/debug/faults",
+            data=_json.dumps({"spec": spec}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def get_state() -> dict:
+        with urllib.request.urlopen(
+                f"{args.url}/debug/faults", timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    if args.schedule:
+        with open(args.schedule) as f:
+            steps = _json.load(f)
+        if not isinstance(steps, list):
+            print("[ERROR] schedule must be a JSON list of "
+                  "{\"at\", \"spec\"} steps.", file=sys.stderr)
+            return 1
+        steps = sorted(steps, key=lambda s: float(s.get("at", 0.0)))
+    else:
+        if not args.fault:
+            print("[ERROR] give --fault SPEC (repeatable) or --schedule "
+                  "FILE.", file=sys.stderr)
+            return 1
+        steps = [{"at": 0.0, "spec": ",".join(args.fault)},
+                 {"at": args.duration, "spec": ""}]
+    t0 = _time.monotonic()
+    injected: dict[str, int] = {}
+
+    def snapshot() -> None:
+        # accumulate ACROSS install/clear cycles: installing a new spec
+        # (or clearing) resets the per-spec counters, so sum snapshots
+        # taken just before each boundary
+        for key, n in get_state().get("injected", {}).items():
+            injected[key] = injected.get(key, 0) + int(n)
+
+    try:
+        for step in steps:
+            delay = float(step.get("at", 0.0)) - (_time.monotonic() - t0)
+            if delay > 0:
+                _time.sleep(delay)
+            spec = step.get("spec", "")
+            snapshot()
+            out = post_spec(spec)
+            print(f"[INFO] t={_time.monotonic() - t0:6.1f}s "
+                  f"spec={spec!r} installed={out.get('installed', 0)}")
+        snapshot()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print("[ERROR] chaos API disabled on the target — start it "
+                  "with PIO_CHAOS=1.", file=sys.stderr)
+        else:
+            print(f"[ERROR] chaos API error: HTTP {e.code} "
+                  f"{e.read()[:200]!r}", file=sys.stderr)
+        return 1
+    except (ConnectionError, OSError, TimeoutError) as e:
+        print(f"[ERROR] cannot reach {args.url}: {e}", file=sys.stderr)
+        return 1
+    finally:
+        try:  # never leave faults armed behind a crashed schedule
+            post_spec("")
+        except Exception:
+            pass
+    if injected:
+        print("[INFO] injections during the schedule:")
+        for key, n in sorted(injected.items()):
+            print(f"[INFO]   {key}: {n}")
+    else:
+        print("[INFO] no injections recorded (did traffic hit the "
+              "instrumented sites?)")
+    print("[INFO] chaos schedule complete; faults cleared.")
     return 0
 
 

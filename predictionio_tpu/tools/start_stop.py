@@ -119,8 +119,8 @@ def _stop_pidfile(pidfile: Path, name: str) -> int:
     when a live process was stopped."""
     try:
         pid = int(pidfile.read_text().strip())
-    except ValueError:
-        pidfile.unlink()
+    except (FileNotFoundError, ValueError):
+        pidfile.unlink(missing_ok=True)
         return 0
     stopped = 0
     if _alive(pid):
@@ -143,7 +143,10 @@ def _stop_pidfile(pidfile: Path, name: str) -> int:
         except (ChildProcessError, OSError):
             pass
         stopped = 1
-    pidfile.unlink()
+    # missing_ok: a gracefully-terminating deploy clears its OWN pidfile
+    # (cmd_deploy's finally) while we wait for it to die — losing that
+    # race must not crash stop-all
+    pidfile.unlink(missing_ok=True)
     return stopped
 
 

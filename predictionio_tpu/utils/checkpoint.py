@@ -20,6 +20,8 @@ import hashlib
 import json
 import logging
 import shutil
+from contextlib import contextmanager
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any
 
@@ -100,6 +102,44 @@ def load_pytree_like(directory: str | Path, like: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+#: Files whose bytes make up a checkpoint's content hash, in order.
+_CONTENT_FILES = ("tree.json", "structure.json", "arrays.npz")
+_CONTENT_HASH_FILE = "content.sha256"
+
+
+def write_content_hash(directory: str | Path) -> str:
+    """Hash a checkpoint directory's payload files into
+    ``content.sha256``. Written LAST (before the atomic rename), so a
+    checkpoint either carries a hash that matches its bytes or it is
+    not a checkpoint at all."""
+    directory = Path(directory)
+    h = hashlib.sha256()
+    for name in _CONTENT_FILES:
+        h.update(name.encode())
+        h.update((directory / name).read_bytes())
+    digest = h.hexdigest()
+    (directory / _CONTENT_HASH_FILE).write_text(digest)
+    return digest
+
+
+def verify_content_hash(directory: str | Path) -> bool:
+    """Whether the directory's payload bytes match its recorded hash.
+    A missing hash file, a missing payload file, or a mismatch (the
+    truncated-arrays.npz crash case) all read as invalid — the loader
+    falls back to the previous snapshot rather than deserializing a
+    torn one."""
+    directory = Path(directory)
+    try:
+        recorded = (directory / _CONTENT_HASH_FILE).read_text().strip()
+        h = hashlib.sha256()
+        for name in _CONTENT_FILES:
+            h.update(name.encode())
+            h.update((directory / name).read_bytes())
+        return recorded == h.hexdigest()
+    except OSError:
+        return False
+
+
 def fingerprint_arrays(*parts) -> str:
     """Stable fingerprint of training inputs: hashes each part's bytes
     (arrays) or repr (config objects). Trainers bind checkpoints to it so
@@ -158,11 +198,18 @@ class TrainCheckpointer:
         return (step + 1) % self.every == 0
 
     def save(self, step: int, state: Any, fingerprint: str = "") -> None:
+        from predictionio_tpu.resilience import faults
+
         tmp = self.directory / f"tmp-{step}"
         if tmp.exists():
             shutil.rmtree(tmp)
         save_pytree(tmp, state)
         (tmp / "fingerprint.txt").write_text(fingerprint)
+        write_content_hash(tmp)
+        # chaos site between the payload write and the atomic publish —
+        # an injected crash here must leave only a tmp- dir (swept at
+        # construction) and the previous checkpoint intact
+        faults.fault_point("checkpoint.write")
         final = self.directory / f"step-{step}"
         if final.exists():
             shutil.rmtree(final)
@@ -172,11 +219,13 @@ class TrainCheckpointer:
 
     def clear(self) -> None:
         """Drop every checkpoint (a finished or abandoned run), including
-        foreign-* stashes moved aside by fingerprint mismatches."""
+        foreign-* stashes moved aside by fingerprint mismatches and
+        corrupt-* snapshots set aside by the content-hash check."""
         for d in self.directory.iterdir():
             if d.is_dir() and (
                 d.name.startswith("step-") or d.name.startswith("tmp-")
                 or d.name.startswith("foreign-")
+                or d.name.startswith("corrupt-")
             ):
                 shutil.rmtree(d, ignore_errors=True)
 
@@ -187,15 +236,41 @@ class TrainCheckpointer:
     def load_latest(
         self, like: Any, fingerprint: str = ""
     ) -> tuple[int, Any] | None:
-        """(step, state) of the newest checkpoint restored into the
-        structure of ``like``, or None if no (matching) checkpoint
-        exists. A fingerprint mismatch — different data or
-        hyperparameters than the run that wrote the checkpoints —
-        moves the foreign checkpoints aside and returns None."""
+        """(step, state) of the newest VALID checkpoint restored into
+        the structure of ``like``, or None if no (matching) checkpoint
+        exists. A corrupt or truncated snapshot — content hash mismatch,
+        or a load that raises — is moved aside and the previous snapshot
+        is used instead: a crash mid-write (or mid-fsync on a dying
+        node) costs one checkpoint interval, never the whole run. A
+        fingerprint mismatch — different data or hyperparameters than
+        the run that wrote the checkpoints — moves the foreign
+        checkpoints aside and returns None."""
         dirs = self._step_dirs()
-        if not dirs:
+        while dirs:
+            step, d = dirs[-1]
+            if verify_content_hash(d):
+                try:
+                    state = load_pytree_like(d, like)
+                    break
+                except (OSError, ValueError, KeyError) as e:
+                    # hash intact but the payload won't deserialize into
+                    # `like` (e.g. the target structure changed): treat
+                    # exactly like corruption — fall back, don't crash
+                    logger.warning(
+                        "checkpoint %s failed to load (%s); falling back "
+                        "to the previous snapshot", d.name, e)
+            else:
+                logger.warning(
+                    "checkpoint %s is corrupt/truncated (content hash "
+                    "mismatch); falling back to the previous snapshot",
+                    d.name)
+            corrupt = d.with_name(f"corrupt-{d.name}")
+            if corrupt.exists():
+                shutil.rmtree(corrupt, ignore_errors=True)
+            d.rename(corrupt)
+            dirs.pop()
+        else:
             return None
-        step, d = dirs[-1]
         fp_file = d / "fingerprint.txt"
         saved_fp = fp_file.read_text() if fp_file.exists() else ""
         if saved_fp != fingerprint:
@@ -218,4 +293,49 @@ class TrainCheckpointer:
                 self.directory, stash,
             )
             return None
-        return step, load_pytree_like(d, like)
+        return step, state
+
+
+# ---------------------------------------------------------------------------
+# Workflow-level checkpoint scope (`pio train --checkpoint-dir/--resume`)
+# ---------------------------------------------------------------------------
+#
+# run_train owns the crash-safe-training contract but never sees inside
+# engine.train; algorithms own their state layout but never see the CLI.
+# The scope is the narrow bridge: run_train publishes (dir, every,
+# resume) for the duration of the train, and checkpoint-capable
+# algorithms whose OWN checkpoint params are unset pick it up.
+
+
+@dataclass
+class TrainCheckpointConfig:
+    directory: str
+    every: int = 1
+    resume: bool = False
+
+
+_train_scope: TrainCheckpointConfig | None = None
+
+
+@contextmanager
+def train_checkpoint_scope(directory: str, every: int = 1,
+                           resume: bool = False):
+    """Publish a workflow-level checkpoint config for the enclosed
+    ``engine.train``. Without ``resume``, pre-existing checkpoints in
+    the directory are cleared first — ``pio train`` without ``--resume``
+    means a fresh run, never a silent continuation of a forgotten one."""
+    global _train_scope
+    cfg = TrainCheckpointConfig(directory, max(int(every), 1), resume)
+    if not resume and directory:
+        TrainCheckpointer(directory).clear()
+    prev = _train_scope
+    _train_scope = cfg
+    try:
+        yield cfg
+    finally:
+        _train_scope = prev
+
+
+def current_train_checkpoint() -> TrainCheckpointConfig | None:
+    """The active workflow-level checkpoint config, or None."""
+    return _train_scope

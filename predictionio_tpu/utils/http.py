@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 import socket
 import threading
@@ -37,7 +38,8 @@ logger = logging.getLogger(__name__)
 #: slowest-N reservoir (and the recent ring) it exists to render.
 #: ``/debug/profile`` qualifies twice over — its handler deliberately
 #: sleeps for the capture window.
-UNTRACED_PATHS = frozenset({"/metrics", "/debug/traces", "/debug/profile"})
+UNTRACED_PATHS = frozenset(
+    {"/metrics", "/debug/traces", "/debug/profile", "/debug/faults"})
 
 # Per-server HTTP telemetry, shared by every AppServer in the process
 # (the ``server`` label separates event/query/admin/dashboard traffic).
@@ -90,19 +92,30 @@ class Request:
 class RawResponse:
     """Return from a handler (as the payload) to emit non-JSON content —
     the dashboard and engine-server status pages serve HTML, like the
-    reference's twirl templates."""
+    reference's twirl templates. ``headers`` adds extra response headers
+    (``Retry-After`` on load-shed responses)."""
 
     body: str | bytes
     content_type: str = "text/html; charset=UTF-8"
+    headers: dict[str, str] = field(default_factory=dict)
 
 
 class HTTPError(Exception):
-    """Raise inside a handler to produce a JSON error response."""
+    """Raise inside a handler to produce a JSON error response.
 
-    def __init__(self, status: int, message: str):
+    ``headers`` ride onto the response (``Retry-After`` on 429/503);
+    ``extra`` fields merge into the JSON error body next to ``message``
+    (``retryAfterSec``, which the gateway's backpressure translation
+    reads)."""
+
+    def __init__(self, status: int, message: str,
+                 headers: dict[str, str] | None = None,
+                 extra: dict | None = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or {}
+        self.extra = extra or {}
 
 
 Handler = Callable[[Request], "tuple[int, Any]"]
@@ -200,6 +213,16 @@ def _parse_target(raw: str) -> tuple[str, dict[str, str]]:
         _target_cache.clear()
     _target_cache[raw] = (parsed.path, query)
     return parsed.path, dict(query)
+
+
+def max_body_bytes() -> int:
+    """Request-body bound (``PIO_MAX_BODY_MB``, default 32 MiB; 0
+    disables). Read at call time so a live process can be retuned. A
+    body over the bound is rejected 413 BEFORE it is read — the server
+    must never buffer an attacker-sized (or merely misconfigured-bulk-
+    loader-sized) JSON blob into memory."""
+    mb = float(os.environ.get("PIO_MAX_BODY_MB", 32))
+    return max(int(mb * 2**20), 0)
 
 
 #: Date header cache: one strftime per second, not per request.
@@ -436,6 +459,28 @@ class AppServer:
                 if length < 0:  # malformed/negative: reject, don't crash
                     self.send_error(400, "Bad Content-Length")
                     return
+                limit = max_body_bytes()
+                if limit and length > limit:
+                    # bounded read: reject BEFORE buffering the body. The
+                    # unread bytes poison the connection for keep-alive,
+                    # so it closes with the response.
+                    self.close_connection = True
+                    data = json.dumps({
+                        "message": f"Request body too large: {length} "
+                                   f"bytes exceeds the {limit}-byte bound "
+                                   "(PIO_MAX_BODY_MB)."
+                    }).encode("utf-8")
+                    resp = (
+                        f"HTTP/1.1 413 Content Too Large\r\n"
+                        f"Server: {self.version_string()}\r\n"
+                        f"Date: {_http_date(time.time())}\r\n"
+                        f"Connection: close\r\n"
+                        f"Content-Type: application/json; charset=UTF-8\r\n"
+                        f"Content-Length: {len(data)}\r\n\r\n"
+                    ).encode("iso-8859-1") + data
+                    self.wfile.write(resp)
+                    _HTTP_REQUESTS.inc(server=server_name, status="413")
+                    return
                 body = self.rfile.read(length) if length else b""
                 request = Request(
                     method=self.command,
@@ -475,10 +520,13 @@ class AppServer:
                         if sp.sampled:
                             sp.set_attr("method", self.command)
                             sp.set_attr("path", path)
+                        extra_headers: dict[str, str] = {}
                         try:
                             status, payload = router.dispatch(request)
                         except HTTPError as e:
-                            status, payload = e.status, {"message": e.message}
+                            status = e.status
+                            payload = {"message": e.message, **e.extra}
+                            extra_headers = e.headers
                         except json.JSONDecodeError as e:
                             # includes invalid UTF-8 bodies: Request.json()
                             # translates UnicodeDecodeError to this class
@@ -493,6 +541,9 @@ class AppServer:
                                 else payload.body
                             )
                             content_type = payload.content_type
+                            if payload.headers:
+                                extra_headers = {**extra_headers,
+                                                 **payload.headers}
                         else:
                             data = json.dumps(payload).encode("utf-8")
                             content_type = "application/json; charset=UTF-8"
@@ -506,6 +557,8 @@ class AppServer:
                             tr_hdr = f"{trace.SAMPLED_HEADER}: 1\r\n"
                         else:  # untraced responses are byte-identical
                             tr_hdr = ""  # to the pre-tracing format
+                        for hk, hv in extra_headers.items():
+                            tr_hdr += f"{hk}: {hv}\r\n"
                         resp = (
                             f"HTTP/1.1 {status} {phrase}\r\n"
                             f"Server: {self.version_string()}\r\n"
@@ -635,9 +688,36 @@ def add_metrics_route(router: Router,
             # process — the profiler is a process-global singleton
             raise HTTPError(503, f"profiler capture failed: {e}") from e
 
+    def debug_faults(request: Request):
+        from predictionio_tpu.resilience import faults
+
+        if not faults.chaos_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/traces contract under PIO_TRACE=off
+            raise HTTPError(404, "chaos API disabled (PIO_CHAOS=0)")
+        if request.method == "POST":
+            body = request.json()
+            if not isinstance(body, dict):
+                raise HTTPError(400, "JSON object expected")
+            spec = body.get("spec", "")
+            try:
+                if spec in ("", None, []):
+                    faults.clear()
+                    installed = []
+                else:
+                    installed = faults.install(spec)
+            except (ValueError, KeyError, TypeError) as e:
+                raise HTTPError(400, f"bad fault spec: {e}") from e
+            return 200, {"installed": len(installed),
+                         "spec": faults.active_spec_text()}
+        return 200, {"spec": faults.active_spec_text(),
+                     "injected": faults.injected_counts()}
+
     router.add("GET", "/metrics", metrics)
     router.add("GET", "/debug/traces", debug_traces)
     router.add("POST", "/debug/profile", debug_profile)
+    router.add("GET", "/debug/faults", debug_faults)
+    router.add("POST", "/debug/faults", debug_faults)
     return router
 
 

@@ -91,12 +91,22 @@ class DeferredBatch:
         self.stage_marks: list[tuple[str, float, float]] | None = None
 
 
+#: Shutdown sentinel: rides the submit queue behind any queued work, so
+#: stop() drains everything already submitted before the threads exit.
+_STOP = object()
+
+
 class MicroBatcher:
     """Single consumer thread draining a submit queue into batched calls.
 
     ``process_batch(items) -> list[result]`` runs on the consumer thread;
     a returned item that is an Exception instance fails only its own
     request, a raised exception fails the whole drained batch.
+
+    :meth:`stop` shuts both worker threads down cleanly — queued
+    requests and in-flight deferred finalizes drain first, then the
+    threads exit and are joined (bounded). A server teardown (or ``pio
+    stop-all``) therefore can't race a mid-flight deferred readback.
     """
 
     def __init__(
@@ -127,6 +137,11 @@ class MicroBatcher:
         self._inflight_finalizes = 0
         self._finalize_lock = threading.Lock()
         self._finalize_q: queue.SimpleQueue = queue.SimpleQueue()
+        self._stopped = False
+        # serializes submit's stopped-check-then-put against stop's
+        # sentinel put: without it a submit could land BEHIND the
+        # sentinel and its Future would never resolve (caller hangs)
+        self._stop_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
         self._thread.start()
         self._finalizer = threading.Thread(
@@ -140,91 +155,127 @@ class MicroBatcher:
         # trace handle of the submitting request (None when untraced):
         # the consumer thread records this rider's queue_wait/predict/
         # serve spans against it — contextvars don't cross the queue
-        self._q.put((item, f, time.perf_counter(), trace.capture()))
+        with self._stop_lock:
+            if self._stopped:
+                raise RuntimeError("MicroBatcher is stopped")
+            self._q.put((item, f, time.perf_counter(), trace.capture()))
         return f.result()
+
+    def stop(self, timeout: float = 5.0) -> bool:
+        """Drain queued work and in-flight deferred finalizes, then stop
+        both threads. Idempotent; returns True when both threads joined
+        inside ``timeout`` (False = something is wedged — the threads
+        are daemons, so the process can still exit, but the caller
+        should say so)."""
+        with self._stop_lock:
+            if not self._stopped:
+                self._stopped = True
+                self._q.put(_STOP)  # strictly behind every admitted put
+        deadline = time.monotonic() + timeout
+        self._thread.join(timeout=max(deadline - time.monotonic(), 0.0))
+        self._finalizer.join(timeout=max(deadline - time.monotonic(), 0.0))
+        return not (self._thread.is_alive() or self._finalizer.is_alive())
 
     def _loop(self) -> None:
         while True:
-            pairs = [self._q.get()]
+            first = self._q.get()
+            if first is _STOP:
+                # forward shutdown to the finalizer AFTER every deferred
+                # batch already handed over — SimpleQueue is FIFO, so
+                # pending finalizes complete before the sentinel lands
+                self._finalize_q.put(_STOP)
+                return
+            pairs = [first]
+            stopping = False
             while len(pairs) < self.max_batch:
                 try:
-                    pairs.append(self._q.get_nowait())
+                    nxt = self._q.get_nowait()
                 except queue.Empty:
                     break
+                if nxt is _STOP:
+                    stopping = True
+                    break
+                pairs.append(nxt)
             drained = time.perf_counter()
-            items = [p[0] for p in pairs]
-            futures = [p[1] for p in pairs]
-            batch_id = self.batch_count
-            # the shared batch execution runs as a child span of the
-            # FIRST traced rider: the consumer thread has no request
-            # context of its own, and without an active span here the
-            # predict/serve stage histograms could never stamp
-            # trace-id exemplars (nor xla_compile events) for batched
-            # traffic. One representative trace carries the shared
-            # span; every rider still gets its own retro stage spans.
-            lead_ctx = next(
-                (p[3] for p in pairs if p[3] is not None), None)
-            for _, _, submitted, ctx in pairs:
-                QUERY_STAGE_SECONDS.observe(drained - submitted,
-                                            stage="queue_wait")
-                trace.record_span(ctx, "queue_wait", submitted,
-                                  drained - submitted, batch_id=batch_id,
+            self._run_batch(pairs, drained)
+            if stopping:
+                self._finalize_q.put(_STOP)
+                return
+
+    def _run_batch(self, pairs: list, drained: float) -> None:
+        items = [p[0] for p in pairs]
+        futures = [p[1] for p in pairs]
+        batch_id = self.batch_count
+        # the shared batch execution runs as a child span of the
+        # FIRST traced rider: the consumer thread has no request
+        # context of its own, and without an active span here the
+        # predict/serve stage histograms could never stamp
+        # trace-id exemplars (nor xla_compile events) for batched
+        # traffic. One representative trace carries the shared
+        # span; every rider still gets its own retro stage spans.
+        lead_ctx = next(
+            (p[3] for p in pairs if p[3] is not None), None)
+        for _, _, submitted, ctx in pairs:
+            QUERY_STAGE_SECONDS.observe(drained - submitted,
+                                        stage="queue_wait")
+            trace.record_span(ctx, "queue_wait", submitted,
+                              drained - submitted, batch_id=batch_id,
+                              batch_size=len(pairs))
+        _BATCH_SIZE.observe(float(len(pairs)))
+        _QUEUE_DEPTH.set(self._q.qsize())
+        self.batch_count += 1
+        self.request_count += len(items)
+        self.max_batch_seen = max(self.max_batch_seen, len(items))
+        self.last_stage_marks = None
+        with self._finalize_lock:
+            readback_inflight = self._inflight_finalizes > 0
+        try:
+            with trace.child_span(lead_ctx, "batch",
+                                  batch_id=batch_id,
+                                  batch_size=len(pairs)):
+                results = self._process(items)
+            if isinstance(results, DeferredBatch):
+                # the tick's dispatch + async d2h are in flight; hand
+                # the blocking readback to the finalizer thread and
+                # go straight back to draining the next tick
+                with self._finalize_lock:
+                    self._inflight_finalizes += 1
+                self.device_ticks += 1
+                _SERVING_TICKS.inc(route="device")
+                if readback_inflight:
+                    # a previous tick's readback/finalize was still
+                    # running while THIS dispatch executed: the link
+                    # round trip got hidden, which is the pipeline's
+                    # whole point — count it
+                    self.overlapped_ticks += 1
+                    _OVERLAPPED_READBACKS.inc()
+                self._finalize_q.put(
+                    (pairs, futures, batch_id, results))
+                return
+            _SERVING_TICKS.inc(route="host")
+            if len(results) != len(items):
+                raise RuntimeError(
+                    f"process_batch returned {len(results)} results "
+                    f"for {len(items)} items"
+                )
+        except Exception as e:
+            for f in futures:
+                f.set_exception(e)
+            return
+        # replay the batch's shared stage marks as one retro span
+        # per rider BEFORE releasing the futures, so a rider's trace
+        # can't commit while its spans are still being written
+        marks = self.last_stage_marks or ()
+        for stage, start, duration in marks:
+            for _, _, _, ctx in pairs:
+                trace.record_span(ctx, stage, start, duration,
+                                  batch_id=batch_id,
                                   batch_size=len(pairs))
-            _BATCH_SIZE.observe(float(len(pairs)))
-            _QUEUE_DEPTH.set(self._q.qsize())
-            self.batch_count += 1
-            self.request_count += len(items)
-            self.max_batch_seen = max(self.max_batch_seen, len(items))
-            self.last_stage_marks = None
-            with self._finalize_lock:
-                readback_inflight = self._inflight_finalizes > 0
-            try:
-                with trace.child_span(lead_ctx, "batch",
-                                      batch_id=batch_id,
-                                      batch_size=len(pairs)):
-                    results = self._process(items)
-                if isinstance(results, DeferredBatch):
-                    # the tick's dispatch + async d2h are in flight; hand
-                    # the blocking readback to the finalizer thread and
-                    # go straight back to draining the next tick
-                    with self._finalize_lock:
-                        self._inflight_finalizes += 1
-                    self.device_ticks += 1
-                    _SERVING_TICKS.inc(route="device")
-                    if readback_inflight:
-                        # a previous tick's readback/finalize was still
-                        # running while THIS dispatch executed: the link
-                        # round trip got hidden, which is the pipeline's
-                        # whole point — count it
-                        self.overlapped_ticks += 1
-                        _OVERLAPPED_READBACKS.inc()
-                    self._finalize_q.put(
-                        (pairs, futures, batch_id, results))
-                    continue
-                _SERVING_TICKS.inc(route="host")
-                if len(results) != len(items):
-                    raise RuntimeError(
-                        f"process_batch returned {len(results)} results "
-                        f"for {len(items)} items"
-                    )
-            except Exception as e:
-                for f in futures:
-                    f.set_exception(e)
-                continue
-            # replay the batch's shared stage marks as one retro span
-            # per rider BEFORE releasing the futures, so a rider's trace
-            # can't commit while its spans are still being written
-            marks = self.last_stage_marks or ()
-            for stage, start, duration in marks:
-                for _, _, _, ctx in pairs:
-                    trace.record_span(ctx, stage, start, duration,
-                                      batch_id=batch_id,
-                                      batch_size=len(pairs))
-            for f, r in zip(futures, results):
-                if isinstance(r, Exception):
-                    f.set_exception(r)
-                else:
-                    f.set_result(r)
+        for f, r in zip(futures, results):
+            if isinstance(r, Exception):
+                f.set_exception(r)
+            else:
+                f.set_result(r)
 
     def _finalize_loop(self) -> None:
         """Second pipeline stage: blocking readback + per-query tail of
@@ -233,7 +284,10 @@ class MicroBatcher:
         drained-batch failure contract carries over unchanged — and the
         loop keeps serving later ticks."""
         while True:
-            pairs, futures, batch_id, deferred = self._finalize_q.get()
+            got = self._finalize_q.get()
+            if got is _STOP:
+                return
+            pairs, futures, batch_id, deferred = got
             try:
                 try:
                     results = deferred.finalize()

@@ -62,8 +62,23 @@ def run_train(
         # (io/transfer.py) nested under the train phase
         try:
             with trace.span("run_train", instance=instance_id):
+                # crash-safe training: publish the workflow checkpoint
+                # scope (dir/interval/resume) around the train so
+                # checkpoint-capable algorithms snapshot periodically
+                # and --resume continues from the last valid snapshot
+                from contextlib import nullcontext
+
+                from predictionio_tpu.utils.checkpoint import (
+                    train_checkpoint_scope,
+                )
+
+                ckpt_scope = (
+                    train_checkpoint_scope(
+                        wp.checkpoint_dir, wp.checkpoint_every, wp.resume)
+                    if wp.checkpoint_dir else nullcontext()
+                )
                 with device_trace(trace_dir), timer.phase("train"), \
-                        trace.span("train"):
+                        trace.span("train"), ckpt_scope:
                     models = engine.train(ctx, engine_params, wp)
                 # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
                 with timer.phase("persist"), trace.span("persist"):

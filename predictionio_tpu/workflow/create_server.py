@@ -82,6 +82,11 @@ _QUERY_ERRORS = REGISTRY.counter(
 #: multi-second warmup compile would read as a device regression).
 _warmup_thread = threading.local()
 
+#: Set on the device-route probe thread: the synthetic tick that re-tests
+#: a tripped device route bypasses the breaker's allow_device() gate
+#: (that gate exists to keep LIVE traffic off the tripped route).
+_probe_thread = threading.local()
+
 
 def _observe_stage(stage: str, seconds: float, times: int = 1) -> None:
     """Explicit stage observation honoring the warmup-thread gate.
@@ -174,6 +179,29 @@ class QueryService:
         #: one batch on the device at a time: serializes the micro-batcher
         #: consumer with the background batch-shape warmup
         self._device_lock = threading.Lock()
+        # self-healing serving (resilience layer): a failed fused
+        # dispatch/readback retries the SAME tick on the host path; K
+        # consecutive device failures trip the route to host until a
+        # synthetic probe tick proves the device healthy again
+        import os as _os
+
+        from predictionio_tpu.resilience import AdmissionGate, \
+            DeviceRouteBreaker
+
+        self.device_route = DeviceRouteBreaker(
+            failures_to_open=int(
+                _os.environ.get("PIO_DEVICE_ROUTE_FAILURES", "3")),
+            cooldown_sec=float(
+                _os.environ.get("PIO_DEVICE_ROUTE_COOLDOWN", "5")),
+            name=config.server_name,
+        )
+        self._last_query = None  # replayed by the synthetic device probe
+        self._promote_thread: threading.Thread | None = None
+        # bounded admission: beyond this many in-flight /queries.json
+        # requests the server sheds with 429 + Retry-After instead of
+        # queueing unboundedly behind the batcher
+        self.admission = AdmissionGate.from_env(
+            "PIO_QUERY_ADMISSION_LIMIT", 256, name=config.server_name)
         from predictionio_tpu.utils.version_check import upgrade_probe_url
 
         if config.upgrade_check and upgrade_probe_url():
@@ -327,9 +355,9 @@ class QueryService:
                     "pinned %d bytes of serving model state device-"
                     "resident (serving_models arena)", pinned)
 
-        threading.Thread(
-            target=promote, name="serving-promote", daemon=True
-        ).start()
+        self._promote_thread = threading.Thread(
+            target=promote, name="serving-promote", daemon=True)
+        self._promote_thread.start()
 
     # -- routes -------------------------------------------------------------
     def _build_router(self) -> Router:
@@ -381,6 +409,9 @@ class QueryService:
                 # many overlapped a previous tick's readback
                 "deviceTicks": self.batcher.device_ticks,
                 "overlappedReadbacks": self.batcher.overlapped_ticks,
+                # resilience: "open" = the device route is tripped to
+                # host and awaiting a successful synthetic probe
+                "deviceRouteBreaker": self.device_route.state,
             }
         return 200, body
 
@@ -473,6 +504,13 @@ class QueryService:
         is what this beats)."""
         t0 = time.perf_counter()
         _QUERY_REQUESTS.inc()
+        # bounded admission BEFORE any parsing: an overloaded server
+        # sheds with 429 + Retry-After (the gateway translates that into
+        # failover/backoff) instead of queueing unboundedly
+        with self.admission.admit():
+            return self._post_query_admitted(request, t0)
+
+    def _post_query_admitted(self, request: Request, t0: float):
         try:
             with _STAGE_SECONDS.time(stage="parse"), trace.span("parse"):
                 data = request.json()
@@ -652,27 +690,67 @@ class QueryService:
             serving = self.serving
         n = len(queries)
         supplemented = [serving.supplement(q) for q in queries]
-        # timing starts AFTER the lock (waiting for the device is queueing,
-        # not device time) and observes only on SUCCESS: a poisoned batch
-        # raises here and gets re-run per query by _predict_batch — an
-        # aborted attempt observing too would double-count the stage and
-        # skew its quantiles exactly during error bursts
+        # remembered for the device-route breaker's synthetic probe: a
+        # query known to parse/supplement is a safe replay candidate
+        self._last_query = queries[0]
+        if len(algorithms) == 1:
+            deferred = getattr(
+                algorithms[0], "batch_predict_deferred", None)
+            if deferred is not None:
+                if self.device_route.probe_due():
+                    # the route is tripped and the cooldown elapsed:
+                    # re-test the device OFF the live path (this tick
+                    # continues on the host below either way)
+                    self._start_device_probe()
+                if self.device_route.allow_device() or \
+                        getattr(_probe_thread, "active", False):
+                    # timing starts AFTER the lock (waiting for the
+                    # device is queueing, not device time)
+                    with self._device_lock:
+                        t_pred = time.perf_counter()
+                        try:
+                            pending = deferred(
+                                models[0], list(enumerate(supplemented)))
+                        except Exception:  # noqa: BLE001
+                            # self-healing: the fused dispatch failed —
+                            # record it and retry the SAME tick on the
+                            # host path below (bit-exact answers, zero
+                            # dropped queries); K consecutive failures
+                            # trip the route
+                            self.device_route.record_failure(
+                                stage="dispatch")
+                            logger.warning(
+                                "device serving dispatch failed; tick "
+                                "retried on the host path", exc_info=True)
+                            pending = None
+                        if pending is not None:
+                            # dispatch + async d2h are enqueued; the
+                            # stage covers exactly the device-call
+                            # hand-off (the readback tail gets its own
+                            # stage below)
+                            pred_s = time.perf_counter() - t_pred
+                            _observe_stage("predict", pred_s, times=n)
+                            return self._deferred_batch(
+                                queries, supplemented, pending,
+                                algorithms, models, serving, n,
+                                t_pred, pred_s)
+        return self._host_batch(
+            queries, supplemented, algorithms, models, serving)
+
+    def _host_batch(self, queries: list, supplemented: list,
+                    algorithms, models, serving,
+                    record_marks: bool = True) -> list:
+        """The legacy host-path batch: pad → per-algorithm (batched)
+        predict under the device lock → per-query serve. Shared by the
+        main path and by the device-route failure retry, so a healed
+        tick's answers are exactly what the host route would have
+        served. Observes stages only on SUCCESS: a poisoned batch
+        raises here and gets re-run per query by _predict_batch — an
+        aborted attempt observing too would double-count the stage and
+        skew its quantiles exactly during error bursts."""
+        n = len(queries)
         with self._device_lock:
             t_pred = time.perf_counter()
-            if len(algorithms) == 1:
-                deferred = getattr(
-                    algorithms[0], "batch_predict_deferred", None)
-                if deferred is not None:
-                    pending = deferred(
-                        models[0], list(enumerate(supplemented)))
-                    if pending is not None:
-                        # dispatch + async d2h are enqueued; the stage
-                        # covers exactly the device-call hand-off (the
-                        # readback tail gets its own stage below)
-                        pred_s = time.perf_counter() - t_pred
-                        _observe_stage("predict", pred_s, times=n)
-                        return self._deferred_batch(
-                            queries, pending, serving, n, t_pred, pred_s)
             padded = supplemented
             if n > 1:
                 bp = 1 << (n - 1).bit_length()
@@ -707,23 +785,84 @@ class QueryService:
         _observe_stage("serve", serve_s, times=n)
         # hand the shared stage timings to the batcher, which replays
         # them as per-rider trace spans (warmup replays are synthetic
-        # traffic and must not be attributed to any rider)
-        if self.batcher is not None and \
+        # traffic and must not be attributed to any rider; the
+        # finalizer-thread device-failure retry passes record_marks=False
+        # — writing here from that thread would clobber the consumer's
+        # marks for a concurrently-running batch)
+        if record_marks and self.batcher is not None and \
                 not getattr(_warmup_thread, "active", False):
             self.batcher.last_stage_marks = [
                 ("predict", t_pred, pred_s), ("serve", t_serve, serve_s)]
         return out
 
-    def _deferred_batch(self, queries: list, pending, serving, n: int,
+    def _start_device_probe(self) -> None:
+        """Re-test a tripped device route with a SYNTHETIC tick on a
+        background thread (a replay of the last known-good query): a
+        successful fused dispatch + readback closes the breaker; a
+        failure re-arms the cooldown. Live traffic never pays the
+        probe."""
+        q = self._last_query
+        if q is None:
+            self.device_route.probe_inconclusive()
+            return
+
+        def probe():
+            _probe_thread.active = True  # bypass the breaker gate
+            _warmup_thread.active = True  # synthetic: no stage metrics
+            try:
+                r = self._predict_batch_shared([q])
+                if isinstance(r, DeferredBatch):
+                    # success/failure is recorded by the route
+                    # instrumentation inside finalize itself
+                    r.finalize()
+                else:
+                    # the dispatch failed (recorded inside) or placement
+                    # kept the probe on the host — nothing proven
+                    self.device_route.probe_inconclusive()
+            except Exception:  # the probe must never surface anywhere
+                logger.debug("device-route probe errored", exc_info=True)
+                self.device_route.probe_inconclusive()
+            finally:
+                _probe_thread.active = False
+                _warmup_thread.active = False
+
+        threading.Thread(
+            target=probe, name="device-route-probe", daemon=True).start()
+
+    def _deferred_batch(self, queries: list, supplemented: list, pending,
+                        algorithms, models, serving, n: int,
                         t_pred: float, pred_s: float) -> DeferredBatch:
         """Wrap a device-resident tick's pending results for the batcher's
         finalizer thread: blocking readback, per-query serve (errors
         isolated per rider), stage observations and retro span marks all
-        happen there — overlapped with the consumer's next dispatch."""
+        happen there — overlapped with the consumer's next dispatch.
+
+        Self-healing: a readback/finalize failure does NOT fail the
+        batch — the tick is retried on the host path right there on the
+        finalizer thread (``pio_serving_device_failures_total{stage=
+        "finalize"}`` counts it; the tick stays counted under
+        ``route="device"`` because that is how it was dispatched)."""
 
         def finalize() -> list:
             t_rb = time.perf_counter()
-            got = dict(pending())
+            try:
+                got = dict(pending())
+            except Exception:  # noqa: BLE001 — device readback failed
+                self.device_route.record_failure(stage="finalize")
+                logger.warning(
+                    "deferred device readback failed; tick retried on "
+                    "the host path", exc_info=True)
+                # the failed tick's result-buffer arena registration was
+                # freed by serve_top_k_batched's finalize ``finally`` —
+                # a regression shows on pio_device_hbm_bytes{arena=
+                # "serving_ticks"} and in the resilience tests. (A
+                # whole-arena scan here would false-alarm on a
+                # CONCURRENT tick's legitimately in-flight buffers —
+                # overlap is the pipeline's normal state.)
+                return self._host_batch(
+                    queries, supplemented, algorithms, models, serving,
+                    record_marks=False)
+            self.device_route.record_success()
             preds = [got[i] for i in range(n)]
             rb_s = time.perf_counter() - t_rb
             _observe_stage("readback", rb_s, times=n)
@@ -830,6 +969,27 @@ class QueryService:
 
     def wait_for_stop(self) -> None:
         self._stop_event.wait()
+
+    def shutdown(self, timeout: float = 5.0) -> bool:
+        """Clean teardown of the service's worker threads: the micro-
+        batcher's consumer AND finalizer stop after draining queued work
+        (a mid-flight deferred readback completes, never races the
+        teardown), and the serving-promote thread is joined. Bounded;
+        returns False when something stayed wedged (daemon threads, so
+        the process still exits). Idempotent."""
+        self._stop_event.set()
+        ok = True
+        if self.batcher is not None:
+            ok = self.batcher.stop(timeout)
+            if not ok:
+                logger.warning(
+                    "micro-batcher threads did not stop within %.1fs",
+                    timeout)
+        t = self._promote_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            ok = ok and not t.is_alive()
+        return ok
 
 
 def undeploy(ip: str, port: int) -> None:

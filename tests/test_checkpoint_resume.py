@@ -56,6 +56,61 @@ def test_checkpointer_sweeps_stale_tmp_dirs(tmp_path):
     assert not (tmp_path / "tmp-7").exists()
 
 
+def test_content_hash_write_and_verify(tmp_path):
+    from predictionio_tpu.utils.checkpoint import (
+        verify_content_hash,
+        write_content_hash,
+    )
+
+    save_pytree(tmp_path / "c", {"a": np.arange(6.0)})
+    assert not verify_content_hash(tmp_path / "c")  # no hash yet
+    write_content_hash(tmp_path / "c")
+    assert verify_content_hash(tmp_path / "c")
+    # any payload byte flip invalidates
+    payload = (tmp_path / "c" / "arrays.npz").read_bytes()
+    (tmp_path / "c" / "arrays.npz").write_bytes(payload[:-1])
+    assert not verify_content_hash(tmp_path / "c")
+
+
+def test_corrupt_latest_snapshot_falls_back_to_previous(tmp_path):
+    """The crash-mid-write case: a truncated newest snapshot is set
+    aside (corrupt-*) and load_latest answers from the previous one."""
+    ckpt = TrainCheckpointer(tmp_path, every=1, keep=2)
+    like = {"a": np.zeros(3)}
+    ckpt.save(0, {"a": np.full(3, 0.0)}, "fp")
+    ckpt.save(1, {"a": np.full(3, 1.0)}, "fp")
+    arrays = tmp_path / "step-1" / "arrays.npz"
+    arrays.write_bytes(arrays.read_bytes()[:10])  # torn write
+    step, state = ckpt.load_latest(like, "fp")
+    assert step == 0
+    np.testing.assert_array_equal(state["a"], np.zeros(3))
+    assert (tmp_path / "corrupt-step-1").is_dir()  # evidence kept
+    # clear() removes the set-aside snapshots too
+    ckpt.clear()
+    assert not list(tmp_path.glob("corrupt-*"))
+
+
+def test_all_snapshots_corrupt_returns_none(tmp_path):
+    ckpt = TrainCheckpointer(tmp_path, every=1, keep=2)
+    ckpt.save(0, {"a": np.zeros(2)}, "fp")
+    ckpt.save(1, {"a": np.ones(2)}, "fp")
+    for d in tmp_path.glob("step-*"):
+        (d / "arrays.npz").write_bytes(b"torn")
+    assert ckpt.load_latest({"a": np.zeros(2)}, "fp") is None
+    assert ckpt.latest_step() is None
+
+
+def test_missing_hash_file_reads_as_invalid(tmp_path):
+    """A pre-hash-era (or hand-built) snapshot without content.sha256
+    must not be trusted as the resume source."""
+    ckpt = TrainCheckpointer(tmp_path, every=1, keep=2)
+    ckpt.save(0, {"a": np.zeros(2)}, "fp")
+    ckpt.save(1, {"a": np.ones(2)}, "fp")
+    (tmp_path / "step-1" / "content.sha256").unlink()
+    step, _state = ckpt.load_latest({"a": np.zeros(2)}, "fp")
+    assert step == 0
+
+
 def test_load_pytree_like_restores_namedtuple_structure(tmp_path):
     import optax
 

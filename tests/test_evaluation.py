@@ -399,7 +399,7 @@ class TestBatchedSweep:
         monkeypatch.setenv("PIO_SWEEP_BATCH", "0")
         result = ev.run(one_ctx)
         assert result.sweep == {
-            "batched": 0, "sequential": 2, "buckets": [],
+            "batched": 0, "sequential": 2, "resumed": 0, "buckets": [],
             "released_models": 2, "enabled": False,
         }
 

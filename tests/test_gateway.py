@@ -38,6 +38,22 @@ def call(port, method, path, body=None, headers=None):
         return e.code, json.loads(e.read() or b"null")
 
 
+def call_with_headers(port, method, path, body=None):
+    """Like call() but also returns the response headers (Retry-After
+    assertions)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"null"), \
+                dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null"), dict(e.headers)
+
+
 class FakeReplica:
     """A scripted query-server stand-in on a real port: answers the
     status/queries/reload/stop surface the gateway talks to, counts
@@ -53,6 +69,9 @@ class FakeReplica:
         self.stop_count = 0
         self.hold: threading.Event | None = None
         self.entered = threading.Event()  # set when a query is in-handler
+        #: scripted (status, payload) responses consumed FIFO by _query;
+        #: empty = the normal 200 echo
+        self.responses: list[tuple[int, dict]] = []
         r = Router()
         r.add("GET", "/", lambda req: (200, {
             "status": "alive", "engineInstanceId": self.instance_id,
@@ -69,6 +88,18 @@ class FakeReplica:
             self.hold.wait(timeout=30)
         if self.delay:
             time.sleep(self.delay)
+        if self.responses:
+            status, payload = self.responses.pop(0)
+            if status == 429 and "retryAfterSec" in payload:
+                from predictionio_tpu.utils.http import RawResponse
+
+                return status, RawResponse(
+                    json.dumps(payload),
+                    "application/json; charset=UTF-8",
+                    headers={"Retry-After": str(int(
+                        payload["retryAfterSec"]))},
+                )
+            return status, payload
         return 200, {"from": self.tag,
                      "rid": req.headers.get("X-Request-ID"),
                      "echo": req.json()}
@@ -427,16 +458,62 @@ def test_gateway_stop_drains_and_stops_replicas():
         gw.stop(); srv.stop(); a.stop(); b.stop()
 
 
-def test_all_replicas_unreachable_returns_502():
+def test_all_replicas_down_returns_503_retry_after_inside_deadline():
+    """Every replica down: the gateway must shed with 503 + Retry-After
+    after ONE failed lap across the fleet — well inside the deadline
+    budget — instead of burning the whole deadline on backoff laps a
+    down fleet can't answer."""
     gw, srv = make_gateway([free_port(), free_port()],
-                           breaker_failures=10, deadline_sec=2.0,
+                           breaker_failures=10, deadline_sec=10.0,
                            retry_backoff_base_sec=0.005)
     try:
-        status, body = call(srv.port, "POST", "/queries.json", {"user": "u1"})
-        assert status == 502
+        t0 = time.monotonic()
+        status, body, headers = call_with_headers(
+            srv.port, "POST", "/queries.json", {"user": "u1"})
+        elapsed = time.monotonic() - t0
+        assert status == 503
         assert "message" in body
+        assert body["retryAfterSec"] > 0
+        assert headers.get("Retry-After") is not None
+        assert int(headers["Retry-After"]) >= 1
+        # one lap of connect-refused + backoff, not the 10s deadline
+        assert elapsed < 5.0
     finally:
         gw.stop(); srv.stop()
+
+
+def test_gateway_treats_upstream_429_as_backpressure():
+    """An upstream 429 is backpressure, not a replica fault: the breaker
+    stays closed, the query fails over to a replica with capacity; when
+    the WHOLE fleet sheds, the 429 (with Retry-After) passes through."""
+    shed = FakeReplica("shed").start()
+    okr = FakeReplica("ok").start()
+    # registration order breaks least-outstanding ties: `shed` (added
+    # first) is the primary for an idle fleet
+    shed.responses = [(429, {"message": "Overloaded.",
+                             "retryAfterSec": 2.0})]
+    gw, srv = make_gateway([shed, okr], retry_backoff_base_sec=0.005)
+    try:
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1"})
+        # the 429 from `shed` failed over to `okr` — and did NOT trip
+        # any breaker (backpressure is not a transport fault)
+        assert status == 200 and body["from"] == "ok"
+        assert shed.query_count == 1 and okr.query_count == 1
+        assert all(b.state == "closed" for b in gw._breakers.values())
+        # now make BOTH replicas shed: the 429 surfaces with its header
+        shed.responses = [(429, {"message": "Overloaded.",
+                                 "retryAfterSec": 2.0})] * 10
+        okr.responses = [(429, {"message": "Overloaded.",
+                                "retryAfterSec": 3.0})] * 10
+        status, body, headers = call_with_headers(
+            srv.port, "POST", "/queries.json", {"user": "u2"})
+        assert status == 429
+        assert headers.get("Retry-After") is not None
+        assert body["retryAfterSec"] > 0
+        assert all(b.state == "closed" for b in gw._breakers.values())
+    finally:
+        gw.stop(); srv.stop(); shed.stop(); okr.stop()
 
 
 def test_cli_deploy_replicas_starts_gateway(memory_storage, tmp_path,

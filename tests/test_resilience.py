@@ -1,0 +1,966 @@
+"""Resilience-layer tests (ISSUE 9): fault injection, self-healing
+serving (device-route breaker + host retry), crash-safe training,
+overload shedding, clean shutdown.
+
+The chaos acceptance pins live here: device-dispatch errors at 30%
+into a 2-replica deploy under load produce ZERO gateway 5xx and
+bit-exact answers, with the route breaker tripping to host and then
+recovering after faults clear; a train killed between checkpoint
+intervals resumes losing at most one interval with exact factor
+parity; sustained ingest beyond the admission bound yields 429 +
+Retry-After, never an unbounded queue or a 5xx.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.resilience import (
+    AdmissionGate,
+    DeviceRouteBreaker,
+    Overloaded,
+    faults,
+)
+from predictionio_tpu.workflow.create_server import ServerConfig, create_server
+
+from test_query_server import call, seed_and_train
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Fault state is process-global: every test starts and ends clean."""
+    monkeypatch.delenv("PIO_FAULTS", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def server(memory_storage):
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    yield {"port": srv.port, "service": service, "storage": memory_storage}
+    srv.stop()
+    service.shutdown()
+
+
+def _wait_for_thread(name: str, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline and any(
+        t.name == name for t in threading.enumerate()
+    ):
+        time.sleep(0.05)
+    assert name not in [t.name for t in threading.enumerate()]
+
+
+def _wait_until(predicate, timeout: float = 10.0, msg: str = "") -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    assert predicate(), msg or "condition not reached in time"
+
+
+# -- fault registry -----------------------------------------------------------
+
+
+def test_parse_compact_and_json_specs():
+    specs = faults.parse_spec(
+        "serving.dispatch:error:0.3:5,transfer.pack:delay:1::2")
+    assert [(s.site, s.kind, s.rate, s.count, s.skip) for s in specs] == [
+        ("serving.dispatch", "error", 0.3, 5, 0),
+        ("transfer.pack", "delay", 1.0, None, 2),
+    ]
+    specs = faults.parse_spec(
+        '[{"site": "a.b", "kind": "oom", "rate": 0.5, "delay_ms": 10}]')
+    assert specs[0].site == "a.b" and specs[0].kind == "oom"
+    assert faults.parse_spec("") == []
+    with pytest.raises(ValueError):
+        faults.parse_spec("a.b:notakind:1")
+    with pytest.raises(ValueError):
+        faults.parse_spec("justasite")
+
+
+def test_error_kind_count_bound_and_metrics():
+    before = faults.INJECTED.value(site="t.count", kind="error")
+    faults.install("t.count:error:1:2")
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("t.count")
+    # count spent: the third check passes clean
+    assert faults.fault_point("t.count", "payload") == "payload"
+    assert faults.injected_counts() == {"t.count:error": 2}
+    assert faults.INJECTED.value(site="t.count", kind="error") == before + 2
+
+
+def test_skip_arms_after_n_clean_passes():
+    faults.install("t.skip:error:1:1:3")
+    for _ in range(3):  # the first three checks pass clean
+        faults.fault_point("t.skip")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("t.skip")
+    faults.fault_point("t.skip")  # count=1: spent
+
+
+def test_oom_and_corrupt_shape_kinds():
+    faults.install("t.oom:oom:1:1")
+    with pytest.raises(faults.InjectedOOM, match="RESOURCE_EXHAUSTED"):
+        faults.fault_point("t.oom")
+    faults.install("t.corrupt:corrupt-shape:1:1")
+    out = faults.fault_point("t.corrupt", np.zeros((4, 3)))
+    assert out.shape == (3, 3)  # leading axis truncated
+    # spent: payload passes through untouched
+    again = np.zeros((4, 3))
+    assert faults.fault_point("t.corrupt", again) is again
+
+
+def test_env_spec_reparsed_on_change(monkeypatch):
+    monkeypatch.setenv("PIO_FAULTS", "t.env:error:1:1")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("t.env")
+    monkeypatch.setenv("PIO_FAULTS", "")  # live retune: faults off
+    faults.fault_point("t.env")
+    monkeypatch.setenv("PIO_FAULTS", "t.env:error:1:1")  # counters reset
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("t.env")
+
+
+def test_rate_is_seeded_deterministic(monkeypatch):
+    def run():
+        monkeypatch.setenv("PIO_FAULTS_SEED", "42")
+        faults.clear()
+        faults.install("t.rate:error:0.5")
+        hits = []
+        for i in range(32):
+            try:
+                faults.fault_point("t.rate")
+                hits.append(0)
+            except faults.InjectedFault:
+                hits.append(1)
+        return hits
+
+    a, b = run(), run()
+    assert a == b and 0 < sum(a) < 32
+
+
+# -- fault sites --------------------------------------------------------------
+
+
+def test_transfer_pack_fault_propagates_and_drains():
+    from predictionio_tpu.io.transfer import ChunkStager
+
+    faults.install("transfer.pack:error:1:1")
+    stager = ChunkStager(slots=2, name="fault-test")
+    with pytest.raises(faults.InjectedFault):
+        for _idx, _chunk in stager.stream(range(4), pack=lambda x: [x]):
+            pass
+    assert stager.inflight == 0  # the failed chunk's slot came back
+
+
+def test_checkpoint_write_fault_keeps_previous_snapshot(tmp_path):
+    from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+    ck = TrainCheckpointer(tmp_path, every=1, keep=2)
+    ck.save(0, {"w": np.arange(4.0)}, fingerprint="fp")
+    faults.install("checkpoint.write:error:1:1")
+    with pytest.raises(faults.InjectedFault):
+        ck.save(1, {"w": np.arange(4.0) * 2}, fingerprint="fp")
+    # the interrupted save left only a tmp- dir; step-0 is intact
+    got = ck.load_latest({"w": np.zeros(4)}, fingerprint="fp")
+    assert got is not None
+    step, state = got
+    assert step == 0 and np.array_equal(state["w"], np.arange(4.0))
+    # a fresh construction sweeps the crash leftovers
+    TrainCheckpointer(tmp_path)
+    assert not list(tmp_path.glob("tmp-*"))
+
+
+# -- device-route breaker (unit) ---------------------------------------------
+
+
+def test_route_breaker_trips_probes_and_recovers():
+    t = [0.0]
+    b = DeviceRouteBreaker(failures_to_open=2, cooldown_sec=5.0,
+                           now=lambda: t[0])
+    assert b.allow_device()
+    b.record_failure()
+    assert b.allow_device()  # 1 < K
+    b.record_failure()
+    assert not b.allow_device() and b.state == "open"
+    assert not b.probe_due()  # cooldown not elapsed
+    t[0] = 5.0
+    assert b.probe_due()
+    assert not b.probe_due()  # one probe owner per window
+    b.record_failure()  # probe failed: cooldown re-arms
+    t[0] = 9.0
+    assert not b.probe_due()
+    t[0] = 10.0
+    assert b.probe_due()
+    b.record_success()
+    assert b.allow_device() and b.state == "closed"
+
+
+def test_route_breaker_probe_inconclusive_rearms():
+    t = [10.0]
+    b = DeviceRouteBreaker(failures_to_open=1, cooldown_sec=2.0,
+                           now=lambda: t[0])
+    b.record_failure()
+    t[0] = 12.0
+    assert b.probe_due()
+    b.probe_inconclusive()
+    assert not b.probe_due()  # slot back, but cooldown restarted
+    t[0] = 14.0
+    assert b.probe_due()
+
+
+def test_consecutive_resets_on_success():
+    b = DeviceRouteBreaker(failures_to_open=2)
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == "closed"  # never two CONSECUTIVE
+
+
+# -- self-healing serving -----------------------------------------------------
+
+
+def test_dispatch_fault_heals_on_host_bit_exact(server):
+    """An injected fused-dispatch error must not fail the query: the
+    tick retries on the host path and answers exactly what the device
+    route answered before the fault."""
+    from predictionio_tpu.resilience.routebreaker import DEVICE_FAILURES
+
+    service = server["service"]
+    status, baseline = call(server["port"], "POST", "/queries.json",
+                            {"user": "u1", "num": 4})
+    assert status == 200
+    _wait_for_thread("batch-warmup")
+    ticks_before = service.batcher.device_ticks
+    fails_before = DEVICE_FAILURES.value(stage="dispatch")
+    faults.install("serving.dispatch:error:1:2")
+    for _ in range(2):
+        status, body = call(server["port"], "POST", "/queries.json",
+                            {"user": "u1", "num": 4})
+        assert status == 200
+        assert body == baseline  # bit-exact with the device route
+    assert DEVICE_FAILURES.value(stage="dispatch") == fails_before + 2
+    # failed dispatches served as host ticks, not device ticks
+    assert service.batcher.device_ticks == ticks_before
+    # 2 consecutive failures < default K=3: the route stayed closed,
+    # and the next (clean) tick goes device again
+    assert service.device_route.state == "closed"
+    faults.clear()
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"user": "u1", "num": 4})
+    assert status == 200 and body == baseline
+    assert service.batcher.device_ticks == ticks_before + 1
+
+
+def test_finalize_fault_heals_arena_and_tick_accounting(server):
+    """begin_readback raising mid-batch (deferred finalize) must heal on
+    the host path with zero dropped queries, leave the serving_ticks
+    arena empty, and keep the tick accounting truthful: the tick stays
+    route=device (how it was dispatched) while the failure lands in
+    pio_serving_device_failures_total{stage=finalize}."""
+    from predictionio_tpu.obs import device as device_obs
+    from predictionio_tpu.resilience.routebreaker import DEVICE_FAILURES
+    from predictionio_tpu.workflow.batching import _SERVING_TICKS
+
+    service = server["service"]
+    status, baseline = call(server["port"], "POST", "/queries.json",
+                            {"user": "u2", "num": 4})
+    assert status == 200
+    _wait_for_thread("batch-warmup")  # warmup resolves its own readbacks
+    ticks_before = service.batcher.device_ticks
+    device_count_before = _SERVING_TICKS.value(route="device")
+    host_count_before = _SERVING_TICKS.value(route="host")
+    fails_before = DEVICE_FAILURES.value(stage="finalize")
+    faults.install("transfer.readback:error:1:1")
+    status, body = call(server["port"], "POST", "/queries.json",
+                        {"user": "u2", "num": 4})
+    assert status == 200 and body == baseline  # healed, bit-exact
+    assert DEVICE_FAILURES.value(stage="finalize") == fails_before + 1
+    # dispatched on the device route: counted there, exactly once —
+    # the host retry does not mint a second tick
+    assert service.batcher.device_ticks == ticks_before + 1
+    assert _SERVING_TICKS.value(route="device") == device_count_before + 1
+    assert _SERVING_TICKS.value(route="host") == host_count_before
+    # the failed tick's device result buffers were freed on the failure
+    # path — nothing left registered in the per-tick arena
+    assert device_obs.arena("serving_ticks").bytes() == 0
+    assert service.device_route.state == "closed"  # 1 < K
+
+
+def test_route_breaker_trips_to_host_then_probe_recovers(
+        memory_storage, monkeypatch):
+    """Sustained device failures trip the route to host (live ticks stop
+    paying the doomed dispatch); after cooldown a synthetic probe tick
+    re-closes it and device serving resumes."""
+    monkeypatch.setenv("PIO_DEVICE_ROUTE_FAILURES", "2")
+    monkeypatch.setenv("PIO_DEVICE_ROUTE_COOLDOWN", "0.2")
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        status, baseline = call(srv.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 3})
+        assert status == 200
+        _wait_for_thread("batch-warmup")
+        faults.install("serving.dispatch:error:1")
+        for _ in range(3):
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"user": "u1", "num": 3})
+            assert status == 200 and body == baseline
+        assert service.device_route.state == "open"
+        assert not service.device_route.allow_device()
+        # while open, ticks go straight to host: no dispatch attempts,
+        # so the failure count stops growing
+        from predictionio_tpu.resilience.routebreaker import DEVICE_FAILURES
+
+        stuck = DEVICE_FAILURES.value(stage="dispatch")
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 3})
+        assert status == 200 and body == baseline
+        assert DEVICE_FAILURES.value(stage="dispatch") == stuck
+        # clear the fault; traffic after the cooldown triggers the
+        # synthetic probe, which closes the route again
+        faults.clear()
+        ticks_tripped = service.batcher.device_ticks
+
+        def recovered():
+            call(srv.port, "POST", "/queries.json",
+                 {"user": "u1", "num": 3})
+            return service.device_route.state == "closed"
+
+        _wait_until(recovered, timeout=15.0,
+                    msg="device route never recovered after faults "
+                        "cleared")
+        # device serving resumed for live ticks
+        status, body = call(srv.port, "POST", "/queries.json",
+                            {"user": "u1", "num": 3})
+        assert status == 200 and body == baseline
+        _wait_until(
+            lambda: (call(srv.port, "POST", "/queries.json",
+                          {"user": "u1", "num": 3}),
+                     service.batcher.device_ticks > ticks_tripped)[1],
+            timeout=10.0, msg="device ticks never resumed")
+    finally:
+        srv.stop()
+        service.shutdown()
+
+
+def test_chaos_dispatch_errors_zero_5xx_bit_exact_breaker_cycle(
+        memory_storage, monkeypatch):
+    """THE chaos acceptance pin: serving.dispatch errors at 30% into a
+    2-replica gateway deploy under concurrent load → every query
+    answers 200 (zero 5xx at the gateway) with answers bit-exact to the
+    host route; escalating to 100% trips both replicas' route breakers
+    to host; clearing the faults lets the synthetic probes recover the
+    device route."""
+    from predictionio_tpu.serve.gateway import (
+        GatewayConfig,
+        create_gateway_deployment,
+    )
+
+    monkeypatch.setenv("PIO_DEVICE_ROUTE_FAILURES", "2")
+    monkeypatch.setenv("PIO_DEVICE_ROUTE_COOLDOWN", "0.2")
+    monkeypatch.setenv("PIO_FAULTS_SEED", "7")
+    seed_and_train(memory_storage)
+    config = ServerConfig(ip="127.0.0.1", port=0)
+    dep = create_gateway_deployment(
+        config, 2,
+        GatewayConfig(ip="127.0.0.1", port=0, hedge=False,
+                      cache_max_entries=0, health_interval_sec=60.0))
+    dep.start()
+    users = [f"u{i}" for i in range(8)]
+    try:
+        # host-route ground truth: force every tick onto the legacy path
+        monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+        expected = {}
+        for u in users:
+            status, body = call(dep.port, "POST", "/queries.json",
+                                {"user": u, "num": 4})
+            assert status == 200
+            expected[u] = body
+        monkeypatch.delenv("PIO_SERVING_DEVICE")
+        # sanity: the device route answers the same before faults
+        status, body = call(dep.port, "POST", "/queries.json",
+                            {"user": users[0], "num": 4})
+        assert status == 200 and body == expected[users[0]]
+
+        def burst(n):
+            """n concurrent queries through the gateway: every one must
+            answer 200 with the host route's exact body. Concurrency
+            matters — it spreads load across BOTH replicas (sequential
+            queries tie-break to the first one)."""
+            statuses, bodies, lock = [], [], threading.Lock()
+
+            def worker(u):
+                s, b = call(dep.port, "POST", "/queries.json",
+                            {"user": u, "num": 4})
+                with lock:
+                    statuses.append(s)
+                    bodies.append((u, b))
+
+            threads = [threading.Thread(target=worker,
+                                        args=(users[i % 8],))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert len(statuses) == n
+            assert all(s == 200 for s in statuses)  # ZERO 5xx
+            for u, b in bodies:
+                assert b == expected[u]  # bit-exact with the host route
+
+        # phase 1: 30% dispatch errors under concurrent load
+        monkeypatch.setenv("PIO_FAULTS", "serving.dispatch:error:0.3")
+        burst(48)
+        assert faults.injected_counts().get(
+            "serving.dispatch:error", 0) > 0  # chaos actually fired
+
+        # phase 2: escalate to 100% until both replicas trip to host
+        monkeypatch.setenv("PIO_FAULTS", "serving.dispatch:error:1")
+        services = [service for _srv, service in dep.replicas]
+
+        def all_tripped():
+            burst(16)
+            return all(sv.device_route.state == "open" for sv in services)
+
+        _wait_until(all_tripped, timeout=30.0,
+                    msg="route breakers never tripped at 100% faults")
+
+        # phase 3: clear faults; synthetic probes recover both replicas
+        monkeypatch.setenv("PIO_FAULTS", "")
+
+        def all_recovered():
+            burst(16)
+            return all(sv.device_route.state == "closed"
+                       for sv in services)
+
+        _wait_until(all_recovered, timeout=30.0,
+                    msg="route breakers never recovered after faults "
+                        "cleared")
+    finally:
+        dep.stop()
+
+
+# -- overload shedding --------------------------------------------------------
+
+
+class _SlowBlocker:
+    """Input blocker that parks ingest handlers, so the admission bound
+    fills deterministically."""
+
+    def __init__(self, hold_sec: float):
+        self.hold_sec = hold_sec
+
+    def process(self, info, ctx):
+        time.sleep(self.hold_sec)
+
+
+def _post_event(port, key, body=None, timeout=30):
+    data = json.dumps(body or {
+        "event": "rate", "entityType": "user", "entityId": "u1",
+        "targetEntityType": "item", "targetEntityId": "i1",
+        "properties": {"rating": 4.0},
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/events.json?accessKey={key}",
+        data=data, headers={"Content-Type": "application/json"},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers)
+
+
+@pytest.fixture
+def event_server(memory_storage, monkeypatch):
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    monkeypatch.setenv("PIO_INGEST_ADMISSION_LIMIT", "2")
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "resapp"))
+    memory_storage.get_events().init(app_id)
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    es = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    es.start()
+    yield es, key
+    es.stop()
+
+
+def test_ingest_overload_sheds_429_never_5xx(event_server):
+    """Sustained ingest beyond the admission bound: excess requests shed
+    with 429 + Retry-After immediately; admitted ones commit 201; no
+    5xx, no unbounded queue."""
+    es, key = event_server
+    es.service.plugin_context.input_blockers["slow"] = _SlowBlocker(0.8)
+    results, lock = [], threading.Lock()
+
+    def worker():
+        status, headers = _post_event(es.port, key)
+        with lock:
+            results.append((status, headers))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    statuses = sorted(s for s, _h in results)
+    assert len(statuses) == 8
+    assert statuses.count(201) == 2  # exactly the admission bound
+    assert statuses.count(429) == 6  # the rest shed, immediately
+    assert not any(s >= 500 for s in statuses)
+    for s, h in results:
+        if s == 429:
+            assert int(h["Retry-After"]) >= 1
+    # the burst over: admission slots released, ingest flows again
+    del es.service.plugin_context.input_blockers["slow"]
+    status, _h = _post_event(es.port, key)
+    assert status == 201
+
+
+def test_query_server_admission_sheds_429(server):
+    service = server["service"]
+    # hold every slot: the next query must shed, not queue
+    for _ in range(service.admission.limit):
+        assert service.admission.try_enter()
+    try:
+        status, body = call(server["port"], "POST", "/queries.json",
+                            {"user": "u1", "num": 2})
+        assert status == 429
+        assert body["retryAfterSec"] > 0
+    finally:
+        for _ in range(service.admission.limit):
+            service.admission.exit()
+    status, _body = call(server["port"], "POST", "/queries.json",
+                         {"user": "u1", "num": 2})
+    assert status == 200
+
+
+def test_admission_gate_disabled_and_bounds():
+    g = AdmissionGate(0)  # 0 disables
+    for _ in range(64):
+        assert g.try_enter()
+    g2 = AdmissionGate(1, retry_after_sec=2.0, name="t2")
+    with g2.admit():
+        with pytest.raises(Overloaded) as ei:
+            with g2.admit():
+                pass
+        assert ei.value.status == 429
+        assert ei.value.extra["retryAfterSec"] == 2.0
+    with g2.admit():
+        pass
+
+
+def test_oversized_body_rejected_413(event_server, monkeypatch):
+    es, key = event_server
+    monkeypatch.setenv("PIO_MAX_BODY_MB", "0.0001")  # ~104 bytes
+    big = {"event": "rate", "entityType": "user", "entityId": "u" * 200,
+           "targetEntityType": "item", "targetEntityId": "i1"}
+    status, _h = _post_event(es.port, key, body=big)
+    assert status == 413
+    monkeypatch.setenv("PIO_MAX_BODY_MB", "32")
+    status, _h = _post_event(es.port, key)
+    assert status == 201
+
+
+# -- crash-safe training ------------------------------------------------------
+
+
+def _one_device_ctx():
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:1]).reshape(1, 1), ("data", "model")))
+
+
+def _prepared_data(name="resilience-train", n=400, n_users=25, n_items=20,
+                   seed=0, ctx=None):
+    from predictionio_tpu.templates.recommendation import (
+        ArrayDataSource,
+        ArrayDataSourceParams,
+        Preparator,
+        register_dataset,
+    )
+
+    rng = np.random.default_rng(seed)
+    register_dataset(
+        name,
+        [f"u{u}" for u in rng.integers(0, n_users, n)],
+        [f"i{i}" for i in rng.integers(0, n_items, n)],
+        rng.integers(1, 6, n).astype(np.float32),
+    )
+    td = ArrayDataSource(ArrayDataSourceParams(dataset=name)) \
+        .read_training(ctx)
+    return Preparator().prepare(ctx, td)
+
+
+def _als_algo(tmp_path, sub, iters=6):
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        AlgorithmParams,
+    )
+
+    return ALSAlgorithm(AlgorithmParams(
+        rank=4, numIterations=iters, seed=3,
+        checkpointDir=str(tmp_path / sub), checkpointEvery=2))
+
+
+def test_train_killed_between_intervals_resumes_with_parity(tmp_path):
+    """Kill-resume acceptance: a train killed between checkpoint
+    intervals resumes from the newest snapshot losing at most one
+    interval, and the resumed factors are EXACTLY an uninterrupted
+    run's."""
+    ctx = _one_device_ctx()
+    pd = _prepared_data(ctx=ctx)
+    # uninterrupted reference (checkpointing on: same per-iteration path)
+    model_ref = _als_algo(tmp_path, "ref").train(ctx, pd)
+    # killed run: the fault fires at iteration 4 (after 0..3 completed
+    # and snapshots landed at iterations 1 and 3)
+    algo = _als_algo(tmp_path, "killed")
+    faults.install("train.iteration:error:1:1:4")
+    with pytest.raises(faults.InjectedFault):
+        algo.train(ctx, pd)
+    faults.clear()
+    steps = sorted(p.name for p in (tmp_path / "killed").glob("step-*"))
+    assert steps == ["step-1", "step-3"]
+    # resume: same checkpoint dir, same params — continues from step-3
+    # (iterations 4 and 5 re-run; nothing before that is recomputed)
+    model_resumed = _als_algo(tmp_path, "killed").train(ctx, pd)
+    assert np.array_equal(model_resumed.factors.user_features,
+                          model_ref.factors.user_features)
+    assert np.array_equal(model_resumed.factors.item_features,
+                          model_ref.factors.item_features)
+    # a completed run clears its snapshots
+    assert not list((tmp_path / "killed").glob("step-*"))
+
+
+def test_truncated_latest_snapshot_falls_back_to_previous(
+        tmp_path, monkeypatch):
+    """A corrupt/truncated newest snapshot (crash mid-write, torn disk)
+    must fall back to the previous one — costing re-done iterations,
+    never a wrong model and never a crash."""
+    from predictionio_tpu.utils.checkpoint import TrainCheckpointer
+
+    ctx = _one_device_ctx()
+    pd = _prepared_data(ctx=ctx)
+    model_ref = _als_algo(tmp_path, "ref2").train(ctx, pd)
+    algo = _als_algo(tmp_path, "tr")
+    faults.install("train.iteration:error:1:1:5")
+    with pytest.raises(faults.InjectedFault):
+        algo.train(ctx, pd)
+    faults.clear()
+    # truncate the newest snapshot's arrays file
+    newest = tmp_path / "tr" / "step-3"
+    payload = (newest / "arrays.npz").read_bytes()
+    (newest / "arrays.npz").write_bytes(payload[: len(payload) // 2])
+    # keep the completed run's clear() from destroying the evidence
+    monkeypatch.setattr(TrainCheckpointer, "clear", lambda self: None)
+    model_resumed = _als_algo(tmp_path, "tr").train(ctx, pd)
+    # the corrupt snapshot was set ASIDE (not stashed as foreign — that
+    # would mean a fresh restart, which would also pass the parity
+    # check) and step-1 carried the resume
+    assert (tmp_path / "tr" / "corrupt-step-3").is_dir()
+    assert not list((tmp_path / "tr").glob("foreign-*"))
+    assert np.array_equal(model_resumed.factors.user_features,
+                          model_ref.factors.user_features)
+    assert np.array_equal(model_resumed.factors.item_features,
+                          model_ref.factors.item_features)
+
+
+def test_run_train_workflow_scope_checkpoint_and_resume(
+        memory_storage, tmp_path, monkeypatch):
+    """The `pio train --checkpoint-dir/--resume` path: run_train
+    publishes the workflow checkpoint scope, the (checkpoint-param-less)
+    ALS template picks it up, a killed train leaves snapshots, and a
+    --resume run completes from them."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.templates.recommendation import engine_factory
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+
+    # the conftest test mesh has 8 virtual devices, which routes ALS
+    # onto the SPMD path; pin the whole train to ONE device so the
+    # solve takes the single-device dense path — the one that supports
+    # per-iteration checkpoint/resume (the SPMD path warns and starts
+    # fresh)
+    from predictionio_tpu.workflow import core_workflow
+
+    monkeypatch.setattr(core_workflow, "workflow_context",
+                        lambda **kw: _one_device_ctx())
+
+    seed_and_train(memory_storage)  # seeds events (and trains once)
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    engine = engine_factory()
+    variant = {
+        "engineFactory": factory,
+        "datasource": {"params": {"app_name": "qsapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": 6, "seed": 0}}],
+    }
+    ep = engine.engine_params_from_json(variant)
+    ckdir = tmp_path / "wf-ck"
+    wp = WorkflowParams(checkpoint_dir=str(ckdir), checkpoint_every=2)
+    faults.install("train.iteration:error:1:1:4")
+    with pytest.raises(faults.InjectedFault):
+        run_train(engine, ep,
+                  new_engine_instance("default", "1", "default", factory,
+                                      ep), wp)
+    faults.clear()
+    assert sorted(p.name for p in ckdir.glob("step-*")) == \
+        ["step-1", "step-3"]
+    # --resume completes from the snapshots (and the instance COMPLETEs)
+    wp_resume = WorkflowParams(checkpoint_dir=str(ckdir),
+                               checkpoint_every=2, resume=True)
+    instance_id = run_train(
+        engine, ep,
+        new_engine_instance("default", "1", "default", factory, ep),
+        wp_resume)
+    inst = memory_storage.get_meta_data_engine_instances().get(instance_id)
+    assert inst.status == "COMPLETED"
+    assert not list(ckdir.glob("step-*"))  # completed: snapshots cleared
+    # WITHOUT --resume, leftover snapshots are cleared up front: seed
+    # one, train fresh, and the stale snapshot must be gone
+    ckdir2 = tmp_path / "wf-ck2"
+    faults.install("train.iteration:error:1:1:4")
+    with pytest.raises(faults.InjectedFault):
+        run_train(engine, ep,
+                  new_engine_instance("default", "1", "default", factory,
+                                      ep),
+                  WorkflowParams(checkpoint_dir=str(ckdir2),
+                                 checkpoint_every=2))
+    faults.clear()
+    assert list(ckdir2.glob("step-*"))
+    run_train(engine, ep,
+              new_engine_instance("default", "1", "default", factory, ep),
+              WorkflowParams(checkpoint_dir=str(ckdir2),
+                             checkpoint_every=2))  # no resume: fresh
+    assert not list(ckdir2.glob("step-*"))
+
+
+def test_killed_sweep_resumes_completed_candidates(tmp_path, monkeypatch):
+    """A sweep killed mid-run re-answers its finished candidates from
+    the completion log instead of retraining them, and the final scores
+    match an uninterrupted sweep's."""
+    from predictionio_tpu.core.engine import EngineParams
+    from predictionio_tpu.core.evaluation import Evaluation
+    from predictionio_tpu.core.fast_eval import FastEvalEngine
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        AlgorithmParams,
+        ArrayDataSource,
+        ArrayDataSourceParams,
+        PrecisionAtK,
+        Preparator,
+        Serving,
+    )
+
+    ctx = _one_device_ctx()
+    rng = np.random.default_rng(1)
+    from predictionio_tpu.templates.recommendation import register_dataset
+
+    register_dataset(
+        "resilience-sweep",
+        [f"u{u}" for u in rng.integers(0, 30, 500)],
+        [f"i{i}" for i in rng.integers(0, 24, 500)],
+        rng.integers(1, 6, 500).astype(np.float32),
+    )
+
+    def make_eval():
+        eps = [
+            EngineParams(
+                data_source_params=ArrayDataSourceParams(
+                    dataset="resilience-sweep", eval_k=2),
+                algorithms_params=(("als", AlgorithmParams(
+                    rank=4, numIterations=2, lambda_=l, seed=3)),),
+            )
+            for l in (0.01, 0.05, 0.1, 0.5)
+        ]
+        engine = FastEvalEngine(
+            ArrayDataSource, Preparator, {"als": ALSAlgorithm}, Serving)
+        ev = Evaluation(engine=engine, engine_params_list=eps,
+                        metric=PrecisionAtK(k=10, rating_threshold=4.0))
+        ev.output_path = None
+        return ev
+
+    monkeypatch.setenv("PIO_SWEEP_BATCH", "0")  # sequential: kill cleanly
+    clean = make_eval().run(ctx)
+    clean_scores = [ms.score for _ep, ms in clean.engine_params_scores]
+
+    monkeypatch.setenv("PIO_SWEEP_RESUME_DIR", str(tmp_path / "sweep"))
+    calls = {"n": 0}
+    orig = PrecisionAtK.calculate
+
+    def dying_calculate(self, eval_data_set):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("killed mid-sweep (simulated)")
+        return orig(self, eval_data_set)
+
+    monkeypatch.setattr(PrecisionAtK, "calculate", dying_calculate)
+    with pytest.raises(RuntimeError, match="killed mid-sweep"):
+        make_eval().run(ctx)
+    monkeypatch.setattr(PrecisionAtK, "calculate", orig)
+    # the first two candidates landed in the log before the kill
+    log = json.loads(
+        (tmp_path / "sweep" / "sweep-progress.json").read_text())
+    assert len(log) == 2
+    resumed = make_eval().run(ctx)
+    assert resumed.sweep["resumed"] == 2
+    got = [ms.score for _ep, ms in resumed.engine_params_scores]
+    assert got == pytest.approx(clean_scores, abs=1e-9)
+    # a completed sweep clears its log
+    assert not (tmp_path / "sweep" / "sweep-progress.json").exists()
+
+
+# -- clean shutdown -----------------------------------------------------------
+
+
+def test_microbatcher_stop_drains_deferred_and_joins():
+    from predictionio_tpu.workflow.batching import DeferredBatch, MicroBatcher
+
+    finalized = []
+
+    def process(items):
+        def fin():
+            time.sleep(0.1)  # a mid-flight readback the stop must drain
+            finalized.append(list(items))
+            return [f"ok:{x}" for x in items]
+
+        return DeferredBatch(fin)
+
+    mb = MicroBatcher(process, max_batch=4, name="stop-test")
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(mb.submit("a")), daemon=True)
+    t.start()
+    time.sleep(0.03)  # let the tick dispatch; its finalize is in flight
+    assert mb.stop(timeout=10.0)  # drains the deferred finalize first
+    t.join(timeout=10)
+    assert results == ["ok:a"] and finalized == [["a"]]
+    assert not mb._thread.is_alive() and not mb._finalizer.is_alive()
+    with pytest.raises(RuntimeError):
+        mb.submit("b")
+    assert mb.stop() is True  # idempotent
+
+
+def test_service_shutdown_joins_worker_threads(memory_storage):
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    status, _ = call(srv.port, "POST", "/queries.json",
+                     {"user": "u1", "num": 2})
+    assert status == 200
+    srv.stop()
+    batcher = service.batcher
+    promote = service._promote_thread
+    assert service.shutdown(timeout=10.0)
+    # assert on THIS service's thread objects, not global thread names —
+    # other tests' (never-shut-down) servers share the names
+    assert not batcher._thread.is_alive()
+    assert not batcher._finalizer.is_alive()
+    assert promote is None or not promote.is_alive()
+
+
+# -- chaos control surface ----------------------------------------------------
+
+
+def test_debug_faults_gated_by_pio_chaos(event_server, monkeypatch):
+    es, _key = event_server
+
+    def hit(method, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{es.port}/debug/faults", data=data,
+            headers={"Content-Type": "application/json"}, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    monkeypatch.delenv("PIO_CHAOS", raising=False)
+    assert hit("GET")[0] == 404  # off = looks like the route isn't there
+    monkeypatch.setenv("PIO_CHAOS", "1")
+    status, body = hit("POST", {"spec": "t.api:error:1:1"})
+    assert status == 200 and body["installed"] == 1
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("t.api")
+    status, body = hit("GET")
+    assert status == 200
+    assert body["injected"] == {"t.api:error": 1}
+    status, body = hit("POST", {"spec": ""})  # clear
+    assert status == 200 and body["installed"] == 0
+    faults.fault_point("t.api")  # nothing armed anymore
+    assert hit("POST", {"spec": "bad"})[0] == 400
+
+
+@pytest.mark.slow
+def test_pio_chaos_cli_drives_schedule_against_live_deploy(
+        memory_storage, monkeypatch, capsys):
+    """The full `pio chaos` flow: a scripted failure window against a
+    live query server, queries kept flowing (and healing) throughout,
+    injections reported, faults cleared at the end."""
+    from predictionio_tpu.tools.cli import cmd_chaos
+
+    monkeypatch.setenv("PIO_CHAOS", "1")
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    stop = threading.Event()
+    statuses = []
+
+    def traffic():
+        while not stop.is_set():
+            s, _b = call(srv.port, "POST", "/queries.json",
+                         {"user": "u1", "num": 3})
+            statuses.append(s)
+            time.sleep(0.02)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        args = type("Args", (), {
+            "url": f"http://127.0.0.1:{srv.port}",
+            "fault": ["serving.dispatch:error:1:5"],
+            "duration": 2.0,
+            "schedule": None,
+        })()
+        assert cmd_chaos(args) == 0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        srv.stop()
+        service.shutdown()
+    out = capsys.readouterr().out
+    # some injections fired — but not necessarily all 5: the route
+    # breaker trips after 3 consecutive failures and stops paying the
+    # doomed dispatch, which is the feature working
+    import re
+
+    m = re.search(r"serving\.dispatch:error: (\d+)", out)
+    assert m is not None and int(m.group(1)) >= 3
+    assert "faults cleared" in out
+    assert statuses and all(s == 200 for s in statuses)  # healed through
+    assert faults.active_spec_text() == ""  # nothing left armed
