@@ -78,6 +78,29 @@ _BATCH_SECONDS = REGISTRY.histogram(
     "Whole /batch/events.json request latency (its own histogram: batch "
     "wall time would corrupt the single-event quantiles)",
 )
+# Ingest staleness: seconds since the last successfully committed event
+# in THIS process, refreshed by a collect hook at scrape time (a pushed
+# age freezes the moment traffic stops — which is exactly when it
+# matters). Unset until the first commit, so a cold server scrapes no
+# misleading zero. Feeds the ingest-freshness side of the staleness SLO
+# and the future events-to-servable headline.
+_LAST_EVENT_AGE = REGISTRY.gauge(
+    "pio_ingest_last_event_age_seconds",
+    "Seconds since the last event was durably committed by this process",
+)
+
+#: Wall time of the last committed event, shared across EventService
+#: instances in the process (the gauge is process-scoped, like the rest
+#: of the registry); None until the first commit.
+_last_commit_walltime: float | None = None
+
+
+def _refresh_last_event_age() -> None:
+    if _last_commit_walltime is not None:
+        _LAST_EVENT_AGE.set(max(time.time() - _last_commit_walltime, 0.0))
+
+
+REGISTRY.add_collect_hook(_refresh_last_event_age)
 
 DEFAULT_PORT = 7070  # ref: EventServer.scala:504
 DEFAULT_GET_LIMIT = 20  # ref: EventServer.scala:313
@@ -212,6 +235,9 @@ class EventService:
         latency observation (per-event records inside a batch: the batch
         observes its wall time once)."""
         _INGESTED.inc(status=str(status))
+        if status == 201:
+            global _last_commit_walltime
+            _last_commit_walltime = time.time()
         if t0 is not None:
             _INGEST_SECONDS.observe(time.perf_counter() - t0)
         if self.config.stats:

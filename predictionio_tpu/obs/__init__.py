@@ -23,6 +23,16 @@ for every perf PR is quantified hot paths. This package provides:
     batcher → device, kept in a bounded ring + slowest-N reservoir and
     served as ``GET /debug/traces`` / ``pio trace``; histograms carry
     OpenMetrics trace-id exemplars while a sampled span is active.
+  * The fleet layer: metrics federation over a multi-process deploy
+    (:mod:`predictionio_tpu.obs.fleet`, ``GET /metrics/fleet`` on the
+    gateway), local time-series history rings
+    (:mod:`predictionio_tpu.obs.history`, ``GET /debug/history``), and
+    declarative SLOs with multi-window burn-rate evaluation
+    (:mod:`predictionio_tpu.obs.slo`, ``GET /debug/slo``, the
+    ``pio doctor`` triage report). These import lazily (history starts
+    its sampler only when a server mounts the scrape surface and
+    ``PIO_HISTORY_INTERVAL_S`` > 0), so library users of obs pay
+    nothing for the fleet machinery.
 
 Naming convention (enforced at registration): ``pio_`` prefix +
 snake_case, so metric names stay scrape-stable across PRs

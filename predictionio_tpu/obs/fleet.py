@@ -1,0 +1,499 @@
+"""Metrics federation: one merged view over a fleet of processes.
+
+The per-process pillars (metrics, traces, device profiling) each answer
+questions about ONE process; a ``pio deploy --replicas N`` topology plus
+an event server is several. This module scrapes every member's
+``GET /metrics`` (Prometheus text format — our own exposition, but any
+conformant one parses) and ``GET /``, and merges the families into a
+single fleet exposition served by the gateway at ``GET /metrics/fleet``:
+
+  * every sample gains an ``instance`` label (the member's ``host:port``,
+    or its role name for the local process); a family that already
+    carries an ``instance`` label has it relabelled to
+    ``exported_instance`` — the standard Prometheus federation collision
+    rule;
+  * **counters** additionally emit a fleet-summed series per remaining
+    label set under ``instance="fleet"`` (query totals across replicas);
+  * **gauges** stay strictly per-instance (summing two replicas' breaker
+    flags or HBM gauges would manufacture a number no process reports);
+  * **histograms** bucket-merge into an ``instance="fleet"`` series only
+    when every member's ``le`` ladder for that label set is identical —
+    cumulative buckets sum correctly then, and silently merging
+    misaligned ladders would corrupt every fleet quantile;
+  * members that fail to answer within the scrape timeout are omitted
+    (their absence shows in ``pio_fleet_instances{state="down"}``) —
+    a dead replica must not stall or sink the fleet scrape.
+
+Note for the in-process ``--replicas N`` topology: the gateway and its
+replicas share one process-wide registry, so each replica's scrape
+returns the same process text and fleet sums count it once per member.
+The per-instance series are still the point there (the ``server`` label
+separates replica traffic); the sums become meaningful the moment
+replicas run as their own processes (``Gateway.add_replica`` at remote
+ports), which is the deployment this layer exists for.
+
+No imports from serve/ — the gateway supplies targets; this module only
+scrapes, parses, merges, and (for ``pio doctor``) diagnoses.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+__all__ = [
+    "FleetTarget",
+    "collect",
+    "diagnose",
+    "fetch_json",
+    "merge_expositions",
+    "parse_exposition",
+]
+
+_SCRAPES = REGISTRY.counter(
+    "pio_fleet_scrapes_total",
+    "Per-member federation scrape outcomes",
+    labels=("result",),
+)
+_SCRAPE_SECONDS = REGISTRY.histogram(
+    "pio_fleet_scrape_seconds",
+    "Wall seconds for one whole-fleet federation collect (all members, "
+    "concurrent)",
+)
+_INSTANCES = REGISTRY.gauge(
+    "pio_fleet_instances",
+    "Fleet members by reachability after the last collect",
+    labels=("state",),
+)
+
+
+# -- exposition parsing -------------------------------------------------------
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\\\", "\x00").replace('\\"', '"')
+            .replace("\\n", "\n").replace("\x00", "\\"))
+
+
+@dataclass
+class Family:
+    name: str
+    kind: str = "untyped"
+    help: str = ""
+    #: (sample metric name, labels, value) — the sample name keeps its
+    #: _bucket/_sum/_count suffix
+    samples: list = field(default_factory=list)
+
+
+def parse_exposition(text: str) -> dict[str, Family]:
+    """Prometheus text format 0.0.4 → families by name. Tolerant: lines
+    it can't parse are skipped (a fleet scrape must survive one member's
+    odd line), samples before any TYPE get an untyped family keyed by
+    their base name."""
+    families: dict[str, Family] = {}
+    current: Family | None = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                current = families.setdefault(parts[2], Family(parts[2]))
+                current.kind = parts[3].strip() if len(parts) > 3 else \
+                    "untyped"
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                fam = families.setdefault(parts[2], Family(parts[2]))
+                fam.help = parts[3] if len(parts) > 3 else ""
+            continue
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                continue
+            name = line[:brace]
+            labels = {k: _unescape(v)
+                      for k, v in _LABEL_RE.findall(line[brace + 1:close])}
+            rest = line[close + 1:].strip()
+        else:
+            bits = line.split()
+            if len(bits) < 2:
+                continue
+            name, rest = bits[0], " ".join(bits[1:])
+            labels = {}
+        try:
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        fam = current
+        if fam is None or not _belongs(name, fam.name):
+            base = _base_name(name, families)
+            fam = families.setdefault(base, Family(base))
+        fam.samples.append((name, labels, value))
+    return families
+
+
+def _belongs(sample_name: str, family: str) -> bool:
+    return sample_name == family or (
+        sample_name.startswith(family)
+        and sample_name[len(family):] in _SUFFIXES)
+
+
+def _base_name(sample_name: str, families: dict) -> str:
+    for sfx in _SUFFIXES:
+        if sample_name.endswith(sfx) and sample_name[: -len(sfx)] in families:
+            return sample_name[: -len(sfx)]
+    return sample_name
+
+
+# -- merge --------------------------------------------------------------------
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _relabel(labels: dict[str, str], instance: str) -> dict[str, str]:
+    out = dict(labels)
+    if "instance" in out:  # relabel-on-collision, never clobber
+        out["exported_instance"] = out.pop("instance")
+    out["instance"] = instance
+    return out
+
+
+def _groupkey(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _merge_histogram_fleet(per_instance: list[tuple[str, Family]],
+                           lines: list[str], family: str) -> None:
+    """Fleet-summed histogram series, emitted only for label sets whose
+    ``le`` ladder is identical across every contributing member."""
+    groups: dict[tuple, dict] = {}
+    for instance, fam in per_instance:
+        for name, labels, value in fam.samples:
+            suffix = name[len(family):]
+            base = {k: v for k, v in labels.items() if k != "le"}
+            g = groups.setdefault(_groupkey(base), {
+                "labels": base, "buckets": {}, "ladders": [],
+                "sum": 0.0, "count": 0.0, "seen": set()})
+            if suffix == "_bucket":
+                le = labels.get("le", "")
+                g["buckets"][le] = g["buckets"].get(le, 0.0) + value
+                g["seen"].add(instance)
+                g.setdefault("ladder_by_instance", {}).setdefault(
+                    instance, []).append(le)
+            elif suffix == "_sum":
+                g["sum"] += value
+            elif suffix == "_count":
+                g["count"] += value
+    for key in sorted(groups):
+        g = groups[key]
+        ladders = {tuple(v) for v in
+                   g.get("ladder_by_instance", {}).values()}
+        if len(ladders) != 1:
+            continue  # misaligned le sets: per-instance series only
+        labels = _relabel(g["labels"], "fleet")
+        (ladder,) = ladders
+        for le in ladder:
+            le_labels = dict(labels)
+            le_labels["le"] = le
+            lines.append(f"{family}_bucket{_fmt_labels(le_labels)} "
+                         f"{_fmt_value(g['buckets'][le])}")
+        lines.append(f"{family}_sum{_fmt_labels(labels)} "
+                     f"{_fmt_value(g['sum'])}")
+        lines.append(f"{family}_count{_fmt_labels(labels)} "
+                     f"{_fmt_value(g['count'])}")
+
+
+def merge_expositions(per_instance: list[tuple[str, str]]) -> str:
+    """Merge (instance_name, exposition_text) pairs into one fleet
+    exposition (see the module docstring for the per-kind rules)."""
+    parsed = [(inst, parse_exposition(text)) for inst, text in per_instance]
+    names = sorted({name for _, fams in parsed for name in fams})
+    lines: list[str] = []
+    for family in names:
+        members = [(inst, fams[family]) for inst, fams in parsed
+                   if family in fams]
+        kind = next((f.kind for _, f in members if f.kind != "untyped"),
+                    "untyped")
+        help_text = next((f.help for _, f in members if f.help), "")
+        if help_text:
+            lines.append(f"# HELP {family} {help_text}")
+        lines.append(f"# TYPE {family} {kind}")
+        # per-instance samples, instance-labelled, in SOURCE order — a
+        # lexical re-sort would put le="+Inf" before le="0.1" and break
+        # parsers that expect ascending histogram buckets
+        for instance, fam in members:
+            for name, labels, value in fam.samples:
+                relabelled = _relabel(labels, instance)
+                lines.append(f"{name}{_fmt_labels(relabelled)} "
+                             f"{_fmt_value(value)}")
+        # fleet aggregates
+        if kind == "counter":
+            sums: dict[tuple, tuple[dict, float, str]] = {}
+            for instance, fam in members:
+                for name, labels, value in fam.samples:
+                    key = (name, _groupkey(labels))
+                    prev = sums.get(key)
+                    sums[key] = (labels, (prev[1] if prev else 0.0) + value,
+                                 name)
+            for key in sorted(sums, key=str):
+                labels, total, name = sums[key]
+                lines.append(f"{name}{_fmt_labels(_relabel(labels, 'fleet'))}"
+                             f" {_fmt_value(total)}")
+        elif kind == "histogram":
+            _merge_histogram_fleet(members, lines, family)
+    return "\n".join(lines) + "\n"
+
+
+# -- scraping -----------------------------------------------------------------
+
+@dataclass
+class FleetTarget:
+    """One fleet member. ``registry`` set = read the local process
+    registry directly (the gateway itself); else scrape host:port.
+    ``status_only`` skips the /metrics fetch (consumers that want just
+    the concurrent bounded status sweep — the dashboard fleet panel);
+    status-only members are naturally absent from the federated merge."""
+
+    instance: str
+    host: str = ""
+    port: int = 0
+    role: str = "replica"
+    registry: MetricsRegistry | None = None
+    status_only: bool = False
+
+
+def fetch_json(url: str, timeout: float = 10.0):
+    """GET ``url`` → parsed JSON, or None on HTTP error (body drained so
+    keep-alive connections stay usable), unreachable host, or a non-JSON
+    body. The one fail-soft JSON-GET used by ``pio doctor``,
+    ``pio status --fleet``, and the dashboard panels — the surfaces it
+    reads are each optional, so "missing" is an answer, not a crash."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        e.read()
+        return None
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def _http_get(host: str, port: int, path: str,
+              timeout: float) -> tuple[int, bytes]:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def scrape_member(target: FleetTarget, timeout: float = 2.0) -> dict:
+    """One member's /metrics text + / status JSON (fail-soft: ``ok``
+    False with the error string when unreachable)."""
+    out: dict = {"instance": target.instance, "role": target.role,
+                 "ok": False, "metricsText": None, "status": None,
+                 "error": None}
+    if target.registry is not None:
+        out["ok"] = True
+        out["metricsText"] = target.registry.expose()
+        return out
+    try:
+        if not target.status_only:
+            code, body = _http_get(target.host, target.port, "/metrics",
+                                   timeout)
+            if code != 200:
+                raise OSError(f"/metrics answered HTTP {code}")
+            out["metricsText"] = body.decode("utf-8", "replace")
+        try:
+            scode, sbody = _http_get(target.host, target.port, "/", timeout)
+            if scode == 200:
+                status = json.loads(sbody or b"{}")
+                out["status"] = status if isinstance(status, dict) else None
+        except (OSError, ValueError):
+            if target.status_only:
+                raise  # the status IS the contract then
+            # else: status is garnish; the scrape is the contract
+        out["ok"] = True
+    except (OSError, ValueError) as e:
+        out["error"] = str(e)
+    return out
+
+
+def collect(targets: list[FleetTarget], timeout: float = 2.0) -> list[dict]:
+    """Scrape every member concurrently: one straggler costs the fleet
+    scrape a bounded wait (scrape_member makes up to TWO sequential
+    GETs — /metrics then / — each budgeted ``timeout``, so the join
+    waits for both), never ``N *`` anything."""
+    t0 = time.perf_counter()
+    results: list[dict | None] = [None] * len(targets)
+
+    def one(i: int, t: FleetTarget) -> None:
+        results[i] = scrape_member(t, timeout)
+
+    threads = [threading.Thread(target=one, args=(i, t), daemon=True)
+               for i, t in enumerate(targets)]
+    for th in threads:
+        th.start()
+    deadline = time.monotonic() + 2.0 * timeout + 0.5
+    for th in threads:
+        th.join(max(deadline - time.monotonic(), 0.0))
+    out = [r if r is not None else
+           {"instance": t.instance, "role": t.role, "ok": False,
+            "metricsText": None, "status": None, "error": "scrape hung"}
+           for r, t in zip(results, targets)]
+    up = sum(1 for r in out if r["ok"])
+    _INSTANCES.set(up, state="up")
+    _INSTANCES.set(len(out) - up, state="down")
+    for r in out:
+        _SCRAPES.inc(result="ok" if r["ok"] else "error")
+    _SCRAPE_SECONDS.observe(time.perf_counter() - t0)
+    return out
+
+
+def federated_exposition(results: list[dict]) -> str:
+    """Merged fleet text from collect() results (dead members omitted)."""
+    return merge_expositions([
+        (r["instance"], r["metricsText"]) for r in results
+        if r["ok"] and r["metricsText"]])
+
+
+# -- triage (`pio doctor`) ----------------------------------------------------
+
+_SEVERITY_RANK = {"critical": 0, "warn": 1, "info": 2}
+
+
+def _finding(severity: str, subject: str, detail: str) -> dict:
+    return {"severity": severity, "subject": subject, "detail": detail}
+
+
+def diagnose(gateway_status: dict | None,
+             members: list[dict],
+             slo_state: dict | None,
+             traces: list[dict] | None = None) -> list[dict]:
+    """Rank what's wrong, most actionable first. Pure function of the
+    fetched surfaces so the heuristics unit-test without a deploy:
+
+      * breached SLOs (and fast-window burns over threshold);
+      * unreachable / down / suspect replicas and open breakers;
+      * per-replica outliers vs the fleet median p99 and error ratio;
+      * tripped device routes and stale models;
+      * the slowest retained traces, as leads.
+    """
+    findings: list[dict] = []
+    # -- SLO judgment
+    for slo in (slo_state or {}).get("slos", []):
+        burns = slo.get("burnRates") or {}
+        fast, slow = burns.get("fast"), burns.get("slow")
+        burn_txt = (f"burn {fast if fast is not None else 'n/a'}x fast / "
+                    f"{slow if slow is not None else 'n/a'}x slow "
+                    f"(threshold {slo.get('burnThreshold')}x)")
+        if slo.get("breached"):
+            findings.append(_finding(
+                "critical", f"SLO {slo['name']}",
+                f"BREACHED: {burn_txt} — {slo.get('description', '')}"))
+        elif fast is not None and fast > slo.get("burnThreshold", 14.4):
+            findings.append(_finding(
+                "warn", f"SLO {slo['name']}",
+                f"fast-window burn over threshold: {burn_txt}"))
+    # -- replica state from the gateway's view
+    breakers_open = []
+    for rep in (gateway_status or {}).get("replicas", []):
+        rid = rep.get("replica", "?")
+        if rep.get("state") == "down":
+            findings.append(_finding(
+                "critical", f"replica {rid}",
+                f"DOWN after {rep.get('consecutiveFailures', '?')} failed "
+                "health probes — routing skips it"))
+        elif rep.get("state") == "suspect":
+            findings.append(_finding(
+                "warn", f"replica {rid}",
+                "suspect (failed its last health probe; still routable)"))
+        if rep.get("breaker") == "open":
+            breakers_open.append(rid)
+            findings.append(_finding(
+                "critical", f"replica {rid}",
+                "circuit breaker OPEN — transport failures shed its "
+                "traffic to the rest of the fleet"))
+    # -- per-member statuses: outliers vs the fleet
+    statuses = {m["instance"]: m.get("status") for m in members
+                if m.get("role") == "replica"}
+    for m in members:
+        if not m["ok"]:
+            findings.append(_finding(
+                "critical", f"{m['role']} {m['instance']}",
+                f"unreachable: {m.get('error')}"))
+    p99s = {inst: s["p99ServingSec"] for inst, s in statuses.items()
+            if isinstance(s, dict) and s.get("p99ServingSec")}
+    if len(p99s) >= 2:
+        ordered = sorted(p99s.values())
+        mid = len(ordered) // 2
+        median = (ordered[mid] if len(ordered) % 2
+                  else (ordered[mid - 1] + ordered[mid]) / 2.0)
+        if median > 0:
+            for inst, p99 in sorted(p99s.items()):
+                if p99 >= 2.0 * median:
+                    findings.append(_finding(
+                        "warn", f"replica {inst}",
+                        f"p99 {p99 * 1e3:.1f} ms is "
+                        f"{p99 / median:.1f}x the fleet median "
+                        f"({median * 1e3:.1f} ms)"))
+    for inst, s in sorted(statuses.items()):
+        if not isinstance(s, dict):
+            continue
+        reqs = s.get("requestCount") or 0
+        errs = s.get("errorCount") or 0
+        if reqs >= 20 and errs / reqs > 0.05:
+            findings.append(_finding(
+                "warn", f"replica {inst}",
+                f"error ratio {errs}/{reqs} "
+                f"({errs / reqs:.1%}) over the last lifetime window"))
+        batching = s.get("batching") or {}
+        if batching.get("deviceRouteBreaker") == "open":
+            findings.append(_finding(
+                "warn", f"replica {inst}",
+                "device serving route tripped to host (awaiting a "
+                "successful synthetic probe)"))
+    # -- leads from the trace reservoir (the caller already bounds how
+    # many it wants folded in — `pio doctor --traces K`)
+    for doc in traces or []:
+        findings.append(_finding(
+            "info", f"trace {doc.get('traceId', '?')}",
+            f"slowest retained: {doc.get('durationMs', 0):.1f} ms, "
+            f"{len(doc.get('spans', []))} span(s) — "
+            f"`pio trace {doc.get('traceId', '')}`"))
+    findings.sort(key=lambda f: _SEVERITY_RANK.get(f["severity"], 3))
+    return findings

@@ -1,0 +1,408 @@
+"""Local time-series history: bounded rings over ~20 key series.
+
+Prometheus answers fleet-wide questions *if* a scraper is running; this
+module answers "what did the last hour look like" from inside the
+process, with no external infrastructure — the co-located-observation
+stance of the serverless-dataflow line of work (PAPERS.md). A background
+sampler reads the process metrics registry every
+``PIO_HISTORY_INTERVAL_S`` seconds (default 10; 0 disables) and records
+each derived series — qps and error rates as counter deltas, latency
+p50/p99 as *windowed* histogram quantiles (``quantile_since`` against
+the previous tick's bucket state, so each point covers exactly one
+interval), plus gauge snapshots (HBM, breakers, admission, staleness) —
+into fixed-size ring buffers (``PIO_HISTORY_CAPACITY`` points, default
+360 = one hour at the default interval).
+
+Surfaces:
+
+  * ``GET /debug/history`` on every server (mounted by
+    utils/http.add_metrics_route; 404 when disabled) — JSON
+    ``{intervalS, capacity, series: {name: {latest, points: [[t, v]]}}}``;
+  * dashboard sparklines (tools/dashboard.py);
+  * the SLO burn-rate engine (obs/slo.py) evaluates its windows over
+    these rings on every sample tick;
+  * optional JSONL spill for post-mortems: ``PIO_HISTORY_SPILL=<path>``
+    appends one ``{"t": ..., "values": {...}}`` line per tick, so a
+    crashed process leaves its last hour on disk.
+
+The sampler is process-global (one per process, like the registry) and
+fail-soft: a broken series samples None, never kills the thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from predictionio_tpu.obs.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "HistorySampler",
+    "ensure_started",
+    "get_sampler",
+    "history_enabled",
+    "history_interval_s",
+    "reset",
+]
+
+_SAMPLES = REGISTRY.counter(
+    "pio_history_samples_total",
+    "History sampler ticks recorded into the local rings",
+)
+
+
+def history_interval_s() -> float:
+    """``PIO_HISTORY_INTERVAL_S`` (default 10 s; 0 disables history,
+    /debug/history, and the SLO engine). Read per call so tests and
+    operators can retune before (re)starting the sampler."""
+    try:
+        return float(os.environ.get("PIO_HISTORY_INTERVAL_S", "10"))
+    except ValueError:
+        return 10.0
+
+
+def history_enabled() -> bool:
+    return history_interval_s() > 0
+
+
+def _capacity() -> int:
+    try:
+        return max(int(os.environ.get("PIO_HISTORY_CAPACITY", "360")), 2)
+    except ValueError:
+        return 360
+
+
+def _counter_total(registry: MetricsRegistry, name: str,
+                   label: str | None = None,
+                   values: tuple[str, ...] | None = None) -> float | None:
+    """Cumulative sum over a counter's children, optionally restricted to
+    ``label in values``; None when the metric has never observed."""
+    m = registry.get(name)
+    if not isinstance(m, (Counter, Gauge)):
+        return None
+    # a registered family with no children yet reads 0, not None: the
+    # subsystem is loaded, it just hasn't observed — so the tick BEFORE
+    # a burst still records a baseline and the burst's first rate lands
+    # one interval sooner (the SLO acceptance window depends on it)
+    items = m.items()
+    if label is None:
+        return sum(v for _, v in items)
+    try:
+        idx = m.label_names.index(label)
+    except ValueError:
+        return None
+    return sum(v for key, v in items
+               if values is None or key[idx] in values)
+
+
+def _gauge_sum(registry: MetricsRegistry, name: str) -> float | None:
+    m = registry.get(name)
+    if not isinstance(m, Gauge):
+        return None
+    items = m.items()
+    if not items:
+        return None
+    return sum(v for _, v in items)
+
+
+def _gauge_max(registry: MetricsRegistry, name: str) -> float | None:
+    m = registry.get(name)
+    if not isinstance(m, Gauge):
+        return None
+    items = m.items()
+    if not items:
+        return None
+    return max(v for _, v in items)
+
+
+class HistorySampler:
+    """Ring-buffered sampler over the process metrics registry.
+
+    ``sample_once()`` is the whole engine — the background thread just
+    calls it on the interval — so tests (and the SLO unit suite) drive
+    ticks synthetically without threads or sleeps."""
+
+    def __init__(self, interval_s: float | None = None,
+                 capacity: int | None = None,
+                 registry: MetricsRegistry = REGISTRY):
+        self.interval_s = (history_interval_s() if interval_s is None
+                           else float(interval_s))
+        self.capacity = _capacity() if capacity is None else int(capacity)
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._rings: dict[str, deque] = {}
+        #: previous cumulative counter values, for per-interval rates
+        self._prev_totals: dict[str, float] = {}
+        #: previous histogram bucket states, for windowed quantiles
+        self._prev_hist: dict[str, object] = {}
+        self._last_sample_t: float | None = None
+        #: called after every tick with (sampler, unix_ts) — the SLO
+        #: engine evaluates its windows here
+        self.listeners: list[Callable[["HistorySampler", float], None]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._spill_warned = False
+
+    # -- derivation helpers -------------------------------------------------
+    def _rate(self, key: str, total: float | None,
+              dt: float) -> float | None:
+        """Per-second rate from a cumulative total vs the previous tick
+        (None on the first sighting — a rate needs two points; a total
+        that went BACKWARDS, i.e. a restarted private registry, re-bases
+        instead of reporting a negative rate)."""
+        if total is None:
+            return None
+        prev = self._prev_totals.get(key)
+        self._prev_totals[key] = total
+        if prev is None or dt <= 0 or total < prev:
+            return None
+        return (total - prev) / dt
+
+    def _windowed_quantile(self, name: str, q: float,
+                           **labels) -> float | None:
+        """Histogram quantile over ONLY the last interval's observations
+        (bucket-state delta vs the previous tick)."""
+        m = self.registry.get(name)
+        if not isinstance(m, Histogram):
+            return None
+        key = f"{name}:{','.join(f'{k}={v}' for k, v in sorted(labels.items()))}:{q}"
+        state = m.state(**labels)
+        prev = self._prev_hist.get(key)
+        self._prev_hist[key] = state
+        if prev is None:
+            return None
+        try:
+            return m.quantile_since(q, prev, **labels)
+        except Exception:  # bucket-shape change mid-process
+            return None
+
+    def _collect(self, dt: float) -> dict[str, float | None]:
+        """One tick's values for every series. Each entry is independent
+        and fail-soft; a series the process never exercises (no gateway
+        in a bare replica, no event server in a query process) samples
+        None and renders as a gap, not a zero."""
+        reg = self.registry
+        ct = _counter_total
+        ms = lambda v: None if v is None else v * 1e3  # noqa: E731
+
+        values: dict[str, float | None] = {}
+        # serving (replica side)
+        values["query_qps"] = self._rate(
+            "query", ct(reg, "pio_query_requests_total"), dt)
+        values["query_error_rate"] = self._rate(
+            "query_err", ct(reg, "pio_query_errors_total"), dt)
+        values["query_p50_ms"] = ms(
+            self._windowed_quantile("pio_query_seconds", 0.5))
+        values["query_p99_ms"] = ms(
+            self._windowed_quantile("pio_query_seconds", 0.99))
+        values["stage_predict_p99_ms"] = ms(self._windowed_quantile(
+            "pio_query_stage_seconds", 0.99, stage="predict"))
+        values["stage_queue_wait_p99_ms"] = ms(self._windowed_quantile(
+            "pio_query_stage_seconds", 0.99, stage="queue_wait"))
+        # serving (gateway side)
+        values["gateway_qps"] = self._rate(
+            "gw", ct(reg, "pio_gateway_requests_total"), dt)
+        values["gateway_failure_rate"] = self._rate(
+            "gw_fail", ct(reg, "pio_gateway_requests_total", "outcome",
+                          ("error", "upstream_error", "no_replica",
+                           "all_down", "deadline")), dt)
+        values["gateway_p99_ms"] = ms(
+            self._windowed_quantile("pio_gateway_seconds", 0.99))
+        values["gateway_cache_hit_rate"] = self._ratio_rate(
+            "gw_cache", ct(reg, "pio_gateway_cache_hits_total"),
+            ct(reg, "pio_gateway_cache_misses_total"), dt)
+        values["gateway_breakers_open"] = _gauge_sum(
+            reg, "pio_gateway_breaker_open")
+        # ingest
+        values["ingest_events_per_sec"] = self._rate(
+            "ingest", ct(reg, "pio_events_ingested_total", "status",
+                         ("200", "201")), dt)
+        values["ingest_error_rate"] = self._rate(
+            "ingest_err", ct(reg, "pio_events_ingested_total", "status",
+                             ("400", "401", "404", "500", "503")), dt)
+        values["ingest_p99_ms"] = ms(
+            self._windowed_quantile("pio_ingest_seconds", 0.99))
+        # device / resilience
+        values["hbm_live_bytes"] = _gauge_sum(reg, "pio_device_hbm_bytes")
+        values["retraces_per_sec"] = self._rate(
+            "retrace", ct(reg, "pio_jax_retraces_total"), dt)
+        values["serving_route_breaker_open"] = _gauge_sum(
+            reg, "pio_serving_route_breaker_open")
+        values["admission_rejected_per_sec"] = self._rate(
+            "admission", ct(reg, "pio_admission_rejected_total"), dt)
+        values["admission_inflight"] = _gauge_sum(
+            reg, "pio_admission_inflight")
+        # staleness (the gauges refresh via collect hooks; run them so
+        # the sample reads current ages, not last-scrape ages)
+        reg._run_collect_hooks()
+        values["model_age_seconds"] = _gauge_max(
+            reg, "pio_serving_model_age_seconds")
+        values["ingest_last_event_age_seconds"] = _gauge_max(
+            reg, "pio_ingest_last_event_age_seconds")
+        return values
+
+    def _ratio_rate(self, key: str, num: float | None, den_extra: float | None,
+                    dt: float) -> float | None:
+        """Interval hit rate: Δhits / (Δhits + Δmisses)."""
+        dn = self._rate(key + ":n", num, dt)
+        dm = self._rate(key + ":m", den_extra, dt)
+        if dn is None or dm is None or dn + dm <= 0:
+            return None
+        return dn / (dn + dm)
+
+    # -- the tick -----------------------------------------------------------
+    def sample_once(self, t: float | None = None) -> dict[str, float | None]:
+        t = time.time() if t is None else t
+        # dt from the sample clock itself, so synthetic ticks (tests,
+        # the SLO unit suite) get deterministic rates
+        dt = (self.interval_s if self._last_sample_t is None
+              else t - self._last_sample_t)
+        self._last_sample_t = t
+        try:
+            values = self._collect(max(dt, 1e-9))
+        except Exception:  # a broken collector must not kill the thread
+            logger.exception("history sample failed")
+            return {}
+        with self._lock:
+            for name, v in values.items():
+                ring = self._rings.get(name)
+                if ring is None:
+                    ring = self._rings[name] = deque(maxlen=self.capacity)
+                ring.append((t, v))
+        _SAMPLES.inc()
+        self._spill(t, values)
+        for listener in list(self.listeners):
+            try:
+                listener(self, t)
+            except Exception:
+                logger.exception("history listener failed")
+        return values
+
+    def _spill(self, t: float, values: dict) -> None:
+        path = os.environ.get("PIO_HISTORY_SPILL", "")
+        if not path:
+            return
+        try:
+            clean = {k: (None if v is None or not math.isfinite(v) else v)
+                     for k, v in values.items()}
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps({"t": round(t, 3), "values": clean})
+                        + "\n")
+        except OSError:
+            if not self._spill_warned:
+                self._spill_warned = True
+                logger.warning("history spill to %s failed", path,
+                               exc_info=True)
+
+    # -- reads --------------------------------------------------------------
+    def series_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def points(self, name: str, since: float | None = None
+               ) -> list[tuple[float, float | None]]:
+        with self._lock:
+            ring = self._rings.get(name)
+            pts = list(ring) if ring is not None else []
+        if since is not None:
+            pts = [p for p in pts if p[0] >= since]
+        return pts
+
+    def window_values(self, name: str, seconds: float,
+                      now_ts: float | None = None) -> list[float]:
+        """Non-None values of ``name`` within the trailing window — the
+        SLO engine's read path."""
+        now_ts = time.time() if now_ts is None else now_ts
+        return [v for t, v in self.points(name, since=now_ts - seconds)
+                if v is not None]
+
+    def to_json(self, seconds: float | None = None,
+                names: list[str] | None = None) -> dict:
+        out: dict = {
+            "intervalS": self.interval_s,
+            "capacity": self.capacity,
+            "series": {},
+        }
+        since = None if seconds is None else time.time() - seconds
+        for name in self.series_names():
+            if names is not None and name not in names:
+                continue
+            pts = self.points(name, since=since)
+            latest = next((v for _, v in reversed(pts) if v is not None),
+                          None)
+            out["series"][name] = {
+                "latest": latest,
+                "points": [[round(t, 3), v] for t, v in pts],
+            }
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="obs-history", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+#: process-global sampler (None until first ensure_started with the
+#: feature enabled)
+_SAMPLER: HistorySampler | None = None
+_SAMPLER_LOCK = threading.Lock()
+
+
+def ensure_started() -> HistorySampler | None:
+    """Create and start the process sampler when history is enabled
+    (idempotent; every server mounts /debug/history through
+    add_metrics_route, which calls this). Also attaches the SLO engine
+    as a tick listener — judgment rides the same clock as observation."""
+    global _SAMPLER
+    if not history_enabled():
+        return None
+    with _SAMPLER_LOCK:
+        if _SAMPLER is None:
+            sampler = HistorySampler()
+            from predictionio_tpu.obs import slo
+
+            slo.attach(sampler)
+            sampler.start()
+            _SAMPLER = sampler
+        return _SAMPLER
+
+
+def get_sampler() -> HistorySampler | None:
+    return _SAMPLER
+
+
+def reset() -> None:
+    """Tear down the process sampler (tests retuning the interval)."""
+    global _SAMPLER
+    with _SAMPLER_LOCK:
+        if _SAMPLER is not None:
+            _SAMPLER.stop()
+            _SAMPLER = None

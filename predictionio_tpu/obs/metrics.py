@@ -23,6 +23,8 @@ Design notes:
 
 from __future__ import annotations
 
+import logging
+import os
 import re
 import threading
 import time
@@ -35,6 +37,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
+    "max_series_per_family",
     "set_exemplar_hook",
     "validate_metric_name",
     "DEFAULT_SECONDS_BUCKETS",
@@ -98,6 +101,54 @@ def _escape_help(v: str) -> str:
     return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
+def max_series_per_family() -> int:
+    """Label-set (child) bound per metric family
+    (``PIO_METRICS_MAX_SERIES``, default 1000; <= 0 disables). Read at
+    observation time so a live process can be retuned. Federation
+    multiplies cardinality (every scraped instance contributes its label
+    sets), so the registry needs a backstop: past the bound a NEW label
+    set is dropped — counted in ``pio_metrics_dropped_series_total`` with
+    a warn-once — instead of growing the scrape unboundedly. Existing
+    children keep updating."""
+    try:
+        return int(os.environ.get("PIO_METRICS_MAX_SERIES", "1000"))
+    except ValueError:
+        return 1000
+
+
+#: Families that already logged a drop warning (warn once per family,
+#: not once per dropped observation).
+_warned_families: set[str] = set()
+_warned_lock = threading.Lock()
+
+#: Created lazily against REGISTRY (defined at module bottom); exempt
+#: from the bound itself so the drop accounting can never recurse into
+#: another drop.
+_dropped_series: "Counter | None" = None
+
+
+def _note_dropped_series(family: str) -> None:
+    global _dropped_series
+    if _dropped_series is None:
+        c = REGISTRY.counter(
+            "pio_metrics_dropped_series_total",
+            "Observations dropped because the family hit the "
+            "PIO_METRICS_MAX_SERIES label-set bound",
+            labels=("family",),
+        )
+        c._exempt = True
+        _dropped_series = c
+    _dropped_series.inc(family=family)
+    with _warned_lock:
+        if family in _warned_families:
+            return
+        _warned_families.add(family)
+    logging.getLogger(__name__).warning(
+        "metric family %s hit the label-set bound (%d); new label sets "
+        "are dropped (PIO_METRICS_MAX_SERIES raises the bound)",
+        family, max_series_per_family())
+
+
 #: Trace-exemplar hook (installed by obs/trace.py): returns the active
 #: sampled trace id, or None. Kept as a module global read per
 #: observation so metrics has no import dependency on the trace layer
@@ -116,12 +167,27 @@ class _Metric:
     to the request path's JSON work)."""
 
     kind = "untyped"
+    #: True exempts the family from the label-set bound (only the drop
+    #: counter itself — bounding the bound's own accounting would lose
+    #: exactly the signal it exists to give).
+    _exempt = False
 
     def __init__(self, name: str, help: str = "", labels: Iterable[str] = ()):
         self.name = validate_metric_name(name)
         self.help = help
         self.label_names = _validate_labels(labels)
         self._lock = threading.Lock()
+
+    def _admit_child(self, n_children: int) -> bool:
+        """Gate a label set seen for the first time (call under
+        ``self._lock``): False = at the cardinality bound, drop it."""
+        if self._exempt:
+            return True
+        limit = max_series_per_family()
+        if limit <= 0 or n_children < limit:
+            return True
+        _note_dropped_series(self.name)
+        return False
 
     def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
         if set(labels) != set(self.label_names):
@@ -151,7 +217,10 @@ class _ScalarMetric(_Metric):
     def _add(self, amount: float, labels: dict[str, str]) -> None:
         key = self._key(labels)
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            cur = self._values.get(key)
+            if cur is None and not self._admit_child(len(self._values)):
+                return
+            self._values[key] = (cur or 0.0) + amount
 
     def value(self, **labels: str) -> float:
         with self._lock:
@@ -171,7 +240,11 @@ class _ScalarMetric(_Metric):
         samples = self.items()
         for key, v in sorted(samples):
             yield f"{self.name}{self._labelstr(key)} {_fmt(v)}"
-        if not self.label_names and not samples:
+        if not self.label_names and not samples and self.kind == "counter":
+            # a never-incremented counter truthfully reads 0; a never-SET
+            # gauge must stay absent — "pio_ingest_last_event_age_seconds
+            # 0" on a server that has ingested nothing would read as a
+            # perpetually-fresh pipeline
             yield f"{self.name} 0"
 
 
@@ -194,6 +267,9 @@ class Gauge(_ScalarMetric):
     def set(self, value: float, **labels: str) -> None:
         key = self._key(labels)
         with self._lock:
+            if key not in self._values and \
+                    not self._admit_child(len(self._values)):
+                return
             self._values[key] = float(value)
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
@@ -242,6 +318,8 @@ class Histogram(_Metric):
         with self._lock:
             d = self._data.get(key)
             if d is None:
+                if not self._admit_child(len(self._data)):
+                    return
                 d = self._data[key] = _HistData(len(self.bounds))
             d.counts[idx] += times
             d.sum += value * times

@@ -1,0 +1,348 @@
+"""Declarative SLOs with multi-window burn-rate evaluation.
+
+Metrics say what the fleet is doing; history says what it has been
+doing; this module renders the *judgment*: is the deploy inside its
+service-level objectives, and how fast is it spending error budget. The
+formulation is the SRE multi-window burn-rate alert that the ads-infra
+continuous-training loop (PAPERS.md) runs in production: for each SLO,
+the burn rate is
+
+    burn = (observed bad fraction over a window) / (1 - target)
+
+so burn 1.0 spends the error budget exactly at the sustainable rate and
+burn 14.4 exhausts a 30-day budget in ~2 days. An SLO is **breached**
+when BOTH the fast window (``PIO_SLO_FAST_WINDOW_S``, default 300 s)
+and the slow window (``PIO_SLO_SLOW_WINDOW_S``, default 3600 s) burn
+above the SLO's threshold (default 14.4) — fast-only spikes are noise,
+slow-only burns are old news; both together mean "paging-worthy now"
+(Google SRE workbook ch. 5).
+
+Windows are evaluated over the obs/history.py rings on every sample
+tick, and judged state lands in three places: the
+``pio_slo_burn_rate{slo,window}`` / ``pio_slo_breached{slo}`` gauges,
+``GET /debug/slo`` (mounted on every server; 404 when history is off),
+and the dashboard banner. ``pio doctor`` folds the same state into its
+triage report.
+
+Built-in SLOs (each retunable by env, replaceable wholesale by
+``PIO_SLO_CONFIG`` — inline JSON or ``@path`` to a file):
+
+  * ``query_availability`` — ratio: gateway failure outcomes over
+    gateway traffic (falls back to replica query errors over query
+    traffic in a gateway-less deploy); target
+    ``PIO_SLO_AVAILABILITY_TARGET`` (0.999).
+  * ``query_latency_p99`` — threshold: the windowed serving p99 must
+    stay under ``PIO_SLO_QUERY_P99_MS`` (250 ms); target 0.99 of
+    intervals.
+  * ``ingest_success`` — ratio: ingest error rate over all ingest
+    attempts; target ``PIO_SLO_INGEST_TARGET`` (0.999).
+  * ``model_staleness`` — threshold: the serving model's age must stay
+    under ``PIO_SLO_MODEL_MAX_AGE_S`` (86400 s); target 0.99.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+
+from predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SLO",
+    "SLOEngine",
+    "attach",
+    "default_slos",
+    "engine",
+    "ratio_burn",
+    "threshold_burn",
+]
+
+_BURN_RATE = REGISTRY.gauge(
+    "pio_slo_burn_rate",
+    "Error-budget burn rate per SLO and window (1.0 = spending budget "
+    "exactly at the sustainable rate)",
+    labels=("slo", "window"),
+)
+_BREACHED = REGISTRY.gauge(
+    "pio_slo_breached",
+    "1 while the SLO's fast AND slow burn rates both exceed its "
+    "threshold",
+    labels=("slo",),
+)
+
+
+@dataclass
+class SLO:
+    """One objective. ``kind="ratio"`` judges a bad-event rate against a
+    traffic rate (series are per-second rates from the history rings);
+    ``kind="threshold"`` judges a value series against a bound, where a
+    sample over the bound is one bad interval."""
+
+    name: str
+    description: str
+    kind: str  # "ratio" | "threshold"
+    target: float  # good-fraction objective, e.g. 0.999
+    #: ratio: series names (history rings)
+    bad: str = ""
+    base: str = ""
+    #: True when ``base`` already counts bad events (gateway_qps counts
+    #: failures); False adds bad to base for the denominator
+    base_includes_bad: bool = True
+    fallback_bad: str = ""
+    fallback_base: str = ""
+    fallback_base_includes_bad: bool = True
+    #: threshold: value series + bound
+    series: str = ""
+    bound: float = 0.0
+    burn_threshold: float = 14.4
+
+    def __post_init__(self):
+        if self.kind not in ("ratio", "threshold"):
+            raise ValueError(f"SLO {self.name}: unknown kind {self.kind!r}")
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"SLO {self.name}: target must be in (0, 1)")
+
+
+def ratio_burn(bad_sum: float, total_sum: float,
+               target: float) -> float | None:
+    """Burn rate of a ratio SLO over one window: bad fraction divided by
+    the error budget (1 - target). None without traffic — no traffic is
+    no evidence, not a breach."""
+    if total_sum <= 0:
+        return None
+    return (bad_sum / total_sum) / (1.0 - target)
+
+
+def threshold_burn(values: list[float], bound: float,
+                   target: float) -> float | None:
+    """Burn rate of a threshold SLO over one window: the fraction of
+    samples beyond the bound, divided by the budgeted fraction."""
+    if not values:
+        return None
+    bad = sum(1 for v in values if v > bound)
+    return (bad / len(values)) / (1.0 - target)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def fast_window_s() -> float:
+    return _env_float("PIO_SLO_FAST_WINDOW_S", 300.0)
+
+
+def slow_window_s() -> float:
+    return _env_float("PIO_SLO_SLOW_WINDOW_S", 3600.0)
+
+
+def default_slos() -> list[SLO]:
+    return [
+        SLO(
+            name="query_availability",
+            description="queries answered without a gateway-side failure "
+                        "(replica error rate in a gateway-less deploy)",
+            kind="ratio",
+            target=_env_float("PIO_SLO_AVAILABILITY_TARGET", 0.999),
+            bad="gateway_failure_rate", base="gateway_qps",
+            base_includes_bad=True,
+            fallback_bad="query_error_rate", fallback_base="query_qps",
+            fallback_base_includes_bad=True,
+        ),
+        SLO(
+            name="query_latency_p99",
+            description="windowed serving p99 under the latency bound",
+            kind="threshold",
+            target=0.99,
+            series="query_p99_ms",
+            bound=_env_float("PIO_SLO_QUERY_P99_MS", 250.0),
+        ),
+        SLO(
+            name="ingest_success",
+            description="events committed without an ingest error",
+            kind="ratio",
+            target=_env_float("PIO_SLO_INGEST_TARGET", 0.999),
+            bad="ingest_error_rate", base="ingest_events_per_sec",
+            base_includes_bad=False,
+        ),
+        SLO(
+            name="model_staleness",
+            description="serving model age under the freshness bound",
+            kind="threshold",
+            target=0.99,
+            series="model_age_seconds",
+            bound=_env_float("PIO_SLO_MODEL_MAX_AGE_S", 86400.0),
+        ),
+    ]
+
+
+def _configured_slos() -> list[SLO]:
+    """``PIO_SLO_CONFIG`` replaces the default set: inline JSON list or
+    ``@path`` to a JSON file; entries are SLO fields by name. A broken
+    config falls back to the defaults with a warning — a typo must not
+    silently disable judgment."""
+    raw = os.environ.get("PIO_SLO_CONFIG", "").strip()
+    if not raw:
+        return default_slos()
+    try:
+        if raw.startswith("@"):
+            with open(raw[1:], encoding="utf-8") as f:
+                raw = f.read()
+        entries = json.loads(raw)
+        if not isinstance(entries, list):
+            raise ValueError("PIO_SLO_CONFIG must be a JSON list")
+        return [SLO(**e) for e in entries]
+    except (OSError, ValueError, TypeError) as e:
+        logger.warning("bad PIO_SLO_CONFIG (%s); using default SLOs", e)
+        return default_slos()
+
+
+class SLOEngine:
+    """Evaluates every SLO's fast/slow windows over a HistorySampler's
+    rings; holds the last judged state for /debug/slo."""
+
+    def __init__(self, slos: list[SLO] | None = None):
+        self.slos = _configured_slos() if slos is None else slos
+        self._lock = threading.Lock()
+        self._state: list[dict] = []
+        self._evaluated_at: float | None = None
+
+    # -- window reads -------------------------------------------------------
+    @staticmethod
+    def _ratio_window(sampler, slo: SLO, seconds: float, now_ts: float,
+                      fallback: bool) -> float | None:
+        bad_name = slo.fallback_bad if fallback else slo.bad
+        base_name = slo.fallback_base if fallback else slo.base
+        includes = (slo.fallback_base_includes_bad if fallback
+                    else slo.base_includes_bad)
+        since = now_ts - seconds
+        bad_pts = dict(sampler.points(bad_name, since=since))
+        base_pts = dict(sampler.points(base_name, since=since))
+        bad_sum = total_sum = 0.0
+        seen = False
+        for t, base in base_pts.items():
+            if base is None:
+                continue
+            seen = True
+            bad = bad_pts.get(t) or 0.0
+            bad_sum += bad
+            total_sum += base if includes else base + bad
+        if not seen:
+            return None
+        return ratio_burn(bad_sum, total_sum, slo.target)
+
+    def _burn(self, sampler, slo: SLO, seconds: float,
+              now_ts: float) -> float | None:
+        if slo.kind == "threshold":
+            return threshold_burn(
+                sampler.window_values(slo.series, seconds, now_ts),
+                slo.bound, slo.target)
+        burn = self._ratio_window(sampler, slo, seconds, now_ts,
+                                  fallback=False)
+        if burn is None and slo.fallback_base:
+            burn = self._ratio_window(sampler, slo, seconds, now_ts,
+                                      fallback=True)
+        return burn
+
+    # -- the tick -----------------------------------------------------------
+    def evaluate(self, sampler, now_ts: float | None = None) -> list[dict]:
+        now_ts = time.time() if now_ts is None else now_ts
+        fast_s, slow_s = fast_window_s(), slow_window_s()
+        state: list[dict] = []
+        for slo in self.slos:
+            fast = self._burn(sampler, slo, fast_s, now_ts)
+            slow = self._burn(sampler, slo, slow_s, now_ts)
+            breached = (fast is not None and slow is not None
+                        and fast > slo.burn_threshold
+                        and slow > slo.burn_threshold)
+            # no-data windows write 0, not "keep the last value": a
+            # frozen 310x burn after an outage drains to zero traffic
+            # would page forever on the gauge while the JSON surface
+            # says null (the registry has no per-child remove)
+            _BURN_RATE.set(fast if fast is not None else 0.0,
+                           slo=slo.name, window="fast")
+            _BURN_RATE.set(slow if slow is not None else 0.0,
+                           slo=slo.name, window="slow")
+            _BREACHED.set(1.0 if breached else 0.0, slo=slo.name)
+            doc = {
+                "name": slo.name,
+                "description": slo.description,
+                "kind": slo.kind,
+                "target": slo.target,
+                "burnThreshold": slo.burn_threshold,
+                "burnRates": {
+                    "fast": None if fast is None else round(fast, 4),
+                    "slow": None if slow is None else round(slow, 4),
+                },
+                "windows": {"fastS": fast_s, "slowS": slow_s},
+                "breached": breached,
+            }
+            if slo.kind == "threshold":
+                doc["series"] = slo.series
+                doc["bound"] = slo.bound
+                latest = sampler.window_values(
+                    slo.series, fast_s, now_ts)
+                doc["latest"] = latest[-1] if latest else None
+            state.append(doc)
+        with self._lock:
+            self._state = state
+            self._evaluated_at = now_ts
+        return state
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "evaluatedAt": self._evaluated_at,
+                "fastWindowS": fast_window_s(),
+                "slowWindowS": slow_window_s(),
+                "slos": list(self._state),
+                "breached": [s["name"] for s in self._state
+                             if s["breached"]],
+            }
+
+    def config(self) -> list[dict]:
+        return [asdict(s) for s in self.slos]
+
+
+#: process-global engine, created when history attaches it
+_ENGINE: SLOEngine | None = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def engine() -> SLOEngine | None:
+    return _ENGINE
+
+
+def attach(sampler) -> SLOEngine:
+    """Wire the process SLO engine onto a history sampler's tick (called
+    by history.ensure_started). Idempotent per process."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = SLOEngine()
+        eng = _ENGINE
+
+    def on_tick(s, t):
+        eng.evaluate(s, t)
+
+    # one listener per sampler (history.reset() builds a fresh sampler)
+    if not any(getattr(f, "_slo_listener", False)
+               for f in sampler.listeners):
+        on_tick._slo_listener = True
+        sampler.listeners.append(on_tick)
+    return eng
+
+
+def reset() -> None:
+    """Drop the process engine (tests retuning SLO env knobs)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = None

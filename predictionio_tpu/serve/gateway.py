@@ -198,6 +198,12 @@ class GatewayConfig:
     health_interval_sec: float = 1.0
     health_timeout_sec: float = 2.0
     health_down_after: int = 3
+    #: extra fleet-federation member: the event server's (host, port),
+    #: scraped into GET /metrics/fleet next to the replicas (None = the
+    #: serving fleet only)
+    event_server: "tuple[str, int] | None" = None
+    #: per-member scrape timeout for GET /metrics/fleet
+    fleet_scrape_timeout_sec: float = 2.0
 
 
 class Gateway:
@@ -294,8 +300,43 @@ class Gateway:
         r.add("POST", "/queries.json", self.post_query)
         r.add("GET", "/reload", self.get_reload)
         r.add("GET", "/stop", self.get_stop)
+        r.add("GET", "/metrics/fleet", self.get_fleet_metrics)
         add_metrics_route(r)
         return r
+
+    # -- fleet federation (obs/fleet.py) ------------------------------------
+    def fleet_targets(self) -> list:
+        """Federation membership: the gateway itself (read locally — no
+        HTTP round trip into our own process), every registered replica,
+        and the configured event server."""
+        from predictionio_tpu.obs import fleet
+
+        targets = [fleet.FleetTarget(
+            instance="gateway", role="gateway", registry=REGISTRY)]
+        for r in self.registry.replicas():
+            targets.append(fleet.FleetTarget(
+                instance=r.id, host=r.host, port=r.port, role="replica"))
+        if self.config.event_server is not None:
+            host, port = self.config.event_server
+            if host in ("0.0.0.0", "::"):
+                host = "127.0.0.1"
+            targets.append(fleet.FleetTarget(
+                instance=f"{host}:{port}", host=host, port=port,
+                role="event"))
+        return targets
+
+    def get_fleet_metrics(self, request: Request):
+        """``GET /metrics/fleet``: scrape every member's /metrics
+        concurrently and serve the instance-labelled merge (dead members
+        omitted; see obs/fleet.py for the per-kind merge rules)."""
+        from predictionio_tpu.obs import fleet
+        from predictionio_tpu.utils.http import METRICS_CONTENT_TYPE
+
+        results = fleet.collect(
+            self.fleet_targets(),
+            timeout=self.config.fleet_scrape_timeout_sec)
+        return 200, RawResponse(fleet.federated_exposition(results),
+                                METRICS_CONTENT_TYPE)
 
     def get_status(self, request: Request):
         with self._stats_lock:

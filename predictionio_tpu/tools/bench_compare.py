@@ -1,0 +1,248 @@
+"""Bench-headline regression diff: ``pio bench-compare a.json b.json``.
+
+The bench trajectory (BENCH_r01...r05 at the repo root) is the perf
+contract between PRs, but reading two 60-key JSON blobs by eye is how
+regressions slip through. This tool diffs two headline documents and
+flags every metric that moved in its BAD direction beyond a threshold
+(default 5%, per-key overridable), exiting nonzero on any regression so
+it can gate CI.
+
+Accepted inputs, per file:
+
+  * a bare headline document — ``{"metric", "value", "extra": {...}}``
+    (the final-stdout-line contract of bench.py / bench_serving.py /
+    bench_sweep.py);
+  * a bench capture wrapper — ``{"n", "cmd", "rc", "tail", "parsed"}``
+    (the checked-in BENCH_r0N.json shape): ``parsed`` is used when
+    present, else the last JSON-parseable line of ``tail`` (older
+    captures have ``"parsed": null``).
+
+Direction is inferred from the key name: latency/wall-time keys
+(``*_ms``, ``*_sec``, ``*_s``, ``sec_per_*``, ``p50``/``p99`` forms)
+are lower-is-better; throughput/utilization keys (``*_per_sec``,
+``qps``, ``mfu``, ...) are higher-is-better. Non-numeric values, bools,
+and bookkeeping keys are skipped; keys present on only one side are
+reported as added/removed, never as regressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+__all__ = [
+    "compare",
+    "flatten_headline",
+    "load_headline",
+    "lower_is_better",
+]
+
+#: keys that are environment facts, not performance metrics
+_SKIP_KEYS = {
+    "metric", "unit", "device", "n_devices", "als_solver",
+    "serve_placement", "serve_conc_placement", "serve_concurrency",
+    "two_tower_batch", "two_tower_fixed_steps", "ingest_conns",
+    "ingest_host_cpus", "scan_events", "scan_partitions",
+    "band_violations", "dense_cache_hit", "peak_bf16_tflops",
+}
+
+_LOWER_BETTER_RE = re.compile(
+    r"(_ms$|_ms_|_sec$|_s$|_seconds$|sec_per_|_p50|_p99|latency"
+    r"|_bytes$|_mb_per_step$|retraces)")
+_HIGHER_BETTER_RE = re.compile(
+    r"(per_sec|per_iter$|_qps$|^qps$|mfu|rate$|_frac$|flops|iter_per)")
+
+
+def lower_is_better(key: str) -> bool:
+    """Bad direction per key. Order matters: cost-shaped names
+    (``sec_per_*``, ``*overhead*``, ``unattributed``) are checked first
+    — ``trace_overhead_frac`` must read as a cost even though ``_frac``
+    keys are otherwise utilization-shaped — then throughput names win
+    the remaining ties because ``*_per_sec`` would otherwise match the
+    ``_sec`` suffix rule."""
+    if "sec_per_" in key or "mb_per_step" in key or "overhead" in key \
+            or "unattributed" in key:
+        return True
+    if _HIGHER_BETTER_RE.search(key):
+        return False
+    return bool(_LOWER_BETTER_RE.search(key))
+
+
+def load_headline(path: str | Path) -> dict:
+    """A headline document from either accepted file shape (see module
+    docstring); raises ValueError when neither parses."""
+    doc = json.loads(Path(path).read_text(encoding="utf-8"))
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if "tail" in doc or "parsed" in doc:  # bench capture wrapper
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            return parsed
+        for line in reversed((doc.get("tail") or "").splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                got = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(got, dict):
+                return got
+        raise ValueError(
+            f"{path}: capture has no parsed headline and no JSON line "
+            "in its tail")
+    return doc
+
+
+def flatten_headline(doc: dict) -> dict[str, float]:
+    """Comparable numeric metrics: the top-level ``value`` (keyed by its
+    ``metric`` name) plus every numeric ``extra`` entry."""
+    out: dict[str, float] = {}
+    metric = doc.get("metric")
+    value = doc.get("value")
+    if isinstance(metric, str) and isinstance(value, (int, float)) \
+            and not isinstance(value, bool):
+        out[metric] = float(value)
+    for key, v in (doc.get("extra") or {}).items():
+        if key in _SKIP_KEYS or isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out
+
+
+def compare(a: dict, b: dict, threshold: float = 0.05,
+            key_thresholds: dict[str, float] | None = None) -> dict:
+    """Diff two flattened headline maps (a = baseline, b = candidate).
+
+    Returns ``{regressions, improvements, unchanged, added, removed}``;
+    each entry carries the relative change and the direction rule used.
+    A key regresses when it moves in its bad direction by more than its
+    threshold (``key_thresholds`` overrides the global one per key)."""
+    key_thresholds = key_thresholds or {}
+    regressions, improvements, unchanged = [], [], []
+    for key in sorted(set(a) & set(b)):
+        base, cand = a[key], b[key]
+        thr = key_thresholds.get(key, threshold)
+        lower = lower_is_better(key)
+        if base == 0:
+            # no relative change exists, but 0 -> nonzero in the bad
+            # direction is exactly the regression shape a zero-cost
+            # metric (retraces, overhead) exists to guard — it must not
+            # hide under "within threshold"
+            entry = {"key": key, "base": base, "candidate": cand,
+                     "change": None, "threshold": thr,
+                     "direction": "lower_is_better" if lower else
+                                  "higher_is_better",
+                     "note": "zero baseline"}
+            if cand == 0:
+                unchanged.append(entry)
+            elif (cand > 0) == lower:
+                regressions.append(entry)
+            else:
+                improvements.append(entry)
+            continue
+        change = (cand - base) / abs(base)
+        bad = change > thr if lower else change < -thr
+        good = change < -thr if lower else change > thr
+        entry = {
+            "key": key, "base": base, "candidate": cand,
+            "change": round(change, 4), "threshold": thr,
+            "direction": "lower_is_better" if lower else
+                         "higher_is_better",
+        }
+        if bad:
+            regressions.append(entry)
+        elif good:
+            improvements.append(entry)
+        else:
+            unchanged.append(entry)
+    return {
+        "regressions": regressions,
+        "improvements": improvements,
+        "unchanged": unchanged,
+        "added": sorted(set(b) - set(a)),
+        "removed": sorted(set(a) - set(b)),
+    }
+
+
+def _fmt_row(entry: dict) -> str:
+    if entry.get("change") is None:
+        return (f"  {entry['key']}: {entry['base']:g} -> "
+                f"{entry['candidate']:g} (zero baseline, "
+                f"{entry['direction']})")
+    arrow = "↓" if entry["change"] < 0 else "↑"
+    return (f"  {entry['key']}: {entry['base']:g} -> "
+            f"{entry['candidate']:g} ({arrow}{abs(entry['change']):.1%}, "
+            f"{entry['direction']}, threshold {entry['threshold']:.0%})")
+
+
+def run(baseline: str, candidate: str, threshold: float = 0.05,
+        key_thresholds: dict[str, float] | None = None,
+        as_json: bool = False) -> int:
+    try:
+        a = flatten_headline(load_headline(baseline))
+        b = flatten_headline(load_headline(candidate))
+    except (OSError, ValueError) as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 2
+    result = compare(a, b, threshold, key_thresholds)
+    if as_json:
+        print(json.dumps(result, indent=2))
+        return 1 if result["regressions"] else 0
+    if result["regressions"]:
+        print(f"[ERROR] {len(result['regressions'])} regression(s) "
+              f"{baseline} -> {candidate}:", file=sys.stderr)
+        for entry in result["regressions"]:
+            print(_fmt_row(entry), file=sys.stderr)
+    if result["improvements"]:
+        print(f"[INFO] {len(result['improvements'])} improvement(s):")
+        for entry in result["improvements"]:
+            print(_fmt_row(entry))
+    print(f"[INFO] {len(result['unchanged'])} metric(s) within threshold; "
+          f"{len(result['added'])} added, {len(result['removed'])} removed.")
+    if result["removed"]:
+        print(f"[INFO] removed keys: {', '.join(result['removed'])}")
+    return 1 if result["regressions"] else 0
+
+
+def parse_key_thresholds(specs: list[str]) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for spec in specs:
+        key, sep, value = spec.partition("=")
+        if not sep:
+            raise ValueError(
+                f"--key-threshold wants key=fraction, got {spec!r}")
+        out[key] = float(value)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="diff two bench headline JSONs; exit 1 on regression")
+    parser.add_argument("baseline")
+    parser.add_argument("candidate")
+    parser.add_argument("--threshold", type=float, default=0.05,
+                        help="relative change flagged as a regression "
+                             "(default 0.05)")
+    parser.add_argument("--key-threshold", action="append", default=[],
+                        metavar="KEY=FRACTION",
+                        help="per-key threshold override (repeatable)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable diff instead of text")
+    args = parser.parse_args(argv)
+    try:
+        kt = parse_key_thresholds(args.key_threshold)
+    except ValueError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 2
+    return run(args.baseline, args.candidate, args.threshold, kt,
+               as_json=args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""CLI/doc drift checker: ``python -m predictionio_tpu.tools.check_cli_docs``.
+
+The ``pio`` subcommand surface is the operator contract the same way
+metric names are the scrape contract (tools/check_metrics.py), and
+docs/operations.md is its operator-facing side. This tool asserts that
+every registered subcommand — the list comes from the REAL parser
+(tools/cli.py ``build_parser``), so it can't drift from the code — is
+mentioned as ``pio <subcommand>`` somewhere in docs/operations.md.
+
+Wired into tier-1 as tests/test_check_cli_docs.py, so a PR adding a
+subcommand without documenting it (or renaming one and stranding the old
+doc text) fails fast. The reverse direction (doc mentions of removed
+subcommands) is checked against the same list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+DOCS_REL = "docs/operations.md"
+
+#: Doc tokens that look like subcommand mentions: ``pio <word>``, with
+#: or without backticks, hyphenated names included.
+_DOC_CMD_RE = re.compile(r"\bpio[ \-]([a-z][a-z0-9-]*)")
+
+#: `pio-start-all` / `pio-stop-all` are installed aliases, and prose
+#: like "pio console" describes the tool, not a subcommand.
+_DOC_IGNORE = {"console", "env", "tpu"}
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def cli_subcommands() -> list[str]:
+    """Registered ``pio`` subcommand names, from the live parser."""
+    from predictionio_tpu.tools.cli import build_parser
+
+    parser = build_parser()
+    sub = next(a for a in parser._actions
+               if isinstance(a, argparse._SubParsersAction))
+    return sorted(sub.choices)
+
+
+def documented_commands(doc_path: Path) -> set[str]:
+    text = doc_path.read_text(encoding="utf-8")
+    return {m.group(1) for m in _DOC_CMD_RE.finditer(text)}
+
+
+def check(root: Path | None = None,
+          subcommands: list[str] | None = None) -> list[str]:
+    """All drift problems (empty list = in sync)."""
+    root = root or repo_root()
+    doc_path = root / DOCS_REL
+    commands = cli_subcommands() if subcommands is None else subcommands
+    documented = documented_commands(doc_path)
+    problems: list[str] = []
+    for name in commands:
+        if name not in documented:
+            problems.append(
+                f"pio {name}: registered in tools/cli.py but never "
+                f"mentioned in {DOCS_REL} — document the subcommand "
+                "(the CLI reference table is the natural home)")
+    known = set(commands) | _DOC_IGNORE
+    for name in sorted(documented - known):
+        # only flag doc tokens that LOOK like commands we once had:
+        # prose such as "pio processes" would false-positive otherwise,
+        # so restrict the reverse check to hyphenated/verb-like tokens
+        # that match a historical naming shape (conservative: hyphenated
+        # names are always command-shaped)
+        if "-" in name:
+            problems.append(
+                f"pio {name}: mentioned in {DOCS_REL} but not a "
+                "registered subcommand — stale docs or a typo")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"[ERROR] {p}", file=sys.stderr)
+    if problems:
+        print(f"[ERROR] {len(problems)} CLI/doc drift problem(s).",
+              file=sys.stderr)
+        return 1
+    print(f"[INFO] pio subcommands and {DOCS_REL} are in sync "
+          f"({len(cli_subcommands())} subcommand(s)).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

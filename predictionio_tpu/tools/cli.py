@@ -22,7 +22,46 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command")
 
     p_status = sub.add_parser("status", help="verify installation and storage")
+    p_status.add_argument(
+        "--fleet", action="store_true",
+        help="report a live deployment's fleet health (gateway + "
+             "replicas + SLOs) instead of inspecting this install")
+    p_status.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="gateway (or single query server) to ask with --fleet")
     p_status.set_defaults(func=cmd_status)
+
+    # -- fleet triage (obs/fleet.py + obs/slo.py surfaces) -------------------
+    p_doc = sub.add_parser(
+        "doctor",
+        help="ranked triage report for a live deployment: replica "
+             "health, SLO burn rates, slowest traces")
+    p_doc.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="gateway (or single query server) front door")
+    p_doc.add_argument(
+        "--traces", type=int, default=3, metavar="K",
+        help="slowest retained traces to fold in as leads (default 3)")
+    p_doc.add_argument("--json", action="store_true",
+                       help="raw findings JSON instead of the report")
+    p_doc.set_defaults(func=cmd_doctor)
+
+    # -- bench regression diff (tools/bench_compare.py) ----------------------
+    p_bc = sub.add_parser(
+        "bench-compare",
+        help="diff two bench headline JSONs and flag metric regressions "
+             "(exit 1 on regression)")
+    p_bc.add_argument("baseline", help="baseline headline/capture JSON")
+    p_bc.add_argument("candidate", help="candidate headline/capture JSON")
+    p_bc.add_argument("--threshold", type=float, default=0.05,
+                      help="relative change flagged as a regression "
+                           "(default 0.05)")
+    p_bc.add_argument("--key-threshold", action="append", default=[],
+                      metavar="KEY=FRACTION",
+                      help="per-key threshold override (repeatable)")
+    p_bc.add_argument("--json", action="store_true",
+                      help="machine-readable diff")
+    p_bc.set_defaults(func=cmd_bench_compare)
 
     # -- app management (ref: Console.scala:467-559) ------------------------
     p_app = sub.add_parser("app", help="manage apps")
@@ -559,6 +598,9 @@ def _deploy_gateway(args, config) -> int:
         breaker_cooldown_sec=args.breaker_cooldown,
         cache_max_entries=args.cache_size if cache_on else 0,
         cache_ttl_sec=args.cache_ttl if cache_on else 0.0,
+        # the event server joins the fleet-federation scrape
+        # (GET /metrics/fleet); a dead/absent one is simply omitted
+        event_server=(args.event_server_ip, args.event_server_port),
     )
     try:
         dep = create_gateway_deployment(config, args.replicas, gw_config)
@@ -584,6 +626,99 @@ def _deploy_gateway(args, config) -> int:
         dep.stop()
     print("[INFO] Gateway and replicas shut down.")
     return 0
+
+
+def _fetch_json(url: str, timeout: float = 10.0):
+    """Fail-soft JSON GET (the doctor reads several optional surfaces;
+    each one missing is a finding, not a crash) — the shared helper
+    lives beside the rest of the scrape plumbing."""
+    from predictionio_tpu.obs.fleet import fetch_json
+
+    return fetch_json(url, timeout)
+
+
+def _fleet_members(base_url: str, status: dict | None) -> list[dict]:
+    """Per-member scrapes for the doctor/status --fleet view: every
+    replica the gateway reports, or the target itself when it's a bare
+    query server."""
+    from predictionio_tpu.obs import fleet
+
+    targets = []
+    for rep in (status or {}).get("replicas", []):
+        rid = rep.get("replica", "")
+        host, _, port = rid.rpartition(":")
+        try:
+            targets.append(fleet.FleetTarget(
+                instance=rid, host=host, port=int(port), role="replica",
+                status_only=True))
+        except ValueError:
+            continue
+    if not targets:
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(base_url)
+        targets.append(fleet.FleetTarget(
+            instance=parts.netloc, host=parts.hostname or "127.0.0.1",
+            port=parts.port or 80, role="replica", status_only=True))
+    return fleet.collect(targets)
+
+
+def cmd_doctor(args) -> int:
+    """``pio doctor``: pull the fleet's health surfaces (gateway status,
+    per-replica statuses, /debug/slo, /debug/traces) and print a ranked
+    triage report. Exit 0 = healthy, 1 = critical findings, 2 = the
+    front door is unreachable."""
+    import json as _json
+
+    from predictionio_tpu.obs import fleet
+
+    base = args.url.rstrip("/")
+    status = _fetch_json(f"{base}/")
+    if status is None:
+        print(f"[ERROR] cannot reach {base} — is the deployment up?",
+              file=sys.stderr)
+        return 2
+    is_gateway = status.get("role") == "gateway"
+    members = _fleet_members(base, status if is_gateway else None)
+    slo_state = _fetch_json(f"{base}/debug/slo")
+    traces_body = _fetch_json(
+        f"{base}/debug/traces?limit={max(args.traces, 0)}")
+    traces = (traces_body or {}).get("slowest") or []
+    findings = fleet.diagnose(
+        status if is_gateway else None, members, slo_state,
+        traces[: args.traces])
+    if args.json:
+        print(_json.dumps({"url": base, "findings": findings}, indent=2))
+        return 1 if any(f["severity"] == "critical" for f in findings) \
+            else 0
+    n_replicas = len(status.get("replicas", [])) if is_gateway else 1
+    print(f"[INFO] pio doctor @ {base} — "
+          f"{'gateway over ' + str(n_replicas) + ' replica(s)' if is_gateway else 'single query server'}")
+    if slo_state is None:
+        print("[WARN] /debug/slo unavailable (history disabled? "
+              "PIO_HISTORY_INTERVAL_S=0) — no burn-rate judgment.")
+    if not findings:
+        print("[INFO] fleet healthy: no findings.")
+        return 0
+    marks = {"critical": "[CRIT]", "warn": "[WARN]", "info": "[INFO]"}
+    for f in findings:
+        print(f"{marks.get(f['severity'], '[INFO]')} {f['subject']}: "
+              f"{f['detail']}")
+    return 1 if any(f["severity"] == "critical" for f in findings) else 0
+
+
+def cmd_bench_compare(args) -> int:
+    """``pio bench-compare a.json b.json``: headline regression diff
+    (tools/bench_compare.py); exits 1 on any flagged regression."""
+    from predictionio_tpu.tools import bench_compare
+
+    try:
+        kt = bench_compare.parse_key_thresholds(args.key_threshold)
+    except ValueError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 2
+    return bench_compare.run(args.baseline, args.candidate,
+                             args.threshold, kt, as_json=args.json)
 
 
 def cmd_trace(args) -> int:
@@ -1079,12 +1214,61 @@ def cmd_upgrade(args) -> int:
     return 0
 
 
+def _cmd_status_fleet(args) -> int:
+    """``pio status --fleet``: one pane over a live deployment — per-
+    replica health from the gateway, plus the SLO judgment. The raw
+    merged scrape lives at ``<url>/metrics/fleet``."""
+    base = args.url.rstrip("/")
+    status = _fetch_json(f"{base}/")
+    if status is None:
+        print(f"[ERROR] cannot reach {base} — is the deployment up?",
+              file=sys.stderr)
+        return 2
+    if status.get("role") == "gateway":
+        print(f"[INFO] gateway @ {base} — engine instance "
+              f"{status.get('engineInstanceId')}")
+        print(f"[INFO] requests={status.get('requestCount')} "
+              f"errors={status.get('errorCount')} "
+              f"hedges={status.get('hedgesFired')}/"
+              f"{status.get('hedgesWon')} retries={status.get('retries')}")
+        for rep in status.get("replicas", []):
+            print(f"[INFO]   replica {rep.get('replica')}: "
+                  f"{rep.get('state')}, breaker {rep.get('breaker')}, "
+                  f"{rep.get('outstanding')} outstanding")
+        cache = status.get("cache") or {}
+        if cache:
+            print(f"[INFO] cache: {cache}")
+    else:
+        print(f"[INFO] single query server @ {base} — instance "
+              f"{status.get('engineInstanceId')}, "
+              f"p99 {status.get('p99ServingSec')}s, model age "
+              f"{status.get('modelAgeSeconds')}s")
+    slo_state = _fetch_json(f"{base}/debug/slo")
+    if slo_state is None:
+        print("[WARN] /debug/slo unavailable (history disabled?).")
+    else:
+        for slo in slo_state.get("slos", []):
+            burns = slo.get("burnRates") or {}
+            flag = "BREACHED" if slo.get("breached") else "ok"
+            print(f"[INFO] SLO {slo['name']}: {flag} "
+                  f"(burn fast={burns.get('fast')} "
+                  f"slow={burns.get('slow')}, "
+                  f"threshold {slo.get('burnThreshold')})")
+    print(f"[INFO] merged fleet scrape: {base}/metrics/fleet ; "
+          f"triage: pio doctor --url {base}")
+    breached = (slo_state or {}).get("breached") or []
+    return 1 if breached else 0
+
+
 def cmd_status(args) -> int:
     """ref: Console.status:1033-1120 — storage smoke test, plus the
     compute substrate report (the reference prints its Spark version
     check here; the TPU analog is the JAX backend + device inventory
     and, off the CPU backend, the measured accelerator link RTT that
-    drives serving placement)."""
+    drives serving placement). ``--fleet`` asks a live deployment
+    instead."""
+    if getattr(args, "fleet", False):
+        return _cmd_status_fleet(args)
     from predictionio_tpu.data.storage import Storage
 
     print("[INFO] Inspecting predictionio_tpu installation...")

@@ -10,6 +10,7 @@ recent first with links to each instance's HTML results page, default port
 from __future__ import annotations
 
 import html
+import os
 
 from predictionio_tpu.data.storage import Storage
 from predictionio_tpu.data.storage.base import EvaluationInstance
@@ -33,12 +34,15 @@ _PAGE = """<!DOCTYPE html>
 </style></head>
 <body>
 <h1>Evaluation Dashboard</h1>
+{slo}
 <p>{count} completed evaluation(s), most recent first.</p>
 <table>
 <tr><th>ID</th><th>Start</th><th>End</th><th>Evaluation</th>
 <th>Params generator</th><th>Batch</th><th>Result</th><th></th></tr>
 {rows}
 </table>
+{fleet}
+{history}
 {metrics}
 {device}
 {traces}
@@ -107,6 +111,187 @@ def _device_panel() -> str:
             "<code>pio profile</code>).</p>" + hbm + progs)
 
 
+def _gateway_url() -> str:
+    """Where the serving fleet's front door lives (``PIO_GATEWAY_URL``,
+    default the standard deploy port). The dashboard is usually its own
+    process, so fleet/SLO/history panels fetch from the gateway and fall
+    back to this process's local state when it is unreachable."""
+    return os.environ.get("PIO_GATEWAY_URL",
+                          "http://127.0.0.1:8000").rstrip("/")
+
+
+def _fetch_json(url: str, timeout: float = 1.5):
+    from predictionio_tpu.obs.fleet import fetch_json
+
+    return fetch_json(url, timeout)
+
+
+def _slo_banner(gw_status) -> str:
+    """Top-of-page judgment: green when every SLO holds, a red banner
+    naming the breached SLOs and their burn rates otherwise. State comes
+    from the gateway's /debug/slo, falling back to this process's own
+    engine (combined deployments / tests). ``gw_status`` is the shared
+    GET / fetch from index(): when the gateway already failed to answer
+    that, skip the remote fetch here — an unroutable host must not cost
+    every panel its own timeout."""
+    state = (_fetch_json(f"{_gateway_url()}/debug/slo")
+             if gw_status is not None else None)
+    if state is None:
+        from predictionio_tpu.obs import history, slo
+
+        sampler = history.get_sampler()
+        eng = slo.engine()
+        if sampler is None or eng is None:
+            return ("<p style='color:#888'>SLOs: no judgment available "
+                    "(gateway unreachable and local history off).</p>")
+        state = eng.state()
+        if state["evaluatedAt"] is None:
+            eng.evaluate(sampler)
+            state = eng.state()
+    breached = [s for s in state.get("slos", []) if s.get("breached")]
+    if breached:
+        items = "; ".join(
+            f"<b>{html.escape(s['name'])}</b> burn "
+            f"{(s.get('burnRates') or {}).get('fast')}x fast / "
+            f"{(s.get('burnRates') or {}).get('slow')}x slow"
+            for s in breached)
+        return (f"<p style='background:#c33;color:#fff;padding:8px'>"
+                f"SLO BREACH: {items} &middot; run <code>pio doctor"
+                f"</code></p>")
+    names = ", ".join(html.escape(s["name"])
+                      for s in state.get("slos", []))
+    return (f"<p style='background:#364;color:#fff;padding:8px'>"
+            f"SLOs healthy ({names or 'none evaluated yet'}).</p>")
+
+
+def _fleet_panel(status) -> str:
+    """Per-replica health as the gateway sees it: state, breaker,
+    outstanding, plus each replica's own p99 / model age / device-route
+    state fetched directly (short per-replica timeout bounds a render
+    over a sick fleet). ``status`` is the gateway's GET / document,
+    fetched ONCE per page render by index(). Empty-state text when no
+    gateway answers (single-server and dashboard-only deployments)."""
+    gw = _gateway_url()
+    if not isinstance(status, dict) or status.get("role") != "gateway":
+        return ("<h2>Fleet</h2><p>No gateway at "
+                f"<code>{html.escape(gw)}</code> (set PIO_GATEWAY_URL; "
+                "single-server deploys have no fleet view).</p>")
+    from predictionio_tpu.obs import fleet
+
+    reps = status.get("replicas", [])
+    targets = []
+    for rep in reps:
+        rid = rep.get("replica", "")
+        rhost, _, rport = rid.rpartition(":")
+        try:
+            targets.append(fleet.FleetTarget(
+                instance=rid, host=rhost, port=int(rport),
+                status_only=True))
+        except ValueError:
+            targets.append(fleet.FleetTarget(instance=rid or "?",
+                                             status_only=True))
+    # one concurrent bounded sweep, not len(replicas) serial timeouts
+    statuses = {m["instance"]: m.get("status") or {}
+                for m in fleet.collect(targets, timeout=0.75)}
+    rows = []
+    for rep in reps:
+        rid = rep.get("replica", "?")
+        rstat = statuses.get(rid) or {}
+        batching = rstat.get("batching") or {}
+        p99 = rstat.get("p99ServingSec")
+        rows.append(
+            f"<tr><td>{html.escape(str(rid))}</td>"
+            f"<td>{html.escape(str(rep.get('state')))}</td>"
+            f"<td>{html.escape(str(rep.get('breaker')))}</td>"
+            f"<td>{rep.get('outstanding')}</td>"
+            f"<td>{'n/a' if p99 is None else f'{p99 * 1e3:.2f} ms'}</td>"
+            f"<td>{rstat.get('requestCount', 'n/a')}</td>"
+            f"<td>{rstat.get('errorCount', 'n/a')}</td>"
+            f"<td>{html.escape(str(batching.get('deviceRouteBreaker', 'n/a')))}</td>"
+            f"<td>{rstat.get('modelAgeSeconds', 'n/a')}</td></tr>")
+    cache = status.get("cache") or {}
+    return (
+        "<h2>Fleet</h2>"
+        f"<p>Gateway <code>{html.escape(gw)}</code> — engine instance "
+        f"{html.escape(str(status.get('engineInstanceId')))}, "
+        f"{status.get('requestCount')} request(s), "
+        f"{status.get('hedgesFired')} hedge(s), cache "
+        f"{html.escape(str(cache))} &middot; merged scrape at "
+        f"<a href='{html.escape(gw)}/metrics/fleet'>/metrics/fleet</a>"
+        "</p><table><tr><th>replica</th><th>state</th><th>breaker</th>"
+        "<th>outstanding</th><th>p99</th><th>requests</th><th>errors</th>"
+        "<th>device route</th><th>model age (s)</th></tr>"
+        + "".join(rows) + "</table>")
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list) -> str:
+    """Unicode sparkline over the series' own min..max (gaps for None).
+    Character cells instead of an image/JS chart: zero dependencies and
+    it renders in any terminal dump of the page too."""
+    nums = [v for v in values if v is not None]
+    if not nums:
+        return ""
+    lo, hi = min(nums), max(nums)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_CHARS) - 1))
+            out.append(_SPARK_CHARS[idx])
+    return "".join(out)
+
+
+def _history_panel(gw_status, points: int = 60) -> str:
+    """Sparklines over the local history rings, falling back to the
+    gateway's rings when the local ones carry no data — a dashboard-only
+    process samples all-None points (it serves no queries), and
+    all-None is "no data", not "has series". The fallback fetch is
+    skipped when index()'s shared gateway status fetch already failed."""
+    from predictionio_tpu.obs import history
+
+    def has_data(doc) -> bool:
+        return bool(doc) and any(
+            s.get("latest") is not None
+            for s in (doc.get("series") or {}).values())
+
+    sampler = history.get_sampler()
+    doc = sampler.to_json() if sampler is not None else None
+    source = "this process"
+    if not has_data(doc) and gw_status is not None:
+        remote = _fetch_json(f"{_gateway_url()}/debug/history")
+        if has_data(remote):
+            doc = remote
+            source = f"gateway {_gateway_url()}"
+    if not has_data(doc):
+        return ("<h2>History</h2><p>No time-series history with data "
+                "yet (PIO_HISTORY_INTERVAL_S=0 disables sampling).</p>")
+    rows = []
+    for name, series in sorted(doc["series"].items()):
+        pts = [v for _, v in series.get("points", [])][-points:]
+        spark = _sparkline(pts)
+        if not spark.strip():
+            continue
+        latest = series.get("latest")
+        rows.append(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td style='font-family:monospace'>{html.escape(spark)}</td>"
+            f"<td>{'n/a' if latest is None else f'{latest:.4g}'}</td></tr>")
+    if not rows:
+        return ("<h2>History</h2><p>History is on but no series has "
+                "data yet.</p>")
+    return (
+        "<h2>History</h2>"
+        f"<p>Local time-series rings ({html.escape(source)}; "
+        f"every {doc.get('intervalS')}s, <code>GET /debug/history</code>)."
+        "</p><table><tr><th>series</th><th>trend</th><th>latest</th></tr>"
+        + "".join(rows) + "</table>")
+
+
 def _traces_panel(limit: int = 5) -> str:
     """The "slow traces" panel: span waterfalls for this process's
     slowest retained traces (obs/trace.py reservoir), each span a
@@ -170,6 +355,9 @@ def build_router() -> Router:
     r = Router()
 
     def index(request: Request):
+        # one gateway status fetch per render, shared by the panels (a
+        # down gateway must cost one timeout, not one per panel)
+        gw_status = _fetch_json(f"{_gateway_url()}/")
         instances = _instances()
         rows = "\n".join(
             _ROW.format(
@@ -185,6 +373,8 @@ def build_router() -> Router:
         )
         return 200, RawResponse(_PAGE.format(
             count=len(instances), rows=rows, metrics=_metrics_footer(),
+            slo=_slo_banner(gw_status), fleet=_fleet_panel(gw_status),
+            history=_history_panel(gw_status),
             device=_device_panel(), traces=_traces_panel()))
 
     def _get(request: Request, running: bool = False) -> EvaluationInstance:

@@ -39,7 +39,8 @@ logger = logging.getLogger(__name__)
 #: ``/debug/profile`` qualifies twice over — its handler deliberately
 #: sleeps for the capture window.
 UNTRACED_PATHS = frozenset(
-    {"/metrics", "/debug/traces", "/debug/profile", "/debug/faults"})
+    {"/metrics", "/metrics/fleet", "/debug/traces", "/debug/profile",
+     "/debug/faults", "/debug/history", "/debug/slo"})
 
 # Per-server HTTP telemetry, shared by every AppServer in the process
 # (the ``server`` label separates event/query/admin/dashboard traffic).
@@ -713,11 +714,54 @@ def add_metrics_route(router: Router,
         return 200, {"spec": faults.active_spec_text(),
                      "injected": faults.injected_counts()}
 
+    def debug_history(request: Request):
+        from predictionio_tpu.obs import history
+
+        sampler = history.get_sampler() or history.ensure_started()
+        if sampler is None:
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/traces contract under PIO_TRACE=off
+            raise HTTPError(404, "history disabled (PIO_HISTORY_INTERVAL_S=0)")
+        try:
+            seconds = request.query.get("seconds")
+            seconds_f = float(seconds) if seconds is not None else None
+            names = request.query.get("series")
+        except ValueError as e:
+            raise HTTPError(400, f"bad filter: {e}") from e
+        return 200, sampler.to_json(
+            seconds=seconds_f,
+            names=names.split(",") if names else None)
+
+    def debug_slo(request: Request):
+        from predictionio_tpu.obs import history, slo
+
+        sampler = history.get_sampler() or history.ensure_started()
+        if sampler is None:
+            # the SLO windows evaluate over the history rings: no
+            # history, no judgment — same 404-as-absent contract
+            raise HTTPError(404, "SLO engine disabled "
+                                 "(PIO_HISTORY_INTERVAL_S=0)")
+        eng = slo.engine() or slo.attach(sampler)
+        state = eng.state()
+        if state["evaluatedAt"] is None:
+            # first scrape before the first sampler tick: evaluate now
+            # so the surface is never an empty shell
+            eng.evaluate(sampler)
+            state = eng.state()
+        return 200, state
+
     router.add("GET", "/metrics", metrics)
     router.add("GET", "/debug/traces", debug_traces)
     router.add("POST", "/debug/profile", debug_profile)
     router.add("GET", "/debug/faults", debug_faults)
     router.add("POST", "/debug/faults", debug_faults)
+    router.add("GET", "/debug/history", debug_history)
+    router.add("GET", "/debug/slo", debug_slo)
+    # kick the process history sampler (no-op when disabled): every
+    # server that mounts the scrape surface also records local history
+    from predictionio_tpu.obs import history as _history
+
+    _history.ensure_started()
     return router
 
 

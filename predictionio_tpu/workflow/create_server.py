@@ -45,7 +45,7 @@ from predictionio_tpu.utils.http import (
     Router,
     add_metrics_route,
 )
-from predictionio_tpu.utils.time import format_datetime, now
+from predictionio_tpu.utils.time import ensure_aware, format_datetime, now
 from predictionio_tpu.workflow.batching import (
     QUERY_STAGE_SECONDS as _STAGE_SECONDS,
     DeferredBatch,
@@ -75,6 +75,18 @@ _QUERY_ERRORS = REGISTRY.counter(
     "pio_query_errors_total",
     "Failed /queries.json requests by kind (bad_request, predict, plugin)",
     labels=("kind",),
+)
+# Model staleness: seconds since the serving engine instance's training
+# started — the age of what this replica is answering with. Refreshed by
+# a collect hook at every scrape (an age pushed at load time would
+# freeze); a /reload hot-swap resets it because the hook reads the
+# CURRENT instance. Feeds the model_staleness SLO (obs/slo.py) and the
+# events-to-servable headline (ROADMAP item 2).
+_MODEL_AGE = REGISTRY.gauge(
+    "pio_serving_model_age_seconds",
+    "Age of the deployed engine instance (now - training start), per "
+    "serving replica; resets on /reload hot-swap",
+    labels=("server",),
 )
 
 #: Set on the batch-shape warmup thread: its replays pay deliberate XLA
@@ -207,6 +219,7 @@ class QueryService:
         if config.upgrade_check and upgrade_probe_url():
             self._start_upgrade_checker()  # offline deploys pay nothing
         self._load()
+        self._register_model_age_hook()
         self.batcher = None
         if config.batching and any(
             self._overrides_batch_predict(a) for a in self.algorithms
@@ -309,6 +322,30 @@ class QueryService:
             instance.id, format_datetime(instance.start_time),
         )
 
+    def _register_model_age_hook(self) -> None:
+        """Keep ``pio_serving_model_age_seconds{server=...}`` current at
+        every scrape. The hook holds only a weakref: collect hooks are
+        never unregistered, and a strong ref would pin every QueryService
+        a long-lived test process ever created (and keep publishing its
+        stale age)."""
+        import weakref
+
+        ref = weakref.ref(self)
+        server_name = self.config.server_name
+
+        def refresh() -> None:
+            svc = ref()
+            if svc is None:
+                return
+            with svc.lock:
+                instance = getattr(svc, "instance", None)
+            if instance is None or instance.start_time is None:
+                return
+            age = (now() - ensure_aware(instance.start_time)).total_seconds()
+            _MODEL_AGE.set(max(age, 0.0), server=server_name)
+
+        REGISTRY.add_collect_hook(refresh)
+
     def _start_serving_promotion(self) -> None:
         """Deploy-time HBM promotion (ROADMAP item 3): pin the fresh
         engine's factor catalogs device-resident on a background thread
@@ -390,6 +427,13 @@ class QueryService:
                 "errorCount": self.error_count,
                 "avgServingSec": round(self.avg_serving_sec, 6),
                 "lastServingSec": round(self.last_serving_sec, 6),
+                # model staleness, for `pio doctor` and the fleet panel
+                # (the gauge pio_serving_model_age_seconds is the same
+                # number on /metrics)
+                "modelAgeSeconds": round(max(
+                    (now() - ensure_aware(self.instance.start_time))
+                    .total_seconds(), 0.0), 1)
+                if self.instance.start_time is not None else None,
             }
         # top-line latency quantiles over THIS service's lifetime, from
         # the log-bucketed histogram (no per-sample storage behind them).

@@ -1,0 +1,143 @@
+"""Bench headline regression diff (tools/bench_compare.py + the
+`pio bench-compare` CLI face) against checked-in fixtures.
+
+The candidate fixture regresses serve_p99_ms (+44%) and serve_qps
+(−18%) while improving serve_p50_ms and iterations/sec; it also ships
+as a bench *capture wrapper* with "parsed": null so the last-JSON-line
+fallback path is exercised (the BENCH_r01–r05 shape)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.tools.bench_compare import (
+    compare,
+    flatten_headline,
+    load_headline,
+    main,
+    parse_key_thresholds,
+)
+
+FIXTURES = Path(__file__).parent / "fixtures"
+BASELINE = FIXTURES / "bench_baseline.json"
+CANDIDATE = FIXTURES / "bench_candidate.json"
+
+
+def test_load_headline_bare_and_capture_wrapper():
+    bare = load_headline(BASELINE)
+    assert bare["metric"] == "ml20m_als_rank10_iterations_per_sec"
+    wrapped = load_headline(CANDIDATE)  # parsed: null → last JSON line
+    assert wrapped["value"] == 3.4
+    assert wrapped["extra"]["serve_p99_ms"] == 2.6
+
+
+def test_load_headline_rejects_empty_capture(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"parsed": None, "tail": "no json here"}))
+    with pytest.raises(ValueError, match="no parsed headline"):
+        load_headline(bad)
+
+
+def test_flatten_skips_bookkeeping_and_bools():
+    flat = flatten_headline(load_headline(BASELINE))
+    assert flat["ml20m_als_rank10_iterations_per_sec"] == 3.3
+    assert flat["serve_p99_ms"] == 1.8
+    assert "device" not in flat and "serve_placement" not in flat
+    assert "dense_cache_hit" not in flat  # bool is not a metric
+    assert "n_devices" not in flat
+
+
+def test_compare_flags_regressions_in_the_bad_direction():
+    a = flatten_headline(load_headline(BASELINE))
+    b = flatten_headline(load_headline(CANDIDATE))
+    result = compare(a, b, threshold=0.05)
+    regressed = {e["key"] for e in result["regressions"]}
+    improved = {e["key"] for e in result["improvements"]}
+    assert regressed == {"serve_p99_ms", "serve_qps"}
+    assert "serve_p50_ms" in improved  # lower latency = improvement
+    assert "sasrec_examples_per_sec" in result["added"]
+    assert "two_tower_examples_per_sec" in result["removed"]
+    # a removed key must never be a regression
+    assert "two_tower_examples_per_sec" not in regressed
+
+
+def test_zero_baseline_to_nonzero_cost_is_a_regression():
+    """A zero-cost metric (retraces, overhead) going 0 -> N has no
+    relative change, but it is exactly the regression shape the gate
+    exists for — it must not hide under 'within threshold'."""
+    result = compare({"retraces": 0.0, "serve_qps": 0.0},
+                     {"retraces": 50.0, "serve_qps": 100.0})
+    assert [e["key"] for e in result["regressions"]] == ["retraces"]
+    assert result["regressions"][0]["change"] is None
+    # 0 -> N in the GOOD direction is an improvement, 0 -> 0 unchanged
+    assert [e["key"] for e in result["improvements"]] == ["serve_qps"]
+    result = compare({"retraces": 0.0}, {"retraces": 0.0})
+    assert [e["key"] for e in result["unchanged"]] == ["retraces"]
+
+
+def test_per_key_threshold_overrides():
+    a = flatten_headline(load_headline(BASELINE))
+    b = flatten_headline(load_headline(CANDIDATE))
+    result = compare(a, b, threshold=0.05,
+                     key_thresholds={"serve_p99_ms": 0.5,
+                                     "serve_qps": 0.5})
+    assert result["regressions"] == []
+    assert parse_key_thresholds(["a=0.1", "b=0.2"]) == \
+        {"a": 0.1, "b": 0.2}
+    with pytest.raises(ValueError):
+        parse_key_thresholds(["nodelimiter"])
+
+
+def test_main_exit_codes(capsys):
+    rc = main([str(BASELINE), str(CANDIDATE)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "serve_p99_ms" in err and "serve_qps" in err
+    # identical inputs: clean exit
+    assert main([str(BASELINE), str(BASELINE)]) == 0
+    # thresholds loose enough: clean exit despite the moves
+    assert main([str(BASELINE), str(CANDIDATE),
+                 "--threshold", "0.5"]) == 0
+    assert main(["/nonexistent.json", str(CANDIDATE)]) == 2
+
+
+def test_main_json_mode(capsys):
+    rc = main([str(BASELINE), str(CANDIDATE), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out)
+    assert {e["key"] for e in doc["regressions"]} == \
+        {"serve_p99_ms", "serve_qps"}
+
+
+def test_cli_face():
+    from predictionio_tpu.tools.cli import build_parser, cmd_bench_compare
+
+    args = build_parser().parse_args(
+        ["bench-compare", str(BASELINE), str(CANDIDATE),
+         "--key-threshold", "serve_p99_ms=0.9",
+         "--key-threshold", "serve_qps=0.9"])
+    assert cmd_bench_compare(args) == 0
+
+
+def test_checked_in_bench_captures_load():
+    """The real BENCH_r0N.json captures at the repo root stay loadable —
+    the tool's reason to exist is diffing exactly these files. Captures
+    whose tail was truncated mid-headline (a pre-PR-3 artifact of the
+    old stdout contract) raise a clear ValueError instead of a wrong
+    diff; at least one capture must load."""
+    root = Path(__file__).parent.parent
+    captures = sorted(root.glob("BENCH_r0*.json"))
+    if not captures:
+        pytest.skip("no bench captures in this checkout")
+    loaded = 0
+    for path in captures:
+        try:
+            flat = flatten_headline(load_headline(path))
+        except ValueError as e:
+            assert "no parsed headline" in str(e)
+            continue
+        assert flat, f"{path.name} flattened to nothing"
+        loaded += 1
+    assert loaded >= 1

@@ -1,0 +1,52 @@
+"""CLI/doc drift checker (tools/check_cli_docs.py): the tier-1 wiring
+that keeps docs/operations.md covering every `pio` subcommand, plus
+unit coverage of the parsing pieces on a synthetic doc."""
+
+from pathlib import Path
+
+from predictionio_tpu.tools.check_cli_docs import (
+    check,
+    cli_subcommands,
+    documented_commands,
+)
+
+
+def test_repo_cli_and_docs_are_in_sync():
+    """THE guard: every registered `pio` subcommand (doctor and
+    bench-compare included) is mentioned in docs/operations.md."""
+    assert check() == []
+
+
+def test_cli_subcommands_come_from_the_real_parser():
+    commands = cli_subcommands()
+    for expected in ("deploy", "doctor", "bench-compare", "chaos",
+                     "train", "status"):
+        assert expected in commands
+
+
+def test_documented_commands_parses_backticks_prose_and_aliases(tmp_path):
+    doc = tmp_path / "ops.md"
+    doc.write_text(
+        "Run `pio deploy` then pio undeploy; the alias pio-start-all "
+        "works too.\n| `pio bench-compare` | diff |\n")
+    names = documented_commands(doc)
+    assert {"deploy", "undeploy", "start-all", "bench-compare"} <= names
+
+
+def test_missing_and_stale_subcommands_flagged(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text(
+        "Use `pio deploy` and the retired `pio spark-submit` verb.\n")
+    problems = check(tmp_path, subcommands=["deploy", "doctor"])
+    assert any("pio doctor" in p and "never mentioned" in p
+               for p in problems)
+    assert any("pio spark-submit" in p and "not a registered" in p
+               for p in problems)
+    assert not any("pio deploy" in p for p in problems)
+
+
+def test_clean_synthetic_tree(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "operations.md").write_text(
+        "`pio deploy` and `pio doctor` are documented.\n")
+    assert check(tmp_path, subcommands=["deploy", "doctor"]) == []

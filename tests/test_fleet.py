@@ -1,0 +1,735 @@
+"""Fleet observability tests: federation merge (obs/fleet.py), local
+history rings (obs/history.py), SLO burn-rate windows (obs/slo.py), the
+metric-cardinality guard, staleness gauges, and the `pio doctor` /
+`GET /metrics/fleet` smoke against a real 2-replica deployment."""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import fleet, history, slo
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+
+def call(port, method, path, body=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"},
+        method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- exposition parsing -------------------------------------------------------
+
+
+def test_parse_exposition_families_kinds_and_labels():
+    text = (
+        "# HELP pio_a_total help text\n"
+        "# TYPE pio_a_total counter\n"
+        'pio_a_total{server="x"} 3\n'
+        'pio_a_total{server="y"} 2.5\n'
+        "# TYPE pio_b_seconds histogram\n"
+        'pio_b_seconds_bucket{le="0.1"} 1\n'
+        'pio_b_seconds_bucket{le="+Inf"} 2\n'
+        "pio_b_seconds_sum 0.55\n"
+        "pio_b_seconds_count 2\n"
+        "# TYPE pio_c_depth gauge\n"
+        "pio_c_depth 7\n"
+    )
+    fams = fleet.parse_exposition(text)
+    assert set(fams) == {"pio_a_total", "pio_b_seconds", "pio_c_depth"}
+    assert fams["pio_a_total"].kind == "counter"
+    assert fams["pio_a_total"].help == "help text"
+    assert fams["pio_a_total"].samples == [
+        ("pio_a_total", {"server": "x"}, 3.0),
+        ("pio_a_total", {"server": "y"}, 2.5)]
+    assert fams["pio_b_seconds"].kind == "histogram"
+    names = [s[0] for s in fams["pio_b_seconds"].samples]
+    assert names == ["pio_b_seconds_bucket", "pio_b_seconds_bucket",
+                     "pio_b_seconds_sum", "pio_b_seconds_count"]
+    assert fams["pio_c_depth"].samples == [("pio_c_depth", {}, 7.0)]
+
+
+def test_parse_exposition_escaped_labels_and_garbage_lines():
+    text = ('# TYPE pio_x_total counter\n'
+            'pio_x_total{name="a\\"b\\\\c\\nd"} 1\n'
+            "this line is garbage\n"
+            "pio_x_total 2\n")
+    fams = fleet.parse_exposition(text)
+    samples = fams["pio_x_total"].samples
+    assert samples[0][1]["name"] == 'a"b\\c\nd'
+    assert samples[1] == ("pio_x_total", {}, 2.0)
+
+
+def _registry_with(counter_children=None, gauge_children=None,
+                   hist_obs=None, buckets=(0.1, 1.0)):
+    r = MetricsRegistry()
+    if counter_children:
+        c = r.counter("pio_f_total", "h", labels=("server",))
+        for label, v in counter_children.items():
+            c.inc(v, server=label)
+    if gauge_children:
+        g = r.gauge("pio_f_depth", "h", labels=("instance",))
+        for label, v in gauge_children.items():
+            g.set(v, instance=label)
+    if hist_obs is not None:
+        h = r.histogram("pio_f_seconds", "h", buckets=list(buckets))
+        for v in hist_obs:
+            h.observe(v)
+    return r
+
+
+# -- merge rules --------------------------------------------------------------
+
+
+def test_merge_adds_instance_label_and_sums_counters():
+    a = _registry_with(counter_children={"s1": 3, "s2": 2}).expose()
+    b = _registry_with(counter_children={"s1": 5}).expose()
+    merged = fleet.merge_expositions([("r0", a), ("r1", b)])
+    assert 'pio_f_total{instance="r0",server="s1"} 3' in merged
+    assert 'pio_f_total{instance="r1",server="s1"} 5' in merged
+    # fleet-summed per remaining label set
+    assert 'pio_f_total{instance="fleet",server="s1"} 8' in merged
+    assert 'pio_f_total{instance="fleet",server="s2"} 2' in merged
+    assert merged.count("# TYPE pio_f_total counter") == 1
+
+
+def test_merge_relabels_existing_instance_label():
+    a = _registry_with(gauge_children={"orig": 7}).expose()
+    merged = fleet.merge_expositions([("r0", a)])
+    assert ('pio_f_depth{exported_instance="orig",instance="r0"} 7'
+            in merged)
+
+
+def test_merge_gauges_stay_per_instance_only():
+    a = _registry_with(gauge_children={"x": 1}).expose()
+    b = _registry_with(gauge_children={"x": 1}).expose()
+    merged = fleet.merge_expositions([("r0", a), ("r1", b)])
+    # no fleet aggregate for gauges: summing breaker flags would
+    # manufacture a number no process reports
+    assert 'instance="fleet"' not in merged
+
+
+def test_merge_histograms_bucket_aligned():
+    a = _registry_with(hist_obs=[0.05, 0.5]).expose()
+    b = _registry_with(hist_obs=[0.05]).expose()
+    merged = fleet.merge_expositions([("r0", a), ("r1", b)])
+    assert 'pio_f_seconds_bucket{instance="fleet",le="0.1"} 2' in merged
+    assert 'pio_f_seconds_bucket{instance="fleet",le="1"} 3' in merged
+    assert 'pio_f_seconds_bucket{instance="fleet",le="+Inf"} 3' in merged
+    assert 'pio_f_seconds_count{instance="fleet"} 3' in merged
+    # per-instance series kept too, in ascending-bucket source order
+    r0_lines = [ln for ln in merged.splitlines() if 'instance="r0"' in ln]
+    les = [re.search(r'le="([^"]+)"', ln).group(1)
+           for ln in r0_lines if "_bucket" in ln]
+    assert les == ["0.1", "1", "+Inf"]
+
+
+def test_merge_histograms_misaligned_le_skips_fleet_series():
+    a = _registry_with(hist_obs=[0.05], buckets=(0.1, 1.0)).expose()
+    b = _registry_with(hist_obs=[0.05], buckets=(0.2, 2.0)).expose()
+    merged = fleet.merge_expositions([("r0", a), ("r1", b)])
+    # both instances present, but no fleet merge for mismatched ladders
+    assert 'pio_f_seconds_bucket{instance="r0",le="0.1"} 1' in merged
+    assert 'pio_f_seconds_bucket{instance="r1",le="0.2"} 1' in merged
+    assert not [ln for ln in merged.splitlines()
+                if "pio_f_seconds" in ln and 'instance="fleet"' in ln]
+
+
+def test_collect_omits_dead_member():
+    from predictionio_tpu.utils.http import free_port
+
+    live = _registry_with(counter_children={"s1": 1})
+    targets = [
+        fleet.FleetTarget(instance="local", registry=live),
+        fleet.FleetTarget(instance="ghost", host="127.0.0.1",
+                          port=free_port(), role="replica"),
+    ]
+    results = fleet.collect(targets, timeout=0.5)
+    assert [r["ok"] for r in results] == [True, False]
+    assert results[1]["error"]
+    merged = fleet.federated_exposition(results)
+    assert 'instance="local"' in merged
+    assert "ghost" not in merged
+
+
+# -- metric-cardinality guard -------------------------------------------------
+
+
+def test_cardinality_guard_bounds_new_children(monkeypatch):
+    monkeypatch.setenv("PIO_METRICS_MAX_SERIES", "3")
+    r = MetricsRegistry()
+    c = r.counter("pio_cg_total", "h", labels=("k",))
+    dropped = REGISTRY.counter(
+        "pio_metrics_dropped_series_total", "", labels=("family",))
+    before = dropped.value(family="pio_cg_total")
+    for i in range(10):
+        c.inc(k=f"v{i}")
+    assert len(c.items()) == 3
+    # existing children keep updating at the bound
+    c.inc(5, k="v0")
+    assert c.value(k="v0") == 6
+    assert dropped.value(family="pio_cg_total") == before + 7
+    # gauges and histograms share the guard
+    g = r.gauge("pio_cg_depth", "h", labels=("k",))
+    h = r.histogram("pio_cg_seconds", "h", labels=("k",),
+                    buckets=[1.0])
+    for i in range(5):
+        g.set(1.0, k=f"v{i}")
+        h.observe(0.5, k=f"v{i}")
+    assert len(g.items()) == 3
+    assert len(h.items()) == 3
+
+
+def test_unset_unlabeled_gauge_absent_counter_reads_zero():
+    """A never-SET gauge stays off the exposition (an age gauge reading
+    0 on a cold server would lie "perpetually fresh"); a never-
+    incremented counter truthfully reads 0."""
+    r = MetricsRegistry()
+    r.gauge("pio_cold_age_seconds", "h")
+    r.counter("pio_cold_total", "h")
+    text = r.expose()
+    assert "pio_cold_age_seconds 0" not in text
+    assert "pio_cold_total 0" in text
+
+
+def test_status_only_scrape_skips_metrics():
+    from predictionio_tpu.utils.http import AppServer, Router, free_port
+
+    router = Router()
+    router.add("GET", "/", lambda req: (200, {"status": "alive",
+                                              "p99ServingSec": 0.01}))
+    srv = AppServer(router, "127.0.0.1", 0)
+    srv.start()
+    try:
+        got = fleet.scrape_member(fleet.FleetTarget(
+            instance="s", host="127.0.0.1", port=srv.port,
+            status_only=True), timeout=2.0)
+        assert got["ok"] and got["metricsText"] is None
+        assert got["status"]["p99ServingSec"] == 0.01
+        dead = fleet.scrape_member(fleet.FleetTarget(
+            instance="d", host="127.0.0.1", port=free_port(),
+            status_only=True), timeout=0.5)
+        assert not dead["ok"] and dead["error"]
+    finally:
+        srv.stop()
+
+
+def test_cardinality_guard_disabled_with_zero(monkeypatch):
+    monkeypatch.setenv("PIO_METRICS_MAX_SERIES", "0")
+    r = MetricsRegistry()
+    c = r.counter("pio_cg2_total", "h", labels=("k",))
+    for i in range(1200):
+        c.inc(k=f"v{i}")
+    assert len(c.items()) == 1200
+
+
+# -- history rings ------------------------------------------------------------
+
+
+def test_history_ring_bounds_and_rates():
+    q = REGISTRY.counter("pio_query_requests_total", "h")
+    s = history.HistorySampler(interval_s=10, capacity=5)
+    base = 1000.0
+    for i in range(8):
+        q.inc(50)
+        s.sample_once(t=base + i * 10)
+    pts = s.points("query_qps")
+    assert len(pts) == 5  # ring bound, oldest evicted
+    assert pts[-1][0] == base + 70
+    # steady 50 per 10 s = 5/s (first tick has no previous total)
+    assert all(v == pytest.approx(5.0) for t, v in pts)
+    assert s.window_values("query_qps", seconds=25, now_ts=base + 70) \
+        == pytest.approx([5.0, 5.0, 5.0])
+
+
+def test_history_windowed_quantiles_cover_one_interval():
+    h = REGISTRY.histogram("pio_query_seconds", "h")
+    s = history.HistorySampler(interval_s=10, capacity=10)
+    h.observe(10.0)  # ancient outlier, before the window
+    s.sample_once(t=1000.0)
+    for _ in range(100):
+        h.observe(0.001)
+    s.sample_once(t=1010.0)
+    pts = dict(s.points("query_p99_ms"))
+    # the interval's p99 reflects ONLY the interval's 1 ms observations,
+    # not the lifetime outlier
+    assert pts[1010.0] is not None and pts[1010.0] < 100.0
+
+
+def test_history_spill_jsonl(tmp_path, monkeypatch):
+    spill = tmp_path / "history.jsonl"
+    monkeypatch.setenv("PIO_HISTORY_SPILL", str(spill))
+    s = history.HistorySampler(interval_s=10, capacity=5)
+    s.sample_once(t=1000.0)
+    s.sample_once(t=1010.0)
+    lines = spill.read_text().splitlines()
+    assert len(lines) == 2
+    doc = json.loads(lines[1])
+    assert doc["t"] == 1010.0 and "values" in doc
+
+
+# -- SLO burn-rate math -------------------------------------------------------
+
+
+def test_burn_rate_units():
+    assert slo.ratio_burn(0, 100, 0.999) == 0.0
+    # 1% bad against a 0.1% budget = 10x burn
+    assert slo.ratio_burn(1, 100, 0.999) == pytest.approx(10.0)
+    assert slo.ratio_burn(0, 0, 0.999) is None  # no traffic, no evidence
+    assert slo.threshold_burn([], 100, 0.99) is None
+    # half the samples over the bound against a 1% budget = 50x
+    assert slo.threshold_burn([50, 150, 200, 10], 100, 0.99) \
+        == pytest.approx(50.0)
+
+
+def _synthetic_sampler(points_by_series):
+    s = history.HistorySampler(interval_s=10, capacity=1000)
+    for name, pts in points_by_series.items():
+        from collections import deque
+
+        s._rings[name] = deque(pts, maxlen=1000)
+    return s
+
+
+def test_slo_multiwindow_fast_spike_alone_does_not_breach(monkeypatch):
+    monkeypatch.setenv("PIO_SLO_FAST_WINDOW_S", "15")
+    monkeypatch.setenv("PIO_SLO_SLOW_WINDOW_S", "200")
+    now = 1000.0
+    # long healthy history, errors only in the last two ticks: the fast
+    # window (covering exactly those two samples) burns hot, the slow
+    # window stays under threshold
+    qps = [(now - 10 * i, 100.0) for i in range(19, -1, -1)]
+    errs = [(t, 0.0) for t, _ in qps[:-2]] + \
+           [(qps[-2][0], 2.0), (qps[-1][0], 2.0)]
+    s = _synthetic_sampler({"gateway_qps": qps,
+                            "gateway_failure_rate": errs})
+    eng = slo.SLOEngine(slos=[d for d in slo.default_slos()
+                              if d.name == "query_availability"])
+    state = eng.evaluate(s, now_ts=now)[0]
+    assert state["burnRates"]["fast"] == pytest.approx(20.0)  # 2% / 0.1%
+    assert state["burnRates"]["slow"] == pytest.approx(2.0)
+    assert not state["breached"]
+
+
+def test_slo_multiwindow_sustained_burn_breaches(monkeypatch):
+    monkeypatch.setenv("PIO_SLO_FAST_WINDOW_S", "20")
+    monkeypatch.setenv("PIO_SLO_SLOW_WINDOW_S", "200")
+    now = 1000.0
+    qps = [(now - 10 * i, 100.0) for i in range(19, -1, -1)]
+    errs = [(t, 30.0) for t, _ in qps]  # 30% everywhere
+    s = _synthetic_sampler({"gateway_qps": qps,
+                            "gateway_failure_rate": errs})
+    eng = slo.SLOEngine(slos=[d for d in slo.default_slos()
+                              if d.name == "query_availability"])
+    state = eng.evaluate(s, now_ts=now)[0]
+    assert state["burnRates"]["fast"] == pytest.approx(300.0)
+    assert state["burnRates"]["slow"] == pytest.approx(300.0)
+    assert state["breached"]
+    assert REGISTRY.get("pio_slo_breached").value(
+        slo="query_availability") == 1.0
+    # recovery clears the flag
+    s2 = _synthetic_sampler({"gateway_qps": qps,
+                             "gateway_failure_rate":
+                                 [(t, 0.0) for t, _ in qps]})
+    assert not eng.evaluate(s2, now_ts=now)[0]["breached"]
+    assert REGISTRY.get("pio_slo_breached").value(
+        slo="query_availability") == 0.0
+
+
+def test_slo_availability_falls_back_to_replica_series(monkeypatch):
+    monkeypatch.setenv("PIO_SLO_FAST_WINDOW_S", "100")
+    monkeypatch.setenv("PIO_SLO_SLOW_WINDOW_S", "100")
+    now = 1000.0
+    s = _synthetic_sampler({
+        "query_qps": [(now - 10, 100.0), (now, 100.0)],
+        "query_error_rate": [(now - 10, 50.0), (now, 50.0)],
+    })
+    eng = slo.SLOEngine(slos=[d for d in slo.default_slos()
+                              if d.name == "query_availability"])
+    state = eng.evaluate(s, now_ts=now)[0]
+    assert state["burnRates"]["fast"] == pytest.approx(500.0)
+    assert state["breached"]
+
+
+def test_slo_threshold_latency(monkeypatch):
+    monkeypatch.setenv("PIO_SLO_FAST_WINDOW_S", "100")
+    monkeypatch.setenv("PIO_SLO_SLOW_WINDOW_S", "100")
+    monkeypatch.setenv("PIO_SLO_QUERY_P99_MS", "50")
+    now = 1000.0
+    s = _synthetic_sampler({
+        "query_p99_ms": [(now - 30, 500.0), (now - 20, 500.0),
+                         (now - 10, 500.0), (now, 500.0)],
+    })
+    eng = slo.SLOEngine(slos=[d for d in slo.default_slos()
+                              if d.name == "query_latency_p99"])
+    state = eng.evaluate(s, now_ts=now)[0]
+    # every interval over the bound against a 1% budget = 100x burn
+    assert state["burnRates"]["fast"] == pytest.approx(100.0)
+    assert state["breached"]
+
+
+def test_slo_config_env_override(monkeypatch):
+    monkeypatch.setenv("PIO_SLO_CONFIG", json.dumps([{
+        "name": "custom", "description": "d", "kind": "threshold",
+        "target": 0.9, "series": "query_p99_ms", "bound": 10.0,
+        "burn_threshold": 2.0,
+    }]))
+    eng = slo.SLOEngine()
+    assert [s.name for s in eng.slos] == ["custom"]
+    assert eng.slos[0].burn_threshold == 2.0
+    monkeypatch.setenv("PIO_SLO_CONFIG", "not json at all [")
+    eng2 = slo.SLOEngine()  # broken config falls back to defaults
+    assert [s.name for s in eng2.slos] == [
+        "query_availability", "query_latency_p99", "ingest_success",
+        "model_staleness"]
+
+
+# -- doctor heuristics (pure) -------------------------------------------------
+
+
+def test_diagnose_ranks_and_names_offenders():
+    gateway_status = {
+        "role": "gateway",
+        "replicas": [
+            {"replica": "127.0.0.1:8001", "state": "healthy",
+             "breaker": "closed"},
+            {"replica": "127.0.0.1:8002", "state": "down",
+             "breaker": "open", "consecutiveFailures": 4},
+        ],
+    }
+    members = [
+        {"instance": "127.0.0.1:8001", "role": "replica", "ok": True,
+         "status": {"p99ServingSec": 0.010, "requestCount": 100,
+                    "errorCount": 0}, "metricsText": "", "error": None},
+        {"instance": "127.0.0.1:8002", "role": "replica", "ok": False,
+         "status": None, "metricsText": None, "error": "refused"},
+        {"instance": "127.0.0.1:8003", "role": "replica", "ok": True,
+         "status": {"p99ServingSec": 0.042, "requestCount": 100,
+                    "errorCount": 10,
+                    "batching": {"deviceRouteBreaker": "open"}},
+         "metricsText": "", "error": None},
+        {"instance": "127.0.0.1:8004", "role": "replica", "ok": True,
+         "status": {"p99ServingSec": 0.011, "requestCount": 100,
+                    "errorCount": 0}, "metricsText": "", "error": None},
+    ]
+    slo_state = {"slos": [{
+        "name": "query_availability", "burnRates":
+            {"fast": 310.0, "slow": 290.0},
+        "burnThreshold": 14.4, "breached": True, "description": "d"}]}
+    traces = [{"traceId": "abc123", "durationMs": 412.0, "spans": [{}]}]
+    findings = fleet.diagnose(gateway_status, members, slo_state, traces)
+    severities = [f["severity"] for f in findings]
+    assert severities == sorted(
+        severities, key=lambda s: {"critical": 0, "warn": 1,
+                                   "info": 2}[s])
+    text = json.dumps(findings)
+    assert "SLO query_availability" in text and "BREACHED" in text
+    assert "127.0.0.1:8002" in text and "DOWN" in text
+    assert "breaker OPEN" in text
+    assert "unreachable" in text
+    # 42 ms vs 10/42 median... p99 outlier: median of [10, 42] ms
+    assert any("fleet median" in f["detail"] for f in findings)
+    assert any("device serving route" in f["detail"] for f in findings)
+    assert any("error ratio" in f["detail"] for f in findings)
+    assert any("abc123" in f["subject"] for f in findings)
+
+
+def test_diagnose_folds_in_every_given_trace():
+    """The caller bounds the trace leads (`pio doctor --traces K`);
+    diagnose must not re-cap them."""
+    traces = [{"traceId": f"t{i}", "durationMs": 10.0 * i, "spans": []}
+              for i in range(5)]
+    findings = fleet.diagnose(None, [], None, traces)
+    assert len(findings) == 5
+    assert {f["subject"] for f in findings} == \
+        {f"trace t{i}" for i in range(5)}
+
+
+def test_diagnose_healthy_fleet_is_quiet():
+    status = {"role": "gateway", "replicas": [
+        {"replica": "127.0.0.1:8001", "state": "healthy",
+         "breaker": "closed"}]}
+    members = [{"instance": "127.0.0.1:8001", "role": "replica",
+                "ok": True, "status": {"p99ServingSec": 0.01,
+                                       "requestCount": 5,
+                                       "errorCount": 0},
+                "metricsText": "", "error": None}]
+    slo_state = {"slos": [{"name": "a", "burnRates":
+                           {"fast": 0.1, "slow": 0.1},
+                           "burnThreshold": 14.4, "breached": False}]}
+    assert fleet.diagnose(status, members, slo_state, []) == []
+
+
+# -- bench-compare key direction (the CLI face is test_bench_compare.py) ------
+
+
+def test_bench_compare_direction_heuristic():
+    from predictionio_tpu.tools.bench_compare import lower_is_better
+
+    assert lower_is_better("serve_p99_ms")
+    assert lower_is_better("train_cold_solve_s")
+    assert lower_is_better("host_numpy_ml100k_sec_per_iter")
+    assert not lower_is_better("ingest_events_per_sec")
+    assert not lower_is_better("serve_qps")
+    assert not lower_is_better("mfu_rank64")
+    assert not lower_is_better("two_tower_examples_per_sec")
+    # frac keys split by shape: overhead is a cost, overlap a win
+    assert lower_is_better("trace_overhead_frac")
+    assert not lower_is_better("serve_readback_overlap_frac")
+    assert not lower_is_better("gateway_cache_hit_rate")
+
+
+# -- staleness gauges + /debug surfaces over live servers ---------------------
+
+
+@pytest.fixture()
+def fresh_history(monkeypatch):
+    """A fast private history clock for server tests; restores the
+    process singleton afterwards."""
+    history.reset()
+    slo.reset()
+    monkeypatch.setenv("PIO_HISTORY_INTERVAL_S", "60")
+    yield
+    history.reset()
+    slo.reset()
+
+
+def test_event_server_ingest_age_gauge(memory_storage, fresh_history):
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    apps = memory_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "fleetapp"))
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    memory_storage.get_events().init(app_id)
+    srv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        status, body = call(
+            srv.port, "POST", f"/events.json?accessKey={key}",
+            {"event": "rate", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "properties": {"rating": 5.0}})
+        assert status == 201, body
+        _, metrics = call(srv.port, "GET", "/metrics")
+        m = re.search(r"^pio_ingest_last_event_age_seconds (\S+)$",
+                      metrics.decode(), re.M)
+        assert m is not None
+        assert 0.0 <= float(m.group(1)) < 30.0
+    finally:
+        srv.stop()
+
+
+def test_query_server_model_age_and_debug_surfaces(memory_storage,
+                                                   fresh_history):
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        status, metrics = call(srv.port, "GET", "/metrics")
+        m = re.search(
+            r'^pio_serving_model_age_seconds\{server="query"\} (\S+)$',
+            metrics.decode(), re.M)
+        assert m is not None
+        assert 0.0 <= float(m.group(1)) < 3600.0
+        status, body = call(srv.port, "GET", "/")
+        assert json.loads(body)["modelAgeSeconds"] >= 0.0
+        # history + SLO surfaces answer on every server
+        sampler = history.get_sampler()
+        assert sampler is not None
+        sampler.sample_once()
+        status, body = call(srv.port, "GET", "/debug/history")
+        assert status == 200
+        doc = json.loads(body)
+        assert "model_age_seconds" in doc["series"]
+        status, body = call(srv.port, "GET", "/debug/slo")
+        assert status == 200
+        names = [s["name"] for s in json.loads(body)["slos"]]
+        assert "query_availability" in names
+    finally:
+        srv.stop()
+        service.shutdown()
+
+
+def test_debug_history_404_when_disabled(monkeypatch):
+    from predictionio_tpu.utils.http import (
+        AppServer,
+        Router,
+        add_metrics_route,
+    )
+
+    history.reset()
+    slo.reset()
+    monkeypatch.setenv("PIO_HISTORY_INTERVAL_S", "0")
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0)
+    srv.start()
+    try:
+        assert call(srv.port, "GET", "/debug/history")[0] == 404
+        assert call(srv.port, "GET", "/debug/slo")[0] == 404
+    finally:
+        srv.stop()
+        history.reset()
+
+
+# -- e2e: federation + SLO trip + doctor over a real 2-replica deploy ---------
+
+
+def _wait_sweeps(gw, n=3):
+    for _ in range(n):
+        gw.registry.check_once()
+
+
+def test_fleet_federation_slo_trip_and_doctor_e2e(memory_storage,
+                                                  monkeypatch, capsys):
+    """The acceptance path: 2 replicas behind the gateway → load →
+    /metrics/fleet shows both instances with fleet-summed counters; a
+    100% error burst (faults on the replica transport) trips the
+    query_availability burn within two history ticks; `pio doctor`
+    flags the breach, and — after one replica is killed — names it."""
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.resilience import faults
+    from predictionio_tpu.serve.gateway import (
+        GatewayConfig,
+        create_gateway_deployment,
+    )
+    from predictionio_tpu.tools.cli import build_parser, cmd_doctor
+    from predictionio_tpu.workflow.create_server import ServerConfig
+
+    history.reset()
+    slo.reset()
+    monkeypatch.setenv("PIO_HISTORY_INTERVAL_S", "30")
+    seed_and_train(memory_storage)
+    dep = create_gateway_deployment(
+        ServerConfig(ip="127.0.0.1", port=0), 2,
+        GatewayConfig(ip="127.0.0.1", port=0, health_interval_sec=60.0,
+                      cache_ttl_sec=0.0, cache_max_entries=0,
+                      hedge=False, deadline_sec=5.0,
+                      retry_backoff_base_sec=0.005,
+                      breaker_cooldown_sec=0.2),
+    )
+    dep.start()
+    try:
+        for k in range(6):
+            status, body = call(dep.port, "POST", "/queries.json",
+                                {"user": f"u{k}", "num": 2})
+            assert status == 200, body
+        # -- federation: both replicas under distinct instance labels,
+        # counters fleet-summed
+        status, text = call(dep.port, "GET", "/metrics/fleet")
+        assert status == 200
+        merged = text.decode()
+        instances = {m.group(1) for m in re.finditer(
+            r'instance="(127\.0\.0\.1:\d+)"', merged)}
+        replica_ids = {f"127.0.0.1:{srv.port}"
+                       for srv, _ in dep.replicas}
+        assert replica_ids <= instances
+        assert 'instance="gateway"' in merged
+        fleet_q = re.search(
+            r'^pio_query_requests_total\{instance="fleet"\} (\d+)',
+            merged, re.M)
+        assert fleet_q is not None and int(fleet_q.group(1)) >= 6
+        # -- SLO trip: 100% transport-error burst; two manual history
+        # ticks bracket it (the acceptance bound: within two intervals)
+        sampler = history.get_sampler()
+        assert sampler is not None
+        sampler.sample_once()  # baseline totals
+        faults.install("replica.socket:error:1")
+        try:
+            for k in range(10):
+                status, _ = call(dep.port, "POST", "/queries.json",
+                                 {"user": f"u{k}", "num": 2})
+                assert status in (503, 504)
+        finally:
+            faults.clear()
+        time.sleep(0.05)
+        sampler.sample_once()
+        burn = REGISTRY.get("pio_slo_burn_rate").value(
+            slo="query_availability", window="fast")
+        assert burn > 14.4, f"burn {burn} did not trip"
+        status, body = call(dep.port, "GET", "/debug/slo")
+        assert "query_availability" in json.loads(body)["breached"]
+        # -- doctor flags the breach
+        args = build_parser().parse_args(
+            ["doctor", "--url", f"http://127.0.0.1:{dep.port}"])
+        rc = cmd_doctor(args)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "SLO query_availability" in out and "BREACHED" in out
+        # -- kill one replica; doctor names it
+        dead = dep.replicas[1][0]
+        dead_id = f"127.0.0.1:{dead.port}"
+        dead.stop()
+        _wait_sweeps(dep.gateway, n=4)
+        rc = cmd_doctor(args)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert dead_id in out
+        assert "DOWN" in out or "unreachable" in out
+        # the dead replica is omitted from the merge, and shows in the
+        # reachability gauge
+        status, text = call(dep.port, "GET", "/metrics/fleet")
+        tail = text.decode()
+        assert f'instance="{dead_id}"' not in tail
+        assert REGISTRY.get("pio_fleet_instances").value(state="down") \
+            >= 1
+    finally:
+        dep.stop()
+        history.reset()
+        slo.reset()
+
+
+def test_status_fleet_cli(memory_storage, monkeypatch, capsys):
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.serve.gateway import (
+        GatewayConfig,
+        create_gateway_deployment,
+    )
+    from predictionio_tpu.tools.cli import build_parser, cmd_status
+    from predictionio_tpu.workflow.create_server import ServerConfig
+
+    history.reset()
+    slo.reset()
+    monkeypatch.setenv("PIO_HISTORY_INTERVAL_S", "60")
+    seed_and_train(memory_storage)
+    dep = create_gateway_deployment(
+        ServerConfig(ip="127.0.0.1", port=0), 2,
+        GatewayConfig(ip="127.0.0.1", port=0, health_interval_sec=60.0))
+    dep.start()
+    try:
+        args = build_parser().parse_args(
+            ["status", "--fleet", "--url",
+             f"http://127.0.0.1:{dep.port}"])
+        rc = cmd_status(args)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "gateway @" in out
+        assert out.count("replica 127.0.0.1:") == 2
+        assert "SLO query_availability" in out
+    finally:
+        dep.stop()
+        history.reset()
+        slo.reset()
