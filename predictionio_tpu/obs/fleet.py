@@ -54,6 +54,7 @@ __all__ = [
     "fetch_json",
     "merge_expositions",
     "parse_exposition",
+    "post_json",
 ]
 
 _SCRAPES = REGISTRY.counter(
@@ -310,6 +311,39 @@ def fetch_json(url: str, timeout: float = 10.0):
         return None
 
 
+def post_json(url: str, body: dict, timeout: float = 10.0
+              ) -> tuple[int, dict] | None:
+    """POST ``body`` as JSON → (status, parsed body) — HTTP error
+    statuses still return their parsed body (a remediation endpoint
+    answers 501/502 WITH a structured result the doctor must report).
+    None only when the host is unreachable or answers non-JSON."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw, status = resp.read(), resp.status
+        try:
+            doc = json.loads(raw or b"{}")
+        except ValueError:
+            doc = {}  # a 2xx with a non-JSON body still ANSWERED
+        return status, doc if isinstance(doc, dict) else {}
+    except urllib.error.HTTPError as e:
+        try:
+            doc = json.loads(e.read() or b"{}")
+        except ValueError:
+            # the server ANSWERED, just not with JSON (plain-HTML 404,
+            # intermediary error page): keep the status visible —
+            # None is reserved for hosts that never answered
+            doc = {}
+        return e.code, doc if isinstance(doc, dict) else {}
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
 def _http_get(host: str, port: int, path: str,
               timeout: float) -> tuple[int, bytes]:
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
@@ -396,8 +430,14 @@ def federated_exposition(results: list[dict]) -> str:
 _SEVERITY_RANK = {"critical": 0, "warn": 1, "info": 2}
 
 
-def _finding(severity: str, subject: str, detail: str) -> dict:
-    return {"severity": severity, "subject": subject, "detail": detail}
+def _finding(severity: str, subject: str, detail: str,
+             action: dict | None = None) -> dict:
+    doc = {"severity": severity, "subject": subject, "detail": detail}
+    if action is not None:
+        # the machine-actionable half of a finding: what `pio doctor
+        # --fix` would POST to the gateway's /fleet/actions
+        doc["action"] = action
+    return doc
 
 
 def diagnose(gateway_status: dict | None,
@@ -412,6 +452,10 @@ def diagnose(gateway_status: dict | None,
       * per-replica outliers vs the fleet median p99 and error ratio;
       * tripped device routes and stale models;
       * the slowest retained traces, as leads.
+
+    Findings with a mechanical fix carry an ``action`` hint
+    (``{"kind", "replica"}``) — the exact payload ``pio doctor --fix``
+    POSTs to the gateway's ``/fleet/actions``.
     """
     findings: list[dict] = []
     # -- SLO judgment
@@ -437,7 +481,8 @@ def diagnose(gateway_status: dict | None,
             findings.append(_finding(
                 "critical", f"replica {rid}",
                 f"DOWN after {rep.get('consecutiveFailures', '?')} failed "
-                "health probes — routing skips it"))
+                "health probes — routing skips it",
+                action={"kind": "restart_replica", "replica": rid}))
         elif rep.get("state") == "suspect":
             findings.append(_finding(
                 "warn", f"replica {rid}",
@@ -447,7 +492,8 @@ def diagnose(gateway_status: dict | None,
             findings.append(_finding(
                 "critical", f"replica {rid}",
                 "circuit breaker OPEN — transport failures shed its "
-                "traffic to the rest of the fleet"))
+                "traffic to the rest of the fleet",
+                action={"kind": "reset_breaker", "replica": rid}))
     # -- per-member statuses: outliers vs the fleet
     statuses = {m["instance"]: m.get("status") for m in members
                 if m.get("role") == "replica"}
@@ -486,7 +532,8 @@ def diagnose(gateway_status: dict | None,
             findings.append(_finding(
                 "warn", f"replica {inst}",
                 "device serving route tripped to host (awaiting a "
-                "successful synthetic probe)"))
+                "successful synthetic probe)",
+                action={"kind": "reset_device_route", "replica": inst}))
     # -- leads from the trace reservoir (the caller already bounds how
     # many it wants folded in — `pio doctor --traces K`)
     for doc in traces or []:

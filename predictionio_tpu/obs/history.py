@@ -246,6 +246,8 @@ class HistorySampler:
             "admission", ct(reg, "pio_admission_rejected_total"), dt)
         values["admission_inflight"] = _gauge_sum(
             reg, "pio_admission_inflight")
+        values["microbatch_queue_depth"] = _gauge_sum(
+            reg, "pio_microbatch_queue_depth")
         # staleness (the gauges refresh via collect hooks; run them so
         # the sample reads current ages, not last-scrape ages)
         reg._run_collect_hooks()

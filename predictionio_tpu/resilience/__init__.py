@@ -26,7 +26,11 @@ Three pillars, wired through every layer of the stack (ISSUE 9):
     transport failure.
 """
 
-from predictionio_tpu.resilience.admission import AdmissionGate, Overloaded
+from predictionio_tpu.resilience.admission import (
+    AdmissionGate,
+    Overloaded,
+    retry_after_jitter,
+)
 from predictionio_tpu.resilience.faults import (
     InjectedFault,
     InjectedOOM,
@@ -41,4 +45,5 @@ __all__ = [
     "InjectedOOM",
     "Overloaded",
     "fault_point",
+    "retry_after_jitter",
 ]

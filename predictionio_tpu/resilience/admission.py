@@ -16,11 +16,57 @@ from __future__ import annotations
 
 import math
 import os
+import random
 import threading
 from contextlib import contextmanager
 
 from predictionio_tpu.obs import REGISTRY
 from predictionio_tpu.utils.http import HTTPError
+
+#: RNG behind retry_after_jitter — module-level so PIO_FAULTS_SEED can
+#: pin it (the chaos suite's reproducibility contract covers backoff
+#: hints too: a seeded storm must shed the same Retry-After sequence)
+_JITTER_RNG = random.Random()
+_JITTER_LOCK = threading.Lock()
+_jitter_seed_seen: str | None = None
+
+
+def retry_after_jitter(base_sec: float) -> float:
+    """``base * (1 + U[0, PIO_RETRY_JITTER])`` — bounded random jitter
+    on shed-response backoff hints.
+
+    A constant Retry-After synchronizes every shed client onto the same
+    retry instant, turning one overload wave into a standing thundering
+    herd; spreading the hint over ``[base, base * (1 + jitter)]``
+    (default jitter 0.5) decorrelates them. ``PIO_RETRY_JITTER=0``
+    restores the constant. Seedable: when ``PIO_FAULTS_SEED`` is set the
+    jitter RNG reseeds on the seed's first sighting (and on any change),
+    so chaos schedules replay byte-identically."""
+    global _jitter_seed_seen
+    try:
+        frac = float(os.environ.get("PIO_RETRY_JITTER", "0.5"))
+    except ValueError:
+        frac = 0.5
+    if frac <= 0 or base_sec <= 0:
+        return base_sec
+    with _JITTER_LOCK:
+        seed = os.environ.get("PIO_FAULTS_SEED")
+        if seed is not None and seed != _jitter_seed_seen:
+            _JITTER_RNG.seed(seed)
+        _jitter_seed_seen = seed
+        u = _JITTER_RNG.random()
+    return base_sec * (1.0 + u * frac)
+
+
+def reseed_jitter() -> None:
+    """Re-pin the jitter RNG from ``PIO_FAULTS_SEED`` (tests replaying
+    a schedule from the top; mirrors faults._reseed on spec install)."""
+    global _jitter_seed_seen
+    with _JITTER_LOCK:
+        seed = os.environ.get("PIO_FAULTS_SEED")
+        if seed is not None:
+            _JITTER_RNG.seed(seed)
+        _jitter_seed_seen = seed
 
 ADMISSION_REJECTED = REGISTRY.counter(
     "pio_admission_rejected_total",
@@ -103,7 +149,11 @@ class AdmissionGate:
             with self._lock:
                 self.rejected += 1
             ADMISSION_REJECTED.inc(server=self.name)
-            raise Overloaded(self.retry_after_sec, self.name)
+            # jitter applied at shed time (not in Overloaded itself):
+            # the exception type stays an exact carrier of whatever
+            # hint the raiser computed
+            raise Overloaded(retry_after_jitter(self.retry_after_sec),
+                             self.name)
         try:
             yield
         finally:

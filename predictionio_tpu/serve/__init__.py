@@ -13,12 +13,20 @@ the pieces compose independently:
     per-replica circuit breaker;
   * :mod:`predictionio_tpu.serve.cache` — LRU+TTL query-result cache
     keyed on canonicalized query JSON + engine-instance id, invalidated
-    on ``/reload`` and redeploy.
+    on ``/reload`` and redeploy;
+  * :mod:`predictionio_tpu.serve.autoscaler` — the closed control loop:
+    scale up on fast-window SLO burn or sustained queue growth, drain
+    idle replicas back down, with cooldowns and flap damping
+    (``pio deploy --max-replicas N``).
 
 Everything exposes ``pio_gateway_*`` metrics through the process
 registry (``GET /metrics`` on the gateway port).
 """
 
+from predictionio_tpu.serve.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerConfig,
+)
 from predictionio_tpu.serve.cache import (  # noqa: F401
     QueryCache,
     canonical_query_key,

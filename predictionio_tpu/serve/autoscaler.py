@@ -1,0 +1,384 @@
+"""SLO-driven autoscaler: the closed loop over senses PRs 9-10 built.
+
+The gateway already *observes* overload (admission 429s, queue-wait
+tails, SLO burn rates) and *locally* absorbs failure (breakers,
+failover, shedding) — but replica count was fixed at deploy time, so a
+sustained load spike was a page, not a scaling event. This module turns
+the observability into actuation, the capacity-management stance of the
+ads-infra production line and the latency-SLO-driven elasticity of the
+serverless-dataflow prediction-serving work (PAPERS.md):
+
+  * **scale up** when a serving SLO's fast-window burn rate crosses its
+    threshold, when admission keeps shedding (429s over
+    ``pressure_ticks`` consecutive history ticks), when the queue-wait
+    p99 or micro-batch queue depth climbs past its bound, or when fewer
+    routable replicas remain than ``min_replicas``;
+  * **scale down** one replica at a time after ``idle_ticks``
+    consecutive quiet ticks (no shedding, no burn, per-replica qps under
+    ``idle_qps_per_replica``), draining the victim through the
+    registry's graceful path before stopping it;
+  * **cooldowns + flap damping** bound the loop: a scale-up starts both
+    cooldown clocks, so a spike can't saw the fleet up and down — the
+    idle streak must *outlast* ``scale_down_cooldown_s`` measured from
+    the last action in either direction.
+
+The decision inputs come from the process surfaces that already exist:
+the SLO engine's last judgment (obs/slo.py) and the history rings
+(obs/history.py) — the autoscaler ticks on its own thread but reads the
+same clock the operator's dashboard reads, so every decision is
+explainable from ``/debug/history`` + ``/debug/slo`` after the fact.
+Every decision (including holds) lands in
+``pio_autoscaler_decisions_total{action,reason}``; the current replica
+count and last-action timestamps ride gauges.
+
+Actuation goes through a *provisioner* — any object with
+``scale_up() -> str | None`` and
+``scale_down(drain_timeout=...) -> str | None`` —
+normally the :class:`~predictionio_tpu.serve.gateway.GatewayDeployment`
+(in-process replicas on consecutive ports), but a process-per-replica
+or k8s-backed provisioner slots in without touching the control loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from predictionio_tpu.obs import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "Signals", "next_replica_port"]
+
+_DECISIONS = REGISTRY.counter(
+    "pio_autoscaler_decisions_total",
+    "Autoscaler control-loop decisions per tick: action "
+    "(scale_up/scale_down/hold) and why (slo_burn, queue_growth, "
+    "below_min, sustained_idle, cooldown, at_max, at_min, steady, "
+    "no_victim, error)",
+    labels=("action", "reason"),
+)
+_REPLICA_COUNT = REGISTRY.gauge(
+    "pio_autoscaler_replicas",
+    "Replicas the autoscaler currently manages (non-draining members "
+    "of the gateway registry), refreshed every control tick",
+)
+_LAST_ACTION = REGISTRY.gauge(
+    "pio_autoscaler_last_action_timestamp",
+    "Unix timestamp of the autoscaler's last applied action, by "
+    "direction (scale_up/scale_down)",
+    labels=("action",),
+)
+
+
+def next_replica_port(gateway_port: int, existing_ports: list[int]) -> int:
+    """Where the next spawned replica binds: consecutive after the
+    fleet's highest port (gateway 8000 over 8001..8003 spawns 8004), or
+    ephemeral (0) when the gateway itself bound an ephemeral port —
+    tests and benches must never collide on fixed ports."""
+    if gateway_port == 0:
+        return 0
+    return max([gateway_port, *existing_ports]) + 1
+
+
+@dataclass
+class AutoscalerConfig:
+    #: replica-count bounds the control loop may never cross
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: control-tick period; None rides the history sampler's interval
+    #: (the signals only refresh that often anyway)
+    interval_s: float | None = None
+    #: seconds after a scale-up before another scale-up may fire
+    scale_up_cooldown_s: float = 30.0
+    #: seconds after the last action (EITHER direction — flap damping)
+    #: before a scale-down may fire
+    scale_down_cooldown_s: float = 180.0
+    #: consecutive pressured ticks before queue growth triggers scale-up
+    #: (an SLO burn or a below-min deficit scales up immediately)
+    pressure_ticks: int = 2
+    #: consecutive idle ticks before a scale-down
+    idle_ticks: int = 6
+    #: a tick is idle only when gateway qps / replica stays under this
+    idle_qps_per_replica: float = 1.0
+    #: queue-wait p99 beyond this is queue pressure even without 429s
+    queue_wait_bound_ms: float = 50.0
+    #: micro-batch queue depth beyond this (and rising) is pressure
+    queue_depth_bound: float = 8.0
+    #: graceful-drain budget per scale-down victim
+    drain_timeout_s: float = 10.0
+    #: serving SLOs whose fast-window burn triggers a scale-up (ingest
+    #: or staleness burns are not solvable with more replicas)
+    slo_names: tuple = ("query_availability", "query_latency_p99")
+
+
+@dataclass
+class Signals:
+    """One control tick's inputs, separated from the decision so tests
+    drive :meth:`Autoscaler.tick_once` with synthetic values."""
+
+    #: serving SLOs whose fast-window burn exceeds their threshold
+    burn_hot: list = field(default_factory=list)
+    #: latest admission-shed rate (429/s) from the history ring
+    rejected_rate: float | None = None
+    #: latest windowed queue-wait p99 (ms)
+    queue_wait_p99_ms: float | None = None
+    #: micro-batch queue depth is rising past its bound
+    queue_growing: bool = False
+    #: latest gateway qps (replica qps fallback)
+    qps: float | None = None
+    #: non-draining registry members (the count the bounds apply to)
+    n_replicas: int = 0
+    #: healthy + suspect members (what routing can actually use)
+    n_routable: int = 0
+
+
+class Autoscaler:
+    """The control loop. Build over a gateway + provisioner, then
+    ``start()`` — or drive ``tick_once()`` manually (tests, one-shot
+    evaluation). One instance per gateway; it also hangs itself off
+    ``gateway.autoscaler`` so the status page can report it."""
+
+    def __init__(self, gateway, provisioner,
+                 config: AutoscalerConfig | None = None):
+        self.gateway = gateway
+        self.provisioner = provisioner
+        self.config = config or AutoscalerConfig()
+        if self.config.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.config.max_replicas < self.config.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self._lock = threading.Lock()  # serializes ticks (thread + manual)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._pressure_streak = 0
+        self._idle_streak = 0
+        self._last_up_t: float | None = None
+        self._last_down_t: float | None = None
+        self.last_decision: tuple[str, str] = ("hold", "steady")
+        self.tick_count = 0
+        if gateway is not None:
+            gateway.autoscaler = self
+
+    # -- signal collection --------------------------------------------------
+    def read_signals(self) -> Signals:
+        """Current inputs from the live surfaces: registry membership,
+        the SLO engine's last judgment, and the history rings."""
+        from predictionio_tpu.obs import history, slo
+
+        sig = Signals()
+        replicas = self.gateway.registry.replicas()
+        sig.n_replicas = sum(1 for r in replicas if r.state != "draining")
+        sig.n_routable = sum(1 for r in replicas
+                             if r.state in ("healthy", "suspect"))
+        eng = slo.engine()
+        if eng is not None:
+            for doc in eng.state().get("slos", []):
+                if doc["name"] not in self.config.slo_names:
+                    continue
+                fast = (doc.get("burnRates") or {}).get("fast")
+                if fast is not None and fast > doc.get("burnThreshold",
+                                                       14.4):
+                    sig.burn_hot.append(doc["name"])
+        sampler = history.get_sampler()
+        if sampler is not None:
+            def latest(name):
+                # the LAST tick's value only — never scan back for the
+                # last non-None: a windowed quantile samples None on
+                # quiet ticks, and resurrecting a spike's hot p99 from
+                # minutes ago would keep "pressure" on (blocking
+                # scale-down and re-triggering scale-up) long after the
+                # traffic died
+                pts = sampler.points(name)
+                return pts[-1][1] if pts else None
+
+            sig.rejected_rate = latest("admission_rejected_per_sec")
+            sig.queue_wait_p99_ms = latest("stage_queue_wait_p99_ms")
+            sig.qps = latest("gateway_qps")
+            if sig.qps is None:
+                sig.qps = latest("query_qps")
+            depth = [v for _, v in
+                     sampler.points("microbatch_queue_depth")
+                     if v is not None][-(self.config.pressure_ticks + 1):]
+            sig.queue_growing = (
+                len(depth) >= 2 and depth[-1] > depth[0]
+                and depth[-1] > self.config.queue_depth_bound)
+        return sig
+
+    # -- the decision -------------------------------------------------------
+    def _decide(self, sig: Signals, now: float) -> tuple[str, str]:
+        """(action, reason) for one tick; updates the streak/cooldown
+        state. Pure given (signals, clock) — the unit-testable core."""
+        cfg = self.config
+        pressured = ((sig.rejected_rate or 0.0) > 0.0
+                     or (sig.queue_wait_p99_ms or 0.0)
+                     > cfg.queue_wait_bound_ms
+                     or sig.queue_growing)
+        self._pressure_streak = self._pressure_streak + 1 if pressured \
+            else 0
+        # idle needs EVIDENCE of quiet, not absence of data: qps is None
+        # when history is off (or hasn't ticked twice yet), and draining
+        # loaded replicas blind would contradict the documented
+        # "below-min healing only" degradation
+        idle = (not pressured and not sig.burn_hot
+                and sig.qps is not None
+                and sig.qps
+                < cfg.idle_qps_per_replica * max(sig.n_replicas, 1))
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+
+        if sig.burn_hot:
+            up_reason = "slo_burn"
+        elif sig.n_routable < cfg.min_replicas:
+            up_reason = "below_min"
+        elif self._pressure_streak >= cfg.pressure_ticks:
+            up_reason = "queue_growth"
+        else:
+            up_reason = None
+
+        if up_reason is not None:
+            # below-min healing counts ROUTABLE members against the
+            # ceiling: a dead replica must not consume capacity, or a
+            # full fleet with a DOWN member could never heal
+            occupied = (sig.n_routable if up_reason == "below_min"
+                        else sig.n_replicas)
+            if occupied >= cfg.max_replicas:
+                return "hold", "at_max"
+            if self._last_up_t is not None and \
+                    now - self._last_up_t < cfg.scale_up_cooldown_s:
+                return "hold", "cooldown"
+            return "scale_up", up_reason
+
+        if self._idle_streak >= cfg.idle_ticks:
+            if sig.n_replicas <= cfg.min_replicas \
+                    or sig.n_routable <= cfg.min_replicas:
+                return "hold", "at_min"
+            acted = [t for t in (self._last_up_t, self._last_down_t)
+                     if t is not None]
+            if acted and now - max(acted) < cfg.scale_down_cooldown_s:
+                # flap damping: idle must OUTLAST the cooldown from the
+                # last action in either direction
+                return "hold", "cooldown"
+            return "scale_down", "sustained_idle"
+        return "hold", "steady"
+
+    # -- the tick -----------------------------------------------------------
+    def tick_once(self, now: float | None = None,
+                  signals: Signals | None = None) -> tuple[str, str]:
+        """One control-loop pass: read signals, decide, actuate. Returns
+        the (action, reason) recorded — after actuation, so a failed
+        spawn/drain downgrades to ``hold``."""
+        with self._lock:
+            now = time.time() if now is None else now
+            if self.gateway is not None and \
+                    getattr(self.gateway, "stopping", False):
+                # graceful undeploy in progress: the drain marks every
+                # replica draining, which would read as a below-min
+                # deficit and spawn a fresh replica into a dying fleet
+                self.last_decision = ("hold", "stopping")
+                self.tick_count += 1
+                _DECISIONS.inc(action="hold", reason="stopping")
+                return "hold", "stopping"
+            sig = self.read_signals() if signals is None else signals
+            action, reason = self._decide(sig, now)
+            if action == "scale_up":
+                try:
+                    new_id = self.provisioner.scale_up()
+                except Exception:
+                    logger.exception("autoscaler scale-up failed")
+                    new_id = None
+                if new_id is None:
+                    action, reason = "hold", "error"
+                else:
+                    self._last_up_t = now
+                    self._pressure_streak = 0
+                    _LAST_ACTION.set(now, action="scale_up")
+                    logger.warning(
+                        "autoscaler scaled UP (%s): %d -> %d replicas "
+                        "(new %s)", reason, sig.n_replicas,
+                        sig.n_replicas + 1, new_id)
+            elif action == "scale_down":
+                try:
+                    victim = self.provisioner.scale_down(
+                        drain_timeout=self.config.drain_timeout_s)
+                except Exception:
+                    logger.exception("autoscaler scale-down failed")
+                    victim = None
+                if victim is None:
+                    action, reason = "hold", "no_victim"
+                else:
+                    self._last_down_t = now
+                    self._idle_streak = 0
+                    _LAST_ACTION.set(now, action="scale_down")
+                    logger.warning(
+                        "autoscaler scaled DOWN (%s): %d -> %d replicas "
+                        "(drained %s)", reason, sig.n_replicas,
+                        sig.n_replicas - 1, victim)
+            _DECISIONS.inc(action=action, reason=reason)
+            if self.gateway is not None:
+                live = sum(1 for r in self.gateway.registry.replicas()
+                           if r.state != "draining")
+            else:
+                live = sig.n_replicas
+            _REPLICA_COUNT.set(live)
+            self.last_decision = (action, reason)
+            self.tick_count += 1
+            return action, reason
+
+    # -- lifecycle ----------------------------------------------------------
+    def interval_s(self) -> float:
+        # clamped to >= 1 s either way: a 0/negative --scale-interval
+        # must degrade to a fast loop, never a busy-spin
+        if self.config.interval_s is not None:
+            return max(self.config.interval_s, 1.0)
+        from predictionio_tpu.obs import history
+
+        return max(history.history_interval_s(), 1.0)
+
+    def start(self) -> None:
+        """Start the control thread. Requires history: the sampler is
+        the loop's sensory input, so a disabled history
+        (PIO_HISTORY_INTERVAL_S=0) leaves the loop running on registry
+        membership alone (below-min healing) with a warning."""
+        from predictionio_tpu.obs import history
+
+        if history.ensure_started() is None:
+            logger.warning(
+                "autoscaler started with history disabled "
+                "(PIO_HISTORY_INTERVAL_S=0): no burn/queue signals — "
+                "only below-min healing will trigger")
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="gateway-autoscaler", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s()):
+            try:
+                self.tick_once()
+            except Exception:  # the loop must never die
+                logger.exception("autoscaler tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    # -- status (gateway GET / + pio doctor) --------------------------------
+    def status(self) -> dict:
+        cfg = self.config
+        return {
+            "minReplicas": cfg.min_replicas,
+            "maxReplicas": cfg.max_replicas,
+            "ticks": self.tick_count,
+            "lastDecision": {"action": self.last_decision[0],
+                             "reason": self.last_decision[1]},
+            "pressureStreak": self._pressure_streak,
+            "idleStreak": self._idle_streak,
+            "lastScaleUpAt": self._last_up_t,
+            "lastScaleDownAt": self._last_down_t,
+        }

@@ -31,6 +31,7 @@ breaker or burn retries.
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
 import json
 import logging
@@ -94,6 +95,28 @@ _GW_COALESCED = REGISTRY.counter(
     "Requests that waited on an identical in-flight query instead of "
     "going upstream (cache singleflight)",
 )
+_FIX_ACTIONS = REGISTRY.counter(
+    "pio_doctor_fix_actions_total",
+    "Remediation actions applied through POST /fleet/actions "
+    "(pio doctor --fix): restart_replica, evict_replica, reset_breaker, "
+    "reset_device_route; result ok/dry_run/error/unsupported/unknown",
+    labels=("action", "result"),
+)
+
+#: the remediation actions POST /fleet/actions accepts
+FLEET_ACTIONS = ("restart_replica", "evict_replica", "reset_breaker",
+                 "reset_device_route")
+
+
+def fleet_actions_enabled() -> bool:
+    """Whether the remediation surface (gateway ``POST /fleet/actions``
+    and the replica's device-route reset) is mounted. On by default —
+    it's the actuation side of ``pio doctor`` — and removable with
+    ``PIO_FLEET_ACTIONS=0`` for deploys that want triage to stay
+    read-only."""
+    import os
+
+    return os.environ.get("PIO_FLEET_ACTIONS", "1") != "0"
 
 
 class CircuitBreaker:
@@ -226,6 +249,10 @@ class Gateway:
         )
         self.start_time = time.time()
         self._stop_event = threading.Event()
+        #: True from the moment a graceful shutdown begins (before the
+        #: drain, well before _stop_event fires) — the autoscaler reads
+        #: it so a fleet-wide drain can't look like a replica deficit
+        self.stopping = False
         self._breakers: dict[str, CircuitBreaker] = {}
         self._pools: dict[str, list[http.client.HTTPConnection]] = {}
         self._pool_lock = threading.Lock()
@@ -241,6 +268,13 @@ class Gateway:
         self.hedges_fired = 0
         self.hedges_won = 0
         self.retries = 0
+        #: set by GatewayDeployment (or any replica-lifecycle owner):
+        #: restart_replica/stop_replica handles for POST /fleet/actions.
+        #: None = the gateway fronts replicas it cannot respawn (remote
+        #: ports) — restart answers "unsupported" then.
+        self.replica_controller = None
+        #: set by serve/autoscaler.Autoscaler when one attaches
+        self.autoscaler = None
         self.router = self._build_router()
 
     # -- assembly -----------------------------------------------------------
@@ -252,6 +286,26 @@ class Gateway:
         _GW_BREAKER_OPEN.set(0, replica=r.id)
         return r
 
+    def remove_replica(self, replica_id: str) -> Replica | None:
+        """Evict a replica from routing: registry membership, its
+        breaker, and any pooled keep-alive connections all go. In-flight
+        requests finish (release only decrements the popped object)."""
+        r = self.registry.remove(replica_id)
+        self._breakers.pop(replica_id, None)
+        _GW_BREAKER_OPEN.set(0, replica=replica_id)
+        self.drop_pooled(replica_id)
+        return r
+
+    def drop_pooled(self, replica_id: str) -> None:
+        """Close this replica's pooled keep-alive connections. A
+        restarted replica REQUIRES this: a stopped AppServer's existing
+        keep-alive handler threads keep answering until their socket
+        closes, so a pooled connection would keep reaching the dead
+        service (stopped micro-batcher → 500s) past the restart."""
+        with self._pool_lock:
+            for conn in self._pools.pop(replica_id, []):
+                conn.close()
+
     def start(self) -> None:
         # one synchronous sweep so routing state and the fleet instance
         # id are populated before the first proxied query (probe-ok
@@ -260,6 +314,7 @@ class Gateway:
         self.registry.start()
 
     def stop(self) -> None:
+        self.stopping = True
         self.registry.stop()
         self._stop_event.set()
         with self._pool_lock:
@@ -301,8 +356,132 @@ class Gateway:
         r.add("GET", "/reload", self.get_reload)
         r.add("GET", "/stop", self.get_stop)
         r.add("GET", "/metrics/fleet", self.get_fleet_metrics)
+        r.add("POST", "/fleet/actions", self.post_fleet_action)
         add_metrics_route(r)
         return r
+
+    # -- remediation (`pio doctor --fix`) ------------------------------------
+    def post_fleet_action(self, request: Request):
+        """``POST /fleet/actions``: apply one remediation action —
+        ``{"action": ..., "replica": "host:port", "dryRun": bool}``.
+        Every action is gated (``PIO_FLEET_ACTIONS=0`` unmounts the
+        surface), logged, counted in
+        ``pio_doctor_fix_actions_total{action,result}``, and dry-runnable
+        (``dryRun`` reports what would happen without acting)."""
+        from predictionio_tpu.utils.http import HTTPError
+
+        if not fleet_actions_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/faults contract
+            raise HTTPError(404, "fleet actions disabled "
+                                 "(PIO_FLEET_ACTIONS=0)")
+        body = request.json()
+        if not isinstance(body, dict):
+            raise HTTPError(400, "JSON object expected")
+        action = body.get("action")
+        replica_id = body.get("replica")
+        dry_run = bool(body.get("dryRun"))
+        if action not in FLEET_ACTIONS:
+            raise HTTPError(
+                400, f"unknown action {action!r}; "
+                     f"one of {', '.join(FLEET_ACTIONS)}")
+        if not isinstance(replica_id, str) or not replica_id:
+            raise HTTPError(400, "action needs a replica (host:port)")
+        result, detail = self._apply_fleet_action(
+            action, replica_id, dry_run)
+        _FIX_ACTIONS.inc(action=action, result=result)
+        logger.warning("fleet action %s on %s: %s (%s)",
+                       action, replica_id, result, detail)
+        doc = {"action": action, "replica": replica_id,
+               "result": result, "detail": detail}
+        status = {"ok": 200, "dry_run": 200, "unknown": 404,
+                  "unsupported": 501}.get(result, 502)
+        if status == 200:
+            return 200, doc
+        # non-2xx still carries the structured body so `pio doctor
+        # --fix` reports the failure verbatim (and can escalate)
+        return status, RawResponse(json.dumps(doc),
+                                   "application/json; charset=UTF-8")
+
+    def _apply_fleet_action(self, action: str, replica_id: str,
+                            dry_run: bool) -> tuple[str, str]:
+        replica = self.registry.find(replica_id)
+        if action == "reset_breaker":
+            breaker = self._breakers.get(replica_id)
+            if breaker is None:
+                return "unknown", "no breaker for that replica"
+            if dry_run:
+                return "dry_run", f"would close breaker ({breaker.state})"
+            previous = breaker.state
+            breaker.reset()
+            _GW_BREAKER_OPEN.set(0, replica=replica_id)
+            return "ok", f"breaker {previous} -> closed"
+        if action == "evict_replica":
+            if replica is None:
+                return "unknown", "replica not in registry"
+            if dry_run:
+                return "dry_run", (f"would evict ({replica.state}, "
+                                   f"{replica.outstanding} outstanding)")
+            self.remove_replica(replica_id)
+            controller = self.replica_controller
+            if controller is not None:
+                # in-process replica: also stop its server + service so
+                # an evicted-but-running replica doesn't leak threads
+                try:
+                    controller.discard_replica(replica_id)
+                except Exception:
+                    logger.exception("evicted replica %s but its local "
+                                     "teardown failed", replica_id)
+            return "ok", "removed from registry"
+        if action == "restart_replica":
+            controller = self.replica_controller
+            if controller is None:
+                return "unsupported", (
+                    "no replica controller: this gateway fronts "
+                    "replicas it cannot respawn — evict instead")
+            if replica is None:
+                return "unknown", "replica not in registry"
+            if dry_run:
+                return "dry_run", f"would restart ({replica.state})"
+            try:
+                controller.restart_replica(replica_id)
+            except Exception as e:
+                return "error", f"restart failed: {e}"
+            # targeted probe so the caller sees the recovery without
+            # paying a whole-fleet sweep (doctor runs exactly when other
+            # replicas may be dead and slow to time out)
+            self.registry.check_replica(replica)
+            return "ok", "replica restarted on its port"
+        # reset_device_route: the breaker lives in the REPLICA process
+        if replica is None:
+            return "unknown", "replica not in registry"
+        if dry_run:
+            return "dry_run", "would reset the device-route breaker"
+        try:
+            status, body = self._replica_post(
+                replica, "/admin/device-route/reset", 10.0)
+        except (OSError, ValueError) as e:
+            return "error", f"replica unreachable: {e}"
+        if status != 200:
+            return "error", f"replica answered HTTP {status}: " \
+                            f"{body.get('message', '')}"
+        return "ok", (f"device route {body.get('previous')} -> "
+                      f"{body.get('state')}")
+
+    def _replica_post(self, replica: Replica, path: str,
+                      timeout: float) -> tuple[int, dict]:
+        """POST a control endpoint on a replica over a fresh direct
+        connection (same rationale as _replica_control)."""
+        conn = http.client.HTTPConnection(replica.host, replica.port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", path, b"{}",
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read() or b"{}")
+            return resp.status, body if isinstance(body, dict) else {}
+        finally:
+            conn.close()
 
     # -- fleet federation (obs/fleet.py) ------------------------------------
     def fleet_targets(self) -> list:
@@ -350,10 +529,14 @@ class Gateway:
                 "hedgesWon": self.hedges_won,
                 "retries": self.retries,
             }
+        breakers = dict(self._breakers)
         body["replicas"] = [
-            {**snap, "breaker": self._breakers[snap["replica"]].state}
+            {**snap, "breaker": getattr(breakers.get(snap["replica"]),
+                                        "state", "closed")}
             for snap in self.registry.snapshot()
         ]
+        if self.autoscaler is not None:
+            body["autoscaler"] = self.autoscaler.status()
         body["cache"] = self.cache.stats()
         p99 = _GW_UPSTREAM_SECONDS.quantile(0.99)
         body["hedgeDelaySec"] = round(self._hedge_delay(), 6)
@@ -416,6 +599,7 @@ class Gateway:
         every replica, and release ``wait_for_stop``."""
 
         def shutdown():
+            self.stopping = True  # freeze the autoscaler first
             self.registry.stop()
             self.registry.drain(timeout_sec=10.0)
             for r in self.registry.replicas():
@@ -515,6 +699,14 @@ class Gateway:
         _GW_REQUESTS.inc(outcome=outcome)
         return status, payload
 
+    def _shed_hint(self) -> float:
+        """Retry-After for gateway-side 503s: breaker cooldown plus
+        bounded random jitter, so a synchronized client herd spreads its
+        retries instead of stampeding the recovering fleet at once."""
+        from predictionio_tpu.resilience.admission import retry_after_jitter
+
+        return round(retry_after_jitter(self.config.breaker_cooldown_sec), 3)
+
     def _hedge_delay(self) -> float:
         if self.config.hedge_delay_sec is not None:
             return self.config.hedge_delay_sec
@@ -563,7 +755,9 @@ class Gateway:
         threading.Thread(target=run, name=f"gw-{kind}", daemon=True).start()
 
     def _record_transport(self, replica: Replica, ok: bool) -> None:
-        breaker = self._breakers[replica.id]
+        breaker = self._breakers.get(replica.id)
+        if breaker is None:
+            return  # evicted while this attempt was in flight
         if ok:
             breaker.record_success()
         else:
@@ -571,9 +765,15 @@ class Gateway:
         _GW_BREAKER_OPEN.set(
             1 if breaker.state == "open" else 0, replica=replica.id)
 
+    def _admit(self, replica: Replica) -> bool:
+        breaker = self._breakers.get(replica.id)
+        # a just-evicted replica can linger in a registry snapshot for
+        # one acquire; without its breaker there is nothing to consult
+        return True if breaker is None else breaker.allow()
+
     def _acquire(self, exclude: set[str]) -> Replica | None:
         return self.registry.acquire_least_outstanding(
-            admit=lambda r: self._breakers[r.id].allow(), exclude=exclude
+            admit=self._admit, exclude=exclude
         )
 
     def _fetch(self, body: bytes, deadline: float) -> tuple[int, object]:
@@ -600,7 +800,7 @@ class Gateway:
         primary = self._acquire(exclude=tried)
         if primary is None:
             return 503, {"message": "No replica available.",
-                         "retryAfterSec": self.config.breaker_cooldown_sec,
+                         "retryAfterSec": self._shed_hint(),
                          "pioGatewayOutcome": "no_replica"}
         tried.add(primary.id)
         self._launch(primary, body, rid, deadline, resq, "primary")
@@ -669,7 +869,9 @@ class Gateway:
                 # Hand back any half-open probe slot allow() consumed,
                 # or the unprobed replica would be shed forever
                 self.registry.release(retry)
-                self._breakers[retry.id].cancel_probe()
+                b = self._breakers.get(retry.id)
+                if b is not None:
+                    b.cancel_probe()
                 break
             time.sleep(backoff)
             backoff = min(backoff * 2, cfg.retry_backoff_max_sec)
@@ -693,7 +895,7 @@ class Gateway:
             # 503 + Retry-After, well inside the deadline budget — the
             # client backs off instead of piling onto a down fleet
             return 503, {"message": f"All replicas unavailable: {last_err}",
-                         "retryAfterSec": self.config.breaker_cooldown_sec,
+                         "retryAfterSec": self._shed_hint(),
                          "pioGatewayOutcome": "all_down"}
         return 504, {"message": "Deadline exceeded.",
                      "pioGatewayOutcome": "deadline"}
@@ -764,13 +966,24 @@ class GatewayDeployment:
     """One in-process serving topology: N replica query servers plus the
     gateway fronting them. start()/stop() manage every server; the
     gateway's ``/stop`` (hit by ``pio undeploy``) releases
-    ``wait_for_stop`` after the graceful drain."""
+    ``wait_for_stop`` after the graceful drain.
+
+    This is also the fleet's *replica controller*: the autoscaler's
+    provisioner (``scale_up``/``scale_down``) and ``pio doctor --fix``'s
+    restart/discard handles both live here, because only the deployment
+    knows how to build a replica (it holds the engine ServerConfig)."""
 
     def __init__(self, gateway: Gateway, gateway_server: AppServer,
-                 replicas: list):
+                 replicas: list, server_config=None):
         self.gateway = gateway
         self.server = gateway_server
         self.replicas = replicas  # [(AppServer, QueryService), ...]
+        #: the engine ServerConfig replicas are built from; None =
+        #: externally supplied replicas, spawn/restart unavailable
+        self.server_config = server_config
+        self._replica_lock = threading.Lock()
+        if server_config is not None:
+            gateway.replica_controller = self
 
     @property
     def port(self) -> int:
@@ -792,14 +1005,150 @@ class GatewayDeployment:
     def stop(self) -> None:
         self.gateway.stop()
         self.server.stop()
-        for srv, service in self.replicas:
-            srv.stop()
-            # drain each replica's micro-batcher (a mid-flight deferred
-            # finalize completes) and join its worker threads, so a
-            # `pio stop-all`-driven teardown can't race them
-            shutdown = getattr(service, "shutdown", None)
-            if shutdown is not None:
-                shutdown()
+        with self._replica_lock:
+            entries = list(self.replicas)
+        for entry in entries:
+            self._teardown(entry, remove=False)
+
+    # -- replica lifecycle (autoscaler + doctor --fix) ----------------------
+    def _teardown(self, entry, remove: bool = True) -> None:
+        """The one replica-teardown sequence: stop the server, drain the
+        service's micro-batcher (a mid-flight deferred finalize
+        completes) and join its worker threads, and (unless the caller
+        keeps the slot, e.g. restart-in-place) drop the entry."""
+        srv, service = entry
+        srv.stop()
+        shutdown = getattr(service, "shutdown", None)
+        if shutdown is not None:
+            shutdown()
+        if remove:
+            with self._replica_lock:
+                if entry in self.replicas:
+                    self.replicas.remove(entry)
+
+    def _find(self, replica_id: str):
+        host, _, port = replica_id.rpartition(":")
+        try:
+            port = int(port)
+        except ValueError:
+            return None
+        with self._replica_lock:
+            for entry in self.replicas:
+                if entry[0].port == port:
+                    return entry
+        return None
+
+    def spawn_replica(self) -> str:
+        """Build, start, and register one more replica on the next
+        consecutive port (ephemeral when the gateway bound port 0).
+        Returns the new replica's registry id.
+
+        The ``query_r<N>`` server_name index is the LOWEST one not in
+        use (not a monotonic counter): server_name is a metric label,
+        and a flapping autoscaled deploy minting query_r57, query_r58,
+        ... would grow label cardinality without bound until the
+        registry's series guard started dropping exactly the newest
+        replicas' metrics."""
+        from predictionio_tpu.serve.autoscaler import next_replica_port
+        from predictionio_tpu.workflow.create_server import create_server
+
+        if self.server_config is None:
+            raise RuntimeError("deployment has no ServerConfig to "
+                               "build replicas from")
+        with self._replica_lock:
+            used = set()
+            for _, service in self.replicas:
+                name = getattr(getattr(service, "config", None),
+                               "server_name", "")
+                if name.startswith("query_r") and name[7:].isdigit():
+                    used.add(int(name[7:]))
+            index = next(i for i in range(len(used) + 1)
+                         if i not in used)
+            port = next_replica_port(
+                self.gateway.config.port,
+                [srv.port for srv, _ in self.replicas])
+        rcfg = dataclasses.replace(
+            self.server_config, port=port, server_name=f"query_r{index}",
+            upgrade_check=False,
+        )
+        srv, service = create_server(rcfg)
+        srv.start()
+        with self._replica_lock:
+            self.replicas.append((srv, service))
+        host = "127.0.0.1" if srv.host in ("0.0.0.0", "::") else srv.host
+        replica = self.gateway.add_replica(host, srv.port)
+        logger.info("spawned replica %s (%s)", replica.id,
+                    rcfg.server_name)
+        return replica.id
+
+    def stop_replica(self, replica_id: str,
+                     drain_timeout: float = 10.0) -> bool:
+        """Gracefully retire one replica: draining state (no new
+        traffic), wait out in-flight requests, stop its server, drain
+        its micro-batcher, drop it from registry + gateway."""
+        entry = self._find(replica_id)
+        replica = self.gateway.registry.find(replica_id)
+        if replica is not None:
+            self.gateway.registry.mark_draining(replica)
+            self.gateway.registry.wait_drained(replica, drain_timeout)
+        if entry is not None:
+            self._teardown(entry)
+        self.gateway.remove_replica(replica_id)
+        return entry is not None or replica is not None
+
+    def discard_replica(self, replica_id: str) -> None:
+        """Local teardown behind a gateway-level eviction (the registry
+        entry is already gone): stop the server and its service threads
+        without a drain — eviction targets replicas presumed dead."""
+        entry = self._find(replica_id)
+        if entry is None:
+            return
+        self._teardown(entry)
+
+    def restart_replica(self, replica_id: str) -> str:
+        """Rebuild a (presumed dead) replica ON ITS PORT: stop whatever
+        is left of the old server, create a fresh server + service from
+        the same ServerConfig, start it. The registry entry survives —
+        the next health probe marks it healthy again."""
+        from predictionio_tpu.workflow.create_server import create_server
+
+        entry = self._find(replica_id)
+        if entry is None:
+            raise ValueError(f"unknown replica {replica_id}")
+        old_srv, old_service = entry
+        self._teardown(entry, remove=False)  # slot reused below
+        # pin the BOUND port: ephemeral-port replicas (ServerConfig
+        # port=0) must come back on the address the registry knows
+        rcfg = dataclasses.replace(
+            old_service.config, port=old_srv.port, upgrade_check=False)
+        srv, service = create_server(rcfg)
+        srv.start()
+        with self._replica_lock:
+            idx = self.replicas.index(entry)
+            self.replicas[idx] = (srv, service)
+        # stale keep-alive connections would still reach the old
+        # (stopped) service's handler threads
+        self.gateway.drop_pooled(replica_id)
+        logger.warning("restarted replica %s (%s)", replica_id,
+                       rcfg.server_name)
+        return replica_id
+
+    # -- autoscaler provisioner protocol ------------------------------------
+    def scale_up(self) -> str | None:
+        return self.spawn_replica()
+
+    def scale_down(self, drain_timeout: float | None = None) -> str | None:
+        """Retire the newest routable replica (LIFO keeps the original
+        fleet's stable ports). None when no routable victim exists."""
+        candidates = [r for r in self.gateway.registry.replicas()
+                      if r.state in ("healthy", "suspect")]
+        if not candidates:
+            return None
+        victim = max(candidates, key=lambda r: r.seq)
+        ok = self.stop_replica(
+            victim.id,
+            drain_timeout=10.0 if drain_timeout is None else drain_timeout)
+        return victim.id if ok else None
 
 
 def create_gateway_deployment(server_config, n_replicas: int,
@@ -814,8 +1163,6 @@ def create_gateway_deployment(server_config, n_replicas: int,
     their own port — on a multi-core host the device calls and HTTP
     handling overlap across replicas; process-per-replica layouts can
     point the same gateway at remote ports instead (add_replica)."""
-    import dataclasses
-
     from predictionio_tpu.workflow.create_server import create_server
 
     if n_replicas < 1:
@@ -834,4 +1181,5 @@ def create_gateway_deployment(server_config, n_replicas: int,
     gateway = Gateway(gateway_config)
     server = AppServer(gateway.router, gateway_config.ip,
                        gateway_config.port, server_name="gateway")
-    return GatewayDeployment(gateway, server, replicas)
+    return GatewayDeployment(gateway, server, replicas,
+                             server_config=server_config)

@@ -93,13 +93,34 @@ class ReplicaRegistry:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._instance_id: str | None = None
+        #: monotonically increasing: replicas can be removed (autoscaler
+        #: scale-down, doctor eviction), so len() would recycle seqs
+        self._next_seq = 0
 
     # -- membership ---------------------------------------------------------
     def add(self, host: str, port: int) -> Replica:
         with self.lock:
-            r = Replica(host=host, port=port, seq=len(self._replicas))
+            r = Replica(host=host, port=port, seq=self._next_seq)
+            self._next_seq += 1
             self._replicas.append(r)
             return r
+
+    def find(self, replica_id: str) -> Replica | None:
+        with self.lock:
+            for r in self._replicas:
+                if r.id == replica_id:
+                    return r
+            return None
+
+    def remove(self, replica_id: str) -> Replica | None:
+        """Drop a replica from membership (no more routing, no more
+        probes). Returns the removed Replica, or None if unknown."""
+        with self.lock:
+            for i, r in enumerate(self._replicas):
+                if r.id == replica_id:
+                    del self._replicas[i]
+                    return r
+            return None
 
     def replicas(self) -> list[Replica]:
         with self.lock:
@@ -183,54 +204,94 @@ class ReplicaRegistry:
         except (OSError, ValueError):
             return None
 
+    def check_replica(self, r: Replica) -> bool:
+        """Probe ONE replica and advance its state machine (the probe
+        runs outside the lock — it blocks on the network; the
+        transition applies under it). Shared by the sweep and by
+        targeted recovery checks (a restarted replica gets probed alone
+        instead of paying a whole-fleet sweep). Returns probe success.
+        No-op on draining replicas — including ones that STARTED
+        draining mid-probe: a scale-down's graceful drain must never be
+        resurrected to ``healthy`` by a concurrent sweep."""
+        if r.state == "draining":
+            return False
+        status = self.probe(r)
+        changed_instance = None
+        with self.lock:
+            if r.state == "draining":
+                # mark_draining raced our probe: the drain decision wins
+                return status is not None
+            if status is not None:
+                _HEALTH_CHECKS.inc(result="ok")
+                if r.state != "healthy":
+                    logger.info("replica %s recovered (%s -> healthy)",
+                                r.id, r.state)
+                r.state = "healthy"
+                r.consecutive_failures = 0
+                iid = status.get("engineInstanceId")
+                if isinstance(iid, str):
+                    r.instance_id = iid
+                    if self._instance_id != iid:
+                        changed_instance = iid
+                        self._instance_id = iid
+            else:
+                _HEALTH_CHECKS.inc(result="fail")
+                r.consecutive_failures += 1
+                if r.consecutive_failures >= self.down_after:
+                    if r.state != "down":
+                        logger.warning("replica %s is down "
+                                       "(%d consecutive failed probes)",
+                                       r.id, r.consecutive_failures)
+                    r.state = "down"
+                else:
+                    if r.state == "healthy":
+                        logger.warning("replica %s is suspect", r.id)
+                    r.state = "suspect"
+        if self.on_probe_result is not None:
+            self.on_probe_result(r, status is not None)
+        if changed_instance is not None and self.on_instance_change:
+            # a redeploy swapped the engine instance: stale cached
+            # answers must go (the cache key carries the id, but
+            # dropping them bounds memory and the status page's lie)
+            self.on_instance_change(changed_instance)
+        return status is not None
+
     def check_once(self) -> None:
         """One sweep: probe every non-draining replica and advance its
-        state machine. Probes run outside the lock (they block on the
-        network); transitions apply under it."""
+        state machine, then refresh the per-state gauge."""
         for r in self.replicas():
-            if r.state == "draining":
-                continue
-            status = self.probe(r)
-            changed_instance = None
-            with self.lock:
-                if status is not None:
-                    _HEALTH_CHECKS.inc(result="ok")
-                    if r.state != "healthy":
-                        logger.info("replica %s recovered (%s -> healthy)",
-                                    r.id, r.state)
-                    r.state = "healthy"
-                    r.consecutive_failures = 0
-                    iid = status.get("engineInstanceId")
-                    if isinstance(iid, str):
-                        r.instance_id = iid
-                        if self._instance_id != iid:
-                            changed_instance = iid
-                            self._instance_id = iid
-                else:
-                    _HEALTH_CHECKS.inc(result="fail")
-                    r.consecutive_failures += 1
-                    if r.consecutive_failures >= self.down_after:
-                        if r.state != "down":
-                            logger.warning("replica %s is down "
-                                           "(%d consecutive failed probes)",
-                                           r.id, r.consecutive_failures)
-                        r.state = "down"
-                    else:
-                        if r.state == "healthy":
-                            logger.warning("replica %s is suspect", r.id)
-                        r.state = "suspect"
-            if self.on_probe_result is not None:
-                self.on_probe_result(r, status is not None)
-            if changed_instance is not None and self.on_instance_change:
-                # a redeploy swapped the engine instance: stale cached
-                # answers must go (the cache key carries the id, but
-                # dropping them bounds memory and the status page's lie)
-                self.on_instance_change(changed_instance)
+            self.check_replica(r)
         counts = {s: 0 for s in STATES}
         for r in self.replicas():
             counts[r.state] += 1
         for s, n in counts.items():
             _REPLICA_STATES.set(n, state=s)
+
+    # -- per-replica graceful drain (autoscaler scale-down path) ------------
+    def mark_draining(self, replica: Replica) -> None:
+        """Terminal-state a single replica: routing skips it immediately
+        (acquire_least_outstanding only considers healthy/suspect/down),
+        the health sweep stops probing it, in-flight requests finish."""
+        with self.lock:
+            replica.state = "draining"
+
+    def wait_drained(self, replica: Replica, timeout_sec: float = 10.0
+                     ) -> bool:
+        """Wait for one draining replica's outstanding count to reach
+        zero. True when fully drained inside the budget."""
+        import time
+
+        deadline = time.monotonic() + timeout_sec
+        while time.monotonic() < deadline:
+            with self.lock:
+                if replica.outstanding == 0:
+                    return True
+            time.sleep(0.02)
+        with self.lock:
+            leftover = replica.outstanding
+        logger.warning("replica %s drain timed out with %d outstanding",
+                       replica.id, leftover)
+        return False
 
     # -- graceful drain (undeploy path) -------------------------------------
     def drain(self, timeout_sec: float = 10.0) -> bool:

@@ -43,7 +43,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--traces", type=int, default=3, metavar="K",
         help="slowest retained traces to fold in as leads (default 3)")
     p_doc.add_argument("--json", action="store_true",
-                       help="raw findings JSON instead of the report")
+                       help="machine-readable JSON (findings + actions "
+                            "taken) instead of the report")
+    p_doc.add_argument(
+        "--fix", action="store_true",
+        help="act on mechanical findings: restart a DOWN replica "
+             "through the deployment handle (evict it if restart is "
+             "unsupported/fails), reset stuck-open replica breakers and "
+             "device routes — via the gateway's POST /fleet/actions")
+    p_doc.add_argument(
+        "--dry-run", action="store_true",
+        help="with --fix: report what each action WOULD do without "
+             "acting (the gateway validates and logs, nothing changes)")
     p_doc.set_defaults(func=cmd_doctor)
 
     # -- bench regression diff (tools/bench_compare.py) ----------------------
@@ -193,6 +204,30 @@ def build_parser() -> argparse.ArgumentParser:
     p_deploy.add_argument(
         "--cache-size", type=int, default=1024, metavar="N",
         help="gateway query-result cache capacity (entries)")
+    # -- autoscaling (serve/autoscaler.py) ----------------------------------
+    p_deploy.add_argument(
+        "--max-replicas", type=int, default=None, metavar="N",
+        help="enable the SLO-driven autoscaler: scale up to N replicas "
+             "on fast-window SLO burn or sustained queue growth, scale "
+             "down after sustained idle (requires history, "
+             "PIO_HISTORY_INTERVAL_S > 0)")
+    p_deploy.add_argument(
+        "--min-replicas", type=int, default=None, metavar="N",
+        help="autoscaler floor (default: --replicas)")
+    p_deploy.add_argument(
+        "--scale-interval", type=float, default=None, metavar="SEC",
+        help="autoscaler control-tick period (default: the history "
+             "sampler interval)")
+    p_deploy.add_argument(
+        "--scale-up-cooldown", type=float, default=30.0, metavar="SEC",
+        help="seconds after a scale-up before the next may fire")
+    p_deploy.add_argument(
+        "--scale-down-cooldown", type=float, default=180.0, metavar="SEC",
+        help="seconds after the LAST action (either direction — flap "
+             "damping) before a scale-down may fire")
+    p_deploy.add_argument(
+        "--idle-ticks", type=int, default=6, metavar="N",
+        help="consecutive idle control ticks before a scale-down")
     p_deploy.set_defaults(func=cmd_deploy)
 
     p_undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -527,7 +562,10 @@ def cmd_deploy(args) -> int:
         event_server_port=args.event_server_port,
         accesskey=args.accesskey,
     )
-    if getattr(args, "replicas", 1) > 1:
+    if getattr(args, "replicas", 1) > 1 or getattr(args, "max_replicas",
+                                                   None):
+        # an autoscaled deploy needs the gateway topology even when it
+        # starts from one replica
         return _deploy_gateway(args, config)
     try:
         server, service = create_server(config)
@@ -608,6 +646,31 @@ def _deploy_gateway(args, config) -> int:
         print(f"[ERROR] {e}", file=sys.stderr)
         return 1
     dep.start()
+    scaler = None
+    if getattr(args, "max_replicas", None):
+        from predictionio_tpu.serve.autoscaler import (
+            Autoscaler,
+            AutoscalerConfig,
+        )
+
+        min_replicas = args.min_replicas or args.replicas
+        try:
+            scaler = Autoscaler(dep.gateway, dep, AutoscalerConfig(
+                min_replicas=min_replicas,
+                max_replicas=args.max_replicas,
+                interval_s=args.scale_interval,
+                scale_up_cooldown_s=args.scale_up_cooldown,
+                scale_down_cooldown_s=args.scale_down_cooldown,
+                idle_ticks=args.idle_ticks,
+            ))
+        except ValueError as e:
+            print(f"[ERROR] {e}", file=sys.stderr)
+            dep.stop()
+            return 1
+        scaler.start()
+        print(f"[INFO] Autoscaler active: {min_replicas}-"
+              f"{args.max_replicas} replicas, control tick every "
+              f"{scaler.interval_s():g}s.")
     replica_ports = ", ".join(str(srv.port) for srv, _ in dep.replicas)
     print(f"[INFO] Engine is deployed: gateway at "
           f"http://{args.ip}:{dep.port} over {args.replicas} replicas "
@@ -622,6 +685,8 @@ def _deploy_gateway(args, config) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if scaler is not None:
+            scaler.stop()
         clear_pidfile(pidfile.stem)
         dep.stop()
     print("[INFO] Gateway and replicas shut down.")
@@ -663,11 +728,89 @@ def _fleet_members(base_url: str, status: dict | None) -> list[dict]:
     return fleet.collect(targets)
 
 
+def _doctor_fix(base: str, findings: list, dry_run: bool,
+                is_gateway: bool) -> list[dict]:
+    """Apply each finding's ``action`` hint through the gateway's
+    ``POST /fleet/actions`` (deduplicated — a DOWN replica with an open
+    breaker restarts once). A failed/unsupported restart escalates to
+    eviction, so a dead replica the deployment can't respawn still
+    leaves the routing tables. Against a bare (gateway-less) query
+    server only ``reset_device_route`` is actionable, and it goes to
+    the server's own ``/admin/device-route/reset``. Returns one result
+    doc per attempt."""
+    from predictionio_tpu.obs.fleet import post_json
+
+    results: list[dict] = []
+    seen: set[tuple] = set()
+
+    def from_response(kind: str, replica: str, got, ok_doc=None) -> dict:
+        if got is None:
+            return {"action": kind, "replica": replica,
+                    "result": "error", "detail": f"{base} unreachable"}
+        http_status, body = got
+        if ok_doc is not None and http_status == 200:
+            return ok_doc(body)
+        if "action" in body:  # the structured /fleet/actions contract
+            return {"action": body.get("action", kind),
+                    "replica": body.get("replica", replica),
+                    "result": body.get("result", "error"),
+                    "detail": body.get("detail", f"HTTP {http_status}")}
+        message = body.get("message", f"HTTP {http_status}")
+        # only claim "disabled" when the server actually said so — a
+        # generic 404 (e.g. a target without the route) stays an error
+        result = ("disabled" if "PIO_FLEET_ACTIONS" in message
+                  else "error")
+        return {"action": kind, "replica": replica, "result": result,
+                "detail": message}
+
+    def apply(kind: str, replica: str) -> dict:
+        if not is_gateway:
+            if kind != "reset_device_route":
+                return {"action": kind, "replica": replica,
+                        "result": "unsupported",
+                        "detail": "needs a gateway front door "
+                                  "(replica lifecycle lives there)"}
+            if dry_run:
+                return {"action": kind, "replica": replica,
+                        "result": "dry_run",
+                        "detail": "would reset the device-route "
+                                  "breaker"}
+            got = post_json(f"{base}/admin/device-route/reset", {})
+            return from_response(
+                kind, replica, got,
+                ok_doc=lambda body: {
+                    "action": kind, "replica": replica, "result": "ok",
+                    "detail": f"device route {body.get('previous')} -> "
+                              f"{body.get('state')}"})
+        got = post_json(f"{base}/fleet/actions",
+                        {"action": kind, "replica": replica,
+                         "dryRun": dry_run})
+        return from_response(kind, replica, got)
+
+    for f in findings:
+        action = f.get("action")
+        if not action:
+            continue
+        key = (action["kind"], action["replica"])
+        if key in seen:
+            continue
+        seen.add(key)
+        out = apply(action["kind"], action["replica"])
+        results.append(out)
+        if is_gateway and action["kind"] == "restart_replica" and \
+                out["result"] in ("unsupported", "error", "unknown"):
+            # escalation: can't respawn it → at least stop routing to it
+            results.append(apply("evict_replica", action["replica"]))
+    return results
+
+
 def cmd_doctor(args) -> int:
     """``pio doctor``: pull the fleet's health surfaces (gateway status,
     per-replica statuses, /debug/slo, /debug/traces) and print a ranked
-    triage report. Exit 0 = healthy, 1 = critical findings, 2 = the
-    front door is unreachable."""
+    triage report; ``--fix`` escalates from naming offenders to acting
+    on them (restart/evict/reset via the gateway's remediation surface,
+    ``--dry-run`` to rehearse). Exit 0 = healthy, 1 = critical findings
+    (as found, before any fix), 2 = the front door is unreachable."""
     import json as _json
 
     from predictionio_tpu.obs import fleet
@@ -687,10 +830,16 @@ def cmd_doctor(args) -> int:
     findings = fleet.diagnose(
         status if is_gateway else None, members, slo_state,
         traces[: args.traces])
+    rc = 1 if any(f["severity"] == "critical" for f in findings) else 0
+    actions: list[dict] = []
+    if getattr(args, "fix", False) and findings:
+        actions = _doctor_fix(base, findings,
+                              dry_run=getattr(args, "dry_run", False),
+                              is_gateway=is_gateway)
     if args.json:
-        print(_json.dumps({"url": base, "findings": findings}, indent=2))
-        return 1 if any(f["severity"] == "critical" for f in findings) \
-            else 0
+        print(_json.dumps({"url": base, "findings": findings,
+                           "actions": actions}, indent=2))
+        return rc
     n_replicas = len(status.get("replicas", [])) if is_gateway else 1
     print(f"[INFO] pio doctor @ {base} — "
           f"{'gateway over ' + str(n_replicas) + ' replica(s)' if is_gateway else 'single query server'}")
@@ -704,7 +853,10 @@ def cmd_doctor(args) -> int:
     for f in findings:
         print(f"{marks.get(f['severity'], '[INFO]')} {f['subject']}: "
               f"{f['detail']}")
-    return 1 if any(f["severity"] == "critical" for f in findings) else 0
+    for a in actions:
+        print(f"[FIX]  {a['action']} {a['replica']}: "
+              f"{a['result']} — {a['detail']}")
+    return rc
 
 
 def cmd_bench_compare(args) -> int:
@@ -1235,6 +1387,13 @@ def _cmd_status_fleet(args) -> int:
             print(f"[INFO]   replica {rep.get('replica')}: "
                   f"{rep.get('state')}, breaker {rep.get('breaker')}, "
                   f"{rep.get('outstanding')} outstanding")
+        scaler = status.get("autoscaler")
+        if scaler:
+            last = scaler.get("lastDecision") or {}
+            print(f"[INFO] autoscaler: {scaler.get('minReplicas')}-"
+                  f"{scaler.get('maxReplicas')} replicas, last decision "
+                  f"{last.get('action')} ({last.get('reason')}) after "
+                  f"{scaler.get('ticks')} tick(s)")
         cache = status.get("cache") or {}
         if cache:
             print(f"[INFO] cache: {cache}")

@@ -407,8 +407,31 @@ class QueryService:
             "GET", "/plugins.json",
             lambda req: (200, self.plugin_context.to_json()),
         )
+        r.add("POST", "/admin/device-route/reset",
+              self.post_device_route_reset)
         add_metrics_route(r)
         return r
+
+    def post_device_route_reset(self, request: Request):
+        """Operator reset of a stuck-open device-route breaker — the
+        replica-side half of ``pio doctor --fix`` (the gateway forwards
+        its ``reset_device_route`` action here). Closing the route also
+        clears the consecutive-failure count, so the next live tick
+        takes the device path again immediately instead of waiting out
+        the synthetic-probe cooldown."""
+        from predictionio_tpu.serve.gateway import fleet_actions_enabled
+
+        if not fleet_actions_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/faults contract
+            raise HTTPError(404,
+                            "fleet actions disabled (PIO_FLEET_ACTIONS=0)")
+        previous = self.device_route.state
+        self.device_route.record_success()
+        logger.warning("device-route breaker reset by operator "
+                       "(%s -> closed)", previous)
+        return 200, {"reset": True, "previous": previous,
+                     "state": self.device_route.state}
 
     def get_status(self, request: Request):
         """Server status: HTML when the client asks for it (a browser's

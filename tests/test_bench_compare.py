@@ -141,3 +141,62 @@ def test_checked_in_bench_captures_load():
         assert flat, f"{path.name} flattened to nothing"
         loaded += 1
     assert loaded >= 1
+
+
+# -- tier-1 regression gate: --dry-run headline vs checked-in baseline --------
+#
+# ROADMAP item 5 asks for `pio bench-compare` wired into tier-1. Real
+# perf numbers need hardware, but the headline doc's KEY SCHEMA is the
+# perf contract the captures/driver/compare tooling all parse — so the
+# gate pins each bench entrypoint's --dry-run doc against a checked-in
+# baseline: a dropped or renamed perf key (or metric) fails here first,
+# not three PRs later when a capture silently loses a series.
+
+
+def _dry_run_headline(script: str) -> dict:
+    import subprocess
+    import sys
+
+    root = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(root / script), "--dry-run"],
+        capture_output=True, text=True, cwd=root, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+@pytest.mark.parametrize("script,baseline", [
+    ("bench.py", "bench_dryrun_baseline.json"),
+    ("bench_serving.py", "bench_serving_dryrun_baseline.json"),
+])
+def test_dry_run_headline_matches_checked_in_baseline(script, baseline):
+    base_doc = json.loads((FIXTURES / baseline).read_text())
+    cand_doc = _dry_run_headline(script)
+    # the whole key schema is the contract: top-level shape, metric
+    # name, and every extra key (nulls included — they become real
+    # series on hardware runs and capture tooling indexes them)
+    assert cand_doc["metric"] == base_doc["metric"]
+    assert sorted(cand_doc) == sorted(base_doc)
+    assert sorted(cand_doc["extra"]) == sorted(base_doc["extra"]), (
+        f"{script} --dry-run extra keys drifted from "
+        f"tests/fixtures/{baseline} — if the change is intentional, "
+        "regenerate the fixture from the new --dry-run output")
+    # and the pio bench-compare face agrees: no regressions, no
+    # removed keys between baseline and candidate
+    result = compare(flatten_headline(base_doc),
+                     flatten_headline(cand_doc))
+    assert result["regressions"] == []
+    assert result["removed"] == []
+
+
+def test_bench_compare_gate_cli_face(tmp_path):
+    """`pio bench-compare <fixture> <fresh dry-run>` exits 0 — the exact
+    invocation a CI gate runs against a real capture."""
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_dry_run_headline("bench.py")))
+    from predictionio_tpu.tools.cli import build_parser, cmd_bench_compare
+
+    args = build_parser().parse_args(
+        ["bench-compare", str(FIXTURES / "bench_dryrun_baseline.json"),
+         str(cand)])
+    assert cmd_bench_compare(args) == 0

@@ -733,3 +733,142 @@ def test_status_fleet_cli(memory_storage, monkeypatch, capsys):
         dep.stop()
         history.reset()
         slo.reset()
+
+
+def test_diagnose_attaches_machine_actionable_hints():
+    """Findings with a mechanical fix carry the exact action payload
+    `pio doctor --fix` POSTs to /fleet/actions; judgment-only findings
+    (SLO breaches, outliers) stay hint-free."""
+    gateway_status = {"role": "gateway", "replicas": [
+        {"replica": "127.0.0.1:8002", "state": "down",
+         "breaker": "open", "consecutiveFailures": 4}]}
+    members = [{"instance": "127.0.0.1:8003", "role": "replica",
+                "ok": True, "metricsText": "", "error": None,
+                "status": {"p99ServingSec": 0.01, "requestCount": 5,
+                           "errorCount": 0,
+                           "batching": {"deviceRouteBreaker": "open"}}}]
+    slo_state = {"slos": [{
+        "name": "query_availability",
+        "burnRates": {"fast": 310.0, "slow": 290.0},
+        "burnThreshold": 14.4, "breached": True, "description": "d"}]}
+    findings = fleet.diagnose(gateway_status, members, slo_state, [])
+    by_kind = {}
+    for f in findings:
+        if "action" in f:
+            by_kind[f["action"]["kind"]] = f["action"]["replica"]
+    assert by_kind == {
+        "restart_replica": "127.0.0.1:8002",
+        "reset_breaker": "127.0.0.1:8002",
+        "reset_device_route": "127.0.0.1:8003",
+    }
+    slo_findings = [f for f in findings if f["subject"].startswith("SLO")]
+    assert slo_findings and all("action" not in f for f in slo_findings)
+
+
+def test_doctor_json_and_fix_formats(memory_storage, monkeypatch, capsys):
+    """`pio doctor --json` is the CI/chaos-e2e contract: url + findings
+    + actions, parseable in every mode — plain triage (actions empty),
+    --fix --dry-run (rehearsed, nothing changes), --fix (applied). The
+    text report prints the same actions as [FIX] lines."""
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.serve.gateway import (
+        GatewayConfig,
+        create_gateway_deployment,
+    )
+    from predictionio_tpu.tools.cli import build_parser, cmd_doctor
+    from predictionio_tpu.workflow.create_server import ServerConfig
+
+    history.reset()
+    slo.reset()
+    monkeypatch.setenv("PIO_HISTORY_INTERVAL_S", "60")
+    seed_and_train(memory_storage)
+    dep = create_gateway_deployment(
+        ServerConfig(ip="127.0.0.1", port=0), 2,
+        GatewayConfig(ip="127.0.0.1", port=0, health_interval_sec=60.0,
+                      cache_ttl_sec=0.0, cache_max_entries=0,
+                      hedge=False, deadline_sec=5.0))
+    dep.start()
+    try:
+        dead_srv, _svc = dep.replicas[1]
+        dead_id = f"127.0.0.1:{dead_srv.port}"
+        dead_srv.stop()
+        for _ in range(4):
+            dep.gateway.registry.check_once()
+
+        def run(*extra):
+            args = build_parser().parse_args(
+                ["doctor", "--url", f"http://127.0.0.1:{dep.port}",
+                 *extra])
+            rc = cmd_doctor(args)
+            return rc, capsys.readouterr().out
+
+        # plain --json: findings only, actions explicitly empty
+        rc, out = run("--json")
+        doc = json.loads(out)
+        assert rc == 1
+        assert set(doc) == {"url", "findings", "actions"}
+        assert doc["actions"] == []
+        assert any(f.get("action", {}).get("kind") == "restart_replica"
+                   for f in doc["findings"])
+        # --fix --dry-run: rehearsed, replica stays down
+        rc, out = run("--fix", "--dry-run", "--json")
+        doc = json.loads(out)
+        assert [a["result"] for a in doc["actions"]].count("dry_run") \
+            >= 1
+        assert dep.gateway.registry.find(dead_id).state == "down"
+        # --fix for real, text mode: [FIX] line + the replica recovers
+        rc, out = run("--fix")
+        assert f"[FIX]  restart_replica {dead_id}: ok" in out
+        dep.gateway.registry.check_once()
+        assert dep.gateway.registry.find(dead_id).state == "healthy"
+        # healthy fleet: nothing critical left, no actions, exit 0
+        # (--traces 0 keeps slow-trace info leads out of the way)
+        rc, out = run("--json", "--traces", "0")
+        doc = json.loads(out)
+        assert rc == 0 and doc["actions"] == []
+        assert all(f["severity"] == "info" for f in doc["findings"])
+    finally:
+        dep.stop()
+        history.reset()
+        slo.reset()
+
+
+def test_doctor_fix_device_route_on_bare_query_server(memory_storage,
+                                                      monkeypatch, capsys):
+    """Against a gateway-less query server, `pio doctor --fix` resets a
+    tripped device route via the server's own /admin/device-route/reset
+    (there is no /fleet/actions there), and reports honestly instead of
+    claiming the surface is disabled."""
+    from test_query_server import seed_and_train
+
+    from predictionio_tpu.tools.cli import build_parser, cmd_doctor
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    history.reset()
+    slo.reset()
+    monkeypatch.setenv("PIO_HISTORY_INTERVAL_S", "60")
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        for _ in range(service.device_route.failures_to_open):
+            service.device_route.record_failure()
+        assert service.device_route.state == "open"
+        args = build_parser().parse_args(
+            ["doctor", "--url", f"http://127.0.0.1:{srv.port}",
+             "--fix", "--json"])
+        cmd_doctor(args)
+        doc = json.loads(capsys.readouterr().out)
+        fixes = [a for a in doc["actions"]
+                 if a["action"] == "reset_device_route"]
+        assert fixes and fixes[0]["result"] == "ok", doc["actions"]
+        assert service.device_route.state == "closed"
+    finally:
+        srv.stop()
+        service.shutdown()
+        history.reset()
+        slo.reset()

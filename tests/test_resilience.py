@@ -563,9 +563,39 @@ def test_admission_gate_disabled_and_bounds():
             with g2.admit():
                 pass
         assert ei.value.status == 429
-        assert ei.value.extra["retryAfterSec"] == 2.0
+        # the shed hint carries bounded random jitter (ISSUE 11): base
+        # <= hint <= base * (1 + PIO_RETRY_JITTER), so synchronized
+        # clients spread their retries instead of herding
+        assert 2.0 <= ei.value.extra["retryAfterSec"] <= 3.0
     with g2.admit():
         pass
+    # Overloaded itself stays an exact carrier of whatever it is given
+    assert Overloaded(2.0, "t2").extra["retryAfterSec"] == 2.0
+
+
+def test_retry_after_jitter_bounds_seed_and_disable(monkeypatch):
+    from predictionio_tpu.resilience.admission import (
+        reseed_jitter,
+        retry_after_jitter,
+    )
+
+    monkeypatch.delenv("PIO_FAULTS_SEED", raising=False)
+    for _ in range(50):
+        v = retry_after_jitter(2.0)
+        assert 2.0 <= v <= 3.0
+    # PIO_RETRY_JITTER tunes the band; 0 restores the constant
+    monkeypatch.setenv("PIO_RETRY_JITTER", "0.1")
+    assert all(2.0 <= retry_after_jitter(2.0) <= 2.2 for _ in range(20))
+    monkeypatch.setenv("PIO_RETRY_JITTER", "0")
+    assert retry_after_jitter(2.0) == 2.0
+    monkeypatch.delenv("PIO_RETRY_JITTER", raising=False)
+    # seeded: the same schedule sheds the same Retry-After sequence —
+    # the chaos suite's reproducibility contract extends to backoff
+    monkeypatch.setenv("PIO_FAULTS_SEED", "99")
+    reseed_jitter()
+    first = [retry_after_jitter(1.0) for _ in range(5)]
+    reseed_jitter()
+    assert [retry_after_jitter(1.0) for _ in range(5)] == first
 
 
 def test_oversized_body_rejected_413(event_server, monkeypatch):
