@@ -80,6 +80,40 @@ def synthesize_ml20m(seed: int = 0):
     return ui, ii, r, 138_493, 26_744
 
 
+#: The headline metric name — one definition shared by sections,
+#: progress flushes and the final doc.
+HEADLINE_METRIC = "ml20m_als_rank10_iterations_per_sec"
+
+#: Workload scales. ``full`` is the publication scale (the values every
+#: BENCH_r0N capture reports); ``dry`` shrinks every section to run in
+#: seconds on a CPU container — the sectioned/resumable machinery and the
+#: key schema are identical, only shapes/iterations/repeats differ, so a
+#: wall-clock-killed `timeout 60 python bench.py --scale dry` exercises
+#: exactly the partial-capture story BENCH_r06 needed. Select with
+#: ``--scale`` or ``PIO_BENCH_SCALE``.
+SCALES: dict[str, dict] = {
+    "full": dict(
+        ml100k=(943, 1_682, 100_000), ml100k_iters=20, ml100k_repeats=2,
+        ml20m=(138_493, 26_744, 20_000_000), ml20m_iters=20,
+        ml20m_repeats=4, rank64_iters=8, rank64_repeats=2,
+        two_tower=dict(nu=138_493, ni=26_744, nnz=2_000_000, batch=4096,
+                       steps=2000, samples=5, b16k=True, rowwise=True),
+        serving=True, host_baseline=True,
+    ),
+    "dry": dict(
+        ml100k=(300, 120, 4_000), ml100k_iters=4, ml100k_repeats=1,
+        ml20m=(1_200, 400, 24_000), ml20m_iters=4,
+        ml20m_repeats=1, rank64_iters=2, rank64_repeats=1,
+        two_tower=dict(nu=1_500, ni=400, nnz=20_000, batch=256,
+                       steps=20, samples=2, b16k=False, rowwise=False),
+        # the serving bench spins up real servers and the host baseline
+        # times a minutes-long numpy solve: both are skipped at dry
+        # scale (vs_baseline falls back to the assumed figure)
+        serving=False, host_baseline=False,
+    ),
+}
+
+
 # --------------------------------------------------------------------------
 # FLOP model (executed work, including bucket padding)
 # --------------------------------------------------------------------------
@@ -382,13 +416,14 @@ def two_tower_adam_bytes_per_step(p, n_users: int, n_items: int) -> float:
     return adam_bytes_per_step(p, n_users, n_items)
 
 
-def bench_two_tower(ctx) -> dict:
+def bench_two_tower(ctx, tt_cfg: dict | None = None) -> dict:
     """Two-tower retrieval steps/sec: in-batch sampled softmax, batch 4096,
     ML-20M-scale entity counts (the 5th BASELINE config). Times the fused
     training dispatch directly, blocking on its SCALAR loss — the product
     train also exports ~21 MB of serving corpora, whose readback through a
     tunneled chip's slow downlink swamped delta-timed measurements with
-    seconds of jitter."""
+    seconds of jitter. ``tt_cfg`` (a SCALES two_tower entry) shrinks the
+    workload for the dry scale; the default is the full-scale config."""
     import jax
 
     from predictionio_tpu.models.two_tower import (
@@ -397,8 +432,10 @@ def bench_two_tower(ctx) -> dict:
         init_params,
     )
 
-    nu, ni = 138_493, 26_744  # ML-20M entity counts (synthesize_ml20m)
-    ui, ii, _r = synthesize(nu, ni, 2_000_000)
+    cfg = tt_cfg or SCALES["full"]["two_tower"]
+    # full scale: ML-20M entity counts (synthesize_ml20m)
+    nu, ni = cfg["nu"], cfg["ni"]
+    ui, ii, _r = synthesize(nu, ni, cfg["nnz"])
     u_all = jax.device_put(ui.astype(np.int32), ctx.replicated)
     i_all = jax.device_put(ii.astype(np.int32), ctx.replicated)
     key = jax.random.PRNGKey(0)
@@ -424,9 +461,9 @@ def bench_two_tower(ctx) -> dict:
             times.append(time.perf_counter() - t0)
         return sorted(times)
 
-    p = TwoTowerParams(batch_size=4096, steps=0, seed=0)
+    p = TwoTowerParams(batch_size=cfg["batch"], steps=0, seed=0)
     batch = ctx.pad_to_multiple(p.batch_size)
-    steps = 2000
+    steps = cfg["steps"]
 
     # fixed-work protocol (round-2 review; spread rationale round 5): the
     # min over 5 pinned-work samples IS the steady rate — the whole
@@ -438,7 +475,7 @@ def bench_two_tower(ctx) -> dict:
     # to satisfy (a <=15% spread target was floated in round 3 and is
     # unmeetable through a tunnel whose stalls are seconds-sized; on
     # co-located hardware the same protocol's spread collapses to noise).
-    times = timed_samples(p, steps, 5)
+    times = timed_samples(p, steps, cfg["samples"])
     dt = times[0]
     dev = ctx.mesh.devices.flat[0]
     peak = peak_flops(dev)
@@ -450,9 +487,9 @@ def bench_two_tower(ctx) -> dict:
         "two_tower_steps_per_sec": round(steps / dt, 2),  # r2/r3 continuity
         "two_tower_steps_per_sec_spread": [
             round(steps / times[-1], 2), round(steps / times[0], 2)],
-        "two_tower_batch": 4096,
+        "two_tower_batch": cfg["batch"],
         "two_tower_fixed_steps": steps,
-        "two_tower_examples_per_sec": round(steps * 4096 / dt, 0),
+        "two_tower_examples_per_sec": round(steps * cfg["batch"] / dt, 0),
         # roofline accounting (round-4 review asked where 745 steps/s
         # sits): the step is optimizer-HBM-bound, not MXU-bound — see
         # docs/perf.md §6
@@ -468,20 +505,22 @@ def bench_two_tower(ctx) -> dict:
     # -- batch 16k (auto loss policy selects the chunked CE here: it
     # engages above 1024 negatives — two_tower._DENSE_LOGITS_MAX — and
     # measured 84 vs 38 dense steps/s at this size, docs/perf.md §6)
-    p16 = TwoTowerParams(batch_size=16384, steps=0, seed=0)
-    steps16 = 500
-    t16 = timed_samples(p16, steps16, 3)[0]
-    out["two_tower_b16k_steps_per_sec"] = round(steps16 / t16, 2)
-    out["two_tower_b16k_examples_per_sec"] = round(
-        steps16 * 16384 / t16, 0)
+    if cfg["b16k"]:
+        p16 = TwoTowerParams(batch_size=16384, steps=0, seed=0)
+        steps16 = 500
+        t16 = timed_samples(p16, steps16, 3)[0]
+        out["two_tower_b16k_steps_per_sec"] = round(steps16 / t16, 2)
+        out["two_tower_b16k_examples_per_sec"] = round(
+            steps16 * 16384 / t16, 0)
 
-    # -- rowwise_adam (round 5): the step is optimizer-HBM-bound, so the
-    # [n, 1]-second-moment optimizer is the published counter — reported
-    # alongside the default-adam headline, not replacing it
-    prw = TwoTowerParams(batch_size=4096, steps=0, seed=0,
-                         optimizer="rowwise_adam")
-    trw = timed_samples(prw, steps, 3)[0]
-    out["two_tower_rowwise_steps_per_sec"] = round(steps / trw, 2)
+    if cfg["rowwise"]:
+        # -- rowwise_adam (round 5): the step is optimizer-HBM-bound, so
+        # the [n, 1]-second-moment optimizer is the published counter —
+        # reported alongside the default-adam headline, not replacing it
+        prw = TwoTowerParams(batch_size=cfg["batch"], steps=0, seed=0,
+                             optimizer="rowwise_adam")
+        trw = timed_samples(prw, steps, 3)[0]
+        out["two_tower_rowwise_steps_per_sec"] = round(steps / trw, 2)
     return out
 
 
@@ -670,143 +709,361 @@ def _check_readme_cli(paths: list[str]) -> int:
     return rc
 
 
-def _collect(metrics_snapshot: bool = False) -> dict:
-    """Run every bench section and return the headline doc. All stdout
-    writes made in here land on stderr (main() redirects them): the
-    process stdout contract is ONE final JSON line, nothing else —
-    BENCH_r01..r05 all recorded ``"parsed": null`` because stray output
-    shared stdout with the headline line."""
+class _BenchState:
+    """Shared context for the bench sections: the compute context, the
+    active scale config, lazily-synthesized datasets, and the merged
+    ``extra`` dict every section writes its keys into."""
+
+    def __init__(self, ctx, cfg: dict, extra: dict, peak):
+        self.ctx = ctx
+        self.cfg = cfg
+        self.extra = extra
+        self.peak = peak
+        self._ml100k = None
+        self._ml20m = None
+
+    def ml100k(self):
+        if self._ml100k is None:
+            nu, ni, nnz = self.cfg["ml100k"]
+            ui, ii, r = synthesize(nu, ni, nnz)
+            self._ml100k = (ui, ii, r, nu, ni)
+        return self._ml100k
+
+    def ml20m(self):
+        if self._ml20m is None:
+            nu, ni, nnz = self.cfg["ml20m"]
+            ui, ii, r = synthesize(nu, ni, nnz)
+            self._ml20m = (ui, ii, r, nu, ni)
+        return self._ml20m
+
+
+def _fl_iter(state: _BenchState, rank: int) -> float:
+    """Model FLOPs of one ALS iteration at the active scale's ML-20M
+    shape, via whichever solver the auto gate picks (side effect at
+    rank 10 on the bucket path: the ``pad_ratio`` diagnostic)."""
+    from predictionio_tpu.models import als_dense
     from predictionio_tpu.models.als import ALSParams
-    from predictionio_tpu.parallel.mesh import compute_context
 
-    ctx = compute_context()
-    dev = ctx.mesh.devices.flat[0]
-    peak = peak_flops(dev)
-    extra: dict = {"device": getattr(dev, "device_kind", str(dev)),
-                   "n_devices": int(ctx.mesh.devices.size)}
+    ui, ii, r, nu, ni = state.ml20m()
+    if als_dense.auto_pick(state.ctx, nu, ni, r):
+        return flops_per_iteration_dense(nu, ni, rank)
+    p = ALSParams(rank=rank)
+    shapes_u = _padded_shapes(ui, p, state.ctx)
+    shapes_i = _padded_shapes(ii, p, state.ctx)
+    if rank == 10:
+        pad = sum(n * k for n, k in shapes_u) / max(len(r), 1)
+        state.extra["pad_ratio"] = round(pad, 2)
+    return flops_per_iteration(shapes_u, shapes_i, rank)
 
-    # --- ML-100K continuity number (rank 10 / 20 iters, template default)
-    ui, ii, r, nu, ni = synthesize_ml100k()
-    ml100k_ips, _ = bench_als(
-        ctx, ui, ii, r, nu, ni, rank=10, iters=20, repeats=2)
-    extra["ml100k_als_rank10_iter_per_sec"] = round(ml100k_ips, 3)
 
-    # --- ML-20M north star (rank 10 / 20 iterations, template defaults)
-    ui, ii, r, nu, ni = synthesize_ml20m()
-    # cold probe FIRST (phase-instrumented, cache-cleared): what a
-    # first-ever train pays. Run before the warm/steady sections — a
-    # cold train issued after heavy device churn measured pathological
-    # solve times (39 s vs 0.7 s fresh) that say nothing about the
-    # product path. It also populates the A-cache the warm runs hit.
-    try:
-        extra.update(bench_als_cold(ctx, ui, ii, r, nu, ni, rank=10,
-                                    iters=20))
-    except Exception as e:
-        extra["cold_bench_error"] = repr(e)
+def _section_ml100k(state: _BenchState) -> None:
+    """ML-100K continuity number (rank 10, template default)."""
+    ui, ii, r, nu, ni = state.ml100k()
+    ips, _ = bench_als(state.ctx, ui, ii, r, nu, ni, rank=10,
+                       iters=state.cfg["ml100k_iters"],
+                       repeats=state.cfg["ml100k_repeats"])
+    state.extra["ml100k_als_rank10_iter_per_sec"] = round(ips, 3)
+
+
+def _section_ml20m_cold(state: _BenchState) -> None:
+    """Cold probe FIRST (phase-instrumented, cache-cleared): what a
+    first-ever train pays. Runs before the warm/steady sections — a cold
+    train issued after heavy device churn measured pathological solve
+    times (39 s vs 0.7 s fresh) that say nothing about the product path.
+    It also populates the A-cache the warm runs hit."""
+    ui, ii, r, nu, ni = state.ml20m()
+    state.extra.update(bench_als_cold(
+        state.ctx, ui, ii, r, nu, ni, rank=10,
+        iters=state.cfg["ml20m_iters"]))
+
+
+def _section_ml20m_warm(state: _BenchState) -> None:
+    """The ML-20M north star (headline) + steady rate + warm phases +
+    solver identification. Unguarded: a failure here IS a failed bench."""
+    from predictionio_tpu.models import als_dense
     from predictionio_tpu.obs import device as device_obs
 
+    ui, ii, r, nu, ni = state.ml20m()
     # drop the ML-100K + cold-probe dispatches from the rank-10 MFU
     # window: mfu_rank10 (and the live gauge the acceptance compares it
     # to) should reflect the warm ML-20M solve rate, not a flops-free
     # small-shape prelude
     device_obs.reset_program_window("als_dense_rank10")
-    ml20m_ips, _, steady = bench_als(
-        ctx, ui, ii, r, nu, ni, rank=10, iters=20, steady=True, repeats=4)
+    ips, _, steady = bench_als(
+        state.ctx, ui, ii, r, nu, ni, rank=10,
+        iters=state.cfg["ml20m_iters"], steady=True,
+        repeats=state.cfg["ml20m_repeats"])
+    state.extra[HEADLINE_METRIC] = round(ips, 3)
     if steady > 0:
-        extra["ml20m_rank10_steady_iter_per_sec"] = round(steady, 3)
-    from predictionio_tpu.models import als_dense
-
+        state.extra["ml20m_rank10_steady_iter_per_sec"] = round(steady, 3)
     # warm-path phase breakdown: the headline's repeated trains hit the
     # densified-A cache (same ratings → same fingerprint), so the warm
     # train is fingerprint + solve + readback
     for k, v in als_dense.last_train_phases.items():
-        extra[f"train_warm_{k}" if k != "cache_hit"
-              else "dense_cache_hit"] = v
-
-    dense = als_dense.auto_pick(ctx, nu, ni, r)
-    extra["als_solver"] = "dense" if dense else "bucket"
-    if dense:
-        fl10 = flops_per_iteration_dense(nu, ni, 10)
-        fl64 = flops_per_iteration_dense(nu, ni, 64)
-    else:
-        p10, p64 = ALSParams(rank=10), ALSParams(rank=64)
-        fl10 = flops_per_iteration(
-            _padded_shapes(ui, p10, ctx), _padded_shapes(ii, p10, ctx), 10)
-        fl64 = flops_per_iteration(
-            _padded_shapes(ui, p64, ctx), _padded_shapes(ii, p64, ctx), 64)
-        pad = sum(
-            n * k for n, k in _padded_shapes(ui, p10, ctx)) / max(len(r), 1)
-        extra["pad_ratio"] = round(pad, 2)
-    extra["ml20m_rank10_gflop_per_iter"] = round(fl10 / 1e9, 2)
+        state.extra[f"train_warm_{k}" if k != "cache_hit"
+                    else "dense_cache_hit"] = v
+    dense = als_dense.auto_pick(state.ctx, nu, ni, r)
+    state.extra["als_solver"] = "dense" if dense else "bucket"
+    fl10 = _fl_iter(state, 10)
+    state.extra["ml20m_rank10_gflop_per_iter"] = round(fl10 / 1e9, 2)
     if steady > 0:
-        extra["ml20m_rank10_achieved_gflops"] = round(fl10 * steady / 1e9, 1)
+        state.extra["ml20m_rank10_achieved_gflops"] = round(
+            fl10 * steady / 1e9, 1)
 
-    # --- ML-20M rank 64: MXU-utilization reading (secondary: must never
-    # sink the headline if the device/tunnel hiccups mid-bench)
-    steady64 = 0.0
+
+def _section_rank64(state: _BenchState) -> None:
+    """ML-20M rank 64: MXU-utilization reading (secondary: must never
+    sink the headline if the device/tunnel hiccups mid-bench)."""
+    from predictionio_tpu.obs import device as device_obs
+
+    ui, ii, r, nu, ni = state.ml20m()
     device_obs.reset_program_window("als_dense_rank64")
-    try:
-        ml20m64_ips, _, steady64 = bench_als(
-            ctx, ui, ii, r, nu, ni, rank=64, iters=8, steady=True,
-            repeats=2)
-        extra["ml20m_rank64_iter_per_sec"] = round(ml20m64_ips, 3)
-        if steady64 > 0:
-            extra["ml20m_rank64_steady_iter_per_sec"] = round(steady64, 3)
-            extra["ml20m_rank64_achieved_tflops"] = round(
-                fl64 * steady64 / 1e12, 2)
-    except Exception as e:
-        extra["rank64_bench_error"] = repr(e)
-    # snapshot the HBM high-water mark at the heaviest point (A cache +
-    # factors still resident), BEFORE releasing it for the later sections
+    ips64, _, steady64 = bench_als(
+        state.ctx, ui, ii, r, nu, ni, rank=64,
+        iters=state.cfg["rank64_iters"], steady=True,
+        repeats=state.cfg["rank64_repeats"])
+    state.extra["ml20m_rank64_iter_per_sec"] = round(ips64, 3)
+    if steady64 > 0:
+        state.extra["ml20m_rank64_steady_iter_per_sec"] = round(steady64, 3)
+        state.extra["ml20m_rank64_achieved_tflops"] = round(
+            _fl_iter(state, 64) * steady64 / 1e12, 2)
+
+
+def _section_mfu(state: _BenchState) -> None:
+    """HBM high-water snapshot at the heaviest point (A cache + factors
+    still resident), release the cache for the sections below, then the
+    MFU headline — the SAME accounting as the live ``pio_device_mfu``
+    gauge (obs/device.py program windows). The closed-form fallback
+    covers the non-profiled routes AND a ``--resume`` in a fresh process
+    whose program windows are empty: the steady rates come from the
+    progress file's keys, so a resumed bench still reports MFU."""
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.obs import device as device_obs
+
     device_obs.hbm_snapshot()
     als_dense.clear_dense_cache()  # release ~4 GB of HBM for the
     # two-tower/serving sections below
-    if peak:
-        # MFU headline reads the SAME accounting as the live
-        # pio_device_mfu gauge (obs/device.py program windows fed by the
-        # profiled _dense_train dispatches, with the iteration_flops
-        # model) — the two figures cannot drift. The closed-form
-        # fallback covers the non-profiled routes (bucket solver, SPMD).
-        mfu10 = device_obs.program_mfu("als_dense_rank10")
-        mfu64 = device_obs.program_mfu("als_dense_rank64")
-        if steady > 0:
-            extra["mfu_rank10"] = round(
-                mfu10 if mfu10 is not None else fl10 * steady / peak, 4)
-        if steady64 > 0:
-            extra["mfu_rank64"] = round(
-                mfu64 if mfu64 is not None else fl64 * steady64 / peak, 4)
-        extra["peak_bf16_tflops"] = peak / 1e12
+    peak = state.peak
+    if not peak:
+        return
+    extra = state.extra
+    steady = extra.get("ml20m_rank10_steady_iter_per_sec", 0.0)
+    steady64 = extra.get("ml20m_rank64_steady_iter_per_sec", 0.0)
+    mfu10 = device_obs.program_mfu("als_dense_rank10")
+    mfu64 = device_obs.program_mfu("als_dense_rank64")
+    if steady > 0:
+        extra["mfu_rank10"] = round(
+            mfu10 if mfu10 is not None
+            else _fl_iter(state, 10) * steady / peak, 4)
+    if steady64 > 0:
+        extra["mfu_rank64"] = round(
+            mfu64 if mfu64 is not None
+            else _fl_iter(state, 64) * steady64 / peak, 4)
+    extra["peak_bf16_tflops"] = peak / 1e12
 
-    # --- two-tower retrieval training throughput (BASELINE configs[4])
+
+def _section_two_tower(state: _BenchState) -> None:
+    """Two-tower retrieval training throughput (BASELINE configs[4])."""
+    state.extra.update(bench_two_tower(state.ctx, state.cfg["two_tower"]))
+
+
+def _section_serving(state: _BenchState) -> None:
+    """Serving latency (p50/p99 REST predict through the query server)
+    + ingest/scan rates. Skipped at dry scale (real servers)."""
+    if not state.cfg["serving"]:
+        import sys as _sys
+
+        print("[bench] serving section skipped at this scale",
+              file=_sys.stderr)
+        return
+    from bench_serving import (
+        bench_event_ingest,
+        bench_event_scan,
+        bench_query_latency,
+    )
+
+    state.extra.update(bench_query_latency())
+    state.extra.update(bench_event_ingest())
+    state.extra.update(bench_event_scan())
+
+
+def _section_host_baseline(state: _BenchState) -> None:
+    """vs_baseline denominator: measured single-host float64 ALS (scaled
+    per-edge from a timed ML-100K run — see measure_host_baseline).
+    Skipped at dry scale; the assembly falls back to the conservative
+    0.1 iter/s Spark-MLlib-class figure when the keys are absent."""
+    if not state.cfg["host_baseline"]:
+        import sys as _sys
+
+        print("[bench] host-baseline section skipped at this scale",
+              file=_sys.stderr)
+        return
+    state.extra.update(measure_host_baseline())
+
+
+#: The sectioned bench: (name, fn, error-key). A section with an
+#: error-key swallows its exception into ``extra[error_key]`` (secondary
+#: metrics must never sink the headline); a ``None`` error-key section
+#: propagates — but the progress file is flushed first, so even a hard
+#: failure (or a wall-clock kill between sections) leaves every
+#: completed section's keys on disk for ``--resume``.
+SECTIONS: list = [
+    ("ml100k", _section_ml100k, None),
+    ("ml20m_cold", _section_ml20m_cold, "cold_bench_error"),
+    ("ml20m_warm", _section_ml20m_warm, None),
+    ("ml20m_rank64", _section_rank64, "rank64_bench_error"),
+    ("mfu", _section_mfu, "mfu_bench_error"),
+    ("two_tower", _section_two_tower, "two_tower_bench_error"),
+    ("serving", _section_serving, "serving_bench_error"),
+    ("host_baseline", _section_host_baseline, "host_baseline_error"),
+]
+
+#: Bookkeeping keys the progress file adds to ``extra`` (stripped when a
+#: resumed run reloads it; re-added at every flush).
+_PROGRESS_META_KEYS = ("bench_sections_done", "bench_sections_pending",
+                       "bench_scale")
+
+
+def progress_path() -> str:
+    import os as _os
+
+    return _os.path.join(_capture_dir(), "progress.json")
+
+
+def _write_progress(scale: str, done: list, pending: list,
+                    extra: dict) -> None:
+    """Flush the partial capture atomically (tmp + replace — a kill
+    mid-flush leaves the previous complete flush, never a torn file).
+    The document is a valid bench headline doc, so `pio bench-compare`
+    accepts a partial sectioned capture directly."""
+    import os as _os
+
+    doc = {
+        "metric": HEADLINE_METRIC,
+        "value": extra.get(HEADLINE_METRIC),
+        "unit": "iter/s",
+        "vs_baseline": None,
+        "partial": bool(pending),
+        "extra": {
+            **{k: v for k, v in extra.items() if k != HEADLINE_METRIC},
+            "bench_scale": scale,
+            "bench_sections_done": list(done),
+            "bench_sections_pending": list(pending),
+        },
+    }
+    path = progress_path()
+    tmp = f"{path}.tmp{_os.getpid()}"
     try:
-        extra.update(bench_two_tower(ctx))
-    except Exception as e:  # secondary metric must never sink the headline
-        extra["two_tower_bench_error"] = repr(e)
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        _os.replace(tmp, path)
+    except OSError:
+        pass  # progress bookkeeping must never sink the bench
 
-    # --- serving latency (p50/p99 REST predict through the query server)
+
+def _load_progress(scale: str) -> tuple[list, dict] | None:
+    """(done-sections, extra) from a prior run's progress file, or None
+    when there is none / it was captured at a different scale."""
+    import os as _os
+    import sys as _sys
+
+    path = progress_path()
+    if not _os.path.exists(path):
+        return None
     try:
-        from bench_serving import (
-            bench_event_ingest,
-            bench_event_scan,
-            bench_query_latency,
-        )
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    extra = dict(doc.get("extra") or {})
+    if extra.get("bench_scale") != scale:
+        print(f"[bench] --resume: progress file is scale "
+              f"{extra.get('bench_scale')!r}, this run is {scale!r} — "
+              "starting fresh", file=_sys.stderr)
+        return None
+    done = [s for s in extra.get("bench_sections_done", [])
+            if isinstance(s, str)]
+    for k in _PROGRESS_META_KEYS:
+        extra.pop(k, None)
+    if doc.get("value") is not None:
+        extra[HEADLINE_METRIC] = doc["value"]
+    return done, extra
 
-        extra.update(bench_query_latency())
-        extra.update(bench_event_ingest())
-        extra.update(bench_event_scan())
-    except Exception as e:  # serving bench must never sink the headline
-        extra["serving_bench_error"] = repr(e)
 
-    # vs_baseline: measured single-host float64 ALS (scaled per-edge from
-    # a timed ML-100K run — see measure_host_baseline); falls back to the
-    # conservative 0.1 iter/s Spark-MLlib-class figure if unmeasurable
-    try:
-        host = measure_host_baseline()
-        extra.update(host)
-        baseline_iter_per_sec = host["host_baseline_iter_per_sec"]
-    except Exception as e:
-        extra["host_baseline_error"] = repr(e)
-        baseline_iter_per_sec = 0.1  # assumed Spark MLlib local-mode class
+def _run_sections(state: _BenchState, done: list, scale: str,
+                  sections=None) -> None:
+    """Run every not-yet-done section in order, flushing the progress
+    file after each — the heart of the kill-resilient bench."""
+    import sys as _sys
+
+    sections = SECTIONS if sections is None else sections
+    names = [name for name, _fn, _guard in sections]
+    for name, fn, guard in sections:
+        if name in done:
+            print(f"[bench] --resume: section {name} already captured, "
+                  "skipping", file=_sys.stderr)
+            continue
+        try:
+            fn(state)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            if guard is None:
+                # flush first: the completed sections' keys survive even
+                # a failed headline section
+                _write_progress(scale, done,
+                                [n for n in names if n not in done],
+                                state.extra)
+                raise
+            state.extra[guard] = repr(e)
+        done.append(name)
+        _write_progress(scale, done, [n for n in names if n not in done],
+                        state.extra)
+
+
+def _collect(metrics_snapshot: bool = False, scale: str = "full",
+             resume: bool = False, sections=None) -> dict:
+    """Run every bench section and return the headline doc. All stdout
+    writes made in here land on stderr (main() redirects them): the
+    process stdout contract is ONE final JSON line, nothing else —
+    BENCH_r01..r05 all recorded ``"parsed": null`` because stray output
+    shared stdout with the headline line.
+
+    The run is SECTIONED: each section flushes its keys to
+    ``bench_captures/progress.json`` as it completes, so a wall-clock
+    kill leaves a usable partial capture (BENCH_r06 recorded two 7200 s
+    timeouts with nothing to show); ``resume`` skips the sections a
+    previous (same-scale) run already captured."""
+    import sys as _sys
+
+    from predictionio_tpu.parallel.mesh import compute_context
+
+    cfg = SCALES[scale]
+    ctx = compute_context()
+    dev = ctx.mesh.devices.flat[0]
+    peak = peak_flops(dev)
+    extra: dict = {}
+    done: list = []
+    if resume:
+        prior = _load_progress(scale)
+        if prior is not None:
+            done, extra = prior
+            print(f"[bench] --resume: {len(done)} section(s) loaded from "
+                  f"{progress_path()}: {', '.join(done)}", file=_sys.stderr)
+        else:
+            print("[bench] --resume: no matching progress file — running "
+                  "everything", file=_sys.stderr)
+    # environment facts always reflect THIS process (a resume may run on
+    # different hardware; the fresher reading wins)
+    extra["device"] = getattr(dev, "device_kind", str(dev))
+    extra["n_devices"] = int(ctx.mesh.devices.size)
+    state = _BenchState(ctx, cfg, extra, peak)
+    _run_sections(state, done, scale, sections)
+
+    ml20m_ips = extra.pop(HEADLINE_METRIC)
+    baseline_iter_per_sec = extra.get(
+        "host_baseline_iter_per_sec",
+        0.1)  # assumed Spark MLlib local-mode class when unmeasured
 
     # --metrics-snapshot: dump the process obs registry into the capture
     # (bench servers run in-process, so their stage histograms, ingest
@@ -830,6 +1087,8 @@ def _collect(metrics_snapshot: bool = False) -> dict:
     # that quietly doubles resident memory or reintroduces per-request
     # retracing shows up in the round-over-round diff
     try:
+        from predictionio_tpu.obs import device as device_obs
+
         device_obs.hbm_snapshot()
         extra["peak_hbm_bytes"] = int(device_obs.peak_total_bytes())
         extra["retraces"] = int(device_obs.total_retraces())
@@ -854,15 +1113,18 @@ def _collect(metrics_snapshot: bool = False) -> dict:
             ]),
             file=_sys.stderr,
         )
+    extra["bench_scale"] = scale
     doc = {
-        "metric": "ml20m_als_rank10_iterations_per_sec",
+        "metric": HEADLINE_METRIC,
         "value": round(ml20m_ips, 3),
         "unit": "iter/s",
         "vs_baseline": round(ml20m_ips / baseline_iter_per_sec, 2),
         "extra": extra,
     }
     merged = {**extra, doc["metric"]: doc["value"]}
-    violations = check_readme_bands(merged)
+    # README bands are full-scale claims; dry-scale values are shapes-
+    # shrunk and would warn on every run for no reason
+    violations = check_readme_bands(merged) if scale == "full" else []
     cap_name = capture_file_name(extra, bool(extra.get("degraded_sections")))
     if violations:
         import sys as _sys
@@ -875,10 +1137,11 @@ def _collect(metrics_snapshot: bool = False) -> dict:
         for v in violations:
             print(f"[bench] WARNING: {v} — investigate the regression"
                   f"{gated}", file=_sys.stderr)
-    for note in band_refresh_notes(merged):
-        import sys as _sys
+    if scale == "full":
+        for note in band_refresh_notes(merged):
+            import sys as _sys
 
-        print(f"[bench] NOTE: {note}", file=_sys.stderr)
+            print(f"[bench] NOTE: {note}", file=_sys.stderr)
     try:
         import os as _os
 
@@ -932,17 +1195,30 @@ def emit_headline(collect) -> None:
     real_stdout.flush()
 
 
-def main(metrics_snapshot: bool = False, dry_run: bool = False) -> None:
+def main(metrics_snapshot: bool = False, dry_run: bool = False,
+         scale: str = "full", resume: bool = False) -> None:
     emit_headline(
-        lambda: _dry_run_doc() if dry_run else _collect(metrics_snapshot))
+        lambda: _dry_run_doc() if dry_run
+        else _collect(metrics_snapshot, scale=scale, resume=resume))
 
 
 if __name__ == "__main__":
+    import os as _os
     import sys as _sys
 
-    if "--check-readme" in _sys.argv:
-        args = [a for a in _sys.argv[1:]
+    argv = _sys.argv[1:]
+    if "--check-readme" in argv:
+        args = [a for a in argv
                 if a not in ("--check-readme", "--metrics-snapshot")]
         _sys.exit(_check_readme_cli(args))
-    main(metrics_snapshot="--metrics-snapshot" in _sys.argv,
-         dry_run="--dry-run" in _sys.argv)
+    scale = _os.environ.get("PIO_BENCH_SCALE", "full")
+    if "--scale" in argv:
+        idx = argv.index("--scale")
+        scale = argv[idx + 1] if idx + 1 < len(argv) else ""
+    if scale not in SCALES:
+        print(f"[bench] unknown scale {scale!r} (choices: "
+              f"{', '.join(SCALES)})", file=_sys.stderr)
+        _sys.exit(2)
+    main(metrics_snapshot="--metrics-snapshot" in argv,
+         dry_run="--dry-run" in argv,
+         scale=scale, resume="--resume" in argv)

@@ -1164,17 +1164,28 @@ class ALS:
             gather_dtype=p.gather_dtype,
         )
 
-        if callback is None:
+        from predictionio_tpu.obs import runlog
+
+        if callback is None and not runlog.want_steps():
             # the whole training run in ONE device dispatch (fori_loop):
             # per-call host/RPC overhead would otherwise rival the compute
+            t0 = time.perf_counter()
             user_f, item_f = _als_train(
                 user_f, item_f, u_nbr, u_val, i_nbr, i_val,
                 u_tiles, i_tiles, p.lambda_, p.alpha, p.num_iterations,
                 **static,
             )
+            # tiny sync so the fused telemetry times the solve, not its
+            # enqueue — free here: the full factor readback follows
+            # immediately below
+            np.asarray(jax.device_get(item_f[:1, :1]))
+            runlog.fused_steps("als_bucket", p.num_iterations,
+                               time.perf_counter() - t0)
         else:
             from predictionio_tpu.resilience import faults
 
+            st = runlog.StepTimer("als_bucket", total=p.num_iterations,
+                                  phase="solve")
             for it in range(p.num_iterations):
                 # crash-safe-training chaos site (same name as the dense
                 # path's): an injected error is a mid-train kill between
@@ -1184,7 +1195,9 @@ class ALS:
                     user_f, item_f, u_nbr, u_val, i_nbr, i_val,
                     u_tiles, i_tiles, p.lambda_, p.alpha, **static,
                 )
-                callback(it, user_f, item_f)
+                if callback is not None:
+                    callback(it, user_f, item_f)
+                st.step(it + 1, sync=item_f)
 
         # one readback for both factor matrices
         packed = np.asarray(jnp.concatenate([user_f, item_f], axis=0))
@@ -1219,6 +1232,10 @@ class ALS:
         i_arrs = tuple(
             _put(x, shard) for x in (it.seg, it.nbr, it.val, it.wgt))
 
+        from predictionio_tpu.obs import runlog
+
+        st = runlog.StepTimer("als_segment", total=p.num_iterations,
+                              phase="solve")
         for step in range(p.num_iterations):
             user_f, item_f = _als_iteration_segment(
                 user_f, item_f, *u_arrs, *i_arrs, p.lambda_, p.alpha,
@@ -1228,6 +1245,7 @@ class ALS:
             )
             if callback is not None:
                 callback(step, user_f, item_f)
+            st.step(step + 1, sync=item_f)
 
         packed = np.asarray(jnp.concatenate([user_f, item_f], axis=0))
         return ALSFactors(packed[:n_users], packed[n_users:])

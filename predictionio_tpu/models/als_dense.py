@@ -1066,6 +1066,15 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
     kernel = use_kernel()
     entry = acquire_device_inputs(ui, ii, ratings, n_users, n_items,
                                   phases=phases)
+    from predictionio_tpu.obs import runlog
+
+    # run-ledger phase records (no-ops outside an active run): the host
+    # prep + staged upload that precede the solve, so `pio watch` can
+    # tell "densifying" from "hung" before the first iteration lands
+    for _k, _phase in (("prepare_s", "prepare"),
+                       ("upload_densify_s", "upload_densify")):
+        if _k in phases:
+            runlog.phase(_phase, phases[_k])
 
     start_iter = 0
     if resume is not None:
@@ -1092,13 +1101,21 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
     # they belong to the caller (readback) and show as unattributed
     factors_alloc = _FACTORS_ARENA.register(
         (n_users + n_items) * p.rank * 4, label=f"rank{p.rank}")
+    # per-iteration dispatch when the iterations must be individually
+    # visible: a checkpointed resume (the fused fori_loop cannot start
+    # mid-loop), a progress/checkpoint callback, or an active run ledger
+    # with step-level observation enabled (PIO_RUNS_STEP_ITERATIONS) —
+    # the `pio train` live-watch mode
+    per_iter = (resume is not None or callback is not None
+                or runlog.want_steps())
     try:
-        if resume is not None:
-            # checkpointed solves run the per-iteration path (the fused
-            # fori_loop cannot start mid-loop); callback may still be
-            # None when the caller only resumes without re-checkpointing
+        if per_iter:
             from predictionio_tpu.resilience import faults
 
+            # the crash-safe-training chaos site: an error here is a
+            # mid-train kill between checkpoint intervals
+            st = runlog.StepTimer("als_dense", total=p.num_iterations,
+                                  start=start_iter, phase="solve")
             for it in range(start_iter, p.num_iterations):
                 faults.fault_point("train.iteration")
                 user_f, item_f = _dense_iteration(
@@ -1106,7 +1123,8 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
                     **static)
                 if callback is not None:
                     callback(it, user_f, item_f)
-        elif callback is None and _pipeline_enabled() and p.num_iterations >= 1:
+                st.step(it + 1, sync=item_f)
+        elif _pipeline_enabled() and p.num_iterations >= 1:
             # the final iteration runs as two half dispatches: once the user
             # half lands, its factors' d2h copy is kicked off and proceeds
             # concurrently with the item half still executing on device —
@@ -1132,26 +1150,26 @@ def train_dense(ctx, params, ui, ii, ratings, n_users, n_items,
             item_f = _dense_item_half(
                 item_f, user_f, blocks, dup_i, p.lambda_, p.alpha, **static)
             start_fetch(item_f)
-        elif callback is None:
+        else:
             user_f, item_f = _dense_train(
                 user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
                 p.num_iterations, **static)
-        else:
-            from predictionio_tpu.resilience import faults
-
-            for it in range(p.num_iterations):
-                # the crash-safe-training chaos site: an error here is a
-                # mid-train kill between checkpoint intervals
-                faults.fault_point("train.iteration")
-                user_f, item_f = _dense_iteration(
-                    user_f, item_f, blocks, dup_u, dup_i, p.lambda_, p.alpha,
-                    **static)
-                callback(it, user_f, item_f)
-        if sync_timing:
+        # sync the solve timing when explicitly asked OR when a ledger
+        # run observes a fused solve (honest step telemetry; unobserved
+        # pipeline trains keep their readback overlap un-synced)
+        fused_synced = sync_timing or (not per_iter
+                                       and runlog.active() is not None)
+        if fused_synced:
             _phase_sync(item_f)
     finally:
         _FACTORS_ARENA.free(factors_alloc)
     phases["solve_s"] = round(time.perf_counter() - t0, 3)
+    if not per_iter:
+        # the fused whole-run dispatch: one aggregate ledger/metric
+        # record (per-iteration average), marked fused; enqueue-only
+        # timings stay out of the step histogram
+        runlog.fused_steps("als_dense", p.num_iterations,
+                           phases["solve_s"], synced=fused_synced)
     global last_train_phases
     last_train_phases = phases
     return user_f, item_f
@@ -1263,6 +1281,8 @@ def train_dense_stacked(ctx, params_list, ui, ii, ratings,
     The densified A is acquired through :func:`acquire_device_inputs`:
     one ChunkStager-streamed upload per ratings fingerprint, shared by
     every candidate of every bucket evaluated on the same fold."""
+    import time
+
     from predictionio_tpu.models.als import _init_factors
 
     p0 = params_list[0]
@@ -1299,6 +1319,7 @@ def train_dense_stacked(ctx, params_list, ui, ii, ratings,
         "ALS(dense,stacked): %d candidate(s), rank %d, %d iteration(s), "
         "A %s", len(params_list), p0.rank, p0.num_iterations,
         "cache hit" if phases.get("cache_hit") else "staged")
+    t0 = time.perf_counter()
     uf_stack, if_stack = _dense_train_stacked(
         uf_stack, if_stack, entry["blocks"], entry["dup_u"], entry["dup_i"],
         lambdas, alphas, p0.num_iterations,
@@ -1311,6 +1332,10 @@ def train_dense_stacked(ctx, params_list, ui, ii, ratings,
     # readback, not block_until_ready: the latter does not actually block
     # through the axon tunnel.
     np.asarray(jax.device_get(uf_stack[:, :1, :1]))
+    from predictionio_tpu.obs import runlog
+
+    runlog.fused_steps(f"als_dense_stacked_rank{p0.rank}",
+                       p0.num_iterations, time.perf_counter() - t0)
     return uf_stack, if_stack
 
 

@@ -423,7 +423,12 @@ class SASRec:
             (params, opt_state), label="sasrec")
         data_alloc = device_obs.arena("train_data").register(
             (seqs_d, pos_d), label="sasrec")
+        from predictionio_tpu.obs import runlog
+
         try:
+            st = runlog.StepTimer(
+                "sasrec_epoch", total=p.num_epochs, start=start_epoch,
+                phase="train", examples_per_step=steps_per_epoch * bs)
             for epoch in range(start_epoch, p.num_epochs):
                 params, opt_state, loss = _train_epoch(
                     params, opt_state, seqs_d, pos_d, key, epoch,
@@ -431,6 +436,9 @@ class SASRec:
                     p=p, steps_per_epoch=steps_per_epoch, bs=bs,
                     n_items=n_items,
                 )
+                st.step(epoch + 1, sync=loss,
+                        loss=(float(loss) if runlog.active() is not None
+                              else None))
                 if callback is not None:
                     callback(epoch, float(loss))
                 if checkpointer is not None \

@@ -537,9 +537,13 @@ def train_two_tower(
         (params, opt_state), label="two_tower")
     _data_alloc = device_obs.arena("train_data").register(
         (u_all, i_all), label="two_tower")
+    from predictionio_tpu.obs import runlog
+
     try:
         loss = None
         if callback is None:
+            import time as _time
+
             step = start_step
             while step < p.steps:  # whole run = ONE dispatch per segment
                 seg = (
@@ -547,10 +551,23 @@ def train_two_tower(
                     if checkpointer is not None
                     else p.steps - step
                 )
+                t0 = _time.perf_counter()
                 params, opt_state, loss = run(
                     params, opt_state, u_all, i_all, key, seg, step
                 )
                 step += seg
+                # run-ledger progress per fused segment (per-step
+                # average): the neural path keeps its one-dispatch-per-
+                # segment shape. The scalar-loss sync is unconditional
+                # so the step histogram never records enqueue time —
+                # its cost is one scalar readback per SEGMENT, and the
+                # serving-corpus export below blocks anyway
+                jax.block_until_ready(loss)
+                dt = _time.perf_counter() - t0
+                runlog.step(
+                    "two_tower_step", iteration=step, total=p.steps,
+                    seconds=dt / max(seg, 1),
+                    examples_per_sec=(seg * batch / dt if dt > 0 else None))
                 if checkpointer is not None:
                     # also save the final segment so fused and callback modes
                     # leave identical checkpoint state behind
@@ -561,11 +578,17 @@ def train_two_tower(
             # starves the collective rendezvous and XLA aborts on its
             # stuck-timeout)
             last_saved = None
+            st = runlog.StepTimer("two_tower_step", total=p.steps,
+                                  start=start_step,
+                                  examples_per_step=batch)
             for step in range(start_step, p.steps):
                 params, opt_state, loss = one_step(
                     params, opt_state, u_all, i_all, key, step
                 )
                 loss.block_until_ready()
+                st.step(step + 1,
+                        loss=(float(loss) if runlog.active() is not None
+                              else None))
                 if (step + 1) % 100 == 0:
                     callback(step, float(loss))
                 if checkpointer is not None and checkpointer.should_save(step):

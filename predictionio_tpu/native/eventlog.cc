@@ -38,16 +38,71 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cerrno>
 #include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <string>
 #include <unordered_map>
 #include <vector>
 
 namespace {
+
+// std::from_chars for double: preferred (locale-independent and bounded —
+// the mmap'd buffer is not null-terminated), but libstdc++ < 11 ships only
+// the integral overloads, which made this file fail to build on every
+// bench/train run of this container (BENCH_r06 stderr). Overload
+// resolution picks the real from_chars when the library has it (the `int`
+// overload below wins via SFINAE); otherwise the `long` fallback runs a
+// bounded strtod: the bytes are copied into a NUL-terminated stack buffer
+// (so strtod cannot read past a truncated final record) and parsed under
+// an explicit "C" locale (plain strtod honors LC_NUMERIC and would
+// mis-parse "4.5" under comma-decimal locales).
+struct fp_parse_result {
+  const char* ptr;
+  std::errc ec;
+};
+
+template <typename T>
+auto parse_double_impl(const char* first, const char* last, T& value, int)
+    -> decltype(std::from_chars(first, last, value), fp_parse_result{}) {
+  auto res = std::from_chars(first, last, value);
+  return {res.ptr, res.ec};
+}
+
+template <typename T>
+fp_parse_result parse_double_impl(const char* first, const char* last,
+                                  T& value, long) {
+  char buf[64];
+  size_t n = static_cast<size_t>(last - first);
+  if (n >= sizeof(buf)) n = sizeof(buf) - 1;  // no real JSON number is longer
+  std::memcpy(buf, first, n);
+  buf[n] = '\0';
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  char* endp = nullptr;
+  errno = 0;
+  double v = c_loc ? strtod_l(buf, &endp, c_loc) : std::strtod(buf, &endp);
+  if (endp == buf) return {first, std::errc::invalid_argument};
+  if (errno == ERANGE) return {first + (endp - buf),
+                               std::errc::result_out_of_range};
+  // strtod is laxer than from_chars (leading whitespace, hex floats,
+  // inf/nan): reject anything that does not start with a JSON-shaped
+  // number so the two toolchain paths accept the same inputs.
+  char c0 = buf[0];
+  if (c0 != '-' && !(c0 >= '0' && c0 <= '9')) {
+    return {first, std::errc::invalid_argument};
+  }
+  value = v;
+  return {first + (endp - buf), std::errc()};
+}
+
+inline fp_parse_result parse_double(const char* first, const char* last,
+                                    double& value) {
+  return parse_double_impl(first, last, value, 0);
+}
 
 constexpr uint32_t kFixedSize = 48;
 constexpr uint16_t kNull16 = 0xFFFF;
@@ -300,12 +355,11 @@ bool json_top_level_number(const char* s, uint32_t len, const char* key,
           num_end--;
         if (num_start < num_end && *num_start == '+') num_start++;
       }
-      // std::from_chars: locale-independent (strtod honors LC_NUMERIC and
-      // would mis-parse "4.5" under comma-decimal locales) and bounded (the
-      // mmap'd buffer is not null-terminated, so strtod could read past it
-      // on a truncated final record).
+      // parse_double: std::from_chars where the toolchain has the
+      // floating overload, else a bounded C-locale strtod (see the
+      // helper above for why both properties matter here).
       double v = 0.0;
-      auto res = std::from_chars(num_start, num_end, v);
+      auto res = parse_double(num_start, num_end, v);
       if (res.ec != std::errc() || res.ptr == num_start) return false;
       if (quoted && res.ptr != num_end) return false;  // e.g. "4.5x"
       *out = v;

@@ -23,6 +23,13 @@ for every perf PR is quantified hot paths. This package provides:
     batcher → device, kept in a bounded ring + slowest-N reservoir and
     served as ``GET /debug/traces`` / ``pio trace``; histograms carry
     OpenMetrics trace-id exemplars while a sampled span is active.
+  * The training-run observatory (:mod:`predictionio_tpu.obs.runlog`,
+    the fourth pillar): an append-only per-run JSONL ledger + atomic
+    heartbeat under ``PIO_RUNS_DIR``, fed by the training loops'
+    step/phase telemetry and read from OUTSIDE the trainer by
+    ``pio runs`` / ``pio watch`` / ``pio doctor`` (STALLED-RUN
+    judgment). Imported lazily by the training paths; library users of
+    obs pay nothing for it.
   * The fleet layer: metrics federation over a multi-process deploy
     (:mod:`predictionio_tpu.obs.fleet`, ``GET /metrics/fleet`` on the
     gateway), local time-series history rings

@@ -57,7 +57,30 @@ __all__ = [
     "history_enabled",
     "history_interval_s",
     "reset",
+    "sparkline",
 ]
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list) -> str:
+    """Unicode sparkline over the series' own min..max (gaps for None).
+    Character cells instead of an image/JS chart: zero dependencies and
+    it renders in any terminal. The one renderer shared by the dashboard
+    panels and ``pio watch``."""
+    nums = [v for v in values if v is not None]
+    if not nums:
+        return ""
+    lo, hi = min(nums), max(nums)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        else:
+            idx = int((v - lo) / span * (len(_SPARK_CHARS) - 1))
+            out.append(_SPARK_CHARS[idx])
+    return "".join(out)
 
 _SAMPLES = REGISTRY.counter(
     "pio_history_samples_total",
@@ -255,6 +278,16 @@ class HistorySampler:
             reg, "pio_serving_model_age_seconds")
         values["ingest_last_event_age_seconds"] = _gauge_max(
             reg, "pio_ingest_last_event_age_seconds")
+        # training (the run-ledger pillar, obs/runlog.py): step latency,
+        # progress and heartbeat age ride the same rings so a trainer
+        # process's /debug/history answers "is it moving?" — the
+        # heartbeat gauge is refreshed by the collect-hook run above
+        values["train_step_p50_ms"] = ms(
+            self._windowed_quantile("pio_train_step_seconds", 0.5))
+        values["train_progress_ratio"] = _gauge_max(
+            reg, "pio_train_progress_ratio")
+        values["train_heartbeat_age_seconds"] = _gauge_max(
+            reg, "pio_train_heartbeat_age_seconds")
         return values
 
     def _ratio_rate(self, key: str, num: float | None, den_extra: float | None,

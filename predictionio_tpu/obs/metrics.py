@@ -264,6 +264,14 @@ class Gauge(_ScalarMetric):
 
     kind = "gauge"
 
+    def remove(self, **labels: str) -> None:
+        """Drop a child so the series goes ABSENT from the exposition —
+        for gauges whose absence is the signal (a heartbeat age after
+        the run ended would otherwise export a frozen, forever-fresh
+        value)."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
     def set(self, value: float, **labels: str) -> None:
         key = self._key(labels)
         with self._lock:

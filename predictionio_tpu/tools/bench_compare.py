@@ -23,6 +23,13 @@ are lower-is-better; throughput/utilization keys (``*_per_sec``,
 ``qps``, ``mfu``, ...) are higher-is-better. Non-numeric values, bools,
 and bookkeeping keys are skipped; keys present on only one side are
 reported as added/removed, never as regressions.
+
+Partial sectioned captures (the ``bench_captures/progress.json`` a
+wall-clock-killed ``bench.py`` run leaves behind, or a driver capture
+wrapping one) are accepted like any headline doc: only the keys both
+sides measured are compared, and when a side is partial its pending
+sections are reported so missing keys read as "not captured yet", never
+as regressions.
 """
 
 from __future__ import annotations
@@ -38,6 +45,7 @@ __all__ = [
     "flatten_headline",
     "load_headline",
     "lower_is_better",
+    "pending_sections",
 ]
 
 #: keys that are environment facts, not performance metrics
@@ -95,6 +103,14 @@ def load_headline(path: str | Path) -> dict:
             f"{path}: capture has no parsed headline and no JSON line "
             "in its tail")
     return doc
+
+
+def pending_sections(doc: dict) -> list[str]:
+    """Section names a partial sectioned capture has not run yet
+    (``[]`` for a complete capture or a pre-sectioning document)."""
+    extra = doc.get("extra") or {}
+    pending = extra.get("bench_sections_pending") or []
+    return [str(s) for s in pending]
 
 
 def flatten_headline(doc: dict) -> dict[str, float]:
@@ -184,15 +200,26 @@ def run(baseline: str, candidate: str, threshold: float = 0.05,
         key_thresholds: dict[str, float] | None = None,
         as_json: bool = False) -> int:
     try:
-        a = flatten_headline(load_headline(baseline))
-        b = flatten_headline(load_headline(candidate))
+        doc_a = load_headline(baseline)
+        doc_b = load_headline(candidate)
+        a = flatten_headline(doc_a)
+        b = flatten_headline(doc_b)
     except (OSError, ValueError) as e:
         print(f"[ERROR] {e}", file=sys.stderr)
         return 2
+    pend_a, pend_b = pending_sections(doc_a), pending_sections(doc_b)
     result = compare(a, b, threshold, key_thresholds)
+    if pend_a or pend_b:
+        result["pendingSections"] = {"baseline": pend_a,
+                                     "candidate": pend_b}
     if as_json:
         print(json.dumps(result, indent=2))
         return 1 if result["regressions"] else 0
+    for side, pend in (("baseline", pend_a), ("candidate", pend_b)):
+        if pend:
+            print(f"[INFO] {side} is a PARTIAL sectioned capture "
+                  f"(pending: {', '.join(pend)}) — only keys both sides "
+                  "measured are compared.")
     if result["regressions"]:
         print(f"[ERROR] {len(result['regressions'])} regression(s) "
               f"{baseline} -> {candidate}:", file=sys.stderr)
@@ -205,7 +232,12 @@ def run(baseline: str, candidate: str, threshold: float = 0.05,
     print(f"[INFO] {len(result['unchanged'])} metric(s) within threshold; "
           f"{len(result['added'])} added, {len(result['removed'])} removed.")
     if result["removed"]:
-        print(f"[INFO] removed keys: {', '.join(result['removed'])}")
+        if pend_b:
+            print(f"[INFO] keys absent from the partial candidate "
+                  f"(pending sections, NOT regressions): "
+                  f"{', '.join(result['removed'])}")
+        else:
+            print(f"[INFO] removed keys: {', '.join(result['removed'])}")
     return 1 if result["regressions"] else 0
 
 

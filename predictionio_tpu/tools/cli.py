@@ -55,7 +55,48 @@ def build_parser() -> argparse.ArgumentParser:
         "--dry-run", action="store_true",
         help="with --fix: report what each action WOULD do without "
              "acting (the gateway validates and logs, nothing changes)")
+    p_doc.add_argument(
+        "--runs-dir", default=None, metavar="DIR",
+        help="run-ledger directory scanned for STALLED training runs "
+             "(default PIO_RUNS_DIR / ~/.predictionio_tpu/runs)")
     p_doc.set_defaults(func=cmd_doctor)
+
+    # -- training-run observatory (obs/runlog.py surfaces) -------------------
+    p_runs = sub.add_parser(
+        "runs",
+        help="list/inspect training runs recorded in the run ledger")
+    p_runs.add_argument("run_id", nargs="?",
+                        help="inspect one run in detail")
+    p_runs.add_argument(
+        "--runs-dir", default=None, metavar="DIR",
+        help="run-ledger directory (default PIO_RUNS_DIR / "
+             "~/.predictionio_tpu/runs)")
+    p_runs.add_argument("--limit", type=int, default=20, metavar="N",
+                        help="newest N runs to list (default 20)")
+    p_runs.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    p_runs.set_defaults(func=cmd_runs)
+
+    p_watch = sub.add_parser(
+        "watch",
+        help="live-tail a training run: progress bar, step time, "
+             "throughput sparkline, ETA, heartbeat age")
+    p_watch.add_argument("run_id", nargs="?",
+                         help="run to watch (default: the newest)")
+    p_watch.add_argument(
+        "--latest", action="store_true",
+        help="watch the newest run (the default when no run id is given)")
+    p_watch.add_argument(
+        "--runs-dir", default=None, metavar="DIR",
+        help="run-ledger directory (default PIO_RUNS_DIR / "
+             "~/.predictionio_tpu/runs)")
+    p_watch.add_argument("--interval", type=float, default=2.0,
+                         metavar="SEC",
+                         help="refresh period (default 2s)")
+    p_watch.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripting / smoke tests)")
+    p_watch.set_defaults(func=cmd_watch)
 
     # -- bench regression diff (tools/bench_compare.py) ----------------------
     p_bc = sub.add_parser(
@@ -804,32 +845,210 @@ def _doctor_fix(base: str, findings: list, dry_run: bool,
     return results
 
 
+def _fmt_duration(seconds) -> str:
+    """``1:02:03`` / ``2:03`` / ``8.1s`` — compact, for run tables."""
+    if seconds is None:
+        return "?"
+    seconds = float(seconds)
+    if seconds < 60:
+        return f"{seconds:.1f}s"
+    s = int(seconds)
+    h, rem = divmod(s, 3600)
+    m, sec = divmod(rem, 60)
+    return f"{h}:{m:02d}:{sec:02d}" if h else f"{m}:{sec:02d}"
+
+
+def _run_progress(s: dict) -> str:
+    if s.get("iteration") is None:
+        return "-"
+    return f"{s['iteration']}/{s['total']}"
+
+
+def cmd_runs(args) -> int:
+    """``pio runs``: list the run ledger (newest first); ``pio runs
+    <run-id>`` inspects one run — phases, step stats, heartbeat, stall
+    judgment. Reads only the runs dir; no live process is touched."""
+    import json as _json
+    from pathlib import Path
+
+    from predictionio_tpu.obs import runlog
+
+    directory = Path(args.runs_dir) if args.runs_dir else runlog.runs_dir()
+    if args.run_id:
+        path = directory / f"{args.run_id}.jsonl"
+        if not path.exists():
+            print(f"[ERROR] no run {args.run_id!r} under {directory}",
+                  file=sys.stderr)
+            return 1
+        run = runlog.read_run(path)
+        s = runlog.summarize(run)
+        if args.json:
+            print(_json.dumps({"summary": s, "phases": run["phases"],
+                               "steps": run["steps"]}, indent=2))
+            return 0
+        print(f"[INFO] run {s['runId']} — {s['status']} "
+              f"({s['engine'] or 'unknown engine'}, params "
+              f"{s['paramsHash'] or '?'})")
+        print(f"[INFO]   progress {_run_progress(s)}"
+              f"{' in ' + s['phase'] if s.get('phase') else ''}, "
+              f"{s['steps']} step record(s), duration "
+              f"{_fmt_duration(s['durationSeconds'])}")
+        if s.get("medianStepSeconds") is not None:
+            print(f"[INFO]   median step {s['medianStepSeconds'] * 1e3:.1f} "
+                  f"ms, last {s['lastStepSeconds'] * 1e3:.1f} ms"
+                  + (f", loss {s['loss']:.6g}" if s.get("loss") is not None
+                     else ""))
+        for ph in run["phases"]:
+            sec = (f" ({ph['seconds']:.3f}s)" if ph.get("seconds") is not None
+                   else "")
+            print(f"[INFO]   phase {ph['phase']}{sec}")
+        if s["status"] in ("RUNNING", "STALLED"):
+            age = s.get("heartbeatAgeSeconds")
+            print(f"[INFO]   heartbeat "
+                  f"{f'{age:.1f}s ago' if age is not None else 'never seen'}"
+                  f" (stall threshold {s['stallThresholdSeconds']:.1f}s)"
+                  + (" — STALLED" if s["stalled"] else ""))
+        if s.get("error"):
+            print(f"[INFO]   error: {s['error']}")
+        return 0
+    runs = runlog.list_runs(directory, limit=args.limit)
+    if args.json:
+        print(_json.dumps(runs, indent=2))
+        return 0
+    if not runs:
+        print(f"[INFO] no training runs recorded under {directory} — "
+              "`pio train` writes one ledger per run.")
+        return 0
+    print(f"[INFO] {len(runs)} training run(s) under {directory} "
+          "(newest first):")
+    for s in runs:
+        med = (f"{s['medianStepSeconds'] * 1e3:.0f}ms/step"
+               if s.get("medianStepSeconds") is not None else "no steps")
+        print(f"[INFO]   {s['runId']}: {s['status']} {_run_progress(s)} "
+              f"{s.get('program') or ''} {med}, "
+              f"{_fmt_duration(s['durationSeconds'])}")
+    print("[INFO] follow live with `pio watch`; inspect with "
+          "`pio runs <run-id>`.")
+    return 0
+
+
+def _watch_line(s: dict, spark: str) -> str:
+    """One watch frame: progress bar + step rate + ETA + heartbeat."""
+    width = 20
+    frac = s.get("progress")
+    if frac is None:
+        bar = "·" * width
+        pct = "  ?%"
+    else:
+        filled = int(min(max(frac, 0.0), 1.0) * width)
+        bar = "█" * filled + "░" * (width - filled)
+        pct = f"{frac * 100:3.0f}%"
+    parts = [
+        f"[watch] {s['runId']} {s.get('program') or ''}"
+        f"{' ' + s['phase'] if s.get('phase') else ''}",
+        f"▕{bar}▏ {_run_progress(s)} {pct}",
+    ]
+    if s.get("lastStepSeconds") is not None:
+        parts.append(f"step {s['lastStepSeconds'] * 1e3:.0f}ms")
+    if s.get("itPerSec") is not None:
+        parts.append(f"{s['itPerSec']:.1f} it/s" + (f" {spark}" if spark
+                                                    else ""))
+    if s.get("loss") is not None:
+        parts.append(f"loss {s['loss']:.5g}")
+    parts.append(f"eta {_fmt_duration(s.get('etaSeconds'))}")
+    if s.get("heartbeatAgeSeconds") is not None:
+        parts.append(f"hb {s['heartbeatAgeSeconds']:.1f}s")
+    if s["status"] == "STALLED":
+        parts.append(f"STALLED (threshold "
+                     f"{s['stallThresholdSeconds']:.0f}s)")
+    return " | ".join(parts)
+
+
+def cmd_watch(args) -> int:
+    """``pio watch``: live-tail the newest (or a named) training run
+    from its ledger — an external view, so it works on a run in another
+    process and keeps reporting (STALLED) when that process dies. Exits
+    0 when the run completes, 1 when it failed, 2 when there is nothing
+    to watch."""
+    import time as _time
+    from pathlib import Path
+
+    from predictionio_tpu.obs import runlog
+    from predictionio_tpu.obs.history import sparkline
+
+    directory = Path(args.runs_dir) if args.runs_dir else runlog.runs_dir()
+    if args.run_id:
+        path = directory / f"{args.run_id}.jsonl"
+        if not path.exists():
+            print(f"[ERROR] no run {args.run_id!r} under {directory}",
+                  file=sys.stderr)
+            return 2
+    else:
+        newest = runlog.list_runs(directory, limit=1)
+        if not newest:
+            print(f"[ERROR] no training runs under {directory} — start "
+                  "one with `pio train`.", file=sys.stderr)
+            return 2
+        path = Path(newest[0]["path"])
+    try:
+        while True:
+            run = runlog.read_run(path)
+            s = runlog.summarize(run)
+            spark = sparkline(runlog.throughput_series(run))
+            print(_watch_line(s, spark), flush=True)
+            if s["status"] in ("COMPLETED", "FAILED"):
+                med = (f"{(s['medianStepSeconds'] or 0) * 1e3:.0f}ms"
+                       if s.get("medianStepSeconds") is not None else "?")
+                print(f"[watch] run {s['runId']} {s['status']} "
+                      f"{_run_progress(s)} in "
+                      f"{_fmt_duration(s['durationSeconds'])} "
+                      f"(median step {med})")
+                return 0 if s["status"] == "COMPLETED" else 1
+            if args.once:
+                return 0
+            _time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_doctor(args) -> int:
     """``pio doctor``: pull the fleet's health surfaces (gateway status,
     per-replica statuses, /debug/slo, /debug/traces) and print a ranked
-    triage report; ``--fix`` escalates from naming offenders to acting
-    on them (restart/evict/reset via the gateway's remediation surface,
-    ``--dry-run`` to rehearse). Exit 0 = healthy, 1 = critical findings
-    (as found, before any fix), 2 = the front door is unreachable."""
+    triage report, prefixed by local run-ledger findings (a RUNNING
+    training run whose heartbeat went stale is a critical STALLED-RUN —
+    training health is judged even with no deployment up); ``--fix``
+    escalates from naming offenders to acting on them (restart/evict/
+    reset via the gateway's remediation surface, ``--dry-run`` to
+    rehearse). Exit 0 = healthy, 1 = critical findings (as found,
+    before any fix), 2 = the front door is unreachable (and no local
+    findings either)."""
     import json as _json
 
-    from predictionio_tpu.obs import fleet
+    from predictionio_tpu.obs import fleet, runlog
 
+    train_findings = runlog.diagnose_runs(getattr(args, "runs_dir", None))
     base = args.url.rstrip("/")
     status = _fetch_json(f"{base}/")
-    if status is None:
+    if status is None and not train_findings:
         print(f"[ERROR] cannot reach {base} — is the deployment up?",
               file=sys.stderr)
         return 2
-    is_gateway = status.get("role") == "gateway"
-    members = _fleet_members(base, status if is_gateway else None)
-    slo_state = _fetch_json(f"{base}/debug/slo")
-    traces_body = _fetch_json(
-        f"{base}/debug/traces?limit={max(args.traces, 0)}")
-    traces = (traces_body or {}).get("slowest") or []
-    findings = fleet.diagnose(
-        status if is_gateway else None, members, slo_state,
-        traces[: args.traces])
+    if status is None:
+        print(f"[WARN] cannot reach {base} — fleet surfaces skipped; "
+              "local run-ledger findings below.", file=sys.stderr)
+        is_gateway = False
+        slo_state = None
+        findings = train_findings
+    else:
+        is_gateway = status.get("role") == "gateway"
+        members = _fleet_members(base, status if is_gateway else None)
+        slo_state = _fetch_json(f"{base}/debug/slo")
+        traces_body = _fetch_json(
+            f"{base}/debug/traces?limit={max(args.traces, 0)}")
+        traces = (traces_body or {}).get("slowest") or []
+        findings = train_findings + fleet.diagnose(
+            status if is_gateway else None, members, slo_state,
+            traces[: args.traces])
     rc = 1 if any(f["severity"] == "critical" for f in findings) else 0
     actions: list[dict] = []
     if getattr(args, "fix", False) and findings:
@@ -841,9 +1060,11 @@ def cmd_doctor(args) -> int:
                            "actions": actions}, indent=2))
         return rc
     n_replicas = len(status.get("replicas", [])) if is_gateway else 1
-    print(f"[INFO] pio doctor @ {base} — "
-          f"{'gateway over ' + str(n_replicas) + ' replica(s)' if is_gateway else 'single query server'}")
-    if slo_state is None:
+    front = ("unreachable front door" if status is None else
+             f"gateway over {n_replicas} replica(s)" if is_gateway else
+             "single query server")
+    print(f"[INFO] pio doctor @ {base} — {front}")
+    if status is not None and slo_state is None:
         print("[WARN] /debug/slo unavailable (history disabled? "
               "PIO_HISTORY_INTERVAL_S=0) — no burn-rate judgment.")
     if not findings:
@@ -1480,6 +1701,27 @@ def cmd_status(args) -> int:
               "(pio_device_*); capture a device trace with `pio profile`.")
     except Exception as e:  # observability must not fail status
         print(f"[WARN] device telemetry probe failed: {e}", file=sys.stderr)
+    try:  # the training-run observatory (obs/runlog.py)
+        from predictionio_tpu.obs import runlog
+
+        rdir = runlog.runs_dir()
+        recent = runlog.list_runs(rdir, limit=3)
+        if recent:
+            print(f"[INFO] Training runs under {rdir} (newest 3):")
+            for r in recent:
+                hb = (f", heartbeat {r['heartbeatAgeSeconds']:.0f}s ago"
+                      if r["status"] in ("RUNNING", "STALLED")
+                      and r.get("heartbeatAgeSeconds") is not None else "")
+                print(f"[INFO]   run {r['runId']}: {r['status']} "
+                      f"{_run_progress(r)} {r.get('program') or ''}"
+                      f" {_fmt_duration(r['durationSeconds'])}{hb}")
+            print("[INFO] Follow live with `pio watch`; list with "
+                  "`pio runs`.")
+        else:
+            print(f"[INFO] Training runs: none recorded under {rdir} "
+                  "(`pio train` writes one ledger per run).")
+    except Exception as e:  # observability must not fail status
+        print(f"[WARN] run-ledger probe failed: {e}", file=sys.stderr)
     s = Storage.instance()
     for name, src in s.sources.items():
         print(f"[INFO] Storage source {name}: type={src.type}")

@@ -224,26 +224,9 @@ def _fleet_panel(status) -> str:
         + "".join(rows) + "</table>")
 
 
-_SPARK_CHARS = "▁▂▃▄▅▆▇█"
-
-
-def _sparkline(values: list) -> str:
-    """Unicode sparkline over the series' own min..max (gaps for None).
-    Character cells instead of an image/JS chart: zero dependencies and
-    it renders in any terminal dump of the page too."""
-    nums = [v for v in values if v is not None]
-    if not nums:
-        return ""
-    lo, hi = min(nums), max(nums)
-    span = (hi - lo) or 1.0
-    out = []
-    for v in values:
-        if v is None:
-            out.append(" ")
-        else:
-            idx = int((v - lo) / span * (len(_SPARK_CHARS) - 1))
-            out.append(_SPARK_CHARS[idx])
-    return "".join(out)
+# the one sparkline renderer lives beside the rings it draws
+# (obs/history.sparkline); `pio watch` shares it
+from predictionio_tpu.obs.history import sparkline as _sparkline  # noqa: E402
 
 
 def _history_panel(gw_status, points: int = 60) -> str:

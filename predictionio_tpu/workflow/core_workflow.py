@@ -36,7 +36,9 @@ def run_train(
     """Train → persist models → mark instance COMPLETED
     (ref: CoreWorkflow.runTrain:42-99). Returns the instance id.
     ``trace_dir`` wraps training in a JAX device trace (xprof)."""
-    from predictionio_tpu.obs import REGISTRY, trace
+    import hashlib
+
+    from predictionio_tpu.obs import REGISTRY, runlog, trace
     from predictionio_tpu.obs.jax_hooks import (
         install_jax_compile_hook,
         jax_compile_stats,
@@ -52,6 +54,11 @@ def run_train(
     install_jax_compile_hook()
     compile_before = jax_compile_stats()
     retraces_before = device_obs.total_retraces()
+    # the run ledger (obs/runlog.py): an external `pio watch` / `pio
+    # doctor` can follow this train's step progress and heartbeat from
+    # the runs dir without touching this process
+    params_hash = hashlib.sha1(
+        engine_instance.algorithms_params.encode()).hexdigest()[:12]
     try:
         ctx = workflow_context(batch=wp.batch, mode="Training")
         timer = PhaseTimer()
@@ -61,7 +68,11 @@ def run_train(
         # the dense-ALS transfer pipeline's pack/upload/readback spans
         # (io/transfer.py) nested under the train phase
         try:
-            with trace.span("run_train", instance=instance_id):
+            with runlog.run_scope(
+                    run_id=instance_id,
+                    engine=engine_instance.engine_factory,
+                    params_hash=params_hash), \
+                    trace.span("run_train", instance=instance_id):
                 # crash-safe training: publish the workflow checkpoint
                 # scope (dir/interval/resume) around the train so
                 # checkpoint-capable algorithms snapshot periodically
@@ -80,6 +91,7 @@ def run_train(
                 with device_trace(trace_dir), timer.phase("train"), \
                         trace.span("train"), ckpt_scope:
                     models = engine.train(ctx, engine_params, wp)
+                runlog.phase("train", timer.phases[-1][1])
                 # makePersistentModel stage (ref: Engine.makeSerializableModels:282-300)
                 with timer.phase("persist"), trace.span("persist"):
                     algorithms = engine._algorithms(engine_params)
@@ -98,6 +110,7 @@ def run_train(
                     blob = serialize_models(persisted)
                     Storage.get_model_data_models().insert(
                         Model(instance_id, blob))
+                runlog.phase("persist", timer.phases[-1][1])
         finally:
             # report in a finally so a persist-stage failure still logs
             # where the (possibly hours-long) train spent its time

@@ -7,8 +7,17 @@ so every sharding/collective path runs in CI without TPU hardware.
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Isolate the training-run ledger (obs/runlog.py): tests that train
+# under an active run scope must not write into the operator's
+# ~/.predictionio_tpu/runs, and doctor/status tests must not see stale
+# runs a previous (possibly killed) test session left behind.
+# Unconditional — an inherited PIO_RUNS_DIR would defeat the hermetic
+# point (tests reading/writing a real runs dir).
+os.environ["PIO_RUNS_DIR"] = tempfile.mkdtemp(prefix="pio-test-runs-")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -72,3 +72,186 @@ def test_serving_bench_gateway_dry_run_uses_gateway_metric_name():
     gateway-fronted and direct-replica topologies as one series."""
     _dry_run_doc("bench_serving.py", "ml100k_gateway_predict_p50_ms",
                  "--gateway")
+
+
+# ---------------------------------------------------------------------------
+# Sectioned + resumable bench (ISSUE 12): each section flushes its keys
+# to bench_captures/progress.json as it completes; --resume skips them.
+# The machinery is unit-tested here with injected fake sections (no
+# device work); the real dry-scale CLI round trip is the slow test below.
+# ---------------------------------------------------------------------------
+
+import pytest  # noqa: E402
+
+sys.path.insert(0, str(REPO_ROOT))
+import bench  # noqa: E402
+
+
+@pytest.fixture()
+def capture_dir(tmp_path, monkeypatch):
+    d = tmp_path / "captures"
+    d.mkdir()
+    monkeypatch.setattr(bench, "_capture_dir", lambda: str(d))
+    return d
+
+
+def _fake_sections(calls, fail_in=None, fail_exc=RuntimeError):
+    """Three fake sections; ``warm`` supplies the headline key. One can
+    be made to raise (guarded for 'late', unguarded for 'warm')."""
+
+    def make(name, keys):
+        def fn(state):
+            if name == fail_in:
+                raise fail_exc(f"{name} died")
+            calls.append(name)
+            state.extra.update(keys)
+            if name == "warm":
+                state.extra[bench.HEADLINE_METRIC] = 12.5
+
+        return fn
+
+    return [
+        ("early", make("early", {"early_iter_per_sec": 100.0}), None),
+        ("warm", make("warm", {"warm_key_s": 1.0}), None),
+        ("late", make("late", {"late_qps": 50.0}), "late_bench_error"),
+    ]
+
+
+def test_each_section_flushes_progress_and_final_doc_merges(capture_dir):
+    calls = []
+    doc = bench._collect(scale="dry", sections=_fake_sections(calls))
+    assert calls == ["early", "warm", "late"]
+    assert doc["value"] == 12.5
+    assert doc["extra"]["early_iter_per_sec"] == 100.0
+    assert doc["extra"]["late_qps"] == 50.0
+    prog = json.loads((capture_dir / "progress.json").read_text())
+    assert prog["partial"] is False
+    assert prog["extra"]["bench_sections_pending"] == []
+    assert prog["extra"]["bench_sections_done"] == ["early", "warm", "late"]
+
+
+def test_killed_run_leaves_partial_progress_with_headline(capture_dir):
+    """A wall-clock kill between sections (here: an unguarded section
+    failure, same flush path) must leave the completed sections' keys —
+    headline included — on disk. This is the r06 'parsed: null' fix."""
+    calls = []
+    with pytest.raises(RuntimeError):
+        bench._collect(scale="dry",
+                       sections=_fake_sections(calls, fail_in="late",
+                                               fail_exc=RuntimeError)[:2]
+                       + [("late", _boom, None)])
+    prog = json.loads((capture_dir / "progress.json").read_text())
+    assert prog["partial"] is True
+    assert prog["value"] == 12.5  # the headline already flushed
+    assert prog["extra"]["bench_sections_done"] == ["early", "warm"]
+    assert prog["extra"]["bench_sections_pending"] == ["late"]
+    assert prog["extra"]["early_iter_per_sec"] == 100.0
+
+
+def _boom(state):
+    raise RuntimeError("unguarded section died")
+
+
+def test_resume_skips_finished_sections(capture_dir):
+    calls = []
+    secs = _fake_sections(calls)
+    with pytest.raises(RuntimeError):
+        bench._collect(scale="dry", sections=secs[:2] + [("late", _boom,
+                                                          None)])
+    # resume with healthy sections: early/warm must NOT re-run
+    calls2 = []
+    doc = bench._collect(scale="dry", resume=True,
+                         sections=_fake_sections(calls2))
+    assert calls2 == ["late"]
+    assert doc["value"] == 12.5  # carried from the first run's flush
+    assert doc["extra"]["early_iter_per_sec"] == 100.0
+    assert doc["extra"]["late_qps"] == 50.0
+
+
+def test_resume_scale_mismatch_starts_fresh(capture_dir):
+    calls = []
+    bench._collect(scale="dry", sections=_fake_sections(calls))
+    calls2 = []
+    doc = bench._collect(scale="full", resume=True,
+                         sections=_fake_sections(calls2))
+    assert calls2 == ["early", "warm", "late"]  # nothing skipped
+    assert doc["value"] == 12.5
+
+
+def test_guarded_section_failure_degrades_not_fatal(capture_dir):
+    calls = []
+    doc = bench._collect(
+        scale="dry",
+        sections=_fake_sections(calls, fail_in="late"))
+    assert "late died" in doc["extra"]["late_bench_error"]
+    assert doc["extra"]["degraded_sections"] == ["late_bench_error"]
+    # the failed section still counts as attempted: resume won't loop it
+    prog = json.loads((capture_dir / "progress.json").read_text())
+    assert "late" in prog["extra"]["bench_sections_done"]
+
+
+def test_partial_progress_is_a_valid_bench_compare_candidate(capture_dir):
+    """The progress file IS a headline doc: bench_compare must load it,
+    compare shared keys, and report pending sections instead of
+    regressions for the missing ones."""
+    from predictionio_tpu.tools import bench_compare
+
+    calls = []
+    with pytest.raises(RuntimeError):
+        bench._collect(scale="dry",
+                       sections=_fake_sections(calls)[:2]
+                       + [("late", _boom, None)])
+    partial = bench_compare.load_headline(capture_dir / "progress.json")
+    assert bench_compare.pending_sections(partial) == ["late"]
+    flat = bench_compare.flatten_headline(partial)
+    assert flat[bench.HEADLINE_METRIC] == 12.5
+    assert "late_qps" not in flat
+    # full baseline vs partial candidate: the missing key is 'removed',
+    # never a regression
+    baseline = {bench.HEADLINE_METRIC: 12.5, "early_iter_per_sec": 100.0,
+                "late_qps": 50.0}
+    result = bench_compare.compare(baseline, flat)
+    assert result["regressions"] == []
+    assert "late_qps" in result["removed"]
+
+
+@pytest.mark.slow
+def test_dry_scale_cli_kill_and_resume_roundtrip():
+    """The real acceptance E2E: `timeout ... python bench.py --scale
+    dry` killed mid-run leaves completed sections' keys on disk, and
+    `--resume` finishes without redoing them, emitting the headline as
+    the final stdout line."""
+    import os
+    import subprocess as sp
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    prog = REPO_ROOT / "bench_captures" / "progress.json"
+    saved = prog.read_text() if prog.exists() else None
+    try:
+        if prog.exists():
+            prog.unlink()
+        try:
+            sp.run([sys.executable, str(REPO_ROOT / "bench.py"),
+                    "--scale", "dry"],
+                   cwd=REPO_ROOT, env=env, capture_output=True,
+                   timeout=45)
+        except sp.TimeoutExpired:
+            pass  # the expected wall-clock kill; a fast box may finish
+        assert prog.exists(), "no progress file after the first pass"
+        first = json.loads(prog.read_text())
+        done_before = first["extra"]["bench_sections_done"]
+        assert done_before, "no section completed within the wall"
+        p2 = sp.run([sys.executable, str(REPO_ROOT / "bench.py"),
+                     "--scale", "dry", "--resume"],
+                    cwd=REPO_ROOT, env=env, capture_output=True,
+                    text=True, timeout=600)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        doc = json.loads(p2.stdout.splitlines()[-1])
+        assert doc["metric"] == "ml20m_als_rank10_iterations_per_sec"
+        for name in done_before:
+            assert f"section {name} already captured" in p2.stderr
+    finally:
+        if saved is not None:
+            prog.write_text(saved)
+        elif prog.exists():
+            prog.unlink()

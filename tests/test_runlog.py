@@ -1,0 +1,416 @@
+"""Training-run observatory (obs/runlog.py): ledger append/rotation/
+atomicity under a killed writer, the doctor's STALLED-RUN judgment over
+a synthetic stale heartbeat, and the `pio runs` / `pio watch` render
+surfaces — all against temp run dirs, no live trainer needed."""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.obs import runlog
+
+
+@pytest.fixture()
+def run_dir(tmp_path, monkeypatch):
+    d = tmp_path / "runs"
+    monkeypatch.setenv("PIO_RUNS_DIR", str(d))
+    return d
+
+
+# ---------------------------------------------------------------------------
+# writer: append, heartbeat, retention, atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_run_scope_writes_start_steps_phases_end(run_dir):
+    with runlog.run_scope(run_id="r1", engine="org.x.E",
+                          params_hash="abc123") as w:
+        assert w is not None
+        runlog.phase("prepare", 0.5)
+        for i in range(3):
+            runlog.step("als_dense", iteration=i + 1, total=3,
+                        seconds=0.01, phase="solve")
+    run = runlog.read_run(run_dir / "r1.jsonl")
+    assert run["meta"]["engine"] == "org.x.E"
+    assert run["meta"]["paramsHash"] == "abc123"
+    assert [s["iteration"] for s in run["steps"]] == [1, 2, 3]
+    assert run["steps"][0]["program"] == "als_dense"
+    assert run["phases"][0] == {
+        **run["phases"][0], "phase": "prepare", "seconds": 0.5}
+    assert run["end"]["status"] == "COMPLETED"
+    s = runlog.summarize(run)
+    assert s["status"] == "COMPLETED"
+    assert s["progress"] == 1.0
+    assert s["medianStepSeconds"] == pytest.approx(0.01)
+
+
+def test_run_scope_marks_failed_and_reraises(run_dir):
+    with pytest.raises(RuntimeError):
+        with runlog.run_scope(run_id="boom"):
+            runlog.step("als_dense", iteration=1, total=5, seconds=0.01)
+            raise RuntimeError("mid-train kill")
+    s = runlog.summarize(runlog.read_run(run_dir / "boom.jsonl"))
+    assert s["status"] == "FAILED"
+    assert "mid-train kill" in s["error"]
+    # the scope must have deactivated: later steps are ledger-silent
+    assert runlog.active() is None
+
+
+def test_nested_scope_reuses_outer_run(run_dir):
+    with runlog.run_scope(run_id="outer") as w:
+        with runlog.run_scope(run_id="inner") as inner:
+            assert inner is w
+        # inner exit must NOT close the outer run
+        runlog.step("als_dense", iteration=1, total=1, seconds=0.01)
+    assert not (run_dir / "inner.jsonl").exists()
+    run = runlog.read_run(run_dir / "outer.jsonl")
+    assert run["end"]["status"] == "COMPLETED"
+    assert len(run["steps"]) == 1
+
+
+def test_killed_writer_torn_tail_is_skipped(run_dir):
+    """The crash window of an append is a torn final line; the reader
+    must keep every complete record and never raise."""
+    w = runlog.RunWriter("killed", run_dir)
+    for i in range(4):
+        w.step("als_dense", iteration=i + 1, total=10, seconds=0.05)
+    # simulate the kill: stop the writer (no end record), truncate
+    # mid-record (torn tail)
+    w.abandon()
+    path = run_dir / "killed.jsonl"
+    raw = path.read_bytes()
+    path.write_bytes(raw[: len(raw) - 17])
+    run = runlog.read_run(path)
+    assert run["end"] is None
+    assert 1 <= len(run["steps"]) <= 4
+    assert run["steps"][-1]["iteration"] < 5  # the torn record is gone
+    s = runlog.summarize(run, now=time.time())
+    assert s["status"] in ("RUNNING", "STALLED")  # never crashes
+
+
+def test_heartbeat_is_atomic_and_monotonic(run_dir):
+    w = runlog.RunWriter("hb", run_dir)
+    w.step("p", iteration=1, total=2, seconds=0.01)
+    doc1 = json.loads(w.hb_path.read_text())
+    assert doc1["pid"] == os.getpid()
+    w.heartbeat(iteration=2, total=2, force=True)
+    doc2 = json.loads(w.hb_path.read_text())
+    assert doc2["t"] >= doc1["t"]
+    assert doc2["iteration"] == 2
+    # no torn temp files left behind
+    assert list(run_dir.glob("*.tmp*")) == []
+
+
+def test_retention_cap_prunes_oldest(run_dir, monkeypatch):
+    monkeypatch.setenv("PIO_RUNS_RETAIN", "3")
+    for i in range(5):
+        w = runlog.RunWriter(f"r{i}", run_dir)
+        w.end("COMPLETED")
+        os.utime(w.path, (time.time() - 100 + i, time.time() - 100 + i))
+    names = sorted(p.stem for p in run_dir.glob("*.jsonl"))
+    assert len(names) == 3
+    assert "r4" in names  # newest kept
+    assert "r0" not in names and "r1" not in names
+    # heartbeats pruned alongside their ledgers
+    assert sorted(p.stem for p in run_dir.glob("*.hb")) == names
+
+
+def test_step_thinning_bounds_ledger_size(run_dir):
+    w = runlog.RunWriter("big", run_dir)
+    for i in range(5000):
+        w.step("p", iteration=i + 1, total=5000, seconds=1e-5)
+    w.end("COMPLETED")
+    run = runlog.read_run(w.path)
+    assert len(run["steps"]) <= 450
+    assert run["steps"][-1]["iteration"] == 5000  # the final step always lands
+
+
+# ---------------------------------------------------------------------------
+# stall judgment + doctor finding
+# ---------------------------------------------------------------------------
+
+
+def _stale_running_run(run_dir, age_s: float, step_s: float = 0.05):
+    """A RUNNING run whose trainer was killed ``age_s`` seconds ago:
+    abandon() stops the keepalive (what a SIGKILL does), then the last
+    beat is aged."""
+    w = runlog.RunWriter("stale", run_dir)
+    for i in range(4):
+        w.step("als_dense", iteration=i + 1, total=20, seconds=step_s)
+    w.abandon()
+    hb = json.loads(w.hb_path.read_text())
+    hb["t"] -= age_s
+    w.hb_path.write_text(json.dumps(hb))
+    return w
+
+
+def test_running_run_with_fresh_heartbeat_is_not_stalled(run_dir):
+    _stale_running_run(run_dir, age_s=0.0)
+    assert runlog.diagnose_runs(run_dir) == []
+    s = runlog.list_runs(run_dir)[0]
+    assert s["status"] == "RUNNING"
+
+
+def test_stale_heartbeat_yields_critical_stalled_finding(run_dir):
+    """A RUNNING run whose heartbeat age exceeds max(factor x median
+    step, grace) is the doctor's STALLED-RUN — within one heartbeat
+    window of the kill."""
+    _stale_running_run(run_dir, age_s=120.0)
+    findings = runlog.diagnose_runs(run_dir)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["severity"] == "critical"
+    assert "STALLED" in f["detail"]
+    assert "stale" in f["subject"]
+    s = runlog.list_runs(run_dir)[0]
+    assert s["status"] == "STALLED" and s["stalled"]
+
+
+def test_completed_run_is_never_stalled(run_dir):
+    w = runlog.RunWriter("done", run_dir)
+    w.step("als_dense", iteration=1, total=1, seconds=0.05)
+    w.end("COMPLETED")
+    hb = json.loads(w.hb_path.read_text())
+    hb["t"] -= 3600
+    w.hb_path.write_text(json.dumps(hb))
+    assert runlog.diagnose_runs(run_dir) == []
+
+
+def test_keepalive_beats_between_steps(run_dir):
+    """A long gap between step records (an XLA compile, a fused
+    dispatch) must NOT read as stalled: the keepalive thread refreshes
+    the heartbeat on its own clock."""
+    import predictionio_tpu.obs.runlog as rl
+
+    w = runlog.RunWriter("compiling", run_dir)
+    w.step("als_dense", iteration=1, total=10, seconds=0.05)
+    t0 = json.loads(w.hb_path.read_text())["t"]
+    deadline = time.time() + rl._HB_KEEPALIVE_INTERVAL * 3
+    fresher = False
+    while time.time() < deadline:
+        if json.loads(w.hb_path.read_text())["t"] > t0:
+            fresher = True
+            break
+        time.sleep(0.2)
+    w.end("COMPLETED")
+    assert fresher, "keepalive never refreshed the heartbeat"
+
+
+def test_stall_threshold_scales_with_median_step(monkeypatch):
+    monkeypatch.setenv("PIO_RUNS_STALL_FACTOR", "8")
+    monkeypatch.setenv("PIO_RUNS_STALL_GRACE", "10")
+    assert runlog.stall_threshold(None) == 10.0  # no steps: grace floor
+    assert runlog.stall_threshold(0.001) == 10.0  # fast stepper: floor
+    assert runlog.stall_threshold(60.0) == 480.0  # slow solver: 8x median
+
+
+def test_doctor_cli_flags_stalled_run_without_deployment(run_dir, capsys):
+    """`pio doctor` judges training health even when the serving front
+    door is down — the BENCH_r06 scenario (a train hung with nothing
+    deployed)."""
+    from predictionio_tpu.tools.cli import main
+
+    _stale_running_run(run_dir, age_s=300.0)
+    rc = main(["doctor", "--url", "http://127.0.0.1:1",
+               "--runs-dir", str(run_dir)])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "STALLED" in out.out
+    assert "[CRIT]" in out.out
+
+
+def test_doctor_json_includes_train_findings(run_dir, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    _stale_running_run(run_dir, age_s=300.0)
+    rc = main(["doctor", "--url", "http://127.0.0.1:1", "--json",
+               "--runs-dir", str(run_dir)])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert any("STALLED" in f["detail"] for f in doc["findings"])
+
+
+def test_doctor_unreachable_and_no_runs_still_rc2(run_dir, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    rc = main(["doctor", "--url", "http://127.0.0.1:1",
+               "--runs-dir", str(run_dir)])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# pio runs / pio watch render smoke
+# ---------------------------------------------------------------------------
+
+
+def _completed_run(run_dir, run_id="done1"):
+    with runlog.run_scope(run_id=run_id, engine="org.x.E",
+                          directory=run_dir):
+        runlog.phase("prepare", 0.1)
+        for i in range(5):
+            runlog.step("als_dense", iteration=i + 1, total=5,
+                        seconds=0.02, phase="solve")
+
+
+def test_pio_runs_lists_and_inspects(run_dir, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    _completed_run(run_dir)
+    assert main(["runs", "--runs-dir", str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "done1" in out and "COMPLETED" in out and "5/5" in out
+    assert main(["runs", "done1", "--runs-dir", str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "org.x.E" in out and "phase prepare" in out
+    assert main(["runs", "--runs-dir", str(run_dir), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc[0]["runId"] == "done1"
+
+
+def test_pio_runs_missing_run_errors(run_dir, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    assert main(["runs", "nope", "--runs-dir", str(run_dir)]) == 1
+
+
+def test_pio_watch_once_renders_progress_and_sparkline(run_dir, capsys):
+    """Watch render smoke: one frame of a finished run carries the
+    progress bar, counts, throughput and the final summary line."""
+    from predictionio_tpu.tools.cli import main
+
+    _completed_run(run_dir)
+    rc = main(["watch", "--once", "--runs-dir", str(run_dir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[watch] done1" in out
+    assert "5/5" in out and "100%" in out
+    assert "COMPLETED" in out
+    assert "█" in out  # the bar rendered
+
+
+def test_pio_watch_live_follows_run_to_completion(run_dir, capsys):
+    """Live watch against a writer stepping on another 'process': the
+    loop renders RUNNING frames and exits 0 on the end record."""
+    import threading
+
+    from predictionio_tpu.tools.cli import main
+
+    w = runlog.RunWriter("live1", run_dir)
+
+    def trainer():
+        for i in range(4):
+            time.sleep(0.1)
+            w.step("als_dense", iteration=i + 1, total=4, seconds=0.1)
+        w.end("COMPLETED")
+
+    t = threading.Thread(target=trainer)
+    t.start()
+    rc = main(["watch", "live1", "--runs-dir", str(run_dir),
+               "--interval", "0.1"])
+    t.join()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "COMPLETED" in out
+
+
+def test_pio_watch_no_runs_rc2(run_dir, capsys):
+    from predictionio_tpu.tools.cli import main
+
+    assert main(["watch", "--runs-dir", str(run_dir)]) == 2
+
+
+def test_watch_line_stalled_marker(run_dir):
+    from predictionio_tpu.tools.cli import _watch_line
+
+    _stale_running_run(run_dir, age_s=300.0)
+    s = runlog.list_runs(run_dir)[0]
+    line = _watch_line(s, "▁▂▃")
+    assert "STALLED" in line and "4/20" in line
+
+
+# ---------------------------------------------------------------------------
+# metrics + history integration
+# ---------------------------------------------------------------------------
+
+
+def test_step_metrics_feed_registry_and_history(run_dir):
+    from predictionio_tpu.obs import REGISTRY
+    from predictionio_tpu.obs.history import HistorySampler
+
+    with runlog.run_scope(run_id="m1", directory=run_dir):
+        sampler = HistorySampler(interval_s=1.0, capacity=8)
+        sampler.sample_once(t=1000.0)  # baseline tick
+        for i in range(3):
+            runlog.step("als_dense", iteration=i + 1, total=3,
+                        seconds=0.04)
+        values = sampler.sample_once(t=1001.0)
+        assert values["train_progress_ratio"] == 1.0
+        assert values["train_step_p50_ms"] == pytest.approx(40, rel=0.6)
+        assert values["train_heartbeat_age_seconds"] is not None
+    hist = REGISTRY.get("pio_train_step_seconds")
+    assert hist.count(program="als_dense") >= 3
+
+
+def test_empty_ledger_corpse_ages_into_stalled(run_dir, capsys):
+    """A trainer killed before flushing ANY record (empty ledger, no
+    heartbeat) must still age into STALLED via the ledger file's mtime —
+    and `pio runs <id>` must render it, not crash on the None fields."""
+    from predictionio_tpu.tools.cli import main
+
+    path = run_dir / "corpse.jsonl"
+    run_dir.mkdir(parents=True, exist_ok=True)
+    path.write_text("")
+    old = time.time() - 300
+    os.utime(path, (old, old))
+    s = runlog.summarize(runlog.read_run(path))
+    assert s["status"] == "STALLED"
+    assert any("corpse" in f["subject"]
+               for f in runlog.diagnose_runs(run_dir))
+    assert main(["runs", "corpse", "--runs-dir", str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "STALLED" in out
+
+
+def test_keepalive_beat_preserves_step_progress(run_dir):
+    """A keepalive beat (no args) must re-emit the last step's
+    iteration/total/phase — not erase them and make `pio watch` jump
+    backward to the thinned ledger's older progress."""
+    w = runlog.RunWriter("prog", run_dir)
+    w.step("p", iteration=7, total=10, seconds=0.01, phase="solve")
+    w.heartbeat(force=True)  # what the keepalive thread does
+    hb = json.loads(w.hb_path.read_text())
+    w.end("COMPLETED")
+    assert hb["iteration"] == 7
+    assert hb["total"] == 10
+    assert hb["phase"] == "solve"
+
+
+def test_gauges_cleared_when_run_ends(run_dir):
+    """pio_train_heartbeat_age_seconds / progress_ratio are documented
+    'absent outside a run' — a frozen post-run value would read as a
+    forever-fresh heartbeat."""
+    from predictionio_tpu.obs import REGISTRY
+
+    with runlog.run_scope(run_id="g1", directory=run_dir):
+        runlog.step("p", iteration=1, total=2, seconds=0.01)
+        REGISTRY._run_collect_hooks()
+        assert "pio_train_heartbeat_age_seconds" in REGISTRY.expose()
+    samples = [line for line in REGISTRY.expose().splitlines()
+               if not line.startswith("#")]
+    assert not any(line.startswith("pio_train_heartbeat_age_seconds")
+                   for line in samples)
+    assert not any(line.startswith("pio_train_progress_ratio")
+                   for line in samples)
+
+
+def test_sparkline_render():
+    from predictionio_tpu.obs.history import sparkline
+
+    s = sparkline([1, 2, 3, None, 8])
+    assert len(s) == 5
+    assert s[3] == " "
+    assert s[0] == "▁" and s[4] == "█"
+    assert sparkline([]) == ""
