@@ -253,6 +253,7 @@ def bench_query_latency(
                 else:
                     os.environ["PIO_SERVING_DEVICE"] = prev
             out.update(_trace_overhead(srv.port))
+            out.update(_quality_section(srv.port))
             return out
         finally:
             srv.stop()
@@ -260,6 +261,81 @@ def bench_query_latency(
         from predictionio_tpu.data.storage import Storage
 
         Storage.reset()
+
+
+def _quality_section(port: int, feedback_every: int = 3) -> dict:
+    """Prediction-quality headline keys (obs/quality.py, ISSUE 13).
+
+    ``quality_join_rate``: the bench traffic above was sampled into the
+    feedback join buffer; post deterministic feedback for every
+    ``feedback_every``-th buffered request (through the monitor — the
+    server is in-process) and report the measured joined/sampled
+    fraction, exercising the real join path end to end.
+
+    ``shadow_overlap_at_k``: retrain on the identical event log (same
+    seed → a near-identical model) and hit ``GET /reload``; the
+    response's shadow block replays the sampled live queries against
+    the candidate, so a healthy pipeline reports overlap@k ≈ 1.0 — the
+    same machinery that catches a corrupted candidate near 0.0.
+
+    Both keys are higher-is-better for `pio bench-compare`; nulls on
+    failure (and in ``--dry-run``) keep the capture schema stable."""
+    out: dict = {"quality_join_rate": None, "shadow_overlap_at_k": None}
+    try:
+        from predictionio_tpu.obs import quality
+
+        mon = quality.MONITOR
+        for i, (rid, item) in enumerate(mon.join_snapshot()):
+            if i % feedback_every == 0:
+                mon.record_feedback(rid, item)
+        doc = mon.to_json()
+        sampled = sum(s.get("sampled") or 0
+                      for s in doc["instances"].values())
+        joined = sum(s.get("joined") or 0
+                     for s in doc["instances"].values())
+        if sampled:
+            out["quality_join_rate"] = round(joined / sampled, 3)
+    except Exception:  # noqa: BLE001 — quality keys are best-effort
+        pass
+    try:
+        _retrain_candidate()  # same events, same seed → a near-twin
+        c = _Client(port)
+        c.conn.request("GET", "/reload")
+        resp = c.conn.getresponse()
+        body = json.loads(resp.read() or b"{}")
+        c.close()
+        shadow = (body or {}).get("shadow") or {}
+        if shadow.get("overlapAtK") is not None:
+            out["shadow_overlap_at_k"] = shadow["overlapAtK"]
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def _retrain_candidate(rank: int = 10) -> str:
+    """Train a second engine instance on the live bench storage's
+    existing event log (no reseeding) — the /reload candidate the
+    shadow scorer judges."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.templates.recommendation import engine_factory
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    engine = engine_factory()
+    variant = {
+        "engineFactory": factory,
+        "datasource": {"params": {"app_name": "benchapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": rank, "numIterations": 5, "seed": 0}}
+        ],
+    }
+    ep = engine.engine_params_from_json(variant)
+    instance = new_engine_instance("default", "1", "default", factory, ep)
+    return run_train(engine, ep, instance, WorkflowParams())
 
 
 def _trace_overhead(port: int, requests: int = 200) -> dict:
@@ -984,6 +1060,11 @@ def _dry_run_doc(gateway: bool = False) -> dict:
             "serve_device_qps": None,
             "serve_device_p50_ms": None,
             "serve_readback_overlap_frac": None,
+            # prediction-quality keys (ISSUE 13) ride every capture;
+            # dry runs emit them as nulls so the schema is stable —
+            # both are higher-is-better under pio bench-compare
+            "quality_join_rate": None,
+            "shadow_overlap_at_k": None,
         },
         metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
 

@@ -238,6 +238,14 @@ class EventService:
         if status == 201:
             global _last_commit_walltime
             _last_commit_walltime = time.time()
+            if event is not None:
+                # online-accuracy join (obs/quality.py): an event
+                # carrying the feedback loop's requestId property joins
+                # the sampled served top-k it responds to; fail-soft —
+                # quality bookkeeping must never fail an ingest
+                from predictionio_tpu.obs import quality
+
+                quality.observe_event(event)
         if t0 is not None:
             _INGEST_SECONDS.observe(time.perf_counter() - t0)
         if self.config.stats:

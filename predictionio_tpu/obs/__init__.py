@@ -30,6 +30,12 @@ for every perf PR is quantified hot paths. This package provides:
     ``pio runs`` / ``pio watch`` / ``pio doctor`` (STALLED-RUN
     judgment). Imported lazily by the training paths; library users of
     obs pay nothing for it.
+  * The prediction-quality observatory (:mod:`predictionio_tpu.obs.quality`,
+    the fifth pillar): score-drift detection against a trained baseline,
+    the feedback-joined online hit-rate ledger behind the
+    ``online_quality`` SLO, and the ``/reload`` shadow scorer — surfaced
+    as ``GET /debug/quality`` / ``pio quality``. Imported eagerly (it is
+    pure stdlib) so its counters predate the first history tick.
   * The fleet layer: metrics federation over a multi-process deploy
     (:mod:`predictionio_tpu.obs.fleet`, ``GET /metrics/fleet`` on the
     gateway), local time-series history rings
@@ -70,3 +76,8 @@ from predictionio_tpu.obs import trace  # noqa: E402,F401
 # registers their gauges and the unattributed-HBM collect hook in the
 # same breath as the rest of the scrape surface.
 from predictionio_tpu.obs import device, profile  # noqa: E402,F401
+# Prediction-quality pillar: imported eagerly so its counters exist
+# from the process's FIRST history tick — a family born mid-burst costs
+# the rings that burst (the sampler's first sighting of a counter
+# establishes a baseline, it can't compute a rate).
+from predictionio_tpu.obs import quality  # noqa: E402,F401

@@ -443,7 +443,8 @@ def _finding(severity: str, subject: str, detail: str,
 def diagnose(gateway_status: dict | None,
              members: list[dict],
              slo_state: dict | None,
-             traces: list[dict] | None = None) -> list[dict]:
+             traces: list[dict] | None = None,
+             quality: dict | None = None) -> list[dict]:
     """Rank what's wrong, most actionable first. Pure function of the
     fetched surfaces so the heuristics unit-test without a deploy:
 
@@ -451,6 +452,12 @@ def diagnose(gateway_status: dict | None,
       * unreachable / down / suspect replicas and open breakers;
       * per-replica outliers vs the fleet median p99 and error ratio;
       * tripped device routes and stale models;
+      * prediction-quality judgment (``quality`` = a ``/debug/quality``
+        doc): QUALITY-DRIFT / QUALITY-REGRESSION naming the engine
+        instance and its model age, plus a starving feedback loop. A
+        breached ``model_staleness`` SLO FOLDS INTO the quality finding
+        for one ranked story — "the model is old AND its answers
+        degraded" is one problem, not two rows;
       * the slowest retained traces, as leads.
 
     Findings with a mechanical fix carry an ``action`` hint
@@ -459,6 +466,7 @@ def diagnose(gateway_status: dict | None,
     """
     findings: list[dict] = []
     # -- SLO judgment
+    staleness_rows: list[dict] = []
     for slo in (slo_state or {}).get("slos", []):
         burns = slo.get("burnRates") or {}
         fast, slow = burns.get("fast"), burns.get("slow")
@@ -466,13 +474,40 @@ def diagnose(gateway_status: dict | None,
                     f"{slow if slow is not None else 'n/a'}x slow "
                     f"(threshold {slo.get('burnThreshold')}x)")
         if slo.get("breached"):
-            findings.append(_finding(
+            row = _finding(
                 "critical", f"SLO {slo['name']}",
-                f"BREACHED: {burn_txt} — {slo.get('description', '')}"))
+                f"BREACHED: {burn_txt} — {slo.get('description', '')}")
         elif fast is not None and fast > slo.get("burnThreshold", 14.4):
-            findings.append(_finding(
+            row = _finding(
                 "warn", f"SLO {slo['name']}",
-                f"fast-window burn over threshold: {burn_txt}"))
+                f"fast-window burn over threshold: {burn_txt}")
+        else:
+            continue
+        findings.append(row)
+        if slo.get("name") == "model_staleness":
+            staleness_rows.append(row)
+    # -- prediction quality (obs/quality.py findings, staleness folded)
+    from predictionio_tpu.obs import quality as quality_mod
+
+    quality_rows = quality_mod.quality_findings(quality)
+    fold_target = next(
+        (row for row in quality_rows
+         if row["subject"].startswith("QUALITY-")), None)
+    if fold_target is not None and staleness_rows:
+        # one ranked story: the model-related quality row carries the
+        # staleness burn and the standalone SLO row leaves the report.
+        # The folded row keeps the WORST severity of the two — folding
+        # a critical breach into a warn-band drift must not downgrade
+        # the doctor's exit code
+        stale = staleness_rows[0]
+        if _SEVERITY_RANK.get(stale["severity"], 3) < \
+                _SEVERITY_RANK.get(fold_target["severity"], 3):
+            fold_target["severity"] = stale["severity"]
+        fold_target["detail"] += (f"; meanwhile {stale['subject']} "
+                                  f"{stale['detail']}")
+        for row in staleness_rows:
+            findings.remove(row)
+    findings.extend(quality_rows)
     # -- replica state from the gateway's view
     breakers_open = []
     for rep in (gateway_status or {}).get("replicas", []):

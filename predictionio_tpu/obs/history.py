@@ -278,6 +278,25 @@ class HistorySampler:
             reg, "pio_serving_model_age_seconds")
         values["ingest_last_event_age_seconds"] = _gauge_max(
             reg, "pio_ingest_last_event_age_seconds")
+        # prediction quality (obs/quality.py; the drift gauge refreshes
+        # via the collect-hook run above). The hit rate is an interval
+        # ratio of JOINED feedback — hits over hits+misses — so the
+        # online_quality SLO judges accuracy, not join coverage; the
+        # join rate separately says how much evidence each interval had
+        values["prediction_drift_score"] = _gauge_max(
+            reg, "pio_prediction_drift_score")
+        values["online_hit_rate"] = self._ratio_rate(
+            "qual_hit",
+            ct(reg, "pio_quality_feedback_total", "result", ("hit",)),
+            ct(reg, "pio_quality_feedback_total", "result", ("miss",)),
+            dt)
+        values["quality_join_rate"] = self._div_rate(
+            "qual_join",
+            ct(reg, "pio_quality_feedback_total", "result",
+               ("hit", "miss")),
+            ct(reg, "pio_quality_sampled_total"), dt)
+        values["feedback_error_rate"] = self._rate(
+            "feedback_err", ct(reg, "pio_feedback_errors_total"), dt)
         # training (the run-ledger pillar, obs/runlog.py): step latency,
         # progress and heartbeat age ride the same rings so a trainer
         # process's /debug/history answers "is it moving?" — the
@@ -298,6 +317,18 @@ class HistorySampler:
         if dn is None or dm is None or dn + dm <= 0:
             return None
         return dn / (dn + dm)
+
+    def _div_rate(self, key: str, num: float | None, den: float | None,
+                  dt: float) -> float | None:
+        """Interval quotient of two counters: Δnum / Δden (None without
+        denominator traffic; may exceed 1 when the numerator answers
+        older intervals' work — the quality join rate does when delayed
+        feedback lands)."""
+        dn = self._rate(key + ":n", num, dt)
+        dd = self._rate(key + ":d", den, dt)
+        if dn is None or dd is None or dd <= 0:
+            return None
+        return dn / dd
 
     # -- the tick -----------------------------------------------------------
     def sample_once(self, t: float | None = None) -> dict[str, float | None]:

@@ -1,0 +1,977 @@
+"""Prediction-quality observatory: the fifth observability pillar.
+
+The first four pillars (tracing, device profiling, fleet SLOs, the
+training-run ledger) say how fast and how reliably the system answers;
+this module says whether the answers are any GOOD — the online
+model-quality monitoring the ads-infra line of work (PAPERS.md) treats
+as production table stakes. Three capabilities, one process-global
+:class:`QualityMonitor`:
+
+  * **Score/output drift.** ``run_train`` persists a per-instance
+    baseline into the engine-instance ``env`` (``quality_baseline``:
+    a score-distribution histogram sketch plus a top-k popularity/
+    coverage profile from a held-out query sample, built by
+    :func:`baseline_env`). The query server samples live predictions
+    (``PIO_QUALITY_SAMPLE`` — ``off`` | ``all`` | a probability, the
+    trace-sampling grammar) into a windowed per-instance sketch and the
+    monitor's collect hook publishes ``pio_prediction_score_*``,
+    ``pio_prediction_drift_score{instance}`` (population-stability index
+    vs the baseline), and item-coverage / popularity-skew gauges, all
+    riding the obs/history rings.
+  * **Feedback-joined online accuracy.** Sampled served top-k sets wait
+    in a bounded TTL join buffer keyed by request id; the event server
+    feeds ingested events through :func:`observe_event`, and an event
+    carrying the ``requestId`` the feedback loop stamps
+    (workflow/create_server.py) joins its serving record — a hit when
+    the acted-on item was in the served set — attributed to the engine
+    instance (and model age) THAT REQUEST was served by, even if a
+    hot-swap landed in between. Windowed hit rate lands in
+    ``pio_online_hit_rate`` and the ``online_quality`` SLO (obs/slo.py).
+  * **Shadow-scored hot swaps.** The monitor keeps the last N sampled
+    queries; ``/reload`` replays them against the candidate instance on
+    the host path before committing the swap and reports score shift +
+    top-k overlap (the ``shadow`` block; ``PIO_RELOAD_SHADOW_GATE``
+    optionally refuses swaps below an overlap floor).
+
+Everything is fail-soft and bounded: sampling off costs a memoized env
+read per query, the join buffer is capacity- and TTL-evicted, and a
+broken baseline never sinks a train or a deploy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import math
+import os
+import random
+import threading
+import time
+from collections import Counter as _TallyCounter, OrderedDict, deque
+
+from predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "MONITOR",
+    "QualityMonitor",
+    "baseline_env",
+    "build_baseline",
+    "extract_item_scores",
+    "merge_docs",
+    "observe_event",
+    "population_stability_index",
+    "quality_enabled",
+    "quality_findings",
+    "sample",
+    "sample_mode",
+    "shadow_gate_floor",
+]
+
+#: Engine-instance env key the trained baseline sketch persists under.
+#: Deliberately NOT ``pio_``-prefixed: that namespace is the metric
+#: scrape contract (tools/check_metrics.py enforces it against the
+#: docs), and this is stored state, not a metric.
+BASELINE_ENV_KEY = "quality_baseline"
+
+_SAMPLED = REGISTRY.counter(
+    "pio_quality_sampled_total",
+    "Live predictions sampled into the quality window and join buffer",
+    labels=("instance",),
+)
+_FEEDBACK = REGISTRY.counter(
+    "pio_quality_feedback_total",
+    "Feedback events processed against the join buffer: hit (acted-on "
+    "item was in the served top-k), miss, unknown (no buffered request "
+    "id — never sampled, expired, or another process served it), "
+    "duplicate (request id already consumed)",
+    labels=("result",),
+)
+_JOIN_EVICTIONS = REGISTRY.counter(
+    "pio_quality_join_evictions_total",
+    "Join-buffer entries dropped before any feedback arrived, by "
+    "reason (ttl = outlived PIO_QUALITY_JOIN_TTL_S, capacity = pushed "
+    "out by PIO_QUALITY_JOIN_CAP)",
+    labels=("reason",),
+)
+_JOIN_ENTRIES = REGISTRY.gauge(
+    "pio_quality_join_buffer_entries",
+    "Served top-k sets currently waiting in the feedback join buffer",
+)
+_HIT_RATE = REGISTRY.gauge(
+    "pio_online_hit_rate",
+    "Windowed online accuracy per engine instance: feedback-joined "
+    "requests whose acted-on item was in the served top-k, over the "
+    "trailing PIO_QUALITY_WINDOW_S",
+    labels=("instance",),
+)
+_SCORE_MEAN = REGISTRY.gauge(
+    "pio_prediction_score_mean",
+    "Mean top-k prediction score over the sampled live window, per "
+    "serving engine instance",
+    labels=("instance",),
+)
+_SCORE_P50 = REGISTRY.gauge(
+    "pio_prediction_score_p50",
+    "Median top-k prediction score over the sampled live window",
+    labels=("instance",),
+)
+_DRIFT = REGISTRY.gauge(
+    "pio_prediction_drift_score",
+    "Population-stability index of the live score distribution vs the "
+    "instance's trained baseline sketch (rule of thumb: <0.1 stable, "
+    "0.1-0.25 drifting, >0.25 major shift)",
+    labels=("instance",),
+)
+_COVERAGE = REGISTRY.gauge(
+    "pio_prediction_item_coverage",
+    "Distinct items served in the sampled window as a fraction of the "
+    "trained catalog (needs a baseline for the catalog size)",
+    labels=("instance",),
+)
+_POP_SKEW = REGISTRY.gauge(
+    "pio_prediction_popularity_skew",
+    "Share of sampled top-k slots taken by the single most-served item "
+    "(1.0 = every slot is one item)",
+    labels=("instance",),
+)
+_SHADOW_OVERLAP = REGISTRY.gauge(
+    "pio_reload_shadow_overlap",
+    "Top-k overlap@k between the serving and candidate instances in "
+    "the last /reload shadow replay",
+)
+_SHADOW_SWAPS = REGISTRY.counter(
+    "pio_reload_shadow_swaps_total",
+    "Shadow-scored /reload outcomes: ok (committed), blocked (refused "
+    "by PIO_RELOAD_SHADOW_GATE), unjudged (no sampled queries to "
+    "replay)",
+    labels=("result",),
+)
+
+
+# -- env knobs (read per call so live processes retune) ----------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+#: (raw env value, parsed mode) memo — the mode check runs per query.
+_mode_cache: tuple[str | None, str] = (None, "all")
+
+
+def sample_mode() -> str:
+    """``PIO_QUALITY_SAMPLE``: ``off`` | ``all`` (default) | a
+    probability in (0, 1) — the trace-sampling grammar, minus ``slow``
+    (quality has no latency to threshold on)."""
+    global _mode_cache
+    env = os.environ.get("PIO_QUALITY_SAMPLE")
+    cached_env, cached_mode = _mode_cache
+    if env == cached_env:
+        return cached_mode
+    raw = (env if env is not None else "all").strip().lower()
+    if raw in ("off", "0", "false", "none", ""):
+        mode = "off"
+    elif raw in ("all", "1", "true"):
+        mode = "all"
+    else:
+        try:
+            p = float(raw)
+            mode = "off" if p <= 0.0 else "all" if p >= 1.0 else raw
+        except ValueError:
+            logger.warning("unrecognized PIO_QUALITY_SAMPLE=%r; "
+                           "falling back to 'all'", env)
+            mode = "all"
+    _mode_cache = (env, mode)
+    return mode
+
+
+def quality_enabled() -> bool:
+    return sample_mode() != "off"
+
+
+def sample(request_id: str | None = None) -> bool:
+    """Head decision for one served prediction. With a request id the
+    decision is a DETERMINISTIC hash of the id, so every process that
+    sees the same request (the query server at serve time, the event
+    server on the feedback loop's predict event) draws the same coin —
+    independent draws would double the effective rate in-process and
+    desynchronize the split-deploy join."""
+    mode = sample_mode()
+    if mode == "off":
+        return False
+    if mode == "all":
+        return True
+    p = float(mode)
+    if request_id:
+        digest = hashlib.sha1(request_id.encode("utf-8", "replace"))
+        return int.from_bytes(digest.digest()[:4], "big") / 2**32 < p
+    return random.random() < p
+
+
+def join_ttl_s() -> float:
+    return _env_float("PIO_QUALITY_JOIN_TTL_S", 600.0)
+
+
+def join_capacity() -> int:
+    return max(_env_int("PIO_QUALITY_JOIN_CAP", 4096), 1)
+
+
+def window_size() -> int:
+    return max(_env_int("PIO_QUALITY_WINDOW", 256), 8)
+
+
+def window_s() -> float:
+    return _env_float("PIO_QUALITY_WINDOW_S", 600.0)
+
+
+def replay_size() -> int:
+    return max(_env_int("PIO_QUALITY_REPLAY_N", 32), 1)
+
+
+def baseline_sample_n() -> int:
+    return max(_env_int("PIO_QUALITY_BASELINE_N", 64), 4)
+
+
+def baseline_k() -> int:
+    return max(_env_int("PIO_QUALITY_TOPK", 10), 1)
+
+
+def shadow_gate_floor() -> float | None:
+    """``PIO_RELOAD_SHADOW_GATE``: minimum shadow overlap@k a /reload
+    candidate must clear before the swap commits; unset/empty = the
+    shadow report is advisory only."""
+    raw = os.environ.get("PIO_RELOAD_SHADOW_GATE", "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("bad PIO_RELOAD_SHADOW_GATE=%r; gate disabled", raw)
+        return None
+
+
+# -- score extraction --------------------------------------------------------
+
+def extract_item_scores(result) -> list[tuple[str | None, float]]:
+    """``(item, score)`` pairs from a prediction in any of the shapes
+    the serving path produces: a template ``PredictedResult`` (an
+    ``itemScores`` sequence of objects or dicts), the JSON dict the
+    server returns, or a bare scalar-``score`` prediction. Unknown
+    shapes yield ``[]`` — quality sampling must never fail a query."""
+    pairs: list[tuple[str | None, float]] = []
+    try:
+        item_scores = None
+        if isinstance(result, dict):
+            item_scores = result.get("itemScores")
+        else:
+            item_scores = getattr(result, "itemScores", None)
+        if item_scores is not None:
+            for entry in item_scores:
+                if isinstance(entry, dict):
+                    item, score = entry.get("item"), entry.get("score")
+                else:
+                    item = getattr(entry, "item", None)
+                    score = getattr(entry, "score", None)
+                if isinstance(score, (int, float)) and not isinstance(
+                        score, bool) and math.isfinite(float(score)):
+                    pairs.append((None if item is None else str(item),
+                                  float(score)))
+            return pairs
+        score = (result.get("score") if isinstance(result, dict)
+                 else getattr(result, "score", None))
+        if isinstance(score, (int, float)) and not isinstance(score, bool) \
+                and math.isfinite(float(score)):
+            pairs.append((None, float(score)))
+    except Exception:  # noqa: BLE001 — never fail the serving path
+        logger.debug("score extraction failed", exc_info=True)
+    return pairs
+
+
+# -- baseline sketch ---------------------------------------------------------
+
+def _score_bins(scores: list[float], edges: list[float]) -> list[float]:
+    """Normalized occupancy over the ``len(edges)+1`` bins the edges
+    split the real line into."""
+    counts = [0] * (len(edges) + 1)
+    for s in scores:
+        lo, hi = 0, len(edges)
+        while lo < hi:  # bisect_right, inlined to avoid float-key import
+            mid = (lo + hi) // 2
+            if s < edges[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        counts[lo] += 1
+    total = float(sum(counts)) or 1.0
+    return [c / total for c in counts]
+
+
+def population_stability_index(baseline_counts: list[float],
+                               live_scores: list[float],
+                               edges: list[float]) -> float | None:
+    """PSI of the live values against the baseline's binned
+    distribution, on the BASELINE's bin edges: ``sum((q-p) * ln(q/p))``.
+    Both sides get Laplace smoothing (α=0.5 per bin) so a small live
+    window's empty bins read as sampling noise, not as a vanished
+    population — raw epsilon smoothing makes PSI explode at the exact
+    moment (few samples) a drift monitor must stay quiet."""
+    if not live_scores or not baseline_counts or \
+            len(baseline_counts) != len(edges) + 1:
+        return None
+    bins = len(baseline_counts)
+    alpha = 0.5
+    n_base = float(sum(baseline_counts))
+    n_live = float(len(live_scores))
+    live_counts = [f * n_live for f in _score_bins(live_scores, edges)]
+    psi = 0.0
+    for cb, cl in zip(baseline_counts, live_counts):
+        p = (cb + alpha) / (n_base + alpha * bins)
+        q = (cl + alpha) / (n_live + alpha * bins)
+        psi += (q - p) * math.log(q / p)
+    return psi
+
+
+def build_baseline(scored: list[list[tuple[str | None, float]]],
+                   n_items: int | None = None,
+                   k: int | None = None) -> dict | None:
+    """The persisted per-instance baseline: decile bin edges + counts of
+    the held-out sample's TOP score per query (the top score is
+    invariant to how many items a live query asks for, so a ``num: 5``
+    request drifts only when the model does), plus the popularity/
+    coverage profile of its served items. ``scored`` is one
+    ``(item, score)`` list per probe query."""
+    scores = [s for pairs in scored for _, s in pairs]
+    tops = [max(s for _, s in pairs) for pairs in scored if pairs]
+    if not scores or not tops:
+        return None
+    ordered = sorted(tops)
+    n = len(ordered)
+    edges = []
+    for decile in range(1, 10):
+        edges.append(ordered[min(int(n * decile / 10), n - 1)])
+    counts = [c * n for c in _score_bins(tops, edges)]
+    tally = _TallyCounter(i for pairs in scored for i, _ in pairs
+                          if i is not None)
+    slots = sum(tally.values())
+    doc = {
+        "v": 1,
+        "queries": len(scored),
+        "k": k if k is not None else max(len(p) for p in scored),
+        "scoreMean": sum(scores) / len(scores),
+        "edges": [round(e, 6) for e in edges],
+        "counts": [round(c, 3) for c in counts],
+        "topShare": (max(tally.values()) / slots) if slots else None,
+        "distinctItems": len(tally),
+    }
+    if n_items:
+        doc["nItems"] = int(n_items)
+        doc["coverage"] = len(tally) / n_items
+    return doc
+
+
+def baseline_env(engine, engine_params, models) -> dict[str, str]:
+    """The train-time half of drift detection: probe each algorithm that
+    exposes ``quality_probe_queries(model, n, k)`` with a held-out query
+    sample, score the answers on the host path, and return the sketch as
+    the ``{BASELINE_ENV_KEY: json}`` fragment ``run_train`` merges into
+    the engine-instance env. ``{}`` when no algorithm opts in or the
+    probe fails — a baseline must never sink a train."""
+    try:
+        algorithms = engine._algorithms(engine_params)
+        for algo, model in zip(algorithms, models):
+            probe = getattr(algo, "quality_probe_queries", None)
+            if probe is None:
+                continue
+            queries = probe(model, n=baseline_sample_n(), k=baseline_k())
+            scored = [pairs for pairs in
+                      (extract_item_scores(p)
+                       for p in batch_predictions(algo, model, queries))
+                      if pairs]
+            if not scored:
+                continue
+            ids = getattr(model, "item_ids", None)
+            n_items = len(ids) if ids is not None and len(ids) else None
+            doc = build_baseline(scored, n_items=n_items, k=baseline_k())
+            if doc is not None:
+                return {BASELINE_ENV_KEY: json.dumps(doc)}
+    except Exception:  # noqa: BLE001
+        logger.debug("quality baseline probe failed", exc_info=True)
+    return {}
+
+
+def batch_predictions(algo, model, queries) -> list:
+    """Predictions for ``queries`` via ONE ``batch_predict`` call when
+    the algorithm has one (one catalog upload/matmul for the whole
+    probe or shadow replay, not one per query), falling back to the
+    per-query path. A query that fails yields None in its slot."""
+    n = len(queries)
+    if n == 0:
+        return []
+    try:
+        got = dict(algo.batch_predict(model, list(enumerate(queries))))
+        return [got.get(i) for i in range(n)]
+    except Exception:  # noqa: BLE001 — per-query fallback isolates one
+        out = []       # bad query instead of losing the whole probe
+        for q in queries:
+            try:
+                out.append(algo.predict(model, q))
+            except Exception:  # noqa: BLE001
+                out.append(None)
+        return out
+
+
+# -- the monitor -------------------------------------------------------------
+
+class _JoinEntry:
+    __slots__ = ("t", "instance", "model_age_s", "items")
+
+    def __init__(self, t: float, instance: str, model_age_s: float | None,
+                 items: frozenset):
+        self.t = t
+        self.instance = instance
+        self.model_age_s = model_age_s
+        self.items = items
+
+
+class QualityMonitor:
+    """Process-global quality state: the sampled-prediction window, the
+    feedback join buffer, the shadow replay buffer, and per-instance
+    tallies. All methods are thread-safe and bounded."""
+
+    #: per-instance tallies kept for at most this many instances (old
+    #: swapped-out instances age out of the doc, newest last)
+    MAX_INSTANCES = 8
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reset_locked()
+
+    def _reset_locked(self) -> None:
+        self.baseline: dict | None = None
+        self.baseline_instance: str | None = None
+        #: (t, instance, scores tuple, items tuple) — the live sketch
+        self._window: deque = deque(maxlen=window_size())
+        #: request id -> _JoinEntry (FIFO, capacity- and TTL-bounded)
+        self._join: OrderedDict[str, _JoinEntry] = OrderedDict()
+        #: (t, instance, hit, model_age_s) — joined feedback outcomes
+        self._results: deque = deque(maxlen=4096)
+        #: last-N sampled query objects, for the /reload shadow replay
+        self._replay: deque = deque(maxlen=replay_size())
+        #: instance -> {"sampled", "joined", "hits", "modelAgeSeconds"}
+        self._instances: OrderedDict[str, dict] = OrderedDict()
+        #: request ids already joined once — duplicates are recognized,
+        #: not re-counted; bounded like everything else here
+        self._consumed = _ConsumedSet()
+        #: (t, reason) of recent feedback POST failures — the doctor
+        #: warns on RECENT failures, not a lifetime counter (one blip
+        #: must not read as a dead loop forever)
+        self._feedback_errors: deque = deque(maxlen=1024)
+        self.last_shadow: dict | None = None
+
+    def reset(self) -> None:
+        """Drop all state (tests retuning the env knobs)."""
+        with self._lock:
+            self._reset_locked()
+
+    # -- baseline ------------------------------------------------------------
+    def set_baseline(self, instance_id: str, doc: dict | None) -> None:
+        """Adopt the deployed instance's trained baseline (None clears —
+        an instance trained before this pillar has no sketch)."""
+        with self._lock:
+            self.baseline = doc if isinstance(doc, dict) else None
+            self.baseline_instance = instance_id
+
+    # -- the serving side ----------------------------------------------------
+    def record_prediction(self, request_id: str | None, instance_id: str,
+                          model_age_s: float | None, query,
+                          result) -> None:
+        """One SAMPLED served prediction: into the score window, the
+        shadow replay buffer, and (when a request id exists) the
+        feedback join buffer."""
+        pairs = extract_item_scores(result)
+        now = time.time()
+        scores = tuple(s for _, s in pairs)
+        items = tuple(i for i, _ in pairs if i is not None)
+        with self._lock:
+            tally = self._tally(instance_id)
+            tally["sampled"] += 1
+            if model_age_s is not None:
+                tally["modelAgeSeconds"] = round(model_age_s, 1)
+            self._window.append((now, instance_id, scores, items))
+            if query is not None:
+                self._replay.append(query)
+            if request_id and items:
+                self._evict_locked(now)
+                if request_id not in self._join:
+                    while len(self._join) >= join_capacity():
+                        self._join.popitem(last=False)
+                        _JOIN_EVICTIONS.inc(reason="capacity")
+                    self._join[request_id] = _JoinEntry(
+                        now, instance_id, model_age_s, frozenset(items))
+        _SAMPLED.inc(instance=instance_id)
+
+    def record_served_set(self, request_id: str, instance_id: str,
+                          model_age_s: float | None,
+                          items: tuple) -> None:
+        """Buffer a served top-k set learned from the SERVING LOG (the
+        feedback loop's predict event) rather than from serving itself —
+        how a split-process event server joins feedback it alone
+        receives. No-op when the request id is already buffered or
+        consumed (the in-process topology records at serve time first),
+        so one request never tallies twice."""
+        if not request_id or not items:
+            return
+        now = time.time()
+        with self._lock:
+            self._evict_locked(now)
+            if request_id in self._join or request_id in self._consumed:
+                return
+            while len(self._join) >= join_capacity():
+                self._join.popitem(last=False)
+                _JOIN_EVICTIONS.inc(reason="capacity")
+            self._join[request_id] = _JoinEntry(
+                now, instance_id, model_age_s,
+                frozenset(str(i) for i in items))
+            tally = self._tally(instance_id)
+            tally["sampled"] += 1
+            if model_age_s is not None:
+                tally["modelAgeSeconds"] = round(model_age_s, 1)
+        _SAMPLED.inc(instance=instance_id)
+
+    def _tally(self, instance_id: str) -> dict:
+        tally = self._instances.get(instance_id)
+        if tally is None:
+            while len(self._instances) >= self.MAX_INSTANCES:
+                self._instances.popitem(last=False)
+            tally = self._instances[instance_id] = {
+                "sampled": 0, "joined": 0, "hits": 0,
+                "modelAgeSeconds": None}
+        return tally
+
+    def _evict_locked(self, now: float) -> None:
+        ttl = join_ttl_s()
+        while self._join:
+            rid, entry = next(iter(self._join.items()))
+            if now - entry.t <= ttl:
+                break
+            del self._join[rid]
+            _JOIN_EVICTIONS.inc(reason="ttl")
+
+    # -- the feedback side ---------------------------------------------------
+    def record_feedback(self, request_id: str | None,
+                        item: str | None) -> str:
+        """Join one feedback event against the buffered serving record.
+        Returns the outcome (``hit``/``miss``/``unknown``/``duplicate``)
+        — attribution goes to the instance that SERVED the request, not
+        whatever is serving now."""
+        now = time.time()
+        outcome = "unknown"
+        with self._lock:
+            self._evict_locked(now)
+            if request_id:
+                entry = self._join.pop(request_id, None)
+                if entry is None:
+                    outcome = ("duplicate"
+                               if request_id in self._consumed else "unknown")
+                else:
+                    self._consumed.add(request_id)
+                    hit = item is not None and item in entry.items
+                    outcome = "hit" if hit else "miss"
+                    self._results.append(
+                        (now, entry.instance, hit, entry.model_age_s))
+                    tally = self._tally(entry.instance)
+                    tally["joined"] += 1
+                    if hit:
+                        tally["hits"] += 1
+        _FEEDBACK.inc(result=outcome)
+        return outcome
+
+    def note_feedback_error(self, reason: str) -> None:
+        """One failed feedback POST (create_server._send_feedback) —
+        timestamped so the quality doc (and the doctor's starving-loop
+        WARN) reports the trailing window, while the lifetime
+        ``pio_feedback_errors_total`` counter rides /metrics."""
+        with self._lock:
+            self._feedback_errors.append((time.time(), reason))
+    def shadow_queries(self) -> list:
+        with self._lock:
+            return list(self._replay)
+
+    def note_shadow(self, report: dict) -> None:
+        with self._lock:
+            self.last_shadow = report
+        overlap = report.get("overlapAtK")
+        if overlap is not None:
+            _SHADOW_OVERLAP.set(float(overlap))
+        _SHADOW_SWAPS.inc(result=(
+            "blocked" if report.get("blocked")
+            else "ok" if report.get("replayed") else "unjudged"))
+
+    # -- derived state -------------------------------------------------------
+    def _instance_stats_locked(self, now: float) -> dict[str, dict]:
+        window_floor = now - window_s()
+        per: dict[str, dict] = {}
+        for iid, tally in self._instances.items():
+            per[iid] = dict(tally)
+        # ONE pass over the joined-feedback window for every instance —
+        # this runs under the monitor lock at every scrape/history tick,
+        # and a per-instance rescan would block the serving hot path for
+        # O(instances × results)
+        window_joined: dict[str, int] = {}
+        window_hits: dict[str, int] = {}
+        for t, riid, hit, _age in self._results:
+            if t >= window_floor:
+                window_joined[riid] = window_joined.get(riid, 0) + 1
+                if hit:
+                    window_hits[riid] = window_hits.get(riid, 0) + 1
+        scores: dict[str, list[float]] = {}
+        tops: dict[str, list[float]] = {}
+        seen_preds: dict[str, set] = {}
+        items: dict[str, _TallyCounter] = {}
+        for t, iid, ss, ii in self._window:
+            scores.setdefault(iid, []).extend(ss)
+            if ss:
+                # the drift population is DISTINCT prediction signatures:
+                # one hot user asked 500 times is one draw from the
+                # model, not 500 — without the dedup, narrow-but-heavy
+                # traffic reads as a drifted score distribution
+                seen = seen_preds.setdefault(iid, set())
+                if ss not in seen:
+                    seen.add(ss)
+                    tops.setdefault(iid, []).append(max(ss))
+            items.setdefault(iid, _TallyCounter()).update(ii)
+        base = self.baseline or {}
+        for iid, doc in per.items():
+            ss = scores.get(iid) or []
+            tally = items.get(iid) or _TallyCounter()
+            slots = sum(tally.values())
+            doc["scoreMean"] = (sum(ss) / len(ss)) if ss else None
+            doc["scoreP50"] = (sorted(ss)[len(ss) // 2]) if ss else None
+            doc["popularitySkew"] = (max(tally.values()) / slots
+                                     if slots else None)
+            n_items = base.get("nItems")
+            doc["coverage"] = (len(tally) / n_items
+                               if n_items and slots else None)
+            drift = None
+            live_tops = tops.get(iid) or []
+            if live_tops and base and iid == self.baseline_instance:
+                # drift judges the TOP-score distribution — invariant
+                # to the per-query num, unlike the full top-k spread
+                drift = population_stability_index(
+                    base.get("counts") or [], live_tops,
+                    base.get("edges") or [])
+            doc["drift"] = None if drift is None else round(drift, 4)
+            # distinct signatures — the drift finding's evidence count
+            doc["windowPredictions"] = len(live_tops)
+            joined = window_joined.get(iid, 0)
+            hits = window_hits.get(iid, 0)
+            doc["windowJoined"] = joined
+            doc["hitRate"] = (hits / joined) if joined else None
+            doc["joinRate"] = (doc["joined"] / doc["sampled"]
+                               if doc["sampled"] else None)
+        return per
+
+    def refresh_gauges(self) -> None:
+        """Collect hook: publish the windowed sketch/hit-rate gauges at
+        every scrape (and every history tick)."""
+        now = time.time()
+        with self._lock:
+            self._evict_locked(now)
+            per = self._instance_stats_locked(now)
+            _JOIN_ENTRIES.set(len(self._join))
+        for iid, doc in per.items():
+            if doc["scoreMean"] is not None:
+                _SCORE_MEAN.set(doc["scoreMean"], instance=iid)
+            if doc["scoreP50"] is not None:
+                _SCORE_P50.set(doc["scoreP50"], instance=iid)
+            if doc["drift"] is not None:
+                _DRIFT.set(doc["drift"], instance=iid)
+            if doc["coverage"] is not None:
+                _COVERAGE.set(doc["coverage"], instance=iid)
+            if doc["popularitySkew"] is not None:
+                _POP_SKEW.set(doc["popularitySkew"], instance=iid)
+            if doc["hitRate"] is not None:
+                _HIT_RATE.set(doc["hitRate"], instance=iid)
+
+    def join_buffer_len(self) -> int:
+        with self._lock:
+            return len(self._join)
+
+    def join_snapshot(self) -> list[tuple[str, str]]:
+        """(request id, one served item) per buffered entry — the
+        public face the serving bench drives deterministic feedback
+        through (bench_serving._quality_section)."""
+        with self._lock:
+            return [(rid, next(iter(e.items)))
+                    for rid, e in self._join.items() if e.items]
+
+    def to_json(self) -> dict:
+        """The ``GET /debug/quality`` document."""
+        now = time.time()
+        with self._lock:
+            self._evict_locked(now)
+            per = self._instance_stats_locked(now)
+            doc = {
+                "sampleMode": sample_mode(),
+                "windowSize": self._window.maxlen,
+                "windowS": window_s(),
+                "joinTtlS": join_ttl_s(),
+                "joinCapacity": join_capacity(),
+                "joinEntries": len(self._join),
+                "baseline": self.baseline,
+                "baselineInstance": self.baseline_instance,
+                "instances": per,
+                "lastShadow": self.last_shadow,
+            }
+        doc["feedback"] = {key[0]: v for key, v in _FEEDBACK.items()}
+        floor = now - window_s()
+        errors: dict[str, int] = {}
+        with self._lock:
+            for t, reason in self._feedback_errors:
+                if t >= floor:
+                    errors[reason] = errors.get(reason, 0) + 1
+        doc["feedbackErrors"] = errors
+        return doc
+
+
+class _ConsumedSet:
+    """Bounded remember-set of already-joined request ids (duplicate
+    detection without unbounded growth)."""
+
+    MAX = 8192
+
+    def __init__(self):
+        self._d: OrderedDict[str, None] = OrderedDict()
+
+    def add(self, rid: str) -> None:
+        self._d[rid] = None
+        while len(self._d) > self.MAX:
+            self._d.popitem(last=False)
+
+    def __contains__(self, rid: str) -> bool:
+        return rid in self._d
+
+
+#: The process-global monitor (one per process, like the registry).
+MONITOR = QualityMonitor()
+
+# Gauges refresh at every scrape/history tick, like the staleness gauges.
+REGISTRY.add_collect_hook(MONITOR.refresh_gauges)
+
+
+def observe_event(event) -> str | None:
+    """Event-server hook: classify one ingested event.
+
+    The serving log itself — the feedback loop's ``predict`` event on a
+    ``pio_pr`` entity — is not user feedback, but it CARRIES the served
+    top-k, the request id, and the serving attribution, so it registers
+    the served set in this process's join buffer (the split-deploy
+    event server has no other view of what was served; in-process the
+    query server already recorded it and the registration no-ops).
+    Any OTHER event carrying the ``requestId`` property joins the
+    buffer, with the event's target entity (falling back to the entity)
+    as the acted-on item. Returns the join outcome, or None for events
+    that aren't feedback."""
+    if not quality_enabled():
+        return None
+    try:
+        props = getattr(event, "properties", None)
+        rid = props.get_opt("requestId") if props is not None else None
+        if not rid:
+            return None
+        if getattr(event, "event", None) == "predict" and \
+                getattr(event, "entity_type", None) == "pio_pr":
+            # the same PIO_QUALITY_SAMPLE head decision the serving
+            # side made — keyed on the request id, so this is the SAME
+            # coin, not a second draw: the feedback loop logs every
+            # request, and an operator sampling at 1% must see the join
+            # path (buffer occupancy, sampled tallies) bounded at 1%
+            if not sample(str(rid)):
+                return None
+            prediction = props.get_opt("prediction")
+            items = tuple(
+                i for i, _ in extract_item_scores(prediction)
+                if i is not None)
+            age = props.get_opt("modelAgeSeconds")
+            MONITOR.record_served_set(
+                str(rid),
+                str(props.get_opt("engineInstanceId") or "unknown"),
+                float(age) if isinstance(age, (int, float)) else None,
+                items)
+            return None
+        item = getattr(event, "target_entity_id", None) or \
+            getattr(event, "entity_id", None)
+        return MONITOR.record_feedback(str(rid),
+                                       None if item is None else str(item))
+    except Exception:  # noqa: BLE001 — quality must never fail ingest
+        logger.debug("quality feedback observation failed", exc_info=True)
+        return None
+
+
+# -- doc merging (gateway fleet view) ----------------------------------------
+
+def merge_docs(docs: list[dict]) -> dict:
+    """Fleet-merged quality doc from per-replica ``/debug/quality``
+    documents: per-instance tallies sum, window stats take the worst
+    case (max drift / skew, min coverage / hit rate — the operator
+    cares about the sickest replica). Note the in-process ``--replicas
+    N`` caveat from obs/fleet.py: replicas sharing one process registry
+    each report the same monitor, so sums there overcount by the
+    replica factor; per-instance worst-case stats stay meaningful."""
+    merged: dict = {"instances": {}, "feedback": {}, "feedbackErrors": {},
+                    "joinEntries": 0, "lastShadow": None, "baseline": None,
+                    "baselineInstance": None}
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        merged["joinEntries"] += doc.get("joinEntries") or 0
+        if merged["baseline"] is None and doc.get("baseline"):
+            merged["baseline"] = doc["baseline"]
+            merged["baselineInstance"] = doc.get("baselineInstance")
+        if doc.get("lastShadow"):
+            merged["lastShadow"] = doc["lastShadow"]
+        for family in ("feedback", "feedbackErrors"):
+            for k, v in (doc.get(family) or {}).items():
+                merged[family][k] = merged[family].get(k, 0) + v
+        for iid, stats in (doc.get("instances") or {}).items():
+            out = merged["instances"].setdefault(iid, {
+                "sampled": 0, "joined": 0, "hits": 0, "windowJoined": 0,
+                "windowPredictions": 0,
+                "modelAgeSeconds": None, "scoreMean": None,
+                "scoreP50": None, "drift": None, "coverage": None,
+                "popularitySkew": None, "hitRate": None, "joinRate": None,
+            })
+            for k in ("sampled", "joined", "hits", "windowJoined",
+                      "windowPredictions"):
+                out[k] += stats.get(k) or 0
+            # a replica's JUDGED stats (drift, hitRate) only join the
+            # worst-case merge when that replica's OWN window has enough
+            # evidence: the merged doc pairs worst-case values with
+            # fleet-SUMMED counts, so an unguarded merge would let one
+            # replica's 2-sample PSI noise ride the fleet's summed
+            # sample count straight past quality_findings' minimum-
+            # evidence guards (docs without the count — older peers —
+            # are judged as-is, matching quality_findings)
+            n_pred = stats.get("windowPredictions")
+            n_join = stats.get("windowJoined")
+            for k, worst in (("drift", max), ("popularitySkew", max),
+                             ("modelAgeSeconds", max),
+                             ("coverage", min), ("hitRate", min),
+                             ("scoreMean", max), ("scoreP50", max)):
+                v = stats.get(k)
+                if v is None:
+                    continue
+                if k == "drift" and n_pred is not None \
+                        and n_pred < min_drift_samples():
+                    continue
+                if k == "hitRate" and n_join is not None \
+                        and n_join < min_joins_for_judgment():
+                    continue
+                out[k] = v if out[k] is None else worst(out[k], v)
+            out["joinRate"] = (out["joined"] / out["sampled"]
+                               if out["sampled"] else None)
+    return merged
+
+
+# -- triage (`pio doctor`) ----------------------------------------------------
+
+def drift_warn_threshold() -> float:
+    return _env_float("PIO_QUALITY_DRIFT_WARN", 0.1)
+
+
+def drift_crit_threshold() -> float:
+    return _env_float("PIO_QUALITY_DRIFT_CRIT", 0.25)
+
+
+def min_joins_for_judgment() -> int:
+    return max(_env_int("PIO_QUALITY_MIN_JOINS", 20), 1)
+
+
+def min_drift_samples() -> int:
+    return max(_env_int("PIO_QUALITY_MIN_SAMPLES", 16), 1)
+
+
+def hit_rate_floor() -> float:
+    return _env_float("PIO_SLO_ONLINE_HIT_RATE_MIN", 0.05)
+
+
+def quality_findings(doc: dict | None) -> list[dict]:
+    """Ranked findings from a quality doc (the single-server shape or a
+    gateway merge): QUALITY-DRIFT (PSI past the warn/crit thresholds),
+    QUALITY-REGRESSION (windowed hit rate under the online_quality
+    floor, with enough joins to judge), and a starving feedback loop
+    (nonzero ``pio_feedback_errors_total``) — each naming the engine
+    instance and its model age."""
+    if not isinstance(doc, dict):
+        return []
+    doc = doc.get("merged") or doc
+    findings: list[dict] = []
+
+    def age_txt(stats: dict) -> str:
+        age = stats.get("modelAgeSeconds")
+        return f"model age {age:.0f}s" if isinstance(age, (int, float)) \
+            else "model age unknown"
+
+    for iid, stats in sorted((doc.get("instances") or {}).items()):
+        drift = stats.get("drift")
+        # a handful of sampled predictions is sampling noise, not a
+        # drifted model: hold the finding until the window has evidence
+        # (a doc without the count — an older peer — is judged as-is)
+        n_window = stats.get("windowPredictions")
+        if n_window is not None and n_window < min_drift_samples():
+            drift = None
+        if drift is not None and drift > drift_warn_threshold():
+            crit = drift > drift_crit_threshold()
+            findings.append({
+                "severity": "critical" if crit else "warn",
+                "subject": f"QUALITY-DRIFT {iid}",
+                "detail": (
+                    f"live score distribution PSI {drift:.3f} vs trained "
+                    f"baseline (warn>{drift_warn_threshold():g}, "
+                    f"crit>{drift_crit_threshold():g}), {age_txt(stats)}"),
+            })
+        hit_rate = stats.get("hitRate")
+        joined = stats.get("windowJoined") or 0
+        if hit_rate is not None and joined >= min_joins_for_judgment() \
+                and hit_rate < hit_rate_floor():
+            findings.append({
+                "severity": "critical",
+                "subject": f"QUALITY-REGRESSION {iid}",
+                "detail": (
+                    f"online hit rate {hit_rate:.3f} under the "
+                    f"online_quality floor {hit_rate_floor():g} over "
+                    f"{joined} joined feedback event(s), {age_txt(stats)}"),
+            })
+    errors = doc.get("feedbackErrors") or {}
+    total_errors = sum(errors.values())
+    if total_errors:
+        by_reason = ", ".join(f"{k}={v}" for k, v in sorted(errors.items()))
+        findings.append({
+            "severity": "warn",
+            "subject": "feedback loop",
+            "detail": (
+                f"{total_errors} feedback POST failure(s) in the last "
+                f"{window_s():g}s ({by_reason}) — a dead feedback loop "
+                "starves the online-accuracy join "
+                "(pio_feedback_errors_total)"),
+        })
+    return findings
+
+
+def reset() -> None:
+    """Tests: drop the process monitor's state and the mode memo."""
+    global _mode_cache
+    _mode_cache = (None, "all")
+    MONITOR.reset()

@@ -38,6 +38,10 @@ Built-in SLOs (each retunable by env, replaceable wholesale by
     attempts; target ``PIO_SLO_INGEST_TARGET`` (0.999).
   * ``model_staleness`` — threshold: the serving model's age must stay
     under ``PIO_SLO_MODEL_MAX_AGE_S`` (86400 s); target 0.99.
+  * ``online_quality`` — threshold (inverted, ``bad_below``): the
+    windowed feedback-joined online hit rate (obs/quality.py) must stay
+    ABOVE ``PIO_SLO_ONLINE_HIT_RATE_MIN`` (0.05); intervals with no
+    joined feedback are no evidence, not a breach; target 0.99.
 """
 
 from __future__ import annotations
@@ -97,9 +101,12 @@ class SLO:
     fallback_bad: str = ""
     fallback_base: str = ""
     fallback_base_includes_bad: bool = True
-    #: threshold: value series + bound
+    #: threshold: value series + bound. ``bad_below`` inverts the
+    #: direction for higher-is-better series (online hit rate): a sample
+    #: UNDER the bound is the bad interval then
     series: str = ""
     bound: float = 0.0
+    bad_below: bool = False
     burn_threshold: float = 14.4
 
     def __post_init__(self):
@@ -120,12 +127,13 @@ def ratio_burn(bad_sum: float, total_sum: float,
 
 
 def threshold_burn(values: list[float], bound: float,
-                   target: float) -> float | None:
+                   target: float, bad_below: bool = False) -> float | None:
     """Burn rate of a threshold SLO over one window: the fraction of
-    samples beyond the bound, divided by the budgeted fraction."""
+    samples beyond the bound (under it with ``bad_below``), divided by
+    the budgeted fraction."""
     if not values:
         return None
-    bad = sum(1 for v in values if v > bound)
+    bad = sum(1 for v in values if (v < bound if bad_below else v > bound))
     return (bad / len(values)) / (1.0 - target)
 
 
@@ -180,6 +188,17 @@ def default_slos() -> list[SLO]:
             target=0.99,
             series="model_age_seconds",
             bound=_env_float("PIO_SLO_MODEL_MAX_AGE_S", 86400.0),
+        ),
+        SLO(
+            name="online_quality",
+            description="feedback-joined online hit rate above the "
+                        "quality floor (no joined feedback = no "
+                        "evidence, not a breach)",
+            kind="threshold",
+            target=0.99,
+            series="online_hit_rate",
+            bound=_env_float("PIO_SLO_ONLINE_HIT_RATE_MIN", 0.05),
+            bad_below=True,
         ),
     ]
 
@@ -244,7 +263,7 @@ class SLOEngine:
         if slo.kind == "threshold":
             return threshold_burn(
                 sampler.window_values(slo.series, seconds, now_ts),
-                slo.bound, slo.target)
+                slo.bound, slo.target, slo.bad_below)
         burn = self._ratio_window(sampler, slo, seconds, now_ts,
                                   fallback=False)
         if burn is None and slo.fallback_base:
@@ -288,6 +307,7 @@ class SLOEngine:
             if slo.kind == "threshold":
                 doc["series"] = slo.series
                 doc["bound"] = slo.bound
+                doc["badBelow"] = slo.bad_below
                 latest = sampler.window_values(
                     slo.series, fast_s, now_ts)
                 doc["latest"] = latest[-1] if latest else None

@@ -54,6 +54,7 @@ import os
 import threading
 import time
 import weakref
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +78,7 @@ __all__ = [
     "serving_device",
     "device_cache_put",
     "host_cache_transform",
+    "serving_cache_bypass",
     "evict_serving_models",
     "set_serving_instance",
     "serving_arena_bytes",
@@ -98,8 +100,28 @@ __all__ = [
 #: with weakref expiry as the backstop for arrays that die outside a swap.
 _IDENTITY_CACHE: dict = {}
 
+#: Set on threads replaying queries against a NOT-YET-COMMITTED engine
+#: instance (the /reload shadow scorer, obs/quality.py): their device
+#: copies must be transient — caching a candidate's catalogs would pin
+#: them in the serving_models arena before (or without) the swap.
+_cache_bypass = threading.local()
+
+
+@contextmanager
+def serving_cache_bypass():
+    """Scope in which :func:`_identity_cached` builds values without
+    caching or arena registration (this thread only)."""
+    prev = getattr(_cache_bypass, "active", False)
+    _cache_bypass.active = True
+    try:
+        yield
+    finally:
+        _cache_bypass.active = prev
+
 
 def _identity_cached(arr: np.ndarray, key: tuple, build):
+    if getattr(_cache_bypass, "active", False):
+        return build()
     hit = _IDENTITY_CACHE.get(key)
     if hit is not None and hit[0]() is arr:
         return hit[1]

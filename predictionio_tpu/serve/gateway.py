@@ -358,7 +358,59 @@ class Gateway:
         r.add("GET", "/metrics/fleet", self.get_fleet_metrics)
         r.add("POST", "/fleet/actions", self.post_fleet_action)
         add_metrics_route(r)
+        # registered AFTER add_metrics_route so the gateway's fleet-
+        # merged view wins the exact-match table over the per-process
+        # default handler every server mounts
+        r.add("GET", "/debug/quality", self.get_quality)
         return r
+
+    def get_quality(self, request: Request):
+        """``GET /debug/quality`` on the gateway: every replica's quality
+        doc plus the fleet merge (obs/quality.merge_docs — per-instance
+        tallies summed, window stats worst-case). Dead replicas report
+        null; the in-process ``--replicas N`` caveat of
+        ``GET /metrics/fleet`` applies to the sums here too."""
+        from predictionio_tpu.obs import fleet, quality
+        from predictionio_tpu.utils.http import HTTPError
+
+        if not quality.quality_enabled():
+            raise HTTPError(404, "quality sampling disabled "
+                                 "(PIO_QUALITY_SAMPLE=off)")
+        replicas = self.registry.replicas()
+        # the event server joins feedback in a split deploy — its doc
+        # carries the online hit-rate half of the merge
+        extra: list[tuple[str, str, int]] = []
+        if self.config.event_server is not None:
+            host, port = self.config.event_server
+            if host in ("0.0.0.0", "::"):
+                host = "127.0.0.1"
+            extra.append((f"event:{host}:{port}", host, port))
+        members = [(r.id, r.host, r.port) for r in replicas] + extra
+        docs: dict[str, dict | None] = {}
+        results: list[dict | None] = [None] * len(members)
+
+        def fetch_one(i: int, host: str, port: int) -> None:
+            results[i] = fleet.fetch_json(
+                f"http://{host}:{port}/debug/quality",
+                timeout=self.config.fleet_scrape_timeout_sec)
+
+        threads = [threading.Thread(target=fetch_one,
+                                    args=(i, host, port), daemon=True)
+                   for i, (_, host, port) in enumerate(members)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(2.0 * self.config.fleet_scrape_timeout_sec + 0.5)
+        for (member_id, _, _), doc in zip(members, results):
+            docs[member_id] = doc
+        return 200, {
+            "role": "gateway",
+            "sampleMode": quality.sample_mode(),
+            "joinTtlS": quality.join_ttl_s(),
+            "replicas": docs,
+            "merged": quality.merge_docs(
+                [d for d in docs.values() if d]),
+        }
 
     # -- remediation (`pio doctor --fix`) ------------------------------------
     def post_fleet_action(self, request: Request):

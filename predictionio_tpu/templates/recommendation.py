@@ -512,6 +512,19 @@ class ALSAlgorithm(PAlgorithm):
                 )
         return out
 
+    # -- prediction-quality observatory (obs/quality.py) ---------------------
+
+    def quality_probe_queries(self, model: ALSModel, n: int = 64,
+                              k: int = 10) -> list[Query]:
+        """Held-out query sample for the train-time quality baseline: an
+        even stride over the trained user catalog (deterministic, so two
+        trains on the same data sketch the same population)."""
+        users = list(model.user_ids.keys())
+        if not users:
+            return []
+        step = max(len(users) // max(n, 1), 1)
+        return [Query(user=u, num=k) for u in users[::step][:n]]
+
     # -- device-resident serving protocol (ROADMAP item 3) -------------------
 
     def pin_serving_state(self, model: ALSModel, max_batch: int = 64) -> int:

@@ -61,7 +61,8 @@ _LOWER_BETTER_RE = re.compile(
     r"(_ms$|_ms_|_sec$|_s$|_seconds$|sec_per_|_p50|_p99|latency"
     r"|_bytes$|_mb_per_step$|retraces)")
 _HIGHER_BETTER_RE = re.compile(
-    r"(per_sec|per_iter$|_qps$|^qps$|mfu|rate$|_frac$|flops|iter_per)")
+    r"(per_sec|per_iter$|_qps$|^qps$|mfu|rate$|_frac$|flops|iter_per"
+    r"|overlap|hit_rate)")
 
 
 def lower_is_better(key: str) -> bool:

@@ -61,6 +61,19 @@ def build_parser() -> argparse.ArgumentParser:
              "(default PIO_RUNS_DIR / ~/.predictionio_tpu/runs)")
     p_doc.set_defaults(func=cmd_doctor)
 
+    # -- prediction-quality observatory (obs/quality.py surfaces) ------------
+    p_q = sub.add_parser(
+        "quality",
+        help="prediction-quality report for a live deployment: score "
+             "drift vs the trained baseline, feedback-joined online "
+             "hit rate, join coverage, last shadow-scored reload")
+    p_q.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="gateway (fleet-merged view) or single query server")
+    p_q.add_argument("--json", action="store_true",
+                     help="raw /debug/quality JSON instead of the report")
+    p_q.set_defaults(func=cmd_quality)
+
     # -- training-run observatory (obs/runlog.py surfaces) -------------------
     p_runs = sub.add_parser(
         "runs",
@@ -1011,6 +1024,99 @@ def cmd_watch(args) -> int:
         return 0
 
 
+def _fmt_ratio(v, digits: int = 3) -> str:
+    return "n/a" if v is None else f"{v:.{digits}f}"
+
+
+def _quality_summary_line(qdoc: dict | None) -> str | None:
+    """One-line quality summary from a ``/debug/quality`` doc (single-
+    server or gateway shape): worst drift, windowed online hit rate,
+    lifetime join rate — the `pio status` companion to the model-age
+    line."""
+    if not isinstance(qdoc, dict):
+        return None
+    doc = qdoc.get("merged") or qdoc
+    instances = doc.get("instances") or {}
+    drifts = [s.get("drift") for s in instances.values()
+              if s.get("drift") is not None]
+    hit_rates = [s.get("hitRate") for s in instances.values()
+                 if s.get("hitRate") is not None]
+    sampled = sum(s.get("sampled") or 0 for s in instances.values())
+    joined = sum(s.get("joined") or 0 for s in instances.values())
+    join_rate = (joined / sampled) if sampled else None
+    return (f"quality: drift {_fmt_ratio(max(drifts) if drifts else None)}, "
+            f"online hit-rate "
+            f"{_fmt_ratio(min(hit_rates) if hit_rates else None)}, "
+            f"join-rate {_fmt_ratio(join_rate)} "
+            f"({joined}/{sampled} sampled)")
+
+
+def cmd_quality(args) -> int:
+    """``pio quality``: the prediction-quality observatory's report —
+    per-instance score drift vs the trained baseline, feedback-joined
+    online hit rate, join-buffer state, and the last shadow-scored
+    reload. Exit 0 = judged healthy, 1 = a critical quality finding,
+    2 = the surface is unreachable/disabled."""
+    import json as _json
+
+    from predictionio_tpu.obs import quality as quality_mod
+
+    base = args.url.rstrip("/")
+    qdoc = _fetch_json(f"{base}/debug/quality")
+    if qdoc is None:
+        print(f"[ERROR] cannot fetch {base}/debug/quality — deployment "
+              "down, or quality sampling disabled "
+              "(PIO_QUALITY_SAMPLE=off).", file=sys.stderr)
+        return 2
+    if args.json:
+        print(_json.dumps(qdoc, indent=2))
+        return 0
+    doc = qdoc.get("merged") or qdoc
+    findings = quality_mod.quality_findings(qdoc)
+    print(f"[INFO] pio quality @ {base}"
+          + (f" — fleet-merged over {len(qdoc.get('replicas') or {})} "
+             "replica(s)" if qdoc.get("role") == "gateway" else ""))
+    summary = _quality_summary_line(qdoc)
+    if summary:
+        print(f"[INFO] {summary}")
+    baseline = doc.get("baseline")
+    if baseline:
+        print(f"[INFO] baseline (instance {doc.get('baselineInstance')}): "
+              f"{baseline.get('queries')} probe queries @ top-"
+              f"{baseline.get('k')}, score mean "
+              f"{baseline.get('scoreMean'):.4g}, coverage "
+              f"{_fmt_ratio(baseline.get('coverage'))}")
+    else:
+        print("[INFO] no trained baseline on the serving instance — "
+              "retrain to enable drift detection.")
+    for iid, s in sorted((doc.get("instances") or {}).items()):
+        print(f"[INFO] instance {iid}: sampled {s.get('sampled')}, "
+              f"drift {_fmt_ratio(s.get('drift'))}, "
+              f"score mean {_fmt_ratio(s.get('scoreMean'), 4)}, "
+              f"coverage {_fmt_ratio(s.get('coverage'))}, "
+              f"hit-rate {_fmt_ratio(s.get('hitRate'))} "
+              f"({s.get('joined')}/{s.get('sampled')} joined)")
+    entries = doc.get("joinEntries", qdoc.get("joinEntries"))
+    if entries is not None:
+        ttl = qdoc.get("joinTtlS") or doc.get("joinTtlS")
+        print(f"[INFO] join buffer: {entries} waiting"
+              + (f" (ttl {ttl:g}s)" if ttl is not None else ""))
+    shadow = doc.get("lastShadow")
+    if shadow:
+        print(f"[INFO] last shadow reload: candidate "
+              f"{shadow.get('candidate')} vs {shadow.get('serving')}, "
+              f"overlap@k {_fmt_ratio(shadow.get('overlapAtK'))}, "
+              f"score shift {_fmt_ratio(shadow.get('scoreShift'))}"
+              + (" — BLOCKED by the gate" if shadow.get("blocked") else ""))
+    marks = {"critical": "[CRIT]", "warn": "[WARN]", "info": "[INFO]"}
+    for f in findings:
+        print(f"{marks.get(f['severity'], '[INFO]')} {f['subject']}: "
+              f"{f['detail']}")
+    if not findings:
+        print("[INFO] prediction quality healthy: no findings.")
+    return 1 if any(f["severity"] == "critical" for f in findings) else 0
+
+
 def cmd_doctor(args) -> int:
     """``pio doctor``: pull the fleet's health surfaces (gateway status,
     per-replica statuses, /debug/slo, /debug/traces) and print a ranked
@@ -1043,12 +1149,13 @@ def cmd_doctor(args) -> int:
         is_gateway = status.get("role") == "gateway"
         members = _fleet_members(base, status if is_gateway else None)
         slo_state = _fetch_json(f"{base}/debug/slo")
+        quality_doc = _fetch_json(f"{base}/debug/quality")
         traces_body = _fetch_json(
             f"{base}/debug/traces?limit={max(args.traces, 0)}")
         traces = (traces_body or {}).get("slowest") or []
         findings = train_findings + fleet.diagnose(
             status if is_gateway else None, members, slo_state,
-            traces[: args.traces])
+            traces[: args.traces], quality=quality_doc)
     rc = 1 if any(f["severity"] == "critical" for f in findings) else 0
     actions: list[dict] = []
     if getattr(args, "fix", False) and findings:
@@ -1623,6 +1730,12 @@ def _cmd_status_fleet(args) -> int:
               f"{status.get('engineInstanceId')}, "
               f"p99 {status.get('p99ServingSec')}s, model age "
               f"{status.get('modelAgeSeconds')}s")
+    # the model-age line's quality companion: is the (possibly fresh)
+    # model actually answering well? (`pio quality` has the long form)
+    quality_line = _quality_summary_line(
+        _fetch_json(f"{base}/debug/quality"))
+    if quality_line:
+        print(f"[INFO] {quality_line}")
     slo_state = _fetch_json(f"{base}/debug/slo")
     if slo_state is None:
         print("[WARN] /debug/slo unavailable (history disabled?).")

@@ -42,6 +42,7 @@ _PAGE = """<!DOCTYPE html>
 {rows}
 </table>
 {fleet}
+{quality}
 {history}
 {metrics}
 {device}
@@ -224,6 +225,72 @@ def _fleet_panel(status) -> str:
         + "".join(rows) + "</table>")
 
 
+def _quality_panel(gw_status) -> str:
+    """Prediction-quality panel (obs/quality.py): per-instance drift vs
+    the trained baseline, windowed online hit rate, join coverage, and
+    the last shadow-scored reload. Fetches the gateway's fleet-merged
+    ``/debug/quality`` (skipped when index()'s shared status fetch
+    already failed), falling back to this process's monitor."""
+    from predictionio_tpu.obs import quality
+
+    # the gateway answers /debug/quality only after its per-replica
+    # fan-out (up to ~2s per slow/dead member, concurrent) — a default
+    # 1.5s fetch would give up first and silently fall back to this
+    # process's empty monitor, hiding exactly the fleet signal the
+    # panel exists to surface
+    doc = (_fetch_json(f"{_gateway_url()}/debug/quality", timeout=5.0)
+           if gw_status is not None else None)
+    source = f"gateway {_gateway_url()}"
+    if doc is None:
+        if not quality.quality_enabled():
+            return ("<h2>Prediction quality</h2><p>Quality sampling is "
+                    "off (PIO_QUALITY_SAMPLE=off).</p>")
+        doc = quality.MONITOR.to_json()
+        source = "this process"
+    merged = doc.get("merged") or doc
+    instances = merged.get("instances") or {}
+    if not any((s.get("sampled") or 0) for s in instances.values()):
+        return ("<h2>Prediction quality</h2><p>No sampled predictions "
+                "yet (<code>GET /debug/quality</code>, <code>pio "
+                "quality</code>).</p>")
+
+    def fmt(v, digits=3):
+        return "n/a" if v is None else f"{v:.{digits}f}"
+
+    rows = []
+    for iid, s in sorted(instances.items()):
+        rows.append(
+            f"<tr><td>{html.escape(str(iid))}</td>"
+            f"<td>{s.get('sampled')}</td>"
+            f"<td>{fmt(s.get('drift'))}</td>"
+            f"<td>{fmt(s.get('scoreMean'), 4)}</td>"
+            f"<td>{fmt(s.get('coverage'))}</td>"
+            f"<td>{fmt(s.get('popularitySkew'))}</td>"
+            f"<td>{fmt(s.get('hitRate'))}</td>"
+            f"<td>{s.get('joined')}</td>"
+            f"<td>{s.get('modelAgeSeconds', 'n/a')}</td></tr>")
+    shadow = merged.get("lastShadow")
+    shadow_txt = ""
+    if shadow:
+        blocked = (" <b style='color:#c33'>BLOCKED</b>"
+                   if shadow.get("blocked") else "")
+        shadow_txt = (
+            f"<p>Last shadow reload: candidate "
+            f"<code>{html.escape(str(shadow.get('candidate')))}</code> vs "
+            f"<code>{html.escape(str(shadow.get('serving')))}</code> — "
+            f"overlap@k {fmt(shadow.get('overlapAtK'))}, score shift "
+            f"{fmt(shadow.get('scoreShift'))}{blocked}</p>")
+    return (
+        "<h2>Prediction quality</h2>"
+        f"<p>Score drift, coverage and feedback-joined online accuracy "
+        f"({html.escape(source)}; <code>GET /debug/quality</code>, "
+        "<code>pio quality</code>).</p>"
+        "<table><tr><th>instance</th><th>sampled</th><th>drift (PSI)</th>"
+        "<th>score mean</th><th>coverage</th><th>pop. skew</th>"
+        "<th>hit rate</th><th>joined</th><th>model age (s)</th></tr>"
+        + "".join(rows) + "</table>" + shadow_txt)
+
+
 # the one sparkline renderer lives beside the rings it draws
 # (obs/history.sparkline); `pio watch` shares it
 from predictionio_tpu.obs.history import sparkline as _sparkline  # noqa: E402
@@ -357,6 +424,7 @@ def build_router() -> Router:
         return 200, RawResponse(_PAGE.format(
             count=len(instances), rows=rows, metrics=_metrics_footer(),
             slo=_slo_banner(gw_status), fleet=_fleet_panel(gw_status),
+            quality=_quality_panel(gw_status),
             history=_history_panel(gw_status),
             device=_device_panel(), traces=_traces_panel()))
 

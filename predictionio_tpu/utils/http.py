@@ -40,7 +40,7 @@ logger = logging.getLogger(__name__)
 #: sleeps for the capture window.
 UNTRACED_PATHS = frozenset(
     {"/metrics", "/metrics/fleet", "/debug/traces", "/debug/profile",
-     "/debug/faults", "/debug/history", "/debug/slo"})
+     "/debug/faults", "/debug/history", "/debug/slo", "/debug/quality"})
 
 # Per-server HTTP telemetry, shared by every AppServer in the process
 # (the ``server`` label separates event/query/admin/dashboard traffic).
@@ -750,6 +750,16 @@ def add_metrics_route(router: Router,
             state = eng.state()
         return 200, state
 
+    def debug_quality(request: Request):
+        from predictionio_tpu.obs import quality
+
+        if not quality.quality_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/traces contract under PIO_TRACE=off
+            raise HTTPError(404, "quality sampling disabled "
+                                 "(PIO_QUALITY_SAMPLE=off)")
+        return 200, quality.MONITOR.to_json()
+
     router.add("GET", "/metrics", metrics)
     router.add("GET", "/debug/traces", debug_traces)
     router.add("POST", "/debug/profile", debug_profile)
@@ -757,6 +767,7 @@ def add_metrics_route(router: Router,
     router.add("POST", "/debug/faults", debug_faults)
     router.add("GET", "/debug/history", debug_history)
     router.add("GET", "/debug/slo", debug_slo)
+    router.add("GET", "/debug/quality", debug_quality)
     # kick the process history sampler (no-op when disabled): every
     # server that mounts the scrape surface also records local history
     from predictionio_tpu.obs import history as _history

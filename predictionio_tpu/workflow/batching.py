@@ -1,26 +1,33 @@
-"""Micro-batched serving: coalesce concurrent queries into one device call.
+"""The deferred-tick serving pipeline: drain → fused dispatch → overlap.
 
-The reference's ServerActor answers queries strictly one at a time on an
-actor thread (ref: core/.../workflow/CreateServer.scala:513-520 — the
-predict loop carries a "TODO: Parallelize"). On TPU the predict hot path
-is an XLA program whose cost is nearly flat in batch size (one
-[b, rank] × [rank, n_items] matmul + top_k fills the MXU better as b
-grows), so the TPU-first design queues concurrent requests and runs ONE
-device call over the drained batch: tail latency under load drops from
-O(n_concurrent × t_predict) to ≈ t_predict + queueing.
+Two worker threads turn concurrent ``/queries.json`` traffic into a
+two-stage device pipeline:
 
-Greedy drain, no timed window: an idle server answers a lone query
-immediately (zero added latency); batches form exactly when concurrency
-exists — while one batch is on the device, arrivals accumulate and become
-the next batch.
+  * **Consumer** (:meth:`MicroBatcher._loop`): greedy-drains the submit
+    queue into one *tick* (no timed window — an idle server answers a
+    lone query immediately; batches form exactly when concurrency
+    exists) and hands the tick to ``process_batch``. The query server's
+    callback runs the whole drained batch as ONE call: supplement, a
+    single batched predict per algorithm, per-query serve — or, on the
+    device-resident route, one fused gather→MIPS→mask→top-k program
+    against the HBM-pinned catalogs.
+  * **Finalizer** (:meth:`MicroBatcher._finalize_loop`): when
+    ``process_batch`` returns a :class:`DeferredBatch` — the fused
+    dispatch and its async d2h copies are enqueued but the blocking
+    readback is not — the consumer forwards it here and immediately
+    drains the next tick. Tick N's device→host readback (and its
+    per-query serve tail) runs concurrently with tick N+1's dispatch,
+    so the serialized per-tick accelerator cost is ``max(rtt, upload)``
+    rather than their sum; ``pio_serving_overlapped_readbacks_total``
+    counts every tick that actually won that overlap.
 
-Device-resident ticks (ROADMAP item 3) add a second pipeline stage:
-``process_batch`` may return a :class:`DeferredBatch` — the tick's fused
-device dispatch and its async d2h copies are already enqueued, but the
-blocking readback is not. The consumer hands it to a dedicated finalizer
-thread and immediately drains the next batch, so tick N's device→host
-copy (and its per-query serve) overlaps tick N+1's dispatch instead of
-serializing the consumer behind the link round trip.
+Error and telemetry contracts both stages share: a result-list entry
+that is an Exception fails only its own rider while a raise fails the
+whole drained tick; per-rider ``queue_wait``/``predict``/``readback``/
+``serve`` spans are replayed retroactively from the shared stage marks
+before any rider's future resolves; and :meth:`MicroBatcher.stop`
+drains queued work AND in-flight deferred finalizes before the threads
+exit, so teardown never races a mid-flight readback.
 """
 
 from __future__ import annotations

@@ -111,6 +111,21 @@ def run_train(
                     Storage.get_model_data_models().insert(
                         Model(instance_id, blob))
                 runlog.phase("persist", timer.phases[-1][1])
+                # prediction-quality baseline (obs/quality.py): probe a
+                # held-out query sample against the fresh models and
+                # persist the score/coverage sketch into the instance
+                # env — the serving side judges live drift against it
+                from predictionio_tpu.obs import quality
+                from predictionio_tpu.parallel import placement
+
+                with timer.phase("baseline"), trace.span("baseline"), \
+                        placement.serving_cache_bypass():
+                    # the probe scores a model that is NOT serving: its
+                    # device copies must stay transient, never pinned in
+                    # the serving_models arena
+                    baseline_env = quality.baseline_env(
+                        engine, engine_params, models)
+                runlog.phase("baseline", timer.phases[-1][1])
         finally:
             # report in a finally so a persist-stage failure still logs
             # where the (possibly hours-long) train spent its time
@@ -125,7 +140,7 @@ def run_train(
                 **current.__dict__,
                 "status": "COMPLETED",
                 "end_time": now(),
-                "env": {**current.env, **train_env},
+                "env": {**current.env, **train_env, **baseline_env},
             }
         )
         instances.update(done)

@@ -88,6 +88,17 @@ _MODEL_AGE = REGISTRY.gauge(
     "serving replica; resets on /reload hot-swap",
     labels=("server",),
 )
+# Feedback-loop delivery failures. A dead feedback loop silently
+# starves the online-accuracy join (obs/quality.py), so failures are
+# counted by reason — not just logged — and `pio doctor` surfaces a
+# nonzero rate as a WARN finding.
+_FEEDBACK_ERRORS = REGISTRY.counter(
+    "pio_feedback_errors_total",
+    "Feedback POSTs to the event server that failed, by reason "
+    "(http_error = the server answered non-2xx, unreachable = "
+    "connect/timeout, error = anything else)",
+    labels=("reason",),
+)
 
 #: Set on the batch-shape warmup thread: its replays pay deliberate XLA
 #: compiles that must NOT land in the live-serving stage histograms (a
@@ -268,7 +279,7 @@ class QueryService:
         )
 
     # -- model loading (ref: createServerActorWithEngine:206-265) -----------
-    def _load(self) -> None:
+    def _latest_instance(self):
         cfg = self.config
         instances = Storage.get_meta_data_engine_instances()
         instance = instances.get_latest_completed(
@@ -280,6 +291,13 @@ class QueryService:
                 f"{cfg.engine_version} {cfg.engine_variant}. Try running "
                 "`pio train` first."
             )
+        return instance
+
+    def _prepare_instance(self, instance) -> dict:
+        """Load an instance's engine + models WITHOUT committing them to
+        serving — get_reload shadow-scores the prepared candidate against
+        live traffic before :meth:`_commit_bundle` swaps it in."""
+        cfg = self.config
         engine = get_engine(instance.engine_factory, cfg.engine_dir)
         variant = {
             "datasource": json.loads(instance.data_source_params or "{}"),
@@ -297,17 +315,29 @@ class QueryService:
             ctx, engine_params, instance.id, persisted, WorkflowParams()
         )
         from predictionio_tpu.core.engine import _instantiate
+
+        return {
+            "instance": instance,
+            "engine": engine,
+            "engine_params": engine_params,
+            "models": models,
+            "algorithms": engine._algorithms(engine_params),
+            "serving": _instantiate(engine.serving_class,
+                                    engine_params.serving_params),
+        }
+
+    def _commit_bundle(self, bundle: dict) -> None:
+        from predictionio_tpu.obs import quality
         from predictionio_tpu.parallel import placement
 
-        algo_instances = engine._algorithms(engine_params)
-        serving = _instantiate(engine.serving_class, engine_params.serving_params)
+        instance = bundle["instance"]
         with self.lock:
             self.instance = instance
-            self.engine = engine
-            self.engine_params = engine_params
-            self.models = models
-            self.algorithms = algo_instances
-            self.serving = serving
+            self.engine = bundle["engine"]
+            self.engine_params = bundle["engine_params"]
+            self.models = bundle["models"]
+            self.algorithms = bundle["algorithms"]
+            self.serving = bundle["serving"]
             # fresh models mean fresh device programs: let the next query
             # re-trigger the batch-shape warmup
             self._batch_shapes_warmed = False
@@ -316,11 +346,26 @@ class QueryService:
             # never double-holds old + new device model state
             self.last_evicted_bytes = placement.set_serving_instance(
                 instance.id)
+        # adopt the instance's trained quality baseline (None for
+        # instances trained before the quality pillar): live drift is
+        # judged against what THIS instance looked like at train time
+        baseline = None
+        raw = (instance.env or {}).get(quality.BASELINE_ENV_KEY)
+        if raw:
+            try:
+                baseline = json.loads(raw)
+            except ValueError:
+                logger.warning("instance %s carries an unparseable "
+                               "quality baseline", instance.id)
+        quality.MONITOR.set_baseline(instance.id, baseline)
         self._start_serving_promotion()
         logger.info(
             "deployed engine instance %s (trained %s)",
             instance.id, format_datetime(instance.start_time),
         )
+
+    def _load(self) -> None:
+        self._commit_bundle(self._prepare_instance(self._latest_instance()))
 
     def _register_model_age_hook(self) -> None:
         """Keep ``pio_serving_model_age_seconds{server=...}`` current at
@@ -628,6 +673,7 @@ class QueryService:
             self._count_error("predict")
             raise
         result = _result_to_json(prediction)
+        self._maybe_sample_quality(query, result)
         # output plugins (ref: CreateServer.scala:598-601)
         try:
             for blocker in self.plugin_context.output_blockers.values():
@@ -659,6 +705,32 @@ class QueryService:
         _QUERY_ERRORS.inc(kind=kind)
         with self.lock:
             self.error_count += 1
+
+    def _maybe_sample_quality(self, query, result) -> None:
+        """Feed one served prediction to the quality observatory
+        (obs/quality.py) under the ``PIO_QUALITY_SAMPLE`` head decision:
+        the score/coverage sketch, the shadow replay buffer, and — keyed
+        by this request's id — the feedback join buffer. Attribution is
+        pinned HERE, to the instance that served it, so feedback landing
+        after a hot-swap still credits the right model."""
+        from predictionio_tpu.obs import quality
+
+        try:
+            rid = current_request_id()
+            # the head decision is keyed on the request id so the event
+            # server's serving-log registration draws the SAME coin
+            if not quality.sample(rid):
+                return
+            with self.lock:
+                instance = self.instance
+            age = None
+            if instance.start_time is not None:
+                age = max((now() - ensure_aware(instance.start_time))
+                          .total_seconds(), 0.0)
+            quality.MONITOR.record_prediction(
+                rid, instance.id, age, query, result)
+        except Exception:  # noqa: BLE001 — sampling must never fail a query
+            logger.debug("quality sampling failed", exc_info=True)
 
     def _maybe_warm_batch_shapes(self, query) -> None:
         """After the first successful query, replay it at every batch
@@ -969,6 +1041,17 @@ class QueryService:
         if rid:
             properties["requestId"] = rid
             headers[REQUEST_ID_HEADER] = rid
+        # serving attribution rides the event too: in a split deploy the
+        # EVENT SERVER owns the feedback join (obs/quality.py buffers
+        # the served set straight from this predict event), and it needs
+        # to credit the instance that served, not guess
+        with self.lock:
+            instance = self.instance
+        properties["engineInstanceId"] = instance.id
+        if instance.start_time is not None:
+            properties["modelAgeSeconds"] = round(max(
+                (now() - ensure_aware(instance.start_time))
+                .total_seconds(), 0.0), 1)
         # the event server's ingest span joins this query's trace
         trace.inject_headers(headers)
         event = {
@@ -992,9 +1075,32 @@ class QueryService:
             with urllib.request.urlopen(req, timeout=5):
                 pass
             return pr_id
-        except Exception:
+        except urllib.error.HTTPError as e:
+            try:
+                e.read()  # drain so keep-alive connections stay usable
+            except Exception:  # noqa: BLE001 — a torn error body must not
+                pass  # escalate a served query into a 500
+            self._count_feedback_error("http_error")
+            logger.exception("feedback POST answered HTTP %s", e.code)
+            return None
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError):
+            self._count_feedback_error("unreachable")
             logger.exception("feedback POST failed")
             return None
+        except Exception:
+            self._count_feedback_error("error")
+            logger.exception("feedback POST failed")
+            return None
+
+    @staticmethod
+    def _count_feedback_error(reason: str) -> None:
+        from predictionio_tpu.obs import quality
+
+        _FEEDBACK_ERRORS.inc(reason=reason)
+        # windowed twin for /debug/quality and the doctor's starving-
+        # loop WARN — recent failures matter, lifetime totals don't
+        quality.MONITOR.note_feedback_error(reason)
 
     def _start_upgrade_checker(self) -> None:
         """Daily upgrade-check timer (ref: CreateServer.scala:268-275
@@ -1020,15 +1126,136 @@ class QueryService:
         """Hot-swap to the latest completed instance (ref: ReloadServer).
         ``evictedBytes`` reports the previous instance's device-pinned
         model state released by the swap — the operator-visible proof the
-        serving_models arena holds exactly one instance's catalogs."""
+        serving_models arena holds exactly one instance's catalogs.
+
+        Shadow-scored swap (obs/quality.py): when the latest instance is
+        a genuinely NEW one, the last-N sampled live queries replay
+        against the prepared candidate on the host path BEFORE
+        ``set_serving_instance`` commits, and the response carries a
+        ``shadow`` block (score shift + top-k overlap@k vs the serving
+        instance). ``PIO_RELOAD_SHADOW_GATE`` turns the report into a
+        gate: a candidate under the overlap floor is refused with 409
+        and the old instance keeps serving — the continuous-training
+        loop's pre-commit quality check."""
+        from predictionio_tpu.obs import quality
+
         old = self.instance.id
-        self._load()
+        instance = self._latest_instance()
+        shadow = None
+        if instance.id != old:
+            bundle = self._prepare_instance(instance)
+            shadow = self._shadow_report(bundle)
+            if shadow is not None:
+                quality.MONITOR.note_shadow(shadow)
+                if shadow.get("blocked"):
+                    logger.warning(
+                        "reload to %s REFUSED by the shadow gate: "
+                        "overlap@k %.3f under floor %.3f", instance.id,
+                        shadow.get("overlapAtK") or 0.0,
+                        shadow.get("gate"))
+                    return 409, {
+                        "reloaded": False,
+                        "previous": old,
+                        "current": old,
+                        "candidate": instance.id,
+                        "shadow": shadow,
+                    }
+            self._commit_bundle(bundle)
+        else:
+            # same instance: keep the legacy full-reload semantics (drop
+            # and re-pin the catalogs) — nothing to shadow against.
+            # Commit THIS fetch, not a re-fetch: a train completing in
+            # between must not slip past the shadow gate unvetted
+            self._commit_bundle(self._prepare_instance(instance))
         return 200, {
             "reloaded": True,
             "previous": old,
             "current": self.instance.id,
             "evictedBytes": self.last_evicted_bytes,
+            "shadow": shadow,
         }
+
+    def _shadow_report(self, bundle: dict) -> dict | None:
+        """Replay the quality monitor's last-N sampled queries against
+        the prepared candidate AND the current serving instance on the
+        host path, and compare: mean top-k overlap@k and the relative
+        score shift. None when nothing was sampled yet (nothing to
+        judge — the swap proceeds, reported as ``replayed: 0``)."""
+        from predictionio_tpu.obs import quality
+
+        queries = quality.MONITOR.shadow_queries()
+        gate = quality.shadow_gate_floor()
+        report: dict = {
+            "serving": self.instance.id,
+            "candidate": bundle["instance"].id,
+            "replayed": 0,
+            "overlapAtK": None,
+            "scoreShift": None,
+            "gate": gate,
+            "blocked": False,
+        }
+        if not queries:
+            return report
+
+        def run_side(side) -> list:
+            """Each query's (item, score) pairs for one side, None for
+            a query that failed. ONE batched predict per algorithm —
+            under the cache bypass every per-query call would re-upload
+            the whole catalog."""
+            algorithms, models, serving = side
+            try:
+                supplemented = [serving.supplement(q) for q in queries]
+            except Exception:  # noqa: BLE001 — a side that cannot even
+                return [None] * len(queries)  # supplement judges nothing
+            per_algo = [
+                quality.batch_predictions(algo, model, supplemented)
+                for algo, model in zip(algorithms, models)]
+            out = []
+            for i, q in enumerate(queries):
+                try:
+                    out.append(quality.extract_item_scores(
+                        _result_to_json(serving.serve(
+                            q, [pa[i] for pa in per_algo]))))
+                except Exception:  # noqa: BLE001 — no evidence
+                    out.append(None)
+            return out
+
+        with self.lock:
+            cur = (self.algorithms, self.models, self.serving)
+        cand = (bundle["algorithms"], bundle["models"], bundle["serving"])
+        from predictionio_tpu.parallel import placement
+
+        # the replay must leave NO residue in the serving_models
+        # identity cache: the candidate isn't committed (and may never
+        # be), and pinning its catalogs here would inflate the swap's
+        # evictedBytes accounting
+        with placement.serving_cache_bypass():
+            side_a = run_side(cur)
+            side_b = run_side(cand)
+        overlaps: list[float] = []
+        shifts: list[float] = []
+        for a, b in zip(side_a, side_b):
+            if a is None or b is None:
+                continue
+            items_a = [i for i, _ in a if i is not None]
+            items_b = [i for i, _ in b if i is not None]
+            k = min(len(items_a), len(items_b))
+            if k > 0:
+                overlaps.append(
+                    len(set(items_a[:k]) & set(items_b[:k])) / k)
+            if a and b:
+                mean_a = sum(s for _, s in a) / len(a)
+                mean_b = sum(s for _, s in b) / len(b)
+                shifts.append((mean_b - mean_a) / (abs(mean_a) + 1e-9))
+        report["replayed"] = len(overlaps)
+        if overlaps:
+            report["overlapAtK"] = round(sum(overlaps) / len(overlaps), 4)
+        if shifts:
+            report["scoreShift"] = round(sum(shifts) / len(shifts), 4)
+        if gate is not None and report["overlapAtK"] is not None \
+                and report["overlapAtK"] < gate:
+            report["blocked"] = True
+        return report
 
     def get_stop(self, request: Request):
         self._stop_event.set()

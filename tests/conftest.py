@@ -37,6 +37,7 @@ import pytest  # noqa: E402
 def memory_storage(monkeypatch):
     """Wire all three repositories to the in-memory backend, isolated per test."""
     from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.obs import quality
 
     for key in list(os.environ):
         if key.startswith("PIO_STORAGE_"):
@@ -46,8 +47,14 @@ def memory_storage(monkeypatch):
         monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "MEM")
         monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"test_{repo.lower()}")
     Storage.reset()
+    # the quality monitor keys state by engine-instance id; the memory
+    # backend's sequential ids ("1", "2") collide across tests, so a
+    # fresh store must also mean a fresh monitor (the PIO_RUNS_DIR
+    # hermeticity precedent)
+    quality.reset()
     yield Storage
     Storage.reset()
+    quality.reset()
 
 
 @pytest.fixture()

@@ -76,6 +76,27 @@ def test_zero_baseline_to_nonzero_cost_is_a_regression():
     assert [e["key"] for e in result["unchanged"]] == ["retraces"]
 
 
+def test_quality_keys_are_higher_is_better():
+    """ISSUE 13's headline keys: a DROP in the feedback join rate or the
+    shadow overlap is the regression, a rise is the improvement — the
+    direction inference must not read them as cost-shaped."""
+    from predictionio_tpu.tools.bench_compare import lower_is_better
+
+    assert not lower_is_better("quality_join_rate")
+    assert not lower_is_better("shadow_overlap_at_k")
+    result = compare(
+        {"quality_join_rate": 0.33, "shadow_overlap_at_k": 1.0},
+        {"quality_join_rate": 0.10, "shadow_overlap_at_k": 0.2})
+    assert {e["key"] for e in result["regressions"]} == {
+        "quality_join_rate", "shadow_overlap_at_k"}
+    result = compare(
+        {"quality_join_rate": 0.10, "shadow_overlap_at_k": 0.5},
+        {"quality_join_rate": 0.33, "shadow_overlap_at_k": 1.0})
+    assert not result["regressions"]
+    assert {e["key"] for e in result["improvements"]} == {
+        "quality_join_rate", "shadow_overlap_at_k"}
+
+
 def test_per_key_threshold_overrides():
     a = flatten_headline(load_headline(BASELINE))
     b = flatten_headline(load_headline(CANDIDATE))

@@ -1,0 +1,504 @@
+"""Prediction-quality observatory (obs/quality.py, ISSUE 13): drift
+sketches, the feedback join buffer's edge cases, the shadow-scored
+/reload gate, the online_quality SLO, and the doctor's quality story."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import REGISTRY, quality
+from tests.test_query_server import call, seed_and_train
+
+FACTORY = "predictionio_tpu.templates.recommendation:engine_factory"
+
+
+@pytest.fixture(autouse=True)
+def fresh_monitor():
+    quality.reset()
+    yield
+    quality.reset()
+
+
+def _predict(mon, rid, instance="inst-a", items=("i1", "i2", "i3"),
+             scores=None, age=5.0, query=None):
+    result = {"itemScores": [
+        {"item": it, "score": (scores[k] if scores else 1.0 - 0.1 * k)}
+        for k, it in enumerate(items)]}
+    mon.record_prediction(rid, instance, age, query, result)
+
+
+# -- score extraction / sketch math ------------------------------------------
+
+
+def test_extract_item_scores_shapes():
+    from predictionio_tpu.templates.recommendation import (
+        ItemScore,
+        PredictedResult,
+    )
+
+    r = PredictedResult((ItemScore("i1", 2.0), ItemScore("i2", 1.0)))
+    assert quality.extract_item_scores(r) == [("i1", 2.0), ("i2", 1.0)]
+    assert quality.extract_item_scores(
+        {"itemScores": [{"item": "x", "score": 3.5}]}) == [("x", 3.5)]
+    assert quality.extract_item_scores({"score": 0.25}) == [(None, 0.25)]
+    assert quality.extract_item_scores({"label": "spam"}) == []
+    # NaN / non-numeric scores never ride into the sketch
+    assert quality.extract_item_scores(
+        {"itemScores": [{"item": "x", "score": float("nan")}]}) == []
+
+
+def test_baseline_and_psi_roundtrip():
+    rng = np.random.default_rng(0)
+    scored = [[(f"i{k}", float(s)) for k, s in
+               enumerate(rng.normal(0.0, 1.0, 10))] for _ in range(50)]
+    doc = quality.build_baseline(scored, n_items=100, k=10)
+    assert doc["queries"] == 50 and doc["nItems"] == 100
+    assert len(doc["edges"]) == 9 and len(doc["counts"]) == 10
+    # the same top-score population drifts ~0; a shifted one visibly
+    same = [max(float(s) for _, s in p) for p in scored]
+    psi_same = quality.population_stability_index(
+        doc["counts"], same, doc["edges"])
+    shifted = [s + 3.0 for s in same]
+    psi_shifted = quality.population_stability_index(
+        doc["counts"], shifted, doc["edges"])
+    assert psi_same < 0.05 < psi_shifted
+    assert psi_shifted > 1.0
+
+
+def test_sample_mode_parsing(monkeypatch):
+    monkeypatch.setenv("PIO_QUALITY_SAMPLE", "off")
+    assert not quality.quality_enabled()
+    monkeypatch.setenv("PIO_QUALITY_SAMPLE", "all")
+    assert quality.sample_mode() == "all" and quality.sample()
+    monkeypatch.setenv("PIO_QUALITY_SAMPLE", "0.5")
+    assert quality.sample_mode() == "0.5"
+    monkeypatch.setenv("PIO_QUALITY_SAMPLE", "2.5")
+    assert quality.sample_mode() == "all"
+    monkeypatch.setenv("PIO_QUALITY_SAMPLE", "garbage")
+    assert quality.sample_mode() == "all"
+
+
+# -- join-buffer edge cases (the ISSUE 13 satellite) --------------------------
+
+
+def test_feedback_unknown_request_id():
+    mon = quality.QualityMonitor()
+    _predict(mon, "r1")
+    assert mon.record_feedback("never-served", "i1") == "unknown"
+    # the buffered entry is untouched
+    assert mon.join_buffer_len() == 1
+
+
+def test_feedback_hit_miss_and_duplicate():
+    mon = quality.QualityMonitor()
+    _predict(mon, "r1")
+    _predict(mon, "r2")
+    assert mon.record_feedback("r1", "i2") == "hit"
+    # duplicate feedback for one request counts once, recognized as such
+    assert mon.record_feedback("r1", "i2") == "duplicate"
+    assert mon.record_feedback("r2", "not-served-item") == "miss"
+    doc = mon.to_json()
+    stats = doc["instances"]["inst-a"]
+    assert stats["joined"] == 2 and stats["hits"] == 1
+    assert stats["hitRate"] == 0.5
+
+
+def test_feedback_with_expired_request_id(monkeypatch):
+    monkeypatch.setenv("PIO_QUALITY_JOIN_TTL_S", "0.05")
+    mon = quality.QualityMonitor()
+    _predict(mon, "r1")
+    time.sleep(0.08)
+    before = dict(REGISTRY.get(
+        "pio_quality_join_evictions_total").items())
+    assert mon.record_feedback("r1", "i1") == "unknown"
+    after = dict(REGISTRY.get("pio_quality_join_evictions_total").items())
+    assert after.get(("ttl",), 0) > before.get(("ttl",), 0)
+    assert mon.join_buffer_len() == 0
+
+
+def test_feedback_after_instance_swap_attributes_to_server(monkeypatch):
+    """Feedback arriving after a hot-swap must credit the instance that
+    SERVED the request, not whatever serves now."""
+    mon = quality.QualityMonitor()
+    _predict(mon, "old-rid", instance="inst-old", age=100.0)
+    # the swap: traffic now serves (and samples) under the new instance
+    _predict(mon, "new-rid", instance="inst-new", age=1.0)
+    assert mon.record_feedback("old-rid", "i1") == "hit"
+    doc = mon.to_json()
+    assert doc["instances"]["inst-old"]["joined"] == 1
+    assert doc["instances"]["inst-old"]["hits"] == 1
+    assert doc["instances"]["inst-new"]["joined"] == 0
+
+
+def test_join_buffer_bounded_under_sustained_load(monkeypatch):
+    monkeypatch.setenv("PIO_QUALITY_JOIN_CAP", "16")
+    mon = quality.QualityMonitor()
+    before = dict(REGISTRY.get(
+        "pio_quality_join_evictions_total").items())
+    for k in range(50):
+        _predict(mon, f"r{k}")
+    assert mon.join_buffer_len() <= 16
+    after = dict(REGISTRY.get("pio_quality_join_evictions_total").items())
+    assert after.get(("capacity",), 0) - before.get(("capacity",), 0) == 34
+    # oldest evicted first: r0 is gone, the newest still joins
+    assert mon.record_feedback("r0", "i1") == "unknown"
+    assert mon.record_feedback("r49", "i1") == "hit"
+
+
+def test_merge_docs_sums_and_worst_cases():
+    a = {"joinEntries": 2, "instances": {"i1": {
+        "sampled": 40, "joined": 24, "hits": 12, "windowJoined": 24,
+        "drift": 0.05, "coverage": 0.9, "hitRate": 0.5,
+        "modelAgeSeconds": 10.0}},
+        "feedback": {"hit": 2, "miss": 2}}
+    b = {"joinEntries": 1, "instances": {"i1": {
+        "sampled": 40, "joined": 26, "hits": 4, "windowJoined": 26,
+        "drift": 0.30, "coverage": 0.4, "hitRate": 0.17,
+        "modelAgeSeconds": 12.0}},
+        "feedback": {"hit": 1, "miss": 5}}
+    merged = quality.merge_docs([a, b])
+    s = merged["instances"]["i1"]
+    assert s["sampled"] == 80 and s["joined"] == 50 and s["hits"] == 16
+    assert s["drift"] == 0.30        # worst case: max
+    assert s["coverage"] == 0.4      # worst case: min
+    assert s["hitRate"] == 0.17      # worst case: min
+    assert merged["feedback"] == {"hit": 3, "miss": 7}
+    assert merged["joinEntries"] == 3
+
+
+def test_merge_docs_gates_judged_stats_on_replica_evidence():
+    """Worst-case drift/hitRate must come only from replicas whose OWN
+    window has enough evidence: the merged doc pairs those values with
+    fleet-SUMMED counts, so an unguarded merge would let one replica's
+    2-sample noise ride the fleet's summed counts past
+    quality_findings' minimum-evidence guards."""
+    healthy = {"instances": {"i1": {
+        "sampled": 40, "joined": 19, "hits": 10, "windowJoined": 19,
+        "windowPredictions": 40, "drift": 0.02, "hitRate": 0.5}}}
+    noisy = {"instances": {"i1": {
+        "sampled": 2, "joined": 2, "hits": 0, "windowJoined": 2,
+        "windowPredictions": 2, "drift": 0.8, "hitRate": 0.0}}}
+    merged = quality.merge_docs([healthy, noisy])
+    s = merged["instances"]["i1"]
+    # summed evidence clears the guards, so the values CARRYING that
+    # evidence must exclude the under-sampled replica
+    assert s["windowJoined"] == 21 and s["windowPredictions"] == 42
+    assert s["drift"] == 0.02        # noisy replica's 2-sample PSI out
+    assert s["hitRate"] is None      # 19 < min joins on BOTH replicas
+    assert not [f for f in quality.quality_findings(merged)
+                if f["subject"].startswith("QUALITY-")]
+    # an older peer without the window counts is judged as-is
+    legacy = {"instances": {"i1": {"sampled": 5, "drift": 0.9}}}
+    assert quality.merge_docs([legacy])["instances"]["i1"]["drift"] == 0.9
+
+
+# -- doctor findings ----------------------------------------------------------
+
+
+def test_quality_findings_name_instance_and_age():
+    doc = {"instances": {
+        "inst-x": {"drift": 0.4, "modelAgeSeconds": 120.0,
+                   "hitRate": 0.0, "windowJoined": 25},
+    }, "feedbackErrors": {"unreachable": 2}}
+    findings = quality.quality_findings(doc)
+    subjects = [f["subject"] for f in findings]
+    assert "QUALITY-DRIFT inst-x" in subjects
+    assert "QUALITY-REGRESSION inst-x" in subjects
+    drift = next(f for f in findings
+                 if f["subject"] == "QUALITY-DRIFT inst-x")
+    assert drift["severity"] == "critical"  # 0.4 > crit 0.25
+    assert "model age 120s" in drift["detail"]
+    fb = next(f for f in findings if f["subject"] == "feedback loop")
+    assert fb["severity"] == "warn" and "unreachable=2" in fb["detail"]
+    # under the warn threshold / too few joins: silence
+    assert quality.quality_findings({"instances": {
+        "ok": {"drift": 0.01, "hitRate": 0.0, "windowJoined": 2}}}) == []
+
+
+def test_doctor_folds_staleness_into_quality_story():
+    from predictionio_tpu.obs import fleet
+
+    slo_state = {"slos": [
+        {"name": "model_staleness", "breached": True,
+         "burnRates": {"fast": 100.0, "slow": 100.0},
+         "burnThreshold": 14.4, "description": "model age bound"},
+    ]}
+    qdoc = {"instances": {"inst-x": {
+        "drift": 0.5, "modelAgeSeconds": 99999.0,
+        "hitRate": None, "windowJoined": 0}}}
+    findings = fleet.diagnose(None, [], slo_state, quality=qdoc)
+    subjects = [f["subject"] for f in findings]
+    # ONE ranked story: the staleness SLO row folded into the quality row
+    assert "SLO model_staleness" not in subjects
+    drift = next(f for f in findings
+                 if f["subject"] == "QUALITY-DRIFT inst-x")
+    assert "model_staleness" in drift["detail"]
+    # folding a CRITICAL breach into a warn-band drift must keep the
+    # critical severity (the doctor's exit code rides on it)
+    warn_qdoc = {"instances": {"inst-x": {
+        "drift": 0.15, "modelAgeSeconds": 99999.0,
+        "hitRate": None, "windowJoined": 0, "windowPredictions": 50}}}
+    findings = fleet.diagnose(None, [], slo_state, quality=warn_qdoc)
+    folded = next(f for f in findings
+                  if f["subject"] == "QUALITY-DRIFT inst-x")
+    assert folded["severity"] == "critical"
+    assert "SLO model_staleness" not in [f["subject"] for f in findings]
+    # a quality doc with ONLY a feedback-loop warn is not model-related:
+    # the staleness row stands alone, never folded into it
+    fb_qdoc = {"instances": {}, "feedbackErrors": {"unreachable": 2}}
+    findings = fleet.diagnose(None, [], slo_state, quality=fb_qdoc)
+    subjects = [f["subject"] for f in findings]
+    assert "SLO model_staleness" in subjects
+    assert "feedback loop" in subjects
+    # without quality findings the staleness row stands alone as before
+    findings = fleet.diagnose(None, [], slo_state, quality=None)
+    assert [f["subject"] for f in findings] == ["SLO model_staleness"]
+
+
+# -- online_quality SLO --------------------------------------------------------
+
+
+def test_online_quality_slo_trips_within_two_ticks(monkeypatch):
+    from predictionio_tpu.obs.history import HistorySampler
+    from predictionio_tpu.obs.slo import SLOEngine
+
+    mon = quality.MONITOR
+    sampler = HistorySampler(interval_s=10.0, capacity=64)
+    eng = SLOEngine()
+    t0 = time.time()
+    sampler.sample_once(t0)  # tick 0: establish counter baselines
+    # a burst of served-and-missed feedback: online hit rate 0.0
+    for k in range(10):
+        _predict(mon, f"slo-r{k}")
+        mon.record_feedback(f"slo-r{k}", "item-nobody-was-served")
+    sampler.sample_once(t0 + 10.0)  # tick 1: the bad interval lands
+    state = eng.evaluate(sampler, t0 + 10.0)
+    slo = next(s for s in state if s["name"] == "online_quality")
+    assert slo["breached"], slo
+    assert slo["burnRates"]["fast"] > 14.4
+    assert slo["badBelow"] is True
+    # hits above the floor drain the burn back down
+    for k in range(10):
+        _predict(mon, f"slo-h{k}", items=("w1", "w2"))
+        mon.record_feedback(f"slo-h{k}", "w1")
+    sampler.sample_once(t0 + 20.0)
+    # intervals with NO joined feedback are no evidence, not a breach
+    sampler.sample_once(t0 + 30.0)
+    vals = sampler.window_values("online_hit_rate", 5.0, t0 + 30.0)
+    assert vals == []  # the empty interval sampled None
+
+
+def test_history_quality_series(monkeypatch):
+    from predictionio_tpu.obs.history import HistorySampler
+
+    mon = quality.MONITOR
+    sampler = HistorySampler(interval_s=10.0, capacity=64)
+    t0 = time.time()
+    sampler.sample_once(t0)
+    for k in range(4):
+        _predict(mon, f"h-r{k}", items=("a", "b"))
+    mon.record_feedback("h-r0", "a")   # hit
+    mon.record_feedback("h-r1", "zz")  # miss
+    values = sampler.sample_once(t0 + 10.0)
+    assert values["online_hit_rate"] == pytest.approx(0.5)
+    assert values["quality_join_rate"] == pytest.approx(0.5)
+
+
+# -- serving E2E: baseline → drift → shadow-gated reload ----------------------
+
+
+@pytest.fixture
+def server(memory_storage):
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    yield {"port": srv.port, "service": service, "storage": memory_storage}
+    srv.stop()
+
+
+def test_train_persists_baseline_and_deploy_adopts_it(server):
+    storage = server["storage"]
+    instance = server["service"].instance
+    raw = instance.env.get(quality.BASELINE_ENV_KEY)
+    assert raw, "run_train must persist the quality baseline"
+    doc = json.loads(raw)
+    assert doc["queries"] > 0 and len(doc["edges"]) == 9
+    assert quality.MONITOR.baseline_instance == instance.id
+    assert quality.MONITOR.baseline == doc
+    assert storage  # fixture keep-alive
+
+
+def test_sampled_traffic_populates_quality_surfaces(server):
+    # representative traffic (16 of the 20 trained users): the drift
+    # statistic judges the model, and must stay quiet when only the
+    # requested num differs from the baseline probe's top-10
+    for k in range(16):
+        status, _ = call(server["port"], "POST", "/queries.json",
+                         {"user": f"u{k}", "num": 5})
+        assert status == 200
+    status, doc = call(server["port"], "GET", "/debug/quality")
+    assert status == 200
+    iid = server["service"].instance.id
+    stats = doc["instances"][iid]
+    assert stats["sampled"] == 16
+    assert stats["scoreMean"] is not None
+    # the same model that built the baseline serves: drift ~ 0
+    assert stats["drift"] is not None and stats["drift"] < 0.25
+    assert doc["baselineInstance"] == iid
+    # gauges land on /metrics at scrape
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server['port']}/metrics") as resp:
+        text = resp.read().decode()
+    assert "pio_prediction_score_mean{" in text
+    assert "pio_prediction_drift_score{" in text
+
+
+def test_debug_quality_404_when_disabled(server, monkeypatch):
+    monkeypatch.setenv("PIO_QUALITY_SAMPLE", "off")
+    status, _ = call(server["port"], "GET", "/debug/quality")
+    assert status == 404
+
+
+def _corrupt_item_factors(storage, instance_id):
+    """Shuffle the persisted model's item factors — a structurally valid
+    candidate whose answers are garbage (the acceptance scenario)."""
+    from predictionio_tpu.core.persistent_model import (
+        deserialize_models,
+        serialize_models,
+    )
+    from predictionio_tpu.data.storage.base import Model
+
+    models_dao = storage.get_model_data_models()
+    blob = models_dao.get(instance_id)
+    models = deserialize_models(blob.models)
+    rng = np.random.default_rng(7)
+    item = models[0].factors.item_features
+    models[0].factors.item_features = item[rng.permutation(len(item))]
+    models_dao.insert(Model(instance_id, serialize_models(models)))
+
+
+def test_shadow_gate_blocks_corrupted_candidate(server, monkeypatch):
+    storage = server["storage"]
+    port = server["port"]
+    old = server["service"].instance.id
+    # live traffic fills the shadow replay buffer
+    for k in range(6):
+        call(port, "POST", "/queries.json", {"user": f"u{k}", "num": 5})
+    candidate = seed_and_train(storage, seed=9)
+    _corrupt_item_factors(storage, candidate)
+    monkeypatch.setenv("PIO_RELOAD_SHADOW_GATE", "0.5")
+    status, body = call(port, "GET", "/reload")
+    assert status == 409
+    assert body["reloaded"] is False
+    assert body["current"] == old and body["candidate"] == candidate
+    shadow = body["shadow"]
+    assert shadow["replayed"] > 0
+    # shuffled factors ≈ random top-k: with a 15-item catalog the
+    # chance overlap@5 sits near 5/15, far under a healthy ≈ 1.0
+    assert shadow["overlapAtK"] < 0.5
+    assert shadow["blocked"] is True
+    # the old instance kept serving
+    assert server["service"].instance.id == old
+    status, _ = call(port, "POST", "/queries.json",
+                     {"user": "u1", "num": 3})
+    assert status == 200
+    # gate off: the same candidate swaps in, shadow block advisory
+    monkeypatch.delenv("PIO_RELOAD_SHADOW_GATE")
+    status, body = call(port, "GET", "/reload")
+    assert status == 200 and body["current"] == candidate
+    assert body["shadow"]["blocked"] is False
+    assert body["shadow"]["overlapAtK"] < 0.5
+
+
+def test_healthy_retrain_passes_shadow_gate(server, monkeypatch):
+    port = server["port"]
+    for k in range(6):
+        call(port, "POST", "/queries.json", {"user": f"u{k}", "num": 5})
+    # same data, same seed → a near-twin model clears the gate
+    candidate = seed_and_train(server["storage"], seed=1)
+    monkeypatch.setenv("PIO_RELOAD_SHADOW_GATE", "0.5")
+    status, body = call(port, "GET", "/reload")
+    assert status == 200
+    assert body["current"] == candidate
+    assert body["shadow"]["overlapAtK"] > 0.8
+    assert quality.MONITOR.last_shadow["candidate"] == candidate
+
+
+def test_feedback_errors_counted_by_reason(memory_storage):
+    from predictionio_tpu.utils.http import free_port
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    seed_and_train(memory_storage)
+    srv, service = create_server(ServerConfig(
+        ip="127.0.0.1", port=0, feedback=True,
+        event_server_ip="127.0.0.1", event_server_port=free_port()))
+    srv.start()
+    try:
+        before = dict(REGISTRY.get("pio_feedback_errors_total").items())
+        status, _ = call(srv.port, "POST", "/queries.json",
+                         {"user": "u1", "num": 3})
+        assert status == 200  # a dead feedback loop never fails the query
+        after = dict(REGISTRY.get("pio_feedback_errors_total").items())
+        assert after.get(("unreachable",), 0) > \
+            before.get(("unreachable",), 0)
+        # the quality doc reports the starving loop for the doctor
+        doc = quality.MONITOR.to_json()
+        assert doc["feedbackErrors"].get("unreachable")
+        assert any(f["subject"] == "feedback loop"
+                   for f in quality.quality_findings(doc))
+    finally:
+        srv.stop()
+
+
+def test_event_server_joins_feedback_via_request_id(memory_storage):
+    """End to end across processes' surfaces: a served+sampled request's
+    id rides a later ingested event and joins the buffer."""
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+
+    mon = quality.MONITOR
+    _predict(mon, "rid-123", items=("i7", "i8"))
+    event = Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i7",
+                  properties=DataMap({"rating": 5.0,
+                                      "requestId": "rid-123"}))
+    assert quality.observe_event(event) == "hit"
+    # the serving log's own predict event is NOT user feedback — but it
+    # REGISTERS the served set, which is how a split-process event
+    # server (that never saw the serving side) joins later feedback
+    log_event = Event(
+        event="predict", entity_type="pio_pr", entity_id="pr1",
+        properties=DataMap({
+            "requestId": "rid-999",
+            "engineInstanceId": "inst-split",
+            "modelAgeSeconds": 42.0,
+            "prediction": {"itemScores": [
+                {"item": "i9", "score": 1.5},
+                {"item": "i4", "score": 1.1}]},
+        }))
+    assert quality.observe_event(log_event) is None
+    later = Event(event="buy", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i9",
+                  properties=DataMap({"requestId": "rid-999"}))
+    assert quality.observe_event(later) == "hit"
+    assert mon.to_json()["instances"]["inst-split"]["hits"] == 1
+    # in-process no-op: a served set the query server ALREADY recorded
+    # (or that feedback already consumed) never tallies twice
+    mon.record_served_set("rid-999", "inst-split", 42.0, ("i9",))
+    assert mon.to_json()["instances"]["inst-split"]["sampled"] == 1
+    # events without a requestId are invisible to the join
+    plain = Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 2.0}))
+    assert quality.observe_event(plain) is None
