@@ -1023,6 +1023,136 @@ HEADLINE_METRIC = "ml100k_rest_predict_p50_ms"
 GATEWAY_HEADLINE_METRIC = "ml100k_gateway_predict_p50_ms"
 
 
+def bench_foldin(burst: int = 400, rank: int = 10,
+                 iterations: int = 20) -> dict:
+    """Continuous-training headline (train/continuous.py, ROADMAP item 2):
+
+    ``events_to_servable_s`` — ingest a burst of N new rating events
+    against a live deployment running the ContinuousTrainer and measure
+    the wall from the FIRST event's ingest to the shadow-gated ``/reload``
+    hot-swap landing (the trainer's own
+    ``pio_foldin_events_to_servable_seconds`` observation). Measured on
+    the SECOND generation: the daemon's steady state is warm — the first
+    generation's one-time XLA compile of the fold-in program is paid at
+    startup, exactly like the serving sections' batch-shape warmups.
+
+    ``foldin_speedup_vs_retrain`` — the same refresh via the legacy path
+    (full ``run_train`` + ``/reload``), timed on the same catalog at the
+    engine's deployed iteration count (the template default, 20); the
+    ratio is the fold-in subsystem's reason to exist (the ISSUE 14
+    acceptance bound is ≥ 5x). Both nulls on failure / ``--dry-run`` so
+    the capture schema stays stable."""
+    import urllib.request
+
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.datamap import DataMap
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.templates.recommendation import engine_factory
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    out: dict = {"events_to_servable_s": None,
+                 "foldin_speedup_vs_retrain": None}
+    factory = "predictionio_tpu.templates.recommendation:engine_factory"
+    storage = _setup_storage()
+    _seed_and_train(storage, rank=rank)
+    engine = engine_factory()
+    variant = {
+        "engineFactory": factory,
+        "datasource": {"params": {"app_name": "benchapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": rank, "numIterations": iterations,
+                        "seed": 0}}
+        ],
+    }
+    ep = engine.engine_params_from_json(variant)
+    # the deployed model at the engine's real iteration count (the
+    # _seed_and_train 5-iteration instance exists only to seed storage)
+    run_train(engine, ep,
+              new_engine_instance("default", "1", "default", factory, ep),
+              WorkflowParams())
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    trainer = None
+    try:
+        from predictionio_tpu.train.continuous import (
+            ContinuousConfig,
+            ContinuousTrainer,
+        )
+
+        trainer = ContinuousTrainer(
+            engine, ep, engine_factory=factory,
+            config=ContinuousConfig(
+                interval_s=3600.0, min_events=1, full_every=0,
+                reload_url=f"http://127.0.0.1:{srv.port}",
+                name="bench_foldin"))
+        trainer.bootstrap()
+        app_id = storage.get_meta_data_apps().get_by_name("benchapp").id
+        events = storage.get_events()
+        rng = np.random.default_rng(7)
+
+        def ingest(n: int) -> None:
+            for _ in range(n):
+                events.insert(
+                    Event(event="rate", entity_type="user",
+                          entity_id=f"u{int(rng.integers(0, 40))}",
+                          target_entity_type="item",
+                          target_entity_id=f"i{int(rng.integers(0, 200))}",
+                          properties=DataMap(
+                              {"rating": float(rng.integers(1, 6))})),
+                    app_id)
+
+        def wait_generation(g: int) -> None:
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                trainer.poll_once()
+                if trainer._generation >= g:
+                    return
+                time.sleep(0.05)
+
+        ingest(burst)       # warmup generation: pays the one-time
+        wait_generation(1)  # fold-in program compile for the burst's
+        #                     touched-row pow2 buckets (the daemon's
+        #                     steady state is warm)
+        ingest(burst)               # the measured steady-state burst
+        wait_generation(2)
+        e2s = trainer._last_events_to_servable_s
+        if trainer._last_swap == "swapped" and e2s:
+            out["events_to_servable_s"] = round(float(e2s), 3)
+            # the legacy path on the SAME (now delta-inclusive) log:
+            # full retrain + redeploy wall
+            t0 = time.perf_counter()
+            instance = new_engine_instance(
+                "default", "1", "default", factory, ep)
+            run_train(engine, ep, instance, WorkflowParams())
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/reload",
+                    timeout=300) as resp:
+                resp.read()
+            retrain_s = time.perf_counter() - t0
+            out["foldin_speedup_vs_retrain"] = round(
+                retrain_s / max(float(e2s), 1e-9), 2)
+    except Exception:  # noqa: BLE001 — headline keys are best-effort
+        import traceback
+
+        traceback.print_exc()
+    finally:
+        if trainer is not None:
+            # mark the state file stopped — a running:true leftover
+            # would read as a dead daemon in pio status/doctor
+            trainer.stop()
+        srv.stop()
+        service.shutdown()
+    return out
+
+
 def _headline(results: dict, metric: str = HEADLINE_METRIC) -> dict:
     """The driver's stdout contract (same shape as bench.py): metric /
     value / unit / vs_baseline / extra, with the full section results
@@ -1065,6 +1195,11 @@ def _dry_run_doc(gateway: bool = False) -> dict:
             # both are higher-is-better under pio bench-compare
             "quality_join_rate": None,
             "shadow_overlap_at_k": None,
+            # continuous-training keys (ISSUE 14): events_to_servable is
+            # a COST (bench-compare treats it lower-is-better), the
+            # speedup ratio higher-is-better
+            "events_to_servable_s": None,
+            "foldin_speedup_vs_retrain": None,
         },
         metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
 
@@ -1076,6 +1211,7 @@ def _collect(gateway: bool, replicas: int) -> dict:
     results = bench_query_latency()
     results.update(bench_event_ingest())
     results.update(bench_event_scan())
+    results.update(bench_foldin())
     return _headline(results)
 
 

@@ -143,6 +143,29 @@ def decode_record(buf: bytes, pos: int = 0) -> tuple[Event | None, int, int]:
     return event, pos + 4 + total, flags
 
 
+def coerce_rating(properties, rating_key: str | None,
+                  default_rating: float) -> float:
+    """The store-wide rating-property coercion (mirrors the C++ columnar
+    scan): numeric and numeric-string values become the rating, booleans
+    and everything else fall back to ``default_rating``. Shared by
+    :func:`intern_interactions` and the continuous trainer's
+    ``DeltaSpec.event_row`` so a row folded in incrementally is the row
+    a full retrain's scan would produce."""
+    v = default_rating
+    if rating_key is not None:
+        raw = properties.get_opt(rating_key)
+        if isinstance(raw, bool):
+            pass  # booleans are not ratings
+        elif isinstance(raw, (int, float)):
+            v = float(raw)
+        elif isinstance(raw, str):
+            try:
+                v = float(raw)  # numeric strings accepted, like the C++
+            except ValueError:
+                pass
+    return v
+
+
 def intern_interactions(
     events: "Iterator[Event]",
     event_names: Sequence[str],
@@ -166,19 +189,7 @@ def intern_interactions(
         ii.append(items.setdefault(ev.target_entity_id, len(items)))
         ni.append(name_to_idx[ev.event])
         tt.append(_to_us(ev.event_time))
-        v = default_rating
-        if rating_key is not None:
-            raw = ev.properties.get_opt(rating_key)
-            if isinstance(raw, bool):
-                pass  # booleans are not ratings
-            elif isinstance(raw, (int, float)):
-                v = float(raw)
-            elif isinstance(raw, str):
-                try:
-                    v = float(raw)  # numeric strings accepted, like the C++
-                except ValueError:
-                    pass
-        rr.append(v)
+        rr.append(coerce_rating(ev.properties, rating_key, default_rating))
     # Rows come out event-time sorted (stable, so file order breaks ties) to
     # honor the store-wide convention that event reads are time-ordered —
     # every other PEventStore.interaction_indices path goes through find(),

@@ -90,6 +90,32 @@ class MemEvents(base.Events):
         with self._c.lock:
             return self._store(app_id, channel_id).pop(event_id, None) is not None
 
+    # -- ingestion-order cursor reads (continuous training) -----------------
+    # seq = 1-based position in the table's insertion order: dicts
+    # preserve it, and an upsert of an existing event id keeps its
+    # original slot — the same cursor semantics as the SQLite rowid
+    # (data/storage/sql.py SQLEvents.find_since). Deletes compact the
+    # order (acceptable for the test/dev backend; documented divergence).
+
+    def find_since(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        since_seq: int = 0,
+        limit: int | None = None,
+    ) -> list[tuple[int, Event]]:
+        with self._c.lock:
+            events = list(self._store(app_id, channel_id).values())
+        out = [(seq, e) for seq, e in
+               enumerate(events[int(since_seq):], start=int(since_seq) + 1)]
+        if limit is not None and limit >= 0:
+            out = out[: int(limit)]
+        return out
+
+    def last_seq(self, app_id: int, channel_id: int | None = None) -> int:
+        with self._c.lock:
+            return len(self._store(app_id, channel_id))
+
     def find(
         self,
         app_id: int,

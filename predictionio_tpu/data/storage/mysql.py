@@ -86,6 +86,8 @@ class MySQLDialect(Dialect):
     #: specification without a key length") — keyed/indexed text columns
     #: get a length-bounded VARCHAR instead
     text_key = "VARCHAR(255)"
+    #: no implicit row id — cursor tail reads fall back to a time scan
+    seq_column = None
 
     def __init__(self, integrity_errors: tuple = ()):
         # driver-specific IntegrityError classes, wired by the client.

@@ -44,6 +44,9 @@ class PGDialect(Dialect):
     autoinc_pk = "BIGSERIAL PRIMARY KEY"
     bigint = "BIGINT"
     blob = "BYTEA"
+    #: no stable insert-order row id without a schema change (ctid moves
+    #: on vacuum) — cursor tail reads fall back to a time-based scan
+    seq_column = None
 
     # upsert_sql: the base ON CONFLICT … DO UPDATE form is already valid PG.
 

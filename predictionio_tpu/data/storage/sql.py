@@ -69,6 +69,14 @@ class Dialect:
     #: type for PRIMARY-KEY/UNIQUE/indexed text columns. SQLite/Postgres
     #: index TEXT directly; MySQL needs a length-bounded VARCHAR.
     text_key = "TEXT"
+    #: stable ingestion-order cursor column for ``SQLEvents.find_since``
+    #: (the continuous trainer's "events since (time, seq)" tail query).
+    #: SQLite's rowid is monotonic in insert order and survives upserts
+    #: (ON CONFLICT DO UPDATE keeps the original rowid, so a re-sent
+    #: event id never reappears past the cursor); server dialects
+    #: without an equivalent set None and callers fall back to a
+    #: time-based scan.
+    seq_column: str | None = "rowid"
 
     def ensure_index(self, client, name: str, table: str, cols: str) -> None:
         """Create the index if absent (MySQL lacks IF NOT EXISTS here)."""
@@ -515,6 +523,49 @@ class SQLEvents(base.Events):
                 sql += f" LIMIT {int(limit)}"
             rows = self._c.query(sql, params)
         return (self._row_to_event(row) for row in rows)
+
+    # -- ingestion-order cursor reads (continuous training) -----------------
+
+    def find_since(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        since_seq: int = 0,
+        limit: int | None = None,
+    ) -> list[tuple[int, Event]] | None:
+        """Events strictly after cursor position ``since_seq`` in
+        INGESTION order, as ``(seq, event)`` pairs — the continuous
+        trainer's tail query (train/continuous.py). Unlike :meth:`find`,
+        polling with the returned tail seq never rescans the log: the
+        cursor predicate rides the dialect's monotonic row id (see
+        ``Dialect.seq_column``), indexed by the storage engine itself.
+        None when the dialect has no stable cursor (callers fall back to
+        a time-based scan)."""
+        seq = self._c.dialect.seq_column
+        if seq is None:
+            return None
+        with self._table(app_id, channel_id) as t:
+            sql = (f'SELECT {_EVENT_COLS}, {seq} FROM "{t}" '
+                   f"WHERE {seq} > ? ORDER BY {seq}")
+            if limit is not None and limit >= 0:
+                sql += f" LIMIT {int(limit)}"
+            rows = self._c.query(sql, (int(since_seq),))
+        return [(int(r[-1]), self._row_to_event(r[:-1])) for r in rows]
+
+    def last_seq(self, app_id: int, channel_id: int | None = None
+                 ) -> int | None:
+        """Current cursor tail (the seq of the newest stored event; 0 for
+        an empty table) — snapshotted by ``run_train`` BEFORE the data
+        read so the trained instance records which events it could have
+        seen (``train_watermark_seq``). None when the dialect has no
+        stable cursor."""
+        seq = self._c.dialect.seq_column
+        if seq is None:
+            return None
+        with self._table(app_id, channel_id) as t:
+            rows = self._c.query(
+                f'SELECT COALESCE(MAX({seq}), 0) FROM "{t}"')
+        return int(rows[0][0]) if rows else 0
 
 
 def _new_instance_id() -> str:

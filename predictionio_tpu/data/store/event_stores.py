@@ -68,6 +68,41 @@ class PEventStore:
         )
 
     @staticmethod
+    def events_since(
+        app_name: str,
+        since_seq: int = 0,
+        channel_name: str | None = None,
+        limit: int | None = None,
+    ) -> list[tuple[int, "Event"]] | None:
+        """Ingestion-ordered ``(seq, event)`` pairs strictly after cursor
+        position ``since_seq`` — the continuous trainer's tail query
+        (train/continuous.py): polling with the returned tail seq reads
+        only what arrived since, never rescanning the log. None when the
+        backend has no stable ingestion cursor (callers fall back to a
+        time-based scan)."""
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        backend = Storage.get_events()
+        find_since = getattr(backend, "find_since", None)
+        if find_since is None:
+            return None
+        return find_since(app_id, channel_id, since_seq=since_seq,
+                          limit=limit)
+
+    @staticmethod
+    def tail_seq(app_name: str, channel_name: str | None = None
+                 ) -> int | None:
+        """The event log's current cursor tail (0 when empty), or None
+        when the backend has no stable cursor. ``run_train`` snapshots
+        this BEFORE the training read so the instance records its
+        ``train_watermark_seq``."""
+        app_id, channel_id = app_name_to_id(app_name, channel_name)
+        backend = Storage.get_events()
+        last_seq = getattr(backend, "last_seq", None)
+        if last_seq is None:
+            return None
+        return last_seq(app_id, channel_id)
+
+    @staticmethod
     def aggregate_properties(
         app_name: str,
         entity_type: str,

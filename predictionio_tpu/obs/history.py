@@ -307,6 +307,15 @@ class HistorySampler:
             reg, "pio_train_progress_ratio")
         values["train_heartbeat_age_seconds"] = _gauge_max(
             reg, "pio_train_heartbeat_age_seconds")
+        # continuous training (train/continuous.py): generation progress,
+        # how fresh the fold-in loop keeps the serving model, and how far
+        # behind the ingest stream it is running
+        values["foldin_generation"] = _gauge_max(
+            reg, "pio_foldin_generation")
+        values["foldin_events_to_servable_s"] = self._windowed_quantile(
+            "pio_foldin_events_to_servable_seconds", 0.5)
+        values["foldin_watermark_lag_s"] = _gauge_max(
+            reg, "pio_foldin_watermark_lag_seconds")
         return values
 
     def _ratio_rate(self, key: str, num: float | None, den_extra: float | None,

@@ -153,18 +153,10 @@ _SHADOW_SWAPS = REGISTRY.counter(
 
 # -- env knobs (read per call so live processes retune) ----------------------
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+from predictionio_tpu.utils.env import (  # noqa: E402
+    env_float as _env_float,
+    env_int as _env_int,
+)
 
 
 #: (raw env value, parsed mode) memo — the mode check runs per query.

@@ -137,11 +137,7 @@ def threshold_burn(values: list[float], bound: float,
     return (bad / len(values)) / (1.0 - target)
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+from predictionio_tpu.utils.env import env_float as _env_float  # noqa: E402
 
 
 def fast_window_s() -> float:

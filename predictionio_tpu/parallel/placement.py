@@ -106,6 +106,15 @@ _IDENTITY_CACHE: dict = {}
 #: them in the serving_models arena before (or without) the swap.
 _cache_bypass = threading.local()
 
+#: Guards _IDENTITY_CACHE entry insert/expire: concurrent serving
+#: threads missing on the same key must not BOTH register an arena
+#: allocation for it — the overwritten entry's allocation would stay
+#: attributed to serving_models until the host array dies (which, for a
+#: live catalog, is never). Reentrant because weakref expiry can fire
+#: on the inserting thread itself mid-critical-section (gc at any
+#: allocation point).
+_CACHE_LOCK = threading.RLock()
+
 
 @contextmanager
 def serving_cache_bypass():
@@ -122,10 +131,11 @@ def serving_cache_bypass():
 def _identity_cached(arr: np.ndarray, key: tuple, build):
     if getattr(_cache_bypass, "active", False):
         return build()
-    hit = _IDENTITY_CACHE.get(key)
-    if hit is not None and hit[0]() is arr:
-        return hit[1]
-    val = build()
+    with _CACHE_LOCK:
+        hit = _IDENTITY_CACHE.get(key)
+        if hit is not None and hit[0]() is arr:
+            return hit[1]
+    val = build()  # outside the lock: device puts are RTT-expensive
     # host-side transform caches (device="host" key tag) hold no HBM;
     # everything else is serving-resident device state — attribute it
     alloc = None
@@ -137,13 +147,25 @@ def _identity_cached(arr: np.ndarray, key: tuple, build):
         # pop only if the cache still holds THIS entry: eviction may have
         # already cleared it and a new engine instance re-keyed the slot
         # (Allocation.free is idempotent, so the free is safe either way)
-        cur = _IDENTITY_CACHE.get(key)
-        if cur is not None and cur[0] is ref:
-            _IDENTITY_CACHE.pop(key, None)
+        with _CACHE_LOCK:
+            cur = _IDENTITY_CACHE.get(key)
+            if cur is not None and cur[0] is ref:
+                _IDENTITY_CACHE.pop(key, None)
         _SERVING_ARENA.free(alloc)
 
     ref = weakref.ref(arr, _expire)
-    _IDENTITY_CACHE[key] = (ref, val, alloc)
+    with _CACHE_LOCK:
+        cur = _IDENTITY_CACHE.get(key)
+        if cur is not None and cur[0]() is arr:
+            # another thread built this entry while we did: keep theirs,
+            # release our duplicate arena attribution
+            _SERVING_ARENA.free(alloc)
+            return cur[1]
+        if cur is not None:
+            # stale entry (dead array, id-reused key) whose expiry has
+            # not fired yet: release its attribution at overwrite time
+            _SERVING_ARENA.free(cur[2])
+        _IDENTITY_CACHE[key] = (ref, val, alloc)
     return val
 
 

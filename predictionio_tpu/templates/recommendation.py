@@ -140,6 +140,22 @@ class DataSource(PDataSource):
             raise NotImplementedError("set eval_k in datasource params to evaluate")
         return _kfold_read_eval(self._read(), k, self.params.seed)
 
+    # -- continuous-training protocol (train/continuous.py) ------------------
+
+    def delta_source(self):
+        """What the ContinuousTrainer tails for this engine: the same
+        event names / rating-property rules :meth:`_read`'s
+        ``interaction_arrays`` scan applies, so an incrementally folded
+        row is exactly the row a full retrain would read."""
+        from predictionio_tpu.train.continuous import DeltaSpec
+
+        return DeltaSpec(
+            app_name=self.params.app_name,
+            event_names=("rate", "buy"),
+            rating_property="rating",
+            default_rating=self.params.buy_rating,
+        )
+
 
 def _kfold_read_eval(td: "TrainingData", k: int, seed: int):
     """k-fold eval folds from one TrainingData — shared by the event-store
@@ -524,6 +540,97 @@ class ALSAlgorithm(PAlgorithm):
             return []
         step = max(len(users) // max(n, 1), 1)
         return [Query(user=u, num=k) for u in users[::step][:n]]
+
+    # -- incremental fold-in protocol (train/foldin.py, ROADMAP item 2) ------
+
+    @staticmethod
+    def _extended_ids(ids: BiMap, delta) -> BiMap:
+        """The id map grown by the delta's unseen entities — existing
+        indices preserved (the fold-in contract: an untouched row keeps
+        its position, so the parent's factor row copies over
+        byte-identical)."""
+        fwd = dict(ids.to_dict())
+        for key in delta:
+            if key not in fwd:
+                fwd[key] = len(fwd)
+        return BiMap(fwd)
+
+    def fold_in_ready(self, model: ALSModel, data) -> bool:
+        """Cheap pre-check: a delta touching more than
+        ``PIO_FOLDIN_MAX_FRACTION`` of either catalog is not
+        "incremental" — the exact full retrain wins (and re-anchors any
+        accumulated fold-in drift)."""
+        from predictionio_tpu.train import foldin as foldin_mod
+
+        delta_users = set(data.delta_users)
+        delta_items = set(data.delta_items)
+        if not delta_users:
+            return False
+        n_users = sum(1 for u in delta_users
+                      if u not in model.user_ids) + len(model.user_ids)
+        n_items = sum(1 for i in delta_items
+                      if i not in model.item_ids) + len(model.item_ids)
+        frac = foldin_mod.max_fraction()
+        if len(delta_users) > frac * n_users \
+                or len(delta_items) > frac * n_items:
+            logger.info(
+                "fold-in declined: delta touches %d/%d users, %d/%d "
+                "items (> %.0f%% of a catalog) — full retrain",
+                len(delta_users), n_users, len(delta_items), n_items,
+                100 * frac)
+            return False
+        return True
+
+    def fold_in(self, ctx: ComputeContext, model: ALSModel,
+                data) -> ALSModel | None:
+        """One fold-in generation: re-solve ONLY the users/items with
+        delta evidence against frozen opposite-side factors
+        (train/foldin.solve_entities — the dense solver's half-step
+        restricted to the touched rows). Brand-new users/items append
+        zero-initialized rows and get their first least-squares solve
+        here. Untouched rows are byte-identical copies of the parent's
+        factors. Returns None when the dense formulation does not apply
+        (non-int8-encodable ratings) — the trainer falls back to a full
+        retrain."""
+        from predictionio_tpu.train import foldin as foldin_mod
+
+        p = self._als_params(self.params)
+        user_ids = self._extended_ids(model.user_ids, data.delta_users)
+        item_ids = self._extended_ids(model.item_ids, data.delta_items)
+        n_users, n_items = len(user_ids), len(item_ids)
+        touched_u = np.unique(
+            user_ids.encode(data.delta_users)).astype(np.int32)
+        touched_i = np.unique(
+            item_ids.encode(data.delta_items)).astype(np.int32)
+        ui = user_ids.encode(data.users).astype(np.int32)
+        ii = item_ids.encode(data.items).astype(np.int32)
+        rr = np.asarray(data.ratings, np.float32)
+        uf = np.asarray(model.factors.user_features, np.float32)
+        uf = np.vstack([uf, np.zeros(
+            (n_users - uf.shape[0], p.rank), np.float32)]) \
+            if n_users > uf.shape[0] else uf.copy()
+        itf = np.asarray(model.factors.item_features, np.float32)
+        itf = np.vstack([itf, np.zeros(
+            (n_items - itf.shape[0], p.rank), np.float32)]) \
+            if n_items > itf.shape[0] else itf.copy()
+        # user half against the FROZEN parent item factors, then item
+        # half against the updated users — the ordering a full
+        # _iteration_dense runs, restricted to the touched rows
+        rows = foldin_mod.solve_entities(
+            p, touched_u, ui, ii, rr, itf, uf[touched_u], n_users,
+            n_items)
+        if rows is None:
+            return None
+        uf[touched_u] = rows
+        rows = foldin_mod.solve_entities(
+            p, touched_i, ii, ui, rr, uf, itf[touched_i], n_items,
+            n_users)
+        if rows is None:
+            return None
+        itf[touched_i] = rows
+        return ALSModel(
+            ALSFactors(uf, itf), user_ids, item_ids,
+            getattr(model, "item_categories", {}))
 
     # -- device-resident serving protocol (ROADMAP item 3) -------------------
 

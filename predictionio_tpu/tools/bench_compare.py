@@ -62,18 +62,20 @@ _LOWER_BETTER_RE = re.compile(
     r"|_bytes$|_mb_per_step$|retraces)")
 _HIGHER_BETTER_RE = re.compile(
     r"(per_sec|per_iter$|_qps$|^qps$|mfu|rate$|_frac$|flops|iter_per"
-    r"|overlap|hit_rate)")
+    r"|overlap|hit_rate|speedup)")
 
 
 def lower_is_better(key: str) -> bool:
     """Bad direction per key. Order matters: cost-shaped names
-    (``sec_per_*``, ``*overhead*``, ``unattributed``) are checked first
-    — ``trace_overhead_frac`` must read as a cost even though ``_frac``
-    keys are otherwise utilization-shaped — then throughput names win
-    the remaining ties because ``*_per_sec`` would otherwise match the
+    (``sec_per_*``, ``*overhead*``, ``unattributed``,
+    ``events_to_servable``) are checked first — ``trace_overhead_frac``
+    must read as a cost even though ``_frac`` keys are otherwise
+    utilization-shaped, and events-to-servable is a LATENCY however it
+    is suffixed — then throughput names (``speedup`` included) win the
+    remaining ties because ``*_per_sec`` would otherwise match the
     ``_sec`` suffix rule."""
     if "sec_per_" in key or "mb_per_step" in key or "overhead" in key \
-            or "unattributed" in key:
+            or "unattributed" in key or "events_to_servable" in key:
         return True
     if _HIGHER_BETTER_RE.search(key):
         return False

@@ -215,6 +215,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="continue from the newest VALID snapshot in "
              "--checkpoint-dir (a corrupt/truncated latest falls back "
              "to the previous one) instead of training from scratch")
+    # -- continuous training (train/continuous.py) --------------------------
+    p_train.add_argument(
+        "--continuous", action="store_true",
+        help="run the continuous-training daemon instead of one train: "
+             "tail the event store from the persisted watermark, fold "
+             "deltas into the serving model incrementally "
+             "(train/foldin.py), and hot-swap via --reload-url behind "
+             "the shadow gate; full retrain every --foldin-full-every "
+             "generations")
+    p_train.add_argument(
+        "--reload-url", default="http://127.0.0.1:8000", metavar="URL",
+        help="where --continuous sends the gated /reload hot-swap "
+             "(the gateway or a single query server; 'none' disables "
+             "swapping)")
+    _add_foldin_args(p_train)
     p_train.set_defaults(func=cmd_train)
 
     # -- deploy / undeploy (ref: Console.scala:835-922) ---------------------
@@ -282,6 +297,14 @@ def build_parser() -> argparse.ArgumentParser:
     p_deploy.add_argument(
         "--idle-ticks", type=int, default=6, metavar="N",
         help="consecutive idle control ticks before a scale-down")
+    # -- continuous training (train/continuous.py) --------------------------
+    p_deploy.add_argument(
+        "--auto-train", action="store_true",
+        help="run the continuous-training daemon inside this deploy: "
+             "ingest-driven incremental fold-in with shadow-gated "
+             "/reload hot-swaps against this deployment's own front "
+             "door")
+    _add_foldin_args(p_deploy)
     p_deploy.set_defaults(func=cmd_deploy)
 
     p_undeploy = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -544,6 +567,56 @@ def cmd_build(args) -> int:
     return 0
 
 
+def _add_foldin_args(p) -> None:
+    """The continuous-training tunables shared by `pio train
+    --continuous` and `pio deploy --auto-train` (None = the
+    PIO_FOLDIN_* environment defaults)."""
+    p.add_argument(
+        "--foldin-interval", type=float, default=None, metavar="SEC",
+        help="delta batching window: fold pending events in after this "
+             "long (default PIO_FOLDIN_INTERVAL_S, 10)")
+    p.add_argument(
+        "--foldin-min-events", type=int, default=None, metavar="N",
+        help="fold in early once this many delta events wait "
+             "(default PIO_FOLDIN_MIN_EVENTS, 32)")
+    p.add_argument(
+        "--foldin-full-every", type=int, default=None, metavar="K",
+        help="run an exact full retrain every K generations to bound "
+             "fold-in drift (default PIO_FOLDIN_FULL_EVERY, 16; "
+             "0 disables the cadence)")
+
+
+def _build_trainer(variant, reload_url: str | None, args, name: str):
+    """A ContinuousTrainer for the variant in cwd (shared by `pio train
+    --continuous` and `pio deploy --auto-train`)."""
+    import os
+
+    from predictionio_tpu.train.continuous import (
+        ContinuousConfig,
+        ContinuousTrainer,
+    )
+    from predictionio_tpu.workflow.engine_loader import get_engine
+
+    factory = variant["engineFactory"]
+    engine = get_engine(factory, os.getcwd())
+    engine_params = engine.engine_params_from_json(variant)
+    return ContinuousTrainer(
+        engine, engine_params,
+        engine_id=variant.get("id", "default"),
+        engine_version=variant.get("version", "1"),
+        engine_variant=variant.get("id", "default"),
+        engine_factory=factory,
+        batch=getattr(args, "batch", "") or "",
+        config=ContinuousConfig(
+            interval_s=getattr(args, "foldin_interval", None),
+            min_events=getattr(args, "foldin_min_events", None),
+            full_every=getattr(args, "foldin_full_every", None),
+            reload_url=reload_url,
+            name=name,
+        ),
+    )
+
+
 def cmd_train(args) -> int:
     """ref: Console.train:825-833 → RunWorkflow → CreateWorkflow; collapses
     to an in-process run (no spark-submit)."""
@@ -559,6 +632,8 @@ def cmd_train(args) -> int:
     variant = _load_variant(args.engine_json)
     if variant is None:
         return 1
+    if getattr(args, "continuous", False):
+        return _cmd_train_continuous(args, variant)
     factory = variant["engineFactory"]
     engine = get_engine(factory, os.getcwd())
     engine_params = engine.engine_params_from_json(variant)
@@ -586,6 +661,31 @@ def cmd_train(args) -> int:
         engine, engine_params, instance, wp, trace_dir=args.profile
     )
     print(f"[INFO] Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def _cmd_train_continuous(args, variant) -> int:
+    """`pio train --continuous`: the foreground continuous-training
+    daemon (train/continuous.py) — tail the event store, fold deltas in,
+    hot-swap via the shadow-gated /reload."""
+    reload_url = args.reload_url
+    if reload_url in ("", "none", "off"):
+        reload_url = None
+    try:
+        trainer = _build_trainer(variant, reload_url, args,
+                                 name=variant.get("id", "default"))
+    except RuntimeError as e:
+        print(f"[ERROR] {e}", file=sys.stderr)
+        return 1
+    print("[INFO] Continuous training up: interval "
+          f"{trainer.interval_s:g}s, min events {trainer.min_events}, "
+          f"full retrain every {trainer.full_every} generation(s), "
+          f"reload target {trainer.reload_url or 'none'}.")
+    print("[INFO] Follow generations with `pio runs` / `pio watch`; "
+          "state in `pio status` / `pio doctor`.")
+    _install_sigterm(trainer.request_stop)
+    trainer.run_forever()
+    print("[INFO] Continuous trainer shut down.")
     return 0
 
 
@@ -620,7 +720,7 @@ def cmd_deploy(args) -> int:
                                                    None):
         # an autoscaled deploy needs the gateway topology even when it
         # starts from one replica
-        return _deploy_gateway(args, config)
+        return _deploy_gateway(args, config, variant)
     try:
         server, service = create_server(config)
     except RuntimeError as e:
@@ -629,17 +729,46 @@ def cmd_deploy(args) -> int:
     server.start()
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
           f"http://{args.ip}:{server.port}.")
+    trainer = _maybe_auto_train(args, variant, server.port)
     _install_sigterm(service._stop_event.set)
     try:
         service.wait_for_stop()
     except KeyboardInterrupt:
         pass
+    if trainer is not None:
+        trainer.stop()
     server.stop()
     # drain the micro-batcher (mid-flight deferred finalizes complete)
     # and join its threads before the process exits
     service.shutdown()
     print("[INFO] Engine server shut down.")
     return 0
+
+
+def _maybe_auto_train(args, variant, port: int):
+    """`pio deploy --auto-train`: start the continuous trainer inside
+    the deploy, hot-swapping against this deployment's own front door
+    (the gateway fans /reload out to every replica)."""
+    if not getattr(args, "auto_train", False):
+        return None
+    # the swap must target the ip the server actually bound (loopback
+    # for the wildcard bind)
+    ip = getattr(args, "ip", "") or "127.0.0.1"
+    if ip in ("0.0.0.0", "::"):
+        ip = "127.0.0.1"
+    try:
+        trainer = _build_trainer(
+            variant, f"http://{ip}:{port}", args,
+            name=variant.get("id", "default"))
+    except RuntimeError as e:
+        print(f"[WARN] --auto-train unavailable: {e}", file=sys.stderr)
+        return None
+    trainer.start()
+    print(f"[INFO] Continuous training active (interval "
+          f"{trainer.interval_s:g}s, min events {trainer.min_events}, "
+          f"full retrain every {trainer.full_every}); follow with "
+          "`pio runs` / `pio status`.")
+    return trainer
 
 
 def _install_sigterm(callback) -> None:
@@ -654,7 +783,7 @@ def _install_sigterm(callback) -> None:
         pass
 
 
-def _deploy_gateway(args, config) -> int:
+def _deploy_gateway(args, config, variant=None) -> int:
     """`pio deploy --replicas N`: N in-process replica servers on
     consecutive ports after --port, fronted by the serving gateway ON
     --port (so clients, `pio undeploy`, and the redeploy script keep
@@ -730,6 +859,8 @@ def _deploy_gateway(args, config) -> int:
           f"http://{args.ip}:{dep.port} over {args.replicas} replicas "
           f"(ports {replica_ports}).")
     pidfile = register_pidfile(f"deploy-gateway-{dep.port}")
+    trainer = (None if variant is None
+               else _maybe_auto_train(args, variant, dep.port))
     # `pio stop-all` SIGTERMs this process: translate it into the same
     # graceful stop as GET /stop, so replicas drain their micro-batchers
     # (no race against a mid-flight deferred finalize) before exit
@@ -741,6 +872,8 @@ def _deploy_gateway(args, config) -> int:
     finally:
         if scaler is not None:
             scaler.stop()
+        if trainer is not None:
+            trainer.stop()
         clear_pidfile(pidfile.stem)
         dep.stop()
     print("[INFO] Gateway and replicas shut down.")
@@ -1129,22 +1262,33 @@ def cmd_doctor(args) -> int:
     before any fix), 2 = the front door is unreachable (and no local
     findings either)."""
     import json as _json
+    from pathlib import Path
 
     from predictionio_tpu.obs import fleet, runlog
+    from predictionio_tpu.train import continuous as continuous_mod
 
     train_findings = runlog.diagnose_runs(getattr(args, "runs_dir", None))
+    # trainer state files live under <runs dir>/continuous — judge them
+    # from the SAME directory --runs-dir points the run ledger at
+    runs_dir = getattr(args, "runs_dir", None)
+    trainer_dir = Path(runs_dir) / "continuous" if runs_dir else None
     base = args.url.rstrip("/")
     status = _fetch_json(f"{base}/")
-    if status is None and not train_findings:
-        print(f"[ERROR] cannot reach {base} — is the deployment up?",
-              file=sys.stderr)
-        return 2
     if status is None:
+        # the continuous-training loop is a local surface too: its
+        # STALLED-LOOP judgment (sans SLO evidence) survives an
+        # unreachable front door, like the run ledger's findings
+        local = train_findings + continuous_mod.diagnose_trainers(
+            None, directory=trainer_dir)
+        if not local:
+            print(f"[ERROR] cannot reach {base} — is the deployment up?",
+                  file=sys.stderr)
+            return 2
         print(f"[WARN] cannot reach {base} — fleet surfaces skipped; "
               "local run-ledger findings below.", file=sys.stderr)
         is_gateway = False
         slo_state = None
-        findings = train_findings
+        findings = local
     else:
         is_gateway = status.get("role") == "gateway"
         members = _fleet_members(base, status if is_gateway else None)
@@ -1153,9 +1297,17 @@ def cmd_doctor(args) -> int:
         traces_body = _fetch_json(
             f"{base}/debug/traces?limit={max(args.traces, 0)}")
         traces = (traces_body or {}).get("slowest") or []
-        findings = train_findings + fleet.diagnose(
-            status if is_gateway else None, members, slo_state,
-            traces[: args.traces], quality=quality_doc)
+        # continuous-training loop judgment (train/continuous.py):
+        # STALLED-LOOP distinguishes "staleness burns AND the registered
+        # trainer's watermark is stuck" from plain staleness without an
+        # actuator
+        findings = (train_findings
+                    + continuous_mod.diagnose_trainers(
+                        slo_state, directory=trainer_dir)
+                    + fleet.diagnose(
+                        status if is_gateway else None, members,
+                        slo_state, traces[: args.traces],
+                        quality=quality_doc))
     rc = 1 if any(f["severity"] == "critical" for f in findings) else 0
     actions: list[dict] = []
     if getattr(args, "fix", False) and findings:
@@ -1835,6 +1987,18 @@ def cmd_status(args) -> int:
                   "(`pio train` writes one ledger per run).")
     except Exception as e:  # observability must not fail status
         print(f"[WARN] run-ledger probe failed: {e}", file=sys.stderr)
+    try:  # continuous-training loop state (train/continuous.py)
+        from predictionio_tpu.train import continuous as continuous_mod
+
+        states = continuous_mod.trainer_states()
+        if states:
+            print("[INFO] Continuous trainers (watermark / generation / "
+                  "last swap):")
+            for line in continuous_mod.render_status_lines(states):
+                print(line)
+    except Exception as e:  # observability must not fail status
+        print(f"[WARN] continuous-trainer probe failed: {e}",
+              file=sys.stderr)
     s = Storage.instance()
     for name, src in s.sources.items():
         print(f"[INFO] Storage source {name}: type={src.type}")

@@ -1,0 +1,3 @@
+"""Continuous training: incremental ALS fold-in + the ingest-driven
+trainer daemon (ROADMAP item 2 — the actuator behind the
+``model_staleness`` SLO and the shadow-gated ``/reload`` swap)."""

@@ -1,0 +1,920 @@
+"""Continuous training daemon: tail the event store → fold in → hot-swap.
+
+The actuator the fleet layer has watched for since PR 10: the
+``model_staleness`` SLO and the shadow-gated ``/reload`` fan-out existed,
+but the only refresh path was a manual full retrain + redeploy. The
+:class:`ContinuousTrainer` closes the event→model→serving loop (the
+production norm in the Google ads-infra paper, PAPERS.md):
+
+  * **tail** — the persisted watermark cursor rides the event store's
+    ingestion-order seq (``PEventStore.events_since``: the SQLite rowid /
+    memory-insertion-order cursor from data/storage), so polling reads
+    only what arrived since — never a log rescan. Backends without a
+    stable cursor degrade to full retrains per cycle, detected via a
+    time-bounded scan.
+  * **batch** — deltas accumulate until ``PIO_FOLDIN_MIN_EVENTS`` or
+    ``PIO_FOLDIN_INTERVAL_S`` (whichever trips first) and fold in as one
+    generation via :func:`train.foldin.run_foldin` (a real engine
+    instance under a run ledger — ``pio runs``/``pio watch``/STALLED-RUN
+    all apply).
+  * **swap** — the generation hot-swaps through the existing ``/reload``
+    fan-out behind the PR-13 shadow gate. A 409-blocked candidate is
+    QUARANTINED: the parent keeps serving, the trainer keeps folding new
+    deltas into the blocked candidate's factors, and the swap retries
+    with the next generation (counted in
+    ``pio_foldin_quarantined_total`` and surfaced in ``pio status``).
+  * **bound drift** — every ``PIO_FOLDIN_FULL_EVERY`` generations (and
+    whenever the delta exceeds ``PIO_FOLDIN_MAX_FRACTION`` of the
+    catalog, or fold-in fails) the cycle runs the exact full retrain
+    through ``run_train`` instead, re-anchoring the factor state.
+
+Watermark discipline (the crash-recovery contract): the watermark of
+record is the ``train_watermark_seq`` env of the newest COMPLETED
+instance — persisted atomically WITH the model it describes. The trainer's
+own state file under ``<runs dir>/continuous/`` is a status surface
+(``pio status`` / ``pio doctor`` STALLED-LOOP), not the source of truth. A
+daemon killed mid-cycle restarts from the last persisted instance's
+watermark: events past it re-read into the pending delta and fold into a
+model that never saw them — nothing double-applied, nothing dropped
+(pinned in tests/test_foldin.py).
+
+Events-to-servable is the subsystem's first-class measured quantity: the
+wall from the oldest delta event's ingest to the gated swap landing, as
+``pio_foldin_events_to_servable_seconds`` (plus per-cycle size/duration
+histograms, the generation gauge, and history series for the dashboards).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from predictionio_tpu.obs import REGISTRY
+from predictionio_tpu.obs.metrics import DEFAULT_SIZE_BUCKETS
+from predictionio_tpu.utils.env import (
+    env_float as _env_float,
+    env_int as _env_int,
+)
+
+logger = logging.getLogger(__name__)
+
+#: state-file heartbeat period while the daemon runs (a side thread, so
+#: a minutes-long cycle cannot starve the doctor's liveness judgment)
+_KEEPALIVE_S = 2.0
+
+# -- telemetry (documented in docs/operations.md § Monitoring) ---------------
+
+_GENERATIONS = REGISTRY.counter(
+    "pio_foldin_generations_total",
+    "Continuous-training generations by path (foldin|full) and outcome "
+    "(swapped|blocked|swap_error|no_target|failed)",
+    labels=("path", "result"),
+)
+_EVENTS_PER_CYCLE = REGISTRY.histogram(
+    "pio_foldin_events_per_cycle",
+    "Delta events consumed per continuous-training cycle",
+    buckets=DEFAULT_SIZE_BUCKETS,
+)
+_CYCLE_SECONDS = REGISTRY.histogram(
+    "pio_foldin_cycle_seconds",
+    "Wall seconds per continuous-training cycle (solve + persist + swap)",
+)
+_EVENTS_TO_SERVABLE = REGISTRY.histogram(
+    "pio_foldin_events_to_servable_seconds",
+    "Oldest delta event's ingest-to-hot-swap wall per swapped generation",
+)
+_WATERMARK_LAG = REGISTRY.gauge(
+    "pio_foldin_watermark_lag_seconds",
+    "Age of the oldest event not yet folded into a servable model "
+    "(0 when the loop is caught up)",
+)
+_GENERATION_GAUGE = REGISTRY.gauge(
+    "pio_foldin_generation",
+    "Current continuous-training generation counter",
+)
+_QUARANTINED = REGISTRY.counter(
+    "pio_foldin_quarantined_total",
+    "Fold-in candidates refused by the reload shadow gate (409) and "
+    "held for retry after the next delta",
+)
+
+
+@dataclass(frozen=True)
+class DeltaSpec:
+    """What the trainer tails and how interaction events become
+    ``(user, item, rating)`` rows — the return value of a datasource's
+    ``delta_source()`` continuous-training protocol method. The
+    conversion mirrors ``eventlog.intern_interactions`` exactly (same
+    rating-property coercion rules), so a row folded in incrementally is
+    the row a full retrain's scan would produce."""
+
+    app_name: str
+    event_names: tuple
+    rating_property: str | None = "rating"
+    default_rating: float = 1.0
+    channel_name: str | None = None
+
+    def event_row(self, event) -> tuple[str, str, float] | None:
+        """``(user, item, rating)`` for an interaction event, None for
+        anything else (non-interaction events advance the cursor but
+        contribute no rows)."""
+        if event.event not in self.event_names \
+                or event.target_entity_id is None:
+            return None
+        from predictionio_tpu.data.storage.eventlog import coerce_rating
+
+        return (event.entity_id, event.target_entity_id,
+                coerce_rating(event.properties, self.rating_property,
+                              self.default_rating))
+
+
+@dataclass
+class ContinuousConfig:
+    """Trainer tunables; None fields resolve from the environment at
+    trainer construction (``PIO_FOLDIN_INTERVAL_S`` /
+    ``PIO_FOLDIN_MIN_EVENTS`` / ``PIO_FOLDIN_FULL_EVERY``)."""
+
+    interval_s: float | None = None  # delta batching window (default 10)
+    min_events: int | None = None    # early-trigger threshold (default 32)
+    full_every: int | None = None    # full retrain cadence (default 16)
+    reload_url: str | None = None    # /reload target (gateway or replica)
+    poll_s: float = 1.0              # cursor poll period
+    page_limit: int = 10_000         # events per cursor page
+    name: str = "default"            # state-file name (one per variant)
+
+
+def state_dir(directory: Path | str | None = None) -> Path:
+    """Where trainer state files live: ``<runs dir>/continuous/`` — the
+    same ``PIO_RUNS_DIR`` filesystem surface the run ledger uses, so
+    ``pio status``/``pio doctor`` judge the loop without reaching the
+    trainer process."""
+    if directory is not None:
+        return Path(directory)
+    from predictionio_tpu.obs import runlog
+
+    return runlog.runs_dir() / "continuous"
+
+
+def train_watermark_env(engine, engine_params) -> dict[str, str]:
+    """The ``train_watermark_seq`` env fragment ``run_train`` merges into
+    every completed instance: the event-store cursor tail snapshotted
+    BEFORE the training read, so the instance records which events it
+    could have seen. Events landing during the read land at seqs past
+    the snapshot and simply re-fold later — a re-solve against data the
+    model already saw is idempotent, while a dropped event never would
+    be. ``{}`` when the datasource has no ``delta_source()`` protocol or
+    the backend no stable cursor."""
+    try:
+        from predictionio_tpu.core.engine import _instantiate
+        from predictionio_tpu.data.store import PEventStore
+
+        ds = _instantiate(engine.data_source_class,
+                          engine_params.data_source_params)
+        src = getattr(ds, "delta_source", None)
+        if src is None:
+            return {}
+        spec = src()
+        tail = PEventStore.tail_seq(spec.app_name, spec.channel_name)
+        if tail is None:
+            return {}
+        return {
+            "train_watermark_seq": str(int(tail)),
+            "train_watermark_time_ms": str(int(time.time() * 1000)),
+        }
+    except Exception:  # noqa: BLE001 — a watermark must never sink a train
+        logger.debug("train watermark snapshot failed", exc_info=True)
+        return {}
+
+
+class ContinuousTrainer:
+    """The ingest-driven trainer daemon. Construct with the engine (and
+    the variant identity its instances are filed under), then either
+    ``start()`` the background thread, or drive ``bootstrap()`` +
+    ``poll_once()`` manually (the test/bench path — deterministic, no
+    thread)."""
+
+    def __init__(self, engine, engine_params, *,
+                 engine_id: str = "default", engine_version: str = "1",
+                 engine_variant: str = "default",
+                 engine_factory: str = "", batch: str = "",
+                 config: ContinuousConfig | None = None):
+        from predictionio_tpu.core.engine import _instantiate
+
+        cfg = config or ContinuousConfig()
+        self.engine = engine
+        self.engine_params = engine_params
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+        self.engine_factory = engine_factory
+        self.batch = batch
+        self.interval_s = (cfg.interval_s if cfg.interval_s is not None
+                           else _env_float("PIO_FOLDIN_INTERVAL_S", 10.0))
+        self.min_events = (cfg.min_events if cfg.min_events is not None
+                           else _env_int("PIO_FOLDIN_MIN_EVENTS", 32))
+        self.full_every = (cfg.full_every if cfg.full_every is not None
+                           else _env_int("PIO_FOLDIN_FULL_EVERY", 16))
+        self.reload_url = (cfg.reload_url or "").rstrip("/") or None
+        self.poll_s = cfg.poll_s
+        self.page_limit = cfg.page_limit
+        self.name = cfg.name
+
+        ds = _instantiate(engine.data_source_class,
+                          engine_params.data_source_params)
+        src = getattr(ds, "delta_source", None)
+        if src is None:
+            raise RuntimeError(
+                "the engine's datasource does not implement the "
+                "delta_source() continuous-training protocol "
+                "(see docs/operations.md § Continuous training)")
+        self.spec: DeltaSpec = src()
+
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.RLock()
+        # model + data state (all owned by the trainer thread)
+        self._instance = None
+        self._models = None
+        self._users: list = []
+        self._items: list = []
+        self._ratings: list = []
+        #: (seq, wall_ts, user, item, rating) rows read but not folded
+        self._pending: list = []
+        self._read_seq = 0
+        self._watermark_seq = 0
+        self._watermark_time_ms = 0
+        self._generation = 0
+        self._quarantined = 0
+        self._last_swap: str | None = None
+        self._last_swap_detail: str | None = None
+        self._last_error: str | None = None
+        self._last_advance = time.time()
+        self._last_cycle_s: float | None = None
+        self._last_events_to_servable_s: float | None = None
+        self._first_pending_t: float | None = None
+        self._force_full: str | None = None
+        #: events-to-servable measures THIS loop's responsiveness — a
+        #: bootstrap backfill of a weeks-old log must not feed the
+        #: headline histogram week-long "latencies"
+        self._start_wall = time.time()
+        #: consecutive failed cycles → exponential retry backoff (a
+        #: persistent failure must not mint an ABORTED instance per
+        #: poll tick)
+        self._fail_streak = 0
+        self._backoff_until = 0.0
+        #: cursor reads supported? (False → every cycle is a full
+        #: retrain and delta detection is a time-bounded scan)
+        self._incremental = True
+        self._fallback_last_ms = 0
+        self._fallback_seen: set = set()
+        self._bootstrapped = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Run the daemon on a background thread (the ``pio deploy
+        --auto-train`` shape)."""
+        self._thread = threading.Thread(
+            target=self._run, name=f"continuous-train-{self.name}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._write_state(running=False)
+        return t is None or not t.is_alive()
+
+    def run_forever(self) -> None:
+        """Foreground loop (the ``pio train --continuous`` shape):
+        bootstrap, then poll until stopped."""
+        hb = self._start_keepalive()
+        try:
+            self.bootstrap()
+            while not self._stop.wait(self.poll_s):
+                self._safe_poll()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self._stop.set()
+            hb.join(2 * _KEEPALIVE_S)
+            self._write_state(running=False)
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        hb = self._start_keepalive()
+        try:
+            self.bootstrap()
+        except Exception as e:  # noqa: BLE001
+            logger.exception("continuous trainer bootstrap failed")
+            self._last_error = repr(e)
+            self._stop.set()
+            hb.join(2 * _KEEPALIVE_S)
+            self._write_state(running=False)
+            return
+        while not self._stop.wait(self.poll_s):
+            self._safe_poll()
+        hb.join(2 * _KEEPALIVE_S)
+        self._write_state(running=False)
+
+    def _start_keepalive(self) -> threading.Thread:
+        """Heartbeat the state file every ~2s on a side thread for as
+        long as the daemon lives: ``_write_state`` otherwise runs only
+        BETWEEN poll ticks, and any cycle longer than the doctor's
+        60s dead-daemon bound (a cadence full retrain on a real
+        dataset, a long bootstrap rebuild) would read as a false
+        critical STALLED-LOOP — the same starvation the run ledger's
+        keepalive solves for ``pio watch``."""
+
+        def beat():
+            while not self._stop.wait(_KEEPALIVE_S):
+                self._write_state()
+
+        t = threading.Thread(
+            target=beat, name=f"continuous-train-hb-{self.name}",
+            daemon=True)
+        t.start()
+        return t
+
+    def _safe_poll(self) -> None:
+        try:
+            self.poll_once()
+        except Exception as e:  # noqa: BLE001 — the loop must survive a
+            logger.exception("continuous trainer poll failed")  # bad cycle
+            self._last_error = repr(e)
+            self._write_state()
+
+    # -- bootstrap -----------------------------------------------------------
+
+    def bootstrap(self) -> None:
+        """Adopt the newest COMPLETED instance and rebuild the trainer's
+        interaction snapshot from the cursor log up to its watermark;
+        events past it become the first pending delta. With no completed
+        instance (or no recorded watermark) the first cycle runs a full
+        retrain to establish a clean (model, watermark) pair."""
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.store import PEventStore
+
+        instances = Storage.get_meta_data_engine_instances()
+        latest = instances.get_latest_completed(
+            self.engine_id, self.engine_version, self.engine_variant)
+        tail = PEventStore.tail_seq(self.spec.app_name,
+                                    self.spec.channel_name)
+        self._incremental = tail is not None
+        if latest is None:
+            self._force_full = "no completed engine instance"
+        else:
+            self._instance = latest
+            self._generation = int(
+                (latest.env or {}).get("foldin_generation", 0) or 0)
+            wm = (latest.env or {}).get("train_watermark_seq", "")
+            if self._incremental and wm not in ("", None):
+                self._watermark_seq = int(wm)
+                self._watermark_time_ms = int(
+                    (latest.env or {}).get("train_watermark_time_ms", 0)
+                    or 0)
+            elif self._incremental:
+                # instance predates the watermark discipline: one full
+                # retrain re-anchors rather than guessing what it saw
+                self._force_full = (
+                    f"instance {latest.id} has no train watermark")
+        if self._incremental:
+            self._load_snapshot()
+        if self._instance is not None and self._models is None \
+                and self._force_full is None:
+            self._models = self._prepare_models(self._instance)
+        self._bootstrapped = True
+        self._write_state()
+        logger.info(
+            "continuous trainer up: instance %s, watermark seq %d, "
+            "%d pending event(s)%s",
+            getattr(self._instance, "id", None), self._watermark_seq,
+            len(self._pending),
+            f" (full retrain forced: {self._force_full})"
+            if self._force_full else "")
+
+    def _load_snapshot(self) -> None:
+        """Rebuild the interaction COO from the cursor log: rows at seq
+        <= watermark form the base snapshot (what the current model
+        saw), later rows queue as pending delta."""
+        from predictionio_tpu.data.store import PEventStore
+
+        self._users, self._items, self._ratings = [], [], []
+        self._pending = []
+        self._read_seq = 0
+        while True:
+            page = PEventStore.events_since(
+                self.spec.app_name, self._read_seq,
+                channel_name=self.spec.channel_name,
+                limit=self.page_limit)
+            if page is None:
+                self._incremental = False
+                return
+            if not page:
+                break
+            for seq, ev in page:
+                self._read_seq = max(self._read_seq, seq)
+                row = self.spec.event_row(ev)
+                if row is None:
+                    continue
+                if seq <= self._watermark_seq:
+                    self._users.append(row[0])
+                    self._items.append(row[1])
+                    self._ratings.append(row[2])
+                else:
+                    self._note_pending(seq, ev, row)
+            if len(page) < self.page_limit:
+                break
+
+    def _prepare_models(self, instance) -> list:
+        """Load an instance's trained models (the serving loader's
+        prepare path, minus serving)."""
+        from predictionio_tpu.core.engine import WorkflowParams
+        from predictionio_tpu.core.persistent_model import (
+            deserialize_models,
+        )
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.workflow.context import workflow_context
+
+        blob = Storage.get_model_data_models().get(instance.id)
+        if blob is None:
+            raise RuntimeError(f"no model data for instance {instance.id}")
+        persisted = deserialize_models(blob.models)
+        ctx = workflow_context(batch=instance.batch, mode="Training")
+        return self.engine.prepare_deploy(
+            ctx, self.engine_params, instance.id, persisted,
+            WorkflowParams())
+
+    # -- the poll tick -------------------------------------------------------
+
+    def _note_pending(self, seq: int, ev, row) -> None:
+        wall = time.time()
+        ct = getattr(ev, "creation_time", None)
+        if ct is not None:
+            try:
+                wall = ct.timestamp()
+            except (OSError, OverflowError, ValueError):
+                pass
+        self._pending.append((seq, wall, row[0], row[1], row[2]))
+        if self._first_pending_t is None:
+            self._first_pending_t = time.time()
+
+    def _read_pages(self) -> None:
+        from predictionio_tpu.data.store import PEventStore
+
+        while True:
+            page = PEventStore.events_since(
+                self.spec.app_name, self._read_seq,
+                channel_name=self.spec.channel_name,
+                limit=self.page_limit)
+            if page is None:
+                self._incremental = False
+                return
+            if not page:
+                return
+            for seq, ev in page:
+                self._read_seq = max(self._read_seq, seq)
+                row = self.spec.event_row(ev)
+                if row is not None:
+                    self._note_pending(seq, ev, row)
+            if len(page) < self.page_limit:
+                return
+
+    def _read_fallback(self) -> None:
+        """Delta detection without a cursor (server databases): a
+        time-bounded scan with an id-dedup set at the boundary. Rows
+        still queue as pending, but cycles run full retrains — the
+        trainer cannot prove its snapshot complete."""
+        import datetime as dt
+
+        from predictionio_tpu.data.store import PEventStore
+
+        start = None
+        if self._fallback_last_ms:
+            start = dt.datetime.fromtimestamp(
+                self._fallback_last_ms / 1e3, tz=dt.timezone.utc)
+        seen_now = set()
+        for ev in PEventStore.find(
+                self.spec.app_name, channel_name=self.spec.channel_name,
+                start_time=start):
+            if ev.event_id in self._fallback_seen:
+                continue
+            seen_now.add(ev.event_id)
+            ms = int(ev.event_time.timestamp() * 1e3)
+            self._fallback_last_ms = max(self._fallback_last_ms, ms)
+            row = self.spec.event_row(ev)
+            if row is not None:
+                self._note_pending(0, ev, row)
+        if seen_now:
+            self._fallback_seen |= seen_now
+            if len(self._fallback_seen) > 100_000:
+                self._fallback_seen = seen_now
+
+    def poll_once(self, now: float | None = None) -> bool:
+        """One poll tick: advance the cursor, refresh the lag gauge and
+        heartbeat, and run a cycle when the delta triggers. Returns True
+        when a cycle ran."""
+        now = time.time() if now is None else now
+        if self._incremental:
+            self._read_pages()
+        else:
+            self._read_fallback()
+        lag = 0.0
+        if self._pending:
+            lag = max(now - self._pending[0][1], 0.0)
+        _WATERMARK_LAG.set(lag)
+        ran = False
+        if self._should_cycle(now):
+            self._cycle()
+            ran = True
+        self._write_state()
+        return ran
+
+    def _should_cycle(self, now: float) -> bool:
+        if now < self._backoff_until:
+            return False
+        if self._force_full and (self._pending or self._instance is None):
+            return True
+        if not self._pending:
+            return False
+        if len(self._pending) >= max(self.min_events, 1):
+            return True
+        first = self._first_pending_t or now
+        return (now - first) >= self.interval_s
+
+    # -- the cycle -----------------------------------------------------------
+
+    def _cycle(self) -> None:
+        from predictionio_tpu.train import foldin
+
+        t0 = time.time()
+        rows = self._pending
+        self._pending = []
+        self._first_pending_t = None
+        new_seq = self._read_seq
+        new_time_ms = int(max((r[1] for r in rows), default=t0) * 1000)
+        oldest_wall = max(min((r[1] for r in rows), default=t0),
+                          self._start_wall)
+        generation = self._generation + 1
+        watermark = {"seq": new_seq, "timeMs": new_time_ms}
+        want_full = bool(
+            self._force_full
+            or not self._incremental
+            or self._models is None
+            or self._instance is None
+            or (self.full_every > 0 and generation % self.full_every == 0)
+        )
+        path = "full" if want_full else "foldin"
+        instance_id = None
+        try:
+            if not want_full:
+                data = foldin.FoldinData(
+                    users=self._users + [r[2] for r in rows],
+                    items=self._items + [r[3] for r in rows],
+                    ratings=np.asarray(
+                        self._ratings + [r[4] for r in rows], np.float32),
+                    delta_start=len(self._users),
+                )
+                got = foldin.run_foldin(
+                    self.engine, self.engine_params, self._instance,
+                    self._models, data, generation, watermark)
+                if got is not None:
+                    instance_id, new_models = got
+                    self._models = new_models
+                    self._users = data.users
+                    self._items = data.items
+                    self._ratings = list(data.ratings)
+            if instance_id is None:
+                path = "full"
+                instance_id = self._full_retrain(generation, watermark)
+                # the retrained model's read covers at least the consumed
+                # rows; commit them to the snapshot like a fold-in would
+                self._users += [r[2] for r in rows]
+                self._items += [r[3] for r in rows]
+                self._ratings += [r[4] for r in rows]
+        except Exception as e:  # noqa: BLE001
+            # the rows are real events the model does not have yet:
+            # re-queue them at the front so the next cycle retries
+            self._pending = rows + self._pending
+            self._first_pending_t = time.time()
+            self._last_error = repr(e)
+            self._fail_streak += 1
+            if not want_full:
+                # the documented fallback covers FAILED fold-ins, not
+                # just declined ones: a deterministic fold-in fault
+                # (solve bug, persistent device error on this delta)
+                # must not loop the incremental path forever — the
+                # retry runs the exact full retrain instead
+                self._force_full = f"fold-in cycle failed: {e!r}"
+            self._backoff_until = time.time() + min(
+                60.0, max(self.poll_s, 1.0) * 2 ** min(self._fail_streak, 6))
+            _GENERATIONS.inc(path=path, result="failed")
+            logger.exception("continuous-training cycle failed "
+                             "(generation %d re-queued, retry in %.0fs)",
+                             generation, self._backoff_until - time.time())
+            return
+        # generation committed: advance the watermark of record (a full
+        # retrain may have bumped watermark["seq"] to its own fresher
+        # pre-read snapshot — commit THAT, matching the instance env)
+        self._generation = generation
+        self._watermark_seq = int(watermark["seq"])
+        self._watermark_time_ms = new_time_ms
+        self._last_advance = time.time()
+        self._force_full = None
+        self._last_error = None
+        self._fail_streak = 0
+        self._backoff_until = 0.0
+        _GENERATION_GAUGE.set(generation)
+        _EVENTS_PER_CYCLE.observe(float(len(rows)))
+        self._swap(instance_id, path, oldest_wall, had_rows=bool(rows))
+        self._last_cycle_s = round(time.time() - t0, 3)
+        _CYCLE_SECONDS.observe(self._last_cycle_s)
+
+    def _full_retrain(self, generation: int, watermark: dict) -> str:
+        """The exact path: a normal ``run_train`` (which snapshots its
+        own fresh watermark env), annotated with the generation
+        counter."""
+        from predictionio_tpu.core.engine import WorkflowParams
+        from predictionio_tpu.data.storage import Storage
+        from predictionio_tpu.data.storage.base import EngineInstance
+        from predictionio_tpu.workflow.core_workflow import (
+            new_engine_instance,
+            run_train,
+        )
+
+        instance = new_engine_instance(
+            self.engine_id, self.engine_version, self.engine_variant,
+            self.engine_factory, self.engine_params, batch=self.batch)
+        iid = run_train(self.engine, self.engine_params, instance,
+                        WorkflowParams(batch=self.batch))
+        instances = Storage.get_meta_data_engine_instances()
+        done = instances.get(iid)
+        env = dict(done.env or {})
+        env["foldin_generation"] = str(int(generation))
+        wm = env.get("train_watermark_seq", "")
+        instances.update(EngineInstance(**{**done.__dict__, "env": env}))
+        self._instance = instances.get(iid)
+        self._models = self._prepare_models(self._instance)
+        if wm not in ("", None):
+            # run_train's snapshot is at least as fresh as ours
+            watermark["seq"] = max(int(wm), int(watermark["seq"]))
+        return iid
+
+    def _swap(self, instance_id: str, path: str, oldest_wall: float,
+              had_rows: bool) -> None:
+        from predictionio_tpu.data.storage import Storage
+
+        self._instance = Storage.get_meta_data_engine_instances().get(
+            instance_id)
+        if self.reload_url is None:
+            self._last_swap = "no_target"
+            self._last_swap_detail = "no reload url configured"
+            _GENERATIONS.inc(path=path, result="no_target")
+            return
+        url = f"{self.reload_url}/reload"
+        try:
+            req = urllib.request.Request(url, method="GET")
+            with urllib.request.urlopen(req, timeout=120.0) as resp:
+                body = json.loads(resp.read() or b"{}")
+            self._last_swap = "swapped"
+            self._last_swap_detail = f"instance {instance_id}"
+            if had_rows:
+                e2s = max(time.time() - oldest_wall, 0.0)
+                self._last_events_to_servable_s = round(e2s, 3)
+                _EVENTS_TO_SERVABLE.observe(e2s)
+            _GENERATIONS.inc(path=path, result="swapped")
+            logger.info("generation %d swapped in via %s (%s)",
+                        self._generation, url,
+                        json.dumps(body.get("shadow")) if isinstance(
+                            body, dict) else "")
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read() or b"{}").get(
+                    "shadow") or {}
+            except ValueError:
+                detail = {}
+            if e.code == 409:
+                # shadow-gate refusal: the candidate is quarantined —
+                # the parent keeps serving, the next delta folds into
+                # the candidate's factors and the swap retries then
+                self._quarantined += 1
+                _QUARANTINED.inc()
+                self._last_swap = "blocked"
+                self._last_swap_detail = (
+                    f"shadow gate 409 (overlap "
+                    f"{(detail or {}).get('overlapAtK')})")
+                _GENERATIONS.inc(path=path, result="blocked")
+                logger.warning(
+                    "generation %d BLOCKED by the shadow gate; parent "
+                    "keeps serving, retrying after the next delta",
+                    self._generation)
+            else:
+                self._last_swap = "swap_error"
+                self._last_swap_detail = f"HTTP {e.code}"
+                _GENERATIONS.inc(path=path, result="swap_error")
+                logger.warning("reload %s answered HTTP %s", url, e.code)
+        except Exception as e:  # noqa: BLE001
+            self._last_swap = "swap_error"
+            self._last_swap_detail = repr(e)
+            _GENERATIONS.inc(path=path, result="swap_error")
+            logger.warning("reload %s failed: %s", url, e)
+
+    # -- state surface -------------------------------------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "engineId": self.engine_id,
+                "engineVariant": self.engine_variant,
+                "instanceId": getattr(self._instance, "id", None),
+                "generation": self._generation,
+                "watermarkSeq": self._watermark_seq,
+                "watermarkTimeMs": self._watermark_time_ms,
+                "readSeq": self._read_seq,
+                "pendingEvents": len(self._pending),
+                "quarantined": self._quarantined,
+                "lastSwap": self._last_swap,
+                "lastSwapDetail": self._last_swap_detail,
+                "lastError": self._last_error,
+                "lastAdvance": self._last_advance,
+                "lastCycleSeconds": self._last_cycle_s,
+                "lastEventsToServableSeconds":
+                    self._last_events_to_servable_s,
+                "intervalS": self.interval_s,
+                "minEvents": self.min_events,
+                "fullEvery": self.full_every,
+                "incremental": self._incremental,
+                "reloadUrl": self.reload_url,
+            }
+
+    def _write_state(self, running: bool = True) -> None:
+        """Atomically persist the status surface (NOT the watermark of
+        record — that lives in the instance env). ``updated`` doubles as
+        the heartbeat ``pio doctor`` judges daemon liveness from."""
+        try:
+            d = state_dir()
+            d.mkdir(parents=True, exist_ok=True)
+            doc = self.state()
+            doc["running"] = bool(running and not self._stop.is_set())
+            doc["updated"] = time.time()
+            tmp = d / f".{self.name}.json.tmp"
+            # stop() (caller thread) and the trainer thread both write
+            # here; the lock keeps the shared tmp path from interleaving
+            with self._lock:
+                tmp.write_text(json.dumps(doc))
+                os.replace(tmp, d / f"{self.name}.json")
+        except OSError:
+            logger.debug("trainer state write failed", exc_info=True)
+
+
+# -- external status/diagnosis (pio status / pio doctor) ---------------------
+
+
+def trainer_states(directory: Path | str | None = None,
+                   now: float | None = None) -> list[dict]:
+    """Every persisted trainer state doc, newest first, each with a
+    computed ``heartbeatAgeSeconds``. Torn writes are skipped (writes
+    are atomic; a torn file means a dead writer mid-rename race)."""
+    d = state_dir(directory)
+    now = time.time() if now is None else now
+    out = []
+    if not d.is_dir():
+        return out
+    for path in sorted(d.glob("*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        doc["heartbeatAgeSeconds"] = round(
+            max(now - float(doc.get("updated", 0) or 0), 0.0), 1)
+        out.append(doc)
+    out.sort(key=lambda s: s.get("updated", 0), reverse=True)
+    return out
+
+
+def _stall_after(interval_s: float) -> float:
+    """Seconds without watermark advance (while events are pending)
+    before the loop reads as stalled: generous multiples of the batching
+    window, floored by ``PIO_FOLDIN_STALL_GRACE``."""
+    return max(_env_float("PIO_FOLDIN_STALL_GRACE", 30.0),
+               4.0 * max(float(interval_s), 1.0))
+
+
+def diagnose_trainers(slo_state: dict | None = None,
+                      directory: Path | str | None = None,
+                      now: float | None = None) -> list[dict]:
+    """Doctor findings for the continuous-training loop. STALLED-LOOP is
+    the headline: the ``model_staleness`` SLO burns while a trainer IS
+    registered but its watermark is not advancing — a different problem
+    from plain staleness with no trainer (no actuator at all), so it
+    gets its own named finding with a runbook (docs/operations.md)."""
+    now = time.time() if now is None else now
+    staleness_burning = False
+    for slo in (slo_state or {}).get("slos", []):
+        if slo.get("name") != "model_staleness":
+            continue
+        fast = (slo.get("burnRates") or {}).get("fast")
+        staleness_burning = bool(
+            slo.get("breached")
+            or (fast is not None
+                and fast > slo.get("burnThreshold", 14.4)))
+    findings: list[dict] = []
+    for st in trainer_states(directory, now=now):
+        name = st.get("name", "?")
+        hb_age = st.get("heartbeatAgeSeconds", 0.0)
+        interval = float(st.get("intervalS", 10.0) or 10.0)
+        stall_after = _stall_after(interval)
+        if not st.get("running"):
+            continue  # cleanly stopped: nothing to watch
+        if hb_age > max(stall_after, 60.0):
+            findings.append({
+                "severity": "critical",
+                "subject": f"STALLED-LOOP trainer {name}",
+                "detail": (
+                    f"continuous trainer heartbeat is {hb_age:.0f}s old "
+                    "(daemon dead or wedged) — the event→model→serving "
+                    "loop has no actuator; restart `pio train "
+                    "--continuous` / the --auto-train deploy"),
+            })
+            continue
+        pending = int(st.get("pendingEvents", 0) or 0)
+        adv_age = now - float(st.get("lastAdvance", now) or now)
+        stalled = pending > 0 and adv_age > stall_after
+        if stalled and staleness_burning:
+            findings.append({
+                "severity": "critical",
+                "subject": f"STALLED-LOOP trainer {name}",
+                "detail": (
+                    f"model_staleness is burning while {pending} "
+                    f"event(s) wait and the watermark has not advanced "
+                    f"in {adv_age:.0f}s (generation "
+                    f"{st.get('generation')}, last swap "
+                    f"{st.get('lastSwap')}"
+                    + (f", last error {st.get('lastError')}"
+                       if st.get("lastError") else "") + ")"),
+            })
+        elif stalled:
+            findings.append({
+                "severity": "warn",
+                "subject": f"STALLED-LOOP trainer {name}",
+                "detail": (
+                    f"{pending} pending event(s) but no watermark "
+                    f"advance in {adv_age:.0f}s"
+                    + (f"; last error {st.get('lastError')}"
+                       if st.get("lastError") else "")),
+            })
+        elif st.get("lastSwap") == "blocked":
+            findings.append({
+                "severity": "warn",
+                "subject": f"trainer {name}",
+                "detail": (
+                    f"latest generation {st.get('generation')} is "
+                    "QUARANTINED by the reload shadow gate "
+                    f"({st.get('quarantined')} total); the parent keeps "
+                    "serving and the swap retries after the next delta"),
+            })
+    return findings
+
+
+def render_status_lines(states: list[dict] | None = None) -> list[str]:
+    """``pio status`` lines for the continuous-training loop: watermark
+    lag, generation, last swap outcome."""
+    if states is None:
+        states = trainer_states()
+    lines = []
+    for st in states:
+        run = "running" if st.get("running") else "stopped"
+        lag = ""
+        if st.get("pendingEvents"):
+            lag = f", {st['pendingEvents']} event(s) pending"
+        e2s = st.get("lastEventsToServableSeconds")
+        e2s_txt = f", events→servable {e2s:.1f}s" if e2s else ""
+        lines.append(
+            f"[INFO]   trainer {st.get('name')}: {run}, generation "
+            f"{st.get('generation')}, watermark seq "
+            f"{st.get('watermarkSeq')}{lag}, last swap "
+            f"{st.get('lastSwap') or 'n/a'}"
+            f"{e2s_txt}, heartbeat {st.get('heartbeatAgeSeconds')}s ago")
+        if st.get("quarantined"):
+            lines.append(
+                f"[WARN]   trainer {st.get('name')}: "
+                f"{st['quarantined']} generation(s) quarantined by the "
+                "shadow gate")
+    return lines

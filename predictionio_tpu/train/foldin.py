@@ -1,0 +1,353 @@
+"""Incremental ALS fold-in: re-solve ONLY the entities with new evidence.
+
+The ALX alternating-solve structure (PAPERS.md: "Large Scale Matrix
+Factorization on TPUs") makes per-entity refresh cheap: each half-step's
+normal equations are independent per row, so a user (or item) whose
+evidence changed can be re-solved exactly against FROZEN opposite-side
+factors without touching the rest of the catalog. A fold-in generation is
+one restricted ALS iteration over the touched rows:
+
+  1. user half — every user with delta events is re-solved against the
+     parent instance's item factors;
+  2. item half — every item with delta events is re-solved against the
+     UPDATED user factors (the same ordering a full ``_iteration_dense``
+     runs, so the restricted step is a faithful slice of a full one);
+  3. untouched rows are byte-identical copies of the parent factors
+     (pinned exactly in tests/test_foldin.py).
+
+The device math reuses the dense solver's own pieces (models/als_dense.py):
+the cell sort + duplicate/zero-cell correction collapse
+(``_sorted_main_and_corrections``), the compact-COO pack + on-device
+densify (``_pack_block``/``_scatter_block``) streamed through the
+``io.transfer.ChunkStager`` (pack+upload of block k+1 overlaps the densify
+of block k, exactly like ``acquire_device_inputs``' staging path), and the
+payload-matmul half solve (``_dense_half_solve`` → ``_normal_eq_solve``).
+The sub-matrix is [touched, n_other] instead of [catalog, n_other], so a
+generation costs O(touched x catalog) cells instead of a full iteration
+sweep — the events-to-servable headline this subsystem exists for.
+
+Brand-new users/items append zero-initialized rows and get their first
+solve as a pure least-squares against the frozen opposite side (their
+rated counterparts that are themselves new contribute nothing this
+generation and refine on the next — the ALX fold-in convention).
+
+When the delta touches more than ``PIO_FOLDIN_MAX_FRACTION`` of either
+catalog the incremental step declines (``fold_in_ready`` → False) and the
+trainer falls back to the exact-parity full retrain path.
+
+:func:`run_foldin` is the engine-instance lifecycle around the solve — the
+fold-in twin of ``workflow.core_workflow.run_train``: INIT → fold_in per
+algorithm → persist → refreshed quality baseline → COMPLETED, under a
+``runlog.run_scope`` so ``pio runs``/``pio watch``/STALLED-RUN cover the
+generation like any other training run. The produced instance records its
+lineage in ``env``: ``foldin_of`` (parent id), ``foldin_generation``, and
+the new ``train_watermark_seq`` the continuous trainer resumes from.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def max_fraction() -> float:
+    """``PIO_FOLDIN_MAX_FRACTION`` (default 0.2): the catalog fraction
+    past which a delta stops being "incremental" and the exact full
+    retrain is the better (and drift-free) deal."""
+    from predictionio_tpu.utils.env import env_float
+
+    return env_float("PIO_FOLDIN_MAX_FRACTION", 0.2)
+
+
+@dataclass
+class FoldinData:
+    """The trainer's full interaction snapshot with the delta appended at
+    the tail: rows ``[delta_start:]`` are the events newer than the
+    parent instance's train watermark. The full snapshot rides along
+    because a touched entity's re-solve needs ALL its evidence (old and
+    new rows alike), not just the delta."""
+
+    users: list
+    items: list
+    ratings: np.ndarray
+    delta_start: int
+
+    @property
+    def delta_users(self) -> list:
+        return self.users[self.delta_start:]
+
+    @property
+    def delta_items(self) -> list:
+        return self.items[self.delta_start:]
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    """Next power of two ≥ max(n, floor): the touched-row count varies
+    per cycle, and padding it onto a pow2 ladder bounds the fold-in
+    program's compile count the same way the serving tick ladder does."""
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def _foldin_half_program():
+    """The jitted restricted half-step, built lazily so importing this
+    module costs no jax work. One program per (shape-bucket x static
+    config); cached on the module."""
+    global _FOLDIN_HALF
+    if _FOLDIN_HALF is not None:
+        return _FOLDIN_HALF
+    import jax
+
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.obs import device as device_obs
+
+    @device_obs.profiled_program(
+        lambda *a, **kw: f"als_foldin_rank{kw['rank']}",
+        bucket=als_dense._dense_bucket,
+        sync=True,  # the rows are read back immediately; a synced
+        # histogram keeps the recorded time device-true
+    )
+    @partial(
+        jax.jit,
+        static_argnames=("implicit", "rank", "scale", "ub", "exact"),
+    )
+    def foldin_half(prev, fixed, blocks, dup, lambda_, alpha, *,
+                    implicit: bool, rank: int, scale: int, ub: int,
+                    exact: bool = False):
+        return als_dense._dense_half_solve(
+            prev, fixed, blocks, None, dup, lambda_, alpha, implicit,
+            rank, scale, ub, exact, False)
+
+    _FOLDIN_HALF = foldin_half
+    return foldin_half
+
+
+_FOLDIN_HALF = None
+
+
+def solve_entities(params, entities: np.ndarray, e_idx: np.ndarray,
+                   o_idx: np.ndarray, vals: np.ndarray, fixed,
+                   prev_rows: np.ndarray, n_entities: int,
+                   n_other: int) -> np.ndarray | None:
+    """Re-solved factor rows ``[m, rank]`` for ``entities`` (sorted
+    unique int32 ids of one side) against frozen ``fixed`` opposite-side
+    factors, from the FULL COO ``(e_idx, o_idx, vals)``. The math is the
+    dense solver's half-step restricted to the touched rows: the
+    sub-matrix of their cells is densified on device (streamed through
+    the ChunkStager in row blocks) and one payload-matmul + Cholesky
+    dispatch re-solves all of them. None when the values are not
+    int8-encodable (the dense formulation does not apply — callers fall
+    back to a full retrain)."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.io import transfer
+    from predictionio_tpu.models import als_dense
+
+    p = params
+    m = int(len(entities))
+    if m == 0:
+        return prev_rows
+    # select the touched entities' edges and remap to local row ids
+    local = np.full(n_entities, -1, np.int32)
+    local[entities] = np.arange(m, dtype=np.int32)
+    le_all = local[np.asarray(e_idx, np.int32)]
+    sel = le_all >= 0
+    le = le_all[sel]
+    lo = np.asarray(o_idx, np.int32)[sel]
+    lv = np.asarray(vals, np.float32)[sel]
+    scale = als_dense._int8_scale(lv)
+    if scale == 0:
+        return None
+    mu, mi, mv, dup_u, _dup_i = als_dense._sorted_main_and_corrections(
+        le, lo, lv, m, n_other, scale)
+    # pow2-pad the row axis (bounds the program's retrace ladder as the
+    # touched count varies cycle to cycle), then block the padded rows
+    # the same way acquire_device_inputs' streamed path does
+    m_pad = _pow2(m)
+    nb, ub, starts, item_dtype = als_dense._block_split(
+        mu, m_pad, n_other, None,
+        max_block_bytes=min(als_dense._BLOCK_BYTES,
+                            transfer.transfer_chunk_bytes()))
+    # the packed cell count varies with the delta's evidence mass; force
+    # it onto the same pow2 ladder as the row axis so a steady-state
+    # cycle re-dispatches warm programs instead of recompiling
+    # (_pack_block's padding cells are dropped by the device scatter)
+    pack_m = _pow2(int(np.diff(starts).max()) if nb else 1, floor=4096)
+
+    def pack(b: int):
+        return als_dense._pack_block(b, mu, mi, mv, starts, ub, pack_m,
+                                     item_dtype)
+
+    def upload(packed):
+        import jax
+
+        f, v, rs, k = packed
+        return (jax.device_put(f), jax.device_put(v),
+                jax.device_put(rs), jnp.int32(k))
+
+    stager = transfer.ChunkStager(name="als_foldin")
+    blocks = []
+    for _idx, (fd, vd, rsd, kd) in stager.stream(
+            range(nb), pack, upload=upload):
+        blocks.append(als_dense._scatter_block(
+            fd, vd, rsd, kd, ub=ub, n_items=n_other))
+    blocks = tuple(blocks)
+    dup_dev = None
+    if dup_u is not None:
+        import jax
+
+        # pow2-pad the correction arrays too — their length is the
+        # delta's duplicate/zero-cell count, different every cycle, and
+        # each new length would recompile the half program. Pad rows are
+        # exact no-ops: cnt=0/val=0 zero both the pair and rhs weights
+        # in _dup_correction, and repeating the last seg id keeps the
+        # segment-sum's indices_are_sorted contract
+        nd = len(dup_u.seg)
+        nd_pad = _pow2(nd, floor=4096)
+        seg_fill = int(dup_u.seg[-1]) if nd else 0
+        dup_dev = tuple(jax.device_put(x) for x in (
+            np.pad(dup_u.seg, (0, nd_pad - nd),
+                   constant_values=seg_fill),
+            np.pad(dup_u.nbr, (0, nd_pad - nd)),
+            np.pad(dup_u.cnt, (0, nd_pad - nd)),
+            np.pad(dup_u.val, (0, nd_pad - nd)),
+        ))
+    prev_pad = np.zeros((nb * ub, p.rank), np.float32)
+    prev_pad[:m] = np.asarray(prev_rows, np.float32)
+    half = _foldin_half_program()
+    out = half(
+        jnp.asarray(prev_pad), jnp.asarray(np.asarray(fixed, np.float32)),
+        blocks, dup_dev, jnp.float32(p.lambda_), jnp.float32(p.alpha),
+        implicit=p.implicit_prefs, rank=p.rank, scale=scale, ub=ub,
+        exact=p.gather_dtype == "float32")
+    return np.asarray(out)[:m]
+
+
+class _FoldinDeclined(Exception):
+    """An algorithm declined the incremental path mid-run (e.g. the delta
+    values stopped being int8-encodable): the caller falls back to the
+    full retrain."""
+
+
+def run_foldin(engine, engine_params, parent, models, data: FoldinData,
+               generation: int, watermark: dict
+               ) -> tuple[str, list] | None:
+    """The fold-in generation's engine-instance lifecycle (the
+    ``run_train`` twin): run every algorithm's ``fold_in`` under a run
+    ledger, persist the models, refresh the quality baseline, and mark
+    the instance COMPLETED with its lineage env. Returns ``(instance_id,
+    new_models)``, or None when any algorithm lacks the protocol or its
+    ``fold_in_ready`` pre-check declines (callers run the exact full
+    retrain instead). A mid-run failure marks the instance ABORTED and
+    re-raises — the trainer counts it and re-queues the delta."""
+    import hashlib
+
+    from predictionio_tpu.core.persistent_model import (
+        PersistentModel,
+        PersistentModelManifest,
+        class_path,
+        serialize_models,
+    )
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import EngineInstance, Model
+    from predictionio_tpu.obs import quality, runlog, trace
+    from predictionio_tpu.utils.time import now
+    from predictionio_tpu.workflow.context import workflow_context
+
+    algorithms = engine._algorithms(engine_params)
+    for algo, model in zip(algorithms, models):
+        if getattr(algo, "fold_in", None) is None:
+            logger.info("fold-in unsupported by %s; full retrain",
+                        type(algo).__name__)
+            return None
+        ready = getattr(algo, "fold_in_ready", None)
+        if ready is not None and not ready(model, data):
+            return None
+
+    ctx = workflow_context(batch=parent.batch, mode="FoldIn")
+    instances = Storage.get_meta_data_engine_instances()
+    instance_id = instances.insert(EngineInstance(**{
+        **parent.__dict__,
+        "id": "",
+        "status": "INIT",
+        "start_time": now(),  # a generation reads as a FRESH model:
+        # model age / staleness derive from start_time, and inheriting
+        # the parent's would leave the swap invisible to the SLO
+        "end_time": now(),
+        "env": {},
+    }))
+    params_hash = hashlib.sha1(
+        parent.algorithms_params.encode()).hexdigest()[:12]
+    try:
+        with runlog.run_scope(run_id=instance_id,
+                              engine=parent.engine_factory,
+                              params_hash=params_hash), \
+                trace.span("run_foldin", instance=instance_id):
+            t0 = time.perf_counter()
+            new_models = []
+            for algo, model in zip(algorithms, models):
+                refreshed = algo.fold_in(ctx, model, data)
+                if refreshed is None:
+                    raise _FoldinDeclined(type(algo).__name__)
+                new_models.append(refreshed)
+            runlog.phase("foldin_solve", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            persisted = []
+            for algo, model in zip(algorithms, new_models):
+                p = algo.make_persistent_model(ctx, instance_id, model)
+                if isinstance(p, PersistentModel):
+                    saved = p.save(instance_id, None)
+                    p = (PersistentModelManifest(class_path(type(p)))
+                         if saved else model)
+                persisted.append(p)
+            blob = serialize_models(persisted)
+            Storage.get_model_data_models().insert(
+                Model(instance_id, blob))
+            runlog.phase("persist", time.perf_counter() - t0)
+            # refreshed quality baseline: the shadow gate and live drift
+            # must judge THIS generation's score distribution, not the
+            # parent's
+            from predictionio_tpu.parallel import placement
+
+            t0 = time.perf_counter()
+            with placement.serving_cache_bypass():
+                baseline = quality.baseline_env(
+                    engine, engine_params, new_models)
+            runlog.phase("baseline", time.perf_counter() - t0)
+    except _FoldinDeclined as e:
+        instances.delete(instance_id)
+        logger.info("fold-in declined by %s; full retrain", e)
+        return None
+    except Exception:
+        aborted = EngineInstance(**{
+            **instances.get(instance_id).__dict__,
+            "status": "ABORTED",
+            "end_time": now(),
+        })
+        instances.update(aborted)
+        raise
+    env = {
+        "foldin_of": parent.id,
+        "foldin_generation": str(int(generation)),
+        "train_watermark_seq": str(watermark.get("seq", "")),
+        "train_watermark_time_ms": str(watermark.get("timeMs", "")),
+        **baseline,
+    }
+    done = EngineInstance(**{
+        **instances.get(instance_id).__dict__,
+        "status": "COMPLETED",
+        "end_time": now(),
+        "env": env,
+    })
+    instances.update(done)
+    logger.info(
+        "fold-in generation %d: instance %s (parent %s, %d delta rows)",
+        generation, instance_id, parent.id,
+        len(data.users) - data.delta_start)
+    return instance_id, new_models

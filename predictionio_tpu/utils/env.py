@@ -1,0 +1,22 @@
+"""Typed environment-variable reads, shared by every tunable that is
+re-read per call so live processes retune without a restart. A
+malformed value falls back to the default instead of raising — an
+operator typo in one knob must not sink a serving process."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default

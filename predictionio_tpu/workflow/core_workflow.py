@@ -59,6 +59,16 @@ def run_train(
     # the runs dir without touching this process
     params_hash = hashlib.sha1(
         engine_instance.algorithms_params.encode()).hexdigest()[:12]
+    # continuous-training watermark (train/continuous.py): snapshot the
+    # event-store cursor tail BEFORE the data read, so the completed
+    # instance records which events it could have seen — the position an
+    # ingest-driven fold-in resumes from. Events landing during the read
+    # sit past the snapshot and re-fold harmlessly; a snapshot after the
+    # read could drop them forever. {} when the engine has no
+    # delta_source() protocol or the backend no stable cursor.
+    from predictionio_tpu.train.continuous import train_watermark_env
+
+    watermark_env = train_watermark_env(engine, engine_params)
     try:
         ctx = workflow_context(batch=wp.batch, mode="Training")
         timer = PhaseTimer()
@@ -140,7 +150,8 @@ def run_train(
                 **current.__dict__,
                 "status": "COMPLETED",
                 "end_time": now(),
-                "env": {**current.env, **train_env, **baseline_env},
+                "env": {**current.env, **train_env, **baseline_env,
+                        **watermark_env},
             }
         )
         instances.update(done)

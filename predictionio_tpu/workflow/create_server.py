@@ -219,7 +219,11 @@ class QueryService:
             name=config.server_name,
         )
         self._last_query = None  # replayed by the synthetic device probe
-        self._promote_thread: threading.Thread | None = None
+        #: EVERY live serving-promote thread, not just the newest: rapid
+        #: successive /reload swaps can overlap promote threads, and
+        #: shutdown must join them ALL or a straggler pins into the
+        #: process-global serving arena after teardown
+        self._promote_threads: list[threading.Thread] = []
         # bounded admission: beyond this many in-flight /queries.json
         # requests the server sheds with 429 + Retry-After instead of
         # queueing unboundedly behind the batcher
@@ -412,8 +416,11 @@ class QueryService:
             for algo, model in zip(algorithms, models):
                 # a /reload racing past this thread already evicted the
                 # instance these models belong to — pinning them now
-                # would resurrect stale catalogs in the arena
-                if placement.current_serving_instance() != instance_id:
+                # would resurrect stale catalogs in the arena; a stopped
+                # service must likewise stop pinning
+                if self._stop_event.is_set() \
+                        or placement.current_serving_instance() \
+                        != instance_id:
                     return
                 pin = getattr(algo, "pin_serving_state", None)
                 if pin is None:
@@ -437,9 +444,12 @@ class QueryService:
                     "pinned %d bytes of serving model state device-"
                     "resident (serving_models arena)", pinned)
 
-        self._promote_thread = threading.Thread(
+        self._promote_threads = [
+            t for t in self._promote_threads if t.is_alive()]
+        t = threading.Thread(
             target=promote, name="serving-promote", daemon=True)
-        self._promote_thread.start()
+        self._promote_threads.append(t)
+        t.start()
 
     # -- routes -------------------------------------------------------------
     def _build_router(self) -> Router:
@@ -503,6 +513,19 @@ class QueryService:
                     .total_seconds(), 0.0), 1)
                 if self.instance.start_time is not None else None,
             }
+            # continuous-training lineage (train/foldin.py): a fold-in
+            # generation names its parent and generation counter so
+            # operators can tell an incremental refresh from a full
+            # retrain at a glance (docs/rest-api.md)
+            env = self.instance.env or {}
+            if env.get("foldin_of"):
+                body["foldinOf"] = env["foldin_of"]
+            if env.get("foldin_generation"):
+                try:
+                    body["foldinGeneration"] = int(
+                        env["foldin_generation"])
+                except (TypeError, ValueError):
+                    body["foldinGeneration"] = env["foldin_generation"]
         # top-line latency quantiles over THIS service's lifetime, from
         # the log-bucketed histogram (no per-sample storage behind them).
         # Always-present keys: an empty observation window reports an
@@ -1279,10 +1302,10 @@ class QueryService:
                 logger.warning(
                     "micro-batcher threads did not stop within %.1fs",
                     timeout)
-        t = self._promote_thread
-        if t is not None and t.is_alive():
-            t.join(timeout)
-            ok = ok and not t.is_alive()
+        for t in self._promote_threads:
+            if t.is_alive():
+                t.join(timeout)
+                ok = ok and not t.is_alive()
         return ok
 
 

@@ -97,6 +97,28 @@ def test_quality_keys_are_higher_is_better():
         "quality_join_rate", "shadow_overlap_at_k"}
 
 
+def test_foldin_keys_directions():
+    """ISSUE 14's headline keys: events-to-servable is a LATENCY however
+    it is suffixed (a rise is the regression), the fold-in speedup ratio
+    is throughput-shaped (a drop is the regression)."""
+    from predictionio_tpu.tools.bench_compare import lower_is_better
+
+    assert lower_is_better("events_to_servable_s")
+    assert lower_is_better("foldin_events_to_servable_seconds")
+    assert not lower_is_better("foldin_speedup_vs_retrain")
+    result = compare(
+        {"events_to_servable_s": 1.0, "foldin_speedup_vs_retrain": 10.0},
+        {"events_to_servable_s": 4.0, "foldin_speedup_vs_retrain": 2.0})
+    assert {e["key"] for e in result["regressions"]} == {
+        "events_to_servable_s", "foldin_speedup_vs_retrain"}
+    result = compare(
+        {"events_to_servable_s": 4.0, "foldin_speedup_vs_retrain": 2.0},
+        {"events_to_servable_s": 1.0, "foldin_speedup_vs_retrain": 10.0})
+    assert not result["regressions"]
+    assert {e["key"] for e in result["improvements"]} == {
+        "events_to_servable_s", "foldin_speedup_vs_retrain"}
+
+
 def test_per_key_threshold_overrides():
     a = flatten_headline(load_headline(BASELINE))
     b = flatten_headline(load_headline(CANDIDATE))

@@ -904,13 +904,13 @@ def test_service_shutdown_joins_worker_threads(memory_storage):
     assert status == 200
     srv.stop()
     batcher = service.batcher
-    promote = service._promote_thread
+    promotes = list(service._promote_threads)
     assert service.shutdown(timeout=10.0)
     # assert on THIS service's thread objects, not global thread names —
     # other tests' (never-shut-down) servers share the names
     assert not batcher._thread.is_alive()
     assert not batcher._finalizer.is_alive()
-    assert promote is None or not promote.is_alive()
+    assert all(not t.is_alive() for t in promotes)
 
 
 # -- chaos control surface ----------------------------------------------------
