@@ -97,7 +97,11 @@ SCALES: dict[str, dict] = {
         ml20m=(138_493, 26_744, 20_000_000), ml20m_iters=20,
         ml20m_repeats=4, rank64_iters=8, rank64_repeats=2,
         two_tower=dict(nu=138_493, ni=26_744, nnz=2_000_000, batch=4096,
-                       steps=2000, samples=5, b16k=True, rowwise=True),
+                       steps=2000, samples=5, b16k=True, rowwise=True,
+                       dense_compare=True),
+        sasrec=dict(n_seqs=16_384, n_items=20_000, max_len=128,
+                    batch=256, embed_dim=64, num_blocks=2, epochs=2,
+                    samples=3),
         serving=True, host_baseline=True,
     ),
     "dry": dict(
@@ -105,7 +109,10 @@ SCALES: dict[str, dict] = {
         ml20m=(1_200, 400, 24_000), ml20m_iters=4,
         ml20m_repeats=1, rank64_iters=2, rank64_repeats=1,
         two_tower=dict(nu=1_500, ni=400, nnz=20_000, batch=256,
-                       steps=20, samples=2, b16k=False, rowwise=False),
+                       steps=20, samples=2, b16k=False, rowwise=False,
+                       dense_compare=True),
+        sasrec=dict(n_seqs=192, n_items=400, max_len=16, batch=64,
+                    embed_dim=16, num_blocks=1, epochs=1, samples=2),
         # the serving bench spins up real servers and the host baseline
         # times a minutes-long numpy solve: both are skipped at dry
         # scale (vs_baseline falls back to the assumed figure)
@@ -464,6 +471,12 @@ def bench_two_tower(ctx, tt_cfg: dict | None = None) -> dict:
     p = TwoTowerParams(batch_size=cfg["batch"], steps=0, seed=0)
     batch = ctx.pad_to_multiple(p.batch_size)
     steps = cfg["steps"]
+    from predictionio_tpu.obs import device as device_obs
+    from predictionio_tpu.models.two_tower import (
+        sparse_update_bytes_per_step,
+    )
+
+    device_obs.reset_program_window("two_tower_sparse_step")
 
     # fixed-work protocol (round-2 review; spread rationale round 5): the
     # min over 5 pinned-work samples IS the steady rate — the whole
@@ -482,6 +495,7 @@ def bench_two_tower(ctx, tt_cfg: dict | None = None) -> dict:
     hbm_bw = hbm_bandwidth(dev)
     fl_step = two_tower_flops_per_step(p, nu, ni, batch)
     adam_bytes = two_tower_adam_bytes_per_step(p, nu, ni)
+    sparse_bytes = sparse_update_bytes_per_step(p, nu, ni, batch)
     out = {
         "two_tower_steady_steps_per_sec": round(steps / dt, 2),
         "two_tower_steps_per_sec": round(steps / dt, 2),  # r2/r3 continuity
@@ -490,17 +504,37 @@ def bench_two_tower(ctx, tt_cfg: dict | None = None) -> dict:
         "two_tower_batch": cfg["batch"],
         "two_tower_fixed_steps": steps,
         "two_tower_examples_per_sec": round(steps * cfg["batch"] / dt, 0),
-        # roofline accounting (round-4 review asked where 745 steps/s
-        # sits): the step is optimizer-HBM-bound, not MXU-bound — see
-        # docs/perf.md §6
+        # roofline accounting: the dense step was optimizer-HBM-bound
+        # (adam_mb_per_step streamed the full tables); the sparse path's
+        # analytic model scales with the batch's TOUCHED rows — see
+        # docs/perf.md §17
         "two_tower_gflop_per_step": round(fl_step / 1e9, 3),
         "two_tower_adam_mb_per_step": round(adam_bytes / 1e6, 1),
+        "two_tower_sparse_mb_per_step": round(sparse_bytes / 1e6, 3),
+        "two_tower_opt_traffic_ratio": round(adam_bytes / sparse_bytes, 1),
     }
     if hbm_bw:
-        out["two_tower_hbm_frac"] = round(
-            adam_bytes * (steps / dt) / hbm_bw, 3)
+        # renamed from two_tower_hbm_frac: the dense-adam roofline no
+        # longer describes the running (sparse) path — a fresh key keeps
+        # bench-compare from reading the deliberate traffic drop as a
+        # utilization regression against old captures
+        out["two_tower_sparse_hbm_frac"] = round(
+            sparse_bytes * (steps / dt) / hbm_bw, 3)
     if peak:
-        out["two_tower_mfu"] = round(fl_step * (steps / dt) / peak, 4)
+        # prefer the live profiled-program accounting (the same window
+        # the pio_device_mfu gauge publishes); closed form as fallback
+        mfu = device_obs.program_mfu("two_tower_sparse_step")
+        out["two_tower_mfu"] = round(
+            mfu if mfu is not None else fl_step * (steps / dt) / peak, 4)
+
+    if cfg.get("dense_compare"):
+        # the dense-update path, same protocol: the optimizer-traffic
+        # story's measured half (sparse steady rate above vs this)
+        pd = TwoTowerParams(batch_size=cfg["batch"], steps=0, seed=0,
+                            sparse_update=False)
+        td = timed_samples(pd, steps, min(cfg["samples"], 3))[0]
+        out["two_tower_dense_steps_per_sec"] = round(steps / td, 2)
+        out["two_tower_sparse_speedup"] = round(td / dt, 2)
 
     # -- batch 16k (auto loss policy selects the chunked CE here: it
     # engages above 1024 negatives — two_tower._DENSE_LOGITS_MAX — and
@@ -522,6 +556,70 @@ def bench_two_tower(ctx, tt_cfg: dict | None = None) -> dict:
         trw = timed_samples(prw, steps, 3)[0]
         out["two_tower_rowwise_steps_per_sec"] = round(steps / trw, 2)
     return out
+
+
+def bench_sasrec(ctx, cfg: dict) -> dict:
+    """SASRec sequential-recommendation training throughput: the sparse
+    item-table update path (docs/perf.md §17) timed with the fixed-work
+    protocol — per-epoch single-dispatch ``_train_epoch`` runs blocked by
+    the scalar loss, min-of-N samples. ``sasrec_examples_per_sec`` is the
+    headline (sequences consumed per second)."""
+    import jax
+
+    from predictionio_tpu.models.sasrec import (
+        SASRecParams,
+        _make_training_arrays,
+        _train_epoch,
+        init_opt_state,
+        init_params,
+    )
+
+    rng = np.random.default_rng(0)
+    n_items = cfg["n_items"]
+    seq_lists = [
+        list(rng.integers(1, n_items + 1,
+                          int(rng.integers(8, cfg["max_len"] + 1))))
+        for _ in range(cfg["n_seqs"])
+    ]
+    p = SASRecParams(
+        max_len=cfg["max_len"], embed_dim=cfg["embed_dim"],
+        num_blocks=cfg["num_blocks"], num_heads=2,
+        ffn_dim=2 * cfg["embed_dim"], dropout=0.2,
+        batch_size=cfg["batch"], num_epochs=cfg["epochs"], seed=0)
+    seqs, pos = _make_training_arrays(seq_lists, p.max_len)
+    n = len(seqs)
+    bs = min(p.batch_size, n)
+    steps_per_epoch = max(n // bs, 1)
+    seqs_d, pos_d = jax.numpy.asarray(seqs), jax.numpy.asarray(pos)
+    params = init_params(n_items, p)
+    opt_state = init_opt_state(params, p)
+    key = jax.random.PRNGKey(0)
+
+    def run(params, opt_state, epochs: int):
+        loss = None
+        for e in range(epochs):
+            params, opt_state, loss = _train_epoch(
+                params, opt_state, seqs_d, pos_d, key, e, p.learning_rate,
+                p=p, steps_per_epoch=steps_per_epoch, bs=bs,
+                n_items=n_items)
+        float(loss)  # scalar sync per epoch (the product loop's shape)
+        return params, opt_state
+
+    params, opt_state = run(params, opt_state, 1)  # compile + warm
+    times = []
+    for _ in range(cfg["samples"]):
+        t0 = time.perf_counter()
+        params, opt_state = run(params, opt_state, cfg["epochs"])
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    examples = cfg["epochs"] * steps_per_epoch * bs
+    return {
+        "sasrec_examples_per_sec": round(examples / dt, 0),
+        "sasrec_steps_per_sec": round(
+            cfg["epochs"] * steps_per_epoch / dt, 2),
+        "sasrec_batch": bs,
+        "sasrec_max_len": cfg["max_len"],
+    }
 
 
 #: The performance bands README.md claims, as ``extra`` key → (lo, hi).
@@ -867,6 +965,11 @@ def _section_two_tower(state: _BenchState) -> None:
     state.extra.update(bench_two_tower(state.ctx, state.cfg["two_tower"]))
 
 
+def _section_sasrec(state: _BenchState) -> None:
+    """SASRec sequential training throughput (sparse item-table path)."""
+    state.extra.update(bench_sasrec(state.ctx, state.cfg["sasrec"]))
+
+
 def _section_serving(state: _BenchState) -> None:
     """Serving latency (p50/p99 REST predict through the query server)
     + ingest/scan rates. Skipped at dry scale (real servers)."""
@@ -880,11 +983,13 @@ def _section_serving(state: _BenchState) -> None:
         bench_event_ingest,
         bench_event_scan,
         bench_query_latency,
+        bench_sasrec_serving,
     )
 
     state.extra.update(bench_query_latency())
     state.extra.update(bench_event_ingest())
     state.extra.update(bench_event_scan())
+    state.extra.update(bench_sasrec_serving())
 
 
 def _section_host_baseline(state: _BenchState) -> None:
@@ -914,6 +1019,7 @@ SECTIONS: list = [
     ("ml20m_rank64", _section_rank64, "rank64_bench_error"),
     ("mfu", _section_mfu, "mfu_bench_error"),
     ("two_tower", _section_two_tower, "two_tower_bench_error"),
+    ("sasrec", _section_sasrec, "sasrec_bench_error"),
     ("serving", _section_serving, "serving_bench_error"),
     ("host_baseline", _section_host_baseline, "host_baseline_error"),
 ]
@@ -1166,9 +1272,13 @@ def _dry_run_doc() -> dict:
         "unit": "iter/s",
         "vs_baseline": 0.0,
         # device-accounting keys present-with-nulls so capture tooling
-        # sees a stable schema whether or not device sections ran
+        # sees a stable schema whether or not device sections ran. The
+        # neural-path headline keys (ISSUE 15) ride every capture too:
+        # two_tower_mfu carries the bench-compare MFU-floor guard
+        # (higher-is-better; gate with --key-threshold two_tower_mfu=...)
         "extra": {"dry_run": True, "peak_hbm_bytes": None,
-                  "retraces": None},
+                  "retraces": None, "two_tower_mfu": None,
+                  "sasrec_examples_per_sec": None},
     }
 
 

@@ -1153,6 +1153,103 @@ def bench_foldin(burst: int = 400, rank: int = 10,
     return out
 
 
+def bench_sasrec_serving(n_users: int = 400, n_items: int = 200,
+                         seq_requests: int = 200) -> dict:
+    """Device-resident SASRec serving (ISSUE 15): deploy the sequential-
+    recommendation template and measure the REST predict p50 through the
+    fused-tick route (pinned transformer + item table in the
+    ``serving_models`` arena, one forward+score+top-k dispatch per tick,
+    deferred readback). ``sasrec_device_p50_ms`` is the first measured
+    device p50 sequential recommendation has had; null when the
+    placement decision kept the route on the host (reported as
+    ``sasrec_serve_placement``)."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+
+    out: dict = {"sasrec_device_p50_ms": None, "sasrec_serve_p50_ms": None,
+                 "sasrec_serve_placement": None,
+                 "sasrec_readback_overlap_frac": None}
+    factory = ("predictionio_tpu.templates.sequentialrecommendation:"
+               "engine_factory")
+    storage = _setup_storage()
+    try:
+        from predictionio_tpu.templates.sequentialrecommendation import (
+            engine_factory,
+        )
+
+        app_id = storage.get_meta_data_apps().insert(App(0, "sasrecapp"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(0)
+        for u in range(n_users):
+            for it in rng.integers(0, n_items,
+                                   int(rng.integers(5, 40))):
+                events.insert(
+                    Event(event="view", entity_type="user",
+                          entity_id=f"u{u}", target_entity_type="item",
+                          target_entity_id=f"i{it}"),
+                    app_id)
+        engine = engine_factory()
+        variant = {
+            "engineFactory": factory,
+            "datasource": {"params": {"app_name": "sasrecapp"}},
+            "algorithms": [
+                {"name": "sasrec",
+                 "params": {"max_len": 32, "embed_dim": 32,
+                            "num_blocks": 1, "num_heads": 2,
+                            "ffn_dim": 64, "dropout": 0.0,
+                            "num_epochs": 3, "seed": 0}}
+            ],
+        }
+        ep = engine.engine_params_from_json(variant)
+        run_train(engine, ep,
+                  new_engine_instance("default", "1", "default", factory,
+                                      ep),
+                  WorkflowParams())
+        srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+        srv.start()
+        try:
+            c = _Client(srv.port)
+            for k in range(30):  # warm the seq-bucket x batch ladder
+                c.query(f"u{k % n_users}", 10)
+            _wait_batch_warmup()
+            lat = [c.query(f"u{k % n_users}", 10)
+                   for k in range(seq_requests)]
+            c.close()
+            p50 = round(float(np.percentile(np.asarray(lat) * 1e3, 50)), 2)
+            out["sasrec_serve_p50_ms"] = p50
+            batcher = service.batcher
+            device_ticks = getattr(batcher, "device_ticks", 0) \
+                if batcher is not None else 0
+            out["sasrec_serve_placement"] = (
+                "device" if device_ticks else "host")
+            if device_ticks:
+                out["sasrec_device_p50_ms"] = p50
+                out["sasrec_readback_overlap_frac"] = round(
+                    batcher.overlapped_ticks / device_ticks, 3)
+        finally:
+            srv.stop()
+            service.shutdown()
+    except Exception:  # noqa: BLE001 — headline keys are best-effort
+        import traceback
+
+        traceback.print_exc()
+    finally:
+        from predictionio_tpu.data.storage import Storage
+
+        Storage.reset()
+    return out
+
+
 def _headline(results: dict, metric: str = HEADLINE_METRIC) -> dict:
     """The driver's stdout contract (same shape as bench.py): metric /
     value / unit / vs_baseline / extra, with the full section results
@@ -1200,6 +1297,12 @@ def _dry_run_doc(gateway: bool = False) -> dict:
             # speedup ratio higher-is-better
             "events_to_servable_s": None,
             "foldin_speedup_vs_retrain": None,
+            # device-resident SASRec serving (ISSUE 15): the sequential
+            # recommender's first measured device p50
+            "sasrec_device_p50_ms": None,
+            "sasrec_serve_p50_ms": None,
+            "sasrec_serve_placement": None,
+            "sasrec_readback_overlap_frac": None,
         },
         metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
 
@@ -1212,6 +1315,7 @@ def _collect(gateway: bool, replicas: int) -> dict:
     results.update(bench_event_ingest())
     results.update(bench_event_scan())
     results.update(bench_foldin())
+    results.update(bench_sasrec_serving())
     return _headline(results)
 
 

@@ -74,6 +74,7 @@ __all__ = [
     "async_readback",
     "begin_readback",
     "iter_chunks",
+    "stage_training_arrays",
     "transfer_chunk_bytes",
     "transfer_slots",
 ]
@@ -496,6 +497,45 @@ def _row_chunks(a, chunk_bytes: int) -> list:
         return [a]
     per = -(-rows // n_chunks)
     return [a[i: i + per] for i in range(0, rows, per)]
+
+
+def stage_training_arrays(arrays: Sequence, sharding=None,
+                          name: str = "train_inputs",
+                          chunk_bytes: int | None = None) -> list:
+    """Upload host training arrays through the :class:`ChunkStager`.
+
+    The neural trainers' input-streaming path (ROADMAP item 3): each
+    array is split into row chunks of ``PIO_TRANSFER_CHUNK_MB``, a
+    worker packs (ascontiguousarray slice) and ``device_put``s chunk
+    ``k+1`` while the consumer enqueues chunk ``k``'s device concat —
+    the same pack/upload-overlaps-consume contract the ALS densify
+    stream rides, with ``pio_transfer_*`` telemetry under ``name``.
+    Arrays at or under one chunk skip the pipeline (a single put has
+    nothing to overlap). Returns one device array per input, placed on
+    ``sharding`` (None = default device)."""
+    import jax
+    import jax.numpy as jnp
+
+    chunk_bytes = chunk_bytes or transfer_chunk_bytes()
+
+    def put(a):
+        return jax.device_put(a, sharding) if sharding is not None \
+            else jnp.asarray(a)
+
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        parts = _row_chunks(a, chunk_bytes)
+        if len(parts) <= 1:
+            out.append(put(a))
+            continue
+        stager = ChunkStager(name=name)
+        staged = [None] * len(parts)
+        for idx, dev in stager.stream(
+                parts, pack=np.ascontiguousarray, upload=put):
+            staged[idx] = dev
+        out.append(jnp.concatenate(staged, axis=0))
+    return out
 
 
 def begin_readback(arrays: Sequence, chunk_bytes: int | None = None,

@@ -62,6 +62,15 @@ class SASRecParams:
     l2_emb: float = 0.0
     seed: int = 0
     attn_impl: str = "auto"  # auto | mha | flash | ring (serving forward)
+    #: Sparse item-embedding updates (docs/perf.md §17): the three
+    #: gathers a step makes (sequence forward, positive and negative
+    #: targets) are differentiated wrt the GATHERED rows, deduped +
+    #: segment-summed, and adam runs over the touched-row slices only —
+    #: optimizer traffic O(batch · seq_len) rows instead of the full
+    #: [n_items + 1, d] table. The transformer blocks / pos_emb / ln
+    #: keep dense adam. Ignored (dense fallback) when ``l2_emb > 0``:
+    #: the whole-table L2 term has an inherently dense gradient.
+    sparse_update: bool = True
 
 
 def init_params(n_items: int, p: SASRecParams, key=None) -> dict:
@@ -178,15 +187,25 @@ def _attend(q, k, v, seqs, impl: str, mesh=None):
 
 
 def forward(params: dict, seqs, p: SASRecParams, *, dropout_key=None,
-            mesh=None):
+            mesh=None, x_emb=None):
     """Hidden states [B, L, D] for padded item-id sequences [B, L] (0=pad).
     ``dropout_key`` enables dropout (training); None disables (serving).
-    ``mesh`` overrides the device mesh for the ring-attention path."""
+    ``mesh`` overrides the device mesh for the ring-attention path.
+    ``x_emb`` supplies pre-gathered item embeddings [B, L, D] (the sparse
+    train step differentiates wrt the gathered rows, so the table
+    gradient never materializes as a dense [n, d] scatter).
+
+    Sequences shorter than ``max_len`` (the serving seq-length buckets,
+    docs/perf.md §16) take the TAIL of the position table: left-padded
+    histories then see the SAME absolute positions at every padded
+    length, so a bucketed forward is numerically the max_len forward."""
     b, l = seqs.shape
     d = p.embed_dim
     valid = (seqs > 0)[..., None]  # [B, L, 1]
-    x = params["item_emb"][seqs] * jnp.sqrt(jnp.asarray(d, jnp.float32))
-    x = x + params["pos_emb"][None, :l]
+    x = (params["item_emb"][seqs] if x_emb is None else x_emb) \
+        * jnp.sqrt(jnp.asarray(d, jnp.float32))
+    n_pos = params["pos_emb"].shape[0]
+    x = x + params["pos_emb"][None, n_pos - l:]
     x = jnp.where(valid, x, 0.0)
 
     def dropout(key, t):
@@ -247,6 +266,83 @@ def _raw_train_step(params, opt_state, seqs, pos, neg, key, tx_lr,
     return optax.apply_updates(params, updates), opt_state, loss
 
 
+def _use_sparse(p: SASRecParams) -> bool:
+    """Sparse item-table updates apply unless the whole-table L2 term
+    (inherently dense gradient) is on."""
+    return p.sparse_update and p.l2_emb <= 0.0
+
+
+def _split_dense(params: dict) -> dict:
+    """The densely-updated subtree: everything but the item table."""
+    return {k: v for k, v in params.items() if k != "item_emb"}
+
+
+def init_opt_state(params: dict, p: SASRecParams):
+    """Optimizer state for the train step: plain adam over the whole
+    pytree on the dense path; on the sparse path, adam over the dense
+    subtree plus the item table's (m, v, last_step) touched-row buffers
+    (ops/sparse_update) and the global step counter."""
+    if not _use_sparse(p):
+        return optax.adam(p.learning_rate).init(params)
+    from predictionio_tpu.ops import sparse_update as su
+
+    m, v, last = su.init_table_state(params["item_emb"])
+    return {
+        "step": jnp.zeros((), jnp.int32),
+        "dense": optax.adam(p.learning_rate).init(_split_dense(params)),
+        "item": {"m": m, "v": v, "last": last},
+    }
+
+
+def _raw_sparse_step(params, opt_state, seqs, pos, neg, key, tx_lr,
+                     p: SASRecParams):
+    """One training step with sparse item-table updates: the three
+    gathers (sequence, positive, negative) enter the loss as explicit
+    [B, L, D] inputs, their gradients dedup + segment-sum into touched-
+    row gradients, and adam applies over the touched slices only —
+    scatter-applied into the donated table (docs/perf.md §17). The
+    padding row 0 receives exactly-zero summed gradients (every masked
+    position), so it stays zero like the dense path keeps it."""
+    from predictionio_tpu.ops import sparse_update as su
+
+    table = params["item_emb"]
+    d = table.shape[1]
+    e_seq = table[seqs]
+    e_pos = table[pos]
+    e_neg = table[neg]
+    dense = _split_dense(params)
+
+    def loss_fn(dense, e_seq, e_pos, e_neg):
+        h = forward({**dense, "item_emb": table}, seqs, p,
+                    dropout_key=key, x_emb=e_seq)
+        pos_logit = jnp.einsum("bld,bld->bl", h, e_pos)
+        neg_logit = jnp.einsum("bld,bld->bl", h, e_neg)
+        mask = (pos > 0).astype(jnp.float32)
+        loss = -(
+            jax.nn.log_sigmoid(pos_logit) + jax.nn.log_sigmoid(-neg_logit)
+        ) * mask
+        return loss.sum() / jnp.maximum(mask.sum(), 1.0)
+
+    loss, (g_dense, g_seq, g_pos, g_neg) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1, 2, 3))(dense, e_seq, e_pos, e_neg)
+    step_no = opt_state["step"] + 1
+    updates, dense_state = optax.adam(tx_lr).update(
+        g_dense, opt_state["dense"], dense)
+    dense_new = optax.apply_updates(dense, updates)
+    idx = jnp.concatenate(
+        [seqs.reshape(-1), pos.reshape(-1), neg.reshape(-1)])
+    grads = jnp.concatenate(
+        [g_seq.reshape(-1, d), g_pos.reshape(-1, d),
+         g_neg.reshape(-1, d)])
+    st = opt_state["item"]
+    table, m, v, last = su.sparse_table_update(
+        table, st["m"], st["v"], st["last"], idx, grads, step_no, tx_lr)
+    new_params = {**dense_new, "item_emb": table}
+    new_state = {"step": step_no, "dense": dense_state,
+                 "item": {"m": m, "v": v, "last": last}}
+    return new_params, new_state, loss
+
+
 @device_obs.profiled_program(
     "sasrec_epoch",
     bucket=lambda params, opt_state, seqs, *a, **kw: (
@@ -282,9 +378,8 @@ def _train_epoch(
         )
         neg = jnp.where(pb > 0, neg, 0)
         kstep = jax.random.fold_in(ekey, 2 + 2 * s)
-        return _raw_train_step(
-            params, opt_state, sb, pb, neg, kstep, tx_lr, p
-        )
+        step_fn = _raw_sparse_step if _use_sparse(p) else _raw_train_step
+        return step_fn(params, opt_state, sb, pb, neg, kstep, tx_lr, p)
 
     zero = jnp.zeros((), jnp.float32)
     return jax.lax.fori_loop(
@@ -362,6 +457,160 @@ def predict_top_k(params, seqs, k: int, p: SASRecParams, exclude_mask=None,
     return _predict_top_k_jit(params, seqs, k, p, exclude_mask)
 
 
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def seq_bucket_len(max_history: int, max_len: int) -> int:
+    """The pow2 sequence-length bucket for a serving tick whose longest
+    real history is ``max_history`` items: next power of two (floor 8),
+    capped at ``max_len`` (the top rung, pow2 or not) — the same ladder
+    shape as the serving batch buckets, so varying histories reuse a
+    handful of compiled programs. With the tail-aligned position table
+    (see :func:`forward`) a bucketed forward scores identically to the
+    max_len one."""
+    b = _pow2(max(max_history, 1))
+    return min(max(b, 8), max_len)
+
+
+def predict_flops(p: SASRecParams, n_rows: int, b: int, l: int) -> float:
+    """Model FLOPs of one serving tick: attention/FFN stack + the final
+    catalog score (the placement decision's accelerator-side payload)."""
+    d = p.embed_dim
+    fwd = 2.0 * b * l * d * (4 * d + 2 * p.ffn_dim) * p.num_blocks
+    fwd += 2.0 * b * l * l * d * p.num_blocks  # attention scores
+    return fwd + 2.0 * b * n_rows * d
+
+
+def serving_tick_on_device(p: SASRecParams, n_rows: int, n_queries: int,
+                           l: int) -> bool:
+    """Cheap pre-gate (the ALS twin): would a SASRec tick of this shape
+    route to the device? Decided WITHOUT the mask-upload term — a False
+    is final, a True still gets the exact decision (mask bytes included)
+    inside :func:`serve_sasrec_topk_batched`."""
+    from predictionio_tpu.parallel.placement import serving_device
+
+    bp = _pow2(max(n_queries, 1))
+    return serving_device(predict_flops(p, n_rows, bp, l), bp * l * 4,
+                          overlapped=True) is None
+
+
+def pin_sasrec_serving_state(params: dict, p: SASRecParams,
+                             max_batch: int = 64) -> int:
+    """Deploy-time HBM promotion of a SASRec model's parameter pytree
+    (``serving_models`` arena): every leaf goes device-resident through
+    the identity cache, so the first serving tick finds the transformer
+    + item table warm instead of paying the upload inline. Decided at a
+    representative full tick (``max_batch`` queries at ``max_len``);
+    returns the pinned byte count (0 = the placement decision keeps
+    serving on the host)."""
+    from predictionio_tpu.parallel.placement import (
+        device_cache_put,
+        serving_device,
+    )
+
+    leaves = jax.tree.leaves(params)
+    if not leaves or not isinstance(leaves[0], np.ndarray):
+        return 0
+    n_rows = int(params["item_emb"].shape[0])
+    bp = _pow2(max_batch)
+    place = serving_device(
+        predict_flops(p, n_rows, bp, p.max_len), bp * p.max_len * 4,
+        overlapped=True)
+    if place is not None:
+        return 0
+    jax.tree.map(lambda a: device_cache_put(a, device=place), params)
+    return int(sum(a.nbytes for a in leaves))
+
+
+#: Per-tick result buffers — registered so a failed dispatch/finalize is
+#: leak-checkable, like the ALS serving ticks (models/als._TICK_ARENA).
+_SASREC_TICK_ARENA = device_obs.arena("serving_ticks")
+
+
+def serve_sasrec_topk_batched(params: dict, seqs: np.ndarray, k: int,
+                              p: SASRecParams, exclude_mask=None):
+    """One FUSED device dispatch for a drained SASRec serving tick, or
+    None.
+
+    ``seqs`` [b, l] are the tick's left-padded histories (already on the
+    pow2 sequence-length bucket — :func:`seq_bucket_len`); the whole
+    transformer forward, the catalog score, the per-row exclusion mask
+    and the top-k run as ONE jitted program (the same
+    ``sasrec_predict``-profiled program the host route compiles) against
+    the HBM-pinned parameter pytree — the host ships only the int32
+    histories and the masks. Batch and k pad to pow2 so the
+    micro-batcher's varying drain sizes reuse a handful of compiled
+    programs.
+
+    Returns None when the tick belongs on the host (placement decision,
+    non-host-numpy params) — the caller falls back to the legacy
+    per-tick :func:`predict_top_k` route. Otherwise returns a zero-arg
+    ``finalize`` whose blocking readback the caller may defer: dispatch
+    AND async d2h copies are in flight when this returns, so calling
+    ``finalize()`` from the batcher's finalizer thread overlaps tick N's
+    readback with tick N+1's dispatch. ``finalize()`` returns
+    (scores [b, k], indices [b, k]) as host numpy."""
+    from predictionio_tpu.parallel.placement import (
+        device_cache_put,
+        serving_device,
+    )
+
+    leaves = jax.tree.leaves(params)
+    if not leaves or not isinstance(leaves[0], np.ndarray):
+        return None
+    seqs = np.asarray(seqs, np.int32)
+    b, l = seqs.shape
+    if b == 0:
+        return None
+    n_rows = int(params["item_emb"].shape[0])
+    k = min(k, n_rows - 1)
+    if k <= 0:
+        return None
+    if _resolve_attn(p, serving=True, l=l) == "ring":
+        return None  # the ring path places its own sequence shards
+    bp = _pow2(b)
+    upload = bp * l * 4
+    if exclude_mask is not None:
+        exclude_mask = np.asarray(exclude_mask, bool)
+        upload += bp * n_rows
+    place = serving_device(predict_flops(p, n_rows, bp, l), upload,
+                           overlapped=True)
+    if place is not None:
+        return None  # host route wins at this tick shape
+    if bp != b:
+        # padding rows repeat the last real history: always a valid
+        # forward, results sliced off at finalize
+        seqs = np.concatenate([seqs, np.repeat(seqs[-1:], bp - b, 0)])
+        if exclude_mask is not None:
+            exclude_mask = np.concatenate(
+                [exclude_mask, np.zeros((bp - b, n_rows), bool)])
+    kp = min(_pow2(k), n_rows - 1)
+    dev_params = jax.tree.map(
+        lambda a: device_cache_put(a, device=place), params)
+    from predictionio_tpu.resilience import faults
+
+    # the chaos suite's device-dispatch site (shared with the ALS route):
+    # an injected error here is the fused program failing to launch —
+    # exactly what the device-route breaker must absorb
+    seqs = faults.fault_point("serving.dispatch", seqs)
+    scores, idx = _predict_top_k_jit(dev_params, seqs, kp, p,
+                                     exclude_mask)
+    from predictionio_tpu.io import transfer
+
+    resolve = transfer.begin_readback((scores, idx), name="serving")
+    alloc = _SASREC_TICK_ARENA.register((scores, idx), label=f"b{bp}")
+
+    def finalize():
+        try:
+            s, i = resolve()
+        finally:
+            _SASREC_TICK_ARENA.free(alloc)
+        return s[:b, :k], i[:b, :k]
+
+    return finalize
+
+
 def dataclass_replace_epochs(p: SASRecParams) -> SASRecParams:
     """The fingerprint ignores num_epochs: extending an interrupted run
     to more epochs is a legitimate resume."""
@@ -393,7 +642,7 @@ class SASRec:
         if n == 0:
             raise ValueError("SASRec.train called with no sequences")
         params = init_params(n_items, p)
-        opt_state = optax.adam(p.learning_rate).init(params)
+        opt_state = init_opt_state(params, p)
         key = jax.random.PRNGKey(p.seed)
         start_epoch = 0
         fingerprint = ""
@@ -413,8 +662,12 @@ class SASRec:
                 logger.info("SASRec: resuming after epoch %d", last_epoch)
         bs = min(p.batch_size, n)
         steps_per_epoch = max(n // bs, 1)
-        seqs_d = jnp.asarray(seqs)  # dataset resident on device for the run
-        pos_d = jnp.asarray(pos)
+        # dataset resident on device for the run, streamed up through the
+        # ChunkStager (pack/upload of chunk k+1 overlaps chunk k's put)
+        from predictionio_tpu.io import transfer
+
+        seqs_d, pos_d = transfer.stage_training_arrays(
+            (seqs, pos), name="sasrec_inputs")
         loss = None
         # params + optimizer state under neural_params (the adam-traffic
         # figure, same as two_tower); the device-resident dataset — which

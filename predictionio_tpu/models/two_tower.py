@@ -63,6 +63,18 @@ class TwoTowerParams:
     #: +15% steps/s at the bench config (740 -> 852) with comparable
     #: loss. MLP weights keep full per-parameter moments either way.
     optimizer: str = "adam"
+    #: Sparse embedding-update path (docs/perf.md §17): dedup the batch's
+    #: row ids, segment-sum per-example embedding gradients into one
+    #: row-gradient per touched row, run the optimizer (adam OR
+    #: rowwise_adam, with the exact lazy-decay staleness correction) over
+    #: the touched-row slices only, and scatter-apply into the donated
+    #: [n, d] buffers — per-step optimizer HBM traffic scales with
+    #: O(batch) touched rows instead of O(n) table rows
+    #: (sparse_update_bytes_per_step vs adam_bytes_per_step). Applies on
+    #: data-parallel meshes; tensor-parallel (model-axis) runs keep the
+    #: dense update (column-sharded tables make row scatter a cross-
+    #: device exchange the dense path already amortizes).
+    sparse_update: bool = True
 
 
 #: auto mode: largest negatives count whose dense [B, B] logits are kept.
@@ -79,13 +91,17 @@ _AUTO_CHUNK = 2048
 _MIN_CHUNK = 64
 
 
+def mlp_n_params(p: TwoTowerParams) -> int:
+    """Parameters of both towers' MLP stacks (embedding tables excluded)."""
+    dims = [p.embed_dim, *p.hidden_dims, p.out_dim]
+    return 2 * sum((a + 1) * b for a, b in zip(dims, dims[1:]))
+
+
 def n_params(p: TwoTowerParams, n_users: int, n_items: int) -> int:
     """Parameter count shared by the MFU and HBM roofline models
     (moved here from bench.py so the live ``pio_device_mfu`` accounting
     and the bench figures read ONE model)."""
-    dims = [p.embed_dim, *p.hidden_dims, p.out_dim]
-    return (n_users + n_items) * p.embed_dim + 2 * sum(
-        (a + 1) * b for a, b in zip(dims, dims[1:]))
+    return (n_users + n_items) * p.embed_dim + mlp_n_params(p)
 
 
 def flops_per_step(p: TwoTowerParams, n_users: int, n_items: int,
@@ -93,22 +109,47 @@ def flops_per_step(p: TwoTowerParams, n_users: int, n_items: int,
     """Model FLOPs of one training step: both towers' MLPs (forward +
     dx/dW backward = 3x forward), the in-batch logits (forward + both
     operand grads = 3x; +1x recompute when the chunked CE is active),
-    and the dense adam update over every parameter (~10 ops/param — the
-    embedding tables dominate the count)."""
+    and the optimizer update (~10 ops/param) — over EVERY parameter on
+    the dense path, over the MLP + the batch's touched embedding rows on
+    the sparse path (docs/perf.md §17)."""
     dims = [p.embed_dim, *p.hidden_dims, p.out_dim]
     mlp = sum(2 * a * b for a, b in zip(dims, dims[1:]))  # per example
     towers = 2 * 3 * batch * mlp
     logit_passes = 4 if batch > _DENSE_LOGITS_MAX else 3
     logits = logit_passes * 2 * batch * batch * p.out_dim
-    return towers + logits + 10.0 * n_params(p, n_users, n_items)
+    if p.sparse_update:
+        opt_params = mlp_n_params(p) + 2.0 * batch * p.embed_dim
+    else:
+        opt_params = n_params(p, n_users, n_items)
+    return towers + logits + 10.0 * opt_params
 
 
 def adam_bytes_per_step(p: TwoTowerParams, n_users: int,
                         n_items: int) -> float:
-    """HBM bytes of the dense adam update: params + dense grads + two
+    """HBM bytes of the DENSE adam update: params + dense grads + two
     moment tensors, read and written (~7 array passes of 4 bytes/param).
-    The embedding tables make this the step's true roofline."""
+    The embedding tables made this the step's true roofline until the
+    sparse path (below) cut the traffic to O(batch) rows."""
     return 7.0 * 4.0 * n_params(p, n_users, n_items)
+
+
+def sparse_update_bytes_per_step(p: TwoTowerParams, n_users: int,
+                                 n_items: int, batch: int) -> float:
+    """HBM bytes of the SPARSE optimizer update: the MLP's dense adam
+    (7 passes of its tiny parameter count) plus O(touched) row traffic
+    per embedding table — param-row gather + scatter-add, m read/write,
+    v read/write, and the segment-summed gradient rows (~8 four-byte row
+    passes; rowwise_adam's [n, 1] v drops two of them). Scales with the
+    batch's touched rows (<= batch per table), NOT the [n, d] tables —
+    the analytic model bench.py reports as
+    ``two_tower_sparse_mb_per_step`` next to the dense
+    ``adam_bytes_per_step`` roofline it replaced. ``n_users``/``n_items``
+    only cap the touched-row count (a catalog smaller than the batch
+    cannot touch more rows than it has)."""
+    touched = min(batch, n_users) + min(batch, n_items)
+    row_passes = 6.0 if p.optimizer == "rowwise_adam" else 8.0
+    return (7.0 * 4.0 * mlp_n_params(p)
+            + row_passes * 4.0 * touched * p.embed_dim)
 
 
 def _resolve_chunk(p: TwoTowerParams, n_negatives: int) -> int | None:
@@ -193,15 +234,23 @@ def _init_tower(key, n_entities: int, p: TwoTowerParams) -> dict:
     return tower
 
 
-def _tower_forward(tower: dict, idx):
-    """Embed + MLP in bfloat16 (MXU), normalize output in f32."""
-    x = tower["embed"][idx].astype(jnp.bfloat16)
-    for i, layer in enumerate(tower["layers"]):
+def _mlp_stack(layers: list, x):
+    """The tower's MLP from pre-gathered embeddings: bfloat16 matmuls
+    (MXU), f32 normalize — shared by the dense path's gather+MLP forward
+    and the sparse path (which differentiates wrt the gathered rows so
+    the embedding gradient comes back as [batch, d], never [n, d])."""
+    x = x.astype(jnp.bfloat16)
+    for i, layer in enumerate(layers):
         x = x @ layer["w"].astype(jnp.bfloat16) + layer["b"].astype(jnp.bfloat16)
-        if i < len(tower["layers"]) - 1:
+        if i < len(layers) - 1:
             x = jax.nn.relu(x)
     x = x.astype(jnp.float32)
     return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-6)
+
+
+def _tower_forward(tower: dict, idx):
+    """Embed + MLP in bfloat16 (MXU), normalize output in f32."""
+    return _mlp_stack(tower["layers"], tower["embed"][idx])
 
 
 def init_params(n_users: int, n_items: int, p: TwoTowerParams) -> dict:
@@ -370,6 +419,96 @@ def make_train_step_gspmd(ctx: ComputeContext, p: TwoTowerParams, tx):
     return _make_step(loss_fn, tx)
 
 
+class _SparseTx:
+    """Optimizer-state builder for the sparse path — duck-types the
+    ``tx.init(params)`` surface :func:`train_two_tower` uses. The state
+    pytree: the global step, optax adam over the MLP subtree, and per
+    table the (m, v, last_step) buffers the touched-row updates scatter
+    into (``v`` is [n, 1] under rowwise_adam)."""
+
+    def __init__(self, p: TwoTowerParams, placement=None):
+        if p.optimizer not in ("adam", "rowwise_adam"):
+            raise ValueError(
+                f"unknown optimizer {p.optimizer!r}: expected 'adam' or "
+                "'rowwise_adam'")
+        self.p = p
+        self.rowwise = p.optimizer == "rowwise_adam"
+        self.mlp_tx = optax.adam(p.learning_rate)
+        self.placement = placement
+
+    @staticmethod
+    def mlp_of(params: dict) -> dict:
+        return {"user": params["user"]["layers"],
+                "item": params["item"]["layers"]}
+
+    def init(self, params: dict):
+        from predictionio_tpu.ops import sparse_update as su
+
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "mlp": self.mlp_tx.init(self.mlp_of(params))}
+        for side in ("user", "item"):
+            m, v, last = su.init_table_state(
+                params[side]["embed"], rowwise=self.rowwise)
+            state[side] = {"m": m, "v": v, "last": last}
+        if self.placement is not None:
+            # commit the fresh state: UNcommitted first-call operands
+            # would give the compiled program a different argument
+            # mapping than every later call (whose inputs are committed
+            # jit outputs) — one invisible extra XLA compile per trainer
+            # the retrace guard now pins away
+            state = jax.device_put(state, self.placement)
+        return state
+
+
+def make_sparse_train_step(ctx: ComputeContext, p: TwoTowerParams):
+    """The sparse embedding-update train step (docs/perf.md §17).
+
+    The loss is differentiated wrt the GATHERED embedding rows (explicit
+    [batch, d] inputs), so the embedding gradient never materializes as a
+    dense [n, d] scatter; the per-example rows are then deduped +
+    segment-summed and the optimizer runs over exactly the touched-row
+    slices (ops/sparse_update.sparse_table_update), scatter-applied into
+    the donated tables. The in-batch softmax is the GSPMD-form global
+    loss (every positive against the whole global batch — identical
+    objective to the shard_map form; XLA partitions it over the data
+    axis)."""
+    tx = _SparseTx(p, placement=ctx.replicated)
+
+    def loss_fn(mlp, e_u, e_i):
+        u = _mlp_stack(mlp["user"], e_u)  # [B, d]
+        v = _mlp_stack(mlp["item"], e_i)  # [B, d]
+        chunk = _resolve_chunk(p, v.shape[0])
+        if chunk is not None:
+            return _chunked_softmax_ce(u, v, v, p.temperature, chunk).mean()
+        logits = (u @ v.T) / p.temperature  # [B, B]
+        b = u.shape[0]
+        labels = jnp.arange(b)
+        return -jax.nn.log_softmax(logits, axis=-1)[labels, labels].mean()
+
+    def step(params, opt_state, u_idx, i_idx):
+        from predictionio_tpu.ops import sparse_update as su
+
+        e_u = params["user"]["embed"][u_idx]  # [B, d] gathers — the only
+        e_i = params["item"]["embed"][i_idx]  # table reads this step makes
+        mlp = tx.mlp_of(params)
+        loss, (g_mlp, g_eu, g_ei) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2))(mlp, e_u, e_i)
+        step_no = opt_state["step"] + 1
+        mlp_updates, mlp_state = tx.mlp_tx.update(g_mlp, opt_state["mlp"])
+        mlp_new = optax.apply_updates(mlp, mlp_updates)
+        new_params, new_state = {}, {"step": step_no, "mlp": mlp_state}
+        for side, idx, g in (("user", u_idx, g_eu), ("item", i_idx, g_ei)):
+            st = opt_state[side]
+            table, m, v, last = su.sparse_table_update(
+                params[side]["embed"], st["m"], st["v"], st["last"],
+                idx, g, step_no, p.learning_rate, rowwise=tx.rowwise)
+            new_params[side] = {"embed": table, "layers": mlp_new[side]}
+            new_state[side] = {"m": m, "v": v, "last": last}
+        return new_params, new_state, loss
+
+    return tx, step
+
+
 #: (mesh devices, model-axis size, compile-relevant params, batch) →
 #: (optax transform, fused whole-run jit, per-step jit). jax.jit caches per
 #: function object, so rebuilding the closures every train_two_tower call
@@ -392,12 +531,24 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
     if hit is not None:
         _TRAINER_CACHE[key] = hit  # LRU refresh: hot entries stay resident
         return hit
-    tx = _make_optimizer(p)
-    if ctx.model_axis_size > 1:
-        # dp×tp: params tensor-sharded over the model axis, GSPMD collectives
+    sparse = p.sparse_update and ctx.model_axis_size == 1
+    # the FLOPs model must describe the RESOLVED path: a tensor-parallel
+    # run keeps the dense optimizer even with sparse_update=True, and
+    # feeding the sparse-sized model to its MFU accounting would omit
+    # the dense-adam ops it actually executes
+    p_flops = dataclasses.replace(p, sparse_update=sparse)
+    if sparse:
+        # sparse embedding updates: optimizer traffic O(batch) rows
+        tx, raw_step = make_sparse_train_step(ctx, p)
+    elif ctx.model_axis_size > 1:
+        # dp×tp: params tensor-sharded over the model axis, GSPMD
+        # collectives; column-sharded tables keep the dense update
+        tx = _make_optimizer(p)
         _, raw_step = make_train_step_gspmd(ctx, p, tx)
     else:
-        # pure dp: explicit shard_map loss with ICI all_gather negatives
+        # dense fallback (sparse_update=False): explicit shard_map loss
+        # with ICI all_gather negatives
+        tx = _make_optimizer(p)
         _, raw_step = make_train_step(ctx, p, tx)
     bshard = ctx.batch_sharding()
 
@@ -443,10 +594,10 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
     trainer_bucket = (batch, ctx.model_axis_size,
                       repr(dataclasses.replace(p, steps=0, seed=0)))
     run = device_obs.profiled_program(
-        "two_tower_step",
+        "two_tower_sparse_step" if sparse else "two_tower_step",
         flops=lambda params, opt_state, u_all, i_all, key, steps,
         start=0: float(steps) * flops_per_step(
-            p, params["user"]["embed"].shape[0],
+            p_flops, params["user"]["embed"].shape[0],
             params["item"]["embed"].shape[0], batch),
         # operand shapes join the bucket: one cached trainer can serve
         # datasets of different sizes (embed tables, event count), and
@@ -521,13 +672,15 @@ def train_two_tower(
     # batches are sampled ON DEVICE (fold_in per step) from the resident
     # interaction arrays — the host batch sampler and per-step transfers
     # (an RTT each through a tunneled TPU) stay out of the loop, and the
-    # trajectory is identical with or without a progress callback
-    u_all = jax.device_put(
-        np.ascontiguousarray(user_idx.astype(np.int32)), ctx.replicated
-    )
-    i_all = jax.device_put(
-        np.ascontiguousarray(item_idx.astype(np.int32)), ctx.replicated
-    )
+    # trajectory is identical with or without a progress callback. The
+    # interaction arrays stream up through the ChunkStager (pack/upload
+    # of chunk k+1 overlaps chunk k's in-flight put — the ALS densify
+    # stream's contract, PIO_TRANSFER_* tunable)
+    from predictionio_tpu.io import transfer
+
+    u_all, i_all = transfer.stage_training_arrays(
+        (user_idx.astype(np.int32), item_idx.astype(np.int32)),
+        sharding=ctx.replicated, name="two_tower_inputs")
     key = jax.random.PRNGKey(p.seed)
     # params + optimizer state own HBM for the whole training run
     # (the 297 MB/step adam-traffic story of ROADMAP item 4 starts
@@ -624,3 +777,143 @@ def train_two_tower(
 def embed_users(model: TwoTowerModel, user_idx: np.ndarray) -> np.ndarray:
     """Precomputed lookup for known users (the serving path)."""
     return model.user_embeddings[np.atleast_1d(user_idx)]
+
+
+# ---------------------------------------------------------------------------
+# Neural fold-in: warm-start rows for entities first seen in a delta
+# ---------------------------------------------------------------------------
+
+
+def _pow2_floor8(n: int) -> int:
+    n = max(int(n), 8)
+    return 1 << (n - 1).bit_length()
+
+
+@partial(jax.jit, static_argnames=("p", "old_nu", "old_ni", "steps"))
+def _foldin_refresh(params, u_idx, i_idx, *, p: TwoTowerParams,
+                    old_nu: int, old_ni: int, steps: int):
+    """A few sparse-update steps over the delta interactions, applied
+    ONLY to the appended rows (``update_rows_from`` redirects existing-
+    row scatters to the drop id) — parent rows AND the MLP stay
+    byte-identical, which is the fold-in parity contract
+    (tests/test_foldin.py)."""
+    from predictionio_tpu.ops import sparse_update as su
+
+    rowwise = p.optimizer == "rowwise_adam"
+
+    def loss_fn(e_u, e_i, mlp):
+        u = _mlp_stack(mlp["user"], e_u)
+        v = _mlp_stack(mlp["item"], e_i)
+        logits = (u @ v.T) / p.temperature
+        b = u.shape[0]
+        labels = jnp.arange(b)
+        return -jax.nn.log_softmax(logits, axis=-1)[labels, labels].mean()
+
+    mlp = _SparseTx.mlp_of(params)
+
+    def body(s, carry):
+        tu, ti = carry
+        table_u, mu, vu, lu = tu
+        table_i, mi, vi, li = ti
+        e_u = table_u[u_idx]
+        e_i = table_i[i_idx]
+        g_eu, g_ei = jax.grad(loss_fn, argnums=(0, 1))(e_u, e_i, mlp)
+        step_no = s + 1
+        tu = su.sparse_table_update(
+            table_u, mu, vu, lu, u_idx, g_eu, step_no, p.learning_rate,
+            rowwise=rowwise, update_rows_from=old_nu)
+        ti = su.sparse_table_update(
+            table_i, mi, vi, li, i_idx, g_ei, step_no, p.learning_rate,
+            rowwise=rowwise, update_rows_from=old_ni)
+        return tu, ti
+
+    state = tuple(
+        (params[side]["embed"],
+         *su.init_table_state(params[side]["embed"], rowwise=rowwise))
+        for side in ("user", "item"))
+    (tu, ti) = jax.lax.fori_loop(0, steps, body, state)
+    return tu[0], ti[0]
+
+
+def fold_in_two_tower(model: TwoTowerModel, delta_u: np.ndarray,
+                      delta_i: np.ndarray, n_users: int, n_items: int,
+                      refresh_steps: int = 3) -> TwoTowerModel:
+    """Fold new entities into a trained two-tower model (ROADMAP item 2's
+    neural analog of the ALS fold-in).
+
+    ``delta_u``/``delta_i`` are the delta interactions encoded against
+    the EXTENDED id space (new entities at indices past the parent table
+    sizes); ``n_users``/``n_items`` are the extended counts. New rows
+    warm-start as the mean of their delta counterparts' trained input
+    embeddings (mean-of-neighbors — a new user lands where the items it
+    touched live), then ``refresh_steps`` sparse-update steps over the
+    delta refine ONLY the appended rows. Parent embedding rows, the MLP,
+    and the parent slices of both serving corpora come back
+    byte-identical; the new entities' corpus rows are computed with the
+    parent towers."""
+    p = model.hyper
+    params = model.params
+    old_nu = int(params["user"]["embed"].shape[0])
+    old_ni = int(params["item"]["embed"].shape[0])
+    delta_u = np.asarray(delta_u, np.int32)
+    delta_i = np.asarray(delta_i, np.int32)
+
+    def extend(table: np.ndarray, n_new: int, new_lo: int, own_idx,
+               other_idx, other_table: np.ndarray) -> np.ndarray:
+        """Append ``n_new`` rows: each initialized to the mean of its
+        delta counterparts' EXISTING trained rows (zeros when every
+        counterpart is itself new — the refresh steps then train it from
+        its interactions alone)."""
+        if n_new <= 0:
+            return table
+        rows = np.zeros((n_new, table.shape[1]), table.dtype)
+        counts = np.zeros(n_new)
+        sel = (own_idx >= new_lo) & (other_idx < other_table.shape[0])
+        np.add.at(rows, own_idx[sel] - new_lo, other_table[other_idx[sel]])
+        np.add.at(counts, own_idx[sel] - new_lo, 1.0)
+        rows /= np.maximum(counts, 1.0)[:, None]
+        return np.vstack([table, rows.astype(table.dtype)])
+
+    uf = np.asarray(params["user"]["embed"], np.float32)
+    itf = np.asarray(params["item"]["embed"], np.float32)
+    new_params = {
+        "user": {"embed": extend(uf, n_users - old_nu, old_nu, delta_u,
+                                 delta_i, itf),
+                 "layers": params["user"]["layers"]},
+        "item": {"embed": extend(itf, n_items - old_ni, old_ni, delta_i,
+                                 delta_u, uf),
+                 "layers": params["item"]["layers"]},
+    }
+    if refresh_steps > 0 and len(delta_u) \
+            and (n_users > old_nu or n_items > old_ni):
+        # refresh only when the delta actually minted entities: with no
+        # new rows every update would redirect to the drop id and the
+        # device program would be guaranteed-byte-identical busywork
+        # pad the delta batch onto the pow2 ladder (repeating the last
+        # pair — updates apply only to new rows, so duplicates merely
+        # reweight the warm-start refinement) to bound compile count
+        bp = _pow2_floor8(len(delta_u))
+        du = np.concatenate(
+            [delta_u, np.full(bp - len(delta_u), delta_u[-1], np.int32)])
+        di = np.concatenate(
+            [delta_i, np.full(bp - len(delta_i), delta_i[-1], np.int32)])
+        emb_u, emb_i = _foldin_refresh(
+            new_params, du, di, p=dataclasses.replace(p, steps=0, seed=0),
+            old_nu=old_nu, old_ni=old_ni, steps=refresh_steps)
+        new_params["user"]["embed"] = np.asarray(emb_u)
+        new_params["item"]["embed"] = np.asarray(emb_i)
+    # serving corpora: parent slices byte-identical, new rows through the
+    # parent towers
+    forward = jax.jit(_tower_forward, static_argnames=())
+    item_emb = model.item_embeddings
+    user_emb = model.user_embeddings
+    if n_items > old_ni:
+        new_rows = np.asarray(forward(
+            new_params["item"], jnp.arange(old_ni, n_items)))
+        item_emb = np.vstack([item_emb, new_rows.astype(item_emb.dtype)])
+    if n_users > old_nu:
+        new_rows = np.asarray(forward(
+            new_params["user"], jnp.arange(old_nu, n_users)))
+        user_emb = np.vstack([user_emb, new_rows.astype(user_emb.dtype)])
+    host = jax.tree.map(np.asarray, new_params)
+    return TwoTowerModel(host, p, item_emb, user_emb)

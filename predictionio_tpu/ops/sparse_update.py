@@ -1,0 +1,178 @@
+"""Sparse embedding-update kernels: dedup → segment-reduce → scatter-apply.
+
+The neural trainers' hot-path fix (ROADMAP item 3): a two-tower / SASRec
+step touches only O(batch) embedding rows, but the dense optimizer update
+streamed the full ``[n, d]`` tables (params + grads + two moment tensors
+— ~297 MB of Adam traffic per step at the ML-20M shape, bench r05's
+``two_tower_adam_mb_per_step``). Tensor Casting and TurboGR (PAPERS.md)
+both identify sparse embedding-gradient handling as the dominant lever;
+this module is the reusable core of that path:
+
+:func:`dedup_rows`
+    ``jnp.unique`` with a static slot count: the batch's row ids collapse
+    to one slot per distinct row, padded with the out-of-range id ``n``
+    (gathers clamp it harmlessly; scatters in ``mode='drop'`` ignore it),
+    plus the inverse map from examples to slots.
+
+:func:`segment_rows`
+    Per-example embedding gradients ``[b, d]`` segment-summed into one
+    row-gradient per touched slot — the dedup that turns ``b`` scattered
+    adds into ``<= b`` dense row updates.
+
+:func:`sparse_adam_rows` / :func:`sparse_rowwise_adam_rows`
+    The Adam recurrence over the *touched rows only*, with the standard
+    lazy-decay staleness correction: a row last updated at step ``t0``
+    and touched again at step ``t`` carries ``k = t - t0`` skipped steps,
+    and (its gradient being exactly zero in between)
+
+        m_t = b1^k * m_{t0} + (1 - b1) * g_t
+        v_t = b2^k * v_{t0} + (1 - b2) * g_t^2
+
+    reproduce the dense recurrence's moments at every touch step exactly
+    — the decayed second moment stays exact, which is what keeps the
+    adaptive scale honest for rarely-touched rows. (The dense update's
+    pure-momentum tail on untouched rows is skipped — the standard
+    sparse-Adam semantics; loss parity within tolerance is pinned in
+    tests/test_two_tower.py.) Bias correction uses the global step, so a
+    row touched every step matches dense Adam bit-for-bit in structure.
+
+:func:`scatter_apply`
+    ``table.at[rows].add(delta, mode='drop')`` — the one write the
+    update makes against the donated ``[n, d]`` buffer: O(touched · d)
+    HBM traffic instead of O(n · d).
+
+Everything here is plain jnp — XLA lowers unique/segment_sum/scatter to
+efficient TPU sort/segmented-reduce programs, and the same code runs the
+CPU test mesh; no pallas kernel is warranted at these shapes (the
+per-step payload is a few thousand rows x 64 floats, far below the tile
+scales where a hand kernel wins).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "dedup_rows",
+    "segment_rows",
+    "sparse_adam_rows",
+    "sparse_rowwise_adam_rows",
+    "scatter_apply",
+    "scatter_set",
+]
+
+
+def dedup_rows(idx, n_rows: int, size: int):
+    """(unique row ids padded with ``n_rows``, inverse example→slot map).
+
+    ``size`` is the static slot count (the batch size: every example
+    distinct is the worst case). Padding slots carry the out-of-range id
+    ``n_rows`` so downstream scatters in ``mode='drop'`` ignore them."""
+    return jnp.unique(
+        idx, size=size, fill_value=n_rows, return_inverse=True)
+
+
+def segment_rows(grads, inv, size: int):
+    """Row-gradients ``[size, ...]``: per-example gradients summed into
+    their dedup slot (padding slots receive exact zeros — no example
+    maps to them)."""
+    return jax.ops.segment_sum(grads, inv.reshape(-1), num_segments=size)
+
+
+def _gather_rows(table, rows):
+    """Touched-row slices with zero fill for the padding id (reading a
+    real row there would be harmless — its update is dropped — but zero
+    fill keeps the padded lanes finite for any dtype)."""
+    return table.at[rows].get(mode="fill", fill_value=0)
+
+
+def sparse_adam_rows(rows_g, m_rows, v_rows, stale, step,
+                     lr, b1=0.9, b2=0.999, eps=1e-8):
+    """One Adam update over touched-row slices.
+
+    ``stale`` [m] = steps since each row's last update (>= 1); ``step``
+    is the global step count AFTER this update. Returns
+    ``(delta, m_new, v_new)`` — the caller scatter-applies all three."""
+    k = stale.astype(jnp.float32)
+    m_new = (b1 ** k)[:, None] * m_rows + (1.0 - b1) * rows_g
+    v_new = (b2 ** k)[:, None] * v_rows + (1.0 - b2) * rows_g * rows_g
+    t = step.astype(jnp.float32)
+    bc1 = 1.0 - b1 ** t
+    bc2 = 1.0 - b2 ** t
+    delta = -lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    return delta, m_new, v_new
+
+
+def sparse_rowwise_adam_rows(rows_g, m_rows, v_rows, stale, step,
+                             lr, b1=0.9, b2=0.999, eps=1e-8):
+    """Rowwise-Adam over touched rows: ``v`` is one scalar per row (the
+    row-mean squared gradient — models/two_tower.rowwise_adam's state),
+    lazily decayed by the same staleness correction."""
+    k = stale.astype(jnp.float32)
+    m_new = (b1 ** k)[:, None] * m_rows + (1.0 - b1) * rows_g
+    v_new = (b2 ** k)[:, None] * v_rows + (1.0 - b2) * jnp.mean(
+        rows_g * rows_g, axis=1, keepdims=True)
+    t = step.astype(jnp.float32)
+    bc1 = 1.0 - b1 ** t
+    bc2 = 1.0 - b2 ** t
+    delta = -lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    return delta, m_new, v_new
+
+
+def scatter_apply(table, rows, delta):
+    """``table[rows] += delta`` with out-of-range (padding) rows dropped
+    — the update's single O(touched · d) write."""
+    return table.at[rows].add(delta, mode="drop")
+
+
+def scatter_set(table, rows, values):
+    """``table[rows] = values`` with padding rows dropped (moment/
+    staleness buffers)."""
+    return table.at[rows].set(values, mode="drop")
+
+
+def sparse_table_update(table, m, v, last_step, idx, grads, step, lr,
+                        *, rowwise: bool = False,
+                        b1: float = 0.9, b2: float = 0.999,
+                        eps: float = 1e-8, update_rows_from: int = 0):
+    """The full dedup → segment-sum → touched-row Adam → scatter-apply
+    pipeline for ONE embedding table.
+
+    ``table`` [n, d], ``m`` [n, d], ``v`` [n, d] (or [n, 1] rowwise),
+    ``last_step`` [n] int32 (step of each row's last update, 0 = never),
+    ``idx`` [b] row ids, ``grads`` [b, d] per-example gradients,
+    ``step`` the global step AFTER this update (int32 scalar).
+
+    ``update_rows_from``: rows below this index are read but never
+    written (their updates redirect to the drop id) — the neural
+    fold-in's freeze-existing-rows mode. Returns the four updated
+    buffers; per-step HBM traffic is O(touched · d), not O(n · d)."""
+    n = table.shape[0]
+    size = int(idx.shape[0])
+    uniq, inv = dedup_rows(idx, n, size)
+    rows_g = segment_rows(grads, inv, size)
+    rows_m = _gather_rows(m, uniq)
+    rows_v = _gather_rows(v, uniq)
+    rows_last = _gather_rows(last_step, uniq)
+    stale = jnp.maximum(step - rows_last, 1)
+    fn = sparse_rowwise_adam_rows if rowwise else sparse_adam_rows
+    delta, m_new, v_new = fn(rows_g, rows_m, rows_v, stale, step, lr,
+                             b1, b2, eps)
+    if update_rows_from:
+        uniq = jnp.where(uniq >= update_rows_from, uniq, n)
+    table = scatter_apply(table, uniq, delta)
+    m = scatter_set(m, uniq, m_new)
+    v = scatter_set(v, uniq, v_new)
+    last_step = scatter_set(last_step, uniq,
+                            jnp.full_like(rows_last, step))
+    return table, m, v, last_step
+
+
+def init_table_state(table, rowwise: bool = False):
+    """Fresh (m, v, last_step) buffers for one embedding table."""
+    m = jnp.zeros_like(table)
+    v = (jnp.zeros((table.shape[0], 1), table.dtype) if rowwise
+         else jnp.zeros_like(table))
+    last = jnp.zeros((table.shape[0],), jnp.int32)
+    return m, v, last

@@ -545,15 +545,13 @@ class ALSAlgorithm(PAlgorithm):
 
     @staticmethod
     def _extended_ids(ids: BiMap, delta) -> BiMap:
-        """The id map grown by the delta's unseen entities — existing
-        indices preserved (the fold-in contract: an untouched row keeps
-        its position, so the parent's factor row copies over
-        byte-identical)."""
-        fwd = dict(ids.to_dict())
-        for key in delta:
-            if key not in fwd:
-                fwd[key] = len(fwd)
-        return BiMap(fwd)
+        """First-appearance-order extension — the ONE shared rule
+        (train/foldin.extended_ids) the continuous trainer's encoded
+        snapshot mirrors, which is what makes its O(delta) maps
+        verifiably extend this model's."""
+        from predictionio_tpu.train.foldin import extended_ids
+
+        return extended_ids(ids, delta)
 
     def fold_in_ready(self, model: ALSModel, data) -> bool:
         """Cheap pre-check: a delta touching more than
@@ -595,15 +593,28 @@ class ALSAlgorithm(PAlgorithm):
         from predictionio_tpu.train import foldin as foldin_mod
 
         p = self._als_params(self.params)
-        user_ids = self._extended_ids(model.user_ids, data.delta_users)
-        item_ids = self._extended_ids(model.item_ids, data.delta_items)
+        if data.encoded() \
+                and foldin_mod.maps_extend(model.user_ids, data.user_ids) \
+                and foldin_mod.maps_extend(model.item_ids, data.item_ids):
+            # O(delta) path: the trainer's persistent encoded snapshot
+            # verifiably extends this model's maps — no re-encode of the
+            # full history (the map check is O(entities), constant per
+            # cycle regardless of event count)
+            user_ids, item_ids = data.user_ids, data.item_ids
+            ui = np.asarray(data.uidx, np.int32)
+            ii = np.asarray(data.iidx, np.int32)
+            touched_u = np.unique(ui[data.delta_start:]).astype(np.int32)
+            touched_i = np.unique(ii[data.delta_start:]).astype(np.int32)
+        else:
+            user_ids = self._extended_ids(model.user_ids, data.delta_users)
+            item_ids = self._extended_ids(model.item_ids, data.delta_items)
+            touched_u = np.unique(
+                user_ids.encode(data.delta_users)).astype(np.int32)
+            touched_i = np.unique(
+                item_ids.encode(data.delta_items)).astype(np.int32)
+            ui = user_ids.encode(data.users).astype(np.int32)
+            ii = item_ids.encode(data.items).astype(np.int32)
         n_users, n_items = len(user_ids), len(item_ids)
-        touched_u = np.unique(
-            user_ids.encode(data.delta_users)).astype(np.int32)
-        touched_i = np.unique(
-            item_ids.encode(data.delta_items)).astype(np.int32)
-        ui = user_ids.encode(data.users).astype(np.int32)
-        ii = item_ids.encode(data.items).astype(np.int32)
         rr = np.asarray(data.ratings, np.float32)
         uf = np.asarray(model.factors.user_features, np.float32)
         uf = np.vstack([uf, np.zeros(

@@ -128,6 +128,8 @@ class AlgorithmParams(Params):
     # serving attention path: auto | mha | flash (pallas kernel) | ring
     # (sequence-parallel over the mesh; histories beyond one device)
     attn_impl: str = "auto"
+    # sparse item-table updates (models/sasrec.SASRecParams.sparse_update)
+    sparse_update: bool = True
     # mid-training checkpointing (utils.checkpoint.TrainCheckpointer):
     # empty = off; a crashed/killed train resumes from the newest epoch
     # checkpoint in this directory instead of restarting from zero
@@ -160,6 +162,7 @@ class SASRecAlgorithm(P2LAlgorithm):
             ffn_dim=a.ffn_dim, dropout=a.dropout,
             learning_rate=a.learning_rate, batch_size=a.batch_size,
             num_epochs=a.num_epochs, seed=a.seed, attn_impl=a.attn_impl,
+            sparse_update=a.sparse_update,
         )
 
     def train(self, ctx: ComputeContext, pd: PreparedData) -> SASRecModel:
@@ -187,10 +190,13 @@ class SASRecAlgorithm(P2LAlgorithm):
     def predict(self, model: SASRecModel, query: Query) -> PredictedResult:
         return self.batch_predict(model, [(0, query)])[0][1]
 
-    def batch_predict(self, model: SASRecModel, queries):
-        """Micro-batched serving: padded histories and per-user seen
-        masks stack into ONE transformer forward + catalog score for the
-        drained batch."""
+    def _prep_batch(self, model: SASRecModel, queries):
+        """Shared tick prep for the host AND device routes: cold-start
+        answers for history-less users, bucket-padded histories for the
+        rest (pow2 sequence-length ladder — models/sasrec.seq_bucket_len;
+        the tail-aligned position table makes the bucketed forward score
+        identically to a max_len pad), per-user seen masks, and k.
+        Returns (cold_results, rows, padded, exclude, k)."""
         hp = model.hp
         n_rows = model.params["item_emb"].shape[0]
         out = []
@@ -208,35 +214,110 @@ class SASRecAlgorithm(P2LAlgorithm):
                 )
                 continue
             rows.append((i, q, seq))
-        if rows:
-            padded = np.zeros((len(rows), hp.max_len), dtype=np.int32)
+        if not rows:
+            return out, rows, None, None, 0
+        from predictionio_tpu.models.sasrec import seq_bucket_len
+
+        longest = max(min(len(seq), hp.max_len) for _, _, seq in rows)
+        l = seq_bucket_len(longest, hp.max_len)
+        padded = np.zeros((len(rows), l), dtype=np.int32)
+        for r, (_i, _q, seq) in enumerate(rows):
+            tail = seq[-l:]
+            padded[r, -len(tail):] = tail
+        exclude = None
+        if model.exclude_seen:  # full history, not the model window
+            exclude = np.zeros((len(rows), n_rows), dtype=bool)
             for r, (_i, _q, seq) in enumerate(rows):
-                tail = seq[-hp.max_len:]
-                padded[r, -len(tail):] = tail
-            exclude = None
-            if model.exclude_seen:  # full history, not the model window
-                exclude = np.zeros((len(rows), n_rows), dtype=bool)
-                for r, (_i, _q, seq) in enumerate(rows):
-                    exclude[r, np.asarray(seq, dtype=np.int64)] = True
-            k = max(q.num for _, q, _ in rows)
-            scores, idx = predict_top_k(
-                model.params, padded, k, hp, exclude_mask=exclude
-            )
-            scores = np.asarray(scores)
-            idx = np.asarray(idx)
-            for r, (i, q, _seq) in enumerate(rows):
-                items = []
-                for s, j in zip(scores[r][: q.num], idx[r][: q.num]):
-                    if not np.isfinite(s) or j == 0:
-                        continue
-                    items.append(
-                        ItemScore(
-                            item=model.item_ids.inverse(int(j)),
-                            score=float(s),
-                        )
+                exclude[r, np.asarray(seq, dtype=np.int64)] = True
+        k = max(q.num for _, q, _ in rows)
+        return out, rows, padded, exclude, k
+
+    @staticmethod
+    def _assemble(model: SASRecModel, out, rows, scores, idx):
+        scores = np.asarray(scores)
+        idx = np.asarray(idx)
+        res = list(out)
+        for r, (i, q, _seq) in enumerate(rows):
+            items = []
+            for s, j in zip(scores[r][: q.num], idx[r][: q.num]):
+                if not np.isfinite(s) or j == 0:
+                    continue
+                items.append(
+                    ItemScore(
+                        item=model.item_ids.inverse(int(j)),
+                        score=float(s),
                     )
-                out.append((i, PredictedResult(tuple(items))))
+                )
+            res.append((i, PredictedResult(tuple(items))))
+        return res
+
+    def batch_predict(self, model: SASRecModel, queries):
+        """Micro-batched serving: padded histories and per-user seen
+        masks stack into ONE transformer forward + catalog score for the
+        drained batch."""
+        out, rows, padded, exclude, k = self._prep_batch(model, queries)
+        if rows:
+            scores, idx = predict_top_k(
+                model.params, padded, k, model.hp, exclude_mask=exclude
+            )
+            out = self._assemble(model, out, rows, scores, idx)
         return out
+
+    # -- device-resident serving protocol (ROADMAP item 3) -------------------
+
+    def pin_serving_state(self, model: SASRecModel,
+                          max_batch: int = 64) -> int:
+        """Deploy-time HBM promotion: pin the whole SASRec parameter
+        pytree (transformer blocks + item table) device-resident
+        (``serving_models`` arena) so the first serving tick finds it
+        warm. Returns the pinned byte count (0 = host placement)."""
+        from predictionio_tpu.models.sasrec import pin_sasrec_serving_state
+
+        return pin_sasrec_serving_state(model.params, model.hp,
+                                        max_batch=max_batch)
+
+    def batch_predict_deferred(self, model: SASRecModel, queries):
+        """Device-resident serving tick: the padded-history transformer
+        forward, catalog score, seen-item exclusion mask and top-k for
+        the whole drained batch run as ONE fused device program against
+        the HBM-pinned parameters, with the blocking readback deferred
+        to the server's finalizer thread (overlapped with the next
+        tick's dispatch). Returns None whenever the fused route does not
+        apply — host placement, no known users — and the server falls
+        back to :meth:`batch_predict`; resolved results are exactly the
+        host route's (parity pinned in tests/test_sasrec_serving.py)."""
+        from predictionio_tpu.models.sasrec import (
+            seq_bucket_len,
+            serve_sasrec_topk_batched,
+            serving_tick_on_device,
+        )
+
+        hp = model.hp
+        n_rows = model.params["item_emb"].shape[0]
+        with_hist = [q for _, q in queries
+                     if model.user_sequences.get(q.user)]
+        if not with_hist:
+            return None  # nothing to dispatch: the legacy path is free
+        # pre-gate BEFORE the per-query host prep (mask builds): a
+        # host-routed tick must not pay them twice
+        longest = max(
+            min(len(model.user_sequences[q.user]), hp.max_len)
+            for q in with_hist)
+        if not serving_tick_on_device(
+                hp, n_rows, len(with_hist),
+                seq_bucket_len(longest, hp.max_len)):
+            return None
+        out, rows, padded, exclude, k = self._prep_batch(model, queries)
+        finalize = serve_sasrec_topk_batched(
+            model.params, padded, k, hp, exclude_mask=exclude)
+        if finalize is None:
+            return None
+
+        def resolve():
+            scores, idx = finalize()
+            return self._assemble(model, out, rows, scores, idx)
+
+        return resolve
 
 
 def engine_factory() -> Engine:

@@ -24,6 +24,7 @@ from predictionio_tpu.models.two_tower import (
     TwoTowerModel,
     TwoTowerParams,
     embed_users,
+    fold_in_two_tower,
     train_two_tower,
 )
 from predictionio_tpu.parallel.mesh import ComputeContext
@@ -76,6 +77,21 @@ class DataSource(PDataSource):
         )
         return TrainingData(users, items)
 
+    def delta_source(self):
+        """Continuous-training protocol (train/continuous.py): the same
+        event names the training scan reads; interactions are implicit
+        (no rating property), so every delta row carries weight 1.0 —
+        exactly what ``interaction_arrays(rating_property=None)``
+        produces."""
+        from predictionio_tpu.train.continuous import DeltaSpec
+
+        return DeltaSpec(
+            app_name=self.params.app_name,
+            event_names=tuple(self.params.event_names),
+            rating_property=None,
+            default_rating=1.0,
+        )
+
 
 @dataclass
 class PreparedData:
@@ -111,6 +127,9 @@ class AlgorithmParams(Params):
     # "adam" | "rowwise_adam" (per-row second moment on the embedding
     # tables: ~15% faster steps at near-Adam quality — models/two_tower)
     optimizer: str = "adam"
+    # sparse embedding updates: optimizer traffic O(batch) touched rows
+    # instead of the full [n, d] tables (models/two_tower, perf.md §17)
+    sparse_update: bool = True
 
 
 @dataclass
@@ -145,6 +164,7 @@ class TwoTowerAlgorithm(P2LAlgorithm):
                 temperature=p.temperature,
                 seed=p.seed,
                 optimizer=p.optimizer,
+                sparse_update=p.sparse_update,
             ),
         )
         return RetrievalModel(tt, pd.user_ids, pd.item_ids)
@@ -178,6 +198,114 @@ class TwoTowerAlgorithm(P2LAlgorithm):
                     )))
                 )
         return out
+
+    # -- device-resident serving protocol (ROADMAP item 3) -------------------
+
+    def pin_serving_state(self, model: RetrievalModel,
+                          max_batch: int = 64) -> int:
+        """Deploy-time HBM promotion: the precomputed user-query and
+        item-corpus embedding matrices pin device-resident
+        (``serving_models`` arena) — the two-tower serving tick is then
+        exactly the ALS fused tick shape (gather→MIPS→mask→top-k over
+        pinned catalogs). Returns pinned bytes (0 = host placement)."""
+        from predictionio_tpu.models.als import pin_serving_factors
+
+        return pin_serving_factors(
+            model.tt.user_embeddings, model.tt.item_embeddings,
+            max_batch=max_batch)
+
+    def batch_predict_deferred(self, model: RetrievalModel, queries):
+        """Device-resident serving tick for the item tower: the user-row
+        gather, MIPS against the pinned corpus and top-k run as ONE
+        fused device program (models/als.serve_top_k_batched — the
+        precomputed towers make the two-tower tick ALS-shaped), with the
+        blocking readback deferred to the server's finalizer thread.
+        Returns None when the fused route does not apply (host
+        placement, no known users) — the server falls back to
+        :meth:`batch_predict`; resolved results are exactly the host
+        route's (parity pinned in tests/test_two_tower.py)."""
+        from predictionio_tpu.models.als import (
+            serve_top_k_batched,
+            serving_tick_on_device,
+        )
+
+        known = [(i, q) for i, q in queries if q.user in model.user_ids]
+        if not known:
+            return None
+        n_items = len(model.item_ids)
+        if not serving_tick_on_device(
+                len(known), n_items, model.tt.item_embeddings.shape[1]):
+            return None
+        uidx = np.array([model.user_ids(q.user) for _, q in known],
+                        np.int32)
+        k = min(max(q.num for _, q in known), n_items)
+        finalize = serve_top_k_batched(
+            model.tt.user_embeddings, model.tt.item_embeddings, uidx, k)
+        if finalize is None:
+            return None
+        out = [(i, PredictedResult(())) for i, q in queries
+               if q.user not in model.user_ids]
+
+        def resolve():
+            scores, idx = finalize()
+            res = list(out)
+            for row, (i, q) in enumerate(known):
+                res.append(
+                    (i, PredictedResult(topk_to_item_scores(
+                        scores[row], idx[row], model.item_ids, q.num,
+                        ItemScore,
+                    )))
+                )
+            return res
+
+        return resolve
+
+    # -- continuous-training fold-in (ROADMAP item 2, neural analog) ---------
+
+    @staticmethod
+    def _extended_ids(ids: BiMap, delta) -> BiMap:
+        """First-appearance-order extension — the ONE shared rule
+        (train/foldin.extended_ids) the trainer's encoded snapshot
+        mirrors."""
+        from predictionio_tpu.train.foldin import extended_ids
+
+        return extended_ids(ids, delta)
+
+    def fold_in_ready(self, model: RetrievalModel, data) -> bool:
+        """Cheap pre-check: a delta minting more than
+        ``PIO_FOLDIN_MAX_FRACTION`` new entities of either catalog is
+        not "incremental" — the exact full retrain wins."""
+        from predictionio_tpu.train import foldin as foldin_mod
+
+        delta_users = set(data.delta_users)
+        delta_items = set(data.delta_items)
+        if not delta_users:
+            return False
+        new_u = sum(1 for u in delta_users if u not in model.user_ids)
+        new_i = sum(1 for i in delta_items if i not in model.item_ids)
+        frac = foldin_mod.max_fraction()
+        if new_u > frac * (len(model.user_ids) + new_u) \
+                or new_i > frac * (len(model.item_ids) + new_i):
+            return False
+        return True
+
+    def fold_in(self, ctx: ComputeContext, model: RetrievalModel,
+                data) -> RetrievalModel:
+        """One neural fold-in generation: extend the id maps with the
+        delta's unseen entities, warm-start their embedding rows
+        (mean-of-neighbors init + a few sparse-update steps over the
+        delta — models/two_tower.fold_in_two_tower) and recompute ONLY
+        the new entities' serving-corpus rows. Existing embedding rows,
+        the MLP, and existing corpus rows are byte-identical to the
+        parent's (pinned in tests/test_foldin.py) — so
+        ``fold_in_ready()`` stops being ALS-only."""
+        user_ids = self._extended_ids(model.user_ids, data.delta_users)
+        item_ids = self._extended_ids(model.item_ids, data.delta_items)
+        delta_u = user_ids.encode(data.delta_users).astype(np.int32)
+        delta_i = item_ids.encode(data.delta_items).astype(np.int32)
+        tt = fold_in_two_tower(
+            model.tt, delta_u, delta_i, len(user_ids), len(item_ids))
+        return RetrievalModel(tt, user_ids, item_ids)
 
 
 class Serving(FirstServing):

@@ -55,6 +55,7 @@ _SKIP_KEYS = {
     "two_tower_batch", "two_tower_fixed_steps", "ingest_conns",
     "ingest_host_cpus", "scan_events", "scan_partitions",
     "band_violations", "dense_cache_hit", "peak_bf16_tflops",
+    "sasrec_batch", "sasrec_max_len", "sasrec_serve_placement",
 }
 
 _LOWER_BETTER_RE = re.compile(

@@ -106,6 +106,115 @@ _QUARANTINED = REGISTRY.counter(
     "Fold-in candidates refused by the reload shadow gate (409) and "
     "held for retry after the next delta",
 )
+_ENCODED_ROWS = REGISTRY.histogram(
+    "pio_foldin_encoded_rows",
+    "Interaction rows string->int encoded per continuous-training "
+    "cycle — the O(delta) snapshot contract: equals the delta size, "
+    "never the full history",
+    buckets=DEFAULT_SIZE_BUCKETS,
+)
+
+
+class _GrowArray:
+    """Amortized-O(append) numpy buffer (capacity doubling) — the
+    encoded snapshot must not pay an O(history) copy per cycle."""
+
+    def __init__(self, dtype):
+        self._buf = np.empty(1024, dtype)
+        self._n = 0
+
+    def append(self, values) -> None:
+        values = np.asarray(values, self._buf.dtype)
+        need = self._n + len(values)
+        if need > len(self._buf):
+            cap = len(self._buf)
+            while cap < need:
+                cap *= 2
+            grown = np.empty(cap, self._buf.dtype)
+            grown[: self._n] = self._buf[: self._n]
+            self._buf = grown
+        self._buf[self._n: need] = values
+        self._n = need
+
+    def view(self) -> np.ndarray:
+        return self._buf[: self._n]
+
+    def truncate(self, n: int) -> None:
+        self._n = min(self._n, int(n))
+
+    def __len__(self) -> int:
+        return self._n
+
+
+class EncodedSnapshot:
+    """Persistent encoded interaction snapshot: int32 COO + entity maps,
+    appended per delta — never re-encoded from the string lists (the
+    O(delta) trainer-cycle contract, ROADMAP item 2). Entity ids extend
+    in first-appearance order, the same rule the algorithms'
+    ``_extended_ids`` applies, so the maps verifiably extend the served
+    model's (``foldin.maps_extend``) as long as both read the same
+    stream order."""
+
+    def __init__(self):
+        self.user_map: dict = {}
+        self.item_map: dict = {}
+        self.u = _GrowArray(np.int32)
+        self.i = _GrowArray(np.int32)
+        self.r = _GrowArray(np.float32)
+        self._user_bimap = None  # cache, dropped when the map grows
+        self._item_bimap = None
+
+    def append(self, users, items, ratings) -> int:
+        """Encode + append delta rows; returns the rows encoded (the
+        per-cycle work measure the O(delta) test pins)."""
+        un = np.empty(len(users), np.int32)
+        inn = np.empty(len(items), np.int32)
+        umap, imap = self.user_map, self.item_map
+        grew = (len(umap), len(imap))
+        for k, (u, i) in enumerate(zip(users, items)):
+            idx = umap.get(u)
+            if idx is None:
+                idx = umap[u] = len(umap)
+            un[k] = idx
+            idx = imap.get(i)
+            if idx is None:
+                idx = imap[i] = len(imap)
+            inn[k] = idx
+        if (len(umap), len(imap)) != grew:
+            self._user_bimap = self._item_bimap = None
+        self.u.append(un)
+        self.i.append(inn)
+        self.r.append(ratings)
+        return len(users)
+
+    def bimaps(self):
+        """(user BiMap, item BiMap) — rebuilt only when the maps grew
+        (steady-state cycles with no new entities reuse the cache)."""
+        from predictionio_tpu.data.bimap import BiMap
+
+        if self._user_bimap is None or len(self._user_bimap) \
+                != len(self.user_map):
+            self._user_bimap = BiMap(self.user_map)
+        if self._item_bimap is None or len(self._item_bimap) \
+                != len(self.item_map):
+            self._item_bimap = BiMap(self.item_map)
+        return self._user_bimap, self._item_bimap
+
+    def mark(self) -> tuple:
+        return (len(self.u), len(self.user_map), len(self.item_map))
+
+    def rollback(self, mark: tuple) -> None:
+        """Undo appends past ``mark`` (a failed cycle re-queues its
+        rows): truncate the arrays and pop the entities the delta
+        minted (dicts preserve insertion order)."""
+        rows, n_users, n_items = mark
+        for arr in (self.u, self.i, self.r):
+            arr.truncate(rows)
+        for m, keep in ((self.user_map, n_users),
+                        (self.item_map, n_items)):
+            for key in list(m)[keep:]:
+                del m[key]
+        self._user_bimap = self._item_bimap = None
 
 
 @dataclass(frozen=True)
@@ -247,6 +356,11 @@ class ContinuousTrainer:
         self._users: list = []
         self._items: list = []
         self._ratings: list = []
+        #: O(delta) snapshot: persistent int32 COO + entity maps — only
+        #: delta rows get string->int encoded per cycle (rebuilt, at
+        #: O(history), only at bootstrap and after full retrains)
+        self._enc: EncodedSnapshot | None = None
+        self._last_encoded_rows: int | None = None
         #: (seq, wall_ts, user, item, rating) rows read but not folded
         self._pending: list = []
         self._read_seq = 0
@@ -438,6 +552,16 @@ class ContinuousTrainer:
                     self._note_pending(seq, ev, row)
             if len(page) < self.page_limit:
                 break
+        self._rebuild_encoded()
+
+    def _rebuild_encoded(self) -> None:
+        """Rebuild the encoded snapshot from the string lists — an
+        O(history) pass paid only at bootstrap and after a full retrain
+        (each itself already O(history)); every fold-in cycle appends
+        O(delta) through :meth:`EncodedSnapshot.append`."""
+        enc = EncodedSnapshot()
+        enc.append(self._users, self._items, self._ratings)
+        self._enc = enc
 
     def _prepare_models(self, instance) -> list:
         """Load an instance's trained models (the serving loader's
@@ -579,14 +703,33 @@ class ContinuousTrainer:
         )
         path = "full" if want_full else "foldin"
         instance_id = None
+        base_rows = len(self._users)
+        enc_mark = None
+        committed = False
         try:
             if not want_full:
+                if self._enc is None:
+                    self._rebuild_encoded()
+                # O(delta) snapshot append: ONLY the delta rows get
+                # string->int encoded (pio_foldin_encoded_rows pins the
+                # per-cycle work; a failed cycle rolls the appends back)
+                enc_mark = self._enc.mark()
+                encoded = self._enc.append(
+                    [r[2] for r in rows], [r[3] for r in rows],
+                    [r[4] for r in rows])
+                self._last_encoded_rows = encoded
+                _ENCODED_ROWS.observe(float(encoded))
+                self._users += [r[2] for r in rows]
+                self._items += [r[3] for r in rows]
+                self._ratings += [r[4] for r in rows]
+                committed = True
+                u_ids, i_ids = self._enc.bimaps()
                 data = foldin.FoldinData(
-                    users=self._users + [r[2] for r in rows],
-                    items=self._items + [r[3] for r in rows],
-                    ratings=np.asarray(
-                        self._ratings + [r[4] for r in rows], np.float32),
-                    delta_start=len(self._users),
+                    users=self._users, items=self._items,
+                    ratings=self._enc.r.view(),
+                    delta_start=base_rows,
+                    uidx=self._enc.u.view(), iidx=self._enc.i.view(),
+                    user_ids=u_ids, item_ids=i_ids,
                 )
                 got = foldin.run_foldin(
                     self.engine, self.engine_params, self._instance,
@@ -594,20 +737,30 @@ class ContinuousTrainer:
                 if got is not None:
                     instance_id, new_models = got
                     self._models = new_models
-                    self._users = data.users
-                    self._items = data.items
-                    self._ratings = list(data.ratings)
             if instance_id is None:
                 path = "full"
                 instance_id = self._full_retrain(generation, watermark)
                 # the retrained model's read covers at least the consumed
                 # rows; commit them to the snapshot like a fold-in would
-                self._users += [r[2] for r in rows]
-                self._items += [r[3] for r in rows]
-                self._ratings += [r[4] for r in rows]
+                if not committed:
+                    self._users += [r[2] for r in rows]
+                    self._items += [r[3] for r in rows]
+                    self._ratings += [r[4] for r in rows]
+                    committed = True
+                # the fresh model's entity maps were rebuilt by its own
+                # scan — re-anchor the encoded snapshot to the committed
+                # string lists (O(history), like the retrain itself)
+                self._rebuild_encoded()
         except Exception as e:  # noqa: BLE001
             # the rows are real events the model does not have yet:
-            # re-queue them at the front so the next cycle retries
+            # re-queue them at the front so the next cycle retries —
+            # rolling back this cycle's snapshot appends
+            if committed:
+                del self._users[base_rows:]
+                del self._items[base_rows:]
+                del self._ratings[base_rows:]
+            if enc_mark is not None and self._enc is not None:
+                self._enc.rollback(enc_mark)
             self._pending = rows + self._pending
             self._first_pending_t = time.time()
             self._last_error = repr(e)
@@ -753,6 +906,8 @@ class ContinuousTrainer:
                 "lastError": self._last_error,
                 "lastAdvance": self._last_advance,
                 "lastCycleSeconds": self._last_cycle_s,
+                "lastCycleEncodedRows": self._last_encoded_rows,
+                "snapshotRows": len(self._users),
                 "lastEventsToServableSeconds":
                     self._last_events_to_servable_s,
                 "intervalS": self.interval_s,

@@ -71,12 +71,25 @@ class FoldinData:
     the tail: rows ``[delta_start:]`` are the events newer than the
     parent instance's train watermark. The full snapshot rides along
     because a touched entity's re-solve needs ALL its evidence (old and
-    new rows alike), not just the delta."""
+    new rows alike), not just the delta.
+
+    The optional ENCODED view (``uidx``/``iidx`` int32 COO +
+    ``user_ids``/``item_ids`` BiMaps) is the O(delta) snapshot the
+    ``ContinuousTrainer`` maintains persistently — only delta rows get
+    string→int encoded per cycle, instead of the whole history. An
+    algorithm's ``fold_in`` uses it when the maps verifiably EXTEND the
+    model's own (same index for every model entity — checked, because
+    the trainer is model-agnostic) and falls back to re-encoding the
+    string lists otherwise."""
 
     users: list
     items: list
     ratings: np.ndarray
     delta_start: int
+    uidx: np.ndarray | None = None
+    iidx: np.ndarray | None = None
+    user_ids: object = None  # BiMap over users, delta entities included
+    item_ids: object = None  # BiMap over items
 
     @property
     def delta_users(self) -> list:
@@ -85,6 +98,42 @@ class FoldinData:
     @property
     def delta_items(self) -> list:
         return self.items[self.delta_start:]
+
+    def encoded(self) -> bool:
+        """True when the encoded COO + maps ride along (and cover every
+        row — a partial view would silently drop evidence)."""
+        return (self.uidx is not None and self.iidx is not None
+                and self.user_ids is not None
+                and self.item_ids is not None
+                and len(self.uidx) == len(self.users)
+                and len(self.iidx) == len(self.items))
+
+
+def extended_ids(ids, delta):
+    """A BiMap grown by the delta's unseen entities in first-appearance
+    order — existing indices preserved (untouched rows keep their
+    position, so a parent's factor/embedding rows copy over
+    byte-identical). ONE definition shared by every template's fold-in
+    AND mirrored by ``EncodedSnapshot.append`` in train/continuous.py:
+    the trainer's O(delta) encoded maps verifiably extend the model's
+    (:func:`maps_extend`) only because both apply this exact rule."""
+    from predictionio_tpu.data.bimap import BiMap
+
+    fwd = dict(ids.to_dict())
+    for key in delta:
+        if key not in fwd:
+            fwd[key] = len(fwd)
+    return BiMap(fwd)
+
+
+def maps_extend(base, ext) -> bool:
+    """True when BiMap ``ext`` is ``base`` plus appended entities: every
+    base entity keeps its index. O(base entities) — constant per cycle
+    regardless of event history, which is the point."""
+    if ext is None or len(ext) < len(base):
+        return False
+    ed = ext.to_dict()
+    return all(ed.get(k) == v for k, v in base.to_dict().items())
 
 
 def _pow2(n: int, floor: int = 8) -> int:

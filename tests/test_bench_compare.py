@@ -243,3 +243,50 @@ def test_bench_compare_gate_cli_face(tmp_path):
         ["bench-compare", str(FIXTURES / "bench_dryrun_baseline.json"),
          str(cand)])
     assert cmd_bench_compare(args) == 0
+
+
+def test_two_tower_mfu_floor_gate():
+    """ISSUE 15's MFU-floor guard: two_tower_mfu is higher-is-better
+    (the `mfu` name rule), the new sasrec keys read in their obvious
+    directions, and a --key-threshold floor turns an MFU regression into
+    a failing `pio bench-compare` — the tier-1 shape of the sparse-path
+    protection."""
+    from predictionio_tpu.tools.bench_compare import lower_is_better
+
+    assert not lower_is_better("two_tower_mfu")
+    assert not lower_is_better("sasrec_examples_per_sec")
+    assert lower_is_better("sasrec_device_p50_ms")
+    assert not lower_is_better("two_tower_sparse_speedup")
+    assert not lower_is_better("two_tower_opt_traffic_ratio")
+    # a drop from the sparse-path MFU back toward the dense-era figure
+    # must regress, even under a loose global threshold, via the per-key
+    # floor ratio
+    base = {"two_tower_mfu": 0.19}
+    result = compare(base, {"two_tower_mfu": 0.02}, threshold=0.05)
+    assert [e["key"] for e in result["regressions"]] == ["two_tower_mfu"]
+    # within-floor wobble stays green with the documented override
+    result = compare(base, {"two_tower_mfu": 0.185}, threshold=0.05,
+                     key_thresholds={"two_tower_mfu": 0.05})
+    assert result["regressions"] == []
+
+
+def test_mfu_floor_cli_gate(tmp_path):
+    """`pio bench-compare a b --key-threshold two_tower_mfu=0.05` — the
+    exact CI invocation — exits 1 when the candidate's MFU falls under
+    the floor."""
+    from predictionio_tpu.tools.cli import build_parser, cmd_bench_compare
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({
+        "metric": "m", "value": 1.0, "extra": {"two_tower_mfu": 0.19}}))
+    b.write_text(json.dumps({
+        "metric": "m", "value": 1.0, "extra": {"two_tower_mfu": 0.02}}))
+    args = build_parser().parse_args(
+        ["bench-compare", str(a), str(b),
+         "--key-threshold", "two_tower_mfu=0.05"])
+    assert cmd_bench_compare(args) == 1
+    args = build_parser().parse_args(
+        ["bench-compare", str(a), str(a),
+         "--key-threshold", "two_tower_mfu=0.05"])
+    assert cmd_bench_compare(args) == 0

@@ -586,3 +586,201 @@ def test_cli_flags_parse():
     assert args.foldin_interval == 5.0 and args.foldin_min_events == 16
     args = p.parse_args(["deploy", "--auto-train"])
     assert args.auto_train
+
+
+# -- O(delta) snapshot + neural fold-in (ISSUE 15 satellites) -----------------
+
+
+def test_trainer_cycle_cost_is_o_delta(memory_storage):
+    """The per-cycle-cost pin: each fold-in cycle string->int encodes
+    ONLY its delta rows (never the accumulated history), and no
+    full-history BiMap.encode happens inside the cycle — so cycle cost
+    stays flat as total history grows."""
+    from predictionio_tpu.data import bimap as bimap_mod
+
+    seed_and_train(memory_storage)
+    app_id = _app_id(memory_storage)
+    tr = _trainer("t-odelta")
+    tr.bootstrap()
+    assert tr._enc is not None
+    assert len(tr._enc.u) == len(tr._users)
+
+    encode_lens = []
+    orig = bimap_mod.BiMap.encode
+
+    def spying(self, keys):
+        encode_lens.append(len(keys))
+        return orig(self, keys)
+
+    bimap_mod.BiMap.encode = spying
+    try:
+        per_cycle = []
+        for cycle in range(4):  # history grows every cycle
+            for k in range(3):
+                _insert_rate(memory_storage, app_id, f"u{(cycle + k) % 8}",
+                             f"i{k}", 3)
+            assert tr.poll_once() is True
+            per_cycle.append(tr._last_encoded_rows)
+        # encoded work per cycle == delta size, flat as history grows
+        assert per_cycle == [3, 3, 3, 3]
+        # and the encoded path never re-encoded the full snapshot: every
+        # BiMap.encode call inside the cycles was delta-sized
+        assert all(n <= 3 for n in encode_lens), encode_lens
+    finally:
+        bimap_mod.BiMap.encode = orig
+    st = tr.state()
+    assert st["lastCycleEncodedRows"] == 3
+    assert st["snapshotRows"] == len(tr._users)
+
+
+def test_encoded_path_factors_match_string_path(memory_storage):
+    """The O(delta) encoded fold-in must produce exactly the factors the
+    legacy string re-encode produces (same solve, different plumbing)."""
+    from predictionio_tpu.data.store import PEventStore
+    from predictionio_tpu.workflow.context import workflow_context
+
+    iid = seed_and_train(memory_storage)
+    parent = _load_model(memory_storage, iid)
+    engine, ep = _engine_and_params()
+    algo = engine._algorithms(ep)[0]
+    base = [(e.entity_id, e.target_entity_id,
+             float(e.properties.get("rating")))
+            for _, e in PEventStore.events_since("qsapp", 0)]
+    delta = [("u0", "i1", 5.0), ("u_new", "i2", 4.0)]
+    rows = base + delta
+    ctx = workflow_context(batch="", mode="FoldIn")
+
+    string_data = foldin.FoldinData(
+        users=[r[0] for r in rows], items=[r[1] for r in rows],
+        ratings=np.asarray([r[2] for r in rows], np.float32),
+        delta_start=len(base))
+    want = algo.fold_in(ctx, parent, string_data)
+
+    from predictionio_tpu.train.continuous import EncodedSnapshot
+
+    enc = EncodedSnapshot()
+    enc.append([r[0] for r in rows], [r[1] for r in rows],
+               [r[2] for r in rows])
+    u_ids, i_ids = enc.bimaps()
+    assert foldin.maps_extend(parent.user_ids, u_ids)
+    enc_data = foldin.FoldinData(
+        users=[r[0] for r in rows], items=[r[1] for r in rows],
+        ratings=enc.r.view(), delta_start=len(base),
+        uidx=enc.u.view(), iidx=enc.i.view(),
+        user_ids=u_ids, item_ids=i_ids)
+    assert enc_data.encoded()
+    got = algo.fold_in(ctx, parent, enc_data)
+    np.testing.assert_array_equal(
+        np.asarray(got.factors.user_features),
+        np.asarray(want.factors.user_features))
+    np.testing.assert_array_equal(
+        np.asarray(got.factors.item_features),
+        np.asarray(want.factors.item_features))
+    assert got.user_ids.to_dict() == want.user_ids.to_dict()
+
+
+def test_encoded_snapshot_rollback(memory_storage):
+    """A failed cycle must leave the encoded snapshot exactly as it was:
+    arrays truncated, delta-minted entities removed."""
+    from predictionio_tpu.train.continuous import EncodedSnapshot
+
+    enc = EncodedSnapshot()
+    enc.append(["a", "b"], ["x", "y"], [1.0, 2.0])
+    mark = enc.mark()
+    enc.append(["a", "c"], ["z", "x"], [3.0, 4.0])
+    assert len(enc.u) == 4 and len(enc.user_map) == 3
+    enc.rollback(mark)
+    assert len(enc.u) == 2 and len(enc.user_map) == 2
+    assert list(enc.user_map) == ["a", "b"]
+    assert list(enc.item_map) == ["x", "y"]
+    np.testing.assert_array_equal(enc.u.view(), [0, 1])
+    # appending after a rollback re-mints the same ids
+    enc.append(["c"], ["z"], [5.0])
+    assert enc.user_map["c"] == 2 and enc.item_map["z"] == 2
+
+
+def test_two_tower_fold_in_byte_parity(memory_storage):
+    """The neural fold-in analog (ISSUE 15 satellite): a fold-in that
+    only ADDS entities leaves every existing embedding row, the MLP, and
+    every existing serving-corpus row byte-identical; the new entities
+    get warm-started rows and become servable."""
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.parallel.mesh import compute_context
+    from predictionio_tpu.templates.twotower import (
+        Query,
+        engine_factory as tt_factory,
+    )
+    from predictionio_tpu.workflow.context import workflow_context
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "ttfold"))
+    events = memory_storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(1)
+    for u in range(16):
+        for _ in range(6):
+            events.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item",
+                      target_entity_id=f"i{rng.integers(0, 10)}"),
+                app_id)
+    engine = tt_factory()
+    ep = engine.engine_params_from_json({
+        "engineFactory": "x",
+        "datasource": {"params": {"app_name": "ttfold"}},
+        "algorithms": [
+            {"name": "twotower",
+             "params": {"embed_dim": 8, "hidden_dims": [16], "out_dim": 8,
+                        "batch_size": 64, "steps": 40, "seed": 0}}
+        ],
+    })
+    ctx = compute_context()
+    models = engine.train(ctx, ep)
+    algo = engine._algorithms(ep)[0]
+    parent = models[0]
+    # the datasource speaks the continuous-training protocol now
+    ds = engine.data_source_class(ep.data_source_params)
+    spec = ds.delta_source()
+    assert spec.rating_property is None
+
+    data = foldin.FoldinData(
+        users=["u_new1", "u_new1", "u_new2", "u3"],
+        items=["i2", "i_new1", "i5", "i_new1"],
+        ratings=np.ones(4, np.float32), delta_start=0)
+    assert algo.fold_in_ready(parent, data) is True
+    refreshed = algo.fold_in(None, parent, data)
+    # existing rows: byte-identical (embeddings AND corpora); note u3's
+    # delta evidence does NOT move its row — the neural fold-in only
+    # warm-starts new entities
+    old_nu, old_ni = len(parent.user_ids), len(parent.item_ids)
+    np.testing.assert_array_equal(
+        refreshed.tt.params["user"]["embed"][:old_nu],
+        parent.tt.params["user"]["embed"])
+    np.testing.assert_array_equal(
+        refreshed.tt.params["item"]["embed"][:old_ni],
+        parent.tt.params["item"]["embed"])
+    np.testing.assert_array_equal(
+        refreshed.tt.user_embeddings[:old_nu], parent.tt.user_embeddings)
+    np.testing.assert_array_equal(
+        refreshed.tt.item_embeddings[:old_ni], parent.tt.item_embeddings)
+    for a, b in zip(refreshed.tt.params["user"]["layers"],
+                    parent.tt.params["user"]["layers"]):
+        np.testing.assert_array_equal(np.asarray(a["w"]),
+                                      np.asarray(b["w"]))
+    # new entities: appended, warm-started, servable
+    assert len(refreshed.user_ids) == old_nu + 2
+    assert len(refreshed.item_ids) == old_ni + 1
+    new_row = refreshed.tt.params["user"]["embed"][
+        refreshed.user_ids("u_new1")]
+    assert np.abs(new_row).sum() > 0
+    got = algo.batch_predict(refreshed,
+                             [(0, Query(user="u_new1", num=3))])[0][1]
+    assert len(got.itemScores) == 3
+    # an empty delta declines; a delta minting most of the catalog too
+    assert algo.fold_in_ready(parent, foldin.FoldinData(
+        users=[], items=[], ratings=np.zeros(0, np.float32),
+        delta_start=0)) is False
+    many = [f"u_x{k}" for k in range(30)]
+    assert algo.fold_in_ready(parent, foldin.FoldinData(
+        users=many, items=["i0"] * 30, ratings=np.ones(30, np.float32),
+        delta_start=0)) is False

@@ -194,3 +194,112 @@ def test_dense_als_train_compiles_once_per_shape_bucket():
         assert len(rep["buckets"]) == 2
         assert rep["calls"] == 4
     als_dense.clear_dense_cache()
+
+
+def test_two_tower_sparse_step_compiles_once_per_bucket():
+    """The sparse embedding-update train program (ISSUE 15): repeated
+    fused runs over one dataset shape must reuse that bucket's ONE
+    compiled program — a dtype/weak-type flap in the dedup/segment/
+    scatter pipeline re-lowering per dispatch is exactly the regression
+    this pins."""
+    import jax
+
+    from predictionio_tpu.models.two_tower import (
+        TwoTowerParams,
+        _get_trainer,
+        init_params,
+    )
+
+    device_obs.reset_program("two_tower_sparse_step")
+    ctx = _one_device_ctx()
+    p = TwoTowerParams(embed_dim=8, hidden_dims=(16,), out_dim=8,
+                       batch_size=32, steps=0, seed=0)
+    rng = np.random.default_rng(5)
+    key = jax.random.PRNGKey(0)
+    for nu, ni in ((41, 29), (67, 43)):  # two UNIQUE dataset shapes
+        u = jax.device_put(
+            rng.integers(0, nu, 300).astype(np.int32), ctx.replicated)
+        i = jax.device_put(
+            rng.integers(0, ni, 300).astype(np.int32), ctx.replicated)
+        batch = ctx.pad_to_multiple(p.batch_size)
+        tx, run, _one = _get_trainer(ctx, p, batch)
+        params = jax.device_put(init_params(nu, ni, p), ctx.replicated)
+        opt = tx.init(params)
+        for _ in range(3):  # dispatches 2-3 must be jit cache hits
+            params, opt, loss = run(params, opt, u, i, key, 2)
+        assert np.isfinite(float(loss))
+    for marker, want in (("(41, 8)", 1), ("(67, 8)", 1)):
+        rep = _assert_one_compile_per_bucket(
+            "two_tower_sparse_step", marker=marker)
+        assert len(rep["buckets"]) == want
+
+
+def test_sasrec_serving_ladder_under_concurrent_load():
+    """The device-resident SASRec serving program (ISSUE 15): one fused
+    forward+score+mask+top-k dispatch per tick must compile exactly once
+    per (pow2 batch, pow2 sequence-length bucket, mask-variant) — a
+    serial pass over the full ladder pays the expected compiles, then
+    sustained concurrent load re-visiting every bucket may add NO
+    signatures and NO compiles (zero retraces across the sequence-length
+    bucket ladder)."""
+    import threading
+
+    import jax
+
+    from predictionio_tpu.models.sasrec import (
+        SASRecParams,
+        init_params,
+        serve_sasrec_topk_batched,
+    )
+
+    device_obs.reset_program("sasrec_predict")
+    p = SASRecParams(max_len=16, embed_dim=8, num_blocks=1, num_heads=2,
+                     ffn_dim=16, dropout=0.0, seed=0)
+    n_items = 53  # unique catalog shape (54, 8): cold buckets
+    params = jax.tree.map(np.asarray, init_params(n_items, p))
+    rng = np.random.default_rng(17)
+
+    def drive(b: int, l: int, masked: bool):
+        seqs = np.zeros((b, l), np.int32)
+        for r in range(b):
+            h = int(rng.integers(1, l + 1))
+            seqs[r, -h:] = rng.integers(1, n_items + 1, h)
+        mask = None
+        if masked:
+            mask = np.zeros((b, n_items + 1), bool)
+            mask[:, :5] = True
+        fin = serve_sasrec_topk_batched(params, seqs, 5, p, mask)
+        assert fin is not None  # CPU default backend = device route
+        scores, idx = fin()
+        assert idx.shape == (b, 5)
+        if masked:
+            assert (idx >= 5).all()
+
+    ladder = [(b, l) for b in (1, 2, 3, 4) for l in (8, 16)]
+    for b, l in ladder:  # serial warm pass: the expected compile set
+        drive(b, l, False)
+        drive(b, l, True)
+
+    errors: list = []
+
+    def load(seed: int):
+        try:
+            r = np.random.default_rng(seed)
+            for _ in range(6):
+                b, l = ladder[int(r.integers(0, len(ladder)))]
+                drive(b, l, bool(r.integers(0, 2)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=load, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    rep = _assert_one_compile_per_bucket("sasrec_predict",
+                                         marker="(54, 8)")
+    # pow2 padding collapses 4 batch sizes onto 3 buckets, x2 sequence
+    # buckets, x2 for the mask/no-mask program split
+    assert len(rep["buckets"]) == 12
+    assert rep["calls"] >= 16 + 24

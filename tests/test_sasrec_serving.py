@@ -1,0 +1,234 @@
+"""Device-resident SASRec serving (ISSUE 15): exact host-route parity
+(ids AND scores, exclusion-mask route included), the pow2 sequence-length
+bucket equivalence, deploy-time pinning, and the query-server e2e through
+the deferred fused-tick protocol."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.sasrec import (
+    SASRec,
+    SASRecParams,
+    predict_top_k,
+    seq_bucket_len,
+    serve_sasrec_topk_batched,
+)
+from predictionio_tpu.parallel.mesh import compute_context
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return compute_context()
+
+
+@pytest.fixture(scope="module")
+def trained(ctx):
+    """A small trained model + the template-shaped state around it."""
+    import jax
+
+    rng = np.random.default_rng(3)
+    n_items = 24
+    seq_lists = {
+        f"u{u}": list(map(int, rng.integers(1, n_items + 1,
+                                            int(rng.integers(3, 14)))))
+        for u in range(16)
+    }
+    p = SASRecParams(max_len=16, embed_dim=8, num_blocks=1, num_heads=2,
+                     ffn_dim=16, dropout=0.0, num_epochs=3, batch_size=8,
+                     seed=0)
+    params = SASRec(ctx, p).train(list(seq_lists.values()), n_items)
+    params = jax.tree.map(np.asarray, params)
+    return params, p, seq_lists, n_items
+
+
+def _template_model(trained, exclude_seen: bool):
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.templates.sequentialrecommendation import (
+        SASRecModel,
+    )
+
+    params, p, seq_lists, n_items = trained
+    item_ids = BiMap({f"i{j}": j + 1 for j in range(n_items)})
+    popular = [f"i{j}" for j in range(5)]
+    return SASRecModel(
+        params=params, item_ids=item_ids, user_sequences=dict(seq_lists),
+        popular=popular, hp=p, exclude_seen=exclude_seen)
+
+
+def test_seq_bucket_ladder():
+    assert seq_bucket_len(1, 50) == 8
+    assert seq_bucket_len(8, 50) == 8
+    assert seq_bucket_len(9, 50) == 16
+    assert seq_bucket_len(33, 50) == 50  # top rung = max_len, pow2 or not
+    assert seq_bucket_len(12, 8) == 8
+
+
+def test_bucketed_pad_scores_match_max_len_pad(trained):
+    """The tail-aligned position table: a history padded to its pow2
+    bucket must score like the max_len pad (same absolute positions,
+    same valid-key window) — what makes the bucket ladder legal."""
+    params, p, _seqs, n_items = trained
+    hist = [3, 7, 11]
+    short = np.zeros((1, 8), np.int32)
+    short[0, -3:] = hist
+    full = np.zeros((1, p.max_len), np.int32)
+    full[0, -3:] = hist
+    s8, i8 = predict_top_k(params, short, 5, p)
+    s16, i16 = predict_top_k(params, full, 5, p)
+    np.testing.assert_array_equal(np.asarray(i8), np.asarray(i16))
+    np.testing.assert_allclose(np.asarray(s8), np.asarray(s16),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_route_exact_parity_with_host(trained):
+    """serve_sasrec_topk_batched vs predict_top_k on identical padded
+    operands: the SAME jitted program runs both routes, so ids AND
+    scores are bit-identical — mask route included."""
+    params, p, _seqs, n_items = trained
+    rng = np.random.default_rng(5)
+    seqs = np.zeros((5, 8), np.int32)
+    for r in range(5):
+        h = int(rng.integers(1, 9))
+        seqs[r, -h:] = rng.integers(1, n_items + 1, h)
+    for mask in (None, (lambda m: m)(np.zeros((5, n_items + 1), bool))):
+        if mask is not None:
+            mask[:, 1:8] = True
+        sh, ih = predict_top_k(params, seqs, 6, p, exclude_mask=mask)
+        fin = serve_sasrec_topk_batched(params, seqs, 6, p,
+                                        exclude_mask=mask)
+        assert fin is not None  # CPU default backend = device route
+        sd, idd = fin()
+        np.testing.assert_array_equal(np.asarray(ih), idd)
+        np.testing.assert_array_equal(np.asarray(sh), sd)
+        if mask is not None:
+            assert ((idd == 0) | (idd >= 8)).all()
+
+
+@pytest.mark.parametrize("exclude_seen", [True, False])
+def test_template_deferred_parity_ids_and_scores(trained, exclude_seen):
+    """The template protocol end to end: batch_predict_deferred's
+    resolved results equal batch_predict's exactly — item ids and float
+    scores — cold-start riders and the seen-item exclusion route
+    included."""
+    from predictionio_tpu.templates.sequentialrecommendation import (
+        Query,
+        SASRecAlgorithm,
+    )
+
+    model = _template_model(trained, exclude_seen)
+    algo = SASRecAlgorithm.__new__(SASRecAlgorithm)  # no params needed
+    queries = list(enumerate([
+        Query(user="u0", num=5), Query(user="ghost", num=4),
+        Query(user="u3", num=7), Query(user="u11", num=3),
+        Query(user="u7", num=5),
+    ]))
+    host = dict(algo.batch_predict(model, list(queries)))
+    deferred = algo.batch_predict_deferred(model, list(queries))
+    assert deferred is not None
+    dev = dict(deferred())
+    assert set(host) == set(dev) == set(range(5))
+    for i in host:
+        assert host[i] == dev[i], (i, host[i], dev[i])
+    if exclude_seen:
+        for i, q in queries:
+            seen = {f"i{j - 1}" for j in model.user_sequences.get(
+                q.user, [])}
+            assert not {s.item for s in dev[i].itemScores} & seen
+
+
+def test_deferred_declines_without_histories(trained):
+    from predictionio_tpu.templates.sequentialrecommendation import (
+        Query,
+        SASRecAlgorithm,
+    )
+
+    model = _template_model(trained, True)
+    algo = SASRecAlgorithm.__new__(SASRecAlgorithm)
+    assert algo.batch_predict_deferred(
+        model, [(0, Query(user="ghost", num=3))]) is None
+
+
+def test_pin_serving_state_pins_bytes(trained):
+    import jax
+
+    from predictionio_tpu.models.sasrec import pin_sasrec_serving_state
+    from predictionio_tpu.parallel import placement
+
+    params, p, _seqs, _n = trained
+    placement.evict_serving_models()
+    before = placement.serving_arena_bytes()
+    pinned = pin_sasrec_serving_state(params, p, max_batch=8)
+    want = sum(a.nbytes for a in jax.tree.leaves(params))
+    assert pinned == want
+    assert placement.serving_arena_bytes() - before == want
+    # idempotent: re-pinning the same pytree adds nothing
+    pin_sasrec_serving_state(params, p, max_batch=8)
+    assert placement.serving_arena_bytes() - before == want
+    placement.evict_serving_models()
+
+
+def test_query_server_e2e_device_route(memory_storage):
+    """Deploy the sequential template through the real query server:
+    the micro-batcher's ticks must ride the device route (fused dispatch
+    + deferred readback) and answer with item scores."""
+    from predictionio_tpu.core.engine import WorkflowParams
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage.base import App
+    from predictionio_tpu.workflow.core_workflow import (
+        new_engine_instance,
+        run_train,
+    )
+    from predictionio_tpu.workflow.create_server import (
+        ServerConfig,
+        create_server,
+    )
+    from tests.test_query_server import call
+
+    factory = ("predictionio_tpu.templates.sequentialrecommendation:"
+               "engine_factory")
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "seqapp"))
+    events = memory_storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    for u in range(12):
+        for it in rng.integers(0, 15, 8):
+            events.insert(
+                Event(event="view", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item",
+                      target_entity_id=f"i{it}"),
+                app_id)
+    from predictionio_tpu.templates.sequentialrecommendation import (
+        engine_factory,
+    )
+
+    engine = engine_factory()
+    variant = {
+        "engineFactory": factory,
+        "datasource": {"params": {"app_name": "seqapp"}},
+        "algorithms": [
+            {"name": "sasrec",
+             "params": {"max_len": 8, "embed_dim": 8, "num_blocks": 1,
+                        "num_heads": 2, "ffn_dim": 16, "dropout": 0.0,
+                        "num_epochs": 2, "seed": 0}}
+        ],
+    }
+    ep = engine.engine_params_from_json(variant)
+    run_train(engine, ep,
+              new_engine_instance("default", "1", "default", factory, ep),
+              WorkflowParams())
+    srv, service = create_server(ServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    try:
+        for u in range(6):
+            status, body = call(srv.port, "POST", "/queries.json",
+                                {"user": f"u{u}", "num": 3})
+            assert status == 200
+            assert body["itemScores"], body
+        assert service.batcher is not None
+        assert service.batcher.device_ticks > 0  # the fused route served
+    finally:
+        srv.stop()
+        service.shutdown()
+        from predictionio_tpu.parallel import placement
+
+        placement.evict_serving_models()

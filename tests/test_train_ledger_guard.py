@@ -187,3 +187,48 @@ def test_every_guarded_program_feeds_the_step_histogram():
         assert program in seen, (
             f"{program} emitted no step metric — its training loop went "
             "dark (ISSUE 12 guard)")
+
+
+def test_two_tower_sparse_program_feeds_device_accounting(ctx, run_dir):
+    """The default train path is now the SPARSE step program (ISSUE 15):
+    its dispatches must land in the per-program device accounting (the
+    retrace/MFU surface) while the run ledger keeps the stable
+    two_tower_step identity — a rename that silently dropped either
+    surface would go dark here first."""
+    from predictionio_tpu.models.two_tower import (
+        TwoTowerParams,
+        train_two_tower,
+    )
+    from predictionio_tpu.obs import device as device_obs
+
+    rng = np.random.default_rng(7)
+    u = rng.integers(0, 31, 300).astype(np.int32)
+    i = rng.integers(0, 17, 300).astype(np.int32)
+    p = TwoTowerParams(embed_dim=8, hidden_dims=(16,), out_dim=8,
+                       batch_size=64, steps=3, seed=0)
+    assert p.sparse_update  # sparse IS the default
+    before = device_obs.program_report("two_tower_sparse_step")["calls"]
+    with runlog.run_scope(run_id="ttsparse", directory=run_dir):
+        train_two_tower(ctx, u, i, 31, 17, p)
+    rep = device_obs.program_report("two_tower_sparse_step")
+    assert rep["calls"] > before
+    steps = [s for s in _ledger_steps(run_dir, "ttsparse")
+             if s["program"] == "two_tower_step"]
+    assert steps and steps[-1]["iteration"] == steps[-1]["total"] == 3
+
+
+def test_sasrec_sparse_path_emits_epoch_records(ctx, run_dir):
+    """The sparse item-table path (default) keeps feeding the ledger;
+    the dense fallback (l2_emb forces it) does too."""
+    from predictionio_tpu.models.sasrec import SASRec, SASRecParams
+
+    seqs = [[(j % 10) + 1 for j in range(i, i + 8)] for i in range(12)]
+    for run_id, l2 in (("sas-sparse", 0.0), ("sas-dense", 1e-4)):
+        p = SASRecParams(max_len=8, embed_dim=8, num_blocks=1,
+                         num_heads=2, ffn_dim=16, dropout=0.0,
+                         num_epochs=2, batch_size=8, seed=0, l2_emb=l2)
+        with runlog.run_scope(run_id=run_id, directory=run_dir):
+            SASRec(ctx, p).train(seqs, n_items=10)
+        steps = [s for s in _ledger_steps(run_dir, run_id)
+                 if s["program"] == "sasrec_epoch"]
+        assert [s["iteration"] for s in steps] == [1, 2], run_id
