@@ -253,6 +253,7 @@ def bench_query_latency(
                 else:
                     os.environ["PIO_SERVING_DEVICE"] = prev
             out.update(_trace_overhead(srv.port))
+            out.update(_log_overhead(srv.port))
             out.update(_quality_section(srv.port))
             return out
         finally:
@@ -261,6 +262,108 @@ def bench_query_latency(
         from predictionio_tpu.data.storage import Storage
 
         Storage.reset()
+
+
+def _log_overhead(port: int, census_n: int = 50) -> dict:
+    """The structured log layer's serving-path cost — the ISSUE 16
+    acceptance guard (``log_overhead_frac`` ≤ 0.01: the sixth pillar
+    must ride the hot path for free).
+
+    Same direct-measurement design as :func:`_trace_overhead` (an
+    end-to-end A/B cannot resolve microseconds against loopback p50
+    drift), with the log layer's two cost components priced separately:
+
+      1. a call census: ``_RingHandler.emit`` is wrapped with a counting
+         delegate and real queries driven through the live server — how
+         many log records one request actually produces (a clean hot
+         path produces none; a stray per-request ``logger.info`` shows
+         up here as 1.0/request and blows the guard, which is the
+         point);
+      2. unit costs: one full admitted ``emit`` (JSON-ify, redact,
+         storm-window bookkeeping, ring append — suppression is pushed
+         out of the way so the EXPENSIVE path is the one priced) and
+         the per-request server-name ContextVar set/reset pair that
+         utils/http.py pays on every request unconditionally.
+
+    ``log_cost_us`` = census × emit + the fixed ContextVar pair;
+    ``log_overhead_frac`` prices it against the same min-of-rounds
+    off-mode p50 the trace guard uses as its denominator."""
+    import logging as _logging
+
+    from predictionio_tpu.obs import logs as _logs
+
+    counts = {"emit": 0}
+    count_lock = threading.Lock()
+    saved_emit = _logs._RingHandler.emit
+
+    def counted_emit(self, record):
+        with count_lock:  # census only — never on a timed path
+            counts["emit"] += 1
+        return saved_emit(self, record)
+
+    try:
+        _logs._RingHandler.emit = counted_emit
+        c = _Client(port)
+        for k in range(census_n):
+            c.query(f"u{k % 900}", 10)
+        c.close()
+    finally:
+        _logs._RingHandler.emit = saved_emit
+    records_per_request = counts["emit"] / census_n
+
+    # -- unit costs, µs/call. Storm suppression would admit only the
+    # first PIO_LOG_STORM_MAX repeats of the probe template and then
+    # early-return, timing the CHEAP path; raise the cap so every
+    # iteration pays for redaction + ring append (the conservative
+    # direction for a ≤-bound guard).
+    probe = _logging.LogRecord(
+        "predictionio_tpu.bench", _logging.INFO, __file__, 0,
+        "bench log-overhead probe %d", (1,), None)
+    handler = _logs._RingHandler(level=_logging.NOTSET)
+
+    def u_emit():
+        handler.emit(probe)
+
+    def u_server_name_pair():
+        token = _logs.server_name_var.set("bench")
+        _logs.server_name_var.reset(token)
+
+    def unit_us(fn, iters: int = 20_000) -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / iters * 1e6
+
+    prev_storm = os.environ.get("PIO_LOG_STORM_MAX")
+    os.environ["PIO_LOG_STORM_MAX"] = "1000000000"
+    try:
+        emit_us = unit_us(u_emit)
+    finally:
+        if prev_storm is None:
+            os.environ.pop("PIO_LOG_STORM_MAX", None)
+        else:
+            os.environ["PIO_LOG_STORM_MAX"] = prev_storm
+    cost_us = records_per_request * emit_us + unit_us(u_server_name_pair)
+
+    # denominator: a fresh quiet-path p50 (logs stay in their default
+    # enabled state — this prices what the layer costs AS DEPLOYED)
+    lat = []
+    c = _Client(port)
+    for k in range(30):
+        c.query(f"u{k % 900}", 10)
+    for k in range(200):
+        lat.append(c.query(f"u{k % 900}", 10))
+    c.close()
+    p50_ms = float(np.percentile(np.asarray(lat) * 1e3, 50))
+    return {
+        "log_records_per_request": round(records_per_request, 3),
+        "log_emit_cost_us": round(emit_us, 2),
+        "log_cost_us": round(cost_us, 2),
+        "log_overhead_frac": round(cost_us / (p50_ms * 1e3), 4),
+    }
 
 
 def _quality_section(port: int, feedback_every: int = 3) -> dict:
@@ -1280,6 +1383,9 @@ def _dry_run_doc(gateway: bool = False) -> dict:
         {
             "dry_run": True,
             "trace_overhead_frac": 0.0,
+            # structured-log layer guard (ISSUE 16): a cost, like the
+            # trace guard above — 0.0 keys the capture schema
+            "log_overhead_frac": 0.0,
             # device-resident-serving keys ride every capture (ISSUE 8);
             # dry runs emit them as nulls so the schema is stable for
             # capture tooling

@@ -81,3 +81,9 @@ from predictionio_tpu.obs import device, profile  # noqa: E402,F401
 # the rings that burst (the sampler's first sighting of a counter
 # establishes a baseline, it can't compute a rate).
 from predictionio_tpu.obs import quality  # noqa: E402,F401
+# Structured-log pillar (ISSUE 16): imported eagerly for the same
+# first-tick reason (its counters feed the error_log_rate series), and
+# so obs.logs.warn_once exists before any subsystem's first suppressed
+# warning. Ring handler installation stays explicit (logs.install()),
+# mirroring the history sampler's ensure_started().
+from predictionio_tpu.obs import logs  # noqa: E402,F401

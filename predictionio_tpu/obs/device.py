@@ -425,15 +425,17 @@ class _Program:
         self.flops = 0.0
         self.window_seconds = 0.0  # resettable MFU window
         self.window_flops = 0.0
-        self._warned = False
 
     def _warn_retrace(self, why: str) -> None:
-        if not self._warned:
-            self._warned = True
-            logger.warning(
-                "device program %r retraced: %s (further retraces for "
-                "this program counted silently on "
-                "pio_jax_retraces_total)", self.name, why)
+        # lazy import: logs imports metrics, device imports logs only at
+        # warn time, so module import order stays acyclic
+        from predictionio_tpu.obs.logs import warn_once
+
+        warn_once(
+            f"device-retrace:{self.name}",
+            "device program %r retraced: %s (further retraces for "
+            "this program counted silently on "
+            "pio_jax_retraces_total)", self.name, why, logger=logger)
 
     def note_signature(self, bucket, sig) -> bool:
         """Record one call's (bucket, signature); returns True when the

@@ -178,7 +178,6 @@ class HistorySampler:
         self.listeners: list[Callable[["HistorySampler", float], None]] = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._spill_warned = False
 
     # -- derivation helpers -------------------------------------------------
     def _rate(self, key: str, total: float | None,
@@ -316,6 +315,14 @@ class HistorySampler:
             "pio_foldin_events_to_servable_seconds", 0.5)
         values["foldin_watermark_lag_s"] = _gauge_max(
             reg, "pio_foldin_watermark_lag_seconds")
+        # structured logs (obs/logs.py): overall record volume and the
+        # ERROR+ slice — the series pio doctor's LOG-STORM judgment
+        # (obs.logs.diagnose_history_doc) reads back out of /debug/history
+        values["log_records_per_sec"] = self._rate(
+            "log_all", ct(reg, "pio_log_records_total"), dt)
+        values["error_log_rate"] = self._rate(
+            "log_err", ct(reg, "pio_log_records_total", "level",
+                          ("ERROR", "CRITICAL")), dt)
         return values
 
     def _ratio_rate(self, key: str, num: float | None, den_extra: float | None,
@@ -378,10 +385,11 @@ class HistorySampler:
                 f.write(json.dumps({"t": round(t, 3), "values": clean})
                         + "\n")
         except OSError:
-            if not self._spill_warned:
-                self._spill_warned = True
-                logger.warning("history spill to %s failed", path,
-                               exc_info=True)
+            from predictionio_tpu.obs.logs import warn_once
+
+            warn_once("history-spill-failed",
+                      "history spill to %s failed", path,
+                      logger=logger, exc_info=True)
 
     # -- reads --------------------------------------------------------------
     def series_names(self) -> list[str]:

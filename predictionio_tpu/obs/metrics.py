@@ -116,11 +116,6 @@ def max_series_per_family() -> int:
         return 1000
 
 
-#: Families that already logged a drop warning (warn once per family,
-#: not once per dropped observation).
-_warned_families: set[str] = set()
-_warned_lock = threading.Lock()
-
 #: Created lazily against REGISTRY (defined at module bottom); exempt
 #: from the bound itself so the drop accounting can never recurse into
 #: another drop.
@@ -139,14 +134,18 @@ def _note_dropped_series(family: str) -> None:
         c._exempt = True
         _dropped_series = c
     _dropped_series.inc(family=family)
-    with _warned_lock:
-        if family in _warned_families:
-            return
-        _warned_families.add(family)
-    logging.getLogger(__name__).warning(
+    # lazy import: logs.py imports this module for its counters, so the
+    # dependency must point one way at import time and loop only at call
+    # time (warn_once's own counter is an ordinary family, bounded by
+    # its callers using bounded keys)
+    from predictionio_tpu.obs.logs import warn_once
+
+    warn_once(
+        f"metrics-series-bound:{family}",
         "metric family %s hit the label-set bound (%d); new label sets "
         "are dropped (PIO_METRICS_MAX_SERIES raises the bound)",
-        family, max_series_per_family())
+        family, max_series_per_family(),
+        logger=logging.getLogger(__name__))
 
 
 #: Trace-exemplar hook (installed by obs/trace.py): returns the active

@@ -1,0 +1,356 @@
+"""Flight recorder: freeze the process's observability state on death.
+
+PR 12's run ledger exists because BENCH_r06 burned two 7200 s walls with
+nothing recording what the process was doing when it stalled; this
+module closes the same gap for *crashes*. Every pillar keeps bounded
+in-memory state (log ring, history rings, trace reservoir, HBM arenas,
+SLO judgments, run ledger) — all of it gone the instant the process
+dies, which is exactly when an operator needs it. The flight recorder
+snapshots them into one on-disk *bundle* under ``PIO_POSTMORTEM_DIR``:
+
+  * on unhandled exceptions (``sys.excepthook`` + ``threading.excepthook``,
+    chained onto whatever was installed before);
+  * on SIGTERM before graceful stop (``pio deploy`` wires it into its
+    signal handler);
+  * on demand: ``POST /debug/postmortem`` and ``pio postmortem``;
+  * automatically when ``pio doctor --fix`` hits a critical finding.
+
+Bundle discipline mirrors the checkpoint/heartbeat atomicity rules:
+each bundle is written into a dot-prefixed temp directory and
+``os.rename``-d into place, so a process SIGKILLed mid-capture leaves
+only an invisible temp dir, never a torn bundle readers would trust.
+Bundles are size-bounded per section, newest-``PIO_POSTMORTEM_KEEP``
+retained (oldest pruned, the run-ledger pattern), and every section is
+passed through :func:`obs.logs.redact` / :func:`obs.logs.redact_env`
+before it touches disk. ``pio postmortem --list/--show`` renders them.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback as _tb
+from pathlib import Path
+
+from predictionio_tpu.obs import logs as _logs
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "bundles_dir",
+    "capture_bundle",
+    "install",
+    "list_bundles",
+    "load_bundle",
+    "postmortem_enabled",
+]
+
+#: Per-section byte cap: a runaway section is truncated to a stub, not
+#: allowed to fill the disk the operator is about to debug on.
+_SECTION_MAX_BYTES = 4 * 2**20
+
+#: Automatic (hook-driven) captures are rate-limited so a crash loop
+#: can't churn the retention window; explicit captures bypass this.
+_AUTO_MIN_INTERVAL_S = 30.0
+_last_auto = 0.0
+_capture_lock = threading.Lock()
+
+
+def postmortem_enabled() -> bool:
+    """``PIO_POSTMORTEM`` (default on; ``0``/``off`` disables capture
+    and 404s ``POST /debug/postmortem``)."""
+    return os.environ.get("PIO_POSTMORTEM", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+def bundles_dir() -> Path:
+    """``PIO_POSTMORTEM_DIR``, else ``$PIO_TPU_HOME/postmortem``, else
+    ``~/.predictionio_tpu/postmortem`` (the runs-dir convention)."""
+    env = os.environ.get("PIO_POSTMORTEM_DIR")
+    if env:
+        return Path(env)
+    home = os.environ.get("PIO_TPU_HOME")
+    base = Path(home) if home else Path.home() / ".predictionio_tpu"
+    return base / "postmortem"
+
+
+def _keep() -> int:
+    """``PIO_POSTMORTEM_KEEP`` newest bundles retained (default 8)."""
+    try:
+        return max(int(os.environ.get("PIO_POSTMORTEM_KEEP", "8")), 1)
+    except ValueError:
+        return 8
+
+
+# ---------------------------------------------------------------------------
+# Section collectors — each independent and fail-soft: a broken pillar
+# costs its own section, never the bundle.
+# ---------------------------------------------------------------------------
+
+
+def _section_logs() -> dict:
+    return _logs.to_json()
+
+
+def _section_history() -> dict | None:
+    from predictionio_tpu.obs import history
+
+    sampler = history.get_sampler()
+    return sampler.to_json() if sampler is not None else None
+
+
+def _section_traces() -> dict | None:
+    from predictionio_tpu.obs import trace
+
+    if not trace.trace_enabled():
+        return None
+    return trace.TRACER.traces(limit=16)
+
+
+def _section_device() -> dict:
+    from predictionio_tpu.obs import device
+
+    return device.hbm_snapshot()
+
+
+def _section_slo() -> dict | None:
+    from predictionio_tpu.obs import slo
+
+    eng = slo.engine()
+    return eng.state() if eng is not None else None
+
+
+def _section_runs() -> list[dict]:
+    from predictionio_tpu.obs import runlog
+
+    return runlog.list_runs(limit=4)
+
+
+def _write_stacks(path: Path) -> None:
+    """faulthandler writes through the OS file descriptor (it is
+    async-signal-safe, not io-module aware), so dump to the real file,
+    then re-read and redact in place like every other section."""
+    with open(path, "w", encoding="utf-8") as f:
+        faulthandler.dump_traceback(file=f, all_threads=True)
+    path.write_text(_logs.redact(path.read_text(encoding="utf-8")),
+                    encoding="utf-8")
+
+
+_SECTIONS = {
+    "logs.json": _section_logs,
+    "history.json": _section_history,
+    "traces.json": _section_traces,
+    "device.json": _section_device,
+    "slo.json": _section_slo,
+    "runs.json": _section_runs,
+}
+
+
+def _dump_section(payload) -> str:
+    text = json.dumps(payload, indent=1, default=str)
+    if len(text) > _SECTION_MAX_BYTES:
+        return json.dumps({"truncated": True, "bytes": len(text)})
+    return _logs.redact(text)
+
+
+def capture_bundle(reason: str, exc: BaseException | None = None,
+                   auto: bool = False) -> Path | None:
+    """Snapshot every pillar into a new bundle; returns its path, or
+    None when disabled, rate-limited (``auto=True`` hooks only), or the
+    filesystem refused. Never raises — this runs inside excepthooks."""
+    global _last_auto
+    if not postmortem_enabled():
+        return None
+    with _capture_lock:
+        now = time.time()
+        if auto:
+            if now - _last_auto < _AUTO_MIN_INTERVAL_S:
+                return None
+            _last_auto = now
+        try:
+            return _capture_locked(reason, exc, now)
+        except Exception:
+            logger.warning("post-mortem capture failed", exc_info=True)
+            return None
+
+
+def _capture_locked(reason: str, exc: BaseException | None,
+                    now: float) -> Path:
+    root = bundles_dir()
+    root.mkdir(parents=True, exist_ok=True)
+    slug = "".join(ch if ch.isalnum() or ch in "-_" else "-"
+                   for ch in reason)[:40] or "manual"
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime(now))
+    name = f"pm-{stamp}-{os.getpid()}-{slug}"
+    final = root / name
+    if final.exists():  # two captures in the same second
+        name += f"-{int((now % 1) * 1000):03d}"
+        final = root / name
+    # dot-prefixed temp dir: a SIGKILL mid-write leaves an invisible
+    # partial, never a torn bundle (list_bundles skips dot-dirs); the
+    # rename at the end is the atomic commit, same as checkpoints
+    tmp = root / f".tmp-{name}"
+    tmp.mkdir(parents=True, exist_ok=True)
+    meta: dict = {
+        "reason": reason,
+        "capturedAt": round(now, 3),
+        "pid": os.getpid(),
+        "server": _logs.current_server_name(),
+        "argv": [_logs.redact(a) for a in sys.argv],
+    }
+    if exc is not None:
+        meta["exception"] = {
+            "type": type(exc).__name__,
+            "message": _logs.redact(str(exc)),
+            "traceback": _logs.redact("".join(_tb.format_exception(
+                type(exc), exc, exc.__traceback__))),
+        }
+    sections_written = []
+    for fname, collect in _SECTIONS.items():
+        try:
+            payload = collect()
+        except Exception as e:
+            payload = {"error": f"{type(e).__name__}: {e}"}
+        if payload is None:
+            continue
+        (tmp / fname).write_text(_dump_section(payload), encoding="utf-8")
+        sections_written.append(fname)
+    try:
+        _write_stacks(tmp / "stacks.txt")
+        sections_written.append("stacks.txt")
+    except Exception:
+        logger.debug("stack dump failed", exc_info=True)
+    (tmp / "env.json").write_text(
+        json.dumps(_logs.redact_env(), indent=1), encoding="utf-8")
+    sections_written.append("env.json")
+    meta["sections"] = sections_written
+    (tmp / "meta.json").write_text(json.dumps(meta, indent=1),
+                                   encoding="utf-8")
+    os.rename(tmp, final)  # the commit point
+    _prune(root)
+    logger.warning("post-mortem bundle captured: %s (%s)", final, reason)
+    return final
+
+
+def _prune(root: Path) -> None:
+    """Newest-K retention over committed bundles, plus sweep of stale
+    temp dirs older than an hour (a crashed capture's leavings)."""
+    try:
+        committed = sorted((p for p in root.iterdir()
+                            if p.is_dir() and not p.name.startswith(".")),
+                           key=lambda p: p.stat().st_mtime)
+        for p in committed[: max(len(committed) - _keep(), 0)]:
+            _rmtree(p)
+        cutoff = time.time() - 3600
+        for p in root.iterdir():
+            if (p.is_dir() and p.name.startswith(".tmp-")
+                    and p.stat().st_mtime < cutoff):
+                _rmtree(p)
+    except OSError:
+        logger.warning("post-mortem retention prune failed", exc_info=True)
+
+
+def _rmtree(path: Path) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Reads (pio postmortem --list/--show)
+# ---------------------------------------------------------------------------
+
+
+def list_bundles(root: Path | str | None = None) -> list[dict]:
+    """Committed bundles newest first: name, path, capture metadata."""
+    root = Path(root) if root else bundles_dir()
+    out: list[dict] = []
+    try:
+        dirs = sorted((p for p in root.iterdir()
+                       if p.is_dir() and not p.name.startswith(".")),
+                      key=lambda p: p.stat().st_mtime, reverse=True)
+    except OSError:
+        return []
+    for p in dirs:
+        meta: dict = {}
+        try:
+            meta = json.loads((p / "meta.json").read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            pass
+        out.append({
+            "name": p.name,
+            "path": str(p),
+            "reason": meta.get("reason"),
+            "capturedAt": meta.get("capturedAt"),
+            "pid": meta.get("pid"),
+            "server": meta.get("server"),
+            "sections": meta.get("sections", []),
+            "sizeBytes": sum(f.stat().st_size for f in p.iterdir()
+                             if f.is_file()),
+        })
+    return out
+
+
+def load_bundle(name: str, root: Path | str | None = None) -> dict:
+    """Every section of one bundle, parsed where JSON. Raises
+    FileNotFoundError for an unknown name."""
+    root = Path(root) if root else bundles_dir()
+    path = root / name
+    if not path.is_dir() or name.startswith("."):
+        raise FileNotFoundError(f"no post-mortem bundle named {name!r} "
+                                f"under {root}")
+    doc: dict = {"name": name, "path": str(path)}
+    for f in sorted(path.iterdir()):
+        if not f.is_file():
+            continue
+        text = f.read_text(encoding="utf-8")
+        if f.suffix == ".json":
+            try:
+                doc[f.stem] = json.loads(text)
+            except ValueError:
+                doc[f.stem] = text
+        else:
+            doc[f.stem] = text
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Crash hooks
+# ---------------------------------------------------------------------------
+
+_installed = False
+_install_lock = threading.Lock()
+
+
+def install() -> None:
+    """Chain bundle capture onto ``sys.excepthook`` and
+    ``threading.excepthook`` (idempotent). The prior hooks still run —
+    the crash still prints — capture happens first, while the process
+    state is intact."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+    prev_sys = sys.excepthook
+    prev_thread = threading.excepthook
+
+    def _sys_hook(exc_type, exc, tb):
+        if exc_type not in (KeyboardInterrupt, SystemExit):
+            capture_bundle("unhandled-exception", exc, auto=True)
+        prev_sys(exc_type, exc, tb)
+
+    def _thread_hook(args):
+        if args.exc_type not in (KeyboardInterrupt, SystemExit):
+            capture_bundle(
+                f"thread-crash-{args.thread.name if args.thread else '?'}",
+                args.exc_value, auto=True)
+        prev_thread(args)
+
+    sys.excepthook = _sys_hook
+    threading.excepthook = _thread_hook

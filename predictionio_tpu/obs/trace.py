@@ -134,10 +134,15 @@ def trace_mode() -> str:
             # instead of silently tracing under the "slow" default
             mode = "off" if float(raw) <= 0.0 else "all"
         except ValueError:
-            logger.warning(
+            # lazy import: logs rides metrics/context only, so trace may
+            # call into it at warn time without an import cycle
+            from predictionio_tpu.obs.logs import warn_once
+
+            warn_once(
+                "trace-bad-mode",
                 "unrecognized %s=%r; falling back to 'slow' "
                 "(valid: off | slow | all | probability in (0,1))",
-                TRACE_ENV, env)
+                TRACE_ENV, env, logger=logger)
             mode = "slow"
     _mode_cache = (env, mode)
     return mode

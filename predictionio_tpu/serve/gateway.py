@@ -38,6 +38,7 @@ import logging
 import queue
 import threading
 import time
+import urllib.parse
 from dataclasses import dataclass
 
 from predictionio_tpu.obs import (
@@ -362,6 +363,7 @@ class Gateway:
         # merged view wins the exact-match table over the per-process
         # default handler every server mounts
         r.add("GET", "/debug/quality", self.get_quality)
+        r.add("GET", "/debug/logs", self.get_logs)
         return r
 
     def get_quality(self, request: Request):
@@ -410,6 +412,64 @@ class Gateway:
             "replicas": docs,
             "merged": quality.merge_docs(
                 [d for d in docs.values() if d]),
+        }
+
+    def get_logs(self, request: Request):
+        """``GET /debug/logs`` on the gateway: the local ring plus every
+        replica's (and the event-server target's, in a split deploy), so
+        one request id is traceable gateway → replica → event server
+        from a single endpoint. Same fan-out as :meth:`get_quality`;
+        merge dedupes the shared process ring of an in-process
+        ``--replicas N`` deploy (obs/logs.merge_docs)."""
+        from predictionio_tpu.obs import fleet, logs
+        from predictionio_tpu.utils.http import HTTPError
+
+        if not logs.logs_enabled():
+            raise HTTPError(404, "structured logs disabled (PIO_LOGS=0)")
+        params = {k: v for k, v in request.query.items()
+                  if k in ("level", "logger", "since", "request_id",
+                           "limit") and v}
+        qs = urllib.parse.urlencode(params)
+        replicas = self.registry.replicas()
+        extra: list[tuple[str, str, int]] = []
+        if self.config.event_server is not None:
+            host, port = self.config.event_server
+            if host in ("0.0.0.0", "::"):
+                host = "127.0.0.1"
+            extra.append((f"event:{host}:{port}", host, port))
+        members = [(r.id, r.host, r.port) for r in replicas] + extra
+        results: list[dict | None] = [None] * len(members)
+
+        def fetch_one(i: int, host: str, port: int) -> None:
+            results[i] = fleet.fetch_json(
+                f"http://{host}:{port}/debug/logs" + (f"?{qs}" if qs else ""),
+                timeout=self.config.fleet_scrape_timeout_sec)
+
+        threads = [threading.Thread(target=fetch_one,
+                                    args=(i, host, port), daemon=True)
+                   for i, (_, host, port) in enumerate(members)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(2.0 * self.config.fleet_scrape_timeout_sec + 0.5)
+        try:
+            since = params.get("since")
+            limit = params.get("limit")
+            local = logs.to_json(
+                level=params.get("level"), logger=params.get("logger"),
+                since=int(since) if since is not None else None,
+                request_id=params.get("request_id"),
+                limit=int(limit) if limit is not None else 500)
+        except ValueError as e:
+            raise HTTPError(400, f"bad filter: {e}") from e
+        docs = {member_id: doc
+                for (member_id, _, _), doc in zip(members, results)}
+        return 200, {
+            "role": "gateway",
+            "local": local,
+            "replicas": docs,
+            "merged": logs.merge_docs(
+                [local] + [d for d in docs.values() if d]),
         }
 
     # -- remediation (`pio doctor --fix`) ------------------------------------

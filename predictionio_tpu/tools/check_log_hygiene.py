@@ -1,0 +1,123 @@
+"""Log-hygiene checker: ``python -m predictionio_tpu.tools.check_log_hygiene``.
+
+The structured log ring (obs/logs.py) hangs ONE handler off the
+``predictionio_tpu`` namespace logger — that design only works if every
+module actually logs under that namespace, and only matters if modules
+log instead of printing. This tool keeps both invariants from rotting:
+
+  1. no bare ``print()`` in library code — ``predictionio_tpu/tools/``
+     is exempt (CLI stdout IS the product there), and the root-level
+     bench entrypoints live outside the package entirely. A print in
+     library code is invisible to ``/debug/logs``, carries no request
+     id, and survives in no post-mortem bundle;
+  2. every ``logging.getLogger`` call resolves inside the
+     ``predictionio_tpu.`` namespace: ``getLogger(__name__)`` (the
+     convention) or a literal starting with the namespace. A logger
+     outside it silently bypasses the ring handler, so its records are
+     exactly the unstructured, uncorrelated lines this layer exists to
+     eliminate.
+
+AST-based, not regex: ``_fingerprint`` must not read as ``print`` and a
+docstring example must not read as a call. Wired into tier-1 as
+tests/test_check_log_hygiene.py, the check_metrics/check_cli_docs
+pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE_REL = "predictionio_tpu"
+
+#: Package-relative directory whose files may print: the CLI/tooling
+#: layer, where stdout is the contract (``pio`` output, checker
+#: reports). Everything else logs.
+PRINT_EXEMPT_PREFIX = "predictionio_tpu/tools/"
+
+LOG_NAMESPACE = "predictionio_tpu"
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def _is_print(node: ast.Call) -> bool:
+    return isinstance(node.func, ast.Name) and node.func.id == "print"
+
+
+def _is_get_logger(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "getLogger":
+        return True
+    return isinstance(f, ast.Name) and f.id == "getLogger"
+
+
+def _logger_name_problem(node: ast.Call) -> str | None:
+    """Why this getLogger call escapes the namespace handler, or None
+    when it provably doesn't."""
+    if not node.args:
+        return "getLogger() names the ROOT logger"
+    arg = node.args[0]
+    if isinstance(arg, ast.Name) and arg.id == "__name__":
+        return None  # module path inside the package: in-namespace
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        name = arg.value
+        if name == LOG_NAMESPACE or name.startswith(LOG_NAMESPACE + "."):
+            return None
+        return f"logger {name!r} is outside the {LOG_NAMESPACE}. namespace"
+    if isinstance(arg, ast.Name) and arg.id == "LOG_NAMESPACE":
+        return None  # obs/logs.py's own constant
+    return ("logger name is dynamic — use getLogger(__name__) so the "
+            "namespace is provable")
+
+
+def check(root: Path | None = None) -> list[str]:
+    """All hygiene problems (empty list = clean)."""
+    root = root or repo_root()
+    package_dir = root / PACKAGE_REL
+    problems: list[str] = []
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"),
+                             filename=rel)
+        except SyntaxError as e:
+            problems.append(f"{rel}: unparseable ({e})")
+            continue
+        exempt_print = rel.startswith(PRINT_EXEMPT_PREFIX)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_print(node) and not exempt_print:
+                problems.append(
+                    f"{rel}:{node.lineno}: bare print() in library code "
+                    "— use logging so the record reaches /debug/logs "
+                    "and post-mortem bundles (tools/ and the bench "
+                    "entrypoints are the only print surfaces)")
+            elif _is_get_logger(node):
+                why = _logger_name_problem(node)
+                if why is not None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: {why} — the structured "
+                        "log handler hangs off the namespace logger, so "
+                        "this logger's records bypass the ring")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"[ERROR] {p}", file=sys.stderr)
+    if problems:
+        print(f"[ERROR] {len(problems)} log-hygiene problem(s).",
+              file=sys.stderr)
+        return 1
+    print("[INFO] log hygiene clean: no bare prints in library code, "
+          "all loggers in the predictionio_tpu. namespace.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

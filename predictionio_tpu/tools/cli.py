@@ -74,6 +74,57 @@ def build_parser() -> argparse.ArgumentParser:
                      help="raw /debug/quality JSON instead of the report")
     p_q.set_defaults(func=cmd_quality)
 
+    # -- structured log pillar (obs/logs.py surfaces) ------------------------
+    p_logs = sub.add_parser(
+        "logs",
+        help="structured log ring of a live deployment: records "
+             "correlated by request id across gateway, replicas, and "
+             "the event server")
+    p_logs.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="gateway (fleet-merged view) or single server")
+    p_logs.add_argument("--level", default=None, metavar="LEVEL",
+                        help="minimum severity (DEBUG..CRITICAL)")
+    p_logs.add_argument("--logger", default=None, metavar="PREFIX",
+                        help="logger-name prefix filter "
+                             "(e.g. predictionio_tpu.serve)")
+    p_logs.add_argument("--request-id", default=None, metavar="ID",
+                        help="only records logged while serving this "
+                             "X-Request-ID / trace id")
+    p_logs.add_argument("--limit", type=int, default=100, metavar="N",
+                        help="newest N records (default 100)")
+    p_logs.add_argument("--follow", action="store_true",
+                        help="keep polling and print new records "
+                             "(Ctrl-C to stop)")
+    p_logs.add_argument("--interval", type=float, default=2.0,
+                        metavar="SEC",
+                        help="--follow poll period (default 2s)")
+    p_logs.add_argument("--json", action="store_true",
+                        help="raw JSON records instead of formatted lines")
+    p_logs.set_defaults(func=cmd_logs)
+
+    # -- flight recorder (obs/postmortem.py surfaces) ------------------------
+    p_pm = sub.add_parser(
+        "postmortem",
+        help="flight-recorder bundles: capture one from a live server, "
+             "list retained bundles, or render one (--show)")
+    p_pm.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server to capture from (POST /debug/postmortem)")
+    p_pm.add_argument("--list", action="store_true", dest="list_bundles",
+                      help="list bundles retained on this host")
+    p_pm.add_argument("--show", default=None, metavar="NAME",
+                      help="render one bundle: crash, thread stacks, "
+                           "last log ring, HBM snapshot")
+    p_pm.add_argument("--dir", default=None, metavar="DIR",
+                      help="bundle directory (default PIO_POSTMORTEM_DIR "
+                           "/ ~/.predictionio_tpu/postmortem)")
+    p_pm.add_argument("--reason", default="on-demand",
+                      help="reason recorded in the captured bundle")
+    p_pm.add_argument("--json", action="store_true",
+                      help="machine-readable output")
+    p_pm.set_defaults(func=cmd_postmortem)
+
     # -- training-run observatory (obs/runlog.py surfaces) -------------------
     p_runs = sub.add_parser(
         "runs",
@@ -702,6 +753,15 @@ def cmd_deploy(args) -> int:
     variant = _load_variant(args.engine_json)
     if variant is None:
         return 1
+    # process-default log attribution for records outside any request
+    # (startup, trainers, batcher threads); per-request attribution
+    # comes from the AppServer handler's contextvar
+    from predictionio_tpu.obs import logs as _logs_mod
+
+    _logs_mod.set_server_name(
+        "gateway" if (getattr(args, "replicas", 1) > 1
+                      or getattr(args, "max_replicas", None))
+        else "query")
     if args.port:  # ref: CreateServer.scala:288-310 undeploy-before-bind
         undeploy(args.ip, args.port)
     config = ServerConfig(
@@ -730,7 +790,7 @@ def cmd_deploy(args) -> int:
     print(f"[INFO] Engine is deployed and running. Engine API is live at "
           f"http://{args.ip}:{server.port}.")
     trainer = _maybe_auto_train(args, variant, server.port)
-    _install_sigterm(service._stop_event.set)
+    _install_sigterm(_with_postmortem(service._stop_event.set))
     try:
         service.wait_for_stop()
     except KeyboardInterrupt:
@@ -781,6 +841,21 @@ def _install_sigterm(callback) -> None:
         signal.signal(signal.SIGTERM, lambda _sig, _frm: callback())
     except ValueError:
         pass
+
+
+def _with_postmortem(stop_callback):
+    """Wrap a deploy's graceful-stop callback so SIGTERM first freezes a
+    flight-recorder bundle (obs/postmortem.py) while the rings are still
+    live, THEN stops. Capture is fail-soft and rate-unlimited here —
+    a terminating deploy captures at most once."""
+
+    def _cb():
+        from predictionio_tpu.obs import postmortem
+
+        postmortem.capture_bundle("sigterm")
+        stop_callback()
+
+    return _cb
 
 
 def _deploy_gateway(args, config, variant=None) -> int:
@@ -863,8 +938,10 @@ def _deploy_gateway(args, config, variant=None) -> int:
                else _maybe_auto_train(args, variant, dep.port))
     # `pio stop-all` SIGTERMs this process: translate it into the same
     # graceful stop as GET /stop, so replicas drain their micro-batchers
-    # (no race against a mid-flight deferred finalize) before exit
-    _install_sigterm(dep.gateway._stop_event.set)
+    # (no race against a mid-flight deferred finalize) before exit —
+    # after the flight recorder freezes the rings (docs/operations.md
+    # § Logs & post-mortems)
+    _install_sigterm(_with_postmortem(dep.gateway._stop_event.set))
     try:
         dep.wait_for_stop()
     except KeyboardInterrupt:
@@ -1265,6 +1342,7 @@ def cmd_doctor(args) -> int:
     from pathlib import Path
 
     from predictionio_tpu.obs import fleet, runlog
+    from predictionio_tpu.obs import logs as logs_mod
     from predictionio_tpu.train import continuous as continuous_mod
 
     train_findings = runlog.diagnose_runs(getattr(args, "runs_dir", None))
@@ -1301,9 +1379,15 @@ def cmd_doctor(args) -> int:
         # STALLED-LOOP distinguishes "staleness burns AND the registered
         # trainer's watermark is stuck" from plain staleness without an
         # actuator
+        # LOG-STORM judgment (obs/logs.py): the error_log_rate series the
+        # server's history sampler already recorded, judged client-side
+        # like every other fetched surface
+        history_doc = _fetch_json(
+            f"{base}/debug/history?series=error_log_rate&seconds=300")
         findings = (train_findings
                     + continuous_mod.diagnose_trainers(
                         slo_state, directory=trainer_dir)
+                    + logs_mod.diagnose_history_doc(history_doc)
                     + fleet.diagnose(
                         status if is_gateway else None, members,
                         slo_state, traces[: args.traces],
@@ -1314,6 +1398,25 @@ def cmd_doctor(args) -> int:
         actions = _doctor_fix(base, findings,
                               dry_run=getattr(args, "dry_run", False),
                               is_gateway=is_gateway)
+        if rc == 1 and status is not None \
+                and not getattr(args, "dry_run", False):
+            # critical findings under --fix: freeze the evidence BEFORE
+            # remediation mutates the fleet — restarts wipe exactly the
+            # rings an operator would want afterwards
+            got = fleet.post_json(f"{base}/debug/postmortem",
+                                  {"reason": "doctor-fix-critical"},
+                                  timeout=30.0)
+            if got is not None and got[0] == 200:
+                actions.append({"action": "postmortem", "replica": "-",
+                                "result": "captured",
+                                "detail": got[1].get("path", "")})
+            else:
+                actions.append({
+                    "action": "postmortem", "replica": "-",
+                    "result": "skipped",
+                    "detail": ("flight recorder disabled or unreachable"
+                               if got is None or got[0] == 404
+                               else f"HTTP {got[0]}")})
     if args.json:
         print(_json.dumps({"url": base, "findings": findings,
                            "actions": actions}, indent=2))
@@ -1407,7 +1510,218 @@ def cmd_trace(args) -> int:
         return 0
     for doc in docs:
         print(render_waterfall_text(doc))
+        # interleave the structured log ring by trace id (= request id):
+        # the waterfall says WHERE the time went, the records say what
+        # the code had to say while it went. Fail-soft — logs disabled
+        # (PIO_LOGS=0) or an older server just renders the bare trace.
+        body = _fetch_json(
+            f"{args.url.rstrip('/')}/debug/logs?"
+            + urllib.parse.urlencode({"request_id": doc["traceId"]}))
+        for rec in _log_docs_records(body):
+            print("  log " + _format_log_record(rec))
         print()
+    return 0
+
+
+def _log_docs_records(body: dict | None) -> list[dict]:
+    """Records from either /debug/logs shape: the gateway's fan-out doc
+    nests them under ``merged``; a bare server's doc has them at top
+    level."""
+    if not isinstance(body, dict):
+        return []
+    doc = body.get("merged") if isinstance(body.get("merged"), dict) \
+        else body
+    return doc.get("records") or []
+
+
+def _format_log_record(r: dict) -> str:
+    import time as _time
+
+    ts = r.get("ts") or 0
+    stamp = _time.strftime("%H:%M:%S", _time.localtime(ts))
+    rid = r.get("request_id") or "-"
+    line = (f"{stamp}.{int((ts % 1) * 1000):03d} "
+            f"{r.get('level', '?'):<8} [{r.get('server', '-')}] "
+            f"{r.get('logger', '?')} rid={rid} {r.get('msg', '')}")
+    if r.get("exc"):
+        first = str(r["exc"]).strip().splitlines()[-1:]
+        line += f"  ({first[0] if first else 'traceback in --json'})"
+    return line
+
+
+def cmd_logs(args) -> int:
+    """``pio logs``: the structured log ring of a live deployment —
+    fleet-merged through a gateway front door (every replica + the
+    event-server target), filterable by severity, logger prefix, and
+    request id, and tailable with ``--follow``. See docs/operations.md
+    § Logs & post-mortems."""
+    import json as _json
+    import time as _time
+    import urllib.parse
+
+    base = args.url.rstrip("/")
+    params = {}
+    if args.level:
+        params["level"] = args.level
+    if args.logger:
+        params["logger"] = args.logger
+    if args.request_id:
+        params["request_id"] = args.request_id
+    if args.limit:
+        params["limit"] = str(args.limit)
+    url = f"{base}/debug/logs"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+
+    def fetch() -> tuple[dict | None, list[dict]]:
+        body = _fetch_json(url)
+        return body, _log_docs_records(body)
+
+    body, records = fetch()
+    if body is None:
+        print(f"[ERROR] cannot read {base}/debug/logs — deployment down "
+              "or structured logs disabled (PIO_LOGS=0)?",
+              file=sys.stderr)
+        return 1
+    if args.json and not args.follow:
+        print(_json.dumps(body, indent=2))
+        return 0
+    for rec in records:
+        print(_json.dumps(rec) if args.json
+              else _format_log_record(rec))
+    if not records and not args.follow:
+        print("[INFO] no matching log records retained "
+              "(ring wrapped, or filters too narrow).")
+    if not args.follow:
+        return 0
+    # follow: re-fetch on the interval and print only unseen records.
+    # Dedupe client-side (seq+ts+logger+msg) instead of a seq cursor —
+    # a fleet merge spans processes whose seq counters are unrelated.
+    seen = {(r.get("seq"), r.get("ts"), r.get("logger"), r.get("msg"))
+            for r in records}
+    try:
+        while True:
+            _time.sleep(args.interval)
+            _, records = fetch()
+            for rec in records:
+                key = (rec.get("seq"), rec.get("ts"), rec.get("logger"),
+                       rec.get("msg"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                print(_json.dumps(rec) if args.json
+                      else _format_log_record(rec))
+            if len(seen) > 50_000:  # bounded for a long tail session
+                seen = {(r.get("seq"), r.get("ts"), r.get("logger"),
+                         r.get("msg")) for r in records}
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_postmortem(args) -> int:
+    """``pio postmortem``: the flight recorder's operator surface —
+    trigger a capture on a live server (default), ``--list`` retained
+    bundles, ``--show <name>`` to render one (thread stacks, last log
+    ring, HBM snapshot, the crash that triggered it)."""
+    import json as _json
+    import time as _time
+
+    from predictionio_tpu.obs import postmortem
+
+    root = getattr(args, "dir", None)
+    if args.list_bundles:
+        bundles = postmortem.list_bundles(root)
+        if args.json:
+            print(_json.dumps(bundles, indent=2))
+            return 0
+        if not bundles:
+            print(f"[INFO] no post-mortem bundles under "
+                  f"{root or postmortem.bundles_dir()}.")
+            return 0
+        for b in bundles:
+            when = (_time.strftime("%Y-%m-%d %H:%M:%S",
+                                   _time.localtime(b["capturedAt"]))
+                    if b.get("capturedAt") else "?")
+            print(f"{b['name']:<44} {when}  pid {b.get('pid') or '?':<7} "
+                  f"{b.get('reason') or '?'}  "
+                  f"({b['sizeBytes'] / 1024:.0f} KiB)")
+        return 0
+    if args.show:
+        try:
+            doc = postmortem.load_bundle(args.show, root)
+        except FileNotFoundError as e:
+            print(f"[ERROR] {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(_json.dumps(doc, indent=2, default=str))
+            return 0
+        meta = doc.get("meta") or {}
+        when = (_time.strftime("%Y-%m-%d %H:%M:%S",
+                               _time.localtime(meta["capturedAt"]))
+                if meta.get("capturedAt") else "?")
+        print(f"[INFO] bundle {doc['name']}")
+        print(f"  reason   {meta.get('reason') or '?'}   captured {when}  "
+              f"pid {meta.get('pid') or '?'}  "
+              f"server {meta.get('server') or '-'}")
+        exc = meta.get("exception")
+        if exc:
+            print(f"  crash    {exc.get('type')}: {exc.get('message')}")
+            for line in (exc.get("traceback") or "").rstrip() \
+                    .splitlines()[-6:]:
+                print(f"    {line}")
+        device = doc.get("device") or {}
+        if device:
+            total = device.get("totalBytes") or device.get("total_bytes")
+            peak = device.get("peakTotalBytes") or device.get(
+                "peak_total_bytes")
+            print(f"  hbm      live {total if total is not None else '?'}"
+                  f" B, peak {peak if peak is not None else '?'} B, "
+                  f"{len(device.get('arenas') or {})} arena(s)")
+        runs = doc.get("runs") or []
+        if runs:
+            r = runs[0]
+            print(f"  last run {r.get('runId')} [{r.get('status')}] "
+                  f"{r.get('phase') or ''}")
+        logdoc = doc.get("logs") or {}
+        tail = (logdoc.get("records") or [])[-15:]
+        if tail:
+            print(f"  log ring (last {len(tail)} of "
+                  f"{logdoc.get('count', len(tail))}):")
+            for rec in tail:
+                print("    " + _format_log_record(rec))
+        stacks = doc.get("stacks") or ""
+        if stacks:
+            lines = stacks.rstrip().splitlines()
+            print(f"  thread stacks ({len(lines)} lines):")
+            for line in lines[:40]:
+                print(f"    {line}")
+            if len(lines) > 40:
+                print(f"    ... {len(lines) - 40} more lines in "
+                      f"{doc['path']}/stacks.txt")
+        return 0
+    # default: trigger a capture on the live server
+    from predictionio_tpu.obs.fleet import post_json
+
+    base = args.url.rstrip("/")
+    got = post_json(f"{base}/debug/postmortem",
+                    {"reason": args.reason}, timeout=30.0)
+    if got is None:
+        print(f"[ERROR] cannot reach {base} — is the deployment up? "
+              "(use --list/--show for bundles already on disk)",
+              file=sys.stderr)
+        return 1
+    http_status, body = got
+    if http_status != 200:
+        print(f"[ERROR] capture failed: HTTP {http_status} "
+              f"{body.get('message', '')}".rstrip(), file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(body, indent=2))
+        return 0
+    print(f"[INFO] captured post-mortem bundle {body.get('bundle')} "
+          f"at {body.get('path')}")
+    print("[INFO] render it with `pio postmortem --show "
+          f"{body.get('bundle')}`.")
     return 0
 
 
@@ -1670,7 +1984,11 @@ def cmd_eventserver(args) -> int:
         EventServerConfig,
         create_event_server,
     )
+    from predictionio_tpu.obs import logs as _logs_mod
 
+    # records logged outside a request (ingest workers, compaction)
+    # still attribute to this process's role in the log ring
+    _logs_mod.set_server_name("event")
     workers = getattr(args, "workers", 1)
     config = EventServerConfig(
         ip=args.ip, port=args.port, stats=args.stats, workers=workers

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import html
 import os
+import time as _time
 
 from predictionio_tpu.data.storage import Storage
 from predictionio_tpu.data.storage.base import EvaluationInstance
@@ -47,6 +48,7 @@ _PAGE = """<!DOCTYPE html>
 {metrics}
 {device}
 {traces}
+{logs}
 </body></html>"""
 
 _METRICS_FOOTER = ('<p>Serving latency (this process): {latency} &middot; '
@@ -390,6 +392,64 @@ def _traces_panel(limit: int = 5) -> str:
             "process (<code>/debug/traces</code>, <code>pio trace</code>)."
             "</p>" + "".join(blocks))
 
+def _logs_panel(gw_status, limit: int = 15) -> str:
+    """Recent warnings/errors panel (obs/logs.py): the newest WARNING+
+    structured log records, fleet-merged through the gateway's
+    ``/debug/logs`` fan-out when one answers (skipped when index()'s
+    shared status fetch already failed — same rule as the other
+    panels), falling back to this process's own ring. Records arrive
+    redacted; escape-only rendering here."""
+    from predictionio_tpu.obs import logs
+
+    # like /debug/quality, the gateway's answer waits on a per-member
+    # fan-out — give it the long timeout or the panel silently falls
+    # back to this process's (usually quiet) ring
+    doc = (_fetch_json(
+        f"{_gateway_url()}/debug/logs?level=WARNING&limit={limit}",
+        timeout=5.0) if gw_status is not None else None)
+    source = f"gateway {_gateway_url()}"
+    if doc is None:
+        if not logs.logs_enabled():
+            return ("<h2>Recent warnings &amp; errors</h2>"
+                    "<p>Structured logging is off (PIO_LOGS=0).</p>")
+        doc = logs.to_json(level="WARNING", limit=limit)
+        source = "this process"
+    recs = (doc.get("merged") or doc).get("records") or []
+    if not recs:
+        return ("<h2>Recent warnings &amp; errors</h2>"
+                "<p>No WARNING-or-worse records retained "
+                "(<code>GET /debug/logs</code>, <code>pio logs</code>)."
+                "</p>")
+    rows = []
+    for r in recs[-limit:]:
+        ts = r.get("ts")
+        when = (_time.strftime("%H:%M:%S", _time.localtime(ts))
+                + f".{int((ts % 1) * 1000):03d}") if ts else "n/a"
+        level = str(r.get("level", "?"))
+        color = "#c33" if level in ("ERROR", "CRITICAL") else "#b80"
+        msg = str(r.get("msg", ""))
+        exc = r.get("exc")
+        if exc:
+            last = exc.strip().splitlines()[-1] if exc.strip() else ""
+            msg = f"{msg} — {last}"
+        rows.append(
+            f"<tr><td>{html.escape(when)}</td>"
+            f"<td style='color:{color}'><b>{html.escape(level)}</b></td>"
+            f"<td>{html.escape(str(r.get('server', '-')))}</td>"
+            f"<td>{html.escape(str(r.get('logger', '')))}</td>"
+            f"<td>{html.escape(str(r.get('request_id') or '-'))}</td>"
+            f"<td>{html.escape(msg)}</td></tr>")
+    return (
+        "<h2>Recent warnings &amp; errors</h2>"
+        f"<p>Newest WARNING+ structured log records "
+        f"({html.escape(source)}; <code>GET /debug/logs</code>, "
+        "<code>pio logs --follow</code>; crash bundles via "
+        "<code>pio postmortem</code>).</p>"
+        "<table><tr><th>time</th><th>level</th><th>server</th>"
+        "<th>logger</th><th>request id</th><th>message</th></tr>"
+        + "".join(rows) + "</table>")
+
+
 _ROW = ("<tr><td>{id}</td><td>{start}</td><td>{end}</td><td>{cls}</td>"
         "<td>{gen}</td><td>{batch}</td><td>{result}</td>"
         '<td><a href="/engine_instances/{id}/evaluator_results.html">HTML</a> '
@@ -426,7 +486,8 @@ def build_router() -> Router:
             slo=_slo_banner(gw_status), fleet=_fleet_panel(gw_status),
             quality=_quality_panel(gw_status),
             history=_history_panel(gw_status),
-            device=_device_panel(), traces=_traces_panel()))
+            device=_device_panel(), traces=_traces_panel(),
+            logs=_logs_panel(gw_status)))
 
     def _get(request: Request, running: bool = False) -> EvaluationInstance:
         iid = request.path_params["instance_id"]

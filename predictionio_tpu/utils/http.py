@@ -28,6 +28,7 @@ from predictionio_tpu.obs import (
     request_id_var,
     trace,
 )
+from predictionio_tpu.obs import logs as _logs
 from predictionio_tpu.obs.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
@@ -40,7 +41,8 @@ logger = logging.getLogger(__name__)
 #: sleeps for the capture window.
 UNTRACED_PATHS = frozenset(
     {"/metrics", "/metrics/fleet", "/debug/traces", "/debug/profile",
-     "/debug/faults", "/debug/history", "/debug/slo", "/debug/quality"})
+     "/debug/faults", "/debug/history", "/debug/slo", "/debug/quality",
+     "/debug/logs", "/debug/postmortem"})
 
 # Per-server HTTP telemetry, shared by every AppServer in the process
 # (the ``server`` label separates event/query/admin/dashboard traffic).
@@ -499,6 +501,11 @@ class AppServer:
                 # the feedback loop can pick it up without plumbing
                 rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
                 rid_token = request_id_var.set(rid)
+                # server attribution for structured log records: one
+                # process hosts several AppServers (gateway + in-process
+                # replicas), so the ring needs to know WHICH one served
+                # the request that logged
+                sn_token = _logs.server_name_var.set(server_name)
                 # server span per request: the trace id IS the request
                 # id, the remote parent rides X-Parent-Span, and the
                 # caller's sampling decision rides X-Trace-Sampled (so a
@@ -578,6 +585,7 @@ class AppServer:
                         # access-log record carries %(request_id)s
                         self.log_request(status, len(data))
                 finally:
+                    _logs.server_name_var.reset(sn_token)
                     request_id_var.reset(rid_token)
 
             do_GET = do_POST = do_DELETE = do_PUT = _handle
@@ -760,6 +768,41 @@ def add_metrics_route(router: Router,
                                  "(PIO_QUALITY_SAMPLE=off)")
         return 200, quality.MONITOR.to_json()
 
+    def debug_logs(request: Request):
+        if not _logs.logs_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/traces contract under PIO_TRACE=off
+            raise HTTPError(404, "structured logs disabled (PIO_LOGS=0)")
+        try:
+            since = request.query.get("since")
+            limit = request.query.get("limit")
+            return 200, _logs.to_json(
+                level=request.query.get("level"),
+                logger=request.query.get("logger"),
+                since=int(since) if since is not None else None,
+                request_id=request.query.get("request_id"),
+                limit=int(limit) if limit is not None else 500,
+            )
+        except ValueError as e:
+            raise HTTPError(400, f"bad filter: {e}") from e
+
+    def debug_postmortem(request: Request):
+        from predictionio_tpu.obs import postmortem
+
+        if not postmortem.postmortem_enabled():
+            # disabled must look exactly like the feature not being
+            # there (404) — the /debug/traces contract under PIO_TRACE=off
+            raise HTTPError(404, "flight recorder disabled "
+                                 "(PIO_POSTMORTEM=0)")
+        body = request.json()
+        if body is not None and not isinstance(body, dict):
+            raise HTTPError(400, "JSON object expected")
+        reason = str((body or {}).get("reason") or "on-demand")
+        path = postmortem.capture_bundle(reason)
+        if path is None:
+            raise HTTPError(503, "post-mortem capture failed")
+        return 200, {"bundle": path.name, "path": str(path)}
+
     router.add("GET", "/metrics", metrics)
     router.add("GET", "/debug/traces", debug_traces)
     router.add("POST", "/debug/profile", debug_profile)
@@ -768,11 +811,19 @@ def add_metrics_route(router: Router,
     router.add("GET", "/debug/history", debug_history)
     router.add("GET", "/debug/slo", debug_slo)
     router.add("GET", "/debug/quality", debug_quality)
+    router.add("GET", "/debug/logs", debug_logs)
+    router.add("POST", "/debug/postmortem", debug_postmortem)
     # kick the process history sampler (no-op when disabled): every
     # server that mounts the scrape surface also records local history
     from predictionio_tpu.obs import history as _history
 
     _history.ensure_started()
+    # ... and feeds the structured log ring + crash flight recorder:
+    # the sixth pillar is installed wherever the scrape surface is
+    _logs.install()
+    from predictionio_tpu.obs import postmortem as _postmortem
+
+    _postmortem.install()
     return router
 
 

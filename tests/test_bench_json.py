@@ -59,6 +59,8 @@ def test_serving_bench_dry_run_last_stdout_line_is_the_headline_json():
     assert doc["unit"] == "ms"
     # the tracing-off overhead guard figure must always ride the headline
     assert "trace_overhead_frac" in doc["extra"]
+    # ...and ISSUE 16's structured-log guard rides next to it
+    assert "log_overhead_frac" in doc["extra"]
     # ISSUE 8: the device-resident-serving keys ride every capture —
     # dry runs emit them as explicit nulls so the schema is stable
     for key in ("serve_placement", "serve_device_qps",

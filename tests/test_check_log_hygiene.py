@@ -1,0 +1,78 @@
+"""Log-hygiene checker (tools/check_log_hygiene.py): tier-1 wiring that
+keeps library code print-free and every logger inside the
+``predictionio_tpu.`` namespace (so the structured ring handler sees
+it), plus unit coverage of the AST rules on a synthetic tree."""
+
+from pathlib import Path
+
+from predictionio_tpu.tools.check_log_hygiene import check
+
+
+def test_repo_is_hygiene_clean():
+    """THE guard: no bare print() outside tools/, no logger that would
+    bypass the namespace ring handler."""
+    assert check() == []
+
+
+def _write_pkg(root: Path, files: dict[str, str]) -> Path:
+    pkg = root / "predictionio_tpu"
+    for rel, text in files.items():
+        path = pkg / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+    return root
+
+
+def test_bare_print_in_library_code_flagged(tmp_path):
+    _write_pkg(tmp_path, {
+        "engine.py": 'def f():\n    print("debug")\n',
+        "tools/cli.py": 'def g():\n    print("cli output is fine")\n',
+    })
+    problems = check(tmp_path)
+    assert len(problems) == 1
+    assert "engine.py:2" in problems[0] and "print()" in problems[0]
+
+
+def test_docstring_print_examples_are_not_calls(tmp_path):
+    _write_pkg(tmp_path, {
+        "mesh.py": 'def f():\n    """Example:\n        print(ctx)\n    """\n',
+    })
+    assert check(tmp_path) == []
+
+
+def test_method_named_print_is_not_flagged(tmp_path):
+    """Only the builtin counts — obj.print() is someone's API."""
+    _write_pkg(tmp_path, {
+        "report.py": "def f(doc):\n    doc.print()\n",
+    })
+    assert check(tmp_path) == []
+
+
+def test_off_namespace_loggers_flagged(tmp_path):
+    _write_pkg(tmp_path, {
+        "a.py": ('import logging\n'
+                 'log = logging.getLogger()\n'),
+        "b.py": ('import logging\n'
+                 'log = logging.getLogger("myapp.thing")\n'),
+        "c.py": ('import logging\n'
+                 'def f(name):\n'
+                 '    return logging.getLogger(name)\n'),
+    })
+    problems = check(tmp_path)
+    assert len(problems) == 3
+    assert any("a.py:2" in p and "ROOT" in p for p in problems)
+    assert any("b.py:2" in p and "myapp.thing" in p for p in problems)
+    assert any("c.py:3" in p and "dynamic" in p for p in problems)
+
+
+def test_in_namespace_loggers_pass(tmp_path):
+    _write_pkg(tmp_path, {
+        "a.py": ('import logging\n'
+                 'log = logging.getLogger(__name__)\n'),
+        "b.py": ('import logging\n'
+                 'log = logging.getLogger("predictionio_tpu.obs.x")\n'),
+        "c.py": ('from logging import getLogger\n'
+                 'LOG_NAMESPACE = "predictionio_tpu"\n'
+                 'log = getLogger(LOG_NAMESPACE)\n'),
+    })
+    assert check(tmp_path) == []

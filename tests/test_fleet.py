@@ -486,6 +486,7 @@ def test_bench_compare_direction_heuristic():
     assert not lower_is_better("two_tower_examples_per_sec")
     # frac keys split by shape: overhead is a cost, overlap a win
     assert lower_is_better("trace_overhead_frac")
+    assert lower_is_better("log_overhead_frac")
     assert not lower_is_better("serve_readback_overlap_frac")
     assert not lower_is_better("gateway_cache_hit_rate")
 

@@ -1,0 +1,487 @@
+"""Structured log pillar (obs/logs.py): redaction, ring mechanics,
+storm suppression, warn_once, the /debug/logs surface (404-when-off
+contract, filters, request-id correlation through a live server), the
+gateway fan-out merge, the error_log_rate LOG-STORM judgment, and the
+pio logs CLI rendering."""
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import logs
+from predictionio_tpu.obs.context import request_id_var
+from predictionio_tpu.utils.http import AppServer, Router, add_metrics_route
+
+LOG = logging.getLogger("predictionio_tpu.tests.logs")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    """Empty ring + attached handler per test; leave the process in the
+    installed state other suites expect."""
+    logs.reset()
+    logs.install()
+    yield
+    logs.reset()
+    logs.install()
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+# -- redaction ----------------------------------------------------------------
+
+
+def test_redact_strips_access_keys_env_secrets_and_jdbc_credentials():
+    assert logs.redact("accessKey=sk-hostile-12345 rest") == \
+        "accessKey=[REDACTED] rest"
+    assert logs.redact("access_key: abc&x=1") == "access_key: [REDACTED]&x=1"
+    assert "[REDACTED]" in logs.redact("PIO_ACCESS_KEY=deadbeef")
+    assert "deadbeef" not in logs.redact("PIO_ACCESS_KEY=deadbeef")
+    jdbc = "jdbc:postgresql://pio:s3cr3t@db:5432/pio"
+    red = logs.redact(jdbc)
+    assert "s3cr3t" not in red and "pio:[REDACTED]@db" in red
+    # non-secret text passes through untouched
+    assert logs.redact("scored 10 items in 3ms") == "scored 10 items in 3ms"
+
+
+def test_hostile_access_key_logged_on_purpose_never_reaches_the_ring():
+    """THE regression pin from the issue: a call site that logs a
+    credential verbatim must not leak it through /debug/logs."""
+    LOG.warning("auth failed for accessKey=sk-live-EVIL999 from 10.0.0.9")
+    try:
+        raise RuntimeError("bad token=tok-EVIL888 in request")
+    except RuntimeError:
+        LOG.error("query rejected", exc_info=True)
+    text = json.dumps(logs.to_json())
+    assert "sk-live-EVIL999" not in text
+    assert "tok-EVIL888" not in text
+    assert text.count("[REDACTED]") >= 2
+
+
+def test_redact_env_wholesale_for_secret_names():
+    env = {"PIO_ACCESS_KEY": "deadbeef", "MY_PASSWORD": "hunter2",
+           "PIO_EVENT_PORT": "7070",
+           "DB_URL": "postgresql://u:pw@host/db"}
+    red = logs.redact_env(env)
+    assert red["PIO_ACCESS_KEY"] == "[REDACTED]"
+    assert red["MY_PASSWORD"] == "[REDACTED]"
+    assert red["PIO_EVENT_PORT"] == "7070"
+    assert "pw" not in red["DB_URL"] and "[REDACTED]" in red["DB_URL"]
+
+
+# -- ring mechanics -----------------------------------------------------------
+
+
+def test_records_carry_structure_and_filters_compose():
+    rid_token = request_id_var.set("rid-logs-1")
+    try:
+        LOG.info("structured %s", "hello")
+        LOG.warning("watch out")
+    finally:
+        request_id_var.reset(rid_token)
+    LOG.error("later, no rid")
+    recs = logs.records()
+    assert [r["msg"] for r in recs] == \
+        ["structured hello", "watch out", "later, no rid"]
+    first = recs[0]
+    assert first["level"] == "INFO"
+    assert first["logger"] == "predictionio_tpu.tests.logs"
+    assert first["request_id"] == "rid-logs-1"
+    assert first["seq"] == 1 and isinstance(first["ts"], float)
+    assert recs[2]["request_id"] == "-"
+    # level is a minimum severity
+    assert [r["msg"] for r in logs.records(level="warning")] == \
+        ["watch out", "later, no rid"]
+    with pytest.raises(ValueError):
+        logs.records(level="noise")
+    # logger prefix, request-id exact, seq watermark, tail limit
+    assert len(logs.records(logger="predictionio_tpu.tests")) == 3
+    assert logs.records(logger="predictionio_tpu.serve") == []
+    assert [r["msg"] for r in logs.records(request_id="rid-logs-1")] == \
+        ["structured hello", "watch out"]
+    assert [r["msg"] for r in logs.records(since=2)] == ["later, no rid"]
+    assert [r["msg"] for r in logs.records(limit=1)] == ["later, no rid"]
+
+
+def test_ring_is_bounded_by_pio_log_ring(monkeypatch):
+    monkeypatch.setenv("PIO_LOG_RING", "16")
+    monkeypatch.setenv("PIO_LOG_STORM_MAX", "0")  # suppression off: the
+    # shared "r%d" template would otherwise read as one storm
+    for i in range(40):
+        LOG.info("r%d", i)
+    doc = logs.to_json()
+    assert doc["capacity"] == 16
+    assert doc["count"] == 16
+    assert doc["lastSeq"] == 40
+    assert doc["records"][-1]["msg"] == "r39"
+    assert doc["records"][0]["msg"] == "r24"  # oldest survivors only
+
+
+def test_disabled_ring_records_nothing(monkeypatch):
+    monkeypatch.setenv("PIO_LOGS", "0")
+    LOG.warning("into the void")
+    assert logs.records() == []
+    monkeypatch.setenv("PIO_LOGS", "1")
+    LOG.warning("back on")
+    assert [r["msg"] for r in logs.records()] == ["back on"]
+
+
+def test_record_counter_counts_by_level_and_logger():
+    c = logs._RECORDS_TOTAL
+    name = "predictionio_tpu.tests.logs"
+    before = c.value(level="WARNING", logger=name)
+    LOG.warning("counted")
+    LOG.warning("counted again")
+    assert c.value(level="WARNING", logger=name) == before + 2
+
+
+def test_exception_records_store_redacted_traceback():
+    try:
+        raise ValueError("password=opensesame rejected")
+    except ValueError:
+        LOG.error("boom", exc_info=True)
+    rec = logs.records()[-1]
+    assert "Traceback" in rec["exc"]
+    assert "opensesame" not in rec["exc"]
+    assert "ValueError" in rec["exc"]
+
+
+# -- storm suppression --------------------------------------------------------
+
+
+def test_storm_suppression_bounds_repeats_and_counts_drops(monkeypatch):
+    monkeypatch.setenv("PIO_LOG_STORM_MAX", "5")
+    monkeypatch.setenv("PIO_LOG_STORM_WINDOW_S", "30")
+    dropped_before = logs._SUPPRESSED_TOTAL.value(
+        logger="predictionio_tpu.tests.logs")
+    for i in range(12):
+        LOG.warning("retry %d failed", i)  # one template = one storm
+    recs = logs.records()
+    assert len(recs) == 5  # admitted up to the cap, rest dropped
+    assert logs._SUPPRESSED_TOTAL.value(
+        logger="predictionio_tpu.tests.logs") == dropped_before + 7
+    # every record the handler saw is still counted, dropped or not
+    assert logs._RECORDS_TOTAL.value(
+        level="WARNING", logger="predictionio_tpu.tests.logs") >= 12
+
+
+def test_storm_summary_record_lands_when_the_window_rolls(monkeypatch):
+    monkeypatch.setenv("PIO_LOG_STORM_MAX", "2")
+    monkeypatch.setenv("PIO_LOG_STORM_WINDOW_S", "0.05")
+    for i in range(6):
+        LOG.warning("flood %d", i)
+    time.sleep(0.1)
+    LOG.warning("flood %d", 99)  # new window: summarizes the 4 drops
+    summaries = [r for r in logs.records() if "suppressed" in r]
+    assert len(summaries) == 1
+    assert summaries[0]["suppressed"] == 4
+    assert "dropped 4 repeat(s)" in summaries[0]["msg"]
+    assert summaries[0]["level"] == "WARNING"
+
+
+def test_storm_suppression_disabled_when_max_nonpositive(monkeypatch):
+    monkeypatch.setenv("PIO_LOG_STORM_MAX", "0")
+    for i in range(30):
+        LOG.warning("unbounded %d", i)
+    assert len(logs.records()) == 30
+
+
+def test_distinct_templates_are_distinct_storms(monkeypatch):
+    monkeypatch.setenv("PIO_LOG_STORM_MAX", "3")
+    for i in range(5):
+        LOG.warning("storm A %d", i)
+        LOG.warning("storm B %d", i)
+    msgs = [r["msg"] for r in logs.records()]
+    assert sum(m.startswith("storm A") for m in msgs) == 3
+    assert sum(m.startswith("storm B") for m in msgs) == 3
+
+
+# -- warn_once ----------------------------------------------------------------
+
+
+def test_warn_once_logs_once_counts_every_call():
+    before = logs._WARN_ONCE_TOTAL.value(key="test-key-1")
+    assert logs.warn_once("test-key-1", "first sighting of %s", "thing")
+    assert not logs.warn_once("test-key-1", "never rendered")
+    assert not logs.warn_once("test-key-1", "never rendered")
+    assert logs.warn_once("test-key-2", "different key logs")
+    assert logs._WARN_ONCE_TOTAL.value(key="test-key-1") == before + 3
+    hits = [r for r in logs.records()
+            if r["msg"] == "first sighting of thing"]
+    assert len(hits) == 1 and hits[0]["level"] == "WARNING"
+
+
+def test_consolidated_callers_route_through_warn_once(monkeypatch):
+    """The satellites' consolidation: metrics' series-bound guard now
+    warns through the shared helper (once per family, counted)."""
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+
+    monkeypatch.setenv("PIO_METRICS_MAX_SERIES", "2")
+    r = MetricsRegistry()
+    c = r.counter("pio_wo_test_total", "h", labels=("k",))
+    for i in range(6):
+        c.inc(k=f"v{i}")
+    key = "metrics-series-bound:pio_wo_test_total"
+    assert logs._WARN_ONCE_TOTAL.value(key=key) >= 1
+    warned = [rec for rec in logs.records()
+              if "pio_wo_test_total" in rec["msg"]]
+    assert len(warned) == 1  # 4 drops, ONE log line
+
+
+# -- merge (gateway fan-out) --------------------------------------------------
+
+
+def test_merge_docs_dedupes_shared_ring_and_orders_by_time():
+    a = {"records": [
+        {"seq": 1, "ts": 10.0, "logger": "l", "msg": "one"},
+        {"seq": 2, "ts": 11.0, "logger": "l", "msg": "two"},
+    ]}
+    # an in-process replica returns the SAME ring: must collapse
+    b = {"records": list(a["records"])}
+    # a remote event server has its own seq space
+    c = {"records": [{"seq": 1, "ts": 10.5, "logger": "ev", "msg": "mid"}]}
+    merged = logs.merge_docs([a, b, None, c])
+    assert [r["msg"] for r in merged["records"]] == ["one", "mid", "two"]
+    assert merged["count"] == 3
+    trimmed = logs.merge_docs([a, c], limit=2)
+    assert [r["msg"] for r in trimmed["records"]] == ["mid", "two"]
+
+
+# -- /debug/logs over HTTP ----------------------------------------------------
+
+
+def test_debug_logs_route_404_when_off_filters_and_correlation(monkeypatch):
+    r = Router()
+
+    def ping(req):
+        LOG.info("handled ping for %s", req.query.get("who", "?"))
+        return 200, {"ok": True}
+
+    r.add("GET", "/ping", ping)
+    srv = AppServer(add_metrics_route(r), "127.0.0.1", 0,
+                    server_name="logsrv")
+    srv.start()
+    try:
+        monkeypatch.setenv("PIO_LOGS", "0")
+        status, _ = _get(srv.port, "/debug/logs")
+        assert status == 404
+        monkeypatch.setenv("PIO_LOGS", "1")
+        _get(srv.port, "/ping?who=alpha",
+             {"X-Request-ID": "rid-corr-7"})
+        status, doc = _get(srv.port, "/debug/logs")
+        assert status == 200
+        assert set(doc) >= {"capacity", "lastSeq", "count", "records"}
+        mine = [rec for rec in doc["records"]
+                if rec["msg"] == "handled ping for alpha"]
+        assert len(mine) == 1
+        # the in-handler record is stamped with the request id AND the
+        # server that handled it — the cross-pillar correlation key
+        assert mine[0]["request_id"] == "rid-corr-7"
+        assert mine[0]["server"] == "logsrv"
+        status, doc = _get(srv.port,
+                           "/debug/logs?request_id=rid-corr-7")
+        assert status == 200 and doc["count"] == 1
+        status, doc = _get(srv.port, "/debug/logs?level=ERROR")
+        assert status == 200 and doc["count"] == 0
+        status, _ = _get(srv.port, "/debug/logs?level=bogus")
+        assert status == 400
+        status, _ = _get(srv.port, "/debug/logs?since=notanint")
+        assert status == 400
+    finally:
+        srv.stop()
+
+
+def test_gateway_fans_out_and_merges(monkeypatch):
+    from tests.test_gateway import FakeReplica, make_gateway
+
+    rep = FakeReplica("r0").start()
+    gw, srv = make_gateway([rep])
+    try:
+        monkeypatch.setenv("PIO_LOGS", "0")
+        status, _ = _get(srv.port, "/debug/logs")
+        assert status == 404
+        monkeypatch.setenv("PIO_LOGS", "1")
+        LOG.info("gateway-side record")
+        status, doc = _get(srv.port, "/debug/logs?logger="
+                           "predictionio_tpu.tests")
+        assert status == 200
+        assert doc["role"] == "gateway"
+        assert set(doc) >= {"local", "replicas", "merged"}
+        msgs = [r["msg"] for r in doc["merged"]["records"]]
+        assert "gateway-side record" in msgs
+        # the fake replica mounts no /debug/logs: fan-out tolerates it
+        assert list(doc["replicas"]) == [f"127.0.0.1:{rep.port}"]
+    finally:
+        srv.stop()
+        gw.stop()
+        rep.stop()
+
+
+# -- history series + doctor LOG-STORM ----------------------------------------
+
+
+def test_history_samples_log_rates(monkeypatch):
+    from predictionio_tpu.obs.history import HistorySampler
+
+    sampler = HistorySampler(interval_s=3600)
+    sampler.sample_once(t=1000.0)
+    LOG.error("failure one")
+    LOG.error("failure two")
+    LOG.info("fine")
+    sampler.sample_once(t=1001.0)
+    doc = sampler.to_json()
+    assert "error_log_rate" in doc["series"]
+    assert "log_records_per_sec" in doc["series"]
+    err_pts = doc["series"]["error_log_rate"]["points"]
+    all_pts = doc["series"]["log_records_per_sec"]["points"]
+    assert err_pts[-1][1] > 0
+    assert all_pts[-1][1] > err_pts[-1][1]  # INFO counts too
+
+
+def test_diagnose_history_doc_flags_sustained_error_storms(monkeypatch):
+    monkeypatch.setenv("PIO_LOG_STORM_ERRORS_PER_S", "5")
+    now = 1_000_000.0
+    mk = lambda pts: {"series": {"error_log_rate": {"points": pts}}}
+    # two in-window samples at/over threshold: critical
+    findings = logs.diagnose_history_doc(
+        mk([(now - 30, 8.0), (now - 10, 6.5)]), now=now)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["severity"] == "critical" and f["subject"] == "log volume"
+    assert "LOG-STORM" in f["detail"] and "8.0/s" in f["detail"]
+    # one spike is noise, not a storm
+    assert logs.diagnose_history_doc(
+        mk([(now - 10, 50.0), (now - 20, 0.0)]), now=now) == []
+    # old samples outside the window don't count
+    assert logs.diagnose_history_doc(
+        mk([(now - 500, 9.0), (now - 400, 9.0)]), now=now) == []
+    # absent series / empty doc: clean
+    assert logs.diagnose_history_doc(None, now=now) == []
+    assert logs.diagnose_history_doc({}, now=now) == []
+
+
+# -- pio logs CLI -------------------------------------------------------------
+
+
+def test_cli_pio_logs_renders_from_live_server(capsys):
+    import argparse
+
+    from predictionio_tpu.tools.cli import cmd_logs
+
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0,
+                    server_name="clilog")
+    srv.start()
+    try:
+        rid_token = request_id_var.set("rid-cli-9")
+        try:
+            LOG.warning("cli-visible warning")
+        finally:
+            request_id_var.reset(rid_token)
+        args = argparse.Namespace(
+            url=f"http://127.0.0.1:{srv.port}", level=None, logger=None,
+            request_id=None, limit=100, follow=False, interval=2.0,
+            json=False)
+        assert cmd_logs(args) == 0
+        out = capsys.readouterr().out
+        assert "cli-visible warning" in out
+        assert "rid=rid-cli-9" in out
+        assert "WARNING" in out
+        # --json emits the raw document
+        args.json = True
+        assert cmd_logs(args) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert any(r["msg"] == "cli-visible warning"
+                   for r in doc["records"])
+        # request-id filter narrows to the correlated record
+        args.json, args.request_id = False, "rid-cli-9"
+        assert cmd_logs(args) == 0
+        lines = [l for l in capsys.readouterr().out.splitlines() if l]
+        assert lines and all("rid-cli-9" in l for l in lines)
+    finally:
+        srv.stop()
+
+
+def test_cli_pio_logs_reports_unreachable_server(capsys):
+    import argparse
+
+    from predictionio_tpu.tools.cli import cmd_logs
+
+    args = argparse.Namespace(
+        url="http://127.0.0.1:9", level=None, logger=None,
+        request_id=None, limit=100, follow=False, interval=2.0,
+        json=False)
+    assert cmd_logs(args) == 1
+
+
+def test_server_name_attribution_follows_the_handling_server():
+    """One process, two servers: records logged while each handles a
+    request attribute to THAT server; background records fall back to
+    the process default."""
+    def mk(name):
+        r = Router()
+        r.add("GET", "/ping", lambda req: (
+            LOG.info("from %s", name) or (200, {"ok": True})))
+        return AppServer(add_metrics_route(r), "127.0.0.1", 0,
+                         server_name=name)
+
+    a, b = mk("alpha"), mk("beta")
+    a.start(), b.start()
+    try:
+        _get(a.port, "/ping")
+        _get(b.port, "/ping")
+        LOG.info("background record")
+        by_msg = {r["msg"]: r for r in logs.records()}
+        assert by_msg["from alpha"]["server"] == "alpha"
+        assert by_msg["from beta"]["server"] == "beta"
+        assert by_msg["background record"]["server"] == \
+            logs.current_server_name()
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_dashboard_logs_panel_renders_local_ring(monkeypatch):
+    """The dashboard's warnings/errors panel over the local ring
+    (gw_status=None skips the gateway fetch): WARNING+ records render
+    escaped with server/rid correlation columns; INFO stays out."""
+    from predictionio_tpu.tools.dashboard import _logs_panel
+
+    from predictionio_tpu.obs.context import request_id_var
+
+    token = request_id_var.set("rid-dash-3")
+    try:
+        LOG.info("quiet info line")
+        LOG.warning("dash warn <tag> %s", "x")
+        LOG.error("dash error line")
+    finally:
+        request_id_var.reset(token)
+    text = _logs_panel(None)
+    assert "Recent warnings &amp; errors" in text
+    assert "dash warn &lt;tag&gt; x" in text  # escaped, not raw HTML
+    assert "dash error line" in text
+    assert "quiet info line" not in text  # INFO filtered out
+    assert "rid-dash-3" in text
+    assert "this process" in text
+
+
+def test_dashboard_logs_panel_states(monkeypatch):
+    """Disabled (PIO_LOGS=0) and empty-ring states render as prose, not
+    an empty table."""
+    from predictionio_tpu.tools.dashboard import _logs_panel
+
+    assert "No WARNING-or-worse records" in _logs_panel(None)
+    monkeypatch.setenv("PIO_LOGS", "0")
+    assert "PIO_LOGS=0" in _logs_panel(None)

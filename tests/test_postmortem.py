@@ -1,0 +1,304 @@
+"""Flight recorder (obs/postmortem.py): bundle capture/commit
+atomicity, redaction, retention, crash hooks (thread crash end-to-end
+with request-id correlation into the bundled log ring), the SIGKILL
+no-torn-bundle pin, the POST /debug/postmortem surface, and the
+pio postmortem CLI."""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs import logs, postmortem
+from predictionio_tpu.obs.context import request_id_var
+from predictionio_tpu.utils.http import AppServer, Router, add_metrics_route
+
+
+@pytest.fixture(autouse=True)
+def _isolated_bundles(tmp_path, monkeypatch):
+    """Every test gets its own bundle root, a fresh rate-limit clock,
+    and an attached log ring."""
+    monkeypatch.setenv("PIO_POSTMORTEM_DIR", str(tmp_path / "pm"))
+    monkeypatch.setattr(postmortem, "_last_auto", 0.0)
+    logs.reset()
+    logs.install()
+    yield
+    logs.reset()
+    logs.install()
+
+
+def _post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+# -- capture ------------------------------------------------------------------
+
+
+def test_capture_writes_committed_redacted_bundle():
+    logs.LOG_NAMESPACE  # noqa: B018 — namespace import sanity
+    import logging
+
+    logging.getLogger("predictionio_tpu.tests.pm").warning(
+        "pre-crash record accessKey=sk-PM-LEAK1")
+    path = postmortem.capture_bundle("unit-test")
+    assert path is not None and path.is_dir()
+    assert not path.name.startswith(".")
+    files = {f.name for f in path.iterdir()}
+    # logs/device/env/stacks/meta are unconditional sections
+    assert {"logs.json", "device.json", "env.json", "stacks.txt",
+            "meta.json"} <= files
+    meta = json.loads((path / "meta.json").read_text())
+    assert meta["reason"] == "unit-test"
+    assert meta["pid"] == os.getpid()
+    assert set(meta["sections"]) == files - {"meta.json"}
+    # the ring snapshot rode along, already redacted
+    logdoc = json.loads((path / "logs.json").read_text())
+    msgs = [r["msg"] for r in logdoc["records"]]
+    assert any("pre-crash record" in m for m in msgs)
+    assert not any("sk-PM-LEAK1" in m for m in msgs)
+    # stacks show this very test frame, captured live
+    stacks = (path / "stacks.txt").read_text()
+    assert "test_capture_writes_committed_redacted_bundle" in stacks
+    # no temp leavings after a successful commit
+    assert not [p for p in path.parent.iterdir()
+                if p.name.startswith(".tmp-")]
+
+
+def test_exception_metadata_is_recorded_and_redacted():
+    try:
+        raise RuntimeError("refused token=tok-PM-EVIL by upstream")
+    except RuntimeError as e:
+        path = postmortem.capture_bundle("with-exc", exc=e)
+    meta = json.loads((path / "meta.json").read_text())
+    exc = meta["exception"]
+    assert exc["type"] == "RuntimeError"
+    assert "tok-PM-EVIL" not in exc["message"]
+    assert "[REDACTED]" in exc["message"]
+    assert "RuntimeError" in exc["traceback"]
+    assert "tok-PM-EVIL" not in exc["traceback"]
+
+
+def test_env_section_redacts_secret_variables(monkeypatch):
+    monkeypatch.setenv("PIO_ACCESS_KEY", "deadbeef-pm")
+    path = postmortem.capture_bundle("env-check")
+    env = json.loads((path / "env.json").read_text())
+    assert env["PIO_ACCESS_KEY"] == "[REDACTED]"
+    assert "deadbeef-pm" not in (path / "env.json").read_text()
+
+
+def test_disabled_recorder_captures_nothing(monkeypatch):
+    monkeypatch.setenv("PIO_POSTMORTEM", "0")
+    assert postmortem.capture_bundle("nope") is None
+    assert postmortem.list_bundles() == []
+
+
+def test_auto_captures_rate_limited_explicit_not(monkeypatch):
+    assert postmortem.capture_bundle("crash-1", auto=True) is not None
+    # a crash loop 1s later is swallowed by the 30s auto window...
+    assert postmortem.capture_bundle("crash-2", auto=True) is None
+    # ...but an operator-requested capture always lands
+    assert postmortem.capture_bundle("operator") is not None
+
+
+def test_retention_keeps_newest_k(monkeypatch):
+    monkeypatch.setenv("PIO_POSTMORTEM_KEEP", "2")
+    kept = [postmortem.capture_bundle(f"r{i}") for i in range(4)]
+    assert all(k is not None for k in kept)
+    names = {b["name"] for b in postmortem.list_bundles()}
+    assert len(names) == 2
+    assert kept[-1].name in names and kept[-2].name in names
+    assert not kept[0].exists() and not kept[1].exists()
+
+
+def test_stale_temp_dirs_are_swept(monkeypatch):
+    root = postmortem.bundles_dir()
+    root.mkdir(parents=True, exist_ok=True)
+    stale = root / ".tmp-pm-ancient"
+    stale.mkdir()
+    old = time.time() - 7200
+    os.utime(stale, (old, old))
+    fresh = root / ".tmp-pm-inflight"
+    fresh.mkdir()
+    postmortem.capture_bundle("sweeper")
+    assert not stale.exists()  # older than an hour: swept
+    assert fresh.exists()      # could be a live capture: left alone
+
+
+def test_list_and_load_skip_dotdirs_and_unknown_names(tmp_path):
+    path = postmortem.capture_bundle("loadable")
+    assert [b["name"] for b in postmortem.list_bundles()] == [path.name]
+    listed = postmortem.list_bundles()[0]
+    assert listed["reason"] == "loadable" and listed["sizeBytes"] > 0
+    doc = postmortem.load_bundle(path.name)
+    assert doc["meta"]["reason"] == "loadable"
+    assert isinstance(doc["logs"], dict)
+    assert isinstance(doc["stacks"], str)
+    with pytest.raises(FileNotFoundError):
+        postmortem.load_bundle("pm-never-existed")
+    with pytest.raises(FileNotFoundError):
+        postmortem.load_bundle(".tmp-pm-sneaky")
+
+
+# -- atomicity: the SIGKILL pin ----------------------------------------------
+
+
+def test_sigkill_mid_capture_leaves_no_torn_bundle(tmp_path):
+    """A process killed -9 halfway through a capture must leave ONLY an
+    invisible temp dir — list_bundles/load_bundle never see a bundle
+    missing its sections (the checkpoint atomic-commit contract)."""
+    pm_dir = tmp_path / "pm"
+    script = tmp_path / "die.py"
+    script.write_text(
+        "import os, signal\n"
+        "from predictionio_tpu.obs import postmortem\n"
+        "def _boom(path):\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "postmortem._write_stacks = _boom\n"  # die just before commit
+        "postmortem.capture_bundle('torn')\n"
+        "raise SystemExit('unreachable: SIGKILL must have fired')\n")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PIO_POSTMORTEM_DIR": str(pm_dir),
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join(
+               p for p in (repo_root, os.environ.get("PYTHONPATH")) if p)}
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL
+    # sections were mid-write: the only residue is the dot-prefixed temp
+    residue = list(pm_dir.iterdir())
+    assert residue, "capture never started"
+    assert all(p.name.startswith(".tmp-") for p in residue)
+    assert postmortem.list_bundles(pm_dir) == []
+
+
+# -- crash hooks: end-to-end correlation -------------------------------------
+
+
+def test_thread_crash_bundles_ring_with_request_id():
+    """The issue's acceptance path: an injected fatal inside a worker
+    carrying a request id produces a bundle whose log ring still shows
+    that request id — crash forensics stay correlated."""
+    import logging
+
+    postmortem.install()
+    done = threading.Event()
+
+    def worker():
+        request_id_var.set("rid-fatal-42")
+        logging.getLogger("predictionio_tpu.tests.pm").error(
+            "about to die, secret=swordfish")
+        try:
+            raise RuntimeError("injected fatal password=hunter2")
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, name="chaos-worker")
+    t.start()
+    t.join(30)
+    assert done.wait(1)
+    deadline = time.time() + 10  # hook runs after join returns
+    bundles = []
+    while time.time() < deadline and not bundles:
+        bundles = postmortem.list_bundles()
+        time.sleep(0.05)
+    assert len(bundles) == 1
+    b = bundles[0]
+    assert b["reason"] == "thread-crash-chaos-worker"
+    doc = postmortem.load_bundle(b["name"])
+    exc = doc["meta"]["exception"]
+    assert exc["type"] == "RuntimeError"
+    assert "hunter2" not in json.dumps(doc["meta"])
+    mine = [r for r in doc["logs"]["records"]
+            if r.get("request_id") == "rid-fatal-42"]
+    assert mine and "about to die" in mine[0]["msg"]
+    assert "swordfish" not in mine[0]["msg"]
+
+
+def test_keyboard_interrupt_does_not_capture():
+    postmortem.install()
+
+    def worker():
+        raise KeyboardInterrupt()
+
+    t = threading.Thread(target=worker, name="ctrl-c")
+    t.start()
+    t.join(30)
+    time.sleep(0.2)
+    assert postmortem.list_bundles() == []
+
+
+# -- HTTP + CLI surfaces ------------------------------------------------------
+
+
+def test_post_debug_postmortem_route(monkeypatch):
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0,
+                    server_name="pmserv")
+    srv.start()
+    try:
+        monkeypatch.setenv("PIO_POSTMORTEM", "0")
+        status, _ = _post(srv.port, "/debug/postmortem")
+        assert status == 404
+        monkeypatch.setenv("PIO_POSTMORTEM", "1")
+        status, body = _post(srv.port, "/debug/postmortem",
+                             {"reason": "route-test"})
+        assert status == 200
+        assert body["bundle"].endswith("route-test")
+        assert postmortem.load_bundle(
+            body["bundle"])["meta"]["reason"] == "route-test"
+    finally:
+        srv.stop()
+
+
+def test_cli_postmortem_list_show_and_trigger(capsys):
+    path = postmortem.capture_bundle("cli-render")
+    base_args = dict(url="http://127.0.0.1:9", list_bundles=False,
+                     show=None, dir=None, reason="on-demand", json=False)
+    assert postmortem.list_bundles()  # precondition
+    args = argparse.Namespace(**{**base_args, "list_bundles": True})
+    from predictionio_tpu.tools.cli import cmd_postmortem
+
+    assert cmd_postmortem(args) == 0
+    out = capsys.readouterr().out
+    assert path.name in out and "cli-render" in out
+    args = argparse.Namespace(**{**base_args, "show": path.name})
+    assert cmd_postmortem(args) == 0
+    out = capsys.readouterr().out
+    assert f"bundle {path.name}" in out
+    assert "cli-render" in out
+    args = argparse.Namespace(**{**base_args, "show": "pm-missing"})
+    assert cmd_postmortem(args) == 1
+    capsys.readouterr()
+    # default mode posts to the live server
+    srv = AppServer(add_metrics_route(Router()), "127.0.0.1", 0,
+                    server_name="pmcli")
+    srv.start()
+    try:
+        args = argparse.Namespace(
+            **{**base_args, "url": f"http://127.0.0.1:{srv.port}",
+               "reason": "from-cli"})
+        assert cmd_postmortem(args) == 0
+        out = capsys.readouterr().out
+        assert "from-cli" in out
+        assert any(b["reason"] == "from-cli"
+                   for b in postmortem.list_bundles())
+    finally:
+        srv.stop()
+    # an unreachable deployment is an error, not a traceback
+    args = argparse.Namespace(**base_args)
+    assert cmd_postmortem(args) == 1

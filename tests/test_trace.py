@@ -8,6 +8,7 @@ shared no-op object) and quantitative in bench_serving.py
 (``trace_overhead_frac``)."""
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -684,3 +685,46 @@ def test_cli_pio_trace_renders_from_live_server(capsys):
         assert main(["trace", "nope", "--url", url]) == 1
     finally:
         srv.stop()
+
+
+def test_cli_pio_trace_interleaves_log_records_by_trace_id(capsys):
+    """ISSUE 16: the waterfall says WHERE the time went; structured log
+    records logged under the same request id render beneath it, `log `
+    prefixed. Fail-soft: with PIO_LOGS=0 the bare trace still renders."""
+    import logging
+
+    from predictionio_tpu.obs import logs as logs_mod
+    from predictionio_tpu.tools.cli import main
+
+    logs_mod.reset()
+    logs_mod.install()
+    lg = logging.getLogger("predictionio_tpu.tests.trace_interleave")
+    r = Router()
+    r.add("GET", "/ping", lambda req: (
+        lg.warning("inside the handler, money=7") or (200, {"ok": True})))
+    srv = AppServer(add_metrics_route(r), "127.0.0.1", 0,
+                    server_name="ilsrv")
+    srv.start()
+    try:
+        _get(srv.port, "/ping", {"X-Request-ID": "rid-il-5"})
+        _wait_trace("rid-il-5")
+        url = f"http://127.0.0.1:{srv.port}"
+        assert main(["trace", "rid-il-5", "--url", url]) == 0
+        out = capsys.readouterr().out
+        assert "rid-il-5" in out
+        line = next(l for l in out.splitlines()
+                    if "inside the handler" in l)
+        assert line.lstrip().startswith("log ")  # interleave marker
+        assert "rid=rid-il-5" in line
+        # logs off: the trace alone still renders, no crash, no log rows
+        os.environ["PIO_LOGS"] = "0"
+        try:
+            assert main(["trace", "rid-il-5", "--url", url]) == 0
+            out2 = capsys.readouterr().out
+            assert "rid-il-5" in out2 and "inside the handler" not in out2
+        finally:
+            os.environ.pop("PIO_LOGS", None)
+    finally:
+        srv.stop()
+        logs_mod.reset()
+        logs_mod.install()
