@@ -1017,6 +1017,132 @@ def bench_event_ingest(total: int = 4000, conns: int = 8,
 
 
 
+def bench_ingest(n_bulk: int = 20_000, n_single: int = 1_000,
+                 chunk: int = 500) -> dict:
+    """Columnar ingest log (ISSUE 17): sustained bulk ingestion vs the
+    single-row baseline, and the cold snapshot read it buys.
+
+    One in-process event server over a sqlite/WAL store with
+    ``PIO_INGEST_LOG_DIR`` set, one keep-alive client:
+
+      * ``bulk_ingest_single_events_per_sec`` — POST /events.json one
+        event per request (the per-event commit baseline);
+      * ``bulk_ingest_events_per_sec`` — POST /events.ndjson in
+        ``chunk``-event requests (one transaction + one columnar chunk
+        per request); ``bulk_ingest_speedup`` is the ratio (acceptance:
+        >= 10x);
+      * ``ingest_view_log_seconds`` vs ``ingest_view_json_seconds`` —
+        the same cold ``DataView.create`` once from the coherent log's
+        bulk decode and once from the row-by-row store scan (log
+        disabled); ``ingest_view_speedup`` is json/log.
+    """
+    import tempfile
+
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.data.storage.base import AccessKey, App
+    from predictionio_tpu.data.view.data_view import DataView
+
+    tmp = tempfile.TemporaryDirectory(prefix="pio-ingestlog-bench-")
+    for k in list(os.environ):
+        if k.startswith("PIO_STORAGE_"):
+            del os.environ[k]
+    os.environ["PIO_STORAGE_SOURCES_S_TYPE"] = "sqlite"
+    os.environ["PIO_STORAGE_SOURCES_S_PATH"] = os.path.join(
+        tmp.name, "pio.db")
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        os.environ[f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE"] = "S"
+        os.environ[f"PIO_STORAGE_REPOSITORIES_{repo}_NAME"] = (
+            f"bench_{repo.lower()}")
+    os.environ["PIO_INGEST_LOG_DIR"] = os.path.join(tmp.name, "ingestlog")
+    Storage.reset()
+    out: dict = {}
+    try:
+        app_id = Storage.get_meta_data_apps().insert(App(0, "ingestlogbench"))
+        Storage.get_events().init(app_id)
+        key = Storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ()))
+        server = create_event_server(
+            EventServerConfig(ip="127.0.0.1", port=0))
+        server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            ev = {"event": "rate", "entityType": "user",
+                  "targetEntityType": "item",
+                  "properties": {"rating": 3.0}}
+
+            def post(path: str, body: bytes, want: int) -> None:
+                conn.request("POST", path, body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status != want:
+                    raise RuntimeError(
+                        f"{path}: {resp.status} {data[:200]!r}")
+
+            single_body = json.dumps(
+                dict(ev, entityId="u0", targetEntityId="i0")).encode()
+            t0 = time.perf_counter()
+            for _ in range(n_single):
+                post(f"/events.json?accessKey={key}", single_body, 201)
+            single_rate = n_single / (time.perf_counter() - t0)
+
+            sent = 0
+            t0 = time.perf_counter()
+            while sent < n_bulk:
+                n = min(chunk, n_bulk - sent)
+                lines = "\n".join(
+                    json.dumps(dict(ev, entityId=f"u{(sent + j) % 997}",
+                                    targetEntityId=f"i{(sent + j) % 431}"))
+                    for j in range(n))
+                post(f"/events.ndjson?accessKey={key}",
+                     lines.encode(), 200)
+                sent += n
+            bulk_rate = sent / (time.perf_counter() - t0)
+            conn.close()
+        finally:
+            server.stop()
+        out["bulk_ingest_single_events_per_sec"] = round(single_rate, 0)
+        out["bulk_ingest_events_per_sec"] = round(bulk_rate, 0)
+        out["bulk_ingest_chunk"] = chunk
+        out["bulk_ingest_speedup"] = round(bulk_rate / single_rate, 2)
+
+        # cold snapshot read: until_time=None keeps DataView from
+        # materializing a cache, so both timings are pure scans over
+        # the SAME committed store — once via the coherent log's bulk
+        # decode, once via the row-by-row SQL scan with the log off
+        def conv(e):
+            return {"u": e.entity_id, "i": e.target_entity_id or ""}
+
+        t0 = time.perf_counter()
+        cols_log = DataView.create("ingestlogbench", conv)
+        t_log = time.perf_counter() - t0
+        log_dir_env = os.environ.pop("PIO_INGEST_LOG_DIR")
+        try:
+            t0 = time.perf_counter()
+            cols_sql = DataView.create("ingestlogbench", conv)
+            t_sql = time.perf_counter() - t0
+        finally:
+            os.environ["PIO_INGEST_LOG_DIR"] = log_dir_env
+        n_rows = len(cols_log.get("u", ()))
+        if n_rows != len(cols_sql.get("u", ())):
+            raise RuntimeError(
+                f"log view rows {n_rows} != sql view rows "
+                f"{len(cols_sql.get('u', ()))}")
+        out["ingest_view_events"] = n_rows
+        out["ingest_view_log_seconds"] = round(t_log, 3)
+        out["ingest_view_json_seconds"] = round(t_sql, 3)
+        out["ingest_view_speedup"] = round(t_sql / t_log, 2) if t_log else None
+        return out
+    finally:
+        Storage.reset()
+        os.environ.pop("PIO_INGEST_LOG_DIR", None)
+        tmp.cleanup()
+
+
 def bench_event_scan(n_events: int = 200_000) -> dict:
     """Columnar training-scan throughput of the eventlog backend: the
     C++ interactions decode, sequential vs partitioned (record-aligned
@@ -1403,6 +1529,16 @@ def _dry_run_doc(gateway: bool = False) -> dict:
             # speedup ratio higher-is-better
             "events_to_servable_s": None,
             "foldin_speedup_vs_retrain": None,
+            # columnar ingest log (ISSUE 17): bulk-vs-single throughput
+            # and the cold snapshot read — the *_events_per_sec and
+            # *_speedup keys are higher-is-better, the *_seconds pair
+            # are COSTS (bench-compare treats them lower-is-better)
+            "bulk_ingest_events_per_sec": None,
+            "bulk_ingest_single_events_per_sec": None,
+            "bulk_ingest_speedup": None,
+            "ingest_view_log_seconds": None,
+            "ingest_view_json_seconds": None,
+            "ingest_view_speedup": None,
             # device-resident SASRec serving (ISSUE 15): the sequential
             # recommender's first measured device p50
             "sasrec_device_p50_ms": None,
@@ -1419,6 +1555,7 @@ def _collect(gateway: bool, replicas: int) -> dict:
                          metric=GATEWAY_HEADLINE_METRIC)
     results = bench_query_latency()
     results.update(bench_event_ingest())
+    results.update(bench_ingest())
     results.update(bench_event_scan())
     results.update(bench_foldin())
     results.update(bench_sasrec_serving())

@@ -22,9 +22,13 @@ the key's app (ref: withAccessKey, EventServer.scala:81-107).
 
 from __future__ import annotations
 
+import http.client
+import json
 import logging
 import os
+import threading
 import time
+import urllib.parse
 from dataclasses import dataclass, replace
 
 from predictionio_tpu.data.api.plugins import (
@@ -102,6 +106,27 @@ def _refresh_last_event_age() -> None:
 
 REGISTRY.add_collect_hook(_refresh_last_event_age)
 
+# Bulk-ingest telemetry. Status is per-EVENT, like
+# pio_events_ingested_total, but restricted to the bulk routes
+# (/batch/events.json, /events.ndjson) so the loader path is watchable
+# on its own — the bulk_ingest_success SLO rides these.
+_BULK_EVENTS = REGISTRY.counter(
+    "pio_ingest_bulk_events_total",
+    "Per-event outcomes on the bulk ingest routes (/batch/events.json, "
+    "/events.ndjson) by HTTP status",
+    labels=("status",),
+)
+_BULK_LAG = REGISTRY.gauge(
+    "pio_ingest_lag_seconds",
+    "Event-time age (seconds) of the newest event in the last committed "
+    "bulk batch — how far ingestion runs behind the data it is loading",
+)
+_ROUTER_REQUESTS = REGISTRY.counter(
+    "pio_ingest_router_requests_total",
+    "Requests proxied by the event-server pool router, per worker index",
+    labels=("worker",),
+)
+
 DEFAULT_PORT = 7070  # ref: EventServer.scala:504
 DEFAULT_GET_LIMIT = 20  # ref: EventServer.scala:313
 
@@ -147,6 +172,10 @@ class EventService:
 
         self.admission = AdmissionGate.from_env(
             "PIO_INGEST_ADMISSION_LIMIT", 128, name="event")
+        # per-(app, channel) columnar ingest-log handles; None cached too
+        # (PIO_INGEST_LOG_DIR unset), so the disabled path stays one dict
+        # probe per request
+        self._ingest_logs: dict[tuple[int, int | None], object] = {}
         self.router = self._build_router()
 
     # -- auth (ref: withAccessKey) ------------------------------------------
@@ -202,6 +231,7 @@ class EventService:
         r.add("GET", "/plugins/{ptype}/{pname}/{args:path}", self.handle_plugin_rest)
         r.add("POST", "/events.json", self.post_event)
         r.add("POST", "/batch/events.json", self.post_batch_events)
+        r.add("POST", "/events.ndjson", self.post_events_ndjson)
         r.add("GET", "/events.json", self.get_events)
         r.add("GET", "/events/{event_id}.json", self.get_event)
         r.add("DELETE", "/events/{event_id}.json", self.delete_event)
@@ -251,6 +281,37 @@ class EventService:
         if self.config.stats:
             self.stats.update(app_id, status, event)
 
+    # -- columnar ingest log (predictionio_tpu/ingest) ----------------------
+    def _ingest_log(self, app_id: int, channel_id: int | None):
+        key = (app_id, channel_id)
+        if key not in self._ingest_logs:
+            from predictionio_tpu.ingest import IngestLog
+
+            self._ingest_logs[key] = IngestLog.open_default(
+                app_id, channel_id)
+        return self._ingest_logs[key]
+
+    def _append_to_log(self, events, event_ids, auth: AuthData) -> None:
+        """Mirror committed events into the columnar ingest log.
+        Fail-soft by design: the log is a derived cache of the SQL store,
+        so a failed append only degrades future log reads to the SQL
+        path — it must never fail an ingest the store already accepted."""
+        try:
+            log = self._ingest_log(auth.app_id, auth.channel_id)
+            if log is None:
+                return
+            client = self.event_client
+            tail_fn = getattr(client, "last_seq", None)
+            count_fn = getattr(client, "count", None)
+            store_tail = (tail_fn(auth.app_id, auth.channel_id)
+                          if tail_fn is not None else None)
+            store_count = (count_fn(auth.app_id, auth.channel_id)
+                           if count_fn is not None else None)
+            log.append(events, event_ids, store_tail, store_count)
+        except Exception:
+            logger.exception("columnar ingest log append failed "
+                             "(log reads degrade to the SQL path)")
+
     def _ingest(self, auth: AuthData, make_event) -> tuple[int, object]:
         """Shared validate → blockers → insert → sniffers → stats → 201 tail
         used by the event and webhook POST routes."""
@@ -273,6 +334,9 @@ class EventService:
         except Exception:
             self._record_ingest(auth.app_id, 500, None, t0)
             raise
+        # the log append is part of the commit-to-both-stores contract,
+        # so it rides inside the validate→commit latency window
+        self._append_to_log([event], [event_id], auth)
         # record BEFORE the sniffers: the event is committed, and the
         # metric's meaning is validate→commit — a slow sniffer must not
         # read as storage latency
@@ -340,10 +404,65 @@ class EventService:
         if len(payload) > self.BATCH_MAX:
             return reject(
                 f"batch size {len(payload)} exceeds {self.BATCH_MAX}")
+        return self._bulk_ingest(auth, payload, t0)
+
+    #: Max events per /events.ndjson request. The real bound on a bulk
+    #: load is the body-size limit (PIO_MAX_BODY_MB); this caps the
+    #: per-transaction row count so one request can't hold the store's
+    #: write lock arbitrarily long.
+    NDJSON_MAX = int(os.environ.get("PIO_NDJSON_MAX_EVENTS", "10000"))
+
+    def post_events_ndjson(self, request: Request):
+        """Newline-delimited bulk ingestion: one JSON event per line,
+        answered with the same per-event verdict array as
+        /batch/events.json. Line framing means a malformed line fails
+        alone (its own 400 verdict) instead of failing the request, and
+        the cap (PIO_NDJSON_MAX_EVENTS, default 10000) is sized for
+        loaders rather than the batch API's upstream-parity 50 — the
+        whole body still lands in ONE storage transaction and ONE
+        columnar log chunk."""
+        with self.admission.admit():  # 429 + Retry-After when full
+            auth = self._auth(request)
+            t0 = time.perf_counter()
+
+            def reject(message: str):
+                if self.config.stats:
+                    self.stats.update(auth.app_id, 400, None)
+                _BATCH_SECONDS.observe(time.perf_counter() - t0)
+                return 400, {"message": message}
+
+            try:
+                text = request.body.decode("utf-8")
+            except UnicodeDecodeError as e:
+                return reject(f"invalid UTF-8 body: {e}")
+            lines = [ln for ln in text.split("\n") if ln.strip()]
+            if len(lines) > self.NDJSON_MAX:
+                return reject(
+                    f"{len(lines)} events exceeds {self.NDJSON_MAX} "
+                    "(PIO_NDJSON_MAX_EVENTS)")
+            items: list = []
+            for ln in lines:
+                try:
+                    items.append(json.loads(ln))
+                except ValueError as e:
+                    # carried as an exception instance: _bulk_ingest
+                    # turns it into that line's own 400 verdict
+                    items.append(ValueError(f"invalid JSON line: {e}"))
+            return self._bulk_ingest(auth, items, t0)
+
+    def _bulk_ingest(self, auth: AuthData, items, t0: float):
+        """Shared core of the bulk routes: per-event validate/blocker
+        verdicts, ONE storage transaction for the valid tail, one
+        columnar log chunk, per-event results in input order. Items that
+        are already Exception instances (ndjson lines that failed to
+        parse) become their own 400 verdicts."""
         results: list[dict] = []
         good: list[tuple[int, Event]] = []  # (position, event)
-        for pos, item in enumerate(payload):
+        for item in items:
+            pos = len(results)
             try:
+                if isinstance(item, Exception):
+                    raise item
                 event = Event.from_json(item or {})
                 validate_event(event)
                 info = EventInfo(auth.app_id, auth.channel_id, event)
@@ -354,10 +473,12 @@ class EventService:
             except HTTPError as e:
                 results.append({"status": e.status, "message": e.message})
                 self._record_ingest(auth.app_id, e.status, None, None)
+                _BULK_EVENTS.inc(status=str(e.status))
             except (EventValidationError, ConnectorError, ValueError,
                     TypeError) as e:
                 results.append({"status": 400, "message": str(e)})
                 self._record_ingest(auth.app_id, 400, None, None)
+                _BULK_EVENTS.inc(status="400")
         if good:
             try:
                 ids = self.event_client.insert_batch(
@@ -369,12 +490,17 @@ class EventService:
                 # http layer
                 for _ in good:
                     self._record_ingest(auth.app_id, 500, None, None)
+                    _BULK_EVENTS.inc(status="500")
                 _BATCH_SECONDS.observe(time.perf_counter() - t0)
                 raise
             _BATCH_SIZE.observe(float(len(good)))  # committed batches only
+            self._append_to_log([e for _, e in good], ids, auth)
+            newest = max(e.event_time.timestamp() for _, e in good)
+            _BULK_LAG.set(max(time.time() - newest, 0.0))
             for (pos, event), eid in zip(good, ids):
                 results[pos] = {"status": 201, "eventId": eid}
                 self._record_ingest(auth.app_id, 201, event, None)
+                _BULK_EVENTS.inc(status="201")
                 info = EventInfo(auth.app_id, auth.channel_id, event)
                 for sniffer in self.plugin_context.input_sniffers.values():
                     try:
@@ -485,13 +611,16 @@ class EventService:
 
 
 def create_event_server(config: EventServerConfig | None = None,
-                        reuse_port: bool = False) -> AppServer:
+                        reuse_port: bool = False,
+                        server_name: str = "event") -> AppServer:
     """Build and bind the event server (ref: EventServer.createEventServer:508-529).
-    Caller starts it with ``.start()`` / blocks with ``.wait()``."""
+    Caller starts it with ``.start()`` / blocks with ``.wait()``.
+    ``server_name`` labels this instance's HTTP metrics and structured
+    logs (pool workers run as ``event-w<i>``)."""
     config = config or EventServerConfig()
     service = EventService(config)
     server = AppServer(service.router, config.ip, config.port,
-                       reuse_port=reuse_port, server_name="event")
+                       reuse_port=reuse_port, server_name=server_name)
     server.service = service  # tests/operators reach the live service
     return server
 
@@ -579,3 +708,251 @@ class EventServerCluster:
     def wait(self) -> None:
         for p in self._procs:
             p.join()
+
+
+def _pool_worker_main(config: EventServerConfig, instance: int) -> None:
+    """Entry point of one pool worker process: serve on its OWN port
+    (config.port is already this worker's), with instance-labelled
+    metrics/logs (``event-w<i>``). Storage wiring and the columnar log
+    root come from the inherited environment; each worker owns its log
+    appends through the log's cross-process seq allocator."""
+    server = create_event_server(config, server_name=f"event-w{instance}")
+    server.start()
+    server.wait()
+
+
+class EventServerPool:
+    """N event-server worker processes on consecutive ports behind a
+    routing proxy on the public port.
+
+    Unlike :class:`EventServerCluster` (SO_REUSEPORT: N workers share
+    ONE port and the kernel balances connections), the pool gives each
+    worker its own port (public port + 1 .. + N) and round-robins
+    requests across them from a thin proxy. That makes every worker
+    individually addressable — per-worker ``/metrics``, instance-
+    labelled diagnostics (``event-w<i>``), a gateway fleet target per
+    worker — and lets the router walk around a dead worker instead of
+    letting the kernel keep dealing it connections.
+
+    Failover policy: a worker that cannot be CONNECTED to is skipped
+    (nothing was sent, the retry is free); once a request has been
+    written, a transport failure answers 502 with NO resend — a blind
+    replay of a POST whose response was lost could double-commit
+    events, and the ingest contract is at-most-once per acknowledged
+    request."""
+
+    def __init__(self, config: EventServerConfig):
+        if config.workers < 2:
+            raise ValueError("EventServerPool wants workers >= 2")
+        if config.port == 0:
+            config = replace(config, port=self._free_port_block(
+                config.workers))
+        self.config = config
+        self.port = config.port
+        self.worker_ports = [config.port + 1 + i
+                             for i in range(config.workers)]
+        self._procs: list = []
+        self._router_server: AppServer | None = None
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+
+    @staticmethod
+    def _free_port_block(n: int) -> int:
+        """A base port with ``n`` consecutive free ports above it (the
+        workers' doors); best-effort — the ports are released before the
+        caller binds them."""
+        import socket
+
+        for _ in range(32):
+            socks: list = []
+            try:
+                base_sock = socket.socket()
+                socks.append(base_sock)
+                base_sock.bind(("127.0.0.1", 0))
+                base = base_sock.getsockname()[1]
+                for i in range(1, n + 1):
+                    s = socket.socket()
+                    socks.append(s)
+                    s.bind(("127.0.0.1", base + i))
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+        raise RuntimeError("no consecutive free port block found")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        import multiprocessing as mp
+
+        # spawn, not fork: workers must not inherit jax/TPU client state
+        # or this process's storage singletons
+        ctx = mp.get_context("spawn")
+        self._procs = []
+        for i, port in enumerate(self.worker_ports):
+            wcfg = replace(self.config, port=port, workers=1)
+            p = ctx.Process(target=_pool_worker_main, args=(wcfg, i),
+                            daemon=True)
+            p.start()
+            self._procs.append(p)
+        self._wait_ready()
+        self._router_server = AppServer(
+            self._build_router(), self.config.ip, self.config.port,
+            server_name="event-router", traced=False)
+        self._router_server.start()
+
+    def _wait_ready(self, deadline: float = 60.0) -> None:
+        end = time.time() + deadline
+        pending = set(self.worker_ports)
+        while pending and time.time() < end:
+            if any(p.exitcode not in (None, 0) for p in self._procs):
+                self.stop()
+                raise RuntimeError(
+                    "event server worker died during startup; exit codes: "
+                    f"{[p.exitcode for p in self._procs]}"
+                )
+            for port in sorted(pending):
+                try:
+                    c = http.client.HTTPConnection(
+                        self._host(), port, timeout=2)
+                    c.request("GET", "/")
+                    c.getresponse().read()
+                    c.close()
+                    pending.discard(port)
+                except OSError:
+                    pass
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            self.stop()
+            raise TimeoutError(
+                f"event workers never listened on {sorted(pending)}")
+
+    def stop(self) -> None:
+        if self._router_server is not None:
+            self._router_server.stop()
+            self._router_server = None
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=10)
+        self._procs = []
+
+    def wait(self) -> None:
+        for p in self._procs:
+            p.join()
+
+    # -- the routing proxy --------------------------------------------------
+
+    def _host(self) -> str:
+        return "127.0.0.1" if self.config.ip == "0.0.0.0" else self.config.ip
+
+    def _build_router(self) -> Router:
+        r = Router()
+        # the router's own scrape surface first (exact routes win the
+        # dispatch table): /metrics here exposes the router process —
+        # pio_ingest_router_requests_total lives here, workers expose
+        # their own /metrics on their own ports
+        add_metrics_route(r)
+        # chaos control fans out: a fault burst installed on the public
+        # port must land in every WORKER (the processes doing the
+        # commits), not just the router
+        r.add("POST", "/debug/faults", self._broadcast_faults)
+        r.add("GET", "/", self._proxy)
+        for method in ("GET", "POST", "DELETE", "PUT"):
+            r.add(method, "/{rest:path}", self._proxy)
+        return r
+
+    def _forward(self, port: int, method: str, target: str, body: bytes,
+                 content_type: str):
+        """One round trip to a worker. Raises ConnectionError BEFORE
+        anything is sent (failover-safe); mid-request failures raise
+        through to the caller's 502 path."""
+        conn = http.client.HTTPConnection(self._host(), port, timeout=60)
+        try:
+            try:
+                conn.connect()
+            except OSError as e:
+                raise ConnectionRefusedError(
+                    f"worker on port {port} unreachable: {e}") from e
+            conn.request(method, target, body,
+                         {"Content-Type": content_type})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data, (
+                resp.getheader("Content-Type")
+                or "application/json; charset=UTF-8")
+        finally:
+            conn.close()
+
+    def _proxy(self, request: Request):
+        rest = request.path_params.get("rest")
+        target = ("/" + rest) if rest is not None else request.path
+        if request.query:
+            target += "?" + urllib.parse.urlencode(request.query)
+        content_type = next(
+            (v for k, v in request.headers.items()
+             if k.lower() == "content-type"),
+            "application/json")
+        n = len(self.worker_ports)
+        with self._rr_lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % n
+        last_err: Exception | None = None
+        for k in range(n):
+            i = (start + k) % n
+            try:
+                status, data, ctype = self._forward(
+                    self.worker_ports[i], request.method, target,
+                    request.body, content_type)
+            except ConnectionRefusedError as e:
+                last_err = e  # nothing sent: the next worker gets it
+                continue
+            except (OSError, http.client.HTTPException) as e:
+                # the request may have reached the worker — a resend
+                # could double-commit, so surface the failure instead
+                _ROUTER_REQUESTS.inc(worker=str(i))
+                return 502, {"message":
+                             f"event worker {i} failed mid-request: {e}"}
+            _ROUTER_REQUESTS.inc(worker=str(i))
+            return status, RawResponse(data, ctype)
+        return 503, {"message":
+                     f"no event-server worker reachable: {last_err}"}
+
+    def _broadcast_faults(self, request: Request):
+        """POST /debug/faults to every worker (and mirror the spec into
+        the router process too, so router-side fault sites stay
+        controllable from the same call)."""
+        results = []
+        for i, port in enumerate(self.worker_ports):
+            try:
+                status, data, _ = self._forward(
+                    port, "POST", "/debug/faults", request.body,
+                    "application/json")
+                doc = {"worker": i, "status": status}
+                try:
+                    doc.update(json.loads(data))
+                except ValueError:
+                    pass
+                results.append(doc)
+            except (OSError, http.client.HTTPException) as e:
+                results.append({"worker": i, "error": str(e)})
+        from predictionio_tpu.resilience import faults
+
+        local: dict = {}
+        if faults.chaos_enabled():
+            body = request.json()
+            spec = (body or {}).get("spec", "") \
+                if isinstance(body, dict) else ""
+            try:
+                if spec in ("", None, []):
+                    faults.clear()
+                    local = {"installed": 0}
+                else:
+                    local = {"installed": len(faults.install(spec))}
+            except (ValueError, KeyError, TypeError) as e:
+                local = {"error": f"bad fault spec: {e}"}
+        return 200, {"router": local, "workers": results}

@@ -86,8 +86,10 @@ class MySQLDialect(Dialect):
     #: specification without a key length") — keyed/indexed text columns
     #: get a length-bounded VARCHAR instead
     text_key = "VARCHAR(255)"
-    #: no implicit row id — cursor tail reads fall back to a time scan
-    seq_column = None
+    #: real monotonic ingestion-order cursor: the events DDL declares an
+    #: AUTO_INCREMENT seq column, so ``find_since``/``last_seq`` work
+    #: here and the continuous trainer keeps its incremental tail
+    seq_column = "seq"
 
     def __init__(self, integrity_errors: tuple = ()):
         # driver-specific IntegrityError classes, wired by the client.
@@ -122,6 +124,30 @@ class MySQLDialect(Dialect):
         return (
             f'INSERT INTO "{table}" ({", ".join(cols)}) VALUES ({ph}) '
             f"ON DUPLICATE KEY UPDATE {updates}"
+        )
+
+    def events_table_sql(self, table: str) -> str:
+        """``seq BIGINT AUTO_INCREMENT PRIMARY KEY`` + ``id`` demoted to
+        UNIQUE NOT NULL. ``ON DUPLICATE KEY UPDATE`` resolves against
+        ANY unique key; seq is never client-supplied, so only re-sent
+        event ids conflict — and they keep their original seq (the
+        cursor contract: a re-sent id never reappears past a reader's
+        tail)."""
+        return (
+            f'CREATE TABLE IF NOT EXISTS "{table}" ('
+            "seq BIGINT NOT NULL AUTO_INCREMENT PRIMARY KEY, "
+            f"id {self.text_key} UNIQUE NOT NULL, "
+            "event TEXT NOT NULL, "
+            f"entityType {self.text_key} NOT NULL, "
+            f"entityId {self.text_key} NOT NULL, "
+            "targetEntityType TEXT, "
+            "targetEntityId TEXT, "
+            "properties TEXT NOT NULL, "
+            "eventTime TEXT NOT NULL, "
+            f"eventTimeMs {self.bigint} NOT NULL, "
+            "tags TEXT NOT NULL, "
+            "prId TEXT, "
+            "creationTime TEXT NOT NULL)"
         )
 
     def table_exists(self, client: "MySQLClient", table: str) -> bool:
@@ -198,10 +224,21 @@ class MySQLClient:
     # DBAPI commit-per-statement; the sqlite group commit doesn't apply
     execute_group = execute
 
-    def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
+    def executemany(self, sql: str, seq_params: Sequence[Sequence],
+                    fault_site: str | None = None) -> None:
         with self.lock:
             cur = self.conn.cursor()
-            cur.executemany(self._sql(sql), [tuple(p) for p in seq_params])
+            try:
+                cur.executemany(
+                    self._sql(sql), [tuple(p) for p in seq_params])
+                if fault_site is not None:
+                    from predictionio_tpu.resilience import faults
+
+                    faults.fault_point(fault_site)
+            except BaseException:
+                self.conn.rollback()
+                cur.close()
+                raise
             self.conn.commit()
             cur.close()
 

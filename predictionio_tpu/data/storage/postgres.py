@@ -44,11 +44,36 @@ class PGDialect(Dialect):
     autoinc_pk = "BIGSERIAL PRIMARY KEY"
     bigint = "BIGINT"
     blob = "BYTEA"
-    #: no stable insert-order row id without a schema change (ctid moves
-    #: on vacuum) — cursor tail reads fall back to a time-based scan
-    seq_column = None
+    #: real monotonic ingestion-order cursor: the events DDL below gives
+    #: every row a BIGSERIAL seq (ctid was never usable — it moves on
+    #: vacuum), so ``find_since``/``last_seq`` work here and the
+    #: continuous trainer stops degrading to time-scan + full retrains
+    seq_column = "seq"
 
     # upsert_sql: the base ON CONFLICT … DO UPDATE form is already valid PG.
+
+    def events_table_sql(self, table: str) -> str:
+        """``seq BIGSERIAL PRIMARY KEY`` + ``id`` demoted to UNIQUE NOT
+        NULL: the sequence is never client-supplied, so ``ON CONFLICT
+        (id)`` still resolves re-sent event ids against the unique index
+        and an upserted duplicate keeps its original seq (the cursor
+        contract: a re-sent id never reappears past a reader's tail)."""
+        return (
+            f'CREATE TABLE IF NOT EXISTS "{table}" ('
+            "seq BIGSERIAL PRIMARY KEY, "
+            f"id {self.text_key} UNIQUE NOT NULL, "
+            "event TEXT NOT NULL, "
+            f"entityType {self.text_key} NOT NULL, "
+            f"entityId {self.text_key} NOT NULL, "
+            "targetEntityType TEXT, "
+            "targetEntityId TEXT, "
+            "properties TEXT NOT NULL, "
+            "eventTime TEXT NOT NULL, "
+            f"eventTimeMs {self.bigint} NOT NULL, "
+            "tags TEXT NOT NULL, "
+            "prId TEXT, "
+            "creationTime TEXT NOT NULL)"
+        )
 
     def table_exists(self, client: "PGClient", table: str) -> bool:
         # Quoted identifiers preserve case, so table_name matches verbatim;
@@ -145,13 +170,16 @@ class PGClient:
     def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
         return self.execute(sql, params).rows
 
-    def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
+    def executemany(self, sql: str, seq_params: Sequence[Sequence],
+                    fault_site: str | None = None) -> None:
         """Batch execute. The wire client runs simple-protocol statements
         one by one; wrapping them in a transaction gives one fsync/WAL
         flush for the whole batch (the /batch/events.json hot path).
         A dead connection is repaired at BEGIN (nothing is lost yet);
         a drop mid-transaction fails the whole batch — the transaction
-        is gone with the connection."""
+        is gone with the connection. ``fault_site`` injects a chaos
+        fault between the statements and the COMMIT (the whole-batch
+        rollback covers it: the transaction is ours alone here)."""
         with self.lock:
             try:
                 self._conn.execute("BEGIN", ())
@@ -163,6 +191,10 @@ class PGClient:
             try:
                 for params in seq_params:
                     self._conn.execute(sql, params)
+                if fault_site is not None:
+                    from predictionio_tpu.resilience import faults
+
+                    faults.fault_point(fault_site)
                 self._conn.execute("COMMIT", ())
             except Exception:
                 try:

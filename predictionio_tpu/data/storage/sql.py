@@ -117,6 +117,28 @@ class Dialect:
         )
         return cur.lastrowid
 
+    def events_table_sql(self, table: str) -> str:
+        """The per-app events DDL. SQLite keeps ``id`` as the PRIMARY KEY
+        and rides the implicit rowid as the ingestion-order cursor; the
+        server dialects override this to add a real monotonic sequence
+        column (BIGSERIAL / AUTO_INCREMENT) so ``find_since`` works there
+        too."""
+        return (
+            f'CREATE TABLE IF NOT EXISTS "{table}" ('
+            f"id {self.text_key} PRIMARY KEY, "
+            "event TEXT NOT NULL, "
+            f"entityType {self.text_key} NOT NULL, "
+            f"entityId {self.text_key} NOT NULL, "
+            "targetEntityType TEXT, "
+            "targetEntityId TEXT, "
+            "properties TEXT NOT NULL, "
+            "eventTime TEXT NOT NULL, "
+            f"eventTimeMs {self.bigint} NOT NULL, "
+            "tags TEXT NOT NULL, "
+            "prId TEXT, "
+            "creationTime TEXT NOT NULL)"
+        )
+
 
 class SQLClient:
     """One sqlite database shared by all DAOs of a storage source."""
@@ -270,11 +292,35 @@ class SQLClient:
         with the condition lock held)."""
         return any(lo < seq <= hi for lo, hi in self._gc_failed)
 
-    def executemany(self, sql: str, seq_params: Sequence[Sequence]) -> None:
+    def executemany(self, sql: str, seq_params: Sequence[Sequence],
+                    fault_site: str | None = None) -> None:
         """Many statements, ONE commit — a WAL commit per row is the
-        dominant cost of row-at-a-time event inserts."""
+        dominant cost of row-at-a-time event inserts.
+
+        ``fault_site`` names a chaos injection point evaluated between
+        the statements and the commit (the bulk-ingest analog of
+        execute_group's ``eventstore.commit`` site). The batch runs
+        inside a SAVEPOINT so an injected failure rolls back exactly
+        these rows: a plain connection-level rollback here would also
+        destroy a concurrent ``execute_group`` caller's still-pending
+        rows, whose leader would then "commit" nothing while its waiters
+        report success — silently lost events."""
         with self.lock:
-            self.conn.executemany(sql, seq_params)
+            if fault_site is None:
+                self.conn.executemany(sql, seq_params)
+                self.conn.commit()
+                return
+            self.conn.execute("SAVEPOINT bulk_ingest")
+            try:
+                self.conn.executemany(sql, seq_params)
+                from predictionio_tpu.resilience import faults
+
+                faults.fault_point(fault_site)
+            except BaseException:
+                self.conn.execute("ROLLBACK TO bulk_ingest")
+                self.conn.execute("RELEASE bulk_ingest")
+                raise
+            self.conn.execute("RELEASE bulk_ingest")
             self.conn.commit()
 
     def query(self, sql: str, params: Sequence = ()) -> list[tuple]:
@@ -319,22 +365,7 @@ class SQLEvents(base.Events):
         t = self._t(app_id, channel_id)
         d = self._c.dialect
         with self._c.lock:
-            self._c.execute(
-                f"""CREATE TABLE IF NOT EXISTS "{t}" (
-                    id {d.text_key} PRIMARY KEY,
-                    event TEXT NOT NULL,
-                    entityType {d.text_key} NOT NULL,
-                    entityId {d.text_key} NOT NULL,
-                    targetEntityType TEXT,
-                    targetEntityId TEXT,
-                    properties TEXT NOT NULL,
-                    eventTime TEXT NOT NULL,
-                    eventTimeMs {d.bigint} NOT NULL,
-                    tags TEXT NOT NULL,
-                    prId TEXT,
-                    creationTime TEXT NOT NULL
-                )"""
-            )
+            self._c.execute(d.events_table_sql(t))
             d.ensure_index(
                 self._c, f"{t}_entity_time", t,
                 "entityType, entityId, eventTimeMs")
@@ -418,9 +449,13 @@ class SQLEvents(base.Events):
         return eids
 
     def _insert_rows(self, t: str, eids, events) -> None:
+        # same chaos site as the single-row path's group commit: an
+        # injected eventstore.commit fault fails the whole batch before
+        # its commit, rolling back exactly these rows
         self._c.executemany(
             self._upsert_sql(t),
-            [
+            fault_site="eventstore.commit",
+            seq_params=[
                 (
                     eid,
                     e.event,
@@ -565,6 +600,15 @@ class SQLEvents(base.Events):
         with self._table(app_id, channel_id) as t:
             rows = self._c.query(
                 f'SELECT COALESCE(MAX({seq}), 0) FROM "{t}"')
+        return int(rows[0][0]) if rows else 0
+
+    def count(self, app_id: int, channel_id: int | None = None) -> int:
+        """Stored event count — the columnar ingest log's coherence
+        check compares it against the log's appended-event tally (an
+        upserted duplicate id or a bypassing writer breaks the match and
+        degrades log reads to the SQL path)."""
+        with self._table(app_id, channel_id) as t:
+            rows = self._c.query(f'SELECT COUNT(*) FROM "{t}"')
         return int(rows[0][0]) if rows else 0
 
 

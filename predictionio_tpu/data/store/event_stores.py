@@ -39,6 +39,18 @@ def app_name_to_id(app_name: str, channel_name: str | None = None) -> tuple[int,
     return app.id, channel_id
 
 
+def _store_tail_count(backend, app_id: int, channel_id: int | None
+                      ) -> tuple[int | None, int | None]:
+    """(last_seq, count) of the backing store, (None, None) when the
+    backend lacks either — the ingest log's coherence check needs BOTH
+    (a store it cannot measure is a store it must not claim to mirror)."""
+    last_seq = getattr(backend, "last_seq", None)
+    count = getattr(backend, "count", None)
+    if last_seq is None or count is None:
+        return None, None
+    return last_seq(app_id, channel_id), count(app_id, channel_id)
+
+
 class PEventStore:
     """Bulk reads for training (ref: PEventStore.scala:54-116)."""
 
@@ -79,9 +91,35 @@ class PEventStore:
         (train/continuous.py): polling with the returned tail seq reads
         only what arrived since, never rescanning the log. None when the
         backend has no stable ingestion cursor (callers fall back to a
-        time-based scan)."""
+        time-based scan).
+
+        When the columnar ingest log (predictionio_tpu/ingest) is
+        enabled and still mirrors the store, the tail serves from its
+        seq-indexed segments instead of SQL — chunk headers prune
+        everything before the cursor, so a steady poll decodes only new
+        data. Log cursors live at ``LOG_SEQ_BASE`` offsets (disjoint
+        from SQL rowids): a fresh cursor (0) may enter log space, an
+        in-log cursor that finds the log incoherent returns None (the
+        trainer degrades to a full scan) rather than replaying a
+        log-space position against SQL rowids."""
         app_id, channel_id = app_name_to_id(app_name, channel_name)
         backend = Storage.get_events()
+        from predictionio_tpu import ingest
+
+        log = ingest.IngestLog.open_default(app_id, channel_id)
+        if log is not None and (since_seq == 0
+                                or since_seq >= ingest.LOG_SEQ_BASE):
+            store_tail, store_count = _store_tail_count(
+                backend, app_id, channel_id)
+            if store_tail is not None and store_count is not None \
+                    and log.coherent(store_tail, store_count):
+                raw_since = max(since_seq - ingest.LOG_SEQ_BASE, 0)
+                return [(ingest.LOG_SEQ_BASE + s, e)
+                        for s, e in log.events_since(raw_since,
+                                                     limit=limit)]
+            ingest.record_fallback("tail")
+            if since_seq >= ingest.LOG_SEQ_BASE:
+                return None
         find_since = getattr(backend, "find_since", None)
         if find_since is None:
             return None
@@ -94,9 +132,20 @@ class PEventStore:
         """The event log's current cursor tail (0 when empty), or None
         when the backend has no stable cursor. ``run_train`` snapshots
         this BEFORE the training read so the instance records its
-        ``train_watermark_seq``."""
+        ``train_watermark_seq``. When the columnar ingest log mirrors
+        the store, the watermark is the log's tail at ``LOG_SEQ_BASE``
+        offset so subsequent ``events_since`` polls stay in log space."""
         app_id, channel_id = app_name_to_id(app_name, channel_name)
         backend = Storage.get_events()
+        from predictionio_tpu import ingest
+
+        log = ingest.IngestLog.open_default(app_id, channel_id)
+        if log is not None:
+            store_tail, store_count = _store_tail_count(
+                backend, app_id, channel_id)
+            if store_tail is not None and store_count is not None \
+                    and log.coherent(store_tail, store_count):
+                return ingest.LOG_SEQ_BASE + log.tail_seq()
         last_seq = getattr(backend, "last_seq", None)
         if last_seq is None:
             return None

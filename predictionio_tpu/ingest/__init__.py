@@ -1,0 +1,18 @@
+"""Bulk ingestion subsystem: the columnar event log and its plumbing.
+
+See :mod:`predictionio_tpu.ingest.columnar` for the log itself. The
+write side lives in the event server's bulk routes
+(:mod:`predictionio_tpu.data.api.event_server`); the read side in
+:mod:`predictionio_tpu.data.store.event_stores` (seq-indexed tail) and
+:mod:`predictionio_tpu.data.view.data_view` (train-time snapshots).
+"""
+
+from predictionio_tpu.ingest.columnar import (  # noqa: F401
+    LOG_SEQ_BASE,
+    IngestLog,
+    decode_chunk,
+    diagnose_logs,
+    encode_chunk,
+    log_dir,
+    record_fallback,
+)

@@ -1,0 +1,711 @@
+"""Crash-safe append-only columnar event log.
+
+The high-rate ingest spine the reference never had: the Event Server's
+front door (ref: data/.../api/EventServer.scala) lands events row-at-a-
+time in SQL, and every train re-parses their JSON. Production
+recommenders decouple a sequential append log from training-time
+columnar scans; this module is that log, sized for the bulk routes
+(``POST /batch/events.json``, ``POST /events.ndjson``) and drained by
+``DataView.create`` and the continuous trainer's ingestion cursor.
+
+Layout (one directory per app/channel under ``PIO_INGEST_LOG_DIR``):
+
+  ``alloc.json``    — the cross-process seq allocator: ``{"next_seq": N}``,
+                      published atomically (temp+rename) BEFORE the chunk
+                      it covers is appended, under the directory's flock.
+  ``meta.json``     — read-side coherence snapshot (tail seq, appended
+                      event count, the SQL store's tail/count sampled
+                      after the covered commit), temp+rename.
+  ``seg-<lo>.log``  — bounded append-only segment files; ``<lo>`` is the
+                      first seq in the segment, so a sorted directory
+                      listing IS seq order.
+
+Each append is one length-prefixed CRC-framed *chunk* holding
+struct-of-arrays columns for a batch of events: epoch-ms timestamp
+arrays, string tables interned through the existing BiMap machinery
+(entity ids repeat heavily), numeric properties as typed f64 columns
+with an int/float tag array, and a residual JSON sidecar string per
+event for everything else (odd property types, tags, prId).
+
+Crash safety: the flock is held from seq allocation through the chunk
+append and meta publish, so a tailing reader can never observe seq N+1
+durable while an earlier writer's seq N is still in flight — a SIGKILL
+between allocator publish and append leaves a harmless seq hole (the
+events were never acknowledged), and a torn final frame is dropped by
+the CRC/length recovery walk on reopen.
+
+Coherence: the SQL store remains the source of truth; the log is a
+derived cache. Reads serve from the log only while the meta snapshot
+still matches the store (same tail seq, same event count, no events
+predating the log) — single-row bypass writes, re-sent event ids that
+SQL upserted, or deletes all break the match and degrade reads to the
+SQL path instead of returning wrong answers. The residual risk is a
+direct DAO-level upsert of an existing id outside the event-server API
+(count and tail unchanged, log stale); supported deployments ingest
+through the API, which always appends here.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import logging
+import os
+import struct
+import zlib
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.obs import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+#: Log seqs are exposed to cursor-holding callers (the continuous
+#: trainer) offset into their own space, disjoint from SQL rowids, so a
+#: cursor can never be replayed against the wrong backend: a seq >= the
+#: base is a log position, below it a SQL position.
+LOG_SEQ_BASE = 1 << 40
+
+#: Segment files seal (next append opens a new file) past this size.
+SEGMENT_BYTES = int(
+    os.environ.get("PIO_INGEST_SEGMENT_BYTES", str(4 * 2**20)))
+
+_MAGIC = b"PIOC"
+_VERSION = 1
+_FRAME = struct.Struct("<II")  # payload length, crc32(payload)
+_HEADER = struct.Struct("<4sHHIqqq")  # magic, ver, flags, n, seq_lo,
+#                                       min event ms, max event ms
+#: ints beyond the f64 mantissa can't ride the numeric columns losslessly
+_MAX_EXACT_INT = 2**53
+
+_APPEND_SECONDS = REGISTRY.histogram(
+    "pio_ingest_append_seconds",
+    "Columnar ingest-log append latency (lock, encode, write, publish)",
+)
+_CHUNKS = REGISTRY.counter(
+    "pio_ingest_chunks_total",
+    "Columnar chunks appended to the ingest log",
+)
+_BYTES = REGISTRY.counter(
+    "pio_ingest_bytes_total",
+    "Bytes appended to the ingest log (frames included)",
+)
+_TAIL_SEQ = REGISTRY.gauge(
+    "pio_ingest_log_tail_seq",
+    "Raw tail seq of the columnar ingest log (last appended event)",
+)
+_FALLBACK = REGISTRY.counter(
+    "pio_ingest_fallback_total",
+    "Reads that wanted the columnar log but fell back to SQL "
+    "(surface: view = DataView.create, tail = events_since)",
+    labels=("surface",),
+)
+
+
+def log_dir() -> Path | None:
+    """The ingest-log root (``PIO_INGEST_LOG_DIR``); None = disabled."""
+    root = os.environ.get("PIO_INGEST_LOG_DIR")
+    return Path(root) if root else None
+
+
+def _ms_and_off(t: dt.datetime) -> tuple[int, int]:
+    off = t.utcoffset() or dt.timedelta(0)
+    return int(t.timestamp() * 1000), int(off.total_seconds())
+
+
+def _ms_to_dt(ms: int, off_s: int) -> dt.datetime:
+    tz = dt.timezone.utc if off_s == 0 \
+        else dt.timezone(dt.timedelta(seconds=off_s))
+    # integer second + ms timedelta: exact, unlike fromtimestamp(ms/1e3)
+    # whose float rounding can smear a millisecond into 999999us
+    return dt.datetime.fromtimestamp(ms // 1000, tz) \
+        + dt.timedelta(milliseconds=ms % 1000)
+
+
+class _Writer:
+    """Append-side byte assembly for one chunk payload."""
+
+    def __init__(self):
+        self.parts: list[bytes] = []
+
+    def raw(self, b: bytes) -> None:
+        self.parts.append(b)
+
+    def array(self, a: np.ndarray) -> None:
+        self.parts.append(a.tobytes())
+
+    def strings(self, strs: Sequence[str]) -> None:
+        out = [struct.pack("<I", len(strs))]
+        for s in strs:
+            b = s.encode("utf-8")
+            out.append(struct.pack("<I", len(b)))
+            out.append(b)
+        self.parts.append(b"".join(out))
+
+    def getvalue(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class _Cursor:
+    """Decode-side cursor over one chunk payload."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def raw(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise ValueError("chunk payload truncated")
+        self.pos += n
+        return b
+
+    def array(self, dtype, n: int) -> np.ndarray:
+        dtype = np.dtype(dtype)
+        return np.frombuffer(self.raw(dtype.itemsize * n), dtype=dtype)
+
+    def strings(self) -> list[str]:
+        (count,) = struct.unpack("<I", self.raw(4))
+        # hot loop (every string column of every chunk): one locals-only
+        # pass over the buffer instead of per-string raw() calls
+        buf = self.buf
+        pos = self.pos
+        end = len(buf)
+        unpack_from = struct.unpack_from
+        out: list[str] = []
+        append = out.append
+        for _ in range(count):
+            if pos + 4 > end:
+                raise ValueError("chunk payload truncated")
+            (ln,) = unpack_from("<I", buf, pos)
+            pos += 4
+            if pos + ln > end:
+                raise ValueError("chunk payload truncated")
+            append(buf[pos:pos + ln].decode("utf-8"))
+            pos += ln
+        self.pos = pos
+        return out
+
+
+def _interned(w: _Writer, values: Sequence[str | None]) -> None:
+    """One BiMap-interned string column: table + i32 codes (-1 = NULL)."""
+    table = BiMap.string_int(v for v in values if v is not None)
+    w.strings(list(table.keys()))
+    codes = np.fromiter(
+        (-1 if v is None else table(v) for v in values),
+        dtype=np.int32, count=len(values))
+    w.array(codes)
+
+
+def _read_interned(c: _Cursor, n: int) -> list[str | None]:
+    table = c.strings()
+    codes = c.array(np.int32, n)
+    return [None if k < 0 else table[k] for k in codes]
+
+
+def _split_properties(props: DataMap) -> tuple[dict, dict]:
+    """(numeric, residual): ints/floats ride the typed columns, anything
+    else (bools included — JSON bool is not a number) stays JSON."""
+    numeric: dict[str, int | float] = {}
+    residual: dict = {}
+    for k, v in props.items():
+        if isinstance(v, bool):
+            residual[k] = v
+        elif isinstance(v, int):
+            if -_MAX_EXACT_INT < v < _MAX_EXACT_INT:
+                numeric[k] = v
+            else:
+                residual[k] = v
+        elif isinstance(v, float):
+            numeric[k] = v
+        else:
+            residual[k] = v
+    return numeric, residual
+
+
+def encode_chunk(events: Sequence[Event], event_ids: Sequence[str],
+                 seq_lo: int) -> bytes:
+    """Struct-of-arrays payload for one contiguous batch
+    [seq_lo, seq_lo + len(events))."""
+    n = len(events)
+    etime = np.empty(n, dtype=np.int64)
+    eoff = np.empty(n, dtype=np.int32)
+    ctime = np.empty(n, dtype=np.int64)
+    coff = np.empty(n, dtype=np.int32)
+    numerics: list[dict] = []
+    residuals: list[str] = []
+    num_keys: dict[str, None] = {}  # insertion-ordered set
+    for i, e in enumerate(events):
+        etime[i], eoff[i] = _ms_and_off(e.event_time)
+        ctime[i], coff[i] = _ms_and_off(e.creation_time)
+        numeric, residual = _split_properties(e.properties)
+        numerics.append(numeric)
+        for k in numeric:
+            num_keys[k] = None
+        side: dict = {}
+        if residual:
+            side["p"] = residual
+        if e.tags:
+            side["t"] = list(e.tags)
+        if e.pr_id is not None:
+            side["pr"] = e.pr_id
+        residuals.append(json.dumps(side) if side else "")
+    w = _Writer()
+    w.raw(_HEADER.pack(_MAGIC, _VERSION, 0, n, seq_lo,
+                       int(etime.min()) if n else 0,
+                       int(etime.max()) if n else 0))
+    w.array(etime)
+    w.array(eoff)
+    w.array(ctime)
+    w.array(coff)
+    _interned(w, [e.event for e in events])
+    _interned(w, [e.entity_type for e in events])
+    _interned(w, [e.entity_id for e in events])
+    _interned(w, [e.target_entity_type for e in events])
+    _interned(w, [e.target_entity_id for e in events])
+    w.strings(list(event_ids))
+    w.strings(list(num_keys))
+    for key in num_keys:
+        tags = np.zeros(n, dtype=np.uint8)
+        vals = np.zeros(n, dtype=np.float64)
+        for i, numeric in enumerate(numerics):
+            v = numeric.get(key)
+            if v is None:
+                continue
+            tags[i] = 1 if isinstance(v, int) else 2
+            vals[i] = float(v)
+        w.array(tags)
+        w.array(vals)
+    w.strings(residuals)
+    return w.getvalue()
+
+
+def _decode_rows(payload: bytes, lo_ms: int | None = None,
+                 hi_ms: int | None = None
+                 ) -> list[tuple[int, int, Event]]:
+    """``(raw_seq, event_ms, Event)`` triples in ingestion order. Rows
+    whose event time falls outside the half-open ``[lo_ms, hi_ms)``
+    window are skipped BEFORE Event construction — the typed ms column
+    is the filter, so a windowed snapshot never materializes the rows
+    it would drop."""
+    c = _Cursor(payload)
+    magic, version, _flags, n, seq_lo, _mn, _mx = _HEADER.unpack(
+        c.raw(_HEADER.size))
+    if magic != _MAGIC:
+        raise ValueError("bad chunk magic")
+    if version != _VERSION:
+        raise ValueError(f"unsupported chunk version {version}")
+    etime = c.array(np.int64, n)
+    eoff = c.array(np.int32, n)
+    ctime = c.array(np.int64, n)
+    coff = c.array(np.int32, n)
+    names = _read_interned(c, n)
+    entity_types = _read_interned(c, n)
+    entity_ids = _read_interned(c, n)
+    target_types = _read_interned(c, n)
+    target_ids = _read_interned(c, n)
+    event_ids = c.strings()
+    num_keys = c.strings()
+    num_cols = []
+    for _ in num_keys:
+        tags = c.array(np.uint8, n)
+        vals = c.array(np.float64, n)
+        num_cols.append((tags, vals))
+    residuals = c.strings()
+    out: list[tuple[int, int, Event]] = []
+    # timestamps inside a chunk cluster heavily (a bulk request shares
+    # one creation instant; event times arrive in bursts) — memoize the
+    # ms→datetime conversion per decode
+    when_memo: dict[tuple[int, int], dt.datetime] = {}
+
+    def when(ms: int, off: int) -> dt.datetime:
+        key = (ms, off)
+        v = when_memo.get(key)
+        if v is None:
+            v = when_memo[key] = _ms_to_dt(ms, off)
+        return v
+
+    for i in range(n):
+        ms = int(etime[i])
+        if lo_ms is not None and ms < lo_ms:
+            continue
+        if hi_ms is not None and ms >= hi_ms:
+            continue
+        props: dict = {}
+        for key, (tags, vals) in zip(num_keys, num_cols):
+            tag = tags[i]
+            if tag == 1:
+                props[key] = int(vals[i])
+            elif tag == 2:
+                props[key] = float(vals[i])
+        side = json.loads(residuals[i]) if residuals[i] else {}
+        props.update(side.get("p") or {})
+        out.append((
+            seq_lo + i,
+            ms,
+            Event(
+                event=names[i],
+                entity_type=entity_types[i],
+                entity_id=entity_ids[i],
+                target_entity_type=target_types[i],
+                target_entity_id=target_ids[i],
+                properties=DataMap(props),
+                event_time=when(ms, int(eoff[i])),
+                tags=tuple(side.get("t") or ()),
+                pr_id=side.get("pr"),
+                event_id=event_ids[i],
+                creation_time=when(int(ctime[i]), int(coff[i])),
+            ),
+        ))
+    return out
+
+
+def decode_chunk(payload: bytes) -> list[tuple[int, Event]]:
+    """``(raw_seq, Event)`` pairs in ingestion order."""
+    return [(seq, e) for seq, _ms, e in _decode_rows(payload)]
+
+
+def _atomic_write_json(path: Path, doc: dict) -> None:
+    tmp = path.with_name(f".tmp-{path.name}-{os.getpid()}")
+    tmp.write_text(json.dumps(doc))
+    os.replace(tmp, path)
+
+
+def _read_json(path: Path) -> dict | None:
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+class IngestLog:
+    """One app/channel's columnar log directory: append + tail + scan."""
+
+    def __init__(self, root: Path, app_id: int,
+                 channel_id: int | None = None):
+        name = f"app_{app_id}"
+        if channel_id:
+            name += f"_ch{channel_id}"
+        self.dir = root / name
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._alloc = self.dir / "alloc.json"
+        self._meta = self.dir / "meta.json"
+        self._lockfile = self.dir / "lock"
+        #: segment name -> verified intact byte length; lets append skip
+        #: re-walking a segment this process already reconciled
+        self._seg_tails: dict[str, int] = {}
+
+    @staticmethod
+    def open_default(app_id: int,
+                     channel_id: int | None = None) -> "IngestLog | None":
+        """The env-configured log for one app, or None when disabled."""
+        root = log_dir()
+        if root is None:
+            return None
+        try:
+            return IngestLog(root, app_id, channel_id)
+        except OSError:
+            logger.exception("ingest log unavailable under %s", root)
+            return None
+
+    # -- write side ---------------------------------------------------------
+
+    def _locked(self):
+        """Advisory cross-process writer lock. fcntl.flock when the
+        platform has it; otherwise a best-effort no-op (single-process
+        deployments stay correct via the storage-layer locks)."""
+        import contextlib
+
+        try:
+            import fcntl
+        except ImportError:  # non-POSIX: degrade to unlocked
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def hold():
+            with open(self._lockfile, "a+b") as fh:
+                fcntl.flock(fh, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(fh, fcntl.LOCK_UN)
+
+        return hold()
+
+    def _segments(self) -> list[Path]:
+        return sorted(self.dir.glob("seg-*.log"))
+
+    def _active_segment(self, seq_lo: int) -> Path:
+        segs = self._segments()
+        if segs:
+            last = segs[-1]
+            try:
+                if last.stat().st_size < SEGMENT_BYTES:
+                    return last
+            except OSError:
+                pass
+        return self.dir / f"seg-{seq_lo:020d}.log"
+
+    def _reconcile_tail(self, seg: Path, meta: dict) -> int:
+        """Crash repair for the active segment, run under the writer
+        flock before every append. Two crash shapes leave work behind:
+
+        * the writer died AFTER its frame hit disk but BEFORE the meta
+          publish — the frame is intact but uncounted. Adopt it: fold
+          its events into ``meta`` (tail_seq / event_count) so coherence
+          recovers instead of lagging the store count forever. The
+          events themselves were committed to SQL first, so adopting is
+          counting, never inventing.
+        * the writer died MID-frame — torn bytes at the tail. Truncate
+          back to the last intact frame boundary; appending after torn
+          bytes would leave frames the CRC walk can never reach.
+
+        Mutates ``meta`` in place (the caller publishes it) and returns
+        the number of adopted events. The verified tail size is cached
+        per segment so steady-state appends skip the walk entirely; a
+        cache/stat mismatch (another process appended, or first touch)
+        triggers one full re-walk."""
+        try:
+            size = seg.stat().st_size
+        except OSError:
+            self._seg_tails[seg.name] = 0
+            return 0
+        if self._seg_tails.get(seg.name) == size:
+            return 0
+        end = 0
+        tail = int(meta.get("tail_seq", 0))
+        adopted = 0
+        for seq_lo, n, payload in self._iter_frames(seg):
+            end += _FRAME.size + len(payload)
+            if seq_lo > tail:
+                adopted += n
+                tail = seq_lo + n - 1
+        if adopted:
+            meta["tail_seq"] = tail
+            meta["event_count"] = int(meta.get("event_count", 0)) + adopted
+            logger.warning(
+                "ingest log %s: adopted %d orphaned event(s) from a "
+                "crashed writer (tail_seq -> %d)", seg.name, adopted, tail)
+        if end < size:
+            with open(seg, "r+b") as fh:
+                fh.truncate(end)
+            logger.warning(
+                "ingest log %s: truncated torn tail %d -> %d bytes",
+                seg.name, size, end)
+        self._seg_tails[seg.name] = end
+        return adopted
+
+    def append(self, events: Sequence[Event], event_ids: Sequence[str],
+               store_tail: int | None, store_count: int | None) -> int:
+        """Append one committed batch; returns the first raw seq.
+
+        Call AFTER the SQL commit succeeded — the store stays the source
+        of truth, and ``store_tail``/``store_count`` are its post-commit
+        cursor tail and row count, snapshotted into ``meta.json`` so
+        readers can verify the log still mirrors the store. The flock is
+        held across allocator publish + chunk append + meta publish (see
+        module docstring for why a narrower lock would let a tailing
+        cursor skip a slower writer's events forever)."""
+        import time
+
+        if not events:
+            return 0
+        t0 = time.perf_counter()
+        n = len(events)
+        with self._locked():
+            alloc = _read_json(self._alloc) or {}
+            seq_lo = int(alloc.get("next_seq", 1))
+            # publish the allocation BEFORE the append: a crash after
+            # this point burns the seqs (a harmless hole — the events
+            # were never acknowledged), never reuses them
+            _atomic_write_json(self._alloc, {"next_seq": seq_lo + n})
+            payload = encode_chunk(events, event_ids, seq_lo)
+            frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+            meta = _read_json(self._meta) or {}
+            # repair BEFORE picking the active segment: truncating a
+            # torn tail can pull the last segment back under the
+            # rollover threshold, and the orphan walk must see the
+            # segment the crashed writer actually appended to
+            segs = self._segments()
+            adopted = self._reconcile_tail(segs[-1], meta) if segs else 0
+            seg = self._active_segment(seq_lo)
+            with open(seg, "ab") as fh:
+                fh.write(frame)
+                fh.flush()
+            self._seg_tails[seg.name] = \
+                self._seg_tails.get(seg.name, 0) + len(frame)
+            if "baseline_store_count" not in meta:
+                # first append: events already in SQL before the log
+                # existed are not covered (a non-zero baseline keeps
+                # full-range reads on the SQL path forever)
+                base = (store_count - n - adopted) \
+                    if store_count is not None else 0
+                meta["baseline_store_count"] = max(int(base), 0)
+            meta["tail_seq"] = seq_lo + n - 1
+            meta["event_count"] = int(meta.get("event_count", 0)) + n
+            meta["store_tail"] = store_tail
+            meta["store_count"] = store_count
+            _atomic_write_json(self._meta, meta)
+        _CHUNKS.inc()
+        _BYTES.inc(len(frame))
+        _TAIL_SEQ.set(float(seq_lo + n - 1))
+        _APPEND_SECONDS.observe(time.perf_counter() - t0)
+        return seq_lo
+
+    # -- read side ----------------------------------------------------------
+
+    def meta(self) -> dict:
+        return _read_json(self._meta) or {}
+
+    def tail_seq(self) -> int:
+        return int(self.meta().get("tail_seq", 0))
+
+    def coherent(self, store_tail: int | None,
+                 store_count: int | None) -> bool:
+        """Whether the log still mirrors the SQL store exactly (and
+        covers it from the first event): serve reads from the log only
+        when True. Conservative by construction — a single-row write
+        observed between its SQL commit and its log append reads as
+        incoherent and self-heals one append later."""
+        meta = self.meta()
+        if not meta or int(meta.get("baseline_store_count", 0)) != 0:
+            return False
+        if store_count is not None \
+                and int(meta.get("event_count", -1)) != int(store_count):
+            return False
+        if store_tail is not None and meta.get("store_tail") is not None \
+                and int(meta["store_tail"]) != int(store_tail):
+            return False
+        return True
+
+    def _iter_frames(self, seg: Path):
+        """(seq_lo, n, payload) per intact frame; a torn tail (short
+        frame or CRC mismatch — a writer died mid-append) ends the walk."""
+        try:
+            data = seg.read_bytes()
+        except OSError:
+            return
+        pos = 0
+        while pos + _FRAME.size <= len(data):
+            length, crc = _FRAME.unpack_from(data, pos)
+            start = pos + _FRAME.size
+            payload = data[start:start + length]
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                logger.warning(
+                    "ingest log %s: torn frame at offset %d dropped",
+                    seg.name, pos)
+                return
+            try:
+                _, _, _, n, seq_lo, _, _ = _HEADER.unpack_from(payload)
+            except struct.error:
+                logger.warning(
+                    "ingest log %s: undecodable frame at %d dropped",
+                    seg.name, pos)
+                return
+            yield seq_lo, n, payload
+            pos = start + length
+
+    def events_since(self, since_raw: int,
+                     limit: int | None = None
+                     ) -> list[tuple[int, Event]]:
+        """Events with raw seq strictly greater than ``since_raw``, in
+        seq order. Chunk headers alone prune fully-covered chunks, so a
+        steady tail poll decodes only new data."""
+        out: list[tuple[int, Event]] = []
+        segs = self._segments()
+        # skip whole segments that end before the cursor: a segment's
+        # name is its first seq, so every segment before the last one
+        # whose lo <= since may still straddle the cursor
+        starts = [int(s.stem.split("-", 1)[1]) for s in segs]
+        lo_idx = 0
+        for i, lo in enumerate(starts):
+            if lo <= since_raw:
+                lo_idx = i
+        for seg in segs[lo_idx:]:
+            for seq_lo, n, payload in self._iter_frames(seg):
+                if seq_lo + n - 1 <= since_raw:
+                    continue
+                for seq, event in decode_chunk(payload):
+                    if seq <= since_raw:
+                        continue
+                    out.append((seq, event))
+                    if limit is not None and len(out) >= limit:
+                        return out
+        return out
+
+    def read_all(self) -> list[tuple[int, Event]]:
+        return self.events_since(0)
+
+    def snapshot(self, lo_ms: int | None = None,
+                 hi_ms: int | None = None) -> list[Event]:
+        """Every event whose ms-truncated event time falls in the
+        half-open ``[lo_ms, hi_ms)`` window, ascending by event time
+        with ingestion order breaking ties — exactly the SQL scan's
+        ``ORDER BY eventTimeMs`` result, decoded in bulk (the
+        ``DataView.create`` snapshot read). Chunk headers carry min/max
+        event ms, so chunks wholly outside the window are skipped
+        without decoding."""
+        rows: list[tuple[int, int, Event]] = []
+        for seg in self._segments():
+            for _seq_lo, _n, payload in self._iter_frames(seg):
+                _, _, _, _, _, mn, mx = _HEADER.unpack_from(payload)
+                if (hi_ms is not None and mn >= hi_ms) \
+                        or (lo_ms is not None and mx < lo_ms):
+                    continue
+                rows.extend(_decode_rows(payload, lo_ms, hi_ms))
+        # stable sort on the ms column alone: rows arrive in ingestion
+        # (seq) order, so equal timestamps keep it
+        rows.sort(key=lambda r: r[1])
+        return [e for _seq, _ms, e in rows]
+
+
+def record_fallback(surface: str) -> None:
+    """A read path that preferred the log but degraded to SQL."""
+    _FALLBACK.inc(surface=surface)
+
+
+def diagnose_logs() -> list[dict]:
+    """``pio doctor`` local findings: for every app directory under the
+    configured log root, WARN when the log's snapshot of the store tail
+    lags the store's live tail (bulk writers dead or bypassed?)."""
+    root = log_dir()
+    if root is None or not root.is_dir():
+        return []
+    findings: list[dict] = []
+    try:
+        from predictionio_tpu.data.storage import Storage
+
+        events = Storage.get_events()
+    except Exception:  # storage not configured: nothing to compare
+        return []
+    for d in sorted(root.glob("app_*")):
+        try:
+            parts = d.name.split("_")
+            app_id = int(parts[1])
+            channel_id = int(parts[2][2:]) if len(parts) > 2 else None
+            log = IngestLog(root, app_id, channel_id)
+            meta = log.meta()
+            if not meta:
+                continue
+            last = events.last_seq(app_id, channel_id)
+            snap = meta.get("store_tail")
+            if last is not None and snap is not None \
+                    and int(snap) < int(last):
+                findings.append({
+                    "severity": "warn",
+                    "subject": f"ingest log {d.name}",
+                    "detail": (
+                        f"columnar log tail lags the SQL store (log saw "
+                        f"store seq {snap}, store is at {last}): bulk "
+                        "ingest stalled or writes are bypassing the "
+                        "event server"),
+                })
+        except Exception:
+            logger.debug("doctor: unreadable ingest log dir %s", d,
+                         exc_info=True)
+    return findings

@@ -258,6 +258,18 @@ class HistorySampler:
                              ("400", "401", "404", "500", "503")), dt)
         values["ingest_p99_ms"] = ms(
             self._windowed_quantile("pio_ingest_seconds", 0.99))
+        # bulk ingest (batch + ndjson routes; data/api/event_server.py):
+        # per-event accept/reject rates plus the event-time age of the
+        # newest committed bulk event — the staleness guardrail pio
+        # doctor's ingest finding and the bulk_ingest_success SLO ride
+        values["bulk_ingest_events_per_sec"] = self._rate(
+            "bulk_ingest", ct(reg, "pio_ingest_bulk_events_total",
+                              "status", ("201",)), dt)
+        values["bulk_ingest_error_rate"] = self._rate(
+            "bulk_ingest_err", ct(reg, "pio_ingest_bulk_events_total",
+                                  "status", ("500",)), dt)
+        values["bulk_ingest_lag_seconds"] = _gauge_max(
+            reg, "pio_ingest_lag_seconds")
         # device / resilience
         values["hbm_live_bytes"] = _gauge_sum(reg, "pio_device_hbm_bytes")
         values["retraces_per_sec"] = self._rate(

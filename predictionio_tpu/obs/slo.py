@@ -178,6 +178,16 @@ def default_slos() -> list[SLO]:
             base_includes_bad=False,
         ),
         SLO(
+            name="bulk_ingest_success",
+            description="bulk-path events (batch + ndjson) committed "
+                        "without a store-side failure",
+            kind="ratio",
+            target=_env_float("PIO_SLO_BULK_INGEST_TARGET", 0.999),
+            bad="bulk_ingest_error_rate",
+            base="bulk_ingest_events_per_sec",
+            base_includes_bad=False,
+        ),
+        SLO(
             name="model_staleness",
             description="serving model age under the freshness bound",
             kind="threshold",

@@ -226,6 +226,11 @@ class GatewayConfig:
     #: scraped into GET /metrics/fleet next to the replicas (None = the
     #: serving fleet only)
     event_server: "tuple[str, int] | None" = None
+    #: multi-worker event deployments (``pio eventserver --workers N``):
+    #: every worker's (host, port), each federated as its own
+    #: instance-labelled member; combines with ``event_server`` (the
+    #: router/front port) without duplication
+    event_servers: "tuple[tuple[str, int], ...]" = ()
     #: per-member scrape timeout for GET /metrics/fleet
     fleet_scrape_timeout_sec: float = 2.0
 
@@ -381,12 +386,8 @@ class Gateway:
         replicas = self.registry.replicas()
         # the event server joins feedback in a split deploy — its doc
         # carries the online hit-rate half of the merge
-        extra: list[tuple[str, str, int]] = []
-        if self.config.event_server is not None:
-            host, port = self.config.event_server
-            if host in ("0.0.0.0", "::"):
-                host = "127.0.0.1"
-            extra.append((f"event:{host}:{port}", host, port))
+        extra = [(f"event:{host}:{port}", host, port)
+                 for host, port in self._event_members()]
         members = [(r.id, r.host, r.port) for r in replicas] + extra
         docs: dict[str, dict | None] = {}
         results: list[dict | None] = [None] * len(members)
@@ -431,12 +432,8 @@ class Gateway:
                            "limit") and v}
         qs = urllib.parse.urlencode(params)
         replicas = self.registry.replicas()
-        extra: list[tuple[str, str, int]] = []
-        if self.config.event_server is not None:
-            host, port = self.config.event_server
-            if host in ("0.0.0.0", "::"):
-                host = "127.0.0.1"
-            extra.append((f"event:{host}:{port}", host, port))
+        extra = [(f"event:{host}:{port}", host, port)
+                 for host, port in self._event_members()]
         members = [(r.id, r.host, r.port) for r in replicas] + extra
         results: list[dict | None] = [None] * len(members)
 
@@ -596,10 +593,28 @@ class Gateway:
             conn.close()
 
     # -- fleet federation (obs/fleet.py) ------------------------------------
+    def _event_members(self) -> "list[tuple[str, int]]":
+        """Every event-tier (host, port) to federate: the singular
+        ``event_server`` (router/front port of a worker pool, or the
+        lone server) plus each ``event_servers`` worker, deduplicated in
+        declaration order. Wildcard binds normalize to loopback — the
+        gateway scrapes members from its own host."""
+        members: list[tuple[str, int]] = []
+        singular = self.config.event_server
+        for hp in ((singular,) if singular is not None else ()) \
+                + tuple(self.config.event_servers):
+            host, port = hp
+            if host in ("0.0.0.0", "::"):
+                host = "127.0.0.1"
+            if (host, port) not in members:
+                members.append((host, port))
+        return members
+
     def fleet_targets(self) -> list:
         """Federation membership: the gateway itself (read locally — no
         HTTP round trip into our own process), every registered replica,
-        and the configured event server."""
+        and every configured event-tier member (router + per-process
+        workers in a ``--workers N`` deploy, each its own instance)."""
         from predictionio_tpu.obs import fleet
 
         targets = [fleet.FleetTarget(
@@ -607,10 +622,7 @@ class Gateway:
         for r in self.registry.replicas():
             targets.append(fleet.FleetTarget(
                 instance=r.id, host=r.host, port=r.port, role="replica"))
-        if self.config.event_server is not None:
-            host, port = self.config.event_server
-            if host in ("0.0.0.0", "::"):
-                host = "127.0.0.1"
+        for host, port in self._event_members():
             targets.append(fleet.FleetTarget(
                 instance=f"{host}:{port}", host=host, port=port,
                 role="event"))

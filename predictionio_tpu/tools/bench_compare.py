@@ -56,6 +56,7 @@ _SKIP_KEYS = {
     "ingest_host_cpus", "scan_events", "scan_partitions",
     "band_violations", "dense_cache_hit", "peak_bf16_tflops",
     "sasrec_batch", "sasrec_max_len", "sasrec_serve_placement",
+    "bulk_ingest_chunk", "ingest_view_events",
 }
 
 _LOWER_BETTER_RE = re.compile(

@@ -465,8 +465,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_es.add_argument("--stats", action="store_true")
     p_es.add_argument(
         "--workers", type=int, default=1,
-        help="worker processes sharing the port via SO_REUSEPORT "
-             "(needs a multi-process-safe storage backend; default 1)",
+        help="worker processes behind a routing front port: workers "
+             "listen on consecutive ports (port+1..port+N) and the "
+             "public port round-robins requests across them (needs a "
+             "multi-process-safe storage backend; default 1)",
+    )
+    p_es.add_argument(
+        "--reuseport", action="store_true",
+        help="with --workers N: share the single public port via "
+             "SO_REUSEPORT kernel load-balancing instead of the routed "
+             "pool (no per-worker diagnostics addressing)",
     )
     p_es.set_defaults(func=cmd_eventserver)
 
@@ -1341,11 +1349,16 @@ def cmd_doctor(args) -> int:
     import json as _json
     from pathlib import Path
 
+    from predictionio_tpu import ingest as ingest_mod
     from predictionio_tpu.obs import fleet, runlog
     from predictionio_tpu.obs import logs as logs_mod
     from predictionio_tpu.train import continuous as continuous_mod
 
-    train_findings = runlog.diagnose_runs(getattr(args, "runs_dir", None))
+    # local like the run ledger: the columnar ingest log is a filesystem
+    # surface, judged even with no deployment up (WARN when a log's tail
+    # snapshot lags the live store — bulk writers dead or bypassed)
+    train_findings = (runlog.diagnose_runs(getattr(args, "runs_dir", None))
+                      + ingest_mod.diagnose_logs())
     # trainer state files live under <runs dir>/continuous — judge them
     # from the SAME directory --runs-dir points the run ledger at
     runs_dir = getattr(args, "runs_dir", None)
@@ -1982,6 +1995,7 @@ def cmd_eventserver(args) -> int:
     from predictionio_tpu.data.api.event_server import (
         EventServerCluster,
         EventServerConfig,
+        EventServerPool,
         create_event_server,
     )
     from predictionio_tpu.obs import logs as _logs_mod
@@ -1993,7 +2007,7 @@ def cmd_eventserver(args) -> int:
     config = EventServerConfig(
         ip=args.ip, port=args.port, stats=args.stats, workers=workers
     )
-    if workers > 1:
+    if workers > 1 and getattr(args, "reuseport", False):
         cluster = EventServerCluster(config)
         cluster.start()
         print(
@@ -2006,6 +2020,21 @@ def cmd_eventserver(args) -> int:
             pass
         finally:
             cluster.stop()
+        return 0
+    if workers > 1:
+        pool = EventServerPool(config)
+        pool.start()
+        print(
+            f"[INFO] Event Server is listening on {args.ip}:{pool.port} "
+            f"({workers} routed workers on ports "
+            f"{pool.worker_ports[0]}-{pool.worker_ports[-1]})"
+        )
+        try:
+            pool.wait()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            pool.stop()
         return 0
     server = create_event_server(config)
     server.start()

@@ -392,7 +392,7 @@ def test_slo_config_env_override(monkeypatch):
     eng2 = slo.SLOEngine()  # broken config falls back to defaults
     assert [s.name for s in eng2.slos] == [
         "query_availability", "query_latency_p99", "ingest_success",
-        "model_staleness", "online_quality"]
+        "bulk_ingest_success", "model_staleness", "online_quality"]
 
 
 # -- doctor heuristics (pure) -------------------------------------------------

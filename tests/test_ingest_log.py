@@ -1,0 +1,502 @@
+"""Columnar ingest log: codec fidelity, crash recovery, bulk routes,
+snapshot reads, and the multi-process worker pool.
+
+The log (predictionio_tpu/ingest/columnar.py) is a derived cache of the
+SQL event store — these tests pin the three contracts that make it safe
+to read from: the codec round-trips every Event field exactly, crash
+shapes (torn frame / orphan frame / burned alloc) recover without
+losing or duplicating acknowledged events, and read surfaces
+(``PEventStore.events_since``, ``DataView.create``) serve from the log
+ONLY while it provably mirrors the store — any bypass degrades to SQL
+rather than answering wrong."""
+
+import datetime as dt
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.ingest import (
+    LOG_SEQ_BASE,
+    IngestLog,
+    decode_chunk,
+    encode_chunk,
+)
+
+UTC = dt.timezone.utc
+
+
+def _ev(i: int, offset_s: int = 0) -> Event:
+    return Event(
+        event="rate",
+        entity_type="user",
+        entity_id=f"u{i}",
+        event_time=dt.datetime(2026, 1, 1, tzinfo=UTC)
+        + dt.timedelta(seconds=i + offset_s),
+    )
+
+
+def _ev_json(i: int) -> dict:
+    t = dt.datetime(2026, 1, 1, tzinfo=UTC) + dt.timedelta(seconds=i)
+    return {
+        "event": "rate",
+        "entityType": "user",
+        "entityId": f"u{i}",
+        "targetEntityType": "item",
+        "targetEntityId": f"i{i % 7}",
+        "properties": {"rating": float(i % 5), "n": i},
+        "eventTime": t.isoformat(),
+    }
+
+
+def _call(port, method, path, params=None, body=None, raw=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    data = raw
+    if body is not None:
+        data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def log_root(tmp_path, monkeypatch):
+    d = tmp_path / "ingestlog"
+    monkeypatch.setenv("PIO_INGEST_LOG_DIR", str(d))
+    return d
+
+
+class TestCodec:
+    def test_roundtrip_every_field(self, log_root):
+        tz = dt.timezone(dt.timedelta(hours=-7))
+        events = [
+            Event(
+                event="buy",
+                entity_type="user",
+                entity_id="u1",
+                target_entity_type="item",
+                target_entity_id="i9",
+                properties=DataMap({
+                    "price": 3.5,          # float -> typed column
+                    "qty": 2,              # int -> typed column, exact
+                    "big": 2 ** 60,        # beyond f64 mantissa -> JSON
+                    "flag": True,          # bool is NOT a number -> JSON
+                    "note": "héllo",       # string -> JSON sidecar
+                    "nested": {"a": [1, 2]},
+                }),
+                event_time=dt.datetime(2025, 3, 1, 12, 0, 0, 250000,
+                                       tzinfo=tz),
+                tags=("t1", "t2"),
+                pr_id="pr7",
+                creation_time=dt.datetime(2025, 3, 1, 19, 0, 1, tzinfo=UTC),
+            ),
+            Event(
+                event="view",
+                entity_type="user",
+                entity_id="u2",
+                event_time=dt.datetime(2025, 3, 2, tzinfo=UTC),
+                creation_time=dt.datetime(2025, 3, 2, tzinfo=UTC),
+            ),
+        ]
+        payload = encode_chunk(events, ["e1", "e2"], seq_lo=5)
+        rows = decode_chunk(payload)
+        assert [s for s, _ in rows] == [5, 6]
+        for orig, (_, got) in zip(events, rows):
+            assert got.event == orig.event
+            assert got.entity_type == orig.entity_type
+            assert got.entity_id == orig.entity_id
+            assert got.target_entity_type == orig.target_entity_type
+            assert got.target_entity_id == orig.target_entity_id
+            assert got.tags == orig.tags
+            assert got.pr_id == orig.pr_id
+            assert got.event_time == orig.event_time
+            assert got.event_time.utcoffset() == orig.event_time.utcoffset()
+            assert got.creation_time == orig.creation_time
+            props = dict(got.properties.items())
+            assert props == dict(orig.properties.items())
+            # int-ness survives the typed column, not just the value
+            for k, v in props.items():
+                assert type(v) is type(dict(orig.properties.items())[k])
+        assert rows[0][1].event_id == "e1"
+        assert rows[1][1].event_id == "e2"
+
+
+class TestCrashRecovery:
+    def test_torn_tail_truncated_on_next_append(self, log_root):
+        log = IngestLog.open_default(1)
+        log.append([_ev(0), _ev(1)], ["a", "b"], 2, 2)
+        seg = log._segments()[-1]
+        with open(seg, "ab") as fh:  # writer died mid-frame
+            fh.write(b"\x40\x00\x00\x00\xde\xad\xbe\xefTORN")
+        # fresh handle = fresh process: no warm tail cache
+        log2 = IngestLog.open_default(1)
+        log2.append([_ev(2)], ["c"], 3, 3)
+        assert b"TORN" not in seg.read_bytes()
+        got = log2.events_since(0)
+        assert [e.entity_id for _, e in got] == ["u0", "u1", "u2"]
+        assert log2.coherent(3, 3)
+
+    def test_orphan_frame_adopted_into_meta(self, log_root):
+        log = IngestLog.open_default(1)
+        log.append([_ev(0)], ["a"], 1, 1)
+        meta_before = log._meta.read_text()
+        log.append([_ev(1), _ev(2)], ["b", "c"], 3, 3)
+        # crash between frame write and meta publish: frame durable,
+        # meta still the old snapshot
+        log._meta.write_text(meta_before)
+        log2 = IngestLog.open_default(1)
+        assert not log2.coherent(3, 3)  # lagging until repaired
+        log2.append([_ev(3)], ["d"], 4, 4)
+        got = log2.events_since(0)
+        assert [e.entity_id for _, e in got] == ["u0", "u1", "u2", "u3"]
+        seqs = [s for s, _ in got]
+        assert seqs == sorted(set(seqs))
+        assert log2.coherent(4, 4)
+
+    def test_burned_alloc_leaves_hole_never_reuses(self, log_root):
+        log = IngestLog.open_default(1)
+        log.append([_ev(0)], ["a"], 1, 1)
+        # crashed writer published the allocation but never appended:
+        # those seqs are burned, not reusable
+        alloc = json.loads((log.dir / "alloc.json").read_text())
+        alloc["next_seq"] += 5
+        (log.dir / "alloc.json").write_text(json.dumps(alloc))
+        log2 = IngestLog.open_default(1)
+        log2.append([_ev(1)], ["b"], 2, 2)
+        got = log2.read_all()
+        assert [s for s, _ in got] == [1, 7]  # hole, no dupes
+        assert [e.entity_id for _, e in got] == ["u0", "u1"]
+        # burned seqs never held acknowledged events, so the hole does
+        # not break coherence
+        assert log2.coherent(2, 2)
+
+    def test_sigkill_mid_write_recovers_to_last_complete_record(
+            self, log_root, tmp_path):
+        """A writer SIGKILLed mid-append must cost at most its own
+        unacknowledged tail: recovery reads every complete record, seqs
+        stay unique and ascending, and the next writer appends past the
+        old tail."""
+        script = tmp_path / "die.py"
+        script.write_text(
+            "import datetime as dt, os, sys\n"
+            "os.environ['PIO_INGEST_LOG_DIR'] = sys.argv[1]\n"
+            "from predictionio_tpu.data.event import Event\n"
+            "from predictionio_tpu.ingest import IngestLog\n"
+            "log = IngestLog.open_default(3)\n"
+            "i = 0\n"
+            "while True:\n"
+            "    evs = [Event(event='e', entity_type='u',\n"
+            "                 entity_id=f'c{i}-{j}',\n"
+            "                 event_time=dt.datetime(\n"
+            "                     2026, 1, 1, tzinfo=dt.timezone.utc))\n"
+            "           for j in range(25)]\n"
+            "    log.append(evs, [f'id{i}-{j}' for j in range(25)],\n"
+            "               None, None)\n"
+            "    i += 1\n"
+            "    print(i, flush=True)\n"
+        )
+        repo_root = str(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": repo_root + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        }
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(log_root)],
+            env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            # let a few appends land, then kill without warning
+            for line in proc.stdout:
+                if int(line) >= 3:
+                    break
+            proc.kill()
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+        assert proc.returncode == -signal.SIGKILL
+        log = IngestLog.open_default(3)
+        got = log.read_all()
+        assert len(got) >= 3 * 25  # everything acknowledged survived
+        seqs = [s for s, _ in got]
+        assert seqs == sorted(set(seqs))
+        ids = [e.entity_id for _, e in got]
+        assert len(ids) == len(set(ids))
+        # the next writer repairs any torn tail and appends past it
+        log.append([_ev(999)], ["post-crash"], None, None)
+        got2 = log.read_all()
+        assert len(got2) == len(got) + 1
+        assert got2[-1][0] > seqs[-1]
+        assert got2[-1][1].entity_id == "u999"
+
+
+class TestSnapshot:
+    def test_window_is_half_open_and_tie_stable(self, log_root):
+        log = IngestLog.open_default(1)
+        t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+
+        def at(sec, uid):
+            return Event(event="e", entity_type="u", entity_id=uid,
+                         event_time=t0 + dt.timedelta(seconds=sec))
+
+        # duplicate timestamps across chunks: ties must keep ingestion
+        # (seq) order, exactly like SQL's stable ORDER BY eventTimeMs
+        log.append([at(5, "a"), at(1, "b")], ["1", "2"], None, None)
+        log.append([at(5, "c"), at(9, "d")], ["3", "4"], None, None)
+        log.append([at(3, "e")], ["5"], None, None)
+        ms = lambda sec: int((t0 + dt.timedelta(seconds=sec)).timestamp()
+                             * 1000)
+        got = [e.entity_id for e in log.snapshot(lo_ms=ms(3), hi_ms=ms(9))]
+        assert got == ["e", "a", "c"]  # 9 excluded, 1 below, ties a<c
+        assert [e.entity_id for e in log.snapshot()] == \
+            ["b", "e", "a", "c", "d"]
+
+
+@pytest.fixture()
+def sql_server(sqlite_storage, log_root):
+    from predictionio_tpu.data.api.event_server import (
+        EventServerConfig,
+        create_event_server,
+    )
+    from predictionio_tpu.data.storage.base import AccessKey, App
+
+    apps = sqlite_storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "ingestapp"))
+    key = sqlite_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    sqlite_storage.get_events().init(app_id)
+    srv = create_event_server(EventServerConfig(ip="127.0.0.1", port=0))
+    srv.start()
+    yield {"port": srv.port, "key": key, "app_id": app_id,
+           "storage": sqlite_storage}
+    srv.stop()
+
+
+class TestServerRoutes:
+    def test_all_routes_keep_log_coherent_and_tail_serves_it(
+            self, sql_server):
+        from predictionio_tpu.data.store.event_stores import PEventStore
+
+        port, key = sql_server["port"], sql_server["key"]
+        status, body = _call(port, "POST", "/events.json",
+                             {"accessKey": key}, _ev_json(0))
+        assert status == 201
+        status, verdicts = _call(port, "POST", "/batch/events.json",
+                                 {"accessKey": key},
+                                 [_ev_json(1), _ev_json(2)])
+        assert status == 200
+        assert [v["status"] for v in verdicts] == [201, 201]
+        nd = "\n".join(json.dumps(_ev_json(i)) for i in (3, 4)).encode()
+        status, verdicts = _call(
+            port, "POST", "/events.ndjson", {"accessKey": key}, raw=nd)
+        assert status == 200
+        assert [v["status"] for v in verdicts] == [201, 201]
+
+        got = PEventStore.events_since("ingestapp")
+        assert got is not None and len(got) == 5
+        seqs = [s for s, _ in got]
+        assert all(s >= LOG_SEQ_BASE for s in seqs)  # log space
+        assert seqs == sorted(set(seqs))
+        assert [e.entity_id for _, e in got] == [f"u{i}" for i in range(5)]
+        assert PEventStore.tail_seq("ingestapp") == seqs[-1]
+        # steady poll from the tail: nothing, then exactly the new event
+        assert PEventStore.events_since("ingestapp",
+                                        since_seq=seqs[-1]) == []
+        _call(port, "POST", "/events.json", {"accessKey": key}, _ev_json(5))
+        tail = PEventStore.events_since("ingestapp", since_seq=seqs[-1])
+        assert [e.entity_id for _, e in tail] == ["u5"]
+        assert tail[0][0] > seqs[-1]
+
+    def test_bypass_write_degrades_reads_to_sql(self, sql_server):
+        from predictionio_tpu.data.event import Event as Ev
+        from predictionio_tpu.data.store.event_stores import PEventStore
+
+        port, key = sql_server["port"], sql_server["key"]
+        for i in range(3):
+            _call(port, "POST", "/events.json", {"accessKey": key},
+                  _ev_json(i))
+        cursor = PEventStore.tail_seq("ingestapp")
+        assert cursor is not None and cursor >= LOG_SEQ_BASE
+        # a writer bypasses the event server: the log no longer mirrors
+        # the store and MUST stop answering
+        sql_server["storage"].get_events().insert(
+            Ev.from_json(_ev_json(7)), sql_server["app_id"])
+        got = PEventStore.events_since("ingestapp")
+        assert got is not None and len(got) == 4
+        assert all(s < LOG_SEQ_BASE for s, _ in got)  # SQL rowid space
+        # a log-space cursor must never be replayed against SQL rowids
+        assert PEventStore.events_since("ingestapp",
+                                        since_seq=cursor) is None
+
+    def test_ndjson_per_line_verdicts_one_commit(self, sql_server):
+        port, key = sql_server["port"], sql_server["key"]
+        lines = [
+            json.dumps(_ev_json(0)),
+            "{not json",
+            json.dumps(dict(_ev_json(1), event="$custom")),
+            json.dumps(_ev_json(2)),
+        ]
+        status, verdicts = _call(
+            port, "POST", "/events.ndjson", {"accessKey": key},
+            raw="\n".join(lines).encode())
+        assert status == 200
+        assert [v["status"] for v in verdicts] == [201, 400, 400, 201]
+        assert "invalid JSON line" in verdicts[1]["message"]
+        assert "reserved" in verdicts[2]["message"]
+        stored = sorted(
+            e.entity_id for e in
+            sql_server["storage"].get_events().find(
+                app_id=sql_server["app_id"]))
+        assert stored == ["u0", "u2"]  # failed lines failed alone
+
+    def test_data_view_from_log_equals_sql_scan(self, sql_server,
+                                                monkeypatch):
+        from predictionio_tpu.data.store.event_stores import PEventStore
+        from predictionio_tpu.data.view.data_view import DataView
+        from predictionio_tpu.utils.time import to_millis
+
+        port, key = sql_server["port"], sql_server["key"]
+        status, verdicts = _call(
+            port, "POST", "/batch/events.json", {"accessKey": key},
+            [_ev_json(i) for i in range(40)])
+        assert status == 200 and len(verdicts) == 40
+
+        def conv(e):
+            if int(e.properties.get("n")) % 3 == 0:
+                return None  # exercise row dropping
+            return {"uid": e.entity_id,
+                    "rating": float(e.properties.get("rating")),
+                    "ms": to_millis(e.event_time)}
+
+        start = dt.datetime(2026, 1, 1, tzinfo=UTC) + dt.timedelta(
+            seconds=10)
+        # the log path must not touch the SQL scan at all
+        with pytest.MonkeyPatch.context() as mp:
+            def _boom(*a, **k):
+                raise AssertionError("log-backed view used the SQL scan")
+
+            mp.setattr(PEventStore, "find", _boom)
+            view_log = DataView.create("ingestapp", conv, start_time=start)
+        monkeypatch.delenv("PIO_INGEST_LOG_DIR")
+        view_sql = DataView.create("ingestapp", conv, start_time=start)
+        assert set(view_log) == set(view_sql)
+        for col in view_sql:
+            assert np.array_equal(view_log[col], view_sql[col]), col
+        assert len(view_sql["uid"]) > 0
+
+
+class TestWorkerPool:
+    def test_two_worker_pool_chaos_at_most_once(
+            self, sqlite_storage, log_root, monkeypatch):
+        """The acceptance chaos drill: a 2-worker pool under an
+        ``eventstore.commit`` fault burst drops no acknowledged batch
+        and double-commits none — the store ends up holding EXACTLY the
+        union of the 201-acked batches."""
+        from predictionio_tpu.data.api.event_server import (
+            EventServerConfig,
+            EventServerPool,
+        )
+        from predictionio_tpu.data.storage.base import AccessKey, App
+        from predictionio_tpu.resilience import faults
+
+        monkeypatch.setenv("PIO_CHAOS", "1")
+        apps = sqlite_storage.get_meta_data_apps()
+        app_id = apps.insert(App(0, "poolapp"))
+        key = sqlite_storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ()))
+        sqlite_storage.get_events().init(app_id)
+        pool = EventServerPool(
+            EventServerConfig(ip="127.0.0.1", port=0, workers=2))
+        pool.start()
+        try:
+            # the burst lands on every WORKER via the public port
+            status, doc = _call(pool.port, "POST", "/debug/faults", None,
+                                {"spec": "eventstore.commit:error:1:4"})
+            assert status == 200
+            assert [w["worker"] for w in doc["workers"]] == [0, 1]
+            assert all(w.get("installed") == 1 for w in doc["workers"])
+
+            acked, failed = [], []
+            for b in range(12):
+                ids = [f"b{b}e{j}" for j in range(5)]
+                body = [dict(_ev_json(b * 5 + j), entityId=ids[j])
+                        for j in range(5)]
+                status, verdicts = _call(
+                    pool.port, "POST", "/batch/events.json",
+                    {"accessKey": key}, body)
+                if status == 200 and all(
+                        v.get("status") == 201 for v in verdicts):
+                    acked.extend(ids)
+                else:
+                    failed.extend(ids)
+            assert failed, "fault burst never fired"
+            assert acked, "no batch survived the burst"
+            stored = {e.entity_id for e in
+                      sqlite_storage.get_events().find(app_id=app_id)}
+            # at-most-once AND at-least-once per acknowledged batch
+            assert stored == set(acked)
+
+            # per-worker observability: the router scrape is its own,
+            # each worker answers on its own port
+            raw = urllib.request.urlopen(
+                f"http://127.0.0.1:{pool.port}/metrics",
+                timeout=10).read().decode()
+            assert "pio_ingest_router_requests_total" in raw
+            for wp in pool.worker_ports:
+                wraw = urllib.request.urlopen(
+                    f"http://127.0.0.1:{wp}/metrics",
+                    timeout=10).read().decode()
+                assert "pio_ingest_bulk_events_total" in wraw
+        finally:
+            pool.stop()
+            faults.clear()  # the router mirrored the spec locally
+
+
+class TestPostgresSeqCursor:
+    def test_real_seq_column_cursor_contract(self, postgres_storage):
+        events = postgres_storage.get_events()
+        events.init(9)
+        evs = [Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                     event_time=dt.datetime(2026, 1, 1, tzinfo=UTC)
+                     + dt.timedelta(seconds=i),
+                     event_id=f"pgid{i}")
+               for i in range(4)]
+        assert events.insert_batch(evs, 9) == [f"pgid{i}"
+                                               for i in range(4)]
+        got = events.find_since(9)
+        assert got is not None
+        seqs = [s for s, _ in got]
+        assert seqs == sorted(set(seqs)) and len(seqs) == 4
+        assert [e.entity_id for _, e in got] == [f"u{i}" for i in range(4)]
+        assert events.last_seq(9) == seqs[-1]
+        assert events.count(9) == 4
+        # strictly-after cursor semantics
+        tail = events.find_since(9, since_seq=seqs[1])
+        assert [s for s, _ in tail] == seqs[2:]
+        # a re-sent event id upserts in place: same count, same tail —
+        # the id never reappears past a reader's cursor
+        events.insert_batch(
+            [Event(event="rate", entity_type="user", entity_id="u0",
+                   event_time=evs[0].event_time, event_id="pgid0")], 9)
+        assert events.count(9) == 4
+        assert events.last_seq(9) == seqs[-1]

@@ -63,6 +63,16 @@ class TestDialect:
         assert d.blob == "LONGBLOB"
         assert d.bigint == "BIGINT"
 
+    def test_events_table_declares_real_seq_cursor(self):
+        """The events DDL carries a server-assigned AUTO_INCREMENT seq
+        (the ingestion-order cursor find_since/last_seq walk) with the
+        event id demoted to a UNIQUE key, so a re-sent id upserts in
+        place instead of minting a new seq."""
+        sql = MySQLDialect().events_table_sql("t_events")
+        assert "seq BIGINT NOT NULL AUTO_INCREMENT PRIMARY KEY" in sql
+        assert "id VARCHAR(255) UNIQUE NOT NULL" in sql
+        assert MySQLDialect().seq_column == "seq"
+
 
 class _FakeCursor:
     def __init__(self, driver):
@@ -92,6 +102,9 @@ class _FakeConn:
     def commit(self):
         self.driver.commits += 1
 
+    def rollback(self):
+        self.driver.rollbacks += 1
+
     def close(self):
         self.driver.closed = True
 
@@ -108,6 +121,7 @@ class _FakeDriver:
         self.executed = []
         self.rows = []
         self.commits = 0
+        self.rollbacks = 0
         self.closed = False
         self.connect_kwargs = None
 
@@ -152,6 +166,29 @@ class TestAdapter:
         assert sql == 'INSERT INTO "t" VALUES (%s)'
         assert seq == [(1,), (2,), (3,)]
         assert driver.commits == 1
+
+    def test_executemany_fault_site_rolls_back(self, driver):
+        """The bulk insert's chaos hook: an injected eventstore.commit
+        fault inside the executemany transaction must roll the whole
+        batch back (no partial commit) and surface the error."""
+        from predictionio_tpu.resilience import faults
+
+        c = MySQLClient({}, driver_module=driver)
+        base = driver.commits
+        faults.install("eventstore.commit:error:1:1")
+        try:
+            with pytest.raises(faults.InjectedFault):
+                c.executemany('INSERT INTO "t" VALUES (?)', [(1,), (2,)],
+                              fault_site="eventstore.commit")
+        finally:
+            faults.clear()
+        assert driver.rollbacks == 1
+        assert driver.commits == base  # nothing committed
+        # burst spent: the same site commits cleanly again
+        c.executemany('INSERT INTO "t" VALUES (?)', [(3,)],
+                      fault_site="eventstore.commit")
+        assert driver.commits == base + 1
+        assert driver.rollbacks == 1
 
     def test_integrity_errors_wired_from_driver(self, driver):
         c = MySQLClient({}, driver_module=driver)
