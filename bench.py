@@ -102,6 +102,9 @@ SCALES: dict[str, dict] = {
         sasrec=dict(n_seqs=16_384, n_items=20_000, max_len=128,
                     batch=256, embed_dim=64, num_blocks=2, epochs=2,
                     samples=3),
+        sharded=dict(iters=8, repeats=2),
+        synth10x=dict(shape=(1_384_930, 26_744, 60_000_000), rank=16,
+                      iters=4),
         serving=True, host_baseline=True,
     ),
     "dry": dict(
@@ -113,6 +116,8 @@ SCALES: dict[str, dict] = {
                        dense_compare=True),
         sasrec=dict(n_seqs=192, n_items=400, max_len=16, batch=64,
                     embed_dim=16, num_blocks=1, epochs=1, samples=2),
+        sharded=dict(iters=2, repeats=1),
+        synth10x=dict(shape=(4_000, 400, 48_000), rank=8, iters=2),
         # the serving bench spins up real servers and the host baseline
         # times a minutes-long numpy solve: both are skipped at dry
         # scale (vs_baseline falls back to the assumed figure)
@@ -960,6 +965,72 @@ def _section_mfu(state: _BenchState) -> None:
     extra["peak_bf16_tflops"] = peak / 1e12
 
 
+def _section_ml20m_sharded(state: _BenchState) -> None:
+    """ALX-style sharded-ALS scaling probe (guarded). Trains the ML-20M
+    shape on the full data-axis mesh through the two-sided sharded
+    solver, then the SAME shape on a one-device sub-mesh, and reports
+    ``sharded_scaling_frac`` — per-shard throughput at N shards over the
+    single-device rate, i.e. the fraction of linear scaling the
+    slice-exchange pipeline preserves (1.0 = perfect). Also surfaces the
+    per-iteration slice-exchange volume and the data-shard imbalance the
+    live ``pio_als_shard_*`` metrics track. Keys absent on a one-device
+    mesh (nothing to shard)."""
+    import sys as _sys
+
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    ndev = int(state.ctx.mesh.shape.get("data", 1))
+    if ndev < 2:
+        print("[bench] ml20m_sharded section skipped: one-device mesh",
+              file=_sys.stderr)
+        return
+    ui, ii, r, nu, ni = state.ml20m()
+    cfg = state.cfg["sharded"]
+    one = ComputeContext(Mesh(
+        np.asarray(state.ctx.mesh.devices.flat[:1]).reshape(1, 1),
+        state.ctx.mesh.axis_names))
+    base_ips, _ = bench_als(one, ui, ii, r, nu, ni, rank=10,
+                            iters=cfg["iters"], repeats=cfg["repeats"])
+    ips, _ = bench_als(state.ctx, ui, ii, r, nu, ni, rank=10,
+                       iters=cfg["iters"], repeats=cfg["repeats"])
+    stats = als_dense.last_sharded_stats or {}
+    state.extra["sharded_shards"] = ndev
+    state.extra["sharded_iter_per_sec"] = round(ips, 3)
+    state.extra["sharded_scaling_frac"] = round(
+        ips / max(base_ips * ndev, 1e-9), 4)
+    if stats:
+        state.extra["sharded_iter_gather_bytes"] = int(
+            stats["gather_bytes_per_iter"])
+        state.extra["sharded_imbalance"] = round(
+            float(stats["imbalance"]), 3)
+
+
+def _section_synth10x(state: _BenchState) -> None:
+    """Beyond-one-HBM story (guarded): a synthetic dataset with 10x the
+    ML-20M user count. The point is not the rate — it is that the
+    sharded solver keeps only per-shard factor slabs plus slice slots
+    resident, so ``synth10x_per_shard_hbm_bytes`` stays far under the
+    ``synth10x_replicated_item_bytes`` a replicated item table would pin
+    on every device. On a one-device mesh only the rate is reported."""
+    from predictionio_tpu.models import als_dense
+
+    cfg = state.cfg["synth10x"]
+    nu, ni, nnz = cfg["shape"]
+    ui, ii, r = synthesize(nu, ni, nnz, seed=7)
+    ips, _ = bench_als(state.ctx, ui, ii, r, nu, ni, rank=cfg["rank"],
+                       iters=cfg["iters"])
+    state.extra["synth10x_users_iter_per_sec"] = round(ips, 3)
+    stats = als_dense.last_sharded_stats or {}
+    if int(state.ctx.mesh.shape.get("data", 1)) > 1 and stats:
+        state.extra["synth10x_per_shard_hbm_bytes"] = int(
+            stats["per_shard_hbm_bytes"])
+        state.extra["synth10x_replicated_item_bytes"] = int(
+            stats["replicated_item_bytes"])
+
+
 def _section_two_tower(state: _BenchState) -> None:
     """Two-tower retrieval training throughput (BASELINE configs[4])."""
     state.extra.update(bench_two_tower(state.ctx, state.cfg["two_tower"]))
@@ -1018,6 +1089,8 @@ SECTIONS: list = [
     ("ml20m_warm", _section_ml20m_warm, None),
     ("ml20m_rank64", _section_rank64, "rank64_bench_error"),
     ("mfu", _section_mfu, "mfu_bench_error"),
+    ("ml20m_sharded", _section_ml20m_sharded, "sharded_bench_error"),
+    ("synth10x", _section_synth10x, "synth10x_bench_error"),
     ("two_tower", _section_two_tower, "two_tower_bench_error"),
     ("sasrec", _section_sasrec, "sasrec_bench_error"),
     ("serving", _section_serving, "serving_bench_error"),
@@ -1278,7 +1351,9 @@ def _dry_run_doc() -> dict:
         # (higher-is-better; gate with --key-threshold two_tower_mfu=...)
         "extra": {"dry_run": True, "peak_hbm_bytes": None,
                   "retraces": None, "two_tower_mfu": None,
-                  "sasrec_examples_per_sec": None},
+                  "sasrec_examples_per_sec": None,
+                  "sharded_scaling_frac": None,
+                  "synth10x_users_iter_per_sec": None},
     }
 
 

@@ -1004,14 +1004,23 @@ class ALS:
         n_items: int,
         callback=None,
         resume=None,
+        checkpoint=None,
     ) -> ALSFactors:
         """``resume`` = ``(start_iter, user_f, item_f)`` restores a
         crash-safe checkpoint (utils/checkpoint.TrainCheckpointer): the
         solve continues from ``start_iter`` on the given host factors
-        instead of the seeded init. Supported on the single-device dense
-        path (the one the checkpoint callback runs on); other solvers
-        log and start fresh — a resume must never silently corrupt a
-        solver that can't honor it."""
+        instead of the seeded init. Supported on the dense paths — the
+        single-device solver AND the SPMD sharded solver (which
+        re-shards a resume tuple across the current device count);
+        other solvers log and start fresh — a resume must never
+        silently corrupt a solver that can't honor it.
+
+        ``checkpoint`` (utils/checkpoint.TrainCheckpointSpec) hands the
+        SPMD sharded path a bound checkpointer: it saves per-shard
+        factor slabs + a layout manifest every ``every`` iterations and
+        (when ``checkpoint.resume``) resumes from the newest valid one,
+        re-sharding across a different device count. Single-device
+        callers keep driving saves through ``callback`` instead."""
         p = self.params
         ctx = self.ctx
         user_idx = np.asarray(user_idx, dtype=np.int32)
@@ -1030,6 +1039,11 @@ class ALS:
                 "ALS resume is only supported on the dense solver; "
                 "solver=%r starts from scratch", p.solver)
             resume = None
+        if checkpoint is not None and p.solver == "segment":
+            logger.warning(
+                "ALS checkpointing is only supported on the dense solver "
+                "paths; solver=%r trains without snapshots", p.solver)
+            checkpoint = None
         if p.solver == "segment":
             return self._train_segment(
                 user_idx, item_idx, ratings, n_users, n_items, callback
@@ -1050,15 +1064,17 @@ class ALS:
                 if ctx.mesh.devices.size > 1:
                     if als_dense.sharded_block_fits(
                             ctx, n_users, n_items, ratings.size):
-                        if resume is not None:
-                            logger.warning(
-                                "ALS resume is not supported on the SPMD "
-                                "sharded dense path; starting from scratch")
-                        # SPMD: one A row-block per device, item normal
-                        # equations completed by a psum over `data`
+                        # SPMD (ALX layout): users and items both
+                        # row-shard over `data`; per-iteration exchange
+                        # ships only referenced factor slices
                         user_f, item_f = als_dense.train_dense_sharded(
                             ctx, p, user_idx, item_idx, ratings, n_users,
-                            n_items, callback=callback)
+                            n_items, callback=callback, resume=resume,
+                            checkpoint=checkpoint)
+                        if checkpoint is not None:
+                            # the run completed; its snapshots are
+                            # obsolete
+                            checkpoint.checkpointer.clear()
                         return ALSFactors(
                             np.asarray(user_f), np.asarray(item_f))
                     # explicit solver="dense" on a mesh whose per-device
@@ -1073,6 +1089,39 @@ class ALS:
                         "back to the SINGLE-DEVICE dense path on the "
                         "default device",
                         ctx.mesh.devices.size, n_users, n_items)
+                if checkpoint is not None:
+                    # single-device dense: whole-factor snapshots ride
+                    # the per-iteration callback; resume restores global
+                    # host factors through the structure-checked loader
+                    ck = checkpoint.checkpointer
+                    fp = checkpoint.fingerprint
+                    if resume is None and checkpoint.resume:
+                        like = {
+                            "user": np.zeros((n_users, p.rank),
+                                             np.float32),
+                            "item": np.zeros((n_items, p.rank),
+                                             np.float32),
+                        }
+                        got = ck.load_latest(like, fingerprint=fp)
+                        if got is not None:
+                            step, state = got
+                            resume = (step + 1, state["user"],
+                                      state["item"])
+                            logger.info(
+                                "ALS train resuming from checkpoint "
+                                "step %d (iteration %d of %d)", step,
+                                step + 1, p.num_iterations)
+                    inner_cb = callback
+
+                    def callback(it, user_f, item_f, _inner=inner_cb,
+                                 _ck=ck, _fp=fp):
+                        if _ck.should_save(it):
+                            _ck.save(it, {"user": np.asarray(user_f),
+                                          "item": np.asarray(item_f)},
+                                     fingerprint=_fp)
+                        if _inner is not None:
+                            _inner(it, user_f, item_f)
+
                 user_f, item_f = als_dense.train_dense(
                     ctx, p, user_idx, item_idx, ratings, n_users, n_items,
                     callback, resume=resume)
@@ -1094,12 +1143,19 @@ class ALS:
                     uf_host, if_host = packed[:n_users], packed[n_users:]
                 als_dense.last_train_phases["readback_s"] = round(
                     time.perf_counter() - t0, 3)
+                if checkpoint is not None:
+                    # the run completed; its snapshots are obsolete
+                    checkpoint.checkpointer.clear()
                 return ALSFactors(uf_host, if_host)
 
         if resume is not None:
             logger.warning(
                 "ALS resume is only supported on the dense solver path; "
                 "the bucketed solver starts from scratch")
+        if checkpoint is not None:
+            logger.warning(
+                "ALS checkpointing is only supported on the dense solver "
+                "paths; the bucketed solver trains without snapshots")
         multi = ctx.mesh.devices.size > 1
         key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
         ku, ki = jax.random.split(key)

@@ -51,6 +51,7 @@ import numpy as np
 
 from predictionio_tpu.io import transfer
 from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs.metrics import REGISTRY
 
 logger = logging.getLogger(__name__)
 
@@ -60,6 +61,28 @@ _A_ARENA = device_obs.arena("dense_a_cache")
 
 #: HBM arena for the factor matrices alive during a dense solve.
 _FACTORS_ARENA = device_obs.arena("train_factors")
+
+#: Cross-shard factor-slice traffic of one sharded-ALS iteration: the
+#: forward gather of referenced opposite-side factor rows plus the
+#: reverse routing of per-slice-slot partial grams, summed over all
+#: shards (both all_to_all directions). The replicated layout this
+#: design replaces would ship the whole item matrix instead.
+SHARD_GATHER_BYTES = REGISTRY.histogram(
+    "pio_als_shard_gather_bytes",
+    "Factor-slice bytes exchanged across the mesh per sharded-ALS "
+    "iteration (slice gather + reverse gram scatter, all shards)",
+    buckets=transfer.BYTES_BUCKETS,
+)
+
+#: Shard load balance of the most recent sharded prepare: max cells on
+#: one shard / mean cells per shard. 1.0 = perfectly balanced; `pio
+#: doctor` WARNs past PIO_SHARD_IMBALANCE_WARN (default 2.0) — straggler
+#: shards are the classic sharded-ALS failure mode.
+SHARD_IMBALANCE = REGISTRY.gauge(
+    "pio_als_shard_imbalance",
+    "max/mean rating cells per data shard of the most recent sharded "
+    "ALS prepare (1.0 = perfectly balanced)",
+)
 
 
 def iteration_flops(n_users: int, n_items: int, rank: int) -> float:
@@ -1365,11 +1388,15 @@ def _local_half_inputs(itf, rank, implicit):
 
 
 def _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha, implicit,
-                     rank, scale):
+                     rank, scale, xtx=None):
     """pairs/rhs/counts -> regularized Cholesky solve (the shared tail of
     both half-steps; ``corr`` is an optional [n, P+r+1] f32 addend). The
     gram stays in its packed upper-triangle column layout all the way
-    into the solver (_reg_solve_packed) — no [n, r, r] materialization."""
+    into the solver (_reg_solve_packed) — no [n, r, r] materialization.
+    ``xtx`` supplies implicit mode's shared Gram term precomputed as a
+    full [r, r] matrix — the sharded path psums per-shard partial grams
+    because no device holds the fixed side whole; ``fixed`` may then be
+    None."""
     from predictionio_tpu.models.als import _reg_solve_packed
 
     n_pairs = rank * (rank + 1) // 2
@@ -1389,36 +1416,445 @@ def _normal_eq_solve(prev, gi, gv, corr, fixed, lambda_, alpha, implicit,
         # Hu-Koren's shared XtX Gram term, packed: one [r, r] added to
         # every entity's upper triangle
         iu, ju = np.triu_indices(rank)
-        xtx = jax.lax.dot_general(
-            fixed, fixed, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+        if xtx is None:
+            xtx = jax.lax.dot_general(
+                fixed, fixed, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
         pairs = pairs + xtx[iu, ju][None, :]
     reg = lambda_ * jnp.maximum(counts, 1.0) + 1e-8
     sol = _reg_solve_packed(pairs, rhs, reg, rank)
     return jnp.where(counts[:, None] > 0, sol, prev)
 
 
-def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
-                        scale: int | None = None, callback=None):
-    """SPMD dense training over the mesh ``data`` axis. Returns
-    (user_f [n_users, r], item_f [n_items, r]), both REPLICATED device
-    arrays: user factors live row-sharded for the whole run and
-    materialize through one final all-gather — a process-spanning
-    row-sharded array would not be host-fetchable in a multi-process
-    deployment. ``callback`` (it, user_f, item_f) runs per iteration
-    (convergence probes) — each iteration is then its own collective
-    dispatch instead of one fused fori_loop, same trade as the
-    single-device path."""
+def _pow2(n: int, floor: int) -> int:
+    """Next power of two >= n (bounded retrace ladder for the sharded
+    programs' data-dependent dims — same role as foldin's pad ladder)."""
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class _ShardPlan:
+    """Host-prepared two-sided sharded layout (see ``_sharded_prepare``).
+    Per-shard payloads are built lazily by ``_pack_shard`` so the staging
+    pipeline can overlap shard k+1's pack with shard k's upload."""
+
+    ndev: int
+    ub: int  # user rows per shard (ceil; ndev*ub >= n_users)
+    ib: int  # item rows per shard (ceil; ndev*ib >= n_items)
+    w: int  # slice width per (src, dst) shard pair (pow2, uniform)
+    m: int  # packed COO cells per shard (pow2, uniform)
+    nd: int  # padded correction cells per shard (0: no corrections)
+    counts: np.ndarray  # [ndev] real cells per shard
+    scale: int
+    imbalance: float  # max/mean cells per shard (1.0 = balanced)
+    n_users: int
+    n_items: int
+    starts: np.ndarray  # [ndev+1] cell offsets per user shard
+    dstarts: np.ndarray | None  # [ndev+1] correction offsets per shard
+    need: list  # need[d][s]: local item rows of shard s that d references
+    mu: np.ndarray
+    mi: np.ndarray
+    mv: np.ndarray
+    dup_u: _DupSide | None
+
+
+def _sharded_prepare(ui, ii, vals, n_users: int, n_items: int, ndev: int,
+                     scale: int | None = None) -> _ShardPlan:
+    """Host prepare for the fully sharded (ALX-style) layout: the
+    cell-sorted COO split into one user-row block per shard, plus each
+    shard's dedup'd index of the item rows its cells (and correction
+    cells) reference — grouped by owner shard, so the per-iteration
+    exchange ships only referenced factor rows via
+    ``ops.collectives.gather_slices`` instead of replicating the item
+    matrix."""
+    if scale is None:
+        scale = _int8_scale(vals)
+    assert scale, "dense solver requires int8-encodable ratings"
+    mu, mi, mv, dup_u, _dup_i = _sorted_main_and_corrections(
+        ui, ii, vals, n_users, n_items, scale)
+    # the item-side correction is rebuilt per shard in slice-slot space
+    # (_pack_shard); the global item-sorted view is unused here
+    ub = -(-n_users // ndev)
+    ib = -(-n_items // ndev)
+    bounds = np.searchsorted(mu, np.arange(1, ndev) * ub)
+    starts = np.concatenate([[0], bounds, [len(mu)]]).astype(np.int64)
+    dstarts = None
+    if dup_u is not None:
+        dstarts = np.searchsorted(
+            dup_u.seg, np.arange(ndev + 1) * ub).astype(np.int64)
+    need: list = []
+    wmax = 1
+    for d in range(ndev):
+        ref = mi[starts[d]:starts[d + 1]]
+        if dup_u is not None:
+            # correction cells may reference items with no densified cell
+            # in this shard (zero-valued cells ride corrections only) —
+            # their rows must be in the slice index too
+            ref = np.concatenate(
+                [ref, dup_u.nbr[dstarts[d]:dstarts[d + 1]]])
+        uniq = np.unique(ref)
+        ob = np.searchsorted(uniq, np.arange(ndev + 1) * ib)
+        per = [uniq[ob[s]:ob[s + 1]].astype(np.int32) - np.int32(s * ib)
+               for s in range(ndev)]
+        wmax = max(wmax, max((len(r) for r in per), default=0))
+        need.append(per)
+    w = _pow2(wmax, floor=8)
+    counts = np.diff(starts).astype(np.int64)
+    m = _pow2(max(int(counts.max()), 1), floor=1024)
+    nd = 0
+    if dup_u is not None:
+        nd = _pow2(max(int(np.diff(dstarts).max()), 1), floor=8)
+    imbalance = (float(counts.max() / max(counts.mean(), 1e-9))
+                 if counts.sum() else 1.0)
+    return _ShardPlan(ndev, ub, ib, w, m, nd, counts, scale, imbalance,
+                      n_users, n_items, starts, dstarts, need, mu, mi, mv,
+                      dup_u)
+
+
+def _pack_shard(plan: _ShardPlan, d: int) -> dict:
+    """Shard ``d``'s staged payload: the compact COO with item columns
+    remapped to slice-slot ids (owner * w + position — ascending within
+    each row because the owner is monotone in the item id and positions
+    ascend within an owner, so the device scatter's sorted/unique
+    contract holds with n_items -> ndev*w), this shard's send table, and
+    both correction sides keyed to the cell's user-owner shard (the item
+    side in slice-slot space, routed back by the reverse all_to_all)."""
+    ndev, w, ib, ub, m = plan.ndev, plan.w, plan.ib, plan.ub, plan.m
+    lookup = np.empty(plan.n_items, np.int32)
+    for s in range(ndev):
+        rows = plan.need[d][s]
+        lookup[s * ib + rows] = s * w + np.arange(len(rows), dtype=np.int32)
+    lo, hi = plan.starts[d], plan.starts[d + 1]
+    k = int(hi - lo)
+    items = np.zeros(m, np.int32)
+    vals8 = np.zeros(m, np.int8)
+    items[:k] = lookup[plan.mi[lo:hi]]
+    vals8[:k] = plan.mv[lo:hi]
+    row_starts = np.searchsorted(
+        plan.mu[lo:hi], d * ub + np.arange(ub + 1)).astype(np.int32)
+    # send table: row dst lists the LOCAL item rows shard dst needs from
+    # this shard; pad = ib (the gather clamps it to a row the receiver
+    # never references, the reverse scatter drops it)
+    send = np.full((ndev, w), ib, np.int32)
+    for dst in range(ndev):
+        rows = plan.need[dst][d]
+        send[dst, :len(rows)] = rows
+    out = dict(items=items, vals=vals8, row_starts=row_starts,
+               k=np.asarray(k, np.int32), send=send)
+    if plan.nd:
+        du = plan.dup_u
+        dlo, dhi = plan.dstarts[d], plan.dstarts[d + 1]
+        kd = int(dhi - dlo)
+        seg = np.zeros(plan.nd, np.int32)
+        nbr = np.zeros(plan.nd, np.int32)
+        cnt = np.zeros(plan.nd, np.float32)
+        val = np.zeros(plan.nd, np.float32)
+        seg[:kd] = du.seg[dlo:dhi] - d * ub
+        nbr[:kd] = lookup[du.nbr[dlo:dhi]]
+        cnt[:kd] = du.cnt[dlo:dhi]
+        val[:kd] = du.val[dlo:dhi]
+        if kd:  # keep segment ids sorted through the padding
+            seg[kd:] = seg[kd - 1]
+        out.update(du_seg=seg, du_nbr=nbr, du_cnt=cnt, du_val=val)
+        # item-side corrections: segment = slice slot (sorted), neighbor
+        # = local user row; weights are zero on padding so pad slots
+        # contribute nothing before the reverse exchange
+        slot = nbr[:kd]
+        o = np.argsort(slot, kind="stable")
+        iseg = np.zeros(plan.nd, np.int32)
+        inbr = np.zeros(plan.nd, np.int32)
+        icnt = np.zeros(plan.nd, np.float32)
+        ival = np.zeros(plan.nd, np.float32)
+        iseg[:kd] = slot[o]
+        inbr[:kd] = seg[:kd][o]
+        icnt[:kd] = cnt[:kd][o]
+        ival[:kd] = val[:kd][o]
+        if kd:
+            iseg[kd:] = iseg[kd - 1]
+        out.update(di_seg=iseg, di_nbr=inbr, di_cnt=icnt, di_val=ival)
+    return out
+
+
+def _stage_sharded_inputs(mesh, plan: _ShardPlan, rank: int,
+                          phases: dict):
+    """Per-shard pack/upload through the ChunkStager: a background worker
+    packs shard k+1's COO + send table while this thread uploads shard
+    k's buffers to its own devices — host pack, h2d copies, and arena
+    registration all overlap. Each shard's HBM footprint registers in
+    its own ``als_shard{k}`` DeviceArena so attribution and leak checks
+    stay per-shard truthful (and prove the item matrix is never whole on
+    one device). Returns (device arrays dict, [(arena, alloc), ...])."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    ndev = plan.ndev
+    if jax.process_count() > 1:
+        # multi-process meshes cannot device_put another process's shard;
+        # fall back to bulk sharded puts (identical content everywhere)
+        shards = [_pack_shard(plan, d) for d in range(ndev)]
+        out = {}
+        for nm in shards[0]:
+            stacked = np.stack([sh[nm] for sh in shards])
+            spec = P("data", *([None] * (stacked.ndim - 1)))
+            out[nm] = jax.device_put(stacked, NamedSharding(mesh, spec))
+        return out, []
+
+    devices = mesh.devices  # [data, model] grid
+    arenas: list = []
+    bufs: dict = {}
+
+    def pack(d: int):
+        return d, _pack_shard(plan, d)
+
+    def upload(packed):
+        d, arrs = packed
+        dev_list = list(np.ravel(devices[d]))
+        put = {nm: [jax.device_put(a[None], dev) for dev in dev_list]
+               for nm, a in arrs.items()}
+        arena = device_obs.arena(f"als_shard{d}")
+        nbytes = sum(int(a.nbytes) for a in arrs.values())
+        # + this shard's live factor rows and its transient slice buffer
+        nbytes += (plan.ub + plan.ib + ndev * plan.w) * rank * 4
+        arenas.append((arena, arena.register(nbytes, label=f"rank{rank}")))
+        return put
+
+    stager = transfer.ChunkStager(name="als_shard_stage")
+    for _i, put in stager.stream(range(ndev), pack, upload=upload):
+        for nm, arr_list in put.items():
+            bufs.setdefault(nm, []).extend(arr_list)
+    out = {}
+    for nm, arr_list in bufs.items():
+        per = arr_list[0]
+        spec = P("data", *([None] * (per.ndim - 1)))
+        out[nm] = jax.make_array_from_single_device_arrays(
+            (ndev,) + per.shape[1:], NamedSharding(mesh, spec), arr_list)
+    phases["shard_chunks"] = ndev
+    phases["shard_stage_s"] = round(stager.staged_s, 3)
+    phases["shard_wait_s"] = round(stager.wait_s, 3)
+    phases["shard_overlap_frac"] = round(stager.overlap_frac(), 3)
+    return out, arenas
+
+
+#: Compiled sharded train programs, keyed by every static of the layout.
+#: Module-level so warm re-dispatch (a retrain at the same shapes) reuses
+#: the compiled executable — the retrace guard's zero-retrace contract.
+_SHARDED_PROGRAMS: dict = {}
+
+
+def _sharded_train_program(mesh, ndev: int, ub: int, ib: int, w: int,
+                           rank: int, implicit: bool, scale: int,
+                           exact: bool, has_dup: bool, n_users: int,
+                           n_items: int):
+    """Build (or fetch) the compiled SPMD train program for one sharded
+    layout. Profiled as ``als_dense_spmd_rank{rank}`` with the shard
+    count riding the bucket key: each (ndev, shapes) bucket compiles
+    exactly once, and re-dispatch at a seen bucket must not retrace."""
+    key = (mesh, ndev, ub, ib, w, rank, implicit, scale, exact, has_dup,
+           n_users, n_items)
+    prog = _SHARDED_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    from jax.sharding import PartitionSpec as P
+
+    from predictionio_tpu.ops import collectives
     from predictionio_tpu.parallel.mesh import shard_map
 
+    dots = _make_dots(implicit, exact, rank=rank)
+    n_pairs = rank * (rank + 1) // 2
+    ncols = n_pairs + rank + 1
+    ci = (rank + 1) if implicit else (n_pairs + 1)
+    cv = (n_pairs + rank) if implicit else rank
+    nw = ndev * w
+    hi = jax.lax.Precision.HIGHEST
+
+    def gram(f):
+        return jax.lax.dot_general(
+            f, f, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=hi)
+
+    def spmd_train(iters, items_l, vals_l, starts_l, k_l, send_l, uf_l,
+                   itf_l, du, di, lambda_, alpha):
+        # items_l/vals_l/starts_l/k_l/send_l/du/di: this shard's [1, ...]
+        # slice — squeeze it. uf_l/itf_l partition their row dim directly
+        # ([ub, r] / [ib, r]). ``iters`` is a traced scalar so the SAME
+        # program serves the fused run and the per-iteration path.
+        a = _scatter_block(items_l[0], vals_l[0], starts_l[0], k_l[0],
+                           ub=ub, n_items=nw)
+        send = send_l[0]
+        du_sq = tuple(x[0] for x in du) if has_dup else None
+        di_sq = tuple(x[0] for x in di) if has_dup else None
+
+        def body(_i, carry):
+            uf_l, itf_l = carry
+            # ---- user half: gather only the item-factor slices this
+            # shard's cells reference (the ALX slice exchange — never the
+            # whole item matrix). Pad slots hold clamped garbage rows the
+            # A block's zero cells and the corrections never touch.
+            ys = collectives.gather_slices(itf_l, send, "data")
+            ip, vp = _local_half_inputs(ys, rank, implicit)
+            gi, gv = dots(a, ip, vp, ((1,), (0,)))
+            corr = (_dup_correction(du_sq, ys, rank, ub, alpha, implicit)
+                    if has_dup else None)
+            # implicit XtX over a sharded fixed side: psum of per-shard
+            # partial grams (zero-padded rows contribute nothing)
+            xtx = (jax.lax.psum(gram(itf_l), "data") if implicit
+                   else None)
+            uf_l = _normal_eq_solve(uf_l, gi, gv, corr, None, lambda_,
+                                    alpha, implicit, rank, scale, xtx=xtx)
+            # ---- item half: contract this shard's cells into per-slice-
+            # slot partial grams (+ slot-space corrections), route every
+            # slot back to the shard owning its item row, scatter-add,
+            # and solve locally — the gram accumulation never leaves the
+            # owner shard un-reduced.
+            ip2, vp2 = _local_half_inputs(uf_l, rank, implicit)
+            d_gi, d_gv = dots(a, ip2, vp2, ((0,), (0,)))
+            buf = jnp.concatenate([d_gi, d_gv], axis=1)
+            if has_dup:
+                buf = jnp.concatenate(
+                    [buf, _dup_correction(di_sq, uf_l, rank, nw, alpha,
+                                          implicit)], axis=1)
+            acc = collectives.scatter_slices_add(buf, send, ib, "data")
+            corr2 = acc[:, ci + cv:] if has_dup else None
+            xtx2 = (jax.lax.psum(gram(uf_l), "data") if implicit
+                    else None)
+            itf_l = _normal_eq_solve(
+                itf_l, acc[:, :ci], acc[:, ci:ci + cv], corr2, None,
+                lambda_, alpha, implicit, rank, scale, xtx=xtx2)
+            return uf_l, itf_l
+
+        return jax.lax.fori_loop(0, iters, body, (uf_l, itf_l))
+
+    dup_spec = (P("data", None),) * 4 if has_dup else P()
+    fn = jax.jit(shard_map(
+        spmd_train, mesh=mesh,
+        in_specs=(P(), P("data", None), P("data", None), P("data", None),
+                  P("data"), P("data", None, None), P("data", None),
+                  P("data", None), dup_spec, dup_spec, P(), P()),
+        out_specs=(P("data", None), P("data", None)),
+        check_vma=False,
+    ))
+    prog = device_obs.profiled_program(
+        f"als_dense_spmd_rank{rank}",
+        flops=lambda iters, *a, **kw: float(iters) * iteration_flops(
+            n_users, n_items, rank),
+        # shard count rides the bucket key: each mesh size is its own
+        # expected-compile bucket, and pio_device_dispatch_seconds stays
+        # retrace-free across them
+        bucket=lambda *a, **kw: (ndev, rank,
+                                 device_obs.shape_bucket(*a)),
+        sync=True,
+    )(fn)
+    if len(_SHARDED_PROGRAMS) >= 8:
+        _SHARDED_PROGRAMS.pop(next(iter(_SHARDED_PROGRAMS)))
+    _SHARDED_PROGRAMS[key] = prog
+    return prog
+
+
+#: Layout manifest magic for sharded checkpoints ("ALX").
+_SHARDED_LAYOUT_MAGIC = 0x414C58
+
+
+def _factor_slabs(arr, ndev: int, rows: int) -> list:
+    """Per-shard host slabs of a row-sharded factor array, in shard
+    order, fetched shard-by-shard (never materializing the matrix whole
+    on any device)."""
+    slabs: list = [None] * ndev
+    try:
+        for s in arr.addressable_shards:
+            i0 = s.index[0].start or 0
+            d = int(i0) // rows
+            if slabs[d] is None:
+                slabs[d] = np.asarray(s.data).reshape(rows, -1)
+    except Exception:
+        logger.debug("per-shard fetch failed; falling back to device_get",
+                     exc_info=True)
+    if any(s is None for s in slabs):
+        full = np.asarray(jax.device_get(arr))
+        slabs = [full[d * rows:(d + 1) * rows] for d in range(ndev)]
+    return slabs
+
+
+def load_sharded_resume(checkpointer, fingerprint: str, n_users: int,
+                        n_items: int, rank: int):
+    """(start_iter, user_f [n_users, r], item_f [n_items, r]) from the
+    newest valid sharded checkpoint, or None. The per-shard slabs are
+    concatenated and re-split for the CURRENT device count — resume
+    across a different shard count is re-sharding, not a format
+    mismatch."""
+    got = checkpointer.load_latest(None, fingerprint=fingerprint)
+    if got is None:
+        return None
+    step, state = got
+    try:
+        layout = np.asarray(state["layout"]).ravel()
+        if (int(layout[0]) != _SHARDED_LAYOUT_MAGIC
+                or [int(x) for x in layout[2:5]]
+                != [n_users, n_items, rank]):
+            logger.warning(
+                "sharded ALS checkpoint layout %s does not match this "
+                "run (%d users x %d items, rank %d) — starting fresh",
+                layout.tolist(), n_users, n_items, rank)
+            return None
+        uf = np.concatenate(
+            [np.asarray(s, np.float32) for s in state["user_shards"]]
+        )[:n_users]
+        itf = np.concatenate(
+            [np.asarray(s, np.float32) for s in state["item_shards"]]
+        )[:n_items]
+    except Exception:
+        logger.warning("unreadable sharded ALS checkpoint — starting "
+                       "fresh", exc_info=True)
+        return None
+    if uf.shape != (n_users, rank) or itf.shape != (n_items, rank):
+        return None
+    return int(step) + 1, uf, itf
+
+
+def _fetch_rows(arr, n: int, rows: int, ndev: int) -> np.ndarray:
+    """Host [n, r] view of a row-sharded factor array via per-shard
+    fetches (pad rows trimmed)."""
+    return np.concatenate(_factor_slabs(arr, ndev, rows))[:n]
+
+
+#: Layout/traffic stats of the most recent train_dense_sharded call:
+#: ndev, w, slice_slots, ub, ib, gather_bytes_per_iter, imbalance,
+#: replicated_item_bytes (what the old replicated layout would pin per
+#: device), per_shard_hbm_bytes. Read by bench.py and the parity tests.
+last_sharded_stats: dict = {}
+
+
+def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
+                        scale: int | None = None, callback=None,
+                        resume=None, checkpoint=None):
+    """Fully sharded SPMD dense training over the mesh ``data`` axis
+    (ALX layout): users AND items row-shard across the axis, gram
+    accumulation stays shard-local, and each iteration exchanges only
+    the dedup'd opposite-side factor *slices* a shard's cells reference
+    (ops/collectives.gather_slices / scatter_slices_add) — no device
+    ever holds the item matrix whole. Returns (user_f [n_users, r],
+    item_f [n_items, r]) as HOST arrays assembled from per-shard
+    fetches.
+
+    ``callback`` (it, user_f, item_f) runs per iteration on host views.
+    ``resume`` = (start_iter, user_f, item_f) continues from global host
+    factors. ``checkpoint`` (utils.checkpoint.TrainCheckpointSpec) saves
+    per-shard factor slabs + a layout manifest every ``every``
+    iterations and resumes from the newest valid one — re-sharding
+    across a different device count on load."""
+    import time
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from predictionio_tpu.models.als import _init_factors
+    from predictionio_tpu.obs import runlog
+    from predictionio_tpu.resilience import faults
 
     p = params
     mesh = ctx.mesh
-    # one row-block per DATA-axis shard; model-axis devices replicate
     ndev = mesh.shape["data"]
     if not sharded_block_fits(ctx, n_users, n_items, len(ratings)):
         # the flat-cell scatter ids are int32; unlike the single-device
@@ -1430,118 +1866,139 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
             f"({-(-n_users // ndev)} rows x {n_items} items per device); "
             "use solver='bucket' or more devices"
         )
-    plan = _dense_prepare(ui, ii, ratings, n_users, n_items, scale=scale,
-                          nb=ndev, uniform_m=True)
-    ub = plan.ub
-    up = ndev * ub
-    logger.info(
-        "ALS(dense,SPMD): %d ratings -> %d x %d int8 cells, %d device "
-        "blocks of %d rows, scale %d, rank %d",
-        len(ratings), n_users, n_items, ndev, ub, plan.scale, p.rank)
+    phases: dict = {}
+    t0 = time.perf_counter()
+    plan = _sharded_prepare(ui, ii, ratings, n_users, n_items, ndev,
+                            scale=scale)
+    phases["prepare_s"] = round(time.perf_counter() - t0, 3)
+    runlog.phase("prepare", phases["prepare_s"])
+    nw = ndev * plan.w
+    if plan.ub * nw + plan.m >= 2**31:
+        raise ValueError(
+            "dense SPMD slice block out of bounds "
+            f"({plan.ub} rows x {nw} slice slots per device); "
+            "use solver='bucket' or more devices")
 
-    data_ax = NamedSharding(mesh, P("data", None))
-    repl = NamedSharding(mesh, P())
-    items = jax.device_put(np.stack(plan.items), data_ax)  # [ndev, m]
-    vals = jax.device_put(np.stack(plan.vals), data_ax)
-    row_starts = jax.device_put(np.stack(plan.row_starts), data_ax)
-    kcounts = jax.device_put(
-        np.asarray(plan.counts, np.int32), NamedSharding(mesh, P("data")))
-    dup_u = dup_i = None
-    if plan.dup_u is not None:
-        dup_u = tuple(jax.device_put(x, repl) for x in (
-            plan.dup_u.seg, plan.dup_u.nbr, plan.dup_u.cnt, plan.dup_u.val))
-        dup_i = tuple(jax.device_put(x, repl) for x in (
-            plan.dup_i.seg, plan.dup_i.nbr, plan.dup_i.cnt, plan.dup_i.val))
-
-    key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
-    ku, ki = jax.random.split(key)
-    # init must match the single-device dense path row for row (the PRNG
-    # stream depends on the shape), and the padding rows must be ZERO:
-    # they are never solved (count 0 keeps them), and implicit mode's
-    # all-gathered XtX Gram term must not see random vectors in them
-    uf_host = np.zeros((up, p.rank), np.float32)
-    uf_host[:n_users] = np.asarray(_init_factors(ku, n_users, p.rank))
-    uf0 = jax.device_put(uf_host, data_ax)
-    itf0 = jax.device_put(
-        np.asarray(_init_factors(ki, n_items, p.rank)), repl)
-
-    rank, implicit, sc = p.rank, p.implicit_prefs, plan.scale
+    rank, implicit = p.rank, p.implicit_prefs
     exact = p.gather_dtype == "float32"
-    dots = _make_dots(implicit, exact, rank=rank)
     n_pairs = rank * (rank + 1) // 2
     ncols = n_pairs + rank + 1
+    ci = (rank + 1) if implicit else (n_pairs + 1)
+    cv = (n_pairs + rank) if implicit else rank
+    # per-iteration cross-shard traffic: every shard sends [ndev, w, r]
+    # f32 factor slices forward and [ndev, w, ci+cv(+ncols)] partial
+    # grams back
+    width_back = ci + cv + (ncols if plan.nd else 0)
+    gather_bytes = 4 * ndev * ndev * plan.w * (rank + width_back)
+    SHARD_GATHER_BYTES.observe(float(gather_bytes))
+    SHARD_IMBALANCE.set(plan.imbalance)
+    runlog.note("shard_imbalance", round(plan.imbalance, 3))
+    runlog.note("shard_gather_bytes", int(gather_bytes))
+    logger.info(
+        "ALS(dense,SPMD): %d ratings -> %d x %d cells over %d shards "
+        "(%d user rows x %d slice slots each, slice width %d, imbalance "
+        "%.2fx), scale %d, rank %d",
+        len(ratings), n_users, n_items, ndev, plan.ub, nw, plan.w,
+        plan.imbalance, plan.scale, rank)
 
-    def spmd_train(iters, items_l, vals_l, starts_l, k_l, uf_l, itf, du,
-                   di):
-        # items_l/vals_l/starts_l/uf_l: this device's [1, ...] shard;
-        # squeeze it. ``iters`` is a traced replicated scalar so the SAME
-        # compiled program serves the fused run (num_iterations) and the
-        # per-iteration callback path (1 at a time).
-        a = _scatter_block(items_l[0], vals_l[0], starts_l[0], k_l[0],
-                           ub=ub, n_items=n_items)
-        row0 = jax.lax.axis_index("data") * ub
+    t0 = time.perf_counter()
+    dev_in, arenas = _stage_sharded_inputs(mesh, plan, rank, phases)
+    phases["upload_densify_s"] = round(time.perf_counter() - t0, 3)
+    runlog.phase("upload_densify", phases["upload_densify_s"])
 
-        def corr_rows(dup, fixed, n_entities):
-            if dup is None:
-                return None
-            return _dup_correction(dup, fixed, rank, n_entities, p.alpha,
-                                   implicit)
+    global last_sharded_stats
+    last_sharded_stats = dict(
+        ndev=ndev, w=plan.w, slice_slots=nw, ub=plan.ub, ib=plan.ib,
+        gather_bytes_per_iter=int(gather_bytes),
+        imbalance=round(plan.imbalance, 4),
+        replicated_item_bytes=int(n_items) * rank * 4,
+        per_shard_hbm_bytes=[int(a.bytes()) for a, _ in arenas],
+    )
 
-        def body(_i, carry):
-            uf_l, itf = carry
-            # ---- user half: local rows only
-            ip, vp = _local_half_inputs(itf, rank, implicit)
-            gi, gv = dots(a, ip, vp, ((1,), (0,)))
-            corr = corr_rows(du, itf, up)
-            if corr is not None:
-                corr = jax.lax.dynamic_slice(corr, (row0, 0), (ub, ncols))
-            uf_l = _normal_eq_solve(uf_l, gi, gv, corr, itf, p.lambda_,
-                                    p.alpha, implicit, rank, sc)
-            # ---- item half: local partial contraction + psum over data.
-            # The payload comes from the LOCAL user rows; summing the
-            # per-device partials over the axis completes the global
-            # normal equations.
-            ip2, vp2 = _local_half_inputs(uf_l, rank, implicit)
-            d_gi, d_gv = dots(a, ip2, vp2, ((0,), (0,)))
-            gi2 = jax.lax.psum(d_gi, "data")
-            gv2 = jax.lax.psum(d_gv, "data")
-            uf_full = None
-            if implicit or di is not None:
-                # the full (small) user matrix: implicit mode's XtX Gram
-                # term and the correction gathers need global rows —
-                # [up, r] f32 rides one all-gather
-                uf_full = jax.lax.all_gather(
-                    uf_l, "data").reshape(up, rank)
-            corr2 = corr_rows(di, uf_full, n_items) if di is not None \
-                else None
-            itf = _normal_eq_solve(
-                itf, gi2, gv2, corr2,
-                uf_full if implicit else itf,
-                p.lambda_, p.alpha, implicit, rank, sc)
-            return uf_l, itf
+    ck = fp = None
+    if checkpoint is not None:
+        ck = checkpoint.checkpointer
+        fp = checkpoint.fingerprint
+        if resume is None and checkpoint.resume:
+            got = load_sharded_resume(ck, fp, n_users, n_items, rank)
+            if got is not None:
+                resume = got
+                logger.info(
+                    "ALS(dense,SPMD): resuming from sharded checkpoint "
+                    "at iteration %d (re-sharded to %d shards)",
+                    got[0], ndev)
 
-        uf_l, itf = jax.lax.fori_loop(0, iters, body, (uf_l, itf))
-        return uf_l, itf
-
-    shard_fn = jax.jit(shard_map(
-        spmd_train, mesh=mesh,
-        in_specs=(P(), P("data", None), P("data", None), P("data", None),
-                  P("data"), P("data", None), P(), P(), P()),
-        out_specs=(P("data", None), P()),
-        check_vma=False,
-    ))
-    # the final (and callback-visible) user factors ride one all-gather:
-    # [n_users, r] f32 is small, and replication is what makes the result
-    # readable on every process of a multi-process mesh
-    replicate_users = jax.jit(lambda u: u[:n_users], out_shardings=repl)
-    if callback is None:
-        uf, itf = shard_fn(jnp.int32(p.num_iterations), items, vals,
-                           row_starts, kcounts, uf0, itf0, dup_u, dup_i)
+    data_ax = NamedSharding(mesh, P("data", None))
+    up, ip_tot = ndev * plan.ub, ndev * plan.ib
+    start_iter = 0
+    # padding rows must be ZERO: they are never solved (count 0 keeps
+    # them) and the psum'd XtX Gram term must not see garbage in them;
+    # the PRNG stream matches the single-device path row for row
+    uf_host = np.zeros((up, rank), np.float32)
+    if_host = np.zeros((ip_tot, rank), np.float32)
+    if resume is not None:
+        start_iter, uf0, if0 = resume
+        uf_host[:n_users] = np.asarray(uf0, np.float32)
+        if_host[:n_items] = np.asarray(if0, np.float32)
     else:
-        one = jnp.int32(1)
-        uf, itf = uf0, itf0
-        for it in range(p.num_iterations):
-            uf, itf = shard_fn(one, items, vals, row_starts, kcounts, uf,
-                               itf, dup_u, dup_i)
-            callback(it, replicate_users(uf), itf)
-    return replicate_users(uf), itf
+        key = jax.random.PRNGKey(p.seed if p.seed is not None else 0)
+        ku, ki = jax.random.split(key)
+        uf_host[:n_users] = np.asarray(_init_factors(ku, n_users, rank))
+        if_host[:n_items] = np.asarray(_init_factors(ki, n_items, rank))
+    uf = jax.device_put(uf_host, data_ax)
+    itf = jax.device_put(if_host, data_ax)
+
+    prog = _sharded_train_program(
+        mesh, ndev, plan.ub, plan.ib, plan.w, rank, implicit, plan.scale,
+        exact, plan.nd > 0, n_users, n_items)
+    if plan.nd:
+        du = (dev_in["du_seg"], dev_in["du_nbr"], dev_in["du_cnt"],
+              dev_in["du_val"])
+        di = (dev_in["di_seg"], dev_in["di_nbr"], dev_in["di_cnt"],
+              dev_in["di_val"])
+    else:
+        du = di = None
+    args = (dev_in["items"], dev_in["vals"], dev_in["row_starts"],
+            dev_in["k"], dev_in["send"])
+    lam, al = float(p.lambda_), float(p.alpha)
+
+    per_iter = (resume is not None or callback is not None
+                or ck is not None or runlog.want_steps())
+    t0 = time.perf_counter()
+    try:
+        if not per_iter:
+            uf, itf = prog(int(p.num_iterations), *args, uf, itf, du, di,
+                           lam, al)
+        else:
+            st = runlog.StepTimer("als_dense_spmd",
+                                  total=p.num_iterations,
+                                  start=start_iter, phase="solve")
+            for it in range(start_iter, p.num_iterations):
+                # the crash-safe-training chaos site: an error here is a
+                # mid-train kill between checkpoint intervals
+                faults.fault_point("train.iteration")
+                uf, itf = prog(1, *args, uf, itf, du, di, lam, al)
+                if callback is not None:
+                    callback(it, _fetch_rows(uf, n_users, plan.ub, ndev),
+                             _fetch_rows(itf, n_items, plan.ib, ndev))
+                if ck is not None and ck.should_save(it):
+                    state = {
+                        "layout": np.asarray(
+                            [_SHARDED_LAYOUT_MAGIC, ndev, n_users,
+                             n_items, rank], np.int64),
+                        "user_shards": _factor_slabs(uf, ndev, plan.ub),
+                        "item_shards": _factor_slabs(itf, ndev, plan.ib),
+                    }
+                    ck.save(it, state, fingerprint=fp)
+                st.step(it + 1, sync=itf)
+    finally:
+        for arena, alloc in arenas:
+            arena.free(alloc)
+    phases["solve_s"] = round(time.perf_counter() - t0, 3)
+    if not per_iter:
+        runlog.fused_steps("als_dense_spmd", p.num_iterations,
+                           phases["solve_s"], synced=True)
+    global last_train_phases
+    last_train_phases = phases
+    return (_fetch_rows(uf, n_users, plan.ub, ndev),
+            _fetch_rows(itf, n_items, plan.ib, ndev))

@@ -78,6 +78,7 @@ __all__ = [
     "diagnose_runs",
     "fused_steps",
     "list_runs",
+    "note",
     "phase",
     "read_run",
     "run_scope",
@@ -306,6 +307,13 @@ class RunWriter:
         self._append(rec)
         self.heartbeat(phase=name, force=True)
 
+    def note(self, key: str, value) -> None:
+        """One named fact about the run (shard imbalance, gather bytes,
+        layout choices) — a "note" record; the newest value per key wins
+        in :func:`read_run`. Values must be JSON scalars."""
+        self._append({"kind": "note", "t": round(time.time(), 3),
+                      "key": str(key), "value": value})
+
     def end(self, status: str, error: str | None = None) -> None:
         self._stop.set()
         rec: dict = {"kind": "end", "t": round(time.time(), 3),
@@ -482,6 +490,18 @@ def phase(name: str, seconds: float | None = None) -> None:
         w.phase(name, seconds)
 
 
+def note(key: str, value) -> None:
+    """Record a named run fact (ledger only; no-op outside a run).
+    Never raises — telemetry must not fail training."""
+    w = _ACTIVE
+    if w is not None:
+        try:
+            w.note(key, value)
+        except Exception:
+            logger.warning("run-ledger note emission failed",
+                           exc_info=True)
+
+
 class StepTimer:
     """Per-iteration wall clock for a training loop. ``step(i)`` times
     the interval since the previous call and emits through
@@ -542,6 +562,7 @@ def read_run(path: Path | str) -> dict:
     meta: dict = {}
     steps: list[dict] = []
     phases: list[dict] = []
+    notes: dict = {}
     end: dict | None = None
     try:
         text = path.read_text(encoding="utf-8", errors="replace")
@@ -564,6 +585,9 @@ def read_run(path: Path | str) -> dict:
             steps.append(rec)
         elif kind == "phase":
             phases.append(rec)
+        elif kind == "note":
+            if rec.get("key"):
+                notes[rec["key"]] = rec.get("value")
         elif kind == "end":
             end = rec
     hb = None
@@ -579,6 +603,7 @@ def read_run(path: Path | str) -> dict:
         "meta": meta,
         "steps": steps,
         "phases": phases,
+        "notes": notes,
         "end": end,
         "heartbeat": hb,
     }
@@ -660,6 +685,7 @@ def summarize(run: dict, now: float | None = None) -> dict:
                             else None),
         "error": (end or {}).get("error"),
         "steps": len(steps),
+        "notes": run.get("notes") or {},
     }
 
 
@@ -689,9 +715,27 @@ def diagnose_runs(directory: Path | str | None = None,
                   limit: int = 50) -> list[dict]:
     """``pio doctor`` findings from the local run ledger: a critical
     STALLED-RUN per RUNNING run whose heartbeat age exceeds its stall
-    threshold. Same finding shape as obs.fleet.diagnose."""
+    threshold, and a SHARD-IMBALANCE warn per run whose noted sharded-ALS
+    load skew exceeds ``PIO_SHARD_IMBALANCE_WARN`` (default 2.0). Same
+    finding shape as obs.fleet.diagnose."""
     findings: list[dict] = []
+    warn_at = float(os.environ.get("PIO_SHARD_IMBALANCE_WARN", "2.0"))
     for s in list_runs(directory, limit=limit, now=now):
+        imb = (s.get("notes") or {}).get("shard_imbalance")
+        if isinstance(imb, (int, float)) and imb > warn_at:
+            # stragglers are the classic sharded-ALS failure mode: every
+            # collective waits for the heaviest shard, so a 3x-loaded
+            # shard makes the whole mesh run at 1/3 throughput
+            findings.append({
+                "severity": "warn",
+                "subject": f"run {s['runId']}",
+                "detail": (
+                    f"SHARD-IMBALANCE: heaviest data shard carries "
+                    f"{imb:.2f}x the mean rating cells (threshold "
+                    f"{warn_at:g}x) — every sharded-ALS collective waits "
+                    "on that straggler; re-index entity ids toward a "
+                    "uniform spread or change the shard count"),
+            })
         if not s["stalled"]:
             continue
         prog = (f"{s['iteration']}/{s['total']}"

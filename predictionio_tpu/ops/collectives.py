@@ -10,6 +10,7 @@ raw lax calls, and so the axis-name conventions stay in one place.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -51,6 +52,41 @@ def psum_mean(x, axis_name: str):
     """Mean over the named axis (ICI all-reduce) — the treeAggregate analog,
     used for data-parallel gradient averaging."""
     return lax.pmean(x, axis_name)
+
+
+def gather_slices(rows, send_idx, axis_name: str):
+    """Exchange *indexed row slices* over a named axis (the ALX move:
+    never replicate the opposite-side factor matrix — ship only the rows
+    each shard's cells reference).
+
+    ``rows``: this shard's locally-owned factor rows ``[rows_local, r]``.
+    ``send_idx``: ``[n, w]`` int32 — row ``d`` lists which local rows
+    shard ``d`` needs, padded with an out-of-range id (``rows_local``);
+    pad slots gather a clamped garbage row that the receiver never
+    references (its A-block columns there hold zero cells).
+
+    Returns the ``[n * w, r]`` slice buffer: rows ``s*w:(s+1)*w`` are
+    the slots served by source shard ``s``. Implemented as a local
+    take + one ``all_to_all`` — per-device traffic is ``n*w*r`` elements
+    instead of the full ``n_rows_global * r`` an all-gather would ship.
+    """
+    n, w = send_idx.shape
+    out = lax.all_to_all(rows[send_idx], axis_name, 0, 0)
+    return out.reshape(n * w, rows.shape[-1])
+
+
+def scatter_slices_add(buf, send_idx, n_rows: int, axis_name: str):
+    """Reverse of :func:`gather_slices`: route per-slice-slot partial
+    sums back to the shard that owns each row and scatter-add them into
+    a ``[n_rows, cols]`` local accumulator. Pad slots (index >=
+    ``n_rows``) are dropped by the out-of-bounds scatter mode; duplicate
+    real indices across destination shards accumulate, which is exactly
+    the cross-shard gram reduction the item half-step needs."""
+    n, w = send_idx.shape
+    back = lax.all_to_all(buf.reshape(n, w, -1), axis_name, 0, 0)
+    zero = jnp.zeros((n_rows, buf.shape[-1]), buf.dtype)
+    return zero.at[send_idx.reshape(-1)].add(
+        back.reshape(n * w, -1), mode="drop")
 
 
 def ring_permute(x, axis_name: str, *, reverse: bool = False):

@@ -364,59 +364,34 @@ class ALSAlgorithm(PAlgorithm):
         als_p = self._als_params(self.params)
         als = ALS(ctx, als_p)
         ck, resume_allowed = self._train_checkpointer()
-        if ck is None:
-            factors = als.train(
-                pd.user_idx,
-                pd.item_idx,
-                pd.ratings,
-                n_users=len(pd.user_ids),
-                n_items=len(pd.item_ids),
+        checkpoint = None
+        if ck is not None:
+            from predictionio_tpu.utils.checkpoint import (
+                TrainCheckpointSpec,
+                fingerprint_arrays,
             )
-            return ALSModel(
-                factors, pd.user_ids, pd.item_ids, pd.item_categories)
-        from predictionio_tpu.utils.checkpoint import fingerprint_arrays
 
-        # bind checkpoints to the data + per-iteration math; the
-        # iteration COUNT is deliberately excluded so a resumed run can
-        # complete (or extend) the interrupted one — each iteration's
-        # update is identical regardless of how many follow it
-        fp = fingerprint_arrays(
-            pd.user_idx, pd.item_idx, pd.ratings,
-            ("als-dense", als_p.rank, als_p.lambda_, als_p.alpha,
-             als_p.implicit_prefs, als_p.seed),
-        )
-        resume = None
-        if resume_allowed:
-            like = {
-                "user": np.zeros((len(pd.user_ids), als_p.rank),
-                                 np.float32),
-                "item": np.zeros((len(pd.item_ids), als_p.rank),
-                                 np.float32),
-            }
-            got = ck.load_latest(like, fingerprint=fp)
-            if got is not None:
-                step, state = got
-                resume = (step + 1, state["user"], state["item"])
-                logger.info(
-                    "ALS train resuming from checkpoint step %d "
-                    "(iteration %d of %d)", step, step + 1,
-                    als_p.num_iterations)
-
-        def checkpoint_cb(it, user_f, item_f):
-            if ck.should_save(it):
-                ck.save(it, {"user": np.asarray(user_f),
-                             "item": np.asarray(item_f)}, fingerprint=fp)
-
+            # bind checkpoints to the data + per-iteration math; the
+            # iteration COUNT is deliberately excluded so a resumed run
+            # can complete (or extend) the interrupted one — each
+            # iteration's update is identical regardless of how many
+            # follow it. The solver owns save/resume from here: the
+            # sharded SPMD path writes per-shard slabs whose layout this
+            # template cannot know.
+            fp = fingerprint_arrays(
+                pd.user_idx, pd.item_idx, pd.ratings,
+                ("als-dense", als_p.rank, als_p.lambda_, als_p.alpha,
+                 als_p.implicit_prefs, als_p.seed),
+            )
+            checkpoint = TrainCheckpointSpec(ck, fp, resume_allowed)
         factors = als.train(
             pd.user_idx,
             pd.item_idx,
             pd.ratings,
             n_users=len(pd.user_ids),
             n_items=len(pd.item_ids),
-            callback=checkpoint_cb,
-            resume=resume,
+            checkpoint=checkpoint,
         )
-        ck.clear()  # the run completed; its snapshots are obsolete
         return ALSModel(factors, pd.user_ids, pd.item_ids, pd.item_categories)
 
     # -- device-batched sweep protocol (core/sweep.py) -----------------------
@@ -629,13 +604,13 @@ class ALSAlgorithm(PAlgorithm):
         # _iteration_dense runs, restricted to the touched rows
         rows = foldin_mod.solve_entities(
             p, touched_u, ui, ii, rr, itf, uf[touched_u], n_users,
-            n_items)
+            n_items, ctx=ctx)
         if rows is None:
             return None
         uf[touched_u] = rows
         rows = foldin_mod.solve_entities(
             p, touched_i, ii, ui, rr, uf, itf[touched_i], n_items,
-            n_users)
+            n_users, ctx=ctx)
         if rows is None:
             return None
         itf[touched_i] = rows

@@ -56,12 +56,12 @@ _SKIP_KEYS = {
     "ingest_host_cpus", "scan_events", "scan_partitions",
     "band_violations", "dense_cache_hit", "peak_bf16_tflops",
     "sasrec_batch", "sasrec_max_len", "sasrec_serve_placement",
-    "bulk_ingest_chunk", "ingest_view_events",
+    "bulk_ingest_chunk", "ingest_view_events", "sharded_shards",
 }
 
 _LOWER_BETTER_RE = re.compile(
     r"(_ms$|_ms_|_sec$|_s$|_seconds$|sec_per_|_p50|_p99|latency"
-    r"|_bytes$|_mb_per_step$|retraces)")
+    r"|_bytes$|_mb_per_step$|retraces|imbalance)")
 _HIGHER_BETTER_RE = re.compile(
     r"(per_sec|per_iter$|_qps$|^qps$|mfu|rate$|_frac$|flops|iter_per"
     r"|overlap|hit_rate|speedup)")

@@ -180,10 +180,181 @@ def _foldin_half_program():
 _FOLDIN_HALF = None
 
 
+#: Compiled sharded fold-in programs keyed by layout statics — module-
+#: level so steady-state continuous-training cycles re-dispatch warm.
+_FOLDIN_SPMD_PROGRAMS: dict = {}
+
+
+def _foldin_spmd_program(mesh, ndev: int, us: int, S: int, rank: int,
+                         implicit: bool, scale: int, exact: bool,
+                         has_dup: bool):
+    """The sharded restricted half-step: a vmap over per-shard
+    ``[us, S]`` sub-blocks, jitted over data-sharded stacked inputs.
+    The fixed side is FROZEN for the whole generation, so each shard's
+    referenced rows are host-gathered into its ``[S, rank]`` slice at
+    pack time — no collectives, and the fixed matrix is never
+    materialized whole on any device (the same never-whole contract as
+    ``train_dense_sharded``). Implicit mode's shared XtX Gram term rides
+    in as a precomputed ``[rank, rank]`` operand for the same reason."""
+    key = (mesh, ndev, us, S, rank, implicit, scale, exact, has_dup)
+    prog = _FOLDIN_SPMD_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.obs import device as device_obs
+
+    dots = als_dense._make_dots(implicit, exact, rank=rank)
+
+    def one(items, vals, row_starts, k, fixed_sl, prev, dup):
+        a = als_dense._scatter_block(items, vals, row_starts, k,
+                                     ub=us, n_items=S)
+        ip, vp = als_dense._local_half_inputs(fixed_sl, rank, implicit)
+        gi, gv = dots(a, ip, vp, ((1,), (0,)))
+        corr = (als_dense._dup_correction(dup, fixed_sl, rank, us,
+                                          one.alpha, implicit)
+                if has_dup else None)
+        return als_dense._normal_eq_solve(
+            prev, gi, gv, corr, fixed_sl, one.lambda_, one.alpha,
+            implicit, rank, scale, xtx=one.xtx)
+
+    def foldin_spmd(items, vals, row_starts, k, fixed_sl, prev, dup,
+                    xtx, lambda_, alpha):
+        # scalars + the shared xtx ride as closure attributes so the
+        # vmap axes stay purely the per-shard stacks
+        one.xtx, one.lambda_, one.alpha = xtx, lambda_, alpha
+        axes = (0, 0, 0, 0, 0, 0, 0 if has_dup else None)
+        return jax.vmap(one, in_axes=axes)(
+            items, vals, row_starts, k, fixed_sl, prev, dup)
+
+    prog = device_obs.profiled_program(
+        f"als_foldin_spmd_rank{rank}",
+        # shard count rides the bucket key (the train-program contract)
+        bucket=lambda *a, **kw: (ndev, rank,
+                                 device_obs.shape_bucket(*a)),
+        sync=True,
+    )(jax.jit(foldin_spmd))
+    if len(_FOLDIN_SPMD_PROGRAMS) >= 8:
+        _FOLDIN_SPMD_PROGRAMS.pop(next(iter(_FOLDIN_SPMD_PROGRAMS)))
+    _FOLDIN_SPMD_PROGRAMS[key] = prog
+    return prog
+
+
+def _solve_entities_sharded(params, entities, e_idx, o_idx, vals, fixed,
+                            prev_rows, n_entities: int, n_other: int,
+                            mesh, ndev: int) -> np.ndarray | None:
+    """Sharded restricted half-step: touched entities split into one
+    contiguous row chunk per ``data`` shard, each solved against a
+    host-gathered slice of the frozen fixed side. Same restricted math
+    as the single-device path — untouched rows never enter, so the
+    byte-exactness contract is unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.models import als_dense
+
+    p = params
+    m = int(len(entities))
+    local = np.full(n_entities, -1, np.int32)
+    local[entities] = np.arange(m, dtype=np.int32)
+    le_all = local[np.asarray(e_idx, np.int32)]
+    sel = le_all >= 0
+    le = le_all[sel]
+    lo = np.asarray(o_idx, np.int32)[sel]
+    lv = np.asarray(vals, np.float32)[sel]
+    scale = als_dense._int8_scale(lv)
+    if scale == 0:
+        return None
+    mu, mi, mv, dup_u, _dup_i = als_dense._sorted_main_and_corrections(
+        le, lo, lv, m, n_other, scale)
+    us0 = -(-m // ndev)  # real rows per shard (last may be short)
+    us = _pow2(us0)
+    starts = np.searchsorted(mu, np.arange(ndev + 1) * us0)
+    dstarts = (np.searchsorted(dup_u.seg, np.arange(ndev + 1) * us0)
+               if dup_u is not None else None)
+    m_pad = _pow2(int(np.diff(starts).max()) if m else 1, floor=4096)
+    nd = 0
+    if dup_u is not None:
+        nd = _pow2(int(np.diff(dstarts).max()), floor=4096)
+    # per-shard dedup'd slice of the frozen fixed side
+    slice_rows = []
+    for d in range(ndev):
+        ref = mi[starts[d]:starts[d + 1]]
+        if dup_u is not None:
+            ref = np.concatenate(
+                [ref, dup_u.nbr[dstarts[d]:dstarts[d + 1]]])
+        slice_rows.append(np.unique(ref).astype(np.int32))
+    S = _pow2(max((len(r) for r in slice_rows), default=1), floor=8)
+    rank = p.rank
+    fx = np.asarray(fixed, np.float32)
+    items_h = np.zeros((ndev, m_pad), np.int32)
+    vals_h = np.zeros((ndev, m_pad), np.int8)
+    rs_h = np.zeros((ndev, us + 1), np.int32)
+    k_h = np.zeros(ndev, np.int32)
+    fixed_h = np.zeros((ndev, S, rank), np.float32)
+    prev_h = np.zeros((ndev, us, rank), np.float32)
+    dup_h = (np.zeros((ndev, nd), np.int32), np.zeros((ndev, nd), np.int32),
+             np.zeros((ndev, nd), np.float32),
+             np.zeros((ndev, nd), np.float32)) if nd else None
+    for d in range(ndev):
+        lookup = np.zeros(n_other, np.int32)
+        rows = slice_rows[d]
+        lookup[rows] = np.arange(len(rows), dtype=np.int32)
+        lo_, hi_ = starts[d], starts[d + 1]
+        k = int(hi_ - lo_)
+        items_h[d, :k] = lookup[mi[lo_:hi_]]
+        vals_h[d, :k] = mv[lo_:hi_]
+        rs_h[d] = np.searchsorted(mu[lo_:hi_],
+                                  d * us0 + np.arange(us + 1))
+        k_h[d] = k
+        fixed_h[d, :len(rows)] = fx[rows]
+        r0, r1 = d * us0, min((d + 1) * us0, m)
+        if r1 > r0:
+            prev_h[d, :r1 - r0] = np.asarray(prev_rows,
+                                             np.float32)[r0:r1]
+        if nd:
+            dl, dh = dstarts[d], dstarts[d + 1]
+            kd = int(dh - dl)
+            dup_h[0][d, :kd] = dup_u.seg[dl:dh] - d * us0
+            dup_h[1][d, :kd] = lookup[dup_u.nbr[dl:dh]]
+            dup_h[2][d, :kd] = dup_u.cnt[dl:dh]
+            dup_h[3][d, :kd] = dup_u.val[dl:dh]
+            if kd:  # keep segment ids sorted through the padding
+                dup_h[0][d, kd:] = dup_h[0][d, kd - 1]
+    xtx = None
+    if p.implicit_prefs:
+        # the shared Gram term needs the FULL frozen fixed matrix; a
+        # per-shard slice gram would double-count rows referenced by
+        # several shards, so it is computed once on host (f64 accumulate
+        # ≈ the device's HIGHEST-precision f32 dot)
+        xtx = (fx.astype(np.float64).T @ fx.astype(np.float64)) \
+            .astype(np.float32)
+
+    def put(a, *trail):
+        return jax.device_put(
+            a, NamedSharding(mesh, P("data", *trail)))
+
+    dup_dev = (tuple(put(x, None) for x in dup_h) if nd else None)
+    prog = _foldin_spmd_program(
+        mesh, ndev, us, S, rank, p.implicit_prefs, scale,
+        p.gather_dtype == "float32", nd > 0)
+    out = prog(put(items_h, None), put(vals_h, None), put(rs_h, None),
+               put(k_h), put(fixed_h, None, None),
+               put(prev_h, None, None), dup_dev,
+               None if xtx is None else jnp.asarray(xtx),
+               float(p.lambda_), float(p.alpha))
+    out = np.asarray(out)
+    return np.concatenate(
+        [out[d, :min(us0, m - d * us0)] for d in range(ndev)
+         if d * us0 < m])
+
+
 def solve_entities(params, entities: np.ndarray, e_idx: np.ndarray,
                    o_idx: np.ndarray, vals: np.ndarray, fixed,
                    prev_rows: np.ndarray, n_entities: int,
-                   n_other: int) -> np.ndarray | None:
+                   n_other: int, ctx=None) -> np.ndarray | None:
     """Re-solved factor rows ``[m, rank]`` for ``entities`` (sorted
     unique int32 ids of one side) against frozen ``fixed`` opposite-side
     factors, from the FULL COO ``(e_idx, o_idx, vals)``. The math is the
@@ -192,7 +363,12 @@ def solve_entities(params, entities: np.ndarray, e_idx: np.ndarray,
     the ChunkStager in row blocks) and one payload-matmul + Cholesky
     dispatch re-solves all of them. None when the values are not
     int8-encodable (the dense formulation does not apply — callers fall
-    back to a full retrain)."""
+    back to a full retrain).
+
+    With a multi-device ``ctx``, the touched rows and the referenced
+    fixed slices shard across the ``data`` axis instead
+    (:func:`_solve_entities_sharded`) — continuous training survives a
+    model whose factor matrices outgrow one device."""
     import jax.numpy as jnp
 
     from predictionio_tpu.io import transfer
@@ -202,6 +378,14 @@ def solve_entities(params, entities: np.ndarray, e_idx: np.ndarray,
     m = int(len(entities))
     if m == 0:
         return prev_rows
+    if ctx is not None:
+        import jax
+
+        ndev = ctx.mesh.shape.get("data", 1)
+        if ndev > 1 and jax.process_count() == 1:
+            return _solve_entities_sharded(
+                params, entities, e_idx, o_idx, vals, fixed, prev_rows,
+                n_entities, n_other, ctx.mesh, ndev)
     # select the touched entities' edges and remap to local row ids
     local = np.full(n_entities, -1, np.int32)
     local[entities] = np.arange(m, dtype=np.int32)

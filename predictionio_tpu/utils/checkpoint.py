@@ -237,8 +237,12 @@ class TrainCheckpointer:
         self, like: Any, fingerprint: str = ""
     ) -> tuple[int, Any] | None:
         """(step, state) of the newest VALID checkpoint restored into
-        the structure of ``like``, or None if no (matching) checkpoint
-        exists. A corrupt or truncated snapshot — content hash mismatch,
+        the structure of ``like`` — or loaded structure-free via
+        :func:`load_pytree` when ``like`` is None (sharded trainers whose
+        per-shard slab layout depends on the device count that WROTE the
+        checkpoint validate the layout themselves) — or None if no
+        (matching) checkpoint exists. A corrupt or truncated snapshot —
+        content hash mismatch,
         or a load that raises — is moved aside and the previous snapshot
         is used instead: a crash mid-write (or mid-fsync on a dying
         node) costs one checkpoint interval, never the whole run. A
@@ -250,7 +254,8 @@ class TrainCheckpointer:
             step, d = dirs[-1]
             if verify_content_hash(d):
                 try:
-                    state = load_pytree_like(d, like)
+                    state = (load_pytree(d) if like is None
+                             else load_pytree_like(d, like))
                     break
                 except (OSError, ValueError, KeyError) as e:
                     # hash intact but the payload won't deserialize into
@@ -311,6 +316,22 @@ class TrainCheckpointer:
 class TrainCheckpointConfig:
     directory: str
     every: int = 1
+    resume: bool = False
+
+
+@dataclass
+class TrainCheckpointSpec:
+    """A bound checkpointer handed INTO an algorithm's train path.
+
+    The workflow scope above carries CLI intent (dir/every/resume); this
+    carries a constructed :class:`TrainCheckpointer` plus the run's data
+    fingerprint, for solvers whose checkpoint state layout the caller
+    cannot know (the sharded ALS path saves per-shard factor slabs + a
+    layout manifest — a template-level ``load_latest(like=global zeros)``
+    would misread them as corrupt)."""
+
+    checkpointer: TrainCheckpointer
+    fingerprint: str = ""
     resume: bool = False
 
 

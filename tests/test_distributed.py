@@ -17,16 +17,16 @@ import pytest
 from predictionio_tpu.utils.http import free_port as _free_port
 
 WORKER = Path(__file__).with_name("dist_worker.py")
+SHARDED_WORKER = Path(__file__).with_name("sharded_worker.py")
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
+#: The jaxlib CPU backend's refusal string for cross-process
+#: collectives. When a worker dies with THIS, the env genuinely cannot
+#: run the two-process path (single-host CPU CI image) and the test
+#: skips with the evidence; any other failure is a real red.
+_CPU_BACKEND_REFUSAL = "computations aren't implemented on the CPU backend"
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="jaxlib CPU backend refuses cross-process collectives "
-    "('Multiprocess computations aren't implemented on the CPU "
-    "backend') — known-red on the single-host CPU CI image; the path "
-    "is exercised for real on multi-host TPU deployments",
-)
+
 def test_two_process_mesh_spans_and_reduces():
     port = _free_port()
     env_base = {
@@ -57,6 +57,14 @@ def test_two_process_mesh_spans_and_reduces():
                 q.kill()
             raise
         outs.append(out)
+    if any(p.returncode != 0 and _CPU_BACKEND_REFUSAL in out
+           for p, out in zip(procs, outs)):
+        pytest.skip(
+            "jaxlib CPU backend refuses cross-process collectives on "
+            "this image ('Multiprocess computations aren't implemented "
+            "on the CPU backend'); the path runs for real on multi-host "
+            "TPU deployments — see test_sharded_als_simulated_mesh for "
+            "the in-process SPMD coverage")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"RESULT {pid} 112.0" in out, f"worker {pid} output:\n{out}"
@@ -71,6 +79,28 @@ def test_two_process_mesh_spans_and_reduces():
     assert fps[0] == fps[1], f"process factor mismatch: {fps}"
     single = _single_process_fingerprint()
     assert abs(fps[0] - single) < 1e-2, (fps[0], single)
+
+
+def test_sharded_als_simulated_mesh():
+    """The PR-18 sharded solver on the exact 4-shard deployment shape,
+    in a fresh subprocess (the suite's own process pinned an 8-device
+    count at conftest import). The worker proves parity vs a
+    single-device ``train_dense``, that the slice working set — and so
+    any device's view of the item factors — is a strict fraction of the
+    item table, and that per-shard DeviceArena-registered HBM stays
+    below what replicating the item factors alone would pin."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("PIO_TPU_", "XLA_", "JAX_"))
+    }
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, str(SHARDED_WORKER)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"sharded worker failed:\n{out}"
+    assert "SHARDED-OK" in out, f"sharded worker output:\n{out}"
 
 
 def _single_process_fingerprint() -> float:

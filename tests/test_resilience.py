@@ -731,10 +731,10 @@ def test_run_train_workflow_scope_checkpoint_and_resume(
     )
 
     # the conftest test mesh has 8 virtual devices, which routes ALS
-    # onto the SPMD path; pin the whole train to ONE device so the
-    # solve takes the single-device dense path — the one that supports
-    # per-iteration checkpoint/resume (the SPMD path warns and starts
-    # fresh)
+    # onto the SPMD path; pin the whole train to ONE device so this
+    # test exercises the single-device dense checkpoint/resume wiring
+    # (the SPMD path's per-shard-slab resume is pinned separately in
+    # tests/test_sharded_als.py)
     from predictionio_tpu.workflow import core_workflow
 
     monkeypatch.setattr(core_workflow, "workflow_context",

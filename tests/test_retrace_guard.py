@@ -196,6 +196,85 @@ def test_dense_als_train_compiles_once_per_shape_bucket():
     als_dense.clear_dense_cache()
 
 
+def _data_mesh_ctx(nd: int):
+    """A FRESH (but value-equal) nd-device data-axis mesh each call:
+    the sharded program caches must hit on mesh equality, not object
+    identity — a production trainer builds a new ComputeContext per
+    train invocation."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:nd]).reshape(nd, 1),
+        ("data", "model")))
+
+
+def test_sharded_als_spmd_ladder_compiles_once_per_bucket():
+    """The fully sharded SPMD train (PR 18): one compile per
+    (shard-count, rank) bucket across the shard-count x rank ladder,
+    and a warm second pass re-dispatching EVERY bucket — through fresh
+    mesh objects — may add NO signatures and NO compiles. A retrace
+    here re-lowers the whole multi-device fori_loop program per train:
+    the costliest invisible compile in the repo."""
+    from predictionio_tpu.models import als_dense
+    from predictionio_tpu.models.als import ALSParams
+
+    programs = ("als_dense_spmd_rank4", "als_dense_spmd_rank8")
+    for name in programs:
+        device_obs.reset_program(name)
+    rng = np.random.default_rng(23)
+    nu, ni, nnz = 61, 47, 400  # unique dataset shape: cold buckets
+    ui = rng.integers(0, nu, nnz).astype(np.int32)
+    ii = rng.integers(0, ni, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    for _pass in range(2):  # pass 2: zero new compiles allowed
+        for rank in (4, 8):
+            params = ALSParams(rank=rank, num_iterations=2, seed=2,
+                               solver="dense")
+            for nd in (2, 4):
+                uf, itf = als_dense.train_dense_sharded(
+                    _data_mesh_ctx(nd), params, ui, ii, r, nu, ni)
+                assert uf.shape == (nu, rank)
+                assert itf.shape == (ni, rank)
+    for name in programs:
+        rep = _assert_one_compile_per_bucket(name)
+        # the shard count rides the bucket key: nd=2 and nd=4 are two
+        # expected compiles, not retraces
+        assert len(rep["buckets"]) == 2
+        assert rep["calls"] == 4  # 2 passes x 2 shard counts, fused
+
+
+def test_sharded_foldin_compiles_once_per_bucket():
+    """The sharded fold-in half-step (PR 18): one compile per
+    shard-count bucket, warm re-dispatch through fresh meshes all
+    cache hits — fold-in runs per deploy tick, so a retrace here is a
+    per-tick compile."""
+    from predictionio_tpu.models.als import ALSParams
+    from predictionio_tpu.train import foldin
+
+    device_obs.reset_program("als_foldin_spmd_rank4")
+    rng = np.random.default_rng(29)
+    n_e, n_o, nnz = 57, 39, 300  # unique shapes: cold buckets
+    e_idx = rng.integers(0, n_e, nnz).astype(np.int32)
+    o_idx = rng.integers(0, n_o, nnz).astype(np.int32)
+    vals = rng.integers(1, 6, nnz).astype(np.float32)
+    entities = np.unique(e_idx).astype(np.int32)
+    fixed = rng.normal(size=(n_o, 4)).astype(np.float32)
+    prev = rng.normal(size=(len(entities), 4)).astype(np.float32)
+    params = ALSParams(rank=4, num_iterations=1, seed=0)
+    for _pass in range(2):  # pass 2: zero new compiles allowed
+        for nd in (2, 4):
+            rows = foldin.solve_entities(
+                params, entities, e_idx, o_idx, vals, fixed, prev,
+                n_e, n_o, ctx=_data_mesh_ctx(nd))
+            assert rows is not None and rows.shape == prev.shape
+    rep = _assert_one_compile_per_bucket("als_foldin_spmd_rank4")
+    assert len(rep["buckets"]) == 2  # one per shard count
+    assert rep["calls"] == 4
+
+
 def test_two_tower_sparse_step_compiles_once_per_bucket():
     """The sparse embedding-update train program (ISSUE 15): repeated
     fused runs over one dataset shape must reuse that bucket's ONE

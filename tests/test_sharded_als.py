@@ -1,0 +1,245 @@
+"""Pins for the fully sharded ALS solver (PR 18, ALX layout).
+
+Four contracts the ISSUE acceptance names, each pinned directly against
+the single-device reference on simulated CPU sub-meshes:
+
+* **Parity**: 1/2/4-shard trains reproduce the single-device
+  ``train_dense`` factors on the same problem.
+* **Working set**: with block-structured ratings the slice-exchange
+  working set — every device's only view of the opposite shards' item
+  factors — is a strict fraction of the item table, and per-shard
+  DeviceArena-registered HBM stays below what replicating the item
+  factors alone would pin per device.
+* **Checkpoint re-shard**: a run checkpointed at 2 shards resumes at 4
+  shards byte-exactly (vs the explicit resume-tuple continuation).
+* **Observability**: the ``pio_als_shard_*`` metrics are live and
+  ``pio doctor`` (runlog.diagnose_runs) warns on noted load skew.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models import als_dense
+from predictionio_tpu.models.als import ALSParams
+
+
+def _ctx(nd: int):
+    """Fresh nd-device data-axis sub-mesh of the conftest 8-CPU pool."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:nd]).reshape(nd, 1),
+        ("data", "model")))
+
+
+def _data(nu=180, ni=120, nnz=2400, seed=0):
+    rng = np.random.default_rng(seed)
+    ui = rng.integers(0, nu, nnz).astype(np.int32)
+    ii = rng.integers(0, ni, nnz).astype(np.int32)
+    r = rng.integers(1, 6, nnz).astype(np.float32)
+    return ui, ii, r, nu, ni
+
+
+def _maxdiff(a, b) -> float:
+    return float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+
+
+@pytest.mark.parametrize("nd", [1, 2, 4])
+def test_sharded_parity_pin(nd):
+    """Sharded factors match single-device ``train_dense`` at every
+    shard count — including the degenerate 1-shard mesh (the sharded
+    program must be correct, not just its multi-device exchange)."""
+    ui, ii, r, nu, ni = _data()
+    params = ALSParams(rank=6, num_iterations=3, seed=4, solver="dense")
+    ref_u, ref_i = als_dense.train_dense(
+        _ctx(1), params, ui, ii, r, nu, ni)
+    uf, itf = als_dense.train_dense_sharded(
+        _ctx(nd), params, ui, ii, r, nu, ni)
+    assert uf.shape == (nu, 6) and itf.shape == (ni, 6)
+    assert _maxdiff(uf, ref_u) < 5e-3
+    assert _maxdiff(itf, ref_i) < 5e-3
+
+
+def test_sharded_parity_pin_implicit():
+    """Implicit-feedback mode exchanges partial grams over the same
+    slice transport plus a psum'd XtX — pin it separately."""
+    ui, ii, r, nu, ni = _data(seed=3)
+    params = ALSParams(rank=6, num_iterations=3, seed=5, solver="dense",
+                       implicit_prefs=True, alpha=8.0)
+    ref_u, ref_i = als_dense.train_dense(
+        _ctx(1), params, ui, ii, r, nu, ni)
+    uf, itf = als_dense.train_dense_sharded(
+        _ctx(4), params, ui, ii, r, nu, ni)
+    assert _maxdiff(uf, ref_u) < 5e-3
+    assert _maxdiff(itf, ref_i) < 5e-3
+
+
+def _block_data(nu=128, ni=2048, per_user=10, shards=4, block=64,
+                seed=2):
+    """Each user shard's users rate only one ``block``-item range, so
+    the slice working set stays far below ``ni``."""
+    rng = np.random.default_rng(seed)
+    ub = nu // shards
+    ui = np.repeat(np.arange(nu, dtype=np.int64), per_user)
+    ii = np.concatenate([
+        rng.integers((u // ub) * block, (u // ub) * block + block,
+                     size=per_user) for u in range(nu)
+    ]).astype(np.int64)
+    r = rng.integers(1, 6, size=ui.size).astype(np.float32)
+    return ui, ii, r, nu, ni
+
+
+def test_item_factors_never_whole_on_any_device():
+    """The ISSUE acceptance: on a simulated 4-device mesh, no device
+    ever materializes the item factor table whole. The slice slots
+    (``nw``) bound any device's view of remote item factors; per-shard
+    arena bytes (inputs + factor slabs + slice slots, snapshotted while
+    the allocations live) stay under the replicated-item-table bytes a
+    one-sided sharding would pin on every device."""
+    ui, ii, r, nu, ni = _block_data()
+    params = ALSParams(rank=8, num_iterations=2, seed=1, solver="dense")
+    als_dense.train_dense_sharded(_ctx(4), params, ui, ii, r, nu, ni)
+    stats = als_dense.last_sharded_stats
+    assert stats["ndev"] == 4
+    assert stats["slice_slots"] < ni, stats
+    replicated = stats["replicated_item_bytes"]
+    assert replicated == ni * 8 * 4
+    per_shard = stats["per_shard_hbm_bytes"]
+    assert len(per_shard) == 4
+    assert all(0 < b < replicated for b in per_shard), stats
+    assert stats["gather_bytes_per_iter"] > 0
+
+
+def test_checkpoint_resume_across_shard_counts(tmp_path):
+    """Save per-shard slabs at 2 shards, resume at 4: the layout
+    manifest re-shards on load and the continuation is byte-identical
+    to handing the same host factors in as an explicit resume tuple."""
+    from predictionio_tpu.utils.checkpoint import (
+        TrainCheckpointer,
+        TrainCheckpointSpec,
+    )
+
+    ui, ii, r, nu, ni = _data(seed=1)
+    p2 = ALSParams(rank=4, num_iterations=2, seed=7, solver="dense")
+    p4 = ALSParams(rank=4, num_iterations=4, seed=7, solver="dense")
+    ck = TrainCheckpointer(tmp_path, every=1)
+    fp = "sharded-resume-pin"
+    uf2, if2 = als_dense.train_dense_sharded(
+        _ctx(2), p2, ui, ii, r, nu, ni,
+        checkpoint=TrainCheckpointSpec(ck, fp, resume=False))
+
+    # the newest checkpoint is the post-iteration-1 state: loading it
+    # back (at ANY device count) must reproduce the returned factors
+    got = als_dense.load_sharded_resume(ck, fp, nu, ni, 4)
+    assert got is not None and got[0] == 2
+    assert np.array_equal(got[1], np.asarray(uf2))
+    assert np.array_equal(got[2], np.asarray(if2))
+
+    res = als_dense.train_dense_sharded(
+        _ctx(4), p4, ui, ii, r, nu, ni,
+        checkpoint=TrainCheckpointSpec(ck, fp, resume=True))
+    ref = als_dense.train_dense_sharded(
+        _ctx(4), p4, ui, ii, r, nu, ni,
+        resume=(2, np.asarray(uf2), np.asarray(if2)))
+    assert np.array_equal(np.asarray(res[0]), np.asarray(ref[0]))
+    assert np.array_equal(np.asarray(res[1]), np.asarray(ref[1]))
+
+
+def test_checkpoint_fingerprint_mismatch_starts_fresh(tmp_path):
+    """A foreign fingerprint must not resume — the sharded loader
+    returns None and the train runs from iteration 0 (same factors as
+    an uncheckpointed train)."""
+    from predictionio_tpu.utils.checkpoint import (
+        TrainCheckpointer,
+        TrainCheckpointSpec,
+    )
+
+    ui, ii, r, nu, ni = _data(seed=6)
+    p = ALSParams(rank=4, num_iterations=2, seed=9, solver="dense")
+    ck = TrainCheckpointer(tmp_path, every=1)
+    als_dense.train_dense_sharded(
+        _ctx(2), p, ui, ii, r, nu, ni,
+        checkpoint=TrainCheckpointSpec(ck, "run-A", resume=False))
+    assert als_dense.load_sharded_resume(ck, "run-B", nu, ni, 4) is None
+    fresh = als_dense.train_dense_sharded(
+        _ctx(2), p, ui, ii, r, nu, ni,
+        checkpoint=TrainCheckpointSpec(
+            TrainCheckpointer(tmp_path / "b"), "run-B", resume=True))
+    plain = als_dense.train_dense_sharded(
+        _ctx(2), p, ui, ii, r, nu, ni)
+    assert np.array_equal(np.asarray(fresh[0]), np.asarray(plain[0]))
+    assert np.array_equal(np.asarray(fresh[1]), np.asarray(plain[1]))
+
+
+def test_sharded_foldin_matches_single_device_route():
+    """The vmap'd sharded fold-in half-step reproduces the single-device
+    restricted solve, and the fold-in contract — untouched rows pass
+    through byte-identical — is preserved when the caller patches the
+    returned rows back."""
+    from predictionio_tpu.train import foldin
+
+    rng = np.random.default_rng(31)
+    n_e, n_o, rank = 90, 70, 4
+    nnz = 400
+    e_idx = rng.integers(0, 40, nnz).astype(np.int32)  # touch ids < 40
+    o_idx = rng.integers(0, n_o, nnz).astype(np.int32)
+    vals = rng.integers(1, 6, nnz).astype(np.float32)
+    entities = np.unique(e_idx).astype(np.int32)
+    fixed = rng.normal(size=(n_o, rank)).astype(np.float32)
+    prev_full = rng.normal(size=(n_e, rank)).astype(np.float32)
+    params = ALSParams(rank=rank, num_iterations=1, seed=0)
+
+    rows_one = foldin.solve_entities(
+        params, entities, e_idx, o_idx, vals, fixed,
+        prev_full[entities], n_e, n_o)
+    rows_sh = foldin.solve_entities(
+        params, entities, e_idx, o_idx, vals, fixed,
+        prev_full[entities], n_e, n_o, ctx=_ctx(4))
+    assert rows_sh is not None and rows_sh.shape == (len(entities), rank)
+    assert _maxdiff(rows_sh, rows_one) < 1e-3
+
+    new_full = prev_full.copy()
+    new_full[entities] = rows_sh
+    untouched = np.setdiff1d(np.arange(n_e), entities)
+    assert untouched.size > 0
+    assert np.array_equal(new_full[untouched], prev_full[untouched])
+
+
+def test_shard_metrics_live_after_sharded_train():
+    """``pio_als_shard_gather_bytes`` / ``pio_als_shard_imbalance``
+    carry real values after a sharded train (the docs/operations.md
+    rows point at live series, not dead declarations)."""
+    from predictionio_tpu.obs import REGISTRY
+
+    ui, ii, r, nu, ni = _data(seed=8)
+    params = ALSParams(rank=4, num_iterations=1, seed=2, solver="dense")
+    als_dense.train_dense_sharded(_ctx(2), params, ui, ii, r, nu, ni)
+    text = REGISTRY.expose()
+    assert "pio_als_shard_gather_bytes" in text
+    assert "pio_als_shard_imbalance" in text
+    assert als_dense.last_sharded_stats["imbalance"] >= 1.0
+
+
+def test_doctor_warns_on_shard_imbalance(tmp_path):
+    """runlog note -> ``pio doctor`` finding: a run whose noted
+    shard_imbalance exceeds PIO_SHARD_IMBALANCE_WARN (default 2.0)
+    yields a warn-severity SHARD-IMBALANCE finding; a balanced run
+    yields none."""
+    from predictionio_tpu.obs import runlog
+
+    skewed = tmp_path / "skewed"
+    with runlog.run_scope(run_id="skew1", directory=skewed):
+        runlog.note("shard_imbalance", 3.2)
+    findings = runlog.diagnose_runs(skewed)
+    hits = [f for f in findings if "SHARD-IMBALANCE" in f["detail"]]
+    assert hits and hits[0]["severity"] == "warn"
+    assert "3.2" in hits[0]["detail"]
+
+    balanced = tmp_path / "balanced"
+    with runlog.run_scope(run_id="flat1", directory=balanced):
+        runlog.note("shard_imbalance", 1.4)
+    assert not [f for f in runlog.diagnose_runs(balanced)
+                if "SHARD-IMBALANCE" in f["detail"]]
