@@ -105,6 +105,11 @@ SCALES: dict[str, dict] = {
         sharded=dict(iters=8, repeats=2),
         synth10x=dict(shape=(1_384_930, 26_744, 60_000_000), rank=16,
                       iters=4),
+        # table + touched-row adam ≈ 48 GB at d=64 — past one v4 chip's
+        # 32 GB HBM; only the PIO_EMB_SHARDS row-sharded layout hosts it
+        synth_bigtable=dict(nu=60_000_000, ni=2_000_000, nnz=2_000_000,
+                            batch=8192, steps=200, samples=3,
+                            embed_dim=64, single_compare=False),
         serving=True, host_baseline=True,
     ),
     "dry": dict(
@@ -118,6 +123,9 @@ SCALES: dict[str, dict] = {
                     embed_dim=16, num_blocks=1, epochs=1, samples=2),
         sharded=dict(iters=2, repeats=1),
         synth10x=dict(shape=(4_000, 400, 48_000), rank=8, iters=2),
+        synth_bigtable=dict(nu=2_000, ni=600, nnz=20_000, batch=256,
+                            steps=20, samples=2, embed_dim=16,
+                            single_compare=True),
         # the serving bench spins up real servers and the host baseline
         # times a minutes-long numpy solve: both are skipped at dry
         # scale (vs_baseline falls back to the assumed figure)
@@ -560,6 +568,107 @@ def bench_two_tower(ctx, tt_cfg: dict | None = None) -> dict:
                              optimizer="rowwise_adam")
         trw = timed_samples(prw, steps, 3)[0]
         out["two_tower_rowwise_steps_per_sec"] = round(steps / trw, 2)
+    return out
+
+
+def bench_synth_bigtable(ctx, cfg: dict) -> dict:
+    """Row-sharded embedding tables (docs/perf.md §19): a synthetic
+    two-tower workload whose table + touched-row adam state is sized
+    PAST one device's HBM at full scale — only the ``PIO_EMB_SHARDS``
+    layout can host it, so the published rate is per-DEVICE examples/sec
+    plus the analytic all_to_all exchange bytes the layout pays instead
+    of whole-table residency. Dry scale runs the same code path on a
+    tiny shape (``single_compare`` then also times the single-device
+    sparse path for the ≥0.8x-per-device acceptance story)."""
+    import os as _os
+
+    import jax
+
+    from predictionio_tpu.models import two_tower as tt
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    nu, ni, nnz = cfg["nu"], cfg["ni"], cfg["nnz"]
+    ui, ii, _r = synthesize(nu, ni, nnz, seed=11)
+    ui = ui.astype(np.int32)
+    ii = ii.astype(np.int32)
+    ndev = int(ctx.mesh.shape.get("data", 1))
+    p = tt.TwoTowerParams(embed_dim=cfg["embed_dim"],
+                          batch_size=cfg["batch"], steps=0, seed=0)
+    steps, samples = cfg["steps"], cfg["samples"]
+    key = jax.random.PRNGKey(0)
+
+    def timed(ctx_, n_shards: int) -> float:
+        """Min-of-N fixed-work wall time of the fused ``steps``-step run
+        (bench_two_tower's protocol: 2-step warm, one scalar readback
+        per sample) under PIO_EMB_SHARDS=n_shards."""
+        prev = _os.environ.get("PIO_EMB_SHARDS")
+        _os.environ["PIO_EMB_SHARDS"] = str(n_shards)
+        try:
+            batch_ = ctx_.pad_to_multiple(p.batch_size)
+            tx_, run_, _one = tt._get_trainer(ctx_, p, batch_, nu, ni)
+            params_ = tt.init_params(nu, ni, p)
+            if n_shards >= 2:
+                params_ = {
+                    side: {
+                        "embed": stbl.put_sharded(
+                            ctx_.mesh, stbl.shard_table(
+                                np.asarray(params_[side]["embed"]),
+                                n_shards)),
+                        "layers": jax.device_put(
+                            params_[side]["layers"], ctx_.replicated),
+                    } for side in ("user", "item")
+                }
+            else:
+                params_ = jax.device_put(params_, ctx_.replicated)
+            opt_ = tx_.init(params_)
+            from predictionio_tpu.io import transfer
+
+            u_all, i_all = transfer.stage_training_arrays(
+                (ui, ii), sharding=ctx_.replicated, name="bigtable_inputs")
+            params_, opt_, loss = run_(params_, opt_, u_all, i_all, key, 2)
+            float(loss)
+            times = []
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                params_, opt_, loss = run_(
+                    params_, opt_, u_all, i_all, key, steps)
+                float(loss)
+                times.append(time.perf_counter() - t0)
+            return min(times)
+        finally:
+            if prev is None:
+                _os.environ.pop("PIO_EMB_SHARDS", None)
+            else:
+                _os.environ["PIO_EMB_SHARDS"] = prev
+
+    dt = timed(ctx, max(ndev, 1))
+    batch = ctx.pad_to_multiple(p.batch_size)
+    eps = steps * batch / dt
+    # the exchange volume of one representative batch (the same host-side
+    # accounting train_two_tower notes into the run ledger)
+    win = min(len(ui), batch)
+    a2a = (stbl.route_stats(ui[:win], nu, max(ndev, 1),
+                            p.embed_dim)["alltoall_bytes_per_step"]
+           + stbl.route_stats(ii[:win], ni, max(ndev, 1),
+                              p.embed_dim)["alltoall_bytes_per_step"])
+    rp_u = stbl.rows_per_shard(nu, max(ndev, 1))
+    rp_i = stbl.rows_per_shard(ni, max(ndev, 1))
+    row_bytes = p.embed_dim * 4 * 3 + 4  # table + m + v + last
+    out = {
+        "bigtable_shards": ndev,
+        "bigtable_examples_per_sec_per_device": round(eps / max(ndev, 1), 1),
+        "emb_alltoall_bytes_per_step": int(a2a),
+        "bigtable_per_shard_hbm_bytes": (rp_u + rp_i) * row_bytes,
+        "bigtable_full_table_bytes": (nu + ni) * row_bytes,
+    }
+    if cfg.get("single_compare") and ndev > 1:
+        from predictionio_tpu.parallel import mesh as mesh_mod
+
+        t1 = timed(mesh_mod.data_subcontext(ctx, 1), 0)
+        single = steps * p.batch_size / t1
+        out["bigtable_single_examples_per_sec"] = round(single, 1)
+        out["bigtable_per_device_frac"] = round(
+            (eps / ndev) / max(single, 1e-9), 3)
     return out
 
 
@@ -1031,6 +1140,12 @@ def _section_synth10x(state: _BenchState) -> None:
             stats["replicated_item_bytes"])
 
 
+def _section_synth_bigtable(state: _BenchState) -> None:
+    """Row-sharded embedding tables past one HBM (docs/perf.md §19)."""
+    state.extra.update(
+        bench_synth_bigtable(state.ctx, state.cfg["synth_bigtable"]))
+
+
 def _section_two_tower(state: _BenchState) -> None:
     """Two-tower retrieval training throughput (BASELINE configs[4])."""
     state.extra.update(bench_two_tower(state.ctx, state.cfg["two_tower"]))
@@ -1055,12 +1170,14 @@ def _section_serving(state: _BenchState) -> None:
         bench_event_scan,
         bench_query_latency,
         bench_sasrec_serving,
+        bench_sharded_topk,
     )
 
     state.extra.update(bench_query_latency())
     state.extra.update(bench_event_ingest())
     state.extra.update(bench_event_scan())
     state.extra.update(bench_sasrec_serving())
+    state.extra.update(bench_sharded_topk())
 
 
 def _section_host_baseline(state: _BenchState) -> None:
@@ -1091,6 +1208,7 @@ SECTIONS: list = [
     ("mfu", _section_mfu, "mfu_bench_error"),
     ("ml20m_sharded", _section_ml20m_sharded, "sharded_bench_error"),
     ("synth10x", _section_synth10x, "synth10x_bench_error"),
+    ("synth_bigtable", _section_synth_bigtable, "bigtable_bench_error"),
     ("two_tower", _section_two_tower, "two_tower_bench_error"),
     ("sasrec", _section_sasrec, "sasrec_bench_error"),
     ("serving", _section_serving, "serving_bench_error"),
@@ -1353,7 +1471,10 @@ def _dry_run_doc() -> dict:
                   "retraces": None, "two_tower_mfu": None,
                   "sasrec_examples_per_sec": None,
                   "sharded_scaling_frac": None,
-                  "synth10x_users_iter_per_sec": None},
+                  "synth10x_users_iter_per_sec": None,
+                  "bigtable_examples_per_sec_per_device": None,
+                  "bigtable_shards": None,
+                  "emb_alltoall_bytes_per_step": None},
     }
 
 

@@ -1479,6 +1479,65 @@ def bench_sasrec_serving(n_users: int = 400, n_items: int = 200,
     return out
 
 
+def bench_sharded_topk(n_users: int = 512, n_items: int = 40_000,
+                       d: int = 64, batch: int = 64, k: int = 10,
+                       ticks: int = 60) -> dict:
+    """Sharded fused top-k serving (docs/perf.md §19): the catalog
+    row-sharded over every device, per-shard partial top-k + cross-shard
+    candidate merge through the SAME deferred ``serve_top_k_batched``
+    protocol the dense tick rides — the route catalogs bigger than one
+    HBM serve through. ``sharded_topk_parity`` is the bit-exact
+    ids+scores check against the single-device fused tick (1 = exact);
+    ``sharded_topk_p50_ms`` is the dispatch→readback tick latency."""
+    import traceback
+
+    import jax
+    from jax.sharding import Mesh
+
+    out = {"sharded_topk_p50_ms": None, "sharded_topk_parity": None,
+           "sharded_topk_shards": None}
+    prev = os.environ.get("PIO_SERVING_DEVICE")
+    os.environ["PIO_SERVING_DEVICE"] = "jax"  # pin the dense reference
+    try:
+        from predictionio_tpu.models import als
+        from predictionio_tpu.ops import topk as topk_ops
+
+        devs = jax.devices()
+        nd = len(devs)
+        mesh = Mesh(np.asarray(devs).reshape(1, nd), ("data", "model"))
+        rng = np.random.default_rng(5)
+        uf = rng.standard_normal((n_users, d)).astype(np.float32)
+        items = rng.standard_normal((n_items, d)).astype(np.float32)
+        cat = topk_ops.shard_catalog(mesh, items, axis="model")
+        uidx = rng.integers(0, n_users, batch).astype(np.int32)
+        fin = als.serve_top_k_batched(uf, cat, uidx, k)
+        if fin is None:
+            return out
+        s_sh, i_sh = fin()
+        ref_fin = als.serve_top_k_batched(uf, items, uidx, k)
+        if ref_fin is not None:
+            s_ref, i_ref = ref_fin()
+            out["sharded_topk_parity"] = int(
+                np.array_equal(i_sh, i_ref)
+                and np.array_equal(s_sh, s_ref))
+        lat = []
+        for _ in range(ticks):
+            t0 = time.perf_counter()
+            als.serve_top_k_batched(uf, cat, uidx, k)()
+            lat.append(time.perf_counter() - t0)
+        out["sharded_topk_p50_ms"] = round(
+            float(np.percentile(np.asarray(lat) * 1e3, 50)), 2)
+        out["sharded_topk_shards"] = nd
+    except Exception:  # noqa: BLE001 — headline keys are best-effort
+        traceback.print_exc()
+    finally:
+        if prev is None:
+            os.environ.pop("PIO_SERVING_DEVICE", None)
+        else:
+            os.environ["PIO_SERVING_DEVICE"] = prev
+    return out
+
+
 def _headline(results: dict, metric: str = HEADLINE_METRIC) -> dict:
     """The driver's stdout contract (same shape as bench.py): metric /
     value / unit / vs_baseline / extra, with the full section results
@@ -1545,6 +1604,12 @@ def _dry_run_doc(gateway: bool = False) -> dict:
             "sasrec_serve_p50_ms": None,
             "sasrec_serve_placement": None,
             "sasrec_readback_overlap_frac": None,
+            # sharded top-k serving (ISSUE 19): parity is 1/0 (bit-exact
+            # vs the single-device fused tick), shards an environment
+            # fact, the p50 a COST (lower-is-better)
+            "sharded_topk_p50_ms": None,
+            "sharded_topk_parity": None,
+            "sharded_topk_shards": None,
         },
         metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
 
@@ -1559,6 +1624,7 @@ def _collect(gateway: bool, replicas: int) -> dict:
     results.update(bench_event_scan())
     results.update(bench_foldin())
     results.update(bench_sasrec_serving())
+    results.update(bench_sharded_topk())
     return _headline(results)
 
 

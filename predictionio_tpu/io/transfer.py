@@ -499,6 +499,37 @@ def _row_chunks(a, chunk_bytes: int) -> list:
     return [a[i: i + per] for i in range(0, rows, per)]
 
 
+def _stage_sharded_slabs(a: np.ndarray, sharding, name: str,
+                         chunk_bytes: int) -> "jax.Array":
+    """Per-shard slab staging for a DEVICE-SHARDED target: each shard's
+    host slab packs and uploads straight to its owner device through the
+    :class:`ChunkStager` (pack of shard ``d+1`` overlaps shard ``d``'s
+    in-flight put — the ALS ``als_shard_stage`` pattern), then the
+    single-device pieces assemble into one global array. The full host
+    array is never resident on ANY device — the staging path for
+    embedding tables bigger than one HBM (docs/perf.md §19)."""
+    import jax
+
+    if a.nbytes <= chunk_bytes:  # nothing to overlap
+        return jax.device_put(a, sharding)
+    items = list(sharding.addressable_devices_indices_map(a.shape).items())
+
+    def pack(item):
+        dev, idx = item
+        return dev, np.ascontiguousarray(a[idx])
+
+    def upload(packed):
+        dev, slab = packed
+        return jax.device_put(slab, dev)
+
+    singles = [None] * len(items)
+    stager = ChunkStager(name=name)
+    for i, dev_arr in stager.stream(items, pack=pack, upload=upload):
+        singles[i] = dev_arr
+    return jax.make_array_from_single_device_arrays(
+        a.shape, sharding, singles)
+
+
 def stage_training_arrays(arrays: Sequence, sharding=None,
                           name: str = "train_inputs",
                           chunk_bytes: int | None = None) -> list:
@@ -512,7 +543,10 @@ def stage_training_arrays(arrays: Sequence, sharding=None,
     stream rides, with ``pio_transfer_*`` telemetry under ``name``.
     Arrays at or under one chunk skip the pipeline (a single put has
     nothing to overlap). Returns one device array per input, placed on
-    ``sharding`` (None = default device)."""
+    ``sharding`` (None = default device). A ``sharding`` that actually
+    splits the array (e.g. row-sharded embedding tables) takes the
+    per-shard SLAB path instead: each shard streams straight to its
+    owner device and the host array never lands whole on one device."""
     import jax
     import jax.numpy as jnp
 
@@ -525,6 +559,10 @@ def stage_training_arrays(arrays: Sequence, sharding=None,
     out = []
     for a in arrays:
         a = np.asarray(a)
+        if (sharding is not None
+                and not getattr(sharding, "is_fully_replicated", True)):
+            out.append(_stage_sharded_slabs(a, sharding, name, chunk_bytes))
+            continue
         parts = _row_chunks(a, chunk_bytes)
         if len(parts) <= 1:
             out.append(put(a))

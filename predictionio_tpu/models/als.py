@@ -1402,6 +1402,27 @@ def _serving_fused_topk(user_f, item_f, uidx, k, exclude_mask=None,
                              exclude_mask=exclude_mask)
 
 
+@device_obs.profiled_program(
+    "sharded_topk",
+    # the sharded serving hot program: one dispatch per drained tick
+    # against a mesh-sharded catalog. Expected compile axes: the pow2
+    # batch ladder, the sharded catalog shape AND its shard count (a
+    # re-shard is a new layout = a new program), k, mask branch — the
+    # retrace guard drives this ladder and pins one compile per bucket
+    # across fresh value-equal meshes.
+    bucket=lambda user_f, catalog, uidx, k, exclude_mask=None: (
+        tuple(user_f.shape), tuple(catalog.items.shape),
+        int(catalog.mesh.shape[catalog.axis]), tuple(uidx.shape), k,
+        exclude_mask is not None),
+)
+def _serving_sharded_topk(user_f, catalog, uidx, k, exclude_mask=None):
+    from predictionio_tpu.ops.topk import sharded_fused_topk
+
+    return sharded_fused_topk(user_f, catalog, uidx, k=k,
+                              chunk=CHUNKED_TOPK_CHUNK,
+                              exclude_mask=exclude_mask)
+
+
 def serving_tick_on_device(n_queries: int, n_items: int, rank: int) -> bool:
     """Cheap pre-gate for ``batch_predict_deferred`` implementations:
     would a tick of this shape route to the device? Decided WITHOUT the
@@ -1466,7 +1487,8 @@ def serve_top_k_batched(user_features, item_features, uidx, k,
     from predictionio_tpu.ops.topk import ShardedCatalog
 
     if isinstance(item_features, ShardedCatalog):
-        return None  # the catalog's mesh IS the placement — legacy route
+        return _serve_sharded_tick(user_features, item_features, uidx, k,
+                                   exclude_mask)
     if not (isinstance(user_features, np.ndarray)
             and isinstance(item_features, np.ndarray)):
         return None
@@ -1520,6 +1542,78 @@ def serve_top_k_batched(user_features, item_features, uidx, k,
     # an assertable invariant (freed in finalize's finally — failure
     # paths included, since the buffers die with the dropped resolver)
     alloc = _TICK_ARENA.register((scores, idx), label=f"b{bp}")
+
+    def finalize():
+        try:
+            s, i = resolve()
+        finally:
+            _TICK_ARENA.free(alloc)
+        return s[:b, :k], i[:b, :k]
+
+    return finalize
+
+
+def _serve_sharded_tick(user_features, catalog, uidx, k, exclude_mask=None):
+    """The sharded-catalog arm of :func:`serve_top_k_batched`: the same
+    deferred-readback tick protocol, dispatched as the fused shard_map
+    MIPS (``sharded_topk`` program). No host-vs-device placement decision
+    applies — the catalog's mesh IS the placement, and a catalog bigger
+    than one HBM has no host copy to fall back to. The host ships the
+    padded int32 row ids plus the column-sharded masks; the per-shard
+    working set is the local catalog slice + O(b · k) candidate lists."""
+    if not isinstance(user_features, np.ndarray):
+        return None
+    uidx = np.asarray(uidx, np.int32)
+    b = int(uidx.shape[0])
+    if b == 0:
+        return None
+    n_items = catalog.n
+    k = min(k, n_items)
+    if k <= 0:
+        return None  # same no-op-tick rule as the dense arm
+    mesh = catalog.mesh
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    bp = _pow2(b)
+    kp = min(_pow2(k), n_items)
+    if bp != b:
+        # padding rows repeat the last real query's row (always a valid
+        # gather index); their results are sliced off at finalize
+        uidx = np.concatenate([uidx, np.full(bp - b, uidx[-1], np.int32)])
+    padded_n = catalog.items.shape[0]
+    em = None
+    if exclude_mask is not None:
+        em = np.asarray(exclude_mask, bool)
+        if em.shape[0] == 1 and bp != 1:  # broadcast masks materialize
+            em = np.broadcast_to(em, (b, em.shape[1]))
+        if em.shape[0] != bp:  # padding rows exclude nothing
+            em = np.concatenate(
+                [em, np.zeros((bp - em.shape[0], em.shape[1]), bool)])
+        if em.shape[1] != padded_n:  # catalog pad rows are masked inside
+            em = np.concatenate(
+                [em, np.zeros((bp, padded_n - em.shape[1]), bool)], axis=1)
+        em = jax.device_put(
+            em, NamedSharding(mesh, PSpec(None, catalog.axis)))
+    # the replicated user-factor pin rides the identity cache exactly
+    # like the dense arm's HBM promotion — one put per deploy, not per
+    # tick (the NamedSharding keys the cache entry to this mesh)
+    uf = _as_device(user_features, tag="serve_sharded",
+                    device=NamedSharding(mesh, PSpec()))
+    from predictionio_tpu.resilience import faults
+
+    # same chaos site as the dense arm: an injected error here is a
+    # failed launch for the device-route breaker; corrupt-shape truncates
+    # the row ids so the finalize-failure heal path fires
+    uidx = faults.fault_point("serving.dispatch", uidx)
+    uidx_d = jax.device_put(np.asarray(uidx, np.int32),
+                            NamedSharding(mesh, PSpec()))
+    scores, idx = _serving_sharded_topk(uf, catalog, uidx_d, kp, em)
+    from predictionio_tpu.io import transfer
+
+    resolve = transfer.begin_readback((scores, idx), name="serving")
+    alloc = _TICK_ARENA.register(
+        (scores, idx),
+        label=f"b{bp}s{int(mesh.shape[catalog.axis])}")
 
     def finalize():
         try:

@@ -41,9 +41,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from jax.sharding import PartitionSpec as P
+
 from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.ops.attention import flash_attention, mha_attention
-from predictionio_tpu.parallel.mesh import ComputeContext
+from predictionio_tpu.parallel.mesh import ComputeContext, DATA_AXIS, shard_map
 
 logger = logging.getLogger(__name__)
 
@@ -387,6 +389,130 @@ def _train_epoch(
     )
 
 
+def _raw_sharded_sparse_step(params_loc, opt_loc, sb, pb, neg, key, tx_lr,
+                             *, p: SASRecParams, n_items: int,
+                             nshards: int, bl: int, cap: int):
+    """Per-shard body of one ROW-SHARDED training step (runs inside the
+    shard_map'd epoch, docs/perf.md §19): slice this shard's batch rows,
+    dedup the three gathers' ids locally, exchange them with the owner
+    shards over ONE all_to_all (ops/sharded_table routes), run the
+    transformer on the local slice, and push the touched-row gradients
+    back over the same route for the shard-local adam. The dense
+    transformer subtree stays replicated with psum'd gradients."""
+    from predictionio_tpu.ops import sharded_table as stbl
+    from predictionio_tpu.ops import sparse_update as su
+
+    table = params_loc["item_emb"][0]  # [rows_per, d] local block
+    d = table.shape[1]
+    n_rows = n_items + 1
+    off = jax.lax.axis_index(DATA_AXIS) * bl
+    sb = jax.lax.dynamic_slice_in_dim(sb, off, bl)
+    pb = jax.lax.dynamic_slice_in_dim(pb, off, bl)
+    neg = jax.lax.dynamic_slice_in_dim(neg, off, bl)
+    dense = _split_dense(params_loc)
+    ids = jnp.concatenate(
+        [sb.reshape(-1), pb.reshape(-1), neg.reshape(-1)])
+    rt = stbl.build_route(ids, n_rows=n_rows, ndev=nshards, cap=cap)
+    e = stbl.route_gather(table, rt, ndev=nshards, cap=cap)[rt.inv]
+    m = bl * sb.shape[1]
+    e_seq = e[:m].reshape(bl, -1, d)
+    e_pos = e[m:2 * m].reshape(bl, -1, d)
+    e_neg = e[2 * m:].reshape(bl, -1, d)
+
+    def loss_fn(dense, e_seq, e_pos, e_neg):
+        h = forward(dense, sb, p, dropout_key=key, x_emb=e_seq)
+        pos_logit = jnp.einsum("bld,bld->bl", h, e_pos)
+        neg_logit = jnp.einsum("bld,bld->bl", h, e_neg)
+        mask = (pb > 0).astype(jnp.float32)
+        num = -((jax.nn.log_sigmoid(pos_logit)
+                 + jax.nn.log_sigmoid(-neg_logit)) * mask).sum()
+        # local partial of the GLOBAL masked mean: the denominator is
+        # psum'd so per-shard gradients sum to the single-device ones
+        denom = jax.lax.psum(mask.sum(), DATA_AXIS)
+        return num / jnp.maximum(denom, 1.0)
+
+    loss, (g_dense, g_seq, g_pos, g_neg) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1, 2, 3))(dense, e_seq, e_pos, e_neg)
+    g_dense = jax.lax.psum(g_dense, DATA_AXIS)
+    step_no = opt_loc["step"] + 1
+    updates, dense_state = optax.adam(tx_lr).update(
+        g_dense, opt_loc["dense"], dense)
+    dense_new = optax.apply_updates(dense, updates)
+    grads = jnp.concatenate(
+        [g_seq.reshape(-1, d), g_pos.reshape(-1, d), g_neg.reshape(-1, d)])
+    g_unique = su.segment_rows(grads, rt.inv, cap)
+    st = opt_loc["item"]
+    t2, m2, v2, l2 = stbl.route_update(
+        table, st["m"][0], st["v"][0], st["last"][0], rt, g_unique,
+        step_no, tx_lr, n_rows=n_rows, ndev=nshards, cap=cap)
+    new_params = {**dense_new, "item_emb": t2[None]}
+    new_state = {"step": step_no, "dense": dense_state,
+                 "item": {"m": m2[None], "v": v2[None], "last": l2[None]}}
+    return new_params, new_state, jax.lax.psum(loss, DATA_AXIS)
+
+
+#: (mesh devices, compile-relevant statics) → compiled sharded epoch
+#: program. Module-level like the two-tower trainer cache: fresh
+#: value-equal meshes (same device ids) must reuse the executable, so a
+#: re-train dispatches with ZERO retraces (tests/test_retrace_guard.py).
+_SHARDED_EPOCH_PROGRAMS: dict = {}
+
+
+def _sharded_epoch_program(mesh, *, p: SASRecParams, steps_per_epoch: int,
+                           bs: int, n_items: int, nshards: int, cap: int):
+    """The row-sharded twin of :func:`_train_epoch`: identical on-device
+    shuffle + negative sampling (replicated RNG — the batch trajectory
+    matches the single-device path), with the per-step body swapped for
+    the all_to_all-routed sharded step."""
+    key_ = (tuple(id(d) for d in mesh.devices.flat),
+            dataclass_replace_epochs(p), steps_per_epoch, bs, n_items,
+            nshards, cap)
+    hit = _SHARDED_EPOCH_PROGRAMS.get(key_)
+    if hit is not None:
+        return hit
+    bl = bs // nshards
+
+    def epoch_local(params, opt_state, seqs, pos, key, epoch, tx_lr):
+        n = seqs.shape[0]
+        ekey = jax.random.fold_in(key, epoch)
+        order = jax.random.permutation(ekey, n).astype(jnp.int32)
+
+        def body(s, carry):
+            params, opt_state, _ = carry
+            idx = jax.lax.dynamic_slice_in_dim(order, s * bs, bs)
+            sb, pb = seqs[idx], pos[idx]
+            kneg = jax.random.fold_in(ekey, 1 + 2 * s)
+            neg = jax.random.randint(
+                kneg, (bs, p.max_len), 1, n_items + 1, dtype=jnp.int32)
+            neg = jnp.where(pb > 0, neg, 0)
+            kstep = jax.random.fold_in(ekey, 2 + 2 * s)
+            return _raw_sharded_sparse_step(
+                params, opt_state, sb, pb, neg, kstep, tx_lr,
+                p=p, n_items=n_items, nshards=nshards, bl=bl, cap=cap)
+
+        zero = jnp.zeros((), jnp.float32)
+        return jax.lax.fori_loop(
+            0, steps_per_epoch, body, (params, opt_state, zero))
+
+    emb3 = P(DATA_AXIS, None, None)
+    pspec = {"item_emb": emb3, "pos_emb": P(), "blocks": P(), "ln_f": P()}
+    sspec = {"step": P(), "dense": P(),
+             "item": {"m": emb3, "v": emb3, "last": P(DATA_AXIS, None)}}
+    fn = shard_map(epoch_local, mesh=mesh,
+                   in_specs=(pspec, sspec, P(), P(), P(), P(), P()),
+                   out_specs=(pspec, sspec, P()), check_vma=False)
+    fn = jax.jit(fn, donate_argnums=(0, 1))
+    fn = device_obs.profiled_program(
+        "sasrec_sharded_step",
+        bucket=lambda params, opt_state, seqs, *a: (
+            tuple(seqs.shape), bs, nshards, steps_per_epoch,
+            repr(dataclass_replace_epochs(p))),
+        sync=True,
+    )(fn)
+    _SHARDED_EPOCH_PROGRAMS[key_] = fn
+    return fn
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _score_last(item_emb, last, k: int, exclude_mask=None):
     """Top-k of last-hidden-state scores against the item table."""
@@ -641,8 +767,37 @@ class SASRec:
         n = len(seqs)
         if n == 0:
             raise ValueError("SASRec.train called with no sequences")
+        from predictionio_tpu.ops import sharded_table as stbl
+        from predictionio_tpu.parallel import mesh as mesh_mod
+
+        ctx = self.ctx
+        want = stbl.requested_shards()
+        if _use_sparse(p) and want >= 2 and ctx.model_axis_size == 1:
+            # PIO_EMB_SHARDS: row-shard the item table over (up to) that
+            # many data-axis devices; one sub-context for everything
+            ctx = mesh_mod.data_subcontext(ctx, want)
+        sharded = (_use_sparse(p) and want >= 2
+                   and ctx.model_axis_size == 1 and ctx.data_axis_size > 1)
+        nshards = ctx.data_axis_size if sharded else 1
+        bs = min(p.batch_size, n)
+        if sharded:
+            bs = max(bs - bs % nshards, nshards)  # local slices must tile
         params = init_params(n_items, p)
         opt_state = init_opt_state(params, p)
+        if sharded:
+            params = {
+                **{k: jax.device_put(v, ctx.replicated)
+                   for k, v in _split_dense(params).items()},
+                "item_emb": stbl.put_sharded(ctx.mesh, stbl.shard_table(
+                    np.asarray(params["item_emb"]), nshards)),
+            }
+            opt_state = {
+                "step": jax.device_put(opt_state["step"], ctx.replicated),
+                "dense": jax.device_put(opt_state["dense"], ctx.replicated),
+                "item": {kk: stbl.put_sharded(ctx.mesh, stbl.shard_table(
+                    np.asarray(vv), nshards))
+                    for kk, vv in opt_state["item"].items()},
+            }
         key = jax.random.PRNGKey(p.seed)
         start_epoch = 0
         fingerprint = ""
@@ -657,17 +812,27 @@ class SASRec:
             )
             hit = checkpointer.load_latest((params, opt_state), fingerprint)
             if hit is not None:
-                last_epoch, (params, opt_state) = hit
+                last_epoch, (h_params, h_opt) = hit
+                if sharded:
+                    # restored host leaves carry the sharded template's
+                    # [shards, rows_per, d] layout; re-pin per template
+                    h_params = jax.tree.map(
+                        lambda h, t: jax.device_put(h, t.sharding),
+                        h_params, params)
+                    h_opt = jax.tree.map(
+                        lambda h, t: jax.device_put(h, t.sharding),
+                        h_opt, opt_state)
+                params, opt_state = h_params, h_opt
                 start_epoch = last_epoch + 1
                 logger.info("SASRec: resuming after epoch %d", last_epoch)
-        bs = min(p.batch_size, n)
         steps_per_epoch = max(n // bs, 1)
         # dataset resident on device for the run, streamed up through the
         # ChunkStager (pack/upload of chunk k+1 overlaps chunk k's put)
         from predictionio_tpu.io import transfer
 
         seqs_d, pos_d = transfer.stage_training_arrays(
-            (seqs, pos), name="sasrec_inputs")
+            (seqs, pos), name="sasrec_inputs",
+            **({"sharding": ctx.replicated} if sharded else {}))
         loss = None
         # params + optimizer state under neural_params (the adam-traffic
         # figure, same as two_tower); the device-resident dataset — which
@@ -678,17 +843,46 @@ class SASRec:
             (seqs_d, pos_d), label="sasrec")
         from predictionio_tpu.obs import runlog
 
+        shard_allocs = []
+        epoch_fn = None
+        if sharded:
+            bl = bs // nshards
+            cap_env = stbl.requested_dedup_cap()
+            cap = 3 * bl * p.max_len
+            cap = min(cap_env, cap) if cap_env else cap
+            epoch_fn = _sharded_epoch_program(
+                ctx.mesh, p=p, steps_per_epoch=steps_per_epoch, bs=bs,
+                n_items=n_items, nshards=nshards, cap=cap)
+            rp = stbl.rows_per_shard(n_items + 1, nshards)
+            per_shard = rp * (p.embed_dim * 4 * 3 + 4)  # table+m+v, last
+            for d in range(nshards):
+                shard_allocs.append(
+                    device_obs.arena(f"emb_shard{d}").register(
+                        per_shard, label="sasrec"))
+            # representative routing stats over the first batch's ids
+            # (host-side: feeds pio_emb_shard_* and the doctor finding
+            # without syncing the epoch loop)
+            ids0 = np.concatenate([seqs[:bs].ravel(), pos[:bs].ravel()])
+            rs = stbl.route_stats(ids0[ids0 > 0], n_items + 1, nshards,
+                                  p.embed_dim)
+            runlog.note("emb_shard_imbalance", round(rs["imbalance"], 3))
+            runlog.note("emb_shards", nshards)
         try:
             st = runlog.StepTimer(
                 "sasrec_epoch", total=p.num_epochs, start=start_epoch,
                 phase="train", examples_per_step=steps_per_epoch * bs)
             for epoch in range(start_epoch, p.num_epochs):
-                params, opt_state, loss = _train_epoch(
-                    params, opt_state, seqs_d, pos_d, key, epoch,
-                    p.learning_rate,
-                    p=p, steps_per_epoch=steps_per_epoch, bs=bs,
-                    n_items=n_items,
-                )
+                if sharded:
+                    params, opt_state, loss = epoch_fn(
+                        params, opt_state, seqs_d, pos_d, key,
+                        jnp.int32(epoch), p.learning_rate)
+                else:
+                    params, opt_state, loss = _train_epoch(
+                        params, opt_state, seqs_d, pos_d, key, epoch,
+                        p.learning_rate,
+                        p=p, steps_per_epoch=steps_per_epoch, bs=bs,
+                        n_items=n_items,
+                    )
                 st.step(epoch + 1, sync=loss,
                         loss=(float(loss) if runlog.active() is not None
                               else None))
@@ -701,7 +895,15 @@ class SASRec:
         finally:
             device_obs.arena("neural_params").free(alloc)
             device_obs.arena("train_data").free(data_alloc)
-        return jax.tree_util.tree_map(np.asarray, params)
+            for d, a in enumerate(shard_allocs):
+                device_obs.arena(f"emb_shard{d}").free(a)
+        out = jax.tree_util.tree_map(np.asarray, params)
+        if sharded:
+            # collapse back to the flat [n_items + 1, d] layout serving
+            # and checkpoint consumers expect (pad rows drop here)
+            out["item_emb"] = stbl.unshard_table(
+                out["item_emb"], n_items + 1)
+        return out
 
 
 def _make_training_arrays(sequences: list[list[int]], max_len: int):

@@ -509,6 +509,146 @@ def make_sparse_train_step(ctx: ComputeContext, p: TwoTowerParams):
     return tx, step
 
 
+#: Host-side layout + routing facts of the most recent SHARDED two-tower
+#: train (shard count, per-shard HBM bytes, the full-table bytes no
+#: device ever holds, touched-row skew) — the acceptance pin that the
+#: embedding tables are never whole on any device, and bench.py's
+#: synth_bigtable section doc. Mirrors als_dense.last_sharded_stats.
+last_sharded_stats: dict = {}
+
+
+class _ShardedSparseTx:
+    """Optimizer-state builder for the ROW-SHARDED sparse path: the MLP
+    subtree keeps replicated optax adam, each table's (m, v, last)
+    buffers live in the ``[D, rows_per, ...]`` sharded layout next to
+    the table rows they correct (ops/sharded_table). Duck-types the
+    ``tx.init(params)`` surface like :class:`_SparseTx`."""
+
+    def __init__(self, ctx: ComputeContext, p: TwoTowerParams):
+        if p.optimizer not in ("adam", "rowwise_adam"):
+            raise ValueError(
+                f"unknown optimizer {p.optimizer!r}: expected 'adam' or "
+                "'rowwise_adam'")
+        self.ctx = ctx
+        self.p = p
+        self.rowwise = p.optimizer == "rowwise_adam"
+        self.mlp_tx = optax.adam(p.learning_rate)
+
+    mlp_of = staticmethod(_SparseTx.mlp_of)
+
+    def init(self, params: dict):
+        from predictionio_tpu.ops import sharded_table as stbl
+
+        mesh = self.ctx.mesh
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "mlp": self.mlp_tx.init(self.mlp_of(params))}
+        # commit like _SparseTx.init: uncommitted first-call operands
+        # would change the compiled argument mapping vs later calls
+        state = jax.device_put(state, self.ctx.replicated)
+        for side in ("user", "item"):
+            tbl = params[side]["embed"]  # [D, rows_per, d] sharded
+            d, rp, dim = tbl.shape
+            m = stbl.put_sharded(mesh, np.zeros((d, rp, dim), np.float32))
+            v = stbl.put_sharded(mesh, np.zeros(
+                (d, rp, 1 if self.rowwise else dim), np.float32))
+            last = stbl.put_sharded(mesh, np.zeros((d, rp), np.int32))
+            state[side] = {"m": m, "v": v, "last": last}
+        return state
+
+
+def make_sharded_sparse_train_step(ctx: ComputeContext, p: TwoTowerParams,
+                                   n_users: int, n_items: int, batch: int):
+    """The ROW-SHARDED sparse train step (docs/perf.md §19).
+
+    Embedding tables live ``[D, rows_per, d]`` over the mesh ``data``
+    axis (strided ownership — ops/sharded_table); the batch splits over
+    the same axis. Inside one shard_map program each shard dedups its
+    local ids, ONE all_to_all routes the requests to the owner shards,
+    the owners answer with embedding rows over the reverse exchange, the
+    towers + global in-batch softmax run on the local batch shard
+    (negatives still cross-device via the all_gather of item-tower
+    outputs — its autodiff transpose routes the cross-shard v-gradients
+    back), and the gradient push re-rides the id route so the PR-15
+    touched-row adam runs shard-locally. MLP gradients psum into a
+    replicated adam update. Neither the optimizer nor table residency
+    binds the step — the table can exceed one device's HBM."""
+    from predictionio_tpu.ops import sharded_table as stbl
+    from predictionio_tpu.ops import sparse_update as su
+
+    mesh = ctx.mesh
+    ndev = ctx.data_axis_size
+    bl = batch // ndev
+    cap_env = stbl.requested_dedup_cap()
+    cap = min(cap_env, bl) if cap_env else bl
+    tx = _ShardedSparseTx(ctx, p)
+    rowwise = tx.rowwise
+
+    def loss_fn(mlp, e_u, e_i):
+        u = _mlp_stack(mlp["user"], e_u)  # [bl, d]
+        v = _mlp_stack(mlp["item"], e_i)  # [bl, d]
+        v_all = jax.lax.all_gather(v, DATA_AXIS, tiled=True)  # [B, d]
+        chunk = _resolve_chunk(p, batch)
+        if chunk is not None:
+            losses = _chunked_softmax_ce(u, v, v_all, p.temperature, chunk)
+        else:
+            logits = (u @ v_all.T) / p.temperature  # [bl, B]
+            labels = (jax.lax.axis_index(DATA_AXIS) * bl
+                      + jnp.arange(bl))
+            losses = -jax.nn.log_softmax(logits, axis=-1)[
+                jnp.arange(bl), labels]
+        # local partial of the GLOBAL batch mean: gradients from every
+        # shard sum through the collective transposes, so scaling by the
+        # global batch here reproduces the single-device objective
+        return losses.sum() / batch
+
+    def step_local(params, opt_state, u_idx, i_idx):
+        t_u = params["user"]["embed"][0]  # [rows_per, d] local block
+        t_i = params["item"]["embed"][0]
+        mlp = {"user": params["user"]["layers"],
+               "item": params["item"]["layers"]}
+        rt_u = stbl.build_route(u_idx, n_rows=n_users, ndev=ndev, cap=cap)
+        rt_i = stbl.build_route(i_idx, n_rows=n_items, ndev=ndev, cap=cap)
+        e_u = stbl.route_gather(t_u, rt_u, ndev=ndev, cap=cap)[rt_u.inv]
+        e_i = stbl.route_gather(t_i, rt_i, ndev=ndev, cap=cap)[rt_i.inv]
+        loss, (g_mlp, g_eu, g_ei) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2))(mlp, e_u, e_i)
+        g_mlp = jax.lax.psum(g_mlp, DATA_AXIS)
+        step_no = opt_state["step"] + 1
+        mlp_updates, mlp_state = tx.mlp_tx.update(g_mlp, opt_state["mlp"])
+        mlp_new = optax.apply_updates(mlp, mlp_updates)
+        new_params = {}
+        new_state = {"step": step_no, "mlp": mlp_state}
+        for side, rt, g, tbl, st, nr in (
+                ("user", rt_u, g_eu, t_u, opt_state["user"], n_users),
+                ("item", rt_i, g_ei, t_i, opt_state["item"], n_items)):
+            g_unique = su.segment_rows(g, rt.inv, cap)
+            t2, m2, v2, l2 = stbl.route_update(
+                tbl, st["m"][0], st["v"][0], st["last"][0], rt, g_unique,
+                step_no, p.learning_rate, n_rows=nr, ndev=ndev, cap=cap,
+                rowwise=rowwise)
+            new_params[side] = {"embed": t2[None],
+                                "layers": mlp_new[side]}
+            new_state[side] = {"m": m2[None], "v": v2[None],
+                               "last": l2[None]}
+        return new_params, new_state, jax.lax.psum(loss, DATA_AXIS)
+
+    emb3 = P(DATA_AXIS, None, None)
+    params_spec = {"user": {"embed": emb3, "layers": P()},
+                   "item": {"embed": emb3, "layers": P()}}
+
+    def side_spec():
+        return {"m": emb3, "v": emb3, "last": P(DATA_AXIS, None)}
+
+    state_spec = {"step": P(), "mlp": P(),
+                  "user": side_spec(), "item": side_spec()}
+    raw_step = shard_map(
+        step_local, mesh=mesh,
+        in_specs=(params_spec, state_spec, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(params_spec, state_spec, P()),
+        check_vma=False)
+    return tx, raw_step
+
+
 #: (mesh devices, model-axis size, compile-relevant params, batch) →
 #: (optax transform, fused whole-run jit, per-step jit). jax.jit caches per
 #: function object, so rebuilding the closures every train_two_tower call
@@ -519,25 +659,39 @@ _TRAINER_CACHE: dict = {}
 _TRAINER_CACHE_MAX = 8
 
 
-def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
+def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int,
+                 n_users: int = 0, n_items: int = 0):
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    sparse = p.sparse_update and ctx.model_axis_size == 1
+    # the row-sharded path binds table sizes into the route programs, so
+    # it only engages when the caller supplies them (train_two_tower and
+    # bench do; legacy direct callers keep the single-device sparse path)
+    sharded = (sparse and ctx.data_axis_size > 1 and n_users > 0
+               and n_items > 0 and stbl.requested_shards() >= 2)
     # steps and seed are runtime inputs to the compiled programs, not part
     # of their shape — exclude them so e.g. a 2-step warmup compiles the
     # same programs a 10k-step run reuses
     key = (
         tuple(id(d) for d in ctx.mesh.devices.flat),
         ctx.model_axis_size, dataclasses.replace(p, steps=0, seed=0), batch,
+        (n_users, n_items, stbl.requested_dedup_cap()) if sharded else None,
     )
     hit = _TRAINER_CACHE.pop(key, None)
     if hit is not None:
         _TRAINER_CACHE[key] = hit  # LRU refresh: hot entries stay resident
         return hit
-    sparse = p.sparse_update and ctx.model_axis_size == 1
     # the FLOPs model must describe the RESOLVED path: a tensor-parallel
     # run keeps the dense optimizer even with sparse_update=True, and
     # feeding the sparse-sized model to its MFU accounting would omit
     # the dense-adam ops it actually executes
     p_flops = dataclasses.replace(p, sparse_update=sparse)
-    if sparse:
+    if sharded:
+        # row-sharded tables: id/gradient exchange via ONE all_to_all
+        # per direction, shard-local touched-row adam
+        tx, raw_step = make_sharded_sparse_train_step(
+            ctx, p, n_users, n_items, batch)
+    elif sparse:
         # sparse embedding updates: optimizer traffic O(batch) rows
         tx, raw_step = make_sparse_train_step(ctx, p)
     elif ctx.model_axis_size > 1:
@@ -592,13 +746,23 @@ def _get_trainer(ctx: ComputeContext, p: TwoTowerParams, batch: int):
     # the flops model so a 2-step warmup and a 2000-step run report the
     # same utilization series
     trainer_bucket = (batch, ctx.model_axis_size,
+                      ctx.data_axis_size if sharded else 0,
                       repr(dataclasses.replace(p, steps=0, seed=0)))
+    if sharded:
+        program = "two_tower_sharded_step"
+    else:
+        program = "two_tower_sparse_step" if sparse else "two_tower_step"
+
+    def _rows(emb):
+        # sharded tables are [shards, rows_per, d]; flat tables [n, d]
+        return emb.shape[0] * emb.shape[1] if emb.ndim == 3 else emb.shape[0]
+
     run = device_obs.profiled_program(
-        "two_tower_sparse_step" if sparse else "two_tower_step",
+        program,
         flops=lambda params, opt_state, u_all, i_all, key, steps,
         start=0: float(steps) * flops_per_step(
-            p_flops, params["user"]["embed"].shape[0],
-            params["item"]["embed"].shape[0], batch),
+            p_flops, _rows(params["user"]["embed"]),
+            _rows(params["item"]["embed"]), batch),
         # operand shapes join the bucket: one cached trainer can serve
         # datasets of different sizes (embed tables, event count), and
         # those recompiles are expected — only a same-shape re-lowering
@@ -632,11 +796,37 @@ def train_two_tower(
     sampler keys off the absolute step index)."""
     if user_idx.size == 0:
         raise ValueError("train_two_tower called with zero interactions")
+    from predictionio_tpu.ops import sharded_table as stbl
+    from predictionio_tpu.parallel import mesh as mesh_mod
+
+    want = stbl.requested_shards()
+    if p.sparse_update and ctx.model_axis_size == 1 and want >= 2:
+        # PIO_EMB_SHARDS: row-shard the embedding tables over (up to)
+        # that many data-axis devices. Resolve the sub-context ONCE here
+        # so staging, placement, and the trainer all see the same mesh.
+        ctx = mesh_mod.data_subcontext(ctx, want)
+    sharded = (p.sparse_update and ctx.model_axis_size == 1
+               and want >= 2 and ctx.data_axis_size > 1)
+    nshards = ctx.data_axis_size if sharded else 1
     # global batch must split evenly over the data axis
     batch = ctx.pad_to_multiple(min(p.batch_size, max(len(user_idx), 1)))
-    tx, run, one_step = _get_trainer(ctx, p, batch)
+    tx, run, one_step = _get_trainer(
+        ctx, p, batch, *((n_users, n_items) if sharded else ()))
     params = init_params(n_users, n_items, p)
-    if ctx.model_axis_size > 1:
+    if sharded:
+        # [n, d] host tables → [shards, rows_per, d] strided layout; the
+        # MLP stacks stay replicated (they're tiny and every shard's
+        # local batch runs the full towers)
+        params = {
+            side: {
+                "embed": stbl.put_sharded(ctx.mesh, stbl.shard_table(
+                    np.asarray(params[side]["embed"]), nshards)),
+                "layers": jax.device_put(
+                    params[side]["layers"], ctx.replicated),
+            }
+            for side in ("user", "item")
+        }
+    elif ctx.model_axis_size > 1:
         params = shard_params(ctx, params)
     else:
         params = jax.device_put(params, ctx.replicated)
@@ -658,11 +848,17 @@ def train_two_tower(
         if hit is not None:
             last, (h_params, h_opt) = hit
             start_step = last + 1
-            params = (
-                shard_params(ctx, h_params)
-                if ctx.model_axis_size > 1
-                else jax.device_put(h_params, ctx.replicated)
-            )
+            if sharded:
+                # restored host leaves already carry the checkpoint
+                # template's [shards, rows_per, d] layout — re-pin each
+                # with the template leaf's sharding
+                params = jax.tree.map(
+                    lambda h, t: jax.device_put(h, t.sharding),
+                    h_params, params)
+            elif ctx.model_axis_size > 1:
+                params = shard_params(ctx, h_params)
+            else:
+                params = jax.device_put(h_params, ctx.replicated)
             # restored host leaves stay UNcommitted (like tx.init's fresh
             # arrays): jit places them via sharding propagation, so they
             # never conflict with the replicated/sharded params
@@ -692,6 +888,42 @@ def train_two_tower(
         (u_all, i_all), label="two_tower")
     from predictionio_tpu.obs import runlog
 
+    _shard_allocs = []
+    if sharded:
+        vdim = 1 if p.optimizer == "rowwise_adam" else p.embed_dim
+        row_bytes = p.embed_dim * 4 * 2 + vdim * 4 + 4  # table+m, v, last
+        per_shard = sum(
+            rp * row_bytes
+            for rp in (stbl.rows_per_shard(n_users, nshards),
+                       stbl.rows_per_shard(n_items, nshards)))
+        for d in range(nshards):
+            _shard_allocs.append(device_obs.arena(f"emb_shard{d}").register(
+                per_shard, label="two_tower"))
+        # host-side representative routing stats over one batch of raw
+        # interactions (touched rows, skew, exchange bytes) — feeds the
+        # pio_emb_shard_* metrics and the doctor imbalance finding
+        # without syncing the device loop
+        win = min(len(user_idx), batch)
+        st_u = stbl.route_stats(user_idx[:win], n_users, nshards,
+                                p.embed_dim)
+        st_i = stbl.route_stats(item_idx[:win], n_items, nshards,
+                                p.embed_dim)
+        imb = max(st_u["imbalance"], st_i["imbalance"])
+        runlog.note("emb_shard_imbalance", round(float(imb), 3))
+        runlog.note("emb_shards", nshards)
+        last_sharded_stats.clear()
+        last_sharded_stats.update({
+            "shards": nshards,
+            "per_shard_hbm_bytes": per_shard,
+            # the single-device sparse path's table residency (table +
+            # touched-row optimizer state, same row_bytes accounting) —
+            # the working set NO device holds whole under sharding
+            "full_table_bytes": (n_users + n_items) * row_bytes,
+            "emb_shard_imbalance": float(imb),
+            "alltoall_bytes_per_step": float(
+                st_u["alltoall_bytes_per_step"]
+                + st_i["alltoall_bytes_per_step"]),
+        })
     try:
         loss = None
         if callback is None:
@@ -757,7 +989,21 @@ def train_two_tower(
     finally:
         device_obs.arena("neural_params").free(_params_alloc)
         device_obs.arena("train_data").free(_data_alloc)
+        for d, alloc in enumerate(_shard_allocs):
+            device_obs.arena(f"emb_shard{d}").free(alloc)
 
+    if sharded:
+        # collapse the [shards, rows_per, d] tables back to the flat
+        # host layout the serving corpora, fold-in, and checkpoints of
+        # the returned model expect (trailing pad rows drop here)
+        params = {
+            side: {
+                "embed": stbl.unshard_table(
+                    np.asarray(params[side]["embed"]), nr),
+                "layers": jax.tree.map(np.asarray, params[side]["layers"]),
+            }
+            for side, nr in (("user", n_users), ("item", n_items))
+        }
     # precompute BOTH serving corpora at train time: queries at serve time
     # are then pure embedding lookups + one matmul — no tower forward, no
     # host→device parameter upload on the /queries.json hot path

@@ -715,9 +715,10 @@ def diagnose_runs(directory: Path | str | None = None,
                   limit: int = 50) -> list[dict]:
     """``pio doctor`` findings from the local run ledger: a critical
     STALLED-RUN per RUNNING run whose heartbeat age exceeds its stall
-    threshold, and a SHARD-IMBALANCE warn per run whose noted sharded-ALS
-    load skew exceeds ``PIO_SHARD_IMBALANCE_WARN`` (default 2.0). Same
-    finding shape as obs.fleet.diagnose."""
+    threshold, and a SHARD-IMBALANCE (sharded ALS) or EMB-SHARD-IMBALANCE
+    (row-sharded embedding tables) warn per run whose noted load skew
+    exceeds ``PIO_SHARD_IMBALANCE_WARN`` (default 2.0). Same finding
+    shape as obs.fleet.diagnose."""
     findings: list[dict] = []
     warn_at = float(os.environ.get("PIO_SHARD_IMBALANCE_WARN", "2.0"))
     for s in list_runs(directory, limit=limit, now=now):
@@ -735,6 +736,23 @@ def diagnose_runs(directory: Path | str | None = None,
                     f"{warn_at:g}x) — every sharded-ALS collective waits "
                     "on that straggler; re-index entity ids toward a "
                     "uniform spread or change the shard count"),
+            })
+        eimb = (s.get("notes") or {}).get("emb_shard_imbalance")
+        if isinstance(eimb, (int, float)) and eimb > warn_at:
+            # row-sharded embedding trainers (PIO_EMB_SHARDS): skewed id
+            # ownership loads one shard's all_to_all segment and its
+            # touched-row adam heavier than the rest, and every exchange
+            # waits on it — surfaced from pio_emb_shard_touched_rows'
+            # per-shard counts noted at train start
+            findings.append({
+                "severity": "warn",
+                "subject": f"run {s['runId']}",
+                "detail": (
+                    f"EMB-SHARD-IMBALANCE: heaviest embedding shard owns "
+                    f"{eimb:.2f}x the mean touched rows (threshold "
+                    f"{warn_at:g}x) — the id exchange and the touched-row "
+                    "adam both wait on that shard; re-index toward a "
+                    "uniform id spread or change PIO_EMB_SHARDS"),
             })
         if not s["stalled"]:
             continue

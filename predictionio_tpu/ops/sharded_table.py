@@ -1,0 +1,458 @@
+"""Row-sharded embedding tables: all-to-all sparse updates (ROADMAP item 2).
+
+PR 15 (``ops/sparse_update.py``) made optimizer traffic O(touched rows),
+but the table itself still lived whole on one device — the user/item
+count was capped by a single HBM regardless of the traffic win. This
+module row-shards the tables across the mesh ``data`` axis per the
+Tensor Casting / TurboGR layout (PAPERS.md) and keeps the PR-15 math
+(touched-row adam/rowwise-adam with exact lazy staleness correction)
+running *shard-locally*:
+
+ownership (strided)
+    Global row ``g`` lives on device ``g % D`` at local slot ``g // D``.
+    Round-robin striding keeps naturally clustered id ranges (new users
+    get the tail ids) spread across shards; the sharded array is
+    ``[D, rows_per, d]`` with spec ``P("data", None, None)`` so each
+    device holds exactly its ``rows_per = ceil(n / D)`` rows and the
+    table is NEVER whole on any device.
+
+exchange (one all_to_all each way)
+    Each shard dedups its local batch's ids (``jnp.unique`` with a
+    static slot count), sorts the unique ids by owner (stable argsort —
+    sentinel pads sort last), and scatters them into a ``[D, cap]``
+    request table. ONE ``lax.all_to_all`` routes every shard's requests
+    to the owners; owners gather the local rows and a reverse
+    ``all_to_all`` returns them, so the forward pass sees exactly the
+    embedding rows it needs — O(unique ids · d) on the interconnect,
+    never a table's worth. The gradient push rides the identical route
+    backwards; the owner seg-sums contributions that arrive from
+    multiple shards for the same row before the one adam update.
+
+sentinels
+    The out-of-range id ``rows_per * D`` marks every pad lane (dedup
+    fill, empty request slots). Its owner-slot is ``rows_per`` — out of
+    range on every device — so gathers fill zero and scatters drop, the
+    same drop-id discipline as the single-device path.
+
+Parity: the owner-side update is literally ``sparse_update``'s
+touched-row adam over the same global unique set with the same global
+``step``/``last_step`` staleness — tests/test_sharded_table.py pins
+bit-equality against :func:`sparse_update.sparse_table_update` at 1/2/4
+simulated shards. Everything is plain jnp + XLA collectives; as with
+PR 15, no pallas kernel is warranted at these row/width scales (the
+exchange payload is thousands of rows x 64 floats, far below hand-kernel
+tile scales).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.io import transfer
+from predictionio_tpu.obs.metrics import REGISTRY
+from predictionio_tpu.ops import sparse_update as su
+from predictionio_tpu.parallel.mesh import shard_map
+
+__all__ = [
+    "requested_shards",
+    "rows_per_shard",
+    "shard_table",
+    "unshard_table",
+    "put_sharded",
+    "init_sharded_state",
+    "build_route",
+    "route_gather",
+    "route_update",
+    "sharded_gather",
+    "sharded_table_update",
+    "route_stats",
+    "alltoall_bytes_per_step",
+]
+
+#: Per-shard touched-row counts of one sharded sparse step (one observe
+#: per shard per measured batch): the skew across shards is the
+#: embedding analog of sharded-ALS cell imbalance — every all_to_all
+#: waits on the shard that owns the most touched rows.
+TOUCHED_ROWS = REGISTRY.histogram(
+    "pio_emb_shard_touched_rows",
+    "Touched (deduped) embedding rows owned per shard per measured "
+    "sharded sparse step",
+    buckets=tuple(float(2**i) for i in range(1, 24)),
+)
+
+#: Owner-side load balance of the most recent measured batch: heaviest
+#: shard's touched rows / mean. 1.0 = perfectly balanced; ``pio doctor``
+#: WARNs past PIO_SHARD_IMBALANCE_WARN (default 2.0) — see
+#: runlog.diagnose_runs' EMB-SHARD-IMBALANCE finding.
+EMB_IMBALANCE = REGISTRY.gauge(
+    "pio_emb_shard_imbalance",
+    "max/mean touched embedding rows per shard of the most recent "
+    "measured sharded sparse step (1.0 = perfectly balanced)",
+)
+
+#: Interconnect traffic of one sharded sparse step: request ids out,
+#: embedding rows back, gradient rows out — summed over shards, both
+#: all_to_all directions. The dense layout this replaces would stream
+#: whole tables instead.
+ALLTOALL_BYTES = REGISTRY.histogram(
+    "pio_emb_shard_alltoall_bytes",
+    "Bytes exchanged across the mesh per sharded sparse step (id "
+    "requests + embedding rows + gradient rows, all shards)",
+    buckets=transfer.BYTES_BUCKETS,
+)
+
+
+def requested_shards(default: int = 0) -> int:
+    """The ``PIO_EMB_SHARDS`` tuning knob: 0/1 = single-device sparse
+    path (the default — tier-1 behavior is unchanged unless a caller
+    opts in), >= 2 = row-shard embedding tables across that many mesh
+    ``data`` devices (clamped to the mesh by the trainer)."""
+    try:
+        return max(int(os.environ.get("PIO_EMB_SHARDS", str(default))), 0)
+    except ValueError:
+        return default
+
+
+def requested_dedup_cap(default: int = 0) -> int:
+    """``PIO_EMB_DEDUP_CAP``: upper bound on the per-shard unique-id
+    slots in one exchange (0 = local batch size). Each shard's all_to_all
+    request table is ``[shards, cap]`` — skewed batches with few unique
+    ids per shard can shrink ``cap`` to cut exchange traffic, at the
+    price of silently dropping updates past the cap (ids beyond it fall
+    into the sentinel slot). Traffic math: docs/perf.md §19."""
+    try:
+        return max(int(os.environ.get("PIO_EMB_DEDUP_CAP", str(default))), 0)
+    except ValueError:
+        return default
+
+
+def rows_per_shard(n_rows: int, ndev: int) -> int:
+    return -(-n_rows // ndev)
+
+
+def shard_table(table, ndev: int) -> np.ndarray:
+    """Host-side strided reshard: ``[n, ...]`` → ``[ndev, rows_per, ...]``
+    where ``out[d, s] = table[s * ndev + d]`` (zero rows pad the tail)."""
+    table = np.asarray(table)
+    n = table.shape[0]
+    rp = rows_per_shard(n, ndev)
+    if rp * ndev != n:
+        pad = np.zeros((rp * ndev - n,) + table.shape[1:], table.dtype)
+        table = np.concatenate([table, pad])
+    st = table.reshape((rp, ndev) + table.shape[1:])
+    return np.ascontiguousarray(np.swapaxes(st, 0, 1))
+
+
+def unshard_table(st, n_rows: int) -> np.ndarray:
+    """Inverse of :func:`shard_table`: ``[ndev, rows_per, ...]`` →
+    ``[n_rows, ...]`` (pad rows dropped)."""
+    st = np.asarray(st)
+    flat = np.swapaxes(st, 0, 1).reshape((-1,) + st.shape[2:])
+    return flat[:n_rows]
+
+
+def put_sharded(mesh, arr):
+    """Place a host ``[ndev, ...]`` stack with its leading axis on the
+    mesh ``data`` axis (each device holds exactly its own block). Big
+    stacks stream per-shard slabs through the transfer stager — the
+    whole table never lands on one device (io/transfer slab mode)."""
+    from predictionio_tpu.io import transfer
+
+    arr = np.asarray(arr)
+    spec = P("data", *([None] * (arr.ndim - 1)))
+    return transfer.stage_training_arrays(
+        [arr], sharding=NamedSharding(mesh, spec),
+        name="emb_shard_stage")[0]
+
+
+def init_sharded_state(table_sh, rowwise: bool = False):
+    """Fresh (m, v, last_step) in the sharded ``[D, rows_per, ...]``
+    layout — the sharded analog of ``sparse_update.init_table_state``."""
+    m = jnp.zeros_like(table_sh)
+    d, rp = table_sh.shape[0], table_sh.shape[1]
+    v = (jnp.zeros((d, rp, 1), table_sh.dtype) if rowwise
+         else jnp.zeros_like(table_sh))
+    last = jnp.zeros((d, rp), jnp.int32)
+    return m, v, last
+
+
+# ---------------------------------------------------------------------------
+# In-shard_map primitives (call these from inside a shard_map body)
+# ---------------------------------------------------------------------------
+
+
+class Route(NamedTuple):
+    """One shard's routing solution for one batch of ids: the dedup
+    (``uids``/``inv``), the owner-sorted permutation (``order`` — stable
+    argsort by owner, sentinels last; ``own_s``/``pos`` = each sorted
+    unique's owner and position within that owner's request segment),
+    and the owner-side slot table (``got_slot`` [D, cap] — local slots
+    this shard was asked for, ``rows_per`` marking pad lanes)."""
+
+    uids: jax.Array
+    inv: jax.Array
+    order: jax.Array
+    own_s: jax.Array
+    pos: jax.Array
+    got_slot: jax.Array
+
+
+def build_route(ids, *, n_rows: int, ndev: int, cap: int,
+                axis: str = "data") -> Route:
+    """Dedup one shard's local ids and run the id all_to_all.
+
+    ``ids`` [bl] global row ids (values >= ``n_rows`` are treated as
+    pads); ``cap`` is the static dedup slot count — it must be >= the
+    worst-case distinct ids per shard batch or updates are silently
+    dropped (``bl`` is always safe; see docs/perf.md §19 for the
+    cap-vs-compile-size trade)."""
+    rp = rows_per_shard(n_rows, ndev)
+    sentinel = rp * ndev  # owner 0, slot rp: out of range on every shard
+    uids, inv = jnp.unique(ids, size=cap, fill_value=sentinel,
+                           return_inverse=True)
+    # sentinel bucket ndev sorts after every real owner
+    okey = jnp.where(uids >= n_rows, ndev, uids % ndev).astype(jnp.int32)
+    order = jnp.argsort(okey, stable=True)
+    uids_s = uids[order]
+    own_s = okey[order]
+    counts = jnp.bincount(okey, length=ndev + 1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+    pos = (jnp.arange(cap, dtype=jnp.int32)
+           - starts[own_s].astype(jnp.int32))
+    req = jnp.full((ndev, cap), sentinel, uids.dtype)
+    req = req.at[own_s, pos].set(uids_s, mode="drop")
+    got = lax.all_to_all(req, axis, 0, 0)  # [ndev, cap] ids I own
+    got_slot = got // ndev  # sentinel → rp (out of range): fill/drop
+    return Route(uids, inv, order, own_s, pos, got_slot)
+
+
+def route_gather(table_loc, rt: Route, *, ndev: int, cap: int,
+                 axis: str = "data"):
+    """Owner-side row gather + reverse all_to_all: returns the unique
+    embedding rows ``[cap, d]`` in ``rt.uids`` order (pad lanes zero).
+    The per-example forward rows are ``route_gather(...)[rt.inv]``."""
+    d = table_loc.shape[-1]
+    rows = table_loc.at[rt.got_slot.reshape(-1)].get(
+        mode="fill", fill_value=0).reshape(ndev, cap, d)
+    resp = lax.all_to_all(rows, axis, 0, 0)  # [ndev, cap, d]
+    # sorted unique i sits at request slot (own_s[i], pos[i]); sentinels
+    # flatten out of range and fill zero
+    flat = rt.own_s.astype(jnp.int32) * cap + rt.pos
+    urows_s = resp.reshape(ndev * cap, d).at[flat].get(
+        mode="fill", fill_value=0)
+    return jnp.zeros((cap, d), table_loc.dtype).at[rt.order].set(urows_s)
+
+
+def route_update(table_loc, m_loc, v_loc, last_loc, rt: Route, g_unique,
+                 step, lr, *, n_rows: int, ndev: int, cap: int,
+                 rowwise: bool = False, b1: float = 0.9,
+                 b2: float = 0.999, eps: float = 1e-8,
+                 update_rows_from: int = 0, axis: str = "data"):
+    """Push per-unique gradients back over the route and run the PR-15
+    touched-row adam on the owner shard.
+
+    ``g_unique`` [cap, d] is this shard's row gradients in ``rt.uids``
+    order (``sparse_update.segment_rows(grads, rt.inv, cap)``). The
+    owner seg-sums arrivals from all shards — a row touched on several
+    shards merges into ONE adam update, exactly the single-device
+    semantics. ``update_rows_from`` freezes global rows below it (the
+    neural fold-in contract), translated owner-side from local slots."""
+    d = table_loc.shape[-1]
+    rp = table_loc.shape[0]
+    gbuf = jnp.zeros((ndev, cap, d), g_unique.dtype)
+    gbuf = gbuf.at[rt.own_s, rt.pos].set(g_unique[rt.order], mode="drop")
+    grecv = lax.all_to_all(gbuf, axis, 0, 0)  # [ndev, cap, d]
+    slots = rt.got_slot.reshape(-1)  # pads → rp
+    cap2 = min(ndev * cap, rp) + 1
+    u2, inv2 = jnp.unique(slots, size=cap2, fill_value=rp,
+                          return_inverse=True)
+    g2 = jax.ops.segment_sum(grecv.reshape(ndev * cap, d),
+                             inv2.reshape(-1), num_segments=cap2)
+    rows_m = m_loc.at[u2].get(mode="fill", fill_value=0)
+    rows_v = v_loc.at[u2].get(mode="fill", fill_value=0)
+    rows_last = last_loc.at[u2].get(mode="fill", fill_value=0)
+    stale = jnp.maximum(step - rows_last, 1)
+    fn = su.sparse_rowwise_adam_rows if rowwise else su.sparse_adam_rows
+    delta, m_new, v_new = fn(g2, rows_m, rows_v, stale, step, lr,
+                             b1, b2, eps)
+    uw = u2
+    if update_rows_from:
+        gid = u2 * ndev + lax.axis_index(axis)
+        uw = jnp.where(gid >= update_rows_from, u2, rp)
+    table_loc = table_loc.at[uw].add(delta, mode="drop")
+    m_loc = m_loc.at[uw].set(m_new, mode="drop")
+    v_loc = v_loc.at[uw].set(v_new, mode="drop")
+    last_loc = last_loc.at[uw].set(
+        jnp.full_like(rows_last, step), mode="drop")
+    return table_loc, m_loc, v_loc, last_loc
+
+
+# ---------------------------------------------------------------------------
+# Standalone compiled programs (parity surface + building blocks)
+# ---------------------------------------------------------------------------
+
+#: Compiled sharded-table programs keyed on (mesh, statics): warm
+#: re-dispatch through a FRESH value-equal mesh must reuse the compiled
+#: executable — the retrace guard's zero-retrace contract (same
+#: discipline as als_dense._SHARDED_PROGRAMS).
+_PROGRAMS: dict = {}
+
+
+def _split_batch(mesh, ids, grads=None):
+    """Host batch [b] (+ grads [b, d]) → device stacks [D, bl] (+
+    [D, bl, d]) split contiguously across shards, padded with the
+    out-of-range id so every shard gets the same lane count."""
+    ndev = mesh.shape["data"]
+    ids = np.asarray(ids)
+    b = ids.shape[0]
+    bl = rows_per_shard(b, ndev)
+    if bl * ndev != b:
+        pad = bl * ndev - b
+        ids = np.concatenate(
+            [ids, np.full((pad,), np.iinfo(np.int32).max, ids.dtype)])
+        if grads is not None:
+            grads = np.concatenate(
+                [np.asarray(grads),
+                 np.zeros((pad,) + np.shape(grads)[1:],
+                          np.asarray(grads).dtype)])
+    out = [put_sharded(mesh, ids.reshape(ndev, bl))]
+    if grads is not None:
+        out.append(put_sharded(
+            mesh, np.asarray(grads).reshape((ndev, bl) + grads.shape[1:])))
+    return out, bl
+
+
+def _gather_program(mesh, *, n_rows, dim, ndev, bl, cap, dtype):
+    key = ("gather", mesh, n_rows, dim, ndev, bl, cap, str(dtype))
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    def fn(table_l, ids_l):
+        rt = build_route(ids_l[0], n_rows=n_rows, ndev=ndev, cap=cap)
+        urows = route_gather(table_l[0], rt, ndev=ndev, cap=cap)
+        return urows[rt.inv][None]
+
+    prog = jax.jit(shard_map(
+        fn, mesh=mesh,
+        in_specs=(P("data", None, None), P("data", None)),
+        out_specs=P("data", None, None), check_vma=False))
+    _PROGRAMS[key] = prog
+    return prog
+
+
+def sharded_gather(mesh, table_sh, ids, *, n_rows: int):
+    """Forward-only embedding lookup against a sharded table: ``ids``
+    [b] host/global → rows [b, d] (gathered via the all_to_all route).
+    The standalone surface for fold-in reads and parity tests; trainers
+    fuse :func:`build_route` + :func:`route_gather` into their step."""
+    ndev = mesh.shape["data"]
+    dim = int(table_sh.shape[-1])
+    (ids_d,), bl = _split_batch(mesh, ids)
+    prog = _gather_program(mesh, n_rows=n_rows, dim=dim, ndev=ndev,
+                           bl=bl, cap=bl, dtype=table_sh.dtype)
+    out = prog(table_sh, ids_d)
+    return np.asarray(out).reshape(ndev * bl, dim)[:len(np.asarray(ids))]
+
+
+def _update_program(mesh, *, n_rows, dim, ndev, bl, cap, rowwise, urf,
+                    b1, b2, eps):
+    key = ("update", mesh, n_rows, dim, ndev, bl, cap, rowwise, urf,
+           b1, b2, eps)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    def fn(table_l, m_l, v_l, last_l, ids_l, grads_l, step, lr):
+        rt = build_route(ids_l[0], n_rows=n_rows, ndev=ndev, cap=cap)
+        g_unique = su.segment_rows(grads_l[0], rt.inv, cap)
+        t, m, v, last = route_update(
+            table_l[0], m_l[0], v_l[0], last_l[0], rt, g_unique, step,
+            lr, n_rows=n_rows, ndev=ndev, cap=cap, rowwise=rowwise,
+            b1=b1, b2=b2, eps=eps, update_rows_from=urf)
+        return t[None], m[None], v[None], last[None]
+
+    sh3 = P("data", None, None)
+    prog = jax.jit(shard_map(
+        fn, mesh=mesh,
+        in_specs=(sh3, sh3, sh3, P("data", None), P("data", None),
+                  P("data", None, None), P(), P()),
+        out_specs=(sh3, sh3, sh3, P("data", None)), check_vma=False))
+    _PROGRAMS[key] = prog
+    return prog
+
+
+def sharded_table_update(mesh, table_sh, m_sh, v_sh, last_sh, idx, grads,
+                         step, lr, *, n_rows: int, rowwise: bool = False,
+                         b1: float = 0.9, b2: float = 0.999,
+                         eps: float = 1e-8, update_rows_from: int = 0,
+                         dedup_cap: int | None = None):
+    """One sharded sparse step against host-side batch arrays — the
+    drop-in analog of ``sparse_update.sparse_table_update`` for tables
+    living in the ``[D, rows_per, ...]`` layout. The batch splits
+    contiguously across shards; the route exchanges ids, rows never
+    leave their owner except as the O(unique · d) forward/grad payload.
+    Returns the four updated sharded buffers."""
+    ndev = mesh.shape["data"]
+    dim = int(table_sh.shape[-1])
+    (ids_d, grads_d), bl = _split_batch(mesh, idx, grads)
+    cap = min(dedup_cap, bl) if dedup_cap else bl
+    prog = _update_program(
+        mesh, n_rows=n_rows, dim=dim, ndev=ndev, bl=bl, cap=cap,
+        rowwise=rowwise, urf=int(update_rows_from), b1=b1, b2=b2,
+        eps=eps)
+    return prog(table_sh, m_sh, v_sh, last_sh, ids_d, grads_d,
+                jnp.asarray(step, jnp.int32), jnp.asarray(lr, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Host-side accounting (no per-step device syncs)
+# ---------------------------------------------------------------------------
+
+
+def alltoall_bytes_per_step(unique_per_shard, dim: int,
+                            itemsize: int = 4) -> int:
+    """Analytic interconnect bytes of one sharded sparse step: per
+    shard-unique id, one id each way is requested/answered (4 B id out)
+    plus one embedding row back and one gradient row out."""
+    total_u = int(np.sum(unique_per_shard))
+    return total_u * (4 + 2 * dim * itemsize)
+
+
+def route_stats(ids, n_rows: int, ndev: int, dim: int) -> dict:
+    """Host-side routing statistics for one (representative) batch —
+    computed on the staged numpy ids so the hot step never syncs.
+    Publishes ``pio_emb_shard_touched_rows`` (per-shard owner counts),
+    ``pio_emb_shard_imbalance`` and ``pio_emb_shard_alltoall_bytes``;
+    returns the dict trainers note into the run ledger and bench.py
+    lifts into its section doc."""
+    ids = np.asarray(ids).reshape(-1)
+    ids = ids[ids < n_rows]
+    uniq = np.unique(ids)
+    per_owner = np.bincount(uniq % ndev if uniq.size else
+                            np.zeros(0, np.int64), minlength=ndev)
+    # sender-side dedup sizes drive the wire payload
+    parts = np.array_split(ids, ndev)
+    uniq_per_shard = [int(np.unique(p).size) for p in parts]
+    a2a = alltoall_bytes_per_step(uniq_per_shard, dim)
+    mean = float(per_owner.mean()) if per_owner.size else 0.0
+    imb = float(per_owner.max() / mean) if mean > 0 else 1.0
+    for c in per_owner:
+        TOUCHED_ROWS.observe(float(c))
+    EMB_IMBALANCE.set(imb)
+    ALLTOALL_BYTES.observe(float(a2a))
+    return {
+        "shards": ndev,
+        "touched_rows": int(uniq.size),
+        "touched_per_shard": [int(c) for c in per_owner],
+        "imbalance": imb,
+        "alltoall_bytes_per_step": int(a2a),
+    }

@@ -161,41 +161,50 @@ def shard_catalog(mesh, items, axis: str = "model") -> ShardedCatalog:
     return ShardedCatalog(arr, n, axis)
 
 
+def _local_topk_merge(q, it, em, *, axis: str, k: int, n: int,
+                      local_n: int, chunk: int):
+    """The shard-local score + candidate merge both sharded entry points
+    share: local top-k over this device's catalog slice, then one
+    all-gather of the tiny [B, kl] lists feeding a replicated merge."""
+    kl = min(k, local_n)
+    base = lax.axis_index(axis) * local_n
+    if local_n > chunk:
+        # catalog padding rows (global id >= n, zero vectors scoring
+        # 0) must be masked BEFORE the local top-k — re-masking after
+        # would let them displace valid negative-score candidates
+        pad = (base + jnp.arange(local_n, dtype=jnp.int32))[None, :] >= n
+        pad = jnp.broadcast_to(pad, (q.shape[0], local_n))
+        em = pad if em is None else (em | pad)
+        ls, li = chunked_topk_scores(q, it, k=kl, chunk=chunk,
+                                     exclude_mask=em)
+    else:
+        s = q @ it.T  # [B, local_n]
+        idx = base + jnp.arange(local_n, dtype=jnp.int32)[None, :]
+        valid = idx < n
+        if em is not None:
+            valid = valid & ~em
+        s = jnp.where(valid, s, -jnp.inf)
+        ls, li = lax.top_k(s, kl)
+    gi = base + li
+    # each device contributes its kl best; the merge inputs are tiny
+    # [B, kl] lists — the all-gather moves O(p*B*k), not catalog rows
+    alls = lax.all_gather(ls, axis)  # [p, B, kl]
+    alli = lax.all_gather(gi, axis)
+    b = q.shape[0]
+    cand_s = alls.transpose(1, 0, 2).reshape(b, -1)
+    cand_i = alli.transpose(1, 0, 2).reshape(b, -1)
+    ms, sel = lax.top_k(cand_s, k)
+    return ms, jnp.take_along_axis(cand_i, sel, axis=1)
+
+
 @functools.lru_cache(maxsize=64)
 def _sharded_topk_fn(mesh, axis: str, k: int, n: int, local_n: int,
                      chunk: int, has_mask: bool):
     """Compiled shard_map MIPS for one (mesh, shape, k) configuration."""
-    kl = min(k, local_n)
 
     def local_topk(q, it, em):
-        base = lax.axis_index(axis) * local_n
-        if local_n > chunk:
-            # catalog padding rows (global id >= n, zero vectors scoring
-            # 0) must be masked BEFORE the local top-k — re-masking after
-            # would let them displace valid negative-score candidates
-            pad = (base + jnp.arange(local_n, dtype=jnp.int32))[None, :] >= n
-            pad = jnp.broadcast_to(pad, (q.shape[0], local_n))
-            em = pad if em is None else (em | pad)
-            ls, li = chunked_topk_scores(q, it, k=kl, chunk=chunk,
-                                         exclude_mask=em)
-        else:
-            s = q @ it.T  # [B, local_n]
-            idx = base + jnp.arange(local_n, dtype=jnp.int32)[None, :]
-            valid = idx < n
-            if em is not None:
-                valid = valid & ~em
-            s = jnp.where(valid, s, -jnp.inf)
-            ls, li = lax.top_k(s, kl)
-        gi = base + li
-        # each device contributes its kl best; the merge inputs are tiny
-        # [B, kl] lists — the all-gather moves O(p*B*k), not catalog rows
-        alls = lax.all_gather(ls, axis)  # [p, B, kl]
-        alli = lax.all_gather(gi, axis)
-        b = q.shape[0]
-        cand_s = alls.transpose(1, 0, 2).reshape(b, -1)
-        cand_i = alli.transpose(1, 0, 2).reshape(b, -1)
-        ms, sel = lax.top_k(cand_s, k)
-        return ms, jnp.take_along_axis(cand_i, sel, axis=1)
+        return _local_topk_merge(q, it, em, axis=axis, k=k, n=n,
+                                 local_n=local_n, chunk=chunk)
 
     if has_mask:
         fn = local_topk
@@ -239,4 +248,55 @@ def sharded_topk_scores(queries, catalog: ShardedCatalog, *, k: int = 10,
             mesh, P(None, catalog.axis))))
     fn = _sharded_topk_fn(mesh, catalog.axis, k, catalog.n, local_n,
                           chunk, exclude_mask is not None)
+    return fn(*args)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_fused_topk_fn(mesh, axis: str, k: int, n: int, local_n: int,
+                           chunk: int, has_mask: bool):
+    """Compiled FUSED serving tick against a sharded catalog: the query
+    gather from the replicated user-factor matrix happens inside the
+    same shard_map as the local MIPS + merge, so one dispatch covers the
+    whole drained tick — the sharded analog of
+    models/als._serving_fused_topk."""
+
+    def fused(uf, uidx, it, em):
+        q = uf[uidx]  # [B, D] replicated gather — the host ships int32 ids
+        return _local_topk_merge(q, it, em, axis=axis, k=k, n=n,
+                                 local_n=local_n, chunk=chunk)
+
+    if has_mask:
+        fn = fused
+        in_specs = (P(), P(), P(axis, None), P(None, axis))
+    else:
+        def fn(uf, uidx, it):
+            return fused(uf, uidx, it, None)
+
+        in_specs = (P(), P(), P(axis, None))
+    return jax.jit(shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=(P(), P()),
+        check_vma=False,
+    ))
+
+
+def sharded_fused_topk(user_f, catalog: ShardedCatalog, uidx, *,
+                       k: int, chunk: int = 8192, exclude_mask=None):
+    """One fused serving tick over a mesh-sharded catalog.
+
+    ``user_f`` [n_users, D] replicated on the catalog's mesh; ``uidx``
+    [B] int32 query rows (replicated); ``exclude_mask`` [B, padded_n]
+    bool already column-sharded (or None). The caller (models/als.
+    serve_top_k_batched) owns padding, placement and the deferred
+    readback; this returns replicated (scores [B, k], indices [B, k])
+    device arrays. Per-shard HBM touched: the local catalog slice plus
+    O(B · k) candidate lists — never the whole catalog."""
+    mesh = catalog.mesh
+    p = mesh.shape[catalog.axis]
+    local_n = catalog.items.shape[0] // p
+    fn = _sharded_fused_topk_fn(mesh, catalog.axis, min(k, catalog.n),
+                                catalog.n, local_n, chunk,
+                                exclude_mask is not None)
+    args = (user_f, uidx, catalog.items)
+    if exclude_mask is not None:
+        args = args + (exclude_mask,)
     return fn(*args)

@@ -121,6 +121,19 @@ def compute_context(n_model: int = 1) -> ComputeContext:
     return ctx
 
 
+def data_subcontext(ctx: ComputeContext, n_data: int) -> ComputeContext:
+    """A ComputeContext over the first ``n_data`` data-axis rows of an
+    existing mesh, model axis kept (row-sharded embedding trainers clamp
+    ``PIO_EMB_SHARDS`` to the mesh through this). Returns ``ctx`` itself
+    when the request covers the whole axis, so identity comparisons and
+    cached shardings keep working in the common case."""
+    n_data = max(1, min(int(n_data), ctx.data_axis_size))
+    if n_data == ctx.data_axis_size:
+        return ctx
+    return ComputeContext(
+        Mesh(ctx.mesh.devices[:n_data], ctx.mesh.axis_names))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -642,6 +642,7 @@ class ALSAlgorithm(PAlgorithm):
         are exactly the host route's (parity pinned in test_query_server).
         """
         from predictionio_tpu.models.als import serving_tick_on_device
+        from predictionio_tpu.ops.topk import ShardedCatalog
 
         known = [(i, q) for i, q in queries if q.user in model.user_ids]
         if not known:
@@ -649,10 +650,12 @@ class ALSAlgorithm(PAlgorithm):
         # pre-gate BEFORE the per-query host prep: a host-routed tick
         # (PIO_SERVING_DEVICE=cpu, high-RTT link at this tick size) must
         # not pay the mask builds twice — here and again in the
-        # batch_predict fallback
-        if not serving_tick_on_device(
-                len(known), len(model.item_ids),
-                model.factors.item_features.shape[1]):
+        # batch_predict fallback. A mesh-sharded catalog skips the gate:
+        # its mesh IS the placement and there is no host copy to prefer.
+        if not isinstance(model.factors.item_features, ShardedCatalog) \
+                and not serving_tick_on_device(
+                    len(known), len(model.item_ids),
+                    model.factors.item_features.shape[1]):
             return None
         uidx = np.array([model.user_ids(q.user) for _, q in known], np.int32)
         k = min(max(q.num for _, q in known), len(model.item_ids))

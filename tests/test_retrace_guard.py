@@ -382,3 +382,133 @@ def test_sasrec_serving_ladder_under_concurrent_load():
     # buckets, x2 for the mask/no-mask program split
     assert len(rep["buckets"]) == 12
     assert rep["calls"] >= 16 + 24
+
+
+def _fresh_data_mesh(nd: int):
+    """A FRESH (value-equal, newly constructed) data-axis mesh — the
+    sharded programs key their caches on the mesh's device identity, so
+    re-dispatching through a new-but-equal Mesh object must be a cache
+    hit, never a recompile."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:nd]).reshape(nd, 1),
+        ("data", "model")))
+
+
+def test_sharded_topk_ladder_across_fresh_meshes():
+    """The sharded serving tick (ISSUE 19): one compile per (pow2 batch,
+    catalog shape, shard count, k, mask branch) bucket. A warm pass over
+    the shard-count x batch ladder pays the expected compiles; a second
+    pass dispatching through FRESH value-equal meshes and freshly built
+    ShardedCatalogs may add NO signatures and NO compiles."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.models import als
+    from predictionio_tpu.ops.topk import shard_catalog
+
+    device_obs.reset_program("sharded_topk")
+    rng = np.random.default_rng(23)
+    uf = rng.normal(size=(30, 8)).astype(np.float32)
+    items = rng.normal(size=(61, 8)).astype(np.float32)  # unique: cold
+
+    def drive(nd: int, b: int, masked: bool):
+        mesh = Mesh(np.asarray(jax.devices("cpu")[:nd]).reshape(1, nd),
+                    ("data", "model"))  # fresh mesh EVERY dispatch
+        cat = shard_catalog(mesh, items, axis="model")
+        uidx = rng.integers(0, 30, b).astype(np.int32)
+        mask = None
+        if masked:
+            mask = np.zeros((b, 61), bool)
+            mask[:, :3] = True
+        fin = als.serve_top_k_batched(uf, cat, uidx, 5, mask)
+        assert fin is not None
+        scores, idx = fin()
+        assert idx.shape == (b, 5)
+
+    ladder = [(nd, b) for nd in (2, 4) for b in (1, 2, 3, 4, 5, 8)]
+    for _ in range(2):  # second pass: all fresh meshes, zero compiles
+        for nd, b in ladder:
+            drive(nd, b, False)
+            drive(nd, b, True)
+    # padded catalog shape differs per shard count: (62, 8) at 2 shards,
+    # (64, 8) at 4 — assert the invariant over both bucket families
+    for marker, want in (("(62, 8)", 8), ("(64, 8)", 8)):
+        rep = _assert_one_compile_per_bucket("sharded_topk",
+                                             marker=marker)
+        # 6 batch sizes pad onto 4 pow2 buckets, x2 mask branch
+        assert len(rep["buckets"]) == want
+
+
+def test_two_tower_sharded_step_ladder_across_fresh_meshes(monkeypatch):
+    """The sharded two-tower train step: one compile per (batch, shard
+    count) bucket, and a retrained model on a FRESH value-equal sub-mesh
+    re-dispatches through the cached trainer — zero retraces, zero new
+    compiles across the shard-count ladder."""
+    import jax
+
+    from predictionio_tpu.io import transfer
+    from predictionio_tpu.models import two_tower as tt
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    device_obs.reset_program("two_tower_sharded_step")
+    nu, ni = 57, 83  # unique dataset shape: cold buckets
+    rng = np.random.default_rng(29)
+    u = rng.integers(0, nu, 200).astype(np.int32)
+    i = rng.integers(0, ni, 200).astype(np.int32)
+    p = tt.TwoTowerParams(embed_dim=12, hidden_dims=(16,), out_dim=8,
+                          batch_size=32, steps=0, seed=0)
+
+    def drive(nd: int):
+        monkeypatch.setenv("PIO_EMB_SHARDS", str(nd))
+        ctx = _fresh_data_mesh(nd)  # fresh mesh every call
+        batch = ctx.pad_to_multiple(p.batch_size)
+        tx, run, _one = tt._get_trainer(ctx, p, batch, nu, ni)
+        params = {
+            s: {"embed": stbl.put_sharded(
+                    ctx.mesh,
+                    stbl.shard_table(np.asarray(e["embed"]), nd)),
+                "layers": jax.device_put(e["layers"], ctx.replicated)}
+            for s, e in tt.init_params(nu, ni, p).items()}
+        opt = tx.init(params)
+        u_d, i_d = transfer.stage_training_arrays(
+            (u, i), sharding=ctx.replicated, name="ladder")
+        key = jax.random.PRNGKey(0)
+        for _ in range(3):  # dispatches 2-3 must be jit cache hits
+            params, opt, loss = run(params, opt, u_d, i_d, key, 2)
+        assert np.isfinite(float(loss))
+
+    for nd in (2, 4):  # warm pass, then fresh-mesh re-dispatch
+        drive(nd)
+        drive(nd)
+    rep = _assert_one_compile_per_bucket("two_tower_sharded_step",
+                                         marker="embed_dim=12")
+    assert len(rep["buckets"]) == 2  # one per shard count
+
+
+def test_sasrec_sharded_step_ladder_across_fresh_meshes(monkeypatch):
+    """The sharded SASRec epoch program: a full retrain on a FRESH
+    value-equal mesh reuses the cached epoch program — zero retraces,
+    one compile per shard-count bucket."""
+    from predictionio_tpu.models import sasrec as sr
+
+    device_obs.reset_program("sasrec_sharded_step")
+    rng = np.random.default_rng(31)
+    n_items = 47  # unique catalog size: cold buckets
+    seqs = [list(rng.integers(1, n_items + 1, rng.integers(3, 10)))
+            for _ in range(80)]
+    p = sr.SASRecParams(max_len=8, embed_dim=8, num_blocks=1,
+                        num_heads=2, ffn_dim=16, dropout=0.0,
+                        num_epochs=2, batch_size=16, seed=5)
+    for nd in (2, 4):
+        monkeypatch.setenv("PIO_EMB_SHARDS", str(nd))
+        for _ in range(2):  # second train: fresh mesh, zero compiles
+            m = sr.SASRec(_fresh_data_mesh(8), p).train(seqs, n_items)
+            assert np.isfinite(m["item_emb"]).all()
+    rep = _assert_one_compile_per_bucket("sasrec_sharded_step",
+                                         marker="embed_dim=8")
+    assert len(rep["buckets"]) == 2  # one per shard count

@@ -1,0 +1,452 @@
+"""Pins for the row-sharded embedding tables (PR 19).
+
+The contracts the ISSUE acceptance names, each pinned on simulated CPU
+sub-meshes of the conftest 8-device pool:
+
+* **Sparse-step parity**: ``sharded_table_update`` reproduces the PR-15
+  single-device ``sparse_table_update`` BIT-EXACTLY at 1/2/4 shards —
+  adam/rowwise-adam, lazy staleness across skipped steps, and the
+  ``update_rows_from`` freeze all included. Gradients are dyadic
+  rationals (k/256) so segment sums are order-independent, and the
+  reference is JITTED (an eager reference differs at the 1e-8 level
+  from XLA fusion, which would mask real routing bugs behind a
+  tolerance).
+* **Gather parity**: ``sharded_gather`` equals a host table lookup.
+* **Serving parity**: the sharded fused top-k tick returns exactly the
+  dense single-device tick's ids AND scores — exclusion masks and a
+  ragged final batch included — through ``serve_top_k_batched`` and
+  end-to-end through the query-server template protocol.
+* **Working set**: per-shard arena bytes stay strictly below the
+  full-table bytes the single-device sparse path would pin.
+* **Trainer parity**: the sharded two-tower step's early losses are
+  bit-identical to the single-device trainer (later steps drift at
+  adam-amplified float noise, which is expected); the sharded SASRec
+  train lands within float noise of the single-device run.
+* **Observability**: ``pio_emb_shard_*`` metrics are live and ``pio
+  doctor`` warns on noted embedding-shard imbalance.
+* **Slab staging**: ``io/transfer.stage_training_arrays`` places a
+  sharded table per-shard-slab without materializing it on one device.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+
+def _ctx(nd: int):
+    """Fresh nd-device data-axis sub-mesh of the conftest 8-CPU pool."""
+    import jax
+    from jax.sharding import Mesh
+
+    from predictionio_tpu.parallel.mesh import ComputeContext
+
+    return ComputeContext(Mesh(
+        np.array(jax.devices("cpu")[:nd]).reshape(nd, 1),
+        ("data", "model")))
+
+
+def _serving_mesh(nd: int):
+    """Serving meshes shard the catalog over the ``model`` axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices("cpu")[:nd]).reshape(1, nd),
+                ("data", "model"))
+
+
+def _dyadic(rng, shape):
+    """Dyadic-rational float32s (k/256): sums are exact in binary
+    float, so segment-sum ordering cannot explain a parity diff."""
+    return (rng.integers(-64, 65, shape) / 256.0).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Sparse-step and gather parity (op level, bit-exact)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("nd", [1, 2, 4])
+@pytest.mark.parametrize("rowwise", [False, True])
+def test_sharded_update_parity_bit_exact(nd, rowwise):
+    """1/2/4-shard sparse steps vs the jitted PR-15 reference across a
+    step sequence with a gap (3 -> 7) so the lazy-staleness bias
+    correction runs on stale>1 rows.
+
+    The FIRST step must be BIT-EXACT in all four buffers — with fresh
+    (zero) m/v the adam FMA fusion cannot differ between the two
+    programs, so any routing, dedup, segment-sum or scatter bug shows
+    as a hard mismatch. From step 2 on, nonzero m/v let XLA's per-
+    program FMA contraction produce 1-ulp diffs (measured 3e-8 even on
+    a ONE-shard mesh, i.e. with zero cross-device traffic), so the rest
+    of the trajectory pins to a few-ulp band plus exact agreement on
+    the integer last_step buffer and on never-touched rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import sharded_table as stbl
+    from predictionio_tpu.ops import sparse_update as su
+
+    n, d, b = 103, 8, 64
+    rng = np.random.default_rng(100 * nd + rowwise)
+    table = _dyadic(rng, (n, d))
+    lr = jnp.float32(0.125)
+
+    ref = jax.jit(functools.partial(su.sparse_table_update,
+                                    rowwise=rowwise))
+    t_r = jnp.asarray(table)
+    m_r, v_r, l_r = su.init_table_state(t_r, rowwise)
+
+    mesh = _ctx(nd).mesh
+    t_s = stbl.put_sharded(mesh, stbl.shard_table(table, nd))
+    m_s, v_s, l_s = stbl.init_sharded_state(t_s, rowwise)
+
+    touched = np.zeros(n, bool)
+    for step in (1, 2, 3, 7, 8):  # the 3 -> 7 gap = skipped steps
+        idx = rng.integers(0, n, b).astype(np.int32)
+        touched[idx] = True
+        g = _dyadic(rng, (b, d))
+        t_r, m_r, v_r, l_r = ref(t_r, m_r, v_r, l_r, idx, g,
+                                 jnp.int32(step), lr)
+        t_s, m_s, v_s, l_s = stbl.sharded_table_update(
+            mesh, t_s, m_s, v_s, l_s, idx, g, step, lr,
+            n_rows=n, rowwise=rowwise)
+        if step == 1:  # zero m/v: no fusion freedom — exact or bust
+            for got_sh, want in ((t_s, t_r), (m_s, m_r), (v_s, v_r)):
+                got = stbl.unshard_table(np.asarray(got_sh), n)
+                assert np.array_equal(got, np.asarray(want))
+
+    for got_sh, want, tol in ((t_s, t_r, 5e-7), (m_s, m_r, 5e-7),
+                              (v_s, v_r, 5e-9)):
+        got = stbl.unshard_table(np.asarray(got_sh), n)
+        want = np.asarray(want)
+        np.testing.assert_allclose(got, want, rtol=0, atol=tol)
+        # rows the batches never hit were never written on either side
+        assert np.array_equal(got[~touched], want[~touched])
+    assert np.array_equal(stbl.unshard_table(np.asarray(l_s), n),
+                          np.asarray(l_r))
+
+
+def test_sharded_update_respects_update_rows_from():
+    """The fold-in freeze contract survives sharding: rows below
+    ``update_rows_from`` are read but never written, and the writable
+    tail stays bit-equal to the jitted reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops import sharded_table as stbl
+    from predictionio_tpu.ops import sparse_update as su
+
+    n, d, b, urf = 90, 8, 32, 40
+    rng = np.random.default_rng(7)
+    table = _dyadic(rng, (n, d))
+    idx = rng.integers(0, n, b).astype(np.int32)
+    g = _dyadic(rng, (b, d))
+    lr = jnp.float32(0.25)
+
+    ref = jax.jit(functools.partial(su.sparse_table_update,
+                                    update_rows_from=urf))
+    t_r = jnp.asarray(table)
+    st_r = su.init_table_state(t_r, False)
+    t_r, m_r, _, _ = ref(t_r, *st_r, idx, g, jnp.int32(1), lr)
+
+    mesh = _ctx(4).mesh
+    t_s = stbl.put_sharded(mesh, stbl.shard_table(table, 4))
+    m_s, v_s, l_s = stbl.init_sharded_state(t_s)
+    t_s, m_s, _, _ = stbl.sharded_table_update(
+        mesh, t_s, m_s, v_s, l_s, idx, g, 1, lr,
+        n_rows=n, update_rows_from=urf)
+
+    got = stbl.unshard_table(np.asarray(t_s), n)
+    assert np.array_equal(got[:urf], table[:urf])  # frozen rows
+    assert np.array_equal(got, np.asarray(t_r))
+    assert np.array_equal(stbl.unshard_table(np.asarray(m_s), n),
+                          np.asarray(m_r))
+
+
+@pytest.mark.parametrize("nd", [1, 2, 4])
+def test_sharded_gather_parity(nd):
+    """Forward rows through the all_to_all route equal a host lookup
+    (repeat ids included — the dedup must fan the row back out)."""
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    n, d = 97, 8
+    rng = np.random.default_rng(nd)
+    table = rng.normal(size=(n, d)).astype(np.float32)
+    ids = rng.integers(0, n, 40).astype(np.int32)
+    ids[5] = ids[11]  # force a duplicate across the batch
+
+    mesh = _ctx(nd).mesh
+    t_s = stbl.put_sharded(mesh, stbl.shard_table(table, nd))
+    got = stbl.sharded_gather(mesh, t_s, ids, n_rows=n)
+    assert np.array_equal(got, table[ids])
+
+
+# ---------------------------------------------------------------------------
+# Sharded serving parity (fused tick + query-server e2e)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_topk_parity_masks_and_ragged(monkeypatch):
+    """The sharded fused tick returns EXACTLY the dense single-device
+    tick's ids and scores — with per-row exclusion masks and a ragged
+    b=13 batch that pads onto the pow2 ladder."""
+    import jax  # noqa: F401 — device pool must exist before meshes
+
+    from predictionio_tpu.models import als
+    from predictionio_tpu.ops import topk as topk_ops
+
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "jax")
+    rng = np.random.default_rng(3)
+    n_users, n_items, d, k = 40, 57, 8, 5
+    uf = rng.normal(size=(n_users, d)).astype(np.float32)
+    items = rng.normal(size=(n_items, d)).astype(np.float32)
+    uidx = rng.integers(0, n_users, 13).astype(np.int32)  # ragged
+    mask = rng.random((13, n_items)) < 0.2
+
+    cat = topk_ops.shard_catalog(_serving_mesh(4), items, axis="model")
+    for em in (None, mask):
+        fin_s = als.serve_top_k_batched(uf, cat, uidx, k, em)
+        fin_d = als.serve_top_k_batched(uf, items, uidx, k, em)
+        assert fin_s is not None and fin_d is not None
+        s_sh, i_sh = fin_s()
+        s_dn, i_dn = fin_d()
+        assert np.array_equal(i_sh, i_dn)
+        assert np.array_equal(s_sh, s_dn)
+        if em is not None:
+            assert not mask[np.arange(13)[:, None], i_sh].any()
+
+
+def test_query_server_e2e_sharded_catalog(monkeypatch):
+    """Template protocol end to end: a model whose item factors live as
+    a mesh-sharded catalog answers ``batch_predict_deferred`` exactly
+    like the dense host route — blacklists, an unknown user, and mixed
+    per-query k included."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSFactors
+    from predictionio_tpu.ops.topk import shard_catalog
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        AlgorithmParams,
+        ALSModel,
+        Query,
+    )
+
+    rng = np.random.default_rng(11)
+    n_users, n_items, rank = 20, 51, 8
+    uf = rng.normal(size=(n_users, rank)).astype(np.float32)
+    itf = rng.normal(size=(n_items, rank)).astype(np.float32)
+    users = BiMap.string_int(f"u{i}" for i in range(n_users))
+    items = BiMap.string_int(f"i{i}" for i in range(n_items))
+    cat = shard_catalog(_serving_mesh(4), itf, axis="model")
+    model_sh = ALSModel(ALSFactors(uf, cat), users, items, {})
+    model_dn = ALSModel(ALSFactors(uf, itf), users, items, {})
+    algo = ALSAlgorithm(AlgorithmParams())
+    queries = [
+        (0, Query(user="u1", num=5)),
+        (1, Query(user="u3", num=3, blackList=("i0", "i7", "i9"))),
+        (2, Query(user="nobody", num=4)),          # unknown user
+        (3, Query(user="u5", num=6)),
+        (4, Query(user="u1", num=2, blackList=("i4",))),
+    ]
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "jax")
+    resolve = algo.batch_predict_deferred(model_sh, queries)
+    assert resolve is not None  # sharded catalog: no host fallback
+    device = dict(resolve())
+    monkeypatch.setenv("PIO_SERVING_DEVICE", "cpu")
+    host = dict(algo.batch_predict(model_dn, queries))
+    assert device.keys() == host.keys()
+    for i in device:
+        assert [s.item for s in device[i].itemScores] == \
+            [s.item for s in host[i].itemScores]
+        assert [s.score for s in device[i].itemScores] == \
+            [s.score for s in host[i].itemScores]
+    assert device[2].itemScores == ()
+    assert all(s.item not in ("i0", "i7", "i9")
+               for s in device[1].itemScores)
+
+
+# ---------------------------------------------------------------------------
+# Sharded trainers (two-tower and SASRec)
+# ---------------------------------------------------------------------------
+
+
+def _events(n_users=300, n_items=500, n_ev=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_users, n_ev).astype(np.int32),
+            rng.integers(0, n_items, n_ev).astype(np.int32),
+            n_users, n_items)
+
+
+def test_two_tower_sharded_loss_trajectory(monkeypatch):
+    """The sharded step IS the single-device step: the first two losses
+    are bit-identical (routing, labels and gradients all agree before
+    adam's 1/sqrt(v) starts amplifying reduction-order noise), and the
+    5-step trajectory stays within that amplified-noise band."""
+    import jax
+
+    from predictionio_tpu.io import transfer
+    from predictionio_tpu.models import two_tower as tt
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    u, i, nu, ni = _events()
+    p = tt.TwoTowerParams(embed_dim=16, hidden_dims=(32,), out_dim=8,
+                          batch_size=256, steps=5, seed=3)
+
+    def run_losses(nd):
+        if nd > 1:
+            monkeypatch.setenv("PIO_EMB_SHARDS", str(nd))
+        else:
+            monkeypatch.delenv("PIO_EMB_SHARDS", raising=False)
+        ctx = _ctx(nd)
+        batch = ctx.pad_to_multiple(min(p.batch_size, len(u)))
+        tx, _run, one_step = tt._get_trainer(
+            ctx, p, batch, *((nu, ni) if nd > 1 else ()))
+        params = tt.init_params(nu, ni, p)
+        if nd > 1:
+            params = {
+                s: {"embed": stbl.put_sharded(
+                        ctx.mesh,
+                        stbl.shard_table(np.asarray(params[s]["embed"]),
+                                         nd)),
+                    "layers": jax.device_put(params[s]["layers"],
+                                             ctx.replicated)}
+                for s in ("user", "item")}
+        else:
+            params = jax.device_put(params, ctx.replicated)
+        opt = tx.init(params)
+        u_d, i_d = transfer.stage_training_arrays(
+            (u, i), sharding=ctx.replicated, name="traj")
+        key = jax.random.PRNGKey(p.seed)
+        out = []
+        for s in range(5):
+            params, opt, loss = one_step(params, opt, u_d, i_d, key, s)
+            out.append(float(loss))
+        return out
+
+    ref = run_losses(1)
+    for nd in (2, 4):
+        got = run_losses(nd)
+        assert got[0] == ref[0] and got[1] == ref[1], (nd, ref, got)
+        assert max(abs(a - b) for a, b in zip(ref, got)) < 5e-3
+
+
+def test_two_tower_sharded_train_working_set_and_metrics(monkeypatch):
+    """Full sharded train: per-shard arena bytes stay strictly below the
+    full-table bytes the single-device sparse path pins, the exported
+    model matches the single-device shape contract, and the
+    ``pio_emb_shard_*`` series carry real values afterwards."""
+    from predictionio_tpu.models import two_tower as tt
+    from predictionio_tpu.obs import REGISTRY
+
+    u, i, nu, ni = _events(seed=1)
+    p = tt.TwoTowerParams(embed_dim=16, hidden_dims=(32,), out_dim=8,
+                          batch_size=256, steps=10, seed=3)
+    monkeypatch.setenv("PIO_EMB_SHARDS", "2")
+    m = tt.train_two_tower(_ctx(8), u, i, nu, ni, p)
+    stats = tt.last_sharded_stats
+    assert stats["shards"] == 2
+    assert 0 < stats["per_shard_hbm_bytes"] < stats["full_table_bytes"]
+    assert stats["emb_shard_imbalance"] >= 1.0
+    assert stats["alltoall_bytes_per_step"] > 0
+    assert m.item_embeddings.shape == (ni, p.out_dim)
+    assert np.isfinite(m.item_embeddings).all()
+    text = REGISTRY.expose()
+    assert "pio_emb_shard_touched_rows" in text
+    assert "pio_emb_shard_imbalance" in text
+    assert "pio_emb_shard_alltoall_bytes" in text
+
+
+def test_sasrec_sharded_train_parity(monkeypatch):
+    """The sharded SASRec epoch program reproduces the single-device
+    train within float noise — same shuffle/negative-sampling RNG, same
+    trajectory — and the padding row keeps its never-updated contract
+    (zero summed gradient => byte-identical to the reference's)."""
+    from predictionio_tpu.models import sasrec as sr
+
+    rng = np.random.default_rng(1)
+    n_items = 200
+    seqs = [list(rng.integers(1, n_items + 1, rng.integers(3, 30)))
+            for _ in range(300)]
+    p = sr.SASRecParams(max_len=20, embed_dim=16, num_blocks=1,
+                        num_heads=2, ffn_dim=32, dropout=0.0,
+                        num_epochs=2, batch_size=64, seed=7)
+    monkeypatch.delenv("PIO_EMB_SHARDS", raising=False)
+    ref = sr.SASRec(_ctx(1), p).train(seqs, n_items)
+    for nd in (2, 4):
+        monkeypatch.setenv("PIO_EMB_SHARDS", str(nd))
+        m = sr.SASRec(_ctx(8), p).train(seqs, n_items)
+        assert m["item_emb"].shape == ref["item_emb"].shape
+        d = np.abs(m["item_emb"] - ref["item_emb"]).max()
+        assert np.isfinite(m["item_emb"]).all()
+        assert d < 5e-3, (nd, d)
+        assert np.array_equal(m["item_emb"][0], ref["item_emb"][0])
+
+
+# ---------------------------------------------------------------------------
+# Observability and staging
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_warns_on_emb_shard_imbalance(tmp_path):
+    """runlog note -> ``pio doctor`` finding: a run whose noted
+    emb_shard_imbalance exceeds PIO_SHARD_IMBALANCE_WARN (default 2.0)
+    yields a warn-severity EMB-SHARD-IMBALANCE finding; a balanced run
+    yields none."""
+    from predictionio_tpu.obs import runlog
+
+    skewed = tmp_path / "skewed"
+    with runlog.run_scope(run_id="eskew", directory=skewed):
+        runlog.note("emb_shard_imbalance", 3.5)
+    findings = runlog.diagnose_runs(skewed)
+    hits = [f for f in findings if "EMB-SHARD-IMBALANCE" in f["detail"]]
+    assert hits and hits[0]["severity"] == "warn"
+    assert "3.5" in hits[0]["detail"]
+
+    balanced = tmp_path / "flat"
+    with runlog.run_scope(run_id="eflat", directory=balanced):
+        runlog.note("emb_shard_imbalance", 1.3)
+    assert not [f for f in runlog.diagnose_runs(balanced)
+                if "EMB-SHARD-IMBALANCE" in f["detail"]]
+
+
+def test_route_stats_accounting():
+    """Host-side accounting: touched rows, imbalance and the exchange
+    traffic model (ids down + rows forward + grads back per unique)."""
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    ids = np.array([0, 1, 2, 3, 4, 5, 6, 8, 10, 12], np.int64)
+    stats = stbl.route_stats(ids, n_rows=16, ndev=2, dim=4)
+    assert stats["shards"] == 2
+    # owners: id % 2 — 7 even ids land on shard 0, 3 odd on shard 1
+    assert sorted(stats["touched_per_shard"]) == [3, 7]
+    assert stats["imbalance"] == pytest.approx(7 / 5)
+    assert stats["alltoall_bytes_per_step"] == \
+        stbl.alltoall_bytes_per_step([7, 3], 4)
+    assert stats["alltoall_bytes_per_step"] == 10 * (4 + 2 * 4 * 4)
+
+
+def test_sharded_slab_staging_round_trip():
+    """Forced slab mode (tiny chunk budget): the staged sharded table is
+    byte-identical per shard, carries the requested sharding, and
+    round-trips through unshard; ``put_sharded`` agrees."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.io import transfer
+    from predictionio_tpu.ops import sharded_table as stbl
+
+    mesh = _ctx(4).mesh
+    t = np.random.default_rng(0).normal(size=(1000, 32)).astype(
+        np.float32)
+    st = stbl.shard_table(t, 4)
+    d = transfer.stage_training_arrays(
+        [st], sharding=NamedSharding(mesh, P("data", None, None)),
+        name="slab_pin", chunk_bytes=1024)[0]
+    assert isinstance(d, jax.Array) and d.shape == st.shape
+    assert str(d.sharding.spec) == str(P("data", None, None))
+    np.testing.assert_array_equal(np.asarray(d), st)
+    np.testing.assert_array_equal(stbl.unshard_table(np.asarray(d),
+                                                     1000), t)
+    np.testing.assert_array_equal(np.asarray(stbl.put_sharded(mesh, st)),
+                                  st)
