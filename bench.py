@@ -661,6 +661,17 @@ def bench_synth_bigtable(ctx, cfg: dict) -> dict:
         "bigtable_per_shard_hbm_bytes": (rp_u + rp_i) * row_bytes,
         "bigtable_full_table_bytes": (nu + ni) * row_bytes,
     }
+    if ndev > 1:
+        from predictionio_tpu.obs import shards as shard_obs
+
+        # exchange fraction over the bench's own measured step time: the
+        # per-step byte model the obs/shards.py ledger captured while the
+        # sharded step traced, priced at the PIO_SHARD_LINK_GBPS link
+        snap = shard_obs.OBSERVATORY.snapshot("two_tower_sharded_step")
+        if snap and snap.get("bytesPerStep"):
+            ex_s = (snap["bytesPerStep"] * steps
+                    / (shard_obs.link_gbps() * 1e9))
+            out["bigtable_exchange_frac"] = round(min(ex_s / dt, 1.0), 4)
     if cfg.get("single_compare") and ndev > 1:
         from predictionio_tpu.parallel import mesh as mesh_mod
 
@@ -1096,6 +1107,8 @@ def _section_ml20m_sharded(state: _BenchState) -> None:
         print("[bench] ml20m_sharded section skipped: one-device mesh",
               file=_sys.stderr)
         return
+    from predictionio_tpu.obs import shards as shard_obs
+
     ui, ii, r, nu, ni = state.ml20m()
     cfg = state.cfg["sharded"]
     one = ComputeContext(Mesh(
@@ -1103,8 +1116,10 @@ def _section_ml20m_sharded(state: _BenchState) -> None:
         state.ctx.mesh.axis_names))
     base_ips, _ = bench_als(one, ui, ii, r, nu, ni, rank=10,
                             iters=cfg["iters"], repeats=cfg["repeats"])
+    ev0 = shard_obs.OBSERVATORY.dispatch_events
     ips, _ = bench_als(state.ctx, ui, ii, r, nu, ni, rank=10,
                        iters=cfg["iters"], repeats=cfg["repeats"])
+    ev_delta = shard_obs.OBSERVATORY.dispatch_events - ev0
     stats = als_dense.last_sharded_stats or {}
     state.extra["sharded_shards"] = ndev
     state.extra["sharded_iter_per_sec"] = round(ips, 3)
@@ -1115,6 +1130,23 @@ def _section_ml20m_sharded(state: _BenchState) -> None:
             stats["gather_bytes_per_iter"])
         state.extra["sharded_imbalance"] = round(
             float(stats["imbalance"]), 3)
+        if stats.get("exchange_frac") is not None:
+            # the obs/shards.py ledger's live reading for this program —
+            # the ALX scaling limiter next to the scaling fraction it caps
+            state.extra["sharded_exchange_frac"] = float(
+                stats["exchange_frac"])
+        if stats.get("collective_bytes_per_iter") is not None:
+            state.extra["sharded_iter_collective_bytes"] = int(
+                stats["collective_bytes_per_iter"])
+    state.extra["sharded_link_gbps"] = shard_obs.link_gbps()
+    # observability census guard (the _log_overhead pattern): dispatch
+    # listener invocations that hit a registered ledger × the measured
+    # unit cost of one pass, over the sharded solve time — the shard
+    # observatory must cost ≤ 1% of the step it observes
+    solve_s = cfg["iters"] * cfg["repeats"] / max(ips, 1e-9)
+    state.extra["shard_obs_overhead_frac"] = round(
+        ev_delta * shard_obs.OBSERVATORY.listener_cost_s()
+        / max(solve_s, 1e-9), 6)
 
 
 def _section_synth10x(state: _BenchState) -> None:
@@ -1471,9 +1503,14 @@ def _dry_run_doc() -> dict:
                   "retraces": None, "two_tower_mfu": None,
                   "sasrec_examples_per_sec": None,
                   "sharded_scaling_frac": None,
+                  "sharded_exchange_frac": None,
+                  "sharded_iter_collective_bytes": None,
+                  "sharded_link_gbps": None,
+                  "shard_obs_overhead_frac": None,
                   "synth10x_users_iter_per_sec": None,
                   "bigtable_examples_per_sec_per_device": None,
                   "bigtable_shards": None,
+                  "bigtable_exchange_frac": None,
                   "emb_alltoall_bytes_per_step": None},
     }
 

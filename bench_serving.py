@@ -1495,7 +1495,7 @@ def bench_sharded_topk(n_users: int = 512, n_items: int = 40_000,
     from jax.sharding import Mesh
 
     out = {"sharded_topk_p50_ms": None, "sharded_topk_parity": None,
-           "sharded_topk_shards": None}
+           "sharded_topk_shards": None, "sharded_topk_exchange_frac": None}
     prev = os.environ.get("PIO_SERVING_DEVICE")
     os.environ["PIO_SERVING_DEVICE"] = "jax"  # pin the dense reference
     try:
@@ -1528,6 +1528,13 @@ def bench_sharded_topk(n_users: int = 512, n_items: int = 40_000,
         out["sharded_topk_p50_ms"] = round(
             float(np.percentile(np.asarray(lat) * 1e3, 50)), 2)
         out["sharded_topk_shards"] = nd
+        # the shard observatory's live reading for the serving merge:
+        # candidate all_gather seconds over the fused tick's dispatch time
+        from predictionio_tpu.obs import shards as shard_obs
+
+        ex = shard_obs.OBSERVATORY.exchange_frac("sharded_topk")
+        if ex is not None:
+            out["sharded_topk_exchange_frac"] = round(ex, 4)
     except Exception:  # noqa: BLE001 — headline keys are best-effort
         traceback.print_exc()
     finally:
@@ -1610,6 +1617,9 @@ def _dry_run_doc(gateway: bool = False) -> dict:
             "sharded_topk_p50_ms": None,
             "sharded_topk_parity": None,
             "sharded_topk_shards": None,
+            # shard & collective observatory (ISSUE 20): the exchange
+            # fraction is a COST (lower-is-better under bench-compare)
+            "sharded_topk_exchange_frac": None,
         },
         metric=GATEWAY_HEADLINE_METRIC if gateway else HEADLINE_METRIC)
 

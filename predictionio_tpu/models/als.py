@@ -1416,8 +1416,14 @@ def _serving_fused_topk(user_f, item_f, uidx, k, exclude_mask=None,
         exclude_mask is not None),
 )
 def _serving_sharded_topk(user_f, catalog, uidx, k, exclude_mask=None):
+    from predictionio_tpu.obs import shards as shard_obs
     from predictionio_tpu.ops.topk import sharded_fused_topk
 
+    # shard observatory: one serving tick = one dispatch; the candidate
+    # all-gather's trace-time bytes replay per tick (obs/shards.py)
+    shard_obs.OBSERVATORY.program_meta(
+        "sharded_topk", shards=int(catalog.mesh.shape[catalog.axis]),
+        steps_per_dispatch=1)
     return sharded_fused_topk(user_f, catalog, uidx, k=k,
                               chunk=CHUNKED_TOPK_CHUNK,
                               exclude_mask=exclude_mask)

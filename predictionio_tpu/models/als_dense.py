@@ -1964,6 +1964,19 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
 
     per_iter = (resume is not None or callback is not None
                 or ck is not None or runlog.want_steps())
+    # shard observatory (obs/shards.py): per-shard cell loads + the
+    # dispatch metadata the byte replay scales by (a fused run is ONE
+    # dispatch executing num_iterations loop steps)
+    from predictionio_tpu.obs import shards as shard_obs
+
+    spmd_name = f"als_dense_spmd_rank{rank}"
+    shard_obs.OBSERVATORY.program_meta(
+        spmd_name, shards=ndev, arena_prefix="als_shard",
+        steps_per_dispatch=(1 if per_iter
+                            else max(int(p.num_iterations) - start_iter,
+                                     1)))
+    shard_obs.OBSERVATORY.record_shard_load(
+        spmd_name, [int(c) for c in plan.counts], kind="rating cells")
     t0 = time.perf_counter()
     try:
         if not per_iter:
@@ -1998,6 +2011,14 @@ def train_dense_sharded(ctx, params, ui, ii, ratings, n_users, n_items,
     if not per_iter:
         runlog.fused_steps("als_dense_spmd", p.num_iterations,
                            phases["solve_s"], synced=True)
+    ex_frac = shard_obs.OBSERVATORY.exchange_frac(spmd_name)
+    if ex_frac is not None:
+        runlog.note("exchange_frac", round(ex_frac, 4))
+        last_sharded_stats["exchange_frac"] = round(ex_frac, 4)
+    snap = shard_obs.OBSERVATORY.snapshot(spmd_name)
+    if snap is not None:
+        last_sharded_stats["collective_bytes_per_iter"] = snap[
+            "bytesPerStep"]
     global last_train_phases
     last_train_phases = phases
     return (_fetch_rows(uf, n_users, plan.ub, ndev),

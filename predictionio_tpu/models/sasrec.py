@@ -867,6 +867,17 @@ class SASRec:
                                   p.embed_dim)
             runlog.note("emb_shard_imbalance", round(rs["imbalance"], 3))
             runlog.note("emb_shards", nshards)
+            # shard observatory (obs/shards.py): one dispatch per epoch
+            # executes steps_per_epoch sharded steps
+            from predictionio_tpu.obs import shards as shard_obs
+
+            shard_obs.OBSERVATORY.program_meta(
+                "sasrec_sharded_step", shards=nshards,
+                arena_prefix="emb_shard",
+                steps_per_dispatch=steps_per_epoch)
+            shard_obs.OBSERVATORY.record_shard_load(
+                "sasrec_sharded_step", rs["touched_per_shard"],
+                kind="touched rows")
         try:
             st = runlog.StepTimer(
                 "sasrec_epoch", total=p.num_epochs, start=start_epoch,
@@ -899,6 +910,12 @@ class SASRec:
                 device_obs.arena(f"emb_shard{d}").free(a)
         out = jax.tree_util.tree_map(np.asarray, params)
         if sharded:
+            from predictionio_tpu.obs import shards as shard_obs
+
+            ex_frac = shard_obs.OBSERVATORY.exchange_frac(
+                "sasrec_sharded_step")
+            if ex_frac is not None:
+                runlog.note("exchange_frac", round(ex_frac, 4))
             # collapse back to the flat [n_items + 1, d] layout serving
             # and checkpoint consumers expect (pad rows drop here)
             out["item_emb"] = stbl.unshard_table(

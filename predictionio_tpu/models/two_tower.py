@@ -911,6 +911,18 @@ def train_two_tower(
         imb = max(st_u["imbalance"], st_i["imbalance"])
         runlog.note("emb_shard_imbalance", round(float(imb), 3))
         runlog.note("emb_shards", nshards)
+        # shard observatory (obs/shards.py): per-shard touched-row
+        # loads (user + item ownership of the representative batch)
+        from predictionio_tpu.obs import shards as shard_obs
+
+        shard_obs.OBSERVATORY.program_meta(
+            "two_tower_sharded_step", shards=nshards,
+            arena_prefix="emb_shard")
+        shard_obs.OBSERVATORY.record_shard_load(
+            "two_tower_sharded_step",
+            [a + b for a, b in zip(st_u["touched_per_shard"],
+                                   st_i["touched_per_shard"])],
+            kind="touched rows")
         last_sharded_stats.clear()
         last_sharded_stats.update({
             "shards": nshards,
@@ -936,6 +948,12 @@ def train_two_tower(
                     if checkpointer is not None
                     else p.steps - step
                 )
+                if sharded:
+                    from predictionio_tpu.obs import shards as shard_obs
+
+                    shard_obs.OBSERVATORY.program_meta(
+                        "two_tower_sharded_step",
+                        steps_per_dispatch=seg)
                 t0 = _time.perf_counter()
                 params, opt_state, loss = run(
                     params, opt_state, u_all, i_all, key, seg, step
@@ -993,6 +1011,13 @@ def train_two_tower(
             device_obs.arena(f"emb_shard{d}").free(alloc)
 
     if sharded:
+        from predictionio_tpu.obs import shards as shard_obs
+
+        ex_frac = shard_obs.OBSERVATORY.exchange_frac(
+            "two_tower_sharded_step")
+        if ex_frac is not None:
+            runlog.note("exchange_frac", round(ex_frac, 4))
+            last_sharded_stats["exchange_frac"] = round(ex_frac, 4)
         # collapse the [shards, rows_per, d] tables back to the flat
         # host layout the serving corpora, fold-in, and checkpoints of
         # the returned model expect (trailing pad rows drop here)

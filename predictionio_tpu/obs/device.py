@@ -407,6 +407,29 @@ def current_program_name() -> str | None:
     return active.name if active is not None else None
 
 
+def current_dispatch_marker():
+    """An object unique to the profiled dispatch executing on this
+    thread (None outside any) — the shard observatory keys trace-time
+    byte accumulation on it so a retrace restarts the sum instead of
+    double-counting (obs/shards.py)."""
+    return _ACTIVE.get()
+
+
+#: Called with ``(program_name, wall_seconds)`` after every profiled
+#: dispatch, right beside the program-record observe. The shard
+#: observatory (obs/shards.py) registers here; an empty list costs one
+#: iteration per dispatch. Listeners must be cheap and never raise —
+#: they run on the training/serving hot path (failures are swallowed to
+#: a debug log).
+_DISPATCH_LISTENERS: list = []
+
+
+def add_dispatch_listener(fn) -> None:
+    """Register a post-dispatch hook (idempotent by identity)."""
+    if fn not in _DISPATCH_LISTENERS:
+        _DISPATCH_LISTENERS.append(fn)
+
+
 class _Program:
     def __init__(self, name: str):
         self.name = name
@@ -741,8 +764,19 @@ def profiled_program(name, flops=None, bucket=None, sync: bool = False,
             # unaffordable on un-synced hot paths like the serving
             # top-k, whose signature set grows with every batch shape
             if new_sig and estimate and sync and flops is None:
-                rec.flops_by_sig[sig] = _cost_analysis_flops(
-                    fn, args, kwargs)
+                # lower under the program scope: lowering traces the
+                # body, and trace-time hooks (the obs/shards.py
+                # collective byte ticks) must attribute to this program
+                # — the actual dispatch below reuses the trace cache,
+                # so this is the only trace those hooks will see.
+                # Lowering raises no backend-compile events, so the
+                # compile-beyond-signature rule is untouched
+                est_token = _ACTIVE.set(_ActiveCall(pname, bkey))
+                try:
+                    rec.flops_by_sig[sig] = _cost_analysis_flops(
+                        fn, args, kwargs)
+                finally:
+                    _ACTIVE.reset(est_token)
             fl = None
             if flops is not None:
                 try:
@@ -766,6 +800,15 @@ def profiled_program(name, flops=None, bucket=None, sync: bool = False,
                 _sync_outputs(out)
             dt = time.perf_counter() - t0
             rec.observe(dt, fl, synced=sync, compile_s=active.compile_s)
+            for listener in _DISPATCH_LISTENERS:
+                try:
+                    # execute seconds, compile excluded: a first-dispatch
+                    # compile would wash out any execute-time fraction a
+                    # listener computes (obs/shards.py exchange_frac)
+                    listener(pname, max(dt - active.compile_s, 0.0))
+                except Exception:
+                    logger.debug("dispatch listener failed for %r",
+                                 pname, exc_info=True)
             return out
 
         inner.__wrapped__ = fn

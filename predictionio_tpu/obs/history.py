@@ -335,6 +335,23 @@ class HistorySampler:
         values["error_log_rate"] = self._rate(
             "log_err", ct(reg, "pio_log_records_total", "level",
                           ("ERROR", "CRITICAL")), dt)
+        # sharded runtime (obs/shards.py): skew, exchange fraction and
+        # the collective-byte rate of the distributed paths — plus the
+        # straggler-window tick the SHARD-STRAGGLER judgment rolls over
+        # (fail-soft like every entry; the max-over-programs shape
+        # matches the other multi-child gauges above)
+        try:
+            from predictionio_tpu.obs import shards as _shards
+
+            _shards.OBSERVATORY.history_tick()
+        except Exception:
+            logger.debug("shard-observatory tick failed", exc_info=True)
+        values["shard_imbalance"] = _gauge_max(
+            reg, "pio_shard_imbalance")
+        values["exchange_frac"] = _gauge_max(
+            reg, "pio_shard_exchange_frac")
+        values["collective_bytes_per_sec"] = self._rate(
+            "coll_bytes", ct(reg, "pio_collective_bytes_total"), dt)
         return values
 
     def _ratio_rate(self, key: str, num: float | None, den_extra: float | None,

@@ -720,40 +720,40 @@ def diagnose_runs(directory: Path | str | None = None,
     exceeds ``PIO_SHARD_IMBALANCE_WARN`` (default 2.0). Same finding
     shape as obs.fleet.diagnose."""
     findings: list[dict] = []
-    warn_at = float(os.environ.get("PIO_SHARD_IMBALANCE_WARN", "2.0"))
+    from predictionio_tpu.obs import shards as _shards
+
+    warn_at = _shards.shard_imbalance_warn()
+    # one code path for every shard-skew note: (note key, finding name,
+    # what the skew is measured over, why waiting on the heavy shard
+    # hurts, what to turn). Stragglers are the classic sharded failure
+    # mode — every collective waits for the heaviest shard, so a
+    # 3x-loaded shard makes the whole mesh run at 1/3 throughput.
+    imbalance_rules = (
+        ("shard_imbalance",
+         "SHARD-IMBALANCE: heaviest data shard carries {imb:.2f}x the "
+         "mean rating cells (threshold {warn_at:g}x) — every sharded-ALS "
+         "collective waits on that straggler; re-index entity ids toward "
+         "a uniform spread or change the shard count"),
+        # row-sharded embedding trainers (PIO_EMB_SHARDS): skewed id
+        # ownership loads one shard's all_to_all segment and its
+        # touched-row adam heavier than the rest — surfaced from
+        # pio_emb_shard_touched_rows' per-shard counts noted at start
+        ("emb_shard_imbalance",
+         "EMB-SHARD-IMBALANCE: heaviest embedding shard owns {imb:.2f}x "
+         "the mean touched rows (threshold {warn_at:g}x) — the id "
+         "exchange and the touched-row adam both wait on that shard; "
+         "re-index toward a uniform id spread or change PIO_EMB_SHARDS"),
+    )
     for s in list_runs(directory, limit=limit, now=now):
-        imb = (s.get("notes") or {}).get("shard_imbalance")
-        if isinstance(imb, (int, float)) and imb > warn_at:
-            # stragglers are the classic sharded-ALS failure mode: every
-            # collective waits for the heaviest shard, so a 3x-loaded
-            # shard makes the whole mesh run at 1/3 throughput
-            findings.append({
-                "severity": "warn",
-                "subject": f"run {s['runId']}",
-                "detail": (
-                    f"SHARD-IMBALANCE: heaviest data shard carries "
-                    f"{imb:.2f}x the mean rating cells (threshold "
-                    f"{warn_at:g}x) — every sharded-ALS collective waits "
-                    "on that straggler; re-index entity ids toward a "
-                    "uniform spread or change the shard count"),
-            })
-        eimb = (s.get("notes") or {}).get("emb_shard_imbalance")
-        if isinstance(eimb, (int, float)) and eimb > warn_at:
-            # row-sharded embedding trainers (PIO_EMB_SHARDS): skewed id
-            # ownership loads one shard's all_to_all segment and its
-            # touched-row adam heavier than the rest, and every exchange
-            # waits on it — surfaced from pio_emb_shard_touched_rows'
-            # per-shard counts noted at train start
-            findings.append({
-                "severity": "warn",
-                "subject": f"run {s['runId']}",
-                "detail": (
-                    f"EMB-SHARD-IMBALANCE: heaviest embedding shard owns "
-                    f"{eimb:.2f}x the mean touched rows (threshold "
-                    f"{warn_at:g}x) — the id exchange and the touched-row "
-                    "adam both wait on that shard; re-index toward a "
-                    "uniform id spread or change PIO_EMB_SHARDS"),
-            })
+        notes = s.get("notes") or {}
+        for note_key, template in imbalance_rules:
+            imb = notes.get(note_key)
+            if isinstance(imb, (int, float)) and imb > warn_at:
+                findings.append({
+                    "severity": "warn",
+                    "subject": f"run {s['runId']}",
+                    "detail": template.format(imb=imb, warn_at=warn_at),
+                })
         if not s["stalled"]:
             continue
         prog = (f"{s['iteration']}/{s['total']}"

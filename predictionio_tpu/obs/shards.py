@@ -1,0 +1,507 @@
+"""Shard & collective observatory — the seventh obs pillar (ISSUE 20).
+
+PRs 18–19 made the hot paths collective-heavy (ALX-layout sharded ALS,
+row-sharded embedding tables, sharded top-k) but the obs stack still saw
+a sharded run as one opaque dispatch: ``ops/collectives.py`` published
+zero metrics and per-shard accounting was scattered across
+``last_sharded_stats`` / ``route_stats``. ALX (PAPERS.md) shows the
+exchange fraction is THE scaling limiter for this layout; this module is
+the process-global ledger every sharded call site reports into so the
+owed real-hardware captures are diagnosable.
+
+Three legs:
+
+collective ledger
+    The ``ops/collectives.py`` helpers (and the ``sharded_table`` /
+    ``topk`` routes) tick analytic mesh-wide bytes at TRACE time —
+    tracing happens inside ``device_obs.profiled_program``'s active
+    scope, so the tick is program-labelled for free and costs nothing
+    per dispatch (a jit body traces once per signature). The dispatch
+    side rides a ``device_obs.add_dispatch_listener`` hook: each
+    profiled dispatch of a registered program replays the traced
+    per-step bytes × ``steps_per_dispatch`` into
+    ``pio_collective_bytes_total{op,program}``, observes the host wall
+    time into ``pio_collective_dispatch_seconds{program}``, derives an
+    exchange-time estimate from the analytic link model
+    (``PIO_SHARD_LINK_GBPS``, default 25.0 — a documented constant, not
+    a runtime probe, so the accounting is deterministic and adds zero
+    compiles), publishes ``pio_shard_exchange_frac{program}`` =
+    cumulative exchange seconds / cumulative dispatch seconds, and
+    records retroactive ``<program>:exchange`` / ``<program>:solve``
+    trace spans so ``pio trace`` waterfalls show the exchange inside a
+    sharded iteration.
+
+per-shard skew
+    Call sites report per-shard loads (rating cells, touched rows,
+    fold-in chunk sizes) into shard-indexed ``pio_shard_load`` gauges
+    plus the unified ``pio_shard_imbalance{program}`` (max/mean). The
+    history sampler calls :meth:`ShardObservatory.history_tick` each
+    tick; a shard whose load exceeds ``PIO_SHARD_IMBALANCE_WARN`` ×
+    median in the two most recent ticks is a persistent straggler —
+    the SHARD-STRAGGLER doctor finding (:func:`diagnose_shards_doc`).
+
+surfaces
+    ``GET /debug/shards`` (utils/http.py, 404 until a sharded program
+    reports), ``pio shards`` (tools/cli.py), the dashboard "Sharded
+    runtime" panel, history series (``exchange_frac``,
+    ``collective_bytes_per_sec``, ``shard_imbalance``), run-ledger
+    ``exchange_frac`` notes, and bench.py's sharded sections reading
+    ``*_exchange_frac`` from this live ledger.
+
+Everything here is fail-soft and lock-cheap: an un-instrumented process
+pays one dict lookup per profiled dispatch (the ``shard_obs_overhead_frac``
+bench guard prices the instrumented path at ≤ 1% of a sharded step).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs import trace
+from predictionio_tpu.obs.metrics import REGISTRY
+
+__all__ = [
+    "OBSERVATORY",
+    "ShardObservatory",
+    "collective_traced",
+    "diagnose_shards_doc",
+    "link_gbps",
+    "shard_imbalance_warn",
+]
+
+logger = logging.getLogger(__name__)
+
+#: Analytic interconnect bytes per collective, labelled by op and the
+#: profiled program whose trace issued it (``unattributed`` outside any).
+#: Ticked at trace time (the regression-pinned floor: the helpers must
+#: publish even when a call site bypasses the observatory) and replayed
+#: per executed step at dispatch time for registered programs.
+COLLECTIVE_BYTES = REGISTRY.counter(
+    "pio_collective_bytes_total",
+    "Analytic mesh-wide interconnect bytes of sharded collectives "
+    "(trace-time model: all_to_all ships every device's send buffer, "
+    "all_gather n-1 copies of each local block)",
+    labels=("op", "program"),
+)
+
+#: Host-side wall time of each profiled dispatch of a collective-bearing
+#: program (enqueue→results for sync'd programs — the denominator of the
+#: exchange fraction).
+COLLECTIVE_DISPATCH = REGISTRY.histogram(
+    "pio_collective_dispatch_seconds",
+    "Host wall seconds per profiled dispatch of a registered sharded "
+    "program",
+    labels=("program",),
+)
+
+#: Estimated fraction of a sharded program's wall time spent on the
+#: interconnect: cumulative analytic exchange seconds (bytes /
+#: ``PIO_SHARD_LINK_GBPS``) over cumulative dispatch seconds. The ALX
+#: scaling limiter, live.
+EXCHANGE_FRAC = REGISTRY.gauge(
+    "pio_shard_exchange_frac",
+    "Estimated exchange-time fraction of a sharded program's dispatch "
+    "wall time (analytic bytes over the PIO_SHARD_LINK_GBPS link model)",
+    labels=("program",),
+)
+
+#: Per-shard load of the most recent reported sharded plan/batch (rating
+#: cells, touched embedding rows, fold-in chunk cells — ``kind`` in the
+#: /debug/shards doc says which). Shard-indexed so skew is visible per
+#: series, not just as a ratio.
+SHARD_LOAD = REGISTRY.gauge(
+    "pio_shard_load",
+    "Per-shard load units of the most recent reported sharded "
+    "plan/batch for a program (see /debug/shards for the unit)",
+    labels=("program", "shard"),
+)
+
+#: The unified skew gauge (max/mean of ``pio_shard_load``): one family
+#: for every sharded program, where the ALS and embedding paths used to
+#: keep separate ad-hoc gauges (those remain as legacy aliases).
+SHARD_SKEW = REGISTRY.gauge(
+    "pio_shard_imbalance",
+    "Heaviest-shard / mean per-shard load of the most recent reported "
+    "sharded plan/batch (1.0 = balanced)",
+    labels=("program",),
+)
+
+
+def shard_imbalance_warn() -> float:
+    """THE ``PIO_SHARD_IMBALANCE_WARN`` parse (default 2.0): the shared
+    threshold of the SHARD-IMBALANCE / EMB-SHARD-IMBALANCE run-ledger
+    findings and the SHARD-STRAGGLER rolling judgment."""
+    try:
+        return float(os.environ.get("PIO_SHARD_IMBALANCE_WARN", "2.0"))
+    except ValueError:
+        return 2.0
+
+
+def link_gbps() -> float:
+    """``PIO_SHARD_LINK_GBPS`` (default 25.0): the analytic per-link
+    interconnect bandwidth the exchange-time estimate divides bytes by.
+    A documented constant rather than a runtime probe — deterministic,
+    zero extra compiles; set it to the real fabric (ICI ~100s of GB/s,
+    DCN ~25) to calibrate ``pio_shard_exchange_frac``."""
+    try:
+        v = float(os.environ.get("PIO_SHARD_LINK_GBPS", "25.0"))
+        return v if v > 0 else 25.0
+    except ValueError:
+        return 25.0
+
+
+#: Straggler judgment window (history ticks). Two consecutive over-
+#: threshold ticks trip the finding — "within two history ticks" is the
+#: ISSUE acceptance — and the deque keeps a few more for the doc.
+_WINDOW = 8
+
+
+class _ProgramLedger:
+    """Everything the observatory knows about one sharded program."""
+
+    __slots__ = ("name", "shards", "arena_prefix", "steps_per_dispatch",
+                 "trace_bytes", "trace_marker", "dispatches", "steps",
+                 "dispatch_s", "bytes_total", "exchange_s",
+                 "exchange_frac", "loads", "load_kind", "imbalance",
+                 "load_window", "updated_at")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.shards: int = 0
+        self.arena_prefix: str | None = None
+        self.steps_per_dispatch: int = 1
+        #: op -> analytic bytes per STEP, captured at trace time (the
+        #: collectives sit inside the program's fori/scan body, so one
+        #: trace sees exactly one step's worth). Latest trace wins.
+        self.trace_bytes: dict[str, float] = {}
+        self.trace_marker: object | None = None
+        self.dispatches = 0
+        self.steps = 0
+        self.dispatch_s = 0.0
+        self.bytes_total = 0.0
+        self.exchange_s = 0.0
+        self.exchange_frac: float | None = None
+        self.loads: list[float] | None = None
+        self.load_kind = ""
+        self.imbalance: float | None = None
+        #: per-history-tick snapshots of ``loads`` (the straggler window)
+        self.load_window: deque = deque(maxlen=_WINDOW)
+        self.updated_at = 0.0
+
+
+def _straggler(window, warn_at: float) -> dict | None:
+    """The persistent-straggler rule: one shard whose load exceeds
+    ``warn_at`` × median(loads) in BOTH of the two most recent history
+    ticks. Returns ``{"shard", "ratio", "ticks"}`` or None."""
+    if len(window) < 2:
+        return None
+    hot: dict[int, float] | None = None
+    for loads in list(window)[-2:]:
+        if not loads:
+            return None
+        srt = sorted(loads)
+        med = srt[len(srt) // 2]
+        if med <= 0:
+            return None
+        tick_hot = {i: ld / med for i, ld in enumerate(loads)
+                    if ld > warn_at * med}
+        hot = (tick_hot if hot is None else
+               {i: max(r, hot[i]) for i, r in tick_hot.items()
+                if i in hot})
+        if not hot:
+            return None
+    shard = max(hot, key=hot.get)
+    return {"shard": shard, "ratio": round(hot[shard], 2), "ticks": 2}
+
+
+class ShardObservatory:
+    """Process-global per-shard runtime ledger (see module docstring).
+    Instantiable for tests; the process singleton is
+    :data:`OBSERVATORY`."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs: dict[str, _ProgramLedger] = {}
+        #: total dispatch-listener invocations that found a registered
+        #: program — the bench census numerator (shard_obs_overhead_frac)
+        self.dispatch_events = 0
+
+    # -- registration -------------------------------------------------------
+    def program_meta(self, program: str, *, shards: int | None = None,
+                     steps_per_dispatch: int | None = None,
+                     arena_prefix: str | None = None) -> None:
+        """Register (or update) a sharded program's static facts. Call
+        before dispatching: ``steps_per_dispatch`` is how many loop
+        steps one profiled dispatch executes (a fused N-iteration run
+        is ONE dispatch), so the byte replay scales correctly."""
+        with self._lock:
+            led = self._programs.get(program)
+            if led is None:
+                led = self._programs[program] = _ProgramLedger(program)
+            if shards is not None:
+                led.shards = int(shards)
+            if steps_per_dispatch is not None:
+                led.steps_per_dispatch = max(int(steps_per_dispatch), 1)
+            if arena_prefix is not None:
+                led.arena_prefix = arena_prefix
+            led.updated_at = time.time()
+
+    def record_shard_load(self, program: str, loads, kind: str = "load"
+                          ) -> None:
+        """Report per-shard load units (rating cells, touched rows...).
+        Publishes the shard-indexed gauges and the unified imbalance;
+        the rolling straggler window samples these at history ticks."""
+        loads = [float(v) for v in loads]
+        if not loads:
+            return
+        self.program_meta(program, shards=len(loads))
+        with self._lock:
+            led = self._programs[program]
+            prev_n = len(led.loads) if led.loads else 0
+            led.loads = loads
+            led.load_kind = kind
+            mean = sum(loads) / len(loads)
+            led.imbalance = (max(loads) / mean) if mean > 0 else 1.0
+            led.updated_at = time.time()
+        for d, v in enumerate(loads):
+            SHARD_LOAD.set(v, program=program, shard=str(d))
+        for d in range(len(loads), prev_n):  # re-shard shrank the mesh
+            SHARD_LOAD.remove(program=program, shard=str(d))
+        SHARD_SKEW.set(led.imbalance, program=program)
+
+    # -- trace-time byte capture -------------------------------------------
+    def collective_traced(self, op: str, nbytes: float) -> None:
+        """Called by the ``ops/collectives.py`` helpers (and the
+        sharded_table/topk routes) while a jit body TRACES: ticks the
+        raw counter unconditionally (the regression-pinned floor) and,
+        when the trace runs inside a profiled program, accumulates the
+        per-step byte model into that program's ledger. One dispatch =
+        one ``_ActiveCall`` marker, so a retrace restarts the
+        accumulation instead of double-counting."""
+        nbytes = float(nbytes)
+        program = device_obs.current_program_name() or "unattributed"
+        COLLECTIVE_BYTES.inc(nbytes, op=op, program=program)
+        if program == "unattributed":
+            return
+        marker = device_obs.current_dispatch_marker()
+        with self._lock:
+            led = self._programs.get(program)
+            if led is None:
+                led = self._programs[program] = _ProgramLedger(program)
+            if led.trace_marker is not marker:
+                led.trace_marker = marker
+                led.trace_bytes = {}
+            led.trace_bytes[op] = led.trace_bytes.get(op, 0.0) + nbytes
+
+    # -- dispatch accounting (device_obs listener) --------------------------
+    def on_dispatch(self, program: str, seconds: float) -> None:
+        """The ``device_obs.add_dispatch_listener`` hook: account one
+        profiled dispatch of a registered program. Unregistered programs
+        cost one dict lookup (the overhead-guard fast path)."""
+        led = self._programs.get(program)
+        if led is None:
+            return
+        with self._lock:
+            self.dispatch_events += 1
+            steps = led.steps_per_dispatch
+            per_step = sum(led.trace_bytes.values())
+            nbytes = per_step * steps
+            led.dispatches += 1
+            led.steps += steps
+            led.dispatch_s += seconds
+            led.bytes_total += nbytes
+            # analytic exchange time, clamped to the wall it lives in
+            ex_s = min(nbytes / (link_gbps() * 1e9), max(seconds, 0.0))
+            led.exchange_s += ex_s
+            frac = (led.exchange_s / led.dispatch_s
+                    if led.dispatch_s > 0 else 0.0)
+            led.exchange_frac = frac
+            ops = dict(led.trace_bytes)
+            led.updated_at = time.time()
+        for op, b in ops.items():
+            COLLECTIVE_BYTES.inc(b * steps, op=op, program=program)
+        COLLECTIVE_DISPATCH.observe(seconds, program=program)
+        EXCHANGE_FRAC.set(frac, program=program)
+        if nbytes > 0:
+            # retroactive spans under the caller's span (no-op when the
+            # trace layer is off or unsampled): the exchange share at
+            # the head of the dispatch window, the solve share after —
+            # an attribution model, not a measured interleaving, but it
+            # puts the exchange inside `pio trace` waterfalls
+            t_end = time.time()
+            trace.record(f"{program}:exchange", t_end - seconds, ex_s,
+                         bytes=int(nbytes), steps=steps)
+            trace.record(f"{program}:solve", t_end - seconds + ex_s,
+                         max(seconds - ex_s, 0.0))
+
+    # -- history / straggler window ----------------------------------------
+    def history_tick(self) -> None:
+        """Called by the history sampler each tick: snapshot every
+        program's current per-shard loads into its straggler window."""
+        with self._lock:
+            for led in self._programs.values():
+                if led.loads:
+                    led.load_window.append(list(led.loads))
+
+    # -- readers ------------------------------------------------------------
+    def active(self) -> bool:
+        """Whether any sharded program has reported (the /debug/shards
+        404 gate: absent must look exactly like not-built)."""
+        with self._lock:
+            return any(led.dispatches > 0 or led.loads
+                       for led in self._programs.values())
+
+    def exchange_frac(self, program_prefix: str) -> float | None:
+        """Live exchange fraction of the most recently updated program
+        whose name starts with ``program_prefix`` (bench sections read
+        their ``*_exchange_frac`` keys here)."""
+        with self._lock:
+            leds = [led for name, led in self._programs.items()
+                    if name.startswith(program_prefix)
+                    and led.exchange_frac is not None]
+            if not leds:
+                return None
+            return max(leds, key=lambda led: led.updated_at).exchange_frac
+
+    def snapshot(self, program_prefix: str) -> dict | None:
+        """The report doc of the most recently updated matching program
+        (None when nothing matches)."""
+        doc = self.report()
+        matches = {name: d for name, d in doc["programs"].items()
+                   if name.startswith(program_prefix)}
+        if not matches:
+            return None
+        name = max(matches, key=lambda n: matches[n]["updatedAt"])
+        return {"program": name, **matches[name]}
+
+    def report(self) -> dict:
+        """The merged /debug/shards document."""
+        warn_at = shard_imbalance_warn()
+        with self._lock:
+            leds = [(name, led, list(led.load_window))
+                    for name, led in self._programs.items()]
+        programs = {}
+        for name, led, window in leds:
+            per_shard = []
+            for d in range(led.shards):
+                row: dict = {"shard": d}
+                if led.loads and d < len(led.loads):
+                    row["load"] = led.loads[d]
+                if led.arena_prefix:
+                    row["arenaBytes"] = int(device_obs.arena(
+                        f"{led.arena_prefix}{d}").bytes())
+                per_shard.append(row)
+            per_step = sum(led.trace_bytes.values())
+            programs[name] = {
+                "shards": led.shards,
+                "loadKind": led.load_kind,
+                "dispatches": led.dispatches,
+                "steps": led.steps,
+                "stepsPerDispatch": led.steps_per_dispatch,
+                "dispatchSeconds": round(led.dispatch_s, 6),
+                "collectiveBytes": int(led.bytes_total),
+                "bytesPerStep": int(per_step),
+                "collectiveOps": {op: int(b)
+                                  for op, b in led.trace_bytes.items()},
+                "exchangeSeconds": round(led.exchange_s, 6),
+                "exchangeFrac": (None if led.exchange_frac is None
+                                 else round(led.exchange_frac, 4)),
+                "imbalance": (None if led.imbalance is None
+                              else round(led.imbalance, 3)),
+                "straggler": _straggler(window, warn_at),
+                "windowTicks": len(window),
+                "perShard": per_shard,
+                "updatedAt": led.updated_at,
+            }
+        return {"programs": programs, "linkGbps": link_gbps(),
+                "warnAt": warn_at}
+
+    # -- bench guard helpers -------------------------------------------------
+    def listener_cost_s(self, iters: int = 5000) -> float:
+        """Unit cost of one registered-program :meth:`on_dispatch` pass
+        (min of 3 tight-loop rounds against a scratch ledger — the
+        EXPENSIVE path: metrics ticks included, trace spans no-op'd by
+        zero bytes... so a one-op byte model is installed to price the
+        counter replay too). The ``shard_obs_overhead_frac`` bench guard
+        multiplies this by the dispatch census."""
+        probe = "shard_obs_overhead_probe"
+        self.program_meta(probe, shards=2, steps_per_dispatch=1)
+        with self._lock:
+            self._programs[probe].trace_bytes = {"probe": 1024.0}
+        best = float("inf")
+        try:
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    self.on_dispatch(probe, 1e-6)
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            self.reset_program(probe)
+        return best / iters
+
+    def reset_program(self, program: str) -> None:
+        """Drop one program's ledger and gauge children (tests, the
+        overhead probe)."""
+        with self._lock:
+            led = self._programs.pop(program, None)
+        if led is None:
+            return
+        EXCHANGE_FRAC.remove(program=program)
+        SHARD_SKEW.remove(program=program)
+        for d in range(len(led.loads) if led.loads else 0):
+            SHARD_LOAD.remove(program=program, shard=str(d))
+
+    def reset(self) -> None:
+        """Drop every ledger (tests)."""
+        with self._lock:
+            names = list(self._programs)
+        for name in names:
+            self.reset_program(name)
+        with self._lock:
+            self.dispatch_events = 0
+
+
+#: The process singleton every call site reports into, wired into the
+#: profiled-dispatch path at import (utils/http.py, the trainers, and
+#: the CLI all import this module, so any process that runs a sharded
+#: program has the listener installed).
+OBSERVATORY = ShardObservatory()
+device_obs.add_dispatch_listener(OBSERVATORY.on_dispatch)
+
+
+def collective_traced(op: str, nbytes: float) -> None:
+    """Module-level convenience for the ops-layer call sites."""
+    OBSERVATORY.collective_traced(op, nbytes)
+
+
+def diagnose_shards_doc(doc: dict | None) -> list[dict]:
+    """SHARD-STRAGGLER findings from a fetched ``/debug/shards``
+    document (``pio doctor``'s client-side judge, same finding shape as
+    obs.fleet.diagnose). None / empty docs judge clean — an unreachable
+    or 404 surface is not a straggler."""
+    findings: list[dict] = []
+    if not isinstance(doc, dict):
+        return findings
+    warn_at = doc.get("warnAt", shard_imbalance_warn())
+    for name, prog in sorted((doc.get("programs") or {}).items()):
+        st = prog.get("straggler") if isinstance(prog, dict) else None
+        if not st:
+            continue
+        kind = prog.get("loadKind") or "load"
+        findings.append({
+            "severity": "warn",
+            "subject": f"program {name}",
+            "detail": (
+                f"SHARD-STRAGGLER: shard {st.get('shard')} has carried "
+                f"{st.get('ratio'):.2f}x the median {kind} for "
+                f"{st.get('ticks')} consecutive history ticks (threshold "
+                f"{warn_at:g}x, PIO_SHARD_IMBALANCE_WARN) — every "
+                "collective waits on that shard; re-index ids toward a "
+                "uniform spread or change the shard count"),
+        })
+    return findings

@@ -14,6 +14,24 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _tick(op: str, nbytes) -> None:
+    """Report one collective's analytic mesh-wide bytes to the shard
+    observatory (obs/shards.py). These helpers run inside ``shard_map``
+    bodies, so this host-side call fires at TRACE time — once per
+    compiled signature, never per dispatch — and the shapes it prices
+    are static. The observatory ticks ``pio_collective_bytes_total``
+    unconditionally (regression-pinned: the raw counter moves even when
+    a call site bypasses the per-program ledger) and attributes the
+    bytes to the profiled program whose trace is running. Fail-soft:
+    collective math must never depend on the obs stack."""
+    try:
+        from predictionio_tpu.obs import shards
+
+        shards.collective_traced(op, float(nbytes))
+    except Exception:  # pragma: no cover - obs must never sink an op
+        pass
+
+
 def axis_size(axis_name: str) -> int:
     """Static size of a named mesh axis from inside a shard_map body.
     ``lax.axis_size`` where jax ships it; ``psum(1)`` on older versions
@@ -45,12 +63,18 @@ def vma_axes(x, default):
 def all_gather_rows(x, axis_name: str):
     """Concatenate each device's rows along axis 0 (ICI all-gather).
     Spark-broadcast / shuffle-read analog for in-batch negative pools."""
+    n = axis_size(axis_name)
+    # every device ships its local block to the n-1 others
+    _tick("all_gather", n * (n - 1) * x.size * x.dtype.itemsize)
     return lax.all_gather(x, axis_name, axis=0, tiled=True)
 
 
 def psum_mean(x, axis_name: str):
     """Mean over the named axis (ICI all-reduce) — the treeAggregate analog,
     used for data-parallel gradient averaging."""
+    n = axis_size(axis_name)
+    # ring all-reduce: ~2(n-1)/n of the payload per device, n devices
+    _tick("psum", 2 * (n - 1) * x.size * x.dtype.itemsize)
     return lax.pmean(x, axis_name)
 
 
@@ -71,6 +95,10 @@ def gather_slices(rows, send_idx, axis_name: str):
     instead of the full ``n_rows_global * r`` an all-gather would ship.
     """
     n, w = send_idx.shape
+    # mesh-wide: n devices each exchange an [n, w, r] slice buffer —
+    # the forward half of als_dense's 4·n²·w·(r + width_back) model
+    _tick("all_to_all",
+          n * n * w * rows.shape[-1] * rows.dtype.itemsize)
     out = lax.all_to_all(rows[send_idx], axis_name, 0, 0)
     return out.reshape(n * w, rows.shape[-1])
 
@@ -83,6 +111,8 @@ def scatter_slices_add(buf, send_idx, n_rows: int, axis_name: str):
     real indices across destination shards accumulate, which is exactly
     the cross-shard gram reduction the item half-step needs."""
     n, w = send_idx.shape
+    # mesh-wide: the reverse [n, w, cols] partial-gram route
+    _tick("all_to_all", n * buf.size * buf.dtype.itemsize)
     back = lax.all_to_all(buf.reshape(n, w, -1), axis_name, 0, 0)
     zero = jnp.zeros((n_rows, buf.shape[-1]), buf.dtype)
     return zero.at[send_idx.reshape(-1)].add(
@@ -92,6 +122,7 @@ def scatter_slices_add(buf, send_idx, n_rows: int, axis_name: str):
 def ring_permute(x, axis_name: str, *, reverse: bool = False):
     """Rotate blocks one hop around the ring (ICI neighbor exchange)."""
     n = axis_size(axis_name)
+    _tick("ppermute", n * x.size * x.dtype.itemsize)
     if reverse:
         perm = [(i, (i - 1) % n) for i in range(n)]
     else:

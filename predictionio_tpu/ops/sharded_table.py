@@ -57,6 +57,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.io import transfer
 from predictionio_tpu.obs.metrics import REGISTRY
+from predictionio_tpu.ops import collectives
 from predictionio_tpu.ops import sparse_update as su
 from predictionio_tpu.parallel.mesh import shard_map
 
@@ -229,6 +230,10 @@ def build_route(ids, *, n_rows: int, ndev: int, cap: int,
            - starts[own_s].astype(jnp.int32))
     req = jnp.full((ndev, cap), sentinel, uids.dtype)
     req = req.at[own_s, pos].set(uids_s, mode="drop")
+    # trace-time analytic bytes (obs/shards.py): ndev devices each ship
+    # a [ndev, cap] id request table. Static cap-shaped upper bound —
+    # route_stats' unique-count model stays the data-dependent estimate
+    collectives._tick("all_to_all", ndev * req.size * req.dtype.itemsize)
     got = lax.all_to_all(req, axis, 0, 0)  # [ndev, cap] ids I own
     got_slot = got // ndev  # sentinel → rp (out of range): fill/drop
     return Route(uids, inv, order, own_s, pos, got_slot)
@@ -242,6 +247,8 @@ def route_gather(table_loc, rt: Route, *, ndev: int, cap: int,
     d = table_loc.shape[-1]
     rows = table_loc.at[rt.got_slot.reshape(-1)].get(
         mode="fill", fill_value=0).reshape(ndev, cap, d)
+    collectives._tick("all_to_all",
+                      ndev * rows.size * rows.dtype.itemsize)
     resp = lax.all_to_all(rows, axis, 0, 0)  # [ndev, cap, d]
     # sorted unique i sits at request slot (own_s[i], pos[i]); sentinels
     # flatten out of range and fill zero
@@ -269,6 +276,8 @@ def route_update(table_loc, m_loc, v_loc, last_loc, rt: Route, g_unique,
     rp = table_loc.shape[0]
     gbuf = jnp.zeros((ndev, cap, d), g_unique.dtype)
     gbuf = gbuf.at[rt.own_s, rt.pos].set(g_unique[rt.order], mode="drop")
+    collectives._tick("all_to_all",
+                      ndev * gbuf.size * gbuf.dtype.itemsize)
     grecv = lax.all_to_all(gbuf, axis, 0, 0)  # [ndev, cap, d]
     slots = rt.got_slot.reshape(-1)  # pads → rp
     cap2 = min(ndev * cap, rp) + 1

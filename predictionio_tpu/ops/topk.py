@@ -187,7 +187,14 @@ def _local_topk_merge(q, it, em, *, axis: str, k: int, n: int,
         ls, li = lax.top_k(s, kl)
     gi = base + li
     # each device contributes its kl best; the merge inputs are tiny
-    # [B, kl] lists — the all-gather moves O(p*B*k), not catalog rows
+    # [B, kl] lists — the all-gather moves O(p*B*k), not catalog rows.
+    # Trace-time analytic bytes (obs/shards.py): p devices each ship
+    # their [B, kl] score + id lists to the p-1 others
+    from predictionio_tpu.ops.collectives import _tick, axis_size
+
+    p_ = axis_size(axis)
+    _tick("all_gather", p_ * (p_ - 1) * ls.size
+          * (ls.dtype.itemsize + gi.dtype.itemsize))
     alls = lax.all_gather(ls, axis)  # [p, B, kl]
     alli = lax.all_gather(gi, axis)
     b = q.shape[0]

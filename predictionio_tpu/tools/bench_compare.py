@@ -58,6 +58,7 @@ _SKIP_KEYS = {
     "sasrec_batch", "sasrec_max_len", "sasrec_serve_placement",
     "bulk_ingest_chunk", "ingest_view_events", "sharded_shards",
     "bigtable_shards", "sharded_topk_shards", "bigtable_full_table_bytes",
+    "sharded_link_gbps",
 }
 
 _LOWER_BETTER_RE = re.compile(
@@ -71,16 +72,19 @@ _HIGHER_BETTER_RE = re.compile(
 def lower_is_better(key: str) -> bool:
     """Bad direction per key. Order matters: cost-shaped names
     (``sec_per_*``, ``*overhead*``, ``unattributed``,
-    ``events_to_servable``, ``*alltoall_bytes*`` — interconnect traffic
-    is a cost however it is suffixed) are checked first — ``trace_overhead_frac``
-    must read as a cost even though ``_frac`` keys are otherwise
+    ``events_to_servable``, ``*alltoall_bytes*`` / ``*collective_bytes*``
+    — interconnect traffic is a cost however it is suffixed — and
+    ``*exchange_frac*``, the interconnect share of step time) are
+    checked first — ``trace_overhead_frac`` and ``*_exchange_frac``
+    must read as costs even though ``_frac`` keys are otherwise
     utilization-shaped, and events-to-servable is a LATENCY however it
     is suffixed — then throughput names (``speedup`` included) win the
     remaining ties because ``*_per_sec`` would otherwise match the
     ``_sec`` suffix rule."""
     if "sec_per_" in key or "mb_per_step" in key or "overhead" in key \
             or "unattributed" in key or "events_to_servable" in key \
-            or "alltoall_bytes" in key:
+            or "alltoall_bytes" in key or "collective_bytes" in key \
+            or "exchange_frac" in key:
         return True
     if _HIGHER_BETTER_RE.search(key):
         return False

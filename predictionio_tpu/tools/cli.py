@@ -74,6 +74,19 @@ def build_parser() -> argparse.ArgumentParser:
                      help="raw /debug/quality JSON instead of the report")
     p_q.set_defaults(func=cmd_quality)
 
+    # -- shard & collective observatory (obs/shards.py surfaces) -------------
+    p_sh = sub.add_parser(
+        "shards",
+        help="per-shard runtime report of the distributed paths: "
+             "collective bytes, exchange fraction of step time, load "
+             "skew and straggler judgment per sharded program")
+    p_sh.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="server whose process ran the sharded programs")
+    p_sh.add_argument("--json", action="store_true",
+                      help="raw /debug/shards JSON instead of the report")
+    p_sh.set_defaults(func=cmd_shards)
+
     # -- structured log pillar (obs/logs.py surfaces) ------------------------
     p_logs = sub.add_parser(
         "logs",
@@ -1335,6 +1348,67 @@ def cmd_quality(args) -> int:
     return 1 if any(f["severity"] == "critical" for f in findings) else 0
 
 
+def _fmt_bytes(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def cmd_shards(args) -> int:
+    """``pio shards``: the shard & collective observatory's report —
+    per sharded program, the collective bytes moved, the fraction of
+    step time spent in the exchange, per-shard load/arena rows, and the
+    rolling SHARD-STRAGGLER judgment. Exit 0 = no straggler, 1 = a
+    straggler finding, 2 = unreachable or no sharded program ran."""
+    import json as _json
+
+    from predictionio_tpu.obs import shards as shards_mod
+
+    base = args.url.rstrip("/")
+    doc = _fetch_json(f"{base}/debug/shards")
+    if doc is None:
+        print(f"[ERROR] cannot fetch {base}/debug/shards — deployment "
+              "down, or no sharded program has run in that process.",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(_json.dumps(doc, indent=2))
+        return 0
+    findings = shards_mod.diagnose_shards_doc(doc)
+    programs = doc.get("programs") or {}
+    print(f"[INFO] pio shards @ {base} — {len(programs)} sharded "
+          f"program(s), link {doc.get('linkGbps')} Gbit/s "
+          f"(PIO_SHARD_LINK_GBPS), straggler threshold "
+          f"{doc.get('warnAt')}x (PIO_SHARD_IMBALANCE_WARN)")
+    for name, p in sorted(programs.items()):
+        ex = p.get("exchangeFrac")
+        print(f"[INFO] {name}: {p.get('shards')} shard(s), "
+              f"{p.get('steps')} step(s) in {p.get('dispatches')} "
+              f"dispatch(es), collective "
+              f"{_fmt_bytes(p.get('collectiveBytes'))} "
+              f"({_fmt_bytes(p.get('bytesPerStep'))}/step), exchange "
+              + (f"{ex * 100:.2f}% of step time" if ex is not None
+                 else "n/a")
+              + f", imbalance {p.get('imbalance')}x")
+        for row in p.get("perShard") or []:
+            load = row.get("load")
+            print(f"[INFO]   shard {row.get('shard')}: "
+                  f"load {load if load is not None else 'n/a'} "
+                  f"{p.get('loadKind') or ''}".rstrip()
+                  + f", arena {_fmt_bytes(row.get('arenaBytes'))}")
+    marks = {"critical": "[CRIT]", "warn": "[WARN]", "info": "[INFO]"}
+    for f in findings:
+        print(f"{marks.get(f['severity'], '[INFO]')} {f['subject']}: "
+              f"{f['detail']}")
+    if not findings:
+        print("[INFO] sharded runtime healthy: no straggler.")
+    return 1 if findings else 0
+
+
 def cmd_doctor(args) -> int:
     """``pio doctor``: pull the fleet's health surfaces (gateway status,
     per-replica statuses, /debug/slo, /debug/traces) and print a ranked
@@ -1397,10 +1471,18 @@ def cmd_doctor(args) -> int:
         # like every other fetched surface
         history_doc = _fetch_json(
             f"{base}/debug/history?series=error_log_rate&seconds=300")
+        # shard & collective observatory (obs/shards.py): rolling
+        # SHARD-STRAGGLER judgment over the fetched /debug/shards doc —
+        # 404 (no sharded program ran) judges clean like every other
+        # absent surface
+        from predictionio_tpu.obs import shards as shards_mod
+
+        shards_doc = _fetch_json(f"{base}/debug/shards")
         findings = (train_findings
                     + continuous_mod.diagnose_trainers(
                         slo_state, directory=trainer_dir)
                     + logs_mod.diagnose_history_doc(history_doc)
+                    + shards_mod.diagnose_shards_doc(shards_doc)
                     + fleet.diagnose(
                         status if is_gateway else None, members,
                         slo_state, traces[: args.traces],

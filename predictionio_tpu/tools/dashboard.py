@@ -47,6 +47,7 @@ _PAGE = """<!DOCTYPE html>
 {history}
 {metrics}
 {device}
+{shards}
 {traces}
 {logs}
 </body></html>"""
@@ -112,6 +113,40 @@ def _device_panel() -> str:
             "utilization for this process (<code>pio_device_*</code> on "
             "<a href='/metrics'>/metrics</a>; capture a trace with "
             "<code>pio profile</code>).</p>" + hbm + progs)
+
+
+def _shards_panel() -> str:
+    """Sharded-runtime panel: per sharded program, the collective bytes
+    moved, the exchange fraction of step time, load skew and the rolling
+    straggler judgment — the obs/shards.py ledger this process carries.
+    Renders empty when no sharded program ran here (the /debug/shards
+    404 contract)."""
+    from predictionio_tpu.obs import shards as shard_obs
+
+    if not shard_obs.OBSERVATORY.active():
+        return ""
+    doc = shard_obs.OBSERVATORY.report()
+    rows = []
+    for name, p in sorted((doc.get("programs") or {}).items()):
+        ex = p.get("exchangeFrac")
+        straggler = p.get("straggler")
+        rows.append(
+            f"<tr><td>{html.escape(name)}</td><td>{p.get('shards')}</td>"
+            f"<td>{p.get('steps')}</td>"
+            f"<td>{(p.get('collectiveBytes') or 0) / 2**20:.1f} MiB</td>"
+            f"<td>{'n/a' if ex is None else f'{ex * 100:.2f}%'}</td>"
+            f"<td>{p.get('imbalance')}x</td>"
+            f"<td>{'shard ' + str(straggler['shard']) if straggler else '—'}"
+            "</td></tr>")
+    return ("<h2>Sharded runtime</h2><p>Collective traffic and per-shard "
+            "skew of the distributed programs in this process "
+            "(<code>pio_collective_*</code> / <code>pio_shard_*</code> on "
+            "<a href='/metrics'>/metrics</a>; details on "
+            "<a href='/debug/shards'>/debug/shards</a> or "
+            "<code>pio shards</code>).</p>"
+            "<table><tr><th>program</th><th>shards</th><th>steps</th>"
+            "<th>collective</th><th>exchange</th><th>imbalance</th>"
+            "<th>straggler</th></tr>" + "".join(rows) + "</table>")
 
 
 def _gateway_url() -> str:
@@ -486,7 +521,8 @@ def build_router() -> Router:
             slo=_slo_banner(gw_status), fleet=_fleet_panel(gw_status),
             quality=_quality_panel(gw_status),
             history=_history_panel(gw_status),
-            device=_device_panel(), traces=_traces_panel(),
+            device=_device_panel(), shards=_shards_panel(),
+            traces=_traces_panel(),
             logs=_logs_panel(gw_status)))
 
     def _get(request: Request, running: bool = False) -> EvaluationInstance:

@@ -340,6 +340,18 @@ def _solve_entities_sharded(params, entities, e_idx, o_idx, vals, fixed,
     prog = _foldin_spmd_program(
         mesh, ndev, us, S, rank, p.implicit_prefs, scale,
         p.gather_dtype == "float32", nd > 0)
+    # shard observatory (obs/shards.py): per-shard fold-in cell loads.
+    # This path moves NO collectives (each shard solves against its own
+    # host-gathered fixed slice), so the ledger shows skew and dispatch
+    # time with a zero exchange fraction — which is the point.
+    from predictionio_tpu.obs import shards as shard_obs
+
+    shard_obs.OBSERVATORY.program_meta(
+        f"als_foldin_spmd_rank{rank}", shards=ndev,
+        steps_per_dispatch=1)
+    shard_obs.OBSERVATORY.record_shard_load(
+        f"als_foldin_spmd_rank{rank}",
+        [int(c) for c in np.diff(starts)], kind="foldin cells")
     out = prog(put(items_h, None), put(vals_h, None), put(rs_h, None),
                put(k_h), put(fixed_h, None, None),
                put(prev_h, None, None), dup_dev,

@@ -786,6 +786,16 @@ def add_metrics_route(router: Router,
         except ValueError as e:
             raise HTTPError(400, f"bad filter: {e}") from e
 
+    def debug_shards(request: Request):
+        from predictionio_tpu.obs import shards
+
+        if not shards.OBSERVATORY.active():
+            # no sharded program has run in this process: the surface
+            # must look exactly like the feature not being there (404)
+            raise HTTPError(404, "no sharded program has run "
+                                 "in this process")
+        return 200, shards.OBSERVATORY.report()
+
     def debug_postmortem(request: Request):
         from predictionio_tpu.obs import postmortem
 
@@ -812,6 +822,7 @@ def add_metrics_route(router: Router,
     router.add("GET", "/debug/slo", debug_slo)
     router.add("GET", "/debug/quality", debug_quality)
     router.add("GET", "/debug/logs", debug_logs)
+    router.add("GET", "/debug/shards", debug_shards)
     router.add("POST", "/debug/postmortem", debug_postmortem)
     # kick the process history sampler (no-op when disabled): every
     # server that mounts the scrape surface also records local history

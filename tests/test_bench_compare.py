@@ -270,6 +270,29 @@ def test_two_tower_mfu_floor_gate():
     assert result["regressions"] == []
 
 
+def test_shard_observatory_direction_rules():
+    """ISSUE 20's bench keys: exchange fractions and collective bytes
+    are COSTS (interconnect share of step time / traffic) despite the
+    ``_frac`` and un-suffixed spellings; the link model is an
+    environment fact, never a regression."""
+    from predictionio_tpu.tools.bench_compare import (
+        _SKIP_KEYS,
+        lower_is_better,
+    )
+
+    assert lower_is_better("sharded_exchange_frac")
+    assert lower_is_better("bigtable_exchange_frac")
+    assert lower_is_better("sharded_topk_exchange_frac")
+    assert lower_is_better("sharded_iter_collective_bytes")
+    assert lower_is_better("shard_obs_overhead_frac")
+    assert "sharded_link_gbps" in _SKIP_KEYS
+    base = {"sharded_exchange_frac": 0.1, "sharded_link_gbps": 25.0}
+    cand = {"sharded_exchange_frac": 0.5, "sharded_link_gbps": 100.0}
+    result = compare(base, cand, threshold=0.05)
+    assert [e["key"] for e in result["regressions"]] == \
+        ["sharded_exchange_frac"]
+
+
 def test_mfu_floor_cli_gate(tmp_path):
     """`pio bench-compare a b --key-threshold two_tower_mfu=0.05` — the
     exact CI invocation — exits 1 when the candidate's MFU falls under
